@@ -1,0 +1,95 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+Brand-new implementation of the capabilities of PaddlePaddle (~v1.8/2.0-rc,
+reference at /root/reference — see SURVEY.md) designed for TPU:
+
+* a Tensor IS a ``jax.Array``; ops are XLA HLO, fused by the compiler
+  (replaces the reference's ProgramDesc interpreter + 650-op kernel registry,
+  paddle/fluid/framework/executor.cc + operators/)
+* training steps are jit-compiled whole-graph (replaces ParallelExecutor SSA
+  graphs, framework/details/)
+* every parallelism strategy is a sharding over a named device Mesh with XLA
+  ICI/DCN collectives (replaces NCCL op handles + transpilers + fleet
+  meta-optimizer program rewriting)
+* Pallas kernels cover the ops XLA won't fuse optimally (flash/ring attention)
+"""
+from .version import __version__  # noqa: F401
+
+import jax as _jax
+
+# Paddle's default index/integer dtype is int64 and float64 tensors are part
+# of the API surface (reference: framework.proto VarType INT64/FP64).  JAX
+# truncates both unless x64 is enabled.  Defaults stay f32/bf16 — model code
+# never sees f64 unless explicitly requested (and TPU computes f32/bf16).
+_jax.config.update("jax_enable_x64", True)
+
+from .framework import (  # noqa: F401
+    float16,
+    float32,
+    float64,
+    bfloat16,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+    bool_,
+    complex64,
+    complex128,
+    set_default_dtype,
+    get_default_dtype,
+    iinfo,
+    finfo,
+    CPUPlace,
+    TPUPlace,
+    CUDAPlace,
+    XPUPlace,
+    set_device,
+    get_device,
+    device_count,
+    is_compiled_with_tpu,
+    is_compiled_with_cuda,
+    set_flags,
+    get_flags,
+    seed,
+    get_rng_state,
+    set_rng_state,
+    Generator,
+)
+
+from .tensor import *  # noqa: F401,F403
+from . import tensor  # noqa: F401
+
+import jax as _jax
+import numpy as _np
+
+#: paddle_tpu.Tensor is jax.Array — no wrapper type (TPU-native design).
+Tensor = _jax.Array
+
+#: paddle.dtype parity: dtypes are numpy dtype objects.
+dtype = _np.dtype
+
+
+def grad_fn(fn, argnums=0, has_aux=False):
+    """Functional gradient — the TPU-native replacement for
+    ``loss.backward()`` (reference: imperative/basic_engine.cc).  JAX's vjp
+    under jit gives the same autodiff coverage as the reference's per-op
+    grad-maker registry (framework/grad_op_desc_maker.h) with zero per-op code."""
+    return _jax.grad(fn, argnums=argnums, has_aux=has_aux)
+
+
+def no_grad(fn=None):
+    """Parity: paddle.no_grad. Differentiation is opt-in (jax.grad) in this
+    framework, so this is an identity decorator/context kept for API parity."""
+    import contextlib
+
+    if fn is None:
+        return contextlib.nullcontext()
+    return fn
+
+
+def to_variable(data, **kwargs):
+    """Legacy dygraph parity alias (ref: python/paddle/fluid/dygraph/base.py)."""
+    from .tensor.creation import to_tensor
+
+    return to_tensor(data, **kwargs)

@@ -1,0 +1,64 @@
+"""paddle_tpu.framework — core runtime services.
+
+TPU-native equivalents of the reference's L1 platform layer
+(paddle/fluid/platform/) and the Python framework glue
+(python/paddle/fluid/framework.py).  There is no ProgramDesc/Scope/Executor
+here: under XLA the "program" is a traced jaxpr compiled per step function,
+so the IR, interpreter, scope tree and garbage collector of the reference
+collapse into ``jax.jit``.
+"""
+from .dtype import (  # noqa: F401
+    float16,
+    float32,
+    float64,
+    bfloat16,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    bool_,
+    complex64,
+    complex128,
+    set_default_dtype,
+    get_default_dtype,
+    convert_dtype,
+    is_floating_point_dtype,
+    is_integer_dtype,
+    iinfo,
+    finfo,
+)
+from .device import (  # noqa: F401
+    Place,
+    CPUPlace,
+    TPUPlace,
+    CUDAPlace,
+    XPUPlace,
+    set_device,
+    get_device,
+    device_count,
+    is_compiled_with_tpu,
+    is_compiled_with_cuda,
+    get_jax_device,
+)
+from .errors import (  # noqa: F401
+    EnforceNotMet,
+    InvalidArgumentError,
+    NotFoundError,
+    OutOfRangeError,
+    UnimplementedError,
+    enforce,
+    enforce_eq,
+)
+from .flags import set_flags, get_flags, define_flag, flag  # noqa: F401
+from .random import (  # noqa: F401
+    Generator,
+    seed,
+    get_rng_state,
+    set_rng_state,
+    default_generator,
+    split_key,
+)

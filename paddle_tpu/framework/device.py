@@ -1,0 +1,162 @@
+"""Device ("Place") management.
+
+TPU-native re-design of the reference's Place / DeviceContext machinery
+(reference: paddle/fluid/platform/place.h:26-103 CPUPlace/CUDAPlace/...,
+paddle/fluid/platform/device_context.h:61 DeviceContextPool,
+python/paddle/device ``set_device``/``get_device``).
+
+On TPU there are no per-device streams/handles to manage — the XLA runtime
+owns contexts and buffers — so a Place reduces to a (kind, index) pair that
+maps to a ``jax.Device``.  ``set_device`` installs the jax default device;
+jit-compiled functions place outputs by sharding, not by Place.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+
+from .errors import InvalidArgumentError, UnavailableError
+
+__all__ = [
+    "Place",
+    "CPUPlace",
+    "TPUPlace",
+    "CUDAPlace",
+    "set_device",
+    "get_device",
+    "device_count",
+    "is_compiled_with_tpu",
+    "is_compiled_with_cuda",
+    "get_jax_device",
+    "XPUPlace",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Place:
+    """Device identity: kind ('cpu'|'tpu'|'gpu') + index.
+
+    Parity: platform::Place (place.h:26); unlike the reference this is not a
+    boost::variant — one dataclass covers all kinds.
+    """
+
+    kind: str
+    index: int = 0
+
+    def __str__(self):
+        return f"{self.kind}:{self.index}"
+
+    def jax_device(self) -> jax.Device:
+        devs = [d for d in jax.devices() if _kind_of(d) == self.kind]
+        if not devs:
+            # fall back to cpu backend (always present)
+            if self.kind == "cpu":
+                devs = jax.devices("cpu")
+            else:
+                raise UnavailableError(
+                    f"No {self.kind} devices available; jax.devices()={jax.devices()}"
+                )
+        if self.index >= len(devs):
+            raise InvalidArgumentError(
+                f"Device index {self.index} out of range for {self.kind} "
+                f"({len(devs)} available)"
+            )
+        return devs[self.index]
+
+
+def CPUPlace(index: int = 0) -> Place:
+    return Place("cpu", index)
+
+
+def TPUPlace(index: int = 0) -> Place:
+    return Place("tpu", index)
+
+
+def CUDAPlace(index: int = 0) -> Place:
+    """Parity alias: maps to 'gpu' backend if jax has one."""
+    return Place("gpu", index)
+
+
+def XPUPlace(index: int = 0) -> Place:
+    """Parity with the reference's Kunlun XPUPlace (place.h:62): on this
+    framework every accelerator is reached through XLA, so XPU maps to the
+    default accelerator kind."""
+    return Place(_default_accel_kind(), index)
+
+
+def _kind_of(d: jax.Device) -> str:
+    plat = d.platform.lower()
+    if plat in ("tpu", "axon"):
+        return "tpu"
+    if plat in ("gpu", "cuda", "rocm"):
+        return "gpu"
+    return "cpu"
+
+
+def _default_accel_kind() -> str:
+    for d in jax.devices():
+        k = _kind_of(d)
+        if k != "cpu":
+            return k
+    return "cpu"
+
+
+_current_place: Optional[Place] = None
+
+
+def set_device(device) -> Place:
+    """Parity: ``paddle.set_device('tpu')`` / ``paddle.set_device('cpu')``.
+
+    Accepts 'tpu', 'tpu:0', 'cpu', 'gpu:1' or a Place. Installs the matching
+    jax default device so eager ops land there.
+    """
+    global _current_place
+    if isinstance(device, Place):
+        place = device
+    else:
+        s = str(device).lower()
+        if ":" in s:
+            kind, idx = s.split(":", 1)
+            place = Place(kind, int(idx))
+        else:
+            place = Place(s, 0)
+    jdev = place.jax_device()
+    jax.config.update("jax_default_device", jdev)
+    _current_place = place
+    return place
+
+
+def get_device() -> str:
+    """Parity: ``paddle.get_device`` — returns e.g. 'tpu:0'."""
+    global _current_place
+    if _current_place is None:
+        d = jax.devices()[0]
+        _current_place = Place(_kind_of(d), 0)
+    return str(_current_place)
+
+
+def get_jax_device() -> jax.Device:
+    """The jax.Device eager ops currently target."""
+    global _current_place
+    if _current_place is None:
+        get_device()
+    return _current_place.jax_device()
+
+
+def device_count(kind: Optional[str] = None) -> int:
+    """Number of visible devices of ``kind`` (default: current kind)."""
+    kind = kind or (_current_place.kind if _current_place else _default_accel_kind())
+    return len([d for d in jax.devices() if _kind_of(d) == kind]) or (
+        len(jax.devices("cpu")) if kind == "cpu" else 0
+    )
+
+
+def is_compiled_with_tpu() -> bool:
+    """True when a TPU backend is visible (parity shape: is_compiled_with_cuda)."""
+    return any(_kind_of(d) == "tpu" for d in jax.devices())
+
+
+def is_compiled_with_cuda() -> bool:
+    return any(_kind_of(d) == "gpu" for d in jax.devices())

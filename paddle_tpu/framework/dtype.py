@@ -1,0 +1,151 @@
+"""Dtype registry for paddle_tpu.
+
+TPU-native re-design of the reference's VarType/proto dtype system
+(reference: paddle/fluid/framework/framework.proto:104 ``VarType.Type``;
+python/paddle/fluid/data_feeder.py convert_dtype).  Instead of a protobuf
+enum we map paddle-style dtype names directly onto numpy/jax dtypes; the
+default float dtype is process-global like
+``paddle.set_default_dtype`` (python/paddle/fluid/framework.py).
+
+On TPU the preferred compute dtype is bfloat16 (MXU-native); float32 stays
+the default for parity with the reference API, and AMP (paddle_tpu.amp)
+switches matmul-heavy ops to bf16.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes  # ships with jax
+
+__all__ = [
+    "dtype",
+    "float16",
+    "float32",
+    "float64",
+    "bfloat16",
+    "int8",
+    "int16",
+    "int32",
+    "int64",
+    "uint8",
+    "uint16",
+    "uint32",
+    "uint64",
+    "bool_",
+    "complex64",
+    "complex128",
+    "set_default_dtype",
+    "get_default_dtype",
+    "convert_dtype",
+    "is_floating_point_dtype",
+    "is_integer_dtype",
+    "iinfo",
+    "finfo",
+]
+
+# Canonical dtype objects (numpy dtype instances; jax consumes these directly).
+dtype = np.dtype
+
+float16 = np.dtype("float16")
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+uint8 = np.dtype("uint8")
+uint16 = np.dtype("uint16")
+uint32 = np.dtype("uint32")
+uint64 = np.dtype("uint64")
+bool_ = np.dtype("bool")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+_ALIASES = {
+    "float16": float16,
+    "fp16": float16,
+    "half": float16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int": int32,
+    "int64": int64,
+    "long": int64,
+    "uint8": uint8,
+    "uint16": uint16,
+    "uint32": uint32,
+    "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_FLOATING = {float16, float32, float64, bfloat16}
+_INTEGER = {int8, int16, int32, int64, uint8, uint16, uint32, uint64}
+
+_default_dtype = float32
+
+
+def convert_dtype(d) -> np.dtype:
+    """Normalize a user-supplied dtype (str / numpy / jax dtype) to np.dtype.
+
+    Mirrors ``paddle.fluid.data_feeder.convert_dtype`` but returns a numpy
+    dtype usable by jax instead of a VarType enum.
+    """
+    if d is None:
+        return get_default_dtype()
+    if isinstance(d, str):
+        key = d.lower()
+        if key in _ALIASES:
+            return _ALIASES[key]
+        raise TypeError(f"Unsupported dtype string: {d!r}")
+    try:
+        return np.dtype(d)
+    except TypeError as e:
+        raise TypeError(f"Unsupported dtype: {d!r}") from e
+
+
+def set_default_dtype(d):
+    """Set the process-global default float dtype (float32/float64/bfloat16/float16).
+
+    Parity: ``paddle.set_default_dtype``.
+    """
+    global _default_dtype
+    nd = convert_dtype(d)
+    if nd not in _FLOATING:
+        raise TypeError(
+            f"set_default_dtype only accepts floating dtypes, got {nd}"
+        )
+    _default_dtype = nd
+
+
+def get_default_dtype() -> np.dtype:
+    """Parity: ``paddle.get_default_dtype``."""
+    return _default_dtype
+
+
+def is_floating_point_dtype(d) -> bool:
+    return convert_dtype(d) in _FLOATING
+
+
+def is_integer_dtype(d) -> bool:
+    return convert_dtype(d) in _INTEGER
+
+
+def iinfo(d):
+    """Parity: ``paddle.iinfo``."""
+    return jnp.iinfo(convert_dtype(d))
+
+
+def finfo(d):
+    """Parity: ``paddle.finfo``."""
+    return jnp.finfo(convert_dtype(d))

@@ -1,0 +1,90 @@
+"""Enforce-style error helpers.
+
+TPU-native equivalent of ``PADDLE_ENFORCE*`` and ``platform::errors``
+(reference: paddle/fluid/platform/enforce.h; errors typed as
+InvalidArgument/NotFound/OutOfRange/... in paddle/fluid/platform/errors.h).
+We keep the typed-error taxonomy (it surfaces in user-visible messages and in
+tests) but implement it as plain Python exceptions — the XLA runtime already
+produces rich device-side errors, so no status-decoding layer is needed.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "EnforceNotMet",
+    "InvalidArgumentError",
+    "NotFoundError",
+    "OutOfRangeError",
+    "AlreadyExistsError",
+    "PermissionDeniedError",
+    "UnimplementedError",
+    "UnavailableError",
+    "PreconditionNotMetError",
+    "ExecutionTimeoutError",
+    "enforce",
+    "enforce_eq",
+    "enforce_gt",
+    "enforce_shape_rank",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base error, parity with paddle's EnforceNotMet."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    pass
+
+
+def enforce(cond, msg="", error_cls=InvalidArgumentError):
+    """PADDLE_ENFORCE equivalent: raise ``error_cls`` when ``cond`` is falsy."""
+    if not cond:
+        raise error_cls(msg)
+
+
+def enforce_eq(a, b, msg="", error_cls=InvalidArgumentError):
+    if a != b:
+        raise error_cls(f"expected {a!r} == {b!r}. {msg}")
+
+
+def enforce_gt(a, b, msg="", error_cls=InvalidArgumentError):
+    if not a > b:
+        raise error_cls(f"expected {a!r} > {b!r}. {msg}")
+
+
+def enforce_shape_rank(shape, rank, name="input"):
+    if len(shape) != rank:
+        raise InvalidArgumentError(
+            f"{name} expected rank {rank}, got shape {tuple(shape)}"
+        )

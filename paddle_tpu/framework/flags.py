@@ -1,0 +1,97 @@
+"""Global flag registry.
+
+TPU-native re-design of the reference's gflags system
+(reference: paddle/fluid/platform/flags.cc:33-560 defines ~30 FLAGS_*;
+python/paddle/fluid/framework.py:5676 ``set_flags``; flags are overridable
+via FLAGS_* environment variables at import time, see
+paddle/fluid/platform/init.cc).
+
+Here flags are a typed in-process registry. Environment variables named
+``FLAGS_<name>`` seed the initial value (same convention as the reference).
+XLA-level knobs (memory fraction etc.) are owned by the XLA runtime; the
+flags kept here are the framework-behavior ones.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict
+
+from .errors import NotFoundError, InvalidArgumentError
+
+__all__ = ["define_flag", "set_flags", "get_flags", "flag"]
+
+_REGISTRY: Dict[str, dict] = {}
+
+
+def _parse_bool(s: str) -> bool:
+    return s.lower() in ("1", "true", "yes", "on")
+
+
+def define_flag(name: str, default: Any, help_str: str = "", type_: type | None = None):
+    """Register a flag. Env var FLAGS_<name> overrides the default."""
+    t = type_ or type(default)
+    value = default
+    env = os.environ.get(f"FLAGS_{name}")
+    if env is not None:
+        if t is bool:
+            value = _parse_bool(env)
+        else:
+            value = t(env)
+    _REGISTRY[name] = {"value": value, "default": default, "type": t, "help": help_str}
+    return value
+
+
+def set_flags(flags: Dict[str, Any]):
+    """Parity: ``paddle.set_flags`` (python/paddle/fluid/framework.py:5676)."""
+    for name, value in flags.items():
+        if name not in _REGISTRY:
+            raise NotFoundError(f"Unknown flag {name!r}")
+        t = _REGISTRY[name]["type"]
+        if t is bool and isinstance(value, str):
+            value = _parse_bool(value)
+        try:
+            _REGISTRY[name]["value"] = t(value)
+        except (TypeError, ValueError) as e:
+            raise InvalidArgumentError(f"Bad value for flag {name}: {value!r}") from e
+
+
+def get_flags(names) -> Dict[str, Any]:
+    """Parity: ``paddle.get_flags``."""
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for name in names:
+        if name not in _REGISTRY:
+            raise NotFoundError(f"Unknown flag {name!r}")
+        out[name] = _REGISTRY[name]["value"]
+    return out
+
+
+def flag(name: str) -> Any:
+    """Fast single-flag read for internal use."""
+    return _REGISTRY[name]["value"]
+
+
+# ---------------------------------------------------------------------------
+# Core flags (subset of platform/flags.cc that still makes sense on TPU).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False,
+            "Sweep op outputs for NaN/Inf during training "
+            "(ref: FLAGS_check_nan_inf, platform/flags.cc:44).")
+define_flag("sort_sum_gradient", False,
+            "Deterministic gradient accumulation order "
+            "(ref: FLAGS_sort_sum_gradient, platform/flags.cc:521). "
+            "On XLA gradients are already deterministic; flag kept for API parity.")
+define_flag("benchmark", False,
+            "Synchronous benchmarking mode: block_until_ready after each step "
+            "(ref: FLAGS_benchmark).")
+define_flag("paddle_num_threads", 1,
+            "Host-side worker threads for data feeding "
+            "(ref: FLAGS_paddle_num_threads).")
+define_flag("use_system_allocator", False,
+            "Ignored on TPU: buffers are owned by the XLA runtime "
+            "(ref: FLAGS_use_system_allocator).")
+define_flag("eager_delete_tensor_gb", 0.0,
+            "Ignored on TPU: XLA owns buffer lifetimes; kept for parity "
+            "(ref: FLAGS_eager_delete_tensor_gb).")
+define_flag("log_level", 0, "Verbosity for paddle_tpu host-side logging.")

@@ -1,0 +1,80 @@
+"""RNG seed management.
+
+TPU-native re-design of the reference's Generator
+(reference: paddle/fluid/framework/generator.cc — global + per-device
+generators seeded by ``paddle.seed``; python/paddle/framework/random.py).
+
+JAX RNG is functional (explicit keys), which is what XLA needs for
+reproducible, parallelizable randomness.  We keep paddle's ``seed()``
+ergonomics with a process-global Generator that *splits* a fresh subkey for
+every eager random op.  Inside jit-traced functions, random ops must receive
+keys explicitly (the layer system plumbs them via ``rngs=`` in
+``paddle_tpu.nn.functional_call``) — a global mutable generator inside a
+traced function would bake one key into the compiled executable.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+__all__ = ["Generator", "seed", "get_rng_state", "set_rng_state", "default_generator", "split_key"]
+
+
+class Generator:
+    """Counter-based key source. Thread-safe; each ``next_key`` is unique."""
+
+    def __init__(self, seed_: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed_)
+
+    def manual_seed(self, seed_: int):
+        with self._lock:
+            self._seed = int(seed_)
+            self._count = 0
+        return self
+
+    def next_key(self) -> jax.Array:
+        with self._lock:
+            c = self._count
+            self._count += 1
+        return jax.random.fold_in(jax.random.PRNGKey(self._seed), c)
+
+    def get_state(self):
+        with self._lock:
+            return {"seed": self._seed, "count": self._count}
+
+    def set_state(self, state):
+        with self._lock:
+            self._seed = int(state["seed"])
+            self._count = int(state["count"])
+
+
+_default = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default
+
+
+def seed(seed_: int) -> Generator:
+    """Parity: ``paddle.seed`` — reseeds the global generator."""
+    return _default.manual_seed(seed_)
+
+
+def split_key(key: Optional[jax.Array] = None) -> jax.Array:
+    """Fresh key: from ``key`` if given (pure) else from the global generator."""
+    if key is not None:
+        return key
+    return _default.next_key()
+
+
+def get_rng_state():
+    """Parity: ``paddle.get_rng_state`` (opaque state blob)."""
+    return _default.get_state()
+
+
+def set_rng_state(state):
+    """Parity: ``paddle.set_rng_state``."""
+    _default.set_state(state)

@@ -1,0 +1,22 @@
+"""paddle_tpu.tensor — the tensor function library.
+
+A paddle_tpu Tensor IS a ``jax.Array``; this package provides the
+paddle-2.0-parity free functions over it (reference surface:
+python/paddle/tensor/).  There is no OpKernel registry: each function maps to
+one or a few XLA HLO ops, and the XLA compiler does kernel selection, fusion,
+layout and memory planning (replacing the reference's
+framework/operator.h kernel dispatch + framework/ir/ passes).
+"""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .attribute import *  # noqa: F401,F403
+
+from . import creation, math, manipulation, linalg, logic, random, search, stat, attribute  # noqa: F401
+
+# stat exports under distinct names to avoid clobbering math.mean (identical behavior)
+from .stat import std, var, quantile, nanquantile, histogramdd  # noqa: F401

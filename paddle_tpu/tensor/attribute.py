@@ -1,0 +1,35 @@
+"""Tensor attribute helpers + einsum.
+
+Parity surface: python/paddle/tensor/attribute.py, einsum.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["shape", "rank", "is_complex", "is_floating_point", "is_integer", "einsum"]
+
+
+def shape(x, name=None):
+    return jnp.asarray(jnp.shape(x), dtype=jnp.int32)
+
+
+def rank(x, name=None):
+    return jnp.asarray(jnp.ndim(x))
+
+
+def is_complex(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer)
+
+
+def einsum(equation, *operands):
+    """Parity: paddle.einsum — maps straight to XLA dot_general chains."""
+    return jnp.einsum(equation, *operands)

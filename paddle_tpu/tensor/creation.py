@@ -1,0 +1,195 @@
+"""Tensor creation ops.
+
+Parity surface: python/paddle/tensor/creation.py (reference).  A
+``paddle_tpu.Tensor`` IS a ``jax.Array`` — there is no wrapper class.  The
+reference's LoDTensor ragged batching (paddle/fluid/framework/lod_tensor.h:114)
+is deliberately not reproduced: XLA wants static shapes, so ragged data is
+handled by padding + masks at the data-pipeline level (see paddle_tpu.io).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as _dt
+from ..framework.errors import InvalidArgumentError
+
+__all__ = [
+    "to_tensor",
+    "zeros",
+    "ones",
+    "full",
+    "zeros_like",
+    "ones_like",
+    "full_like",
+    "empty",
+    "empty_like",
+    "arange",
+    "linspace",
+    "logspace",
+    "eye",
+    "meshgrid",
+    "diag",
+    "diagflat",
+    "tril",
+    "triu",
+    "tril_indices",
+    "triu_indices",
+    "assign",
+    "clone",
+    "complex",
+    "real",
+    "imag",
+    "numel",
+    "one_hot",
+]
+
+
+def _resolve(dtype):
+    return _dt.convert_dtype(dtype) if dtype is not None else None
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """Parity: ``paddle.to_tensor``. Returns a jax.Array on the current device.
+
+    ``stop_gradient`` is accepted for API parity; differentiation in this
+    framework is functional (jax.grad), so the flag is a no-op.
+    """
+    del stop_gradient
+    if isinstance(data, (list, tuple)) and any(
+        isinstance(x, jax.Array) for x in jax.tree_util.tree_leaves(data)
+    ):
+        data = jnp.asarray(data)
+    arr = jnp.asarray(data, dtype=_resolve(dtype))
+    if arr.dtype == jnp.float64 and dtype is None:
+        # numpy default float64 → framework default float, like paddle
+        arr = arr.astype(_dt.get_default_dtype())
+    if place is not None:
+        arr = jax.device_put(arr, place.jax_device() if hasattr(place, "jax_device") else place)
+    return arr
+
+
+def zeros(shape, dtype=None):
+    return jnp.zeros(shape, dtype=_resolve(dtype) or _dt.get_default_dtype())
+
+
+def ones(shape, dtype=None):
+    return jnp.ones(shape, dtype=_resolve(dtype) or _dt.get_default_dtype())
+
+
+def full(shape, fill_value, dtype=None):
+    # paddle.full defaults to the framework float dtype regardless of the
+    # python type of fill_value
+    if dtype is None and not isinstance(fill_value, bool) and isinstance(fill_value, (int, float)):
+        return jnp.full(shape, fill_value, dtype=_dt.get_default_dtype())
+    return jnp.full(shape, fill_value, dtype=_resolve(dtype) if dtype is not None else None)
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=_resolve(dtype))
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=_resolve(dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=_resolve(dtype))
+
+
+def empty(shape, dtype=None):
+    # XLA has no uninitialized buffers; zeros compiles to a cheap broadcast.
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, dtype=_resolve(dtype))
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, int(num), dtype=_resolve(dtype) or _dt.get_default_dtype())
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, int(num), base=base, dtype=_resolve(dtype) or _dt.get_default_dtype())
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns, dtype=_resolve(dtype) or _dt.get_default_dtype())
+
+
+def meshgrid(*args):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return list(jnp.meshgrid(*args, indexing="ij"))
+
+
+def diag(x, offset=0, padding_value=0):
+    x = jnp.asarray(x)
+    if x.ndim == 1 and padding_value != 0:
+        d = jnp.diag(x, k=offset)
+        mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+        return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+    return jnp.diag(x, k=offset)
+
+
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril_indices(row, col, offset=0):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return jnp.stack([r, c])
+
+
+def triu_indices(row, col=None, offset=0):
+    r, c = jnp.triu_indices(row, k=offset, m=col if col is not None else row)
+    return jnp.stack([r, c])
+
+
+def assign(x, output=None):
+    """Parity: ``paddle.assign``. Functional: returns a copy; ``output`` ignored
+    (XLA buffers are immutable — in-place assign does not exist on TPU)."""
+    del output
+    return jnp.asarray(x).copy() if isinstance(x, jax.Array) else jnp.asarray(np.asarray(x))
+
+
+def clone(x):
+    return jnp.asarray(x).copy()
+
+
+def complex(real_, imag_):
+    return jax.lax.complex(jnp.asarray(real_), jnp.asarray(imag_))
+
+
+def real(x):
+    return jnp.real(x)
+
+
+def imag(x):
+    return jnp.imag(x)
+
+
+def numel(x):
+    return jnp.asarray(jnp.size(x))
+
+
+def one_hot(x, num_classes):
+    if num_classes <= 0:
+        raise InvalidArgumentError("num_classes must be > 0")
+    return jax.nn.one_hot(jnp.asarray(x), num_classes, dtype=_dt.get_default_dtype())

@@ -1,0 +1,253 @@
+"""Linear algebra ops.
+
+Parity surface: python/paddle/tensor/linalg.py and the reference's
+matmul/mul ops (paddle/fluid/operators/matmul_op.cc, math/blas.h cuBLAS
+wrapper).  On TPU every matmul maps to the MXU via a single XLA dot_general —
+the entire Blas wrapper layer of the reference collapses into
+``jax.lax.dot_general`` with an appropriate ``preferred_element_type``
+(float32 accumulation for bf16 inputs, matching cuBLAS tensor-op math mode).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as _dt
+
+__all__ = [
+    "matmul", "dot", "mm", "bmm", "mv", "t", "transpose_", "norm", "dist",
+    "cond", "cov", "corrcoef", "cholesky", "cholesky_solve", "inverse", "det",
+    "slogdet", "matrix_rank", "matrix_power", "qr", "lu", "svd", "pinv",
+    "solve", "triangular_solve", "lstsq", "eig", "eigh", "eigvals", "eigvalsh",
+    "multi_dot", "cross", "histogram", "bincount", "householder_product",
+    "matrix_exp", "pca_lowrank",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """Parity: paddle.matmul (ref: operators/matmul_op.cc).
+
+    bf16 inputs accumulate in f32 on the MXU (preferred_element_type), which
+    matches the reference's cuBLAS CUBLAS_COMPUTE_32F on tensor cores.
+    """
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    if x.dtype == _dt.bfloat16 or y.dtype == _dt.bfloat16:
+        return jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(
+            jnp.result_type(x.dtype, y.dtype)
+        )
+    return jnp.matmul(x, y)
+
+
+def dot(x, y, name=None):
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if x.ndim == 2:
+        return jnp.sum(x * y, axis=-1)
+    return jnp.dot(x, y)
+
+
+def mm(input, mat2, name=None):
+    return jnp.matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return jnp.matmul(x, y)
+
+
+def mv(x, vec, name=None):
+    return jnp.matmul(x, vec)
+
+
+def t(input, name=None):
+    x = jnp.asarray(input)
+    if x.ndim < 2:
+        return x
+    return jnp.swapaxes(x, -1, -2)
+
+
+def transpose_(x, perm, name=None):
+    return jnp.transpose(x, axes=perm)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    x = jnp.asarray(x)
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(_dt.get_default_dtype())
+    if p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x), keepdims=keepdim))
+        return jnp.linalg.norm(x, ord="fro" if isinstance(axis, (tuple, list)) else None,
+                               axis=tuple(axis) if isinstance(axis, (tuple, list)) else axis,
+                               keepdims=keepdim)
+    if p == "nuc":
+        return jnp.linalg.norm(x, ord="nuc", axis=tuple(axis), keepdims=keepdim)
+    if axis is None:
+        x = x.ravel()
+        axis = 0
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis if not isinstance(axis, list) else tuple(axis), keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis if not isinstance(axis, list) else tuple(axis), keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    ax = tuple(axis) if isinstance(axis, (tuple, list)) else axis
+    return jnp.sum(jnp.abs(x) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(jnp.asarray(x) - jnp.asarray(y), p=p)
+
+
+def cond(x, p=None, name=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fweights, aweights=aweights)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def cholesky(x, upper=False, name=None):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return jax.scipy.linalg.cho_solve((jnp.asarray(y), not upper), jnp.asarray(x))
+
+
+def inverse(x, name=None):
+    return jnp.linalg.inv(x)
+
+
+def det(x, name=None):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x, name=None):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+def matrix_power(x, n, name=None):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def qr(x, mode="reduced", name=None):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = jax.scipy.linalg.lu_factor(jnp.asarray(x))
+    if get_infos:
+        return lu_, piv.astype(jnp.int32) + 1, jnp.zeros((), jnp.int32)
+    return lu_, piv.astype(jnp.int32) + 1
+
+
+def svd(x, full_matrices=False, name=None):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def solve(x, y, name=None):
+    return jnp.linalg.solve(x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return jax.scipy.linalg.solve_triangular(
+        jnp.asarray(x), jnp.asarray(y), lower=not upper,
+        trans=1 if transpose else 0, unit_diagonal=unitriangular)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(jnp.asarray(x), jnp.asarray(y), rcond=rcond)
+    return sol, res, rank, sv
+
+
+def eig(x, name=None):
+    """General eig: XLA supports it on CPU only; runs via host callback there."""
+    import numpy as np
+
+    w, v = np.linalg.eig(np.asarray(x))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigvals(x, name=None):
+    import numpy as np
+
+    return jnp.asarray(np.linalg.eigvals(np.asarray(x)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def multi_dot(x, name=None):
+    return jnp.linalg.multi_dot(list(x))
+
+
+def cross(x, y, axis=9, name=None):
+    x = jnp.asarray(x)
+    if axis == 9:
+        # paddle default: first axis with dim 3
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return jnp.cross(x, jnp.asarray(y), axis=axis)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    x = jnp.asarray(input).ravel()
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return hist.astype(jnp.int64)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    return jnp.bincount(jnp.asarray(x).astype(jnp.int32), weights=weights,
+                        minlength=minlength, length=None)
+
+
+def householder_product(x, tau, name=None):
+    import numpy as np
+    from scipy.linalg import lapack  # scipy ships with the image
+
+    a = np.asarray(x)
+    t = np.asarray(tau)
+    q, _, _ = lapack.dorgqr(a.astype(np.float64), t.astype(np.float64))
+    return jnp.asarray(q.astype(a.dtype))
+
+
+def matrix_exp(x, name=None):
+    return jax.scipy.linalg.expm(jnp.asarray(x))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    x = jnp.asarray(x)
+    m, n = x.shape[-2], x.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        x = x - jnp.mean(x, axis=-2, keepdims=True)
+    u, s, vt = jnp.linalg.svd(x, full_matrices=False)
+    return u[..., :q], s[..., :q], jnp.swapaxes(vt, -1, -2)[..., :q]

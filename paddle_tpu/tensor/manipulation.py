@@ -1,0 +1,438 @@
+"""Shape/layout manipulation ops.
+
+Parity surface: python/paddle/tensor/manipulation.py and the reference ops
+reshape/transpose/concat/split/gather/scatter/... (paddle/fluid/operators/).
+All are pure XLA metadata or data-movement ops; the compiler fuses or
+eliminates most of them.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as _dt
+from ..framework.errors import InvalidArgumentError
+
+__all__ = [
+    "reshape", "flatten", "squeeze", "unsqueeze", "transpose", "moveaxis",
+    "concat", "stack", "unstack", "split", "chunk", "tile", "expand",
+    "expand_as", "broadcast_to", "broadcast_tensors", "flip", "rot90", "roll",
+    "gather", "gather_nd", "scatter", "scatter_nd", "scatter_nd_add",
+    "index_select", "index_sample", "index_add", "index_put", "put_along_axis",
+    "take_along_axis", "slice", "strided_slice", "crop", "pad", "cast",
+    "repeat_interleave", "unbind", "unique", "unique_consecutive",
+    "masked_select", "masked_fill", "as_complex", "as_real", "view", "view_as",
+    "tensordot", "atleast_1d", "atleast_2d", "atleast_3d", "tolist",
+    "shard_index", "tensor_split", "hsplit", "vsplit", "dsplit",
+    "hstack", "vstack", "dstack", "column_stack", "row_stack",
+]
+
+
+def reshape(x, shape, name=None):
+    return jnp.reshape(x, tuple(shape) if not isinstance(shape, int) else (shape,))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return jnp.reshape(x, tuple(shape_or_dtype))
+    return jnp.asarray(x).view(_dt.convert_dtype(shape_or_dtype))
+
+
+def view_as(x, other, name=None):
+    return jnp.reshape(x, jnp.shape(other))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = jnp.asarray(x)
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    s = start_axis % nd
+    e = stop_axis % nd
+    if s > e:
+        raise InvalidArgumentError("start_axis must be <= stop_axis")
+    new_shape = x.shape[:s] + (-1,) + x.shape[e + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+def squeeze(x, axis=None, name=None):
+    x = jnp.asarray(x)
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = [axis]
+    axis = tuple(a % x.ndim for a in axis if x.shape[a % x.ndim] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.expand_dims(x, tuple(axis))
+
+
+def transpose(x, perm=None, name=None):
+    return jnp.transpose(x, axes=perm)
+
+
+def moveaxis(x, source, destination, name=None):
+    return jnp.moveaxis(x, source, destination)
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, jax.Array):
+        axis = int(axis)
+    return jnp.concatenate(list(x), axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return jnp.stack(list(x), axis=axis)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = jnp.asarray(x)
+    n = num if num is not None else x.shape[axis]
+    return [jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis)]
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = jnp.asarray(x)
+    if isinstance(axis, jax.Array):
+        axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return list(jnp.split(x, num_or_sections, axis=axis))
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if any(s == -1 for s in sections):
+        known = builtins.sum(s for s in sections if s != -1)
+        sections = [total - known if s == -1 else s for s in sections]
+    idx = []
+    acc = 0
+    for s in sections[:-1]:
+        acc += s
+        idx.append(acc)
+    return list(jnp.split(x, idx, axis=axis))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return list(jnp.array_split(jnp.asarray(x), chunks, axis=axis))
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    return list(jnp.array_split(jnp.asarray(x), num_or_indices, axis=axis))
+
+
+def hsplit(x, num_or_indices, name=None):
+    return list(jnp.hsplit(jnp.asarray(x), num_or_indices))
+
+
+def vsplit(x, num_or_indices, name=None):
+    return list(jnp.vsplit(jnp.asarray(x), num_or_indices))
+
+
+def dsplit(x, num_or_indices, name=None):
+    return list(jnp.dsplit(jnp.asarray(x), num_or_indices))
+
+
+def hstack(x, name=None):
+    return jnp.hstack(list(x))
+
+
+def vstack(x, name=None):
+    return jnp.vstack(list(x))
+
+
+def dstack(x, name=None):
+    return jnp.dstack(list(x))
+
+
+def column_stack(x, name=None):
+    return jnp.column_stack(list(x))
+
+
+row_stack = vstack
+
+
+def tile(x, repeat_times, name=None):
+    return jnp.tile(x, tuple(repeat_times))
+
+
+def expand(x, shape, name=None):
+    x = jnp.asarray(x)
+    shape = tuple(
+        x.shape[i - (len(shape) - x.ndim)] if s in (-1,) else s
+        for i, s in enumerate(shape)
+    )
+    return jnp.broadcast_to(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return jnp.broadcast_to(x, jnp.shape(y))
+
+
+def broadcast_to(x, shape, name=None):
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    return list(jnp.broadcast_arrays(*inputs))
+
+
+def flip(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    """Parity: paddle.gather (ref: operators/gather_op.cc) — select rows."""
+    return jnp.take(jnp.asarray(x), jnp.asarray(index).astype(jnp.int32), axis=axis)
+
+
+def gather_nd(x, index, name=None):
+    """Parity: paddle.gather_nd (ref: operators/gather_nd_op.cc)."""
+    x = jnp.asarray(x)
+    index = jnp.asarray(index)
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    """Parity: paddle.scatter (ref: operators/scatter_op.cc) — row scatter."""
+    x = jnp.asarray(x)
+    index = jnp.asarray(index).astype(jnp.int32).reshape(-1)
+    updates = jnp.asarray(updates, x.dtype)
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle semantics: non-overwrite zeroes target rows then accumulates
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x = jnp.asarray(x)
+    index = jnp.asarray(index)
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(jnp.asarray(updates, x.dtype))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    zeros = jnp.zeros(tuple(shape), dtype=jnp.asarray(updates).dtype)
+    return scatter_nd_add(zeros, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return jnp.take(jnp.asarray(x), jnp.asarray(index).astype(jnp.int32), axis=axis)
+
+
+def index_sample(x, index, name=None):
+    """Parity: paddle.index_sample — per-row gather (ref: operators/index_sample_op.cc)."""
+    x = jnp.asarray(x)
+    index = jnp.asarray(index).astype(jnp.int32)
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_add(x, index, axis, value, name=None):
+    x = jnp.asarray(x)
+    index = jnp.asarray(index).astype(jnp.int32)
+    x_moved = jnp.moveaxis(x, axis, 0)
+    v_moved = jnp.moveaxis(jnp.asarray(value, x.dtype), axis, 0)
+    out = x_moved.at[index].add(v_moved)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = jnp.asarray(x)
+    idx = tuple(jnp.asarray(i) for i in indices)
+    if accumulate:
+        return x.at[idx].add(jnp.asarray(value, x.dtype))
+    return x.at[idx].set(jnp.asarray(value, x.dtype))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    arr = jnp.asarray(arr)
+    indices = jnp.asarray(indices).astype(jnp.int32)
+    values = jnp.broadcast_to(jnp.asarray(values, arr.dtype), indices.shape)
+    dims = list(range(arr.ndim))
+    idx = tuple(
+        indices if d == axis else jax.lax.broadcasted_iota(jnp.int32, indices.shape, d)
+        for d in dims
+    )
+    if reduce == "assign":
+        return arr.at[idx].set(values)
+    if reduce == "add":
+        return arr.at[idx].add(values)
+    if reduce in ("mul", "multiply"):
+        return arr.at[idx].multiply(values)
+    raise InvalidArgumentError(f"unknown reduce {reduce!r}")
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return jnp.take_along_axis(jnp.asarray(arr), jnp.asarray(indices).astype(jnp.int32), axis=axis)
+
+
+def slice(input, axes, starts, ends, name=None):
+    """Parity: paddle.slice (ref: operators/slice_op.cc)."""
+    x = jnp.asarray(input)
+    slices = [builtins.slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        slices[ax] = builtins.slice(int(st), int(en))
+    return x[tuple(slices)]
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = jnp.asarray(x)
+    slices = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        slices[ax] = builtins.slice(int(st), int(en), int(sd))
+    return x[tuple(slices)]
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = jnp.asarray(x)
+    shape = list(shape) if shape is not None else list(x.shape)
+    offsets = list(offsets) if offsets is not None else [0] * x.ndim
+    shape = [x.shape[i] - offsets[i] if s == -1 else s for i, s in enumerate(shape)]
+    return jax.lax.dynamic_slice(x, offsets, shape)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """Parity: paddle.nn.functional.pad / paddle.pad (ref: operators/pad_op.cc).
+
+    ``pad`` is either a flat list covering all dims (paddle's pad2d style:
+    last-dim-first pairs) or len(2*ndim) covering every dim.
+    """
+    x = jnp.asarray(x)
+    pad = list(pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full spec, paddle order = [dim0_lo, dim0_hi, dim1_lo, ...]? The
+        # reference uses per-dim pairs in dim order for paddle.pad.
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial spec applies to trailing spatial dims, last dim first
+        npairs = len(pad) // 2
+        widths = [(0, 0)] * nd
+        for i in range(npairs):
+            dim = nd - 1 - i
+            widths[dim] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, widths, mode="constant", constant_values=value)
+    return jnp.pad(x, widths, mode=jmode)
+
+
+def cast(x, dtype, name=None):
+    return jnp.asarray(x).astype(_dt.convert_dtype(dtype))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def unbind(input, axis=0, name=None):
+    return unstack(input, axis=axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, name=None):
+    """NOTE: output size is data-dependent — not jittable; eager/host-side only,
+    same as the reference's unique op which runs on CPU for index outputs."""
+    import numpy as np
+
+    xs = np.asarray(x)
+    res = np.unique(xs, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return jnp.asarray(res)
+    return tuple(jnp.asarray(r) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, name=None):
+    import numpy as np
+
+    xs = np.asarray(x)
+    if axis is None:
+        xs = xs.ravel()
+        axis = 0
+    changed = np.ones(xs.shape[axis], dtype=bool)
+    if xs.shape[axis] > 1:
+        sl = np.any(
+            np.take(xs, range(1, xs.shape[axis]), axis=axis)
+            != np.take(xs, range(0, xs.shape[axis] - 1), axis=axis),
+            axis=tuple(i for i in range(xs.ndim) if i != axis),
+        ) if xs.ndim > 1 else (
+            np.take(xs, range(1, xs.shape[axis])) != np.take(xs, range(0, xs.shape[axis] - 1))
+        )
+        changed[1:] = sl
+    idx = np.nonzero(changed)[0]
+    out = np.take(xs, idx, axis=axis)
+    rets = [jnp.asarray(out)]
+    if return_inverse:
+        inv = np.cumsum(changed) - 1
+        rets.append(jnp.asarray(inv))
+    if return_counts:
+        counts = np.diff(np.append(idx, xs.shape[axis]))
+        rets.append(jnp.asarray(counts))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def masked_select(x, mask, name=None):
+    """Data-dependent output size — eager/host-side only."""
+    import numpy as np
+
+    return jnp.asarray(np.asarray(x)[np.asarray(mask)])
+
+
+def masked_fill(x, mask, value, name=None):
+    x = jnp.asarray(x)
+    return jnp.where(jnp.asarray(mask, bool), jnp.asarray(value, x.dtype), x)
+
+
+def as_complex(x, name=None):
+    x = jnp.asarray(x)
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_real(x, name=None):
+    x = jnp.asarray(x)
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def tensordot(x, y, axes=2, name=None):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def atleast_1d(*inputs, name=None):
+    out = jnp.atleast_1d(*inputs)
+    return out if isinstance(out, list) else out
+
+
+def atleast_2d(*inputs, name=None):
+    return jnp.atleast_2d(*inputs)
+
+
+def atleast_3d(*inputs, name=None):
+    return jnp.atleast_3d(*inputs)
+
+
+def tolist(x):
+    import numpy as np
+
+    return np.asarray(x).tolist()
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Parity: paddle.shard_index (ref: operators/shard_index_op.cc) — used by
+    sharded embedding tables (model-parallel lookup)."""
+    input = jnp.asarray(input)
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (input // shard_size) == shard_id
+    return jnp.where(in_shard, input % shard_size, ignore_value)

@@ -1,0 +1,548 @@
+"""Elementwise & reduction math ops.
+
+Parity surface: python/paddle/tensor/math.py plus the reference's
+elementwise_* / reduce_ops operator families
+(paddle/fluid/operators/elementwise/, operators/reduce_ops/).  On TPU all of
+these lower to single XLA HLO ops that the compiler fuses into neighbors, so
+there is no per-op kernel code — the value here is the paddle-parity calling
+convention (names, default dtypes, broadcasting semantics).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as _dt
+
+__all__ = [
+    # elementwise binary
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "pow", "maximum", "minimum", "fmax", "fmin", "atan2", "logaddexp",
+    "heaviside", "gcd", "lcm", "hypot", "copysign", "nextafter", "ldexp",
+    # elementwise unary
+    "abs", "neg", "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt",
+    "rsqrt", "square", "reciprocal", "sign", "floor", "ceil", "round", "trunc",
+    "frac", "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
+    "tanh", "asinh", "acosh", "atanh", "erf", "erfinv", "sigmoid", "logit",
+    "digamma", "lgamma", "angle", "conj", "deg2rad", "rad2deg", "exp2",
+    "i0", "i0e", "i1", "i1e", "sgn",
+    # scale/clip
+    "scale", "clip", "stanh",
+    # reductions
+    "sum", "nansum", "mean", "nanmean", "prod", "max", "min", "amax", "amin",
+    "logsumexp", "all", "any", "count_nonzero",
+    # cumulative
+    "cumsum", "cumprod", "cummax", "cummin", "logcumsumexp",
+    # misc
+    "addmm", "inner", "outer", "multiplex", "lerp", "diff", "trapezoid",
+    "isfinite", "isinf", "isnan", "nan_to_num", "broadcast_shape",
+    "increment", "kron", "renorm", "trace", "diagonal", "take",
+]
+
+
+def _f(x):
+    """Promote python scalars / int arrays to the default float dtype."""
+    x = jnp.asarray(x)
+    if not jnp.issubdtype(x.dtype, jnp.floating) and not jnp.issubdtype(x.dtype, jnp.complexfloating):
+        x = x.astype(_dt.get_default_dtype())
+    return x
+
+
+# -- elementwise binary ------------------------------------------------------
+
+def add(x, y, name=None):
+    return jnp.add(x, y)
+
+
+def subtract(x, y, name=None):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y, name=None):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y, name=None):
+    return jnp.true_divide(x, y)
+
+
+def floor_divide(x, y, name=None):
+    return jnp.floor_divide(x, y)
+
+
+def mod(x, y, name=None):
+    return jnp.mod(x, y)
+
+
+remainder = mod
+
+
+def pow(x, y, name=None):
+    return jnp.power(x, y)
+
+
+def maximum(x, y, name=None):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y, name=None):
+    return jnp.minimum(x, y)
+
+
+def fmax(x, y, name=None):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y, name=None):
+    return jnp.fmin(x, y)
+
+
+def atan2(x, y, name=None):
+    return jnp.arctan2(_f(x), _f(y))
+
+
+def logaddexp(x, y, name=None):
+    return jnp.logaddexp(_f(x), _f(y))
+
+
+def heaviside(x, y, name=None):
+    return jnp.heaviside(x, y)
+
+
+def gcd(x, y, name=None):
+    return jnp.gcd(x, y)
+
+
+def lcm(x, y, name=None):
+    return jnp.lcm(x, y)
+
+
+def hypot(x, y, name=None):
+    return jnp.hypot(_f(x), _f(y))
+
+
+def copysign(x, y, name=None):
+    return jnp.copysign(x, y)
+
+
+def nextafter(x, y, name=None):
+    return jnp.nextafter(x, y)
+
+
+def ldexp(x, y, name=None):
+    return jnp.ldexp(x, y)
+
+
+# -- elementwise unary -------------------------------------------------------
+
+def abs(x, name=None):
+    return jnp.abs(x)
+
+
+def neg(x, name=None):
+    return jnp.negative(x)
+
+
+def exp(x, name=None):
+    return jnp.exp(_f(x))
+
+
+def expm1(x, name=None):
+    return jnp.expm1(_f(x))
+
+
+def exp2(x, name=None):
+    return jnp.exp2(_f(x))
+
+
+def log(x, name=None):
+    return jnp.log(_f(x))
+
+
+def log2(x, name=None):
+    return jnp.log2(_f(x))
+
+
+def log10(x, name=None):
+    return jnp.log10(_f(x))
+
+
+def log1p(x, name=None):
+    return jnp.log1p(_f(x))
+
+
+def sqrt(x, name=None):
+    return jnp.sqrt(_f(x))
+
+
+def rsqrt(x, name=None):
+    return jax.lax.rsqrt(_f(x))
+
+
+def square(x, name=None):
+    return jnp.square(x)
+
+
+def reciprocal(x, name=None):
+    return jnp.reciprocal(_f(x))
+
+
+def sign(x, name=None):
+    return jnp.sign(x)
+
+
+def sgn(x, name=None):
+    x = jnp.asarray(x)
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0, x / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(x)
+
+
+def floor(x, name=None):
+    return jnp.floor(x)
+
+
+def ceil(x, name=None):
+    return jnp.ceil(x)
+
+
+def round(x, name=None):
+    return jnp.round(x)
+
+
+def trunc(x, name=None):
+    return jnp.trunc(x)
+
+
+def frac(x, name=None):
+    return jnp.asarray(x) - jnp.trunc(x)
+
+
+def sin(x, name=None):
+    return jnp.sin(_f(x))
+
+
+def cos(x, name=None):
+    return jnp.cos(_f(x))
+
+
+def tan(x, name=None):
+    return jnp.tan(_f(x))
+
+
+def asin(x, name=None):
+    return jnp.arcsin(_f(x))
+
+
+def acos(x, name=None):
+    return jnp.arccos(_f(x))
+
+
+def atan(x, name=None):
+    return jnp.arctan(_f(x))
+
+
+def sinh(x, name=None):
+    return jnp.sinh(_f(x))
+
+
+def cosh(x, name=None):
+    return jnp.cosh(_f(x))
+
+
+def tanh(x, name=None):
+    return jnp.tanh(_f(x))
+
+
+def asinh(x, name=None):
+    return jnp.arcsinh(_f(x))
+
+
+def acosh(x, name=None):
+    return jnp.arccosh(_f(x))
+
+
+def atanh(x, name=None):
+    return jnp.arctanh(_f(x))
+
+
+def erf(x, name=None):
+    return jax.scipy.special.erf(_f(x))
+
+
+def erfinv(x, name=None):
+    return jax.scipy.special.erfinv(_f(x))
+
+
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(_f(x))
+
+
+def logit(x, eps=None, name=None):
+    x = _f(x)
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jax.scipy.special.logit(x)
+
+
+def digamma(x, name=None):
+    return jax.scipy.special.digamma(_f(x))
+
+
+def lgamma(x, name=None):
+    return jax.scipy.special.gammaln(_f(x))
+
+
+def angle(x, name=None):
+    return jnp.angle(x)
+
+
+def conj(x, name=None):
+    return jnp.conj(x)
+
+
+def deg2rad(x, name=None):
+    return jnp.deg2rad(_f(x))
+
+
+def rad2deg(x, name=None):
+    return jnp.rad2deg(_f(x))
+
+
+def i0(x, name=None):
+    return jax.scipy.special.i0(_f(x))
+
+
+def i0e(x, name=None):
+    return jax.scipy.special.i0e(_f(x))
+
+
+def i1(x, name=None):
+    return jax.scipy.special.i1(_f(x))
+
+
+def i1e(x, name=None):
+    return jax.scipy.special.i1e(_f(x))
+
+
+# -- scale/clip --------------------------------------------------------------
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """Parity: paddle.scale (ref op: paddle/fluid/operators/scale_op.cc)."""
+    x = jnp.asarray(x)
+    s = jnp.asarray(scale, x.dtype)
+    b = jnp.asarray(bias, x.dtype)
+    out = x * s + b if bias_after_scale else (x + b) * s
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act == "tanh":
+        out = jnp.tanh(out)
+    elif act == "sigmoid":
+        out = jax.nn.sigmoid(out)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    return jnp.clip(x, min, max)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return scale_b * jnp.tanh(scale_a * _f(x))
+
+
+# -- reductions --------------------------------------------------------------
+
+def _axis(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return axis
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return jnp.sum(x, axis=_axis(axis), dtype=_dt.convert_dtype(dtype) if dtype else None, keepdims=keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return jnp.nansum(x, axis=_axis(axis), dtype=_dt.convert_dtype(dtype) if dtype else None, keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return jnp.mean(_f(x), axis=_axis(axis), keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmean(_f(x), axis=_axis(axis), keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return jnp.prod(x, axis=_axis(axis), dtype=_dt.convert_dtype(dtype) if dtype else None, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+amax = max
+amin = min
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return jax.scipy.special.logsumexp(_f(x), axis=_axis(axis), keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)
+
+
+# -- cumulative --------------------------------------------------------------
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=_dt.convert_dtype(dtype) if dtype else None)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return jnp.cumprod(x, axis=dim, dtype=_dt.convert_dtype(dtype) if dtype else None)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    values = jax.lax.cummax(x, axis=axis)
+    idx_dtype = _dt.convert_dtype(dtype)
+    n = x.shape[axis]
+    iota = jax.lax.broadcasted_iota(jnp.int32, x.shape, axis)
+    is_new = x == values
+    indices = jax.lax.cummax(jnp.where(is_new, iota, -1), axis=axis)
+    return values, indices.astype(idx_dtype)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    values = jax.lax.cummin(x, axis=axis)
+    idx_dtype = _dt.convert_dtype(dtype)
+    iota = jax.lax.broadcasted_iota(jnp.int32, x.shape, axis)
+    is_new = x == values
+    indices = jax.lax.cummax(jnp.where(is_new, iota, -1), axis=axis)
+    return values, indices.astype(idx_dtype)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    return jax.lax.cumlogsumexp(_f(x), axis=axis)
+
+
+# -- misc --------------------------------------------------------------------
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return beta * jnp.asarray(input) + alpha * jnp.matmul(x, y)
+
+
+def inner(x, y, name=None):
+    return jnp.inner(x, y)
+
+
+def outer(x, y, name=None):
+    return jnp.outer(x, y)
+
+
+def multiplex(inputs, index, name=None):
+    """Parity: paddle.multiplex (ref op: operators/multiplex_op.cc)."""
+    stacked = jnp.stack(inputs, axis=0)  # (n, batch, ...)
+    idx = jnp.reshape(jnp.asarray(index), (-1,))
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]
+
+
+def lerp(x, y, weight, name=None):
+    x = _f(x)
+    return x + jnp.asarray(weight, x.dtype) * (jnp.asarray(y, x.dtype) - x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if dx is None and x is None:
+        dx = 1.0
+    return jnp.trapezoid(_f(y), x=x, dx=dx if dx is not None else 1.0, axis=axis)
+
+
+def isfinite(x, name=None):
+    return jnp.isfinite(x)
+
+
+def isinf(x, name=None):
+    return jnp.isinf(x)
+
+
+def isnan(x, name=None):
+    return jnp.isnan(x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as np
+
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def increment(x, value=1.0, name=None):
+    """Functional: returns x + value (XLA has no in-place mutation)."""
+    x = jnp.asarray(x)
+    return x + jnp.asarray(value, x.dtype)
+
+
+def kron(x, y, name=None):
+    return jnp.kron(x, y)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    x = _f(x)
+    dims = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def take(x, index, mode="raise", name=None):
+    x = jnp.asarray(x).ravel()
+    idx = jnp.asarray(index)
+    if mode == "wrap":
+        idx = jnp.mod(idx, x.shape[0])
+    elif mode == "clip":
+        idx = jnp.clip(idx, -x.shape[0], x.shape[0] - 1)
+    idx = jnp.where(idx < 0, idx + x.shape[0], idx)
+    return x[idx]

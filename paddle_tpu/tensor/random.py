@@ -1,0 +1,115 @@
+"""Random sampling ops.
+
+Parity surface: python/paddle/tensor/random.py (reference ops:
+operators/uniform_random_op.cc, gaussian_random_op.cc, dropout_op.cc seeds,
+framework/generator.cc).
+
+Eager calls draw fresh subkeys from the global Generator
+(paddle_tpu.framework.random).  Every function also accepts ``key=`` for
+pure/traced use — inside jit you MUST pass a key or the randomness freezes
+at trace time.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as _dt
+from ..framework.random import split_key
+
+__all__ = [
+    "uniform", "rand", "randn", "normal", "standard_normal", "randint",
+    "randint_like", "randperm", "multinomial", "bernoulli", "poisson",
+    "exponential", "uniform_", "normal_",
+]
+
+
+def _dtype(d):
+    return _dt.convert_dtype(d) if d is not None else _dt.get_default_dtype()
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None, key=None):
+    k = split_key(key) if seed == 0 else jax.random.PRNGKey(seed)
+    return jax.random.uniform(k, tuple(shape), dtype=_dtype(dtype), minval=min, maxval=max)
+
+
+def rand(shape, dtype=None, name=None, key=None):
+    return jax.random.uniform(split_key(key), tuple(shape), dtype=_dtype(dtype))
+
+
+def randn(shape, dtype=None, name=None, key=None):
+    return jax.random.normal(split_key(key), tuple(shape), dtype=_dtype(dtype))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None, key=None):
+    if isinstance(mean, jax.Array) or isinstance(std, jax.Array):
+        shape = jnp.broadcast_shapes(jnp.shape(mean), jnp.shape(std)) if shape is None else tuple(shape)
+        z = jax.random.normal(split_key(key), shape, dtype=_dt.get_default_dtype())
+        return z * jnp.asarray(std, z.dtype) + jnp.asarray(mean, z.dtype)
+    z = jax.random.normal(split_key(key), tuple(shape or ()), dtype=_dt.get_default_dtype())
+    return z * std + mean
+
+
+def standard_normal(shape, dtype=None, name=None, key=None):
+    return jax.random.normal(split_key(key), tuple(shape), dtype=_dtype(dtype))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None, key=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(split_key(key), tuple(shape), low, high, dtype=_dt.convert_dtype(dtype))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None, key=None):
+    x = jnp.asarray(x)
+    return randint(low, high, x.shape, dtype or x.dtype, key=key)
+
+
+def randperm(n, dtype="int64", name=None, key=None):
+    return jax.random.permutation(split_key(key), n).astype(_dt.convert_dtype(dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None, key=None):
+    x = jnp.asarray(x)
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(_dt.get_default_dtype())
+    logits = jnp.log(jnp.clip(x / jnp.sum(x, axis=-1, keepdims=True), 1e-30, None))
+    k = split_key(key)
+    if replacement:
+        return jax.random.categorical(k, logits, axis=-1, shape=(num_samples,) + x.shape[:-1]).T.astype(jnp.int64) \
+            if x.ndim > 1 else jax.random.categorical(k, logits, shape=(num_samples,)).astype(jnp.int64)
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(k, x.shape, dtype=logits.dtype)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx.astype(jnp.int64)
+
+
+def bernoulli(x, name=None, key=None):
+    x = jnp.asarray(x)
+    u = jax.random.uniform(split_key(key), x.shape, dtype=x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32)
+    return (u < x).astype(x.dtype)
+
+
+def poisson(x, name=None, key=None):
+    x = jnp.asarray(x)
+    return jax.random.poisson(split_key(key), x, dtype=jnp.int32).astype(x.dtype)
+
+
+def exponential(x_or_lam=1.0, shape=None, name=None, key=None):
+    if shape is None and hasattr(x_or_lam, "shape"):
+        x = jnp.asarray(x_or_lam)
+        e = jax.random.exponential(split_key(key), x.shape, dtype=x.dtype)
+        return e / x
+    e = jax.random.exponential(split_key(key), tuple(shape or ()), dtype=_dt.get_default_dtype())
+    return e / x_or_lam
+
+
+# "in-place" aliases: functional on TPU, kept for API-shape parity.
+def uniform_(x, min=-1.0, max=1.0, key=None):
+    x = jnp.asarray(x)
+    return jax.random.uniform(split_key(key), x.shape, dtype=x.dtype, minval=min, maxval=max)
+
+
+def normal_(x, mean=0.0, std=1.0, key=None):
+    x = jnp.asarray(x)
+    return jax.random.normal(split_key(key), x.shape, dtype=x.dtype) * std + mean

@@ -1,0 +1,154 @@
+"""Search / sort / top-k ops.
+
+Parity surface: python/paddle/tensor/search.py (reference ops:
+operators/top_k_op.cc, arg_max/arg_min, argsort, where, nonzero).
+top_k lowers to XLA's sort/partial-sort which is TPU-tuned.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "where", "nonzero",
+    "index_select_search", "kthvalue", "mode", "median", "nanmedian",
+    "searchsorted", "bucketize", "masked_select_idx",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..framework import dtype as _dt
+
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(_dt.convert_dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..framework import dtype as _dt
+
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(_dt.convert_dtype(dtype))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    idx = jnp.argsort(x, axis=axis, stable=True, descending=descending)
+    return idx.astype(jnp.int64)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    out = jnp.sort(x, axis=axis, stable=True, descending=descending)
+    return out
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    """Parity: paddle.topk (ref: operators/top_k_v2_op.cc)."""
+    x = jnp.asarray(x)
+    if axis is None:
+        axis = -1
+    x_moved = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(x_moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-x_moved, k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx.astype(jnp.int64), -1, axis)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return jnp.where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    """Data-dependent output shape — host-side eager only (as in the
+    reference, where-index op runs with dynamic output)."""
+    import numpy as np
+
+    idx = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(i.reshape(-1, 1)) for i in idx)
+    return jnp.asarray(np.stack(idx, axis=1).astype(np.int64))
+
+
+def index_select_search(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = jnp.asarray(x)
+    sorted_vals = jnp.sort(x, axis=axis)
+    sorted_idx = jnp.argsort(x, axis=axis)
+    vals = jnp.take(sorted_vals, k - 1, axis=axis)
+    idx = jnp.take(sorted_idx, k - 1, axis=axis).astype(jnp.int64)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = jnp.asarray(x)
+    sorted_x = jnp.sort(x, axis=axis)
+    n = x.shape[axis]
+
+    def count_runs(row):
+        eq = jnp.concatenate([jnp.ones(1, bool), row[1:] == row[:-1]])
+        run_id = jnp.cumsum(~eq)
+        counts = jax.nn.one_hot(run_id, n, dtype=jnp.int32).sum(0)
+        best_run = jnp.argmax(counts)
+        pos = jnp.argmax(run_id == best_run)
+        return row[pos], pos
+
+    moved = jnp.moveaxis(sorted_x, axis, -1)
+    flat = moved.reshape(-1, n)
+    vals, pos = jax.vmap(count_runs)(flat)
+    vals = vals.reshape(moved.shape[:-1])
+    # paddle returns index into the *original* tensor of the last occurrence;
+    # we return index into sorted order's first occurrence position mapped back
+    sorted_idx = jnp.moveaxis(jnp.argsort(x, axis=axis), axis, -1).reshape(-1, n)
+    orig_idx = jnp.take_along_axis(sorted_idx, pos[:, None], axis=1)[:, 0]
+    idx = orig_idx.reshape(moved.shape[:-1]).astype(jnp.int64)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = jnp.asarray(x)
+    from ..framework import dtype as _dt
+
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(_dt.get_default_dtype())
+    if mode == "avg":
+        return jnp.median(x, axis=axis, keepdims=keepdim)
+    # mode == 'min': lower median
+    if axis is None:
+        flat = x.ravel()
+        k = (flat.shape[0] - 1) // 2
+        return jnp.sort(flat)[k]
+    n = x.shape[axis]
+    k = (n - 1) // 2
+    out = jnp.take(jnp.sort(x, axis=axis), k, axis=axis)
+    return jnp.expand_dims(out, axis) if keepdim else out
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmedian(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    out = jnp.searchsorted(jnp.asarray(sorted_sequence), jnp.asarray(values),
+                           side="right" if right else "left")
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def masked_select_idx(x, mask):
+    import numpy as np
+
+    return jnp.asarray(np.asarray(x)[np.asarray(mask)])

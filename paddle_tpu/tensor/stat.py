@@ -1,0 +1,52 @@
+"""Statistics ops.
+
+Parity surface: python/paddle/tensor/stat.py (mean/std/var/quantile...).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework import dtype as _dt
+
+__all__ = ["mean", "std", "var", "numel", "quantile", "nanquantile", "histogramdd"]
+
+
+def _f(x):
+    x = jnp.asarray(x)
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(_dt.get_default_dtype())
+    return x
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return jnp.mean(_f(x), axis=tuple(axis) if isinstance(axis, list) else axis, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.std(_f(x), axis=tuple(axis) if isinstance(axis, list) else axis,
+                   ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.var(_f(x), axis=tuple(axis) if isinstance(axis, list) else axis,
+                   ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def numel(x, name=None):
+    return jnp.asarray(jnp.size(x))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return jnp.quantile(_f(x), jnp.asarray(q), axis=axis, keepdims=keepdim, method=interpolation)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return jnp.nanquantile(_f(x), jnp.asarray(q), axis=axis, keepdims=keepdim, method=interpolation)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    import numpy as np
+
+    hist, edges = np.histogramdd(np.asarray(x), bins=bins, range=ranges,
+                                 density=density, weights=None if weights is None else np.asarray(weights))
+    return jnp.asarray(hist), [jnp.asarray(e) for e in edges]

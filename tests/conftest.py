@@ -1,0 +1,34 @@
+"""Test config: run the suite on a simulated 8-device CPU mesh.
+
+Mirrors the reference's strategy of testing distributed paths without a real
+cluster (reference: python/paddle/fluid/tests/unittests/test_dist_base.py
+spawns localhost subprocesses; test_collective_base.py fakes 2 ranks on one
+GPU).  The TPU-native equivalent is XLA's host-platform device partitioning:
+8 virtual CPU devices let every pjit/shard_map path compile and execute.
+"""
+import os
+
+# Must be set before jax import (8 virtual host devices for the mesh tests).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# Force CPU even when a TPU plugin was pre-registered by the environment
+# (sitecustomize may override the JAX_PLATFORMS env var).
+jax.config.update("jax_platforms", "cpu")
+
+# Numeric tests compare against the numpy oracle: force exact f32 matmuls.
+# The framework default (XLA "default" precision ≈ bf16 passes on TPU) is the
+# perf-correct choice in production — it matches the reference's cuBLAS TF32
+# default on A100.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

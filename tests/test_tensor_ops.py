@@ -1,0 +1,390 @@
+"""Tensor-function correctness vs the numpy oracle.
+
+Mirrors the reference's OpTest pattern (unittests/op_test.py:184): declare
+inputs, compute with the framework, compare against numpy reference outputs.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def check(actual, expected, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(np.asarray(actual), expected, rtol=rtol, atol=atol)
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        x = pt.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert x.shape == (2, 2)
+        assert str(x.dtype) == "float32"
+
+    def test_to_tensor_f64_downcast(self):
+        x = pt.to_tensor(np.zeros((3,), np.float64))
+        assert x.dtype == np.float32
+
+    def test_zeros_ones_full(self):
+        check(pt.zeros([2, 3]), np.zeros((2, 3)))
+        check(pt.ones([2], "int32"), np.ones(2, np.int32))
+        check(pt.full([2, 2], 7.0), np.full((2, 2), 7.0))
+
+    def test_arange_linspace(self):
+        check(pt.arange(5), np.arange(5))
+        check(pt.arange(1, 10, 2), np.arange(1, 10, 2))
+        check(pt.linspace(0, 1, 5), np.linspace(0, 1, 5, dtype=np.float32))
+
+    def test_eye_diag_tri(self):
+        check(pt.eye(3), np.eye(3, dtype=np.float32))
+        check(pt.diag(pt.to_tensor([1.0, 2.0])), np.diag([1.0, 2.0]))
+        a = np.arange(9, dtype=np.float32).reshape(3, 3)
+        check(pt.tril(pt.to_tensor(a)), np.tril(a))
+        check(pt.triu(pt.to_tensor(a), 1), np.triu(a, 1))
+
+    def test_one_hot(self):
+        out = pt.one_hot(pt.to_tensor([0, 2], "int32"), 3)
+        check(out, np.array([[1, 0, 0], [0, 0, 1]], np.float32))
+
+    def test_meshgrid(self):
+        gx, gy = pt.meshgrid(pt.arange(2), pt.arange(3))
+        ex, ey = np.meshgrid(np.arange(2), np.arange(3), indexing="ij")
+        check(gx, ex)
+        check(gy, ey)
+
+
+class TestMath:
+    def setup_method(self):
+        rs = np.random.RandomState(42)
+        self.a = rs.rand(3, 4).astype(np.float32)
+        self.b = rs.rand(3, 4).astype(np.float32) + 0.5
+
+    def test_binary(self):
+        a, b = pt.to_tensor(self.a), pt.to_tensor(self.b)
+        check(pt.add(a, b), self.a + self.b)
+        check(pt.subtract(a, b), self.a - self.b)
+        check(pt.multiply(a, b), self.a * self.b)
+        check(pt.divide(a, b), self.a / self.b)
+        check(pt.maximum(a, b), np.maximum(self.a, self.b))
+        check(pt.pow(a, 2.0), self.a ** 2)
+
+    def test_unary(self):
+        a = pt.to_tensor(self.a)
+        # XLA lowers transcendentals to fast approximations (~1e-4 rel err)
+        check(pt.exp(a), np.exp(self.a), rtol=2e-4, atol=1e-5)
+        check(pt.log(a + 1), np.log(self.a + 1), rtol=2e-4, atol=1e-5)
+        check(pt.sqrt(a), np.sqrt(self.a))
+        check(pt.rsqrt(a + 1), 1 / np.sqrt(self.a + 1), rtol=2e-4)
+        # XLA lowers tanh/sigmoid to rational approximations (~1e-4 rel err)
+        check(pt.tanh(a), np.tanh(self.a), rtol=2e-4, atol=1e-5)
+        check(pt.sigmoid(a), 1 / (1 + np.exp(-self.a)), rtol=2e-4, atol=1e-5)
+        check(pt.floor(a * 3), np.floor(self.a * 3))
+        check(pt.abs(-a), np.abs(self.a))
+
+    def test_int_unary_promotes(self):
+        x = pt.to_tensor([1, 2, 3], "int32")
+        out = pt.exp(x)
+        assert out.dtype == np.float32
+
+    def test_reductions(self):
+        a = pt.to_tensor(self.a)
+        check(pt.sum(a), self.a.sum(), rtol=1e-5)
+        check(pt.sum(a, axis=1), self.a.sum(1), rtol=1e-5)
+        check(pt.sum(a, axis=[0, 1]), self.a.sum(), rtol=1e-5)
+        check(pt.mean(a, axis=0, keepdim=True), self.a.mean(0, keepdims=True), rtol=1e-5)
+        check(pt.max(a), self.a.max())
+        check(pt.min(a, axis=1), self.a.min(1))
+        check(pt.prod(a, axis=0), self.a.prod(0), rtol=1e-4)
+        check(pt.logsumexp(a), np.log(np.exp(self.a).sum()), rtol=1e-5)
+
+    def test_cumulative(self):
+        a = pt.to_tensor(self.a)
+        check(pt.cumsum(a, axis=1), self.a.cumsum(1), rtol=1e-5)
+        check(pt.cumsum(a), self.a.ravel().cumsum(), rtol=1e-5)
+        check(pt.cumprod(a, dim=0), self.a.cumprod(0), rtol=1e-5)
+        vals, idx = pt.cummax(pt.to_tensor([1.0, 3.0, 2.0, 5.0, 4.0]))
+        check(vals, np.array([1, 3, 3, 5, 5], np.float32))
+        check(idx, np.array([0, 1, 1, 3, 3]))
+
+    def test_clip_scale(self):
+        a = pt.to_tensor(self.a)
+        check(pt.clip(a, 0.2, 0.8), np.clip(self.a, 0.2, 0.8))
+        check(pt.scale(a, scale=2.0, bias=1.0), self.a * 2 + 1, rtol=1e-6)
+        check(pt.scale(a, scale=2.0, bias=1.0, bias_after_scale=False), (self.a + 1) * 2, rtol=1e-6)
+
+    def test_isnan_isinf(self):
+        x = pt.to_tensor([1.0, float("nan"), float("inf")])
+        check(pt.isnan(x), [False, True, False])
+        check(pt.isinf(x), [False, False, True])
+        check(pt.isfinite(x), [True, False, False])
+
+    def test_lerp_addmm(self):
+        a, b = pt.to_tensor(self.a), pt.to_tensor(self.b)
+        check(pt.lerp(a, b, 0.3), self.a + 0.3 * (self.b - self.a), rtol=1e-5)
+        m = np.eye(3, dtype=np.float32)
+        check(
+            pt.addmm(pt.to_tensor(m), pt.to_tensor(self.a), pt.to_tensor(self.b.T)),
+            m + self.a @ self.b.T,
+            rtol=1e-5,
+        )
+
+
+class TestManipulation:
+    def setup_method(self):
+        self.a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+
+    def test_reshape_flatten(self):
+        a = pt.to_tensor(self.a)
+        assert pt.reshape(a, [6, 4]).shape == (6, 4)
+        assert pt.flatten(a).shape == (24,)
+        assert pt.flatten(a, 1, 2).shape == (2, 12)
+
+    def test_squeeze_unsqueeze(self):
+        a = pt.to_tensor(self.a[None])
+        assert pt.squeeze(a, 0).shape == (2, 3, 4)
+        assert pt.unsqueeze(pt.to_tensor(self.a), [0, 2]).shape == (1, 2, 1, 3, 4)
+
+    def test_transpose_concat_split(self):
+        a = pt.to_tensor(self.a)
+        check(pt.transpose(a, [2, 0, 1]), self.a.transpose(2, 0, 1))
+        check(pt.concat([a, a], axis=1), np.concatenate([self.a, self.a], 1))
+        parts = pt.split(a, 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == (2, 1, 4)
+        parts = pt.split(a, [1, -1], axis=1)
+        assert parts[1].shape == (2, 2, 4)
+
+    def test_stack_tile_expand(self):
+        a = pt.to_tensor(self.a)
+        check(pt.stack([a, a]), np.stack([self.a, self.a]))
+        check(pt.tile(a, [1, 2, 1]), np.tile(self.a, (1, 2, 1)))
+        b = pt.to_tensor(np.ones((1, 3), np.float32))
+        assert pt.expand(b, [5, 3]).shape == (5, 3)
+
+    def test_gather_scatter(self):
+        a = pt.to_tensor(self.a.reshape(6, 4))
+        check(pt.gather(a, pt.to_tensor([0, 2], "int32")), self.a.reshape(6, 4)[[0, 2]])
+        x = pt.zeros([4, 2])
+        out = pt.scatter(x, pt.to_tensor([1, 3], "int32"), pt.ones([2, 2]))
+        expected = np.zeros((4, 2), np.float32)
+        expected[[1, 3]] = 1
+        check(out, expected)
+
+    def test_gather_nd(self):
+        a = pt.to_tensor(self.a)
+        idx = pt.to_tensor([[0, 1], [1, 2]], "int32")
+        check(pt.gather_nd(a, idx), self.a[[0, 1], [1, 2]])
+
+    def test_take_along_put_along(self):
+        a = pt.to_tensor(self.a.reshape(6, 4))
+        idx = pt.to_tensor(np.array([[0], [1], [2], [3], [0], [1]]), "int64")
+        check(pt.take_along_axis(a, idx, 1),
+              np.take_along_axis(self.a.reshape(6, 4), np.array([[0], [1], [2], [3], [0], [1]]), 1))
+
+    def test_pad_cast_flip(self):
+        a = pt.to_tensor(np.ones((2, 2), np.float32))
+        out = pt.pad(a, [1, 1], value=5.0)  # pads last dim
+        assert out.shape == (2, 4)
+        assert np.asarray(out)[0, 0] == 5.0
+        assert pt.cast(a, "int64").dtype == np.int64
+        check(pt.flip(pt.to_tensor(self.a), [0]), self.a[::-1])
+
+    def test_roll_chunk(self):
+        a = pt.to_tensor(np.arange(6))
+        check(pt.roll(a, 2), np.roll(np.arange(6), 2))
+        chunks = pt.chunk(pt.to_tensor(self.a), 2, axis=2)
+        assert chunks[0].shape == (2, 3, 2)
+
+    def test_unique_masked(self):
+        out = pt.unique(pt.to_tensor([3, 1, 2, 1, 3]))
+        check(out, [1, 2, 3])
+        sel = pt.masked_select(pt.to_tensor([1.0, 2.0, 3.0]), pt.to_tensor([True, False, True]))
+        check(sel, [1.0, 3.0])
+        filled = pt.masked_fill(pt.to_tensor([1.0, 2.0]), pt.to_tensor([True, False]), -1.0)
+        check(filled, [-1.0, 2.0])
+
+    def test_shard_index(self):
+        out = pt.shard_index(pt.to_tensor([0, 5, 9, 3], "int64"), 10, 2, 0)
+        check(out, [0, -1, -1, 3])
+
+
+class TestLinalg:
+    def setup_method(self):
+        rs = np.random.RandomState(7)
+        self.a = rs.rand(3, 4).astype(np.float32)
+        self.b = rs.rand(4, 5).astype(np.float32)
+
+    def test_matmul(self):
+        check(pt.matmul(pt.to_tensor(self.a), pt.to_tensor(self.b)), self.a @ self.b, rtol=1e-5)
+        check(pt.matmul(pt.to_tensor(self.a), pt.to_tensor(self.b.T), transpose_y=True),
+              self.a @ self.b, rtol=1e-5)
+
+    def test_matmul_bf16_accum(self):
+        a = pt.cast(pt.to_tensor(self.a), "bfloat16")
+        b = pt.cast(pt.to_tensor(self.b), "bfloat16")
+        out = pt.matmul(a, b)
+        assert out.dtype == pt.bfloat16
+        check(pt.cast(out, "float32"), self.a @ self.b, rtol=2e-2, atol=2e-2)
+
+    def test_norm_dist(self):
+        a = pt.to_tensor(self.a)
+        check(pt.norm(a), np.linalg.norm(self.a), rtol=1e-5)
+        check(pt.norm(a, p=1, axis=1), np.abs(self.a).sum(1), rtol=1e-5)
+        check(pt.dist(a, pt.zeros_like(a)), np.linalg.norm(self.a), rtol=1e-5)
+
+    def test_solve_inv(self):
+        m = np.eye(3, dtype=np.float32) * 2 + 0.1
+        check(pt.inverse(pt.to_tensor(m)), np.linalg.inv(m), rtol=1e-4)
+        y = np.ones((3,), np.float32)
+        check(pt.solve(pt.to_tensor(m), pt.to_tensor(y)), np.linalg.solve(m, y), rtol=1e-4)
+        check(pt.det(pt.to_tensor(m)), np.linalg.det(m), rtol=1e-4)
+
+    def test_svd_qr_cholesky(self):
+        m = self.a @ self.a.T + np.eye(3, dtype=np.float32)
+        u, s, vt = pt.svd(pt.to_tensor(self.a))
+        check(s, np.linalg.svd(self.a, compute_uv=False), rtol=1e-4)
+        L = pt.cholesky(pt.to_tensor(m))
+        check(pt.matmul(L, L, transpose_y=True), m, rtol=1e-4)
+        q, r = pt.qr(pt.to_tensor(self.a))
+        check(pt.matmul(q, r), self.a, rtol=1e-4, atol=1e-5)
+
+    def test_einsum(self):
+        check(pt.einsum("ij,jk->ik", pt.to_tensor(self.a), pt.to_tensor(self.b)),
+              self.a @ self.b, rtol=1e-5)
+
+    def test_bincount_histogram(self):
+        check(pt.bincount(pt.to_tensor([0, 1, 1, 3], "int32")), [1, 2, 0, 1])
+        h = pt.histogram(pt.to_tensor([0.0, 1.0, 2.0, 3.0]), bins=4, min=0, max=4)
+        check(h, [1, 1, 1, 1])
+
+
+class TestLogic:
+    def test_compare(self):
+        a = pt.to_tensor([1.0, 2.0, 3.0])
+        b = pt.to_tensor([2.0, 2.0, 2.0])
+        check(pt.equal(a, b), [False, True, False])
+        check(pt.greater_than(a, b), [False, False, True])
+        check(pt.less_equal(a, b), [True, True, False])
+        assert bool(pt.equal_all(a, a))
+        assert bool(pt.allclose(a, a + 1e-9))
+
+    def test_logical_bitwise(self):
+        t = pt.to_tensor([True, False])
+        check(pt.logical_and(t, t), [True, False])
+        check(pt.logical_not(t), [False, True])
+        x = pt.to_tensor([1, 2], "int32")
+        check(pt.bitwise_and(x, pt.to_tensor([3, 2], "int32")), [1, 2])
+        check(pt.bitwise_left_shift(x, 1), [2, 4])
+
+    def test_is_tensor(self):
+        assert pt.is_tensor(pt.ones([1]))
+        assert not pt.is_tensor([1.0])
+
+
+class TestSearch:
+    def setup_method(self):
+        self.a = np.array([[3.0, 1.0, 2.0], [6.0, 5.0, 4.0]], np.float32)
+
+    def test_argmax_sort(self):
+        a = pt.to_tensor(self.a)
+        check(pt.argmax(a, axis=1), [0, 0])
+        check(pt.argmin(a, axis=1), [1, 2])
+        check(pt.sort(a, axis=1), np.sort(self.a, 1))
+        check(pt.argsort(a, axis=1), np.argsort(self.a, 1))
+        check(pt.sort(a, axis=1, descending=True), -np.sort(-self.a, 1))
+
+    def test_topk(self):
+        vals, idx = pt.topk(pt.to_tensor(self.a), 2, axis=1)
+        check(vals, [[3.0, 2.0], [6.0, 5.0]])
+        check(idx, [[0, 2], [0, 1]])
+        vals, idx = pt.topk(pt.to_tensor(self.a), 1, axis=1, largest=False)
+        check(vals, [[1.0], [4.0]])
+
+    def test_where_nonzero(self):
+        a = pt.to_tensor(self.a)
+        check(pt.where(pt.greater_than(a, 2.5), a, pt.zeros_like(a)),
+              np.where(self.a > 2.5, self.a, 0))
+        nz = pt.nonzero(pt.to_tensor([0, 1, 0, 2]))
+        check(nz, [[1], [3]])
+
+    def test_median_kth(self):
+        x = pt.to_tensor([1.0, 3.0, 2.0, 4.0])
+        check(pt.median(x), 2.5)
+        vals, idx = pt.kthvalue(x, 2)
+        check(vals, 2.0)
+        check(pt.searchsorted(pt.to_tensor([1.0, 2.0, 3.0]), pt.to_tensor([2.5])), [2])
+
+    def test_mode(self):
+        vals, idx = pt.mode(pt.to_tensor([[1.0, 2.0, 2.0], [3.0, 3.0, 1.0]]))
+        check(vals, [2.0, 3.0])
+
+
+class TestStatRandom:
+    def test_std_var(self):
+        rs = np.random.RandomState(0)
+        a = rs.rand(10, 5).astype(np.float32)
+        check(pt.std(pt.to_tensor(a)), a.std(ddof=1), rtol=1e-4)
+        check(pt.var(pt.to_tensor(a), axis=0), a.var(0, ddof=1), rtol=1e-4)
+        check(pt.var(pt.to_tensor(a), unbiased=False), a.var(), rtol=1e-4)
+
+    def test_quantile(self):
+        a = np.arange(8, dtype=np.float32)
+        check(pt.quantile(pt.to_tensor(a), 0.5), 3.5)
+
+    def test_random_shapes_and_ranges(self):
+        pt.seed(123)
+        u = pt.uniform([100], min=0.0, max=2.0)
+        arr = np.asarray(u)
+        assert arr.shape == (100,) and (arr >= 0).all() and (arr < 2).all()
+        n = pt.randn([1000])
+        assert abs(float(np.asarray(n).mean())) < 0.2
+        r = pt.randint(0, 5, [50])
+        assert np.asarray(r).min() >= 0 and np.asarray(r).max() < 5
+        p = pt.randperm(10)
+        assert sorted(np.asarray(p).tolist()) == list(range(10))
+
+    def test_seed_reproducible(self):
+        pt.seed(7)
+        a = np.asarray(pt.randn([4]))
+        pt.seed(7)
+        b = np.asarray(pt.randn([4]))
+        np.testing.assert_array_equal(a, b)
+
+    def test_bernoulli_multinomial(self):
+        pt.seed(3)
+        b = pt.bernoulli(pt.full([200], 0.5))
+        frac = float(np.asarray(b).mean())
+        assert 0.3 < frac < 0.7
+        m = pt.multinomial(pt.to_tensor([0.1, 0.0, 0.9]), 5, replacement=True)
+        assert 1 not in np.asarray(m)
+
+
+class TestFramework:
+    def test_default_dtype(self):
+        assert pt.get_default_dtype() == np.float32
+        pt.set_default_dtype("float64")
+        try:
+            assert pt.ones([1]).dtype == np.float64
+        finally:
+            pt.set_default_dtype("float32")
+
+    def test_flags(self):
+        pt.set_flags({"check_nan_inf": True})
+        assert pt.get_flags("check_nan_inf")["check_nan_inf"] is True
+        pt.set_flags({"check_nan_inf": False})
+        with pytest.raises(Exception):
+            pt.set_flags({"no_such_flag": 1})
+
+    def test_device(self):
+        dev = pt.get_device()
+        assert ":" in dev
+        assert pt.device_count("cpu") >= 1
+
+    def test_dtype_convert(self):
+        from paddle_tpu.framework.dtype import convert_dtype
+
+        assert convert_dtype("fp16") == np.float16
+        assert convert_dtype("bf16") == pt.bfloat16
+        with pytest.raises(TypeError):
+            convert_dtype("not_a_dtype")
+
+    def test_finfo_iinfo(self):
+        assert pt.finfo("float32").max > 1e38
+        assert pt.iinfo("int8").max == 127
