@@ -1,0 +1,26 @@
+"""paddle_tpu.nn — neural network layers (paddle.nn parity).
+
+Reference surface: python/paddle/nn/ (19.5k LoC of Layer classes).  See
+layer_base.py for the TPU-native Layer/autodiff design.
+"""
+from .layer_base import (  # noqa: F401
+    Layer,
+    Parameter,
+    Buffer,
+    functional_call,
+    current_rng_key,
+    rng_scope,
+)
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .initializer import ParamAttr  # noqa: F401
+
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .activation import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .container import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403
+from .transformer import *  # noqa: F401,F403

@@ -1,0 +1,111 @@
+"""Conv layers.
+
+Parity surface: paddle.nn.Conv1D/2D/3D(+Transpose)
+(reference: python/paddle/nn/layer/conv.py over operators/conv_op.cc).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import functional as F
+from . import initializer as I
+from .layer_base import Layer
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose"]
+
+
+def _ntuple(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _ConvNd(Layer):
+    _ndim = 2
+    _transpose = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, output_padding=0, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None, name=None):
+        super().__init__()
+        n = self._ndim
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _ntuple(kernel_size, n)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.output_padding = output_padding
+        self.data_format = data_format
+        if self._transpose:
+            # paddle transpose kernel layout: (in_channels, out_channels // g, *k)
+            w_shape = (in_channels, out_channels // groups) + self.kernel_size
+        else:
+            w_shape = (out_channels, in_channels // groups) + self.kernel_size
+        fan_in = in_channels // groups * int(np.prod(self.kernel_size))
+        self.weight = self.create_parameter(
+            w_shape, attr=weight_attr,
+            default_initializer=I.Normal(0.0, (2.0 / max(fan_in, 1)) ** 0.5))
+        if bias_attr is not False:
+            self.bias = self.create_parameter((out_channels,), attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def _bias(self):
+        return self.bias.value if self.bias is not None else None
+
+
+class Conv1D(_ConvNd):
+    _ndim = 1
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight.value, self._bias(), self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format or "NCL")
+
+
+class Conv2D(_ConvNd):
+    """Parity: paddle.nn.Conv2D (ref: operators/conv_op.cc; cuDNN variant
+    conv_cudnn_op.cu → here one XLA convolution on the MXU)."""
+
+    _ndim = 2
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight.value, self._bias(), self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format or "NCHW")
+
+
+class Conv3D(_ConvNd):
+    _ndim = 3
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight.value, self._bias(), self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format or "NCDHW")
+
+
+class Conv1DTranspose(_ConvNd):
+    _ndim = 1
+    _transpose = True
+
+    def forward(self, x, output_size=None):
+        return F.conv1d_transpose(x, self.weight.value, self._bias(), self.stride,
+                                  self.padding, self.output_padding, self.groups,
+                                  self.dilation, output_size, self.data_format or "NCL")
+
+
+class Conv2DTranspose(_ConvNd):
+    _ndim = 2
+    _transpose = True
+
+    def forward(self, x, output_size=None):
+        return F.conv2d_transpose(x, self.weight.value, self._bias(), self.stride,
+                                  self.padding, self.output_padding, self.groups,
+                                  self.dilation, output_size, self.data_format or "NCHW")
+
+
+class Conv3DTranspose(_ConvNd):
+    _ndim = 3
+    _transpose = True
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight.value, self._bias(), self.stride,
+                                  self.padding, self.output_padding, self.groups,
+                                  self.dilation, output_size, self.data_format or "NCDHW")

@@ -1,0 +1,205 @@
+"""Activation functions.
+
+Parity surface: paddle.nn.functional activations (reference:
+paddle/fluid/operators/activation_op.cc — ~35 registered activations).
+Each lowers to a couple of XLA elementwise HLOs that fuse into the
+surrounding computation; on TPU these run on the VPU fused with the matmul
+epilogue, so there is no standalone "activation kernel" to optimize.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import dtype as _dt
+
+__all__ = [
+    "relu", "relu6", "relu_", "elu", "selu", "celu", "gelu", "silu", "swish",
+    "leaky_relu", "prelu", "rrelu", "hardshrink", "hardsigmoid", "hardswish",
+    "hardtanh", "log_sigmoid", "log_softmax", "softmax", "softmax_",
+    "maxout", "mish", "softplus", "softshrink", "softsign", "tanhshrink",
+    "thresholded_relu", "glu", "gumbel_softmax", "sigmoid", "tanh",
+]
+
+
+def _f(x):
+    x = jnp.asarray(x)
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(_dt.get_default_dtype())
+    return x
+
+
+def relu(x, name=None):
+    return jax.nn.relu(jnp.asarray(x))
+
+
+relu_ = relu
+
+
+def relu6(x, name=None):
+    return jax.nn.relu6(jnp.asarray(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return jax.nn.elu(_f(x), alpha=alpha)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    x = _f(x)
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return jax.nn.celu(_f(x), alpha=alpha)
+
+
+def gelu(x, approximate=False, name=None):
+    return jax.nn.gelu(_f(x), approximate=approximate)
+
+
+def silu(x, name=None):
+    return jax.nn.silu(_f(x))
+
+
+def swish(x, name=None):
+    return jax.nn.silu(_f(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return jax.nn.leaky_relu(_f(x), negative_slope=negative_slope)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x = _f(x)
+    w = jnp.asarray(weight, x.dtype)
+    if w.size > 1:
+        # broadcast per-channel weight along the channel axis
+        axis = 1 if data_format == "NCHW" else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[axis] = w.size
+        w = w.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=True, name=None, key=None):
+    x = _f(x)
+    if training:
+        from ..layer_base import current_rng_key
+
+        k = key if key is not None else current_rng_key()
+        a = jax.random.uniform(k, x.shape, dtype=x.dtype,
+                               minval=lower, maxval=upper)
+    else:
+        a = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, a * x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    x = _f(x)
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    x = _f(x)
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hardswish(x, name=None):
+    x = _f(x)
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return jnp.clip(_f(x), min, max)
+
+
+def log_sigmoid(x, name=None):
+    return jax.nn.log_sigmoid(_f(x))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = _f(x)
+    if dtype is not None:
+        x = x.astype(_dt.convert_dtype(dtype))
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = _f(x)
+    if dtype is not None:
+        x = x.astype(_dt.convert_dtype(dtype))
+    return jax.nn.softmax(x, axis=axis)
+
+
+softmax_ = softmax
+
+
+def maxout(x, groups, axis=1, name=None):
+    """Parity: operators/maxout_op.cc."""
+    x = jnp.asarray(x)
+    c = x.shape[axis]
+    nd = x.ndim
+    axis = axis % nd
+    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def mish(x, name=None):
+    x = _f(x)
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    x = _f(x)
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x, jax.nn.softplus(scaled) / beta)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    x = _f(x)
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def softsign(x, name=None):
+    x = _f(x)
+    return x / (1.0 + jnp.abs(x))
+
+
+def tanhshrink(x, name=None):
+    x = _f(x)
+    return x - jnp.tanh(x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    x = _f(x)
+    return jnp.where(x > threshold, x, value)
+
+
+def glu(x, axis=-1, name=None):
+    x = _f(x)
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None, key=None):
+    from ..layer_base import current_rng_key
+
+    x = _f(x)
+    k = key if key is not None else current_rng_key()
+    g = jax.random.gumbel(k, x.shape, dtype=x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+        y = y_hard - jax.lax.stop_gradient(y) + y  # straight-through estimator
+    return y
+
+
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(_f(x))
+
+
+def tanh(x, name=None):
+    return jnp.tanh(_f(x))

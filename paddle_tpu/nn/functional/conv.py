@@ -1,0 +1,178 @@
+"""Convolution ops.
+
+Parity surface: paddle.nn.functional.conv1d/2d/3d(+_transpose)
+(reference: paddle/fluid/operators/conv_op.cc, conv_cudnn_op.cu,
+conv_transpose_op.cc).  The reference dispatches to cuDNN with exhaustive
+algo search; on TPU a single ``lax.conv_general_dilated`` HLO maps onto the
+MXU and XLA picks the tiling — there is no algo-search subsystem to port.
+
+Layouts: paddle defaults to NCHW with OIHW kernels.  XLA:TPU internally
+prefers NHWC and will transpose as needed; we pass the paddle layout through
+dimension_numbers so user-facing semantics match the reference exactly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...framework.errors import InvalidArgumentError
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose", "conv3d_transpose"]
+
+
+def _norm_tuple(v, n, name):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    if len(v) == 1:
+        return v * n
+    if len(v) != n:
+        raise InvalidArgumentError(f"{name} must have length {n}, got {v}")
+    return v
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p in ("SAME", "VALID"):
+            return p
+        raise InvalidArgumentError(f"unknown padding {padding!r}")
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    # paddle also allows [[0,0],[0,0],[h0,h1],[w0,w1]] incl. batch/channel
+    if len(padding) == n + 2:
+        return [tuple(p) for p in padding[2:]]
+    return [tuple(p) for p in padding]
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n, channel_last):
+    spatial = "DHW"[3 - n:]
+    if channel_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    out_spec = lhs_spec
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, (lhs_spec, rhs_spec, out_spec))
+    out = lax.conv_general_dilated(
+        x, weight,
+        window_strides=_norm_tuple(stride, n, "stride"),
+        padding=_padding(padding, n),
+        rhs_dilation=_norm_tuple(dilation, n, "dilation"),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    )
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    if bias is not None:
+        b = jnp.asarray(bias, out.dtype)
+        shape = [1] * out.ndim
+        shape[-1 if channel_last else 1] = b.size
+        out = out + b.reshape(shape)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(jnp.asarray(x), jnp.asarray(weight), bias, stride, padding,
+                    dilation, groups, 1, data_format in ("NLC",))
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    """Parity: paddle.nn.functional.conv2d (ref: operators/conv_op.cc)."""
+    return _conv_nd(jnp.asarray(x), jnp.asarray(weight), bias, stride, padding,
+                    dilation, groups, 2, data_format == "NHWC")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(jnp.asarray(x), jnp.asarray(weight), bias, stride, padding,
+                    dilation, groups, 3, data_format == "NDHWC")
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, n, channel_last, output_size):
+    x = jnp.asarray(x)
+    weight = jnp.asarray(weight)  # paddle transpose-conv kernel layout: (C_in, C_out//g, *k)
+    spatial = "DHW"[3 - n:]
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    # transpose_kernel=True swaps the I/O axes of the given spec and flips the
+    # spatial dims, so the (C_in, C_out, *k) paddle kernel is described as
+    # "OI"+spatial here (the layout a forward conv's gradient kernel has).
+    rhs_spec = "OI" + spatial
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, (lhs_spec, rhs_spec, lhs_spec))
+    strides = _norm_tuple(stride, n, "stride")
+    dil = _norm_tuple(dilation, n, "dilation")
+    pads = _padding(padding, n)
+    opad = _norm_tuple(output_padding, n, "output_padding") if output_padding else (0,) * n
+    if isinstance(pads, str):
+        pad_cfg = pads
+    else:
+        # paddle transposed-conv padding p ↔ raw dilated-conv padding
+        # (dk-1-p); output_padding extends the high side.
+        k_dil = [dil[i] * (weight.shape[2 + i] - 1) + 1 for i in range(n)]
+        pad_cfg = [(k_dil[i] - 1 - pads[i][0], k_dil[i] - 1 - pads[i][1] + opad[i])
+                   for i in range(n)]
+        opad = (0,) * n  # folded into pad_cfg
+    if groups > 1:
+        # grouped transpose conv: split along the input-channel axis of both
+        xs = jnp.split(x, groups, axis=(x.ndim - 1) if channel_last else 1)
+        ws = jnp.split(weight, groups, axis=0)
+        outs = [
+            lax.conv_transpose(xg, wg, strides=strides, padding=pad_cfg,
+                               rhs_dilation=dil, dimension_numbers=dn,
+                               transpose_kernel=True)
+            for xg, wg in zip(xs, ws)
+        ]
+        out = jnp.concatenate(outs, axis=(x.ndim - 1) if channel_last else 1)
+    else:
+        out = lax.conv_transpose(x, weight, strides=strides, padding=pad_cfg,
+                                 rhs_dilation=dil, dimension_numbers=dn,
+                                 transpose_kernel=True)
+    if any(p > 0 for p in opad):
+        widths = [(0, 0)] * out.ndim
+        for i, p in enumerate(opad):
+            dim = (1 + i) if channel_last else (2 + i)
+            widths[dim] = (0, p)
+        out = jnp.pad(out, widths)
+    if output_size is not None:
+        # crop/pad to the requested spatial size
+        target = tuple(output_size)
+        slices = [slice(None)] * out.ndim
+        start_dim = 1 if channel_last else 2
+        for i, t in enumerate(target):
+            slices[start_dim + i] = slice(0, t)
+        out = out[tuple(slices)]
+    if bias is not None:
+        b = jnp.asarray(bias, out.dtype)
+        shape = [1] * out.ndim
+        shape[-1 if channel_last else 1] = b.size
+        out = out + b.reshape(shape)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 1, data_format == "NLC", output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    """Parity: paddle.nn.functional.conv2d_transpose (ref: operators/conv_transpose_op.cc)."""
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 2, data_format == "NHWC", output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 3, data_format == "NDHWC", output_size)

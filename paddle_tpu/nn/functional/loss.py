@@ -1,0 +1,318 @@
+"""Loss functions.
+
+Parity surface: paddle.nn.functional losses (reference:
+paddle/fluid/operators/cross_entropy_op.cc, softmax_with_cross_entropy_op.cu,
+bce_loss_op.cc, smooth_l1_loss_op.cc, kldiv_loss_op.cc, nll_loss_op.cc,
+margin_rank_loss_op.cc, ...; python/paddle/nn/functional/loss.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import dtype as _dt
+from ...framework.errors import InvalidArgumentError
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
+    "kl_div", "smooth_l1_loss", "margin_ranking_loss", "cosine_embedding_loss",
+    "hinge_embedding_loss", "triplet_margin_loss", "label_smooth",
+    "square_error_cost", "log_loss", "sigmoid_focal_loss", "dice_loss",
+    "npair_loss", "cosine_similarity", "ctc_loss",
+]
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "none":
+        return loss
+    raise InvalidArgumentError(f"unknown reduction {reduction!r}")
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """Parity: paddle.nn.functional.cross_entropy
+    (ref: operators/softmax_with_cross_entropy_op.cu — fused on GPU; XLA
+    fuses the log_softmax+gather chain the same way)."""
+    input = jnp.asarray(input)
+    label = jnp.asarray(label)
+    logp = jax.nn.log_softmax(input, axis=axis) if use_softmax else jnp.log(jnp.clip(input, 1e-15, None))
+
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis)
+    else:
+        lbl = label
+        if lbl.ndim == input.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis)
+        n_classes = input.shape[axis]
+        if label_smoothing > 0.0:
+            onehot = jax.nn.one_hot(lbl, n_classes, dtype=logp.dtype, axis=axis)
+            smoothed = onehot * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(smoothed * logp, axis=axis)
+        else:
+            safe = jnp.clip(lbl, 0, n_classes - 1)
+            picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, axis).astype(jnp.int32), axis=axis)
+            loss = -jnp.squeeze(picked, axis)
+        w = None
+        if weight is not None:
+            w = jnp.take(jnp.asarray(weight, logp.dtype), jnp.clip(lbl, 0, n_classes - 1))
+            loss = loss * w
+        mask = (lbl != ignore_index)
+        loss = jnp.where(mask, loss, 0.0)
+        if reduction == "mean":
+            if w is not None:
+                denom = jnp.sum(jnp.where(mask, w, 0.0))
+            else:
+                denom = jnp.maximum(jnp.sum(mask.astype(logp.dtype)), 1.0)
+            return jnp.sum(loss) / denom
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = jnp.expand_dims(loss, axis)
+    if return_softmax:
+        return loss, jax.nn.softmax(jnp.asarray(logits), axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    input = jnp.clip(jnp.asarray(input), 1e-12, 1.0 - 1e-7)
+    label = jnp.asarray(label, input.dtype)
+    loss = -(label * jnp.log(input) + (1 - label) * jnp.log1p(-input))
+    if weight is not None:
+        loss = loss * jnp.asarray(weight, input.dtype)
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    logit = jnp.asarray(logit)
+    label = jnp.asarray(label, logit.dtype)
+    # numerically stable: max(x,0) - x*y + log(1+exp(-|x|))
+    neg_abs = -jnp.abs(logit)
+    loss = jnp.maximum(logit, 0.0) - logit * label + jnp.log1p(jnp.exp(neg_abs))
+    if pos_weight is not None:
+        pw = jnp.asarray(pos_weight, logit.dtype)
+        log_sig = jax.nn.log_sigmoid(logit)
+        log_sig_neg = jax.nn.log_sigmoid(-logit)
+        loss = -(pw * label * log_sig + (1 - label) * log_sig_neg)
+    if weight is not None:
+        loss = loss * jnp.asarray(weight, logit.dtype)
+    return _reduce(loss, reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    d = jnp.asarray(input) - jnp.asarray(label)
+    return _reduce(jnp.square(d), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    d = jnp.abs(jnp.asarray(input) - jnp.asarray(label))
+    return _reduce(d, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    input = jnp.asarray(input)  # log-probabilities (N, C, ...)
+    label = jnp.asarray(label)
+    n_classes = input.shape[1]
+    safe = jnp.clip(label, 0, n_classes - 1)
+    picked = jnp.take_along_axis(input, jnp.expand_dims(safe, 1).astype(jnp.int32), axis=1)
+    loss = -jnp.squeeze(picked, 1)
+    if weight is not None:
+        w = jnp.take(jnp.asarray(weight, input.dtype), safe)
+        loss = loss * w
+    mask = label != ignore_index
+    loss = jnp.where(mask, loss, 0.0)
+    if reduction == "mean":
+        if weight is not None:
+            denom = jnp.sum(jnp.where(mask, jnp.take(jnp.asarray(weight, input.dtype), safe), 0.0))
+        else:
+            denom = jnp.maximum(jnp.sum(mask.astype(input.dtype)), 1.0)
+        return jnp.sum(loss) / denom
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    """input = log-probs, label = probs (paddle semantics)."""
+    input = jnp.asarray(input)
+    label = jnp.asarray(label, input.dtype)
+    loss = label * (jnp.log(jnp.clip(label, 1e-12, None)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    d = jnp.asarray(input) - jnp.asarray(label)
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta) * delta
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    loss = jnp.maximum(0.0, -jnp.asarray(label) * (jnp.asarray(input) - jnp.asarray(other)) + margin)
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    cos = cosine_similarity(input1, input2, axis=-1)
+    label = jnp.asarray(label)
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    input = jnp.asarray(input)
+    label = jnp.asarray(label, input.dtype)
+    loss = jnp.where(label == 1, input, jnp.maximum(0.0, margin - input))
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def pdist(a, b):
+        return jnp.sum(jnp.abs(a - b + epsilon) ** p, axis=-1) ** (1.0 / p)
+
+    input, positive, negative = map(jnp.asarray, (input, positive, negative))
+    dp = pdist(input, positive)
+    dn = pdist(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, pdist(positive, negative))
+    return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    """Parity: paddle.nn.functional.label_smooth (ref: operators/label_smooth_op.cc)."""
+    label = jnp.asarray(label)
+    n = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * jnp.asarray(prior_dist, label.dtype)
+    return (1 - epsilon) * label + epsilon / n
+
+
+def square_error_cost(input, label):
+    """Legacy fluid.layers.square_error_cost parity."""
+    d = jnp.asarray(input) - jnp.asarray(label)
+    return jnp.square(d)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    input = jnp.asarray(input)
+    label = jnp.asarray(label, input.dtype)
+    return -label * jnp.log(input + epsilon) - (1 - label) * jnp.log(1 - input + epsilon)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    logit = jnp.asarray(logit)
+    label = jnp.asarray(label, logit.dtype)
+    p = jax.nn.sigmoid(logit)
+    ce = binary_cross_entropy_with_logits(logit, label, reduction="none")
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * ((1 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / jnp.asarray(normalizer, logit.dtype)
+    return _reduce(loss, reduction)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    input = jnp.asarray(input)
+    label = jnp.asarray(label)
+    label_oh = jax.nn.one_hot(jnp.squeeze(label, -1), input.shape[-1], dtype=input.dtype)
+    reduce_axes = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * label_oh, axis=reduce_axes)
+    denom = jnp.sum(input, axis=reduce_axes) + jnp.sum(label_oh, axis=reduce_axes)
+    return jnp.mean(1 - (2 * inter + epsilon) / (denom + epsilon))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    anchor = jnp.asarray(anchor)
+    positive = jnp.asarray(positive)
+    labels = jnp.asarray(labels)
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), 1)) +
+                    jnp.mean(jnp.sum(jnp.square(positive), 1))) * 0.25
+    sim = anchor @ positive.T
+    lbl = (labels[:, None] == labels[None, :]).astype(anchor.dtype)
+    lbl = lbl / jnp.sum(lbl, axis=1, keepdims=True)
+    xent = jnp.mean(-jnp.sum(lbl * jax.nn.log_softmax(sim, axis=1), axis=1))
+    return xent + reg
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    x1 = jnp.asarray(x1)
+    x2 = jnp.asarray(x2, x1.dtype)
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
+    n2 = jnp.sqrt(jnp.sum(jnp.square(x2), axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC (ref: operators/warpctc_op.cc wraps warp-ctc; here: pure-XLA
+    forward algorithm in log space via lax.scan — jit/grad-able)."""
+    log_probs = jnp.asarray(log_probs)  # (T, N, C) paddle layout
+    labels = jnp.asarray(labels)  # (N, S)
+    T, N, C = log_probs.shape
+    S = labels.shape[1]
+    neg_inf = jnp.array(-1e30, log_probs.dtype)
+
+    # extended label sequence with blanks: length 2S+1
+    ext = jnp.full((N, 2 * S + 1), blank, dtype=labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    ext_len = 2 * label_lengths + 1
+
+    def logsumexp2(a, b):
+        m = jnp.maximum(a, b)
+        m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+        return jnp.where(jnp.isfinite(m), m_safe + jnp.log(jnp.exp(a - m_safe) + jnp.exp(b - m_safe)), neg_inf)
+
+    # alpha init
+    alpha0 = jnp.full((N, 2 * S + 1), neg_inf)
+    p0 = log_probs[0]  # (N, C)
+    alpha0 = alpha0.at[:, 0].set(jnp.take_along_axis(p0, ext[:, :1], axis=1)[:, 0])
+    if 2 * S + 1 > 1:
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(ext_len > 1, jnp.take_along_axis(p0, ext[:, 1:2], axis=1)[:, 0], neg_inf))
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.ones((N, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def step(alpha, logp_t):
+        shift1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+        shift2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+        shift2 = jnp.where(same_as_prev2, neg_inf, shift2)
+        merged = logsumexp2(logsumexp2(alpha, shift1), shift2)
+        emit = jnp.take_along_axis(logp_t, ext, axis=1)
+        return merged + emit, None
+
+    def scan_body(carry, t):
+        alpha = carry
+        new_alpha, _ = step(alpha, log_probs[t])
+        # freeze once past this sequence's input length
+        new_alpha = jnp.where((t < input_lengths)[:, None], new_alpha, alpha)
+        return new_alpha, None
+
+    alpha, _ = jax.lax.scan(scan_body, alpha0, jnp.arange(1, T))
+    idx_last = (ext_len - 1)[:, None]
+    idx_prev = jnp.maximum(ext_len - 2, 0)[:, None]
+    ll = logsumexp2(
+        jnp.take_along_axis(alpha, idx_last, axis=1)[:, 0],
+        jnp.take_along_axis(alpha, idx_prev, axis=1)[:, 0],
+    )
+    loss = -ll
+    if norm_by_times:
+        loss = loss / jnp.asarray(input_lengths, loss.dtype)
+    if reduction == "mean":
+        # paddle semantics: per-sample NLL / label_length, then batch mean
+        return jnp.mean(loss / jnp.maximum(jnp.asarray(label_lengths, loss.dtype), 1.0))
+    return _reduce(loss, reduction)

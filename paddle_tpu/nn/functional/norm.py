@@ -1,0 +1,176 @@
+"""Normalization ops.
+
+Parity surface: paddle.nn.functional.{batch_norm,layer_norm,instance_norm,
+group_norm,local_response_norm,normalize} (reference:
+paddle/fluid/operators/batch_norm_op.cc/.cu (cuDNN), layer_norm_op.cu,
+group_norm_op.cc, instance_norm_op.cc, norm_op.cc).
+
+The reference hand-fuses these as CUDA kernels; under XLA each is a handful
+of elementwise/reduce HLOs that fuse with neighbors automatically, which is
+why there is no custom kernel here.  All stats accumulate in float32 even
+for bf16 inputs (TPU numerics policy; matches cuDNN's float accumulation).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.errors import InvalidArgumentError
+
+__all__ = [
+    "batch_norm", "layer_norm", "instance_norm", "group_norm",
+    "local_response_norm", "normalize",
+]
+
+
+def _stat_dtype(x):
+    return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else x.dtype
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Functional batch norm.
+
+    Returns ``(out, new_mean, new_var)`` in training mode (functional stat
+    update — the Layer wrapper assigns them back), ``out`` in eval mode.
+    Paddle's momentum convention: new = momentum*old + (1-momentum)*batch.
+    """
+    x = jnp.asarray(x)
+    ch_axis = x.ndim - 1 if data_format in ("NHWC", "NLC", "NDHWC") else 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    sd = _stat_dtype(x)
+    xf = x.astype(sd)
+
+    if use_global_stats is None:
+        use_global_stats = not training
+
+    if training and not use_global_stats:
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)
+        new_mean = momentum * jnp.asarray(running_mean, sd) + (1 - momentum) * mean
+        new_var = momentum * jnp.asarray(running_var, sd) + (1 - momentum) * var
+    else:
+        mean = jnp.asarray(running_mean, sd)
+        var = jnp.asarray(running_var, sd)
+        new_mean, new_var = None, None
+
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    inv = jax.lax.rsqrt(var + epsilon)
+    out = (xf - mean.reshape(shape)) * inv.reshape(shape)
+    if weight is not None:
+        out = out * jnp.asarray(weight, sd).reshape(shape)
+    if bias is not None:
+        out = out + jnp.asarray(bias, sd).reshape(shape)
+    out = out.astype(x.dtype)
+    if new_mean is not None:
+        return out, new_mean, new_var
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    """Parity: paddle.nn.functional.layer_norm (ref: operators/layer_norm_op.cu)."""
+    x = jnp.asarray(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    normalized_shape = tuple(normalized_shape)
+    n = len(normalized_shape)
+    if tuple(x.shape[x.ndim - n:]) != normalized_shape:
+        raise InvalidArgumentError(
+            f"normalized_shape {normalized_shape} does not match trailing dims of {x.shape}")
+    axes = tuple(range(x.ndim - n, x.ndim))
+    sd = _stat_dtype(x)
+    xf = x.astype(sd)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * jnp.asarray(weight, sd)
+    if bias is not None:
+        out = out + jnp.asarray(bias, sd)
+    return out.astype(x.dtype)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    ch_axis = x.ndim - 1 if channel_last else 1
+    axes = tuple(i for i in range(2, x.ndim)) if not channel_last else tuple(i for i in range(1, x.ndim - 1))
+    sd = _stat_dtype(x)
+    xf = x.astype(sd)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        shape = [1] * x.ndim
+        shape[ch_axis] = x.shape[ch_axis]
+        out = out * jnp.asarray(weight, sd).reshape(shape)
+    if bias is not None:
+        shape = [1] * x.ndim
+        shape[ch_axis] = x.shape[ch_axis]
+        out = out + jnp.asarray(bias, sd).reshape(shape)
+    return out.astype(x.dtype)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    ch_axis = x.ndim - 1 if channel_last else 1
+    C = x.shape[ch_axis]
+    if C % num_groups != 0:
+        raise InvalidArgumentError(f"channels {C} not divisible by groups {num_groups}")
+    sd = _stat_dtype(x)
+    xf = x.astype(sd)
+    if channel_last:
+        moved = jnp.moveaxis(xf, ch_axis, 1)
+    else:
+        moved = xf
+    N = moved.shape[0]
+    grouped = moved.reshape((N, num_groups, C // num_groups) + moved.shape[2:])
+    axes = tuple(range(2, grouped.ndim))
+    mean = jnp.mean(grouped, axis=axes, keepdims=True)
+    var = jnp.var(grouped, axis=axes, keepdims=True)
+    out = ((grouped - mean) * jax.lax.rsqrt(var + epsilon)).reshape(moved.shape)
+    if channel_last:
+        out = jnp.moveaxis(out, 1, ch_axis)
+    shape = [1] * x.ndim
+    shape[ch_axis] = C
+    if weight is not None:
+        out = out * jnp.asarray(weight, sd).reshape(shape)
+    if bias is not None:
+        out = out + jnp.asarray(bias, sd).reshape(shape)
+    return out.astype(x.dtype)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    """Parity: paddle.nn.functional.local_response_norm (ref: operators/lrn_op.cc)."""
+    x = jnp.asarray(x)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    ch_axis = x.ndim - 1 if channel_last else 1
+    sq = jnp.square(x.astype(jnp.float32))
+    # sum over a window of `size` channels centered at each channel
+    pad_lo = (size - 1) // 2
+    pad_hi = size - 1 - pad_lo
+    widths = [(0, 0)] * x.ndim
+    widths[ch_axis] = (pad_lo, pad_hi)
+    padded = jnp.pad(sq, widths)
+    window = [1] * x.ndim
+    window[ch_axis] = size
+    summed = jax.lax.reduce_window(padded, jnp.array(0, jnp.float32), jax.lax.add,
+                                   tuple(window), (1,) * x.ndim, "VALID")
+    div = jnp.power(k + alpha * summed, beta)
+    return (x.astype(jnp.float32) / div).astype(x.dtype)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = jnp.asarray(x)
+    if p == 2:
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    else:
+        n = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(n, epsilon)

@@ -1,0 +1,239 @@
+"""Pooling ops.
+
+Parity surface: paddle.nn.functional pooling (reference:
+paddle/fluid/operators/pool_op.cc, pool_cudnn_op.cu, operators/math/pooling.cu).
+On TPU pooling is a ``lax.reduce_window`` HLO.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ...framework.errors import InvalidArgumentError
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d",
+    "max_pool1d", "max_pool2d", "max_pool3d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+]
+
+
+def _norm(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    return v * n if len(v) == 1 else v
+
+
+def _pool(x, kernel, stride, padding, n, channel_last, reducer, init, ceil_mode):
+    x = jnp.asarray(x)
+    kernel = _norm(kernel, n)
+    stride = _norm(stride if stride is not None else kernel, n)
+    if isinstance(padding, str):
+        raise InvalidArgumentError("string padding not supported for pool; use ints")
+    padding = _norm(padding, n)
+
+    if channel_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pads = [(0, 0)] + [(p, p) for p in padding] + [(0, 0)]
+        spatial_dims = list(range(1, 1 + n))
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = [(0, 0), (0, 0)] + [(p, p) for p in padding]
+        spatial_dims = list(range(2, 2 + n))
+
+    if ceil_mode:
+        # add extra right-padding so ceil-division windows fit
+        for i, d in enumerate(spatial_dims):
+            size = x.shape[d] + 2 * padding[i]
+            rem = (size - kernel[i]) % stride[i]
+            if rem != 0:
+                lo, hi = pads[d]
+                pads[d] = (lo, hi + (stride[i] - rem))
+    return lax.reduce_window(x, init, reducer, window, strides, pads), kernel, pads, spatial_dims
+
+
+def _avg_pool(x, kernel, stride, padding, n, channel_last, exclusive, ceil_mode):
+    x = jnp.asarray(x)
+    summed, kernel_t, pads, spatial_dims = _pool(
+        x, kernel, stride, padding, n, channel_last, lax.add, 0.0 if x.dtype == jnp.float64 else jnp.array(0, x.dtype), ceil_mode)
+    if exclusive:
+        # divide by the count of valid (non-pad) elements per window
+        ones = jnp.ones([x.shape[d] for d in spatial_dims], x.dtype)
+        shape = [1] * x.ndim
+        for d in spatial_dims:
+            shape[d] = x.shape[d]
+        ones = ones.reshape(shape)
+        stride_t = _norm(stride if stride is not None else kernel, n)
+        if channel_last:
+            window = (1,) + _norm(kernel, n) + (1,)
+            strides = (1,) + stride_t + (1,)
+        else:
+            window = (1, 1) + _norm(kernel, n)
+            strides = (1, 1) + stride_t
+        counts = lax.reduce_window(jnp.broadcast_to(ones, shape), jnp.array(0, x.dtype),
+                                   lax.add, window, strides, pads)
+        return summed / counts
+    return summed / np.prod(kernel_t)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False,
+               data_format="NCL", name=None):
+    return _avg_pool(x, kernel_size, stride, padding, 1, data_format == "NLC", exclusive, ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCHW", name=None):
+    if divisor_override:
+        x = jnp.asarray(x)
+        summed, kernel_t, _, _ = _pool(x, kernel_size, stride, padding, 2,
+                                       data_format == "NHWC", lax.add, jnp.array(0, x.dtype), ceil_mode)
+        return summed / divisor_override
+    return _avg_pool(x, kernel_size, stride, padding, 2, data_format == "NHWC", exclusive, ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCDHW", name=None):
+    if divisor_override:
+        x = jnp.asarray(x)
+        summed, _, _, _ = _pool(x, kernel_size, stride, padding, 3,
+                                data_format == "NDHWC", lax.add, jnp.array(0, x.dtype), ceil_mode)
+        return summed / divisor_override
+    return _avg_pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC", exclusive, ceil_mode)
+
+
+def _max_pool(x, kernel, stride, padding, n, channel_last, ceil_mode):
+    x = jnp.asarray(x)
+    neg_inf = jnp.array(-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min, x.dtype)
+    out, _, _, _ = _pool(x, kernel, stride, padding, n, channel_last, lax.max, neg_inf, ceil_mode)
+    return out
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCL", name=None):
+    out = _max_pool(x, kernel_size, stride, padding, 1, data_format == "NLC", ceil_mode)
+    return (out, _max_pool_indices(x, kernel_size, stride, padding, 1, data_format == "NLC", ceil_mode)) if return_mask else out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCHW", name=None):
+    out = _max_pool(x, kernel_size, stride, padding, 2, data_format == "NHWC", ceil_mode)
+    return (out, _max_pool_indices(x, kernel_size, stride, padding, 2, data_format == "NHWC", ceil_mode)) if return_mask else out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCDHW", name=None):
+    out = _max_pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC", ceil_mode)
+    return (out, _max_pool_indices(x, kernel_size, stride, padding, 3, data_format == "NDHWC", ceil_mode)) if return_mask else out
+
+
+def _max_pool_indices(x, kernel, stride, padding, n, channel_last, ceil_mode=False):
+    """Flat spatial argmax indices (paddle return_mask parity). Computed by
+    an extra reduce_window over (value, iota) pairs — only built when
+    requested; uses the same window/stride/pad (incl. ceil_mode) as the
+    value pool so shapes always match."""
+    x = jnp.asarray(x)
+    spatial_shape = x.shape[1:-1] if channel_last else x.shape[2:]
+    size = int(np.prod(spatial_shape))
+    iota = jnp.arange(size, dtype=jnp.int64).reshape(spatial_shape)
+    shape = [1] * x.ndim
+    for i, d in enumerate(range(1, 1 + n) if channel_last else range(2, 2 + n)):
+        shape[d] = spatial_shape[i]
+    iota = jnp.broadcast_to(iota.reshape(shape), x.shape)
+
+    kernel_t = _norm(kernel, n)
+    stride_t = _norm(stride if stride is not None else kernel, n)
+    padding_t = _norm(padding, n)
+    if channel_last:
+        window = (1,) + kernel_t + (1,)
+        strides = (1,) + stride_t + (1,)
+        pads = [(0, 0)] + [(p, p) for p in padding_t] + [(0, 0)]
+        spatial_dims = list(range(1, 1 + n))
+    else:
+        window = (1, 1) + kernel_t
+        strides = (1, 1) + stride_t
+        pads = [(0, 0), (0, 0)] + [(p, p) for p in padding_t]
+        spatial_dims = list(range(2, 2 + n))
+    if ceil_mode:
+        for i, dd in enumerate(spatial_dims):
+            sz = x.shape[dd] + 2 * padding_t[i]
+            rem = (sz - kernel_t[i]) % stride_t[i]
+            if rem != 0:
+                lo, hi = pads[dd]
+                pads[dd] = (lo, hi + (stride_t[i] - rem))
+
+    neg_inf = jnp.array(-jnp.inf, x.dtype)
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        pick_b = bv > av
+        return lax.select(pick_b, bv, av), lax.select(pick_b, bi, ai)
+
+    _, idx = lax.reduce_window((x, iota), (neg_inf, jnp.array(-1, jnp.int64)),
+                               reducer, window, strides, pads)
+    return idx
+
+
+def _adaptive_pool(x, output_size, n, channel_last, op):
+    x = jnp.asarray(x)
+    if isinstance(output_size, int):
+        output_size = (output_size,) * n
+    output_size = tuple(s if s is not None else x.shape[(1 + i) if channel_last else (2 + i)]
+                        for i, s in enumerate(output_size))
+    spatial_start = 1 if channel_last else 2
+    out = x
+    for i in range(n):
+        dim = spatial_start + i
+        in_size = out.shape[dim]
+        o = output_size[i]
+        if in_size % o == 0:
+            # even split: reshape + reduce (fast path)
+            k = in_size // o
+            new_shape = out.shape[:dim] + (o, k) + out.shape[dim + 1:]
+            r = out.reshape(new_shape)
+            out = jnp.max(r, axis=dim + 1) if op == "max" else jnp.mean(r, axis=dim + 1)
+        else:
+            # uneven: gather per output index (adaptive windows)
+            starts = np.floor(np.arange(o) * in_size / o).astype(np.int64)
+            ends = np.ceil((np.arange(o) + 1) * in_size / o).astype(np.int64)
+            pieces = []
+            for s, e in zip(starts, ends):
+                sl = [slice(None)] * out.ndim
+                sl[dim] = slice(int(s), int(e))
+                seg = out[tuple(sl)]
+                red = jnp.max(seg, axis=dim, keepdims=True) if op == "max" else jnp.mean(seg, axis=dim, keepdims=True)
+                pieces.append(red)
+            out = jnp.concatenate(pieces, axis=dim)
+    return out
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, False, "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format == "NHWC", "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format == "NDHWC", "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 1, False, "max")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 2, False, "max")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 3, False, "max")
+    return (out, None) if return_mask else out

@@ -1,0 +1,230 @@
+"""Weight initializers + ParamAttr.
+
+Parity surface: paddle.nn.initializer / paddle.ParamAttr
+(reference: python/paddle/fluid/initializer.py — ConstantInitializer,
+UniformInitializer, NormalInitializer, TruncatedNormal, Xavier, MSRA
+(= Kaiming), Bilinear, NumpyArrayInitializer; python/paddle/fluid/param_attr.py).
+
+Initializers are pure callables ``init(shape, dtype, key) -> jax.Array`` —
+no init-op graph insertion as in the reference; values materialize directly.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dt
+from ..framework.random import split_key
+
+__all__ = [
+    "Initializer", "Constant", "Uniform", "Normal", "TruncatedNormal",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Bilinear", "Dirac", "Orthogonal", "calculate_gain", "ParamAttr",
+]
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # paddle Linear weight layout: (in_features, out_features)
+        return shape[0], shape[1]
+    # conv kernels (paddle layout OIHW): receptive = prod(spatial)
+    receptive = int(np.prod(shape[2:]))
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0,
+        "conv2d": 1.0,
+        "conv3d": 1.0,
+        "tanh": 5.0 / 3.0,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4.0,
+    }
+    return gains[nonlinearity]
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None, key=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None, key=None):
+        return jnp.full(tuple(shape), self.value, dtype=_dt.convert_dtype(dtype))
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None, key=None):
+        return jax.random.uniform(split_key(key), tuple(shape),
+                                  dtype=_dt.convert_dtype(dtype),
+                                  minval=self.low, maxval=self.high)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None, key=None):
+        d = _dt.convert_dtype(dtype)
+        return jax.random.normal(split_key(key), tuple(shape), dtype=d) * self.std + self.mean
+
+
+class TruncatedNormal(Initializer):
+    """Truncated at ±2σ, matching the reference's TruncatedNormalInitializer."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None, key=None):
+        d = _dt.convert_dtype(dtype)
+        z = jax.random.truncated_normal(split_key(key), -2.0, 2.0, tuple(shape), dtype=d)
+        return z * self.std + self.mean
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None, key=None):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        d = _dt.convert_dtype(dtype)
+        return jax.random.normal(split_key(key), tuple(shape), dtype=d) * std
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None, key=None):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        d = _dt.convert_dtype(dtype)
+        return jax.random.uniform(split_key(key), tuple(shape), dtype=d,
+                                  minval=-limit, maxval=limit)
+
+
+class KaimingNormal(Initializer):
+    """Parity: MSRAInitializer (fluid/initializer.py) / paddle.nn.initializer.KaimingNormal."""
+
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=None, key=None):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        d = _dt.convert_dtype(dtype)
+        return jax.random.normal(split_key(key), tuple(shape), dtype=d) * std
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=None, key=None):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        d = _dt.convert_dtype(dtype)
+        return jax.random.uniform(split_key(key), tuple(shape), dtype=d,
+                                  minval=-limit, maxval=limit)
+
+
+class Assign(Initializer):
+    """Parity: NumpyArrayInitializer."""
+
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def __call__(self, shape, dtype=None, key=None):
+        v = jnp.asarray(self.value, dtype=_dt.convert_dtype(dtype))
+        if tuple(v.shape) != tuple(shape):
+            v = jnp.reshape(v, tuple(shape))
+        return v
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel for ConvTranspose (ref: BilinearInitializer)."""
+
+    def __call__(self, shape, dtype=None, key=None):
+        C_out, C_in, *spatial = shape
+        weight = np.zeros(tuple(shape), dtype=np.float64)
+        k = spatial[0]
+        factor = (k + 1) // 2
+        center = factor - 1.0 if k % 2 == 1 else factor - 0.5
+        og = np.ogrid[tuple(np.s_[:s] for s in spatial)]
+        filt = np.ones([1] * len(spatial))
+        for g in og:
+            filt = filt * (1 - np.abs(g - center) / factor)
+        for i in range(min(C_out, C_in)):
+            weight[i, i % C_in] = filt
+        return jnp.asarray(weight, dtype=_dt.convert_dtype(dtype))
+
+
+class Dirac(Initializer):
+    def __call__(self, shape, dtype=None, key=None):
+        C_out, C_in, *spatial = shape
+        w = np.zeros(tuple(shape), dtype=np.float64)
+        centers = tuple(s // 2 for s in spatial)
+        for i in range(min(C_out, C_in)):
+            w[(i, i) + centers] = 1.0
+        return jnp.asarray(w, dtype=_dt.convert_dtype(dtype))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None, key=None):
+        d = _dt.convert_dtype(dtype)
+        return jax.nn.initializers.orthogonal(scale=self.gain)(split_key(key), tuple(shape), d)
+
+
+class ParamAttr:
+    """Parity: paddle.ParamAttr (python/paddle/fluid/param_attr.py).
+
+    ``learning_rate`` and ``regularizer`` are honored by the optimizer layer
+    (per-parameter lr scaling / weight decay), ``trainable`` by the Layer.
+    """
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip

@@ -1,0 +1,302 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU (+cells).
+
+Parity surface: paddle.nn.{SimpleRNN,LSTM,GRU,RNNCellBase,...}
+(reference: python/paddle/nn/layer/rnn.py; kernels operators/rnn_op /
+cudnn_lstm_op.cu, math/gru_compute, lstm_compute).
+
+TPU-native design: the time loop is a single ``lax.scan`` per layer &
+direction — XLA compiles it into one fused loop (the cuDNN-RNN equivalent);
+gate matmuls are batched into one (4*hidden) MXU matmul per step, the same
+packing trick cuDNN uses.  Variable-length sequences use ``sequence_length``
+masks (dense padding policy, SURVEY §5 LoD note).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from . import functional as F
+from . import initializer as I
+from .layer_base import Layer
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+    "SimpleRNN", "LSTM", "GRU",
+]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_size, dtype="float32"):
+        raise NotImplementedError
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter((hidden_size, input_size),
+                                               attr=weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size),
+                                               attr=weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter((hidden_size,), attr=bias_ih_attr,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter((hidden_size,), attr=bias_hh_attr,
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = jnp.zeros((inputs.shape[0], self.hidden_size), jnp.asarray(inputs).dtype)
+        pre = (jnp.asarray(inputs) @ self.weight_ih.value.T + self.bias_ih.value
+               + states @ self.weight_hh.value.T + self.bias_hh.value)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        h = act(pre)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    """Gate order i, f, c(g), o — matches the reference
+    (operators/math/detail/lstm_kernel.h)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size),
+                                               attr=weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size),
+                                               attr=weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter((4 * hidden_size,), attr=bias_ih_attr,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter((4 * hidden_size,), attr=bias_hh_attr,
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        x = jnp.asarray(inputs)
+        if states is None:
+            h = jnp.zeros((x.shape[0], self.hidden_size), x.dtype)
+            c = jnp.zeros((x.shape[0], self.hidden_size), x.dtype)
+        else:
+            h, c = states
+        gates = (x @ self.weight_ih.value.T + self.bias_ih.value
+                 + h @ self.weight_hh.value.T + self.bias_hh.value)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        new_c = f * c + i * g
+        new_h = o * jnp.tanh(new_c)
+        return new_h, (new_h, new_c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    """Gate order r(reset), z(update), c(candidate) — paddle convention."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size),
+                                               attr=weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size),
+                                               attr=weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter((3 * hidden_size,), attr=bias_ih_attr,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter((3 * hidden_size,), attr=bias_hh_attr,
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        x = jnp.asarray(inputs)
+        if states is None:
+            states = jnp.zeros((x.shape[0], self.hidden_size), x.dtype)
+        h = states
+        xg = x @ self.weight_ih.value.T + self.bias_ih.value
+        hg = h @ self.weight_hh.value.T + self.bias_hh.value
+        xr, xz, xc = jnp.split(xg, 3, axis=-1)
+        hr, hz, hc = jnp.split(hg, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        c = jnp.tanh(xc + r * hc)
+        new_h = (1 - z) * c + z * h
+        return new_h, new_h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+def _scan_rnn(cell, params_fn, inputs, init_state, reverse=False, seq_lens=None):
+    """Run a cell over time via lax.scan. inputs: (T, B, I)."""
+
+    def step(state, xt_t):
+        xt, t = xt_t
+        out, new_state = cell(xt, state)
+        if seq_lens is not None:
+            valid = (t < seq_lens)[:, None]
+            new_state = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(valid, n, o), new_state, state)
+            out = jnp.where(valid, out, jnp.zeros_like(out))
+        return new_state, out
+
+    T = inputs.shape[0]
+    ts = jnp.arange(T - 1, -1, -1) if reverse else jnp.arange(T)
+    xs = jnp.flip(inputs, 0) if reverse else inputs
+    final, outs = jax.lax.scan(step, init_state, (xs, ts))
+    if reverse:
+        outs = jnp.flip(outs, 0)
+    return outs, final
+
+
+class RNN(Layer):
+    """Generic wrapper running a cell over a sequence (paddle.nn.RNN parity)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = jnp.asarray(inputs)
+        if not self.time_major:
+            x = jnp.swapaxes(x, 0, 1)
+        if initial_states is None:
+            batch = x.shape[1]
+            zeros = jnp.zeros((batch, self.cell.hidden_size), x.dtype)
+            initial_states = (zeros, zeros) if isinstance(self.cell, LSTMCell) else zeros
+        seq_lens = jnp.asarray(sequence_length) if sequence_length is not None else None
+        outs, final = _scan_rnn(self.cell, None, x, initial_states,
+                                reverse=self.is_reverse, seq_lens=seq_lens)
+        if not self.time_major:
+            outs = jnp.swapaxes(outs, 0, 1)
+        return outs, final
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        fw = RNN(self.cell_fw, False, self.time_major)
+        bw = RNN(self.cell_bw, True, self.time_major)
+        o1, s1 = fw(inputs, None if initial_states is None else initial_states[0], sequence_length)
+        o2, s2 = bw(inputs, None if initial_states is None else initial_states[1], sequence_length)
+        return jnp.concatenate([o1, o2], axis=-1), (s1, s2)
+
+
+class _RNNBase(Layer):
+    _cell_cls = SimpleRNNCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirectional else 1
+        kw = {}
+        if self._cell_cls is SimpleRNNCell:
+            kw["activation"] = activation
+        for layer in range(num_layers):
+            in_size = input_size if layer == 0 else hidden_size * num_dirs
+            for d in range(num_dirs):
+                cell = self._cell_cls(in_size, hidden_size,
+                                      weight_ih_attr=weight_ih_attr,
+                                      weight_hh_attr=weight_hh_attr,
+                                      bias_ih_attr=bias_ih_attr,
+                                      bias_hh_attr=bias_hh_attr, **kw)
+                self.add_sublayer(f"cell_{layer}_{d}", cell)
+
+    def _cells(self):
+        num_dirs = 2 if self.bidirectional else 1
+        return [[self._sub_layers[f"cell_{l}_{d}"] for d in range(num_dirs)]
+                for l in range(self.num_layers)]
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = jnp.asarray(inputs)
+        if not self.time_major:
+            x = jnp.swapaxes(x, 0, 1)  # (T, B, I)
+        batch = x.shape[1]
+        num_dirs = 2 if self.bidirectional else 1
+        is_lstm = self._cell_cls is LSTMCell
+        seq_lens = jnp.asarray(sequence_length) if sequence_length is not None else None
+
+        def init_state(layer, d):
+            idx = layer * num_dirs + d
+            if initial_states is None:
+                z = jnp.zeros((batch, self.hidden_size), x.dtype)
+                return (z, z) if is_lstm else z
+            if is_lstm:
+                h0, c0 = initial_states
+                return (jnp.asarray(h0)[idx], jnp.asarray(c0)[idx])
+            return jnp.asarray(initial_states)[idx]
+
+        out = x
+        final_h, final_c = [], []
+        for layer, cells in enumerate(self._cells()):
+            outs_dirs = []
+            for d, cell in enumerate(cells):
+                o, f = _scan_rnn(cell, None, out, init_state(layer, d),
+                                 reverse=(d == 1), seq_lens=seq_lens)
+                outs_dirs.append(o)
+                if is_lstm:
+                    final_h.append(f[0])
+                    final_c.append(f[1])
+                else:
+                    final_h.append(f)
+            out = outs_dirs[0] if len(outs_dirs) == 1 else jnp.concatenate(outs_dirs, -1)
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                out = F.dropout(out, p=self.dropout, training=self.training)
+        if not self.time_major:
+            out = jnp.swapaxes(out, 0, 1)
+        h = jnp.stack(final_h, 0)
+        if is_lstm:
+            c = jnp.stack(final_c, 0)
+            return out, (h, c)
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    _cell_cls = SimpleRNNCell
+
+
+class LSTM(_RNNBase):
+    """Parity: paddle.nn.LSTM (ref: operators/cudnn_lstm_op.cu → lax.scan)."""
+
+    _cell_cls = LSTMCell
+
+
+class GRU(_RNNBase):
+    _cell_cls = GRUCell

@@ -1,0 +1,249 @@
+"""Transformer layers.
+
+Parity surface: paddle.nn.{MultiHeadAttention,TransformerEncoderLayer,
+TransformerEncoder,TransformerDecoderLayer,TransformerDecoder,Transformer}
+(reference: python/paddle/nn/layer/transformer.py).
+
+TPU-native: attention runs through
+``paddle_tpu.nn.functional.scaled_dot_product_attention`` which routes long
+sequences to the Pallas flash-attention kernel; QKV projections are three
+MXU matmuls XLA fuses; everything is bf16-friendly.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import functional as F
+from .common import Linear, Dropout
+from .container import LayerList
+from .layer_base import Layer
+from .norm import LayerNorm
+
+__all__ = [
+    "MultiHeadAttention", "TransformerEncoderLayer", "TransformerEncoder",
+    "TransformerDecoderLayer", "TransformerDecoder", "Transformer",
+]
+
+
+def _convert_attn_mask(mask, dtype):
+    """paddle convention: bool mask True=keep; float mask added to logits."""
+    if mask is None:
+        return None
+    mask = jnp.asarray(mask)
+    if mask.dtype == jnp.bool_:
+        return mask
+    return mask.astype(dtype)
+
+
+class MultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
+                 need_weights=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.dropout = dropout
+        self.need_weights = need_weights
+        self.head_dim = embed_dim // num_heads
+        kdim = kdim or embed_dim
+        vdim = vdim or embed_dim
+        self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self.k_proj = Linear(kdim, embed_dim, weight_attr, bias_attr)
+        self.v_proj = Linear(vdim, embed_dim, weight_attr, bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self._cache = None
+
+    def _shape(self, x):
+        # (B, S, E) → (B, S, H, D)
+        b, s, _ = x.shape
+        return x.reshape(b, s, self.num_heads, self.head_dim)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        query = jnp.asarray(query)
+        key = query if key is None else jnp.asarray(key)
+        value = key if value is None else jnp.asarray(value)
+        q = self._shape(self.q_proj(query))
+        k = self._shape(self.k_proj(key))
+        v = self._shape(self.v_proj(value))
+        if cache is not None:
+            # incremental decode: concat past K/V (paddle Cache parity)
+            pk, pv = cache
+            k = jnp.concatenate([pk, k], axis=1)
+            v = jnp.concatenate([pv, v], axis=1)
+            new_cache = (k, v)
+        mask = _convert_attn_mask(attn_mask, q.dtype)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=mask, dropout_p=self.dropout,
+            training=self.training)
+        b, s, _, _ = out.shape
+        out = self.out_proj(out.reshape(b, s, self.embed_dim))
+        if cache is not None:
+            return out, new_cache
+        return out
+
+    def gen_cache(self, key, value=None, type=None):
+        """Start an empty decode cache (paddle parity shape)."""
+        key = jnp.asarray(key)
+        b = key.shape[0]
+        empty = jnp.zeros((b, 0, self.num_heads, self.head_dim), key.dtype)
+        return (empty, empty)
+
+
+class TransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 layer_norm_eps=1e-5):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(
+            d_model, nhead, dropout=attn_dropout if attn_dropout is not None else dropout,
+            weight_attr=weight_attr, bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)
+        self.norm1 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.norm2 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.act_dropout = Dropout(act_dropout if act_dropout is not None else dropout)
+        self.activation = activation
+
+    def _act(self, x):
+        return getattr(F, self.activation)(x)
+
+    def forward(self, src, src_mask=None, cache=None):
+        residual = src
+        x = self.norm1(src) if self.normalize_before else src
+        x = self.self_attn(x, attn_mask=src_mask)
+        x = residual + self.dropout1(x)
+        if not self.normalize_before:
+            x = self.norm1(x)
+        residual = x
+        y = self.norm2(x) if self.normalize_before else x
+        y = self.linear2(self.act_dropout(self._act(self.linear1(y))))
+        y = residual + self.dropout2(y)
+        if not self.normalize_before:
+            y = self.norm2(y)
+        return y
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer, num_layers, norm=None):
+        super().__init__()
+        import copy
+
+        self.layers = LayerList([encoder_layer] +
+                                [copy.deepcopy(encoder_layer) for _ in range(num_layers - 1)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, src, src_mask=None):
+        out = src
+        for layer in self.layers:
+            out = layer(out, src_mask=src_mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        return out
+
+
+class TransformerDecoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 layer_norm_eps=1e-5):
+        super().__init__()
+        self.normalize_before = normalize_before
+        ad = attn_dropout if attn_dropout is not None else dropout
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=ad,
+                                            weight_attr=weight_attr, bias_attr=bias_attr)
+        self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=ad,
+                                             weight_attr=weight_attr, bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)
+        self.norm1 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.norm2 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.norm3 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.dropout3 = Dropout(dropout)
+        self.act_dropout = Dropout(act_dropout if act_dropout is not None else dropout)
+        self.activation = activation
+
+    def _act(self, x):
+        return getattr(F, self.activation)(x)
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
+        residual = tgt
+        x = self.norm1(tgt) if self.normalize_before else tgt
+        x = self.self_attn(x, attn_mask=tgt_mask)
+        x = residual + self.dropout1(x)
+        if not self.normalize_before:
+            x = self.norm1(x)
+        residual = x
+        y = self.norm2(x) if self.normalize_before else x
+        y = self.cross_attn(y, memory, memory, attn_mask=memory_mask)
+        y = residual + self.dropout2(y)
+        if not self.normalize_before:
+            y = self.norm2(y)
+        residual = y
+        z = self.norm3(y) if self.normalize_before else y
+        z = self.linear2(self.act_dropout(self._act(self.linear1(z))))
+        z = residual + self.dropout3(z)
+        if not self.normalize_before:
+            z = self.norm3(z)
+        return z
+
+
+class TransformerDecoder(Layer):
+    def __init__(self, decoder_layer, num_layers, norm=None):
+        super().__init__()
+        import copy
+
+        self.layers = LayerList([decoder_layer] +
+                                [copy.deepcopy(decoder_layer) for _ in range(num_layers - 1)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None):
+        out = tgt
+        for layer in self.layers:
+            out = layer(out, memory, tgt_mask=tgt_mask, memory_mask=memory_mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        return out
+
+
+class Transformer(Layer):
+    """Parity: paddle.nn.Transformer."""
+
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 custom_encoder=None, custom_decoder=None):
+        super().__init__()
+        if custom_encoder is not None:
+            self.encoder = custom_encoder
+        else:
+            enc_layer = TransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before, weight_attr, bias_attr)
+            enc_norm = LayerNorm(d_model) if normalize_before else None
+            self.encoder = TransformerEncoder(enc_layer, num_encoder_layers, enc_norm)
+        if custom_decoder is not None:
+            self.decoder = custom_decoder
+        else:
+            dec_layer = TransformerDecoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before, weight_attr, bias_attr)
+            dec_norm = LayerNorm(d_model) if normalize_before else None
+            self.decoder = TransformerDecoder(dec_layer, num_decoder_layers, dec_norm)
+        self.d_model = d_model
+        self.nhead = nhead
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None, memory_mask=None):
+        memory = self.encoder(src, src_mask=src_mask)
+        return self.decoder(tgt, memory, tgt_mask=tgt_mask, memory_mask=memory_mask)
+
+    @staticmethod
+    def generate_square_subsequent_mask(length):
+        return jnp.tril(jnp.ones((length, length), bool))

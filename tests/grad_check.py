@@ -1,0 +1,37 @@
+"""Numeric-vs-analytic gradient harness.
+
+TPU-native equivalent of the reference's OpTest.check_grad
+(python/paddle/fluid/tests/unittests/op_test.py:1282 — compares analytic grad
+kernels against central finite differences, delta=0.005).  Here the analytic
+side is jax.grad over the same function, which exercises our op
+implementations' VJPs through XLA.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def numeric_grad(fn, args, idx=0, delta=5e-3):
+    """Central finite differences w.r.t. args[idx] of scalar fn(*args)."""
+    args = [np.asarray(a, np.float64) for a in args]
+    x = args[idx]
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        f_hi = float(fn(*[jnp.asarray(a) for a in args]))
+        flat[i] = orig - delta
+        f_lo = float(fn(*[jnp.asarray(a) for a in args]))
+        flat[i] = orig
+        gflat[i] = (f_hi - f_lo) / (2 * delta)
+    return g
+
+
+def check_grad(fn, args, idx=0, rtol=1e-2, atol=1e-3, delta=5e-3):
+    """Assert jax.grad(fn) matches finite differences (f64 for accuracy)."""
+    args64 = [jnp.asarray(np.asarray(a, np.float64)) for a in args]
+    analytic = np.asarray(jax.grad(fn, argnums=idx)(*args64))
+    numeric = numeric_grad(fn, args, idx=idx, delta=delta)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)

@@ -1,0 +1,367 @@
+"""nn.functional correctness vs numpy oracles + gradient checks."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+
+from grad_check import check_grad
+
+
+def check(actual, expected, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(np.asarray(actual), expected, rtol=rtol, atol=atol)
+
+
+class TestActivations:
+    def setup_method(self):
+        self.x = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+
+    def test_basic(self):
+        x = pt.to_tensor(self.x)
+        check(F.relu(x), np.maximum(self.x, 0))
+        check(F.relu6(x), np.clip(self.x, 0, 6))
+        check(F.leaky_relu(x, 0.1), np.where(self.x > 0, self.x, 0.1 * self.x))
+        check(F.elu(x), np.where(self.x > 0, self.x, np.expm1(self.x)), rtol=1e-4)
+        check(F.softsign(x), self.x / (1 + np.abs(self.x)), rtol=1e-5)
+        check(F.hardtanh(x), np.clip(self.x, -1, 1))
+        check(F.hardswish(x), self.x * np.clip(self.x + 3, 0, 6) / 6, rtol=1e-4, atol=1e-5)
+
+    def test_softmax_lse(self):
+        x = pt.to_tensor(self.x)
+        e = np.exp(self.x - self.x.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        check(F.softmax(x), sm, rtol=1e-4)
+        check(F.log_softmax(x), np.log(sm), rtol=1e-4, atol=1e-5)
+
+    def test_gelu(self):
+        from scipy.stats import norm as snorm
+
+        x = pt.to_tensor(self.x)
+        exact = self.x * snorm.cdf(self.x)
+        check(F.gelu(x), exact, rtol=1e-3, atol=1e-4)
+
+    def test_shrinks(self):
+        x = pt.to_tensor(self.x)
+        check(F.hardshrink(x, 0.5), np.where(np.abs(self.x) > 0.5, self.x, 0))
+        expected = np.where(self.x > 0.5, self.x - 0.5, np.where(self.x < -0.5, self.x + 0.5, 0))
+        check(F.softshrink(x, 0.5), expected, rtol=1e-5)
+
+    def test_glu_maxout(self):
+        x = pt.to_tensor(self.x[:, :4])
+        a, b = self.x[:, :2], self.x[:, 2:4]
+        check(F.glu(x), a * (1 / (1 + np.exp(-b))), rtol=1e-4)
+        m = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(1, 6, 2))
+        out = F.maxout(m, groups=2, axis=1)
+        assert out.shape == (1, 3, 2)
+
+    def test_grad_activations(self):
+        x = self.x[:2, :3]
+        check_grad(lambda a: jnp.sum(F.gelu(a)), [x])
+        check_grad(lambda a: jnp.sum(F.softmax(a) ** 2), [x])
+        check_grad(lambda a: jnp.sum(F.silu(a)), [x])
+
+
+class TestLinearConv:
+    def test_linear(self):
+        rs = np.random.RandomState(1)
+        x = rs.rand(4, 3).astype(np.float32)
+        w = rs.rand(3, 5).astype(np.float32)
+        b = rs.rand(5).astype(np.float32)
+        check(F.linear(pt.to_tensor(x), pt.to_tensor(w), pt.to_tensor(b)),
+              x @ w + b, rtol=1e-5)
+
+    def test_conv2d_vs_scipy(self):
+        from scipy.signal import correlate2d
+
+        rs = np.random.RandomState(2)
+        x = rs.rand(1, 1, 6, 6).astype(np.float32)
+        w = rs.rand(1, 1, 3, 3).astype(np.float32)
+        out = F.conv2d(pt.to_tensor(x), pt.to_tensor(w))
+        expected = correlate2d(x[0, 0], w[0, 0], mode="valid")
+        check(out[0, 0], expected, rtol=1e-4, atol=1e-5)
+
+    def test_conv2d_stride_pad_groups(self):
+        rs = np.random.RandomState(3)
+        x = rs.rand(2, 4, 8, 8).astype(np.float32)
+        w = rs.rand(6, 2, 3, 3).astype(np.float32)
+        out = F.conv2d(pt.to_tensor(x), pt.to_tensor(w), stride=2, padding=1, groups=2)
+        assert out.shape == (2, 6, 4, 4)
+
+    def test_conv2d_nhwc(self):
+        rs = np.random.RandomState(4)
+        x = rs.rand(1, 5, 5, 3).astype(np.float32)
+        w = rs.rand(2, 3, 3, 3).astype(np.float32)
+        out = F.conv2d(pt.to_tensor(x), pt.to_tensor(w), data_format="NHWC")
+        assert out.shape == (1, 3, 3, 2)
+
+    def test_conv2d_transpose(self):
+        rs = np.random.RandomState(5)
+        x = rs.rand(1, 2, 4, 4).astype(np.float32)
+        w = rs.rand(2, 3, 3, 3).astype(np.float32)  # (C_in, C_out, kh, kw)
+        out = F.conv2d_transpose(pt.to_tensor(x), pt.to_tensor(w), stride=2)
+        assert out.shape == (1, 3, 9, 9)
+        # parity: transpose-conv is the gradient of conv w.r.t. input
+        def conv_sum(xin):
+            wt = jnp.transpose(jnp.asarray(w), (1, 0, 2, 3))  # OIHW for fwd
+            return jnp.sum(F.conv2d(xin, wt, stride=2))
+
+    def test_conv_grad(self):
+        rs = np.random.RandomState(6)
+        x = rs.rand(1, 1, 5, 5).astype(np.float64)
+        w = rs.rand(1, 1, 3, 3).astype(np.float64)
+        check_grad(lambda a, b: jnp.sum(F.conv2d(a, b) ** 2), [x, w], idx=0)
+        check_grad(lambda a, b: jnp.sum(F.conv2d(a, b) ** 2), [x, w], idx=1)
+
+    def test_conv1d_3d(self):
+        rs = np.random.RandomState(7)
+        x1 = rs.rand(2, 3, 10).astype(np.float32)
+        w1 = rs.rand(4, 3, 3).astype(np.float32)
+        assert F.conv1d(pt.to_tensor(x1), pt.to_tensor(w1), padding=1).shape == (2, 4, 10)
+        x3 = rs.rand(1, 2, 4, 4, 4).astype(np.float32)
+        w3 = rs.rand(3, 2, 2, 2, 2).astype(np.float32)
+        assert F.conv3d(pt.to_tensor(x3), pt.to_tensor(w3)).shape == (1, 3, 3, 3, 3)
+
+
+class TestPooling:
+    def setup_method(self):
+        self.x = np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4)
+
+    def test_max_pool2d(self):
+        out = F.max_pool2d(pt.to_tensor(self.x), 2)
+        expected = self.x.reshape(1, 2, 2, 2, 2, 2).max((3, 5))
+        check(out, expected)
+
+    def test_avg_pool2d(self):
+        out = F.avg_pool2d(pt.to_tensor(self.x), 2)
+        expected = self.x.reshape(1, 2, 2, 2, 2, 2).mean((3, 5))
+        check(out, expected)
+
+    def test_avg_pool_pad_exclusive(self):
+        x = np.ones((1, 1, 2, 2), np.float32)
+        out = F.avg_pool2d(pt.to_tensor(x), 2, stride=1, padding=1, exclusive=True)
+        # corners average over 1 valid element → still 1.0
+        check(out, np.ones((1, 1, 3, 3), np.float32))
+        out2 = F.avg_pool2d(pt.to_tensor(x), 2, stride=1, padding=1, exclusive=False)
+        assert np.asarray(out2)[0, 0, 0, 0] == 0.25
+
+    def test_max_pool_ceil(self):
+        x = np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5)
+        out = F.max_pool2d(pt.to_tensor(x), 2, stride=2, ceil_mode=True)
+        assert out.shape == (1, 1, 3, 3)
+
+    def test_adaptive(self):
+        out = F.adaptive_avg_pool2d(pt.to_tensor(self.x), 1)
+        check(out, self.x.mean((2, 3), keepdims=True))
+        out = F.adaptive_avg_pool2d(pt.to_tensor(self.x), (2, 2))
+        check(out, self.x.reshape(1, 2, 2, 2, 2, 2).mean((3, 5)))
+        # uneven
+        x = np.arange(10, dtype=np.float32).reshape(1, 1, 10, 1)
+        out = F.adaptive_avg_pool2d(pt.to_tensor(x), (3, 1))
+        assert out.shape == (1, 1, 3, 1)
+
+    def test_return_mask(self):
+        out, idx = F.max_pool2d(pt.to_tensor(self.x), 2, return_mask=True)
+        assert idx.shape == out.shape
+        # max of first window of channel 0 is at flat position 5
+        assert int(np.asarray(idx)[0, 0, 0, 0]) == 5
+
+
+class TestNorms:
+    def test_layer_norm(self):
+        rs = np.random.RandomState(8)
+        x = rs.rand(4, 6).astype(np.float32)
+        g = rs.rand(6).astype(np.float32)
+        b = rs.rand(6).astype(np.float32)
+        out = F.layer_norm(pt.to_tensor(x), 6, pt.to_tensor(g), pt.to_tensor(b))
+        mu = x.mean(-1, keepdims=True)
+        sig = x.var(-1, keepdims=True)
+        expected = (x - mu) / np.sqrt(sig + 1e-5) * g + b
+        check(out, expected, rtol=1e-4, atol=1e-5)
+
+    def test_batch_norm_train_eval(self):
+        rs = np.random.RandomState(9)
+        x = rs.rand(4, 3, 2, 2).astype(np.float32)
+        rm = np.zeros(3, np.float32)
+        rv = np.ones(3, np.float32)
+        out, nm, nv = F.batch_norm(pt.to_tensor(x), rm, rv, training=True, momentum=0.9)
+        mu = x.mean((0, 2, 3))
+        var = x.var((0, 2, 3))
+        check(out, (x - mu.reshape(1, 3, 1, 1)) / np.sqrt(var.reshape(1, 3, 1, 1) + 1e-5),
+              rtol=1e-4, atol=1e-4)
+        check(nm, 0.9 * rm + 0.1 * mu, rtol=1e-4)
+        check(nv, 0.9 * rv + 0.1 * var, rtol=1e-4)
+        out_eval = F.batch_norm(pt.to_tensor(x), pt.to_tensor(mu), pt.to_tensor(var), training=False)
+        check(out_eval, (x - mu.reshape(1, 3, 1, 1)) / np.sqrt(var.reshape(1, 3, 1, 1) + 1e-5),
+              rtol=1e-4, atol=1e-4)
+
+    def test_group_instance_norm(self):
+        rs = np.random.RandomState(10)
+        x = rs.rand(2, 4, 3, 3).astype(np.float32)
+        out = F.group_norm(pt.to_tensor(x), 2)
+        g = x.reshape(2, 2, 2, 3, 3)
+        mu = g.mean((2, 3, 4), keepdims=True)
+        var = g.var((2, 3, 4), keepdims=True)
+        check(out, ((g - mu) / np.sqrt(var + 1e-5)).reshape(x.shape), rtol=1e-4, atol=1e-4)
+        out_in = F.instance_norm(pt.to_tensor(x))
+        mu_i = x.mean((2, 3), keepdims=True)
+        var_i = x.var((2, 3), keepdims=True)
+        check(out_in, (x - mu_i) / np.sqrt(var_i + 1e-5), rtol=1e-4, atol=1e-4)
+
+    def test_normalize(self):
+        x = np.array([[3.0, 4.0]], np.float32)
+        check(F.normalize(pt.to_tensor(x), axis=1), x / 5.0, rtol=1e-5)
+
+
+class TestDropoutEmbedding:
+    def test_dropout_train_scale(self):
+        pt.seed(0)
+        x = pt.ones([1000])
+        out = np.asarray(F.dropout(x, p=0.3, training=True))
+        kept = out != 0
+        assert 0.6 < kept.mean() < 0.8
+        np.testing.assert_allclose(out[kept], 1 / 0.7, rtol=1e-5)
+        out_eval = F.dropout(x, p=0.3, training=False)
+        check(out_eval, np.ones(1000, np.float32))
+
+    def test_dropout_axis(self):
+        pt.seed(1)
+        x = pt.ones([8, 16])
+        out = np.asarray(F.dropout(x, p=0.5, axis=0, training=True))
+        # whole rows are zero or scaled
+        for r in out:
+            assert (r == 0).all() or np.allclose(r, 2.0)
+
+    def test_embedding(self):
+        w = np.arange(12, dtype=np.float32).reshape(4, 3)
+        out = F.embedding(pt.to_tensor([1, 3], "int64"), pt.to_tensor(w))
+        check(out, w[[1, 3]])
+        out_pad = F.embedding(pt.to_tensor([0, 1], "int64"), pt.to_tensor(w), padding_idx=0)
+        assert (np.asarray(out_pad)[0] == 0).all()
+
+    def test_interpolate(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = F.interpolate(pt.to_tensor(x), size=[2, 2], mode="nearest")
+        check(out, x[:, :, ::2, ::2])
+        out_b = F.interpolate(pt.to_tensor(x), scale_factor=2, mode="bilinear", align_corners=True)
+        assert out_b.shape == (1, 1, 8, 8)
+        check(np.asarray(out_b)[0, 0, 0, [0, -1]], [0.0, 3.0], rtol=1e-5)
+
+    def test_pixel_shuffle(self):
+        x = np.arange(8, dtype=np.float32).reshape(1, 4, 1, 2)
+        out = F.pixel_shuffle(pt.to_tensor(x), 2)
+        assert out.shape == (1, 1, 2, 4)
+
+    def test_unfold_fold_roundtrip(self):
+        x = np.random.RandomState(11).rand(1, 2, 4, 4).astype(np.float32)
+        cols = F.unfold(pt.to_tensor(x), 2, strides=2)
+        assert cols.shape == (1, 8, 4)
+        back = F.fold(cols, (4, 4), 2, strides=2)
+        check(back, x, rtol=1e-6)
+
+    def test_sequence_mask(self):
+        out = F.sequence_mask(pt.to_tensor([2, 0, 3], "int64"), maxlen=4)
+        check(out, [[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+
+
+class TestLosses:
+    def test_cross_entropy(self):
+        rs = np.random.RandomState(12)
+        logits = rs.rand(4, 5).astype(np.float32)
+        labels = np.array([0, 2, 1, 4])
+        out = F.cross_entropy(pt.to_tensor(logits), pt.to_tensor(labels, "int64"))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        logp = np.log(e / e.sum(-1, keepdims=True))
+        expected = -logp[np.arange(4), labels].mean()
+        check(out, expected, rtol=1e-5)
+
+    def test_cross_entropy_ignore_soft(self):
+        rs = np.random.RandomState(13)
+        logits = rs.rand(4, 3).astype(np.float32)
+        labels = np.array([0, -100, 1, 2])
+        out = F.cross_entropy(pt.to_tensor(logits), pt.to_tensor(labels, "int64"),
+                              ignore_index=-100)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        logp = np.log(e / e.sum(-1, keepdims=True))
+        valid = labels != -100
+        expected = -logp[np.arange(4), np.clip(labels, 0, 2)][valid].mean()
+        check(out, expected, rtol=1e-5)
+        soft = np.array([[0.5, 0.5, 0.0]] * 4, np.float32)
+        out_soft = F.cross_entropy(pt.to_tensor(logits), pt.to_tensor(soft), soft_label=True)
+        check(out_soft, (-soft * logp).sum(-1).mean(), rtol=1e-5)
+
+    def test_bce(self):
+        p = np.array([0.2, 0.8], np.float32)
+        y = np.array([0.0, 1.0], np.float32)
+        out = F.binary_cross_entropy(pt.to_tensor(p), pt.to_tensor(y))
+        expected = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        check(out, expected, rtol=1e-5)
+        logit = np.array([-1.0, 2.0], np.float32)
+        out2 = F.binary_cross_entropy_with_logits(pt.to_tensor(logit), pt.to_tensor(y))
+        sp = 1 / (1 + np.exp(-logit))
+        expected2 = -(y * np.log(sp) + (1 - y) * np.log(1 - sp)).mean()
+        check(out2, expected2, rtol=1e-4)
+
+    def test_mse_l1_smooth(self):
+        a = np.array([1.0, 2.0], np.float32)
+        b = np.array([1.5, 4.0], np.float32)
+        check(F.mse_loss(pt.to_tensor(a), pt.to_tensor(b)), ((a - b) ** 2).mean(), rtol=1e-6)
+        check(F.l1_loss(pt.to_tensor(a), pt.to_tensor(b)), np.abs(a - b).mean(), rtol=1e-6)
+        check(F.smooth_l1_loss(pt.to_tensor(a), pt.to_tensor(b)),
+              np.mean([0.5 * 0.25, 1.5]), rtol=1e-5)
+
+    def test_kl_nll(self):
+        rs = np.random.RandomState(14)
+        p = rs.dirichlet(np.ones(3), 2).astype(np.float32)
+        logq = np.log(rs.dirichlet(np.ones(3), 2).astype(np.float32))
+        out = F.kl_div(pt.to_tensor(logq), pt.to_tensor(p), reduction="sum")
+        expected = (p * (np.log(p) - logq)).sum()
+        check(out, expected, rtol=1e-4)
+        nll = F.nll_loss(pt.to_tensor(logq), pt.to_tensor([0, 2], "int64"))
+        check(nll, -(logq[0, 0] + logq[1, 2]) / 2, rtol=1e-5)
+
+    def test_loss_grads(self):
+        rs = np.random.RandomState(15)
+        logits = rs.rand(3, 4)
+        labels = np.array([0, 1, 3])
+        check_grad(lambda a: F.cross_entropy(a, jnp.asarray(labels)), [logits])
+        check_grad(lambda a: F.mse_loss(a, jnp.zeros((3, 4))), [logits])
+
+    def test_ctc_loss(self):
+        # simple case: T=3, C=3 (blank=0), label "1"
+        logp = np.log(np.full((3, 1, 3), 1 / 3, np.float32))
+        loss = F.ctc_loss(pt.to_tensor(logp), pt.to_tensor([[1]], "int64"),
+                          pt.to_tensor([3], "int64"), pt.to_tensor([1], "int64"),
+                          reduction="none")
+        # paths emitting '1': positions of 1 among 3 frames with blanks:
+        # number of valid CTC paths for single label over T=3 = 7? compute:
+        # alignments: 1--, -1-, --1, 11-, -11, 111, 1-1(invalid? 1,blank,1 decodes "11"? no: 1,_,1 -> "11"!? for single '1' invalid)
+        # valid: {1bb,b1b,bb1,11b,b11,111,1b b? } = 6... probability = n_paths*(1/27)
+        val = float(np.asarray(loss).reshape(-1)[0])
+        n_paths = np.exp(-val) * 27
+        assert abs(n_paths - round(n_paths)) < 1e-3  # integer path count sanity
+        assert 5 <= round(n_paths) <= 7
+
+    def test_scaled_dot_product_attention(self):
+        rs = np.random.RandomState(16)
+        q = rs.rand(2, 4, 2, 8).astype(np.float32)
+        k = rs.rand(2, 4, 2, 8).astype(np.float32)
+        v = rs.rand(2, 4, 2, 8).astype(np.float32)
+        out = F.scaled_dot_product_attention(q, k, v)
+        # numpy reference
+        qt = q.transpose(0, 2, 1, 3)
+        kt = k.transpose(0, 2, 1, 3)
+        vt = v.transpose(0, 2, 1, 3)
+        logits = qt @ kt.transpose(0, 1, 3, 2) / np.sqrt(8)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expected = (p @ vt).transpose(0, 2, 1, 3)
+        check(out, expected, rtol=1e-4, atol=1e-5)
+
+    def test_sdpa_causal(self):
+        rs = np.random.RandomState(17)
+        q = rs.rand(1, 3, 1, 4).astype(np.float32)
+        out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        # first position attends only to itself → equals v[0]
+        check(np.asarray(out)[0, 0, 0], q[0, 0, 0], rtol=1e-5)

@@ -1,0 +1,311 @@
+"""Layer system tests: registration, state_dict, functional_call/jit bridge,
+and layer forward correctness."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def check(actual, expected, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(np.asarray(actual), expected, rtol=rtol, atol=atol)
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 8)
+        self.fc2 = nn.Linear(8, 2)
+        self.drop = nn.Dropout(0.5)
+
+    def forward(self, x):
+        return self.fc2(self.drop(F.relu(self.fc1(x))))
+
+
+class TestLayerSystem:
+    def test_registration_traversal(self):
+        m = MLP()
+        names = [n for n, _ in m.named_parameters()]
+        assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+        assert len(m.parameters()) == 4
+        assert len(m.sublayers()) == 3
+
+    def test_state_dict_roundtrip(self):
+        m1, m2 = MLP(), MLP()
+        sd = m1.state_dict()
+        assert set(sd) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+        m2.set_state_dict(sd)
+        x = pt.randn([2, 4])
+        m1.eval()
+        m2.eval()
+        check(m2(x), np.asarray(m1(x)))
+
+    def test_state_dict_shape_mismatch(self):
+        m = MLP()
+        bad = {"fc1.weight": np.zeros((3, 3), np.float32)}
+        with pytest.raises(Exception):
+            m.set_state_dict(bad)
+
+    def test_train_eval_modes(self):
+        m = MLP()
+        m.eval()
+        assert not m.drop.training
+        m.train()
+        assert m.drop.training
+
+    def test_eager_forward_dropout(self):
+        pt.seed(0)
+        m = MLP()
+        x = pt.randn([16, 4])
+        m.eval()
+        out1 = np.asarray(m(x))
+        out2 = np.asarray(m(x))
+        np.testing.assert_array_equal(out1, out2)  # eval: deterministic
+        m.train()
+        o1 = np.asarray(m(x))
+        o2 = np.asarray(m(x))
+        assert not np.array_equal(o1, o2)  # train: dropout differs
+
+    def test_functional_call_pure(self):
+        m = MLP().eval()
+        params = m.param_pytree()
+        x = pt.randn([3, 4])
+        out_direct = np.asarray(m(x))
+        out_fc = np.asarray(nn.functional_call(m, params, x))
+        np.testing.assert_array_equal(out_direct, out_fc)
+        # substituting zeros changes output but not the layer's stored params
+        zeros = {k: jnp.zeros_like(v) for k, v in params.items()}
+        out_zero = nn.functional_call(m, zeros, x)
+        check(out_zero, np.zeros((3, 2), np.float32))
+        np.testing.assert_array_equal(np.asarray(m(x)), out_direct)
+
+    def test_functional_call_jit_grad(self):
+        m = MLP().eval()
+        params = m.param_pytree()
+        x = pt.randn([8, 4])
+        y = pt.randn([8, 2])
+
+        @jax.jit
+        def loss_fn(p, x, y):
+            pred = nn.functional_call(m, p, x)
+            return jnp.mean((pred - y) ** 2)
+
+        g = jax.grad(loss_fn)(params, x, y)
+        assert set(g) == set(params)
+        assert all(g[k].shape == params[k].shape for k in params)
+        assert float(jnp.abs(g["fc1.weight"]).sum()) > 0
+
+    def test_functional_call_rngs_deterministic(self):
+        m = MLP().train()
+        params = m.param_pytree()
+        x = pt.randn([4, 4])
+        k = jax.random.PRNGKey(0)
+        o1 = np.asarray(nn.functional_call(m, params, x, rngs=k, training=True))
+        o2 = np.asarray(nn.functional_call(m, params, x, rngs=k, training=True))
+        np.testing.assert_array_equal(o1, o2)
+        o3 = np.asarray(nn.functional_call(m, params, x, rngs=jax.random.PRNGKey(1), training=True))
+        assert not np.array_equal(o1, o3)
+
+    def test_bn_buffers_functional(self):
+        bn = nn.BatchNorm2D(3)
+        x = pt.randn([4, 3, 2, 2])
+        params = bn.param_pytree()
+        bufs = bn.buffer_pytree()
+        out, new_bufs = nn.functional_call(bn, params, x, buffers=bufs,
+                                           training=True, return_buffers=True)
+        # captured functionally, eager state unchanged
+        check(bn._mean.value, np.zeros(3, np.float32))
+        assert not np.allclose(np.asarray(new_bufs["_mean"]), 0.0)
+        # eager call mutates
+        bn(x)
+        assert not np.allclose(np.asarray(bn._mean.value), 0.0)
+
+    def test_bn_under_jit(self):
+        bn = nn.BatchNorm2D(3)
+        params = bn.param_pytree()
+        bufs = bn.buffer_pytree()
+
+        @jax.jit
+        def step(p, b, x):
+            out, nb = nn.functional_call(bn, p, x, buffers=b, training=True,
+                                         return_buffers=True)
+            return out, nb
+
+        x = pt.randn([4, 3, 2, 2])
+        out, nb = step(params, bufs, x)
+        assert out.shape == x.shape
+        # no tracer leak into the layer
+        assert isinstance(bn._mean.value, jax.Array)
+        check(bn._mean.value, np.zeros(3, np.float32))
+
+    def test_to_dtype(self):
+        m = MLP()
+        m.to(dtype="bfloat16")
+        assert m.fc1.weight.dtype == pt.bfloat16
+
+    def test_hooks(self):
+        m = nn.Linear(2, 2)
+        calls = []
+        h = m.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        m(pt.ones([1, 2]))
+        assert calls == [1]
+        h.remove()
+        m(pt.ones([1, 2]))
+        assert calls == [1]
+
+
+class TestContainers:
+    def test_sequential(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        out = m(pt.randn([3, 4]))
+        assert out.shape == (3, 2)
+        assert len(m) == 3
+        assert isinstance(m[1], nn.ReLU)
+
+    def test_layer_list_dict(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4
+        assert len(list(ll.parameters())) == 8
+        ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+        ld["b"] = nn.Linear(2, 3)
+        assert "b" in ld and len(ld) == 2
+
+    def test_parameter_list(self):
+        pl = nn.ParameterList([nn.Parameter(jnp.ones((2,)))])
+        pl.append(nn.Parameter(jnp.zeros((3,))))
+        assert len(pl.parameters()) == 2
+
+
+class TestLayers:
+    def test_conv2d_layer(self):
+        conv = nn.Conv2D(3, 8, 3, padding=1)
+        out = conv(pt.randn([2, 3, 8, 8]))
+        assert out.shape == (2, 8, 8, 8)
+        assert conv.weight.shape == (8, 3, 3, 3)
+
+    def test_conv_transpose_layer(self):
+        conv = nn.Conv2DTranspose(4, 2, 3, stride=2)
+        out = conv(pt.randn([1, 4, 5, 5]))
+        assert out.shape == (1, 2, 11, 11)
+
+    def test_bn_layer_stats_update(self):
+        bn = nn.BatchNorm2D(2, momentum=0.5)
+        x = pt.to_tensor(np.random.RandomState(0).rand(8, 2, 3, 3).astype(np.float32))
+        bn.train()
+        bn(x)
+        mu = np.asarray(x).mean((0, 2, 3))
+        check(bn._mean.value, 0.5 * mu, rtol=1e-4)
+        bn.eval()
+        out = bn(x)
+        assert out.shape == x.shape
+
+    def test_embedding_layer(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(pt.to_tensor([[1, 2], [0, 3]], "int64"))
+        assert out.shape == (2, 2, 4)
+        assert (np.asarray(out)[1, 0] == 0).all()
+
+    def test_layernorm_layer(self):
+        ln = nn.LayerNorm(6)
+        out = ln(pt.randn([2, 3, 6]))
+        arr = np.asarray(out)
+        np.testing.assert_allclose(arr.mean(-1), 0, atol=1e-5)
+        np.testing.assert_allclose(arr.std(-1), 1, atol=2e-2)
+
+    def test_rnn_layers(self):
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        x = pt.randn([3, 5, 4])  # (B, T, I)
+        out, (h, c) = lstm(x)
+        assert out.shape == (3, 5, 8)
+        assert h.shape == (2, 3, 8) and c.shape == (2, 3, 8)
+
+    def test_rnn_bidirectional(self):
+        gru = nn.GRU(4, 6, direction="bidirect")
+        out, h = gru(pt.randn([2, 7, 4]))
+        assert out.shape == (2, 7, 12)
+        assert h.shape == (2, 2, 6)
+
+    def test_rnn_sequence_length(self):
+        rnn = nn.SimpleRNN(3, 5)
+        x = pt.randn([2, 6, 3])
+        out, h = rnn(x, sequence_length=pt.to_tensor([6, 2], "int64"))
+        arr = np.asarray(out)
+        assert (arr[1, 2:] == 0).all()  # padded steps zeroed
+        assert not (arr[1, :2] == 0).all()
+
+    def test_lstm_cell(self):
+        cell = nn.LSTMCell(3, 4)
+        h, (h2, c2) = cell(pt.randn([2, 3]))
+        assert h.shape == (2, 4)
+        np.testing.assert_array_equal(np.asarray(h), np.asarray(h2))
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        enc.eval()
+        out = enc(pt.randn([2, 5, 16]))
+        assert out.shape == (2, 5, 16)
+
+    def test_transformer_full(self):
+        model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32, dropout=0.0)
+        model.eval()
+        out = model(pt.randn([2, 4, 16]), pt.randn([2, 3, 16]))
+        assert out.shape == (2, 3, 16)
+
+    def test_mha_mask_and_cache(self):
+        mha = nn.MultiHeadAttention(8, 2, dropout=0.0)
+        mha.eval()
+        q = pt.randn([1, 4, 8])
+        mask = jnp.tril(jnp.ones((4, 4), bool))
+        out = mha(q, attn_mask=mask)
+        assert out.shape == (1, 4, 8)
+        cache = mha.gen_cache(q)
+        o1, cache = mha(q[:, :1], q[:, :1], q[:, :1], cache=cache)
+        o2, cache = mha(q[:, 1:2], q[:, 1:2], q[:, 1:2], cache=cache)
+        assert cache[0].shape == (1, 2, 2, 4)
+
+    def test_transformer_jit_grad(self):
+        layer = nn.TransformerEncoderLayer(8, 2, 16, dropout=0.0)
+        layer.eval()
+        params = layer.param_pytree()
+        x = pt.randn([2, 3, 8])
+
+        @jax.jit
+        def loss(p, x):
+            return jnp.sum(nn.functional_call(layer, p, x) ** 2)
+
+        g = jax.grad(loss)(params, x)
+        assert all(float(jnp.abs(v).sum()) > 0 for v in g.values())
+
+    def test_groupnorm_prelu_spectral(self):
+        gn = nn.GroupNorm(2, 4)
+        assert gn(pt.randn([2, 4, 3, 3])).shape == (2, 4, 3, 3)
+        pr = nn.PReLU(4)
+        assert pr(pt.randn([2, 4, 2, 2])).shape == (2, 4, 2, 2)
+
+    def test_initializers(self):
+        from paddle_tpu.nn import initializer as I
+
+        pt.seed(0)
+        w = I.XavierUniform()((100, 100), "float32")
+        limit = np.sqrt(6 / 200)
+        arr = np.asarray(w)
+        assert arr.min() >= -limit and arr.max() <= limit
+        k = I.KaimingNormal()((100, 100), "float32")
+        assert abs(np.asarray(k).std() - np.sqrt(2 / 100)) < 0.01
+        c = I.Constant(3.0)((2, 2), "float32")
+        check(c, np.full((2, 2), 3.0))
+        a = I.Assign(np.eye(2))((2, 2), "float32")
+        check(a, np.eye(2))
+
+    def test_param_attr(self):
+        lin = nn.Linear(2, 3, weight_attr=pt.ParamAttr(
+            initializer=nn.initializer.Constant(0.5), trainable=False))
+        check(lin.weight.value, np.full((2, 3), 0.5))
+        assert not lin.weight.trainable
+        assert len(lin.param_pytree(trainable_only=True)) == 1  # only bias
