@@ -60,6 +60,7 @@ from .framework import (  # noqa: F401
 from .tensor import *  # noqa: F401,F403
 from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
 from .nn import ParamAttr  # noqa: F401
 
 import jax as _jax

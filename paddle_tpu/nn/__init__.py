@@ -24,3 +24,8 @@ from .loss import *  # noqa: F401,F403
 from .container import *  # noqa: F401,F403
 from .rnn import *  # noqa: F401,F403
 from .transformer import *  # noqa: F401,F403
+from ..optimizer.clip import (  # noqa: F401  (paddle.nn re-exports clips)
+    ClipGradByValue,
+    ClipGradByNorm,
+    ClipGradByGlobalNorm,
+)

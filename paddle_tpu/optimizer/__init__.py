@@ -1,0 +1,24 @@
+"""paddle_tpu.optimizer — optimizers + lr schedulers (paddle.optimizer parity).
+
+See optimizer.py for the functional/eager dual design; lr.py for schedulers;
+clip.py for gradient clipping strategies (also exported via paddle_tpu.nn).
+"""
+from .optimizer import (  # noqa: F401
+    Optimizer,
+    SGD,
+    Momentum,
+    Adagrad,
+    Adam,
+    AdamW,
+    Adamax,
+    RMSProp,
+    Adadelta,
+    Lamb,
+    Lars,
+)
+from . import lr  # noqa: F401
+from .clip import (  # noqa: F401
+    ClipGradByValue,
+    ClipGradByNorm,
+    ClipGradByGlobalNorm,
+)

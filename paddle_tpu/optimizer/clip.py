@@ -1,0 +1,73 @@
+"""Gradient clipping strategies.
+
+Parity: paddle.nn.ClipGradByValue / ClipGradByNorm / ClipGradByGlobalNorm
+(reference: python/paddle/fluid/clip.py — GradientClipByValue:119,
+GradientClipByNorm:214, GradientClipByGlobalNorm:311).  The reference
+implements these as op-insertion passes over (param, grad) op pairs; here
+each is a pure pytree→pytree function, fused by XLA into the update step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class ClipGradByValue:
+    """Clamp every gradient element into [min, max]."""
+
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, grads):
+        return jax.tree_util.tree_map(lambda g: jnp.clip(g, self.min, self.max), grads)
+
+    def __repr__(self):
+        return f"ClipGradByValue(min={self.min}, max={self.max})"
+
+
+class ClipGradByNorm:
+    """Rescale each gradient independently to at most clip_norm (L2)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads):
+        def _clip(g):
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-12))
+            return (g.astype(jnp.float32) * scale).astype(g.dtype)
+
+        return jax.tree_util.tree_map(_clip, grads)
+
+    def __repr__(self):
+        return f"ClipGradByNorm(clip_norm={self.clip_norm})"
+
+
+class ClipGradByGlobalNorm:
+    """Rescale ALL gradients jointly so the global L2 norm is ≤ clip_norm.
+
+    The norm is computed in f32 regardless of grad dtype (bf16 grads would
+    overflow/lose precision) — matches the reference's f32 accumulation in
+    GradientClipByGlobalNorm (fluid/clip.py:311).
+    """
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        if not leaves:
+            return grads
+        gnorm = jnp.sqrt(
+            sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        )
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        return jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads
+        )
+
+    def __repr__(self):
+        return f"ClipGradByGlobalNorm(clip_norm={self.clip_norm})"

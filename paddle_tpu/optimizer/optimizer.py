@@ -1,0 +1,608 @@
+"""Optimizers — pure functional update rules with an eager bridge.
+
+Parity surface: paddle.optimizer (reference: python/paddle/optimizer/
+optimizer.py Optimizer base; adam.py, adamw.py, sgd.py, momentum.py, …;
+C++ kernels paddle/fluid/operators/optimizers/{sgd,momentum,adam,adagrad,
+adadelta,adamax,rmsprop,lamb,lars_momentum}_op.cc).
+
+TPU-native design: the reference appends per-parameter *update ops* to the
+Program (optimizer.py:57 `_append_optimize_op`); here each optimizer is a
+pair of pure functions over parameter pytrees —
+
+    state              = opt.init(params)            # slot variables
+    new_params, state  = opt.update(grads, state, params, lr=...)
+
+— which jit/grad/vmap compose with, and which XLA fuses into a single
+fused update kernel per step (no per-op dispatch).  The eager paddle flow
+(``opt.step()`` mutating Layer Parameters) is a thin wrapper over the same
+rules.
+
+Slot state is ``{"count": i32, "slots": {param_name: {slot: array}}}`` —
+`count` replaces the reference's per-param beta1_pow/beta2_pow accumulator
+tensors (adam_op.h) with one scalar.
+
+Mixed precision: with ``multi_precision=True`` (same flag as the reference's
+momentum/adam ops), low-precision (bf16/fp16) parameters get an f32 master
+copy in their slot dict; math runs on the master and the stored param is the
+cast-down view.  This is the standard TPU bf16 training recipe.
+"""
+from __future__ import annotations
+
+import math
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.errors import InvalidArgumentError
+from ..nn.layer_base import Parameter
+from .lr import LRScheduler
+
+__all__ = [
+    "Optimizer",
+    "SGD",
+    "Momentum",
+    "Adagrad",
+    "Adam",
+    "AdamW",
+    "Adamax",
+    "RMSProp",
+    "Adadelta",
+    "Lamb",
+    "Lars",
+]
+
+
+def _is_low_precision(x) -> bool:
+    return x.dtype in (jnp.bfloat16, jnp.float16)
+
+
+def _is_traced(*trees) -> bool:
+    return any(
+        isinstance(leaf, jax.core.Tracer)
+        for tree in trees
+        for leaf in jax.tree_util.tree_leaves(tree)
+    )
+
+
+class Optimizer:
+    """Base optimizer.
+
+    Args mirror paddle.optimizer.Optimizer: ``learning_rate`` (float or
+    LRScheduler), ``parameters`` (list of nn.Parameter for eager use),
+    ``weight_decay`` (float → L2 regularization added to the gradient, as
+    the reference's L2Decay regularizer), ``grad_clip`` (one of the
+    ClipGradBy* callables).
+    """
+
+    def __init__(
+        self,
+        learning_rate: Union[float, LRScheduler] = 0.001,
+        parameters: Optional[Sequence[Parameter]] = None,
+        weight_decay: Optional[float] = None,
+        grad_clip: Optional[Callable] = None,
+        name: Optional[str] = None,
+        multi_precision: bool = False,
+    ):
+        self._learning_rate = learning_rate
+        self._weight_decay = float(weight_decay) if weight_decay else 0.0
+        self._grad_clip = grad_clip
+        self._name = name
+        self._multi_precision = multi_precision
+        self._param_boxes: Optional[List[Parameter]] = (
+            list(parameters) if parameters is not None else None
+        )
+        self._eager_state: Optional[Dict[str, Any]] = None
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate.last_lr
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise InvalidArgumentError(
+                "optimizer's learning rate is an LRScheduler; call its step() instead"
+            )
+        self._learning_rate = float(value)
+
+    @property
+    def lr_scheduler(self):
+        return self._learning_rate if isinstance(self._learning_rate, LRScheduler) else None
+
+    # -- functional API ------------------------------------------------------
+    def init(self, params: Dict[str, jax.Array]) -> Dict[str, Any]:
+        """Create slot state for a parameter pytree."""
+        slots = {
+            name: self._init_slots(p, name) for name, p in params.items()
+        }
+        return {"count": jnp.zeros((), jnp.int32), "slots": slots}
+
+    def update(
+        self,
+        grads: Dict[str, jax.Array],
+        state: Dict[str, Any],
+        params: Dict[str, jax.Array],
+        lr: Optional[jax.Array] = None,
+    ):
+        """Pure update: returns (new_params, new_state).  ``lr`` defaults to
+        the eager scheduler value captured as a scalar."""
+        if lr is None:
+            if self.lr_scheduler is not None and _is_traced(grads, params):
+                raise InvalidArgumentError(
+                    "update() called under jit with a scheduler-driven lr but "
+                    "no explicit lr argument: the current scheduler value "
+                    "would be baked into the compiled step forever.  Pass "
+                    "lr=opt.get_lr() (a fresh scalar each call) or "
+                    "lr=sched.value_at(step) into the jitted function."
+                )
+            lr = self.get_lr()
+        if self._grad_clip is not None:
+            grads = self._grad_clip(grads)
+        count = state["count"] + 1
+        new_params = {}
+        new_slots = {}
+        for name, p in params.items():
+            g = grads.get(name)
+            if g is None:  # frozen / no gradient
+                new_params[name] = p
+                new_slots[name] = state["slots"][name]
+                continue
+            np_, ns = self._rule(p, g, state["slots"][name], lr, count, name)
+            new_params[name] = np_
+            new_slots[name] = ns
+        return new_params, {"count": count, "slots": new_slots}
+
+    # -- per-optimizer hooks -------------------------------------------------
+    def _init_slots(self, p: jax.Array, name: str) -> Dict[str, jax.Array]:
+        slots: Dict[str, jax.Array] = {}
+        if self._multi_precision and _is_low_precision(p):
+            slots["master"] = p.astype(jnp.float32)
+        return slots
+
+    def _rule(self, p, g, slots, lr, count, name):
+        """Returns (new_param, new_slots). Subclasses implement _update on
+        the f32 master view; this wrapper handles master-weight plumbing and
+        L2 weight decay."""
+        out_dtype = p.dtype
+        slots = dict(slots)
+        master = slots.get("master")
+        w = master if master is not None else p
+        g = g.astype(w.dtype)
+        if self._weight_decay and self._use_l2_decay(name):
+            g = g + self._weight_decay * w
+        new_w, slots = self._update(w, g, slots, lr, count)
+        if master is not None:
+            slots["master"] = new_w
+            return new_w.astype(out_dtype), slots
+        return new_w.astype(out_dtype), slots
+
+    def _use_l2_decay(self, name: str) -> bool:
+        return True
+
+    def _update(self, w, g, slots, lr, count):
+        raise NotImplementedError
+
+    # -- eager API (paddle dygraph flow) -------------------------------------
+    def _eager_params(self) -> "OrderedDict[str, Parameter]":
+        if self._param_boxes is None:
+            raise InvalidArgumentError(
+                "optimizer was constructed without `parameters`; "
+                "pass parameters= for eager step() use"
+            )
+        return OrderedDict(
+            (box.name or f"param_{i}", box) for i, box in enumerate(self._param_boxes)
+        )
+
+    def step(self, grads=None):
+        """Apply gradients to the bound Parameter boxes.
+
+        ``grads``: dict {name: grad} or sequence aligned with `parameters`.
+        (The reference's ``loss.backward(); opt.step()`` tape flow is
+        replaced by explicit grads from ``jax.grad`` — see nn.layer_base.)
+        """
+        boxes = self._eager_params()
+        if grads is None:
+            raise InvalidArgumentError(
+                "step() needs grads: this framework has no implicit tape; "
+                "compute them with jax.grad / paddle_tpu.grad_fn"
+            )
+        trainable = OrderedDict(
+            (n, b) for n, b in boxes.items() if b.trainable
+        )
+        if not isinstance(grads, dict):
+            grads = list(grads)
+            if len(grads) != len(trainable):
+                raise InvalidArgumentError(
+                    f"got {len(grads)} grads for {len(trainable)} trainable parameters"
+                )
+            grads = {name: g for name, g in zip(trainable, grads)}
+        elif grads and not any(k in trainable for k in grads):
+            # Layer parameters are usually unnamed boxes (create_parameter
+            # leaves name="" unless ParamAttr.name is set), so a grad dict
+            # keyed by Layer.named_parameters dotted names won't match our
+            # positional param_i keys.  Insertion order of both dicts is the
+            # parameter traversal order → remap positionally.
+            if len(grads) != len(trainable):
+                raise InvalidArgumentError(
+                    f"grad names {sorted(grads)[:5]}… match no bound parameter "
+                    f"and count {len(grads)} != trainable count {len(trainable)}"
+                )
+            grads = {name: g for name, g in zip(trainable, grads.values())}
+        else:
+            unknown = [k for k in grads if k not in boxes]
+            if unknown:
+                raise InvalidArgumentError(
+                    f"grads for unknown parameters: {unknown[:5]}"
+                )
+        params = {name: box.value for name, box in trainable.items()}
+        if self._eager_state is None:
+            self._eager_state = self.init(params)
+        new_params, self._eager_state = self.update(
+            grads, self._eager_state, params, lr=self.get_lr()
+        )
+        for name, v in new_params.items():
+            boxes[name].value = v
+
+    def clear_grad(self):
+        """No-op: gradients are function outputs, never accumulated state."""
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss_fn, params=None):
+        raise InvalidArgumentError(
+            "static-graph minimize() does not exist here; jit a train step "
+            "using functional_call + jax.grad (see hapi.Model or fleet)"
+        )
+
+    # -- state ---------------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self._eager_state is not None:
+            d["count"] = self._eager_state["count"]
+            for pname, slots in self._eager_state["slots"].items():
+                for sname, v in slots.items():
+                    d[f"{pname}.{sname}"] = v
+        if isinstance(self._learning_rate, LRScheduler):
+            d["LR_Scheduler"] = self._learning_rate.state_dict()
+        return d
+
+    def set_state_dict(self, state: Dict[str, Any]):
+        state = dict(state)
+        lr_state = state.pop("LR_Scheduler", None)
+        if lr_state and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(lr_state)
+        count = state.pop("count", None)
+        if self._param_boxes is None:
+            if state or count is not None:
+                raise InvalidArgumentError(
+                    "set_state_dict on an optimizer without bound parameters "
+                    "would silently drop slot state; in functional mode keep "
+                    "the state pytree yourself (it is checkpointable as-is)"
+                )
+            return
+        if self._param_boxes is not None:
+            boxes = self._eager_params()
+            params = {n: b.value for n, b in boxes.items() if b.trainable}
+            if self._eager_state is None:
+                self._eager_state = self.init(params)
+            if count is not None:
+                self._eager_state["count"] = jnp.asarray(count, jnp.int32)
+            for key, v in state.items():
+                pname, _, sname = key.rpartition(".")
+                if pname in self._eager_state["slots"]:
+                    self._eager_state["slots"][pname][sname] = jnp.asarray(v)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.get_lr()})"
+
+
+# ---------------------------------------------------------------------------
+# Concrete rules (reference kernels cited per class)
+# ---------------------------------------------------------------------------
+class SGD(Optimizer):
+    """param -= lr * grad  (ref: operators/optimizers/sgd_op.h)."""
+
+    def _update(self, w, g, slots, lr, count):
+        return w - lr * g, slots
+
+
+class Momentum(Optimizer):
+    """Heavy-ball / Nesterov momentum (ref: momentum_op.h:127 — velocity =
+    mu*velocity + grad; nesterov: p -= (grad + mu*velocity)*lr)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_slots(self, p, name):
+        slots = super()._init_slots(p, name)
+        acc = slots.get("master", p)
+        slots["velocity"] = jnp.zeros_like(acc, dtype=jnp.float32 if _is_low_precision(acc) else acc.dtype)
+        return slots
+
+    def _update(self, w, g, slots, lr, count):
+        v = self._momentum * slots["velocity"] + g
+        if self._nesterov:
+            new_w = w - (g + self._momentum * v) * lr
+        else:
+            new_w = w - lr * v
+        slots["velocity"] = v
+        return new_w, slots
+
+
+class Adagrad(Optimizer):
+    """moment += g²; p -= lr * g / (sqrt(moment)+eps) (ref: adagrad_op.h)."""
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_slots(self, p, name):
+        slots = super()._init_slots(p, name)
+        acc = slots.get("master", p)
+        slots["moment"] = jnp.full_like(acc, self._init_acc, dtype=jnp.float32)
+        return slots
+
+    def _update(self, w, g, slots, lr, count):
+        m = slots["moment"] + jnp.square(g)
+        slots["moment"] = m
+        return w - lr * g / (jnp.sqrt(m) + self._epsilon), slots
+
+
+class Adam(Optimizer):
+    """Adam (ref: adam_op.h:430 — bias-corrected via beta^t accumulators;
+    here beta^t is computed from the shared step count)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_slots(self, p, name):
+        slots = super()._init_slots(p, name)
+        acc = slots.get("master", p)
+        dt = jnp.float32 if _is_low_precision(acc) else acc.dtype
+        slots["moment1"] = jnp.zeros_like(acc, dtype=dt)
+        slots["moment2"] = jnp.zeros_like(acc, dtype=dt)
+        return slots
+
+    def _update(self, w, g, slots, lr, count):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        t = count.astype(jnp.float32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        new_w = w - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        slots["moment1"], slots["moment2"] = m, v
+        return new_w, slots
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (ref: python/paddle/optimizer/adamw.py —
+    decay applied directly to the param, NOT through the gradient).
+    ``apply_decay_param_fun(name)->bool`` filters decayed params (same knob
+    the reference uses to exempt layer_norm/bias)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 apply_decay_param_fun=None, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._coeff = float(weight_decay)
+        self._decay_fn = apply_decay_param_fun
+
+    def _use_l2_decay(self, name):
+        return False
+
+    def _rule(self, p, g, slots, lr, count, name):
+        new_p, slots = super()._rule(p, g, slots, lr, count, name)
+        if self._coeff and (self._decay_fn is None or self._decay_fn(name)):
+            master = slots.get("master")
+            if master is not None:
+                decayed = master - lr * self._coeff * master
+                slots = dict(slots)
+                slots["master"] = decayed
+                return decayed.astype(p.dtype), slots
+            # decay math in f32: lr*coeff ~1e-3 underflows bf16 resolution
+            decayed = new_p.astype(jnp.float32) * (1.0 - lr * self._coeff)
+            return decayed.astype(new_p.dtype), slots
+        return new_p, slots
+
+
+class Adamax(Optimizer):
+    """Adamax — infinity-norm Adam variant (ref: adamax_op.h)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, p, name):
+        slots = super()._init_slots(p, name)
+        acc = slots.get("master", p)
+        dt = jnp.float32 if _is_low_precision(acc) else acc.dtype
+        slots["moment"] = jnp.zeros_like(acc, dtype=dt)
+        slots["inf_norm"] = jnp.zeros_like(acc, dtype=dt)
+        return slots
+
+    def _update(self, w, g, slots, lr, count):
+        m = self._beta1 * slots["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(g))
+        t = count.astype(jnp.float32)
+        new_w = w - (lr / (1 - self._beta1 ** t)) * m / (u + self._epsilon)
+        slots["moment"], slots["inf_norm"] = m, u
+        return new_w, slots
+
+
+class RMSProp(Optimizer):
+    """RMSProp w/ optional centering & momentum (ref: rmsprop_op.h)."""
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_slots(self, p, name):
+        slots = super()._init_slots(p, name)
+        acc = slots.get("master", p)
+        dt = jnp.float32 if _is_low_precision(acc) else acc.dtype
+        slots["mean_square"] = jnp.zeros_like(acc, dtype=dt)
+        slots["momentum_acc"] = jnp.zeros_like(acc, dtype=dt)
+        if self._centered:
+            slots["mean_grad"] = jnp.zeros_like(acc, dtype=dt)
+        return slots
+
+    def _update(self, w, g, slots, lr, count):
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * jnp.square(g)
+        slots["mean_square"] = ms
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * g
+            slots["mean_grad"] = mg
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * slots["momentum_acc"] + lr * g / denom
+        slots["momentum_acc"] = mom
+        return w - mom, slots
+
+
+class Adadelta(Optimizer):
+    """Adadelta (ref: adadelta_op.h)."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _init_slots(self, p, name):
+        slots = super()._init_slots(p, name)
+        acc = slots.get("master", p)
+        dt = jnp.float32 if _is_low_precision(acc) else acc.dtype
+        slots["avg_squared_grad"] = jnp.zeros_like(acc, dtype=dt)
+        slots["avg_squared_update"] = jnp.zeros_like(acc, dtype=dt)
+        return slots
+
+    def _update(self, w, g, slots, lr, count):
+        asg = self._rho * slots["avg_squared_grad"] + (1 - self._rho) * jnp.square(g)
+        upd = g * jnp.sqrt(slots["avg_squared_update"] + self._epsilon) / jnp.sqrt(asg + self._epsilon)
+        asu = self._rho * slots["avg_squared_update"] + (1 - self._rho) * jnp.square(upd)
+        slots["avg_squared_grad"], slots["avg_squared_update"] = asg, asu
+        return w - lr * upd, slots
+
+
+class Lamb(Optimizer):
+    """LAMB layer-wise adaptive large-batch optimizer (ref: lamb_op.h —
+    Adam step scaled by trust ratio ||w|| / ||r + λw||)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_slots(self, p, name):
+        slots = super()._init_slots(p, name)
+        acc = slots.get("master", p)
+        dt = jnp.float32 if _is_low_precision(acc) else acc.dtype
+        slots["moment1"] = jnp.zeros_like(acc, dtype=dt)
+        slots["moment2"] = jnp.zeros_like(acc, dtype=dt)
+        return slots
+
+    def _update(self, w, g, slots, lr, count, wd=None):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        t = count.astype(jnp.float32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        if wd is None:
+            wd = self._wd
+        upd = r + wd * w
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(w)))
+        u_norm = jnp.sqrt(jnp.sum(jnp.square(upd)))
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        slots["moment1"], slots["moment2"] = m, v
+        return w - lr * trust * upd, slots
+
+    def _rule(self, p, g, slots, lr, count, name):
+        # decay is a pure function of the param name; thread it explicitly
+        wd = 0.0 if (self._exclude_fn and self._exclude_fn(name)) else self._wd
+        out_dtype = p.dtype
+        slots = dict(slots)
+        master = slots.get("master")
+        w = master if master is not None else p
+        new_w, slots = self._update(w, g.astype(w.dtype), slots, lr, count, wd=wd)
+        if master is not None:
+            slots["master"] = new_w
+        return new_w.astype(out_dtype), slots
+
+
+class Lars(Momentum):
+    """LARS — layer-wise adaptive rate scaling on top of momentum
+    (ref: lars_momentum_op.cc: local_lr = η·||w|| / (||g|| + λ·||w||))."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, name=None,
+                 exclude_from_weight_decay=None, epsilon=0,
+                 multi_precision=False):
+        super().__init__(learning_rate, momentum, parameters, False,
+                         None, grad_clip, multi_precision, name)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._exclude = exclude_from_weight_decay or []
+        self._lars_eps = epsilon
+
+    def _rule(self, p, g, slots, lr, count, name):
+        decay = self._lars_wd
+        for pat in self._exclude:
+            if pat in name:
+                decay = 0.0
+        out_dtype = p.dtype
+        slots = dict(slots)
+        master = slots.get("master")
+        w = master if master is not None else p
+        g = g.astype(w.dtype)
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(w)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm / (g_norm + decay * w_norm + self._lars_eps),
+            1.0,
+        )
+        v = self._momentum * slots["velocity"] + lr * local_lr * (g + decay * w)
+        new_w = w - v
+        slots["velocity"] = v
+        if master is not None:
+            slots["master"] = new_w
+        return new_w.astype(out_dtype), slots

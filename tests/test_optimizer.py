@@ -1,0 +1,494 @@
+"""Optimizer tests: update rules vs numpy references (oracle style mirrors
+the reference's OpTest for optimizer ops, e.g. test_adam_op.py which checks
+the kernel against a numpy step), plus jit/eager parity and schedulers."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as opt_mod
+from paddle_tpu.nn.layer_base import Parameter
+
+
+def make_params(rng, shapes=((4, 3), (3,))):
+    return {f"p{i}": jnp.asarray(rng.randn(*s).astype(np.float32)) for i, s in enumerate(shapes)}
+
+
+def make_grads(rng, params):
+    return {k: jnp.asarray(rng.randn(*v.shape).astype(np.float32)) for k, v in params.items()}
+
+
+def run_steps(opt, params, grads_list, lr=None):
+    state = opt.init(params)
+    for g in grads_list:
+        params, state = opt.update(g, state, params, lr=lr)
+    return params, state
+
+
+class TestRules:
+    def test_sgd(self, rng):
+        params = make_params(rng)
+        grads = make_grads(rng, params)
+        out, _ = run_steps(opt_mod.SGD(learning_rate=0.1), params, [grads])
+        for k in params:
+            np.testing.assert_allclose(out[k], np.asarray(params[k]) - 0.1 * np.asarray(grads[k]), rtol=1e-6)
+
+    def test_momentum(self, rng):
+        params = make_params(rng)
+        g1, g2 = make_grads(rng, params), make_grads(rng, params)
+        out, _ = run_steps(opt_mod.Momentum(learning_rate=0.1, momentum=0.9), params, [g1, g2])
+        for k in params:
+            v1 = np.asarray(g1[k])
+            p1 = np.asarray(params[k]) - 0.1 * v1
+            v2 = 0.9 * v1 + np.asarray(g2[k])
+            p2 = p1 - 0.1 * v2
+            np.testing.assert_allclose(out[k], p2, rtol=1e-6)
+
+    def test_momentum_nesterov(self, rng):
+        params = make_params(rng)
+        g1 = make_grads(rng, params)
+        out, _ = run_steps(opt_mod.Momentum(learning_rate=0.1, momentum=0.9, use_nesterov=True), params, [g1])
+        for k in params:
+            g = np.asarray(g1[k])
+            v = g
+            expect = np.asarray(params[k]) - (g + 0.9 * v) * 0.1
+            np.testing.assert_allclose(out[k], expect, rtol=1e-6)
+
+    def test_adam_two_steps(self, rng):
+        params = make_params(rng)
+        gs = [make_grads(rng, params) for _ in range(2)]
+        out, _ = run_steps(opt_mod.Adam(learning_rate=0.01), params, gs)
+        # numpy reference
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        for k in params:
+            p = np.asarray(params[k])
+            m = np.zeros_like(p)
+            v = np.zeros_like(p)
+            for t, g_ in enumerate(gs, start=1):
+                g = np.asarray(g_[k])
+                m = b1 * m + (1 - b1) * g
+                v = b2 * v + (1 - b2) * g * g
+                mhat = m / (1 - b1 ** t)
+                vhat = v / (1 - b2 ** t)
+                p = p - 0.01 * mhat / (np.sqrt(vhat) + eps)
+            np.testing.assert_allclose(out[k], p, rtol=1e-5)
+
+    def test_adamw_decoupled_decay(self, rng):
+        params = make_params(rng)
+        grads = {k: jnp.zeros_like(v) for k, v in params.items()}
+        out, _ = run_steps(opt_mod.AdamW(learning_rate=0.1, weight_decay=0.5), params, [grads])
+        # zero grad → pure decay: p *= (1 - lr*coeff)
+        for k in params:
+            np.testing.assert_allclose(out[k], np.asarray(params[k]) * (1 - 0.1 * 0.5), rtol=1e-5)
+
+    def test_adamw_decay_filter(self, rng):
+        params = make_params(rng)
+        grads = {k: jnp.zeros_like(v) for k, v in params.items()}
+        opt = opt_mod.AdamW(learning_rate=0.1, weight_decay=0.5,
+                            apply_decay_param_fun=lambda n: n == "p0")
+        out, _ = run_steps(opt, params, [grads])
+        np.testing.assert_allclose(out["p0"], np.asarray(params["p0"]) * 0.95, rtol=1e-5)
+        np.testing.assert_allclose(out["p1"], np.asarray(params["p1"]), rtol=1e-6)
+
+    def test_adagrad(self, rng):
+        params = make_params(rng)
+        g = make_grads(rng, params)
+        out, _ = run_steps(opt_mod.Adagrad(learning_rate=0.1), params, [g])
+        for k in params:
+            gn = np.asarray(g[k])
+            expect = np.asarray(params[k]) - 0.1 * gn / (np.sqrt(gn * gn) + 1e-6)
+            np.testing.assert_allclose(out[k], expect, rtol=1e-5)
+
+    def test_rmsprop(self, rng):
+        params = make_params(rng)
+        g = make_grads(rng, params)
+        out, _ = run_steps(opt_mod.RMSProp(learning_rate=0.1, rho=0.95), params, [g])
+        for k in params:
+            gn = np.asarray(g[k])
+            ms = 0.05 * gn * gn
+            expect = np.asarray(params[k]) - 0.1 * gn / np.sqrt(ms + 1e-6)
+            np.testing.assert_allclose(out[k], expect, rtol=1e-5)
+
+    def test_adadelta(self, rng):
+        params = make_params(rng)
+        g = make_grads(rng, params)
+        out, _ = run_steps(opt_mod.Adadelta(learning_rate=1.0, rho=0.95), params, [g])
+        for k in params:
+            gn = np.asarray(g[k])
+            asg = 0.05 * gn * gn
+            upd = gn * np.sqrt(1e-6) / np.sqrt(asg + 1e-6)
+            expect = np.asarray(params[k]) - upd
+            np.testing.assert_allclose(out[k], expect, rtol=1e-4)
+
+    def test_adamax(self, rng):
+        params = make_params(rng)
+        g = make_grads(rng, params)
+        out, _ = run_steps(opt_mod.Adamax(learning_rate=0.1), params, [g])
+        for k in params:
+            gn = np.asarray(g[k])
+            m = 0.1 * gn
+            u = np.abs(gn)
+            expect = np.asarray(params[k]) - (0.1 / 0.1) * m / (u + 1e-8)
+            np.testing.assert_allclose(out[k], expect, rtol=1e-4)
+
+    def test_lamb_trust_ratio(self, rng):
+        params = make_params(rng)
+        g = make_grads(rng, params)
+        out, _ = run_steps(opt_mod.Lamb(learning_rate=0.01, lamb_weight_decay=0.01), params, [g])
+        b1, b2, eps = 0.9, 0.999, 1e-6
+        for k in params:
+            p = np.asarray(params[k]); gn = np.asarray(g[k])
+            m = (1 - b1) * gn; v = (1 - b2) * gn * gn
+            mhat = m / (1 - b1); vhat = v / (1 - b2)
+            r = mhat / (np.sqrt(vhat) + eps)
+            upd = r + 0.01 * p
+            trust = np.linalg.norm(p) / np.linalg.norm(upd)
+            expect = p - 0.01 * trust * upd
+            np.testing.assert_allclose(out[k], expect, rtol=1e-4)
+
+    def test_lars(self, rng):
+        params = make_params(rng)
+        g = make_grads(rng, params)
+        opt = opt_mod.Lars(learning_rate=0.1, momentum=0.9, lars_coeff=0.001,
+                           lars_weight_decay=0.0005)
+        out, _ = run_steps(opt, params, [g])
+        for k in params:
+            p = np.asarray(params[k]); gn = np.asarray(g[k])
+            wn = np.linalg.norm(p); gnorm = np.linalg.norm(gn)
+            local_lr = 0.001 * wn / (gnorm + 0.0005 * wn)
+            v = 0.1 * local_lr * (gn + 0.0005 * p)
+            np.testing.assert_allclose(out[k], p - v, rtol=1e-4)
+
+    def test_l2_weight_decay_as_grad(self, rng):
+        params = make_params(rng)
+        grads = {k: jnp.zeros_like(v) for k, v in params.items()}
+        out, _ = run_steps(opt_mod.SGD(learning_rate=0.1, weight_decay=0.5), params, [grads])
+        for k in params:
+            np.testing.assert_allclose(out[k], np.asarray(params[k]) * (1 - 0.05), rtol=1e-5)
+
+
+class TestClip:
+    def test_global_norm(self, rng):
+        g = {"a": jnp.ones((10,)) * 3.0, "b": jnp.ones((10,)) * 4.0}
+        clipped = opt_mod.ClipGradByGlobalNorm(1.0)(g)
+        total = np.sqrt(sum(np.sum(np.square(np.asarray(v))) for v in clipped.values()))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+        # direction preserved
+        np.testing.assert_allclose(
+            np.asarray(clipped["b"]) / np.asarray(clipped["a"]), 4.0 / 3.0, rtol=1e-5
+        )
+
+    def test_global_norm_noop_below_threshold(self):
+        g = {"a": jnp.ones((2,)) * 0.1}
+        clipped = opt_mod.ClipGradByGlobalNorm(10.0)(g)
+        np.testing.assert_allclose(clipped["a"], 0.1, rtol=1e-6)
+
+    def test_by_value(self):
+        g = {"a": jnp.asarray([-5.0, 0.5, 5.0])}
+        out = opt_mod.ClipGradByValue(1.0)(g)
+        np.testing.assert_allclose(out["a"], [-1.0, 0.5, 1.0])
+
+    def test_by_norm(self):
+        g = {"a": jnp.asarray([3.0, 4.0])}
+        out = opt_mod.ClipGradByNorm(1.0)(g)
+        np.testing.assert_allclose(np.linalg.norm(out["a"]), 1.0, rtol=1e-6)
+
+    def test_clip_in_optimizer(self, rng):
+        params = make_params(rng)
+        g = {k: jnp.full(v.shape, 100.0) for k, v in params.items()}
+        opt = opt_mod.SGD(learning_rate=1.0, grad_clip=opt_mod.ClipGradByValue(0.1))
+        out, _ = run_steps(opt, params, [g])
+        for k in params:
+            np.testing.assert_allclose(out[k], np.asarray(params[k]) - 0.1, rtol=1e-5)
+
+
+class TestJitAndEager:
+    def test_update_is_jittable_and_matches(self, rng):
+        params = make_params(rng)
+        gs = [make_grads(rng, params) for _ in range(3)]
+        opt = opt_mod.Adam(learning_rate=0.01)
+
+        eager_params, _ = run_steps(opt, params, gs)
+
+        @jax.jit
+        def step(p, s, g):
+            return opt.update(g, s, p)
+
+        p, s = params, opt.init(params)
+        for g in gs:
+            p, s = step(p, s, g)
+        for k in params:
+            np.testing.assert_allclose(p[k], eager_params[k], rtol=1e-6)
+
+    def test_eager_step_with_parameter_boxes(self, rng):
+        w = Parameter(rng.randn(3, 3).astype(np.float32), name="w")
+        b = Parameter(rng.randn(3).astype(np.float32), name="b")
+        opt = opt_mod.SGD(learning_rate=0.5, parameters=[w, b])
+        g = {"w": jnp.ones((3, 3)), "b": jnp.ones((3,))}
+        before = w.numpy().copy()
+        opt.step(g)
+        np.testing.assert_allclose(w.numpy(), before - 0.5, rtol=1e-6)
+
+    def test_state_dict_roundtrip(self, rng):
+        w = Parameter(rng.randn(3).astype(np.float32), name="w")
+        opt = opt_mod.Adam(learning_rate=0.01, parameters=[w])
+        opt.step({"w": jnp.ones((3,))})
+        sd = opt.state_dict()
+        assert "w.moment1" in sd and "count" in sd
+
+        w2 = Parameter(rng.randn(3).astype(np.float32), name="w")
+        opt2 = opt_mod.Adam(learning_rate=0.01, parameters=[w2])
+        opt2.set_state_dict(sd)
+        np.testing.assert_allclose(
+            opt2._eager_state["slots"]["w"]["moment1"], sd["w.moment1"]
+        )
+
+    def test_multi_precision_master_weights(self, rng):
+        p32 = rng.randn(8, 8).astype(np.float32)
+        params = {"w": jnp.asarray(p32).astype(jnp.bfloat16)}
+        g = {"w": jnp.asarray(rng.randn(8, 8).astype(np.float32) * 1e-3).astype(jnp.bfloat16)}
+        opt = opt_mod.Momentum(learning_rate=0.01, multi_precision=True)
+        state = opt.init(params)
+        assert state["slots"]["w"]["master"].dtype == jnp.float32
+        p, state = opt.update(g, state, params)
+        assert p["w"].dtype == jnp.bfloat16
+        # master accumulates small updates that bf16 param would lose
+        for _ in range(50):
+            p, state = opt.update(g, state, params)
+        assert not np.allclose(
+            np.asarray(state["slots"]["w"]["master"]), p32, atol=1e-4
+        )
+
+    def test_frozen_param_skipped(self, rng):
+        params = make_params(rng)
+        g = {"p0": jnp.ones_like(params["p0"])}  # p1 missing
+        out, _ = run_steps(opt_mod.SGD(learning_rate=0.1), params, [g])
+        np.testing.assert_allclose(out["p1"], params["p1"])
+
+
+class TestSchedulers:
+    def test_piecewise(self):
+        s = opt_mod.lr.PiecewiseDecay(boundaries=[2, 5], values=[1.0, 0.5, 0.1])
+        lrs = []
+        for _ in range(7):
+            lrs.append(s())
+            s.step()
+        assert lrs[:2] == [1.0, 1.0]
+        assert lrs[2:5] == [0.5, 0.5, 0.5]
+        assert lrs[5:] == [0.1, 0.1]
+
+    def test_exponential(self):
+        s = opt_mod.lr.ExponentialDecay(learning_rate=1.0, gamma=0.5)
+        assert s() == 1.0
+        s.step()
+        assert s() == 0.5
+
+    def test_cosine(self):
+        s = opt_mod.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+        np.testing.assert_allclose(s(), 1.0)
+        s.step(10)
+        np.testing.assert_allclose(s(), 0.0, atol=1e-7)
+
+    def test_noam_peak_at_warmup(self):
+        s = opt_mod.lr.NoamDecay(d_model=512, warmup_steps=100, learning_rate=1.0)
+        vals = []
+        for i in range(1, 300):
+            s.step(i)
+            vals.append(s())
+        assert np.argmax(vals) == 99  # peak at warmup boundary
+
+    def test_linear_warmup(self):
+        s = opt_mod.lr.LinearWarmup(learning_rate=0.5, warmup_steps=10, start_lr=0.0, end_lr=0.5)
+        s.step(5)
+        np.testing.assert_allclose(s(), 0.25)
+        s.step(20)
+        np.testing.assert_allclose(s(), 0.5)
+
+    def test_multistep(self):
+        s = opt_mod.lr.MultiStepDecay(learning_rate=1.0, milestones=[2, 4], gamma=0.1)
+        s.step(3)
+        np.testing.assert_allclose(s(), 0.1)
+        s.step(5)
+        np.testing.assert_allclose(s(), 0.01, rtol=1e-6)
+
+    def test_step_decay(self):
+        s = opt_mod.lr.StepDecay(learning_rate=1.0, step_size=3, gamma=0.5)
+        s.step(7)
+        np.testing.assert_allclose(s(), 0.25)
+
+    def test_lambda(self):
+        s = opt_mod.lr.LambdaDecay(learning_rate=2.0, lr_lambda=lambda e: 1.0 / (e + 1))
+        s.step(3)
+        np.testing.assert_allclose(s(), 0.5)
+
+    def test_reduce_on_plateau(self):
+        s = opt_mod.lr.ReduceOnPlateau(learning_rate=1.0, patience=1, factor=0.1)
+        for loss in [1.0, 1.0, 1.0]:
+            s.step(loss)
+        np.testing.assert_allclose(s(), 0.1)
+
+    def test_value_at_matches_eager(self):
+        for s in [
+            opt_mod.lr.ExponentialDecay(learning_rate=1.0, gamma=0.9),
+            opt_mod.lr.NaturalExpDecay(learning_rate=1.0, gamma=0.1),
+            opt_mod.lr.InverseTimeDecay(learning_rate=1.0, gamma=0.1),
+            opt_mod.lr.PolynomialDecay(learning_rate=1.0, decay_steps=20),
+            opt_mod.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=17),
+            opt_mod.lr.StepDecay(learning_rate=1.0, step_size=4),
+            opt_mod.lr.MultiStepDecay(learning_rate=1.0, milestones=[3, 9]),
+            opt_mod.lr.NoamDecay(d_model=64, warmup_steps=5),
+            opt_mod.lr.PiecewiseDecay(boundaries=[4], values=[1.0, 0.1]),
+        ]:
+            for step in [0, 1, 5, 11]:
+                s.step(step)
+                np.testing.assert_allclose(
+                    float(s.value_at(jnp.asarray(step))), s(), rtol=1e-5,
+                    err_msg=f"{type(s).__name__} step={step}",
+                )
+
+    def test_scheduler_drives_optimizer(self, rng):
+        sched = opt_mod.lr.PiecewiseDecay(boundaries=[1], values=[1.0, 0.0])
+        opt = opt_mod.SGD(learning_rate=sched)
+        params = {"w": jnp.ones((2,))}
+        state = opt.init(params)
+        params, state = opt.update({"w": jnp.ones((2,))}, state, params)
+        np.testing.assert_allclose(params["w"], 0.0)  # lr=1
+        sched.step()
+        params, state = opt.update({"w": jnp.ones((2,))}, state, params)
+        np.testing.assert_allclose(params["w"], 0.0)  # lr=0 → unchanged
+
+
+class TestTraining:
+    def test_quadratic_convergence(self, rng):
+        """All optimizers minimize a convex quadratic."""
+        target = jnp.asarray(rng.randn(6).astype(np.float32))
+
+        def loss_fn(params):
+            return jnp.sum(jnp.square(params["w"] - target))
+
+        for opt in [
+            opt_mod.SGD(learning_rate=0.05),
+            opt_mod.Momentum(learning_rate=0.02),
+            opt_mod.Adam(learning_rate=0.3),
+            opt_mod.AdamW(learning_rate=0.3, weight_decay=0.0),
+            opt_mod.RMSProp(learning_rate=0.1),
+            opt_mod.Adagrad(learning_rate=0.9),
+            opt_mod.Adamax(learning_rate=0.5),
+        ]:
+            params = {"w": jnp.zeros(6)}
+            state = opt.init(params)
+            step = jax.jit(lambda p, s: opt.update(jax.grad(loss_fn)(p), s, p))
+            for _ in range(200):
+                params, state = step(params, state)
+            assert float(loss_fn(params)) < 1e-2, type(opt).__name__
+
+
+class TestReviewRegressions:
+    """Regression tests for the code-review findings on this package."""
+
+    def test_step_with_layer_named_grads(self, rng):
+        """Grad dicts keyed by Layer.named_parameters names must update
+        unnamed layer-created parameter boxes (positional remap)."""
+        import paddle_tpu.nn as nn
+        lin = nn.Linear(3, 2)
+        opt = opt_mod.SGD(learning_rate=1.0, parameters=lin.parameters())
+        before = {n: p.numpy().copy() for n, p in lin.named_parameters()}
+        grads = {n: jnp.ones_like(p.value) for n, p in lin.named_parameters()}
+        opt.step(grads)
+        for n, p in lin.named_parameters():
+            np.testing.assert_allclose(p.numpy(), before[n] - 1.0, rtol=1e-6)
+
+    def test_step_rejects_unknown_grad_names(self, rng):
+        w = Parameter(rng.randn(3).astype(np.float32), name="w")
+        opt = opt_mod.SGD(learning_rate=1.0, parameters=[w])
+        with pytest.raises(Exception):
+            opt.step({"w": jnp.ones((3,)), "nope": jnp.ones((3,))})
+
+    def test_positional_grads_align_with_trainable_only(self, rng):
+        w = Parameter(rng.randn(2).astype(np.float32), name="w")
+        frozen = Parameter(rng.randn(2).astype(np.float32), name="f", trainable=False)
+        b = Parameter(rng.randn(2).astype(np.float32), name="b")
+        opt = opt_mod.SGD(learning_rate=1.0, parameters=[w, frozen, b])
+        fb, bb = frozen.numpy().copy(), b.numpy().copy()
+        opt.step([jnp.ones((2,)), jnp.ones((2,))])  # grads for w, b only
+        np.testing.assert_allclose(frozen.numpy(), fb)
+        np.testing.assert_allclose(b.numpy(), bb - 1.0, rtol=1e-6)
+
+    def test_jit_with_scheduler_requires_explicit_lr(self):
+        sched = opt_mod.lr.ExponentialDecay(learning_rate=1.0, gamma=0.5)
+        opt = opt_mod.SGD(learning_rate=sched)
+        params = {"w": jnp.ones((2,))}
+        state = opt.init(params)
+
+        @jax.jit
+        def bad(p, s, g):
+            return opt.update(g, s, p)
+
+        with pytest.raises(Exception, match="baked"):
+            bad(params, state, {"w": jnp.ones((2,))})
+
+        # explicit lr works and tracks the scheduler without retrace
+        @jax.jit
+        def good(p, s, g, lr):
+            return opt.update(g, s, p, lr=lr)
+
+        p, s = good(params, state, {"w": jnp.ones((2,))}, sched())
+        np.testing.assert_allclose(p["w"], 0.0)
+        sched.step()
+        p, s = good(p, s, {"w": jnp.ones((2,))}, sched())
+        np.testing.assert_allclose(p["w"], -0.5)
+
+    def test_polynomial_cycle_value_at(self):
+        s = opt_mod.lr.PolynomialDecay(1.0, decay_steps=10, cycle=True)
+        s.step(15)
+        np.testing.assert_allclose(float(s.value_at(jnp.asarray(15))), s(), rtol=1e-5)
+
+    def test_linear_warmup_state_roundtrip(self):
+        inner = opt_mod.lr.ExponentialDecay(learning_rate=1.0, gamma=0.5)
+        s = opt_mod.lr.LinearWarmup(inner, warmup_steps=3, start_lr=0.0, end_lr=1.0)
+        for _ in range(6):
+            s.step()
+        sd = s.state_dict()
+        inner2 = opt_mod.lr.ExponentialDecay(learning_rate=1.0, gamma=0.5)
+        s2 = opt_mod.lr.LinearWarmup(inner2, warmup_steps=3, start_lr=0.0, end_lr=1.0)
+        s2.set_state_dict(sd)
+        assert s2() == s()
+        assert inner2.last_epoch == inner.last_epoch
+
+    def test_state_dict_does_not_revert_hyperparams(self):
+        s = opt_mod.lr.MultiStepDecay(learning_rate=1.0, milestones=[2, 4])
+        sd = s.state_dict()
+        assert "milestones" not in sd and "gamma" not in sd
+
+    def test_functional_set_state_dict_raises(self):
+        opt = opt_mod.Adam()
+        with pytest.raises(Exception, match="functional"):
+            opt.set_state_dict({"count": 3, "w.moment1": np.zeros(2)})
+
+    def test_adamw_bf16_decay_effective(self, rng):
+        # decay large enough to survive bf16 storage rounding: f32 math path
+        p = {"w": jnp.full((4,), 1.0, dtype=jnp.bfloat16)}
+        g = {"w": jnp.zeros((4,), dtype=jnp.bfloat16)}
+        opt = opt_mod.AdamW(learning_rate=0.1, weight_decay=0.5)
+        state = opt.init(p)
+        x, state = opt.update(g, state, p)
+        np.testing.assert_allclose(float(x["w"][0]), 0.95, rtol=1e-2)
+
+        # tiny decay on bf16 storage needs master weights (multi_precision)
+        opt2 = opt_mod.AdamW(learning_rate=0.1, weight_decay=0.01,
+                             multi_precision=True)
+        state2 = opt2.init(p)
+        x2 = p
+        for _ in range(10):
+            x2, state2 = opt2.update(g, state2, x2)
+        assert float(state2["slots"]["w"]["master"][0]) < 1.0 - 5e-3
+
+    def test_lamb_exclude_fn(self, rng):
+        params = make_params(rng)
+        g = {k: jnp.zeros_like(v) for k, v in params.items()}
+        opt = opt_mod.Lamb(learning_rate=0.1, lamb_weight_decay=0.5,
+                           exclude_from_weight_decay_fn=lambda n: n == "p1")
+        out, _ = run_steps(opt, params, [g])
+        np.testing.assert_allclose(out["p1"], params["p1"])  # excluded: no decay
+        assert not np.allclose(np.asarray(out["p0"]), np.asarray(params["p0"]))
