@@ -61,7 +61,9 @@ from .tensor import *  # noqa: F401,F403
 from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
 from .nn import ParamAttr  # noqa: F401
+from .framework.serialization import save, load  # noqa: F401
 
 import jax as _jax
 import numpy as _np

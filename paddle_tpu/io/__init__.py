@@ -1,0 +1,29 @@
+"""paddle_tpu.io — datasets, samplers, DataLoader (paddle.io parity).
+
+Reference surface: python/paddle/io/__init__.py re-exporting
+fluid/dataloader/* and fluid/reader.py.  See dataloader.py for the
+TPU-native input-pipeline design (worker pool + device double-buffering).
+"""
+from .dataset import (  # noqa: F401
+    Dataset,
+    IterableDataset,
+    TensorDataset,
+    ComposeDataset,
+    ChainDataset,
+    ConcatDataset,
+    Subset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    Sampler,
+    SequenceSampler,
+    RandomSampler,
+    WeightedRandomSampler,
+    BatchSampler,
+    DistributedBatchSampler,
+)
+from .dataloader import (  # noqa: F401
+    DataLoader,
+    default_collate_fn,
+    default_convert_fn,
+)

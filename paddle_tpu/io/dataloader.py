@@ -1,0 +1,284 @@
+"""DataLoader — multiprocess host input pipeline with device double-buffering.
+
+Parity: paddle.io.DataLoader (reference: python/paddle/fluid/reader.py:147 —
+multiprocess workers over a shared-memory queue; C++ side
+operators/reader/buffered_reader.cc — double-buffered async H2D staging).
+
+TPU-native design:
+
+* worker pool (forked processes, dataset shipped once per worker via the
+  pool initializer — the reference ships samples back over a shared-memory
+  LoDTensorBlockingQueue; we rely on pickle over pipes, which measures
+  within noise for batched numpy) fetches + collates batches ahead of the
+  consumer, ``prefetch_factor`` deep;
+* a staging thread ``jax.device_put``s the *next* batch while the current
+  one is being consumed (the buffered_reader double-buffer, but the
+  "stream" is XLA's async dispatch);
+* batches arrive as committed device arrays ready to feed a jitted step —
+  by the time step N's compute finishes, batch N+1's H2D copy has overlapped
+  with it.
+
+``return_numpy=True`` skips staging (for hosts that feed a sharded
+device_put themselves, e.g. the fleet data-parallel path).
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing
+import queue
+import threading
+from concurrent.futures import ProcessPoolExecutor
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from ..framework.errors import InvalidArgumentError
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler, Sampler
+
+__all__ = ["DataLoader", "default_collate_fn", "default_convert_fn"]
+
+
+def default_convert_fn(sample):
+    return sample
+
+
+def default_collate_fn(batch):
+    """Stack a list of samples into a batch (reference:
+    fluid/dataloader/collate.py default_collate_fn): arrays/numbers stack
+    along a new dim 0; dict/tuple structures collate per field."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch, axis=0)
+    if isinstance(sample, (int, float, np.generic)):
+        return np.asarray(batch)
+    if isinstance(sample, (str, bytes)):
+        return batch
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return tuple(default_collate_fn(list(field)) for field in zip(*batch))
+    if hasattr(sample, "__array__"):  # jax arrays and friends
+        return np.stack([np.asarray(s) for s in batch], axis=0)
+    raise InvalidArgumentError(f"cannot collate batch of {type(sample)}")
+
+
+# -- worker-process globals (set once per worker by the pool initializer) ----
+_worker_dataset = None
+_worker_collate = None
+
+
+def _init_worker(dataset, collate_fn, worker_init_fn, worker_id_counter):
+    global _worker_dataset, _worker_collate
+    _worker_dataset = dataset
+    _worker_collate = collate_fn
+    with worker_id_counter.get_lock():
+        worker_id = worker_id_counter.value
+        worker_id_counter.value += 1
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+
+
+def _fetch_batch(indices):
+    samples = [_worker_dataset[i] for i in indices]
+    return _worker_collate(samples)
+
+
+class _StagingIterator:
+    """Pulls collated numpy batches from ``source`` and keeps ``depth``
+    batches resident on device ahead of the consumer.  ``close()`` (also
+    invoked on GC) stops the producer and closes the source generator, so a
+    consumer that breaks mid-epoch doesn't leak the thread or — with
+    num_workers>0 — the whole worker pool."""
+
+    _DONE = object()
+
+    def __init__(self, source, depth: int, to_device: bool):
+        self._source = source
+        self._to_device = to_device
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+        self._err: Optional[BaseException] = None
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _stage(self, batch):
+        if not self._to_device:
+            return batch
+        # device_put dispatches the H2D copy asynchronously; consuming code
+        # only blocks when it actually reads values.
+        return jax.tree_util.tree_map(jax.device_put, batch)
+
+    def _put(self, item) -> bool:
+        while not self._stop:
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self):
+        try:
+            for batch in self._source:
+                if not self._put(self._stage(batch)):
+                    break
+        except BaseException as e:  # propagate into the consumer thread
+            self._err = e
+        finally:
+            if self._stop:
+                # closing the source generator unwinds its `with pool:`
+                close = getattr(self._source, "close", None)
+                if close is not None:
+                    close()
+            self._put(self._DONE)
+
+    def close(self):
+        self._stop = True
+        while True:  # drain so a blocked producer can observe _stop
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=10.0)
+
+    def __del__(self):
+        if not self._stop and self._thread.is_alive():
+            self.close()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._DONE:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+
+class DataLoader:
+    """Iterate a Dataset in collated, device-staged batches.
+
+    Accepted arguments mirror paddle.io.DataLoader (feed_list/places are
+    legacy static-graph knobs, accepted and ignored).
+    """
+
+    def __init__(
+        self,
+        dataset: Dataset,
+        feed_list=None,
+        places=None,
+        return_list: bool = True,
+        batch_sampler: Optional[BatchSampler] = None,
+        batch_size: int = 1,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        collate_fn: Optional[Callable] = None,
+        num_workers: int = 0,
+        use_buffer_reader: bool = True,
+        prefetch_factor: int = 2,
+        use_shared_memory: bool = True,
+        timeout: int = 0,
+        worker_init_fn: Optional[Callable] = None,
+        return_numpy: bool = False,
+        sampler: Optional[Sampler] = None,
+    ):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = max(int(num_workers), 0)
+        self.prefetch_factor = max(int(prefetch_factor), 1)
+        self.use_buffer_reader = use_buffer_reader
+        self.timeout = timeout or None
+        self.worker_init_fn = worker_init_fn
+        self.return_numpy = return_numpy
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+
+        if self._iterable_mode:
+            if batch_sampler is not None:
+                raise InvalidArgumentError("IterableDataset cannot use batch_sampler")
+            if self.num_workers > 0:
+                import warnings
+
+                warnings.warn(
+                    "IterableDataset streams in the main process; "
+                    "num_workers is ignored", RuntimeWarning)
+                self.num_workers = 0
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            if batch_size is None:
+                raise InvalidArgumentError("batch_size or batch_sampler required")
+            self.batch_sampler = BatchSampler(
+                dataset=None if sampler is not None else dataset,
+                sampler=sampler,
+                shuffle=shuffle,
+                batch_size=batch_size,
+                drop_last=drop_last,
+            )
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("DataLoader over IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    # -- batch sources -------------------------------------------------------
+    def _iter_sync(self):
+        for indices in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def _iter_iterable(self):
+        it = iter(self.dataset)
+        while True:
+            batch = list(itertools.islice(it, self.batch_size))
+            if not batch:
+                return
+            if len(batch) < self.batch_size and self.drop_last:
+                return
+            yield self.collate_fn(batch)
+
+    def _iter_workers(self):
+        # one pool per epoch: keeps worker lifetime scoped to iteration,
+        # mirroring the reference's per-epoch worker respawn (reader.py).
+        # spawn, not fork: the parent is multithreaded the moment jax
+        # initializes, and forking a threaded process can deadlock the child.
+        # Consequence (same as torch on spawn platforms): dataset and
+        # collate_fn must be picklable at module scope.
+        ctx = multiprocessing.get_context("spawn")
+        worker_id_counter = ctx.Value("i", 0)
+        with ProcessPoolExecutor(
+            max_workers=self.num_workers,
+            mp_context=ctx,
+            initializer=_init_worker,
+            initargs=(self.dataset, self.collate_fn, self.worker_init_fn,
+                      worker_id_counter),
+        ) as pool:
+            window = self.num_workers * self.prefetch_factor
+            batches = iter(self.batch_sampler)
+            pending = []
+            for indices in itertools.islice(batches, window):
+                pending.append(pool.submit(_fetch_batch, indices))
+            while pending:
+                fut = pending.pop(0)
+                nxt = next(batches, None)
+                if nxt is not None:
+                    pending.append(pool.submit(_fetch_batch, nxt))
+                yield fut.result(timeout=self.timeout)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            source = self._iter_iterable()
+        elif self.num_workers > 0:
+            source = self._iter_workers()
+        else:
+            source = self._iter_sync()
+        if self.return_numpy:
+            return iter(source)
+        if self.use_buffer_reader:
+            return _StagingIterator(source, self.prefetch_factor, to_device=True)
+        return (jax.tree_util.tree_map(jax.device_put, b) for b in source)

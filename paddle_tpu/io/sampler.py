@@ -1,0 +1,200 @@
+"""Samplers and batch samplers.
+
+Parity: python/paddle/io/ (reference: python/paddle/fluid/dataloader/
+batch_sampler.py — BatchSampler, DistributedBatchSampler:~169; sampler.py —
+Sampler/SequenceSampler/RandomSampler/WeightedRandomSampler).
+
+DistributedBatchSampler is the data-parallel shard selector: each rank reads
+a disjoint 1/num_replicas slice per epoch — on TPU this pairs with a mesh
+"data" axis (one process per host feeding its addressable devices).
+"""
+from __future__ import annotations
+
+import math
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.errors import InvalidArgumentError
+
+__all__ = [
+    "Sampler",
+    "SequenceSampler",
+    "RandomSampler",
+    "WeightedRandomSampler",
+    "BatchSampler",
+    "DistributedBatchSampler",
+]
+
+
+def _batched(indices, batch_size: int, drop_last: bool):
+    """Group an index stream into batch lists (shared by Batch/Distributed)."""
+    batch: List[int] = []
+    for idx in indices:
+        batch.append(idx)
+        if len(batch) == batch_size:
+            yield batch
+            batch = []
+    if batch and not drop_last:
+        yield batch
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self) -> Iterator[int]:
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None, generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+        if not replacement and num_samples is not None and num_samples > len(data_source):
+            raise InvalidArgumentError("num_samples > dataset size without replacement")
+
+    @property
+    def num_samples(self):
+        return self._num_samples if self._num_samples is not None else len(self.data_source)
+
+    def _rng(self):
+        if self.generator is not None:
+            next_key = getattr(self.generator, "next_key", None)
+            if next_key is not None:
+                # a paddle_tpu Generator: each epoch pulls a fresh key so
+                # the permutation differs per epoch but replays under seed()
+                key = np.asarray(next_key(), dtype=np.uint32).ravel()
+                return np.random.RandomState(int(key[-1]) & 0x7FFFFFFF)
+            # an int seed: vary per epoch deterministically
+            self._epoch = getattr(self, "_epoch", -1) + 1
+            return np.random.RandomState((int(self.generator) + self._epoch) & 0x7FFFFFFF)
+        return np.random.RandomState()
+
+    def __iter__(self):
+        n = len(self.data_source)
+        rng = self._rng()
+        if self.replacement:
+            return iter(rng.randint(0, n, size=self.num_samples).tolist())
+        return iter(rng.permutation(n)[: self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights: Sequence[float], num_samples: int, replacement=True):
+        super().__init__(None)
+        self.weights = np.asarray(weights, dtype=np.float64)
+        if (self.weights < 0).any():
+            raise InvalidArgumentError("weights must be non-negative")
+        self.num_samples = num_samples
+        self.replacement = replacement
+        if not replacement and num_samples > len(self.weights):
+            raise InvalidArgumentError("num_samples > len(weights) without replacement")
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.choice(
+            len(self.weights), size=self.num_samples, replace=self.replacement, p=p
+        )
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    """Group sampler indices into batches.
+
+    Matches the reference's constructor contract: either ``dataset`` (+
+    shuffle) or an explicit ``sampler``.
+    """
+
+    def __init__(self, dataset=None, sampler: Optional[Sampler] = None,
+                 shuffle: bool = False, batch_size: int = 1, drop_last: bool = False):
+        if batch_size <= 0:
+            raise InvalidArgumentError("batch_size must be positive")
+        if sampler is not None:
+            if dataset is not None:
+                raise InvalidArgumentError("give either dataset or sampler, not both")
+            self.sampler = sampler
+        else:
+            if dataset is None:
+                raise InvalidArgumentError("need a dataset or a sampler")
+            self.sampler = RandomSampler(dataset) if shuffle else SequenceSampler(dataset)
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        return _batched(self.sampler, self.batch_size, self.drop_last)
+
+    def __len__(self):
+        n = len(self.sampler)
+        return n // self.batch_size if self.drop_last else (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Per-rank disjoint shard of the dataset (ref: batch_sampler.py:169).
+
+    ``num_replicas``/``rank`` default from the distributed environment
+    (paddle_tpu.distributed.ParallelEnv → jax process_index/process_count).
+    ``set_epoch(e)`` reseeds the shuffle so every rank permutes identically.
+    """
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        if batch_size <= 0:
+            raise InvalidArgumentError("batch_size must be positive")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        if num_replicas is None or rank is None:
+            import jax
+
+            num_replicas = num_replicas if num_replicas is not None else jax.process_count()
+            rank = rank if rank is not None else jax.process_index()
+        if rank >= num_replicas or rank < 0:
+            raise InvalidArgumentError(f"rank {rank} out of range for {num_replicas} replicas")
+        self.nranks = self.num_replicas = num_replicas
+        self.local_rank = self.rank = rank
+        self.epoch = 0
+        self.num_samples = int(math.ceil(len(dataset) / num_replicas))
+        self.total_size = self.num_samples * num_replicas
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            indices = rng.permutation(n).tolist()
+        else:
+            indices = list(range(n))
+        # pad to make evenly divisible (reference pads by wrapping; loop so
+        # datasets smaller than total_size/2 still fill up)
+        while len(indices) < self.total_size:
+            indices += indices[: self.total_size - len(indices)]
+        local = indices[self.rank : self.total_size : self.num_replicas]
+        assert len(local) == self.num_samples
+        yield from _batched(local, self.batch_size, self.drop_last)
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch

@@ -1,0 +1,319 @@
+"""IO tests: save/load roundtrip, datasets, samplers, DataLoader paths
+(sync, multiprocess workers, iterable, device staging).  Mirrors the
+reference's test_dataloader_* / test_batch_sampler unittests."""
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import io as pio
+from paddle_tpu import nn
+
+
+class TestSaveLoad:
+    def test_state_dict_roundtrip(self, tmp_path, rng):
+        layer = nn.Linear(4, 3)
+        path = str(tmp_path / "model.pdparams")
+        paddle.save(layer.state_dict(), path)
+        loaded = paddle.load(path)
+        layer2 = nn.Linear(4, 3)
+        layer2.set_state_dict(loaded)
+        for (n1, p1), (n2, p2) in zip(layer.named_parameters(), layer2.named_parameters()):
+            np.testing.assert_allclose(p1.numpy(), p2.numpy())
+
+    def test_nested_containers(self, tmp_path):
+        obj = {"a": [jnp.ones((2, 2)), 3, "s"], "b": {"c": np.zeros(3)}, "d": None}
+        path = str(tmp_path / "obj.pkl")
+        paddle.save(obj, path)
+        out = paddle.load(path)
+        np.testing.assert_allclose(out["a"][0], 1.0)
+        assert out["a"][1] == 3 and out["a"][2] == "s" and out["d"] is None
+
+    def test_optimizer_state_roundtrip(self, tmp_path, rng):
+        from paddle_tpu import optimizer as O
+
+        layer = nn.Linear(3, 3)
+        opt = O.Adam(parameters=layer.parameters())
+        grads = {n: jnp.ones_like(p.value) for n, p in layer.named_parameters()}
+        opt.step(grads)
+        path = str(tmp_path / "opt.pdopt")
+        paddle.save(opt.state_dict(), path)
+        opt2 = O.Adam(parameters=nn.Linear(3, 3).parameters())
+        opt2.set_state_dict(paddle.load(path))
+        assert int(opt2._eager_state["count"]) == 1
+
+    def test_load_missing_raises(self, tmp_path):
+        with pytest.raises(Exception, match="exist"):
+            paddle.load(str(tmp_path / "nope.pdparams"))
+
+    def test_load_foreign_file_raises(self, tmp_path):
+        p = tmp_path / "junk.bin"
+        p.write_bytes(b"garbage-not-a-checkpoint")
+        with pytest.raises(Exception, match="magic"):
+            paddle.load(str(p))
+
+    def test_atomic_save_creates_dirs(self, tmp_path):
+        path = str(tmp_path / "deep" / "nested" / "m.pdparams")
+        paddle.save({"x": np.ones(2)}, path)
+        assert os.path.exists(path)
+        assert not os.path.exists(path + ".tmp")
+
+
+class SquareDataset(pio.Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.asarray([i], dtype=np.float32), np.asarray(i * i, dtype=np.float32)
+
+
+class BadDataset(pio.Dataset):
+    """Raises from workers (module scope: spawn workers pickle the dataset)."""
+
+    def __len__(self):
+        return 4
+
+    def __getitem__(self, i):
+        raise ValueError("boom-from-worker")
+
+
+class TestDatasets:
+    def test_tensor_dataset(self, rng):
+        x, y = rng.randn(10, 3).astype(np.float32), rng.randn(10).astype(np.float32)
+        ds = pio.TensorDataset([x, y])
+        assert len(ds) == 10
+        np.testing.assert_allclose(ds[3][0], x[3])
+        np.testing.assert_allclose(ds[3][1], y[3])
+
+    def test_concat_subset_split(self):
+        a, b = SquareDataset(5), SquareDataset(7)
+        cat = pio.ConcatDataset([a, b])
+        assert len(cat) == 12
+        np.testing.assert_allclose(cat[6][0], [1.0])  # second dataset idx 1
+        sub = pio.Subset(cat, [0, 6])
+        assert len(sub) == 2
+        parts = pio.random_split(SquareDataset(10), [7, 3])
+        assert len(parts[0]) == 7 and len(parts[1]) == 3
+        all_idx = sorted(parts[0].indices + parts[1].indices)
+        assert all_idx == list(range(10))
+
+    def test_compose(self):
+        ds = pio.ComposeDataset([SquareDataset(4), SquareDataset(4)])
+        sample = ds[2]
+        assert len(sample) == 4
+
+    def test_chain(self):
+        class It(pio.IterableDataset):
+            def __init__(self, lo, hi):
+                self.lo, self.hi = lo, hi
+
+            def __iter__(self):
+                return iter(range(self.lo, self.hi))
+
+        out = list(pio.ChainDataset([It(0, 3), It(10, 12)]))
+        assert out == [0, 1, 2, 10, 11]
+
+
+class TestSamplers:
+    def test_sequence(self):
+        assert list(pio.SequenceSampler(SquareDataset(4))) == [0, 1, 2, 3]
+
+    def test_random_permutes(self):
+        out = list(pio.RandomSampler(SquareDataset(50)))
+        assert sorted(out) == list(range(50)) and out != list(range(50))
+
+    def test_weighted(self):
+        s = pio.WeightedRandomSampler([0.0, 1.0, 0.0], num_samples=20)
+        assert all(i == 1 for i in s)
+
+    def test_batch_sampler(self):
+        bs = pio.BatchSampler(dataset=SquareDataset(10), batch_size=3)
+        batches = list(bs)
+        assert len(bs) == 4 and len(batches) == 4
+        assert batches[-1] == [9]
+        bs = pio.BatchSampler(dataset=SquareDataset(10), batch_size=3, drop_last=True)
+        assert len(list(bs)) == 3 == len(bs)
+
+    def test_distributed_batch_sampler_disjoint_covering(self):
+        n, reps = 20, 4
+        seen = []
+        for rank in range(reps):
+            s = pio.DistributedBatchSampler(
+                SquareDataset(n), batch_size=2, num_replicas=reps, rank=rank
+            )
+            idx = [i for b in s for i in b]
+            assert len(idx) == 5
+            seen.extend(idx)
+        assert sorted(seen) == list(range(n))
+
+    def test_distributed_shuffle_consistent_across_ranks(self):
+        perms = []
+        for rank in range(2):
+            s = pio.DistributedBatchSampler(
+                SquareDataset(10), batch_size=5, num_replicas=2, rank=rank, shuffle=True
+            )
+            s.set_epoch(3)
+            perms.append([i for b in s for i in b])
+        assert not set(perms[0]) & set(perms[1])
+        s.set_epoch(4)
+        assert [i for b in s for i in b] != perms[1]
+
+
+class TestDataLoader:
+    def test_sync_loader_shapes(self):
+        dl = pio.DataLoader(SquareDataset(10), batch_size=4, return_numpy=True)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == (4, 1) and y.shape == (4,)
+        np.testing.assert_allclose(batches[-1][0][:, 0], [8, 9])
+
+    def test_device_staging_returns_jax_arrays(self):
+        import jax
+
+        dl = pio.DataLoader(SquareDataset(6), batch_size=3)
+        for x, y in dl:
+            assert isinstance(x, jax.Array)
+
+    def test_shuffle_epoch_differs(self):
+        dl = pio.DataLoader(SquareDataset(30), batch_size=30, shuffle=True, return_numpy=True)
+        (a,) = [b[1] for b in dl]
+        (b,) = [b[1] for b in dl]
+        assert sorted(a.tolist()) == sorted(b.tolist())
+        assert a.tolist() != b.tolist()
+
+    def test_multiprocess_workers_match_sync(self):
+        sync = [b[1] for b in pio.DataLoader(SquareDataset(17), batch_size=4, return_numpy=True)]
+        mp = [
+            b[1]
+            for b in pio.DataLoader(
+                SquareDataset(17), batch_size=4, num_workers=2, return_numpy=True
+            )
+        ]
+        assert len(sync) == len(mp)
+        for s, m in zip(sync, mp):
+            np.testing.assert_allclose(s, m)
+
+    def test_worker_exception_propagates(self):
+        dl = pio.DataLoader(BadDataset(), batch_size=2, num_workers=1, return_numpy=True)
+        with pytest.raises(Exception, match="boom"):
+            list(dl)
+
+    def test_iterable_dataset(self):
+        class Stream(pio.IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.asarray([i], np.float32)
+
+        dl = pio.DataLoader(Stream(), batch_size=3, return_numpy=True)
+        batches = list(dl)
+        assert [b.shape[0] for b in batches] == [3, 3, 1]
+        dl = pio.DataLoader(Stream(), batch_size=3, drop_last=True, return_numpy=True)
+        assert [b.shape[0] for b in dl] == [3, 3]
+
+    def test_dict_collate(self):
+        class D(pio.Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return {"x": np.ones(2, np.float32) * i, "n": i}
+
+        dl = pio.DataLoader(D(), batch_size=2, return_numpy=True)
+        b = next(iter(dl))
+        assert b["x"].shape == (2, 2) and b["n"].tolist() == [0, 1]
+
+    def test_custom_collate_and_sampler(self):
+        dl = pio.DataLoader(
+            SquareDataset(8),
+            batch_size=2,
+            sampler=pio.SequenceSampler(SquareDataset(8)),
+            collate_fn=lambda batch: len(batch),
+            return_numpy=True,
+        )
+        assert list(dl) == [2, 2, 2, 2]
+
+    def test_training_with_dataloader_e2e(self, rng):
+        """Linear regression learns y=2x from a DataLoader feed."""
+        import jax
+
+        X = rng.randn(64, 1).astype(np.float32)
+        Y = 2.0 * X
+        ds = pio.TensorDataset([X, Y])
+        dl = pio.DataLoader(ds, batch_size=16, shuffle=True)
+        from paddle_tpu import optimizer as O
+
+        w = nn.Parameter(np.zeros((1, 1), np.float32), name="w")
+        opt = O.SGD(learning_rate=0.1, parameters=[w])
+
+        def loss_fn(params, x, y):
+            return jnp.mean((x @ params["w"] - y) ** 2)
+
+        gfn = jax.jit(jax.grad(loss_fn))
+        for _ in range(10):
+            for x, y in dl:
+                opt.step(gfn({"w": w.value}, x, y))
+        np.testing.assert_allclose(float(w.value[0, 0]), 2.0, rtol=1e-3)
+
+
+def _record_worker_id(wid):
+    # spawn workers write their id to a tempfile named by pid-independent env
+    import os, tempfile
+    with open(os.path.join(os.environ["PTPU_TEST_WIDDIR"], f"w{wid}"), "w") as f:
+        f.write(str(wid))
+
+
+class TestReviewRegressions:
+    def test_distinct_worker_ids(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PTPU_TEST_WIDDIR", str(tmp_path))
+        dl = pio.DataLoader(SquareDataset(8), batch_size=2, num_workers=2,
+                            worker_init_fn=_record_worker_id, return_numpy=True)
+        list(dl)
+        ids = sorted(p.name for p in tmp_path.iterdir())
+        assert ids == ["w0", "w1"]
+
+    def test_early_break_shuts_down_pool(self):
+        import multiprocessing, gc
+        before = len(multiprocessing.active_children())
+        dl = pio.DataLoader(SquareDataset(40), batch_size=2, num_workers=2)
+        it = iter(dl)
+        next(it)
+        it.close()
+        gc.collect()
+        import time
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if len(multiprocessing.active_children()) <= before:
+                break
+            time.sleep(0.2)
+        assert len(multiprocessing.active_children()) <= before
+
+    def test_random_sampler_generator_varies_per_epoch(self):
+        from paddle_tpu.framework.random import Generator
+        s = pio.RandomSampler(SquareDataset(30), generator=Generator(7))
+        a, b = list(s), list(s)
+        assert sorted(a) == sorted(b) == list(range(30))
+        assert a != b
+
+    def test_random_sampler_int_seed_varies_per_epoch(self):
+        s = pio.RandomSampler(SquareDataset(30), generator=7)
+        assert list(s) != list(s)
+
+    def test_distributed_sampler_tiny_dataset_pads(self):
+        s = pio.DistributedBatchSampler(SquareDataset(1), batch_size=1,
+                                        num_replicas=3, rank=2)
+        assert [i for b in s for i in b] == [0]
+
+    def test_iterable_num_workers_warns(self):
+        class Stream(pio.IterableDataset):
+            def __iter__(self):
+                return iter(range(3))
+
+        with pytest.warns(RuntimeWarning, match="num_workers"):
+            dl = pio.DataLoader(Stream(), batch_size=2, num_workers=4, return_numpy=True)
+        assert dl.num_workers == 0
