@@ -62,6 +62,10 @@ from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi import Model  # noqa: F401
+from .hapi import callbacks  # noqa: F401
 from .nn import ParamAttr  # noqa: F401
 from .framework.serialization import save, load  # noqa: F401
 

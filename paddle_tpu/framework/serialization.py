@@ -40,6 +40,8 @@ def _to_host(obj: Any) -> Any:
         return np.asarray(obj)
     if isinstance(obj, dict):
         return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
+        return type(obj)(*(_to_host(v) for v in obj))
     if isinstance(obj, (list, tuple)):
         t = type(obj)
         return t(_to_host(v) for v in obj)

@@ -1,0 +1,6 @@
+"""paddle_tpu.hapi — high-level Model API (paddle.hapi parity).
+
+Reference: python/paddle/hapi/ (model.py, callbacks.py).
+"""
+from .model import Model  # noqa: F401
+from . import callbacks  # noqa: F401

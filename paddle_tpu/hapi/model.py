@@ -1,0 +1,361 @@
+"""paddle.Model — the high-level train/eval/predict API.
+
+Parity: python/paddle/hapi/model.py:813 (Model.prepare/fit/evaluate/predict/
+save/load/train_batch/eval_batch/predict_batch/summary).
+
+TPU-native design: the reference maintains TWO adapters (a static-graph one
+building Programs per mode, :254, and a dygraph one, :639).  Here there is
+exactly one path: ``prepare()`` builds jit-compiled step functions
+
+    train_step(params, opt_state, buffers, key, lr, *batch)
+      → loss, outputs, new_params, new_opt_state, new_buffers
+
+from ``nn.functional_call`` + ``jax.value_and_grad`` + the functional
+optimizer — the whole forward/backward/update is ONE fused XLA executable
+(replacing the per-op Executor loop, executor.cc:474).  Old params/opt
+buffers are donated, so the update is in-place on device memory.
+
+State lives functionally during fit() and is written back to the Layer's
+Parameter boxes after every batch (cheap rebinding of device arrays), so
+eager inspection (`model.network.weight`) always sees current values.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as _random
+from ..framework import serialization
+from ..framework.errors import InvalidArgumentError
+from ..metric import Metric
+from ..nn.layer_base import Layer, functional_call
+from ..optimizer.optimizer import Optimizer
+from . import callbacks as _callbacks_mod
+
+__all__ = ["Model"]
+
+
+def _tuplize(x):
+    return x if isinstance(x, (tuple, list)) else (x,)
+
+
+class Model:
+    """Wrap a Layer with train/eval/predict conveniences.
+
+    ``inputs``/``labels`` may be specs (lists) — only their *count* matters
+    here (how to split a dataloader batch); shapes/dtypes come from tracing.
+    """
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._n_inputs = len(_tuplize(inputs)) if inputs is not None else None
+        self._n_labels = len(_tuplize(labels)) if labels is not None else 1
+        self._optimizer: Optional[Optimizer] = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self._eval_step = None
+        self._predict_step = None
+        self._opt_state = None
+        self.stop_training = False
+        self._save_dir = None
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer: Optional[Optimizer] = None, loss=None,
+                metrics: Optional[Sequence[Metric]] = None, amp_configs=None):
+        if loss is not None and not (isinstance(loss, Layer) or callable(loss)):
+            raise InvalidArgumentError("loss must be a Layer or callable")
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = list(metrics or [])
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise InvalidArgumentError(f"metric {m!r} is not a Metric")
+
+        net = self.network
+        loss_fn = loss
+
+        def forward_loss(params, buffers, key, training, *batch):
+            inputs, labels = self._split_batch(batch)
+            out, new_bufs = functional_call(
+                net, params, *inputs, buffers=buffers, rngs=key,
+                training=training, return_buffers=True,
+            )
+            outs = _tuplize(out)
+            if loss_fn is not None:
+                loss_val = loss_fn(*(tuple(outs) + tuple(labels)))
+            else:
+                loss_val = jnp.zeros(())
+            return loss_val, (out, new_bufs)
+
+        opt = optimizer
+
+        def train_step(params, opt_state, buffers, key, lr, *batch):
+            grad_fn = jax.value_and_grad(
+                lambda p: forward_loss(p, buffers, key, True, *batch),
+                has_aux=True,
+            )
+            (loss_val, (out, new_bufs)), grads = grad_fn(params)
+            new_params, new_opt_state = opt.update(grads, opt_state, params, lr=lr)
+            return loss_val, out, new_params, new_opt_state, new_bufs
+
+        def eval_step(params, buffers, *batch):
+            loss_val, (out, _) = forward_loss(params, buffers, None, False, *batch)
+            return loss_val, out
+
+        def predict_step(params, buffers, *inputs):
+            out = functional_call(net, params, *inputs, buffers=buffers,
+                                  training=False)
+            return out
+
+        if optimizer is not None:
+            # donate old params/opt_state/buffers: the update happens in-place
+            # in device memory (reference analogue: buffer reuse passes)
+            self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        self._eval_step = jax.jit(eval_step)
+        self._predict_step = jax.jit(predict_step)
+        self._opt_state = None
+        return self
+
+    def _split_batch(self, batch):
+        n_in = self._n_inputs
+        if n_in is None:
+            n_in = max(len(batch) - self._n_labels, 1)
+        return batch[:n_in], batch[n_in:]
+
+    # -- functional state plumbing -------------------------------------------
+    def _pull_state(self):
+        params = self.network.param_pytree(trainable_only=True)
+        buffers = self.network.buffer_pytree()
+        return params, buffers
+
+    def _push_state(self, params, buffers):
+        boxes = dict(self.network.named_parameters())
+        for name, v in params.items():
+            boxes[name].value = v
+        bufs = dict(self.network.named_buffers())
+        for name, v in buffers.items():
+            bufs[name].value = v
+
+    def _ensure_opt_state(self, params):
+        if self._opt_state is None:
+            self._opt_state = self._optimizer.init(params)
+
+    # -- batch-level API -----------------------------------------------------
+    def train_batch(self, inputs, labels=None):
+        """One optimization step; returns (loss, metrics_results)."""
+        loss_val, metrics = self._train_batch_device(inputs, labels)
+        return float(loss_val), metrics
+
+    def _train_batch_device(self, inputs, labels=None):
+        """Like train_batch but leaves the loss as a device scalar — no host
+        sync, so fit()'s loop can dispatch ahead of the device (the loss is
+        only materialized at logging points)."""
+        if self._train_step is None:
+            raise InvalidArgumentError("call prepare(optimizer=..., loss=...) first")
+        batch = tuple(_tuplize(inputs)) + tuple(_tuplize(labels) if labels is not None else ())
+        batch = tuple(jnp.asarray(b) for b in batch)
+        params, buffers = self._pull_state()
+        self._ensure_opt_state(params)
+        key = _random.default_generator().next_key()
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        loss_val, out, params, self._opt_state, buffers = self._train_step(
+            params, self._opt_state, buffers, key, lr, *batch)
+        self._push_state(params, buffers)
+        metrics = self._update_metrics(out, batch[len(_tuplize(inputs)):])
+        return loss_val, metrics
+
+    def eval_batch(self, inputs, labels=None):
+        batch = tuple(_tuplize(inputs)) + tuple(_tuplize(labels) if labels is not None else ())
+        batch = tuple(jnp.asarray(b) for b in batch)
+        params, buffers = self._pull_state()
+        loss_val, out = self._eval_step(params, buffers, *batch)
+        _, labels_part = self._split_batch(batch)
+        metrics = self._update_metrics(out, labels_part)
+        return float(loss_val), metrics
+
+    def predict_batch(self, inputs):
+        inputs = tuple(jnp.asarray(b) for b in _tuplize(inputs))
+        params, buffers = self._pull_state()
+        return self._predict_step(params, buffers, *inputs)
+
+    def _update_metrics(self, out, labels):
+        results = []
+        outs = _tuplize(out)
+        for m in self._metrics:
+            computed = m.compute(outs[0], *labels)
+            results.append(m.update(computed) if not isinstance(computed, tuple)
+                           else m.update(*computed))
+        return results
+
+    # -- loops ---------------------------------------------------------------
+    def _as_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        from ..io import DataLoader, Dataset
+
+        if data is None or hasattr(data, "__next__") or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=drop_last, num_workers=num_workers,
+                              return_numpy=True)
+        return data  # any iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        train_loader = self._as_loader(train_data, batch_size, shuffle,
+                                       drop_last, num_workers)
+        eval_loader = self._as_loader(eval_data, batch_size, False, False,
+                                      num_workers)
+        if epochs > 1 and hasattr(train_loader, "__next__"):
+            raise InvalidArgumentError(
+                "train_data is a one-shot iterator but epochs > 1: epochs "
+                "after the first would train on zero batches.  Pass a "
+                "Dataset/DataLoader (re-iterable) or epochs=1."
+            )
+        self._save_dir = save_dir
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbks = _callbacks_mod.config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, verbose=verbose, save_freq=save_freq,
+            save_dir=save_dir, metrics=self._metrics_names(),
+        )
+        self.stop_training = False
+        cbks.on_train_begin()
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs: Dict[str, Any] = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                batch = _tuplize(batch)
+                n_in = (self._n_inputs if self._n_inputs is not None
+                        else max(len(batch) - self._n_labels, 1))
+                loss_val, metrics = self._train_batch_device(batch[:n_in], batch[n_in:])
+                logs = {"loss": loss_val}  # device scalar; callbacks pull it
+                for name, res in zip(self._metrics_names(), metrics):
+                    logs[name] = res
+                logs["batch_size"] = np.asarray(batch[0]).shape[0]
+                cbks.on_train_batch_end(step, logs)
+                if self.stop_training:
+                    break
+            # epoch-end logs report accumulated metric values
+            for m in self._metrics:
+                for name, val in zip(_tuplize(m.name()), _tuplize(m.accumulate())):
+                    logs[name] = val
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              verbose=verbose, callbacks=cbks, _inner=True)
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, _inner=False):
+        loader = self._as_loader(eval_data, batch_size, False, False, num_workers)
+        cbks = callbacks if _inner else _callbacks_mod.config_callbacks(
+            callbacks, model=self, verbose=verbose, metrics=self._metrics_names())
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        total_loss, n_batches = 0.0, 0
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            batch = _tuplize(batch)
+            n_in = (self._n_inputs if self._n_inputs is not None
+                    else max(len(batch) - self._n_labels, 1))
+            loss_val, _ = self.eval_batch(batch[:n_in], batch[n_in:])
+            total_loss += loss_val
+            n_batches += 1
+            cbks.on_eval_batch_end(step, {"loss": loss_val})
+        logs = {"loss": total_loss / max(n_batches, 1)}
+        for m in self._metrics:
+            for name, val in zip(_tuplize(m.name()), _tuplize(m.accumulate())):
+                logs[name] = val
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False, False, num_workers)
+        outputs = []
+        for batch in loader:
+            batch = _tuplize(batch)
+            n_in = (self._n_inputs if self._n_inputs is not None else len(batch))
+            out = self.predict_batch(batch[:n_in])
+            outputs.append(jax.tree_util.tree_map(np.asarray, out))
+        if stack_outputs and outputs:
+            outputs = jax.tree_util.tree_map(
+                lambda *xs: np.concatenate(xs, axis=0), *outputs)
+        return outputs
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path: str, training: bool = True):
+        """Writes ``path.pdparams`` (+ ``path.pdopt`` when training).
+        serialization.save creates parent directories itself."""
+        serialization.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            opt_state = {"state": jax.tree_util.tree_map(np.asarray, self._opt_state)} \
+                if self._opt_state is not None else {}
+            sched = self._optimizer.lr_scheduler
+            if sched is not None:
+                opt_state["LR_Scheduler"] = sched.state_dict()
+            else:
+                opt_state["lr"] = self._optimizer.get_lr()
+            serialization.save(opt_state, path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer: bool = False):
+        state = serialization.load(path + ".pdparams")
+        missing = self.network.set_state_dict(state)
+        if missing and not skip_mismatch:
+            raise InvalidArgumentError(f"unmatched keys in checkpoint: {missing[:5]}")
+        if not reset_optimizer and os.path.exists(path + ".pdopt"):
+            opt_state = serialization.load(path + ".pdopt")
+            if "state" in opt_state:
+                self._opt_state = jax.tree_util.tree_map(
+                    jnp.asarray, opt_state["state"])
+            if self._optimizer is not None:
+                sched = self._optimizer.lr_scheduler
+                if sched is not None and "LR_Scheduler" in opt_state:
+                    sched.set_state_dict(opt_state["LR_Scheduler"])
+                elif sched is None and "lr" in opt_state:
+                    self._optimizer.set_lr(float(opt_state["lr"]))
+        return self
+
+    # -- misc ----------------------------------------------------------------
+    def parameters(self):
+        return self.network.parameters()
+
+    def _metrics_names(self):
+        names = []
+        for m in self._metrics:
+            names.extend(_tuplize(m.name()))
+        return names
+
+    def summary(self, input_size=None, dtype=None):
+        rows = []
+        total = 0
+        trainable = 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            total += n
+            if p.trainable:
+                trainable += n
+            rows.append((name, tuple(p.shape), n))
+        width = max([len(r[0]) for r in rows], default=10) + 2
+        lines = [f"{'Layer':<{width}}{'Shape':<20}{'Params':>12}"]
+        lines += [f"{n:<{width}}{str(s):<20}{c:>12,}" for n, s, c in rows]
+        lines.append(f"Total params: {total:,}")
+        lines.append(f"Trainable params: {trainable:,}")
+        print("\n".join(lines))
+        return {"total_params": total, "trainable_params": trainable}

@@ -153,7 +153,8 @@ class _StagingIterator:
     def __next__(self):
         item = self._q.get()
         if item is self._DONE:
-            if self._err is not None:
+            self._q.put(self._DONE)  # keep exhausted: further next() calls
+            if self._err is not None:  # must re-raise, not block forever
                 raise self._err
             raise StopIteration
         return item

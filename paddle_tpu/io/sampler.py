@@ -82,7 +82,12 @@ class RandomSampler(Sampler):
             # an int seed: vary per epoch deterministically
             self._epoch = getattr(self, "_epoch", -1) + 1
             return np.random.RandomState((int(self.generator) + self._epoch) & 0x7FFFFFFF)
-        return np.random.RandomState()
+        # default: the framework generator, so paddle.seed() reproduces
+        # shuffle order (consistent with random_split)
+        from ..framework import random as _random
+
+        key = np.asarray(_random.default_generator().next_key(), dtype=np.uint32).ravel()
+        return np.random.RandomState(int(key[-1]) & 0x7FFFFFFF)
 
     def __iter__(self):
         n = len(self.data_source)
@@ -131,6 +136,11 @@ class BatchSampler(Sampler):
         if sampler is not None:
             if dataset is not None:
                 raise InvalidArgumentError("give either dataset or sampler, not both")
+            if shuffle:
+                raise InvalidArgumentError(
+                    "shuffle=True conflicts with an explicit sampler; the "
+                    "sampler alone controls ordering"
+                )
             self.sampler = sampler
         else:
             if dataset is None:
