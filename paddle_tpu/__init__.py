@@ -62,6 +62,8 @@ from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import io  # noqa: F401
+from . import distributed  # noqa: F401
+from .distributed import DataParallel  # noqa: F401
 from . import metric  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model  # noqa: F401

@@ -1,0 +1,41 @@
+"""paddle_tpu.distributed — collectives, mesh, fleet, parallel layers.
+
+Reference surface: python/paddle/distributed/ (§2.9 of SURVEY.md).  The
+communication backend is XLA ICI/DCN collectives over a named Mesh (see
+mesh.py) instead of NCCL rings + Gloo + gRPC parameter servers.
+"""
+from .env import (  # noqa: F401
+    ParallelEnv,
+    init_parallel_env,
+    get_rank,
+    get_world_size,
+)
+from .mesh import (  # noqa: F401
+    build_mesh,
+    get_mesh,
+    set_mesh,
+    mesh_axis_size,
+    Mesh,
+    NamedSharding,
+    PartitionSpec,
+)
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_reduce,
+    all_gather,
+    reduce,
+    broadcast,
+    scatter,
+    alltoall,
+    barrier,
+    psum,
+    pmean,
+    pmax,
+    pmin,
+    ppermute,
+    all_to_all_single,
+)
+from .parallel import DataParallel, spawn  # noqa: F401
+from . import launch  # noqa: F401  (module: python -m paddle_tpu.distributed.launch)
+from . import fleet  # noqa: F401
+from . import meta_parallel  # noqa: F401

@@ -1,0 +1,220 @@
+"""User-facing collectives.
+
+Parity: python/paddle/distributed/collective.py (broadcast:89, all_reduce:146,
+reduce:221, all_gather:304, scatter:377, barrier:449) and the c_* collective
+ops (operators/collective/c_allreduce_op.h:109 NCCL dispatch).
+
+TPU-native semantics: there is ONE controller per host, not one process per
+chip, so "each rank's tensor" is expressed as a *stacked global array* whose
+leading dim indexes ranks along a mesh axis (default ``data``).  Each
+collective shard_maps a ``lax`` collective over that axis — XLA lowers it to
+an ICI/DCN all-reduce/gather/permute exactly like the reference's NCCL ring
+call, but compiler-scheduled and fusable.  After the call, every rank slot
+holds the value paddle's per-process API would give that rank.
+
+For *in-graph* use (inside your own ``shard_map``), use the primitives
+directly: ``psum``/``pmean``/``pmax``/``ppermute``/``all_to_all`` re-exports.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map as _shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    # check_vma=False: collectives like all_gather produce values that ARE
+    # replicated over the group axis, but the static checker can't always
+    # infer it
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=False)
+
+from ..framework.errors import InvalidArgumentError
+from .mesh import get_mesh
+
+__all__ = [
+    "ReduceOp",
+    "all_reduce",
+    "all_gather",
+    "reduce",
+    "broadcast",
+    "scatter",
+    "alltoall",
+    "barrier",
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "ppermute",
+    "all_to_all_single",
+]
+
+# in-graph primitive re-exports (for custom shard_map code)
+psum = lax.psum
+pmean = lax.pmean
+pmax = lax.pmax
+pmin = lax.pmin
+ppermute = lax.ppermute
+
+
+def all_to_all_single(x, axis_name: str, split_axis: int = 0, concat_axis: int = 0):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lax.psum,
+    ReduceOp.MAX: lax.pmax,
+    ReduceOp.MIN: lax.pmin,
+    ReduceOp.PROD: lambda x, a: lax.all_gather(x, a).prod(axis=0),
+}
+
+
+def _group_axis(group) -> str:
+    if group is None:
+        return "data"
+    if isinstance(group, str):
+        return group
+    return getattr(group, "axis", "data")
+
+
+def _stacked(tensor, axis: str):
+    mesh = get_mesh()
+    n = mesh.shape[axis]
+    tensor = jnp.asarray(tensor)
+    if tensor.shape[0] != n:
+        raise InvalidArgumentError(
+            f"stacked collective input must have leading dim {n} "
+            f"(= size of mesh axis {axis!r}), got {tensor.shape}"
+        )
+    return mesh, tensor
+
+
+@functools.partial(jax.jit, static_argnames=("op", "axis"))
+def _all_reduce_impl(tensor, op, axis):
+    mesh = get_mesh()
+    reducer = _REDUCERS[op]
+
+    def f(t):  # t: [1, ...] per rank
+        return reducer(t, axis)
+
+    return shard_map(f, mesh=mesh, in_specs=P(axis), out_specs=P(axis))(tensor)
+
+
+def all_reduce(tensor, op: str = ReduceOp.SUM, group=None, sync_op: bool = True):
+    """Every rank slot ends with the reduction over all rank slots."""
+    axis = _group_axis(group)
+    _, tensor = _stacked(tensor, axis)
+    return _all_reduce_impl(tensor, op, axis)
+
+
+def all_gather(tensor_or_list, tensor=None, group=None, sync_op: bool = True) -> List[jax.Array]:
+    """Returns the list of per-rank tensors (replicated everywhere).
+
+    Call styles: ``all_gather(stacked)`` or paddle-style
+    ``all_gather(out_list, stacked)`` which extends ``out_list``.
+    """
+    out_list = None
+    if tensor is None:
+        stacked = tensor_or_list
+    else:
+        out_list, stacked = tensor_or_list, tensor
+    axis = _group_axis(group)
+    mesh, stacked = _stacked(stacked, axis)
+
+    def f(t):  # [1, ...] → gather to [n, ...] on every rank
+        return lax.all_gather(t, axis, axis=0, tiled=True)
+
+    gathered = shard_map(f, mesh=mesh, in_specs=P(axis), out_specs=P(None))(stacked)
+    result = [gathered[i] for i in range(gathered.shape[0])]
+    if out_list is not None:
+        out_list.extend(result)
+    return result
+
+
+def reduce(tensor, dst: int = 0, op: str = ReduceOp.SUM, group=None, sync_op: bool = True):
+    """Rank ``dst``'s slot gets the reduction; other slots keep their value."""
+    axis = _group_axis(group)
+    mesh, tensor = _stacked(tensor, axis)
+    reducer = _REDUCERS[op]
+
+    def f(t):
+        total = reducer(t, axis)
+        i = lax.axis_index(axis)
+        return jnp.where(i == dst, total, t)
+
+    return shard_map(f, mesh=mesh, in_specs=P(axis), out_specs=P(axis))(tensor)
+
+
+def broadcast(tensor, src: int = 0, group=None, sync_op: bool = True):
+    """Every rank slot ends with rank ``src``'s value."""
+    axis = _group_axis(group)
+    mesh, tensor = _stacked(tensor, axis)
+
+    def f(t):
+        # mask-and-sum: contributes only src's shard, summed over the axis —
+        # lowers to a one-hot all-reduce (XLA folds it into a broadcast)
+        i = lax.axis_index(axis)
+        contrib = jnp.where(i == src, t, jnp.zeros_like(t))
+        return lax.psum(contrib, axis)
+
+    return shard_map(f, mesh=mesh, in_specs=P(axis), out_specs=P(axis))(tensor)
+
+
+def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op: bool = True):
+    """Rank i's slot gets ``tensor_list[i]`` (from rank src).  With the
+    stacked representation the rows ARE the per-rank values, so this
+    broadcasts src's stacked rows and selects row i for rank i."""
+    axis = _group_axis(group)
+    if tensor_list is not None:
+        tensor = jnp.stack([jnp.asarray(t) for t in tensor_list], axis=0)
+    mesh, tensor = _stacked(tensor, axis)
+    return tensor  # row i is already rank i's result
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op: bool = True):
+    """result[i][j] = input[j][i] over the group axis (ragged-free)."""
+    axis = _group_axis(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        stacked = jnp.stack([jnp.asarray(t) for t in in_tensor_list], axis=0)
+    else:
+        stacked = jnp.asarray(in_tensor_list)
+    mesh, stacked = _stacked(stacked, axis)
+
+    def f(t):  # t: [1, n, ...] per rank — swap rank/slot dims globally
+        return lax.all_to_all(t, axis, split_axis=1, concat_axis=0, tiled=False)
+
+    n = mesh.shape[axis]
+    if stacked.shape[1] != n:
+        raise InvalidArgumentError(
+            f"alltoall needs [n, n, ...] stacked input, got {stacked.shape}"
+        )
+    out = shard_map(f, mesh=mesh, in_specs=P(axis), out_specs=P(axis))(stacked)
+    out = out.reshape(stacked.shape)
+    result = [out[i] for i in range(n)]
+    if out_tensor_list is not None:
+        out_tensor_list.extend(result)
+    return result
+
+
+def barrier(group=None):
+    """Block until all prior device work completes (XLA programs are
+    compiler-ordered; the host-visible barrier is block_until_ready)."""
+    axis = _group_axis(group)
+    mesh = get_mesh()
+    n = mesh.shape[axis]
+    token = jnp.zeros((n,), jnp.int32)
+    out = _all_reduce_impl(token, ReduceOp.SUM, axis)
+    jax.block_until_ready(out)

@@ -1,0 +1,113 @@
+"""Process/rank environment + rendezvous.
+
+Parity: python/paddle/distributed/parallel.py (init_parallel_env:46,
+ParallelEnv:62 in fluid/dygraph/parallel.py) and fleet launch env wiring
+(PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS, fleet/launch_utils.py).
+
+TPU-native: rendezvous is JAX's coordination service
+(``jax.distributed.initialize``) instead of NCCL-id-over-TCP
+(imperative/nccl_context.cc) or Gloo file/HTTP KV stores (role_maker.py:33).
+One process per *host* (driving all its local chips), not one per device —
+collectives ride ICI/DCN via XLA, so there is no per-GPU process model.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = [
+    "ParallelEnv",
+    "init_parallel_env",
+    "get_rank",
+    "get_world_size",
+    "is_initialized",
+]
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None):
+    """Initialize multi-host execution.
+
+    Single-host (the common TPU pod-slice dev loop and all tests): no-op
+    beyond marking the env initialized — every local device is already
+    visible.  Multi-host: wires ``jax.distributed.initialize`` from args or
+    the standard env vars (COORDINATOR_ADDRESS / PADDLE_TRAINER_ENDPOINTS,
+    PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ID — the launch-compatible names).
+    """
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+
+    addr = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if addr is None:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS")
+        if eps:
+            addr = eps.split(",")[0]
+    nproc = num_processes or int(os.environ.get("PADDLE_TRAINERS_NUM", "0") or 0)
+    pid = process_id if process_id is not None else int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+
+    if addr and nproc > 1:
+        jax.distributed.initialize(
+            coordinator_address=addr, num_processes=nproc, process_id=pid
+        )
+    _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def get_rank() -> int:
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    """Number of participating *devices* across all processes (paddle's
+    world_size counts trainers = GPUs; the TPU analogue is chips)."""
+    return jax.device_count()
+
+
+class ParallelEnv:
+    """Parity: paddle.distributed.ParallelEnv (fluid/dygraph/parallel.py:62)."""
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def world_size(self) -> int:
+        return jax.device_count()
+
+    @property
+    def local_rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def nranks(self) -> int:
+        return jax.device_count()
+
+    @property
+    def device_id(self) -> int:
+        devs = jax.local_devices()
+        return devs[0].id if devs else 0
+
+    @property
+    def local_devices(self):
+        return jax.local_devices()
+
+    @property
+    def current_endpoint(self) -> str:
+        eps = self.trainer_endpoints
+        i = jax.process_index()
+        return eps[i] if i < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []

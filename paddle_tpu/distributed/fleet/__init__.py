@@ -1,0 +1,142 @@
+"""paddle_tpu.distributed.fleet — the distributed-training control plane.
+
+Parity: python/paddle/distributed/fleet/ (Fleet singleton fleet_base.py:62,
+init:125, distributed_optimizer:554, minimize:946; meta-optimizer composition
+:995-1065).  Usage is the same four lines:
+
+    strategy = fleet.DistributedStrategy(sharding=True)
+    fleet.init(is_collective=True, strategy=strategy)
+    opt = fleet.distributed_optimizer(paddle_tpu.optimizer.Adam(...))
+    model = paddle_tpu.Model(net); model.prepare(opt, loss); model.fit(...)
+
+but where the reference's fleet rewrites the Program through meta-optimizers,
+``init`` here builds the hybrid device Mesh and ``distributed_optimizer``
+tags the optimizer with a ShardingPlan that Model.prepare lowers to
+pjit shardings (see plan.py).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ...framework.errors import InvalidArgumentError
+from .. import env as _env
+from ..mesh import build_mesh, get_mesh, set_mesh
+from .plan import ShardingPlan
+from .strategy import DistributedStrategy
+
+__all__ = [
+    "DistributedStrategy",
+    "ShardingPlan",
+    "init",
+    "distributed_optimizer",
+    "distributed_model",
+    "worker_num",
+    "worker_index",
+    "is_first_worker",
+    "barrier_worker",
+    "stop_worker",
+    "get_strategy",
+    "is_initialized",
+]
+
+_strategy: Optional[DistributedStrategy] = None
+_initialized = False
+
+
+def init(role_maker=None, is_collective: bool = True, strategy: Optional[DistributedStrategy] = None):
+    """Build the hybrid mesh from the strategy degrees and mark fleet active.
+
+    ``role_maker`` (the reference's Gloo rendezvous) is accepted for parity
+    and ignored — rank wiring comes from init_parallel_env / jax.distributed.
+    """
+    global _strategy, _initialized
+    if not is_collective:
+        raise InvalidArgumentError(
+            "parameter-server mode is not supported on TPU; capabilities are "
+            "covered by sharded arrays (see SURVEY §7 translation table)"
+        )
+    _env.init_parallel_env()
+    strategy = strategy or DistributedStrategy()
+    n = jax.device_count()
+    fixed = strategy.mp_degree * strategy.pp_degree * strategy.sep_degree
+    sharding_degree = strategy.sharding_degree
+    dp = strategy.dp_degree
+    if strategy.sharding and sharding_degree in (0, 1):
+        # span the devices an explicit dp_degree doesn't claim
+        sharding_degree = n // (fixed * (dp or 1))
+    if strategy.sharding and dp in (0, None):
+        dp = n // (fixed * sharding_degree)
+    mesh = build_mesh(
+        dp=dp or 0,
+        mp=strategy.mp_degree,
+        pp=strategy.pp_degree,
+        sep=strategy.sep_degree,
+        sharding=max(sharding_degree, 1),
+    )
+    set_mesh(mesh)
+    strategy.sharding_degree = max(sharding_degree, 1)
+    _strategy = strategy
+    _initialized = True
+    return mesh
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _strategy
+
+
+def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
+    """Tag an optimizer for distributed execution.  Model.prepare builds the
+    ShardingPlan from this tag (replaces meta-opt minimize orchestration,
+    fleet_base.py:946)."""
+    global _strategy
+    if strategy is not None:
+        _strategy = strategy
+    if not _initialized:
+        raise InvalidArgumentError("call fleet.init() before distributed_optimizer")
+    optimizer._fleet_strategy = _strategy or DistributedStrategy()
+    return optimizer
+
+
+def distributed_model(model):
+    """Place a Layer's parameters onto the mesh per the active strategy
+    (replicated + TP annotations).  Returns the same object (no wrapper —
+    SPMD needs no grad-hook machinery like dygraph DataParallel,
+    fluid/dygraph/parallel.py:335)."""
+    from ...hapi.model import Model as _HapiModel
+    from ...nn.layer_base import Layer
+
+    net = model.network if isinstance(model, _HapiModel) else model
+    if not isinstance(net, Layer):
+        raise InvalidArgumentError("distributed_model expects a Layer or Model")
+    plan = ShardingPlan(net, optimizer=None, strategy=_strategy, mesh=get_mesh())
+    plan.place_network()
+    return model
+
+
+def worker_num() -> int:
+    return jax.process_count()
+
+
+def worker_index() -> int:
+    return jax.process_index()
+
+
+def is_first_worker() -> bool:
+    return jax.process_index() == 0
+
+
+def barrier_worker():
+    from .. import collective
+
+    collective.barrier()
+
+
+def stop_worker():
+    """No persistent worker daemons exist (the reference tears down brpc/gloo
+    servers here)."""

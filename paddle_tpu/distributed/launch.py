@@ -1,0 +1,12 @@
+"""``python -m paddle_tpu.distributed.launch script.py [args...]``
+
+Parity: ``python -m paddle.distributed.launch`` (reference: fleet/launch.py).
+One process per HOST (not per device — SPMD drives all local chips); the pod
+runtime (or the operator) runs this command on every host with
+COORDINATOR_ADDRESS / PADDLE_TRAINER_* env wiring, and init_parallel_env
+joins the jax.distributed coordination service.
+"""
+from .parallel import launch
+
+if __name__ == "__main__":
+    raise SystemExit(launch())

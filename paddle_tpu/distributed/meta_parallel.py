@@ -1,0 +1,122 @@
+"""Tensor-parallel building blocks (megatron-style sharded layers).
+
+The reference at this version has NO tensor parallelism (verified in
+SURVEY §2.9: no megatron/model_parallel hits) — these are the new
+first-class capability required of the TPU framework.  Naming follows the
+later fleet.meta_parallel API so paddle users find what they expect:
+ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+ParallelCrossEntropy.
+
+SPMD design: a layer does NOT call collectives.  It annotates its
+parameters with a ``partition_spec`` over the ``model`` mesh axis and
+constrains its activation sharding; GSPMD inserts the all-gather /
+reduce-scatter exactly where the megatron forward would put explicit
+NCCL calls.  Column(out-sharded) → Row(in-sharded) pairs therefore fuse
+into one all-reduce at the row output, the classic 2-matmul MLP pattern.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..framework import random as _random
+from ..nn import initializer as I
+from ..nn.layer_base import Layer, Parameter, current_rng_key
+from .mesh import get_mesh
+
+__all__ = [
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "constrain",
+]
+
+
+def constrain(x, *spec):
+    """Apply a sharding constraint when tracing (no-op eagerly)."""
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(get_mesh(), P(*spec)))
+    return x
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with the OUTPUT features sharded over the ``model`` axis.
+
+    weight [in, out∥model]; bias [out∥model].  ``gather_output=True``
+    replicates the result (ends the TP region)."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, gather_output: bool = True, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.partition_spec = (None, "model")
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            self.bias.partition_spec = ("model",)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        y = jnp.matmul(jnp.asarray(x), jnp.asarray(self.weight))
+        if self.bias is not None:
+            y = y + jnp.asarray(self.bias)
+        if self.gather_output:
+            y = constrain(y, *([None] * y.ndim))
+        else:
+            y = constrain(y, *([None] * (y.ndim - 1) + ["model"]))
+        return y
+
+
+class RowParallelLinear(Layer):
+    """Linear with the INPUT features sharded over ``model``.
+
+    weight [in∥model, out]; bias [out] (replicated, added once).  Feeding it
+    a ColumnParallelLinear(gather_output=False) output keeps the hidden
+    activations sharded end-to-end; the sum over the sharded contraction
+    becomes the single all-reduce of the megatron MLP."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, input_is_parallel: bool = False, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.partition_spec = ("model", None)
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        x = jnp.asarray(x)
+        if self.input_is_parallel:
+            x = constrain(x, *([None] * (x.ndim - 1) + ["model"]))
+        y = jnp.matmul(x, jnp.asarray(self.weight))
+        y = constrain(y, *([None] * y.ndim))
+        if self.bias is not None:
+            y = y + jnp.asarray(self.bias)
+        return y
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocabulary dim sharded over ``model``."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 weight_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=I.Normal(std=0.02))
+        self.weight.partition_spec = ("model", None)
+
+    def forward(self, ids):
+        # gather from a vocab-sharded table: GSPMD partitions the take along
+        # the sharded dim and all-reduces the partial lookups
+        out = jnp.take(jnp.asarray(self.weight), jnp.asarray(ids), axis=0)
+        return constrain(out, *([None] * out.ndim))

@@ -1,0 +1,96 @@
+"""DataParallel wrapper + spawn/launch helpers.
+
+Parity: paddle.DataParallel (fluid/dygraph/parallel.py:335 — grad coalescing
++ allreduce hooks) and paddle.distributed.spawn/launch.
+
+Under SPMD none of the reference's machinery (coalesced grad buffers
+:229-284, imperative allreduce, nccl bootstrap) exists as user-visible
+moving parts: wrapping a Layer just replicates its parameters over the mesh
+and records that batches should be split over the data axes.  The hapi
+Model / fleet path does this automatically; DataParallel exists for users
+who write their own step functions.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+import jax
+
+from ..framework.errors import InvalidArgumentError
+from ..nn.layer_base import Layer
+from . import env as _env
+from .mesh import get_mesh
+
+__all__ = ["DataParallel", "spawn", "launch"]
+
+
+class DataParallel(Layer):
+    """Replicate a Layer across the mesh; forward = inner forward.
+
+    ``scale_loss``/``apply_collective_grads`` are kept as no-ops for source
+    compatibility with reference training loops (gradient averaging falls
+    out of psum/mean in the SPMD step).
+    """
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size_MB: int = 25,
+                 last_comm_buffer_size_MB: int = 1, find_unused_parameters: bool = False):
+        super().__init__()
+        self._layers = layers
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = get_mesh()
+        repl = NamedSharding(mesh, P())
+        for _, p in layers.named_parameters():
+            p.value = jax.device_put(p.value, repl)
+        for _, b in layers.named_buffers():
+            b.value = jax.device_put(b.value, repl)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+
+def spawn(func, args=(), nprocs: Optional[int] = None, join: bool = True, **kwargs):
+    """Parity: paddle.distributed.spawn.  On TPU the unit of spawning is a
+    *host process driving all local chips* — inside one host there is nothing
+    to spawn (SPMD covers the local devices), so this runs ``func`` once.
+    Multi-host pods launch one process per host externally (see launch)."""
+    if nprocs not in (None, 1) and jax.process_count() == 1:
+        raise InvalidArgumentError(
+            "spawn(nprocs>1) maps to multi-host launch on TPU: one process "
+            "drives all local chips (SPMD), so per-device process spawning "
+            "does not exist.  Use paddle_tpu.distributed.launch across hosts."
+        )
+    _env.init_parallel_env()
+    func(*args)
+
+
+def launch(argv=None):
+    """Minimal `python -m paddle_tpu.distributed.launch script.py` analogue
+    (reference: fleet/launch.py:183).  Sets the env vars init_parallel_env
+    reads and execs the training script in-process (one process per host —
+    the pod runtime starts this command on every host)."""
+    import runpy
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print("usage: python -m paddle_tpu.distributed.launch script.py [args...]")
+        return 1
+    script, *rest = argv
+    sys.argv = [script] + rest
+    _env.init_parallel_env()
+    runpy.run_path(script, run_name="__main__")
+    return 0

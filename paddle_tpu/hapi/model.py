@@ -61,6 +61,7 @@ class Model:
         self._eval_step = None
         self._predict_step = None
         self._opt_state = None
+        self._plan = None
         self.stop_training = False
         self._save_dir = None
 
@@ -112,10 +113,23 @@ class Model:
                                   training=False)
             return out
 
+        # fleet path: distributed_optimizer tagged the optimizer — lower the
+        # strategy to mesh shardings (replaces meta-opt minimize, SURVEY §3.4)
+        self._plan = None
+        strategy = getattr(optimizer, "_fleet_strategy", None)
+        if strategy is not None:
+            from ..distributed.fleet.plan import ShardingPlan
+
+            self._plan = ShardingPlan(net, optimizer, strategy)
+            self._plan.place_network()
+
         if optimizer is not None:
-            # donate old params/opt_state/buffers: the update happens in-place
-            # in device memory (reference analogue: buffer reuse passes)
-            self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+            if self._plan is not None:
+                self._train_step = self._plan.jit_train_step(train_step)
+            else:
+                # donate old params/opt_state/buffers: the update happens
+                # in-place in device memory
+                self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
         self._eval_step = jax.jit(eval_step)
         self._predict_step = jax.jit(predict_step)
         self._opt_state = None
@@ -143,7 +157,15 @@ class Model:
 
     def _ensure_opt_state(self, params):
         if self._opt_state is None:
-            self._opt_state = self._optimizer.init(params)
+            if self._plan is not None:
+                # init under jit with sharded outputs: ZeRO slots are born
+                # sharded — the full replicated state never materializes
+                self._opt_state = jax.jit(
+                    self._optimizer.init,
+                    out_shardings=self._plan.opt_state_shardings(params),
+                )(params)
+            else:
+                self._opt_state = self._optimizer.init(params)
 
     # -- batch-level API -----------------------------------------------------
     def train_batch(self, inputs, labels=None):
@@ -158,7 +180,10 @@ class Model:
         if self._train_step is None:
             raise InvalidArgumentError("call prepare(optimizer=..., loss=...) first")
         batch = tuple(_tuplize(inputs)) + tuple(_tuplize(labels) if labels is not None else ())
-        batch = tuple(jnp.asarray(b) for b in batch)
+        if self._plan is not None:
+            batch = self._plan.shard_batch(batch)
+        else:
+            batch = tuple(jnp.asarray(b) for b in batch)
         params, buffers = self._pull_state()
         self._ensure_opt_state(params)
         key = _random.default_generator().next_key()
@@ -171,7 +196,10 @@ class Model:
 
     def eval_batch(self, inputs, labels=None):
         batch = tuple(_tuplize(inputs)) + tuple(_tuplize(labels) if labels is not None else ())
-        batch = tuple(jnp.asarray(b) for b in batch)
+        if self._plan is not None:
+            batch = self._plan.shard_batch(batch)
+        else:
+            batch = tuple(jnp.asarray(b) for b in batch)
         params, buffers = self._pull_state()
         loss_val, out = self._eval_step(params, buffers, *batch)
         _, labels_part = self._split_batch(batch)
@@ -179,7 +207,10 @@ class Model:
         return float(loss_val), metrics
 
     def predict_batch(self, inputs):
-        inputs = tuple(jnp.asarray(b) for b in _tuplize(inputs))
+        if self._plan is not None:
+            inputs = self._plan.shard_batch(tuple(_tuplize(inputs)))
+        else:
+            inputs = tuple(jnp.asarray(b) for b in _tuplize(inputs))
         params, buffers = self._pull_state()
         return self._predict_step(params, buffers, *inputs)
 
@@ -207,10 +238,13 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        if self._plan is not None and not drop_last:
+            # a partial final batch can't split across the data shards
+            drop_last = True
         train_loader = self._as_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
-        eval_loader = self._as_loader(eval_data, batch_size, False, False,
-                                      num_workers)
+        eval_loader = self._as_loader(eval_data, batch_size, False,
+                                      self._plan is not None, num_workers)
         if epochs > 1 and hasattr(train_loader, "__next__"):
             raise InvalidArgumentError(
                 "train_data is a one-shot iterator but epochs > 1: epochs "

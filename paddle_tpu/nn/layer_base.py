@@ -42,14 +42,20 @@ __all__ = [
 
 
 class Parameter:
-    """Trainable tensor box. ``trainable=False`` ≙ paddle's stop_gradient."""
+    """Trainable tensor box. ``trainable=False`` ≙ paddle's stop_gradient.
 
-    __slots__ = ("value", "name", "trainable")
+    ``partition_spec`` (tuple of mesh axis names / None per dim, or None for
+    replicated) is the tensor-parallel placement annotation consumed by
+    distributed.fleet.ShardingPlan."""
 
-    def __init__(self, value, name: str = "", trainable: bool = True):
+    __slots__ = ("value", "name", "trainable", "partition_spec")
+
+    def __init__(self, value, name: str = "", trainable: bool = True,
+                 partition_spec=None):
         self.value = jnp.asarray(value)
         self.name = name
         self.trainable = trainable
+        self.partition_spec = partition_spec
 
     # jnp.asarray(param) → the underlying array; makes params usable in ops.
     def __jax_array__(self):
