@@ -65,6 +65,7 @@ from . import io  # noqa: F401
 from . import distributed  # noqa: F401
 from .distributed import DataParallel  # noqa: F401
 from . import metric  # noqa: F401
+from . import models  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .hapi import callbacks  # noqa: F401

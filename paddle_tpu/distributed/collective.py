@@ -102,15 +102,19 @@ def _stacked(tensor, axis: str):
     return mesh, tensor
 
 
-@functools.partial(jax.jit, static_argnames=("op", "axis"))
-def _all_reduce_impl(tensor, op, axis):
-    mesh = get_mesh()
+@functools.partial(jax.jit, static_argnames=("op", "axis", "mesh"))
+def _all_reduce_jit(tensor, op, axis, mesh):
     reducer = _REDUCERS[op]
 
     def f(t):  # t: [1, ...] per rank
         return reducer(t, axis)
 
     return shard_map(f, mesh=mesh, in_specs=P(axis), out_specs=P(axis))(tensor)
+
+
+def _all_reduce_impl(tensor, op, axis):
+    # the mesh is a static jit key: set_mesh() must never hit a stale cache
+    return _all_reduce_jit(tensor, op, axis, get_mesh())
 
 
 def all_reduce(tensor, op: str = ReduceOp.SUM, group=None, sync_op: bool = True):

@@ -45,7 +45,8 @@ _strategy: Optional[DistributedStrategy] = None
 _initialized = False
 
 
-def init(role_maker=None, is_collective: bool = True, strategy: Optional[DistributedStrategy] = None):
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None, devices=None):
     """Build the hybrid mesh from the strategy degrees and mark fleet active.
 
     ``role_maker`` (the reference's Gloo rendezvous) is accepted for parity
@@ -59,13 +60,19 @@ def init(role_maker=None, is_collective: bool = True, strategy: Optional[Distrib
         )
     _env.init_parallel_env()
     strategy = strategy or DistributedStrategy()
-    n = jax.device_count()
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
     fixed = strategy.mp_degree * strategy.pp_degree * strategy.sep_degree
     sharding_degree = strategy.sharding_degree
     dp = strategy.dp_degree
     if strategy.sharding and sharding_degree in (0, 1):
         # span the devices an explicit dp_degree doesn't claim
         sharding_degree = n // (fixed * (dp or 1))
+        if sharding_degree < 1:
+            raise InvalidArgumentError(
+                f"mp*pp*sep*dp degrees ({fixed * (dp or 1)}) exceed the "
+                f"device count {n}; no devices left for the sharding axis"
+            )
     if strategy.sharding and dp in (0, None):
         dp = n // (fixed * sharding_degree)
     mesh = build_mesh(
@@ -74,6 +81,7 @@ def init(role_maker=None, is_collective: bool = True, strategy: Optional[Distrib
         pp=strategy.pp_degree,
         sep=strategy.sep_degree,
         sharding=max(sharding_degree, 1),
+        devices=devices,
     )
     set_mesh(mesh)
     strategy.sharding_degree = max(sharding_degree, 1)
@@ -95,10 +103,10 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
     ShardingPlan from this tag (replaces meta-opt minimize orchestration,
     fleet_base.py:946)."""
     global _strategy
-    if strategy is not None:
-        _strategy = strategy
     if not _initialized:
         raise InvalidArgumentError("call fleet.init() before distributed_optimizer")
+    if strategy is not None:
+        _strategy = strategy
     optimizer._fleet_strategy = _strategy or DistributedStrategy()
     return optimizer
 

@@ -230,6 +230,9 @@ class Model:
         if data is None or hasattr(data, "__next__") or isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset):
+            if self._plan is not None:
+                # a partial final batch can't split across the data shards
+                drop_last = True
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
                               drop_last=drop_last, num_workers=num_workers,
                               return_numpy=True)
@@ -238,13 +241,10 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None):
-        if self._plan is not None and not drop_last:
-            # a partial final batch can't split across the data shards
-            drop_last = True
         train_loader = self._as_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
-        eval_loader = self._as_loader(eval_data, batch_size, False,
-                                      self._plan is not None, num_workers)
+        eval_loader = self._as_loader(eval_data, batch_size, False, False,
+                                      num_workers)
         if epochs > 1 and hasattr(train_loader, "__next__"):
             raise InvalidArgumentError(
                 "train_data is a one-shot iterator but epochs > 1: epochs "
@@ -312,6 +312,13 @@ class Model:
             total_loss += loss_val
             n_batches += 1
             cbks.on_eval_batch_end(step, {"loss": loss_val})
+        if n_batches == 0:
+            import warnings
+
+            warnings.warn(
+                "evaluate() saw zero batches (dataset smaller than one "
+                "data-parallel batch?) — metrics are meaningless",
+                RuntimeWarning)
         logs = {"loss": total_loss / max(n_batches, 1)}
         for m in self._metrics:
             for name, val in zip(_tuplize(m.name()), _tuplize(m.accumulate())):

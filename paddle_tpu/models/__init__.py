@@ -1,0 +1,20 @@
+"""paddle_tpu.models — flagship model families.
+
+Transformer LMs (GPT decoder, BERT encoder) are tensor-parallel-ready via
+meta_parallel layers; vision models live in paddle_tpu.vision.models.
+"""
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTModel,
+    GPTForCausalLM,
+    gpt_tiny,
+    gpt_small,
+)
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertModel,
+    BertForPretraining,
+    BertForSequenceClassification,
+    bert_base,
+    bert_tiny,
+)

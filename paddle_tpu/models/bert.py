@@ -1,0 +1,194 @@
+"""BERT — bidirectional transformer encoder, tensor-parallel-ready.
+
+Reference workload parity: the reference ships transformer encoder layers
+(python/paddle/nn/layer/transformer.py TransformerEncoder) and BERT-class
+training is the BASELINE.json north-star benchmark (BERT-base seq/sec/chip).
+Reuses the GPT parallel blocks (same megatron column/row sharding) with a
+bidirectional mask and BERT's token-type embeddings + pooler + MLM/NSP heads.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..distributed.meta_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    constrain,
+)
+from ..nn import initializer as I
+from ..nn.layer_base import Layer
+from .gpt import GPTConfig, ParallelMLP
+
+__all__ = [
+    "BertConfig",
+    "BertModel",
+    "BertForPretraining",
+    "BertForSequenceClassification",
+    "bert_base",
+    "bert_tiny",
+]
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072, max_position=512,
+                 type_vocab_size=2, dropout=0.1, layer_norm_epsilon=1e-12,
+                 dtype="float32"):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position = max_position
+        self.type_vocab_size = type_vocab_size
+        self.dropout = dropout
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.dtype = dtype
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def bert_tiny(**kw):
+    cfg = dict(vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+               intermediate_size=64, max_position=64, dropout=0.0)
+    cfg.update(kw)
+    return BertConfig(**cfg)
+
+
+class BertSelfAttention(Layer):
+    """Bidirectional multi-head attention, model-axis-sharded heads."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        d, h = cfg.hidden_size, cfg.num_heads
+        self.num_heads = h
+        self.head_dim = d // h
+        self.qkv = ColumnParallelLinear(d, 3 * d, gather_output=False)
+        self.out = RowParallelLinear(d, d, input_is_parallel=True)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x, attn_mask=None):
+        B, S, D = x.shape
+        qkv = self.qkv(x).reshape(B, S, 3, self.num_heads, self.head_dim)
+        qkv = constrain(qkv, None, None, None, "model", None)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(self.head_dim)
+        if attn_mask is not None:
+            scores = scores + attn_mask
+        probs = self.drop(jax.nn.softmax(scores, axis=-1))
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, D)
+        ctx = constrain(ctx, None, None, "model")
+        return self.out(ctx)
+
+
+class BertLayer(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        gcfg = GPTConfig(hidden_size=cfg.hidden_size,
+                         intermediate_size=cfg.intermediate_size,
+                         dropout=cfg.dropout)
+        self.attn = BertSelfAttention(cfg)
+        self.ln1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.mlp = ParallelMLP(gcfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x, attn_mask=None):
+        # post-LN (original BERT): LN(x + sublayer(x))
+        x = self.ln1(x + self.drop(self.attn(x, attn_mask)))
+        x = self.ln2(x + self.mlp(x))
+        return x
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        attr = nn.ParamAttr(initializer=I.Normal(std=0.02))
+        self.word = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        self.position = nn.Embedding(cfg.max_position, cfg.hidden_size, weight_attr=attr)
+        self.token_type = nn.Embedding(cfg.type_vocab_size, cfg.hidden_size, weight_attr=attr)
+        self.ln = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, input_ids, token_type_ids=None):
+        B, S = input_ids.shape
+        pos = jnp.arange(S)[None, :]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = self.word(input_ids) + self.position(pos) + self.token_type(token_type_ids)
+        return self.drop(self.ln(x))
+
+
+class BertModel(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.layers = nn.LayerList([BertLayer(cfg) for _ in range(cfg.num_layers)])
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.pooler_act = nn.Tanh()
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        """attention_mask: [B, S] with 1 = attend, 0 = pad."""
+        mask = None
+        if attention_mask is not None:
+            mask = (1.0 - jnp.asarray(attention_mask, jnp.float32)) * -1e9
+            mask = mask[:, None, None, :]  # [B,1,1,S] additive
+        x = self.embeddings(input_ids, token_type_ids)
+        for layer in self.layers:
+            x = layer(x, mask)
+        pooled = self.pooler_act(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForPretraining(Layer):
+    """MLM (tied decoder) + NSP heads."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.act = nn.GELU()
+        self.ln = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.nsp = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.ln(self.act(self.transform(seq)))
+        mlm_logits = jnp.einsum(
+            "bsd,vd->bsv", h, jnp.asarray(self.bert.embeddings.word.weight))
+        return constrain(mlm_logits, None, None, None), self.nsp(pooled)
+
+    def loss(self, mlm_logits, nsp_logits, mlm_labels, nsp_labels,
+             ignore_index: int = -100):
+        logp = jax.nn.log_softmax(mlm_logits, axis=-1)
+        labels = jnp.asarray(mlm_labels)
+        safe = jnp.where(labels == ignore_index, 0, labels)
+        ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        mask = (labels != ignore_index).astype(logp.dtype)
+        mlm_loss = -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        nsp_logp = jax.nn.log_softmax(nsp_logits, axis=-1)
+        nsp_loss = -jnp.take_along_axis(
+            nsp_logp, jnp.asarray(nsp_labels).reshape(-1, 1), axis=-1).mean()
+        return mlm_loss + nsp_loss
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, cfg: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.drop(pooled))

@@ -1,0 +1,166 @@
+"""GPT — decoder-only transformer LM, tensor-parallel-ready.
+
+The reference has no GPT (its transformer surface is the seq2seq
+paddle.nn.Transformer, python/paddle/nn/layer/transformer.py); a decoder LM
+is the flagship workload for the TPU framework's distributed story
+(BASELINE.json north star: BERT-class encoder + LM training throughput).
+
+Every projection is a meta_parallel layer: on a mesh with ``model`` axis
+size 1 they degenerate to plain Linears (zero overhead single-chip); with
+mp>1 the weights shard megatron-style and GSPMD inserts the two
+all-reduces per block.  Heads are split along the ``model`` axis, so
+attention runs fully sharded between the column (qkv) and row (out)
+projections.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..distributed.meta_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    constrain,
+)
+from ..nn import initializer as I
+from ..nn.layer_base import Layer
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny", "gpt_small"]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=None, max_position=1024,
+                 dropout=0.1, layer_norm_epsilon=1e-5, dtype="float32"):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_position = max_position
+        self.dropout = dropout
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.dtype = dtype
+
+
+def gpt_tiny(**kw):
+    cfg = dict(vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+               max_position=64, dropout=0.0)
+    cfg.update(kw)
+    return GPTConfig(**cfg)
+
+
+def gpt_small(**kw):
+    return GPTConfig(**kw)
+
+
+class ParallelAttention(Layer):
+    """Causal (or masked) multi-head self-attention with model-sharded heads."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        d, h = cfg.hidden_size, cfg.num_heads
+        if d % h:
+            raise ValueError(f"hidden {d} % heads {h} != 0")
+        self.num_heads = h
+        self.head_dim = d // h
+        self.qkv = ColumnParallelLinear(d, 3 * d, gather_output=False)
+        self.out = RowParallelLinear(d, d, input_is_parallel=True)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x, attn_mask=None):
+        B, S, D = x.shape
+        qkv = self.qkv(x)  # [B,S,3D] sharded on last dim
+        qkv = qkv.reshape(B, S, 3, self.num_heads, self.head_dim)
+        # heads inherit the model sharding of the projection output
+        qkv = constrain(qkv, None, None, None, "model", None)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,hd]
+        q = q.transpose(0, 2, 1, 3)  # [B,H,S,hd]
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(self.head_dim)
+        causal = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
+        if attn_mask is not None:
+            scores = scores + attn_mask
+        probs = jax.nn.softmax(scores, axis=-1)
+        probs = self.drop(probs)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, D)
+        ctx = constrain(ctx, None, None, "model")
+        return self.out(ctx)
+
+
+class ParallelMLP(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.fc1 = ColumnParallelLinear(cfg.hidden_size, cfg.intermediate_size,
+                                        gather_output=False)
+        self.fc2 = RowParallelLinear(cfg.intermediate_size, cfg.hidden_size,
+                                     input_is_parallel=True)
+        self.act = nn.GELU()
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        return self.drop(self.fc2(self.act(self.fc1(x))))
+
+
+class GPTBlock(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.attn = ParallelAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.mlp = ParallelMLP(cfg)
+
+    def forward(self, x, attn_mask=None):
+        x = x + self.attn(self.ln1(x), attn_mask)
+        x = x + self.mlp(self.ln2(x))
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_position, cfg.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=I.Normal(std=0.02)))
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+
+    def forward(self, input_ids, attn_mask=None):
+        B, S = input_ids.shape
+        pos = jnp.arange(S)[None, :]
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        for blk in self.blocks:
+            x = blk(x, attn_mask)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    """LM head ties the (vocab-sharded) input embedding."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(cfg)
+
+    def forward(self, input_ids, attn_mask=None):
+        h = self.gpt(input_ids, attn_mask)  # [B,S,D]
+        logits = jnp.einsum("bsd,vd->bsv", h, jnp.asarray(self.gpt.wte.weight))
+        return constrain(logits, None, None, None)
+
+    def loss(self, logits, labels):
+        """Shifted next-token cross entropy (labels = input_ids)."""
+        logits = logits[:, :-1]
+        labels = jnp.asarray(labels)[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return -ll.mean()

@@ -359,3 +359,50 @@ class TestReviewRegressions:
         import importlib
         mod = importlib.import_module("paddle_tpu.distributed.launch")
         assert hasattr(mod, "launch")
+
+    def test_evaluate_predict_drop_partial_under_plan(self):
+        fleet.init(is_collective=True, strategy=fleet.DistributedStrategy())
+        rng = np.random.RandomState(0)
+        X, y = _make_data(rng, n=100)
+        opt = fleet.distributed_optimizer(popt.SGD(learning_rate=0.01))
+        model = paddle.Model(MLP())
+        model.prepare(optimizer=opt, loss=nn.CrossEntropyLoss(),
+                      metrics=[pmetric.Accuracy()])
+        ds = pio.TensorDataset([X, y.reshape(-1, 1)])
+        model.evaluate(ds, batch_size=64, verbose=0)   # partial batch dropped
+        model.predict(pio.TensorDataset([X]), batch_size=64)
+
+    def test_evaluate_zero_batches_warns(self):
+        fleet.init(is_collective=True, strategy=fleet.DistributedStrategy())
+        rng = np.random.RandomState(0)
+        X, y = _make_data(rng, n=16)  # < one 64-batch
+        opt = fleet.distributed_optimizer(popt.SGD(learning_rate=0.01))
+        model = paddle.Model(MLP())
+        model.prepare(optimizer=opt, loss=nn.CrossEntropyLoss())
+        with pytest.warns(RuntimeWarning, match="zero batches"):
+            model.evaluate(pio.TensorDataset([X, y.reshape(-1, 1)]),
+                           batch_size=64, verbose=0)
+
+    def test_all_reduce_follows_mesh_change(self):
+        """jit cache must key on the mesh: same shapes, different mesh."""
+        set_mesh(build_mesh(devices=jax.devices()[:4]))
+        x4 = jnp.ones((4, 1))
+        out4 = dist.all_reduce(x4)
+        np.testing.assert_allclose(np.asarray(out4), 4.0)
+        set_mesh(build_mesh(devices=jax.devices()[4:]))
+        out4b = dist.all_reduce(x4)
+        np.testing.assert_allclose(np.asarray(out4b), 4.0)
+        assert {d.id for d in out4b.devices()} == {d.id for d in jax.devices()[4:]}
+
+    def test_oversubscribed_sharding_clear_error(self):
+        with pytest.raises(Exception, match="exceed"):
+            fleet.init(is_collective=True,
+                       strategy=fleet.DistributedStrategy(sharding=True, mp_degree=16))
+
+    def test_failed_distributed_optimizer_keeps_no_strategy(self):
+        fleet._initialized = False
+        fleet._strategy = None
+        with pytest.raises(Exception):
+            fleet.distributed_optimizer(
+                popt.SGD(), strategy=fleet.DistributedStrategy(sharding=True))
+        assert fleet.get_strategy() is None

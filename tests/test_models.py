@@ -1,0 +1,172 @@
+"""Flagship model tests (GPT decoder, BERT encoder): shapes, causality,
+masking, loss semantics, tiny-scale convergence, TP-sharded training parity
+(mirrors the reference's dist_transformer.py model-level tests)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as popt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.mesh import build_mesh, set_mesh
+from paddle_tpu.models import (
+    BertForPretraining,
+    BertForSequenceClassification,
+    GPTForCausalLM,
+    bert_tiny,
+    gpt_tiny,
+)
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    set_mesh(build_mesh())
+    yield
+    set_mesh(build_mesh())
+    fleet._initialized = False
+
+
+class TestGPT:
+    def test_forward_shapes(self):
+        paddle.seed(0)
+        net = GPTForCausalLM(gpt_tiny())
+        ids = jnp.asarray(np.random.randint(0, 128, (2, 10)), jnp.int32)
+        logits = net(ids)
+        assert logits.shape == (2, 10, 128)
+
+    def test_causality(self):
+        """Changing a future token must not affect earlier logits."""
+        paddle.seed(0)
+        net = GPTForCausalLM(gpt_tiny())
+        net.eval()
+        rng = np.random.RandomState(0)
+        ids_a = rng.randint(0, 128, (1, 12)).astype(np.int32)
+        ids_b = ids_a.copy()
+        ids_b[0, -1] = (ids_b[0, -1] + 1) % 128
+        la = np.asarray(net(jnp.asarray(ids_a)))
+        lb = np.asarray(net(jnp.asarray(ids_b)))
+        np.testing.assert_allclose(la[0, :-1], lb[0, :-1], atol=1e-5)
+        assert not np.allclose(la[0, -1], lb[0, -1])
+
+    def test_loss_decreases(self):
+        paddle.seed(0)
+        cfg = gpt_tiny(num_layers=1, hidden_size=16, num_heads=2)
+        net = GPTForCausalLM(cfg)
+        # repetitive sequence is learnable
+        ids = np.tile(np.arange(8, dtype=np.int32), (4, 2))
+        model = paddle.Model(net)
+        model.prepare(optimizer=popt.Adam(learning_rate=1e-2), loss=net.loss)
+        l0, _ = model.train_batch([ids], [ids])
+        for _ in range(60):
+            l1, _ = model.train_batch([ids], [ids])
+        assert l1 < l0 * 0.5, (l0, l1)
+
+    def test_tied_lm_head(self):
+        net = GPTForCausalLM(gpt_tiny())
+        names = [n for n, _ in net.named_parameters()]
+        assert not any("lm_head" in n for n in names)  # tied to wte
+
+
+class TestBert:
+    def test_forward_shapes(self):
+        paddle.seed(0)
+        net = BertForPretraining(bert_tiny())
+        ids = jnp.asarray(np.random.randint(0, 128, (2, 12)), jnp.int32)
+        mlm, nsp = net(ids)
+        assert mlm.shape == (2, 12, 128)
+        assert nsp.shape == (2, 2)
+
+    def test_attention_mask_blocks_pad(self):
+        """Masked (pad) positions must not influence unmasked outputs."""
+        paddle.seed(0)
+        net = BertForSequenceClassification(bert_tiny(), num_classes=3)
+        net.eval()
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 128, (1, 10)).astype(np.int32)
+        mask = np.ones((1, 10), np.float32)
+        mask[0, 7:] = 0.0
+        out_a = np.asarray(net(jnp.asarray(ids), attention_mask=jnp.asarray(mask)))
+        ids2 = ids.copy()
+        ids2[0, 8] = (ids2[0, 8] + 3) % 128  # change a padded token
+        out_b = np.asarray(net(jnp.asarray(ids2), attention_mask=jnp.asarray(mask)))
+        np.testing.assert_allclose(out_a, out_b, atol=1e-5)
+
+    def test_mlm_loss_ignores_unmasked(self):
+        paddle.seed(0)
+        net = BertForPretraining(bert_tiny())
+        ids = jnp.asarray(np.random.randint(0, 128, (2, 8)), jnp.int32)
+        mlm, nsp = net(ids)
+        labels_none = np.full((2, 8), -100, np.int64)
+        labels_none[0, 2] = 5
+        nsp_labels = np.zeros((2, 1), np.int64)
+        l1 = float(net.loss(mlm, nsp, jnp.asarray(labels_none), jnp.asarray(nsp_labels)))
+        assert np.isfinite(l1)
+        # all-ignored MLM → only NSP contributes
+        all_ignored = np.full((2, 8), -100, np.int64)
+        l2 = float(net.loss(mlm, nsp, jnp.asarray(all_ignored), jnp.asarray(nsp_labels)))
+        assert l2 < l1 + 10  # finite, no nan from 0/0
+
+    def test_classification_trains(self):
+        paddle.seed(0)
+        net = BertForSequenceClassification(bert_tiny(num_layers=1), num_classes=2)
+        rng = np.random.RandomState(0)
+        # class = token[0] parity
+        ids = rng.randint(0, 128, (32, 8)).astype(np.int32)
+        y = (ids[:, 0] % 2).astype(np.int64).reshape(-1, 1)
+        model = paddle.Model(net)
+        model.prepare(optimizer=popt.Adam(learning_rate=1e-3),
+                      loss=nn.CrossEntropyLoss())
+        l0, _ = model.train_batch([ids], [y])
+        for _ in range(80):
+            l1, _ = model.train_batch([ids], [y])
+        assert l1 < l0, (l0, l1)
+
+
+class TestTPParity:
+    def test_gpt_tp_matches_single(self):
+        """TP=2 forward must equal the single-device forward with the same
+        weights (megatron sharding is mathematically transparent)."""
+        paddle.seed(0)
+        net = GPTForCausalLM(gpt_tiny())
+        net.eval()
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (2, 8)), jnp.int32)
+        ref = np.asarray(net(ids))
+
+        strat = fleet.DistributedStrategy(
+            tensor_parallel=True,
+            tensor_parallel_configs={"tensor_parallel_degree": 2})
+        fleet.init(is_collective=True, strategy=strat)
+        fleet.distributed_model(net)
+        assert not net.gpt.blocks[0].attn.qkv.weight.value.sharding.is_fully_replicated
+
+        @jax.jit
+        def fwd(ids):
+            return net(ids)
+
+        out = np.asarray(fwd(ids))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+class TestGraftEntry:
+    def test_dryrun_multichip_8(self):
+        import __graft_entry__ as g
+
+        g.dryrun_multichip(8)
+
+    def test_entry_compiles_tiny_proxy(self):
+        """entry() builds BERT-base (heavy); validate the same path at tiny
+        scale + check entry()'s structure lazily."""
+        import __graft_entry__ as g
+
+        fn_args = None  # full entry() exercised by the driver on TPU
+        net = BertForSequenceClassification(bert_tiny(), num_classes=2)
+        net.eval()
+        params = net.param_pytree()
+
+        def fn(params, ids):
+            return nn.functional_call(net, params, ids, training=False)
+
+        ids = jnp.asarray(np.random.randint(0, 128, (2, 16)), jnp.int32)
+        out = jax.jit(fn)(params, ids)
+        assert out.shape == (2, 2)
