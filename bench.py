@@ -2,9 +2,9 @@
 
 North star (BASELINE.json): BERT-base seq/sec/chip ≥ 0.9× the stock CUDA
 build on A100.  The reference publishes no in-tree numbers (BASELINE.md);
-``A100_REF_SEQ_PER_SEC`` is the public NVIDIA DeepLearningExamples BERT-base
-(seq 128, mixed precision, single A100) training throughput commonly cited
-(~230 seq/s) — vs_baseline is measured/230.
+``A100_REF_SEQ_PER_SEC`` (~1100 seq/s) stands in for the public NVIDIA
+DeepLearningExamples BERT-base phase-1 (seq 128, AMP, 1×A100) pretraining
+throughput — vs_baseline is measured/1100.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """

@@ -1,0 +1,14 @@
+"""paddle_tpu.amp — automatic mixed precision (paddle.amp parity).
+
+bf16-first: on TPU the MXU computes natively in bfloat16, which shares
+f32's exponent range — ``auto_cast`` alone is the whole story and
+GradScaler is only needed for fp16-parity workloads.
+"""
+from .auto_cast import (  # noqa: F401
+    auto_cast,
+    amp_guard,
+    decorate,
+    WHITE_CLASSES,
+    BLACK_CLASSES,
+)
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401

@@ -1,0 +1,167 @@
+"""Automatic mixed precision — autocast policy.
+
+Parity: paddle.amp.auto_cast (reference: python/paddle/fluid/dygraph/amp/
+auto_cast.py:90 amp_guard; per-op white/black lists
+contrib/mixed_precision/fp16_lists.py; C++ cast insertion
+imperative/amp_auto_cast.cc).
+
+TPU-native: the mixed-precision dtype is **bfloat16** (same exponent range
+as f32 — no loss scaling needed; fp16 is supported for parity and needs
+GradScaler).  The reference inserts cast ops around each kernel by op name;
+here the policy acts at the Layer boundary: inside ``auto_cast()``,
+white-list layers (matmul/conv compute that the MXU runs natively in bf16)
+cast their floating inputs down, black-list layers (normalizations, losses,
+softmax — numerically f32-sensitive) cast them up, everything else runs in
+whatever dtype arrives.  XLA fuses the casts into neighbors, so the policy
+costs nothing at runtime.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Set
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.errors import InvalidArgumentError
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_state",
+           "cast_layer_call", "WHITE_CLASSES", "BLACK_CLASSES"]
+
+# Layer-class names, mirroring fp16_lists.py op groupings
+WHITE_CLASSES: Set[str] = {
+    "Linear", "Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose",
+    "Conv2DTranspose", "Conv3DTranspose", "ColumnParallelLinear",
+    "RowParallelLinear", "MultiHeadAttention", "ParallelAttention",
+    "BertSelfAttention",
+}
+BLACK_CLASSES: Set[str] = {
+    "BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D", "SyncBatchNorm",
+    "LayerNorm", "GroupNorm", "InstanceNorm1D", "InstanceNorm2D",
+    "InstanceNorm3D", "Softmax", "LogSoftmax",
+    "CrossEntropyLoss", "NLLLoss", "BCELoss", "BCEWithLogitsLoss",
+    "KLDivLoss", "MSELoss", "L1Loss", "SmoothL1Loss",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.white: Set[str] = set()
+        self.black: Set[str] = set()
+
+
+_state = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _state
+
+
+def _cast_floats(tree, dtype):
+    def cast(x):
+        if isinstance(x, (jax.Array, jnp.ndarray)) and jnp.issubdtype(
+                jnp.asarray(x).dtype, jnp.floating):
+            return jnp.asarray(x).astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def _policy_dtype(layer) -> Optional[object]:
+    """The dtype this layer's floats should compute in, or None (no cast)."""
+    if not _state.enabled:
+        return None
+    name = type(layer).__name__
+    if name in _state.black:
+        return jnp.float32
+    if name in _state.white or _state.level == "O2":
+        return _state.dtype
+    return None
+
+
+@contextlib.contextmanager
+def cast_layer_call(layer, args, kwargs):
+    """Called from Layer.__call__: apply the active autocast policy to the
+    inputs AND the layer's own parameters (a bf16 input × f32 weight matmul
+    silently promotes back to f32, so weights must be cast too — the
+    reference does the same by casting the persistable inputs of each
+    white-list op, fp16_utils.py).  Parameter boxes are swapped to cast
+    views for the call and restored after; under jit these are free
+    converts folded into the dot."""
+    dtype = _policy_dtype(layer)
+    if dtype is None:
+        yield args, kwargs
+        return
+    args = tuple(_cast_floats(a, dtype) for a in args)
+    kwargs = {k: _cast_floats(v, dtype) for k, v in kwargs.items()}
+    saved = []
+    for box in layer._parameters.values():
+        if box is not None and jnp.issubdtype(box.value.dtype, jnp.floating) \
+                and box.value.dtype != dtype:
+            saved.append((box, box.value))
+            box.value = box.value.astype(dtype)
+    try:
+        yield args, kwargs
+    finally:
+        for box, v in saved:
+            box.value = v
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None,
+              custom_black_list=None, level: str = "O1", dtype="bfloat16"):
+    """Context manager enabling the mixed-precision policy.
+
+    O1: per-layer white/black lists (default).  O2: everything floating is
+    cast to the amp dtype except black-list layers (use with
+    ``amp.decorate`` for bf16 parameters + f32 master weights).
+    """
+    if level not in ("O0", "O1", "O2"):
+        raise InvalidArgumentError(f"amp level {level!r} not in O0/O1/O2")
+    import numpy as np
+
+    dt = jnp.bfloat16 if str(dtype) in ("bfloat16", "bf16") else jnp.float16
+    prev = (_state.enabled, _state.dtype, _state.level, _state.white, _state.black)
+    white = set(WHITE_CLASSES) | set(custom_white_list or ())
+    black = (set(BLACK_CLASSES) - set(custom_white_list or ())) | set(custom_black_list or ())
+    white -= set(custom_black_list or ())
+    _state.enabled = enable and level != "O0"
+    _state.dtype = dt
+    _state.level = level
+    _state.white = white
+    _state.black = black
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level,
+         _state.white, _state.black) = prev
+
+
+amp_guard = auto_cast  # legacy alias (fluid/dygraph/amp/auto_cast.py)
+
+
+def decorate(models=None, optimizers=None, level: str = "O2",
+             dtype="bfloat16", master_weight: Optional[bool] = None,
+             save_dtype=None):
+    """O2 preparation (parity: paddle.amp.decorate): cast model params to the
+    amp dtype and enable f32 master weights in the optimizer."""
+    from ..nn.layer_base import Layer
+    from ..optimizer.optimizer import Optimizer
+
+    if level != "O2":
+        return (models, optimizers) if optimizers is not None else models
+    nets = models if isinstance(models, (list, tuple)) else [models]
+    for net in nets:
+        if isinstance(net, Layer):
+            net.astype(str(dtype))
+    opts = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+    for opt in opts:
+        if isinstance(opt, Optimizer) and master_weight is not False:
+            opt._multi_precision = True
+    if optimizers is None:
+        return models
+    return models, optimizers

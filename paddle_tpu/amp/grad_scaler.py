@@ -1,0 +1,156 @@
+"""GradScaler — dynamic loss scaling for fp16 training.
+
+Parity: paddle.amp.GradScaler (reference: python/paddle/amp/grad_scaler.py
+wrapping fluid/dygraph/amp/loss_scaler.py:27 AmpScaler; C++ state machine
+operators/amp/update_loss_scaling_op.cc: scale ×2 after
+``incr_every_n_steps`` finite steps, ×0.5 after
+``decr_every_n_nan_or_inf`` non-finite steps, skipping updates on inf).
+
+bf16 training does not need loss scaling (f32 exponent range) — construct
+with ``enable=False`` or just don't use a scaler; this class exists for
+fp16 parity and for workloads ported from GPU recipes.
+
+Both eager (``scale``/``step``/``update``) and functional/jit
+(``unscale_and_check``/``apply_state``) forms are provided; the functional
+form keeps the finite-check on device so the whole guarded update stays in
+one XLA program (the reference's check_finite_and_unscale + conditional
+update ops, fused).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.errors import InvalidArgumentError
+
+__all__ = ["GradScaler", "AmpScaler"]
+
+
+class GradScaler:
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 1000, decr_every_n_nan_or_inf: int = 2,
+                 use_dynamic_loss_scaling: bool = True):
+        if incr_ratio <= 1.0 or not (0.0 < decr_ratio < 1.0):
+            raise InvalidArgumentError("incr_ratio>1 and 0<decr_ratio<1 required")
+        self._enable = enable
+        self._init_scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._state = self.init_state()
+
+    # -- functional core -----------------------------------------------------
+    def init_state(self) -> Dict[str, jax.Array]:
+        return {
+            "scale": jnp.asarray(self._init_scale, jnp.float32),
+            "good_steps": jnp.zeros((), jnp.int32),
+            "bad_steps": jnp.zeros((), jnp.int32),
+        }
+
+    def scale_value(self, state) -> jax.Array:
+        return state["scale"]
+
+    def unscale_and_check(self, grads, state) -> Tuple[Any, jax.Array]:
+        """Divide grads by the scale; return (unscaled, found_inf[bool])."""
+        inv = 1.0 / state["scale"]
+        unscaled = jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+        leaves = jax.tree_util.tree_leaves(unscaled)
+        finite = jnp.asarray(True)
+        for g in leaves:
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+        return unscaled, jnp.logical_not(finite)
+
+    def next_state(self, state, found_inf) -> Dict[str, jax.Array]:
+        """The update_loss_scaling_op state machine, branch-free."""
+        if not self._dynamic:
+            return state
+        good = jnp.where(found_inf, 0, state["good_steps"] + 1)
+        bad = jnp.where(found_inf, state["bad_steps"] + 1, 0)
+        grow = good >= self._incr_every
+        shrink = bad >= self._decr_every
+        scale = state["scale"]
+        scale = jnp.where(grow, scale * self._incr_ratio, scale)
+        scale = jnp.where(shrink, jnp.maximum(scale * self._decr_ratio, 1.0), scale)
+        good = jnp.where(grow, 0, good)
+        bad = jnp.where(shrink, 0, bad)
+        return {"scale": scale, "good_steps": good, "bad_steps": bad}
+
+    def guarded_update(self, optimizer, grads, opt_state, params, state, lr=None):
+        """Jit-safe: unscale, check, update-or-skip, advance scaler state.
+        Returns (new_params, new_opt_state, new_scaler_state, found_inf)."""
+        unscaled, found_inf = self.unscale_and_check(grads, state)
+        new_params, new_opt = optimizer.update(unscaled, opt_state, params, lr=lr)
+        # skip: keep old values where the step was non-finite
+        pick = lambda new, old: jax.tree_util.tree_map(
+            lambda n, o: jnp.where(found_inf, o, n), new, old)
+        new_params = pick(new_params, params)
+        new_opt = pick(new_opt, opt_state)
+        return new_params, new_opt, self.next_state(state, found_inf), found_inf
+
+    # -- eager API (paddle dygraph flow) -------------------------------------
+    def is_enable(self) -> bool:
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self) -> bool:
+        return self._dynamic
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._state["scale"].astype(jnp.asarray(loss).dtype)
+
+    def step(self, optimizer, grads=None):
+        """Unscale grads, skip the step on inf/nan (eager host check)."""
+        if not self._enable:
+            optimizer.step(grads)
+            return
+        if grads is None:
+            raise InvalidArgumentError("step() needs grads (no implicit tape)")
+        items = grads.items() if isinstance(grads, dict) else enumerate(grads)
+        keys = [k for k, _ in items]
+        vals = [v for _, v in (grads.items() if isinstance(grads, dict) else enumerate(grads))]
+        unscaled, found_inf = self.unscale_and_check(vals, self._state)
+        self._found_inf = bool(found_inf)
+        if not self._found_inf:
+            out = dict(zip(keys, unscaled)) if isinstance(grads, dict) else list(unscaled)
+            optimizer.step(out)
+
+    def update(self):
+        if self._enable and self._dynamic:
+            self._state = jax.tree_util.tree_map(
+                jnp.asarray,
+                self.next_state(self._state, jnp.asarray(getattr(self, "_found_inf", False))),
+            )
+
+    def minimize(self, optimizer, scaled_loss=None, grads=None):
+        self.step(optimizer, grads)
+        self.update()
+
+    # -- introspection / persistence -----------------------------------------
+    def get_loss_scaling(self) -> float:
+        return float(self._state["scale"])
+
+    def set_init_loss_scaling(self, v: float):
+        self._state["scale"] = jnp.asarray(float(v), jnp.float32)
+
+    def state_dict(self):
+        return {k: jax.device_get(v) for k, v in self._state.items()} | {
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+        }
+
+    def load_state_dict(self, state):
+        for k in ("scale", "good_steps", "bad_steps"):
+            if k in state:
+                self._state[k] = jnp.asarray(state[k])
+
+    set_state_dict = load_state_dict
+
+
+AmpScaler = GradScaler  # legacy alias (fluid/dygraph/amp/loss_scaler.py)

@@ -65,6 +65,13 @@ class ShardingPlan:
     def batch_sharding(self) -> NamedSharding:
         return self.named(P(self._batch_axes))
 
+    @property
+    def n_data_shards(self) -> int:
+        n = 1
+        for a in self._batch_axes:
+            n *= self.mesh.shape[a]
+        return n
+
     def _slot_spec(self, pspec: P, shape) -> P:
         """ZeRO: shard optimizer slots over the ``sharding`` axis on top of
         any TP sharding the parameter already has."""
@@ -112,9 +119,7 @@ class ShardingPlan:
     def shard_batch(self, batch):
         """Split a global host batch across the data axes."""
         sh = self.batch_sharding()
-        n_shards = 1
-        for a in self._batch_axes:
-            n_shards *= self.mesh.shape[a]
+        n_shards = self.n_data_shards
         out = []
         for b in batch:
             b = jnp.asarray(b)

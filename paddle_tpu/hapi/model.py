@@ -80,17 +80,33 @@ class Model:
         net = self.network
         loss_fn = loss
 
+        if isinstance(amp_configs, str):  # paddle-parity: amp_configs="O1"
+            amp_configs = {"level": amp_configs}
+        amp_cfg = dict(amp_configs or {})
+        # keep only the autocast policy knobs; scaler keys (init_loss_scaling
+        # etc.) belong to GradScaler and are irrelevant for bf16
+        _AC_KEYS = {"enable", "custom_white_list", "custom_black_list",
+                    "level", "dtype"}
+        amp_cfg = {k: v for k, v in amp_cfg.items() if k in _AC_KEYS}
+        use_amp = bool(amp_cfg) and amp_cfg.get("level", "O1") != "O0"
+
         def forward_loss(params, buffers, key, training, *batch):
+            import contextlib
+
+            from ..amp import auto_cast as _ac
+
             inputs, labels = self._split_batch(batch)
-            out, new_bufs = functional_call(
-                net, params, *inputs, buffers=buffers, rngs=key,
-                training=training, return_buffers=True,
-            )
-            outs = _tuplize(out)
-            if loss_fn is not None:
-                loss_val = loss_fn(*(tuple(outs) + tuple(labels)))
-            else:
-                loss_val = jnp.zeros(())
+            ctx = _ac(**amp_cfg) if use_amp else contextlib.nullcontext()
+            with ctx:  # loss layers are black-listed → compute in f32
+                out, new_bufs = functional_call(
+                    net, params, *inputs, buffers=buffers, rngs=key,
+                    training=training, return_buffers=True,
+                )
+                outs = _tuplize(out)
+                if loss_fn is not None:
+                    loss_val = loss_fn(*(tuple(outs) + tuple(labels)))
+                else:
+                    loss_val = jnp.zeros(())
             return loss_val, (out, new_bufs)
 
         opt = optimizer
@@ -224,14 +240,23 @@ class Model:
         return results
 
     # -- loops ---------------------------------------------------------------
-    def _as_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+    def _as_loader(self, data, batch_size, shuffle, drop_last, num_workers,
+                   allow_partial=False):
         from ..io import DataLoader, Dataset
 
         if data is None or hasattr(data, "__next__") or isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset):
-            if self._plan is not None:
+            if self._plan is not None and not allow_partial and not drop_last:
                 # a partial final batch can't split across the data shards
+                if len(data) % batch_size:
+                    import warnings
+
+                    warnings.warn(
+                        f"dropping the final partial batch "
+                        f"({len(data) % batch_size} samples) — it cannot "
+                        f"split across {self._plan.n_data_shards} data "
+                        f"shards", RuntimeWarning)
                 drop_last = True
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
                               drop_last=drop_last, num_workers=num_workers,
@@ -328,12 +353,28 @@ class Model:
 
     def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
                 verbose=1, callbacks=None):
-        loader = self._as_loader(test_data, batch_size, False, False, num_workers)
+        loader = self._as_loader(test_data, batch_size, False, False, num_workers,
+                                 allow_partial=True)
         outputs = []
         for batch in loader:
             batch = _tuplize(batch)
             n_in = (self._n_inputs if self._n_inputs is not None else len(batch))
-            out = self.predict_batch(batch[:n_in])
+            inputs = batch[:n_in]
+            pad = 0
+            if self._plan is not None:
+                # pad the partial final batch to shardability, slice it off
+                # after — predictions stay 1:1 with the input dataset
+                n = np.asarray(inputs[0]).shape[0]
+                shards = self._plan.n_data_shards
+                pad = (-n) % shards
+                if pad:
+                    inputs = tuple(
+                        np.concatenate([np.asarray(b),
+                                        np.repeat(np.asarray(b)[-1:], pad, axis=0)])
+                        for b in inputs)
+            out = self.predict_batch(inputs)
+            if pad:
+                out = jax.tree_util.tree_map(lambda o: o[:-pad], out)
             outputs.append(jax.tree_util.tree_map(np.asarray, out))
         if stack_outputs and outputs:
             outputs = jax.tree_util.tree_map(

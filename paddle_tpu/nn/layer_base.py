@@ -30,6 +30,9 @@ import numpy as np
 
 from ..framework import random as _random
 from ..framework.errors import InvalidArgumentError, NotFoundError
+# amp only imports framework/jax at module level — no cycle back into nn
+from ..amp.auto_cast import amp_state as _amp_state
+from ..amp.auto_cast import cast_layer_call as _amp_cast_layer_call
 
 __all__ = [
     "Parameter",
@@ -400,6 +403,12 @@ class Layer:
         raise NotImplementedError
 
     def __call__(self, *args, **kwargs):
+        if _amp_state().enabled:
+            with _amp_cast_layer_call(self, args, kwargs) as (args, kwargs):
+                return self._call_impl(args, kwargs)
+        return self._call_impl(args, kwargs)
+
+    def _call_impl(self, args, kwargs):
         for hook in self._forward_pre_hooks.values():
             result = hook(self, args)
             if result is not None:

@@ -1,0 +1,243 @@
+"""AMP tests: autocast white/black policy, O2 decorate, GradScaler state
+machine vs the reference's update_loss_scaling_op semantics, and jit-safe
+guarded updates (mirrors test_amp_* / test_imperative_auto_mixed_precision
+unittests)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import amp, nn, optimizer as popt
+from paddle_tpu.nn.layer_base import Parameter
+
+
+class TestAutoCast:
+    def test_linear_bf16_under_o1(self):
+        lin = nn.Linear(4, 4)
+        x = jnp.ones((2, 4), jnp.float32)
+        with amp.auto_cast():
+            out = lin(x)
+        assert out.dtype == jnp.bfloat16
+        # outside: f32 again
+        assert lin(x).dtype == jnp.float32
+
+    def test_blacklist_stays_f32(self):
+        ln = nn.LayerNorm(4)
+        x = jnp.ones((2, 4), jnp.bfloat16)
+        with amp.auto_cast():
+            out = ln(x)
+        assert out.dtype == jnp.float32
+
+    def test_custom_lists(self):
+        lin = nn.Linear(4, 4)
+        x = jnp.ones((2, 4), jnp.float32)
+        with amp.auto_cast(custom_black_list=["Linear"]):
+            out = lin(x)
+        assert out.dtype == jnp.float32
+
+    def test_disabled(self):
+        lin = nn.Linear(4, 4)
+        x = jnp.ones((2, 4), jnp.float32)
+        with amp.auto_cast(enable=False):
+            assert lin(x).dtype == jnp.float32
+
+    def test_nesting_restores(self):
+        lin = nn.Linear(4, 4)
+        x = jnp.ones((2, 4), jnp.float32)
+        with amp.auto_cast():
+            with amp.auto_cast(enable=False):
+                assert lin(x).dtype == jnp.float32
+            assert lin(x).dtype == jnp.bfloat16
+        assert lin(x).dtype == jnp.float32
+
+    def test_works_under_jit(self):
+        lin = nn.Linear(4, 4)
+
+        @jax.jit
+        def f(x):
+            with amp.auto_cast():
+                return lin(x)
+
+        assert f(jnp.ones((2, 4))).dtype == jnp.bfloat16
+
+    def test_decorate_o2(self):
+        net = nn.Linear(4, 4)
+        opt = popt.Adam(parameters=net.parameters())
+        net2, opt2 = amp.decorate(models=net, optimizers=opt)
+        assert net.weight.dtype == jnp.bfloat16
+        assert opt._multi_precision
+
+
+class TestGradScaler:
+    def test_scale_and_unscale(self):
+        s = amp.GradScaler(init_loss_scaling=4.0)
+        loss = jnp.asarray(2.0)
+        assert float(s.scale(loss)) == 8.0
+        grads, inf = s.unscale_and_check([jnp.asarray([8.0])], s._state)
+        np.testing.assert_allclose(grads[0], 2.0)
+        assert not bool(inf)
+
+    def test_inf_detection(self):
+        s = amp.GradScaler(init_loss_scaling=2.0)
+        _, inf = s.unscale_and_check([jnp.asarray([jnp.inf])], s._state)
+        assert bool(inf)
+        _, nan = s.unscale_and_check([jnp.asarray([jnp.nan])], s._state)
+        assert bool(nan)
+
+    def test_skip_update_on_inf_and_shrink(self):
+        w = Parameter(np.ones(2, np.float32), name="w")
+        opt = popt.SGD(learning_rate=1.0, parameters=[w])
+        s = amp.GradScaler(init_loss_scaling=8.0, decr_every_n_nan_or_inf=1)
+        s.step(opt, {"w": jnp.asarray([np.inf, 1.0])})
+        s.update()
+        np.testing.assert_allclose(w.numpy(), 1.0)  # skipped
+        assert s.get_loss_scaling() == 4.0  # halved
+
+    def test_growth_after_n_good_steps(self):
+        w = Parameter(np.ones(2, np.float32), name="w")
+        opt = popt.SGD(learning_rate=0.0, parameters=[w])
+        s = amp.GradScaler(init_loss_scaling=2.0, incr_every_n_steps=3)
+        for _ in range(3):
+            s.step(opt, {"w": jnp.ones(2)})
+            s.update()
+        assert s.get_loss_scaling() == 4.0
+
+    def test_functional_guarded_update_jit(self):
+        opt = popt.SGD(learning_rate=1.0)
+        s = amp.GradScaler(init_loss_scaling=2.0, decr_every_n_nan_or_inf=1)
+        params = {"w": jnp.ones(2)}
+        opt_state = opt.init(params)
+        sstate = s.init_state()
+
+        @jax.jit
+        def guarded(grads, params, opt_state, sstate):
+            return s.guarded_update(opt, grads, opt_state, params, sstate)
+
+        # finite step: applied (grads are scaled by 2, unscale → 1)
+        p, o, st, inf = guarded({"w": jnp.full(2, 2.0)}, params, opt_state, sstate)
+        np.testing.assert_allclose(p["w"], 0.0)
+        assert not bool(inf)
+        # inf step: skipped, scale halves
+        p2, o2, st2, inf2 = guarded({"w": jnp.asarray([jnp.inf, 0.0])}, p, o, st)
+        np.testing.assert_allclose(p2["w"], 0.0)
+        assert bool(inf2)
+        assert float(st2["scale"]) == 1.0
+
+    def test_disabled_passthrough(self):
+        w = Parameter(np.ones(2, np.float32), name="w")
+        opt = popt.SGD(learning_rate=1.0, parameters=[w])
+        s = amp.GradScaler(enable=False)
+        assert float(s.scale(jnp.asarray(3.0))) == 3.0
+        s.step(opt, {"w": jnp.ones(2)})
+        np.testing.assert_allclose(w.numpy(), 0.0)
+
+    def test_state_dict_roundtrip(self):
+        s = amp.GradScaler(init_loss_scaling=16.0)
+        sd = s.state_dict()
+        s2 = amp.GradScaler()
+        s2.load_state_dict(sd)
+        assert s2.get_loss_scaling() == 16.0
+
+
+class TestModelAmp:
+    def test_fit_with_amp_o1_converges(self, rng):
+        W = rng.randn(16, 4).astype(np.float32)
+        X = rng.randn(256, 16).astype(np.float32)
+        y = np.argmax(X @ W, 1).astype(np.int64)
+
+        class MLP(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(16, 32)
+                self.act = nn.ReLU()
+                self.fc2 = nn.Linear(32, 4)
+
+            def forward(self, x):
+                return self.fc2(self.act(self.fc1(x)))
+
+        from paddle_tpu import io as pio, metric as pmetric
+
+        paddle.seed(0)
+        model = paddle.Model(MLP())
+        model.prepare(optimizer=popt.Adam(learning_rate=5e-3),
+                      loss=nn.CrossEntropyLoss(),
+                      metrics=[pmetric.Accuracy()],
+                      amp_configs={"level": "O1"})
+        ds = pio.TensorDataset([X, y.reshape(-1, 1)])
+        model.fit(ds, batch_size=64, epochs=20, verbose=0)
+        logs = model.evaluate(ds, batch_size=64, verbose=0)
+        assert logs["acc"] > 0.9, logs
+
+
+class TestReviewRegressions:
+    def test_white_layer_weights_cast_bf16(self):
+        """The matmul must run in bf16: bf16 input × f32 weight would promote
+        back to f32 (the original bug — zero mixed-precision benefit)."""
+        lin = nn.Linear(4, 4)
+
+        def f(x):
+            with amp.auto_cast():
+                return lin(x)
+
+        jaxpr = str(jax.make_jaxpr(f)(jnp.ones((2, 4))))
+        import re
+        # weight enters as f32 const/arg but must be converted before the dot
+        assert "bf16" in jaxpr
+        dots = [l for l in jaxpr.splitlines() if "dot_general" in l]
+        assert dots and all("f32[4,4]" not in d for d in dots), dots
+
+    def test_kwargs_cast(self):
+        class KW(nn.Layer):
+            def forward(self, x=None):
+                return x
+
+        KW.__name__ = "Linear"  # force white-list membership
+        layer = KW()
+        with amp.auto_cast():
+            out = layer(x=jnp.ones((2,), jnp.float32))
+        assert out.dtype == jnp.bfloat16
+
+    def test_o2_casts_unlisted_layers(self):
+        class Custom(nn.Layer):
+            def forward(self, x):
+                return x
+
+        layer = Custom()
+        x = jnp.ones((2,), jnp.float32)
+        with amp.auto_cast(level="O1"):
+            assert layer(x).dtype == jnp.float32  # not white-listed
+        with amp.auto_cast(level="O2"):
+            assert layer(x).dtype == jnp.bfloat16  # O2: everything
+        ln = nn.LayerNorm(2)
+        with amp.auto_cast(level="O2"):
+            assert ln(x).dtype == jnp.float32  # black list still wins
+
+    def test_param_boxes_restored_after_call(self):
+        lin = nn.Linear(4, 4)
+        with amp.auto_cast():
+            lin(jnp.ones((2, 4)))
+        assert lin.weight.dtype == jnp.float32
+
+    def test_amp_configs_string_form(self, rng):
+        X = rng.randn(32, 8).astype(np.float32)
+        y = rng.randint(0, 2, (32, 1)).astype(np.int64)
+        model = paddle.Model(nn.Linear(8, 2))
+        model.prepare(optimizer=popt.SGD(learning_rate=0.1),
+                      loss=nn.CrossEntropyLoss(), amp_configs="O1")
+        model.train_batch([X], [y])  # no crash
+
+    def test_amp_configs_scaler_keys_ignored(self, rng):
+        X = rng.randn(16, 8).astype(np.float32)
+        y = rng.randint(0, 2, (16, 1)).astype(np.int64)
+        model = paddle.Model(nn.Linear(8, 2))
+        model.prepare(optimizer=popt.SGD(learning_rate=0.1),
+                      loss=nn.CrossEntropyLoss(),
+                      amp_configs={"level": "O1", "init_loss_scaling": 512,
+                                   "use_fp16_guard": False})
+        model.train_batch([X], [y])  # no crash
+
+    def test_is_use_dynamic_loss_scaling(self):
+        s = amp.GradScaler(enable=True, use_dynamic_loss_scaling=False)
+        assert not s.is_use_dynamic_loss_scaling()
+        assert s.is_enable()

@@ -406,3 +406,14 @@ class TestReviewRegressions:
             fleet.distributed_optimizer(
                 popt.SGD(), strategy=fleet.DistributedStrategy(sharding=True))
         assert fleet.get_strategy() is None
+
+    def test_predict_returns_all_samples_under_plan(self):
+        fleet.init(is_collective=True, strategy=fleet.DistributedStrategy())
+        rng = np.random.RandomState(0)
+        X, _ = _make_data(rng, n=100)
+        opt = fleet.distributed_optimizer(popt.SGD(learning_rate=0.01))
+        model = paddle.Model(MLP())
+        model.prepare(optimizer=opt, loss=nn.CrossEntropyLoss())
+        out = model.predict(pio.TensorDataset([X]), batch_size=64,
+                            stack_outputs=True)
+        assert np.asarray(out).shape[0] == 100  # padded + sliced, not dropped
