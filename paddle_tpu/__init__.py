@@ -65,6 +65,7 @@ from . import io  # noqa: F401
 from . import distributed  # noqa: F401
 from .distributed import DataParallel  # noqa: F401
 from . import amp  # noqa: F401
+from . import ops  # noqa: F401
 from . import metric  # noqa: F401
 from . import models  # noqa: F401
 from . import hapi  # noqa: F401

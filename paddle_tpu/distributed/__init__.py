@@ -39,3 +39,9 @@ from .parallel import DataParallel, spawn  # noqa: F401
 from . import launch  # noqa: F401  (module: python -m paddle_tpu.distributed.launch)
 from . import fleet  # noqa: F401
 from . import meta_parallel  # noqa: F401
+from .sequence_parallel import (  # noqa: F401
+    ring_attention,
+    ulysses_attention,
+    ring_attention_sharded,
+    ulysses_attention_sharded,
+)

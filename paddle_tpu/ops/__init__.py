@@ -1,0 +1,8 @@
+"""paddle_tpu.ops — Pallas TPU kernels for ops XLA won't fuse optimally.
+
+The reference's 650-kernel operator library (paddle/fluid/operators/) maps
+almost entirely to XLA-fused lax ops; this package holds the few hand
+kernels that beat the compiler (flash attention; more as profiling finds
+them).
+"""
+from .flash_attention import flash_attention  # noqa: F401
