@@ -1,0 +1,77 @@
+"""Throwaway perf experiments for the BERT bench (delete before commit)."""
+import sys
+import time
+
+import numpy as np
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "base"
+
+import jax
+
+if VARIANT == "rbg":
+    jax.config.update("jax_default_prng_impl", "rbg")
+if VARIANT == "partitionable":
+    jax.config.update("jax_threefry_partitionable", True)
+
+import jax.numpy as jnp
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as popt
+from paddle_tpu.models import BertForPretraining, bert_base
+
+BATCH = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+SEQ = 128
+MAX_PRED = 20
+
+paddle.seed(0)
+cfg = bert_base()
+if VARIANT == "nodrop":
+    cfg.dropout = 0.0
+net = BertForPretraining(cfg).astype("bfloat16")
+if VARIANT == "attndrop0":  # attention-probs dropout off, hidden on
+    for lyr in net.bert.layers:
+        lyr.attn.drop.p = 0.0
+if VARIANT == "hiddendrop0":  # hidden dropouts off, attention on
+    net.bert.embeddings.drop.p = 0.0
+    for lyr in net.bert.layers:
+        lyr.drop.p = 0.0
+        lyr.mlp.drop.p = 0.0
+if VARIANT == "remat":
+    import jax as _jax
+    for lyr in net.bert.layers:
+        _orig = lyr.forward
+        lyr.forward = _jax.checkpoint(_orig, policy=_jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+opt = popt.AdamW(learning_rate=1e-4, weight_decay=0.01, multi_precision=True)
+model = paddle.Model(
+    net,
+    inputs=["input_ids", "token_type_ids", "attention_mask", "masked_positions"],
+    labels=["mlm_labels", "nsp_labels"])
+model.prepare(optimizer=opt, loss=net.loss)
+
+rng = np.random.RandomState(0)
+ids = rng.randint(0, cfg.vocab_size, size=(BATCH, SEQ)).astype(np.int32)
+token_type = (rng.uniform(size=(BATCH, SEQ)) < 0.5).astype(np.int32)
+attn_mask = np.ones((BATCH, SEQ), np.int32)
+positions = np.stack([
+    np.sort(rng.choice(SEQ, MAX_PRED, replace=False))
+    for _ in range(BATCH)]).astype(np.int32)
+mlm_labels = np.take_along_axis(ids, positions, axis=1)
+nsp_labels = rng.randint(0, 2, size=(BATCH, 1)).astype(np.int32)
+
+
+def step():
+    loss, _ = model._train_batch_device(
+        [ids, token_type, attn_mask, positions], [mlm_labels, nsp_labels])
+    return loss
+
+
+for _ in range(3):
+    loss = step()
+float(loss)
+t0 = time.perf_counter()
+for _ in range(10):
+    loss = step()
+final = float(loss)
+dt = time.perf_counter() - t0
+assert np.isfinite(final)
+print(f"VARIANT={VARIANT} BATCH={BATCH}: {BATCH*10/dt:.1f} seq/s "
+      f"({dt*100:.1f} ms/step) loss={final:.3f}")

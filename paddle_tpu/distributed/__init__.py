@@ -36,6 +36,7 @@ from .collective import (  # noqa: F401
     all_to_all_single,
 )
 from .parallel import DataParallel, spawn  # noqa: F401
+from ..nn.recompute import recompute  # noqa: F401  (fleet.utils.recompute parity)
 from . import launch  # noqa: F401  (module: python -m paddle_tpu.distributed.launch)
 from . import fleet  # noqa: F401
 from . import meta_parallel  # noqa: F401

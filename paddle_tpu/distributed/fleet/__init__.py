@@ -99,15 +99,79 @@ def get_strategy() -> Optional[DistributedStrategy]:
 
 
 def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
-    """Tag an optimizer for distributed execution.  Model.prepare builds the
-    ShardingPlan from this tag (replaces meta-opt minimize orchestration,
-    fleet_base.py:946)."""
+    """Compose the strategy's optimizer-level features and tag the result
+    for distributed execution; Model.prepare builds the ShardingPlan from
+    the tag (replaces meta-opt minimize orchestration, fleet_base.py:946,
+    and the meta-optimizer composition in strategy_compiler.py:112)."""
     global _strategy
     if not _initialized:
         raise InvalidArgumentError("call fleet.init() before distributed_optimizer")
     if strategy is not None:
         _strategy = strategy
-    optimizer._fleet_strategy = _strategy or DistributedStrategy()
+    st = _strategy or DistributedStrategy()
+
+    # honest errors for strategies with no TPU implementation yet — the
+    # reference silently composed these as program rewrites; silently
+    # ignoring them here would train with a different algorithm than asked
+    from ...framework.errors import UnimplementedError
+
+    if st.localsgd:
+        raise UnimplementedError(
+            "strategy.localsgd (reference: transpiler/collective.py:270 "
+            "LocalSGD) is not implemented in paddle_tpu")
+    if st.dgc:
+        raise UnimplementedError(
+            "strategy.dgc (reference: operators/dgc_op.cc top-k gradient "
+            "compression) is not implemented in paddle_tpu — XLA allreduce "
+            "over ICI makes dense grads the fast path on TPU")
+    if st.a_sync:
+        raise UnimplementedError(
+            "strategy.a_sync is parameter-server async mode (reference: "
+            "operators/distributed/communicator.h:268); PS does not exist "
+            "on TPU — use sharded embedding tables instead")
+
+    from ...optimizer.optimizer import Lamb, Lars, Momentum
+
+    if st.lamb and not isinstance(optimizer, Lamb):
+        # LAMB meta-optimizer replaces an Adam-family inner optimizer
+        # (reference: fleet/meta_optimizers/lamb_optimizer.py)
+        cfg = st.lamb_configs or {}
+        optimizer = Lamb(
+            learning_rate=optimizer._learning_rate,
+            lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01),
+            parameters=optimizer._param_boxes,
+            grad_clip=optimizer._grad_clip,
+            multi_precision=optimizer._multi_precision,
+            exclude_from_weight_decay_fn=cfg.get("exclude_from_weight_decay_fn"),
+        )
+    if st.lars and not isinstance(optimizer, Lars):
+        # reference: fleet/meta_optimizers/lars_optimizer.py (momentum only)
+        cfg = st.lars_configs or {}
+        momentum = getattr(optimizer, "_momentum", 0.9)
+        if not isinstance(optimizer, Momentum):
+            raise InvalidArgumentError(
+                "strategy.lars applies to a Momentum optimizer (reference "
+                "lars_optimizer.py _can_apply)")
+        optimizer = Lars(
+            learning_rate=optimizer._learning_rate,
+            momentum=momentum,
+            lars_coeff=cfg.get("lars_coeff", 0.001),
+            lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+            parameters=optimizer._param_boxes,
+            grad_clip=optimizer._grad_clip,
+            multi_precision=optimizer._multi_precision,
+            exclude_from_weight_decay=cfg.get("exclude_from_weight_decay"),
+            epsilon=cfg.get("epsilon", 0),
+        )
+    if st.gradient_merge:
+        from ...optimizer.gradient_merge import GradientMergeOptimizer
+
+        cfg = st.gradient_merge_configs or {}
+        optimizer = GradientMergeOptimizer(
+            optimizer, k_steps=int(cfg.get("k_steps", 1)),
+            avg=bool(cfg.get("avg", True)))
+
+    optimizer._fleet_strategy = st
     return optimizer
 
 

@@ -38,9 +38,16 @@ class DistributedStrategy:
     pipeline: bool = False
     pipeline_configs: Dict = field(default_factory=lambda: {"accumulate_steps": 1})
     sequence_parallel: bool = False
+    sequence_parallel_configs: Dict = field(
+        default_factory=lambda: {"method": "ring"})
     localsgd: bool = False
+    localsgd_configs: Dict = field(default_factory=dict)
+    dgc: bool = False
+    dgc_configs: Dict = field(default_factory=dict)
     lamb: bool = False
+    lamb_configs: Dict = field(default_factory=dict)
     lars: bool = False
+    lars_configs: Dict = field(default_factory=dict)
     a_sync: bool = False        # PS async mode — not supported on TPU
     hybrid_configs: Optional[Dict] = None
 

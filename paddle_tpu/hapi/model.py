@@ -136,6 +136,37 @@ class Model:
         if strategy is not None:
             from ..distributed.fleet.plan import ShardingPlan
 
+            if strategy.recompute:
+                # reference: RecomputeOptimizer (fluid/optimizer.py:4547) —
+                # here jax.checkpoint on the repeated block layers
+                from ..nn.recompute import apply_recompute
+
+                rc_cfg = strategy.recompute_configs or {}
+                wrapped = apply_recompute(
+                    net, rc_cfg.get("layer_classes"), rc_cfg.get("policy"))
+                if wrapped == 0:
+                    import warnings
+
+                    warnings.warn(
+                        "strategy.recompute matched no block sublayers — "
+                        "pass recompute_configs={'layer_classes': [...]}",
+                        RuntimeWarning)
+            if strategy.sequence_parallel:
+                # route attention through ring/Ulysses over the sep axis
+                sp_cfg = strategy.sequence_parallel_configs or {}
+                method = sp_cfg.get("method", "ring")
+                hits = 0
+                for sub in net.sublayers(include_self=True):
+                    if hasattr(sub, "sequence_parallel") and hasattr(sub, "qkv"):
+                        sub.sequence_parallel = method
+                        hits += 1
+                if hits == 0:
+                    import warnings
+
+                    warnings.warn(
+                        "strategy.sequence_parallel found no attention "
+                        "layers exposing a `sequence_parallel` knob",
+                        RuntimeWarning)
             self._plan = ShardingPlan(net, optimizer, strategy)
             self._plan.place_network()
 

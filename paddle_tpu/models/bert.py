@@ -82,7 +82,9 @@ class BertSelfAttention(Layer):
         q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(self.head_dim)
         if attn_mask is not None:
-            scores = scores + attn_mask
+            # keep the hot graph in the compute dtype: an f32 mask would
+            # silently upcast bf16 scores (and the softmax) to f32
+            scores = scores + attn_mask.astype(scores.dtype)
         probs = self.drop(jax.nn.softmax(scores, axis=-1))
         ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, D)
@@ -121,7 +123,9 @@ class BertEmbeddings(Layer):
 
     def forward(self, input_ids, token_type_ids=None):
         B, S = input_ids.shape
-        pos = jnp.arange(S)[None, :]
+        # i32 index math: under the x64 API surface a bare arange is i64,
+        # which doubles index traffic on TPU for no benefit
+        pos = jnp.arange(S, dtype=jnp.int32)[None, :]
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         x = self.word(input_ids) + self.position(pos) + self.token_type(token_type_ids)
@@ -139,11 +143,12 @@ class BertModel(Layer):
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         """attention_mask: [B, S] with 1 = attend, 0 = pad."""
+        x = self.embeddings(input_ids, token_type_ids)
         mask = None
         if attention_mask is not None:
-            mask = (1.0 - jnp.asarray(attention_mask, jnp.float32)) * -1e9
+            mask = (1.0 - jnp.asarray(attention_mask, x.dtype)) * jnp.asarray(
+                -1e9, x.dtype)
             mask = mask[:, None, None, :]  # [B,1,1,S] additive
-        x = self.embeddings(input_ids, token_type_ids)
         for layer in self.layers:
             x = layer(x, mask)
         pooled = self.pooler_act(self.pooler(x[:, 0]))
@@ -161,8 +166,17 @@ class BertForPretraining(Layer):
         self.ln = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
         self.nsp = nn.Linear(cfg.hidden_size, 2)
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_positions=None):
+        """``masked_positions`` [B, P] (int): gather only the masked tokens
+        before the vocab projection — standard MLM pretraining computes the
+        decoder over max_predictions_per_seq (~20) positions, not all S
+        (the A100 CUDA baselines do the same; computing the full [B,S,V]
+        logits would be ~6× the vocab-projection FLOPs)."""
         seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        if masked_positions is not None:
+            idx = jnp.asarray(masked_positions, jnp.int32)
+            seq = jnp.take_along_axis(seq, idx[..., None], axis=1)  # [B,P,D]
         h = self.ln(self.act(self.transform(seq)))
         mlm_logits = jnp.einsum(
             "bsd,vd->bsv", h, jnp.asarray(self.bert.embeddings.word.weight))
@@ -172,13 +186,17 @@ class BertForPretraining(Layer):
              ignore_index: int = -100):
         logp = jax.nn.log_softmax(mlm_logits, axis=-1)
         labels = jnp.asarray(mlm_labels)
+        if labels.dtype in (jnp.int64, jnp.uint32, jnp.uint64):
+            labels = labels.astype(jnp.int32)  # i32 gather on the big tensor
         safe = jnp.where(labels == ignore_index, 0, labels)
         ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
         mask = (labels != ignore_index).astype(logp.dtype)
         mlm_loss = -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
         nsp_logp = jax.nn.log_softmax(nsp_logits, axis=-1)
         nsp_loss = -jnp.take_along_axis(
-            nsp_logp, jnp.asarray(nsp_labels).reshape(-1, 1), axis=-1).mean()
+            nsp_logp,
+            jnp.asarray(nsp_labels).astype(jnp.int32).reshape(-1, 1),
+            axis=-1).mean()
         return mlm_loss + nsp_loss
 
 

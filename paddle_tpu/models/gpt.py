@@ -36,7 +36,8 @@ __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny", "gpt_small"]
 class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=None, max_position=1024,
-                 dropout=0.1, layer_norm_epsilon=1e-5, dtype="float32"):
+                 dropout=0.1, layer_norm_epsilon=1e-5, dtype="float32",
+                 sequence_parallel=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -46,6 +47,9 @@ class GPTConfig:
         self.dropout = dropout
         self.layer_norm_epsilon = layer_norm_epsilon
         self.dtype = dtype
+        #: None | "ring" | "ulysses" — long-sequence attention over the
+        #: ``sep`` mesh axis (see distributed/sequence_parallel.py)
+        self.sequence_parallel = sequence_parallel
 
 
 def gpt_tiny(**kw):
@@ -60,7 +64,16 @@ def gpt_small(**kw):
 
 
 class ParallelAttention(Layer):
-    """Causal (or masked) multi-head self-attention with model-sharded heads."""
+    """Causal (or masked) multi-head self-attention with model-sharded heads.
+
+    With ``sequence_parallel`` set ("ring"/"ulysses") and a mesh whose
+    ``sep`` axis is >1, attention runs sequence-sharded: ring attention
+    rotates KV chunks over ICI (lax.ppermute) with online-softmax merging,
+    Ulysses all-to-alls heads↔sequence.  Both are exact; attention-prob
+    dropout is skipped on that path (the probabilities never materialize —
+    same trade flash-attention kernels make).  A custom ``attn_mask`` forces
+    the dense path (SP supports the built-in causal mask only).
+    """
 
     def __init__(self, cfg: GPTConfig):
         super().__init__()
@@ -72,6 +85,12 @@ class ParallelAttention(Layer):
         self.qkv = ColumnParallelLinear(d, 3 * d, gather_output=False)
         self.out = RowParallelLinear(d, d, input_is_parallel=True)
         self.drop = nn.Dropout(cfg.dropout)
+        self.sequence_parallel = cfg.sequence_parallel
+
+    def _sp_degree(self):
+        from ..distributed.mesh import get_mesh
+
+        return get_mesh().shape.get("sep", 1)
 
     def forward(self, x, attn_mask=None):
         B, S, D = x.shape
@@ -83,17 +102,43 @@ class ParallelAttention(Layer):
         q = q.transpose(0, 2, 1, 3)  # [B,H,S,hd]
         k = k.transpose(0, 2, 1, 3)
         v = v.transpose(0, 2, 1, 3)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(self.head_dim)
-        causal = jnp.tril(jnp.ones((S, S), bool))
-        scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
-        if attn_mask is not None:
-            scores = scores + attn_mask
-        probs = jax.nn.softmax(scores, axis=-1)
-        probs = self.drop(probs)
-        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        if (self.sequence_parallel and attn_mask is None
+                and self._sp_degree() > 1):
+            ctx = self._sp_attention(q, k, v)  # [B,H,S,hd]
+        else:
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(self.head_dim)
+            causal = jnp.tril(jnp.ones((S, S), bool))
+            scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
+            if attn_mask is not None:
+                scores = scores + attn_mask.astype(scores.dtype)
+            probs = jax.nn.softmax(scores, axis=-1)
+            probs = self.drop(probs)
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, D)
         ctx = constrain(ctx, None, None, "model")
         return self.out(ctx)
+
+    def _sp_attention(self, q, k, v):
+        from jax.sharding import PartitionSpec as P
+
+        from ..distributed.collective import shard_map
+        from ..distributed.mesh import data_axes, get_mesh
+        from ..distributed.sequence_parallel import (
+            ring_attention,
+            ulysses_attention,
+        )
+
+        mesh = get_mesh()
+        batch_ax = tuple(data_axes(mesh))
+        model_ax = "model" if mesh.shape.get("model", 1) > 1 else None
+        spec = P(batch_ax, model_ax, "sep", None)
+        fn = (ulysses_attention if self.sequence_parallel == "ulysses"
+              else ring_attention)
+
+        def local(ql, kl, vl):
+            return fn(ql, kl, vl, axis_name="sep", causal=True)
+
+        return shard_map(local, mesh, (spec, spec, spec), spec)(q, k, v)
 
 
 class ParallelMLP(Layer):
@@ -137,7 +182,7 @@ class GPTModel(Layer):
 
     def forward(self, input_ids, attn_mask=None):
         B, S = input_ids.shape
-        pos = jnp.arange(S)[None, :]
+        pos = jnp.arange(S, dtype=jnp.int32)[None, :]
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
         for blk in self.blocks:
@@ -161,6 +206,8 @@ class GPTForCausalLM(Layer):
         """Shifted next-token cross entropy (labels = input_ids)."""
         logits = logits[:, :-1]
         labels = jnp.asarray(labels)[:, 1:]
+        if labels.dtype in (jnp.int64, jnp.uint32, jnp.uint64):
+            labels = labels.astype(jnp.int32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         return -ll.mean()

@@ -15,6 +15,9 @@ from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from .initializer import ParamAttr  # noqa: F401
 
+from . import recompute as _recompute_mod  # noqa: F401
+from .recompute import apply_recompute, mark_recompute, recompute  # noqa: F401
+
 from .common import *  # noqa: F401,F403
 from .conv import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403

@@ -63,7 +63,9 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
         mask_shape = tuple(x.shape[i] if i in axes else 1 for i in range(x.ndim))
     else:
         mask_shape = x.shape
-    keep = jax.random.bernoulli(k, 1.0 - p, mask_shape)
+    # f32 keep-probability: under the x64 API surface a Python-float p
+    # promotes the uniform draw to f64, which TPUs emulate at huge cost
+    keep = jax.random.bernoulli(k, np.float32(1.0 - p), mask_shape)
     if mode == "upscale_in_train":
         return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
     return jnp.where(keep, x, 0.0).astype(x.dtype)

@@ -59,8 +59,12 @@ def _pool(x, kernel, stride, padding, n, channel_last, reducer, init, ceil_mode)
 
 def _avg_pool(x, kernel, stride, padding, n, channel_last, exclusive, ceil_mode):
     x = jnp.asarray(x)
+    # init must be a CONCRETE numpy scalar: under jit tracing a jnp.array
+    # init defeats lax.reduce_window's monoid detection, lowering to the
+    # generic reduce_window primitive which has no autodiff rule
     summed, kernel_t, pads, spatial_dims = _pool(
-        x, kernel, stride, padding, n, channel_last, lax.add, 0.0 if x.dtype == jnp.float64 else jnp.array(0, x.dtype), ceil_mode)
+        x, kernel, stride, padding, n, channel_last, lax.add,
+        np.array(0, x.dtype), ceil_mode)
     if exclusive:
         # divide by the count of valid (non-pad) elements per window
         ones = jnp.ones([x.shape[d] for d in spatial_dims], x.dtype)
@@ -75,7 +79,8 @@ def _avg_pool(x, kernel, stride, padding, n, channel_last, exclusive, ceil_mode)
         else:
             window = (1, 1) + _norm(kernel, n)
             strides = (1, 1) + stride_t
-        counts = lax.reduce_window(jnp.broadcast_to(ones, shape), jnp.array(0, x.dtype),
+        counts = lax.reduce_window(jnp.broadcast_to(ones, shape),
+                                   np.array(0, x.dtype),
                                    lax.add, window, strides, pads)
         return summed / counts
     return summed / np.prod(kernel_t)
@@ -91,7 +96,8 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
     if divisor_override:
         x = jnp.asarray(x)
         summed, kernel_t, _, _ = _pool(x, kernel_size, stride, padding, 2,
-                                       data_format == "NHWC", lax.add, jnp.array(0, x.dtype), ceil_mode)
+                                       data_format == "NHWC", lax.add,
+                                       np.array(0, x.dtype), ceil_mode)
         return summed / divisor_override
     return _avg_pool(x, kernel_size, stride, padding, 2, data_format == "NHWC", exclusive, ceil_mode)
 
@@ -101,14 +107,17 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
     if divisor_override:
         x = jnp.asarray(x)
         summed, _, _, _ = _pool(x, kernel_size, stride, padding, 3,
-                                data_format == "NDHWC", lax.add, jnp.array(0, x.dtype), ceil_mode)
+                                data_format == "NDHWC", lax.add,
+                                np.array(0, x.dtype), ceil_mode)
         return summed / divisor_override
     return _avg_pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC", exclusive, ceil_mode)
 
 
 def _max_pool(x, kernel, stride, padding, n, channel_last, ceil_mode):
     x = jnp.asarray(x)
-    neg_inf = jnp.array(-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min, x.dtype)
+    neg_inf = np.array(
+        -np.inf if jnp.issubdtype(x.dtype, jnp.floating)
+        else np.iinfo(np.dtype(x.dtype)).min, x.dtype)
     out, _, _, _ = _pool(x, kernel, stride, padding, n, channel_last, lax.max, neg_inf, ceil_mode)
     return out
 

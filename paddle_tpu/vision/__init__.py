@@ -1,0 +1,15 @@
+"""paddle_tpu.vision — computer-vision models, datasets and transforms.
+
+Capability parity with the reference's ``python/paddle/vision/`` package
+(models/resnet.py, datasets/mnist.py, transforms/transforms.py, image.py),
+built TPU-first: models are jit-friendly Layer trees whose convolutions
+lower to XLA convolutions on the MXU; transforms are host-side numpy
+(they run inside DataLoader workers, off the device).
+"""
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from .image import get_image_backend, image_load, set_image_backend  # noqa: F401
+
+__all__ = ["datasets", "models", "transforms",
+           "set_image_backend", "get_image_backend", "image_load"]

@@ -1,0 +1,35 @@
+"""Image backend selection (parity: python/paddle/vision/image.py).
+
+Backends: 'pil' (default) and 'cv2'.  ``image_load`` returns the backend's
+native image object; datasets convert to numpy HWC before batching (the
+device only ever sees dense numpy/jax arrays).
+"""
+from __future__ import annotations
+
+__all__ = ["set_image_backend", "get_image_backend", "image_load"]
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend: str) -> None:
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"backend must be 'pil' or 'cv2', got {backend!r}")
+    global _image_backend
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    backend = backend or _image_backend
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"backend must be 'pil' or 'cv2', got {backend!r}")
+    if backend == "pil":
+        from PIL import Image
+
+        return Image.open(path)
+    import cv2
+
+    return cv2.imread(str(path))

@@ -1,0 +1,206 @@
+"""Strategy-feature tests: recompute (remat), gradient merge, LAMB/LARS
+toggles, and honest UnimplementedError for un-built strategies.
+
+Mirrors the reference's meta-optimizer tests, which assert on the rewritten
+program (fleet_meta_optimizer_base.py:23 — op/attr inspection); here the
+"program" is the jaxpr, so remat is asserted by jaxpr inspection, and
+gradient merge by trajectory parity with the equivalent big batch.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as popt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.mesh import build_mesh, set_mesh
+from paddle_tpu.framework.errors import UnimplementedError
+from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+from paddle_tpu.optimizer.gradient_merge import GradientMergeOptimizer
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    set_mesh(build_mesh())
+    yield
+    set_mesh(build_mesh())
+    fleet._initialized = False
+    fleet._strategy = None
+
+
+class TestRecompute:
+    def test_apply_recompute_wraps_repeated_blocks(self):
+        net = GPTForCausalLM(gpt_tiny())
+        n = nn.apply_recompute(net)
+        assert n == 2  # gpt_tiny has 2 GPTBlocks
+        assert all(getattr(b, "_recompute_wrapped", False)
+                   for b in net.gpt.blocks)
+
+    def test_jaxpr_contains_remat(self):
+        paddle.seed(0)
+        net = GPTForCausalLM(gpt_tiny())
+        nn.apply_recompute(net)
+        ids = jnp.zeros((2, 8), jnp.int32)
+        params = net.param_pytree()
+
+        def loss_fn(params):
+            logits = nn.functional_call(net, params, ids, training=True)
+            return net.loss(logits, ids)
+
+        jaxpr = jax.make_jaxpr(jax.grad(loss_fn))(params)
+        assert "remat" in str(jaxpr), "no remat/checkpoint in the grad jaxpr"
+
+    def test_recompute_matches_baseline_numerics(self):
+        paddle.seed(0)
+        net_a = GPTForCausalLM(gpt_tiny())
+        paddle.seed(0)
+        net_b = GPTForCausalLM(gpt_tiny())
+        nn.apply_recompute(net_b)
+        ids = np.random.RandomState(0).randint(0, 128, (2, 8)).astype(np.int32)
+
+        def train(net):
+            opt = popt.Adam(learning_rate=1e-2)
+            m = paddle.Model(net)
+            m.prepare(optimizer=opt, loss=net.loss)
+            losses = [m.train_batch([ids], [ids])[0] for _ in range(3)]
+            return losses
+
+        np.testing.assert_allclose(train(net_a), train(net_b),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_strategy_recompute_via_fleet(self):
+        paddle.seed(0)
+        strat = fleet.DistributedStrategy(recompute=True)
+        fleet.init(is_collective=True, strategy=strat)
+        net = GPTForCausalLM(gpt_tiny())
+        opt = fleet.distributed_optimizer(popt.Adam(learning_rate=1e-3))
+        model = paddle.Model(net)
+        model.prepare(optimizer=opt, loss=net.loss)
+        assert all(getattr(b, "_recompute_wrapped", False)
+                   for b in net.gpt.blocks)
+        ids = np.random.RandomState(0).randint(0, 128, (8, 8)).astype(np.int32)
+        loss, _ = model.train_batch([ids], [ids])
+        assert np.isfinite(loss)
+
+
+class TestGradientMerge:
+    def _toy(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 3)
+        x = np.random.RandomState(0).normal(size=(8, 4)).astype(np.float32)
+        y = np.random.RandomState(1).normal(size=(8, 3)).astype(np.float32)
+        return net, x, y
+
+    def test_merged_matches_big_batch_sgd(self):
+        """k micro-steps with GM == one step on the concatenated batch."""
+        net, x, y = self._toy()
+        loss_fn = nn.MSELoss()
+
+        def run(merge):
+            paddle.seed(0)
+            net = nn.Linear(4, 3)
+            params = net.param_pytree()
+            if merge:
+                opt = GradientMergeOptimizer(popt.SGD(learning_rate=0.1), k_steps=2)
+            else:
+                opt = popt.SGD(learning_rate=0.1)
+            state = opt.init(params)
+
+            def grads_of(xb, yb, params):
+                def f(p):
+                    out = nn.functional_call(net, p, xb, training=True)
+                    return loss_fn(out, yb)
+                return jax.grad(f)(params)
+
+            if merge:
+                for xb, yb in ((x[:4], y[:4]), (x[4:], y[4:])):
+                    g = grads_of(xb, yb, params)
+                    params, state = opt.update(g, state, params, lr=0.1)
+            else:
+                g = grads_of(x, y, params)
+                params, state = opt.update(g, state, params, lr=0.1)
+            return params
+
+        merged = run(True)
+        big = run(False)
+        for k in merged:
+            np.testing.assert_allclose(np.asarray(merged[k]),
+                                       np.asarray(big[k]), rtol=1e-5, atol=1e-6)
+
+    def test_no_update_mid_cycle(self):
+        net, x, y = self._toy()
+        params = net.param_pytree()
+        opt = GradientMergeOptimizer(popt.SGD(learning_rate=0.1), k_steps=3)
+        state = opt.init(params)
+        g = {k: jnp.ones_like(v) for k, v in params.items()}
+        p1, state = opt.update(g, state, params, lr=0.1)
+        for k in params:
+            np.testing.assert_array_equal(np.asarray(p1[k]), np.asarray(params[k]))
+        p2, state = opt.update(g, state, p1, lr=0.1)
+        for k in params:
+            np.testing.assert_array_equal(np.asarray(p2[k]), np.asarray(params[k]))
+        p3, state = opt.update(g, state, p2, lr=0.1)
+        for k in params:  # cycle complete: mean grad = 1 → p -= 0.1
+            np.testing.assert_allclose(np.asarray(p3[k]),
+                                       np.asarray(params[k]) - 0.1, rtol=1e-6)
+
+    def test_inner_count_advances_per_cycle_not_per_micro(self):
+        net, _, _ = self._toy()
+        params = net.param_pytree()
+        opt = GradientMergeOptimizer(popt.Adam(learning_rate=1e-3), k_steps=2)
+        state = opt.init(params)
+        g = {k: jnp.ones_like(v) for k, v in params.items()}
+        _, state = opt.update(g, state, params)
+        assert int(state["count"]) == 0  # mid-cycle: no Adam step yet
+        _, state = opt.update(g, state, params)
+        assert int(state["count"]) == 1  # one Adam step after k micro-steps
+
+    def test_under_fleet_and_jit(self):
+        paddle.seed(0)
+        strat = fleet.DistributedStrategy(
+            gradient_merge=True, gradient_merge_configs={"k_steps": 2})
+        fleet.init(is_collective=True, strategy=strat)
+        net = GPTForCausalLM(gpt_tiny())
+        opt = fleet.distributed_optimizer(popt.Adam(learning_rate=1e-2))
+        assert isinstance(opt, GradientMergeOptimizer)
+        model = paddle.Model(net)
+        model.prepare(optimizer=opt, loss=net.loss)
+        ids = np.random.RandomState(0).randint(0, 128, (8, 8)).astype(np.int32)
+        w0 = np.asarray(net.gpt.wte.weight.value).copy()
+        model.train_batch([ids], [ids])
+        w1 = np.asarray(net.gpt.wte.weight.value)
+        np.testing.assert_array_equal(w0, w1)  # micro-step 1: accumulate only
+        model.train_batch([ids], [ids])
+        w2 = np.asarray(net.gpt.wte.weight.value)
+        assert not np.array_equal(w1, w2)  # cycle end: params move
+
+
+class TestOptimizerToggles:
+    def test_lamb_toggle_replaces_optimizer(self):
+        fleet.init(is_collective=True,
+                   strategy=fleet.DistributedStrategy(lamb=True))
+        opt = fleet.distributed_optimizer(popt.Adam(learning_rate=1e-3))
+        assert isinstance(opt, popt.Lamb)
+
+    def test_lars_toggle_replaces_momentum(self):
+        fleet.init(is_collective=True,
+                   strategy=fleet.DistributedStrategy(lars=True))
+        opt = fleet.distributed_optimizer(
+            popt.Momentum(learning_rate=0.1, momentum=0.9))
+        assert isinstance(opt, popt.Lars)
+
+    def test_lars_toggle_rejects_adam(self):
+        fleet.init(is_collective=True,
+                   strategy=fleet.DistributedStrategy(lars=True))
+        with pytest.raises(Exception, match="Momentum"):
+            fleet.distributed_optimizer(popt.Adam(learning_rate=1e-3))
+
+
+class TestUnimplementedStrategies:
+    @pytest.mark.parametrize("field", ["localsgd", "dgc", "a_sync"])
+    def test_raises_instead_of_silent_noop(self, field):
+        strat = fleet.DistributedStrategy(**{field: True})
+        fleet.init(is_collective=True, strategy=strat)
+        with pytest.raises(UnimplementedError):
+            fleet.distributed_optimizer(popt.SGD(learning_rate=0.1))
