@@ -1,0 +1,174 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis (GPipe schedule).
+
+TPU-native replacement for the reference's program-splitting pipeline stack —
+PipelineOptimizer (python/paddle/fluid/optimizer.py:3695), the section
+program cutter (device_worker.py PipelineWorker) and the C++ SectionWorker
+microbatch thread loop (paddle/fluid/framework/section_worker.cc:82-230).
+The reference cuts a ProgramDesc into per-device section programs and streams
+microbatches through worker threads with explicit send/recv ops; here the
+whole schedule is ONE differentiable XLA computation:
+
+* the repeated block stack's parameters are **stacked** along a leading
+  stage axis ``[pp, layers_per_stage, ...]`` and shard_map'd over ``pipe``
+  (partial-manual: every other mesh axis stays GSPMD-auto, so TP/DP/ZeRO
+  shardings compose inside),
+* a ``lax.scan`` over ``M + pp - 1`` schedule ticks applies each device's
+  stage and rotates activations stage→stage with ``lax.ppermute`` (ICI
+  neighbor exchange — the send/recv pair of section_worker.cc, but
+  compiler-scheduled),
+* reverse-mode autodiff of that scan IS the backward pipeline: the ticks
+  replay in reverse with the transposed ppermute, i.e. a GPipe
+  fwd-all-then-bwd-all schedule with the same bubble fraction
+  ``(pp-1)/(M+pp-1)``.
+
+Parameters stay stored per-block (un-stacked), so optimizers, ZeRO slot
+sharding, checkpointing and state_dict round-trips are untouched; the stack
+is formed inside the jitted step where XLA turns the backward's unstack into
+slices of the scan-accumulated gradient.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..framework.errors import InvalidArgumentError
+from ..nn.layer_base import current_rng_key, functional_call
+from .mesh import get_mesh
+
+__all__ = ["pipeline_degree", "pipeline_blocks"]
+
+
+def pipeline_degree(mesh=None) -> int:
+    mesh = mesh or get_mesh()
+    return mesh.shape.get("pipe", 1)
+
+
+def _stack_block_params(blocks) -> Dict[str, jax.Array]:
+    """{param_name_within_block: [L, ...]} — the per-stage weight cube."""
+    names = [n for n, _ in blocks[0].named_parameters()]
+    per_block = [dict(b.named_parameters()) for b in blocks]
+    for i, bp in enumerate(per_block):
+        if set(bp) != set(names):
+            raise InvalidArgumentError(
+                f"pipeline stages must be structurally identical: block {i} "
+                f"parameters differ from block 0")
+    return {n: jnp.stack([bp[n].value for bp in per_block]) for n in names}
+
+
+def pipeline_blocks(
+    blocks: Sequence,
+    x: jax.Array,
+    *,
+    num_microbatches: Optional[int] = None,
+    mesh=None,
+    axis_name: str = "pipe",
+):
+    """Run ``x`` through ``blocks`` (a homogeneous Layer stack) pipelined
+    over the ``pipe`` mesh axis.  Semantically identical to
+
+        for b in blocks: x = b(x)
+
+    but executed as a GPipe microbatch schedule: stage ``s`` owns blocks
+    ``[s*L/pp, (s+1)*L/pp)`` and the batch is split into ``num_microbatches``
+    chunks that flow stage→stage over ICI.
+
+    Constraints: ``len(blocks) % pp == 0``; batch divisible by
+    ``num_microbatches``; blocks take/return a single activation and hold no
+    buffers (BatchNorm-free — transformer blocks qualify).
+    """
+    mesh = mesh or get_mesh()
+    pp = mesh.shape.get(axis_name, 1)
+    if pp == 1:
+        for b in blocks:
+            x = b(x)
+        return x
+
+    L = len(blocks)
+    if L % pp:
+        raise InvalidArgumentError(
+            f"pipeline: {L} blocks not divisible by pp={pp} stages")
+    template = blocks[0]
+    if list(template.named_buffers()):
+        raise InvalidArgumentError(
+            "pipeline blocks must be buffer-free (running-stat updates "
+            "cannot cross the stage scan); use LayerNorm, not BatchNorm")
+    per_stage = L // pp
+
+    M = int(num_microbatches or pp)
+    B = x.shape[0]
+    if B % M:
+        raise InvalidArgumentError(
+            f"pipeline: batch {B} not divisible by {M} microbatches")
+    mb = B // M
+
+    # per-(block, tick) dropout keys — matches the pp=1 semantics of "every
+    # block / every sample draws an independent mask"
+    training = bool(getattr(template, "training", False))
+    base_key = current_rng_key() if training else jax.random.PRNGKey(0)
+
+    stacked = _stack_block_params(blocks)
+    stacked = {
+        n: v.reshape((pp, per_stage) + v.shape[1:]) for n, v in stacked.items()
+    }
+
+    def block_fn(pdict, h, global_idx, tick):
+        key = jax.random.fold_in(
+            jax.random.fold_in(base_key, global_idx), tick)
+        return functional_call(template, pdict, h, rngs=key)
+
+    def local(stage_params, xin):
+        # in_spec P(pipe) leaves a leading length-1 stage dim — drop it:
+        # stage_params: {n: [per_stage, ...]}
+        stage_params = {n: v[0] for n, v in stage_params.items()}
+        stage = lax.axis_index(axis_name)
+        micro = xin.reshape((M, mb) + xin.shape[1:])
+        state = jnp.zeros((mb,) + xin.shape[1:], xin.dtype)
+        outputs = jnp.zeros_like(micro)
+
+        def apply_stage(h, t):
+            def body(h, idx_and_params):
+                j, pdict = idx_and_params
+                return block_fn(pdict, h, stage * per_stage + j, t), None
+
+            h, _ = lax.scan(body, h, (jnp.arange(per_stage), stage_params))
+            return h
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 injects the next microbatch (tail ticks re-feed the
+            # last one; its results never reach a valid output slot)
+            inject = lax.dynamic_index_in_dim(
+                micro, jnp.minimum(t, M - 1), axis=0, keepdims=False)
+            state = jnp.where(stage == 0, inject, state)
+            state = apply_stage(state, t)
+            out_idx = t - (pp - 1)
+            upd = lax.dynamic_update_index_in_dim(
+                outputs, state, jnp.maximum(out_idx, 0), axis=0)
+            valid = (out_idx >= 0) & (stage == pp - 1)
+            outputs = jnp.where(valid, upd, outputs)
+            state = lax.ppermute(
+                state, axis_name, [(i, (i + 1) % pp) for i in range(pp)])
+            return (state, outputs), None
+
+        (state, outputs), _ = lax.scan(
+            tick, (state, outputs), jnp.arange(M + pp - 1))
+        # hand the last stage's collected outputs to every pipe rank (the
+        # head/loss run replicated over pipe outside this shard_map)
+        outputs = lax.psum(
+            jnp.where(stage == pp - 1, outputs, jnp.zeros_like(outputs)),
+            axis_name)
+        return outputs.reshape(xin.shape)
+
+    shmapped = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=({n: P(axis_name) for n in stacked}, P()),
+        out_specs=P(),
+        axis_names={axis_name},
+        check_vma=False,
+    )
+    return shmapped(stacked, x)

@@ -151,6 +151,26 @@ class Model:
                         "strategy.recompute matched no block sublayers — "
                         "pass recompute_configs={'layer_classes': [...]}",
                         RuntimeWarning)
+            if strategy.pipeline or strategy.pp_degree > 1:
+                # reference: PipelineOptimizer (fluid/optimizer.py:3695) —
+                # here the block stack pipelines over the `pipe` mesh axis
+                # (distributed/pipeline_parallel.py); plumb the microbatch
+                # count to every pipeline-capable sublayer
+                pc = strategy.pipeline_configs or {}
+                micro = int(pc.get("accumulate_steps", 0)) or None
+                hits = 0
+                for sub in net.sublayers(include_self=True):
+                    if hasattr(sub, "pipeline_microbatches"):
+                        sub.pipeline_microbatches = micro
+                        hits += 1
+                if hits == 0:
+                    import warnings
+
+                    warnings.warn(
+                        "strategy.pipeline: no sublayer exposes a "
+                        "`pipeline_microbatches` knob — the model will not "
+                        "pipeline (GPTModel-style block stacks do)",
+                        RuntimeWarning)
             if strategy.sequence_parallel:
                 # route attention through ring/Ulysses over the sep axis
                 sp_cfg = strategy.sequence_parallel_configs or {}

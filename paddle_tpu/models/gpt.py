@@ -179,14 +179,38 @@ class GPTModel(Layer):
         self.drop = nn.Dropout(cfg.dropout)
         self.blocks = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
         self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        #: microbatch count for the pipeline schedule (None → pp); set by
+        #: Model.prepare from strategy.pipeline_configs["accumulate_steps"]
+        self.pipeline_microbatches = None
 
     def forward(self, input_ids, attn_mask=None):
+        from ..distributed.pipeline_parallel import (
+            pipeline_blocks,
+            pipeline_degree,
+        )
+
         B, S = input_ids.shape
         pos = jnp.arange(S, dtype=jnp.int32)[None, :]
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
-        for blk in self.blocks:
-            x = blk(x, attn_mask)
+        pp = pipeline_degree()
+        if pp > 1:
+            # embedding/head run replicated over `pipe`; the block stack is
+            # the pipelined section (see distributed/pipeline_parallel.py)
+            if attn_mask is not None:
+                raise ValueError(
+                    "pipeline parallelism supports the built-in causal mask "
+                    "only (a per-batch attn_mask cannot microbatch-split)")
+            if any(b.attn.sequence_parallel for b in self.blocks):
+                raise ValueError(
+                    "pipeline (pp>1) and sequence parallelism cannot combine "
+                    "yet — ring/Ulysses attention opens its own shard_map")
+            x = pipeline_blocks(
+                self.blocks, x,
+                num_microbatches=self.pipeline_microbatches)
+        else:
+            for blk in self.blocks:
+                x = blk(x, attn_mask)
         return self.ln_f(x)
 
 

@@ -1,0 +1,150 @@
+"""Pipeline parallelism on the 8-device CPU mesh.
+
+Reference capability: PipelineOptimizer (python/paddle/fluid/optimizer.py:3695)
++ SectionWorker (paddle/fluid/framework/section_worker.cc:82) — microbatch
+scheduling across pipeline stages.  Here: GPipe via shard_map over the `pipe`
+axis (distributed/pipeline_parallel.py); these tests assert exactness vs the
+un-pipelined stack, gradient parity, and the hybrid pp×dp×tp training path.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as popt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.mesh import build_mesh, set_mesh
+from paddle_tpu.distributed.pipeline_parallel import pipeline_blocks
+from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    set_mesh(build_mesh())
+    yield
+    set_mesh(build_mesh())
+    fleet._initialized = False
+    fleet._strategy = None
+
+
+def _train_gpt(pp, dp, mp, steps=3, micro=None, seed=0):
+    """Train a tiny GPT under the given hybrid degrees; return losses."""
+    fleet._initialized = False
+    strategy = fleet.DistributedStrategy(
+        dp_degree=dp, pp_degree=pp,
+        pipeline=pp > 1,
+        pipeline_configs={"accumulate_steps": micro} if micro else {},
+        tensor_parallel=mp > 1,
+        tensor_parallel_configs={"tensor_parallel_degree": mp},
+    )
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(seed)
+    net = GPTForCausalLM(gpt_tiny(num_layers=4))
+    opt = fleet.distributed_optimizer(popt.Adam(learning_rate=1e-3))
+    model = paddle.Model(net)
+    model.prepare(optimizer=opt, loss=net.loss)
+    rng = np.random.RandomState(7)
+    losses = []
+    for _ in range(steps):
+        ids = rng.randint(0, net.gpt.cfg.vocab_size, size=(8, 16)).astype(np.int32)
+        loss, _ = model.train_batch([ids], [ids])
+        losses.append(loss)
+    return np.asarray(losses)
+
+
+class TestPipelineBlocks:
+    def test_forward_exact_vs_sequential(self):
+        """pipeline_blocks == plain loop, bit-for-bit on f32 CPU."""
+        set_mesh(build_mesh(pp=4))
+        paddle.seed(0)
+        blocks = nn.LayerList([nn.Linear(16, 16) for _ in range(8)])
+        for b in blocks:
+            b.eval()
+        x = jnp.asarray(np.random.RandomState(1).randn(12, 16), jnp.float32)
+
+        want = x
+        for b in blocks:
+            want = b(want)
+        got = jax.jit(
+            lambda xx: pipeline_blocks(blocks, xx, num_microbatches=3))(x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_gradient_parity(self):
+        """Grads through the pipeline schedule match the sequential stack."""
+        set_mesh(build_mesh(pp=2))
+        paddle.seed(0)
+        blocks = nn.LayerList([nn.Linear(8, 8) for _ in range(4)])
+        for b in blocks:
+            b.eval()
+        x = jnp.asarray(np.random.RandomState(2).randn(4, 8), jnp.float32)
+        params = {n: p.value for n, p in blocks.named_parameters()}
+
+        def run(fn):
+            def loss(ps):
+                boxes = dict(blocks.named_parameters())
+                saved = {n: b.value for n, b in boxes.items()}
+                try:
+                    for n, v in ps.items():
+                        boxes[n].value = v
+                    h = fn(x)
+                finally:
+                    for n, v in saved.items():
+                        boxes[n].value = v
+                return (h ** 2).mean()
+
+            return jax.jit(jax.value_and_grad(loss))(params)
+
+        v_seq, g_seq = run(lambda xx: _apply_seq(blocks, xx))
+        v_pp, g_pp = run(lambda xx: pipeline_blocks(blocks, xx,
+                                                    num_microbatches=2))
+        np.testing.assert_allclose(float(v_pp), float(v_seq), rtol=1e-6)
+        for n in g_seq:
+            np.testing.assert_allclose(np.asarray(g_pp[n]),
+                                       np.asarray(g_seq[n]),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_bad_divisibility_raises(self):
+        set_mesh(build_mesh(pp=4))
+        blocks = nn.LayerList([nn.Linear(4, 4) for _ in range(6)])
+        x = jnp.zeros((4, 4))
+        with pytest.raises(Exception, match="not divisible"):
+            pipeline_blocks(blocks, x)
+        set_mesh(build_mesh(pp=2))
+        blocks = nn.LayerList([nn.Linear(4, 4) for _ in range(2)])
+        with pytest.raises(Exception, match="microbatch"):
+            pipeline_blocks(blocks, jnp.zeros((5, 4)), num_microbatches=2)
+
+
+def _apply_seq(blocks, x):
+    for b in blocks:
+        x = b(x)
+    return x
+
+
+class TestPipelineGPT:
+    def test_pp2_loss_parity_vs_pp1(self):
+        """tiny-GPT pp=2 trains with per-step loss parity vs pp=1."""
+        ref = _train_gpt(pp=1, dp=8, mp=1)
+        got = _train_gpt(pp=2, dp=4, mp=1, micro=2)
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+    def test_pp2_dp2_tp2_hybrid(self):
+        """The VERDICT acceptance config: pp=2 × dp=2 × tp=2 trains and
+        matches the pure-DP trajectory."""
+        ref = _train_gpt(pp=1, dp=8, mp=1)
+        got = _train_gpt(pp=2, dp=2, mp=2, micro=2)
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+    def test_microbatch_count_plumbed(self):
+        fleet._initialized = False
+        strategy = fleet.DistributedStrategy(
+            dp_degree=4, pp_degree=2, pipeline=True,
+            pipeline_configs={"accumulate_steps": 4})
+        fleet.init(is_collective=True, strategy=strategy)
+        net = GPTForCausalLM(gpt_tiny(num_layers=2))
+        opt = fleet.distributed_optimizer(popt.Adam(learning_rate=1e-3))
+        model = paddle.Model(net)
+        model.prepare(optimizer=opt, loss=net.loss)
+        assert net.gpt.pipeline_microbatches == 4
