@@ -51,8 +51,21 @@ class Model:
     """
 
     def __init__(self, network: Layer, inputs=None, labels=None):
+        from ..static import InputSpec
+
         self.network = network
         self._n_inputs = len(_tuplize(inputs)) if inputs is not None else None
+        # shape-carrying entries (InputSpec or example tensors) enable
+        # save(training=False); name-only specs don't.  All-or-nothing:
+        # a partial spec list would export with the wrong arity.
+        self._input_specs = None
+        if inputs is not None:
+            ins = _tuplize(inputs)
+            specs = [s for s in ins
+                     if isinstance(s, InputSpec)
+                     or (hasattr(s, "shape") and hasattr(s, "dtype"))]
+            if len(specs) == len(ins):
+                self._input_specs = specs
         self._n_labels = len(_tuplize(labels)) if labels is not None else 1
         self._optimizer: Optional[Optimizer] = None
         self._loss = None
@@ -433,9 +446,23 @@ class Model:
         return outputs
 
     # -- persistence ---------------------------------------------------------
-    def save(self, path: str, training: bool = True):
-        """Writes ``path.pdparams`` (+ ``path.pdopt`` when training).
+    def save(self, path: str, training: bool = True, input_spec=None):
+        """``training=True``: writes ``path.pdparams`` (+ ``path.pdopt``).
+        ``training=False``: exports an AOT inference module
+        (``path.pdmodel`` + ``path.pdiparams`` — see paddle_tpu.inference;
+        reference: hapi Model.save → paddle.jit.save, hapi/model.py:1004).
         serialization.save creates parent directories itself."""
+        if not training:
+            from ..inference import save_inference_model
+
+            spec = input_spec or self._input_specs
+            if spec is None:
+                raise InvalidArgumentError(
+                    "save(training=False) needs input shapes: pass "
+                    "input_spec=[InputSpec(...)] here or declare them in "
+                    "Model(inputs=[InputSpec(...)])")
+            save_inference_model(path, self.network, spec)
+            return
         serialization.save(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:
             opt_state = {"state": jax.tree_util.tree_map(np.asarray, self._opt_state)} \

@@ -1,0 +1,239 @@
+"""Inference export and serving — the AOT saved-module path.
+
+Parity: the reference's entire inference stack —
+``save_inference_model`` (python/paddle/fluid/io.py:1164: prune the train
+Program to feed→fetch, save ``__model__`` + params) and the C++
+AnalysisPredictor (paddle/fluid/inference/api/analysis_predictor.h:82:
+load, run IR optimization passes, execute with zero-copy tensors).
+
+TPU-native design: there is no Program to prune and no pass pipeline to
+run — the eval-mode forward is traced once, lowered to StableHLO with
+``jax.export`` (batch-polymorphic via symbolic dims), and serialized as a
+versioned portable artifact.  XLA *is* the analysis/optimization pipeline,
+applied at load time for whatever device the predictor lands on (the
+artifact is multi-platform: tpu + cpu by default).  Weights ride in a
+separate ``.pdiparams`` file in the framework checkpoint format, so a
+served model can hot-swap weights without re-export.
+
+Files written for prefix ``P``:
+  P.pdmodel    — magic/version header + meta JSON + serialized StableHLO
+  P.pdiparams  — params + buffers state (framework/serialization format)
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..framework import serialization
+from ..framework.errors import InvalidArgumentError, NotFoundError
+from ..nn.layer_base import Layer, functional_call
+from ..static import InputSpec
+
+__all__ = [
+    "save_inference_model",
+    "load_inference_model",
+    "Config",
+    "Predictor",
+    "create_predictor",
+]
+
+_MAGIC = b"PTPUIM01"
+
+
+def _as_input_specs(input_spec) -> List[InputSpec]:
+    specs = []
+    for i, s in enumerate(input_spec):
+        if isinstance(s, InputSpec):
+            specs.append(s if s.name else InputSpec(s.shape, s.dtype, f"x{i}"))
+        elif hasattr(s, "shape") and hasattr(s, "dtype"):
+            specs.append(InputSpec.from_tensor(s, name=f"x{i}"))
+        else:
+            raise InvalidArgumentError(
+                f"input_spec[{i}] must be an InputSpec or tensor, got "
+                f"{type(s).__name__}")
+    return specs
+
+
+def save_inference_model(
+    path_prefix: str,
+    layer: Layer,
+    input_spec: Sequence,
+    *,
+    platforms: Optional[Sequence[str]] = None,
+) -> str:
+    """Export ``layer``'s eval-mode forward as an AOT saved module.
+
+    ``input_spec``: one InputSpec (or example tensor) per forward input;
+    ``None``/-1 dims are batch-polymorphic.  ``platforms`` defaults to
+    ``("cpu", "tpu")`` so the artifact serves on either; pass e.g.
+    ``("cpu",)`` to shrink it.
+    """
+    from jax import export as jexport
+
+    if not isinstance(layer, Layer):
+        raise InvalidArgumentError("save_inference_model expects a Layer")
+    specs = _as_input_specs(input_spec)
+    platforms = tuple(platforms or ("cpu", "tpu"))
+
+    was_training = layer.training
+    layer.eval()
+    try:
+        params = layer.param_pytree()
+        buffers = layer.buffer_pytree()
+
+        def fn(params, buffers, *inputs):
+            return functional_call(layer, params, *inputs, buffers=buffers,
+                                   training=False)
+
+        from ..static import make_symbols
+
+        symbols = make_symbols(specs)  # one scope for ALL dynamic dims
+        in_shapes = tuple(s.shape_dtype(symbols) for s in specs)
+        p_shapes = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+        b_shapes = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), buffers)
+        exported = jexport.export(jax.jit(fn), platforms=list(platforms))(
+            p_shapes, b_shapes, *in_shapes)
+        blob = exported.serialize()
+    finally:
+        if was_training:
+            layer.train()
+
+    meta = {
+        "format_version": 1,
+        "platforms": list(platforms),
+        "inputs": [
+            {"name": s.name, "shape": [d if d is not None else -1
+                                       for d in s.shape],
+             "dtype": str(np.dtype(s.dtype))}
+            for s in specs
+        ],
+        "n_outputs": len(exported.out_avals),
+    }
+    meta_bytes = json.dumps(meta).encode()
+
+    parent = os.path.dirname(os.path.abspath(path_prefix))
+    os.makedirs(parent, exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<I", len(meta_bytes)))
+        f.write(meta_bytes)
+        f.write(blob)
+    serialization.save({"params": params, "buffers": buffers},
+                       path_prefix + ".pdiparams")
+    return path_prefix
+
+
+def _read_model_file(path: str):
+    if not os.path.exists(path):
+        raise NotFoundError(f"no inference model at {path}")
+    with open(path, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise InvalidArgumentError(
+                f"{path} is not a paddle_tpu inference model (bad magic "
+                f"{magic!r}); train checkpoints load via paddle_tpu.load")
+        try:
+            (n,) = struct.unpack("<I", f.read(4))
+            meta = json.loads(f.read(n).decode())
+        except (struct.error, ValueError, UnicodeDecodeError) as e:
+            raise InvalidArgumentError(
+                f"{path} is truncated or corrupt (unreadable header): {e}")
+        blob = f.read()
+    return meta, blob
+
+
+class Config:
+    """Predictor configuration (reference: inference/api/paddle_analysis_config.h).
+
+    The IR/pass toggles of the reference config have no meaning here (XLA
+    compiles at load); the surviving knobs are file locations and device
+    choice.
+    """
+
+    def __init__(self, model_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if model_file and model_file.endswith(".pdmodel"):
+            model_file = model_file[: -len(".pdmodel")]
+        self.prefix = model_file
+        self.params_file = params_file
+        self.device: Optional[str] = None
+
+    def set_prog_file(self, path: str):
+        self.prefix = path[: -len(".pdmodel")] if path.endswith(".pdmodel") else path
+
+    def enable_use_gpu(self, *a, **k):  # parity no-op: device comes from jax
+        self.device = "tpu"
+
+    def disable_gpu(self):
+        self.device = "cpu"
+
+
+class Predictor:
+    """Loaded AOT module + weights; runs on the current jax device.
+
+    Reference: AnalysisPredictor (inference/api/analysis_predictor.h:82) —
+    minus the pass pipeline (XLA recompiles the portable StableHLO for the
+    local device on first run, then caches).
+    """
+
+    def __init__(self, path_prefix: str, device: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        from jax import export as jexport
+
+        self._meta, blob = _read_model_file(path_prefix + ".pdmodel")
+        exported = jexport.deserialize(blob)
+        params_path = params_file or path_prefix + ".pdiparams"
+        state = serialization.load(params_path)
+        if not isinstance(state, dict) or "params" not in state:
+            raise InvalidArgumentError(
+                f"{params_path} is not an inference params file")
+        self._params = jax.tree_util.tree_map(np.asarray, state["params"])
+        self._buffers = jax.tree_util.tree_map(np.asarray, state.get("buffers", {}))
+        if device is not None:
+            try:
+                dev = jax.devices(device)[0]
+            except Exception:
+                raise InvalidArgumentError(
+                    f"no {device!r} device available for this predictor "
+                    f"(have: {[d.platform for d in jax.devices()]})")
+            self._params = jax.device_put(self._params, dev)
+            self._buffers = jax.device_put(self._buffers, dev)
+        self._call = jax.jit(exported.call)
+
+    # -- paddle inference api surface ---------------------------------------
+    def get_input_names(self) -> List[str]:
+        return [i["name"] for i in self._meta["inputs"]]
+
+    def get_num_outputs(self) -> int:
+        return self._meta["n_outputs"]
+
+    def run(self, inputs: Sequence) -> List[np.ndarray]:
+        """numpy in → numpy out (zero-copy staging is jax's concern)."""
+        ins = [np.asarray(x) for x in inputs]
+        declared = self._meta["inputs"]
+        if len(ins) != len(declared):
+            raise InvalidArgumentError(
+                f"predictor takes {len(declared)} inputs "
+                f"({[d['name'] for d in declared]}), got {len(ins)}")
+        out = self._call(self._params, self._buffers, *ins)
+        flat = jax.tree_util.tree_leaves(out)
+        return [np.asarray(o) for o in flat]
+
+
+def create_predictor(config: Config) -> Predictor:
+    if not config.prefix:
+        raise InvalidArgumentError("Config has no model file set")
+    return Predictor(config.prefix, device=config.device,
+                     params_file=config.params_file)
+
+
+def load_inference_model(path_prefix: str) -> Predictor:
+    """Convenience loader (reference: fluid/io.py load_inference_model)."""
+    return Predictor(path_prefix)

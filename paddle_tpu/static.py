@@ -1,0 +1,89 @@
+"""paddle.static compatibility surface — InputSpec.
+
+Parity: python/paddle/static/input.py (InputSpec) / fluid/data.py:23 —
+the declarative tensor signature used to declare feed slots for inference
+export.  TPU-native: an InputSpec lowers to a ``jax.ShapeDtypeStruct``
+whose ``None`` dims become ``jax.export`` symbolic dimensions, so one
+exported artifact serves any batch size (the reference's -1 batch dim).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from .framework.dtype import convert_dtype
+
+__all__ = ["InputSpec", "make_symbols"]
+
+
+class InputSpec:
+    """Declarative (shape, dtype, name) signature of a model input.
+
+    ``None`` / ``-1`` dims are dynamic (batch-polymorphic at export).
+    """
+
+    def __init__(self, shape: Sequence[Optional[int]], dtype="float32",
+                 name: Optional[str] = None):
+        # a str dim is a NAMED symbolic size — two specs using the same
+        # name share it (e.g. both inputs' batch dim "b"), which is how
+        # shapes that must broadcast/match declare it at export time
+        self.shape = tuple(
+            d if isinstance(d, str)
+            else None if d in (None, -1)
+            else int(d)
+            for d in shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, tensor, name: Optional[str] = None) -> "InputSpec":
+        t = np.asarray(tensor) if not isinstance(tensor, jax.Array) else tensor
+        return cls(t.shape, t.dtype, name)
+
+    def symbol_names(self):
+        """One symbol name per dynamic dim: the declared name for str dims,
+        an auto-generated unique one for None/-1 dims."""
+        out = []
+        for i, d in enumerate(self.shape):
+            if isinstance(d, str):
+                out.append(d)
+            elif d is None:
+                out.append(f"d_{self.name or 'in'}_{i}".replace("-", "_"))
+        return out
+
+    def shape_dtype(self, symbols=None) -> jax.ShapeDtypeStruct:
+        """Lower to a ShapeDtypeStruct.  ``symbols`` maps symbol name →
+        symbolic dim; ALL dynamic dims of a multi-input export must come
+        from ONE ``jax.export.symbolic_shape`` call (one scope) — see
+        ``make_symbols``.  Called with ``symbols=None``, a private
+        single-scope set is created for this spec alone."""
+        if symbols is None:
+            symbols = make_symbols([self])
+        dims = []
+        names = iter(self.symbol_names())
+        for d in self.shape:
+            dims.append(d if isinstance(d, int) else symbols[next(names)])
+        return jax.ShapeDtypeStruct(tuple(dims), self.dtype)
+
+
+def make_symbols(specs) -> dict:
+    """Create every dynamic dim of ``specs`` in one shared symbolic scope
+    (jax.export requires all symbols of an export to share a scope; two
+    specs reusing a name intentionally share that size)."""
+    from jax import export as jexport
+
+    names = []
+    for s in specs:
+        for n in s.symbol_names():
+            if n not in names:
+                names.append(n)
+    if not names:
+        return {}
+    dims = jexport.symbolic_shape(", ".join(names))
+    return dict(zip(names, dims))
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name!r})")

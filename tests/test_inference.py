@@ -1,0 +1,207 @@
+"""Inference export / predictor round-trips.
+
+Reference capability: save_inference_model (fluid/io.py:1164) +
+AnalysisPredictor (inference/api/analysis_predictor.h:82).  Here: AOT
+StableHLO export via jax.export (paddle_tpu/inference) — tests cover the
+save→load→run round-trip, batch polymorphism, output parity with the live
+Layer, Model.save(training=False), and error paths.
+"""
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.framework.errors import InvalidArgumentError
+from paddle_tpu.inference import (
+    Config,
+    Predictor,
+    create_predictor,
+    load_inference_model,
+    save_inference_model,
+)
+from paddle_tpu.static import InputSpec
+from paddle_tpu.vision.models import LeNet
+
+
+def _mlp():
+    paddle.seed(0)
+    return nn.Sequential(
+        nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+class TestSaveLoad:
+    def test_round_trip_output_parity(self, tmp_path):
+        net = _mlp()
+        x = np.random.RandomState(0).randn(6, 8).astype(np.float32)
+        want = np.asarray(net(jnp.asarray(x)))
+
+        prefix = os.path.join(tmp_path, "mlp")
+        save_inference_model(prefix, net, [InputSpec([None, 8], "float32")],
+                             platforms=("cpu",))
+        assert os.path.exists(prefix + ".pdmodel")
+        assert os.path.exists(prefix + ".pdiparams")
+
+        pred = load_inference_model(prefix)
+        (got,) = pred.run([x])
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    def test_batch_polymorphic(self, tmp_path):
+        net = _mlp()
+        prefix = os.path.join(tmp_path, "mlp")
+        save_inference_model(prefix, net, [InputSpec([None, 8], "float32")],
+                             platforms=("cpu",))
+        pred = load_inference_model(prefix)
+        for b in (1, 3, 17):
+            (out,) = pred.run([np.zeros((b, 8), np.float32)])
+            assert out.shape == (b, 4)
+
+    def test_export_is_eval_mode(self, tmp_path):
+        """Dropout must be OFF in the exported graph even if the layer was
+        in train mode at save time (reference prunes to test program)."""
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.9))
+        net.train()
+        prefix = os.path.join(tmp_path, "drop")
+        save_inference_model(prefix, net, [InputSpec([None, 4], "float32")],
+                             platforms=("cpu",))
+        assert net.training  # restored
+        pred = load_inference_model(prefix)
+        x = np.ones((5, 4), np.float32)
+        a, b = pred.run([x])[0], pred.run([x])[0]
+        np.testing.assert_array_equal(a, b)
+
+    def test_weights_ride_separately(self, tmp_path):
+        """Hot-swapping .pdiparams changes predictions without re-export."""
+        net = _mlp()
+        prefix = os.path.join(tmp_path, "mlp")
+        save_inference_model(prefix, net, [InputSpec([None, 8], "float32")],
+                             platforms=("cpu",))
+        x = np.random.RandomState(1).randn(2, 8).astype(np.float32)
+        (before,) = load_inference_model(prefix).run([x])
+        # zero all weights, save params only (re-save over the same prefix)
+        from paddle_tpu.framework import serialization
+
+        state = serialization.load(prefix + ".pdiparams")
+        state["params"] = {k: np.zeros_like(v)
+                          for k, v in state["params"].items()}
+        serialization.save(state, prefix + ".pdiparams")
+        (after,) = load_inference_model(prefix).run([x])
+        assert not np.allclose(before, after)
+        np.testing.assert_allclose(after, 0.0, atol=1e-6)
+
+    def test_conv_model_exports(self, tmp_path):
+        net = LeNet()
+        net.eval()
+        prefix = os.path.join(tmp_path, "lenet")
+        save_inference_model(prefix, net,
+                             [InputSpec([None, 1, 28, 28], "float32")],
+                             platforms=("cpu",))
+        pred = load_inference_model(prefix)
+        (out,) = pred.run([np.zeros((2, 1, 28, 28), np.float32)])
+        assert out.shape == (2, 10)
+
+
+class TestPredictorAPI:
+    def test_config_create_predictor(self, tmp_path):
+        net = _mlp()
+        prefix = os.path.join(tmp_path, "m")
+        save_inference_model(prefix, net, [InputSpec([None, 8], "float32")],
+                             platforms=("cpu",))
+        cfg = Config(prefix + ".pdmodel", prefix + ".pdiparams")
+        pred = create_predictor(cfg)
+        assert pred.get_input_names() == ["x0"]
+        assert pred.get_num_outputs() == 1
+
+    def test_wrong_arity_raises(self, tmp_path):
+        net = _mlp()
+        prefix = os.path.join(tmp_path, "m")
+        save_inference_model(prefix, net, [InputSpec([None, 8], "float32")],
+                             platforms=("cpu",))
+        pred = load_inference_model(prefix)
+        with pytest.raises(InvalidArgumentError, match="takes 1 inputs"):
+            pred.run([np.zeros((2, 8), np.float32)] * 2)
+
+    def test_bad_magic_rejected(self, tmp_path):
+        p = os.path.join(tmp_path, "junk.pdmodel")
+        with open(p, "wb") as f:
+            f.write(b"NOTAMODEL")
+        with pytest.raises(InvalidArgumentError, match="bad magic"):
+            Predictor(os.path.join(tmp_path, "junk"))
+
+    def test_truncated_header_rejected(self, tmp_path):
+        p = os.path.join(tmp_path, "trunc.pdmodel")
+        with open(p, "wb") as f:
+            f.write(b"PTPUIM01\x02")  # magic + half a length field
+        with pytest.raises(InvalidArgumentError, match="truncated or corrupt"):
+            Predictor(os.path.join(tmp_path, "trunc"))
+
+    def test_separate_params_file_honored(self, tmp_path):
+        net = _mlp()
+        prefix = os.path.join(tmp_path, "m")
+        save_inference_model(prefix, net, [InputSpec([None, 8], "float32")],
+                             platforms=("cpu",))
+        other = os.path.join(tmp_path, "weights.pdiparams")
+        os.rename(prefix + ".pdiparams", other)
+        cfg = Config(prefix + ".pdmodel", other)
+        pred = create_predictor(cfg)
+        (out,) = pred.run([np.zeros((2, 8), np.float32)])
+        assert out.shape == (2, 4)
+
+    def test_multi_input_multi_dynamic_dims(self, tmp_path):
+        """Two inputs, each with a dynamic batch AND a dynamic feature-like
+        dim, must export under one symbolic scope."""
+        paddle.seed(0)
+
+        class TwoIn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 4)
+
+            def forward(self, a, b):
+                return self.fc(a) + b.sum(axis=1, keepdims=True)
+
+        net = TwoIn()
+        prefix = os.path.join(tmp_path, "two")
+        save_inference_model(
+            prefix, net,
+            [InputSpec(["batch", 8], "float32", "a"),
+             InputSpec(["batch", None], "float32", "b")],
+            platforms=("cpu",))
+        pred = load_inference_model(prefix)
+        (out,) = pred.run([np.ones((3, 8), np.float32),
+                           np.ones((3, 5), np.float32)])
+        assert out.shape == (3, 4)
+
+
+class TestModelSave:
+    def test_model_save_inference(self, tmp_path):
+        net = _mlp()
+        model = paddle.Model(net, inputs=[InputSpec([None, 8], "float32")])
+        prefix = os.path.join(tmp_path, "m")
+        model.save(prefix, training=False)
+        pred = load_inference_model(prefix)
+        (out,) = pred.run([np.zeros((3, 8), np.float32)])
+        assert out.shape == (3, 4)
+
+    def test_model_save_without_spec_raises(self, tmp_path):
+        model = paddle.Model(_mlp())
+        with pytest.raises(InvalidArgumentError, match="input shapes"):
+            model.save(os.path.join(tmp_path, "m"), training=False)
+
+    def test_model_example_tensor_inputs(self, tmp_path):
+        """Example tensors (not InputSpec) also carry export shapes."""
+        net = _mlp()
+        model = paddle.Model(net, inputs=[np.zeros((2, 8), np.float32)])
+        prefix = os.path.join(tmp_path, "m")
+        model.save(prefix, training=False)
+        (out,) = load_inference_model(prefix).run(
+            [np.zeros((2, 8), np.float32)])
+        assert out.shape == (2, 4)
+
+    def test_model_name_only_inputs_still_raise(self, tmp_path):
+        model = paddle.Model(_mlp(), inputs=["input_ids"])
+        with pytest.raises(InvalidArgumentError, match="input shapes"):
+            model.save(os.path.join(tmp_path, "m"), training=False)
