@@ -10,6 +10,10 @@ from .gpt import (  # noqa: F401
     gpt_tiny,
     gpt_small,
 )
+from .wide_deep import (  # noqa: F401
+    WideDeep,
+    wide_deep_tiny,
+)
 from .bert import (  # noqa: F401
     BertConfig,
     BertModel,
