@@ -77,6 +77,7 @@ from . import metric  # noqa: F401
 from . import models  # noqa: F401
 from . import vision  # noqa: F401
 from . import hapi  # noqa: F401
+from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
 from . import static  # noqa: F401
 from .static import InputSpec  # noqa: F401

@@ -1,0 +1,3 @@
+"""paddle_tpu.incubate — graduated-API staging area (reference:
+python/paddle/fluid/incubate/)."""
+from . import checkpoint  # noqa: F401

@@ -1,0 +1,236 @@
+"""Auto-checkpoint: periodic async snapshots + preemption resume.
+
+Parity: python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py:265
+(``TrainEpochRange`` — wrap the epoch loop, checkpoint train state to a
+fault-tolerant store, transparently resume after a kill) and the
+CheckpointSaver there.  TPU-native differences:
+
+* the snapshot is materialized to **host numpy synchronously** (device
+  buffers are donated by the next train step — they cannot be read later),
+  then written by a background thread so the device never waits on disk;
+* one checkpoint = one directory, committed by writing ``meta`` LAST via
+  the serialization module's atomic tmp+rename — a preemption mid-write
+  leaves a meta-less directory that resume skips;
+* everything rides the framework checkpoint format (serialization.py), so
+  the files double as ordinary ``Model.load``-able artifacts.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import shutil
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..framework import random as _random
+from ..framework import serialization
+from ..framework.errors import InvalidArgumentError
+
+__all__ = ["AutoCheckpoint", "train_epoch_range"]
+
+_META = "meta.pdmeta"
+_PARAMS = "m.pdparams"
+_OPT = "m.pdopt"
+_PREFIX = "ckpt-"
+
+
+def _host(tree):
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+class AutoCheckpoint:
+    """Periodic checkpointing for a ``paddle_tpu.Model``.
+
+    >>> acp = AutoCheckpoint(model, "ckpts", save_steps=100)
+    >>> state = acp.resume()            # None on a fresh run
+    >>> for epoch in range(start, n):
+    ...     for batch in loader:
+    ...         model.train_batch(...)
+    ...         acp.step(epoch)         # async save every save_steps
+    ...     acp.epoch_end(epoch)
+    >>> acp.close()
+    """
+
+    def __init__(self, model, save_dir: str, save_steps: Optional[int] = None,
+                 keep_max: int = 3, async_save: bool = True):
+        if keep_max < 1:
+            raise InvalidArgumentError("keep_max must be >= 1")
+        self.model = model
+        self.save_dir = os.fspath(save_dir)
+        self.save_steps = save_steps
+        self.keep_max = keep_max
+        self.async_save = async_save
+        self._counter = 0      # monotonic checkpoint id
+        self._global_step = 0
+        # bounded: save() applies back-pressure rather than queueing an
+        # unbounded pile of full host snapshots when disk is the bottleneck
+        self._q: "queue.Queue" = queue.Queue(maxsize=2)
+        self._worker: Optional[threading.Thread] = None
+        self._worker_err: Optional[BaseException] = None
+
+    # -- write path ----------------------------------------------------------
+    def _snapshot(self, epoch: int) -> Dict[str, Any]:
+        """Host-side copy of the full train state (sync — see module doc)."""
+        model = self.model
+        params = _host(model.network.state_dict())
+        opt: Dict[str, Any] = {}
+        if getattr(model, "_opt_state", None) is not None:
+            opt["state"] = _host(model._opt_state)
+        optimizer = getattr(model, "_optimizer", None)
+        if optimizer is not None:
+            sched = optimizer.lr_scheduler
+            if sched is not None:
+                opt["LR_Scheduler"] = sched.state_dict()
+            else:
+                opt["lr"] = optimizer.get_lr()
+        meta = {
+            "epoch": int(epoch),
+            "global_step": int(self._global_step),
+            "counter": int(self._counter),
+            "kind": "step",  # save()/epoch_end overwrite as appropriate
+            "rng_state": _random.default_generator().get_state(),
+        }
+        return {"params": params, "opt": opt, "meta": meta}
+
+    def _write(self, snap: Dict[str, Any]):
+        name = f"{_PREFIX}{snap['meta']['counter']:010d}"
+        d = os.path.join(self.save_dir, name)
+        os.makedirs(d, exist_ok=True)
+        serialization.save(snap["params"], os.path.join(d, _PARAMS))
+        serialization.save(snap["opt"], os.path.join(d, _OPT))
+        # meta LAST: its presence commits the checkpoint
+        serialization.save(snap["meta"], os.path.join(d, _META))
+        self._prune()
+
+    def _prune(self):
+        done = sorted(
+            n for n in os.listdir(self.save_dir)
+            if n.startswith(_PREFIX)
+            and os.path.exists(os.path.join(self.save_dir, n, _META)))
+        for n in done[: -self.keep_max]:
+            shutil.rmtree(os.path.join(self.save_dir, n), ignore_errors=True)
+
+    def _worker_loop(self):
+        while True:
+            snap = self._q.get()
+            if snap is None:
+                return
+            try:
+                self._write(snap)
+            except BaseException as e:  # surfaced on next save()/close()
+                self._worker_err = e
+
+    def save(self, epoch: int, kind: str = "step"):
+        """Snapshot now (host copy sync, file write async)."""
+        if self._worker_err is not None:
+            err, self._worker_err = self._worker_err, None
+            raise err
+        snap = self._snapshot(epoch)
+        self._counter += 1
+        snap["meta"]["counter"] = self._counter
+        snap["meta"]["kind"] = kind
+        if self.async_save:
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._worker_loop, daemon=True)
+                self._worker.start()
+            self._q.put(snap)
+        else:
+            self._write(snap)
+
+    def step(self, epoch: int):
+        """Count one train step; save when save_steps divides the count."""
+        self._global_step += 1
+        if self.save_steps and self._global_step % self.save_steps == 0:
+            self.save(epoch)
+
+    def epoch_end(self, epoch: int):
+        self.save(epoch, kind="epoch_end")
+
+    def close(self):
+        """Drain pending writes (call before process exit)."""
+        if self._worker is not None:
+            self._q.put(None)
+            self._worker.join()
+            self._worker = None
+        if self._worker_err is not None:
+            err, self._worker_err = self._worker_err, None
+            raise err
+
+    # -- read path -----------------------------------------------------------
+    def latest_dir(self) -> Optional[str]:
+        if not os.path.isdir(self.save_dir):
+            return None
+        done = sorted(
+            n for n in os.listdir(self.save_dir)
+            if n.startswith(_PREFIX)
+            and os.path.exists(os.path.join(self.save_dir, n, _META)))
+        return os.path.join(self.save_dir, done[-1]) if done else None
+
+    def resume(self) -> Optional[Dict[str, Any]]:
+        """Load the newest committed checkpoint into the model; returns its
+        meta ({'epoch', 'global_step', ...}) or None on a fresh run."""
+        d = self.latest_dir()
+        if d is None:
+            return None
+        import jax.numpy as jnp
+
+        model = self.model
+        params = serialization.load(os.path.join(d, _PARAMS))
+        not_in_ckpt = [n for n in model.network.state_dict() if n not in params]
+        if not_in_ckpt:
+            raise InvalidArgumentError(
+                f"checkpoint {d} lacks model state {not_in_ckpt[:5]} — "
+                f"resuming would mix restored weights with fresh init")
+        unmatched = model.network.set_state_dict(params)
+        if unmatched:
+            raise InvalidArgumentError(
+                f"checkpoint {d} has keys the model lacks: {unmatched[:5]}")
+        opt = serialization.load(os.path.join(d, _OPT))
+        if "state" in opt:
+            model._opt_state = jax.tree_util.tree_map(jnp.asarray, opt["state"])
+        optimizer = getattr(model, "_optimizer", None)
+        if optimizer is not None:
+            if optimizer.lr_scheduler is not None and "LR_Scheduler" in opt:
+                optimizer.lr_scheduler.set_state_dict(opt["LR_Scheduler"])
+            elif optimizer.lr_scheduler is None and "lr" in opt:
+                optimizer.set_lr(float(opt["lr"]))
+        meta = serialization.load(os.path.join(d, _META))
+        if meta.get("rng_state"):
+            _random.default_generator().set_state(meta["rng_state"])
+        self._counter = int(meta["counter"])
+        self._global_step = int(meta["global_step"])
+        return meta
+
+
+def train_epoch_range(max_epoch: int, model, save_dir: str,
+                      save_steps: Optional[int] = None, keep_max: int = 3):
+    """Resumable epoch loop (reference: acp.train_epoch_range,
+    auto_checkpoint.py:265).  Yields ``(epoch, acp)`` starting after the
+    last *completed* epoch; checkpoints at each epoch end and drains writes
+    when the range completes.  Resuming from a mid-epoch ``step()`` save
+    re-enters THAT epoch (its remaining batches would otherwise be skipped);
+    batches already seen before the save are replayed from restored state.
+
+    >>> for epoch, acp in train_epoch_range(10, model, "ckpts", save_steps=50):
+    ...     for batch in loader:
+    ...         model.train_batch(...); acp.step(epoch)
+    """
+    acp = AutoCheckpoint(model, save_dir, save_steps=save_steps,
+                         keep_max=keep_max)
+    meta = acp.resume()
+    if meta is None:
+        start = 0
+    elif meta.get("kind") == "epoch_end":
+        start = meta["epoch"] + 1
+    else:
+        start = meta["epoch"]  # mid-epoch save: finish that epoch
+    try:
+        for epoch in range(start, max_epoch):
+            yield epoch, acp
+            acp.epoch_end(epoch)
+    finally:
+        acp.close()

@@ -103,14 +103,51 @@ class BatchNorm3D(_BatchNormBase):
 
 class SyncBatchNorm(_BatchNormBase):
     """Cross-replica BN (ref: operators/sync_batch_norm_op.cu — NCCL partial
-    sums; here: ``jax.lax.pmean`` over the data-parallel mesh axis when one is
-    in scope, else falls back to local BN)."""
+    sums across ranks).
+
+    TPU-native semantics — two regimes:
+
+    * **GSPMD (jit / the fleet path)**: the batch dim is *sharded*, not
+      per-replica, so ``jnp.mean`` over it already IS the global-batch mean
+      (XLA inserts the cross-chip reduction).  No collective is emitted
+      here — the sync the reference needed NCCL for is the compiler's job.
+    * **shard_map (manual code)**: each program instance sees its local
+      shard, so the partial moments are ``lax.pmean``-ed over whichever
+      data axes are bound (default: ``data``/``sharding``; override with
+      ``axis_name=`` for custom meshes).
+    """
 
     def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
-                 bias_attr=None, data_format="NCHW", name=None, axis_name="dp"):
+                 bias_attr=None, data_format="NCHW", name=None, axis_name=None):
         super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
                          data_format, None, name)
         self.axis_name = axis_name
+
+    def _sync_axes(self):
+        """Mapped axes to reduce over: the bound subset of the defaults, or
+        the user's explicit axis_name (which must be bound)."""
+        if self.axis_name is None:
+            candidates = ("data", "sharding")
+            explicit = False
+        else:
+            candidates = ((self.axis_name,) if isinstance(self.axis_name, str)
+                          else tuple(self.axis_name))
+            explicit = True
+        bound = []
+        for a in candidates:
+            try:
+                jax.lax.axis_size(a)
+                bound.append(a)
+            except NameError:
+                if explicit:
+                    from ..framework.errors import InvalidArgumentError
+
+                    raise InvalidArgumentError(
+                        f"SyncBatchNorm(axis_name={self.axis_name!r}): axis "
+                        f"{a!r} is not bound here — it only names shard_map "
+                        f"axes; under plain jit the batch mean is already "
+                        f"global (leave axis_name unset)")
+        return tuple(bound)
 
     def forward(self, x):
         x = jnp.asarray(x)
@@ -121,11 +158,10 @@ class SyncBatchNorm(_BatchNormBase):
         xf = x.astype(jnp.float32)
         mean = jnp.mean(xf, axis=axes)
         meansq = jnp.mean(jnp.square(xf), axis=axes)
-        try:
-            mean = jax.lax.pmean(mean, self.axis_name)
-            meansq = jax.lax.pmean(meansq, self.axis_name)
-        except NameError:
-            pass  # not inside a mapped axis: local stats
+        sync = self._sync_axes()
+        if sync:
+            mean = jax.lax.pmean(mean, sync)
+            meansq = jax.lax.pmean(meansq, sync)
         var = meansq - jnp.square(mean)
         new_mean = self.momentum * self._mean.value + (1 - self.momentum) * mean
         new_var = self.momentum * self._variance.value + (1 - self.momentum) * var

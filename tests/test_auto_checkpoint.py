@@ -1,0 +1,160 @@
+"""Auto-checkpoint / preemption resume.
+
+Reference capability: fluid/incubate/checkpoint/auto_checkpoint.py:265
+(TrainEpochRange + CheckpointSaver).  Tests: exact-resume training
+trajectory, per-N-steps async saves, keep_max pruning, and crash-safety
+(meta-less directories are not resumed from).
+"""
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as popt
+from paddle_tpu.incubate.checkpoint import AutoCheckpoint, train_epoch_range
+
+
+def _model(seed=0):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    loss = nn.CrossEntropyLoss()
+    model = paddle.Model(net, inputs=["x"], labels=["y"])
+    model.prepare(optimizer=popt.Adam(learning_rate=1e-2), loss=loss)
+    return model
+
+
+def _batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(16, 4).astype(np.float32),
+             rng.randint(0, 2, size=(16,)).astype(np.int32))
+            for _ in range(n)]
+
+
+class TestAutoCheckpoint:
+    def test_exact_resume_trajectory(self, tmp_path):
+        """train 6 steps straight == train 3, kill, resume, train 3 more."""
+        data = _batches(6)
+
+        straight = _model(seed=1)
+        ref = [straight.train_batch([x], [y])[0] for x, y in data]
+
+        m1 = _model(seed=1)
+        acp1 = AutoCheckpoint(m1, os.path.join(tmp_path, "ck"), async_save=False)
+        first = [m1.train_batch([x], [y])[0] for x, y in data[:3]]
+        acp1.save(epoch=0)
+        del m1  # "preempted"
+
+        m2 = _model(seed=2)  # different init — must be overwritten by resume
+        acp2 = AutoCheckpoint(m2, os.path.join(tmp_path, "ck"))
+        meta = acp2.resume()
+        assert meta is not None and meta["epoch"] == 0
+        rest = [m2.train_batch([x], [y])[0] for x, y in data[3:]]
+
+        np.testing.assert_allclose(first + rest, ref, rtol=1e-5, atol=1e-6)
+
+    def test_save_steps_and_async(self, tmp_path):
+        model = _model()
+        d = os.path.join(tmp_path, "ck")
+        acp = AutoCheckpoint(model, d, save_steps=2, keep_max=10)
+        for x, y in _batches(5):
+            model.train_batch([x], [y])
+            acp.step(epoch=0)
+        acp.close()  # drain async writes
+        done = [n for n in os.listdir(d) if n.startswith("ckpt-")]
+        assert len(done) == 2  # steps 2 and 4
+
+    def test_keep_max_prunes(self, tmp_path):
+        model = _model()
+        d = os.path.join(tmp_path, "ck")
+        acp = AutoCheckpoint(model, d, keep_max=2, async_save=False)
+        for e in range(5):
+            acp.epoch_end(e)
+        names = sorted(n for n in os.listdir(d) if n.startswith("ckpt-"))
+        assert len(names) == 2
+        # newest survive
+        meta = acp.resume()
+        assert meta["epoch"] == 4
+
+    def test_uncommitted_checkpoint_ignored(self, tmp_path):
+        model = _model()
+        d = os.path.join(tmp_path, "ck")
+        acp = AutoCheckpoint(model, d, async_save=False)
+        acp.epoch_end(0)
+        # simulate a crash mid-write: newer dir without meta
+        broken = os.path.join(d, "ckpt-9999999999")
+        os.makedirs(broken)
+        with open(os.path.join(broken, "m.pdparams"), "wb") as f:
+            f.write(b"partial")
+        meta = acp.resume()
+        assert meta is not None and meta["epoch"] == 0
+
+    def test_fresh_run_returns_none(self, tmp_path):
+        model = _model()
+        acp = AutoCheckpoint(model, os.path.join(tmp_path, "nope"))
+        assert acp.resume() is None
+
+    def test_train_epoch_range_resumes(self, tmp_path):
+        d = os.path.join(tmp_path, "ck")
+        data = _batches(2)
+
+        m1 = _model(seed=1)
+        seen = []
+        for epoch, acp in train_epoch_range(4, m1, d):
+            seen.append(epoch)
+            for x, y in data:
+                m1.train_batch([x], [y])
+                acp.step(epoch)
+            if epoch == 1:
+                break  # "preempted" after epoch-1 yield, before its save
+        assert seen == [0, 1]
+
+        m2 = _model(seed=1)
+        seen2 = []
+        for epoch, acp in train_epoch_range(4, m2, d):
+            seen2.append(epoch)
+            for x, y in data:
+                m2.train_batch([x], [y])
+                acp.step(epoch)
+        assert seen2 == [1, 2, 3]  # epoch 0 completed; 1 was cut short
+
+    def test_mid_epoch_step_save_reenters_epoch(self, tmp_path):
+        """A save_steps snapshot mid-epoch must NOT mark the epoch done —
+        resume re-enters it (review finding: the rest of the epoch was
+        silently skipped before)."""
+        d = os.path.join(tmp_path, "ck")
+        data = _batches(4)
+
+        m1 = _model(seed=1)
+        for epoch, acp in train_epoch_range(3, m1, d, save_steps=2):
+            for i, (x, y) in enumerate(data):
+                m1.train_batch([x], [y])
+                acp.step(epoch)
+                if epoch == 1 and i == 1:
+                    break  # killed right after the step-2 save of epoch 1
+            else:
+                continue
+            break
+
+        m2 = _model(seed=1)
+        seen = []
+        for epoch, acp in train_epoch_range(3, m2, d, save_steps=2):
+            seen.append(epoch)
+            for x, y in data:
+                m2.train_batch([x], [y])
+                acp.step(epoch)
+        assert seen == [1, 2]  # epoch 1 re-entered, not skipped
+
+    def test_resume_rejects_model_mismatch(self, tmp_path):
+        d = os.path.join(tmp_path, "ck")
+        m1 = _model()
+        AutoCheckpoint(m1, d, async_save=False).epoch_end(0)
+        paddle.seed(0)
+        bigger = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2),
+                               nn.Linear(2, 2))
+        m2 = paddle.Model(bigger, inputs=["x"], labels=["y"])
+        m2.prepare(optimizer=popt.Adam(learning_rate=1e-2),
+                   loss=nn.CrossEntropyLoss())
+        with pytest.raises(Exception, match="lacks model state"):
+            AutoCheckpoint(m2, d).resume()
