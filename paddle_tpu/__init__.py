@@ -74,6 +74,7 @@ from .distributed import DataParallel  # noqa: F401
 from . import amp  # noqa: F401
 from . import ops  # noqa: F401
 from . import metric  # noqa: F401
+from . import profiler  # noqa: F401
 from . import models  # noqa: F401
 from . import vision  # noqa: F401
 from . import hapi  # noqa: F401

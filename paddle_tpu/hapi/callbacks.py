@@ -98,6 +98,9 @@ class ProgBarLogger(Callback):
     def on_epoch_begin(self, epoch, logs=None):
         self.epoch = epoch
         self._start = time.time()
+        self._window_t0 = time.time()
+        self._window_steps = 0
+        self._window_samples = 0
 
     def _fmt(self, logs):
         parts = []
@@ -110,8 +113,30 @@ class ProgBarLogger(Callback):
         return " - ".join(parts)
 
     def on_train_batch_end(self, step, logs=None):
+        # per-step timing (ref capability: profiler.h step stats; VERDICT
+        # asked for step timing in callbacks so perf work isn't blind)
+        self._window_steps += 1
+        bs = (logs or {}).get("batch_size")
+        if isinstance(bs, numbers.Number):
+            self._window_samples += int(bs)
         if self.verbose and step % self.log_freq == 0:
-            print(f"Epoch {self.epoch}: step {step}/{self.steps or '?'} - {self._fmt(logs)}")
+            # sync on the window's last loss BEFORE reading the clock —
+            # steps dispatch async, so without this dt measures host
+            # dispatch (~µs) instead of device time
+            v = (logs or {}).get("loss")
+            if hasattr(v, "block_until_ready"):
+                v.block_until_ready()
+            dt = time.time() - self._window_t0
+            perf = ""
+            if self._window_steps and dt > 0:
+                perf = f" - {dt * 1e3 / self._window_steps:.1f} ms/step"
+                if self._window_samples:
+                    perf += f" - {self._window_samples / dt:.1f} samples/s"
+            print(f"Epoch {self.epoch}: step {step}/{self.steps or '?'} - "
+                  f"{self._fmt(logs)}{perf}")
+            self._window_t0 = time.time()
+            self._window_steps = 0
+            self._window_samples = 0
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:

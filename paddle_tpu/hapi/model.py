@@ -30,6 +30,7 @@ import numpy as np
 
 from ..framework import random as _random
 from ..framework import serialization
+from ..framework.flags import flag as _flag
 from ..framework.errors import InvalidArgumentError
 from ..metric import Metric
 from ..nn.layer_base import Layer, functional_call
@@ -77,6 +78,7 @@ class Model:
         self._plan = None
         self.stop_training = False
         self._save_dir = None
+        self._finite_check = None  # lazily-built FLAGS_check_nan_inf probe
 
     # -- setup ---------------------------------------------------------------
     def prepare(self, optimizer: Optional[Optimizer] = None, loss=None,
@@ -271,8 +273,35 @@ class Model:
         loss_val, out, params, self._opt_state, buffers = self._train_step(
             params, self._opt_state, buffers, key, lr, *batch)
         self._push_state(params, buffers)
+        if _flag("check_nan_inf"):
+            # debug mode (ref: FLAGS_check_nan_inf nan sweep,
+            # framework/details/nan_inf_utils.h:33) — syncs every step
+            self._check_nan_inf(loss_val, params, buffers)
+        if _flag("benchmark"):
+            jax.block_until_ready(loss_val)
         metrics = self._update_metrics(out, batch[len(_tuplize(inputs)):])
         return loss_val, metrics
+
+    def _check_nan_inf(self, loss_val, params, buffers):
+        if self._finite_check is None:
+            def all_finite(l, tree):
+                leaves = jax.tree_util.tree_leaves(tree)
+                ok = jnp.isfinite(l).all()
+                if leaves:
+                    ok = jnp.logical_and(
+                        ok, jnp.array([jnp.isfinite(p).all()
+                                       for p in leaves]).all())
+                return ok
+
+            self._finite_check = jax.jit(all_finite)
+        if not bool(self._finite_check(loss_val, (params, buffers))):
+            bad = [] if np.isfinite(np.asarray(loss_val)).all() else ["loss"]
+            for tree in (params, buffers):
+                bad += [n for n, v in tree.items()
+                        if not np.isfinite(np.asarray(v)).all()]
+            raise RuntimeError(
+                f"FLAGS_check_nan_inf: non-finite values after train step "
+                f"in: {bad[:8]}{' …' if len(bad) > 8 else ''}")
 
     def eval_batch(self, inputs, labels=None):
         batch = tuple(_tuplize(inputs)) + tuple(_tuplize(labels) if labels is not None else ())

@@ -1,0 +1,168 @@
+"""Profiler — device traces + host-side event timing.
+
+Parity: paddle/fluid/platform/profiler.h:40-212 (RecordEvent, Enable/
+DisableProfiler, the event table printed by PrintProfiler) and
+python/paddle/fluid/profiler.py (profiler context manager,
+start_profiler/stop_profiler/reset_profiler).
+
+TPU-native design: the *device* timeline comes from the XLA profiler —
+``start_profiler(log_dir)`` wraps ``jax.profiler.start_trace`` and writes a
+TensorBoard/perfetto-loadable trace of every compiled computation, transfer
+and ICI collective (far richer than the reference's per-op CUDA event
+pairs).  The *host* table the reference prints is kept too: ``RecordEvent``
+annotates the device trace AND accumulates wall-clock stats, and
+``stop_profiler``/``summary`` prints the familiar
+name/calls/total/avg/min/max table.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Optional
+
+import jax
+
+__all__ = [
+    "RecordEvent",
+    "start_profiler",
+    "stop_profiler",
+    "reset_profiler",
+    "profiler",
+    "summary",
+]
+
+_lock = threading.Lock()
+_events: Dict[str, dict] = {}
+_trace_dir: Optional[str] = None
+_started = False
+
+
+class RecordEvent:
+    """Annotate a region: shows up named in the device trace and in the
+    host event table.  Context manager or decorator.
+
+    Parity: platform/profiler.h:121 RecordEvent.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._ann = None
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = (time.perf_counter() - self._t0) * 1e3  # ms
+        self._ann.__exit__(*exc)
+        with _lock:
+            e = _events.setdefault(
+                self.name,
+                {"calls": 0, "total": 0.0, "min": float("inf"), "max": 0.0})
+            e["calls"] += 1
+            e["total"] += dt
+            e["min"] = min(e["min"], dt)
+            e["max"] = max(e["max"], dt)
+        return False
+
+    def __call__(self, fn):
+        def wrapped(*a, **k):
+            with RecordEvent(self.name):
+                return fn(*a, **k)
+
+        return wrapped
+
+
+def start_profiler(log_dir: Optional[str] = None, state: str = "All",
+                   tracer_option: str = "Default"):
+    """Begin profiling.  ``log_dir`` set → also capture the XLA device trace
+    there (view in TensorBoard's profile plugin / Perfetto).
+
+    Parity: fluid/profiler.py start_profiler (state/tracer_option accepted
+    for signature compatibility; the XLA trace always covers both CPU and
+    device activity).
+    """
+    global _trace_dir, _started
+    if _started:
+        raise RuntimeError(
+            "profiler already running — call stop_profiler() first")
+    reset_profiler()
+    if log_dir is not None:
+        jax.profiler.start_trace(log_dir)
+        _trace_dir = log_dir
+    _started = True
+
+
+def stop_profiler(sorted_key: Optional[str] = "total",
+                  profile_path: Optional[str] = None) -> str:
+    """End profiling; returns (and prints) the host event table.  With a
+    ``log_dir`` given at start, finalizes the device trace.
+
+    Parity: fluid/profiler.py stop_profiler (sorted_key: one of
+    calls/total/max/min/ave)."""
+    global _trace_dir, _started
+    if not _started:
+        return ""  # stop without start: nothing to finalize
+    if _trace_dir is not None:
+        jax.profiler.stop_trace()
+        _trace_dir = None
+    _started = False
+    table = summary(sorted_key=sorted_key)
+    if profile_path:
+        with open(profile_path, "w") as f:
+            f.write(table)
+    if table:
+        print(table)
+    return table
+
+
+def reset_profiler():
+    """Parity: fluid/profiler.py reset_profiler."""
+    with _lock:
+        _events.clear()
+
+
+def summary(sorted_key: Optional[str] = "total") -> str:
+    """The reference's PrintProfiler table (profiler.cc) from host events."""
+    with _lock:
+        rows = [
+            (name, e["calls"], e["total"], e["total"] / e["calls"],
+             e["min"], e["max"])
+            for name, e in _events.items()
+        ]
+    if not rows:
+        return ""
+    key_idx = {"calls": 1, "total": 2, "ave": 3, "min": 4, "max": 5}.get(
+        sorted_key or "total", 2)
+    rows.sort(key=lambda r: r[key_idx], reverse=True)
+    grand = sum(r[2] for r in rows) or 1.0
+    w = max(len(r[0]) for r in rows) + 2
+    lines = [
+        f"{'Event':<{w}}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>10}"
+        f"{'Min(ms)':>10}{'Max(ms)':>10}{'Ratio':>8}"
+    ]
+    for name, calls, total, avg, mn, mx in rows:
+        lines.append(
+            f"{name:<{w}}{calls:>8}{total:>12.3f}{avg:>10.3f}"
+            f"{mn:>10.3f}{mx:>10.3f}{total / grand:>8.2%}")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: Optional[str] = "total",
+             profile_path: Optional[str] = None,
+             log_dir: Optional[str] = None):
+    """``with profiler(...):`` — parity with fluid.profiler.profiler.
+
+    The reference's ``state`` chose CPU vs GPU event capture; the XLA trace
+    captures both, so it is accepted and ignored.
+    """
+    start_profiler(log_dir=log_dir, state=state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key=sorted_key, profile_path=profile_path)

@@ -1,0 +1,131 @@
+"""Profiler + debug-flag wiring.
+
+Reference capability: platform/profiler.h:40-212 (RecordEvent + the
+printed event table), fluid/profiler.py (profiler context), and
+FLAGS_check_nan_inf (platform/flags.cc:44 gating the nan sweep of
+framework/details/nan_inf_utils.h:33).
+"""
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as popt
+from paddle_tpu import profiler as prof
+from paddle_tpu.framework.flags import set_flags
+
+
+@pytest.fixture(autouse=True)
+def clean_profiler():
+    prof.reset_profiler()
+    yield
+    prof.reset_profiler()
+    set_flags({"check_nan_inf": False, "benchmark": False})
+
+
+class TestRecordEvent:
+    def test_accumulates_stats(self):
+        for _ in range(3):
+            with prof.RecordEvent("fwd"):
+                jnp.ones((32, 32)).sum().block_until_ready()
+        with prof.RecordEvent("bwd"):
+            pass
+        table = prof.summary()
+        assert "fwd" in table and "bwd" in table
+        assert "Calls" in table
+        # fwd ran 3 times
+        fwd_row = [l for l in table.splitlines() if l.startswith("fwd")][0]
+        assert fwd_row.split()[1] == "3"
+
+    def test_decorator_form(self):
+        @prof.RecordEvent("work")
+        def work(x):
+            return x + 1
+
+        assert work(1) == 2
+        assert "work" in prof.summary()
+
+    def test_sorted_key(self):
+        with prof.RecordEvent("a"):
+            pass
+        for _ in range(5):
+            with prof.RecordEvent("b"):
+                pass
+        lines = prof.summary(sorted_key="calls").splitlines()
+        assert lines[1].startswith("b")
+
+
+class TestProfilerContext:
+    def test_device_trace_written(self, tmp_path):
+        d = os.path.join(tmp_path, "trace")
+        with prof.profiler(log_dir=d):
+            with prof.RecordEvent("traced_region"):
+                jnp.ones((64, 64)).sum().block_until_ready()
+        found = []
+        for root, _, files in os.walk(d):
+            found += files
+        assert any(f.endswith(".xplane.pb") for f in found), found
+
+    def test_profile_path_written(self, tmp_path):
+        p = os.path.join(tmp_path, "prof.txt")
+        with prof.profiler(profile_path=p):
+            with prof.RecordEvent("ev"):
+                pass
+        with open(p) as f:
+            assert "ev" in f.read()
+
+    def test_reset(self):
+        with prof.RecordEvent("x"):
+            pass
+        prof.reset_profiler()
+        assert prof.summary() == ""
+
+
+class TestCheckNanInf:
+    def _model(self, lr):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+        m = paddle.Model(net, inputs=["x"], labels=["y"])
+        m.prepare(optimizer=popt.SGD(learning_rate=lr),
+                  loss=nn.CrossEntropyLoss())
+        return m
+
+    def test_flag_catches_divergence(self):
+        set_flags({"check_nan_inf": True})
+        m = self._model(lr=0.01)
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        x[0, 0] = np.nan  # poisoned batch → NaN loss and grads
+        y = np.zeros((8,), np.int32)
+        with pytest.raises(RuntimeError, match="check_nan_inf"):
+            for _ in range(3):
+                m.train_batch([x], [y])
+
+    def test_flag_off_no_raise(self):
+        m = self._model(lr=1e12)
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32) * 100
+        y = np.zeros((8,), np.int32)
+        for _ in range(5):
+            m.train_batch([x], [y])  # silently diverges — old behavior
+
+    def test_benchmark_flag_runs(self):
+        set_flags({"benchmark": True})
+        m = self._model(lr=0.01)
+        x = np.zeros((8, 4), np.float32)
+        y = np.zeros((8,), np.int32)
+        loss, _ = m.train_batch([x], [y])
+        assert np.isfinite(loss)
+
+
+class TestLifecycle:
+    def test_double_start_raises(self):
+        prof.start_profiler()
+        try:
+            with pytest.raises(RuntimeError, match="already running"):
+                prof.start_profiler()
+        finally:
+            prof.stop_profiler()
+
+    def test_stop_without_start_is_noop(self):
+        assert prof.stop_profiler() == ""
