@@ -77,6 +77,8 @@ from . import metric  # noqa: F401
 from . import profiler  # noqa: F401
 from . import models  # noqa: F401
 from . import vision  # noqa: F401
+from . import text  # noqa: F401
+from . import distribution  # noqa: F401
 from . import hapi  # noqa: F401
 from . import incubate  # noqa: F401
 from . import inference  # noqa: F401

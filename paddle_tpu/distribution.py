@@ -1,0 +1,157 @@
+"""Probability distributions.
+
+Parity: python/paddle/distribution.py (Distribution:40, Uniform, Normal,
+Categorical — sample/entropy/log_prob/probs/kl_divergence).  The reference
+assembles these from fluid ops with static/dygraph branches; here each is a
+thin jax.numpy formulation (sampling draws keys from the framework
+generator, so ``paddle.seed`` reproduces sample streams).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework import random as _random
+from .framework.errors import InvalidArgumentError
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
+
+
+def _as_array(x, dtype=jnp.float32):
+    if isinstance(x, (int, float, list, tuple, np.ndarray)):
+        return jnp.asarray(x, dtype)
+    return jnp.asarray(x)
+
+
+def _key(seed: int) -> jax.Array:
+    if seed:
+        return jax.random.PRNGKey(seed)
+    return _random.default_generator().next_key()
+
+
+class Distribution:
+    """Abstract base (parity: distribution.py:40)."""
+
+    def sample(self, shape: Sequence[int] = (), seed: int = 0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other: "Distribution"):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U[low, high) with elementwise broadcastable bounds."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _as_array(low)
+        self.high = _as_array(high)
+
+    def sample(self, shape: Sequence[int] = (), seed: int = 0):
+        base = jnp.broadcast_shapes(self.low.shape, self.high.shape)
+        u = jax.random.uniform(_key(seed), tuple(shape) + base,
+                               dtype=self.low.dtype)
+        return self.low + u * (self.high - self.low)
+
+    def entropy(self):
+        return jnp.log(self.high - self.low)
+
+    def _inside(self, value):
+        # strict bounds both ends — reference Uniform.log_prob uses
+        # ``low < value`` and ``value < high``
+        return (value > self.low) & (value < self.high)
+
+    def log_prob(self, value):
+        value = _as_array(value)
+        dens = jnp.where(self._inside(value), 1.0 / (self.high - self.low), 0.0)
+        return jnp.log(dens)  # -inf outside the support
+
+    def probs(self, value):
+        value = _as_array(value)
+        return jnp.where(self._inside(value), 1.0 / (self.high - self.low), 0.0)
+
+
+class Normal(Distribution):
+    """N(loc, scale^2), elementwise."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+
+    def sample(self, shape: Sequence[int] = (), seed: int = 0):
+        base = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        z = jax.random.normal(_key(seed), tuple(shape) + base,
+                              dtype=self.loc.dtype)
+        return self.loc + z * self.scale
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+
+    def log_prob(self, value):
+        value = _as_array(value)
+        var = jnp.square(self.scale)
+        return (-jnp.square(value - self.loc) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def probs(self, value):
+        return jnp.exp(self.log_prob(value))
+
+    def kl_divergence(self, other: "Normal"):
+        """KL(self || other), elementwise (reference: Normal.kl_divergence)."""
+        if not isinstance(other, Normal):
+            raise InvalidArgumentError("kl_divergence expects another Normal")
+        var_ratio = jnp.square(self.scale / other.scale)
+        t1 = jnp.square((self.loc - other.loc) / other.scale)
+        return 0.5 * (var_ratio + t1 - 1.0 - jnp.log(var_ratio))
+
+
+class Categorical(Distribution):
+    """Categorical over the last axis of ``logits`` (unnormalized)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _as_array(logits)
+        if self.logits.ndim < 1:
+            raise InvalidArgumentError("Categorical logits must be >= 1-D")
+
+    def _log_pmf(self):
+        return self.logits - jax.nn.logsumexp(self.logits, axis=-1,
+                                              keepdims=True)
+
+    def sample(self, shape: Sequence[int] = (), seed: int = 0):
+        return jax.random.categorical(
+            _key(seed), self.logits, axis=-1,
+            shape=tuple(shape) + self.logits.shape[:-1])
+
+    def entropy(self):
+        logp = self._log_pmf()
+        return -(jnp.exp(logp) * logp).sum(-1)
+
+    def probs(self, value):
+        value = jnp.asarray(value, jnp.int32)
+        p = jnp.exp(self._log_pmf())
+        return jnp.take_along_axis(p, value[..., None], axis=-1)[..., 0]
+
+    def log_prob(self, value):
+        value = jnp.asarray(value, jnp.int32)
+        return jnp.take_along_axis(self._log_pmf(), value[..., None],
+                                   axis=-1)[..., 0]
+
+    def kl_divergence(self, other: "Categorical"):
+        if not isinstance(other, Categorical):
+            raise InvalidArgumentError(
+                "kl_divergence expects another Categorical")
+        logp = self._log_pmf()
+        logq = other._log_pmf()
+        return (jnp.exp(logp) * (logp - logq)).sum(-1)

@@ -1,0 +1,11 @@
+"""paddle_tpu.text — NLP dataset surface (parity: python/paddle/text/)."""
+from . import datasets  # noqa: F401
+from .datasets import (  # noqa: F401
+    Conll05st,
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    WMT14,
+    WMT16,
+)

@@ -1,0 +1,30 @@
+"""Shared plumbing for text datasets (no-egress file resolution)."""
+from __future__ import annotations
+
+import os
+
+from ...framework.errors import NotFoundError
+
+_DEFAULT_ROOT = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+
+
+def resolve_data_file(data_file, name: str, filename: str, url_hint: str,
+                      download: bool = True) -> str:
+    """Return a readable local path for ``name`` or raise with instructions.
+
+    Mirrors the reference's _check_exists_and_download
+    (dataset/common.py) minus the fetch: this environment has no egress.
+    """
+    if data_file:
+        if not os.path.exists(data_file):
+            raise NotFoundError(f"{name}: data_file {data_file!r} not found")
+        return data_file
+    cached = os.path.join(_DEFAULT_ROOT, name, filename)
+    if os.path.exists(cached):
+        return cached
+    hint = (f"place the file at {cached!r} or pass data_file=;"
+            f" upstream source: {url_hint}")
+    if download:
+        raise NotFoundError(
+            f"{name}: no local copy and this environment cannot download — {hint}")
+    raise NotFoundError(f"{name}: data_file not set and download=False — {hint}")

@@ -1,0 +1,155 @@
+"""CoNLL-2005 semantic-role-labeling dataset.
+
+Parity: python/paddle/text/datasets/conll05.py (Conll05st(data_file,
+word_dict_file, verb_dict_file, target_dict_file, download) over the
+conll05st-tests tar — ``conll05st-release/test.wsj/words/test.wsj.words.gz``
++ ``.../props/test.wsj.props.gz``; bracketed prop labels expand to BIO
+sequences and each sample is the 9-column SRL feature tuple: word ids, five
+predicate-context windows, predicate id, mark vector, label ids).
+"""
+from __future__ import annotations
+
+import gzip
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+from ._base import resolve_data_file
+
+__all__ = ["Conll05st"]
+
+DATA_URL = "http://paddlemodels.bj.bcebos.com/conll05st/conll05st-tests.tar.gz"
+WORDDICT_URL = "http://paddlemodels.bj.bcebos.com/conll05st%2FwordDict.txt"
+VERBDICT_URL = "http://paddlemodels.bj.bcebos.com/conll05st%2FverbDict.txt"
+TRGDICT_URL = "http://paddlemodels.bj.bcebos.com/conll05st%2FtargetDict.txt"
+UNK_IDX = 0
+
+
+class Conll05st(Dataset):
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, download=True):
+        self.data_file = resolve_data_file(
+            data_file, "conll05st", "conll05st-tests.tar.gz", DATA_URL,
+            download)
+        self.word_dict_file = resolve_data_file(
+            word_dict_file, "conll05st", "wordDict.txt", WORDDICT_URL,
+            download)
+        self.verb_dict_file = resolve_data_file(
+            verb_dict_file, "conll05st", "verbDict.txt", VERBDICT_URL,
+            download)
+        self.target_dict_file = resolve_data_file(
+            target_dict_file, "conll05st", "targetDict.txt", TRGDICT_URL,
+            download)
+        self.word_dict = self._load_dict(self.word_dict_file)
+        self.predicate_dict = self._load_dict(self.verb_dict_file)
+        self.label_dict = self._load_label_dict(self.target_dict_file)
+        self._load_anno()
+
+    def _load_dict(self, filename):
+        with open(filename) as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    def _load_label_dict(self, filename):
+        tags = []
+        with open(filename) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith(("B-", "I-")) and line[2:] not in tags:
+                    tags.append(line[2:])
+        d = {}
+        for tag in tags:
+            d["B-" + tag] = len(d)
+            d["I-" + tag] = len(d)
+        d["O"] = len(d)
+        return d
+
+    def _expand_bio(self, lbl):
+        """One props column (bracket notation) → BIO tag sequence."""
+        cur_tag, in_bracket, seq = "O", False, []
+        for l in lbl:
+            if l == "*" and not in_bracket:
+                seq.append("O")
+            elif l == "*" and in_bracket:
+                seq.append("I-" + cur_tag)
+            elif l == "*)":
+                seq.append("I-" + cur_tag)
+                in_bracket = False
+            elif "(" in l and ")" in l:
+                cur_tag = l[1:l.find("*")]
+                seq.append("B-" + cur_tag)
+                in_bracket = False
+            elif "(" in l:
+                cur_tag = l[1:l.find("*")]
+                seq.append("B-" + cur_tag)
+                in_bracket = True
+            else:
+                raise RuntimeError(f"Unexpected label: {l}")
+        return seq
+
+    def _load_anno(self):
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words_file, \
+                    gzip.GzipFile(fileobj=pf) as props_file:
+                sentence, one_seg = [], []
+                for word, label in zip(words_file, props_file):
+                    word = str(word, encoding="utf-8").strip()
+                    label = str(label, encoding="utf-8").strip().split()
+                    if label:
+                        sentence.append(word)
+                        one_seg.append(label)
+                        continue
+                    # end of sentence: column 0 is the verb column, the
+                    # rest are one bracketed role row per predicate
+                    if one_seg:
+                        cols = list(zip(*one_seg))
+                        verbs = [v for v in cols[0] if v != "-"]
+                        for i, lbl in enumerate(cols[1:]):
+                            seq = self._expand_bio(lbl)
+                            self.sentences.append(list(sentence))
+                            self.predicates.append(verbs[i])
+                            self.labels.append(seq)
+                    sentence, one_seg = [], []
+
+    def __getitem__(self, idx):
+        sentence = self.sentences[idx]
+        predicate = self.predicates[idx]
+        labels = self.labels[idx]
+        sen_len = len(sentence)
+
+        verb_index = labels.index("B-V")
+        mark = [0] * len(labels)
+        ctx = {}
+        for off, name, fallback in ((-2, "ctx_n2", "bos"),
+                                    (-1, "ctx_n1", "bos"),
+                                    (0, "ctx_0", None),
+                                    (1, "ctx_p1", "eos"),
+                                    (2, "ctx_p2", "eos")):
+            j = verb_index + off
+            if 0 <= j < len(labels):
+                mark[j] = 1
+                ctx[name] = sentence[j]
+            else:
+                ctx[name] = fallback
+
+        word_idx = [self.word_dict.get(w, UNK_IDX) for w in sentence]
+        ctx_cols = [
+            [self.word_dict.get(ctx[name], UNK_IDX)] * sen_len
+            for name in ("ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2")
+        ]
+        pred_idx = [self.predicate_dict.get(predicate)] * sen_len
+        label_idx = [self.label_dict.get(w) for w in labels]
+        return tuple(
+            np.array(a) for a in
+            [word_idx] + ctx_cols + [pred_idx, mark, label_idx])
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict

@@ -1,0 +1,74 @@
+"""IMDB sentiment dataset.
+
+Parity: python/paddle/text/datasets/imdb.py:33 (Imdb(data_file, mode,
+cutoff, download) over the aclImdb tar: ``aclImdb/<mode>/<pos|neg>/*.txt``;
+word dict built from the train split with frequency > cutoff; samples are
+(doc_ids int64[], label) with pos→0, neg→1).
+"""
+from __future__ import annotations
+
+import collections
+import re
+import string
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+from ._base import resolve_data_file
+
+__all__ = ["Imdb"]
+
+URL = "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz"
+
+
+class Imdb(Dataset):
+    def __init__(self, data_file=None, mode="train", cutoff=150, download=True):
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode!r}")
+        self.mode = mode.lower()
+        self.data_file = resolve_data_file(
+            data_file, "imdb", "aclImdb_v1.tar.gz", URL, download)
+        self.word_idx = self._build_word_dict(cutoff)
+        self._load_anno()
+
+    def _tokenize(self, raw: bytes):
+        tok = str(raw, encoding="utf-8", errors="ignore").lower()
+        return tok.translate(str.maketrans("", "", string.punctuation)).split()
+
+    def _iter_docs(self, pattern: re.Pattern):
+        with tarfile.open(self.data_file) as tarf:
+            member = tarf.next()
+            while member is not None:
+                if bool(pattern.match(member.name)):
+                    yield self._tokenize(tarf.extractfile(member).read())
+                member = tarf.next()
+
+    def _build_word_dict(self, cutoff):
+        pattern = re.compile(r"aclImdb/train/(pos|neg)/.*\.txt$")
+        word_freq = collections.defaultdict(int)
+        for doc in self._iter_docs(pattern):
+            for w in doc:
+                word_freq[w] += 1
+        word_freq = [x for x in word_freq.items() if x[1] > cutoff]
+        dictionary = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(dictionary)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load_anno(self):
+        unk = self.word_idx["<unk>"]
+        self.docs = []
+        self.labels = []
+        for label, tag in ((0, "pos"), (1, "neg")):
+            pattern = re.compile(
+                rf"aclImdb/{self.mode}/{tag}/.*\.txt$")
+            for doc in self._iter_docs(pattern):
+                self.docs.append([self.word_idx.get(w, unk) for w in doc])
+                self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return np.array(self.docs[idx]), np.array(self.labels[idx])
+
+    def __len__(self):
+        return len(self.docs)

@@ -1,0 +1,89 @@
+"""imikolov (PTB) language-model dataset.
+
+Parity: python/paddle/text/datasets/imikolov.py (Imikolov(data_file, mode,
+data_type='NGRAM'|'SEQ', window_size, min_word_freq, download) over the
+simple-examples tar — ``./simple-examples/data/ptb.{train,valid,test}.txt``;
+dict from train+valid with freq > min_word_freq, '<unk>' last).
+"""
+from __future__ import annotations
+
+import collections
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+from ._base import resolve_data_file
+
+__all__ = ["Imikolov"]
+
+URL = "https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tar.gz"
+
+
+class Imikolov(Dataset):
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        if mode not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode!r}")
+        if data_type not in ("NGRAM", "SEQ"):
+            raise ValueError(f"data_type must be NGRAM or SEQ, got {data_type!r}")
+        if data_type == "NGRAM" and window_size <= 0:
+            raise ValueError("NGRAM mode needs window_size > 0")
+        self.mode = mode
+        self.data_type = data_type
+        self.window_size = window_size
+        self.min_word_freq = min_word_freq
+        self.data_file = resolve_data_file(
+            data_file, "imikolov", "simple-examples.tar.gz", URL, download)
+        self.word_idx = self._build_word_dict(min_word_freq)
+        self._load_anno()
+
+    def _word_count(self, f, word_freq=None):
+        if word_freq is None:
+            word_freq = collections.defaultdict(int)
+        for line in f:
+            for w in str(line, encoding="utf-8").strip().split():
+                word_freq[w] += 1
+            word_freq["<s>"] += 1
+            word_freq["<e>"] += 1
+        return word_freq
+
+    def _build_word_dict(self, cutoff):
+        with tarfile.open(self.data_file) as tf:
+            trainf = tf.extractfile("./simple-examples/data/ptb.train.txt")
+            validf = tf.extractfile("./simple-examples/data/ptb.valid.txt")
+            word_freq = self._word_count(validf, self._word_count(trainf))
+        word_freq.pop("<unk>", None)  # re-added as the last index
+        word_freq = [x for x in word_freq.items() if x[1] > cutoff]
+        dictionary = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(dictionary)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load_anno(self):
+        self.data = []
+        name = {"train": "train", "test": "valid"}[self.mode]
+        unk = self.word_idx["<unk>"]
+        with tarfile.open(self.data_file) as tf:
+            f = tf.extractfile(f"./simple-examples/data/ptb.{name}.txt")
+            for line in f:
+                words = str(line, encoding="utf-8").strip().split()
+                if self.data_type == "NGRAM":
+                    seq = ["<s>"] + words + ["<e>"]
+                    if len(seq) >= self.window_size:
+                        ids = [self.word_idx.get(w, unk) for w in seq]
+                        for i in range(self.window_size, len(ids) + 1):
+                            self.data.append(tuple(ids[i - self.window_size:i]))
+                else:  # SEQ
+                    ids = [self.word_idx.get(w, unk) for w in words]
+                    src = [self.word_idx.get("<s>", unk)] + ids
+                    trg = ids + [self.word_idx.get("<e>", unk)]
+                    if self.window_size > 0 and len(src) > self.window_size:
+                        continue
+                    self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)

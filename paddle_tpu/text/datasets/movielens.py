@@ -1,0 +1,122 @@
+"""MovieLens-1M rating dataset.
+
+Parity: python/paddle/text/datasets/movielens.py (Movielens(data_file, mode,
+test_ratio, rand_seed, download) over the ml-1m zip — movies.dat/users.dat/
+ratings.dat, '::'-separated, latin-1; samples are user features + movie
+features + [rating*2-5]).
+"""
+from __future__ import annotations
+
+import re
+import zipfile
+
+import numpy as np
+
+from ...io import Dataset
+from ._base import resolve_data_file
+
+__all__ = ["Movielens", "MovieInfo", "UserInfo"]
+
+URL = "https://dataset.bj.bcebos.com/movielens%2Fml-1m.zip"
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        """[movie_id, [category ids], [title word ids]]."""
+        return [
+            [self.index],
+            [categories_dict[c] for c in self.categories],
+            [movie_title_dict[w.lower()] for w in self.title.split()],
+        ]
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = [1, 18, 25, 35, 45, 50, 56].index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+
+class Movielens(Dataset):
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        if mode not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode!r}")
+        self.mode = mode
+        self.test_ratio = test_ratio
+        self.rand_seed = rand_seed
+        self.data_file = resolve_data_file(
+            data_file, "movielens", "ml-1m.zip", URL, download)
+        np.random.seed(rand_seed)
+        self._load_meta_info()
+        self._load_data()
+
+    def _load_meta_info(self):
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        self.movie_info = {}
+        self.movie_title_dict = {}
+        self.categories_dict = {}
+        self.user_info = {}
+        with zipfile.ZipFile(self.data_file) as package:
+            for info in package.namelist():
+                if info.endswith("movies.dat"):
+                    with package.open(info) as f:
+                        for line in f:
+                            line = str(line, encoding="latin")
+                            movie_id, title, categories = \
+                                line.strip().split("::")
+                            categories = categories.split("|")
+                            for c in categories:
+                                self.categories_dict.setdefault(
+                                    c, len(self.categories_dict))
+                            m = pattern.match(title)
+                            title = m.group(1) if m else title
+                            for w in title.split():
+                                self.movie_title_dict.setdefault(
+                                    w.lower(), len(self.movie_title_dict))
+                            self.movie_info[int(movie_id)] = MovieInfo(
+                                movie_id, categories, title)
+                elif info.endswith("users.dat"):
+                    with package.open(info) as f:
+                        for line in f:
+                            line = str(line, encoding="latin")
+                            uid, gender, age, job, _ = \
+                                line.strip().split("::")
+                            self.user_info[int(uid)] = UserInfo(
+                                uid, gender, age, job)
+
+    def _load_data(self):
+        self.data = []
+        is_test = self.mode == "test"
+        with zipfile.ZipFile(self.data_file) as package:
+            ratings = [n for n in package.namelist()
+                       if n.endswith("ratings.dat")]
+            with package.open(ratings[0]) as f:
+                for line in f:
+                    line = str(line, encoding="latin")
+                    if (np.random.random() < self.test_ratio) != is_test:
+                        continue
+                    uid, mov_id, rating, _ = line.strip().split("::")
+                    mov = self.movie_info[int(mov_id)]
+                    usr = self.user_info[int(uid)]
+                    self.data.append(
+                        usr.value()
+                        + mov.value(self.categories_dict,
+                                    self.movie_title_dict)
+                        + [[float(rating) * 2 - 5.0]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)

@@ -1,0 +1,48 @@
+"""UCI housing regression dataset.
+
+Parity: python/paddle/text/datasets/uci_housing.py:34 (UCIHousing(data_file,
+mode, download) → (feature[13] f32, target[1] f32) samples, features
+min/max-normalized, 80/20 train/test split).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...io import Dataset
+from ._base import resolve_data_file
+
+__all__ = ["UCIHousing"]
+
+URL = "http://paddlemodels.bj.bcebos.com/uci_housing/housing.data"
+FEATURE_NUM = 14
+
+
+class UCIHousing(Dataset):
+    def __init__(self, data_file=None, mode="train", download=True):
+        if mode not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode!r}")
+        self.mode = mode
+        self.data_file = resolve_data_file(
+            data_file, "uci_housing", "housing.data", URL, download)
+        self._load_data()
+
+    def _load_data(self, feature_num=FEATURE_NUM, ratio=0.8):
+        data = np.loadtxt(self.data_file).astype(np.float32)
+        if data.size % feature_num:
+            raise ValueError(
+                f"{self.data_file}: not a whitespace table of "
+                f"{feature_num}-column rows")
+        data = data.reshape(-1, feature_num)
+        maxs, mins, avgs = (data.max(0), data.min(0),
+                            data.sum(0) / data.shape[0])
+        span = np.where(maxs - mins == 0, 1.0, maxs - mins)
+        data[:, :-1] = (data[:, :-1] - avgs[:-1]) / span[:-1]
+        offset = int(data.shape[0] * ratio)
+        self.data = data[:offset] if self.mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return np.array(row[:-1]), np.array(row[-1:])
+
+    def __len__(self):
+        return len(self.data)

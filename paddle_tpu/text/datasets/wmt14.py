@@ -1,0 +1,92 @@
+"""WMT14 en→fr translation dataset.
+
+Parity: python/paddle/text/datasets/wmt14.py (WMT14(data_file, mode,
+dict_size, download) over the paddle wmt14 tar: ``*/src.dict``,
+``*/trg.dict`` and ``<mode>/<mode>`` tab-separated sentence pairs; samples
+(src_ids, trg_ids, trg_ids_next) with <s>/<e>/<unk> framing and the >80
+token filter in all modes).
+"""
+from __future__ import annotations
+
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+from ._base import resolve_data_file
+
+__all__ = ["WMT14"]
+
+URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz"
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+UNK_IDX = 2
+
+
+class WMT14(Dataset):
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True):
+        if mode not in ("train", "test", "gen"):
+            raise ValueError(
+                f"mode should be 'train', 'test' or 'gen', got {mode!r}")
+        if dict_size <= 0:
+            raise ValueError("dict_size should be a positive number")
+        self.mode = mode
+        self.dict_size = dict_size
+        self.data_file = resolve_data_file(
+            data_file, "wmt14", "wmt14.tgz", URL, download)
+        self._load_data()
+
+    def _to_dict(self, fd, size):
+        out = {}
+        for i, line in enumerate(fd):
+            if i >= size:
+                break
+            out[str(line, encoding="utf-8").strip()] = i
+        return out
+
+    def _load_data(self):
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file, mode="r") as f:
+            names = [m.name for m in f if m.name.endswith("src.dict")]
+            assert len(names) == 1, f"need exactly one src.dict, got {names}"
+            self.src_dict = self._to_dict(f.extractfile(names[0]),
+                                          self.dict_size)
+            names = [m.name for m in f if m.name.endswith("trg.dict")]
+            assert len(names) == 1, f"need exactly one trg.dict, got {names}"
+            self.trg_dict = self._to_dict(f.extractfile(names[0]),
+                                          self.dict_size)
+            file_name = f"{self.mode}/{self.mode}"
+            names = [m.name for m in f if m.name.endswith(file_name)]
+            for name in names:
+                for line in f.extractfile(name):
+                    line = str(line, encoding="utf-8")
+                    parts = line.strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_words = parts[0].split()
+                    src_ids = [self.src_dict.get(w, UNK_IDX)
+                               for w in [START] + src_words + [END]]
+                    trg_words = parts[1].split()
+                    trg_ids = [self.trg_dict.get(w, UNK_IDX)
+                               for w in trg_words]
+                    if len(src_ids) > 80 or len(trg_ids) > 80:
+                        continue
+                    self.src_ids.append(src_ids)
+                    self.trg_ids.append([self.trg_dict[START]] + trg_ids)
+                    self.trg_ids_next.append(trg_ids + [self.trg_dict[END]])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, reverse=False):
+        src, trg = self.src_dict, self.trg_dict
+        if reverse:
+            src = {v: k for k, v in src.items()}
+            trg = {v: k for k, v in trg.items()}
+        return src, trg

@@ -1,0 +1,101 @@
+"""WMT16 en↔de translation dataset.
+
+Parity: python/paddle/text/datasets/wmt16.py (WMT16(data_file, mode,
+src_dict_size, trg_dict_size, lang, download) over the paddle wmt16 tar:
+``wmt16/{train,val,test}`` tab-separated en/de pairs; dictionaries built
+from the train split by frequency with <s>/<e>/<unk> as ids 0/1/2; samples
+(src_ids, trg_ids, trg_ids_next)).  The reference caches built dicts under
+DATA_HOME; here they are built in memory each construction (same content).
+"""
+from __future__ import annotations
+
+import tarfile
+from collections import defaultdict
+
+import numpy as np
+
+from ...io import Dataset
+from ._base import resolve_data_file
+
+__all__ = ["WMT16"]
+
+URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz"
+START_MARK = "<s>"
+END_MARK = "<e>"
+UNK_MARK = "<unk>"
+
+
+class WMT16(Dataset):
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        if mode.lower() not in ("train", "test", "val"):
+            raise ValueError(
+                f"mode should be 'train', 'test' or 'val', got {mode!r}")
+        if src_dict_size <= 0 or trg_dict_size <= 0:
+            raise ValueError("dict sizes should be positive numbers")
+        if lang not in ("en", "de"):
+            raise ValueError(f"lang should be 'en' or 'de', got {lang!r}")
+        self.mode = mode.lower()
+        self.lang = lang
+        self.data_file = resolve_data_file(
+            data_file, "wmt16", "wmt16.tar.gz", URL, download)
+        # one pass over wmt16/train counts BOTH language columns
+        en_freq, de_freq = self._count_words()
+        src_freq, trg_freq = ((en_freq, de_freq) if lang == "en"
+                              else (de_freq, en_freq))
+        self.src_dict = self._build_dict(src_freq, src_dict_size)
+        self.trg_dict = self._build_dict(trg_freq, trg_dict_size)
+        self._load_data()
+
+    def _count_words(self):
+        en, de = defaultdict(int), defaultdict(int)
+        with tarfile.open(self.data_file, mode="r") as f:
+            for line in f.extractfile("wmt16/train"):
+                parts = str(line, encoding="utf-8").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                for w in parts[0].split():
+                    en[w] += 1
+                for w in parts[1].split():
+                    de[w] += 1
+        return en, de
+
+    def _build_dict(self, freq, dict_size):
+        words = [w for w, _ in sorted(freq.items(), key=lambda x: x[1],
+                                      reverse=True)]
+        words = words[: max(dict_size - 3, 0)]
+        return {w: i for i, w in enumerate(
+            [START_MARK, END_MARK, UNK_MARK] + words)}
+
+    def _load_data(self):
+        start_id = self.src_dict[START_MARK]
+        end_id = self.src_dict[END_MARK]
+        unk_id = self.src_dict[UNK_MARK]
+        src_col = 0 if self.lang == "en" else 1
+        trg_col = 1 - src_col
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file, mode="r") as f:
+            for line in f.extractfile(f"wmt16/{self.mode}"):
+                parts = str(line, encoding="utf-8").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src_words = parts[src_col].split()
+                src_ids = ([start_id]
+                           + [self.src_dict.get(w, unk_id) for w in src_words]
+                           + [end_id])
+                trg_words = parts[trg_col].split()
+                trg_ids = [self.trg_dict.get(w, unk_id) for w in trg_words]
+                self.src_ids.append(src_ids)
+                self.trg_ids.append([start_id] + trg_ids)
+                self.trg_ids_next.append(trg_ids + [end_id])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, lang, reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else dict(d)

@@ -1,0 +1,265 @@
+"""paddle_tpu.distribution + paddle_tpu.text.datasets.
+
+Reference capability: python/paddle/distribution.py (Distribution/Uniform/
+Normal/Categorical) and python/paddle/text/datasets/ (UCIHousing, Imdb,
+Imikolov, Movielens, WMT14, WMT16, Conll05st).  Dataset tests build tiny
+fixture files in the reference's exact on-disk formats (no egress here).
+"""
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import Categorical, Normal, Uniform
+from paddle_tpu.text.datasets import (
+    Conll05st,
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    WMT14,
+    WMT16,
+)
+
+
+class TestDistributions:
+    def test_normal_log_prob_oracle(self):
+        n = Normal(1.0, 2.0)
+        x = np.linspace(-3, 5, 7)
+        got = np.asarray(n.log_prob(x))
+        want = (-((x - 1.0) ** 2) / 8.0 - np.log(2.0)
+                - 0.5 * np.log(2 * np.pi))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(n.probs(x)), np.exp(want),
+                                   rtol=1e-5)
+
+    def test_normal_entropy_and_kl(self):
+        a, b = Normal(0.0, 1.0), Normal(2.0, 3.0)
+        np.testing.assert_allclose(
+            float(a.entropy()), 0.5 + 0.5 * np.log(2 * np.pi), rtol=1e-6)
+        assert float(a.kl_divergence(a)) == pytest.approx(0.0, abs=1e-7)
+        # KL(N(0,1)||N(2,3)) closed form
+        want = 0.5 * (1 / 9 + 4 / 9 - 1 - np.log(1 / 9))
+        np.testing.assert_allclose(float(a.kl_divergence(b)), want, rtol=1e-5)
+
+    def test_normal_sampling_moments(self):
+        paddle.seed(0)
+        s = np.asarray(Normal(3.0, 0.5).sample((20000,)))
+        assert abs(s.mean() - 3.0) < 0.02
+        assert abs(s.std() - 0.5) < 0.02
+
+    def test_uniform(self):
+        u = Uniform(-1.0, 3.0)
+        np.testing.assert_allclose(float(u.entropy()), np.log(4.0), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(u.probs(np.array([0.0, 5.0]))), [0.25, 0.0])
+        paddle.seed(1)
+        s = np.asarray(u.sample((8000,)))
+        assert s.min() >= -1.0 and s.max() < 3.0
+        assert abs(s.mean() - 1.0) < 0.05
+
+    def test_categorical(self):
+        logits = np.log(np.array([[0.2, 0.3, 0.5]], np.float32))
+        c = Categorical(logits)
+        np.testing.assert_allclose(
+            np.asarray(c.probs(np.array([2]))), [0.5], rtol=1e-5)
+        want_ent = -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5))
+        np.testing.assert_allclose(np.asarray(c.entropy()), [want_ent],
+                                   rtol=1e-5)
+        assert float(c.kl_divergence(c).sum()) == pytest.approx(0.0, abs=1e-6)
+        paddle.seed(2)
+        s = np.asarray(c.sample((30000,)))
+        freq = np.bincount(s.ravel(), minlength=3) / s.size
+        np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.02)
+
+
+# --------------------------------------------------------------------------
+# dataset fixtures in the reference's on-disk formats
+# --------------------------------------------------------------------------
+def _add_tar_bytes(tar, name, data: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tar.addfile(info, io.BytesIO(data))
+
+
+class TestUCIHousing:
+    def test_load_and_split(self, tmp_path):
+        rng = np.random.RandomState(0)
+        table = rng.rand(50, 14).astype(np.float32)
+        p = os.path.join(tmp_path, "housing.data")
+        np.savetxt(p, table)
+        train = UCIHousing(data_file=p, mode="train")
+        test = UCIHousing(data_file=p, mode="test")
+        assert len(train) == 40 and len(test) == 10
+        feat, tgt = train[0]
+        assert feat.shape == (13,) and tgt.shape == (1,)
+
+    def test_missing_file_clear_error(self, tmp_path):
+        with pytest.raises(Exception, match="cannot download"):
+            UCIHousing(data_file=None, mode="train")
+
+
+class TestImdb:
+    def _make_tar(self, tmp_path):
+        p = os.path.join(tmp_path, "aclImdb_v1.tar.gz")
+        docs = {
+            "aclImdb/train/pos/0.txt": b"a great great movie",
+            "aclImdb/train/neg/0.txt": b"a bad movie indeed",
+            "aclImdb/test/pos/0.txt": b"great fun",
+            "aclImdb/test/neg/0.txt": b"bad bad bad",
+        }
+        with tarfile.open(p, "w:gz") as t:
+            for name, data in docs.items():
+                _add_tar_bytes(t, name, data)
+        return p
+
+    def test_word_dict_and_labels(self, tmp_path):
+        p = self._make_tar(tmp_path)
+        ds = Imdb(data_file=p, mode="train", cutoff=1)
+        # freq > 1 in train: 'a'(2), 'great'(2), 'movie'(2)
+        assert set(ds.word_idx) == {"a", "great", "movie", "<unk>"}
+        assert len(ds) == 2
+        docs = {tuple(ds[i][0].tolist()): int(ds[i][1]) for i in range(2)}
+        # pos doc → label 0; neg doc → label 1
+        labels = sorted(docs.values())
+        assert labels == [0, 1]
+
+    def test_test_mode(self, tmp_path):
+        ds = Imdb(data_file=self._make_tar(tmp_path), mode="test", cutoff=1)
+        assert len(ds) == 2
+
+
+class TestImikolov:
+    def _make_tar(self, tmp_path):
+        p = os.path.join(tmp_path, "simple-examples.tar.gz")
+        train = b"the cat sat\nthe dog sat\n"
+        valid = b"the cat ran\n"
+        with tarfile.open(p, "w:gz") as t:
+            _add_tar_bytes(t, "./simple-examples/data/ptb.train.txt", train)
+            _add_tar_bytes(t, "./simple-examples/data/ptb.valid.txt", valid)
+        return p
+
+    def test_ngram(self, tmp_path):
+        ds = Imikolov(data_file=self._make_tar(tmp_path), data_type="NGRAM",
+                      window_size=2, mode="train", min_word_freq=0)
+        # each train line: <s> w w w <e> → 4 bigrams, 2 lines → 8
+        assert len(ds) == 8
+        a, b = ds[0], ds[1]
+        assert a[1] == b[0]  # sliding window
+
+    def test_seq(self, tmp_path):
+        ds = Imikolov(data_file=self._make_tar(tmp_path), data_type="SEQ",
+                      window_size=-1, mode="train", min_word_freq=0)
+        src, trg = ds[0]
+        assert src[0] == ds.word_idx["<s>"]
+        assert trg[-1] == ds.word_idx["<e>"]
+        np.testing.assert_array_equal(src[1:], trg[:-1])
+
+
+class TestMovielens:
+    def _make_zip(self, tmp_path):
+        p = os.path.join(tmp_path, "ml-1m.zip")
+        movies = "1::Toy Story (1995)::Animation|Comedy\n2::Heat (1995)::Action\n"
+        users = "1::M::25::6::55117\n2::F::35::3::55117\n"
+        ratings = "".join(f"{u}::{m}::{r}::978300760\n"
+                          for u, m, r in [(1, 1, 5), (1, 2, 3), (2, 1, 4),
+                                          (2, 2, 2)] * 10)
+        with zipfile.ZipFile(p, "w") as z:
+            z.writestr("ml-1m/movies.dat", movies)
+            z.writestr("ml-1m/users.dat", users)
+            z.writestr("ml-1m/ratings.dat", ratings)
+        return p
+
+    def test_loads_and_splits(self, tmp_path):
+        p = self._make_zip(tmp_path)
+        train = Movielens(data_file=p, mode="train", test_ratio=0.25,
+                          rand_seed=0)
+        test = Movielens(data_file=p, mode="test", test_ratio=0.25,
+                         rand_seed=0)
+        assert len(train) + len(test) == 40
+        sample = train[0]
+        assert len(sample) == 8  # uid,gender,age,job, mid,cats,title, rating
+        assert sample[-1].shape == (1,)
+        assert -5.0 <= float(sample[-1][0]) <= 5.0
+
+
+class TestWMT:
+    def _wmt14_tar(self, tmp_path):
+        p = os.path.join(tmp_path, "wmt14.tgz")
+        src_dict = b"<s>\n<e>\n<unk>\nhello\nworld\n"
+        trg_dict = b"<s>\n<e>\n<unk>\nbonjour\nmonde\n"
+        train = b"hello world\tbonjour monde\nhello\tbonjour\n"
+        with tarfile.open(p, "w:gz") as t:
+            _add_tar_bytes(t, "wmt14/src.dict", src_dict)
+            _add_tar_bytes(t, "wmt14/trg.dict", trg_dict)
+            _add_tar_bytes(t, "train/train", train)
+        return p
+
+    def test_wmt14(self, tmp_path):
+        ds = WMT14(data_file=self._wmt14_tar(tmp_path), mode="train",
+                   dict_size=5)
+        assert len(ds) == 2
+        src, trg, trg_next = ds[0]
+        sdict, tdict = ds.get_dict()
+        assert src.tolist() == [sdict["<s>"], sdict["hello"],
+                                sdict["world"], sdict["<e>"]]
+        assert trg.tolist()[0] == tdict["<s>"]
+        assert trg_next.tolist()[-1] == tdict["<e>"]
+
+    def test_wmt16(self, tmp_path):
+        p = os.path.join(tmp_path, "wmt16.tar.gz")
+        train = b"hello world\thallo welt\nworld world\twelt welt\n"
+        with tarfile.open(p, "w:gz") as t:
+            _add_tar_bytes(t, "wmt16/train", train)
+            _add_tar_bytes(t, "wmt16/val", b"hello\thallo\n")
+        ds = WMT16(data_file=p, mode="val", src_dict_size=10,
+                   trg_dict_size=10, lang="en")
+        assert len(ds) == 1
+        src, trg, trg_next = ds[0]
+        assert src[0] == ds.src_dict["<s>"] and src[-1] == ds.src_dict["<e>"]
+        # 'world' appears 3x in train → first corpus word after the marks
+        assert ds.src_dict["world"] == 3
+
+
+class TestConll05:
+    def _fixture(self, tmp_path):
+        words = b"The\ncat\nsat\n\n"
+        # props: col0 = verb lemma rows; one predicate column
+        props = b"-\t*\nsit\t(V*)\n-\t(A1*)\n\n"
+        tar_p = os.path.join(tmp_path, "conll05st-tests.tar.gz")
+        with tarfile.open(tar_p, "w:gz") as t:
+            _add_tar_bytes(
+                t, "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                gzip.compress(words))
+            _add_tar_bytes(
+                t, "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                gzip.compress(props))
+        wd = os.path.join(tmp_path, "wordDict.txt")
+        vd = os.path.join(tmp_path, "verbDict.txt")
+        td = os.path.join(tmp_path, "targetDict.txt")
+        with open(wd, "w") as f:
+            f.write("the\ncat\nsat\nThe\n")
+        with open(vd, "w") as f:
+            f.write("sit\n")
+        with open(td, "w") as f:
+            f.write("B-V\nI-V\nB-A1\nI-A1\nO\n")
+        return tar_p, wd, vd, td
+
+    def test_srl_sample(self, tmp_path):
+        tar_p, wd, vd, td = self._fixture(tmp_path)
+        ds = Conll05st(data_file=tar_p, word_dict_file=wd, verb_dict_file=vd,
+                       target_dict_file=td)
+        assert len(ds) == 1
+        cols = ds[0]
+        assert len(cols) == 9
+        word_idx, *ctx, pred_idx, mark, label_idx = cols
+        assert word_idx.shape == (3,)
+        assert mark.tolist().count(1) == 3  # verb @1: ctx -1,0,+1 in range
+        labels = ds.labels[0]
+        assert labels == ["O", "B-V", "B-A1"]
+        assert pred_idx.tolist() == [0, 0, 0]
