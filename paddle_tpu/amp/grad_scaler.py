@@ -112,13 +112,16 @@ class GradScaler:
             return
         if grads is None:
             raise InvalidArgumentError("step() needs grads (no implicit tape)")
-        items = grads.items() if isinstance(grads, dict) else enumerate(grads)
+        # materialize ONCE — a generator input would otherwise yield keys
+        # and then an empty vals list (silent no-op step)
+        is_dict = isinstance(grads, dict)
+        items = list(grads.items()) if is_dict else list(enumerate(grads))
         keys = [k for k, _ in items]
-        vals = [v for _, v in (grads.items() if isinstance(grads, dict) else enumerate(grads))]
+        vals = [v for _, v in items]
         unscaled, found_inf = self.unscale_and_check(vals, self._state)
         self._found_inf = bool(found_inf)
         if not self._found_inf:
-            out = dict(zip(keys, unscaled)) if isinstance(grads, dict) else list(unscaled)
+            out = dict(zip(keys, unscaled)) if is_dict else list(unscaled)
             optimizer.step(out)
 
     def update(self):

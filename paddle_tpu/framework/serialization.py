@@ -12,7 +12,11 @@ top of this same format.
 
 Format: a zip-free single file — pickle protocol 2+ of nested python
 containers whose leaves are numpy arrays / scalars, prefixed by a magic +
-version header so load() can reject foreign files with a clear error.
+version header.  load() rejects non-magic files with a clear error, with
+ONE exception: headerless pickles from the reference's ``paddle.save`` are
+accepted when (and only when) the filename uses the reference checkpoint
+extensions ``.pdparams``/``.pdopt`` (migration path; note that unpickling
+any file implies trusting its origin).
 """
 from __future__ import annotations
 
@@ -75,14 +79,32 @@ def save(obj: Any, path: str, protocol: int = 4, **configs):
 def load(path: str, **configs) -> Any:
     """Load an object saved by :func:`save`. Leaves come back as numpy
     arrays; feed them to ``Layer.set_state_dict`` / ``Optimizer.set_state_dict``
-    (which cast onto the right device/dtype lazily)."""
+    (which cast onto the right device/dtype lazily).
+
+    Compat: files written by the reference's ``paddle.save`` (plain pickle,
+    no magic header — python/paddle/framework/io.py) also load, so
+    checkpoints migrate without conversion.  Anything else is rejected with
+    a clear error."""
     path = os.fspath(path)
     if not os.path.exists(path):
         raise NotFoundError(f"checkpoint file {path!r} does not exist")
     with open(path, "rb") as f:
         magic = f.read(len(_MAGIC))
-        if magic != _MAGIC:
+        if magic == _MAGIC:
+            return pickle.load(f)
+        # compat fallback ONLY for the reference's own checkpoint
+        # extensions: a stray non-checkpoint pickle (or malicious file)
+        # under another name is still rejected before unpickling
+        if not path.endswith((".pdparams", ".pdopt")):
             raise InvalidArgumentError(
-                f"{path!r} is not a paddle_tpu checkpoint (bad magic {magic!r})"
+                f"{path!r} is not a paddle_tpu checkpoint (bad magic "
+                f"{magic!r}); reference paddle pickles load only from "
+                f".pdparams/.pdopt files")
+        f.seek(0)
+        try:
+            return pickle.load(f)  # reference paddle.save: headerless pickle
+        except Exception:
+            raise InvalidArgumentError(
+                f"{path!r} is neither a paddle_tpu checkpoint (magic "
+                f"{_MAGIC!r}) nor a reference paddle pickle"
             )
-        return pickle.load(f)

@@ -393,7 +393,11 @@ class Model:
                         else max(len(batch) - self._n_labels, 1))
                 loss_val, metrics = self._train_batch_device(batch[:n_in], batch[n_in:])
                 logs = {"loss": loss_val}  # device scalar; callbacks pull it
-                for name, res in zip(self._metrics_names(), metrics):
+                # flatten multi-output metric results (e.g. Accuracy
+                # topk=(1,5)) so they pair 1:1 with the flattened names,
+                # matching the epoch-end handling
+                flat_results = [r for res in metrics for r in _tuplize(res)]
+                for name, res in zip(self._metrics_names(), flat_results):
                     logs[name] = res
                 logs["batch_size"] = np.asarray(batch[0]).shape[0]
                 cbks.on_train_batch_end(step, logs)

@@ -23,6 +23,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 __all__ = ["flash_attention"]
@@ -113,19 +114,24 @@ def _fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, q_offset):
         _fwd_kernel, kv_seq=K, block_k=block_k, causal=causal,
         sm_scale=sm_scale, q_offset_blocks=q_offset // block_q)
 
+    _I0 = np.int32(0)  # np scalar: index maps may not capture device arrays
+
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
+        # index-map constants MUST be i32: under the package's global x64
+        # mode a literal 0 traces as i64 and Mosaic fails to legalize the
+        # index computation (func.return (i32, i32, i64))
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, K, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, K, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, _I0)),
+            pl.BlockSpec((1, K, D), lambda b, i: (b, _I0, _I0)),
+            pl.BlockSpec((1, K, D), lambda b, i: (b, _I0, _I0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, _I0)),
             # lse as [BH, 1, S]: block (1,1,block_q) satisfies the TPU
             # (8,128)-divisible-or-full tiling rule on the last two dims
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, _I0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
@@ -218,8 +224,11 @@ def flash_attention(q, k, v, causal: bool = False,
     S, K = q.shape[2], k.shape[2]
     bq = min(block_q, S)
     bk = min(block_k, K)
-    if S % bq or K % bk:
-        # ragged tail: fall back to the reference path (still correct)
+    if S % bq or K % bk or (causal and q_position_offset % bq):
+        # ragged tail — or a causal offset that isn't q-block-aligned: the
+        # forward kernel floors the offset to whole q-blocks
+        # (q_offset_blocks), which would mis-mask and disagree with the
+        # exact-offset backward.  The reference path is exact for any shape.
         return _naive_reference(q, k, v, causal, sm_scale, q_position_offset)
     return _flash(q, k, v, causal, float(sm_scale), bq, bk,
                   int(q_position_offset))

@@ -241,3 +241,14 @@ class TestReviewRegressions:
         s = amp.GradScaler(enable=True, use_dynamic_loss_scaling=False)
         assert not s.is_use_dynamic_loss_scaling()
         assert s.is_enable()
+
+
+class TestGradScalerInputs:
+    def test_generator_grads_not_silently_dropped(self):
+        """ADVICE r1: a generator grads input used to produce an empty
+        value list → silent no-op step."""
+        w = Parameter(np.ones(2, np.float32), name="w")
+        opt = popt.SGD(learning_rate=1.0, parameters=[w])
+        s = amp.GradScaler(init_loss_scaling=2.0)
+        s.step(opt, (g for g in [jnp.ones(2) * 2.0]))
+        np.testing.assert_allclose(w.numpy(), 0.0)  # 1 - 1.0*(2/2)

@@ -179,3 +179,37 @@ class TestUlysses:
         for a, b in zip(g, g_ref):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-3, atol=1e-4)
+
+
+class TestNonAlignedOffset:
+    """ADVICE r1 (medium): a causal q_position_offset that isn't
+    q-block-aligned must not go through the Pallas forward (it floors the
+    offset to whole blocks → wrong mask, grads inconsistent with fwd)."""
+
+    def test_non_block_aligned_offset_exact(self, rng):
+        q, k, v = make_qkv(rng, S=16, K=64)
+        for off in (3, 7, 13):  # none divisible by block_q=8
+            out = flash_attention(q, k, v, causal=True, block_q=8,
+                                  block_k=16, q_position_offset=off)
+            ref = _naive_reference(q, k, v, True, 1.0 / math.sqrt(16),
+                                   q_offset=off)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-4, atol=2e-5, err_msg=f"off={off}")
+
+    def test_non_aligned_grads_consistent(self, rng):
+        import jax
+
+        q, k, v = make_qkv(rng, S=16, K=16)
+
+        def loss_flash(q):
+            return flash_attention(q, k, v, causal=True, block_q=8,
+                                   block_k=8, q_position_offset=5).sum()
+
+        def loss_ref(q):
+            return _naive_reference(q, k, v, True, 1.0 / math.sqrt(16),
+                                    q_offset=5).sum()
+
+        g1 = jax.grad(loss_flash)(q)
+        g2 = jax.grad(loss_ref)(q)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=2e-4, atol=2e-4)

@@ -292,3 +292,39 @@ class TestReviewRegressions:
         with pytest.raises(Exception, match="shuffle"):
             pio.DataLoader(ds, batch_size=2, shuffle=True,
                            sampler=pio.SequenceSampler(ds))
+
+
+class TestMultiOutputMetricLogs:
+    def test_topk_accuracy_batch_logs_both_names(self):
+        """ADVICE r1: per-batch logs must pair flattened metric names with
+        flattened results (Accuracy(topk=(1,2)) logs both, not a list
+        under the first name)."""
+        import paddle_tpu as paddle
+        from paddle_tpu import metric as pmetric
+        from paddle_tpu.hapi.callbacks import Callback
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 4))
+        model = paddle.Model(net, inputs=["x"], labels=["y"])
+        model.prepare(optimizer=popt.SGD(learning_rate=0.1),
+                      loss=nn.CrossEntropyLoss(),
+                      metrics=[pmetric.Accuracy(topk=(1, 2))])
+
+        seen = {}
+
+        class Capture(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                seen.update(logs or {})
+
+        rng = np.random.RandomState(0)
+        X = rng.randn(16, 4).astype(np.float32)
+        Y = rng.randint(0, 4, size=(16, 1)).astype(np.int32)
+        from paddle_tpu.io import TensorDataset
+
+        model.fit(TensorDataset([X, Y]), batch_size=8, epochs=1, verbose=0,
+                  callbacks=[Capture()])
+        assert "acc_top1" in seen and "acc_top2" in seen
+        import numbers
+
+        assert isinstance(seen["acc_top1"], numbers.Number)
+        assert isinstance(seen["acc_top2"], numbers.Number)

@@ -317,3 +317,41 @@ class TestReviewRegressions:
         with pytest.warns(RuntimeWarning, match="num_workers"):
             dl = pio.DataLoader(Stream(), batch_size=2, num_workers=4, return_numpy=True)
         assert dl.num_workers == 0
+
+
+class TestReferenceCompatLoad:
+    def test_headerless_reference_pickle_loads(self, tmp_path):
+        """ADVICE r1: reference paddle.save files are plain pickles with no
+        magic header — load() accepts them."""
+        import pickle
+
+        from paddle_tpu.framework import serialization
+
+        p = os.path.join(tmp_path, "ref.pdparams")
+        state = {"w": np.arange(4, dtype=np.float32)}
+        with open(p, "wb") as f:
+            pickle.dump(state, f, protocol=2)
+        out = serialization.load(p)
+        np.testing.assert_array_equal(out["w"], state["w"])
+
+    def test_garbage_still_rejected(self, tmp_path):
+        from paddle_tpu.framework import serialization
+
+        p = os.path.join(tmp_path, "junk.pdparams")
+        with open(p, "wb") as f:
+            f.write(b"\x00\x01garbage not a pickle")
+        with pytest.raises(Exception, match="neither"):
+            serialization.load(p)
+
+    def test_foreign_extension_never_unpickled(self, tmp_path):
+        """The compat fallback is gated to .pdparams/.pdopt — any other
+        extension is rejected BEFORE the unpickler runs."""
+        import pickle
+
+        from paddle_tpu.framework import serialization
+
+        p = os.path.join(tmp_path, "model.pkl")
+        with open(p, "wb") as f:
+            pickle.dump({"w": np.ones(2)}, f)
+        with pytest.raises(Exception, match="pdparams"):
+            serialization.load(p)
