@@ -304,6 +304,17 @@ class Model:
                 f"in: {bad[:8]}{' …' if len(bad) > 8 else ''}")
 
     def eval_batch(self, inputs, labels=None):
+        loss_val, metrics = self._eval_batch_device(inputs, labels)
+        return float(loss_val), metrics
+
+    def _eval_batch_device(self, inputs, labels=None):
+        """eval_batch without the loss host-sync — the loss stays a device
+        scalar so evaluate()'s loop dispatches ahead of the device, the
+        same way fit() does.  NOTE: metrics (if prepared) still sync per
+        batch — Metric.compute/update are host-side numpy by design; the
+        async win applies to loss-only evaluation."""
+        if self._eval_step is None:
+            raise InvalidArgumentError("call prepare(loss=...) first")
         batch = tuple(_tuplize(inputs)) + tuple(_tuplize(labels) if labels is not None else ())
         if self._plan is not None:
             batch = self._plan.shard_batch(batch)
@@ -313,7 +324,7 @@ class Model:
         loss_val, out = self._eval_step(params, buffers, *batch)
         _, labels_part = self._split_batch(batch)
         metrics = self._update_metrics(out, labels_part)
-        return float(loss_val), metrics
+        return loss_val, metrics
 
     def predict_batch(self, inputs):
         if self._plan is not None:
@@ -424,16 +435,17 @@ class Model:
         for m in self._metrics:
             m.reset()
         cbks.on_eval_begin()
-        total_loss, n_batches = 0.0, 0
+        batch_losses = []  # device scalars — loss syncs once, at the end
         for step, batch in enumerate(loader):
             cbks.on_eval_batch_begin(step)
             batch = _tuplize(batch)
             n_in = (self._n_inputs if self._n_inputs is not None
                     else max(len(batch) - self._n_labels, 1))
-            loss_val, _ = self.eval_batch(batch[:n_in], batch[n_in:])
-            total_loss += loss_val
-            n_batches += 1
+            loss_val, _ = self._eval_batch_device(batch[:n_in], batch[n_in:])
+            batch_losses.append(loss_val)
             cbks.on_eval_batch_end(step, {"loss": loss_val})
+        total_loss = float(jnp.stack(batch_losses).sum()) if batch_losses else 0.0
+        n_batches = len(batch_losses)
         if n_batches == 0:
             import warnings
 

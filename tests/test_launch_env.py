@@ -1,0 +1,119 @@
+"""Multi-host launch/env wiring (mock form).
+
+Reference capability: fleet launch env plumbing (fleet/launch_utils.py
+PADDLE_TRAINER_ID/PADDLE_TRAINER_ENDPOINTS → trainer bootstrap; tested by
+the reference's test_launch.sh).  TPU-native: those env vars must reach
+``jax.distributed.initialize``.  Real multi-host needs multiple machines,
+so initialize is captured by a stub — exactly how the reference fakes
+multi-rank in test_collective_base.py.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.distributed.env as penv
+from paddle_tpu.distributed.parallel import launch, spawn
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    """Reset the module singleton + scrub trainer vars around each test."""
+    penv._initialized = False
+    for k in ("COORDINATOR_ADDRESS", "PADDLE_TRAINER_ENDPOINTS",
+              "PADDLE_TRAINERS_NUM", "PADDLE_TRAINER_ID"):
+        monkeypatch.delenv(k, raising=False)
+    yield monkeypatch
+    penv._initialized = False
+
+
+@pytest.fixture
+def capture_init(clean_env):
+    calls = []
+
+    def fake_initialize(coordinator_address=None, num_processes=None,
+                        process_id=None, **kw):
+        calls.append({"addr": coordinator_address, "nproc": num_processes,
+                      "pid": process_id})
+
+    clean_env.setattr(penv.jax.distributed, "initialize", fake_initialize)
+    return calls
+
+
+class TestInitParallelEnv:
+    def test_single_host_is_noop(self, capture_init):
+        env = penv.init_parallel_env()
+        assert capture_init == []  # no rendezvous for one host
+        assert env.rank == 0
+        assert penv.is_initialized()
+
+    def test_paddle_trainer_env_wires_rendezvous(self, clean_env, capture_init):
+        clean_env.setenv("PADDLE_TRAINER_ENDPOINTS",
+                         "10.0.0.1:6170,10.0.0.2:6170")
+        clean_env.setenv("PADDLE_TRAINERS_NUM", "2")
+        clean_env.setenv("PADDLE_TRAINER_ID", "1")
+        penv.init_parallel_env()
+        assert capture_init == [
+            {"addr": "10.0.0.1:6170", "nproc": 2, "pid": 1}]
+
+    def test_coordinator_address_beats_endpoints(self, clean_env, capture_init):
+        clean_env.setenv("COORDINATOR_ADDRESS", "coord:1234")
+        clean_env.setenv("PADDLE_TRAINER_ENDPOINTS", "other:1,other:2")
+        clean_env.setenv("PADDLE_TRAINERS_NUM", "4")
+        clean_env.setenv("PADDLE_TRAINER_ID", "3")
+        penv.init_parallel_env()
+        assert capture_init == [{"addr": "coord:1234", "nproc": 4, "pid": 3}]
+
+    def test_explicit_args_beat_env(self, clean_env, capture_init):
+        clean_env.setenv("PADDLE_TRAINERS_NUM", "8")
+        penv.init_parallel_env(coordinator_address="a:1", num_processes=2,
+                               process_id=1)
+        assert capture_init == [{"addr": "a:1", "nproc": 2, "pid": 1}]
+
+    def test_second_init_is_idempotent(self, clean_env, capture_init):
+        clean_env.setenv("COORDINATOR_ADDRESS", "coord:1")
+        clean_env.setenv("PADDLE_TRAINERS_NUM", "2")
+        penv.init_parallel_env()
+        penv.init_parallel_env()
+        assert len(capture_init) == 1
+
+    def test_endpoints_env_surfaced(self, clean_env):
+        clean_env.setenv("PADDLE_TRAINER_ENDPOINTS", "h1:1,h2:2")
+        env = penv.ParallelEnv()
+        assert env.trainer_endpoints == ["h1:1", "h2:2"]
+        assert env.current_endpoint == "h1:1"
+
+
+class TestLaunch:
+    def test_launch_runs_script_with_env(self, clean_env, capture_init, tmp_path):
+        script = os.path.join(tmp_path, "train.py")
+        marker = os.path.join(tmp_path, "ran.txt")
+        with open(script, "w") as f:
+            f.write(
+                "import sys, os\n"
+                f"open({marker!r}, 'w').write(' '.join(sys.argv[1:]))\n")
+        clean_env.setenv("COORDINATOR_ADDRESS", "c:9")
+        clean_env.setenv("PADDLE_TRAINERS_NUM", "2")
+        clean_env.setenv("PADDLE_TRAINER_ID", "0")
+        old_argv = list(sys.argv)
+        try:
+            rc = launch([script, "--lr", "0.1"])
+        finally:
+            sys.argv = old_argv
+        assert rc == 0
+        with open(marker) as f:
+            assert f.read() == "--lr 0.1"
+        assert capture_init == [{"addr": "c:9", "nproc": 2, "pid": 0}]
+
+    def test_launch_no_script_usage(self, clean_env):
+        assert launch([]) == 1
+
+    def test_spawn_single_runs_func(self, capture_init):
+        out = []
+        spawn(lambda a: out.append(a), args=(7,))
+        assert out == [7]
+
+    def test_spawn_multi_on_one_host_errors(self, clean_env):
+        with pytest.raises(Exception, match="multi-host"):
+            spawn(lambda: None, nprocs=4)
