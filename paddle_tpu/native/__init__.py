@@ -1,0 +1,81 @@
+"""Native runtime components (C++, ctypes-bound).
+
+The reference's runtime is C++ where it matters for throughput — the
+ingest stack above all (framework/data_set.h, data_feed.h run the whole
+file→shuffle→batch path without Python in the loop).  This package holds
+the TPU framework's native equivalents.  pybind11 isn't available in this
+image, so the ABI is plain C over ctypes.
+
+The shared library builds from the in-tree source on first use (g++ -O2)
+and is cached under ``~/.cache/paddle_tpu/native`` keyed by a source hash —
+the same "compile on first touch, cache after" contract as XLA kernels.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+__all__ = ["ingest_lib", "NativeBuildError"]
+
+_CACHE_DIR = os.path.expanduser("~/.cache/paddle_tpu/native")
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "ingest.cc")
+
+_lock = threading.Lock()
+_lib = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build(src: str, tag: str) -> str:
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_CACHE_DIR, f"{tag}-{digest}.so")
+    if os.path.exists(out):
+        return out
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           src, "-o", tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+    except FileNotFoundError as e:
+        raise NativeBuildError(f"g++ not available: {e}")
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"native build failed ({' '.join(cmd)}):\n{proc.stderr[-2000:]}")
+    os.replace(tmp, out)  # atomic publish; concurrent builders converge
+    return out
+
+
+def ingest_lib() -> ctypes.CDLL:
+    """The ingest engine library, built/cached on first call."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        path = _build(_SRC, "ingest")
+        lib = ctypes.CDLL(path)
+        lib.ingest_create.restype = ctypes.c_void_p
+        lib.ingest_create.argtypes = [ctypes.c_int64]
+        lib.ingest_destroy.argtypes = [ctypes.c_void_p]
+        lib.ingest_load.restype = ctypes.c_int64
+        lib.ingest_load.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_char_p),
+                                    ctypes.c_int64, ctypes.c_int64]
+        lib.ingest_size.restype = ctypes.c_int64
+        lib.ingest_size.argtypes = [ctypes.c_void_p]
+        lib.ingest_error.restype = ctypes.c_char_p
+        lib.ingest_error.argtypes = [ctypes.c_void_p]
+        lib.ingest_shuffle.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.ingest_copy_rows.restype = ctypes.c_int64
+        lib.ingest_copy_rows.argtypes = [ctypes.c_void_p,
+                                         ctypes.POINTER(ctypes.c_double),
+                                         ctypes.c_int64, ctypes.c_int64]
+        lib.ingest_clear.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib

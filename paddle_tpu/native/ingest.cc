@@ -1,0 +1,224 @@
+// Native ingest engine — the TPU framework's counterpart of the reference's
+// C++ Dataset/DataFeed stack (paddle/fluid/framework/data_set.h:157
+// InMemoryDataset, data_feed.h:302 InMemoryDataFeed, MultiSlotDataFeed):
+// multithreaded file-sharded parsing into an in-memory sample store, global
+// shuffle, and dense minibatch assembly — all off the Python interpreter.
+//
+// Format: numeric text, one sample per line, fields separated by spaces,
+// tabs or commas; every line must have exactly the configured column count
+// (fixed-width dense — the reference's ragged LoD slots map to padding/
+// bucketing on TPU, SURVEY §7g).  Values are stored as float64 so integer
+// ids up to 2^53 round-trip exactly.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  int64_t ncols = 0;
+  std::vector<double> arena;        // nsamples * ncols, row-major
+  std::vector<int64_t> order;       // shuffle permutation
+  std::string error;                // first error, if any
+};
+
+// One reader thread: parse its share of files into a private arena.
+void ParseFiles(const std::vector<std::string>* files, size_t begin,
+                size_t stride, int64_t ncols, std::vector<double>* out,
+                std::atomic<bool>* failed, std::mutex* err_mu,
+                std::string* err) {
+  for (size_t fi = begin; fi < files->size(); fi += stride) {
+    if (failed->load(std::memory_order_relaxed)) return;
+    FILE* f = std::fopen((*files)[fi].c_str(), "r");
+    if (!f) {
+      std::lock_guard<std::mutex> g(*err_mu);
+      if (err->empty())
+        *err = "cannot open " + (*files)[fi] + ": " + std::strerror(errno);
+      failed->store(true);
+      return;
+    }
+
+    int64_t lineno = 0;
+    auto parse_line = [&](const char* p) -> bool {  // false = abort file
+      ++lineno;
+      int64_t got = 0;
+      bool blank = true;
+      while (*p) {
+        while (*p == ' ' || *p == '\t' || *p == ',' || *p == '\r') ++p;
+        if (*p == '\0' || *p == '\n') break;
+        blank = false;
+        char* end = nullptr;
+        double v = std::strtod(p, &end);
+        if (end == p) {
+          std::lock_guard<std::mutex> g(*err_mu);
+          if (err->empty())
+            *err = (*files)[fi] + ":" + std::to_string(lineno) +
+                   ": unparsable field near '" +
+                   std::string(p).substr(0, 16) + "'";
+          failed->store(true);
+          return false;
+        }
+        out->push_back(v);
+        ++got;
+        p = end;
+      }
+      if (blank) return true;  // skip empty lines
+      if (got != ncols) {
+        std::lock_guard<std::mutex> g(*err_mu);
+        if (err->empty())
+          *err = (*files)[fi] + ":" + std::to_string(lineno) + ": expected " +
+                 std::to_string(ncols) + " columns, got " +
+                 std::to_string(got);
+        failed->store(true);
+        return false;
+      }
+      return true;
+    };
+
+    char buf[1 << 16];
+    std::string pending;
+    bool aborted = false;
+    while (std::fgets(buf, sizeof(buf), f)) {
+      size_t blen = std::strlen(buf);
+      const char* p = buf;
+      if (!pending.empty() || (blen + 1 == sizeof(buf) &&
+                               buf[blen - 1] != '\n' && !std::feof(f))) {
+        // rare path: a line longer than the read buffer
+        pending += buf;
+        if (pending.back() != '\n' && !std::feof(f)) continue;
+        p = pending.c_str();
+      }
+      if (!parse_line(p)) {
+        aborted = true;
+        break;
+      }
+      pending.clear();
+    }
+    // a final unterminated line can be left in `pending` when its length
+    // is an exact multiple of the read buffer (fgets fills the buffer
+    // without seeing EOF) — parse it, don't drop it
+    if (!aborted && !pending.empty() && !parse_line(pending.c_str()))
+      aborted = true;
+    std::fclose(f);
+    if (aborted) return;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque store handle, or 0 on allocation failure.
+void* ingest_create(int64_t ncols) {
+  if (ncols <= 0) return nullptr;
+  Store* s = new (std::nothrow) Store();
+  if (!s) return nullptr;
+  s->ncols = ncols;
+  return s;
+}
+
+void ingest_destroy(void* h) { delete static_cast<Store*>(h); }
+
+// Parse `nfiles` paths with `nthreads` workers.  Thread k takes files
+// k, k+n, k+2n... (file-sharded, like the reference's per-thread channel
+// split, data_set.h filelist distribution).  Appends to the store.
+// Returns number of samples loaded, or -1 (check ingest_error).
+int64_t ingest_load(void* h, const char** paths, int64_t nfiles,
+                    int64_t nthreads) {
+  Store* s = static_cast<Store*>(h);
+  if (!s) return -1;
+  s->error.clear();  // a previous failed load's message must not shadow ours
+  std::vector<std::string> files(paths, paths + nfiles);
+  if (nthreads < 1) nthreads = 1;
+  if (nthreads > nfiles) nthreads = nfiles;
+  std::vector<std::vector<double>> parts(nthreads);
+  std::vector<std::thread> workers;
+  std::atomic<bool> failed(false);
+  std::mutex err_mu;
+  for (int64_t t = 0; t < nthreads; ++t) {
+    workers.emplace_back(ParseFiles, &files, t, nthreads, s->ncols, &parts[t],
+                         &failed, &err_mu, &s->error);
+  }
+  for (auto& w : workers) w.join();
+  if (failed.load()) return -1;
+  int64_t before = static_cast<int64_t>(s->arena.size()) / s->ncols;
+  size_t total = s->arena.size();
+  for (auto& p : parts) total += p.size();
+  s->arena.reserve(total);
+  for (auto& p : parts) {
+    s->arena.insert(s->arena.end(), p.begin(), p.end());
+    p.clear();
+    p.shrink_to_fit();
+  }
+  int64_t n = static_cast<int64_t>(s->arena.size()) / s->ncols;
+  s->order.resize(n);
+  for (int64_t i = 0; i < n; ++i) s->order[i] = i;
+  return n - before;
+}
+
+int64_t ingest_size(void* h) {
+  Store* s = static_cast<Store*>(h);
+  return s ? static_cast<int64_t>(s->order.size()) : -1;
+}
+
+const char* ingest_error(void* h) {
+  Store* s = static_cast<Store*>(h);
+  return s ? s->error.c_str() : "null store";
+}
+
+// Fisher–Yates over the sample permutation (the data never moves — the
+// reference's global_shuffle also permutes channel order, data_set.h:
+// global shuffle path).  The permutation restarts from identity, so a
+// given seed yields the same order regardless of prior shuffles.
+void ingest_shuffle(void* h, uint64_t seed) {
+  Store* s = static_cast<Store*>(h);
+  if (!s) return;
+  std::mt19937_64 rng(seed);
+  int64_t n = static_cast<int64_t>(s->order.size());
+  for (int64_t i = 0; i < n; ++i) s->order[i] = i;
+  for (int64_t i = n - 1; i > 0; --i) {
+    int64_t j = static_cast<int64_t>(rng() % static_cast<uint64_t>(i + 1));
+    std::swap(s->order[i], s->order[j]);
+  }
+}
+
+// Copy up to `count` samples starting at permutation position `start`
+// into `out` (count*ncols f64, caller-allocated).  Returns rows written;
+// 0 = past the end.  The CALLER owns the cursor — concurrent iterators
+// over one store each keep their own position.
+int64_t ingest_copy_rows(void* h, double* out, int64_t start, int64_t count) {
+  Store* s = static_cast<Store*>(h);
+  if (!s || count <= 0 || start < 0) return 0;
+  int64_t n = static_cast<int64_t>(s->order.size());
+  int64_t take = n - start;
+  if (take <= 0) return 0;
+  if (take > count) take = count;
+  for (int64_t r = 0; r < take; ++r) {
+    const double* src = s->arena.data() + s->order[start + r] * s->ncols;
+    std::memcpy(out + r * s->ncols, src,
+                sizeof(double) * static_cast<size_t>(s->ncols));
+  }
+  return take;
+}
+
+void ingest_clear(void* h) {
+  Store* s = static_cast<Store*>(h);
+  if (!s) return;
+  s->arena.clear();
+  s->arena.shrink_to_fit();
+  s->order.clear();
+  s->error.clear();
+}
+
+}  // extern "C"

@@ -1,0 +1,246 @@
+"""Native ingest engine (C++ InMemoryDataset).
+
+Reference capability: framework/data_set.h:157 (InMemoryDataset — file-
+sharded multithreaded load, global shuffle) + data_feed.h:302
+(InMemoryDataFeed batch assembly).  Oracle: numpy parsing of the same
+files.  Also exercises the end-to-end CTR path: native ingest feeding
+WideDeep training.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.errors import InvalidArgumentError, NotFoundError
+from paddle_tpu.io import InMemoryDataset
+
+
+def _write_parts(tmp_path, n_files=4, rows_per_file=25, ncols=6, seed=0):
+    rng = np.random.RandomState(seed)
+    files, all_rows = [], []
+    for i in range(n_files):
+        rows = np.round(rng.randn(rows_per_file, ncols) * 100, 3)
+        rows[:, -1] = rng.randint(0, 2, rows_per_file)  # int label col
+        p = os.path.join(tmp_path, f"part-{i}.txt")
+        sep = "," if i % 2 else " "  # both separators are valid
+        with open(p, "w") as f:
+            for r in rows:
+                f.write(sep.join(repr(float(v)) for v in r) + "\n")
+        files.append(p)
+        all_rows.append(rows)
+    return files, np.concatenate(all_rows)
+
+
+def _dataset():
+    return InMemoryDataset(slots=[("feat", 5, "float32"),
+                                  ("label", 1, "int64")])
+
+
+class TestLoad:
+    def test_load_matches_numpy_oracle(self, tmp_path):
+        files, oracle = _write_parts(tmp_path)
+        ds = _dataset()
+        ds.set_filelist(files)
+        n = ds.load_into_memory(thread_num=3)
+        assert n == 100 and len(ds) == 100
+        batches = list(ds.batch_iter(batch_size=100))
+        assert len(batches) == 1
+        feat, label = batches[0]
+        assert feat.dtype == np.float32 and label.dtype == np.int64
+        got = np.concatenate([feat.astype(np.float64),
+                              label.astype(np.float64)], axis=1)
+        # unshuffled load preserves within-thread file order but thread
+        # merge order is deterministic round-robin → compare as sorted sets
+        np.testing.assert_allclose(
+            np.sort(got, axis=0), np.sort(oracle, axis=0), rtol=1e-6)
+
+    def test_multithreaded_equals_single(self, tmp_path):
+        files, _ = _write_parts(tmp_path)
+        a, b = _dataset(), _dataset()
+        a.set_filelist(files)
+        a.load_into_memory(thread_num=1)
+        b.set_filelist(files)
+        b.load_into_memory(thread_num=4)
+        ga = np.concatenate(
+            [np.concatenate(t, axis=None) for t in a.batch_iter(1000)])
+        gb = np.concatenate(
+            [np.concatenate(t, axis=None) for t in b.batch_iter(1000)])
+        np.testing.assert_allclose(np.sort(ga), np.sort(gb))
+
+    def test_incremental_load_appends(self, tmp_path):
+        files, _ = _write_parts(tmp_path)
+        ds = _dataset()
+        ds.set_filelist(files[:2])
+        assert ds.load_into_memory() == 50
+        ds.set_filelist(files[2:])
+        assert ds.load_into_memory() == 50
+        assert len(ds) == 100
+
+    def test_missing_file_error(self, tmp_path):
+        ds = _dataset()
+        ds.set_filelist([os.path.join(tmp_path, "nope.txt")])
+        with pytest.raises(NotFoundError, match="cannot open"):
+            ds.load_into_memory()
+
+    def test_bad_column_count_names_line(self, tmp_path):
+        p = os.path.join(tmp_path, "bad.txt")
+        with open(p, "w") as f:
+            f.write("1 2 3 4 5 6\n1 2 3\n")
+        ds = _dataset()
+        ds.set_filelist([p])
+        with pytest.raises(InvalidArgumentError, match="bad.txt:2"):
+            ds.load_into_memory()
+
+    def test_unparsable_field_error(self, tmp_path):
+        p = os.path.join(tmp_path, "junk.txt")
+        with open(p, "w") as f:
+            f.write("1 2 three 4 5 6\n")
+        ds = _dataset()
+        ds.set_filelist([p])
+        with pytest.raises(InvalidArgumentError, match="unparsable"):
+            ds.load_into_memory()
+
+    def test_release_memory(self, tmp_path):
+        files, _ = _write_parts(tmp_path)
+        ds = _dataset()
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        ds.release_memory()
+        assert len(ds) == 0
+
+
+class TestShuffleAndBatch:
+    def test_global_shuffle_deterministic_and_complete(self, tmp_path):
+        files, _ = _write_parts(tmp_path)
+        ds = _dataset()
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        before = [t[0].copy() for t in ds.batch_iter(100)][0]
+        ds.global_shuffle(seed=7)
+        s1 = [t[0].copy() for t in ds.batch_iter(100)][0]
+        ds.global_shuffle(seed=7)
+        s2 = [t[0].copy() for t in ds.batch_iter(100)][0]
+        np.testing.assert_array_equal(s1, s2)  # same seed → same order
+        assert not np.array_equal(s1, before)  # actually shuffled
+        np.testing.assert_allclose(np.sort(s1, axis=0),
+                                   np.sort(before, axis=0))  # same multiset
+
+    def test_batch_shapes_and_drop_last(self, tmp_path):
+        files, _ = _write_parts(tmp_path)  # 100 samples
+        ds = _dataset()
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        sizes = [t[0].shape[0] for t in ds.batch_iter(32)]
+        assert sizes == [32, 32, 32, 4]
+        sizes = [t[0].shape[0] for t in ds.batch_iter(32, drop_last=True)]
+        assert sizes == [32, 32, 32]
+
+    def test_epoch_restarts(self, tmp_path):
+        files, _ = _write_parts(tmp_path)
+        ds = _dataset()
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        assert sum(1 for _ in ds.batch_iter(10)) == 10
+        assert sum(1 for _ in ds.batch_iter(10)) == 10  # restartable
+
+    def test_sample_iteration_refused(self, tmp_path):
+        ds = _dataset()
+        with pytest.raises(InvalidArgumentError, match="batch_iter"):
+            iter(ds)
+
+
+class TestEndToEnd:
+    def test_ctr_training_from_native_ingest(self, tmp_path):
+        """The reference's train_from_dataset capability: CTR files →
+        native ingest → Wide&Deep training, loss decreases."""
+        from paddle_tpu import optimizer as popt
+        from paddle_tpu.models import wide_deep_tiny
+
+        rng = np.random.RandomState(0)
+        files = []
+        for i in range(2):
+            p = os.path.join(tmp_path, f"ctr-{i}.txt")
+            with open(p, "w") as f:
+                for _ in range(128):
+                    ids = rng.randint(0, 64, size=4)
+                    dense = np.round(rng.randn(4), 4)
+                    label = int(ids[0] < 32)
+                    f.write(" ".join(map(str, list(ids) + list(dense)
+                                         + [label])) + "\n")
+            files.append(p)
+
+        ds = InMemoryDataset(slots=[("sparse", 4, "int32"),
+                                    ("dense", 4, "float32"),
+                                    ("label", 1, "float32")])
+        ds.set_filelist(files)
+        assert ds.load_into_memory(thread_num=2) == 256
+        ds.global_shuffle(seed=1)
+
+        paddle.seed(0)
+        net = wide_deep_tiny()
+        model = paddle.Model(net, inputs=["sparse", "dense"],
+                             labels=["label"])
+        model.prepare(optimizer=popt.Adam(learning_rate=1e-2),
+                      loss=net.loss)
+        losses = []
+        for _ in range(8):
+            for sparse, dense, label in ds.batch_iter(64):
+                loss, _ = model.train_batch([sparse, dense], [label])
+                losses.append(loss)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.7, losses
+
+
+class TestReviewRegressions:
+    def test_unterminated_final_line_buffer_multiple(self, tmp_path):
+        """A final line with no newline whose length is an exact multiple
+        of the 64KiB read buffer must not be dropped."""
+        p = os.path.join(tmp_path, "edge.txt")
+        ncols = 6
+        first = " ".join(["1.0"] * ncols) + "\n"
+        # craft a last line of exactly 2*(65535) bytes, 6 numeric fields
+        target = 2 * 65535
+        fields = ["2.0"] * (ncols - 1)
+        base = " ".join(fields) + " "
+        pad_len = target - len(base)
+        last = base + "3." + "0" * (pad_len - 2)
+        assert len(last) == target
+        with open(p, "w") as f:
+            f.write(first)
+            f.write(last)  # NO trailing newline
+        ds = _dataset()
+        ds.set_filelist([p])
+        assert ds.load_into_memory() == 2
+
+    def test_error_message_not_stale(self, tmp_path):
+        """A failed load must not shadow the NEXT failure's message."""
+        ds = _dataset()
+        ds.set_filelist([os.path.join(tmp_path, "missing.txt")])
+        with pytest.raises(NotFoundError, match="cannot open"):
+            ds.load_into_memory()
+        bad = os.path.join(tmp_path, "bad.txt")
+        with open(bad, "w") as f:
+            f.write("1 2 3\n")
+        ds.set_filelist([bad])
+        with pytest.raises(InvalidArgumentError, match="bad.txt:1"):
+            ds.load_into_memory()
+
+    def test_concurrent_iterators_independent(self, tmp_path):
+        files, _ = _write_parts(tmp_path)
+        ds = _dataset()
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        it1 = ds.batch_iter(10)
+        it2 = ds.batch_iter(10)
+        a1 = next(it1)[0]
+        b1 = next(it2)[0]
+        a2 = next(it1)[0]
+        np.testing.assert_array_equal(a1, b1)  # both start at position 0
+        assert not np.array_equal(a1, a2)
+        assert sum(1 for _ in it1) == 8  # it1 continues its own epoch
+
+    def test_batch_iter_validates_eagerly(self, tmp_path):
+        ds = _dataset()
+        with pytest.raises(InvalidArgumentError, match="batch_size"):
+            ds.batch_iter(0)  # raises at call, not at first next()
