@@ -87,6 +87,9 @@ def init(role_maker=None, is_collective: bool = True,
     strategy.sharding_degree = max(sharding_degree, 1)
     _strategy = strategy
     _initialized = True
+    from ...framework.logging import vlog
+
+    vlog(1, "fleet.init: mesh %s over %d devices", dict(mesh.shape), n)
     return mesh
 
 

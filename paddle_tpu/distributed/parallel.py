@@ -79,18 +79,93 @@ def spawn(func, args=(), nprocs: Optional[int] = None, join: bool = True, **kwar
 
 
 def launch(argv=None):
-    """Minimal `python -m paddle_tpu.distributed.launch script.py` analogue
-    (reference: fleet/launch.py:183).  Sets the env vars init_parallel_env
-    reads and execs the training script in-process (one process per host —
-    the pod runtime starts this command on every host)."""
+    """`python -m paddle_tpu.distributed.launch [--max-restarts=N] script.py`
+    (reference: fleet/launch.py:183).  One process per host — the pod
+    runtime starts this command on every host.
+
+    Default: exec the training script in-process.  With ``--max-restarts``
+    the script runs as a watched subprocess instead (the reference's
+    launch_utils.py TrainerProc watch loop): a non-zero exit restarts it up
+    to N times — pair with incubate.checkpoint auto-resume and a preempted/
+    crashed trainer continues from its last snapshot (the elastic-lite
+    story; the reference's `strategy.elastic` proto field was never
+    implemented)."""
     import runpy
 
     argv = list(sys.argv[1:] if argv is None else argv)
+    usage = ("usage: python -m paddle_tpu.distributed.launch "
+             "[--max-restarts=N] script.py [args...]")
+    max_restarts = 0
+    watched = False
+    while argv and argv[0].startswith("--"):
+        flag = argv.pop(0)
+        if flag == "--max-restarts" or flag.startswith("--max-restarts="):
+            watched = True
+            try:
+                value = (flag.split("=", 1)[1] if "=" in flag
+                         else argv.pop(0))
+                max_restarts = int(value)
+            except (IndexError, ValueError):
+                print(f"--max-restarts needs an integer value\n{usage}")
+                return 2
+        else:
+            print(f"unknown launch flag {flag}\n{usage}")
+            return 2
     if not argv:
-        print("usage: python -m paddle_tpu.distributed.launch script.py [args...]")
+        print(usage)
         return 1
     script, *rest = argv
+    if watched:
+        # child re-enters launch in-process mode so init_parallel_env runs
+        # inside each (re)started trainer, exactly like the unwatched path
+        return watch([sys.executable, "-m", "paddle_tpu.distributed.launch",
+                      script] + rest, max_restarts=max_restarts)
     sys.argv = [script] + rest
     _env.init_parallel_env()
     runpy.run_path(script, run_name="__main__")
     return 0
+
+
+def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0) -> int:
+    """Run ``cmd`` as a watched subprocess; restart on non-zero exit up to
+    ``max_restarts`` times (reference: launch_utils.py watch_local_trainers /
+    terminate_local_procs).  Returns the final exit code.  SIGTERM/SIGINT
+    to the watchdog tears the child down (pod preemption path)."""
+    import signal
+    import subprocess
+    import time
+
+    from ..framework import monitor as _monitor
+    from ..framework.logging import vlog
+
+    attempts = 0
+    child = None
+
+    def _teardown(signum, frame):
+        if child is not None and child.poll() is None:
+            child.terminate()
+            try:
+                child.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                child.kill()
+        raise SystemExit(128 + signum)
+
+    old_term = signal.signal(signal.SIGTERM, _teardown)
+    old_int = signal.signal(signal.SIGINT, _teardown)
+    try:
+        while True:
+            vlog(1, "watchdog: starting %s (attempt %d)", cmd, attempts + 1)
+            child = subprocess.Popen(cmd)
+            rc = child.wait()
+            if rc == 0:
+                return 0
+            vlog(1, "watchdog: trainer exited rc=%d", rc)
+            if attempts >= max_restarts:
+                vlog(1, "watchdog: restart budget exhausted (%d)", attempts)
+                return rc
+            attempts += 1
+            _monitor.stat_add("trainer_restarts")  # an actual restart
+            time.sleep(_sleep)
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)

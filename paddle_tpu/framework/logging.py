@@ -1,0 +1,20 @@
+"""VLOG-style host logging gated by FLAGS_log_level.
+
+Parity: the reference's glog VLOG(level) usage throughout the runtime,
+with verbosity from GLOG_v; here the knob is the framework flag
+``log_level`` (settable via FLAGS_log_level env or paddle.set_flags).
+"""
+from __future__ import annotations
+
+import sys
+
+from .flags import flag
+
+__all__ = ["vlog"]
+
+
+def vlog(level: int, msg: str, *args):
+    """Print ``msg % args`` when FLAGS_log_level >= level."""
+    if int(flag("log_level")) >= level:
+        print(f"[paddle_tpu:v{level}] " + (msg % args if args else msg),
+              file=sys.stderr, flush=True)

@@ -1,0 +1,54 @@
+"""Monitor — named global stat counters.
+
+Parity: paddle/fluid/platform/monitor.h:44-145 (StatRegistry + the
+STAT_ADD/STAT_SUB/STAT_RESET macros; e.g. STAT_gpu0_mem_size:174) and its
+python accessor.  Framework subsystems bump counters here (train steps,
+checkpoint saves, host→device staging bytes), and operators read them for
+observability — the no-Prometheus, in-process flavor the reference has.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ["stat_add", "stat_sub", "stat_set", "get_stat", "reset_stat",
+           "all_stats"]
+
+_lock = threading.Lock()
+_stats: Dict[str, int] = {}
+
+
+def stat_add(name: str, value: int = 1) -> int:
+    """STAT_ADD (monitor.h:131): bump and return the counter."""
+    with _lock:
+        _stats[name] = _stats.get(name, 0) + int(value)
+        return _stats[name]
+
+
+def stat_sub(name: str, value: int = 1) -> int:
+    return stat_add(name, -int(value))
+
+
+def stat_set(name: str, value: int) -> int:
+    with _lock:
+        _stats[name] = int(value)
+        return _stats[name]
+
+
+def get_stat(name: str) -> int:
+    with _lock:
+        return _stats.get(name, 0)
+
+
+def reset_stat(name: str = None):
+    """Reset one counter, or all (STAT_RESET)."""
+    with _lock:
+        if name is None:
+            _stats.clear()
+        else:
+            _stats.pop(name, None)
+
+
+def all_stats() -> Dict[str, int]:
+    with _lock:
+        return dict(_stats)

@@ -273,6 +273,9 @@ class Model:
         loss_val, out, params, self._opt_state, buffers = self._train_step(
             params, self._opt_state, buffers, key, lr, *batch)
         self._push_state(params, buffers)
+        from ..framework import monitor as _monitor
+
+        _monitor.stat_add("total_train_steps")
         if _flag("check_nan_inf"):
             # debug mode (ref: FLAGS_check_nan_inf nan sweep,
             # framework/details/nan_inf_utils.h:33) — syncs every step

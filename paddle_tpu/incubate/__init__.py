@@ -1,3 +1,4 @@
 """paddle_tpu.incubate — graduated-API staging area (reference:
 python/paddle/fluid/incubate/)."""
 from . import checkpoint  # noqa: F401
+from . import sharded_checkpoint  # noqa: F401

@@ -103,6 +103,9 @@ class AutoCheckpoint:
         serialization.save(snap["opt"], os.path.join(d, _OPT))
         # meta LAST: its presence commits the checkpoint
         serialization.save(snap["meta"], os.path.join(d, _META))
+        from ..framework import monitor as _monitor
+
+        _monitor.stat_add("checkpoint_saves")
         self._prune()
 
     def _prune(self):

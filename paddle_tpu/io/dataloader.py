@@ -106,6 +106,12 @@ class _StagingIterator:
     def _stage(self, batch):
         if not self._to_device:
             return batch
+        from ..framework import monitor as _monitor
+
+        for leaf in jax.tree_util.tree_leaves(batch):
+            nbytes = getattr(leaf, "nbytes", 0)
+            if nbytes:
+                _monitor.stat_add("host_to_device_bytes", int(nbytes))
         # device_put dispatches the H2D copy asynchronously; consuming code
         # only blocks when it actually reads values.
         return jax.tree_util.tree_map(jax.device_put, batch)

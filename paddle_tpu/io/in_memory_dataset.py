@@ -63,9 +63,15 @@ class InMemoryDataset:
     def set_filelist(self, files: Sequence[str]):
         self._filelist = [str(f) for f in files]
 
-    def load_into_memory(self, thread_num: int = 4) -> int:
-        """Parse the filelist with ``thread_num`` native readers; returns
-        samples added.  Raises with file:line context on malformed input."""
+    def load_into_memory(self, thread_num: Optional[int] = None) -> int:
+        """Parse the filelist with ``thread_num`` native readers (default:
+        FLAGS_paddle_num_threads); returns samples added.  Raises with
+        file:line context on malformed input."""
+        from ..framework import monitor as _monitor
+        from ..framework.flags import flag as _flag
+
+        if thread_num is None:
+            thread_num = max(int(_flag("paddle_num_threads")), 1)
         if not self._filelist:
             raise InvalidArgumentError("set_filelist() first")
         arr = (ctypes.c_char_p * len(self._filelist))(
@@ -76,6 +82,7 @@ class InMemoryDataset:
             msg = self._lib.ingest_error(self._h).decode()
             exc = NotFoundError if "cannot open" in msg else InvalidArgumentError
             raise exc(f"load_into_memory: {msg}")
+        _monitor.stat_add("ingest_samples", int(n))
         return int(n)
 
     def global_shuffle(self, seed: int = 0):

@@ -117,3 +117,112 @@ class TestLaunch:
     def test_spawn_multi_on_one_host_errors(self, clean_env):
         with pytest.raises(Exception, match="multi-host"):
             spawn(lambda: None, nprocs=4)
+
+
+class TestWatchdog:
+    """Elastic-lite (reference: launch_utils.py trainer watch loop)."""
+
+    def test_restart_then_success(self, clean_env, tmp_path):
+        from paddle_tpu.distributed.parallel import watch
+        from paddle_tpu.framework import monitor
+
+        marker = os.path.join(tmp_path, "crashed-once")
+        script = os.path.join(tmp_path, "flaky.py")
+        with open(script, "w") as f:
+            f.write(
+                "import os, sys\n"
+                f"m = {marker!r}\n"
+                "if not os.path.exists(m):\n"
+                "    open(m, 'w').close()\n"
+                "    sys.exit(3)\n"  # first run: simulated preemption
+                "sys.exit(0)\n")
+        monitor.reset_stat("trainer_restarts")
+        rc = watch([sys.executable, script], max_restarts=2, _sleep=0.01)
+        assert rc == 0
+        assert monitor.get_stat("trainer_restarts") == 1
+
+    def test_budget_exhausted_propagates_rc(self, clean_env, tmp_path):
+        from paddle_tpu.distributed.parallel import watch
+
+        script = os.path.join(tmp_path, "dead.py")
+        with open(script, "w") as f:
+            f.write("import sys; sys.exit(7)\n")
+        rc = watch([sys.executable, script], max_restarts=1, _sleep=0.01)
+        assert rc == 7
+
+    def test_launch_flag_parses(self, clean_env, capture_init, tmp_path):
+        from paddle_tpu.distributed.parallel import launch
+
+        script = os.path.join(tmp_path, "ok.py")
+        with open(script, "w") as f:
+            f.write("print('fine')\n")
+        old_argv = list(sys.argv)
+        try:
+            assert launch(["--max-restarts=0", script]) == 0
+            assert launch(["--bogus", script]) == 2
+        finally:
+            sys.argv = old_argv
+
+    def test_watchdog_resume_end_to_end(self, clean_env, tmp_path):
+        """Preempted trainer + auto-checkpoint: the restarted run resumes
+        from the snapshot and finishes all epochs exactly once."""
+        from paddle_tpu.distributed.parallel import watch
+
+        log = os.path.join(tmp_path, "epochs.log")
+        script = os.path.join(tmp_path, "train.py")
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(script, "w") as f:
+            f.write(f'''
+import os, sys
+sys.path.insert(0, {repo_root!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as popt
+from paddle_tpu.incubate.checkpoint import train_epoch_range
+
+paddle.seed(0)
+net = nn.Sequential(nn.Linear(4, 2))
+m = paddle.Model(net, inputs=["x"], labels=["y"])
+m.prepare(optimizer=popt.SGD(learning_rate=0.1), loss=nn.CrossEntropyLoss())
+x = np.zeros((4, 4), np.float32); y = np.zeros((4,), np.int32)
+for epoch, acp in train_epoch_range(4, m, {os.path.join(tmp_path, "ck")!r}):
+    m.train_batch([x], [y])
+    with open({log!r}, "a") as fh:
+        fh.write(f"{{epoch}}\\n")
+    if epoch == 1 and os.environ.get("CRASH_ONCE") and not os.path.exists(
+            {os.path.join(tmp_path, "crashed")!r}):
+        open({os.path.join(tmp_path, "crashed")!r}, "w").close()
+        os._exit(9)  # hard kill AFTER epoch-1 work, BEFORE its commit
+''')
+        env_backup = os.environ.get("CRASH_ONCE")
+        os.environ["CRASH_ONCE"] = "1"
+        try:
+            rc = watch([sys.executable, script], max_restarts=1, _sleep=0.01)
+        finally:
+            if env_backup is None:
+                os.environ.pop("CRASH_ONCE", None)
+        assert rc == 0
+        with open(log) as fh:
+            epochs = [int(l) for l in fh.read().split()]
+        # first run: 0,1 (epoch 1 uncommitted); resumed run: 1,2,3
+        assert epochs == [0, 1, 1, 2, 3]
+
+    def test_bad_flag_values_usage_not_traceback(self, clean_env):
+        from paddle_tpu.distributed.parallel import launch
+
+        assert launch(["--max-restarts"]) == 2        # missing value
+        assert launch(["--max-restarts=abc", "s.py"]) == 2
+        assert launch(["--max-restartsfoo=3", "s.py"]) == 2
+
+    def test_no_restart_counts_zero(self, clean_env, tmp_path):
+        from paddle_tpu.distributed.parallel import watch
+        from paddle_tpu.framework import monitor
+
+        script = os.path.join(tmp_path, "fail.py")
+        with open(script, "w") as f:
+            f.write("import sys; sys.exit(5)\n")
+        monitor.reset_stat("trainer_restarts")
+        assert watch([sys.executable, script], max_restarts=0,
+                     _sleep=0.01) == 5
+        assert monitor.get_stat("trainer_restarts") == 0

@@ -1,0 +1,125 @@
+"""Monitor stat registry + flag-consumer wiring.
+
+Reference capability: platform/monitor.h:44 StatRegistry (STAT_ADD etc.)
+and glog VLOG gated by verbosity.  Asserts real framework subsystems
+actually bump the counters (train steps, checkpoint saves, staging bytes,
+ingest samples) and that log_level/paddle_num_threads are consumed.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as popt
+from paddle_tpu.framework import monitor
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.framework.logging import vlog
+
+
+@pytest.fixture(autouse=True)
+def clean():
+    monitor.reset_stat()
+    yield
+    monitor.reset_stat()
+    set_flags({"log_level": 0, "paddle_num_threads": 1})
+
+
+class TestRegistry:
+    def test_add_sub_get_reset(self):
+        assert monitor.stat_add("x", 5) == 5
+        assert monitor.stat_add("x") == 6
+        assert monitor.stat_sub("x", 2) == 4
+        assert monitor.get_stat("x") == 4
+        assert monitor.get_stat("unknown") == 0
+        monitor.stat_set("y", 9)
+        assert monitor.all_stats() == {"x": 4, "y": 9}
+        monitor.reset_stat("x")
+        assert monitor.get_stat("x") == 0
+        assert monitor.get_stat("y") == 9
+
+    def test_train_steps_counted(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 2))
+        m = paddle.Model(net, inputs=["x"], labels=["y"])
+        m.prepare(optimizer=popt.SGD(learning_rate=0.1),
+                  loss=nn.CrossEntropyLoss())
+        x = np.zeros((4, 4), np.float32)
+        y = np.zeros((4,), np.int32)
+        before = monitor.get_stat("total_train_steps")
+        for _ in range(3):
+            m.train_batch([x], [y])
+        assert monitor.get_stat("total_train_steps") == before + 3
+
+    def test_checkpoint_saves_counted(self, tmp_path):
+        from paddle_tpu.incubate.checkpoint import AutoCheckpoint
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 2))
+        m = paddle.Model(net, inputs=["x"], labels=["y"])
+        m.prepare(optimizer=popt.SGD(learning_rate=0.1),
+                  loss=nn.CrossEntropyLoss())
+        acp = AutoCheckpoint(m, os.path.join(tmp_path, "ck"),
+                             async_save=False)
+        acp.epoch_end(0)
+        acp.epoch_end(1)
+        assert monitor.get_stat("checkpoint_saves") == 2
+
+    def test_staging_bytes_counted(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+
+        X = np.zeros((8, 4), np.float32)
+        loader = DataLoader(TensorDataset([X]), batch_size=4)
+        for _ in loader:
+            pass
+        assert monitor.get_stat("host_to_device_bytes") >= X.nbytes
+
+    def test_ingest_samples_counted(self, tmp_path):
+        from paddle_tpu.io import InMemoryDataset
+
+        p = os.path.join(tmp_path, "a.txt")
+        with open(p, "w") as f:
+            f.write("1 2\n3 4\n")
+        ds = InMemoryDataset(slots=[("x", 2, "float32")])
+        ds.set_filelist([p])
+        ds.load_into_memory()
+        assert monitor.get_stat("ingest_samples") == 2
+
+
+class TestFlagConsumers:
+    def test_vlog_gated(self, capsys):
+        vlog(1, "hidden %d", 1)
+        assert capsys.readouterr().err == ""
+        set_flags({"log_level": 2})
+        vlog(1, "shown %d", 2)
+        assert "shown 2" in capsys.readouterr().err
+
+    def test_fleet_init_logs_mesh_at_v1(self, capsys):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.mesh import build_mesh, set_mesh
+
+        set_flags({"log_level": 1})
+        fleet._initialized = False
+        try:
+            fleet.init(is_collective=True,
+                       strategy=fleet.DistributedStrategy())
+            assert "fleet.init: mesh" in capsys.readouterr().err
+        finally:
+            fleet._initialized = False
+            fleet._strategy = None
+            set_mesh(build_mesh())
+
+    def test_paddle_num_threads_default(self, tmp_path):
+        """InMemoryDataset honors FLAGS_paddle_num_threads as default."""
+        from paddle_tpu.io import InMemoryDataset
+
+        files = []
+        for i in range(4):
+            p = os.path.join(tmp_path, f"p{i}.txt")
+            with open(p, "w") as f:
+                f.write(f"{i} {i}\n")
+            files.append(p)
+        set_flags({"paddle_num_threads": 4})
+        ds = InMemoryDataset(slots=[("x", 2, "float32")])
+        ds.set_filelist(files)
+        assert ds.load_into_memory() == 4  # thread_num=None → flag value

@@ -1,0 +1,107 @@
+"""Sharded checkpoint save/restore on the 8-device mesh.
+
+Reference capability: per-shard PS table persistence
+(distributed_ops/checkpoint_notify_op.cc:65 + large_scale_kv shard save).
+Here: orbax per-shard format driven by jax shardings — saved distributed,
+restored straight onto the target sharding.
+"""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.mesh import build_mesh, set_mesh
+from paddle_tpu.framework.errors import NotFoundError
+from paddle_tpu.incubate.sharded_checkpoint import (
+    latest_step,
+    restore_sharded,
+    save_sharded,
+)
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    set_mesh(build_mesh())
+    yield
+    set_mesh(build_mesh())
+
+
+class TestShardedCheckpoint:
+    def test_round_trip_preserves_sharding(self, tmp_path):
+        mesh = build_mesh(dp=4, mp=2)
+        set_mesh(mesh)
+        w = jax.device_put(
+            jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+            NamedSharding(mesh, P("data", "model")))
+        b = jax.device_put(jnp.ones(8), NamedSharding(mesh, P()))
+        state = {"params": {"w": w, "b": b}, "step": jnp.asarray(3)}
+        d = os.path.join(tmp_path, "ck")
+        save_sharded(d, state, step=10)
+        assert latest_step(d) == 10
+
+        out = restore_sharded(d, like=state)
+        np.testing.assert_array_equal(np.asarray(out["params"]["w"]),
+                                      np.asarray(w))
+        np.testing.assert_array_equal(np.asarray(out["params"]["b"]), 1.0)
+        # restored ONTO the distributed sharding, not gathered
+        assert out["params"]["w"].sharding.is_equivalent_to(w.sharding, 2)
+
+    def test_latest_step_and_multiple(self, tmp_path):
+        d = os.path.join(tmp_path, "ck")
+        s1 = {"x": jnp.zeros(4)}
+        save_sharded(d, s1, step=1)
+        save_sharded(d, {"x": jnp.ones(4)}, step=2)
+        assert latest_step(d) == 2
+        out = restore_sharded(d, like=s1)  # latest by default
+        np.testing.assert_array_equal(np.asarray(out["x"]), 1.0)
+        out1 = restore_sharded(d, like=s1, step=1)
+        np.testing.assert_array_equal(np.asarray(out1["x"]), 0.0)
+
+    def test_keep_max_prunes(self, tmp_path):
+        d = os.path.join(tmp_path, "ck")
+        for s in range(4):
+            save_sharded(d, {"x": jnp.full(2, s)}, step=s, keep_max=2)
+        steps = sorted(int(n) for n in os.listdir(d) if n.isdigit())
+        assert steps == [2, 3]
+
+    def test_missing_raises(self, tmp_path):
+        with pytest.raises(NotFoundError, match="no sharded checkpoint"):
+            restore_sharded(os.path.join(tmp_path, "nope"))
+
+    def test_model_state_round_trip(self, tmp_path):
+        """Full Model train state through the sharded path under a plan."""
+        from paddle_tpu import nn, optimizer as popt
+        from paddle_tpu.distributed import fleet
+
+        fleet._initialized = False
+        strategy = fleet.DistributedStrategy(sharding=True)
+        fleet.init(is_collective=True, strategy=strategy)
+        try:
+            paddle.seed(0)
+            net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+            opt = fleet.distributed_optimizer(popt.Adam(learning_rate=1e-2))
+            model = paddle.Model(net, inputs=["x"], labels=["y"])
+            model.prepare(optimizer=opt, loss=nn.CrossEntropyLoss())
+            rng = np.random.RandomState(0)
+            x = rng.randn(16, 8).astype(np.float32)
+            y = rng.randint(0, 2, (16,)).astype(np.int32)
+            model.train_batch([x], [y])
+
+            state = {"params": model.network.param_pytree(),
+                     "opt": model._opt_state}
+            d = os.path.join(tmp_path, "ck")
+            save_sharded(d, state, step=1)
+            # ZeRO slots restore onto their sharded layout
+            out = restore_sharded(d, like=state)
+            for name, slots in out["opt"]["slots"].items():
+                for sname, v in slots.items():
+                    ref = state["opt"]["slots"][name][sname]
+                    np.testing.assert_allclose(np.asarray(v), np.asarray(ref))
+                    assert v.sharding.is_equivalent_to(ref.sharding, v.ndim)
+        finally:
+            fleet._initialized = False
+            fleet._strategy = None
