@@ -68,12 +68,16 @@ def restore_sharded(path: str, like: Any = None,
     structure/placement; without it, arrays come back as the saved layout."""
     import orbax.checkpoint as ocp
 
-    if step is None:
-        step = latest_step(path)
-        if step is None:
-            raise NotFoundError(f"no sharded checkpoint under {path!r}")
+    if not os.path.isdir(path):
+        # check before _manager: CheckpointManagerOptions(create=True) would
+        # mkdir the (possibly mistyped) path as a side effect
+        raise NotFoundError(f"no sharded checkpoint under {path!r}")
     mgr = _manager(path)
     try:
+        if step is None:
+            step = mgr.latest_step()
+            if step is None:
+                raise NotFoundError(f"no sharded checkpoint under {path!r}")
         if like is None:
             return mgr.restore(int(step))
         targets = jax.tree_util.tree_map(

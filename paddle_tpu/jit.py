@@ -1,0 +1,169 @@
+"""paddle.jit — to_static, save, load.
+
+Parity: python/paddle/fluid/dygraph/jit.py + dygraph_to_static/
+(ProgramTranslator, program_translator.py:708, TranslatedLayer in
+dygraph/io.py).  The reference needs a whole AST transpiler to turn eager
+code into a static Program; here eager code IS traceable — ``to_static``
+is jax.jit over the layer's functional projection, and save/load ride the
+AOT inference-export format (paddle_tpu.inference).
+
+Semantics kept from the reference:
+* ``to_static(layer)`` returns a callable that runs the layer compiled;
+  parameters are re-read each call (training continues to work), and
+  buffer updates (BN running stats) are written back eagerly.
+* ``jit.save`` exports the eval-mode forward + weights; ``jit.load``
+  returns a ``TranslatedLayer`` usable like a Layer for inference.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from .framework.errors import InvalidArgumentError
+from .nn.layer_base import Layer, functional_call
+
+__all__ = ["to_static", "not_to_static", "save", "load", "TranslatedLayer"]
+
+
+def _jit_layer_call(layer: Layer, inner_call=None):
+    """jit over (params, buffers, training, *args) → (out, new_buffers),
+    delegating the substitute/restore contract to functional_call.
+    ``inner_call`` overrides the callee for @to_static bound methods
+    (calling ``layer(...)`` there would re-enter the descriptor)."""
+
+    def run(params, buffers, training, *args):
+        return functional_call(layer, params, *args, buffers=buffers,
+                               training=training, return_buffers=True,
+                               call=inner_call)
+
+    return jax.jit(run, static_argnums=(2,))
+
+
+class StaticFunction:
+    """Compiled wrapper over a Layer, a bound method, or a pure fn — the
+    TranslatedLayer-before-save analogue.  Retracing follows jax.jit rules
+    (new input shapes/dtypes or a flipped training mode retrace; new param
+    VALUES don't).
+
+    Also a descriptor, so the canonical paddle pattern works::
+
+        class Net(nn.Layer):
+            @jit.to_static
+            def forward(self, x): ...
+    """
+
+    def __init__(self, fn, input_spec=None, _bound_layer=None):
+        self._orig = fn
+        self._input_spec = input_spec
+        self._layer = fn if isinstance(fn, Layer) else _bound_layer
+        if isinstance(fn, Layer):
+            self._jitted = _jit_layer_call(fn)
+        elif _bound_layer is not None:
+            self._jitted = _jit_layer_call(
+                _bound_layer, lambda *a: fn(_bound_layer, *a))
+        else:
+            self._jitted = jax.jit(fn)
+
+    def __get__(self, obj, objtype=None):
+        """Method-decorator support: bind the wrapped function to the Layer
+        instance (per-instance compiled cache)."""
+        if obj is None:
+            return self
+        cache = obj.__dict__.setdefault("_static_methods", {})
+        key = id(self)
+        if key not in cache:
+            if not isinstance(obj, Layer):
+                raise InvalidArgumentError(
+                    "@to_static methods are supported on nn.Layer "
+                    "subclasses (the trace substitutes layer parameters)")
+            cache[key] = StaticFunction(self._orig, self._input_spec,
+                                        _bound_layer=obj)
+        return cache[key]
+
+    def __call__(self, *args, **kwargs):
+        if kwargs:
+            raise InvalidArgumentError(
+                "to_static calls are positional-only (kwargs change the "
+                "trace signature); bind keywords before wrapping")
+        layer = self._layer
+        if layer is None:
+            return self._jitted(*args)
+        params = layer.param_pytree()
+        buffers = layer.buffer_pytree()
+        out, new_bufs = self._jitted(params, buffers, layer.training, *args)
+        boxes = dict(layer.named_buffers())
+        for name, v in new_bufs.items():  # eager BN-stat semantics
+            boxes[name].value = v
+        return out
+
+    @property
+    def forward(self):
+        return self
+
+
+def to_static(function=None, input_spec=None, **kwargs):
+    """Decorator/wrapper: compile a Layer or function for execution.
+
+    Reference surface: paddle.jit.to_static (dygraph/jit.py) — there it
+    AST-transpiles to a Program; here tracing is native, so this is a thin
+    jit wrapper kept for source compatibility and the save() pathway.
+    """
+    if function is None:
+        return functools.partial(to_static, input_spec=input_spec, **kwargs)
+    return StaticFunction(function, input_spec)
+
+
+def not_to_static(fn):
+    """Parity no-op: nothing is transpiled, so nothing needs excluding."""
+    return fn
+
+
+def save(layer, path: str, input_spec: Optional[Sequence] = None, **configs):
+    """Export ``layer`` for inference (reference: paddle.jit.save →
+    TranslatedLayer artifacts).  ``input_spec``: InputSpec/example per
+    forward input."""
+    from .inference import save_inference_model
+
+    target = layer._orig if isinstance(layer, StaticFunction) else layer
+    spec = input_spec or (layer._input_spec
+                          if isinstance(layer, StaticFunction) else None)
+    if spec is None:
+        raise InvalidArgumentError(
+            "jit.save needs input_spec=[InputSpec(...)] (or wrap with "
+            "to_static(input_spec=...))")
+    if not isinstance(target, Layer):
+        raise InvalidArgumentError("jit.save exports Layers")
+    return save_inference_model(path, target, spec)
+
+
+class TranslatedLayer:
+    """A loaded inference module, callable like a Layer (reference:
+    dygraph/io.py TranslatedLayer over the saved program)."""
+
+    def __init__(self, predictor):
+        self._predictor = predictor
+        self.training = False
+
+    def __call__(self, *inputs):
+        outs = self._predictor.run([np.asarray(x) for x in inputs])
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise InvalidArgumentError(
+            "a loaded inference module is eval-only (the reference's "
+            "TranslatedLayer trains only if exported with trainable "
+            "programs — export params + rebuild the Layer to fine-tune)")
+
+
+def load(path: str) -> TranslatedLayer:
+    from .inference import Predictor
+
+    return TranslatedLayer(Predictor(path))

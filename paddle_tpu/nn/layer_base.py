@@ -461,6 +461,7 @@ def functional_call(
     rngs: Optional[jax.Array] = None,
     training: Optional[bool] = None,
     return_buffers: bool = False,
+    call: Optional[Callable] = None,
     **kwargs,
 ):
     """Run ``layer(*args, **kwargs)`` with parameter/buffer values substituted
@@ -477,6 +478,10 @@ def functional_call(
     values afterwards — the updates are returned functionally, never left
     behind (a traced call must not leak tracers into eager state).  Without
     it, in-forward buffer mutation persists (eager paddle semantics).
+
+    ``call`` overrides the invoked callable (still runs with the layer's
+    values substituted) — jit.to_static uses it for @to_static-decorated
+    bound methods, where calling ``layer(...)`` would re-enter the wrapper.
     """
     boxes: Dict[str, Parameter] = dict(layer.named_parameters())
     buf_boxes: Dict[str, Buffer] = dict(layer.named_buffers())
@@ -508,7 +513,7 @@ def functional_call(
 
         ctx = rng_scope(rngs) if rngs is not None else contextlib.nullcontext()
         with ctx:
-            out = layer(*args, **kwargs)
+            out = (layer if call is None else call)(*args, **kwargs)
 
         if return_buffers:
             new_buffers = {n: b.value for n, b in buf_boxes.items()}

@@ -16,6 +16,11 @@ from .optimizer import (  # noqa: F401
     Lamb,
     Lars,
 )
+from .averaging import (  # noqa: F401
+    ExponentialMovingAverage,
+    Lookahead,
+    ModelAverage,
+)
 from . import lr  # noqa: F401
 from .clip import (  # noqa: F401
     ClipGradByValue,

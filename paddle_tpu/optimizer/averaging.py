@@ -1,0 +1,291 @@
+"""Weight-averaging optimizers: EMA, ModelAverage, Lookahead.
+
+Parity: python/paddle/fluid/optimizer.py — ExponentialMovingAverage:3443,
+ModelAverage:3134, LookaheadOptimizer:4853.  The reference implements each
+as program-rewriting wrappers over accumulator ops; here EMA/ModelAverage
+are eager shadow-state managers over Parameter boxes (update after each
+step; ``apply()`` context-swaps weights for eval), and Lookahead is a pure
+functional Optimizer wrapper (slow/fast weights live in the slot state, so
+it composes with jit/fleet like any other optimizer).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.errors import InvalidArgumentError
+from .optimizer import Optimizer, _is_low_precision
+
+__all__ = ["ExponentialMovingAverage", "ModelAverage", "Lookahead"]
+
+
+def _boxes_of(parameters):
+    from ..nn.layer_base import Layer, Parameter
+
+    if isinstance(parameters, Layer):
+        return [p for _, p in parameters.named_parameters()]
+    boxes = list(parameters or [])
+    if not boxes or not all(isinstance(p, Parameter) for p in boxes):
+        raise InvalidArgumentError(
+            "pass a Layer or a list of Parameters (layer.parameters())")
+    return boxes
+
+
+class ExponentialMovingAverage:
+    """EMA of parameter values (reference: optimizer.py:3443).
+
+    >>> ema = ExponentialMovingAverage(net, decay=0.999)
+    >>> for batch: train_step(); ema.update()
+    >>> with ema.apply():   # weights are the bias-corrected EMA
+    ...     evaluate()
+    """
+
+    def __init__(self, parameters, decay: float = 0.999,
+                 thres_steps: bool = False, name=None):
+        if not (0.0 <= decay < 1.0):
+            raise InvalidArgumentError("decay must be in [0, 1)")
+        self._boxes = _boxes_of(parameters)
+        self._decay = float(decay)
+        #: dynamic ramp-up min(decay, (1+t)/(10+t)) — the reference's
+        #: thres_steps behavior
+        self._thres = bool(thres_steps)
+        self._step = 0
+        # f32 shadow regardless of param dtype: a bf16 accumulator can't
+        # resolve (1-decay)*w increments (same upcast rule as _init_slots)
+        self._shadow = [jnp.zeros(b.value.shape, jnp.float32)
+                        for b in self._boxes]
+        self._decay_prod = 1.0  # prod of per-step decays → bias correction
+        self._backup = None
+
+    def update(self):
+        """Fold the current weights into the shadow (call once per step)."""
+        self._step += 1
+        d = self._decay
+        if self._thres:
+            d = min(d, (1.0 + self._step) / (10.0 + self._step))
+        self._decay_prod *= d
+        self._shadow = [
+            d * s + (1.0 - d) * jnp.asarray(b.value, jnp.float32)
+            for s, b in zip(self._shadow, self._boxes)
+        ]
+
+    def _corrected(self):
+        # zero-init shadow → bias-correct by 1 - prod(d_i); with constant
+        # decay this is the familiar 1 - decay^t, and it stays exact for
+        # the thres_steps ramp too
+        corr = 1.0 - self._decay_prod
+        corr = corr or 1.0
+        return [(s / corr).astype(b.value.dtype)
+                for s, b in zip(self._shadow, self._boxes)]
+
+    @contextlib.contextmanager
+    def apply(self, need_restore: bool = True):
+        if self._step == 0:
+            raise InvalidArgumentError("apply() before any update()")
+        self._backup = [b.value for b in self._boxes]
+        for b, s in zip(self._boxes, self._corrected()):
+            b.value = s
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self):
+        if self._backup is not None:
+            for b, v in zip(self._boxes, self._backup):
+                b.value = v
+            self._backup = None
+
+
+class ModelAverage:
+    """Windowed average of parameter values (reference: optimizer.py:3134).
+
+    Accumulates sums in rotating windows (sum_1/2/3 like the reference's
+    average_accumulates op): the applied average covers roughly the last
+    ``average_window_rate`` fraction of updates, clamped to
+    [min_average_window, max_average_window].
+    """
+
+    def __init__(self, parameters, average_window_rate: float = 0.15,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000, name=None):
+        self._boxes = _boxes_of(parameters)
+        self.rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        # f32 window sums (bf16 sums stop absorbing additions once the
+        # running total dwarfs one sample)
+        zeros = [jnp.zeros(b.value.shape, jnp.float32) for b in self._boxes]
+        self._sum1, self._sum2, self._sum3 = zeros, list(zeros), list(zeros)
+        self._num1 = self._num2 = self._num3 = 0  # samples per window
+        self._updates = 0
+        self._backup = None
+
+    def update(self):
+        self._updates += 1
+        self._num1 += 1
+        self._sum1 = [s + jnp.asarray(b.value, jnp.float32)
+                      for s, b in zip(self._sum1, self._boxes)]
+        if (self._num1 >= self.max_window
+                or self._num1 >= max(self.rate * self._updates,
+                                     self.min_window)):
+            # rotate: drop the oldest window, start a fresh one
+            self._sum3, self._num3 = self._sum2, self._num2
+            self._sum2, self._num2 = self._sum1, self._num1
+            self._sum1 = [jnp.zeros_like(s) for s in self._sum1]
+            self._num1 = 0
+
+    @contextlib.contextmanager
+    def apply(self, need_restore: bool = True):
+        total = self._num1 + self._num2 + self._num3
+        if total == 0:
+            raise InvalidArgumentError("apply() before any update()")
+        self._backup = [b.value for b in self._boxes]
+        for b, s1, s2, s3 in zip(self._boxes, self._sum1, self._sum2,
+                                 self._sum3):
+            avg = (s1 + s2 + s3) / total
+            b.value = avg.astype(b.value.dtype)
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self):
+        if self._backup is not None:
+            for b, v in zip(self._boxes, self._backup):
+                b.value = v
+            self._backup = None
+
+
+class Lookahead(Optimizer):
+    """Lookahead (k steps forward, 1 step back) over any inner optimizer
+    (reference: LookaheadOptimizer, optimizer.py:4853).  Pure-functional:
+    slow weights ride in the slot state, so it jits and shards like any
+    optimizer."""
+
+    def __init__(self, inner_optimizer: Optimizer, alpha: float = 0.5,
+                 k: int = 5):
+        if not isinstance(inner_optimizer, Optimizer):
+            raise InvalidArgumentError("inner_optimizer must be an Optimizer")
+        if not (0.0 < alpha <= 1.0):
+            raise InvalidArgumentError("alpha in (0, 1]")
+        if k < 1:
+            raise InvalidArgumentError("k must be >= 1")
+        self.inner = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        super().__init__(
+            learning_rate=inner_optimizer._learning_rate,
+            parameters=inner_optimizer._param_boxes,
+            grad_clip=None,  # the inner optimizer clips
+            multi_precision=inner_optimizer._multi_precision,
+        )
+
+    def init(self, params: Dict[str, jax.Array]) -> Dict[str, Any]:
+        return {
+            "inner": self.inner.init(params),
+            # copy=True: the slow weights must be distinct buffers — the
+            # jitted train step donates params AND opt state, and aliased
+            # buffers would be donated twice.  Low-precision params get an
+            # f32 slow copy (same upcast rule as _init_slots): the k-step
+            # interpolation must not round through bf16.
+            "slow": {n: (jnp.asarray(p, jnp.float32) if _is_low_precision(p)
+                         else jnp.array(p, copy=True))
+                     for n, p in params.items()},
+            "count": jnp.zeros((), jnp.int32),
+        }
+
+    def update(self, grads, state, params, lr=None):
+        fast, inner_state = self.inner.update(
+            grads, state["inner"], params, lr=lr)
+        count = state["count"] + 1
+        sync = (count % self.k == 0)
+        inner_slots = inner_state.get("slots")
+        new_slots = dict(inner_slots) if inner_slots is not None else None
+        new_slow = {}
+        new_params = {}
+        for n, f in fast.items():
+            slow = state["slow"][n]
+            pslots = inner_slots.get(n) if inner_slots is not None else None
+            master = pslots.get("master") if isinstance(pslots, dict) else None
+            # interpolate from the f32 master view when the inner optimizer
+            # keeps one — `f` is its bf16 shadow
+            f_val = master if master is not None else f
+            synced = slow + self.alpha * (f_val.astype(slow.dtype) - slow)
+            s_out = jnp.where(sync, synced, slow)
+            new_slow[n] = s_out
+            new_params[n] = jnp.where(sync, s_out.astype(f.dtype), f)
+            if master is not None:
+                # pull the master back too, else the next inner step resumes
+                # the fast trajectory from the un-synced master
+                pslots = dict(pslots)
+                pslots["master"] = jnp.where(
+                    sync, s_out.astype(master.dtype), master)
+                new_slots[n] = pslots
+        if new_slots is not None:
+            inner_state = dict(inner_state)
+            inner_state["slots"] = new_slots
+        return new_params, {"inner": inner_state, "slow": new_slow,
+                            "count": count}
+
+    # eager .step() rides the base class via update()
+    def get_lr(self):
+        return self.inner.get_lr()
+
+    @property
+    def lr_scheduler(self):
+        return self.inner.lr_scheduler
+
+    # -- checkpointing: state shape differs from the base {'count','slots'}
+    def state_dict(self):
+        d = {}
+        if self._eager_state is not None:
+            st = self._eager_state
+            d["count"] = st["count"]
+            d["slow"] = dict(st["slow"])
+            inner = self.inner
+            saved, inner._eager_state = inner._eager_state, st["inner"]
+            try:
+                d["inner"] = inner.state_dict()
+            finally:
+                inner._eager_state = saved
+        if self.lr_scheduler is not None:
+            d["LR_Scheduler"] = self.lr_scheduler.state_dict()
+        return d
+
+    def set_state_dict(self, state):
+        state = dict(state)
+        lr_state = state.pop("LR_Scheduler", None)
+        if lr_state and self.lr_scheduler is not None:
+            self.lr_scheduler.set_state_dict(lr_state)
+        if not state:
+            return
+        if self._param_boxes is None:
+            raise InvalidArgumentError(
+                "set_state_dict on a Lookahead without bound parameters — "
+                "in functional mode checkpoint the state pytree directly")
+        boxes = self._eager_params()
+        params = {n: b.value for n, b in boxes.items() if b.trainable}
+        if self._eager_state is None:
+            self._eager_state = self.init(params)
+        st = self._eager_state
+        if "count" in state:
+            st["count"] = jnp.asarray(state["count"], jnp.int32)
+        for n, v in dict(state.get("slow", {})).items():
+            if n in st["slow"]:
+                st["slow"][n] = jnp.asarray(v)
+        inner_sd = state.get("inner")
+        if inner_sd:
+            inner = self.inner
+            saved, inner._eager_state = inner._eager_state, st["inner"]
+            try:
+                inner.set_state_dict(inner_sd)
+                st["inner"] = inner._eager_state
+            finally:
+                inner._eager_state = saved

@@ -1,0 +1,166 @@
+"""Book-style end-to-end convergence tests.
+
+Reference test strategy (SURVEY §4): python/paddle/fluid/tests/book/ — 9
+small train-to-threshold scripts (fit_a_line, recognize_digits, word2vec,
+machine_translation…) asserting a loss/accuracy bar.  Same idea here,
+wired through THIS framework's data path (text.datasets fixtures / native
+ingest) and full Model API, on the 8-device CPU mesh where it adds
+coverage.
+"""
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as popt
+from paddle_tpu.io import TensorDataset
+
+
+class TestFitALine:
+    """book/test_fit_a_line.py: linear regression on UCI housing."""
+
+    def test_converges(self, tmp_path):
+        from paddle_tpu.text.datasets import UCIHousing
+
+        # synthesize a housing.data in the real format: y = w·x + noise
+        rng = np.random.RandomState(0)
+        X = rng.rand(200, 13).astype(np.float32)
+        w = rng.randn(13).astype(np.float32)
+        y = X @ w + 0.01 * rng.randn(200).astype(np.float32)
+        table = np.concatenate([X, y[:, None]], axis=1)
+        p = os.path.join(tmp_path, "housing.data")
+        np.savetxt(p, table)
+
+        train = UCIHousing(data_file=p, mode="train")
+        feats = np.stack([s[0] for s in train])
+        targets = np.stack([s[1] for s in train])
+
+        paddle.seed(0)
+        net = nn.Linear(13, 1)
+        model = paddle.Model(net, inputs=["x"], labels=["y"])
+        model.prepare(optimizer=popt.Adam(learning_rate=0.05),
+                      loss=nn.MSELoss())
+        first = last = None
+        for _ in range(60):
+            loss, _ = model.train_batch([feats], [targets])
+            first = loss if first is None else first
+            last = loss
+        assert last < first * 0.1, (first, last)
+
+
+class TestWord2Vec:
+    """book/test_word2vec.py: ngram LM over the imikolov pipeline."""
+
+    def test_learns_deterministic_corpus(self, tmp_path):
+        import io
+        import tarfile
+
+        from paddle_tpu.text.datasets import Imikolov
+
+        text = ("the cat sat on the mat\n" * 40).encode()
+        tar_p = os.path.join(tmp_path, "simple-examples.tar.gz")
+        with tarfile.open(tar_p, "w:gz") as t:
+            for name in ("train", "valid"):
+                info = tarfile.TarInfo(
+                    f"./simple-examples/data/ptb.{name}.txt")
+                info.size = len(text)
+                t.addfile(info, io.BytesIO(text))
+
+        ds = Imikolov(data_file=tar_p, data_type="NGRAM", window_size=3,
+                      mode="train", min_word_freq=0)
+        grams = np.stack([np.array(s) for s in ds])
+        ctx, target = grams[:, :2].astype(np.int32), grams[:, 2].astype(np.int32)
+        V = len(ds.word_idx)
+
+        class NGram(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(V, 16)
+                self.fc = nn.Linear(32, V)
+
+            def forward(self, ctx):
+                e = self.emb(ctx)  # [B, 2, 16]
+                return self.fc(e.reshape(e.shape[0], -1))
+
+        paddle.seed(0)
+        net = NGram()
+        model = paddle.Model(net, inputs=["ctx"], labels=["y"])
+        model.prepare(optimizer=popt.Adam(learning_rate=0.05),
+                      loss=nn.CrossEntropyLoss())
+        for _ in range(40):
+            loss, _ = model.train_batch([ctx], [target])
+        # corpus is deterministic → the LM should be near-certain
+        assert float(loss) < 0.2, float(loss)
+        logits = model.predict_batch([ctx[:8]])
+        acc = (np.argmax(np.asarray(logits), -1) == target[:8]).mean()
+        assert acc == 1.0
+
+
+class TestMachineTranslation:
+    """book/test_machine_translation.py: seq2seq over the WMT16 pipeline
+    (tiny copy task: source sentence → identical target sentence)."""
+
+    def test_copy_task_converges(self, tmp_path):
+        import io
+        import tarfile
+
+        from paddle_tpu.text.datasets import WMT16
+
+        rng = np.random.RandomState(0)
+        words = ["w%d" % i for i in range(12)]
+        lines = []
+        for _ in range(64):
+            sent = " ".join(rng.choice(words, size=5))
+            lines.append(f"{sent}\t{sent}")
+        blob = ("\n".join(lines) + "\n").encode()
+        tar_p = os.path.join(tmp_path, "wmt16.tar.gz")
+        with tarfile.open(tar_p, "w:gz") as t:
+            for name in ("train", "val"):
+                info = tarfile.TarInfo(f"wmt16/{name}")
+                info.size = len(blob)
+                t.addfile(info, io.BytesIO(blob))
+
+        ds = WMT16(data_file=tar_p, mode="train", src_dict_size=20,
+                   trg_dict_size=20, lang="en")
+        src = np.stack([s[0] for s in ds]).astype(np.int32)   # [N, 7]
+        trg_in = np.stack([s[1] for s in ds]).astype(np.int32)
+        trg_next = np.stack([s[2] for s in ds]).astype(np.int32)
+        V = len(ds.src_dict)
+
+        class Seq2Seq(nn.Layer):
+            """Tiny encoder-decoder with attention-free context."""
+
+            def __init__(self):
+                super().__init__()
+                self.src_emb = nn.Embedding(V, 24)
+                self.trg_emb = nn.Embedding(V, 24)
+                self.proj = nn.Sequential(
+                    nn.Linear(48, 64), nn.GELU(), nn.Linear(64, V))
+
+            def forward(self, src, trg_in):
+                ctx = self.src_emb(src).mean(axis=1, keepdims=True)  # [B,1,24]
+                d = self.trg_emb(trg_in)                             # [B,T,24]
+                ctx = jnp.broadcast_to(ctx, d.shape)
+                return self.proj(jnp.concatenate([d, ctx], axis=-1))
+
+            def loss(self, logits, labels):
+                import jax
+
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                picked = jnp.take_along_axis(
+                    logp, jnp.asarray(labels)[..., None].astype(jnp.int32),
+                    axis=-1)
+                return -picked.mean()
+
+        paddle.seed(0)
+        net = Seq2Seq()
+        model = paddle.Model(net, inputs=["src", "trg"], labels=["y"])
+        model.prepare(optimizer=popt.Adam(learning_rate=0.05),
+                      loss=net.loss)
+        first = None
+        for _ in range(150):
+            loss, _ = model.train_batch([src, trg_in], [trg_next])
+            first = loss if first is None else first
+        assert loss < first * 0.3, (first, loss)

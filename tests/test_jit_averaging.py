@@ -1,0 +1,215 @@
+"""paddle.jit surface + weight-averaging optimizers.
+
+Reference capability: dygraph/jit.py to_static + TranslatedLayer
+(dygraph_to_static ProgramTranslator:708), and fluid/optimizer.py
+ExponentialMovingAverage:3443 / ModelAverage:3134 / Lookahead:4853.
+"""
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn, optimizer as popt
+from paddle_tpu.framework.errors import InvalidArgumentError
+from paddle_tpu.optimizer import (
+    ExponentialMovingAverage,
+    Lookahead,
+    ModelAverage,
+)
+from paddle_tpu.static import InputSpec
+
+
+def _net():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+
+
+class TestToStatic:
+    def test_layer_output_parity(self):
+        net = _net()
+        net.eval()
+        static_net = jit.to_static(net)
+        x = jnp.asarray(np.random.RandomState(0).randn(6, 4), jnp.float32)
+        np.testing.assert_allclose(np.asarray(static_net(x)),
+                                   np.asarray(net(x)), rtol=1e-6)
+
+    def test_params_stay_live_through_training(self):
+        """to_static must see updated weights (no baked constants)."""
+        net = _net()
+        static_net = jit.to_static(net)
+        x = jnp.ones((2, 4))
+        before = np.asarray(static_net(x))
+        for _, p in net.named_parameters():
+            p.value = p.value * 0.0
+        after = np.asarray(static_net(x))
+        assert not np.allclose(before, after)
+        np.testing.assert_allclose(after, 0.0, atol=1e-6)
+
+    def test_bn_buffers_update_eagerly(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 3), nn.BatchNorm1D(3))
+        net.train()
+        static_net = jit.to_static(net)
+        bn = net[1]
+        before = np.asarray(bn._mean.value).copy()
+        static_net(jnp.asarray(
+            np.random.RandomState(0).randn(8, 4), jnp.float32))
+        assert not np.allclose(np.asarray(bn._mean.value), before)
+
+    def test_pure_function(self):
+        f = jit.to_static(lambda a, b: a * 2 + b)
+        np.testing.assert_allclose(
+            np.asarray(f(jnp.ones(3), jnp.ones(3))), 3.0)
+
+    def test_decorator_with_spec_and_save_load(self, tmp_path):
+        net = _net()
+        wrapped = jit.to_static(net, input_spec=[InputSpec([None, 4],
+                                                           "float32")])
+        prefix = os.path.join(tmp_path, "m")
+        jit.save(wrapped, prefix)
+        loaded = jit.load(prefix)
+        x = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(loaded(x)),
+                                   np.asarray(net.eval()(jnp.asarray(x))),
+                                   rtol=1e-5, atol=1e-6)
+        with pytest.raises(InvalidArgumentError, match="eval-only"):
+            loaded.train()
+
+    def test_save_without_spec_raises(self, tmp_path):
+        with pytest.raises(InvalidArgumentError, match="input_spec"):
+            jit.save(_net(), os.path.join(tmp_path, "m"))
+
+
+class TestEMA:
+    def test_shadow_tracks_and_bias_corrects(self):
+        paddle.seed(0)
+        lin = nn.Linear(1, 1, bias_attr=False)
+        lin.weight.value = jnp.ones((1, 1))
+        ema = ExponentialMovingAverage(lin, decay=0.5)
+        # weights constant → corrected EMA equals the weight exactly
+        for _ in range(3):
+            ema.update()
+        with ema.apply():
+            np.testing.assert_allclose(np.asarray(lin.weight.value), 1.0,
+                                       rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(lin.weight.value), 1.0)
+
+    def test_apply_restores(self):
+        net = _net()
+        ema = ExponentialMovingAverage(net, decay=0.9)
+        orig = {n: np.asarray(p.value).copy()
+                for n, p in net.named_parameters()}
+        ema.update()
+        for _, p in net.named_parameters():
+            p.value = p.value + 1.0
+        with ema.apply():
+            pass
+        for n, p in net.named_parameters():
+            np.testing.assert_allclose(np.asarray(p.value), orig[n] + 1.0)
+
+    def test_ema_smooths_oscillation(self):
+        lin = nn.Linear(1, 1, bias_attr=False)
+        ema = ExponentialMovingAverage(lin, decay=0.99)
+        for i in range(200):
+            lin.weight.value = jnp.full((1, 1), 1.0 + (-1) ** i * 0.5)
+            ema.update()
+        with ema.apply():
+            assert abs(float(lin.weight.value[0, 0]) - 1.0) < 0.1
+
+    def test_apply_before_update_raises(self):
+        ema = ExponentialMovingAverage(_net())
+        with pytest.raises(InvalidArgumentError, match="update"):
+            with ema.apply():
+                pass
+
+
+class TestModelAverage:
+    def test_average_over_window(self):
+        lin = nn.Linear(1, 1, bias_attr=False)
+        ma = ModelAverage(lin, average_window_rate=1.0,
+                          min_average_window=100, max_average_window=100)
+        for v in (1.0, 2.0, 3.0, 4.0):
+            lin.weight.value = jnp.full((1, 1), v)
+            ma.update()
+        with ma.apply():
+            np.testing.assert_allclose(float(lin.weight.value[0, 0]), 2.5)
+        np.testing.assert_allclose(float(lin.weight.value[0, 0]), 4.0)
+
+    def test_window_rotation_bounds_memory_of_old_values(self):
+        lin = nn.Linear(1, 1, bias_attr=False)
+        ma = ModelAverage(lin, average_window_rate=0.5,
+                          min_average_window=2, max_average_window=4)
+        for i in range(40):
+            lin.weight.value = jnp.full((1, 1), float(i))
+            ma.update()
+        with ma.apply():
+            # early values must have rotated out: average is recent-ish
+            assert float(lin.weight.value[0, 0]) > 25.0
+
+
+class TestLookahead:
+    def test_slow_fast_dynamics(self):
+        """After k inner steps the params jump to the slow interpolation."""
+        from paddle_tpu.nn.layer_base import Parameter
+
+        w = Parameter(np.zeros(1, np.float32), name="w")
+        inner = popt.SGD(learning_rate=1.0, parameters=[w])
+        look = Lookahead(inner, alpha=0.5, k=2)
+        params = {"w": jnp.zeros(1)}
+        state = look.init(params)
+        g = {"w": jnp.full(1, -1.0)}  # each fast step adds +1
+        params, state = look.update(g, state, params)     # fast: 1
+        np.testing.assert_allclose(np.asarray(params["w"]), 1.0)
+        params, state = look.update(g, state, params)     # fast: 2 → sync
+        # slow = 0 + 0.5*(2-0) = 1; params snap to slow
+        np.testing.assert_allclose(np.asarray(params["w"]), 1.0)
+        params, state = look.update(g, state, params)     # fast: 2
+        np.testing.assert_allclose(np.asarray(params["w"]), 2.0)
+
+    def test_trains_under_model_and_jit(self):
+        paddle.seed(0)
+        net = _net()
+        look = Lookahead(popt.Adam(learning_rate=1e-2), alpha=0.8, k=3)
+        m = paddle.Model(net, inputs=["x"], labels=["y"])
+        m.prepare(optimizer=look, loss=nn.CrossEntropyLoss())
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 4).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int32)
+        losses = [m.train_batch([x], [y])[0] for _ in range(30)]
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_multi_precision_master_syncs(self):
+        """The slow pull-back must land in the inner optimizer's f32 master
+        slots too — otherwise step k+1 resumes the fast trajectory and
+        Lookahead degenerates to the inner optimizer."""
+        import jax.numpy as jnp
+        from paddle_tpu.nn.layer_base import Parameter
+
+        w = Parameter(np.zeros(1, np.float32), name="w")
+        inner = popt.SGD(learning_rate=1.0, parameters=[w],
+                         multi_precision=True)
+        look = Lookahead(inner, alpha=0.5, k=2)
+        params = {"w": jnp.zeros(1, jnp.bfloat16)}
+        state = look.init(params)
+        assert state["slow"]["w"].dtype == jnp.float32
+        g = {"w": jnp.full(1, -1.0, jnp.bfloat16)}  # each fast step adds +1
+        params, state = look.update(g, state, params)  # fast: 1
+        params, state = look.update(g, state, params)  # fast: 2 → sync to 1
+        np.testing.assert_allclose(
+            np.asarray(params["w"], np.float32), 1.0)
+        np.testing.assert_allclose(
+            np.asarray(state["inner"]["slots"]["w"]["master"]), 1.0)
+        # next step continues from the SYNCED point: 1 + 1 = 2, not 3
+        params, state = look.update(g, state, params)
+        np.testing.assert_allclose(
+            np.asarray(params["w"], np.float32), 2.0)
+
+    def test_validation(self):
+        with pytest.raises(InvalidArgumentError):
+            Lookahead(popt.SGD(), alpha=2.0)
+        with pytest.raises(InvalidArgumentError):
+            Lookahead(popt.SGD(), k=0)
+        with pytest.raises(InvalidArgumentError):
+            Lookahead("not an optimizer")
