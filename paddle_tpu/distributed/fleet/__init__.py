@@ -118,10 +118,10 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
     # ignoring them here would train with a different algorithm than asked
     from ...framework.errors import UnimplementedError
 
-    if st.localsgd:
-        raise UnimplementedError(
-            "strategy.localsgd (reference: transpiler/collective.py:270 "
-            "LocalSGD) is not implemented in paddle_tpu")
+    if st.localsgd and st.gradient_merge:
+        raise InvalidArgumentError(
+            "strategy.localsgd does not compose with gradient_merge (the "
+            "reference meta-optimizers are mutually exclusive too)")
     if st.dgc:
         raise UnimplementedError(
             "strategy.dgc (reference: operators/dgc_op.cc top-k gradient "
@@ -189,6 +189,11 @@ def distributed_model(model):
     net = model.network if isinstance(model, _HapiModel) else model
     if not isinstance(net, Layer):
         raise InvalidArgumentError("distributed_model expects a Layer or Model")
+    if _strategy is not None and _strategy.localsgd:
+        raise InvalidArgumentError(
+            "strategy.localsgd only runs through Model.prepare/fit (the "
+            "per-replica state and sync schedule live in the Model's plan); "
+            "manual training loops would silently skip the averaging")
     plan = ShardingPlan(net, optimizer=None, strategy=_strategy, mesh=get_mesh())
     plan.place_network()
     return model

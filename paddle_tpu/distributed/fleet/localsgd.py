@@ -1,0 +1,155 @@
+"""LocalSGD data parallelism — k local steps per replica, then model averaging.
+
+Parity: the reference's LocalSGD program transpiler
+(transpiler/collective.py:270 — snapshot vars + c_allreduce of param deltas
+every ``k_steps``) and the fleet meta-optimizer
+(fleet/meta_optimizers/localsgd_optimizer.py).
+
+TPU-native design: instead of rewriting a Program with snapshot/allreduce
+ops, the train step runs under ``shard_map`` over the ``data`` axis with NO
+implicit gradient all-reduce — each device advances its own replica.  The
+divergent per-replica state (parameters, optimizer slots, buffers) rides
+stacked ``[ndp, ...]`` inside the optimizer state, sharded over ``data`` so
+each device holds exactly its own copy.  Every ``k_steps`` a *separately
+compiled* step adds a ``lax.pmean`` over replicas; between syncs no
+collective appears in the HLO at all — the communication saving is
+structural, not simulated.
+
+Semantics kept from the reference:
+* ``k_steps``: sync period; ``begin_step``: plain per-step averaging (≈DP)
+  until this step, LocalSGD after.
+* The Model-visible parameters/buffers are the last *synced* values —
+  between syncs they lag the replicas (evaluate after a sync boundary,
+  as the reference does).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...framework.errors import InvalidArgumentError
+from ..collective import shard_map
+from .plan import ShardingPlan
+
+__all__ = ["LocalSGDPlan"]
+
+
+class LocalSGDPlan(ShardingPlan):
+    """ShardingPlan variant where the ``data`` axis holds independent
+    replicas between sync points instead of a single GSPMD program."""
+
+    def __init__(self, network, optimizer, strategy, mesh=None):
+        super().__init__(network, optimizer, strategy, mesh)
+        for ax in ("model", "pipe", "sep", "sharding"):
+            if self.mesh.shape.get(ax, 1) > 1:
+                raise InvalidArgumentError(
+                    "strategy.localsgd composes only with pure data "
+                    f"parallelism (mesh axis {ax!r} has size > 1) — same "
+                    "restriction as the reference meta-optimizer's _can_apply")
+        cfg = getattr(strategy, "localsgd_configs", None) or {}
+        self.k_steps = max(int(cfg.get("k_steps", 1)), 1)
+        self.begin_step = max(int(cfg.get("begin_step", 1)), 1)
+        self.axis = "data"
+        self.ndp = self.mesh.shape["data"]
+        self._t = None  # host mirror of opt_state["count"] (avoids a
+        #                 device sync per step when choosing sync/local)
+
+    # -- state ---------------------------------------------------------------
+    def _local_sharding(self) -> NamedSharding:
+        return self.named(P(self.axis))
+
+    def on_state_restored(self):
+        """Model.load calls this — re-derive the host step mirror from the
+        restored ``opt_state['count']`` on the next step."""
+        self._t = None
+
+    def init_opt_state(self, optimizer, params, buffers=None):
+        """{"count", "local": {"params", "inner", "buffers"}} — the local
+        subtrees are stacked [ndp, ...], one replica per data-axis device."""
+        buffers = buffers or {}
+        ndp = self.ndp
+
+        def init_fn(params, buffers):
+            stack = lambda t: jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (ndp,) + x.shape), t)
+            return {
+                "count": jnp.zeros((), jnp.int32),
+                "local": {
+                    "params": stack(params),
+                    "inner": stack(optimizer.init(params)),
+                    "buffers": stack(buffers),
+                },
+            }
+
+        shapes = jax.eval_shape(init_fn, params, buffers)
+        shardings = {
+            "count": self.named(P()),
+            "local": jax.tree.map(lambda _: self._local_sharding(),
+                                  shapes["local"]),
+        }
+        return jax.jit(init_fn, out_shardings=shardings)(params, buffers)
+
+    # -- step ----------------------------------------------------------------
+    def jit_train_step(self, train_step):
+        plan = self
+        mesh, axis, k = self.mesh, self.axis, self.k_steps
+        spec_l = P(axis)
+
+        def make(sync: bool, n_batch: int):
+            def step(params, opt_state, buffers, key, lr, *batch):
+                local = opt_state["local"]
+
+                def body(params, buffers, l_params, l_inner, l_bufs,
+                         key, lr, *batch):
+                    # local leaves arrive [1, ...] — this device's replica
+                    sq = lambda t: jax.tree.map(lambda x: x[0], t)
+                    st = lambda t: jax.tree.map(lambda x: x[None], t)
+                    key = jax.random.fold_in(key, lax.axis_index(axis))
+                    loss, out, new_p, new_inner, new_b = train_step(
+                        sq(l_params), sq(l_inner), sq(l_bufs),
+                        key, lr, *batch)
+                    loss = lax.pmean(loss, axis)
+                    if sync:
+                        pm = lambda t: jax.tree.map(
+                            lambda x: lax.pmean(x, axis), t)
+                        new_p = pm(new_p)
+                        new_b = pm(new_b)
+                        g_params, g_bufs = new_p, new_b
+                    else:  # pass the last synced values through, unchanged
+                        g_params, g_bufs = params, buffers
+                    return (loss, out, g_params, st(new_p), st(new_inner),
+                            st(new_b), g_bufs)
+
+                in_specs = (P(), P(), spec_l, spec_l, spec_l, P(), P()) \
+                    + (spec_l,) * n_batch
+                out_specs = (P(), spec_l, P(), spec_l, spec_l, spec_l, P())
+                loss, out, g_params, nl_p, nl_i, nl_b, g_bufs = shard_map(
+                    body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                )(params, buffers, local["params"], local["inner"],
+                  local["buffers"], key, lr, *batch)
+                new_state = {
+                    "count": opt_state["count"] + 1,
+                    "local": {"params": nl_p, "inner": nl_i, "buffers": nl_b},
+                }
+                return loss, out, g_params, new_state, g_bufs
+
+            return step
+
+        compiled = {}
+
+        def wrapped(params, opt_state, buffers, key, lr, *batch):
+            # host mirror of opt_state["count"]: one device read at start
+            # and after each Model.load (on_state_restored nulls it)
+            t = (plan._t if plan._t is not None
+                 else int(opt_state["count"])) + 1
+            sync = t < plan.begin_step or t % k == 0
+            kk = (bool(sync), len(batch))
+            if kk not in compiled:
+                compiled[kk] = jax.jit(make(*kk), donate_argnums=(0, 1, 2))
+            out = compiled[kk](params, opt_state, buffers, key, lr, *batch)
+            plan._t = t  # advance only after a successful dispatch
+            return out
+
+        return wrapped

@@ -106,6 +106,16 @@ class ShardingPlan:
     def buffer_shardings(self, buffers: Dict[str, jax.Array]):
         return {n: self.named(P()) for n in buffers}
 
+    def init_opt_state(self, optimizer, params: Dict[str, jax.Array],
+                       buffers=None):
+        """Init under jit with sharded outputs: ZeRO slots are born sharded —
+        the full replicated state never materializes.  (LocalSGDPlan
+        overrides this to stack per-replica state; it needs ``buffers``.)"""
+        return jax.jit(
+            optimizer.init,
+            out_shardings=self.opt_state_shardings(params),
+        )(params)
+
     # -- application ---------------------------------------------------------
     def place_network(self):
         """device_put every Parameter/Buffer box with its sharding — the

@@ -202,7 +202,14 @@ class Model:
                         "strategy.sequence_parallel found no attention "
                         "layers exposing a `sequence_parallel` knob",
                         RuntimeWarning)
-            self._plan = ShardingPlan(net, optimizer, strategy)
+            if strategy.localsgd:
+                # reference: localsgd_optimizer.py — per-replica training
+                # with periodic model averaging (see fleet/localsgd.py)
+                from ..distributed.fleet.localsgd import LocalSGDPlan
+
+                self._plan = LocalSGDPlan(net, optimizer, strategy)
+            else:
+                self._plan = ShardingPlan(net, optimizer, strategy)
             self._plan.place_network()
 
         if optimizer is not None:
@@ -237,15 +244,11 @@ class Model:
         for name, v in buffers.items():
             bufs[name].value = v
 
-    def _ensure_opt_state(self, params):
+    def _ensure_opt_state(self, params, buffers=None):
         if self._opt_state is None:
             if self._plan is not None:
-                # init under jit with sharded outputs: ZeRO slots are born
-                # sharded — the full replicated state never materializes
-                self._opt_state = jax.jit(
-                    self._optimizer.init,
-                    out_shardings=self._plan.opt_state_shardings(params),
-                )(params)
+                self._opt_state = self._plan.init_opt_state(
+                    self._optimizer, params, buffers)
             else:
                 self._opt_state = self._optimizer.init(params)
 
@@ -267,7 +270,7 @@ class Model:
         else:
             batch = tuple(jnp.asarray(b) for b in batch)
         params, buffers = self._pull_state()
-        self._ensure_opt_state(params)
+        self._ensure_opt_state(params, buffers)
         key = _random.default_generator().next_key()
         lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
         loss_val, out, params, self._opt_state, buffers = self._train_step(
@@ -532,6 +535,9 @@ class Model:
             if "state" in opt_state:
                 self._opt_state = jax.tree_util.tree_map(
                     jnp.asarray, opt_state["state"])
+                if self._plan is not None and hasattr(self._plan,
+                                                      "on_state_restored"):
+                    self._plan.on_state_restored()
             if self._optimizer is not None:
                 sched = self._optimizer.lr_scheduler
                 if sched is not None and "LR_Scheduler" in opt_state:

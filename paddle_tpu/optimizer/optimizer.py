@@ -203,6 +203,13 @@ class Optimizer:
         (The reference's ``loss.backward(); opt.step()`` tape flow is
         replaced by explicit grads from ``jax.grad`` — see nn.layer_base.)
         """
+        st = getattr(self, "_fleet_strategy", None)
+        if st is not None and getattr(st, "localsgd", False):
+            raise InvalidArgumentError(
+                "strategy.localsgd only runs through Model.prepare/fit — "
+                "the eager step() path has no per-replica state or sync "
+                "schedule, so it would silently train plain SGD"
+            )
         boxes = self._eager_params()
         if grads is None:
             raise InvalidArgumentError(

@@ -1,0 +1,178 @@
+"""LocalSGD strategy — k local steps per replica, periodic model averaging.
+
+Reference capability: transpiler/collective.py:270 (LocalSGD snapshot +
+allreduce rewrite) / fleet/meta_optimizers/localsgd_optimizer.py.  Here the
+assertions are trajectory-level: k=1 LocalSGD must equal plain DP for plain
+SGD (averaging post-step params == stepping on averaged grads), replicas
+must actually diverge between syncs, and the synced model must converge.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as popt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.mesh import build_mesh, set_mesh
+from paddle_tpu.framework.errors import InvalidArgumentError
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    set_mesh(build_mesh())
+    yield
+    set_mesh(build_mesh())
+    fleet._initialized = False
+    fleet._strategy = None
+
+
+def _data(n=64, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d, 1).astype(np.float32)
+    y = x @ w + 0.01 * rng.randn(n, 1).astype(np.float32)
+    return x, y
+
+
+def _net(d=8):
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(d, 16), nn.ReLU(), nn.Linear(16, 1))
+
+
+def _fit(model, x, y, steps):
+    losses = []
+    for i in range(steps):
+        loss, _ = model.train_batch([x], [y])
+        losses.append(loss)
+    return losses
+
+
+def _prepare_localsgd(k_steps, begin_step=1, opt_factory=None):
+    strat = fleet.DistributedStrategy(
+        localsgd=True,
+        localsgd_configs={"k_steps": k_steps, "begin_step": begin_step})
+    fleet.init(is_collective=True, strategy=strat)
+    net = _net()
+    opt = fleet.distributed_optimizer(
+        (opt_factory or (lambda: popt.SGD(learning_rate=0.1)))())
+    model = paddle.Model(net, inputs=["x"], labels=["y"])
+    model.prepare(optimizer=opt, loss=nn.MSELoss())
+    return model, net
+
+
+class TestLocalSGDParity:
+    def test_k1_matches_plain_dp_sgd(self):
+        """Averaging post-step params == stepping on averaged grads for
+        plain SGD, so k_steps=1 LocalSGD must retrace plain DP exactly."""
+        x, y = _data()
+
+        strat = fleet.DistributedStrategy()
+        fleet.init(is_collective=True, strategy=strat)
+        net_dp = _net()
+        opt = fleet.distributed_optimizer(popt.SGD(learning_rate=0.1))
+        m_dp = paddle.Model(net_dp, inputs=["x"], labels=["y"])
+        m_dp.prepare(optimizer=opt, loss=nn.MSELoss())
+        ref = _fit(m_dp, x, y, 6)
+        fleet._initialized = False
+
+        m_ls, _ = _prepare_localsgd(k_steps=1)
+        got = _fit(m_ls, x, y, 6)
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
+
+    def test_replicas_diverge_then_sync(self):
+        x, y = _data()
+        m, net = _prepare_localsgd(k_steps=4)
+        p0 = {n: np.asarray(p.value).copy()
+              for n, p in net.named_parameters()}
+        m.train_batch([x], [y])  # step 1: local only
+        # Model-visible params are the last synced values — unchanged
+        for n, p in net.named_parameters():
+            np.testing.assert_allclose(np.asarray(p.value), p0[n])
+        # but each replica advanced on a different rng/shard: locals differ
+        local = m._opt_state["local"]["params"]
+        some = next(iter(local.values()))
+        stacked = np.asarray(some)
+        assert stacked.shape[0] == 8
+        # every replica moved off the init
+        leaf0 = p0[next(iter(local.keys()))]
+        assert not np.allclose(stacked[0], leaf0)
+        # replicas saw different batch shards → different trajectories
+        assert not np.allclose(stacked[0], stacked[1])
+
+        m.train_batch([x], [y])
+        m.train_batch([x], [y])
+        m.train_batch([x], [y])  # step 4: sync
+        local = m._opt_state["local"]["params"]
+        for n, p in net.named_parameters():
+            vis = np.asarray(p.value)
+            assert not np.allclose(vis, p0[n]), "sync must update the model"
+            stacked = np.asarray(local[n])
+            for r in range(8):  # replicas reset to the average
+                np.testing.assert_allclose(stacked[r], vis, rtol=1e-6)
+
+    def test_begin_step_syncs_every_step_before(self):
+        x, y = _data()
+        m, net = _prepare_localsgd(k_steps=4, begin_step=3)
+        p0 = {n: np.asarray(p.value).copy()
+              for n, p in net.named_parameters()}
+        m.train_batch([x], [y])  # t=1 < begin_step → sync
+        changed = any(
+            not np.allclose(np.asarray(p.value), p0[n])
+            for n, p in net.named_parameters())
+        assert changed, "before begin_step LocalSGD behaves like DP"
+
+    def test_converges(self):
+        x, y = _data()
+        m, _ = _prepare_localsgd(
+            k_steps=2, opt_factory=lambda: popt.Adam(learning_rate=1e-2))
+        losses = _fit(m, x, y, 40)
+        assert losses[-1] < losses[0] * 0.2, losses
+
+    def test_rejects_hybrid_mesh(self):
+        strat = fleet.DistributedStrategy(localsgd=True, mp_degree=2)
+        fleet.init(is_collective=True, strategy=strat)
+        net = _net()
+        opt = fleet.distributed_optimizer(popt.SGD(learning_rate=0.1))
+        m = paddle.Model(net, inputs=["x"], labels=["y"])
+        with pytest.raises(InvalidArgumentError, match="localsgd"):
+            m.prepare(optimizer=opt, loss=nn.MSELoss())
+
+    def test_load_resets_sync_schedule(self, tmp_path):
+        """Model.load must re-derive the step mirror from the restored
+        count, or the averaging cadence drifts after restore-and-continue."""
+        import os
+
+        x, y = _data()
+        m, net = _prepare_localsgd(k_steps=4)
+        for _ in range(4):
+            m.train_batch([x], [y])  # t=4: sync
+        ck = os.path.join(tmp_path, "ck")
+        m.save(ck)
+        for _ in range(6):
+            m.train_batch([x], [y])  # t=10
+        m.load(ck)
+        assert m._plan._t is None  # mirror invalidated
+        m.train_batch([x], [y])    # resumes at t=5 (local, no sync)
+        assert m._plan._t == 5
+        assert int(np.asarray(m._opt_state["count"])) == 5
+
+    def test_eager_step_and_distributed_model_guarded(self):
+        strat = fleet.DistributedStrategy(localsgd=True)
+        fleet.init(is_collective=True, strategy=strat)
+        net = _net()
+        opt = fleet.distributed_optimizer(
+            popt.SGD(learning_rate=0.1, parameters=net.parameters()))
+        with pytest.raises(InvalidArgumentError, match="localsgd"):
+            opt.step({n: jnp.zeros_like(p.value)
+                      for n, p in net.named_parameters()})
+        with pytest.raises(InvalidArgumentError, match="localsgd"):
+            fleet.distributed_model(net)
+
+    def test_rejects_gradient_merge_combo(self):
+        strat = fleet.DistributedStrategy(
+            localsgd=True, gradient_merge=True,
+            gradient_merge_configs={"k_steps": 2})
+        fleet.init(is_collective=True, strategy=strat)
+        with pytest.raises(InvalidArgumentError, match="gradient_merge"):
+            fleet.distributed_optimizer(popt.SGD(learning_rate=0.1))
