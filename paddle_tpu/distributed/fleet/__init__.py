@@ -123,10 +123,39 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
             "strategy.localsgd does not compose with gradient_merge (the "
             "reference meta-optimizers are mutually exclusive too)")
     if st.dgc:
-        raise UnimplementedError(
-            "strategy.dgc (reference: operators/dgc_op.cc top-k gradient "
-            "compression) is not implemented in paddle_tpu — XLA allreduce "
-            "over ICI makes dense grads the fast path on TPU")
+        # reference: DGC meta-optimizer applies only to Momentum
+        # (fleet/meta_optimizers/dgc_optimizer.py _can_apply); swap it for
+        # DGCMomentum, which compresses inside the DGCPlan shard_map
+        from ...optimizer.dgc import DGCMomentum
+        from ...optimizer.optimizer import Momentum as _Momentum
+
+        for other in ("localsgd", "lamb", "lars", "gradient_merge"):
+            if getattr(st, other):
+                raise InvalidArgumentError(
+                    f"strategy.dgc does not compose with {other} (the "
+                    "reference meta-optimizers are mutually exclusive too)")
+        if not isinstance(optimizer, (DGCMomentum, _Momentum)):
+            raise InvalidArgumentError(
+                "strategy.dgc applies to a Momentum optimizer (reference "
+                "dgc_optimizer.py _can_apply)")
+        if not isinstance(optimizer, DGCMomentum):
+            if optimizer._multi_precision:
+                raise InvalidArgumentError(
+                    "strategy.dgc has no multi_precision support (the u/v "
+                    "accumulators are f32 already); construct the Momentum "
+                    "with multi_precision=False")
+            cfg = st.dgc_configs or {}
+            optimizer = DGCMomentum(
+                learning_rate=optimizer._learning_rate,
+                momentum=optimizer._momentum,
+                parameters=optimizer._param_boxes,
+                rampup_begin_step=int(cfg.get("rampup_begin_step", 0)),
+                rampup_step=int(cfg.get("rampup_step", 1)),
+                sparsity=cfg.get("sparsity", [0.999]),
+                use_nesterov=optimizer._nesterov,
+                weight_decay=optimizer._weight_decay,
+                grad_clip=optimizer._grad_clip,
+            )
     if st.a_sync:
         raise UnimplementedError(
             "strategy.a_sync is parameter-server async mode (reference: "

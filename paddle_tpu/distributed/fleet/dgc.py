@@ -1,0 +1,119 @@
+"""DGC execution plan — runs the train step under shard_map so gradients
+stay per-device for the DGCMomentum optimizer's sparse exchange.
+
+Parity: the reference's DGC meta-optimizer path (fluid/optimizer.py:1129
+DGCMomentumOptimizer + operators/dgc_op.cc), which rewrites the Program to
+encode top-k gradients before NCCL.  Here the structure is the LocalSGD
+pattern (fleet/localsgd.py): parameters stay replicated (the post-exchange
+update is identical on every device), while the u/v accumulators — which
+hold each replica's unsent gradient mass — ride stacked [ndp, ...] in the
+optimizer state, sharded over ``data``.  The sparsity ramp-up resolves on
+the host: each phase (dense warmup, then each sparsity level) is its own
+compiled step, since top-k needs a static k.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ...framework.errors import InvalidArgumentError
+from ..collective import shard_map
+from .plan import ShardingPlan
+
+__all__ = ["DGCPlan"]
+
+
+class DGCPlan(ShardingPlan):
+    def __init__(self, network, optimizer, strategy, mesh=None):
+        super().__init__(network, optimizer, strategy, mesh)
+        self._require_pure_dp("dgc")
+        from ...optimizer.dgc import DGCMomentum
+
+        if not isinstance(optimizer, DGCMomentum):
+            raise InvalidArgumentError(
+                "strategy.dgc requires a Momentum optimizer (reference "
+                "_can_apply); fleet.distributed_optimizer converts one")
+        self.axis = "data"
+        self.ndp = self.mesh.shape["data"]
+
+    # -- state ---------------------------------------------------------------
+    def init_opt_state(self, optimizer, params, buffers=None):
+        ndp = self.ndp
+
+        def init_fn(params):
+            st = optimizer.init(params)
+            stack = lambda t: jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (ndp,) + x.shape), t)
+            return {"count": st["count"], "velocity": st["velocity"],
+                    "u": stack(st["u"]), "v": stack(st["v"])}
+
+        shapes = jax.eval_shape(init_fn, params)
+        local = self.named(P(self.axis))
+        shardings = {
+            "count": self.named(P()),
+            "velocity": jax.tree.map(lambda _: self.named(P()),
+                                     shapes["velocity"]),
+            "u": jax.tree.map(lambda _: local, shapes["u"]),
+            "v": jax.tree.map(lambda _: local, shapes["v"]),
+        }
+        return jax.jit(init_fn, out_shardings=shardings)(params)
+
+    # -- step ----------------------------------------------------------------
+    def jit_train_step(self, train_step):
+        plan = self
+        opt = self.optimizer
+        mesh, axis = self.mesh, self.axis
+        spec_l = P(axis)
+
+        def make(n_batch):
+            def step(params, opt_state, buffers, key, lr, *batch):
+                def body(params, buffers, vel, count, l_u, l_v,
+                         key, lr, *batch):
+                    sq = lambda t: jax.tree.map(lambda x: x[0], t)
+                    st = lambda t: jax.tree.map(lambda x: x[None], t)
+                    state_in = {"count": count, "velocity": vel,
+                                "u": sq(l_u), "v": sq(l_v)}
+                    key = jax.random.fold_in(key, lax.axis_index(axis))
+                    loss, out, new_p, ns, new_b = train_step(
+                        params, state_in, buffers, key, lr, *batch)
+                    loss = lax.pmean(loss, axis)
+                    # buffers (BN stats) are computed on the local shard —
+                    # average to keep the GSPMD global-batch semantics
+                    new_b = jax.tree.map(lambda x: lax.pmean(x, axis), new_b)
+                    return (loss, out, new_p, ns["velocity"], ns["count"],
+                            st(ns["u"]), st(ns["v"]), new_b)
+
+                local = opt_state
+                in_specs = (P(), P(), P(), P(), spec_l, spec_l, P(), P()) \
+                    + (spec_l,) * n_batch
+                out_specs = (P(), spec_l, P(), P(), P(), spec_l, spec_l, P())
+                loss, out, g_params, vel, count, nu, nv, g_bufs = shard_map(
+                    body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                )(params, buffers, local["velocity"], local["count"],
+                  local["u"], local["v"], key, lr, *batch)
+                new_state = {"count": count, "velocity": vel,
+                             "u": nu, "v": nv}
+                return loss, out, g_params, new_state, g_bufs
+
+            return step
+
+        compiled = {}
+
+        def wrapped(params, opt_state, buffers, key, lr, *batch):
+            t = (plan._t if plan._t is not None
+                 else int(opt_state["count"])) + 1
+            phase = opt.sparsity_at(t)
+            kk = (phase, len(batch))
+            # _sparsity_now is read at TRACE time only; keep it current so
+            # a fresh cache entry compiles the right phase
+            opt._sparsity_now = phase
+            if kk not in compiled:
+                compiled[kk] = jax.jit(make(len(batch)),
+                                       donate_argnums=(0, 1, 2))
+            out = compiled[kk](params, opt_state, buffers, key, lr, *batch)
+            plan._t = t
+            return out
+
+        return wrapped

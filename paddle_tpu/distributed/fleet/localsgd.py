@@ -29,7 +29,6 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ...framework.errors import InvalidArgumentError
 from ..collective import shard_map
 from .plan import ShardingPlan
 
@@ -42,28 +41,16 @@ class LocalSGDPlan(ShardingPlan):
 
     def __init__(self, network, optimizer, strategy, mesh=None):
         super().__init__(network, optimizer, strategy, mesh)
-        for ax in ("model", "pipe", "sep", "sharding"):
-            if self.mesh.shape.get(ax, 1) > 1:
-                raise InvalidArgumentError(
-                    "strategy.localsgd composes only with pure data "
-                    f"parallelism (mesh axis {ax!r} has size > 1) — same "
-                    "restriction as the reference meta-optimizer's _can_apply")
+        self._require_pure_dp("localsgd")
         cfg = getattr(strategy, "localsgd_configs", None) or {}
         self.k_steps = max(int(cfg.get("k_steps", 1)), 1)
         self.begin_step = max(int(cfg.get("begin_step", 1)), 1)
         self.axis = "data"
         self.ndp = self.mesh.shape["data"]
-        self._t = None  # host mirror of opt_state["count"] (avoids a
-        #                 device sync per step when choosing sync/local)
 
     # -- state ---------------------------------------------------------------
     def _local_sharding(self) -> NamedSharding:
         return self.named(P(self.axis))
-
-    def on_state_restored(self):
-        """Model.load calls this — re-derive the host step mirror from the
-        restored ``opt_state['count']`` on the next step."""
-        self._t = None
 
     def init_opt_state(self, optimizer, params, buffers=None):
         """{"count", "local": {"params", "inner", "buffers"}} — the local

@@ -57,6 +57,27 @@ class ShardingPlan:
             spec = getattr(box, "partition_spec", None)
             self.param_specs[name] = P(*spec) if spec else P()
         self.buffer_specs = {n: P() for n, _ in network.named_buffers()}
+        # host mirror of a step counter for plans with a host-side schedule
+        # (LocalSGD sync cadence, DGC sparsity ramp); see on_state_restored
+        self._t: Optional[int] = None
+
+    def _require_pure_dp(self, feature: str):
+        """Plans that replace GSPMD with per-replica shard_map execution
+        only compose with pure data parallelism — same restriction as the
+        reference meta-optimizers' _can_apply."""
+        from ...framework.errors import InvalidArgumentError
+
+        for ax in ("model", "pipe", "sep", "sharding"):
+            if self.mesh.shape.get(ax, 1) > 1:
+                raise InvalidArgumentError(
+                    f"strategy.{feature} composes only with pure data "
+                    f"parallelism (mesh axis {ax!r} has size > 1)")
+
+    def on_state_restored(self):
+        """Model.load calls this after replacing the optimizer state —
+        schedule-carrying plans re-derive their host step mirror from the
+        restored ``opt_state['count']`` on the next step."""
+        self._t = None
 
     # -- shardings -----------------------------------------------------------
     def named(self, spec: P) -> NamedSharding:

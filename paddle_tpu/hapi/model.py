@@ -208,6 +208,12 @@ class Model:
                 from ..distributed.fleet.localsgd import LocalSGDPlan
 
                 self._plan = LocalSGDPlan(net, optimizer, strategy)
+            elif strategy.dgc:
+                # reference: dgc_optimizer.py — top-k gradient compression
+                # with error feedback (see fleet/dgc.py)
+                from ..distributed.fleet.dgc import DGCPlan
+
+                self._plan = DGCPlan(net, optimizer, strategy)
             else:
                 self._plan = ShardingPlan(net, optimizer, strategy)
             self._plan.place_network()

@@ -21,6 +21,7 @@ from .averaging import (  # noqa: F401
     Lookahead,
     ModelAverage,
 )
+from .dgc import DGCMomentum  # noqa: F401
 from . import lr  # noqa: F401
 from .clip import (  # noqa: F401
     ClipGradByValue,

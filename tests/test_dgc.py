@@ -1,0 +1,241 @@
+"""Deep Gradient Compression strategy.
+
+Reference capability: DGCMomentumOptimizer (fluid/optimizer.py:1129) +
+operators/dgc_op.cc.  Assertions are trajectory- and structure-level:
+the dense warmup phase must equal plain DP Momentum, sparsity=0 must
+reduce to SGD on averaged grads (all momentum mass is flushed every
+step), the error-feedback accumulators must hold unsent gradient mass,
+and the compiled sparse step must exchange k-sized all-gathers instead
+of parameter-sized all-reduces.
+"""
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as popt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.mesh import build_mesh, set_mesh
+from paddle_tpu.framework.errors import InvalidArgumentError
+from paddle_tpu.optimizer import DGCMomentum
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    set_mesh(build_mesh())
+    yield
+    set_mesh(build_mesh())
+    fleet._initialized = False
+    fleet._strategy = None
+
+
+def _data(n=64, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d, 1).astype(np.float32)
+    y = x @ w + 0.01 * rng.randn(n, 1).astype(np.float32)
+    return x, y
+
+
+def _net(d=8):
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(d, 16), nn.ReLU(), nn.Linear(16, 1))
+
+
+def _prepare(dgc_configs, lr=0.05, momentum=0.9):
+    strat = fleet.DistributedStrategy(dgc=True, dgc_configs=dgc_configs)
+    fleet.init(is_collective=True, strategy=strat)
+    net = _net()
+    opt = fleet.distributed_optimizer(
+        popt.Momentum(learning_rate=lr, momentum=momentum))
+    assert isinstance(opt, DGCMomentum)
+    model = paddle.Model(net, inputs=["x"], labels=["y"])
+    model.prepare(optimizer=opt, loss=nn.MSELoss())
+    return model, net
+
+
+def _fit(model, x, y, steps):
+    return [model.train_batch([x], [y])[0] for _ in range(steps)]
+
+
+class TestDGCSchedule:
+    def test_sparsity_at(self):
+        opt = DGCMomentum(rampup_begin_step=2, rampup_step=4,
+                          sparsity=[0.75, 0.9375])
+        assert opt.sparsity_at(1) is None
+        assert opt.sparsity_at(2) is None
+        assert opt.sparsity_at(3) == 0.75
+        assert opt.sparsity_at(4) == 0.75
+        assert opt.sparsity_at(5) == 0.9375
+        assert opt.sparsity_at(100) == 0.9375
+
+    def test_validation(self):
+        with pytest.raises(InvalidArgumentError):
+            DGCMomentum(momentum=1.5)
+        with pytest.raises(InvalidArgumentError):
+            DGCMomentum(sparsity=[1.0])
+        with pytest.raises(InvalidArgumentError, match="Model"):
+            DGCMomentum(parameters=_net().parameters()).step({})
+
+
+class TestDGCTrajectories:
+    def test_warmup_matches_dense_momentum_dp(self):
+        x, y = _data()
+
+        fleet.init(is_collective=True,
+                   strategy=fleet.DistributedStrategy())
+        net_ref = _net()
+        opt = fleet.distributed_optimizer(
+            popt.Momentum(learning_rate=0.05, momentum=0.9))
+        m_ref = paddle.Model(net_ref, inputs=["x"], labels=["y"])
+        m_ref.prepare(optimizer=opt, loss=nn.MSELoss())
+        ref = _fit(m_ref, x, y, 5)
+        fleet._initialized = False
+
+        # rampup_begin_step large → every tested step is dense warmup
+        m, _ = _prepare({"rampup_begin_step": 100})
+        got = _fit(m, x, y, 5)
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
+
+    def test_warmup_nesterov_and_clip_match_dense_dp(self):
+        """grad_clip must apply to the AGGREGATED warmup gradient and
+        nesterov must survive the Momentum→DGCMomentum conversion."""
+        from paddle_tpu.optimizer import ClipGradByGlobalNorm
+
+        x, y = _data()
+
+        fleet.init(is_collective=True,
+                   strategy=fleet.DistributedStrategy())
+        net_ref = _net()
+        opt = fleet.distributed_optimizer(
+            popt.Momentum(learning_rate=0.05, momentum=0.9,
+                          use_nesterov=True,
+                          grad_clip=ClipGradByGlobalNorm(0.5)))
+        m_ref = paddle.Model(net_ref, inputs=["x"], labels=["y"])
+        m_ref.prepare(optimizer=opt, loss=nn.MSELoss())
+        ref = _fit(m_ref, x, y, 5)
+        fleet._initialized = False
+
+        strat = fleet.DistributedStrategy(
+            dgc=True, dgc_configs={"rampup_begin_step": 100})
+        fleet.init(is_collective=True, strategy=strat)
+        net = _net()
+        dopt = fleet.distributed_optimizer(
+            popt.Momentum(learning_rate=0.05, momentum=0.9,
+                          use_nesterov=True,
+                          grad_clip=ClipGradByGlobalNorm(0.5)))
+        assert isinstance(dopt, DGCMomentum) and dopt._nesterov
+        m = paddle.Model(net, inputs=["x"], labels=["y"])
+        m.prepare(optimizer=dopt, loss=nn.MSELoss())
+        got = _fit(m, x, y, 5)
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
+
+    def test_sparsity_zero_is_sgd_on_mean_grads(self):
+        """k=n sends everything each step, so u is flushed every step and
+        momentum never accumulates — DGC(s=0) == SGD on averaged grads."""
+        x, y = _data()
+
+        fleet.init(is_collective=True,
+                   strategy=fleet.DistributedStrategy())
+        net_ref = _net()
+        opt = fleet.distributed_optimizer(popt.SGD(learning_rate=0.05))
+        m_ref = paddle.Model(net_ref, inputs=["x"], labels=["y"])
+        m_ref.prepare(optimizer=opt, loss=nn.MSELoss())
+        ref = _fit(m_ref, x, y, 5)
+        fleet._initialized = False
+
+        m, _ = _prepare({"rampup_begin_step": 0, "sparsity": [0.0]})
+        got = _fit(m, x, y, 5)
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
+
+    def test_error_feedback_holds_unsent_mass(self):
+        x, y = _data()
+        m, _ = _prepare({"rampup_begin_step": 0, "sparsity": [0.9]})
+        m.train_batch([x], [y])
+        v = m._opt_state["v"]
+        # each replica's residual holds the ~90% unsent entries
+        leaf = np.asarray(next(iter(v.values())))  # [8, ...]
+        assert leaf.shape[0] == 8
+        assert np.count_nonzero(leaf) > 0
+        # replicas saw different shards → different residuals
+        assert not np.allclose(leaf[0], leaf[1])
+
+    def test_converges_at_high_sparsity(self):
+        x, y = _data()
+        m, _ = _prepare({"rampup_begin_step": 2, "rampup_step": 4,
+                         "sparsity": [0.75, 0.9]}, lr=0.05)
+        losses = _fit(m, x, y, 50)
+        assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
+
+    def test_save_load_resets_schedule_mirror(self, tmp_path):
+        import os
+
+        x, y = _data()
+        m, _ = _prepare({"rampup_begin_step": 0, "sparsity": [0.9]})
+        for _ in range(3):
+            m.train_batch([x], [y])
+        ck = os.path.join(tmp_path, "ck")
+        m.save(ck)
+        m.train_batch([x], [y])
+        m.load(ck)
+        assert m._plan._t is None
+        m.train_batch([x], [y])
+        assert m._plan._t == 4
+        assert int(np.asarray(m._opt_state["count"])) == 4
+
+
+class TestDGCStructure:
+    def test_sparse_step_has_no_param_sized_all_reduce(self):
+        x, y = _data()
+        m, net = _prepare({"rampup_begin_step": 0, "sparsity": [0.9]})
+        m.train_batch([x], [y])
+        cache = [c.cell_contents for c in m._train_step.__closure__
+                 if isinstance(c.cell_contents, dict)][0]
+        ((phase, nb), fn), = cache.items()
+        assert phase == 0.9
+        params, bufs = m._pull_state()
+        hlo = fn.lower(params, m._opt_state, bufs, jax.random.PRNGKey(0),
+                       jnp.float32(0.05), jnp.asarray(x),
+                       jnp.asarray(y)).compile().as_text()
+        ar_sizes = [int(np.prod([int(d) for d in s.split(",") if d])) if s
+                    else 1
+                    for s in re.findall(r"all-reduce[^\n]*f32\[([\d,]*)\]",
+                                        hlo)]
+        assert not [s for s in ar_sizes if s > 64], ar_sizes
+        assert "all-gather" in hlo  # the k-sized sparse exchange
+
+    def test_requires_momentum(self):
+        strat = fleet.DistributedStrategy(dgc=True)
+        fleet.init(is_collective=True, strategy=strat)
+        with pytest.raises(InvalidArgumentError, match="Momentum"):
+            fleet.distributed_optimizer(popt.Adam(learning_rate=1e-3))
+
+    @pytest.mark.parametrize("other", ["localsgd", "lamb", "lars",
+                                       "gradient_merge"])
+    def test_rejects_meta_optimizer_combos(self, other):
+        strat = fleet.DistributedStrategy(dgc=True, **{other: True})
+        fleet.init(is_collective=True, strategy=strat)
+        with pytest.raises(InvalidArgumentError, match="compose"):
+            fleet.distributed_optimizer(
+                popt.Momentum(learning_rate=0.05, momentum=0.9))
+
+    def test_rejects_multi_precision(self):
+        strat = fleet.DistributedStrategy(dgc=True)
+        fleet.init(is_collective=True, strategy=strat)
+        with pytest.raises(InvalidArgumentError, match="multi_precision"):
+            fleet.distributed_optimizer(
+                popt.Momentum(learning_rate=0.05, momentum=0.9,
+                              multi_precision=True))
+
+    def test_rejects_hybrid_mesh(self):
+        strat = fleet.DistributedStrategy(dgc=True, mp_degree=2)
+        fleet.init(is_collective=True, strategy=strat)
+        net = _net()
+        opt = fleet.distributed_optimizer(
+            popt.Momentum(learning_rate=0.05, momentum=0.9))
+        m = paddle.Model(net, inputs=["x"], labels=["y"])
+        with pytest.raises(InvalidArgumentError, match="dgc"):
+            m.prepare(optimizer=opt, loss=nn.MSELoss())

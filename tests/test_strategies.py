@@ -198,7 +198,7 @@ class TestOptimizerToggles:
 
 
 class TestUnimplementedStrategies:
-    @pytest.mark.parametrize("field", ["dgc", "a_sync"])
+    @pytest.mark.parametrize("field", ["a_sync"])
     def test_raises_instead_of_silent_noop(self, field):
         strat = fleet.DistributedStrategy(**{field: True})
         fleet.init(is_collective=True, strategy=strat)
