@@ -23,12 +23,14 @@ import jax
 from ...framework.errors import InvalidArgumentError
 from .. import env as _env
 from ..mesh import build_mesh, get_mesh, set_mesh
+from . import metrics  # noqa: F401
 from .plan import ShardingPlan
 from .strategy import DistributedStrategy
 
 __all__ = [
     "DistributedStrategy",
     "ShardingPlan",
+    "metrics",
     "init",
     "distributed_optimizer",
     "distributed_model",

@@ -1,0 +1,84 @@
+"""fleet.metrics — globally-reduced evaluation metrics.
+
+Parity: python/paddle/distributed/fleet/metrics/metric.py (sum/max/min/
+auc/mae/rmse/mse/acc over gloo all_reduce of scope tensors).  TPU-native:
+each process evaluates its own data shard and holds host-side numpy
+accumulators; aggregation rides ``multihost_utils.process_allgather``
+(the jax coordination service) instead of a gloo ring.  Single-process
+runs reduce to the identity, so the same training script works from a
+laptop to a pod.
+
+The ``scope`` parameter of the reference (static-graph Variable lookup)
+is accepted and ignored — there is no scope; pass arrays directly.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+__all__ = ["sum", "max", "min", "auc", "mae", "rmse", "mse", "acc"]
+
+_py_sum, _py_max, _py_min = sum, max, min  # the paddle API shadows builtins
+
+
+def _allgather(arr: np.ndarray) -> np.ndarray:
+    """[n_process, *arr.shape] — every process's value."""
+    if jax.process_count() == 1:
+        return arr[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr))
+
+
+def _to_np(x) -> np.ndarray:
+    return np.asarray(x, dtype=np.float64)
+
+
+def sum(input, scope=None, util=None):  # noqa: A001 — paddle API name
+    """Global elementwise sum of ``input`` across processes."""
+    return _allgather(_to_np(input)).sum(axis=0)
+
+
+def max(input, scope=None, util=None):  # noqa: A001
+    return _allgather(_to_np(input)).max(axis=0)
+
+
+def min(input, scope=None, util=None):  # noqa: A001
+    return _allgather(_to_np(input)).min(axis=0)
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None) -> float:
+    """AUC from bucketed score histograms (reference: metric.py:140 —
+    same bucket-trapezoid estimate as the distributed auc op).
+
+    ``stat_pos[i]`` / ``stat_neg[i]``: counts of positive / negative
+    examples whose predicted score fell into bucket ``i``.
+    """
+    from ...metric import bucket_auc
+
+    # reference metric.py:214 returns 0.5 when one class is empty (the
+    # hapi Auc metric returns 0.0 — both kept, via the shared sweep)
+    return bucket_auc(sum(stat_pos), sum(stat_neg), degenerate=0.5)
+
+
+def mae(abserr, total_ins_num, scope=None, util=None) -> float:
+    """Global mean absolute error: sum(abserr) / sum(total_ins_num)."""
+    err = float(sum(abserr).sum())
+    n = float(sum(_to_np(total_ins_num)).sum())
+    return err / _py_max(n, 1.0)
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None) -> float:
+    err = float(sum(sqrerr).sum())
+    n = float(sum(_to_np(total_ins_num)).sum())
+    return err / _py_max(n, 1.0)
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None) -> float:
+    return float(np.sqrt(mse(sqrerr, total_ins_num)))
+
+
+def acc(correct, total, scope=None, util=None) -> float:
+    c = float(sum(_to_np(correct)).sum())
+    t = float(sum(_to_np(total)).sum())
+    return c / _py_max(t, 1.0)

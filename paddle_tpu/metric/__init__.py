@@ -138,6 +138,24 @@ class Recall(Metric):
         return self._name
 
 
+def bucket_auc(stat_pos, stat_neg, degenerate: float = 0.0) -> float:
+    """Trapezoid AUC over bucketed score histograms, sweeping thresholds
+    high→low (the reference's estimate in both metrics.py:509 and the
+    fleet metric.py:203).  ``degenerate``: value when one class is empty
+    (the two reference surfaces disagree: 0.0 for the Metric, 0.5 for
+    fleet.metrics — callers pick)."""
+    pos = np.asarray(stat_pos, dtype=np.float64).ravel()
+    neg = np.asarray(stat_neg, dtype=np.float64).ravel()
+    tot_pos = tot_neg = area = 0.0
+    for p, n in zip(pos[::-1], neg[::-1]):
+        area += n * (tot_pos + p / 2.0)
+        tot_pos += p
+        tot_neg += n
+    if tot_pos == 0 or tot_neg == 0:
+        return degenerate
+    return float(area / (tot_pos * tot_neg))
+
+
 class Auc(Metric):
     """ROC AUC via thresholded confusion histogram (reference uses the same
     bucketed approximation, metrics.py:509 num_thresholds=4095)."""
@@ -168,18 +186,7 @@ class Auc(Metric):
         self._stat_neg = np.zeros(self.num_thresholds + 1, dtype=np.int64)
 
     def accumulate(self):
-        tot_pos = 0.0
-        tot_neg = 0.0
-        auc = 0.0
-        # sweep thresholds high→low, trapezoid over (FP, TP) increments
-        for i in range(self.num_thresholds, -1, -1):
-            p, n = float(self._stat_pos[i]), float(self._stat_neg[i])
-            auc += n * (tot_pos + p / 2.0)
-            tot_pos += p
-            tot_neg += n
-        if tot_pos == 0 or tot_neg == 0:
-            return 0.0
-        return auc / (tot_pos * tot_neg)
+        return bucket_auc(self._stat_pos, self._stat_neg, degenerate=0.0)
 
     def name(self):
         return self._name
