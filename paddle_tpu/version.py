@@ -1,3 +1,6 @@
 __version__ = "0.1.0"
 full_version = __version__
 major, minor, patch = (int(p) for p in __version__.split("."))
+# stamped by setup.py's build_py with the checkout commit (parity:
+# cmake/version.cmake → PADDLE_VERSION/commit in fluid/platform/init.cc)
+git_commit = "unknown"

@@ -1,0 +1,67 @@
+"""setup shim — version stamping from git.
+
+Parity: the reference's cmake/version.cmake writes PADDLE_VERSION and the
+git commit into the build (fluid/platform/init.cc prints it); here the
+sdist/wheel build stamps ``paddle_tpu/version.py`` with the commit of the
+checkout so ``paddle_tpu.version.git_commit`` identifies a build.  All
+static metadata lives in pyproject.toml.
+"""
+import os
+import re
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+from setuptools.command.sdist import sdist
+
+
+def _git_commit() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+            capture_output=True, text=True, timeout=10)
+        return out.stdout.strip() if out.returncode == 0 else "unknown"
+    except (OSError, subprocess.SubprocessError):
+        return "unknown"
+
+
+def _stamp(path: str):
+    """Write the checkout commit into a copied version.py (never the
+    in-tree source).  Keeps an existing non-unknown stamp: a wheel built
+    from an sdist has no .git but the sdist was already stamped."""
+    if not os.path.exists(path):
+        return
+    commit = _git_commit()
+    with open(path) as f:
+        src = f.read()
+    if commit == "unknown" and 'git_commit = "unknown"' not in src:
+        return  # already carries a real commit from the sdist stamp
+    src = re.sub(r"^git_commit = .*$", f'git_commit = "{commit}"',
+                 src, flags=re.M)
+    with open(path, "w") as f:
+        f.write(src)
+
+
+class BuildPyStampVersion(build_py):
+    def run(self):
+        super().run()
+        _stamp(os.path.join(self.build_lib, "paddle_tpu", "version.py"))
+
+
+class SdistStampVersion(sdist):
+    def make_release_tree(self, base_dir, files):
+        super().make_release_tree(base_dir, files)
+        # the release tree hard-links by default — copy before writing so
+        # the stamp never touches the working tree's version.py
+        target = os.path.join(base_dir, "paddle_tpu", "version.py")
+        if os.path.exists(target):
+            os.unlink(target)
+            import shutil
+
+            shutil.copyfile(os.path.join("paddle_tpu", "version.py"), target)
+        _stamp(target)
+
+
+setup(cmdclass={"build_py": BuildPyStampVersion,
+                "sdist": SdistStampVersion})
