@@ -1,0 +1,137 @@
+"""Linear-chain CRF ops.
+
+Parity: operators/linear_chain_crf_op.{cc,h} (forward-algorithm
+log-likelihood) and operators/crf_decoding_op.h (Viterbi decode), the ops
+behind the label_semantic_roles book test (tests/book/
+test_label_semantic_roles.py).
+
+Conventions kept from the reference (linear_chain_crf_op.cc:103-107):
+``transition`` is ``[(D+2), D]`` — row 0 holds the start weights, row 1
+the stop weights, rows 2.. the D×D transition matrix.  The reference's
+kernel is a per-sequence C++ loop over LoD slices with L1-normalized
+alphas; here sequences are dense-padded ``[B, T, D]`` and the forward /
+Viterbi recursions are ``lax.scan`` over time in log space — batch
+parallelism comes from the scan body's vectorized ops, autodiff replaces
+the hand-written gradient kernel (LinearChainCRFGradOpKernel).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy.special import logsumexp
+
+__all__ = ["linear_chain_crf", "crf_decoding", "viterbi_decode"]
+
+
+def _split(transition):
+    t = jnp.asarray(transition, jnp.float32)
+    return t[0], t[1], t[2:]  # start [D], stop [D], trans [D, D]
+
+
+def _lengths_mask(B, T, length):
+    if length is None:
+        return jnp.ones((B, T), bool), jnp.full((B,), T, jnp.int32)
+    length = jnp.asarray(length, jnp.int32).reshape(B)
+    return jnp.arange(T, dtype=jnp.int32)[None, :] < length[:, None], length
+
+
+def linear_chain_crf(emission, transition, label,
+                     length=None):
+    """Negative log-likelihood of ``label`` paths under a linear-chain CRF.
+
+    emission ``[B, T, D]``, transition ``[(D+2), D]``, label ``[B, T]``
+    int, length ``[B]`` (None → all full).  Returns ``[B, 1]`` — the
+    reference's LogLikelihood output, used directly as a cost.
+    """
+    e = jnp.asarray(emission, jnp.float32)
+    B, T, D = e.shape
+    y = jnp.asarray(label, jnp.int32).reshape(B, T)
+    start, stop, trans = _split(transition)
+    mask, length = _lengths_mask(B, T, length)
+
+    # -- partition function: forward algorithm over time ---------------------
+    alpha = start[None, :] + e[:, 0]  # [B, D]
+
+    def fwd(alpha, xs):
+        e_t, m_t = xs  # [B, D], [B]
+        nxt = logsumexp(alpha[:, :, None] + trans[None], axis=1) + e_t
+        return jnp.where(m_t[:, None], nxt, alpha), None
+
+    if T > 1:
+        alpha, _ = lax.scan(
+            fwd, alpha,
+            (e[:, 1:].transpose(1, 0, 2), mask[:, 1:].T))
+    log_z = logsumexp(alpha + stop[None, :], axis=-1)  # [B]
+
+    # -- gold-path score -----------------------------------------------------
+    e_path = jnp.take_along_axis(e, y[:, :, None], axis=2)[:, :, 0]  # [B,T]
+    score = start[y[:, 0]] + e_path[:, 0]
+    if T > 1:
+        step_scores = trans[y[:, :-1], y[:, 1:]] + e_path[:, 1:]  # [B,T-1]
+        score = score + jnp.where(mask[:, 1:], step_scores, 0.0).sum(axis=1)
+    y_last = jnp.take_along_axis(y, (length - 1)[:, None], axis=1)[:, 0]
+    score = score + stop[y_last]
+
+    return (log_z - score)[:, None]
+
+
+def viterbi_decode(emission, transition, length=None):
+    """Highest-scoring tag path.  Returns ``(path [B, T] i32, score [B])``;
+    positions beyond ``length`` hold 0."""
+    e = jnp.asarray(emission, jnp.float32)
+    B, T, D = e.shape
+    start, stop, trans = _split(transition)
+    mask, length = _lengths_mask(B, T, length)
+
+    delta = start[None, :] + e[:, 0]  # [B, D]
+
+    def step(delta, xs):
+        e_t, m_t = xs
+        scores = delta[:, :, None] + trans[None]        # [B, D_prev, D]
+        back = jnp.argmax(scores, axis=1).astype(jnp.int32)  # [B, D]
+        nxt = jnp.max(scores, axis=1) + e_t
+        nxt = jnp.where(m_t[:, None], nxt, delta)
+        # padded steps point to themselves so the backtrace passes through
+        back = jnp.where(m_t[:, None],
+                         back, jnp.arange(D, dtype=jnp.int32)[None, :])
+        return nxt, back
+
+    if T > 1:
+        delta, backs = lax.scan(
+            step, delta, (e[:, 1:].transpose(1, 0, 2), mask[:, 1:].T))
+    else:
+        backs = jnp.zeros((0, B, D), jnp.int32)
+
+    final = delta + stop[None, :]
+    best_score = jnp.max(final, axis=-1)
+    best_last = jnp.argmax(final, axis=-1).astype(jnp.int32)  # [B]
+
+    def trace(tag, back_t):
+        prev = jnp.take_along_axis(back_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    first, rest = lax.scan(trace, best_last, backs, reverse=True)
+    # rest[t-1] holds the tag at step t (reverse scan stores outputs at the
+    # matching xs index); the final carry is the tag at step 0
+    path = (jnp.concatenate([first[None], rest], axis=0)
+            if T > 1 else first[None])
+    path = path.T  # [B, T]
+    return jnp.where(mask, path, 0).astype(jnp.int32), best_score
+
+
+def crf_decoding(emission, transition, label=None, length=None):
+    """Reference crf_decoding_op.h: Viterbi path, or — when ``label`` is
+    given — a per-position 0/1 tensor marking where the best path and the
+    label AGREE (crf_decoding_op.h:70 ``label == path ? 1 : 0``; positions
+    beyond ``length`` are 0), so ``output.sum()/num_tokens`` is tagging
+    accuracy."""
+    path, _ = viterbi_decode(emission, transition, length)
+    if label is None:
+        return path
+    B, T = path.shape
+    y = jnp.asarray(label, jnp.int32).reshape(B, T)
+    mask, _ = _lengths_mask(B, T, length)
+    return jnp.where(mask, (path == y).astype(jnp.int64), 0)

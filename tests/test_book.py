@@ -98,6 +98,184 @@ class TestWord2Vec:
         assert acc == 1.0
 
 
+class TestLabelSemanticRoles:
+    """book/test_label_semantic_roles.py: sequence tagging trained through
+    linear_chain_crf, decoded with Viterbi.  Ground truth comes from a
+    Markov tag chain whose observations alias two tags — only the learned
+    transitions can disambiguate, so CRF decoding must beat per-token
+    argmax."""
+
+    def test_crf_tagging_beats_pointwise(self):
+        from paddle_tpu.nn import functional as F
+
+        D, T, N, V = 4, 12, 256, 6
+        rng = np.random.RandomState(0)
+        # tags 0/1 emit observation 0; tags 2/3 emit their own symbol.
+        # transitions: 0→{2}, 1→{3} strongly — context resolves the alias
+        trans_true = np.array([
+            [0.05, 0.05, 0.85, 0.05],
+            [0.05, 0.05, 0.05, 0.85],
+            [0.45, 0.45, 0.05, 0.05],
+            [0.45, 0.45, 0.05, 0.05],
+        ])
+        obs_of_tag = {0: 0, 1: 0, 2: 2, 3: 3}
+        tags = np.zeros((N, T), np.int32)
+        toks = np.zeros((N, T), np.int32)
+        for n in range(N):
+            t0 = rng.randint(D)
+            for t in range(T):
+                tags[n, t] = t0
+                toks[n, t] = obs_of_tag[t0]
+                t0 = rng.choice(D, p=trans_true[t0])
+        lengths = np.full(N, T, np.int32)
+
+        class Tagger(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(V, 16)
+                self.proj = nn.Linear(16, D)
+                self.transition = self.create_parameter(
+                    [D + 2, D],
+                    default_initializer=nn.initializer.Normal(std=0.1))
+
+            def forward(self, toks):
+                # transition rides the outputs so the loss sees the traced
+                # (differentiable) value, not the eager box
+                return self.proj(self.emb(toks)), self.transition.value
+
+        def crf_loss(emissions, transition, y, ln):
+            return F.linear_chain_crf(emissions, transition, y, ln).mean()
+
+        paddle.seed(0)
+        net = Tagger()
+        model = paddle.Model(net, inputs=["toks"], labels=["y", "len"])
+        model.prepare(optimizer=popt.Adam(learning_rate=0.1), loss=crf_loss)
+        for _ in range(120):
+            loss, _ = model.train_batch([toks], [tags, lengths])
+
+        emissions, transition = net(jnp.asarray(toks))
+        path = np.asarray(F.crf_decoding(emissions, transition,
+                                         length=lengths))
+        crf_acc = (path == tags).mean()
+        pointwise_acc = (np.asarray(emissions).argmax(-1) == tags).mean()
+        assert crf_acc > 0.85, crf_acc
+        assert crf_acc > pointwise_acc + 0.05, (crf_acc, pointwise_acc)
+
+
+class TestUnderstandSentiment:
+    """book/test_understand_sentiment.py: text classification over the
+    IMDB pipeline (synthetic corpus in the real aclImdb tar format)."""
+
+    def test_classifies_synthetic_reviews(self, tmp_path):
+        import io
+        import tarfile
+
+        from paddle_tpu.text.datasets import Imdb
+
+        rng = np.random.RandomState(0)
+        pos_w = ["great", "love", "fun", "superb"]
+        neg_w = ["bad", "awful", "boring", "dire"]
+        fill = ["the", "movie", "a", "was", "plot"]
+
+        def doc(words):
+            toks = list(rng.choice(fill, 6)) + list(rng.choice(words, 3))
+            rng.shuffle(toks)
+            return " ".join(toks).encode()
+
+        p = os.path.join(tmp_path, "aclImdb_v1.tar.gz")
+        with tarfile.open(p, "w:gz") as t:
+            for i in range(40):
+                for sent, words in (("pos", pos_w), ("neg", neg_w)):
+                    blob = doc(words)
+                    info = tarfile.TarInfo(f"aclImdb/train/{sent}/{i}.txt")
+                    info.size = len(blob)
+                    t.addfile(info, io.BytesIO(blob))
+
+        ds = Imdb(data_file=p, mode="train", cutoff=0)
+        V = len(ds.word_idx)
+        T = max(len(s[0]) for s in ds)
+        X = np.zeros((len(ds), T), np.int64)
+        y = np.zeros((len(ds),), np.int64)
+        for i in range(len(ds)):
+            toks, lab = ds[i]
+            X[i, :len(toks)] = toks
+            y[i] = int(lab)
+
+        class SentimentNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(V + 1, 16)
+                self.fc = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                                        nn.Linear(32, 2))
+
+            def forward(self, x):
+                return self.fc(self.emb(x).mean(axis=1))
+
+        paddle.seed(0)
+        net = SentimentNet()
+        model = paddle.Model(net, inputs=["x"], labels=["y"])
+        model.prepare(optimizer=popt.Adam(learning_rate=0.05),
+                      loss=nn.CrossEntropyLoss(),
+                      metrics=[paddle.metric.Accuracy()])
+        for _ in range(40):
+            loss, metrics = model.train_batch([X], [y])
+        assert metrics[0] > 0.95, metrics
+
+
+class TestRecommenderSystem:
+    """book/test_recommender_system.py: rating regression over the
+    Movielens pipeline (two-tower embedding dot product)."""
+
+    def test_learns_ratings(self, tmp_path):
+        import zipfile
+
+        from paddle_tpu.text.datasets import Movielens
+
+        rng = np.random.RandomState(0)
+        n_users, n_movies = 12, 12
+        movies = "".join(f"{m}::Movie {m} (1999)::Drama\n"
+                         for m in range(1, n_movies + 1))
+        users = "".join(f"{u}::M::25::6::55117\n"
+                        for u in range(1, n_users + 1))
+        # structured preference: like iff same parity
+        lines = []
+        for u in range(1, n_users + 1):
+            for m in rng.choice(range(1, n_movies + 1), 8, replace=False):
+                r = 5 if (u + m) % 2 == 0 else 1
+                lines.append(f"{u}::{m}::{r}::978300760\n")
+        p = os.path.join(tmp_path, "ml-1m.zip")
+        with zipfile.ZipFile(p, "w") as z:
+            z.writestr("ml-1m/movies.dat", movies)
+            z.writestr("ml-1m/users.dat", users)
+            z.writestr("ml-1m/ratings.dat", "".join(lines))
+
+        ds = Movielens(data_file=p, mode="train", test_ratio=0.1,
+                       rand_seed=0)
+        uid = np.stack([s[0] for s in ds]).astype(np.int64).ravel()
+        mid = np.stack([s[4] for s in ds]).astype(np.int64).ravel()
+        rating = np.stack([s[-1] for s in ds]).astype(np.float32)
+
+        class TwoTower(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.u = nn.Embedding(n_users + 1, 8)
+                self.m = nn.Embedding(n_movies + 1, 8)
+
+            def forward(self, uid, mid):
+                return (self.u(uid) * self.m(mid)).sum(-1, keepdims=True)
+
+        paddle.seed(0)
+        net = TwoTower()
+        model = paddle.Model(net, inputs=["uid", "mid"], labels=["r"])
+        model.prepare(optimizer=popt.Adam(learning_rate=0.1),
+                      loss=nn.MSELoss())
+        first = None
+        for _ in range(80):
+            loss, _ = model.train_batch([uid, mid], [rating])
+            first = loss if first is None else first
+        assert loss < first * 0.05, (first, loss)
+
+
 class TestMachineTranslation:
     """book/test_machine_translation.py: seq2seq over the WMT16 pipeline
     (tiny copy task: source sentence → identical target sentence)."""

@@ -1,0 +1,141 @@
+"""Linear-chain CRF ops vs brute-force enumeration.
+
+Reference capability: operators/linear_chain_crf_op.h (forward algorithm)
+and crf_decoding_op.h (Viterbi) — the ops behind the label_semantic_roles
+book test.  Small tag/time sizes let every path be enumerated exactly.
+"""
+import itertools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn.functional import (
+    crf_decoding,
+    linear_chain_crf,
+    viterbi_decode,
+)
+
+D, T, B = 3, 4, 5
+
+
+def _rand(seed=0):
+    rng = np.random.RandomState(seed)
+    emission = rng.randn(B, T, D).astype(np.float32)
+    transition = rng.randn(D + 2, D).astype(np.float32)
+    labels = rng.randint(0, D, (B, T)).astype(np.int32)
+    lengths = np.array([T, T - 1, 2, 1, T], np.int32)
+    return emission, transition, labels, lengths
+
+
+def _path_score(e_b, transition, path):
+    start, stop, trans = (transition[0], transition[1], transition[2:])
+    s = start[path[0]] + e_b[0, path[0]]
+    for t in range(1, len(path)):
+        s += trans[path[t - 1], path[t]] + e_b[t, path[t]]
+    return s + stop[path[-1]]
+
+
+def _brute(e_b, transition, length):
+    scores = {
+        p: _path_score(e_b[:length], transition, p)
+        for p in itertools.product(range(D), repeat=length)
+    }
+    arr = np.array(list(scores.values()))
+    log_z = np.log(np.exp(arr - arr.max()).sum()) + arr.max()
+    best = max(scores, key=scores.get)
+    return log_z, np.array(best), scores[best]
+
+
+class TestLinearChainCrf:
+    def test_nll_matches_bruteforce(self):
+        emission, transition, labels, lengths = _rand()
+        nll = np.asarray(linear_chain_crf(emission, transition, labels,
+                                          lengths))
+        assert nll.shape == (B, 1)
+        for b in range(B):
+            L = lengths[b]
+            log_z, _, _ = _brute(emission[b], transition, L)
+            gold = _path_score(emission[b][:L], transition, labels[b][:L])
+            np.testing.assert_allclose(nll[b, 0], log_z - gold, rtol=1e-5)
+
+    def test_full_length_default(self):
+        emission, transition, labels, _ = _rand()
+        a = np.asarray(linear_chain_crf(emission, transition, labels))
+        b = np.asarray(linear_chain_crf(emission, transition, labels,
+                                        np.full(B, T, np.int32)))
+        np.testing.assert_allclose(a, b)
+
+    def test_gradients_flow_and_train(self):
+        """Minimizing the NLL must drive p(gold) → 1 on a toy problem."""
+        emission, transition, labels, lengths = _rand()
+        trans = jnp.asarray(transition)
+        em = jnp.asarray(emission)
+
+        def loss(trans, em):
+            return linear_chain_crf(em, trans, labels, lengths).mean()
+
+        g = jax.grad(loss, argnums=(0, 1))(trans, em)
+        assert all(np.isfinite(np.asarray(x)).all() for x in g)
+        l0 = float(loss(trans, em))
+
+        @jax.jit
+        def sgd(trans, em):
+            gt, ge = jax.grad(loss, argnums=(0, 1))(trans, em)
+            return trans - 0.5 * gt, em - 0.5 * ge
+
+        for _ in range(200):
+            trans, em = sgd(trans, em)
+        lN = float(loss(trans, em))
+        assert lN < l0 * 0.1
+        # decoded path now equals the gold labels inside each length
+        path = np.asarray(crf_decoding(em, trans, length=lengths))
+        for b in range(B):
+            np.testing.assert_array_equal(path[b, :lengths[b]],
+                                          labels[b, :lengths[b]])
+
+
+class TestViterbi:
+    def test_matches_bruteforce(self):
+        emission, transition, labels, lengths = _rand(1)
+        path, score = viterbi_decode(emission, transition, lengths)
+        path, score = np.asarray(path), np.asarray(score)
+        for b in range(B):
+            L = lengths[b]
+            _, best, best_score = _brute(emission[b], transition, L)
+            np.testing.assert_array_equal(path[b, :L], best)
+            np.testing.assert_allclose(score[b], best_score, rtol=1e-5)
+            assert (path[b, L:] == 0).all()
+
+    def test_crf_decoding_agreement_mode(self):
+        """Reference semantics (crf_decoding_op.h:70): 1 where the label
+        AGREES with the best path, 0 elsewhere and beyond length."""
+        emission, transition, _, lengths = _rand(2)
+        path = np.asarray(crf_decoding(emission, transition,
+                                       length=lengths))
+        # feed the decoded path back as labels → all ones within lengths
+        hit = np.asarray(crf_decoding(emission, transition, label=path,
+                                      length=lengths))
+        for b in range(B):
+            assert (hit[b, :lengths[b]] == 1).all()
+            assert (hit[b, lengths[b]:] == 0).all()
+        # flip one in-length position → exactly that position reads 0
+        wrong = path.copy()
+        wrong[0, 0] = (wrong[0, 0] + 1) % D
+        agree = np.asarray(crf_decoding(emission, transition, label=wrong,
+                                        length=lengths))
+        assert agree[0, 0] == 0
+        assert agree.sum() == hit.sum() - 1
+
+    def test_t1_edge(self):
+        emission, transition, labels, _ = _rand(3)
+        e1 = emission[:, :1]
+        path, _ = viterbi_decode(e1, transition)
+        start, stop = transition[0], transition[1]
+        want = np.argmax(e1[:, 0] + start[None] + stop[None], axis=-1)
+        np.testing.assert_array_equal(np.asarray(path)[:, 0], want)
+        nll = np.asarray(linear_chain_crf(e1, transition, labels[:, :1]))
+        assert np.isfinite(nll).all()
