@@ -60,6 +60,11 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n, channel_last
     rhs_spec = "OI" + spatial
     out_spec = lhs_spec
     dn = lax.conv_dimension_numbers(x.shape, weight.shape, (lhs_spec, rhs_spec, out_spec))
+    # NO preferred_element_type=f32 for bf16 inputs: the TPU conv unit
+    # accumulates in f32 internally regardless, and an f32-typed OUTPUT
+    # breaks autodiff — the weight-gradient transpose rule feeds the f32
+    # cotangent and the saved bf16 activation into one conv, which rejects
+    # mixed dtypes.  bf16-in/bf16-out is the AMP storage convention.
     out = lax.conv_general_dilated(
         x, weight,
         window_strides=_norm_tuple(stride, n, "stride"),
@@ -67,10 +72,7 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n, channel_last
         rhs_dilation=_norm_tuple(dilation, n, "dilation"),
         dimension_numbers=dn,
         feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
     )
-    if out.dtype != x.dtype:
-        out = out.astype(x.dtype)
     if bias is not None:
         b = jnp.asarray(bias, out.dtype)
         shape = [1] * out.ndim

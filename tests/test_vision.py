@@ -218,6 +218,61 @@ def test_image_folder(tmp_path):
 
 # --- convergence gate (book-test style) -------------------------------------
 
+def test_resnet50_amp_dp_plan():
+    """BASELINE configs 2+4: ResNet-50 trains AMP-O1 under an 8-device
+    data-parallel fleet plan (batch sharded over the mesh, momentum with
+    f32 master weights)."""
+    from paddle_tpu import optimizer as popt
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.mesh import build_mesh, set_mesh
+
+    fleet._initialized = False
+    set_mesh(build_mesh())
+    try:
+        fleet.init(is_collective=True, strategy=fleet.DistributedStrategy())
+        paddle.seed(0)
+        net = M.resnet50(num_classes=4)
+        opt = fleet.distributed_optimizer(
+            popt.Momentum(learning_rate=0.01, momentum=0.9,
+                          multi_precision=True))
+        model = paddle.Model(net)
+        model.prepare(optimizer=opt, loss=nn.CrossEntropyLoss(),
+                      amp_configs="O1")
+        assert model._plan is not None and model._plan.n_data_shards == 8
+
+        rng = np.random.RandomState(0)
+        labels = rng.randint(0, 4, (16,))
+        x = rng.normal(0, 0.5, (16, 3, 64, 64)).astype(np.float32)
+        for i, y in enumerate(labels):  # separable: class tints a channel
+            x[i, int(y) % 3] += 1.0 + 0.5 * int(y)
+
+        w_before = np.asarray(net.conv1.weight.value).copy()
+        losses = [model.train_batch([x], [labels[:, None]])[0]
+                  for _ in range(3)]
+        assert all(np.isfinite(l) for l in losses), losses
+        # the step actually trained (3 steps of a fresh BN net need not
+        # decrease the loss yet — LeNet covers convergence)
+        assert not np.allclose(w_before, np.asarray(net.conv1.weight.value))
+        # AMP is engaged: conv compute runs in bf16 inside the traced step
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.amp import auto_cast
+
+        params, _ = model._pull_state()
+
+        def fwd(p):
+            with auto_cast(level="O1"):
+                return nn.functional_call(net, p, jnp.asarray(x),
+                                          training=True)
+
+        jaxpr = str(jax.make_jaxpr(fwd)(params))
+        assert "bf16" in jaxpr, "O1 autocast left no bf16 compute in the step"
+    finally:
+        fleet._initialized = False
+        fleet._strategy = None
+        set_mesh(build_mesh())
+
+
 def test_lenet_convergence_synthetic_digits():
     """Train LeNet on a synthetic separable 10-class image problem and
     assert the loss drops and accuracy rises — the BASELINE config-1 gate
