@@ -18,6 +18,7 @@ from .bert import (  # noqa: F401
     BertConfig,
     BertModel,
     BertForPretraining,
+    BertForQuestionAnswering,
     BertForSequenceClassification,
     bert_base,
     bert_tiny,

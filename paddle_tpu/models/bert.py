@@ -30,6 +30,7 @@ __all__ = [
     "BertModel",
     "BertForPretraining",
     "BertForSequenceClassification",
+    "BertForQuestionAnswering",
     "bert_base",
     "bert_tiny",
 ]
@@ -198,6 +199,42 @@ class BertForPretraining(Layer):
             jnp.asarray(nsp_labels).astype(jnp.int32).reshape(-1, 1),
             axis=-1).mean()
         return mlm_loss + nsp_loss
+
+
+class BertForQuestionAnswering(Layer):
+    """Extractive-QA (SQuAD) head: per-token start/end logits over the
+    encoder states — BASELINE config 3 (BERT-base SQuAD fine-tune)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.qa_outputs = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.qa_outputs(seq)                    # [B, S, 2]
+        start, end = logits[..., 0], logits[..., 1]      # [B, S] each
+        return start, end
+
+    @staticmethod
+    def loss(start_logits, end_logits, start_pos, end_pos):
+        """Mean of start/end cross-entropies (the SQuAD objective).
+        Positions outside the sequence — answers truncated away — are
+        remapped to ignore_index and skipped, the standard SQuAD recipe
+        (clamping them instead would train toward the last token)."""
+        from ..nn import functional as F
+
+        S = start_logits.shape[-1]
+
+        def prep(pos):
+            pos = jnp.asarray(pos, jnp.int32).reshape(-1)
+            return jnp.where((pos < 0) | (pos >= S), -100, pos)
+
+        return 0.5 * (
+            F.cross_entropy(start_logits.astype(jnp.float32),
+                            prep(start_pos))
+            + F.cross_entropy(end_logits.astype(jnp.float32),
+                              prep(end_pos)))
 
 
 class BertForSequenceClassification(Layer):

@@ -122,6 +122,60 @@ class TestBert:
             l1, _ = model.train_batch([ids], [y])
         assert l1 < l0, (l0, l1)
 
+    def test_question_answering_finetunes(self):
+        """BASELINE config 3 (SQuAD fine-tune shape): the QA head learns to
+        point start/end at a marker token's span."""
+        from paddle_tpu.models import BertForQuestionAnswering
+
+        paddle.seed(0)
+        net = BertForQuestionAnswering(bert_tiny(num_layers=1))
+        rng = np.random.RandomState(0)
+        B, S, MARK = 32, 12, 7
+        ids = rng.randint(8, 128, (B, S)).astype(np.int32)
+        starts = rng.randint(0, S - 1, (B,))
+        for i, s in enumerate(starts):
+            ids[i, s] = MARK
+            ids[i, s + 1] = MARK
+        start_pos = starts.astype(np.int64)[:, None]
+        end_pos = (starts + 1).astype(np.int64)[:, None]
+
+        model = paddle.Model(net, inputs=["ids"], labels=["s", "e"])
+        model.prepare(optimizer=popt.Adam(learning_rate=2e-3),
+                      loss=net.loss)
+        l0, _ = model.train_batch([ids], [start_pos, end_pos])
+        for _ in range(120):
+            l1, _ = model.train_batch([ids], [start_pos, end_pos])
+        assert l1 < l0 * 0.3, (l0, l1)
+        start_logits, end_logits = net(jnp.asarray(ids))
+        acc_s = (np.asarray(start_logits).argmax(-1) == starts).mean()
+        acc_e = (np.asarray(end_logits).argmax(-1) == starts + 1).mean()
+        assert acc_s > 0.8 and acc_e > 0.8, (acc_s, acc_e)
+
+    def test_qa_loss_ignores_truncated_answers(self):
+        """Positions beyond the sequence (truncated answers) must be
+        skipped, not clamped toward the last token."""
+        from paddle_tpu.models import BertForQuestionAnswering
+
+        rng = np.random.RandomState(0)
+        s_log = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+        e_log = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+        in_range = np.array([1, 2, 3, 4], np.int64)[:, None]
+        base = BertForQuestionAnswering.loss(s_log, e_log, in_range,
+                                             in_range)
+        # one example's answer truncated away → OOB position
+        oob = in_range.copy()
+        oob[0, 0] = 400
+        mixed = BertForQuestionAnswering.loss(s_log, e_log, oob, in_range)
+        assert np.isfinite(float(mixed))
+        assert float(mixed) != float(base)
+        # exact decomposition: the OOB start example is dropped from the
+        # start-CE mean; the end-CE still averages all four
+        import paddle_tpu.nn.functional as F
+
+        want = 0.5 * (float(F.cross_entropy(s_log[1:], in_range[1:]))
+                      + float(F.cross_entropy(e_log, in_range)))
+        np.testing.assert_allclose(float(mixed), want, rtol=1e-6)
+
 
 class TestTPParity:
     def test_gpt_tp_matches_single(self):
