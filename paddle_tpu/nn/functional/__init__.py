@@ -7,3 +7,5 @@ from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .attention import *  # noqa: F401,F403
 from .crf import *  # noqa: F401,F403
+from .extension import *  # noqa: F401,F403
+from ...tensor.manipulation import pad  # noqa: F401  # paddle exposes pad under nn.functional too

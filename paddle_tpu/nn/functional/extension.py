@@ -1,0 +1,56 @@
+"""Extension ops — row_conv, diag_embed.
+
+Parity: python/paddle/nn/functional/extension.py (row_conv:151,
+diag_embed) over operators/row_conv_op.cc and diag_embed_op.cc.  Both are
+data-layout ops: row_conv is the DeepSpeech2 lookahead convolution (a
+causal-in-reverse depthwise conv along time), diag_embed builds batched
+diagonal matrices.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["row_conv", "diag_embed"]
+
+
+def row_conv(input, weight, act=None, name=None):
+    """Lookahead row convolution (ref: operators/row_conv_op.cc —
+    out[t] = Σ_{j<k} x[t+j]·w[j], zero-padded at the sequence end).
+
+    input ``[B, T, D]``, weight ``[k, D]`` (k = future_context_size + 1).
+    """
+    x = jnp.asarray(input)
+    w = jnp.asarray(weight, x.dtype)
+    k = w.shape[0]
+    T = x.shape[1]
+    xp = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = sum(xp[:, j:j + T, :] * w[j] for j in range(k))
+    if act:
+        from . import activation as A
+
+        fn = getattr(A, act, None)
+        if fn is None:
+            raise ValueError(f"unsupported act {act!r}")
+        out = fn(out)
+    return out
+
+
+def diag_embed(input, offset: int = 0, dim1: int = -2, dim2: int = -1,
+               name=None):
+    """Batched diagonal-matrix construction (ref: operators/diag_embed_op):
+    ``out[..., i, i+offset] = input[..., i]`` with the two new axes placed
+    at ``dim1``/``dim2``."""
+    x = jnp.asarray(input)
+    n = x.shape[-1] + abs(offset)
+    rows = jnp.arange(x.shape[-1]) + max(-offset, 0)
+    cols = jnp.arange(x.shape[-1]) + max(offset, 0)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    out = out.at[..., rows, cols].set(x)
+    # the diagonal plane currently sits in the last two axes; move to
+    # (dim1, dim2) of the OUTPUT rank
+    ndim = out.ndim
+    d1 = dim1 % ndim
+    d2 = dim2 % ndim
+    if (d1, d2) != (ndim - 2, ndim - 1):
+        out = jnp.moveaxis(out, (ndim - 2, ndim - 1), (d1, d2))
+    return out

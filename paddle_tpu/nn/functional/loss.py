@@ -19,7 +19,7 @@ __all__ = [
     "kl_div", "smooth_l1_loss", "margin_ranking_loss", "cosine_embedding_loss",
     "hinge_embedding_loss", "triplet_margin_loss", "label_smooth",
     "square_error_cost", "log_loss", "sigmoid_focal_loss", "dice_loss",
-    "npair_loss", "cosine_similarity", "ctc_loss",
+    "npair_loss", "cosine_similarity", "ctc_loss", "hsigmoid_loss",
 ]
 
 
@@ -316,3 +316,61 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         # paddle semantics: per-sample NLL / label_length, then batch mean
         return jnp.mean(loss / jnp.maximum(jnp.asarray(label_lengths, loss.dtype), 1.0))
     return _reduce(loss, reduction)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss over a complete binary class tree
+    (ref: nn/functional/loss.py:335 over operators/hierarchical_sigmoid_op
+    + math/matrix_bit_code.h SimpleCode).
+
+    Default tree: leaf code ``c = label + num_classes``; walking bits from
+    the LSB, the (bit+1)-level parent node is ``(c >> (bit+1)) - 1`` and
+    the binary target is ``(c >> bit) & 1`` (matrix_bit_code.h:119-121).
+    Each path node is a binary logistic classifier; the loss sums their
+    BCEs.  Custom trees pass ``path_table``/``path_code`` ``[N, L]`` (−1
+    padded).  ``is_sparse`` selected a SelectedRows gradient in the
+    reference; XLA's scatter-add gather gradient covers it — accepted and
+    ignored.
+
+    input ``[N, D]``, label ``[N]`` int, weight ``[num_classes-1, D]``,
+    bias ``[num_classes-1]`` — returns ``[N, 1]``.
+    """
+    x = jnp.asarray(input)
+    y = jnp.asarray(label, jnp.int32).reshape(-1)
+    w = jnp.asarray(weight)
+    if (path_table is None) != (path_code is None):
+        raise InvalidArgumentError(
+            "path_table and path_code must be given together")
+    if path_table is not None:
+        idx = jnp.asarray(path_table, jnp.int32)          # [N, L]
+        bits = jnp.asarray(path_code, x.dtype)            # [N, L]
+        valid = idx >= 0
+        idx = jnp.where(valid, idx, 0)
+    else:
+        import math as _math
+
+        c = y.astype(jnp.int64) + jnp.int64(num_classes)  # [N]
+        L = int(_math.ceil(_math.log2(max(num_classes, 2)))) + 1
+        j = jnp.arange(L, dtype=jnp.int64)[None, :]       # [1, L]
+        # get_length = FindLastSet(c) - 1, in exact INTEGER arithmetic:
+        # floor(log2 c) = #{k >= 1 : 2^k <= c} (a float32 log2 rounds
+        # wrong near powers of two once num_classes is large — the very
+        # regime hierarchical softmax exists for)
+        length = jnp.sum(
+            c[:, None] >= (jnp.int64(1) << jnp.arange(1, L + 1,
+                                                      dtype=jnp.int64))[None],
+            axis=1, dtype=jnp.int64)[:, None]
+        valid = j < length
+        idx = jnp.where(valid, (c[:, None] >> (j + 1)) - 1, 0)
+        bits = ((c[:, None] >> j) & 1).astype(x.dtype)
+    w_path = jnp.take(w, idx, axis=0)                     # [N, L, D]
+    logits = jnp.einsum("nld,nd->nl", w_path.astype(x.dtype), x)
+    if bias is not None:
+        logits = logits + jnp.take(
+            jnp.asarray(bias, x.dtype).reshape(-1), idx, axis=0)
+    per_node = binary_cross_entropy_with_logits(logits, bits,
+                                                reduction="none")
+    per_node = jnp.where(valid, per_node, 0.0)
+    return per_node.sum(axis=1, keepdims=True)

@@ -15,6 +15,7 @@ from .logic import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .attribute import *  # noqa: F401,F403
+from .to_string import *  # noqa: F401,F403
 
 from . import creation, math, manipulation, linalg, logic, random, search, stat, attribute  # noqa: F401
 

@@ -16,7 +16,7 @@ from ..framework import dtype as _dt
 
 __all__ = [
     # elementwise binary
-    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "add", "add_n", "addcmul", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
     "pow", "maximum", "minimum", "fmax", "fmin", "atan2", "logaddexp",
     "heaviside", "gcd", "lcm", "hypot", "copysign", "nextafter", "ldexp",
     # elementwise unary
@@ -546,3 +546,17 @@ def take(x, index, mode="raise", name=None):
         idx = jnp.clip(idx, -x.shape[0], x.shape[0] - 1)
     idx = jnp.where(idx < 0, idx + x.shape[0], idx)
     return x[idx]
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list (ref: tensor/math.py:721 sum op)."""
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    out = jnp.asarray(inputs[0])
+    for t in inputs[1:]:
+        out = out + jnp.asarray(t)
+    return out
+
+
+def addcmul(input, tensor1, tensor2, value=1.0, name=None):
+    """out = input + value * tensor1 * tensor2 (ref: tensor/math.py:1318)."""
+    return jnp.asarray(input) + value * jnp.asarray(tensor1) * jnp.asarray(tensor2)

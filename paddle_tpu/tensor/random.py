@@ -18,7 +18,7 @@ from ..framework import dtype as _dt
 from ..framework.random import split_key
 
 __all__ = [
-    "uniform", "rand", "randn", "normal", "standard_normal", "randint",
+    "uniform", "rand", "randn", "normal", "gaussian", "standard_normal", "randint",
     "randint_like", "randperm", "multinomial", "bernoulli", "poisson",
     "exponential", "uniform_", "normal_",
 ]
@@ -113,3 +113,11 @@ def uniform_(x, min=-1.0, max=1.0, key=None):
 def normal_(x, mean=0.0, std=1.0, key=None):
     x = jnp.asarray(x)
     return jax.random.normal(split_key(key), x.shape, dtype=x.dtype) * std + mean
+
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, name=None, key=None):
+    """Gaussian-distributed random tensor (ref: tensor/random.py:155 over
+    gaussian_random_op.cc) — samples IN the requested dtype (casting a
+    f32 draw would give f32 tail resolution in a f64 output)."""
+    z = jax.random.normal(split_key(key), tuple(shape), dtype=_dtype(dtype))
+    return z * std + mean
+

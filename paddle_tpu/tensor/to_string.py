@@ -1,0 +1,63 @@
+"""Tensor printing — set_printoptions / to_string.
+
+Parity: python/paddle/tensor/to_string.py (print options held in a
+DEFAULT_PRINT_OPTIONS struct consumed by _to_summary).  Arrays here ARE
+jax arrays whose repr goes through numpy, so the options map onto
+numpy's printoptions.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["set_printoptions", "to_string"]
+
+
+@dataclass
+class _PrintOptions:
+    precision: int = 8
+    threshold: int = 1000
+    edgeitems: int = 3
+    sci_mode: bool = False
+    linewidth: int = 80
+
+
+_options = _PrintOptions()
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Configure tensor formatting (ref: to_string.py set_printoptions).
+
+    Applies to numpy's GLOBAL printoptions too: tensors here are jax
+    arrays whose ``repr``/``print`` go through numpy, so this is what
+    makes ``print(tensor)`` honor the options — not just ``to_string``.
+    """
+    if precision is not None:
+        _options.precision = int(precision)
+    if threshold is not None:
+        _options.threshold = int(threshold)
+    if edgeitems is not None:
+        _options.edgeitems = int(edgeitems)
+    if sci_mode is not None:
+        _options.sci_mode = bool(sci_mode)
+    if linewidth is not None:
+        _options.linewidth = int(linewidth)
+    np.set_printoptions(precision=_options.precision,
+                        threshold=_options.threshold,
+                        edgeitems=_options.edgeitems,
+                        linewidth=_options.linewidth,
+                        suppress=not _options.sci_mode)
+
+
+def to_string(x, prefix="Tensor"):
+    arr = np.asarray(x)
+    with np.printoptions(precision=_options.precision,
+                         threshold=_options.threshold,
+                         edgeitems=_options.edgeitems,
+                         linewidth=_options.linewidth,
+                         suppress=not _options.sci_mode):
+        body = np.array2string(arr, separator=", ")
+    return (f"{prefix}(shape={list(arr.shape)}, dtype={arr.dtype},\n"
+            f"       {body})")

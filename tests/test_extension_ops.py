@@ -1,0 +1,185 @@
+"""row_conv, diag_embed, hsigmoid_loss + small tensor utilities.
+
+Reference capability: nn/functional/extension.py:151 (row_conv),
+diag_embed_op, hierarchical_sigmoid_op + matrix_bit_code.h (hsigmoid),
+tensor/math.py add_n/addcmul, tensor/random.py gaussian,
+tensor/to_string.py printoptions.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn.functional import diag_embed, hsigmoid_loss, row_conv
+
+
+class TestRowConv:
+    def test_matches_loop_oracle(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 6, 3).astype(np.float32)
+        w = rng.randn(4, 3).astype(np.float32)
+        got = np.asarray(row_conv(x, w))
+        want = np.zeros_like(x)
+        for t in range(6):
+            for j in range(4):
+                if t + j < 6:
+                    want[:, t] += x[:, t + j] * w[j]
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_act(self):
+        x = np.ones((1, 2, 2), np.float32)
+        w = np.ones((1, 2), np.float32)
+        out = np.asarray(row_conv(x, w, act="sigmoid"))
+        np.testing.assert_allclose(out, 1 / (1 + np.exp(-1.0)), rtol=1e-6)
+
+
+class TestDiagEmbed:
+    def test_basic(self):
+        x = np.array([[1.0, 2.0, 3.0]], np.float32)
+        out = np.asarray(diag_embed(x))
+        assert out.shape == (1, 3, 3)
+        np.testing.assert_allclose(out[0], np.diag([1.0, 2.0, 3.0]))
+
+    @pytest.mark.parametrize("offset", [-2, -1, 1, 2])
+    def test_offsets(self, offset):
+        x = np.arange(1.0, 4.0, dtype=np.float32)
+        out = np.asarray(diag_embed(x, offset=offset))
+        np.testing.assert_allclose(out, np.diag(x, k=offset))
+
+    def test_dims(self):
+        x = np.ones((2, 3), np.float32)
+        out = diag_embed(x, dim1=0, dim2=2)
+        assert out.shape == (3, 2, 3)
+
+
+class TestHSigmoid:
+    @staticmethod
+    def _oracle(x, y, C, w, b):
+        """Walk the SimpleCode path per sample (matrix_bit_code.h:119)."""
+        out = np.zeros((x.shape[0], 1))
+        for n in range(x.shape[0]):
+            c = int(y[n]) + C
+            length = c.bit_length() - 1
+            for bit in range(length):
+                idx = (c >> (bit + 1)) - 1
+                t = float((c >> bit) & 1)
+                z = float(w[idx] @ x[n] + (b[idx] if b is not None else 0.0))
+                p = 1.0 / (1.0 + math.exp(-z))
+                out[n, 0] -= t * math.log(p) + (1 - t) * math.log(1 - p)
+        return out
+
+    def test_matches_path_oracle(self):
+        rng = np.random.RandomState(0)
+        N, D, C = 8, 5, 7
+        x = rng.randn(N, D).astype(np.float32)
+        y = rng.randint(0, C, (N,))
+        w = 0.3 * rng.randn(C - 1, D).astype(np.float32)
+        b = 0.1 * rng.randn(C - 1).astype(np.float32)
+        got = np.asarray(hsigmoid_loss(x, y, C, w, b))
+        np.testing.assert_allclose(got, self._oracle(x, y, C, w, b),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_no_bias_and_pow2_classes(self):
+        rng = np.random.RandomState(1)
+        N, D, C = 6, 4, 8
+        x = rng.randn(N, D).astype(np.float32)
+        y = rng.randint(0, C, (N,))
+        w = 0.3 * rng.randn(C - 1, D).astype(np.float32)
+        got = np.asarray(hsigmoid_loss(x, y, C, w))
+        np.testing.assert_allclose(got, self._oracle(x, y, C, w, None),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_custom_path(self):
+        """path_table/path_code mode reproduces the default tree when fed
+        the same codes."""
+        rng = np.random.RandomState(2)
+        N, D, C = 5, 4, 6
+        x = rng.randn(N, D).astype(np.float32)
+        y = rng.randint(0, C, (N,))
+        w = 0.3 * rng.randn(C - 1, D).astype(np.float32)
+        L = max(int(y_n + C).bit_length() - 1 for y_n in y)
+        table = -np.ones((N, L), np.int32)
+        code = np.zeros((N, L), np.float32)
+        for n in range(N):
+            c = int(y[n]) + C
+            for bit in range(c.bit_length() - 1):
+                table[n, bit] = (c >> (bit + 1)) - 1
+                code[n, bit] = (c >> bit) & 1
+        got = np.asarray(hsigmoid_loss(x, y, C, w, path_table=table,
+                                       path_code=code))
+        want = np.asarray(hsigmoid_loss(x, y, C, w))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_large_num_classes_exact_bit_length(self):
+        """Near powers of two a float32 log2 rounds the path length up —
+        the integer bit-length must stay exact (the large-vocab regime is
+        what hierarchical softmax exists for)."""
+        C = 1 << 20
+        rng = np.random.RandomState(4)
+        N, D = 2, 4
+        x = rng.randn(N, D).astype(np.float32)
+        y = np.array([C - 1, 0])  # c = 2^21 - 1 (float32 log2 → 21.0) and 2^20
+        w = np.zeros((C - 1, D), np.float32)
+        # put recognizable weights on the true path nodes only
+        for n in range(N):
+            c = int(y[n]) + C
+            for bit in range(c.bit_length() - 1):
+                w[(c >> (bit + 1)) - 1] = rng.randn(D)
+        got = np.asarray(hsigmoid_loss(x, y, C, w))
+        np.testing.assert_allclose(got, self._oracle(x, y, C, w, None),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_trains(self):
+        """hsigmoid as an LM head: gradient descent drives the loss down
+        and the implied class scores identify the gold class."""
+        rng = np.random.RandomState(3)
+        N, D, C = 64, 12, 10
+        y = rng.randint(0, C, (N,))
+        x = np.eye(C, D, dtype=np.float32)[y] + \
+            0.1 * rng.randn(N, D).astype(np.float32)
+        w = jnp.asarray(0.1 * rng.randn(C - 1, D).astype(np.float32))
+        b = jnp.zeros((C - 1,))
+
+        def loss(w, b):
+            return hsigmoid_loss(x, y, C, w, b).mean()
+
+        l0 = float(loss(w, b))
+        step = jax.jit(lambda w, b: tuple(
+            p - 0.5 * g for p, g in zip((w, b), jax.grad(loss, (0, 1))(w, b))))
+        for _ in range(150):
+            w, b = step(w, b)
+        assert float(loss(w, b)) < l0 * 0.3
+
+
+class TestTensorUtilities:
+    def test_add_n(self):
+        a, b, c = (np.full((2, 2), v, np.float32) for v in (1, 2, 3))
+        np.testing.assert_allclose(np.asarray(paddle.add_n([a, b, c])), 6.0)
+        np.testing.assert_allclose(np.asarray(paddle.add_n(a)), 1.0)
+
+    def test_addcmul(self):
+        x = np.ones((2,), np.float32)
+        np.testing.assert_allclose(
+            np.asarray(paddle.addcmul(x, 2 * x, 3 * x, value=0.5)), 4.0)
+
+    def test_gaussian(self):
+        paddle.seed(0)
+        a = paddle.gaussian([1000], mean=2.0, std=0.5)
+        assert abs(float(a.mean()) - 2.0) < 0.1
+        assert abs(float(np.asarray(a).std()) - 0.5) < 0.1
+        assert paddle.gaussian([2], dtype="float64").dtype == jnp.float64
+
+    def test_printoptions_and_to_string(self):
+        try:
+            paddle.set_printoptions(precision=2, threshold=5)
+            s = paddle.to_string(np.array([1.23456, 2.34567]))
+            assert "1.23" in s and "1.2346" not in s
+            assert "shape=[2]" in s
+            # print(tensor) goes through numpy's global options — they
+            # must be affected too (the reference's primary use)
+            assert "1.23" in repr(np.array([1.23456]))
+        finally:
+            paddle.set_printoptions(precision=8, threshold=1000)
