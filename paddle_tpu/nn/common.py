@@ -15,7 +15,7 @@ __all__ = [
     "AlphaDropout", "Flatten", "Unflatten", "Upsample", "UpsamplingBilinear2D",
     "UpsamplingNearest2D", "Pad1D", "Pad2D", "Pad3D", "ZeroPad2D",
     "CosineSimilarity", "Bilinear", "PixelShuffle", "PixelUnshuffle",
-    "ChannelShuffle", "Fold", "Unfold",
+    "ChannelShuffle", "Fold", "Unfold", "PairwiseDistance", "RowConv",
 ]
 
 
@@ -260,3 +260,35 @@ class Unfold(Layer):
 
     def forward(self, x):
         return F.unfold(x, *self.args)
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance between paired rows (ref: nn/layer/distance.py:24
+    PairwiseDistance over the p_norm op)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        from ..tensor.linalg import norm
+
+        d = jnp.asarray(x) - jnp.asarray(y) + self.epsilon
+        return norm(d, p=self.p, axis=-1, keepdim=self.keepdim)
+
+
+class RowConv(Layer):
+    """Lookahead row convolution layer (ref: nn/layer/extension RowConv
+    over operators/row_conv_op.cc); weight [future_context_size+1, D]."""
+
+    def __init__(self, num_channels, future_context_size, activation=None,
+                 param_attr=None, name=None):
+        super().__init__()
+        self.activation = activation
+        self.weight = self.create_parameter(
+            [future_context_size + 1, num_channels], attr=param_attr)
+
+    def forward(self, x):
+        return F.row_conv(x, self.weight.value, act=self.activation)

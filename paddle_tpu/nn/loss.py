@@ -8,7 +8,7 @@ __all__ = [
     "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
     "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss", "MarginRankingLoss",
     "CosineEmbeddingLoss", "HingeEmbeddingLoss", "TripletMarginLoss",
-    "CTCLoss",
+    "CTCLoss", "HSigmoidLoss",
 ]
 
 
@@ -152,3 +152,39 @@ class CTCLoss(Layer):
     def forward(self, log_probs, labels, input_lengths, label_lengths, norm_by_times=False):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
                           self.blank, self.reduction, norm_by_times)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer (ref: nn/layer/loss.py HSigmoidLoss
+    over hierarchical_sigmoid_op) — owns the [num_classes-1, feature_size]
+    path-classifier weights; see F.hsigmoid_loss for the tree coding."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if num_classes < 2:
+            from ..framework.errors import InvalidArgumentError
+
+            raise InvalidArgumentError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        # reference sizing (nn/layer/loss.py): a custom tree may address
+        # node ids up to num_classes-1, a default complete tree has
+        # num_classes-1 internal nodes
+        n_nodes = num_classes if is_custom else num_classes - 1
+        self.weight = self.create_parameter(
+            [n_nodes, feature_size], attr=weight_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([n_nodes], attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        if self.is_custom and (path_table is None or path_code is None):
+            from ..framework.errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                "is_custom=True needs path_table and path_code")
+        return F.hsigmoid_loss(input, label, self.num_classes,
+                               self.weight.value,
+                               None if self.bias is None else self.bias.value,
+                               path_table=path_table, path_code=path_code)

@@ -22,7 +22,7 @@ from . import initializer as I
 from .layer_base import Layer
 
 __all__ = [
-    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN", "RNNBase",
     "SimpleRNN", "LSTM", "GRU",
 ]
 
@@ -210,14 +210,34 @@ class BiRNN(Layer):
         return jnp.concatenate([o1, o2], axis=-1), (s1, s2)
 
 
-class _RNNBase(Layer):
-    _cell_cls = SimpleRNNCell
+#: mode string → cell class (reference nn/layer/rnn.py RNNBase modes)
+_RNN_MODES = {
+    "LSTM": LSTMCell,
+    "GRU": GRUCell,
+    "RNN_TANH": SimpleRNNCell,
+    "RNN_RELU": SimpleRNNCell,
+}
 
-    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
-                 time_major=False, dropout=0.0, activation="tanh",
-                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
-                 bias_hh_attr=None, name=None):
+
+class RNNBase(Layer):
+    """Shared multi-layer/bidirectional RNN driver (reference:
+    nn/layer/rnn.py RNNBase) — the first argument selects the cell mode;
+    SimpleRNN/LSTM/GRU subclass this with their mode pinned."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation=None, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
         super().__init__()
+        if mode not in _RNN_MODES:
+            from ..framework.errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                f"mode must be one of {sorted(_RNN_MODES)}, got {mode!r}")
+        self._mode = mode
+        self._cell_cls = _RNN_MODES[mode]
+        if activation is None:
+            activation = "relu" if mode == "RNN_RELU" else "tanh"
         self.input_size = input_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -288,15 +308,27 @@ class _RNNBase(Layer):
         return out, h
 
 
-class SimpleRNN(_RNNBase):
-    _cell_cls = SimpleRNNCell
+class SimpleRNN(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        mode = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(mode, input_size, hidden_size, num_layers,
+                         direction, time_major, dropout,
+                         activation=activation, **kw)
 
 
-class LSTM(_RNNBase):
+class LSTM(RNNBase):
     """Parity: paddle.nn.LSTM (ref: operators/cudnn_lstm_op.cu → lax.scan)."""
 
-    _cell_cls = LSTMCell
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
 
 
-class GRU(_RNNBase):
-    _cell_cls = GRUCell
+class GRU(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)

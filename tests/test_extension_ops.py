@@ -154,6 +154,92 @@ class TestHSigmoid:
         assert float(loss(w, b)) < l0 * 0.3
 
 
+class TestLayers:
+    def test_pairwise_distance(self):
+        from paddle_tpu import nn
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 6).astype(np.float32)
+        y = rng.randn(4, 6).astype(np.float32)
+        d = np.asarray(nn.PairwiseDistance(p=2.0, epsilon=0.0)(x, y))
+        np.testing.assert_allclose(d, np.linalg.norm(x - y, axis=-1),
+                                   rtol=1e-5)
+        d1 = np.asarray(nn.PairwiseDistance(p=1.0, epsilon=0.0,
+                                            keepdim=True)(x, y))
+        assert d1.shape == (4, 1)
+        np.testing.assert_allclose(
+            d1[:, 0], np.abs(x - y).sum(-1), rtol=1e-5)
+
+    def test_row_conv_layer(self):
+        from paddle_tpu import nn
+
+        paddle.seed(0)
+        layer = nn.RowConv(num_channels=3, future_context_size=2,
+                           activation="relu")
+        out = layer(np.ones((2, 5, 3), np.float32))
+        assert out.shape == (2, 5, 3)
+        want = row_conv(np.ones((2, 5, 3), np.float32),
+                        np.asarray(layer.weight.value), act="relu")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want))
+
+    def test_hsigmoid_layer_trains(self):
+        from paddle_tpu import nn
+        from paddle_tpu import optimizer as popt
+
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        N, D, C = 32, 12, 10
+        y = rng.randint(0, C, (N,))
+        x = np.eye(C, D, dtype=np.float32)[y] + \
+            0.1 * rng.randn(N, D).astype(np.float32)
+        layer = nn.HSigmoidLoss(feature_size=D, num_classes=C)
+        m = paddle.Model(layer, inputs=["x", "y"], labels=[])
+        m.prepare(optimizer=popt.Adam(learning_rate=0.1),
+                  loss=lambda out: out.mean())
+        l0 = m.train_batch([x, y], [])[0]
+        for _ in range(60):
+            l1 = m.train_batch([x, y], [])[0]
+        assert l1 < l0 * 0.5, (l0, l1)
+
+    def test_rnn_base_alias(self):
+        from paddle_tpu import nn
+
+        assert issubclass(nn.LSTM, nn.RNNBase)
+
+    def test_rnn_base_mode_constructor(self):
+        """Reference signature RNNBase(mode, input_size, hidden_size)."""
+        import jax.numpy as jnp
+        from paddle_tpu import nn
+        from paddle_tpu.framework.errors import InvalidArgumentError
+
+        paddle.seed(0)
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 5, 4),
+                        jnp.float32)
+        out, (h, c) = nn.RNNBase("LSTM", 4, 8)(x)
+        assert out.shape == (2, 5, 8) and h.shape == c.shape == (1, 2, 8)
+        out, h = nn.RNNBase("GRU", 4, 8)(x)
+        assert out.shape == (2, 5, 8)
+        with pytest.raises(InvalidArgumentError, match="mode"):
+            nn.RNNBase("FOO", 4, 8)
+
+    def test_hsigmoid_custom_tree_full_weight_rows(self):
+        """is_custom=True sizes weights [num_classes, D] — a custom tree
+        may address node id num_classes-1 (reference nn/layer/loss.py)."""
+        from paddle_tpu import nn
+
+        paddle.seed(0)
+        C, D = 6, 4
+        layer = nn.HSigmoidLoss(D, C, is_custom=True)
+        assert layer.weight.value.shape == (C, D)
+        table = np.full((2, 3), C - 1, np.int32)  # max node id everywhere
+        code = np.ones((2, 3), np.float32)
+        out = layer(np.random.RandomState(0).randn(2, D).astype(np.float32),
+                    np.zeros(2, np.int64), path_table=table, path_code=code)
+        assert np.isfinite(np.asarray(out)).all()
+        with pytest.raises(Exception, match="path_table"):
+            layer(np.zeros((2, D), np.float32), np.zeros(2, np.int64))
+
+
 class TestTensorUtilities:
     def test_add_n(self):
         a, b, c = (np.full((2, 2), v, np.float32) for v in (1, 2, 3))
