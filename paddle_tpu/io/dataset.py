@@ -13,6 +13,11 @@ import numpy as np
 
 from ..framework.errors import InvalidArgumentError
 
+#: the one on-disk cache root every dataset family shares (text + vision)
+import os
+
+DEFAULT_DATA_ROOT = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+
 __all__ = [
     "Dataset",
     "IterableDataset",

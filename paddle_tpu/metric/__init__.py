@@ -13,7 +13,7 @@ import numpy as np
 
 from ..framework.errors import InvalidArgumentError
 
-__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
 
 
 class Metric:
@@ -190,3 +190,17 @@ class Auc(Metric):
 
     def name(self):
         return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (ref: metric/metrics.py:742 accuracy op):
+    the fraction of rows whose true label appears in the top-k logits.
+    ``correct``/``total`` were in-place accumulators in the reference —
+    accepted and ignored (use the Accuracy Metric for accumulation)."""
+    import jax.numpy as jnp
+
+    logits = jnp.asarray(input)
+    y = jnp.asarray(label).reshape(logits.shape[0], -1)[:, :1]
+    topk = jnp.argsort(-logits, axis=-1)[:, :k]
+    hit = (topk == y).any(axis=-1)
+    return hit.astype(logits.dtype).mean()

@@ -5,7 +5,7 @@ import os
 
 from ...framework.errors import NotFoundError
 
-_DEFAULT_ROOT = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+from ...io.dataset import DEFAULT_DATA_ROOT as _DEFAULT_ROOT
 
 
 def resolve_data_file(data_file, name: str, filename: str, url_hint: str,

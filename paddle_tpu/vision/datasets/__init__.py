@@ -1,7 +1,16 @@
 """Vision datasets (parity: python/paddle/vision/datasets/__init__.py)."""
 from .cifar import Cifar10, Cifar100  # noqa: F401
-from .folder import DatasetFolder, ImageFolder  # noqa: F401
+from .folder import (  # noqa: F401
+    DatasetFolder,
+    ImageFolder,
+    cv2_loader,
+    default_loader,
+    pil_loader,
+)
+from .flowers import Flowers  # noqa: F401
+from .voc2012 import VOC2012  # noqa: F401
 from .mnist import MNIST, FashionMNIST  # noqa: F401
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
-           "ImageFolder"]
+           "ImageFolder", "Flowers", "VOC2012", "pil_loader", "cv2_loader",
+           "default_loader"]

@@ -15,7 +15,7 @@ from ...io import Dataset
 
 __all__ = ["Cifar10", "Cifar100"]
 
-_DEFAULT_ROOT = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+from ...io.dataset import DEFAULT_DATA_ROOT as _DEFAULT_ROOT
 
 
 class Cifar10(Dataset):

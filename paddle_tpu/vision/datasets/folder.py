@@ -13,7 +13,7 @@ import numpy as np
 from ...io import Dataset
 from ..image import image_load
 
-__all__ = ["DatasetFolder", "ImageFolder"]
+__all__ = ["DatasetFolder", "ImageFolder", "pil_loader", "cv2_loader", "default_loader"]
 
 IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
                   ".tiff", ".webp")
@@ -23,11 +23,31 @@ def has_valid_extension(filename, extensions=IMG_EXTENSIONS):
     return filename.lower().endswith(tuple(extensions))
 
 
-def _default_loader(path):
+def pil_loader(path):
+    """Reference folder.py pil_loader — a PIL RGB image."""
+    from PIL import Image
+
+    with open(path, "rb") as f:
+        return Image.open(f).convert("RGB")
+
+
+def cv2_loader(path):
+    """Reference folder.py cv2_loader — cv2.imread, i.e. an HWC **BGR**
+    ndarray (matching image_load's cv2 backend; pil_loader is RGB)."""
+    import cv2
+
+    return cv2.imread(path)
+
+
+def default_loader(path):
+    """Reference folder.py default_loader: backend-dispatched read."""
     img = image_load(path)
     if hasattr(img, "convert"):
         img = img.convert("RGB")
     return np.asarray(img)
+
+
+_default_loader = default_loader
 
 
 def make_dataset(directory, class_to_idx, extensions=None, is_valid_file=None):

@@ -17,7 +17,7 @@ from ...io import Dataset
 
 __all__ = ["MNIST", "FashionMNIST"]
 
-_DEFAULT_ROOT = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+from ...io.dataset import DEFAULT_DATA_ROOT as _DEFAULT_ROOT
 
 
 def _read_idx_images(path):

@@ -1,0 +1,161 @@
+"""Flowers / VOC2012 datasets, folder loaders, metric.accuracy.
+
+Reference capability: vision/datasets/flowers.py:43, voc2012.py:41,
+folder.py loaders, metric/metrics.py:742 — fixtures synthesize the real
+archive layouts (tgz of jpgs + .mat labels; VOCdevkit tar).
+"""
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.datasets import (
+    VOC2012,
+    Flowers,
+    cv2_loader,
+    default_loader,
+    pil_loader,
+)
+
+
+def _jpg_bytes(color, size=(8, 8)):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", size, color).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def _png_bytes(value, size=(8, 8)):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("L", size, value).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _add(tar, name, blob):
+    info = tarfile.TarInfo(name)
+    info.size = len(blob)
+    tar.addfile(info, io.BytesIO(blob))
+
+
+class TestFlowers:
+    @pytest.fixture
+    def files(self, tmp_path):
+        import scipy.io as scio
+
+        data = os.path.join(tmp_path, "102flowers.tgz")
+        with tarfile.open(data, "w:gz") as t:
+            for i in range(1, 7):
+                _add(t, "jpg/image_%05d.jpg" % i,
+                     _jpg_bytes((i * 30 % 255, 0, 0)))
+        labels = os.path.join(tmp_path, "imagelabels.mat")
+        scio.savemat(labels, {"labels": np.array([[1, 2, 3, 1, 2, 3]])})
+        setid = os.path.join(tmp_path, "setid.mat")
+        scio.savemat(setid, {"trnid": np.array([[1, 2, 3, 4]]),
+                             "valid": np.array([[5]]),
+                             "tstid": np.array([[6]])})
+        return data, labels, setid
+
+    def test_splits_and_samples(self, files):
+        data, labels, setid = files
+        train = Flowers(data_file=data, label_file=labels, setid_file=setid,
+                        mode="train")
+        assert len(train) == 4
+        img, y = train[0]
+        assert img.shape == (8, 8, 3) and int(y) == 0  # label 1 → 0-based
+        test = Flowers(data_file=data, label_file=labels, setid_file=setid,
+                       mode="test")
+        assert len(test) == 1 and int(test[0][1]) == 2
+
+    def test_transform_and_missing(self, files, tmp_path):
+        data, labels, setid = files
+        ds = Flowers(data_file=data, label_file=labels, setid_file=setid,
+                     mode="valid", transform=lambda im: im.mean())
+        assert np.isscalar(ds[0][0]) or ds[0][0].shape == ()
+        with pytest.raises(FileNotFoundError, match="egress"):
+            Flowers(data_file=os.path.join(tmp_path, "nope.tgz"),
+                    label_file=labels, setid_file=setid)
+        with pytest.raises(ValueError, match="backend"):
+            Flowers(data_file=data, label_file=labels, setid_file=setid,
+                    backend="CV2")
+
+    def test_pickles_for_dataloader_workers(self, files):
+        """Tar handles open lazily per process — the dataset must pickle
+        (DataLoader num_workers>0 ships it to spawn workers)."""
+        import pickle
+
+        data, labels, setid = files
+        ds = Flowers(data_file=data, label_file=labels, setid_file=setid,
+                     mode="train")
+        _ = ds[0]  # force the tar open in THIS process
+        clone = pickle.loads(pickle.dumps(ds))
+        img, y = clone[0]
+        assert img.shape == (8, 8, 3)
+
+        from paddle_tpu.io import DataLoader
+
+        loader = DataLoader(ds, batch_size=2, num_workers=2,
+                            drop_last=True)
+        batch = next(iter(loader))
+        assert batch[0].shape[0] == 2
+
+
+class TestVOC2012:
+    @pytest.fixture
+    def archive(self, tmp_path):
+        p = os.path.join(tmp_path, "VOCtrainval_11-May-2012.tar")
+        names = ["2007_000001", "2007_000002"]
+        with tarfile.open(p, "w") as t:
+            _add(t, "VOCdevkit/VOC2012/ImageSets/Segmentation/trainval.txt",
+                 "\n".join(names).encode())
+            _add(t, "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt",
+                 names[0].encode())
+            _add(t, "VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt",
+                 names[1].encode())
+            for n in names:
+                _add(t, f"VOCdevkit/VOC2012/JPEGImages/{n}.jpg",
+                     _jpg_bytes((0, 128, 0)))
+                _add(t, f"VOCdevkit/VOC2012/SegmentationClass/{n}.png",
+                     _png_bytes(7))
+        return p
+
+    def test_modes_and_samples(self, archive):
+        ds = VOC2012(data_file=archive, mode="train")
+        assert len(ds) == 2
+        img, mask = ds[0]
+        assert img.shape == (8, 8, 3)
+        assert mask.shape == (8, 8) and (np.asarray(mask) == 7).all()
+        assert len(VOC2012(data_file=archive, mode="test")) == 1
+        assert len(VOC2012(data_file=archive, mode="valid")) == 1
+
+
+class TestLoaders:
+    def test_three_loaders(self, tmp_path):
+        p = os.path.join(tmp_path, "x.jpg")
+        with open(p, "wb") as f:
+            f.write(_jpg_bytes((10, 120, 230), size=(4, 4)))
+        pil = pil_loader(p)
+        assert hasattr(pil, "convert")  # a PIL image
+        arr = cv2_loader(p)
+        assert arr.shape == (4, 4, 3)
+        # cv2.imread convention: BGR — channel-reversed vs the PIL read
+        np.testing.assert_array_equal(arr, np.asarray(pil)[..., ::-1])
+        np.testing.assert_array_equal(default_loader(p), np.asarray(pil))
+
+
+class TestAccuracyFunctional:
+    def test_topk(self):
+        logits = np.array([[0.1, 0.9, 0.0],
+                           [0.8, 0.1, 0.1],
+                           [0.3, 0.3, 0.4]], np.float32)
+        y = np.array([[1], [2], [2]])
+        assert float(paddle.metric.accuracy(logits, y, k=1)) == \
+            pytest.approx(2 / 3)
+        assert float(paddle.metric.accuracy(logits, y, k=2)) == \
+            pytest.approx(2 / 3)
+        assert float(paddle.metric.accuracy(logits, y, k=3)) == 1.0
