@@ -123,3 +123,63 @@ def to_variable(data, **kwargs):
     from .tensor.creation import to_tensor
 
     return to_tensor(data, **kwargs)
+
+
+def in_dygraph_mode() -> bool:
+    """Parity: paddle.in_dygraph_mode — this framework has ONE runtime
+    (eager trace-to-XLA), so it is always 'dygraph'."""
+    return True
+
+
+def enable_dygraph(place=None):
+    """Parity no-op: there is no static Program mode to leave."""
+
+
+def disable_dygraph():
+    """Parity no-op kept for source compatibility; the single-runtime
+    design has no static Program mode to enter (jaxpr replaces Program —
+    see SURVEY §7)."""
+
+
+def is_compiled_with_xpu() -> bool:
+    """Parity: paddle.is_compiled_with_xpu — no Kunlun backend here."""
+    return False
+
+
+def floor_mod(x, y, name=None):
+    """Parity alias of mod (ref: tensor/math.py floor_mod == elementwise_mod)."""
+    from .tensor.math import mod
+
+    return mod(x, y)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """Legacy alias of tensor.crop (ref: fluid/layers/nn.py crop_tensor)."""
+    from .tensor.manipulation import crop
+
+    return crop(x, shape=shape, offsets=offsets)
+
+
+def create_parameter(shape, dtype=None, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone Parameter creation (ref: fluid/layers/tensor.py:75
+    create_parameter) — a Parameter box outside any Layer, usable with
+    ``optimizer(parameters=[...])`` and the eager step flow.  Shares
+    ParamAttr handling (initializer precedence, trainable, session
+    default dtype) with Layer.create_parameter via build_parameter."""
+    from .nn.layer_base import build_parameter
+
+    p = build_parameter(shape, dtype, attr, is_bias, default_initializer)
+    if name and not p.name:
+        p.name = name
+    return p
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Parity: paddle.summary — delegates to Model.summary.  The table is
+    derived from the network's parameters, so ``input_size``/``dtypes``/
+    ``input`` are accepted for source compatibility but not needed (no
+    shape propagation pass exists — there is no static graph to walk)."""
+    from .hapi.model import Model as _Model
+
+    return _Model(net).summary(input_size=input_size, dtype=dtypes)

@@ -170,6 +170,30 @@ def current_rng_key() -> jax.Array:
 # ---------------------------------------------------------------------------
 # Layer
 # ---------------------------------------------------------------------------
+def build_parameter(shape, dtype=None, attr=None, is_bias=False,
+                    default_initializer=None) -> "Parameter":
+    """Create a Parameter box from ParamAttr semantics — shared by
+    Layer.create_parameter and the top-level paddle.create_parameter
+    (ref: fluid/layers/tensor.py:75), so initializer precedence, dtype
+    defaulting, and the trainable flag cannot drift between the two."""
+    from . import initializer as I
+    from ..framework import dtype as _dt
+
+    dtype = _dt.convert_dtype(dtype or _dt.get_default_dtype())
+    init = None
+    name = None
+    trainable = True
+    if attr is not None and attr is not False:
+        init = getattr(attr, "initializer", None)
+        name = getattr(attr, "name", None)
+        trainable = getattr(attr, "trainable", True)
+    if init is None:
+        init = default_initializer or (
+            I.Constant(0.0) if is_bias else I.XavierNormal())
+    value = init(tuple(shape), dtype, key=_random.default_generator().next_key())
+    return Parameter(value, name=name or "", trainable=trainable)
+
+
 class Layer:
     """Parity: paddle.nn.Layer (python/paddle/fluid/dygraph/layers.py).
 
@@ -257,21 +281,8 @@ class Layer:
                          default_initializer=None):
         """Parity: Layer.create_parameter (dygraph/layers.py). Uses ParamAttr
         semantics from paddle.ParamAttr."""
-        from . import initializer as I
-        from ..framework import dtype as _dt
-
-        dtype = _dt.convert_dtype(dtype or self._dtype)
-        init = None
-        name = None
-        trainable = True
-        if attr is not None and attr is not False:
-            init = getattr(attr, "initializer", None)
-            name = getattr(attr, "name", None)
-            trainable = getattr(attr, "trainable", True)
-        if init is None:
-            init = default_initializer or (I.Constant(0.0) if is_bias else I.XavierNormal())
-        value = init(tuple(shape), dtype, key=_random.default_generator().next_key())
-        return Parameter(value, name=name or "", trainable=trainable)
+        return build_parameter(shape, dtype or self._dtype, attr, is_bias,
+                               default_initializer)
 
     # -- traversal -----------------------------------------------------------
     def named_sublayers(self, prefix: str = "", include_self: bool = False) -> Iterator[Tuple[str, "Layer"]]:

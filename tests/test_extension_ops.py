@@ -258,6 +258,38 @@ class TestTensorUtilities:
         assert abs(float(np.asarray(a).std()) - 0.5) < 0.1
         assert paddle.gaussian([2], dtype="float64").dtype == jnp.float64
 
+    def test_top_level_parity_shims(self):
+        assert paddle.in_dygraph_mode() is True
+        paddle.enable_dygraph()
+        paddle.disable_dygraph()
+        assert paddle.is_compiled_with_xpu() is False
+        assert float(paddle.floor_mod(np.array([7]), np.array([3]))[0]) == 1
+        np.testing.assert_allclose(
+            np.asarray(paddle.crop_tensor(np.arange(9.0).reshape(3, 3),
+                                          shape=[2, 2], offsets=[1, 1])),
+            [[4.0, 5.0], [7.0, 8.0]])
+
+    def test_create_parameter_trains_standalone(self):
+        from paddle_tpu import optimizer as popt
+
+        paddle.seed(0)
+        w = paddle.create_parameter([4, 3])
+        b = paddle.create_parameter([3], is_bias=True)
+        assert w.value.shape == (4, 3)
+        assert np.abs(np.asarray(b.value)).sum() == 0  # bias zero-init
+        before = np.asarray(w.value).copy()
+        opt = popt.SGD(learning_rate=0.1, parameters=[w, b])
+        opt.step({"w": np.ones((4, 3), np.float32),
+                  "b": np.ones((3,), np.float32)})
+        assert not np.allclose(before, np.asarray(w.value))
+        # ParamAttr(trainable=False) must be honored (shared with
+        # Layer.create_parameter via build_parameter)
+        from paddle_tpu import nn
+
+        frozen = paddle.create_parameter(
+            [2], attr=nn.ParamAttr(trainable=False))
+        assert frozen.trainable is False
+
     def test_printoptions_and_to_string(self):
         try:
             paddle.set_printoptions(precision=2, threshold=5)
