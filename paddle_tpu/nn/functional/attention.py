@@ -32,8 +32,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if use_pallas is None:
         use_pallas = False
         try:
+            # gate threshold measured in-model on v5e: XLA's fused bf16
+            # attention is flash-class, so the kernel only engages where
+            # it doesn't lose (parity at seq >= 4096, with O(S) memory)
             if (jax.default_backend() == "tpu" and attn_mask is None
-                    and dropout_p == 0.0 and q.shape[1] >= 512
+                    and dropout_p == 0.0 and q.shape[1] >= 4096
                     and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
                     and q.shape[-1] in (64, 128, 256)):
                 from ...ops import flash_attention as _  # noqa: F401

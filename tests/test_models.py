@@ -177,6 +177,48 @@ class TestBert:
         np.testing.assert_allclose(float(mixed), want, rtol=1e-6)
 
 
+class TestGPTFlashRouting:
+    def test_use_flash_gate(self):
+        import jax
+
+        from paddle_tpu.models.gpt import GPTConfig, ParallelAttention
+
+        attn = ParallelAttention(GPTConfig(hidden_size=64, num_heads=1,
+                                           dropout=0.1))
+        on_tpu = jax.default_backend() == "tpu"
+        attn.eval()  # dropout inactive → gate may open
+        assert attn._use_flash(4096, None) == on_tpu
+        attn.train()  # probs-dropout active → flash must stay off
+        assert attn._use_flash(4096, None) is False
+        attn.eval()
+        assert attn._use_flash(2048, None) is False       # below gate
+        assert attn._use_flash(4096, object()) is False   # extra mask
+        assert attn._use_flash(4104, None) is False       # ragged blocks
+
+        attn0 = ParallelAttention(GPTConfig(hidden_size=64, num_heads=1,
+                                            dropout=0.0))
+        attn0.train()  # no dropout configured → train mode is fine
+        assert attn0._use_flash(4096, None) == on_tpu
+
+    def test_flash_branch_matches_dense_in_model(self, monkeypatch):
+        """Force the gate open and run ParallelAttention.forward through
+        the kernel branch (Pallas interpret mode off-TPU) — it must agree
+        with the dense einsum branch."""
+        from paddle_tpu.models.gpt import GPTConfig, ParallelAttention
+
+        paddle.seed(0)
+        attn = ParallelAttention(GPTConfig(hidden_size=128, num_heads=2,
+                                           dropout=0.0))
+        attn.eval()
+        x = jnp.asarray(np.random.RandomState(0).randn(1, 256, 128),
+                        jnp.float32)
+        dense = np.asarray(attn(x))
+        monkeypatch.setattr(ParallelAttention, "_use_flash",
+                            lambda self, S, m: m is None)
+        flash = np.asarray(attn(x))
+        np.testing.assert_allclose(flash, dense, rtol=2e-4, atol=2e-5)
+
+
 class TestTPParity:
     def test_gpt_tp_matches_single(self):
         """TP=2 forward must equal the single-device forward with the same
