@@ -1,0 +1,110 @@
+"""paddle_tpu.utils — install check, deprecation, lazy import.
+
+Parity: python/paddle/utils/ (install_check.py:134 run_check,
+deprecated.py:31, lazy_import.py:19 try_import; download.py is omitted —
+this environment has no egress, datasets document local placement).
+"""
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+__all__ = ["run_check", "deprecated", "try_import"]
+
+
+def try_import(module_name: str):
+    """Import a module with an actionable error (ref: lazy_import.py:19)."""
+    install_name = {"cv2": "opencv-python", "PIL": "pillow"}.get(
+        module_name, module_name)
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            f"{e}\n  required module {module_name!r} is missing — "
+            f"pip install {install_name}") from e
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = ""):
+    """Deprecation decorator (ref: deprecated.py:31): extends the
+    docstring and warns DeprecationWarning on call."""
+
+    def decorator(fn):
+        note = "Warning: this API is deprecated"
+        if since:
+            note += f" since {since}"
+        if update_to:
+            note += f", use {update_to} instead"
+        if reason:
+            note += f" ({reason})"
+        fn.__doc__ = f"{note}.\n\n{fn.__doc__ or ''}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with warnings.catch_warnings():
+                # default filters hide DeprecationWarning outside __main__;
+                # the reference forces visibility the same way
+                warnings.simplefilter("always", DeprecationWarning)
+                warnings.warn(note, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def run_check():
+    """Sanity-check the install (ref: install_check.py:134): run a tiny
+    train step on the available backend, and — when more than one device
+    is visible — a data-parallel step over all of them."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer as popt
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import mesh as _mesh
+    from paddle_tpu.framework import random as _random
+
+    devices = jax.devices()
+    backend = jax.default_backend()
+    print(f"Running verify on {len(devices)} {backend} device(s) ...")
+
+    def one_step(use_fleet):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+        if use_fleet:
+            fleet.init(is_collective=True,
+                       strategy=fleet.DistributedStrategy())
+            opt = fleet.distributed_optimizer(popt.SGD(learning_rate=0.1))
+        else:
+            opt = popt.SGD(learning_rate=0.1)
+        model = paddle.Model(net, inputs=["x"], labels=["y"])
+        model.prepare(optimizer=opt, loss=nn.MSELoss())
+        rng = np.random.RandomState(0)
+        n = max(len(devices) * 2, 4)
+        x = rng.randn(n, 8).astype(np.float32)
+        y = rng.randn(n, 1).astype(np.float32)
+        loss, _ = model.train_batch([x], [y])
+        if not np.isfinite(loss):
+            raise RuntimeError(f"run_check train step produced {loss}")
+
+    # a sanity check must not perturb the session: snapshot the RNG and
+    # the fleet/mesh globals it touches, restore on the way out
+    saved_rng = _random.get_rng_state()
+    saved_mesh = _mesh._global_mesh
+    saved_strategy = fleet._strategy
+    saved_initialized = fleet._initialized
+    try:
+        one_step(use_fleet=False)
+        print("paddle_tpu works on 1 device.")
+        if len(devices) > 1:
+            one_step(use_fleet=True)
+            print(f"paddle_tpu works on {len(devices)} devices "
+                  f"(data parallel).")
+        print("paddle_tpu is installed successfully!")
+    finally:
+        _random.set_rng_state(saved_rng)
+        _mesh._global_mesh = saved_mesh
+        fleet._strategy = saved_strategy
+        fleet._initialized = saved_initialized

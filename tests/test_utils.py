@@ -1,0 +1,52 @@
+"""paddle_tpu.utils — run_check, deprecated, try_import.
+
+Reference capability: python/paddle/utils/ (install_check.py:134,
+deprecated.py:31, lazy_import.py:19).
+"""
+import warnings
+
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestUtils:
+    def test_run_check_passes_and_restores_state(self, capsys):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.mesh import _global_mesh  # noqa: F401
+        from paddle_tpu.framework import random as prandom
+
+        paddle.seed(1234)
+        key_before = prandom.get_rng_state()
+        strategy_before = fleet._strategy
+        paddle.utils.run_check()
+        out = capsys.readouterr().out
+        assert "installed successfully" in out
+        assert "8" in out  # the 8-device CPU mesh exercises the DP leg
+        # the sanity check must not perturb the session
+        import numpy as np
+
+        assert fleet._strategy is strategy_before
+        np.testing.assert_array_equal(
+            np.asarray(prandom.get_rng_state()),
+            np.asarray(key_before))
+
+    def test_deprecated_warns_and_documents(self):
+        @paddle.utils.deprecated(since="0.1", update_to="paddle.new_api",
+                                 reason="renamed")
+        def old_api(x):
+            """Old docstring."""
+            return x + 1
+
+        assert "deprecated since 0.1" in old_api.__doc__
+        assert "paddle.new_api" in old_api.__doc__
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old_api(1) == 2
+        assert any(issubclass(x.category, DeprecationWarning) for x in w)
+
+    def test_try_import(self):
+        mod = paddle.utils.try_import("math")
+        assert mod.sqrt(4) == 2
+        with pytest.raises(ImportError, match="pip install"):
+            paddle.utils.try_import("definitely_not_a_module_xyz")
