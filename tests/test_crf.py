@@ -98,6 +98,29 @@ class TestLinearChainCrf:
                                           labels[b, :lengths[b]])
 
 
+class TestCrfGradients:
+    def test_nll_grads_match_finite_differences(self):
+        """OpTest.check_grad equivalent for the CRF forward algorithm —
+        the reference hand-writes LinearChainCRFGradOpKernel; here the
+        scan's VJP must match numeric gradients."""
+        from grad_check import check_grad
+
+        rng = np.random.RandomState(0)
+        em = rng.randn(2, 3, D).astype(np.float64)
+        tr = rng.randn(D + 2, D).astype(np.float64)
+        y = rng.randint(0, D, (2, 3)).astype(np.int32)
+        ln = np.array([3, 2], np.int32)
+
+        def nll_em(e):
+            return linear_chain_crf(e, jnp.asarray(tr), y, ln).sum()
+
+        def nll_tr(t):
+            return linear_chain_crf(jnp.asarray(em), t, y, ln).sum()
+
+        check_grad(nll_em, [em])
+        check_grad(nll_tr, [tr])
+
+
 class TestViterbi:
     def test_matches_bruteforce(self):
         emission, transition, labels, lengths = _rand(1)

@@ -132,6 +132,25 @@ class TestHSigmoid:
         np.testing.assert_allclose(got, self._oracle(x, y, C, w, None),
                                    rtol=1e-4, atol=1e-5)
 
+    def test_grads_match_finite_differences(self):
+        """OpTest.check_grad equivalent for the hierarchical sigmoid —
+        the reference hand-writes HierarchicalSigmoidGradOpKernel."""
+        from grad_check import check_grad
+
+        rng = np.random.RandomState(5)
+        N, D, C = 3, 4, 6
+        x = rng.randn(N, D).astype(np.float64)
+        y = rng.randint(0, C, (N,))
+        w = 0.3 * rng.randn(C - 1, D).astype(np.float64)
+        b = 0.1 * rng.randn(C - 1).astype(np.float64)
+
+        check_grad(lambda a: hsigmoid_loss(a, y, C, jnp.asarray(w),
+                                           jnp.asarray(b)).sum(), [x])
+        check_grad(lambda a: hsigmoid_loss(jnp.asarray(x), y, C, a,
+                                           jnp.asarray(b)).sum(), [w])
+        check_grad(lambda a: hsigmoid_loss(jnp.asarray(x), y, C,
+                                           jnp.asarray(w), a).sum(), [b])
+
     def test_trains(self):
         """hsigmoid as an LM head: gradient descent drives the loss down
         and the implied class scores identify the gold class."""
