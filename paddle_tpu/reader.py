@@ -1,0 +1,221 @@
+"""paddle.reader — composable reader decorators (1.x data pipeline).
+
+Parity: python/paddle/reader/decorator.py (cache:51, map_readers:91,
+shuffle:133, chain:182, compose:247, buffered:307, firstn:366,
+xmap_readers:411, multiprocess_reader:504).  A *reader creator* is a
+zero-arg callable returning an iterable of samples; decorators wrap
+creators and compose.  These feed ``DataLoader``/``Model.fit`` via
+``IterableDataset`` or plain python iteration — no framework machinery
+involved, which is exactly why the API survives unchanged.
+
+``xmap_readers``/``multiprocess_reader`` keep the reference's semantics
+with a thread pool / spawn processes; for heavy ingest prefer the C++
+``InMemoryDataset`` (io/in_memory_dataset.py).
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+from .framework.errors import InvalidArgumentError
+
+__all__ = [
+    "cache", "map_readers", "buffered", "compose", "chain", "shuffle",
+    "firstn", "xmap_readers", "multiprocess_reader",
+]
+
+
+def cache(reader):
+    """Cache the full pass in memory; later passes replay it
+    (decorator.py:51)."""
+    all_data = tuple(reader())
+
+    def _impl():
+        return iter(all_data)
+
+    return _impl
+
+
+def map_readers(func, *readers):
+    """Zip several readers, yield func(*samples) (decorator.py:91)."""
+
+    def _impl():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return _impl
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle (decorator.py:133): fill a buf_size window,
+    shuffle, emit."""
+
+    def _impl():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return _impl
+
+
+def chain(*readers):
+    """Concatenate readers back to back (decorator.py:182)."""
+
+    def _impl():
+        return itertools.chain(*[r() for r in readers])
+
+    return _impl
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into combined samples (decorator.py:247): each output
+    is the flattened tuple of the inputs' samples.  check_alignment=True
+    (default) raises when readers end at different lengths."""
+    check_alignment = kwargs.pop("check_alignment", True)
+    if kwargs:
+        raise InvalidArgumentError(f"unknown kwargs {sorted(kwargs)}")
+
+    def _flatten(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def _impl():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum((_flatten(o) for o in outputs), ())
+            return
+        for outputs in itertools.zip_longest(*rs):
+            if any(o is None for o in outputs):
+                raise InvalidArgumentError(
+                    "compose: readers have different lengths "
+                    "(pass check_alignment=False to truncate)")
+            yield sum((_flatten(o) for o in outputs), ())
+
+    return _impl
+
+
+class _Feeder:
+    """Producer thread(s) → bounded queue, with the two properties the
+    naive version lacks (same design as io/dataloader._StagingIterator):
+    producer exceptions re-raise in the consumer instead of looking like
+    a clean end-of-stream, and abandoning the consumer early unblocks
+    the producers (timeout-put + stop flag) so threads and the readers'
+    open files don't leak."""
+
+    _END = object()
+
+    def __init__(self, readers, size):
+        self._q: _queue.Queue = _queue.Queue(maxsize=max(int(size), 1))
+        self._stop = threading.Event()
+        self._err = None
+        self._n = len(readers)
+        for r in readers:
+            threading.Thread(target=self._run, args=(r,),
+                             daemon=True).start()
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _run(self, r):
+        try:
+            for d in r():
+                if not self._put(d):
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+            self._err = e
+        finally:
+            self._put(self._END)
+
+    def __iter__(self):
+        ended = 0
+        try:
+            while ended < self._n:
+                e = self._q.get()
+                if e is self._END:
+                    ended += 1
+                    if self._err is not None:
+                        raise self._err
+                    continue
+                yield e
+        finally:
+            self._stop.set()
+
+
+def buffered(reader, size):
+    """Read ahead into a bounded queue on a worker thread
+    (decorator.py:307) — overlaps producer IO with consumer compute."""
+
+    def _impl():
+        return iter(_Feeder([reader], size))
+
+    return _impl
+
+
+def firstn(reader, n):
+    """Only the first n samples (decorator.py:366)."""
+
+    def _impl():
+        return itertools.islice(reader(), n)
+
+    return _impl
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with a thread pool (decorator.py:411 —
+    the reference also uses threads).  ``order=True`` preserves input
+    order."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def _impl():
+        pool = ThreadPoolExecutor(max_workers=process_num)
+        try:
+            window = []
+            for sample in reader():
+                window.append(pool.submit(mapper, sample))
+                if len(window) >= buffer_size:
+                    if order:
+                        yield window.pop(0).result()
+                    else:
+                        done = next(f for f in window if f.done()) \
+                            if any(f.done() for f in window) else window[0]
+                        window.remove(done)
+                        yield done.result()
+            for f in window:
+                yield f.result()
+        finally:
+            # prompt on early consumer exit: don't wait for the in-flight
+            # window (a plain context manager would block in shutdown)
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    return _impl
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Run several readers concurrently and interleave their samples
+    (decorator.py:504).  Threads stand in for the reference's fork-based
+    processes — reader creators are usually closures over open files,
+    which do not survive pickling to spawn workers; the C++
+    InMemoryDataset covers the true multiprocess ingest capability."""
+    if len(readers) < 1:
+        raise InvalidArgumentError("multiprocess_reader needs >= 1 readers")
+
+    def _impl():
+        return iter(_Feeder(list(readers), queue_size))
+
+    return _impl

@@ -1,0 +1,133 @@
+"""paddle.reader decorators (1.x data pipeline).
+
+Reference capability: python/paddle/reader/decorator.py — reader
+creators compose; each decorator preserves the zero-arg-callable
+contract and its documented semantics.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.errors import InvalidArgumentError
+
+reader = paddle.reader
+
+
+def _r(n, base=0):
+    def _impl():
+        return iter(range(base, base + n))
+
+    return _impl
+
+
+class TestReaderDecorators:
+    def test_cache_replays(self):
+        calls = []
+
+        def once():
+            calls.append(1)
+            return iter([1, 2, 3])
+
+        c = reader.cache(once)
+        assert list(c()) == [1, 2, 3]
+        assert list(c()) == [1, 2, 3]
+        assert len(calls) == 1  # source consumed exactly once
+
+    def test_map_readers(self):
+        out = list(reader.map_readers(lambda a, b: a + b, _r(3), _r(3, 10))())
+        assert out == [10, 12, 14]
+
+    def test_shuffle_is_permutation(self):
+        # order comes from python's global `random`, like the reference
+        out = list(reader.shuffle(_r(20), buf_size=7)())
+        assert sorted(out) == list(range(20))
+
+    def test_chain(self):
+        assert list(reader.chain(_r(2), _r(2, 5))()) == [0, 1, 5, 6]
+
+    def test_compose_flattens_and_checks_alignment(self):
+        def pairs():
+            return iter([(1, 2), (3, 4)])
+
+        out = list(reader.compose(pairs, _r(2, 9))())
+        assert out == [(1, 2, 9), (3, 4, 10)]
+        with pytest.raises(InvalidArgumentError, match="length"):
+            list(reader.compose(_r(2), _r(3))())
+        assert len(list(reader.compose(_r(2), _r(3),
+                                       check_alignment=False)())) == 2
+
+    def test_buffered_and_firstn(self):
+        assert list(reader.buffered(_r(10), size=3)()) == list(range(10))
+        assert list(reader.firstn(_r(10), 4)()) == [0, 1, 2, 3]
+
+    def test_buffered_propagates_producer_errors(self):
+        def bad():
+            yield 1
+            raise IOError("corrupt shard")
+
+        it = reader.buffered(lambda: bad(), size=2)()
+        assert next(it) == 1
+        with pytest.raises(IOError, match="corrupt shard"):
+            list(it)
+
+    def test_multiprocess_reader_propagates_errors(self):
+        def bad():
+            raise IOError("boom")
+            yield  # pragma: no cover
+
+        with pytest.raises(IOError, match="boom"):
+            list(reader.multiprocess_reader([_r(3), lambda: bad()])())
+
+    def test_early_exit_unblocks_producer(self):
+        """firstn over a buffered reader must not leave the fill thread
+        blocked on a full queue forever."""
+        import threading
+        import time
+
+        n_before = threading.active_count()
+        out = list(reader.firstn(reader.buffered(_r(1000), size=2), 3)())
+        assert out == [0, 1, 2]
+        deadline = time.time() + 5
+        while threading.active_count() > n_before and time.time() < deadline:
+            time.sleep(0.05)
+        assert threading.active_count() <= n_before
+
+    def test_xmap_ordered(self):
+        out = list(reader.xmap_readers(lambda x: x * x, _r(10),
+                                       process_num=3, buffer_size=4,
+                                       order=True)())
+        assert out == [i * i for i in range(10)]
+
+    def test_xmap_unordered_same_set(self):
+        out = list(reader.xmap_readers(lambda x: x + 1, _r(10),
+                                       process_num=2, buffer_size=3)())
+        assert sorted(out) == list(range(1, 11))
+
+    def test_multiprocess_reader_interleaves_all(self):
+        out = list(reader.multiprocess_reader([_r(5), _r(5, 100)])())
+        assert sorted(out) == sorted(list(range(5)) + list(range(100, 105)))
+
+    def test_feeds_model_fit_via_iteration(self):
+        """Readers plug into the training loop like the reference's
+        train loop over reader() batches."""
+        from paddle_tpu import nn, optimizer as popt
+
+        rng = np.random.RandomState(0)
+        data = [(rng.randn(8).astype(np.float32),
+                 rng.randn(1).astype(np.float32)) for _ in range(32)]
+
+        def creator():
+            return iter(data)
+
+        paddle.seed(0)
+        net = nn.Linear(8, 1)
+        m = paddle.Model(net, inputs=["x"], labels=["y"])
+        m.prepare(optimizer=popt.SGD(learning_rate=0.05),
+                  loss=nn.MSELoss())
+        pipe = reader.buffered(reader.shuffle(creator, 16), 8)
+        for _ in range(3):
+            for x, y in pipe():
+                m.train_batch([x[None]], [y[None]])
+        # it trained
+        l, _ = m.train_batch([data[0][0][None]], [data[0][1][None]])
+        assert np.isfinite(l)
