@@ -23,6 +23,7 @@ from .layer_base import Layer
 
 __all__ = [
     "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN", "RNNBase",
+    "split_states", "concat_states",
     "SimpleRNN", "LSTM", "GRU",
 ]
 
@@ -332,3 +333,29 @@ class GRU(RNNBase):
                  direction="forward", time_major=False, dropout=0.0, **kw):
         super().__init__("GRU", input_size, hidden_size, num_layers,
                          direction, time_major, dropout, **kw)
+
+
+def split_states(states, bidirectional=False, state_components=1):
+    """Split concatenated [L*D, N, C] RNN-network states into per-cell
+    states (reference: nn/layer/rnn.py:49)."""
+    if state_components == 1:
+        parts = [states[i] for i in range(states.shape[0])]
+        if not bidirectional:
+            return parts
+        return list(zip(parts[::2], parts[1::2]))
+    assert len(states) == state_components
+    comps = tuple([item[i] for i in range(item.shape[0])] for item in states)
+    zipped = list(zip(*comps))
+    if not bidirectional:
+        return zipped
+    return list(zip(zipped[::2], zipped[1::2]))
+
+
+def concat_states(states, bidirectional=False, state_components=1):
+    """Inverse of split_states: nested per-cell states → [L*D, N, C]
+    (reference: nn/layer/rnn.py:102)."""
+    flat = jax.tree_util.tree_leaves(states)
+    if state_components == 1:
+        return jnp.stack(flat)
+    comps = [flat[i::state_components] for i in range(state_components)]
+    return tuple(jnp.stack(c) for c in comps)

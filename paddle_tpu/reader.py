@@ -22,9 +22,15 @@ import threading
 from .framework.errors import InvalidArgumentError
 
 __all__ = [
-    "cache", "map_readers", "buffered", "compose", "chain", "shuffle",
-    "firstn", "xmap_readers", "multiprocess_reader",
+    "ComposeNotAligned", "cache", "map_readers", "buffered", "compose",
+    "chain", "shuffle", "firstn", "xmap_readers", "multiprocess_reader",
 ]
+
+
+class ComposeNotAligned(InvalidArgumentError):
+    """compose() inputs ended at different lengths (decorator.py:243) —
+    InvalidArgumentError already subclasses ValueError, so both the
+    reference-style and framework-style except clauses catch it."""
 
 
 def cache(reader):
@@ -96,7 +102,7 @@ def compose(*readers, **kwargs):
             return
         for outputs in itertools.zip_longest(*rs):
             if any(o is None for o in outputs):
-                raise InvalidArgumentError(
+                raise ComposeNotAligned(
                     "compose: readers have different lengths "
                     "(pass check_alignment=False to truncate)")
             yield sum((_flatten(o) for o in outputs), ())

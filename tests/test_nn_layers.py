@@ -309,3 +309,32 @@ class TestLayers:
         check(lin.weight.value, np.full((2, 3), 0.5))
         assert not lin.weight.trainable
         assert len(lin.param_pytree(trainable_only=True)) == 1  # only bias
+
+
+class TestRNNStateHelpers:
+    """split_states/concat_states (reference: nn/layer/rnn.py:49,102)."""
+
+    def test_roundtrip_single_component(self):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(0)
+        # L=2 layers, D=2 directions, N=3 batch, C=4 hidden
+        h = jnp.asarray(rng.randn(4, 3, 4), jnp.float32)
+        cells = nn.split_states(h, bidirectional=True)
+        assert len(cells) == 2 and len(cells[0]) == 2
+        np.testing.assert_array_equal(
+            np.asarray(nn.concat_states(cells, bidirectional=True)),
+            np.asarray(h))
+
+    def test_roundtrip_lstm_components(self):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(0)
+        h = jnp.asarray(rng.randn(4, 3, 4), jnp.float32)
+        c = jnp.asarray(rng.randn(4, 3, 4), jnp.float32)
+        cells = nn.split_states((h, c), bidirectional=False,
+                                state_components=2)
+        assert len(cells) == 4 and len(cells[0]) == 2
+        back = nn.concat_states(cells, state_components=2)
+        np.testing.assert_array_equal(np.asarray(back[0]), np.asarray(h))
+        np.testing.assert_array_equal(np.asarray(back[1]), np.asarray(c))
