@@ -133,6 +133,25 @@ def in_dygraph_mode() -> bool:
     return True
 
 
+def disable_static(place=None):
+    """Parity no-op: eager IS the (only) mode — common 2.0 scripts call
+    this at the top and should keep working unchanged."""
+
+
+def enable_static():
+    """The reference's static Program mode does not exist here — whole-
+    graph compilation happens by tracing eager code (jaxpr replaces
+    Program, SURVEY §7).  Raises with the migration path."""
+    from .framework.errors import UnimplementedError
+
+    raise UnimplementedError(
+        "enable_static(): there is no Program interpreter in this "
+        "framework — eager code is traced and whole-graph compiled by "
+        "XLA already.  Use Model.prepare/fit (fused jit train step), "
+        "jit.to_static (compiled callables), or inference.save_inference_model "
+        "(AOT export) for the use cases static mode served")
+
+
 def enable_dygraph(place=None):
     """Parity no-op: there is no static Program mode to leave."""
 

@@ -37,6 +37,10 @@ class InputSpec:
         self.dtype = convert_dtype(dtype)
         self.name = name
 
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name!r})")
+
     @classmethod
     def from_tensor(cls, tensor, name: Optional[str] = None) -> "InputSpec":
         t = np.asarray(tensor) if not isinstance(tensor, jax.Array) else tensor
@@ -84,6 +88,37 @@ def make_symbols(specs) -> dict:
     dims = jexport.symbolic_shape(", ".join(names))
     return dict(zip(names, dims))
 
-    def __repr__(self):
-        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
-                f"name={self.name!r})")
+
+# the reference's static-graph surface (Program/Executor/program_guard/
+# data/...) has no counterpart by DESIGN — jaxpr tracing replaces Program
+# construction (SURVEY §7).  Accessing those names raises with the
+# TPU-native migration path instead of an opaque AttributeError.
+_STATIC_ONLY = {
+    "Program": "Model.prepare compiles the whole train step from traced "
+               "eager code",
+    "Executor": "Model.fit / Model.evaluate run the compiled step",
+    "program_guard": "no Program objects exist — write eager code",
+    "default_main_program": "no Program objects exist",
+    "default_startup_program": "parameter init happens at Layer "
+                               "construction",
+    "data": "pass arrays directly; declare export signatures with "
+            "InputSpec",
+    "scope_guard": "no Scope — state lives in Layer parameter boxes",
+    "global_scope": "no Scope — state lives in Layer parameter boxes",
+}
+
+
+def __getattr__(name):
+    if name in _STATIC_ONLY:
+        from .framework.errors import UnimplementedError
+
+        class _StaticOnlyError(UnimplementedError, AttributeError):
+            """Also an AttributeError so hasattr()/getattr(default)
+            feature probes report 'absent' instead of crashing — exactly
+            the migration code paths this shim exists to help."""
+
+        raise _StaticOnlyError(
+            f"paddle.static.{name} is static-Program API with no "
+            f"counterpart in this single-runtime framework (jaxpr replaces "
+            f"Program — SURVEY §7); instead: {_STATIC_ONLY[name]}")
+    raise AttributeError(f"module 'paddle_tpu.static' has no attribute {name!r}")

@@ -277,6 +277,23 @@ class TestTensorUtilities:
         assert abs(float(np.asarray(a).std()) - 0.5) < 0.1
         assert paddle.gaussian([2], dtype="float64").dtype == jnp.float64
 
+    def test_static_mode_shims(self):
+        from paddle_tpu.framework.errors import UnimplementedError
+
+        paddle.disable_static()  # common 2.0 preamble — must be a no-op
+        with pytest.raises(UnimplementedError, match="Program"):
+            paddle.enable_static()
+        with pytest.raises(UnimplementedError, match="Model.fit"):
+            paddle.static.Executor
+        # feature probes must see 'absent', not crash
+        assert not hasattr(paddle.static, "Program")
+        assert getattr(paddle.static, "Executor", None) is None
+        with pytest.raises(AttributeError):
+            paddle.static.definitely_not_an_api
+        spec = paddle.static.InputSpec([2, 3])
+        assert spec.shape == (2, 3)
+        assert "InputSpec(shape=(2, 3)" in repr(spec)
+
     def test_top_level_parity_shims(self):
         assert paddle.in_dygraph_mode() is True
         paddle.enable_dygraph()
