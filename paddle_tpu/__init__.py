@@ -81,6 +81,7 @@ from . import text  # noqa: F401
 from . import distribution  # noqa: F401
 from . import hapi  # noqa: F401
 from . import incubate  # noqa: F401
+from . import dataset  # noqa: F401
 from . import jit  # noqa: F401
 from . import reader  # noqa: F401
 from . import utils  # noqa: F401
@@ -125,6 +126,29 @@ def to_variable(data, **kwargs):
     from .tensor.creation import to_tensor
 
     return to_tensor(data, **kwargs)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Batch a sample reader into a lists-of-samples reader
+    (ref: python/paddle/batch.py:18, incl. its batch_size validation)."""
+    batch_size = int(batch_size)
+    if batch_size <= 0:
+        from .framework.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"batch_size should be a positive integer, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
 
 
 def in_dygraph_mode() -> bool:

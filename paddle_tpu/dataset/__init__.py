@@ -1,0 +1,33 @@
+"""paddle.dataset — 1.x module-level reader creators.
+
+Parity: python/paddle/dataset/ (mnist.py:91 train/test, cifar.py,
+uci_housing.py, imdb.py, imikolov.py, movielens.py, conll05.py,
+flowers.py, voc2012.py, wmt14.py, wmt16.py) — each module exposes
+``train()``/``test()`` returning a *reader*: a zero-arg callable
+yielding samples, composable with ``paddle.reader`` decorators and
+``paddle.batch``.
+
+TPU-native design: the modules are thin bridges over the class-based
+datasets (paddle_tpu.vision.datasets / paddle_tpu.text.datasets), which
+own the file formats.  No network egress exists here, so the reference's
+``common.download`` flow is replaced by the datasets' documented
+local-file placement; ``fetch()`` raises with those instructions.
+"""
+from __future__ import annotations
+
+from . import (  # noqa: F401
+    cifar,
+    conll05,
+    flowers,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    uci_housing,
+    voc2012,
+    wmt14,
+    wmt16,
+)
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "imikolov",
+           "movielens", "conll05", "flowers", "voc2012", "wmt14", "wmt16"]

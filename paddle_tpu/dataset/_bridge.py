@@ -1,0 +1,47 @@
+"""Shared bridge: class Dataset → 1.x reader creator."""
+from __future__ import annotations
+
+
+def dataset_reader(factory, transform=None):
+    """A zero-arg reader yielding ``factory()``'s samples.  Construction
+    is lazy (first iteration, per 1.x semantics where ``train()`` is
+    cheap) but cached after — the standard epoch loop calls reader()
+    every epoch, and rebuilding would rescan archives/vocabs each time."""
+    cache = []
+
+    def reader():
+        if not cache:
+            cache.append(factory())
+        ds = cache[0]
+        for i in range(len(ds)):
+            sample = ds[i]
+            yield transform(sample) if transform is not None else sample
+
+    return reader
+
+
+def no_fetch(name: str):
+    def fetch():
+        raise RuntimeError(
+            f"paddle.dataset.{name}.fetch(): this environment has no "
+            f"network egress — place the standard archives locally as the "
+            f"{name} Dataset class documents (see its FileNotFoundError "
+            f"message for exact paths)")
+
+    return fetch
+
+
+def _check_word_idx(user_dict, ds_dict, builder: str):
+    """The 1.x readers MAP tokens through the caller's word_idx; these
+    bridges delegate encoding to the class datasets, which derive the
+    same vocab from the same corpus/cutoff — so a dict from ``builder()``
+    matches exactly, and anything else must fail loudly rather than
+    silently emit ids from a different vocabulary."""
+    if user_dict is None or dict(user_dict) == dict(ds_dict):
+        return
+    from ..framework.errors import InvalidArgumentError
+
+    raise InvalidArgumentError(
+        f"word_idx does not match the vocabulary this dataset derives "
+        f"from its corpus; build it with {builder}() (same cutoff/"
+        f"min_word_freq) — custom vocabularies are not remapped here")

@@ -1,0 +1,34 @@
+"""paddle.dataset.cifar (ref: dataset/cifar.py) — samples are the
+Cifar Dataset tuples: (f32 image [3,32,32], int64 label)."""
+from __future__ import annotations
+
+from ._bridge import dataset_reader, no_fetch
+
+__all__ = ["train10", "test10", "train100", "test100", "fetch"]
+
+
+def train10(data_file=None):
+    from ..vision.datasets import Cifar10
+
+    return dataset_reader(lambda: Cifar10(data_file=data_file, mode="train"))
+
+
+def test10(data_file=None):
+    from ..vision.datasets import Cifar10
+
+    return dataset_reader(lambda: Cifar10(data_file=data_file, mode="test"))
+
+
+def train100(data_file=None):
+    from ..vision.datasets import Cifar100
+
+    return dataset_reader(lambda: Cifar100(data_file=data_file, mode="train"))
+
+
+def test100(data_file=None):
+    from ..vision.datasets import Cifar100
+
+    return dataset_reader(lambda: Cifar100(data_file=data_file, mode="test"))
+
+
+fetch = no_fetch("cifar")

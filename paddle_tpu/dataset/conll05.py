@@ -1,0 +1,29 @@
+"""paddle.dataset.conll05 (ref: dataset/conll05.py) — SRL samples."""
+from __future__ import annotations
+
+from ._bridge import dataset_reader, no_fetch
+
+__all__ = ["test", "get_dict", "fetch"]
+
+
+def test(data_file=None, word_dict_file=None, verb_dict_file=None,
+         target_dict_file=None):
+    from ..text.datasets import Conll05st
+
+    return dataset_reader(lambda: Conll05st(
+        data_file=data_file, word_dict_file=word_dict_file,
+        verb_dict_file=verb_dict_file, target_dict_file=target_dict_file))
+
+
+def get_dict(data_file=None, word_dict_file=None, verb_dict_file=None,
+             target_dict_file=None):
+    """(word_dict, verb_dict, label_dict) — reference conll05.get_dict."""
+    from ..text.datasets import Conll05st
+
+    ds = Conll05st(data_file=data_file, word_dict_file=word_dict_file,
+                   verb_dict_file=verb_dict_file,
+                   target_dict_file=target_dict_file)
+    return ds.word_dict, ds.predicate_dict, ds.label_dict
+
+
+fetch = no_fetch("conll05")

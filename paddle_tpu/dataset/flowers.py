@@ -1,0 +1,23 @@
+"""paddle.dataset.flowers (ref: dataset/flowers.py)."""
+from __future__ import annotations
+
+from ._bridge import dataset_reader, no_fetch
+
+__all__ = ["train", "valid", "test", "fetch"]
+
+
+def _make(mode):
+    def creator(data_file=None, label_file=None, setid_file=None):
+        from ..vision.datasets import Flowers
+
+        return dataset_reader(lambda: Flowers(
+            data_file=data_file, label_file=label_file,
+            setid_file=setid_file, mode=mode))
+
+    return creator
+
+
+train = _make("train")
+valid = _make("valid")
+test = _make("test")
+fetch = no_fetch("flowers")

@@ -1,0 +1,34 @@
+"""paddle.dataset.imdb (ref: dataset/imdb.py) — samples are
+(token-id sequence, 0/1 label); word_dict() builds the cutoff vocab."""
+from __future__ import annotations
+
+from ._bridge import _check_word_idx, dataset_reader, no_fetch
+
+__all__ = ["train", "test", "word_dict", "fetch"]
+
+
+def _make(mode):
+    def creator(word_idx=None, data_file=None, cutoff=150):
+        from ..text.datasets import Imdb
+
+        def factory():
+            ds = Imdb(data_file=data_file, mode=mode, cutoff=cutoff)
+            _check_word_idx(word_idx, ds.word_idx, "imdb.word_dict")
+            return ds
+
+        return dataset_reader(factory)
+
+    return creator
+
+
+train = _make("train")
+test = _make("test")
+
+
+def word_dict(data_file=None, cutoff=150):
+    from ..text.datasets import Imdb
+
+    return Imdb(data_file=data_file, mode="train", cutoff=cutoff).word_idx
+
+
+fetch = no_fetch("imdb")

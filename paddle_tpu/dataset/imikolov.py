@@ -1,0 +1,38 @@
+"""paddle.dataset.imikolov (ref: dataset/imikolov.py) — ngram or seq
+samples from the PTB corpus."""
+from __future__ import annotations
+
+from ._bridge import _check_word_idx, dataset_reader, no_fetch
+
+__all__ = ["train", "test", "build_dict", "fetch"]
+
+
+def _make(mode):
+    def creator(word_idx=None, n=-1, data_type="NGRAM", data_file=None,
+                min_word_freq=50):
+        from ..text.datasets import Imikolov
+
+        def factory():
+            ds = Imikolov(data_file=data_file, data_type=data_type,
+                          window_size=n, mode=mode,
+                          min_word_freq=min_word_freq)
+            _check_word_idx(word_idx, ds.word_idx, "imikolov.build_dict")
+            return ds
+
+        return dataset_reader(factory)
+
+    return creator
+
+
+train = _make("train")
+test = _make("test")
+
+
+def build_dict(data_file=None, min_word_freq=50):
+    from ..text.datasets import Imikolov
+
+    return Imikolov(data_file=data_file, mode="train",
+                    min_word_freq=min_word_freq).word_idx
+
+
+fetch = no_fetch("imikolov")

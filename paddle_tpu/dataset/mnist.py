@@ -1,0 +1,36 @@
+"""paddle.dataset.mnist (ref: dataset/mnist.py:91) — samples are
+(flattened f32 pixels in [-1, 1], int label), the documented 1.x format."""
+from __future__ import annotations
+
+import numpy as np
+
+from ._bridge import dataset_reader, no_fetch
+
+__all__ = ["train", "test", "fetch"]
+
+
+def _flatten_norm(sample):
+    img, label = sample
+    return (np.asarray(img, np.float32).reshape(-1) / 127.5 - 1.0,
+            int(label))
+
+
+def train(image_file=None, label_file=None):
+    from ..vision.datasets import MNIST
+
+    return dataset_reader(
+        lambda: MNIST(image_path=image_file, label_path=label_file,
+                      mode="train"),
+        transform=_flatten_norm)
+
+
+def test(image_file=None, label_file=None):
+    from ..vision.datasets import MNIST
+
+    return dataset_reader(
+        lambda: MNIST(image_path=image_file, label_path=label_file,
+                      mode="test"),
+        transform=_flatten_norm)
+
+
+fetch = no_fetch("mnist")

@@ -1,0 +1,26 @@
+"""paddle.dataset.movielens (ref: dataset/movielens.py) — samples are
+the Movielens Dataset's 8-tuples (user/movie features + rating)."""
+from __future__ import annotations
+
+from ._bridge import dataset_reader, no_fetch
+
+__all__ = ["train", "test", "fetch"]
+
+
+def train(data_file=None, test_ratio=0.1, rand_seed=0):
+    from ..text.datasets import Movielens
+
+    return dataset_reader(lambda: Movielens(
+        data_file=data_file, mode="train", test_ratio=test_ratio,
+        rand_seed=rand_seed))
+
+
+def test(data_file=None, test_ratio=0.1, rand_seed=0):
+    from ..text.datasets import Movielens
+
+    return dataset_reader(lambda: Movielens(
+        data_file=data_file, mode="test", test_ratio=test_ratio,
+        rand_seed=rand_seed))
+
+
+fetch = no_fetch("movielens")

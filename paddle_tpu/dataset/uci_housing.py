@@ -1,0 +1,24 @@
+"""paddle.dataset.uci_housing (ref: dataset/uci_housing.py) — samples
+are (13 f32 features, 1 f32 target)."""
+from __future__ import annotations
+
+from ._bridge import dataset_reader, no_fetch
+
+__all__ = ["train", "test", "fetch"]
+
+
+def train(data_file=None):
+    from ..text.datasets import UCIHousing
+
+    return dataset_reader(lambda: UCIHousing(data_file=data_file,
+                                             mode="train"))
+
+
+def test(data_file=None):
+    from ..text.datasets import UCIHousing
+
+    return dataset_reader(lambda: UCIHousing(data_file=data_file,
+                                             mode="test"))
+
+
+fetch = no_fetch("uci_housing")

@@ -1,0 +1,22 @@
+"""paddle.dataset.voc2012 (ref: dataset/voc2012.py)."""
+from __future__ import annotations
+
+from ._bridge import dataset_reader, no_fetch
+
+__all__ = ["train", "val", "test", "fetch"]
+
+
+def _make(mode):
+    def creator(data_file=None):
+        from ..vision.datasets import VOC2012
+
+        return dataset_reader(lambda: VOC2012(data_file=data_file,
+                                              mode=mode))
+
+    return creator
+
+
+train = _make("train")
+val = _make("valid")
+test = _make("test")
+fetch = no_fetch("voc2012")

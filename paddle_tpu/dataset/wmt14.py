@@ -1,0 +1,24 @@
+"""paddle.dataset.wmt14 (ref: dataset/wmt14.py) — (src_ids, trg_in,
+trg_next) translation samples."""
+from __future__ import annotations
+
+from ._bridge import dataset_reader, no_fetch
+
+__all__ = ["train", "test", "fetch"]
+
+
+def train(dict_size=-1, data_file=None):
+    from ..text.datasets import WMT14
+
+    return dataset_reader(lambda: WMT14(data_file=data_file, mode="train",
+                                        dict_size=dict_size))
+
+
+def test(dict_size=-1, data_file=None):
+    from ..text.datasets import WMT14
+
+    return dataset_reader(lambda: WMT14(data_file=data_file, mode="test",
+                                        dict_size=dict_size))
+
+
+fetch = no_fetch("wmt14")

@@ -1,0 +1,24 @@
+"""paddle.dataset.wmt16 (ref: dataset/wmt16.py)."""
+from __future__ import annotations
+
+from ._bridge import dataset_reader, no_fetch
+
+__all__ = ["train", "test", "validation", "fetch"]
+
+
+def _make(mode):
+    def creator(src_dict_size=-1, trg_dict_size=-1, src_lang="en",
+                data_file=None):
+        from ..text.datasets import WMT16
+
+        return dataset_reader(lambda: WMT16(
+            data_file=data_file, mode=mode, src_dict_size=src_dict_size,
+            trg_dict_size=trg_dict_size, lang=src_lang))
+
+    return creator
+
+
+train = _make("train")
+test = _make("test")
+validation = _make("val")
+fetch = no_fetch("wmt16")

@@ -1,0 +1,140 @@
+"""paddle.dataset 1.x reader creators + paddle.batch.
+
+Reference capability: python/paddle/dataset/ (module-level train()/test()
+reader creators) and python/paddle/batch.py:18 — here thin bridges over
+the class datasets, composable with paddle.reader decorators.
+"""
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _write_idx(tmpdir, n=16):
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 255, (n, 28, 28), dtype=np.uint8)
+    labels = rng.randint(0, 10, (n,)).astype(np.uint8)
+    img_path = os.path.join(tmpdir, "imgs.gz")
+    lbl_path = os.path.join(tmpdir, "lbls.gz")
+    with gzip.open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(images.tobytes())
+    with gzip.open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return img_path, lbl_path, labels
+
+
+class TestDatasetBridge:
+    def test_mnist_reader_1x_format(self, tmp_path):
+        img, lbl, labels = _write_idx(str(tmp_path))
+        r = paddle.dataset.mnist.train(image_file=img, label_file=lbl)
+        samples = list(r())
+        assert len(samples) == 16
+        x, y = samples[3]
+        # documented 1.x format: flattened pixels in [-1, 1], int label
+        assert x.shape == (784,) and x.dtype == np.float32
+        assert -1.0 <= x.min() and x.max() <= 1.0
+        assert y == int(labels[3])
+
+    def test_uci_housing_reader(self, tmp_path):
+        rng = np.random.RandomState(0)
+        table = np.concatenate(
+            [rng.rand(50, 13), rng.rand(50, 1)], axis=1)
+        p = os.path.join(tmp_path, "housing.data")
+        np.savetxt(p, table)
+        r = paddle.dataset.uci_housing.train(data_file=p)
+        x, y = next(iter(r()))
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_composes_with_reader_decorators_and_batch(self, tmp_path):
+        img, lbl, _ = _write_idx(str(tmp_path))
+        r = paddle.dataset.mnist.train(image_file=img, label_file=lbl)
+        pipe = paddle.batch(
+            paddle.reader.shuffle(r, buf_size=8), batch_size=4,
+            drop_last=True)
+        batches = list(pipe())
+        assert len(batches) == 4
+        assert len(batches[0]) == 4
+        assert batches[0][0][0].shape == (784,)
+
+    def test_batch_drop_last(self):
+        r = lambda: iter(range(10))
+        assert len(list(paddle.batch(r, 4)())) == 3
+        assert len(list(paddle.batch(r, 4, drop_last=True)())) == 2
+
+    def test_batch_validates_size(self):
+        from paddle_tpu.framework.errors import InvalidArgumentError
+
+        with pytest.raises(InvalidArgumentError, match="positive"):
+            paddle.batch(lambda: iter(range(4)), 0)
+        with pytest.raises(InvalidArgumentError, match="positive"):
+            paddle.batch(lambda: iter(range(4)), -2)
+
+    def test_dataset_cached_across_epochs(self, tmp_path, monkeypatch):
+        """reader() per epoch must not reconstruct the Dataset (vocab/
+        archive rescans)."""
+        img, lbl, _ = _write_idx(str(tmp_path))
+        import paddle_tpu.vision.datasets as V
+
+        calls = []
+        orig = V.MNIST.__init__
+
+        def counting(self, *a, **k):
+            calls.append(1)
+            return orig(self, *a, **k)
+
+        monkeypatch.setattr(V.MNIST, "__init__", counting)
+        r = paddle.dataset.mnist.train(image_file=img, label_file=lbl)
+        list(r())
+        list(r())
+        assert len(calls) == 1
+
+    def test_imdb_word_idx_checked(self, tmp_path):
+        import io
+        import tarfile
+
+        from paddle_tpu.framework.errors import InvalidArgumentError
+
+        p = os.path.join(tmp_path, "aclImdb_v1.tar.gz")
+        docs = {"aclImdb/train/pos/0.txt": b"a great movie",
+                "aclImdb/train/neg/0.txt": b"a bad movie"}
+        with tarfile.open(p, "w:gz") as t:
+            for name, data in docs.items():
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                t.addfile(info, io.BytesIO(data))
+
+        # the documented pattern: dict from word_dict() matches
+        d = paddle.dataset.imdb.word_dict(data_file=p, cutoff=0)
+        r = paddle.dataset.imdb.train(word_idx=d, data_file=p, cutoff=0)
+        assert len(list(r())) == 2
+        # a custom dict must fail loudly, not silently re-encode
+        bad = {"a": 0, "great": 1}
+        r2 = paddle.dataset.imdb.train(word_idx=bad, data_file=p, cutoff=0)
+        with pytest.raises(InvalidArgumentError, match="word_idx"):
+            next(iter(r2()))
+
+    def test_fetch_raises_actionable(self):
+        with pytest.raises(RuntimeError, match="egress"):
+            paddle.dataset.mnist.fetch()
+
+    def test_lazy_construction(self, tmp_path):
+        """train() must be cheap — the dataset opens at iteration, so a
+        missing file errors on reader(), not on creator construction."""
+        from paddle_tpu.framework.errors import NotFoundError
+
+        r = paddle.dataset.uci_housing.train(
+            data_file=os.path.join(tmp_path, "nope.data"))
+        with pytest.raises(NotFoundError):
+            next(iter(r()))
+
+    def test_all_modules_importable(self):
+        for m in ["mnist", "cifar", "uci_housing", "imdb", "imikolov",
+                  "movielens", "conll05", "flowers", "voc2012", "wmt14",
+                  "wmt16"]:
+            assert hasattr(paddle.dataset, m)
