@@ -155,7 +155,10 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
                 rampup_step=int(cfg.get("rampup_step", 1)),
                 sparsity=cfg.get("sparsity", [0.999]),
                 use_nesterov=optimizer._nesterov,
-                weight_decay=optimizer._weight_decay,
+                # a regularizer object lives in _regularizer with
+                # _weight_decay zeroed — forward whichever is active
+                weight_decay=(optimizer._regularizer
+                              or optimizer._weight_decay),
                 grad_clip=optimizer._grad_clip,
             )
     if st.a_sync:

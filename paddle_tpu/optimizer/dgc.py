@@ -113,7 +113,9 @@ class DGCMomentum(Optimizer):
                 new_v[name] = state["v"][name]
                 continue
             g = g.astype(jnp.float32)
-            if self._weight_decay:
+            if self._regularizer is not None:
+                g = g + self._regularizer(p.astype(jnp.float32))
+            elif self._weight_decay:
                 g = g + self._weight_decay * p.astype(jnp.float32)
             if sparsity is None:
                 # warmup: dense momentum on the (already averaged+clipped)

@@ -71,9 +71,10 @@ class Optimizer:
 
     Args mirror paddle.optimizer.Optimizer: ``learning_rate`` (float or
     LRScheduler), ``parameters`` (list of nn.Parameter for eager use),
-    ``weight_decay`` (float → L2 regularization added to the gradient, as
-    the reference's L2Decay regularizer), ``grad_clip`` (one of the
-    ClipGradBy* callables).
+    ``weight_decay`` (float → L2 regularization added to the gradient,
+    or a ``paddle.regularizer`` instance — L2Decay normalizes to its
+    float coefficient, L1Decay adds ``coeff·sign(w)``), ``grad_clip``
+    (one of the ClipGradBy* callables).
     """
 
     def __init__(
@@ -86,6 +87,18 @@ class Optimizer:
         multi_precision: bool = False,
     ):
         self._learning_rate = learning_rate
+        # weight_decay: float (L2, as always) or a regularizer object
+        # (paddle.regularizer.L1Decay/L2Decay) — an L2Decay instance
+        # normalizes to its float coeff so every existing float path
+        # (master-weight plumbing, DGC conversion, ...) stays identical
+        from ..regularizer import L2Decay, WeightDecayRegularizer
+
+        self._regularizer = None
+        if isinstance(weight_decay, L2Decay):
+            weight_decay = weight_decay.coeff
+        elif isinstance(weight_decay, WeightDecayRegularizer):
+            self._regularizer = weight_decay
+            weight_decay = 0.0
         self._weight_decay = float(weight_decay) if weight_decay else 0.0
         self._grad_clip = grad_clip
         self._name = name
@@ -171,8 +184,11 @@ class Optimizer:
         master = slots.get("master")
         w = master if master is not None else p
         g = g.astype(w.dtype)
-        if self._weight_decay and self._use_l2_decay(name):
-            g = g + self._weight_decay * w
+        if self._use_l2_decay(name):
+            if self._regularizer is not None:
+                g = g + self._regularizer(w).astype(w.dtype)
+            elif self._weight_decay:
+                g = g + self._weight_decay * w
         new_w, slots = self._update(w, g, slots, lr, count)
         if master is not None:
             slots["master"] = new_w
@@ -413,6 +429,17 @@ class AdamW(Adam):
                  apply_decay_param_fun=None, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision, name)
+        from ..regularizer import L2Decay, WeightDecayRegularizer
+
+        if isinstance(weight_decay, L2Decay):
+            # AdamW's decay is decoupled but the coefficient is the same
+            weight_decay = weight_decay.coeff
+        elif isinstance(weight_decay, WeightDecayRegularizer):
+            raise InvalidArgumentError(
+                "AdamW's decay is decoupled (applied to the parameter, "
+                "not the gradient) — only L2Decay/float coefficients are "
+                "meaningful here; for L1 regularization use an Adam-family "
+                "optimizer with weight_decay=L1Decay(...)")
         self._coeff = float(weight_decay)
         self._decay_fn = apply_decay_param_fun
 

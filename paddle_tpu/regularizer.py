@@ -1,0 +1,49 @@
+"""paddle.regularizer — L1Decay / L2Decay.
+
+Parity: python/paddle/regularizer.py (L1Decay:20, L2Decay:82 over
+fluid/regularizer.py append_regularization_ops).  The reference appends
+a regularization op to each parameter's gradient in the Program; here
+the optimizer adds the penalty gradient in its (jit-traced) update —
+same math, zero graph surgery.  Pass an instance as ``weight_decay=``
+to any optimizer (a bare float keeps meaning L2, as before).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["WeightDecayRegularizer", "L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    """Base: maps a parameter value to its penalty gradient dP/dw."""
+
+    def __call__(self, w):
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    """P = coeff * ||w||_1 → dP/dw = coeff * sign(w) (ref:
+    regularizer.py:20, fluid L1DecayRegularizer)."""
+
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, w):
+        return self.coeff * jnp.sign(w)
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self.coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """P = 0.5 * coeff * ||w||² → dP/dw = coeff * w (ref:
+    regularizer.py:82, fluid L2DecayRegularizer)."""
+
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, w):
+        return self.coeff * w
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self.coeff})"

@@ -239,3 +239,17 @@ class TestDGCStructure:
         m = paddle.Model(net, inputs=["x"], labels=["y"])
         with pytest.raises(InvalidArgumentError, match="dgc"):
             m.prepare(optimizer=opt, loss=nn.MSELoss())
+
+
+class TestDGCRegularizer:
+    def test_l1decay_survives_conversion(self):
+        """A regularizer object on the source Momentum must reach the
+        converted DGCMomentum (weight_decay floats and objects both)."""
+        strat = fleet.DistributedStrategy(dgc=True)
+        fleet.init(is_collective=True, strategy=strat)
+        src = popt.Momentum(learning_rate=0.05, momentum=0.9,
+                            weight_decay=paddle.regularizer.L1Decay(0.01))
+        dopt = fleet.distributed_optimizer(src)
+        assert isinstance(dopt, DGCMomentum)
+        assert dopt._regularizer is src._regularizer
+        assert dopt._regularizer is not None

@@ -492,3 +492,51 @@ class TestReviewRegressions:
         out, _ = run_steps(opt, params, [g])
         np.testing.assert_allclose(out["p1"], params["p1"])  # excluded: no decay
         assert not np.allclose(np.asarray(out["p0"]), np.asarray(params["p0"]))
+
+
+class TestRegularizer:
+    """paddle.regularizer L1Decay/L2Decay as optimizer weight_decay
+    (reference: regularizer.py:20,82 over append_regularization_ops)."""
+
+    def test_l2decay_object_equals_float_coeff(self):
+        w0 = jnp.full((4,), 2.0)
+        g = {"w": jnp.zeros((4,))}
+
+        def run(wd):
+            opt = opt_mod.Momentum(learning_rate=0.1, momentum=0.9,
+                                weight_decay=wd)
+            state = opt.init({"w": w0})
+            p = {"w": w0}
+            for _ in range(5):
+                p, state = opt.update(g, state, p)
+            return np.asarray(p["w"])
+
+        np.testing.assert_allclose(run(0.01),
+                                   run(paddle.regularizer.L2Decay(0.01)))
+
+    def test_l1decay_gradient_is_sign(self):
+        w0 = jnp.asarray([2.0, -3.0, 0.5, -0.1])
+        opt = opt_mod.SGD(learning_rate=0.1,
+                       weight_decay=paddle.regularizer.L1Decay(0.05))
+        state = opt.init({"w": w0})
+        p, _ = opt.update({"w": jnp.zeros_like(w0)}, state, {"w": w0})
+        want = np.asarray(w0) - 0.1 * 0.05 * np.sign(np.asarray(w0))
+        np.testing.assert_allclose(np.asarray(p["w"]), want, rtol=1e-6)
+
+    def test_l1_drives_weights_to_zero(self):
+        w = {"w": jnp.full((8,), 0.3)}
+        opt = opt_mod.SGD(learning_rate=0.1,
+                       weight_decay=paddle.regularizer.L1Decay(0.5))
+        state = opt.init(w)
+        for _ in range(200):
+            w, state = opt.update({"w": jnp.zeros((8,))}, state, w)
+        # pure L1 decay oscillates around zero within one step size
+        assert np.abs(np.asarray(w["w"])).max() <= 0.1 * 0.5 + 1e-6
+
+
+    def test_adamw_accepts_l2decay_rejects_l1(self):
+        a = opt_mod.AdamW(learning_rate=1e-3,
+                          weight_decay=paddle.regularizer.L2Decay(0.02))
+        assert a._coeff == 0.02
+        with pytest.raises(Exception, match="decoupled"):
+            opt_mod.AdamW(weight_decay=paddle.regularizer.L1Decay(0.02))
