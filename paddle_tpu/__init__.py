@@ -81,10 +81,12 @@ from . import text  # noqa: F401
 from . import distribution  # noqa: F401
 from . import hapi  # noqa: F401
 from . import incubate  # noqa: F401
+from . import compat  # noqa: F401
 from . import dataset  # noqa: F401
 from . import jit  # noqa: F401
 from . import reader  # noqa: F401
 from . import regularizer  # noqa: F401
+from . import sysconfig  # noqa: F401
 from . import utils  # noqa: F401
 from . import inference  # noqa: F401
 from . import static  # noqa: F401

@@ -1,0 +1,68 @@
+"""paddle.compat — string/number helpers kept for 1.x source compat.
+
+Parity: python/paddle/compat.py (to_text:36, to_bytes:120, round:193,
+floor_division:219, get_exception_message:236).  The reference carried
+these for the py2→py3 transition; ported scripts still import them.
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = ["to_text", "to_bytes", "round", "floor_division",
+           "get_exception_message"]
+
+
+def _convert(obj, conv, inplace):
+    """Elementwise over list/set/dict (keys AND values, like the
+    reference compat.py:74 dict branch); scalars through ``conv``."""
+    if obj is None:
+        return obj
+    if isinstance(obj, (list, set)):
+        if inplace:
+            items = [_convert(i, conv, False) for i in obj]
+            obj.clear()
+            (obj.extend if isinstance(obj, list) else obj.update)(items)
+            return obj
+        return type(obj)(_convert(i, conv, False) for i in obj)
+    if isinstance(obj, dict):
+        new = {_convert(k, conv, False): _convert(v, conv, False)
+               for k, v in obj.items()}
+        if inplace:
+            obj.update(new)
+            return obj
+        return new
+    return conv(obj)
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """bytes → str (elementwise over list/set), str passthrough."""
+    def conv(o):
+        return o.decode(encoding) if isinstance(o, bytes) else str(o)
+
+    return _convert(obj, conv, inplace)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """str → bytes (elementwise over list/set), bytes passthrough."""
+    def conv(o):
+        return o.encode(encoding) if isinstance(o, str) else bytes(o)
+
+    return _convert(obj, conv, inplace)
+
+
+def round(x, d=0):  # noqa: A001 — paddle API name
+    """Python-2-style half-away-from-zero rounding (compat.py:193)."""
+    p = 10 ** d
+    if x > 0:
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    if x < 0:
+        return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+    return 0.0
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc) -> str:
+    return str(exc)

@@ -1,0 +1,28 @@
+"""paddle.sysconfig — include/lib paths for building native extensions.
+
+Parity: python/paddle/sysconfig.py:20,37.  The reference points at its
+bundled C++ headers and libpaddle; here native components are plain-C
+ABI over ctypes (paddle_tpu.native), so the include dir is the package's
+native source tree and the lib dir is the per-user build cache where the
+shared objects land after their first-use compile.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include() -> str:
+    """Directory holding the native C/C++ sources and headers."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+
+
+def get_lib() -> str:
+    """Directory holding the compiled native shared objects (created here
+    if no native component has built yet — a -L flag must point at an
+    existing directory)."""
+    from .native import _CACHE_DIR
+
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    return _CACHE_DIR

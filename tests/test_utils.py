@@ -50,3 +50,37 @@ class TestUtils:
         assert mod.sqrt(4) == 2
         with pytest.raises(ImportError, match="pip install"):
             paddle.utils.try_import("definitely_not_a_module_xyz")
+
+
+class TestCompatSysconfig:
+    """paddle.compat (compat.py:36,120,193) + paddle.sysconfig."""
+
+    def test_to_text_to_bytes(self):
+        assert paddle.compat.to_text(b"abc") == "abc"
+        assert paddle.compat.to_bytes("abc") == b"abc"
+        assert paddle.compat.to_text([b"a", b"b"]) == ["a", "b"]
+        assert paddle.compat.to_bytes({"a"}) == {b"a"}
+        # dicts convert keys AND values (reference compat.py:74)
+        assert paddle.compat.to_text({b"k": b"v"}) == {"k": "v"}
+        lst = [b"x"]
+        out = paddle.compat.to_text(lst, inplace=True)
+        assert out is lst and lst == ["x"]
+
+    def test_round_half_away_from_zero(self):
+        assert paddle.compat.round(0.5) == 1.0
+        assert paddle.compat.round(-0.5) == -1.0
+        assert paddle.compat.round(2.675, 2) == 2.68
+        assert paddle.compat.round(0.0) == 0.0
+
+    def test_misc(self):
+        assert paddle.compat.floor_division(7, 2) == 3
+        assert paddle.compat.get_exception_message(ValueError("x")) == "x"
+
+    def test_sysconfig_paths(self):
+        import os
+
+        inc = paddle.sysconfig.get_include()
+        assert os.path.isdir(inc)
+        assert any(f.endswith(".cc") for f in os.listdir(inc))
+        lib = paddle.sysconfig.get_lib()
+        assert os.path.isdir(lib)  # must exist even before any native build
