@@ -1,0 +1,314 @@
+"""Sequence decoding: ``Decoder`` / ``BeamSearchDecoder`` / ``dynamic_decode``.
+
+Parity surface: paddle.nn.{BeamSearchDecoder, dynamic_decode} (reference:
+python/paddle/fluid/layers/rnn.py:751 Decoder, :864 BeamSearchDecoder,
+:1567 dynamic_decode; backtrace op operators/gather_tree_op.h:27).
+
+TPU-native design: the reference builds a ``While`` op over a static
+Program (declarative) or runs a Python loop with per-step array appends
+(imperative).  Here the whole decode is ONE ``lax.while_loop`` with
+preallocated ``[max_steps, ...]`` output buffers written by
+``dynamic_update_index`` — XLA compiles a single early-exiting device
+loop (stops as soon as every sequence is finished), and the function is
+jit/vmap/shard-compatible.  Output step-structure is discovered with
+``jax.eval_shape`` (no throwaway execution).
+
+Semantic notes kept from the reference:
+* ``decoder.tracks_own_finished`` — beam search reorders beams, so its
+  own ``finished`` replaces (not ORs into) the loop tracker
+  (rnn.py:1371-1379).
+* finished-beam probability masking forces all mass onto ``end_token``
+  (``_mask_probs``, rnn.py:1025).
+* ``impute_finished`` freezes states of finished sequences using the
+  pre-step finished mask (declarative path semantics, rnn.py:1508).
+"""
+from __future__ import annotations
+
+import collections
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.errors import InvalidArgumentError
+from .layer_base import Layer
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+_KINF = 1e9
+
+
+class Decoder:
+    """Abstract decode-step provider for ``dynamic_decode`` (reference:
+    fluid/layers/rnn.py:751).  Subclasses implement ``initialize`` /
+    ``step`` / optionally ``finalize``; every method must be traceable
+    (jnp ops, no data-dependent Python control flow) so the decode loop
+    compiles to a single XLA while."""
+
+    def initialize(self, inits):
+        """→ (initial_inputs, initial_states, finished)."""
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        """→ (outputs, next_states, next_inputs, finished)."""
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """→ (final_outputs, final_states); optional."""
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search decoding over a cell (reference: fluid/layers/rnn.py:864).
+
+    The cell sees merged ``[batch*beam, ...]`` tensors; beam bookkeeping
+    (score accumulation, finished masking, top-k over ``beam*vocab``,
+    ancestor gathers) happens in ``[batch, beam, ...]`` — all dense jnp
+    ops, so the whole step fuses into the decode while-loop.
+    """
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished", "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+
+    # -- shape plumbing ------------------------------------------------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] → [batch*beam, ...] with each entry repeated
+        ``beam_size`` times (for attention memories etc., rnn.py:933)."""
+        x = jnp.asarray(x)
+        return jnp.repeat(x, beam_size, axis=0)
+
+    def _split_batch_beams(self, x):
+        x = jnp.asarray(x)
+        return x.reshape((-1, self.beam_size) + x.shape[1:])
+
+    def _merge_batch_beams(self, x):
+        x = jnp.asarray(x)
+        return x.reshape((-1,) + x.shape[2:])
+
+    def _expand_to_beam_size(self, x):
+        x = jnp.asarray(x)
+        return jnp.broadcast_to(
+            x[:, None], (x.shape[0], self.beam_size) + x.shape[1:])
+
+    def _gather(self, x, indices):
+        """x: [batch, beam, ...]; indices: [batch, beam] beam ids →
+        reordered x (take_along_axis replaces the reference's
+        coordinate-stack + gather_nd, rnn.py:1054)."""
+        x = jnp.asarray(x)
+        idx = indices.reshape(indices.shape + (1,) * (x.ndim - 2))
+        return jnp.take_along_axis(x, idx.astype(jnp.int32), axis=1)
+
+    # -- decode protocol ----------------------------------------------
+    def initialize(self, initial_cell_states):
+        states = jax.tree_util.tree_map(self._expand_to_beam_size,
+                                        initial_cell_states)
+        leaf = jax.tree_util.tree_leaves(initial_cell_states)[0]
+        batch = leaf.shape[0]
+        init_ids = jnp.full((batch, self.beam_size), self.start_token,
+                            jnp.int64)
+        # beam 0 live, the rest dead — standard first-step tie-break
+        log_probs = jnp.tile(
+            jnp.asarray([[0.0] + [-_KINF] * (self.beam_size - 1)],
+                        jnp.float32), (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        lengths = jnp.zeros((batch, self.beam_size), jnp.int64)
+        inputs = (self.embedding_fn(init_ids) if self.embedding_fn
+                  else init_ids)
+        return inputs, self.StateWrapper(states, log_probs, finished,
+                                         lengths), finished
+
+    def _beam_search_step(self, time, logits, next_cell_states, beam_state):
+        vocab = logits.shape[-1]
+        step_log_probs = jax.nn.log_softmax(
+            jnp.asarray(logits, jnp.float32), axis=-1)
+        # finished beams: all probability mass on end_token (rnn.py:1025)
+        noend = jnp.full((vocab,), -_KINF, jnp.float32)
+        noend = noend.at[self.end_token].set(0.0)
+        step_log_probs = jnp.where(beam_state.finished[:, :, None], noend,
+                                   step_log_probs)
+        log_probs = step_log_probs + beam_state.log_probs[:, :, None]
+        scores = log_probs.reshape(-1, self.beam_size * vocab)
+        topk_scores, topk_indices = jax.lax.top_k(scores, self.beam_size)
+        beam_indices = (topk_indices // vocab).astype(jnp.int64)
+        token_indices = (topk_indices % vocab).astype(jnp.int64)
+        next_log_probs = jnp.take_along_axis(scores, topk_indices, axis=1)
+        next_cell_states = jax.tree_util.tree_map(
+            lambda x: self._gather(x, beam_indices), next_cell_states)
+        next_finished = self._gather(beam_state.finished, beam_indices)
+        next_lengths = self._gather(beam_state.lengths, beam_indices)
+        next_lengths = next_lengths + (~next_finished).astype(jnp.int64)
+        next_finished = next_finished | (token_indices == self.end_token)
+        output = self.OutputWrapper(topk_scores, token_indices, beam_indices)
+        state = self.StateWrapper(next_cell_states, next_log_probs,
+                                  next_finished, next_lengths)
+        return output, state
+
+    def step(self, time, inputs, states, **kwargs):
+        inputs = jax.tree_util.tree_map(self._merge_batch_beams, inputs)
+        cell_states = jax.tree_util.tree_map(self._merge_batch_beams,
+                                             states.cell_states)
+        cell_outputs, next_cell_states = self.cell(inputs, cell_states,
+                                                   **kwargs)
+        cell_outputs = jax.tree_util.tree_map(self._split_batch_beams,
+                                              cell_outputs)
+        next_cell_states = jax.tree_util.tree_map(self._split_batch_beams,
+                                                  next_cell_states)
+        if self.output_fn is not None:
+            cell_outputs = self.output_fn(cell_outputs)
+        output, state = self._beam_search_step(time, cell_outputs,
+                                               next_cell_states, states)
+        sample_ids = output.predicted_ids
+        next_inputs = (self.embedding_fn(sample_ids) if self.embedding_fn
+                       else sample_ids)
+        return output, state, next_inputs, state.finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        from .functional.extension import gather_tree
+
+        predicted_ids = gather_tree(outputs.predicted_ids,
+                                    outputs.parent_ids)
+        return predicted_ids, final_states
+
+    def output_padding(self, out_shapes):
+        """Buffer-tail padding for steps past all-finished early exit
+        (consumed by dynamic_decode): exactly what a post-finish step
+        would emit — EOS tokens, identity parents, zero scores — so the
+        gather_tree backtrace passes straight through the tail rows.
+        Without this, zero-filled parents would reroute every beam's
+        ancestry through slot 0 under jit (where the tail can't be
+        sliced off)."""
+        batch, beam = out_shapes.parent_ids.shape
+        return self.OutputWrapper(
+            scores=jnp.zeros((batch, beam), out_shapes.scores.dtype),
+            predicted_ids=jnp.full((batch, beam), self.end_token,
+                                   out_shapes.predicted_ids.dtype),
+            parent_ids=jnp.broadcast_to(
+                jnp.arange(beam, dtype=out_shapes.parent_ids.dtype),
+                (batch, beam)),
+        )
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def _transpose_batch_time(x):
+    return jnp.swapaxes(jnp.asarray(x), 0, 1)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run ``decoder`` until every sequence finishes or ``max_step_num``
+    steps elapsed (reference: fluid/layers/rnn.py:1567).
+
+    One ``lax.while_loop`` over preallocated output buffers — the loop
+    exits early on the device when all sequences finish; under jit the
+    time dimension of the outputs is ``max_step_num + 1`` (XLA static
+    shapes), eagerly it is sliced to the steps actually executed, which
+    matches the reference's dynamic-length outputs.
+    """
+    if max_step_num is None:
+        max_step_num = 255  # reference decodes unbounded; XLA needs a cap
+    max_steps = int(max_step_num) + 1  # ref loop runs until step > max
+
+    initial_inputs, initial_states, initial_finished = decoder.initialize(
+        inits)
+    initial_finished = jnp.asarray(initial_finished)
+    seq_len0 = jnp.zeros(initial_finished.shape, jnp.int64)
+
+    # discover the per-step output structure without running a step
+    out_shapes = jax.eval_shape(
+        lambda i, s: decoder.step(jnp.asarray(0, jnp.int64), i, s,
+                                  **kwargs)[0],
+        initial_inputs, initial_states)
+    # rows past the early exit keep their initial value (the loop never
+    # writes them); let the decoder pick padding that means "decoding
+    # already finished" — beam search needs identity parents + EOS ids
+    # there or finalize's backtrace corrupts under jit
+    pad = (decoder.output_padding(out_shapes)
+           if hasattr(decoder, "output_padding") else
+           jax.tree_util.tree_map(
+               lambda sd: jnp.zeros(tuple(sd.shape), sd.dtype), out_shapes))
+    out_bufs = jax.tree_util.tree_map(
+        lambda p: jnp.broadcast_to(p, (max_steps,) + p.shape), pad)
+
+    def cond(carry):
+        time, _, _, finished, _, _ = carry
+        return (time < max_steps) & ~jnp.all(finished)
+
+    def body(carry):
+        time, inputs, states, finished, seq_lens, bufs = carry
+        outputs, next_states, next_inputs, step_finished = decoder.step(
+            time, inputs, states, **kwargs)
+        if decoder.tracks_own_finished:
+            next_finished = jnp.asarray(step_finished)
+        else:
+            next_finished = jnp.asarray(step_finished) | finished
+        # count this step for every sequence not ALREADY finished — the
+        # EOS-emitting step is included (reference declarative path,
+        # rnn.py:1502 adds ¬global_finished before updating it)
+        next_seq_lens = seq_lens + (~finished).astype(jnp.int64)
+        if impute_finished:  # freeze finished sequences' states
+            next_states = jax.tree_util.tree_map(
+                lambda old, new: jnp.where(
+                    finished.reshape(finished.shape + (1,) *
+                                     (jnp.asarray(new).ndim - finished.ndim)),
+                    old, new),
+                states, next_states)
+        bufs = jax.tree_util.tree_map(
+            lambda buf, o: jax.lax.dynamic_update_index_in_dim(
+                buf, jnp.asarray(o, buf.dtype), time, axis=0),
+            bufs, outputs)
+        return (time + 1, next_inputs, next_states, next_finished,
+                next_seq_lens, bufs)
+
+    carry = (jnp.asarray(0, jnp.int64), initial_inputs, initial_states,
+             initial_finished, seq_len0, out_bufs)
+    time, _, final_states, _, sequence_lengths, out_bufs = (
+        jax.lax.while_loop(cond, body, carry))
+
+    if not isinstance(time, jax.core.Tracer):  # eager: true dynamic length
+        steps = int(time)
+        out_bufs = jax.tree_util.tree_map(lambda b: b[:steps], out_bufs)
+
+    final_outputs = out_bufs
+    try:
+        final_outputs, final_states = decoder.finalize(
+            final_outputs, final_states, sequence_lengths)
+    except NotImplementedError:
+        pass
+
+    if not output_time_major:
+        final_outputs = jax.tree_util.tree_map(_transpose_batch_time,
+                                               final_outputs)
+    return ((final_outputs, final_states, sequence_lengths)
+            if return_length else (final_outputs, final_states))
+
+
+class _DecodeHelperCell:
+    """Adapter: a paddle RNN cell Layer → the (inputs, states) → (out,
+    new_states) callable BeamSearchDecoder expects.  Layers already have
+    that signature; this exists for callables needing kwargs bound."""
+
+    def __init__(self, cell, **kwargs):
+        self._cell = cell
+        self._kwargs = kwargs
+
+    def __call__(self, inputs, states):
+        return self._cell(inputs, states, **self._kwargs)

@@ -1,16 +1,16 @@
-"""Extension ops — row_conv, diag_embed.
+"""Extension ops — row_conv, diag_embed, gather_tree.
 
 Parity: python/paddle/nn/functional/extension.py (row_conv:151,
-diag_embed) over operators/row_conv_op.cc and diag_embed_op.cc.  Both are
-data-layout ops: row_conv is the DeepSpeech2 lookahead convolution (a
-causal-in-reverse depthwise conv along time), diag_embed builds batched
-diagonal matrices.
+diag_embed) over operators/row_conv_op.cc and diag_embed_op.cc, plus the
+beam-search backtrace op gather_tree (fluid/layers/nn.py:14972 over
+operators/gather_tree_op.h:27).
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
-__all__ = ["row_conv", "diag_embed"]
+__all__ = ["row_conv", "diag_embed", "gather_tree"]
 
 
 def row_conv(input, weight, act=None, name=None):
@@ -54,3 +54,33 @@ def diag_embed(input, offset: int = 0, dim1: int = -2, dim2: int = -1,
     if (d1, d2) != (ndim - 2, ndim - 1):
         out = jnp.moveaxis(out, (ndim - 2, ndim - 1), (d1, d2))
     return out
+
+
+def gather_tree(ids, parents):
+    """Backtrace beam-search ancestry to full sequences (reference kernel
+    operators/gather_tree_op.h:27): for each (batch, beam) start from the
+    last step's own slot and follow ``parents`` backwards, reading
+    ``ids`` along the path.
+
+    ids/parents: int ``[max_time, batch, beam]`` → same-shape output.
+    TPU-native: one reversed ``lax.scan`` carrying the current ancestor
+    slot per (batch, beam) — no host loop, jit/grad-safe (int path).
+    """
+    ids = jnp.asarray(ids)
+    parents = jnp.asarray(parents)
+    if ids.ndim != 3:
+        raise ValueError(f"gather_tree expects [time, batch, beam], "
+                         f"got shape {ids.shape}")
+    beam = ids.shape[2]
+
+    def step(slot, xs):
+        ids_t, parents_t = xs  # [batch, beam] each
+        out_t = jnp.take_along_axis(ids_t, slot, axis=1)
+        next_slot = jnp.take_along_axis(parents_t, slot, axis=1)
+        return next_slot, out_t
+
+    # last step reads its own slot; earlier steps follow the parent chain
+    init = jnp.broadcast_to(jnp.arange(beam, dtype=parents.dtype),
+                            ids.shape[1:])
+    _, rev = jax.lax.scan(step, init, (jnp.flip(ids, 0), jnp.flip(parents, 0)))
+    return jnp.flip(rev, 0)

@@ -1,0 +1,208 @@
+"""Seq2seq decoding: gather_tree, BeamSearchDecoder, dynamic_decode.
+
+Reference behavior: fluid/layers/rnn.py:864 (BeamSearchDecoder), :1567
+(dynamic_decode); operators/gather_tree_op.h:27 (backtrace kernel —
+replicated in numpy as the oracle, per SURVEY §4 OpTest style).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def _gather_tree_np(ids, parents):
+    """Numpy oracle transcribing gather_tree_op.h:27 semantics."""
+    T, B, W = ids.shape
+    out = np.zeros_like(ids)
+    for b in range(B):
+        for k in range(W):
+            out[T - 1, b, k] = ids[T - 1, b, k]
+            parent = parents[T - 1, b, k]
+            for t in range(T - 2, -1, -1):
+                out[t, b, k] = ids[t, b, parent]
+                parent = parents[t, b, parent]
+    return out
+
+
+class TestGatherTree:
+    def test_matches_kernel_oracle(self):
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 23, size=(6, 3, 4)).astype(np.int64)
+        parents = rng.randint(0, 4, size=(6, 3, 4)).astype(np.int64)
+        out = F.gather_tree(ids, parents)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      _gather_tree_np(ids, parents))
+
+    def test_reference_docstring_example(self):
+        # fluid/layers/nn.py gather_tree doc example
+        ids = np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]],
+                       np.int64)
+        parents = np.array(
+            [[[0, 0], [1, 1]], [[1, 0], [1, 0]], [[0, 0], [0, 1]]], np.int64)
+        expected = np.array(
+            [[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]], np.int64)
+        np.testing.assert_array_equal(np.asarray(F.gather_tree(ids, parents)),
+                                      expected)
+
+    def test_jit(self):
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, 11, size=(5, 2, 3)).astype(np.int64)
+        parents = rng.randint(0, 3, size=(5, 2, 3)).astype(np.int64)
+        out = jax.jit(F.gather_tree)(ids, parents)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      _gather_tree_np(ids, parents))
+
+
+def _make_decoder(vocab=17, hidden=16, beam=4, end_token=1):
+    paddle.seed(7)
+    embedder = nn.Embedding(vocab, hidden)
+    out_layer = nn.Linear(hidden, vocab)
+    cell = nn.GRUCell(input_size=hidden, hidden_size=hidden)
+    decoder = nn.BeamSearchDecoder(cell, start_token=0, end_token=end_token,
+                                   beam_size=beam, embedding_fn=embedder,
+                                   output_fn=out_layer)
+    return decoder, cell
+
+
+class TestBeamSearchDecode:
+    def test_shapes_and_types(self):
+        beam, batch, hidden = 4, 3, 16
+        decoder, cell = _make_decoder(beam=beam, hidden=hidden)
+        init = jnp.zeros((batch, hidden), jnp.float32)
+        (outputs, final_states), = [nn.dynamic_decode(decoder, inits=init,
+                                                      max_step_num=9)]
+        # predicted_ids backtraced via gather_tree: [batch, T, beam]
+        assert outputs.shape[0] == batch and outputs.shape[2] == beam
+        assert outputs.shape[1] <= 10
+        assert np.issubdtype(np.asarray(outputs).dtype, np.integer)
+        assert final_states.lengths.shape == (batch, beam)
+
+    def test_time_major_and_lengths(self):
+        decoder, _ = _make_decoder()
+        init = jnp.zeros((2, 16), jnp.float32)
+        outputs, final_states, lengths = nn.dynamic_decode(
+            decoder, inits=init, max_step_num=7, output_time_major=True,
+            return_length=True)
+        assert outputs.shape[1] == 2  # [T, batch, beam]
+        assert lengths.shape == (2, 4)
+        assert int(np.max(np.asarray(lengths))) <= outputs.shape[0]
+
+    def test_beams_sorted_and_finished_padding(self):
+        """Top beam has the best accumulated score; finished beams keep
+        emitting end_token (mass forced onto EOS, rnn.py:1025)."""
+        decoder, _ = _make_decoder(end_token=1)
+        init = jnp.zeros((5, 16), jnp.float32)
+        outputs, final_states = nn.dynamic_decode(decoder, inits=init,
+                                                  max_step_num=19)
+        log_probs = np.asarray(final_states.log_probs)
+        assert (np.diff(log_probs, axis=1) <= 1e-5).all(), \
+            "beams not sorted by score"
+        ids = np.asarray(outputs)  # [batch, T, beam]
+        lengths = np.asarray(final_states.lengths)
+        fin = np.asarray(final_states.finished)
+        for b in range(ids.shape[0]):
+            for k in range(ids.shape[2]):
+                if fin[b, k]:
+                    L = lengths[b, k]
+                    assert (ids[b, L - 1:, k] == 1).all(), \
+                        "finished beam must be EOS-padded"
+
+    def test_jit_compiles_single_while(self):
+        decoder, _ = _make_decoder()
+
+        @jax.jit
+        def decode(init):
+            out, states = nn.dynamic_decode(decoder, inits=init,
+                                            max_step_num=9)
+            return out, states.lengths
+
+        init = jnp.zeros((2, 16), jnp.float32)
+        out, lengths = decode(init)
+        assert out.shape == (2, 10, 4)  # static T under jit
+        out2, _ = decode(init + 0)  # cache hit, same shapes
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+    def test_beam1_matches_greedy(self):
+        """beam_size=1 beam search IS greedy decoding — verify against a
+        hand-rolled argmax loop over the same cell/embedder."""
+        vocab, hidden = 13, 8
+        paddle.seed(11)
+        embedder = nn.Embedding(vocab, hidden)
+        out_layer = nn.Linear(hidden, vocab)
+        cell = nn.GRUCell(input_size=hidden, hidden_size=hidden)
+        decoder = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                       beam_size=1, embedding_fn=embedder,
+                                       output_fn=out_layer)
+        init = jnp.asarray(np.random.RandomState(3).randn(2, hidden),
+                           jnp.float32)
+        outputs, _ = nn.dynamic_decode(decoder, inits=init, max_step_num=11)
+        got = np.asarray(outputs)[:, :, 0]  # [batch, T]
+
+        # greedy oracle
+        state = init
+        tok = jnp.zeros((2,), jnp.int64)
+        want = []
+        done = np.zeros(2, bool)
+        for _ in range(got.shape[1]):
+            h, state = cell(embedder(tok), state)
+            logits = np.asarray(out_layer(h))
+            nxt = logits.argmax(-1)
+            nxt = np.where(done, 1, nxt)
+            want.append(nxt)
+            done |= nxt == 1
+            tok = jnp.asarray(nxt, jnp.int64)
+        want = np.stack(want, 1)
+        np.testing.assert_array_equal(got, want)
+
+    def test_tile_beam_merge_with_batch(self):
+        x = np.arange(6).reshape(3, 2).astype(np.float32)
+        tiled = nn.BeamSearchDecoder.tile_beam_merge_with_batch(x, 2)
+        assert tiled.shape == (6, 2)
+        np.testing.assert_array_equal(np.asarray(tiled)[0],
+                                      np.asarray(tiled)[1])
+
+    def test_jit_early_finish_matches_eager(self):
+        """Under jit the output buffer keeps its full [max_steps] length;
+        the tail past the early exit must be inert padding (EOS ids,
+        identity parents) so backtraced sequences match the eager run."""
+        decoder, _ = _make_decoder(end_token=1)
+        init = jnp.zeros((3, 16), jnp.float32)
+        eager_out, eager_states = nn.dynamic_decode(decoder, inits=init,
+                                                    max_step_num=30)
+        jit_out, jit_states = jax.jit(
+            lambda i: nn.dynamic_decode(decoder, inits=i,
+                                        max_step_num=30))(init)
+        eager_np = np.asarray(eager_out)
+        jit_np = np.asarray(jit_out)
+        T = eager_np.shape[1]
+        np.testing.assert_array_equal(jit_np[:, :T], eager_np)
+        assert (jit_np[:, T:] == 1).all(), "tail must be EOS padding"
+        np.testing.assert_allclose(np.asarray(jit_states.log_probs),
+                                   np.asarray(eager_states.log_probs),
+                                   atol=1e-5)
+
+    def test_early_exit_eager_slices_time(self):
+        """Eagerly, outputs are sliced to the steps actually run — an
+        immediately-finishing decode is short even with a large cap."""
+        vocab, hidden = 7, 8
+        paddle.seed(5)
+        cell = nn.GRUCell(input_size=hidden, hidden_size=hidden)
+        embedder = nn.Embedding(vocab, hidden)
+
+        def force_eos(h):  # every step scores EOS (=1) highest
+            base = jnp.full(h.shape[:-1] + (vocab,), -5.0, h.dtype)
+            return base.at[..., 1].set(5.0)
+
+        decoder = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                       beam_size=2, embedding_fn=embedder,
+                                       output_fn=force_eos)
+        outputs, _ = nn.dynamic_decode(
+            decoder, inits=jnp.zeros((2, hidden), jnp.float32),
+            max_step_num=199)
+        assert outputs.shape[1] <= 3, \
+            f"early exit failed, decoded {outputs.shape[1]} steps"
