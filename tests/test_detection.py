@@ -1,0 +1,253 @@
+"""Detection ops: iou_similarity, bipartite_match, target_assign,
+mine_hard_examples, box_coder, ssd_loss, prior_box.
+
+Oracles transcribe the reference kernels in numpy (SURVEY §4 OpTest
+style): operators/detection/{iou_similarity_op.h, bipartite_match_op.cc,
+mine_hard_examples_op.cc, box_coder_op.h}.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+
+def _iou_np(x, y, normalized=True):
+    off = 0.0 if normalized else 1.0
+    out = np.zeros((x.shape[0], y.shape[0]), np.float64)
+    for i, a in enumerate(x):
+        for j, b in enumerate(y):
+            iw = min(a[2], b[2]) - max(a[0], b[0]) + off
+            ih = min(a[3], b[3]) - max(a[1], b[1]) + off
+            inter = max(iw, 0) * max(ih, 0)
+            ua = ((a[2] - a[0] + off) * (a[3] - a[1] + off)
+                  + (b[2] - b[0] + off) * (b[3] - b[1] + off) - inter)
+            out[i, j] = inter / ua if ua > 0 else 0.0
+    return out
+
+
+def _bipartite_np(dist, match_type="bipartite", threshold=0.5):
+    """Transcribes BipartiteMatch + ArgMaxMatch (bipartite_match_op.cc)."""
+    G, P = dist.shape
+    match = np.full(P, -1, np.int32)
+    mdist = np.zeros(P, dist.dtype)
+    row_pool = list(range(G))
+    while row_pool:
+        best = (-1, -1, -1.0)
+        for j in range(P):
+            if match[j] != -1:
+                continue
+            for i in row_pool:
+                if dist[i, j] < 1e-6:
+                    continue
+                if dist[i, j] > best[2]:
+                    best = (i, j, dist[i, j])
+        if best[0] == -1:
+            break
+        match[best[1]] = best[0]
+        mdist[best[1]] = best[2]
+        row_pool.remove(best[0])
+    if match_type == "per_prediction":
+        for j in range(P):
+            if match[j] != -1:
+                continue
+            cand = [(dist[i, j], i) for i in range(G)
+                    if dist[i, j] >= max(threshold, 1e-6)]
+            if cand:
+                d, i = max(cand)
+                match[j] = i
+                mdist[j] = d
+    return match, mdist
+
+
+class TestIouSimilarity:
+    def test_reference_doc_example(self):
+        x = np.array([[0.5, 0.5, 2.0, 2.0], [0., 0., 1.0, 1.0]], np.float32)
+        y = np.array([[1.0, 1.0, 2.5, 2.5]], np.float32)
+        out = np.asarray(F.iou_similarity(x, y))
+        np.testing.assert_allclose(out, [[0.2857143], [0.0]], atol=1e-6)
+
+    @pytest.mark.parametrize("normalized", [True, False])
+    def test_vs_oracle(self, normalized):
+        rng = np.random.RandomState(0)
+        mins = rng.uniform(0, 5, size=(7, 2))
+        x = np.concatenate([mins, mins + rng.uniform(0.5, 4, (7, 2))], 1)
+        mins = rng.uniform(0, 5, size=(9, 2))
+        y = np.concatenate([mins, mins + rng.uniform(0.5, 4, (9, 2))], 1)
+        out = np.asarray(F.iou_similarity(x.astype(np.float32),
+                                          y.astype(np.float32),
+                                          box_normalized=normalized))
+        np.testing.assert_allclose(out, _iou_np(x, y, normalized), atol=1e-5)
+
+
+class TestBipartiteMatch:
+    @pytest.mark.parametrize("match_type", ["bipartite", "per_prediction"])
+    def test_vs_oracle(self, match_type):
+        rng = np.random.RandomState(1)
+        for _ in range(4):
+            dist = rng.uniform(0, 1, size=(5, 12)).astype(np.float32)
+            dist[rng.uniform(size=dist.shape) < 0.3] = 0.0
+            idx, d = F.bipartite_match(dist, match_type, 0.5)
+            widx, wd = _bipartite_np(dist, match_type, 0.5)
+            np.testing.assert_array_equal(np.asarray(idx)[0], widx)
+            np.testing.assert_allclose(np.asarray(d)[0], wd, atol=1e-6)
+
+    def test_each_gt_matched_once(self):
+        rng = np.random.RandomState(2)
+        dist = rng.uniform(0.1, 1, size=(4, 10)).astype(np.float32)
+        idx, _ = F.bipartite_match(dist)
+        matched = np.asarray(idx)[0]
+        pos = matched[matched != -1]
+        assert len(np.unique(pos)) == len(pos) == 4
+
+
+class TestTargetAssign:
+    def test_labels_and_weights(self):
+        labels = jnp.asarray([[[3], [5]]], jnp.int64)  # [1, G=2, 1]
+        match = jnp.asarray([[0, -1, 1, -1]], jnp.int32)
+        out, w = F.target_assign(labels, match, mismatch_value=0)
+        np.testing.assert_array_equal(np.asarray(out)[0, :, 0], [3, 0, 5, 0])
+        np.testing.assert_array_equal(np.asarray(w)[0, :, 0], [1, 0, 1, 0])
+
+    def test_negative_mask_weights(self):
+        labels = jnp.zeros((1, 2, 1), jnp.int64)
+        match = jnp.asarray([[0, -1, -1, 1]], jnp.int32)
+        neg = jnp.asarray([[False, True, False, False]])
+        _, w = F.target_assign(labels, match, negative_mask=neg)
+        np.testing.assert_array_equal(np.asarray(w)[0, :, 0], [1, 1, 0, 1])
+
+    def test_per_prior_gather(self):
+        x = jnp.asarray(np.arange(2 * 3 * 4 * 4).reshape(2, 3, 4, 4),
+                        jnp.float32)  # [N, G, P, K]
+        match = jnp.asarray([[2, -1, 0, 1], [-1, 1, 1, -1]], jnp.int32)
+        out, _ = F.target_assign(x, match, mismatch_value=-9)
+        xn = np.asarray(x)
+        for n in range(2):
+            for p in range(4):
+                m = np.asarray(match)[n, p]
+                want = xn[n, m, p] if m != -1 else np.full(4, -9.0)
+                np.testing.assert_array_equal(np.asarray(out)[n, p], want)
+
+
+class TestMineHardExamples:
+    def test_quota_and_ordering(self):
+        """2 positives, ratio 1.5 → 3 negatives, the highest-loss eligible."""
+        cls_loss = jnp.asarray(
+            [[0.1, 0.9, 0.5, 0.7, 0.3, 0.2, 0.8, 0.4]], jnp.float32)
+        match = jnp.asarray([[0, -1, -1, -1, -1, -1, 1, -1]], jnp.int32)
+        dist = jnp.asarray([[0.9, 0.1, 0.2, 0.1, 0.1, 0.7, 0.8, 0.1]],
+                           jnp.float32)
+        neg, updated = F.mine_hard_examples(
+            cls_loss, match, dist, neg_pos_ratio=1.5, neg_dist_threshold=0.5)
+        # eligible: cols 1,2,3,4,7 (unmatched & dist<0.5); top-3 by loss:
+        # col1 (.9), col3 (.7), col2 (.5)
+        np.testing.assert_array_equal(
+            np.asarray(neg)[0],
+            [False, True, True, True, False, False, False, False])
+        np.testing.assert_array_equal(np.asarray(updated), np.asarray(match))
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.RandomState(3)
+        mins = rng.uniform(0, 5, (6, 2))
+        priors = np.concatenate([mins, mins + rng.uniform(1, 3, (6, 2))],
+                                1).astype(np.float32)
+        mins = rng.uniform(0, 5, (4, 2))
+        targets = np.concatenate([mins, mins + rng.uniform(1, 3, (4, 2))],
+                                 1).astype(np.float32)
+        var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+        enc = F.box_coder(priors, var, targets)  # [4, 6, 4]
+        assert enc.shape == (4, 6, 4)
+        dec = F.box_coder(priors, var, enc, code_type="decode_center_size")
+        # decoding each target's own encoding against the same prior
+        # recovers the target box
+        for g in range(4):
+            for p in range(6):
+                np.testing.assert_allclose(np.asarray(dec)[g, p],
+                                           targets[g], atol=1e-4)
+
+
+class TestSsdLoss:
+    def _inputs(self, N=2, P=8, C=4, G=3):
+        rng = np.random.RandomState(4)
+        loc = rng.randn(N, P, 4).astype(np.float32)
+        conf = rng.randn(N, P, C).astype(np.float32)
+        mins = rng.uniform(0, 0.6, (N, G, 2))
+        gt_box = np.concatenate(
+            [mins, mins + rng.uniform(0.1, 0.4, (N, G, 2))], -1
+        ).astype(np.float32)
+        gt_box[1, 2] = 0  # padded gt row — must be inert
+        gt_label = rng.randint(1, C, size=(N, G)).astype(np.int64)
+        mins = rng.uniform(0, 0.7, (P, 2))
+        priors = np.concatenate([mins, mins + rng.uniform(0.1, 0.4, (P, 2))],
+                                -1).astype(np.float32)
+        pvar = np.tile(np.array([[0.1, 0.1, 0.2, 0.2]], np.float32), (P, 1))
+        return loc, conf, gt_box, gt_label, priors, pvar
+
+    def test_shape_finite_positive(self):
+        loc, conf, gt_box, gt_label, priors, pvar = self._inputs()
+        loss = F.ssd_loss(loc, conf, gt_box, gt_label, priors, pvar[0])
+        assert loss.shape == (2, 1)
+        assert np.isfinite(np.asarray(loss)).all()
+        assert (np.asarray(loss) > 0).all()
+
+    def test_differentiable_and_jits(self):
+        loc, conf, gt_box, gt_label, priors, pvar = self._inputs()
+
+        @jax.jit
+        def total(loc, conf):
+            return jnp.sum(F.ssd_loss(loc, conf, gt_box, gt_label, priors,
+                                      pvar[0]))
+
+        g_loc, g_conf = jax.grad(total, argnums=(0, 1))(
+            jnp.asarray(loc), jnp.asarray(conf))
+        assert np.isfinite(np.asarray(g_loc)).all()
+        assert np.isfinite(np.asarray(g_conf)).all()
+        assert float(jnp.abs(g_conf).sum()) > 0
+
+    def test_perfect_predictions_lower_loss(self):
+        loc, conf, gt_box, gt_label, priors, pvar = self._inputs()
+        base = float(F.ssd_loss(loc, conf, gt_box, gt_label, priors,
+                                pvar[0]).sum())
+        enc = np.asarray(F.box_coder(priors, pvar[0], gt_box))  # [N,G,P,4]
+        iou = np.asarray(F.iou_similarity(gt_box, priors))
+        midx, _ = F.bipartite_match(iou, "per_prediction", 0.5)
+        midx = np.asarray(midx)
+        loc2 = loc.copy()
+        conf2 = np.full_like(conf, -8.0)
+        conf2[..., 0] = 8.0  # background everywhere...
+        for n in range(loc.shape[0]):
+            for p in range(loc.shape[1]):
+                if midx[n, p] != -1:
+                    loc2[n, p] = enc[n, midx[n, p], p]
+                    conf2[n, p, :] = -8.0
+                    conf2[n, p, gt_label[n, midx[n, p]]] = 8.0  # ...true class
+        better = float(F.ssd_loss(loc2, conf2, gt_box, gt_label, priors,
+                                  pvar[0]).sum())
+        assert better < base * 0.25, (better, base)
+
+
+class TestPriorBox:
+    def test_shapes_and_ranges(self):
+        feat = jnp.zeros((1, 8, 4, 6))
+        img = jnp.zeros((1, 3, 32, 48))
+        boxes, var = F.prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                                 aspect_ratios=[2.0], flip=True, clip=True)
+        # K = 1 (ar=1,min) + 1 (max) + 2 (ar=2, 1/2) = 4
+        assert boxes.shape == (4, 6, 4, 4)
+        assert var.shape == boxes.shape
+        b = np.asarray(boxes)
+        assert (b >= 0).all() and (b <= 1).all()
+        assert (b[..., 2] >= b[..., 0]).all()
+
+    def test_centers_follow_offset(self):
+        feat = jnp.zeros((1, 1, 2, 2))
+        img = jnp.zeros((1, 3, 20, 20))
+        boxes, _ = F.prior_box(feat, img, min_sizes=[4.0])
+        b = np.asarray(boxes)
+        cx = (b[..., 0] + b[..., 2]) / 2 * 20
+        np.testing.assert_allclose(cx[0, :, 0], [5.0, 15.0], atol=1e-5)
