@@ -102,6 +102,11 @@ import numpy as _np
 #: paddle_tpu.Tensor is jax.Array — no wrapper type (TPU-native design).
 Tensor = _jax.Array
 
+#: complex values are ordinary arrays with complex64/128 dtype (the
+#: reference's separate ComplexTensor wrapper, incubate/complex, is
+#: unnecessary — XLA supports complex natively).
+ComplexTensor = _jax.Array
+
 #: paddle.dtype parity: dtypes are numpy dtype objects.
 dtype = _np.dtype
 
@@ -158,6 +163,67 @@ def in_dygraph_mode() -> bool:
     """Parity: paddle.in_dygraph_mode — this framework has ONE runtime
     (eager trace-to-XLA), so it is always 'dygraph'."""
     return True
+
+
+def in_dynamic_mode() -> bool:
+    """2.0 rename of in_dygraph_mode (same single-runtime answer)."""
+    return True
+
+
+def grad(outputs=None, inputs=None, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """The reference's tape-based partial grad (paddle.grad,
+    imperative/partial_grad_engine.cc) needs an op tape recorded during
+    eager execution — this framework differentiates FUNCTIONS, not tapes
+    (SURVEY §7: jax vjp replaces BasicEngine).  Raises with the
+    functional migration path."""
+    from .framework.errors import UnimplementedError
+
+    raise UnimplementedError(
+        "paddle.grad(outputs, inputs): no autograd tape exists in this "
+        "framework — wrap the computation in a function and use "
+        "paddle.grad_fn(fn) (jax.grad) or jax.vjp for partial gradients")
+
+
+class CUDAPinnedPlace:
+    """Parity stub: pinned host staging is owned by the XLA runtime here
+    (SURVEY §2.5 translation); the class exists so place-dispatch code
+    imports, and compares unequal to real places."""
+
+    def __repr__(self):
+        return "CUDAPinnedPlace"
+
+
+def get_cudnn_version():
+    """Parity: None — no cuDNN in a TPU build (reference returns None
+    when not compiled with CUDA)."""
+    return None
+
+
+def get_cuda_rng_state():
+    """CUDA-named alias of the device RNG state (reference:
+    framework/generator.cc per-device states; ONE unified generator here)."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)
+
+
+def check_import_scipy(OsName=None):
+    """Parity no-op: the reference works around a Windows scipy DLL issue
+    (python/paddle/check_import_scipy.py); nothing to do on TPU hosts."""
+
+
+def monkey_patch_math_varbase():
+    """Parity no-op: operator overloads live on jax.Array natively — there
+    is no VarBase to patch (ref: fluid/dygraph/math_op_patch.py)."""
+
+
+def monkey_patch_variable():
+    """Parity no-op: no static-graph Variable exists to patch (ref:
+    fluid/layers/math_op_patch.py)."""
 
 
 def disable_static(place=None):

@@ -36,6 +36,18 @@ from .collective import (  # noqa: F401
     all_to_all_single,
 )
 from .parallel import DataParallel, spawn  # noqa: F401
+
+
+def prepare_context(strategy=None):
+    """Legacy dygraph-DP bootstrap (ref: fluid/dygraph/parallel.py:34) —
+    the modern entry is init_parallel_env; kept for source compatibility.
+    Returns None single-process, else initializes the env like the
+    reference (which also returns None when nranks < 2)."""
+    env = ParallelEnv()
+    if env.world_size < 2:
+        return None
+    init_parallel_env()
+    return strategy
 from ..nn.recompute import recompute  # noqa: F401  (fleet.utils.recompute parity)
 from . import launch  # noqa: F401  (module: python -m paddle_tpu.distributed.launch)
 from . import fleet  # noqa: F401

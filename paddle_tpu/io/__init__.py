@@ -25,6 +25,8 @@ from .sampler import (  # noqa: F401
 from .in_memory_dataset import InMemoryDataset  # noqa: F401
 from .dataloader import (  # noqa: F401
     DataLoader,
+    WorkerInfo,
     default_collate_fn,
     default_convert_fn,
+    get_worker_info,
 )

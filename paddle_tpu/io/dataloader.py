@@ -67,15 +67,40 @@ def default_collate_fn(batch):
 # -- worker-process globals (set once per worker by the pool initializer) ----
 _worker_dataset = None
 _worker_collate = None
+_worker_info = None
 
 
-def _init_worker(dataset, collate_fn, worker_init_fn, worker_id_counter):
-    global _worker_dataset, _worker_collate
+class WorkerInfo:
+    """Worker-process identity visible to Dataset code (ref:
+    fluid/dataloader/worker.py WorkerInfo / get_worker_info)."""
+
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, "
+                f"num_workers={self.num_workers})")
+
+
+def get_worker_info():
+    """Inside a DataLoader worker process: that worker's ``WorkerInfo``
+    (id / num_workers / dataset); in the main process: None (ref:
+    python/paddle/fluid/dataloader/worker.py get_worker_info — used by
+    IterableDataset shards to split work across workers)."""
+    return _worker_info
+
+
+def _init_worker(dataset, collate_fn, worker_init_fn, worker_id_counter,
+                 num_workers=0):
+    global _worker_dataset, _worker_collate, _worker_info
     _worker_dataset = dataset
     _worker_collate = collate_fn
     with worker_id_counter.get_lock():
         worker_id = worker_id_counter.value
         worker_id_counter.value += 1
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
 
@@ -263,7 +288,7 @@ class DataLoader:
             mp_context=ctx,
             initializer=_init_worker,
             initargs=(self.dataset, self.collate_fn, self.worker_init_fn,
-                      worker_id_counter),
+                      worker_id_counter, self.num_workers),
         ) as pool:
             window = self.num_workers * self.prefetch_factor
             batches = iter(self.batch_sampler)

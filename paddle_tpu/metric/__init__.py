@@ -12,8 +12,11 @@ from typing import List, Sequence, Union
 import numpy as np
 
 from ..framework.errors import InvalidArgumentError
+from .functional import chunk_eval, mean_iou  # noqa: F401
+from . import metrics  # noqa: F401  (paddle.metric.metrics alias module)
 
-__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy",
+           "chunk_eval", "mean_iou"]
 
 
 class Metric:

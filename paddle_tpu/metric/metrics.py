@@ -1,0 +1,14 @@
+"""Alias module: ``paddle.metric.metrics`` — the reference keeps every
+metric class in metrics.py and re-exports from the package
+(python/paddle/metric/__init__.py); scripts importing the long path keep
+working here."""
+
+
+def __getattr__(name):
+    from paddle_tpu import metric as _m
+
+    try:
+        return getattr(_m, name)
+    except AttributeError:
+        raise AttributeError(
+            f"module 'paddle_tpu.metric.metrics' has no attribute {name!r}")

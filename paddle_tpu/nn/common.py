@@ -16,6 +16,7 @@ __all__ = [
     "UpsamplingNearest2D", "Pad1D", "Pad2D", "Pad3D", "ZeroPad2D",
     "CosineSimilarity", "Bilinear", "PixelShuffle", "PixelUnshuffle",
     "ChannelShuffle", "Fold", "Unfold", "PairwiseDistance", "RowConv",
+    "BilinearTensorProduct", "Pool2D",
 ]
 
 
@@ -292,3 +293,72 @@ class RowConv(Layer):
 
     def forward(self, x):
         return F.row_conv(x, self.weight.value, act=self.activation)
+
+
+class BilinearTensorProduct(Layer):
+    """Legacy bilinear layer (ref: fluid/dygraph/nn.py BilinearTensorProduct
+    / nn/__init__.py:74): ``out_i = act(x W_i y^T + b_i)`` — the 2.0
+    ``Bilinear`` math plus the built-in activation of the 1.x API."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None):
+        super().__init__()
+        self.act = act
+        self.weight = self.create_parameter(
+            (output_dim, input1_dim, input2_dim), attr=param_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter((1, output_dim), attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, x, y):
+        out = F.bilinear(x, y, self.weight.value,
+                         self.bias.value if self.bias is not None else None)
+        if self.act:
+            out = getattr(F, self.act)(out)
+        return out
+
+
+class Pool2D(Layer):
+    """Legacy pooling layer (ref: fluid/dygraph/nn.py Pool2D /
+    nn/__init__.py:75) — thin driver over the 2.0 functional pools; the
+    1.x knobs (global_pooling, exclusive, ceil_mode) map directly."""
+
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True, data_format="NCHW"):
+        super().__init__()
+        from ..framework.errors import InvalidArgumentError
+
+        if pool_type not in ("max", "avg"):
+            raise InvalidArgumentError(
+                f"pool_type must be 'max' or 'avg', got {pool_type!r}")
+        if not global_pooling and pool_size == -1:
+            raise InvalidArgumentError(
+                "Pool2D: pool_size must be set when global_pooling is "
+                "False (the -1 default only makes sense with "
+                "global_pooling=True)")
+        self.pool_size = pool_size
+        self.pool_type = pool_type
+        self.pool_stride = pool_stride
+        self.pool_padding = pool_padding
+        self.global_pooling = global_pooling
+        self.ceil_mode = ceil_mode
+        self.exclusive = exclusive
+        self.data_format = data_format
+
+    def forward(self, x):
+        x = jnp.asarray(x)
+        if self.global_pooling:
+            axes = (2, 3) if self.data_format == "NCHW" else (1, 2)
+            red = jnp.max if self.pool_type == "max" else jnp.mean
+            return red(x, axis=axes, keepdims=True)
+        if self.pool_type == "max":
+            return F.max_pool2d(x, self.pool_size, stride=self.pool_stride,
+                                padding=self.pool_padding,
+                                ceil_mode=self.ceil_mode,
+                                data_format=self.data_format)
+        return F.avg_pool2d(x, self.pool_size, stride=self.pool_stride,
+                            padding=self.pool_padding,
+                            ceil_mode=self.ceil_mode,
+                            exclusive=self.exclusive,
+                            data_format=self.data_format)

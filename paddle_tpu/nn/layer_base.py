@@ -501,11 +501,16 @@ def functional_call(
     saved_training = None
 
     try:
+        # snapshot EVERY param box, not just the substituted ones: derived
+        # params (e.g. the weight_norm cache, nn/utils.py) are rewritten by
+        # pre-hooks during the traced call and must not leak tracers into
+        # eager state
+        for name, box in boxes.items():
+            saved_vals[("p", name)] = box.value
         for name, value in params.items():
             box = boxes.get(name)
             if box is None:
                 raise NotFoundError(f"no parameter named {name!r} in {type(layer).__name__}")
-            saved_vals[("p", name)] = box.value
             box.value = value
         if return_buffers:
             for name, box in buf_boxes.items():

@@ -1,0 +1,118 @@
+"""paddle.nn.utils — weight normalization hooks.
+
+Parity: python/paddle/nn/utils/weight_norm_hook.py (WeightNorm:93,
+weight_norm:155, remove_weight_norm:203).  Reparameterizes a layer's
+weight as ``w = g * v / ||v||`` (norm over every dim except ``dim``).
+
+TPU-native: the recompute runs as a forward pre-hook *inside* the traced
+call, after ``functional_call`` substitutes ``<name>_g``/``<name>_v`` —
+so gradients flow to g and v, and the derived ``<name>`` box is a
+non-trainable cache the optimizer skips (Parameter.trainable=False ≙
+stop_gradient).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.errors import InvalidArgumentError
+from .layer_base import Layer, Parameter
+
+__all__ = ["weight_norm", "remove_weight_norm"]
+
+
+def _norm_except_dim(v, dim):
+    """L2 norm over all axes but ``dim`` (ref: weight_norm_hook.py:45
+    norm_except_dim); dim=-1 → scalar full norm."""
+    v = jnp.asarray(v)
+    if dim == -1:
+        return jnp.sqrt(jnp.sum(v * v) + 1e-12)
+    moved = jnp.moveaxis(v, dim, 0).reshape(v.shape[dim], -1)
+    return jnp.sqrt(jnp.sum(moved * moved, axis=1) + 1e-12)
+
+
+def _weight_from_gv(g, v, dim):
+    v = jnp.asarray(v)
+    g = jnp.asarray(g)
+    if dim == -1:
+        return v / (jnp.sqrt(jnp.sum(v * v)) + 1e-12) * g
+    norm = _norm_except_dim(v, dim)
+    shape = [1] * v.ndim
+    shape[dim] = v.shape[dim]
+    return v / norm.reshape(shape) * (g.reshape(shape))
+
+
+class WeightNorm:
+    """The registered pre-hook object (ref: weight_norm_hook.py:93)."""
+
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = -1 if dim is None else dim
+
+    def compute_weight(self, layer):
+        g = layer._parameters[self.name + "_g"].value
+        v = layer._parameters[self.name + "_v"].value
+        return _weight_from_gv(g, v, self.dim)
+
+    @staticmethod
+    def apply(layer: Layer, name: str, dim):
+        for hook in layer._forward_pre_hooks.values():
+            if isinstance(hook, WeightNorm) and hook.name == name:
+                raise InvalidArgumentError(
+                    f"weight_norm already registered on parameter {name!r}")
+        w = layer._parameters.get(name)
+        if w is None:
+            raise InvalidArgumentError(
+                f"{type(layer).__name__} has no parameter {name!r}")
+        ndim = w.ndim
+        if dim is None:
+            dim = -1
+        if not (-ndim <= dim < ndim):
+            raise InvalidArgumentError(
+                f"dim must be in [-{ndim}, {ndim}), got {dim}")
+        if dim != -1:
+            dim = dim % ndim
+        fn = WeightNorm(name, dim)
+
+        v = Parameter(w.value, name=(w.name + "_v") if w.name else "",
+                      trainable=True)
+        g = Parameter(_norm_except_dim(w.value, dim),
+                      name=(w.name + "_g") if w.name else "", trainable=True)
+        layer.add_parameter(name + "_v", v)
+        layer.add_parameter(name + "_g", g)
+        # the original becomes a derived, non-trainable cache the hook
+        # refreshes each call (optimizers skip trainable=False)
+        w.trainable = False
+        w.value = fn.compute_weight(layer)
+        layer.register_forward_pre_hook(fn)
+        return fn
+
+    def remove(self, layer: Layer):
+        w = layer._parameters[self.name]
+        w.value = self.compute_weight(layer)
+        w.trainable = True
+        del layer._parameters[self.name + "_g"]
+        del layer._parameters[self.name + "_v"]
+        for hid, hook in list(layer._forward_pre_hooks.items()):
+            if hook is self:
+                del layer._forward_pre_hooks[hid]
+
+    def __call__(self, layer, inputs):
+        layer._parameters[self.name].value = self.compute_weight(layer)
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0):
+    """Apply weight normalization to ``layer.<name>``
+    (ref: weight_norm_hook.py:155)."""
+    WeightNorm.apply(layer, name, dim)
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight"):
+    """Undo ``weight_norm``, folding g·v/||v|| back into one trainable
+    parameter (ref: weight_norm_hook.py:203)."""
+    for hook in list(layer._forward_pre_hooks.values()):
+        if isinstance(hook, WeightNorm) and hook.name == name:
+            hook.remove(layer)
+            return layer
+    raise InvalidArgumentError(
+        f"weight_norm of {name!r} not found in {type(layer).__name__}")

@@ -1,0 +1,5 @@
+"""Alias module: paddle.nn.vision (ref: python/paddle/nn/layer/vision.py
+holds PixelShuffle at this version; the class lives in common.py here)."""
+from .common import PixelShuffle  # noqa: F401
+
+__all__ = ["PixelShuffle"]

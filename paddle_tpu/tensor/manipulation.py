@@ -18,7 +18,7 @@ from ..framework.errors import InvalidArgumentError
 __all__ = [
     "reshape", "flatten", "squeeze", "unsqueeze", "transpose", "moveaxis",
     "concat", "stack", "unstack", "split", "chunk", "tile", "expand",
-    "expand_as", "broadcast_to", "broadcast_tensors", "flip", "rot90", "roll",
+    "expand_as", "broadcast_to", "broadcast_tensors", "flip", "reverse", "rot90", "roll",
     "gather", "gather_nd", "scatter", "scatter_nd", "scatter_nd_add",
     "index_select", "index_sample", "index_add", "index_put", "put_along_axis",
     "take_along_axis", "slice", "strided_slice", "crop", "pad", "cast",
@@ -178,6 +178,12 @@ def broadcast_to(x, shape, name=None):
 
 def broadcast_tensors(inputs, name=None):
     return list(jnp.broadcast_arrays(*inputs))
+
+
+def reverse(x, axis, name=None):
+    """Legacy alias of flip (ref: fluid/layers/tensor.py reverse —
+    paddle.reverse / paddle.tensor.reverse)."""
+    return flip(x, axis)
 
 
 def flip(x, axis, name=None):

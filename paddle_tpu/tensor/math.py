@@ -16,7 +16,7 @@ from ..framework import dtype as _dt
 
 __all__ = [
     # elementwise binary
-    "add", "add_n", "addcmul", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "add", "add_n", "addcmul", "subtract", "multiply", "divide", "floor_divide", "mod", "floor_mod", "remainder",
     "pow", "maximum", "minimum", "fmax", "fmin", "atan2", "logaddexp",
     "heaviside", "gcd", "lcm", "hypot", "copysign", "nextafter", "ldexp",
     # elementwise unary
@@ -75,6 +75,7 @@ def mod(x, y, name=None):
 
 
 remainder = mod
+floor_mod = mod  # legacy alias (ref: tensor/math.py floor_mod == elementwise_mod)
 
 
 def pow(x, y, name=None):

@@ -1,0 +1,207 @@
+"""API-parity additions: weight_norm, legacy layers, chunk_eval/mean_iou,
+clip fns, aliases (round-2 namespace audit closure)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import metric as M
+from paddle_tpu.nn import functional_call
+
+
+class TestWeightNorm:
+    def test_apply_preserves_forward(self):
+        paddle.seed(0)
+        lin = nn.Linear(6, 4)
+        x = jnp.asarray(np.random.RandomState(0).randn(3, 6), jnp.float32)
+        before = np.asarray(lin(x))
+        nn.weight_norm(lin, "weight", dim=0)
+        after = np.asarray(lin(x))
+        np.testing.assert_allclose(after, before, atol=1e-5)
+        names = dict(lin.named_parameters())
+        assert "weight_g" in names and "weight_v" in names
+        assert not names["weight"].trainable
+
+    def test_grads_flow_to_g_and_v(self):
+        paddle.seed(1)
+        lin = nn.Linear(5, 3)
+        nn.weight_norm(lin, "weight", dim=0)
+        x = jnp.ones((2, 5), jnp.float32)
+        params = lin.param_pytree(trainable_only=True)
+        assert set(params) == {"weight_g", "weight_v", "bias"}
+
+        def loss(p):
+            return jnp.sum(functional_call(lin, p, x) ** 2)
+
+        g = jax.grad(loss)(params)
+        assert float(jnp.abs(g["weight_g"]).sum()) > 0
+        assert float(jnp.abs(g["weight_v"]).sum()) > 0
+
+    def test_no_tracer_leak_after_jit(self):
+        paddle.seed(2)
+        lin = nn.Linear(4, 4)
+        nn.weight_norm(lin)
+        x = jnp.ones((2, 4), jnp.float32)
+        params = lin.param_pytree(trainable_only=True)
+        jax.jit(lambda p, x: functional_call(lin, p, x))(params, x)
+        # every box must hold a concrete array after the traced call
+        for _, p in lin.named_parameters():
+            np.asarray(p.value)
+
+    def test_remove_restores_single_param(self):
+        paddle.seed(3)
+        lin = nn.Linear(4, 2)
+        x = jnp.asarray(np.random.RandomState(1).randn(2, 4), jnp.float32)
+        nn.weight_norm(lin, dim=1)
+        mid = np.asarray(lin(x))
+        nn.remove_weight_norm(lin)
+        names = dict(lin.named_parameters())
+        assert "weight_g" not in names and names["weight"].trainable
+        np.testing.assert_allclose(np.asarray(lin(x)), mid, atol=1e-5)
+
+    def test_dim_none_scalar_g(self):
+        lin = nn.Linear(4, 2)
+        nn.weight_norm(lin, dim=None)
+        assert dict(lin.named_parameters())["weight_g"].shape == ()
+
+
+class TestLegacyLayers:
+    def test_pool2d_max_avg_global(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 8, 8),
+                        jnp.float32)
+        out = nn.Pool2D(pool_size=2, pool_type="max", pool_stride=2)(x)
+        assert out.shape == (2, 3, 4, 4)
+        out = nn.Pool2D(pool_size=2, pool_type="avg", pool_stride=2)(x)
+        assert out.shape == (2, 3, 4, 4)
+        g = nn.Pool2D(pool_type="avg", global_pooling=True)(x)
+        np.testing.assert_allclose(np.asarray(g)[..., 0, 0],
+                                   np.asarray(x).mean((2, 3)), atol=1e-6)
+
+    def test_bilinear_tensor_product(self):
+        paddle.seed(4)
+        layer = nn.BilinearTensorProduct(4, 5, 3, act="sigmoid")
+        x = jnp.ones((2, 4), jnp.float32)
+        y = jnp.ones((2, 5), jnp.float32)
+        out = np.asarray(layer(x, y))
+        assert out.shape == (2, 3)
+        assert (out > 0).all() and (out < 1).all()  # sigmoid range
+
+    def test_clip_fns(self):
+        x = jnp.asarray([-2.0, 0.5, 3.0])
+        np.testing.assert_allclose(np.asarray(nn.clip(x, -1.0, 1.0)),
+                                   [-1.0, 0.5, 1.0])
+        big = jnp.asarray([3.0, 4.0])  # norm 5
+        clipped = nn.clip_by_norm(big, 1.0)
+        np.testing.assert_allclose(np.asarray(clipped), [0.6, 0.8],
+                                   atol=1e-6)
+        small = jnp.asarray([0.3, 0.4])  # norm .5 < max_norm → unchanged
+        np.testing.assert_allclose(np.asarray(nn.clip_by_norm(small, 1.0)),
+                                   [0.3, 0.4], atol=1e-6)
+
+
+class TestChunkEval:
+    def test_iob_ner_example(self):
+        """The docstring NER example (fluid/layers/nn.py:1060): IOB, 3
+        chunk types; ids: B-ORG=0 I-ORG=1 B-PER=2 I-PER=3 B-LOC=4
+        I-LOC=5 O=6."""
+        label = [[2, 3, 6, 6, 0, 1, 1, 1, 6, 4]]
+        pred = [[2, 3, 6, 6, 0, 1, 6, 1, 6, 4]]  # breaks the ORG chunk
+        p, r, f1, ni, nl, nc = M.chunk_eval(pred, label, "IOB", 3)
+        # label chunks: PER[0-1] ORG[4-7] LOC[9]; pred: PER[0-1] ORG[4-5]
+        # I-ORG[7] LOC[9] → 4 inferred, 2 correct (PER, LOC)
+        assert nl == 3 and ni == 4 and nc == 2
+        np.testing.assert_allclose(p, 0.5)
+        np.testing.assert_allclose(r, 2 / 3, rtol=1e-6)
+        np.testing.assert_allclose(f1, 2 * 0.5 * (2 / 3) / (0.5 + 2 / 3),
+                                   rtol=1e-6)
+
+    def test_perfect_and_seq_length(self):
+        label = np.array([[0, 1, 6, 2, 3, 0, 0, 0]])
+        p, r, f1, ni, nl, nc = M.chunk_eval(label, label, "IOB", 3,
+                                            seq_length=[5])
+        assert p == r == f1 == 1.0
+        assert ni == nl == nc == 2  # padding region excluded
+
+    def test_excluded_types(self):
+        label = [[2, 3, 0, 1]]  # PER chunk + ORG chunk
+        _, _, _, ni, nl, nc = M.chunk_eval(label, label, "IOB", 3,
+                                           excluded_chunk_types=[0])
+        assert ni == nl == nc == 1  # ORG (type 0) excluded
+
+    @pytest.mark.parametrize("scheme,labels,n", [
+        ("IOBES", [[0, 1, 2, 8, 3]], 2),  # B I E O S (2 types, T=4)
+        ("plain", [[0, 2, 1, 1, 2]], 2),  # each non-O type-run is a chunk
+        ("IOE", [[0, 1, 4, 0, 1]], 2),    # I E O I E (2 types, T=2)
+    ])
+    def test_schemes(self, scheme, labels, n):
+        _, _, _, ni, nl, nc = M.chunk_eval(labels, labels, scheme, 2)
+        assert ni == nl == nc == n
+
+
+class TestMeanIou:
+    def test_vs_confusion_oracle(self):
+        rng = np.random.RandomState(0)
+        pred = rng.randint(0, 5, size=(200,))
+        lab = rng.randint(0, 5, size=(200,))
+        miou, wrong, correct = M.mean_iou(pred, lab, 5)
+        correct_np = np.zeros(5, np.int64)
+        wrong_np = np.zeros(5, np.int64)
+        for p, l in zip(pred, lab):
+            if p == l:
+                correct_np[p] += 1
+            else:
+                wrong_np[p] += 1
+                wrong_np[l] += 1
+        np.testing.assert_array_equal(np.asarray(correct), correct_np)
+        np.testing.assert_array_equal(np.asarray(wrong), wrong_np)
+        denom = np.maximum(correct_np + wrong_np, 1)
+        valid = (correct_np + wrong_np) > 0
+        want = (correct_np / denom).sum() / max(valid.sum(), 1)
+        np.testing.assert_allclose(float(miou), want, rtol=1e-6)
+
+    def test_perfect(self):
+        lab = np.array([0, 1, 2, 1])
+        miou, _, correct = M.mean_iou(lab, lab, 3)
+        assert float(miou) == 1.0
+        np.testing.assert_array_equal(np.asarray(correct), [1, 2, 1])
+
+
+class TestAliases:
+    def test_metric_metrics_module(self):
+        from paddle_tpu.metric import metrics
+
+        assert metrics.Accuracy is M.Accuracy
+        with pytest.raises(AttributeError):
+            metrics.nope
+
+    def test_tensor_reverse_floor_mod(self):
+        x = jnp.asarray([[1, 2], [3, 4]])
+        np.testing.assert_array_equal(np.asarray(paddle.reverse(x, [0])),
+                                      [[3, 4], [1, 2]])
+        np.testing.assert_array_equal(
+            np.asarray(paddle.floor_mod(jnp.asarray([7, -7]),
+                                        jnp.asarray([3, 3]))), [1, 2])
+
+    def test_misc_top_level(self):
+        assert paddle.in_dynamic_mode() and paddle.in_dygraph_mode()
+        assert paddle.get_cudnn_version() is None
+        paddle.check_import_scipy()
+        paddle.monkey_patch_math_varbase()
+        s = paddle.get_cuda_rng_state()
+        paddle.set_cuda_rng_state(s)
+        with pytest.raises(Exception):
+            paddle.grad(None, None)
+
+    def test_get_worker_info_main_process(self):
+        from paddle_tpu.io import get_worker_info
+
+        assert get_worker_info() is None
+
+    def test_nn_functional_assign(self):
+        from paddle_tpu.nn import functional as F
+
+        np.testing.assert_array_equal(
+            np.asarray(F.assign(np.array([1.0, 2.0]))), [1.0, 2.0])
