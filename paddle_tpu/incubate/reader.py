@@ -1,0 +1,31 @@
+"""paddle.incubate.reader — multi-process reader sharding.
+
+Parity: python/paddle/fluid/contrib/reader/distributed_reader.py:21
+(re-exported as paddle.incubate.reader).  Round-robin shards a batch
+reader across trainers using the same PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ID env contract the launcher sets.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    """Each trainer keeps every ``trainers_num``-th batch, offset by its
+    rank — batch i goes to trainer ``i % trainers_num`` (ref
+    :21; single-trainer is a pass-through)."""
+    trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    if trainer_id >= trainers_num:
+        raise ValueError(
+            f"PADDLE_TRAINER_ID {trainer_id} out of range for "
+            f"PADDLE_TRAINERS_NUM {trainers_num}")
+
+    def reader():
+        for batch_id, data in enumerate(batch_reader()):
+            if batch_id % trainers_num == trainer_id:
+                yield data
+
+    return reader

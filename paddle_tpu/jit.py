@@ -25,7 +25,13 @@ import numpy as np
 from .framework.errors import InvalidArgumentError
 from .nn.layer_base import Layer, functional_call
 
-__all__ = ["to_static", "not_to_static", "save", "load", "TranslatedLayer"]
+__all__ = ["to_static", "not_to_static", "save", "load", "TranslatedLayer",
+           "ProgramTranslator", "TracedLayer", "set_code_level",
+           "set_verbosity"]
+
+#: global to_static switch (ref: ProgramTranslator.enable —
+#: program_translator.py:708); False → wrapped callables run eagerly
+_to_static_enabled = True
 
 
 def _jit_layer_call(layer: Layer, inner_call=None):
@@ -84,6 +90,10 @@ class StaticFunction:
         return cache[key]
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:  # ProgramTranslator.enable(False)
+            if self._layer is not None and not isinstance(self._orig, Layer):
+                return self._orig(self._layer, *args, **kwargs)
+            return self._orig(*args, **kwargs)
         if kwargs:
             raise InvalidArgumentError(
                 "to_static calls are positional-only (kwargs change the "
@@ -167,3 +177,99 @@ def load(path: str) -> TranslatedLayer:
     from .inference import Predictor
 
     return TranslatedLayer(Predictor(path))
+
+
+class ProgramTranslator:
+    """Global to_static control (ref: dygraph_to_static/
+    program_translator.py:708).  The reference's singleton owns an AST
+    transpiler cache; here compilation is jax.jit, so the surviving
+    responsibility is the enable/disable switch (debugging escape hatch:
+    ``ProgramTranslator().enable(False)`` runs wrapped code eagerly)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, enable_to_static: bool):
+        global _to_static_enabled
+        if not isinstance(enable_to_static, bool):
+            raise InvalidArgumentError(
+                "ProgramTranslator.enable expects a bool")
+        _to_static_enabled = enable_to_static
+
+    @property
+    def enable_to_static(self) -> bool:
+        return _to_static_enabled
+
+
+def set_code_level(level: int = 100):
+    """Ref: dygraph_to_static logging_utils.set_code_level — printed the
+    AST-transformed code at each transpile stage.  No transpiler exists
+    (tracing is native); to inspect what compiles, use
+    jax.make_jaxpr(fn)(*args) / jax.jit(fn).lower(*args).as_text()."""
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    """Ref: logging_utils.set_verbosity — dy2static transpiler log level;
+    nothing to log without a transpiler (see set_code_level)."""
+
+
+class _Dy2Static:
+    """Namespace stand-in for paddle.jit.dy2static (the reference's AST
+    transpiler package, fluid/dygraph/dygraph_to_static/).  Tracing is
+    native here, so only the control surface survives."""
+
+    @property
+    def ProgramTranslator(self):
+        return ProgramTranslator
+
+
+dy2static = _Dy2Static()
+
+
+class TracedLayer:
+    """Trace a dygraph Layer into a deployable artifact (ref:
+    fluid/dygraph/jit.py TracedLayer over ProgramDescTracer).  Here the
+    'trace' IS jax.jit of the layer's functional projection; saving
+    AOT-exports StableHLO (paddle_tpu.inference format).
+    """
+
+    def __init__(self, layer: Layer, example_inputs):
+        self._layer = layer
+        self._inputs = list(example_inputs)
+        self._fn = _jit_layer_call(layer)
+
+    @staticmethod
+    def trace(layer: Layer, inputs):
+        """→ (example_outputs, TracedLayer) — reference signature."""
+        if not isinstance(layer, Layer):
+            raise InvalidArgumentError("TracedLayer.trace expects a Layer")
+        traced = TracedLayer(layer, inputs)
+        return traced(*inputs), traced
+
+    def __call__(self, *args):
+        out, new_bufs = self._fn(self._layer.param_pytree(),
+                                 self._layer.buffer_pytree(),
+                                 self._layer.training, *args)
+        boxes = dict(self._layer.named_buffers())
+        for name, v in new_bufs.items():
+            boxes[name].value = v
+        return out
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        """Export for serving (ref signature kept; feed/fetch index
+        filters applied by the reference are meaningless for a
+        single-signature jax export and are accepted unchecked)."""
+        from .inference import save_inference_model as _save
+        from .static import InputSpec
+
+        specs = [InputSpec.from_tensor(np.asarray(x), name=f"x{i}")
+                 for i, x in enumerate(self._inputs)]
+        return _save(path, self._layer, specs)

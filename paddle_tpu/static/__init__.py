@@ -1,21 +1,42 @@
-"""paddle.static compatibility surface — InputSpec.
+"""paddle.static compatibility surface.
 
-Parity: python/paddle/static/input.py (InputSpec) / fluid/data.py:23 —
-the declarative tensor signature used to declare feed slots for inference
-export.  TPU-native: an InputSpec lowers to a ``jax.ShapeDtypeStruct``
-whose ``None`` dims become ``jax.export`` symbolic dimensions, so one
-exported artifact serves any batch size (the reference's -1 batch dim).
+Parity: python/paddle/static/__init__.py.  Three tiers, matching what
+each name MEANS without a Program interpreter (jaxpr replaces Program,
+SURVEY §7):
+
+* genuinely portable names are implemented (InputSpec, data→InputSpec,
+  Print→jax.debug.print, py_func→jax.pure_callback, name_scope,
+  cpu_places, create_parameter/create_global_var, the inference
+  save/load pair, load_program_state, BuildStrategy/ExecutionStrategy
+  config holders);
+* Program-machinery names (Program, Executor, append_backward, ...) are
+  module-level shims that exist but raise ``UnimplementedError`` (also
+  an AttributeError, so feature probes degrade gracefully) *when used*,
+  each naming its eager replacement;
+* ``static.nn`` is a module of op-builder shims pointing at the eager
+  layer/functional equivalents.
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 
-from .framework.dtype import convert_dtype
+from ..framework.dtype import convert_dtype
 
-__all__ = ["InputSpec", "make_symbols"]
+__all__ = [
+    "append_backward", "gradients", "Executor", "global_scope",
+    "scope_guard", "BuildStrategy", "CompiledProgram", "Print", "py_func",
+    "ExecutionStrategy", "name_scope", "ParallelExecutor", "program_guard",
+    "WeightNormParamAttr", "default_main_program",
+    "default_startup_program", "Program", "data", "InputSpec", "save",
+    "load", "save_inference_model", "load_inference_model",
+    "load_program_state", "set_program_state", "cpu_places", "cuda_places",
+    "Variable", "Scope", "create_parameter", "create_global_var",
+    "make_symbols", "nn",
+]
 
 
 class InputSpec:
@@ -89,36 +110,307 @@ def make_symbols(specs) -> dict:
     return dict(zip(names, dims))
 
 
-# the reference's static-graph surface (Program/Executor/program_guard/
-# data/...) has no counterpart by DESIGN — jaxpr tracing replaces Program
-# construction (SURVEY §7).  Accessing those names raises with the
-# TPU-native migration path instead of an opaque AttributeError.
-_STATIC_ONLY = {
-    "Program": "Model.prepare compiles the whole train step from traced "
-               "eager code",
-    "Executor": "Model.fit / Model.evaluate run the compiled step",
-    "program_guard": "no Program objects exist — write eager code",
-    "default_main_program": "no Program objects exist",
-    "default_startup_program": "parameter init happens at Layer "
-                               "construction",
-    "data": "pass arrays directly; declare export signatures with "
-            "InputSpec",
-    "scope_guard": "no Scope — state lives in Layer parameter boxes",
-    "global_scope": "no Scope — state lives in Layer parameter boxes",
-}
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed slot (ref: static/input.py data / fluid/data.py:23).
+    Eager mapping: returns the ``InputSpec`` for that slot — the one
+    object here that plays the 'declared graph input' role (export
+    signatures, jit.save)."""
+    return InputSpec(shape, dtype or "float32", name)
 
 
-def __getattr__(name):
-    if name in _STATIC_ONLY:
-        from .framework.errors import UnimplementedError
+def cpu_places(device_count=None):
+    """Host CPU devices (ref: fluid/framework.py cpu_places).  Count
+    defaults to the visible CPU device count (the reference uses
+    CPU_NUM)."""
+    from ..framework.device import CPUPlace
 
-        class _StaticOnlyError(UnimplementedError, AttributeError):
-            """Also an AttributeError so hasattr()/getattr(default)
-            feature probes report 'absent' instead of crashing — exactly
-            the migration code paths this shim exists to help."""
+    if device_count is None:
+        try:
+            device_count = len(jax.devices("cpu"))
+        except RuntimeError:
+            device_count = 1
+    return [CPUPlace() for _ in range(device_count)]
 
-        raise _StaticOnlyError(
-            f"paddle.static.{name} is static-Program API with no "
-            f"counterpart in this single-runtime framework (jaxpr replaces "
-            f"Program — SURVEY §7); instead: {_STATIC_ONLY[name]}")
-    raise AttributeError(f"module 'paddle_tpu.static' has no attribute {name!r}")
+
+def cuda_places(device_ids=None):
+    from ..framework.errors import UnimplementedError
+
+    raise UnimplementedError(
+        "cuda_places(): no CUDA devices in the TPU build — use "
+        "paddle.set_device('tpu') / jax.devices() (places map to "
+        "jax.Device, SURVEY §7)")
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Parity: fluid/framework.py:5616 name_scope — a debugging aid that
+    prefixed op names in the Program graph.  There is no op graph to
+    name here (XLA keeps jaxpr provenance automatically), so this scopes
+    nothing; kept so instrumented model code runs unchanged."""
+    yield
+
+
+def Print(input, first_n=-1, message=None, summarize=20, **kwargs):
+    """Debug-print a tensor inside compiled code (ref:
+    fluid/layers/control_flow.py Print op).  TPU-native: jax.debug.print
+    — works under jit, prints when the value resolves; returns the input
+    unchanged like the reference op."""
+    if isinstance(input, jax.core.Tracer):
+        # inside jit: route through the debug-callback channel.  (Note:
+        # some remote PJRT transports, e.g. the axon tunnel, don't carry
+        # host callbacks — there, Print only works eagerly.)
+        msg = (message or "").replace("{", "{{").replace("}", "}}")
+        jax.debug.print((msg + ": {x}") if message else "{x}", x=input)
+    else:  # eager: plain host print, works on every backend
+        print(f"{message}: {np.asarray(input)}" if message
+              else str(np.asarray(input)))
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Call host Python from compiled code (ref: fluid/layers/nn.py
+    py_func over py_func_op).  TPU-native: ``jax.pure_callback`` — ``out``
+    declares the result template as InputSpec(s)/ShapeDtypeStruct(s)
+    (static shapes; the reference likewise required pre-created out
+    vars).  ``backward_func`` is not supported — use jax.custom_vjp for
+    differentiable callbacks."""
+    from ..framework.errors import UnimplementedError
+
+    if backward_func is not None:
+        raise UnimplementedError(
+            "py_func(backward_func=...): wrap the op in jax.custom_vjp "
+            "instead — host-side backward callbacks don't exist here")
+    single = not isinstance(out, (list, tuple))
+    specs = [out] if single else list(out)
+    shape_dtypes = [
+        s.shape_dtype() if isinstance(s, InputSpec)
+        else s if isinstance(s, jax.ShapeDtypeStruct)
+        else jax.ShapeDtypeStruct(np.asarray(s).shape, np.asarray(s).dtype)
+        for s in specs
+    ]
+    xs = x if isinstance(x, (list, tuple)) else [x]
+
+    def host(*args):  # declared template wins: cast host results to it
+        res = func(*args)
+        rs = [res] if single else list(res)
+        rs = [np.asarray(r, sd.dtype) for r, sd in zip(rs, shape_dtypes)]
+        return rs[0] if single else tuple(rs)
+
+    if not any(isinstance(a, jax.core.Tracer) for a in xs):
+        # eager: call the host function directly — no callback channel
+        # needed (remote PJRT transports like the axon tunnel lack one)
+        res = host(*(np.asarray(a) for a in xs))
+        import jax.numpy as jnp
+
+        return (jnp.asarray(res) if single
+                else tuple(jnp.asarray(r) for r in res))
+    result = jax.pure_callback(
+        host, shape_dtypes[0] if single else tuple(shape_dtypes), *xs)
+    return result
+
+
+class BuildStrategy:
+    """Pass-tuning knob bag (ref: framework/details/build_strategy.h:50).
+    XLA owns fusion/memory decisions here, so the knobs are accepted and
+    recorded but decide nothing; reads of unwritten knobs return the
+    reference defaults (build_strategy.h:71-158) so migration code that
+    probes them keeps running."""
+
+    _DEFAULTS = {
+        "debug_graphviz_path": "",
+        "enable_sequential_execution": False,
+        "remove_unnecessary_lock": True,
+        "fuse_elewise_add_act_ops": False,
+        "fuse_bn_act_ops": False,
+        "fuse_relu_depthwise_conv": False,
+        "fuse_broadcast_ops": False,
+        "fuse_all_optimizer_ops": False,
+        "fuse_all_reduce_ops": False,
+        "sync_batch_norm": False,
+        "memory_optimize": False,
+        "enable_inplace": True,
+        "cache_runtime_context": False,
+        "enable_backward_optimizer_op_deps": True,
+        "trainer_id": 0,
+        "num_trainers": 1,
+        "use_hierarchical_allreduce": False,
+        "hierarchical_allreduce_inter_nranks": 0,
+        "gradient_scale_strategy": 0,
+        "reduce_strategy": 0,
+        "build_cinn_pass": False,
+    }
+
+    def __init__(self):
+        self.__dict__["_opts"] = dict(self._DEFAULTS)
+
+    def __setattr__(self, k, v):
+        self._opts[k] = v
+
+    def __getattr__(self, k):
+        try:
+            return self.__dict__["_opts"][k]
+        except KeyError:
+            raise AttributeError(k)
+
+
+class ExecutionStrategy(BuildStrategy):
+    """Executor-thread knob bag (ref: details/execution_strategy.h:22) —
+    same accepted-but-inert contract as BuildStrategy."""
+
+    _DEFAULTS = {
+        "num_threads": 0,
+        "use_cuda": False,
+        "allow_op_delay": False,
+        "num_iteration_per_drop_scope": 100,
+        "num_iteration_per_run": 1,
+        "use_thread_barrier": False,
+    }
+
+
+def load_program_state(model_path, var_list=None):
+    """Read a saved state into {name: numpy} (ref: fluid/io.py:1730
+    load_program_state).  Works on this framework's ``paddle.save``
+    artifacts — the Program-free half of the reference API."""
+    from ..framework.serialization import load as _load
+
+    path = model_path if model_path.endswith(".pdparams") else (
+        model_path + ".pdparams")
+    state = _load(path)
+    return {k: np.asarray(v) for k, v in state.items()
+            if var_list is None or k in var_list}
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars=None,
+                         executor=None, **kwargs):
+    """Ref: fluid/io.py:1164.  Eager form: ``feed_vars`` is the Layer and
+    ``fetch_vars`` its InputSpecs (the Program/Executor arguments of the
+    reference have no meaning here) — delegates to
+    paddle_tpu.inference.save_inference_model (AOT StableHLO export)."""
+    from ..inference import save_inference_model as _save
+
+    from ..nn.layer_base import Layer
+
+    if isinstance(feed_vars, Layer):
+        return _save(path_prefix, feed_vars, fetch_vars)
+    if isinstance(fetch_vars, Layer):  # (specs, layer) order tolerated
+        return _save(path_prefix, fetch_vars, feed_vars)
+    from ..framework.errors import InvalidArgumentError
+
+    raise InvalidArgumentError(
+        "static.save_inference_model(path, layer, input_specs): pass the "
+        "eager Layer to export (no Program exists to save — SURVEY §7)")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Ref: fluid/io.py:1374 — returns the loaded Predictor (the eager
+    counterpart of (program, feed_names, fetch_names))."""
+    from ..inference import load_inference_model as _load
+
+    return _load(path_prefix)
+
+
+def save(program, model_path, protocol=4, **configs):
+    _program_only("save", "paddle.save(layer.state_dict(), path)")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    _program_only("load", "paddle.load(path) + layer.set_state_dict")
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Real eager parameter creation (shared with paddle.create_parameter;
+    ref: fluid/layers/tensor.py:75)."""
+    import paddle_tpu as _p
+
+    return _p.create_parameter(shape, dtype, name=name, attr=attr,
+                               is_bias=is_bias,
+                               default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Eager mapping (ref: fluid/layers/tensor.py create_global_var): a
+    'global variable' is just a named non-trainable Parameter box."""
+    from ..nn.layer_base import Parameter
+
+    import jax.numpy as jnp
+
+    return Parameter(jnp.full(tuple(shape), value, convert_dtype(dtype)),
+                     name=name or "", trainable=False)
+
+
+class WeightNormParamAttr:
+    """Ref: fluid/param_attr.py WeightNormParamAttr — static-graph weight
+    norm via transpiled split params.  The eager equivalent is
+    ``paddle.nn.weight_norm(layer, name, dim)`` (nn/utils.py); raising
+    here names it rather than silently dropping the reparameterization."""
+
+    def __init__(self, *a, **k):
+        from ..framework.errors import UnimplementedError
+
+        raise UnimplementedError(
+            "WeightNormParamAttr: apply paddle.nn.weight_norm(layer, "
+            "name, dim) to the built layer instead (hook-based weight "
+            "norm, nn/utils.py)")
+
+
+# -- Program-machinery shims: exist, but raise on use --------------------
+def _program_only(name, instead):
+    from ..framework.errors import UnimplementedError
+
+    class _StaticOnlyError(UnimplementedError, AttributeError):
+        """Also an AttributeError so feature probes degrade to 'absent'."""
+
+    raise _StaticOnlyError(
+        f"paddle.static.{name} is static-Program API with no counterpart "
+        f"in this single-runtime framework (jaxpr replaces Program — "
+        f"SURVEY §7); instead: {instead}")
+
+
+def _make_program_shim(name, instead):
+    def shim(*args, **kwargs):
+        _program_only(name, instead)
+
+    shim.__name__ = name
+    shim.__qualname__ = name
+    shim.__doc__ = (f"Static-Program API shim — raises UnimplementedError "
+                    f"pointing at: {instead}")
+    return shim
+
+
+Program = _make_program_shim(
+    "Program", "Model.prepare compiles the whole train step from traced "
+               "eager code")
+Executor = _make_program_shim(
+    "Executor", "Model.fit / Model.evaluate run the compiled step")
+CompiledProgram = _make_program_shim(
+    "CompiledProgram", "jit compilation happens automatically in "
+                       "Model.prepare / jit.to_static")
+ParallelExecutor = _make_program_shim(
+    "ParallelExecutor", "distributed.fleet shards the jitted step over a "
+                        "device Mesh")
+Scope = _make_program_shim(
+    "Scope", "state lives in Layer parameter boxes")
+Variable = _make_program_shim(
+    "Variable", "tensors are jax.Array; declared inputs are InputSpec")
+global_scope = _make_program_shim(
+    "global_scope", "state lives in Layer parameter boxes")
+scope_guard = _make_program_shim(
+    "scope_guard", "state lives in Layer parameter boxes")
+program_guard = _make_program_shim(
+    "program_guard", "no Program objects exist — write eager code")
+default_main_program = _make_program_shim(
+    "default_main_program", "no Program objects exist")
+default_startup_program = _make_program_shim(
+    "default_startup_program", "parameter init happens at Layer "
+                               "construction")
+append_backward = _make_program_shim(
+    "append_backward", "gradients come from paddle.grad_fn (jax.grad) "
+                       "over a loss function")
+gradients = _make_program_shim(
+    "gradients", "use paddle.grad_fn (jax.grad) / jax.vjp on a function")
+set_program_state = _make_program_shim(
+    "set_program_state", "layer.set_state_dict(state)")
+
+from . import nn  # noqa: E402,F401  (static.nn op-builder shims)

@@ -1,0 +1,63 @@
+"""paddle.static.nn — op-builder shims.
+
+Parity: python/paddle/static/nn/__init__.py.  Every name there appends
+ops to a Program; with no Program interpreter each shim raises at CALL
+time, naming the eager layer/functional equivalent (kept callable so
+``from paddle.static.nn import fc`` imports cleanly and fails with
+guidance only when actually used).
+
+``create_parameter`` and ``py_func`` ARE portable and delegate to the
+real implementations; ``cond``/``while_loop`` point at lax control flow.
+"""
+from __future__ import annotations
+
+from . import py_func, create_parameter  # noqa: F401  (real implementations)
+
+#: static.nn name → eager replacement
+_EAGER = {
+    "fc": "paddle.nn.Linear (+ activation from nn.functional)",
+    "batch_norm": "paddle.nn.BatchNorm2D / nn.functional.batch_norm",
+    "embedding": "paddle.nn.Embedding",
+    "bilinear_tensor_product": "paddle.nn.BilinearTensorProduct",
+    "case": "jax.lax.switch over traced branches",
+    "cond": "jax.lax.cond (compiled) or plain Python if (eager)",
+    "conv2d": "paddle.nn.Conv2D / nn.functional.conv2d",
+    "conv2d_transpose": "paddle.nn.Conv2DTranspose",
+    "conv3d": "paddle.nn.Conv3D",
+    "conv3d_transpose": "paddle.nn.Conv3DTranspose",
+    "crf_decoding": "paddle.nn.functional.viterbi_decode (crf ops)",
+    "data_norm": "paddle.nn.BatchNorm (data_norm was its PS-side twin)",
+    "deform_conv2d": "paddle.vision.ops (not yet implemented here)",
+    "group_norm": "paddle.nn.GroupNorm",
+    "instance_norm": "paddle.nn.InstanceNorm2D",
+    "layer_norm": "paddle.nn.LayerNorm",
+    "multi_box_head": "paddle.nn.functional.prior_box + detection heads",
+    "nce": "paddle.nn.functional.softmax_with_cross_entropy on sampled "
+           "logits",
+    "prelu": "paddle.nn.PReLU",
+    "row_conv": "paddle.nn.RowConv / nn.functional.row_conv",
+    "spectral_norm": "paddle.nn.SpectralNorm",
+    "switch_case": "jax.lax.switch",
+    "while_loop": "jax.lax.while_loop",
+}
+
+__all__ = sorted(_EAGER) + ["py_func", "create_parameter"]
+
+
+def _make_shim(name, instead):
+    def shim(*args, **kwargs):
+        from ..framework.errors import UnimplementedError
+
+        raise UnimplementedError(
+            f"paddle.static.nn.{name} builds Program ops — this framework "
+            f"traces eager code instead (SURVEY §7); use: {instead}")
+
+    shim.__name__ = name
+    shim.__qualname__ = name
+    shim.__doc__ = f"Op-builder shim; eager equivalent: {instead}"
+    return shim
+
+
+for _name, _instead in _EAGER.items():
+    globals()[_name] = _make_shim(_name, _instead)
+del _name, _instead

@@ -1,8 +1,10 @@
-"""paddle_tpu.utils — install check, deprecation, lazy import.
+"""paddle_tpu.utils — install check, deprecation, lazy import, naming,
+downloads, profiler driver.
 
 Parity: python/paddle/utils/ (install_check.py:134 run_check,
-deprecated.py:31, lazy_import.py:19 try_import; download.py is omitted —
-this environment has no egress, datasets document local placement).
+deprecated.py:31, lazy_import.py:19 try_import, profiler.py, download.py,
+op_version.py) + the fluid framework utilities re-exported there
+(unique_name, require_version, load_op_library).
 """
 from __future__ import annotations
 
@@ -10,7 +12,71 @@ import functools
 import importlib
 import warnings
 
-__all__ = ["run_check", "deprecated", "try_import"]
+from . import unique_name  # noqa: F401
+from . import download  # noqa: F401
+from .profiler import Profiler, ProfilerOptions, get_profiler  # noqa: F401
+
+__all__ = ["run_check", "deprecated", "try_import", "unique_name",
+           "download", "Profiler", "ProfilerOptions", "get_profiler",
+           "require_version", "load_op_library", "OpLastCheckpointChecker"]
+
+
+def require_version(min_version: str, max_version=None):
+    """Assert the installed framework version is in range (ref:
+    fluid/framework.py require_version).  Compares dot-release tuples;
+    a development build ('0.0.0'-style or git suffix) passes."""
+    from ..version import __version__
+
+    def parse(v):
+        parts = []
+        for piece in str(v).split("."):
+            digits = "".join(ch for ch in piece if ch.isdigit())
+            if digits == "":
+                break
+            parts.append(int(digits))
+        return tuple(parts)
+
+    if not isinstance(min_version, str) or (
+            max_version is not None and not isinstance(max_version, str)):
+        raise TypeError("require_version: versions must be strings")
+    cur = parse(__version__)
+    if not cur or cur[0] == 0:
+        return  # 0.x dev build — version gates are for released majors
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed paddle_tpu {__version__} < required minimum "
+            f"{min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed paddle_tpu {__version__} > required maximum "
+            f"{max_version}")
+
+
+def load_op_library(lib_filename: str):
+    """Custom C++ op loading (ref: fluid/framework.py load_op_library,
+    .so of REGISTER_OPERATOR ops).  There is no OpKernel registry to
+    extend — custom ops are jax ops (pure functions, optionally Pallas
+    kernels); raises with that migration path."""
+    from ..framework.errors import UnimplementedError
+
+    raise UnimplementedError(
+        "load_op_library: no operator registry exists — write the op as "
+        "a jax function (optionally a Pallas kernel, see "
+        "paddle_tpu/ops/flash_attention.py for the pattern) and call it "
+        "directly; host C/C++ code can be reached via jax.pure_callback "
+        "or ctypes (paddle_tpu/native/ingest.cc pattern)")
+
+
+class OpLastCheckpointChecker:
+    """Op-version compatibility probe (ref: utils/op_version.py).  Ops
+    here have no version registry (XLA HLO is the contract), so every
+    query reports the op as current: empty mod list, version 0."""
+
+    def get_op_attrs(self, op_name):
+        return []
+
+    def get_version(self, op_name):
+        return 0
 
 
 def try_import(module_name: str):

@@ -1,0 +1,51 @@
+"""paddle.utils.download — cached artifact resolution.
+
+Parity: python/paddle/utils/download.py (get_weights_path_from_url,
+get_path_from_url).  This environment has no network egress, so the
+resolution order is: already-local path → populated cache hit
+(``~/.cache/paddle_tpu/<name>``, md5-checked when given) → a clear
+error telling the user where to place the file — never a silent hang
+on a socket.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+WEIGHTS_HOME = osp.expanduser("~/.cache/paddle_tpu/hapi/weights")
+DOWNLOAD_HOME = osp.expanduser("~/.cache/paddle_tpu/download")
+
+
+def _md5check(fullname: str, md5sum=None) -> bool:
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def get_path_from_url(url: str, root_dir: str = DOWNLOAD_HOME, md5sum=None,
+                      check_exist: bool = True) -> str:
+    """Resolve ``url`` to a local file (ref: download.py get_path_from_url
+    — minus the actual fetch, which needs egress)."""
+    if osp.exists(url):  # already a local path
+        return url
+    fname = osp.split(url)[-1]
+    fullname = osp.join(root_dir, fname)
+    if check_exist and osp.exists(fullname) and _md5check(fullname, md5sum):
+        return fullname
+    raise RuntimeError(
+        f"cannot download {url!r}: this environment has no network "
+        f"egress.  Place the file at {fullname!r} (it will be md5-checked "
+        f"and used as a cache hit) and retry")
+
+
+def get_weights_path_from_url(url: str, md5sum=None) -> str:
+    """Pretrained-weight resolution (ref: download.py
+    get_weights_path_from_url) — same cache contract, weights directory."""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

@@ -1,0 +1,117 @@
+"""paddle.utils.profiler — batch-range profiler driver.
+
+Parity: python/paddle/utils/profiler.py (ProfilerOptions:26, Profiler:63,
+get_profiler:131) over the paddle_tpu.profiler engine (host event table
++ jax.profiler device traces; see profiler.py for the TPU-native design
+replacing CUPTI, SURVEY §5).
+"""
+from __future__ import annotations
+
+import sys
+import warnings
+
+from ..profiler import start_profiler, stop_profiler, reset_profiler
+
+__all__ = ["ProfilerOptions", "Profiler", "get_profiler"]
+
+
+class ProfilerOptions:
+    """Option bag with the reference's keys/defaults (utils/profiler.py:26)."""
+
+    def __init__(self, options=None):
+        self.options = {
+            "state": "All",
+            "sorted_key": "default",
+            "tracer_level": "Default",
+            "batch_range": [0, sys.maxsize],
+            "output_thread_detail": False,
+            "profile_path": "none",
+            "timeline_path": "none",
+            "op_summary_path": "none",
+        }
+        if options is not None:
+            for key in self.options:
+                if options.get(key) is not None:
+                    self.options[key] = options[key]
+
+    def with_state(self, state):
+        self.options["state"] = state
+        return self
+
+    def __getitem__(self, name):
+        if self.options.get(name) is None:
+            raise ValueError(f"ProfilerOptions has no option named {name}")
+        v = self.options[name]
+        return None if isinstance(v, str) and v == "none" else v
+
+
+_current_profiler = None
+
+
+class Profiler:
+    """Context manager profiling a batch range (utils/profiler.py:63):
+    ``record_step()`` each iteration; profiling starts/stops when
+    ``batch_id`` crosses ``batch_range``."""
+
+    def __init__(self, enabled=True, options=None):
+        self.profiler_options = (options if options is not None
+                                 else ProfilerOptions())
+        self.batch_id = 0
+        self.enabled = enabled
+        self._running = False
+
+    def __enter__(self):
+        global _current_profiler
+        self.previous_profiler = _current_profiler
+        _current_profiler = self
+        if self.enabled and self.profiler_options["batch_range"][0] == 0:
+            self.start()
+        return self
+
+    def __exit__(self, exc_type, exc_value, tb):
+        global _current_profiler
+        _current_profiler = self.previous_profiler
+        if self.enabled:
+            self.stop()
+
+    def start(self):
+        if self.enabled and not self._running:
+            try:
+                start_profiler(state=self.profiler_options["state"])
+                self._running = True
+            except Exception as e:  # match reference's warn-don't-raise
+                warnings.warn(f"Profiler not enabled: {e}")
+
+    def stop(self):
+        if self.enabled and self._running:
+            try:
+                stop_profiler(
+                    sorted_key=self.profiler_options["sorted_key"],
+                    profile_path=self.profiler_options["profile_path"])
+                self._running = False
+            except Exception as e:
+                warnings.warn(f"Profiler not disabled: {e}")
+
+    def reset(self):
+        if self.enabled and self._running:
+            reset_profiler()
+
+    def record_step(self, change_profiler_status=True):
+        if not self.enabled:
+            return
+        self.batch_id += 1
+        if change_profiler_status:
+            lo, hi = self.profiler_options["batch_range"]
+            if self.batch_id == lo:
+                self.reset() if self._running else self.start()
+            if self.batch_id == hi:
+                self.stop()
+
+
+def get_profiler():
+    """The innermost active Profiler, creating a default one if none
+    (utils/profiler.py:131)."""
+    global _current_profiler
+    if _current_profiler is None:
+        _current_profiler = Profiler()
+    return _current_profiler

@@ -1,0 +1,58 @@
+"""paddle.utils.unique_name — session-unique name generation.
+
+Parity: python/paddle/fluid/unique_name.py (generate:84, switch:134,
+guard:187).  Names are purely cosmetic here (parameters live in Layer
+attribute paths, not a global Scope), but user code and ParamAttr
+defaults still ask for fresh names.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class UniqueNameGenerator:
+    """Counter-per-prefix generator (ref: unique_name.py:33)."""
+
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
+        self.ids: dict = {}
+
+    def __call__(self, key: str) -> str:
+        tmp = self.ids.setdefault(key, 0)
+        self.ids[key] = tmp + 1
+        return self.prefix + "_".join([key, str(tmp)])
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key: str) -> str:
+    """``key`` → ``key_<n>``, unique within the active generator."""
+    return generator(key)
+
+
+def switch(new_generator=None):
+    """Swap the active generator, returning the old one (ref :134)."""
+    global generator
+    old = generator
+    generator = new_generator if new_generator is not None \
+        else UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Scope a fresh (or prefixed) generator (ref :187): inside the
+    guard, counters restart — two models built under different guards
+    can reuse names without collision."""
+    if isinstance(new_generator, str):
+        new_generator = UniqueNameGenerator(new_generator)
+    elif isinstance(new_generator, bytes):
+        new_generator = UniqueNameGenerator(new_generator.decode())
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -1,0 +1,194 @@
+"""static/jit/utils/incubate parity surface (round-2 audit closure)."""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static, jit, utils
+from paddle_tpu.framework.errors import UnimplementedError
+
+
+class TestStatic:
+    def test_data_returns_input_spec(self):
+        spec = static.data("x", [None, 8], "float32")
+        assert isinstance(spec, static.InputSpec)
+        assert spec.name == "x" and spec.shape == (None, 8)
+
+    def test_print_passthrough(self, capsys):
+        x = jnp.asarray([1.0, 2.0])
+        out = static.Print(x, message="dbg")
+        np.testing.assert_array_equal(np.asarray(out), [1.0, 2.0])
+        jax.effects_barrier()
+        assert "dbg" in capsys.readouterr().out
+
+    def test_py_func_under_jit(self):
+        def host_twice(a):
+            return np.asarray(a) * 2  # runs on host
+
+        spec = static.InputSpec([3], "float32")
+
+        @jax.jit
+        def f(x):
+            return static.py_func(host_twice, x, spec)
+
+        np.testing.assert_allclose(
+            np.asarray(f(jnp.asarray([1.0, 2.0, 3.0]))), [2.0, 4.0, 6.0])
+
+    def test_py_func_backward_unimplemented(self):
+        with pytest.raises(UnimplementedError):
+            static.py_func(lambda x: x, jnp.zeros(2),
+                           static.InputSpec([2]), backward_func=lambda g: g)
+
+    def test_strategy_bags(self):
+        bs = static.BuildStrategy()
+        bs.fuse_all_reduce_ops = True
+        assert bs.fuse_all_reduce_ops is True
+        es = static.ExecutionStrategy()
+        es.num_threads = 4
+        assert es.num_threads == 4
+
+    def test_program_machinery_raises_on_use(self):
+        for name in ["Program", "Executor", "CompiledProgram",
+                     "ParallelExecutor", "append_backward", "gradients",
+                     "default_main_program", "global_scope",
+                     "program_guard", "set_program_state"]:
+            with pytest.raises(UnimplementedError):
+                getattr(static, name)()
+
+    def test_cpu_places_and_name_scope(self):
+        places = static.cpu_places(2)
+        assert len(places) == 2
+        with static.name_scope("block"):
+            pass
+        with pytest.raises(UnimplementedError):
+            static.cuda_places()
+
+    def test_load_program_state(self, tmp_path):
+        paddle.seed(0)
+        lin = nn.Linear(3, 2)
+        path = str(tmp_path / "m.pdparams")
+        paddle.save(lin.state_dict(), path)
+        state = static.load_program_state(str(tmp_path / "m"))
+        assert "weight" in state and state["weight"].shape == (3, 2)
+
+    def test_create_global_var(self):
+        v = static.create_global_var([2, 2], 1.5, "float32")
+        assert not v.trainable
+        np.testing.assert_allclose(np.asarray(v.value), 1.5)
+
+    def test_static_nn_shims(self):
+        from paddle_tpu.static import nn as snn
+
+        with pytest.raises(UnimplementedError) as ei:
+            snn.fc(None, 10)
+        assert "paddle.nn.Linear" in str(ei.value)
+        assert callable(snn.create_parameter)  # the real one
+
+    def test_weight_norm_param_attr_points_at_hook(self):
+        with pytest.raises(UnimplementedError) as ei:
+            static.WeightNormParamAttr(dim=0)
+        assert "weight_norm" in str(ei.value)
+
+
+class TestJit:
+    def test_program_translator_toggle(self):
+        paddle.seed(1)
+        lin = nn.Linear(4, 2)
+        compiled = jit.to_static(lin)
+        x = jnp.ones((2, 4), jnp.float32)
+        want = np.asarray(compiled(x))
+        pt = jit.ProgramTranslator.get_instance()
+        assert pt is jit.ProgramTranslator()
+        try:
+            pt.enable(False)
+            assert not pt.enable_to_static
+            np.testing.assert_allclose(np.asarray(compiled(x)), want,
+                                       atol=1e-6)
+        finally:
+            pt.enable(True)
+
+    def test_traced_layer_roundtrip(self, tmp_path):
+        paddle.seed(2)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        net.eval()
+        x = jnp.asarray(np.random.RandomState(0).randn(3, 4), jnp.float32)
+        out, traced = jit.TracedLayer.trace(net, [x])
+        np.testing.assert_allclose(np.asarray(traced(x)), np.asarray(out),
+                                   atol=1e-6)
+        path = str(tmp_path / "traced")
+        traced.save_inference_model(path)
+        loaded = jit.load(path)
+        np.testing.assert_allclose(np.asarray(loaded(np.asarray(x))),
+                                   np.asarray(out), atol=1e-5)
+
+    def test_verbosity_noops(self):
+        jit.set_code_level(50)
+        jit.set_verbosity(3)
+
+
+class TestUtils:
+    def test_unique_name(self):
+        from paddle_tpu.utils import unique_name
+
+        a = unique_name.generate("fc")
+        b = unique_name.generate("fc")
+        assert a != b and a.startswith("fc_")
+        with unique_name.guard():
+            c = unique_name.generate("fc")
+            assert c == "fc_0"
+        with unique_name.guard("pre_"):
+            assert unique_name.generate("fc").startswith("pre_fc_")
+
+    def test_require_version(self):
+        utils.require_version("0.0.1")  # dev build passes
+        with pytest.raises(TypeError):
+            utils.require_version(1)
+
+    def test_download_local_and_missing(self, tmp_path):
+        f = tmp_path / "w.bin"
+        f.write_bytes(b"abc")
+        assert utils.download.get_path_from_url(str(f)) == str(f)
+        with pytest.raises(RuntimeError) as ei:
+            utils.download.get_weights_path_from_url(
+                "https://example.com/nope.pdparams")
+        assert "no network egress" in str(ei.value)
+
+    def test_profiler_driver(self):
+        opts = utils.ProfilerOptions({"batch_range": [0, 2]})
+        with utils.Profiler(options=opts) as prof:
+            assert utils.get_profiler() is prof
+            prof.record_step()
+            prof.record_step()  # hits batch_range[1] → stop
+
+    def test_op_checker_and_load_op_library(self):
+        checker = utils.OpLastCheckpointChecker()
+        assert checker.get_version("matmul") == 0
+        assert checker.get_op_attrs("matmul") == []
+        with pytest.raises(UnimplementedError):
+            utils.load_op_library("custom.so")
+
+
+class TestIncubateReader:
+    def test_shards_round_robin(self, monkeypatch):
+        from paddle_tpu.incubate.reader import distributed_batch_reader
+
+        def batches():
+            for i in range(6):
+                yield i
+
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        assert list(distributed_batch_reader(batches)()) == [1, 3, 5]
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        assert list(distributed_batch_reader(batches)()) == [0, 2, 4]
+
+    def test_single_process_passthrough(self, monkeypatch):
+        from paddle_tpu.incubate.reader import distributed_batch_reader
+
+        monkeypatch.delenv("PADDLE_TRAINERS_NUM", raising=False)
+        monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+        assert list(distributed_batch_reader(lambda: iter([7, 8]))()) == [7, 8]
