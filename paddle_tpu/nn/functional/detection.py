@@ -25,7 +25,8 @@ from ...framework.errors import InvalidArgumentError
 
 __all__ = [
     "iou_similarity", "box_coder", "bipartite_match", "target_assign",
-    "mine_hard_examples", "ssd_loss", "prior_box",
+    "mine_hard_examples", "ssd_loss", "prior_box", "nms",
+    "multiclass_nms", "detection_output", "box_clip",
 ]
 
 _EPS = 1e-6
@@ -284,6 +285,116 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     if normalize:
         loss = loss / jnp.maximum(jnp.sum(target_loc_weight), _EPS)
     return loss
+
+
+def nms(boxes, scores, score_threshold=-jnp.inf, nms_top_k=-1,
+        nms_threshold=0.3, nms_eta=1.0, normalized=True):
+    """Single-class greedy NMS → keep mask ``[M]`` bool (transcribes
+    NMSFast, multiclass_nms_op.cc:139-192, incl. the adaptive-eta
+    threshold decay after each kept box).
+
+    TPU-native: candidates are score-sorted once (lax.top_k), the
+    pairwise IoU matrix is computed up front, and the inherently
+    sequential keep decision is a ``lax.fori_loop`` over the (bounded)
+    candidate list — one compiled loop, no host round-trips.
+    """
+    boxes = jnp.asarray(boxes)
+    scores = jnp.asarray(scores)
+    M = boxes.shape[0]
+    s = jnp.where(scores > score_threshold, scores, -jnp.inf)
+    k = M if nms_top_k is None or nms_top_k < 0 else min(int(nms_top_k), M)
+    top_s, order = jax.lax.top_k(s, k)
+    iou = iou_similarity(boxes[order], boxes[order], normalized)
+    idx = jnp.arange(k)
+
+    def body(i, state):
+        keep, thr = state
+        suppressed = jnp.any(keep & (idx < i) & (iou[i] > thr))
+        ok = (~suppressed) & jnp.isfinite(top_s[i])
+        keep = keep.at[i].set(ok)
+        thr = jnp.where(ok & (nms_eta < 1.0) & (thr > 0.5),
+                        thr * nms_eta, thr)  # :188-190
+        return keep, thr
+
+    keep_sorted, _ = jax.lax.fori_loop(
+        0, k, body,
+        (jnp.zeros((k,), bool), jnp.asarray(nms_threshold, jnp.float32)))
+    return jnp.zeros((M,), bool).at[order].set(keep_sorted)
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None, return_num=False):
+    """Multi-class NMS (ref: fluid/layers/detection.py:3256 over
+    multiclass_nms_op.cc).  bboxes ``[N, M, 4]``, scores ``[N, C, M]``.
+
+    Dense output (the reference emits a ragged LoD tensor): ``[N, K, 6]``
+    rows of (label, score, xmin, ymin, xmax, ymax) sorted by score,
+    padded with label=-1 (the reference's empty-result marker), where
+    ``K = keep_top_k`` (or C·M when keep_top_k=-1).  With
+    ``return_num=True`` also returns kept counts ``[N]``.
+    """
+    bboxes = jnp.asarray(bboxes)
+    scores = jnp.asarray(scores)
+    N, M = bboxes.shape[0], bboxes.shape[1]
+    C = scores.shape[1]
+    K = C * M if keep_top_k is None or keep_top_k < 0 else min(
+        int(keep_top_k), C * M)
+
+    def image(boxes, sc):  # boxes [M,4], sc [C,M]
+        keep = jax.vmap(lambda s1: nms(
+            boxes, s1, score_threshold, nms_top_k, nms_threshold,
+            nms_eta, normalized))(sc)  # [C, M]
+        if 0 <= background_label < C:
+            keep = keep.at[background_label].set(False)
+        flat = jnp.where(keep.reshape(-1), sc.reshape(-1), -jnp.inf)
+        top_s, top_i = jax.lax.top_k(flat, K)  # keep-top-k across classes
+        label = (top_i // M).astype(bboxes.dtype)
+        box = boxes[top_i % M]
+        valid = jnp.isfinite(top_s)
+        row = jnp.concatenate(
+            [label[:, None], top_s[:, None], box], axis=-1)
+        row = jnp.where(valid[:, None], row, -1.0)
+        return row, valid.sum().astype(jnp.int32)
+
+    out, nums = jax.vmap(image)(bboxes, scores)
+    return (out, nums) if return_num else out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """SSD inference head (ref: fluid/layers/detection.py:620): decode
+    location offsets against the priors, then multi-class NMS.  loc
+    ``[N, M, 4]``, scores ``[N, M, C]`` → dense ``[N, keep_top_k, 6]``
+    (see multiclass_nms for the padding contract; with ``return_index``
+    also the kept counts per image — the dense stand-in for the
+    reference's index LoD)."""
+    decoded = box_coder(prior_box, prior_box_var, jnp.asarray(loc),
+                        code_type="decode_center_size")  # [N, M, 4]
+    out, nums = multiclass_nms(
+        decoded, jnp.swapaxes(jnp.asarray(scores), 1, 2), score_threshold,
+        nms_top_k, keep_top_k, nms_threshold, True, nms_eta,
+        background_label, return_num=True)
+    return (out, nums) if return_index else out
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image bounds (ref kernel operators/detection/
+    box_clip_op.h): per image, x into [0, w/scale - 1], y into
+    [0, h/scale - 1].  input ``[N, M, 4]``, im_info ``[N, 3]``
+    (height, width, scale)."""
+    boxes = jnp.asarray(input)
+    info = jnp.asarray(im_info, boxes.dtype)
+    im_h = jnp.round(info[:, 0] / info[:, 2]) - 1.0
+    im_w = jnp.round(info[:, 1] / info[:, 2]) - 1.0
+    shape = (-1,) + (1,) * (boxes.ndim - 1)
+    zero = jnp.zeros((), boxes.dtype)
+    x = jnp.clip(boxes[..., 0::2], zero, im_w.reshape(shape))
+    y = jnp.clip(boxes[..., 1::2], zero, im_h.reshape(shape))
+    out = jnp.stack([x[..., 0], y[..., 0], x[..., 1], y[..., 1]], axis=-1)
+    return out
 
 
 def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.,),

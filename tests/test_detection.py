@@ -231,6 +231,115 @@ class TestSsdLoss:
         assert better < base * 0.25, (better, base)
 
 
+def _nms_np(boxes, scores, score_thr, top_k, iou_thr, eta):
+    """Transcribes NMSFast (multiclass_nms_op.cc:139-192)."""
+    order = np.argsort(-scores, kind="stable")
+    if top_k is not None and top_k >= 0:
+        order = order[:top_k]
+    order = [i for i in order if scores[i] > score_thr]
+    selected = []
+    thr = iou_thr
+    for i in order:
+        keep = True
+        for j in selected:
+            if _iou_np(boxes[i:i + 1], boxes[j:j + 1])[0, 0] > thr:
+                keep = False
+                break
+        if keep:
+            selected.append(i)
+            if eta < 1 and thr > 0.5:
+                thr *= eta
+    return selected
+
+
+class TestNms:
+    @pytest.mark.parametrize("eta", [1.0, 0.9])
+    def test_vs_oracle(self, eta):
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            mins = rng.uniform(0, 10, (20, 2))
+            boxes = np.concatenate([mins, mins + rng.uniform(1, 6, (20, 2))],
+                                   1).astype(np.float32)
+            scores = rng.uniform(0, 1, 20).astype(np.float32)
+            keep = np.asarray(F.nms(boxes, scores, score_threshold=0.1,
+                                    nms_top_k=15, nms_threshold=0.4,
+                                    nms_eta=eta))
+            want = _nms_np(boxes, scores, 0.1, 15, 0.4, eta)
+            np.testing.assert_array_equal(np.where(keep)[0], sorted(want))
+
+    def test_suppresses_overlaps(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 10.5, 10.5],
+                          [20, 20, 30, 30]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        keep = np.asarray(F.nms(boxes, scores, nms_threshold=0.5))
+        np.testing.assert_array_equal(keep, [True, False, True])
+
+
+class TestMulticlassNms:
+    def test_end_to_end(self):
+        rng = np.random.RandomState(1)
+        N, M, C = 2, 12, 4
+        mins = rng.uniform(0, 10, (N, M, 2))
+        boxes = np.concatenate([mins, mins + rng.uniform(1, 5, (N, M, 2))],
+                               -1).astype(np.float32)
+        scores = rng.uniform(0, 1, (N, C, M)).astype(np.float32)
+        out, nums = F.multiclass_nms(boxes, scores, score_threshold=0.3,
+                                     nms_top_k=10, keep_top_k=5,
+                                     nms_threshold=0.4, return_num=True)
+        assert out.shape == (N, 5, 6)
+        o = np.asarray(out)
+        n = np.asarray(nums)
+        for i in range(N):
+            rows = o[i, :n[i]]
+            assert (rows[:, 0] != 0).all(), "background must be excluded"
+            assert (np.diff(rows[:, 1]) <= 1e-6).all(), "sorted by score"
+            assert (o[i, n[i]:] == -1).all(), "padding rows are -1"
+            # every kept row agrees with a single-class oracle run
+            for lab in np.unique(rows[:, 0]):
+                sel = _nms_np(boxes[i], scores[i, int(lab)], 0.3, 10, 0.4, 1.0)
+                kept_boxes = rows[rows[:, 0] == lab][:, 2:]
+                for kb in kept_boxes:
+                    assert any(np.allclose(kb, boxes[i, s], atol=1e-5)
+                               for s in sel)
+
+    def test_jit(self):
+        rng = np.random.RandomState(2)
+        boxes = np.sort(rng.uniform(0, 9, (1, 6, 4)), -1).astype(np.float32)
+        scores = rng.uniform(0, 1, (1, 3, 6)).astype(np.float32)
+        f = jax.jit(lambda b, s: F.multiclass_nms(
+            b, s, score_threshold=0.2, nms_top_k=6, keep_top_k=4))
+        assert f(boxes, scores).shape == (1, 4, 6)
+
+
+class TestDetectionOutput:
+    def test_decode_then_nms(self):
+        rng = np.random.RandomState(3)
+        M, C = 8, 3
+        mins = rng.uniform(0, 0.6, (M, 2))
+        priors = np.concatenate([mins, mins + rng.uniform(0.1, 0.3, (M, 2))],
+                                -1).astype(np.float32)
+        pvar = np.tile(np.array([[0.1, 0.1, 0.2, 0.2]], np.float32), (M, 1))
+        loc = np.zeros((1, M, 4), np.float32)  # zero offsets → priors
+        scores = rng.uniform(0, 1, (1, M, C)).astype(np.float32)
+        out, nums = F.detection_output(loc, scores, priors, pvar,
+                                       keep_top_k=6, return_index=True)
+        o = np.asarray(out)[0]
+        n = int(np.asarray(nums)[0])
+        assert n > 0
+        for row in o[:n]:  # zero offsets decode back to the prior boxes
+            assert any(np.allclose(row[2:], p, atol=1e-4) for p in priors)
+
+
+class TestBoxClip:
+    def test_clips_to_image(self):
+        boxes = np.array([[[-5.0, -2.0, 50.0, 60.0],
+                           [1.0, 2.0, 3.0, 4.0]]], np.float32)
+        im_info = np.array([[40.0, 30.0, 1.0]], np.float32)  # h=40 w=30
+        out = np.asarray(F.box_clip(boxes, im_info))
+        np.testing.assert_allclose(out[0, 0], [0.0, 0.0, 29.0, 39.0])
+        np.testing.assert_allclose(out[0, 1], [1.0, 2.0, 3.0, 4.0])
+
+
 class TestPriorBox:
     def test_shapes_and_ranges(self):
         feat = jnp.zeros((1, 8, 4, 6))
