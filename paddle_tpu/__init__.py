@@ -297,3 +297,9 @@ def summary(net, input_size=None, dtypes=None, input=None):
     from .hapi.model import Model as _Model
 
     return _Model(net).summary(input_size=input_size, dtype=dtypes)
+
+
+# imported LAST: fluid's 1.x adapters re-use the top-level definitions
+# above (places, create_parameter, batch, ...), so the package must be
+# fully populated first
+from . import fluid  # noqa: E402,F401

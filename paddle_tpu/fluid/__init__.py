@@ -1,0 +1,93 @@
+"""paddle.fluid — the 1.x root namespace.
+
+Parity: python/paddle/fluid/__init__.py.  Everything here is an adapter
+over the one TPU-native implementation: layer functions (fluid.layers),
+1.x dygraph classes (fluid.dygraph), 1.x optimizer spellings
+(fluid.optimizer), places/ParamAttr/initializer/regularizer re-exports,
+and honest Program-machinery shims shared with paddle.static.  A 1.x
+script migrating to this framework finds every fluid name it touches:
+implemented, or raising with the eager replacement spelled out.
+"""
+from __future__ import annotations
+
+from paddle_tpu.framework import (  # noqa: F401
+    CPUPlace, TPUPlace, CUDAPlace, XPUPlace,
+    set_flags, get_flags,
+    is_compiled_with_cuda, is_compiled_with_tpu,
+)
+from paddle_tpu import CUDAPinnedPlace  # noqa: F401
+from paddle_tpu.nn import ParamAttr  # noqa: F401
+from paddle_tpu.nn.layer_base import Parameter  # noqa: F401
+from paddle_tpu import in_dygraph_mode  # noqa: F401
+from paddle_tpu.framework.serialization import save, load  # noqa: F401
+
+from . import layers  # noqa: F401
+from . import dygraph  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import initializer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from . import io  # noqa: F401
+from . import core  # noqa: F401
+from . import metrics  # noqa: F401
+from . import unique_name  # noqa: F401
+from .param_attr import WeightNormParamAttr  # noqa: F401
+from paddle_tpu import profiler  # noqa: F401
+
+# 1.x entry points that ARE portable
+from paddle_tpu.static import (  # noqa: F401
+    data, cpu_places, cuda_places, name_scope,
+    # Program-machinery shims (raise on use, naming the eager path)
+    Program, Executor, CompiledProgram, ParallelExecutor, Scope,
+    Variable, global_scope, scope_guard, program_guard,
+    default_main_program, default_startup_program, BuildStrategy,
+    ExecutionStrategy,
+)
+from paddle_tpu.static import (  # noqa: F401
+    save_inference_model, load_inference_model, load_program_state,
+    set_program_state,
+)
+from paddle_tpu.fluid.dygraph import guard as dygraph_guard  # noqa: F401
+from paddle_tpu import (  # noqa: F401
+    enable_dygraph, disable_dygraph, enable_static, disable_static,
+)
+from paddle_tpu.io import DataLoader  # noqa: F401
+from paddle_tpu.io import InMemoryDataset  # noqa: F401
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """fluid.embedding / fluid.input.embedding — the op-builder form;
+    points at the Layer (same contract as fluid.layers.embedding)."""
+    from ..framework.errors import UnimplementedError
+
+    raise UnimplementedError(
+        "fluid.embedding builds Program ops; construct "
+        "paddle.nn.Embedding(size[0], size[1]) once and call it "
+        "(fluid.dygraph.Embedding keeps the 1.x size=[v,d] spelling)")
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    return layers.one_hot(input, depth, allow_out_of_range)
+
+
+class LoDTensor:
+    """The reference's ragged runtime value (lod_tensor.h:114).  The
+    dense-padding policy (SURVEY §7g) replaces LoD with plain arrays +
+    lengths; constructing one raises with that guidance."""
+
+    def __init__(self, *a, **k):
+        from ..framework.errors import UnimplementedError
+
+        raise UnimplementedError(
+            "LoDTensor: ragged batches are dense-padded arrays + a "
+            "lengths tensor here (SURVEY §7g) — use "
+            "paddle.nn.functional.sequence_mask for masking")
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    LoDTensor()
+
+
+def create_random_int_lodtensor(*a, **k):
+    LoDTensor()

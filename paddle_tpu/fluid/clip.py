@@ -1,0 +1,24 @@
+"""paddle.fluid.clip — 1.x gradient-clip names.
+
+Parity: python/paddle/fluid/clip.py — GradientClipBy{Value,Norm,
+GlobalNorm} are the same strategies the 2.0 optimizers consume
+(optimizer/clip.py); set_gradient_clip's Program-global registration
+maps to the optimizer's ``grad_clip=`` argument.
+"""
+from paddle_tpu.optimizer.clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+)
+
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    from ..framework.errors import UnimplementedError
+
+    raise UnimplementedError(
+        "set_gradient_clip registered a clip on the global Program; pass "
+        "grad_clip=GradientClipBy...(...) to the optimizer instead "
+        "(the 2.0-recommended spelling, which the reference also "
+        "deprecates toward)")

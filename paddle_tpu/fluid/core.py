@@ -1,0 +1,56 @@
+"""paddle.fluid.core — the pybind module's Python-visible surface.
+
+Parity: paddle/fluid/pybind/pybind.cc:353 (module ``core_avx``).  The
+reference's core is the C++ bridge; here jax IS the bridge (SURVEY §7,
+L4 row), so this module exposes the handful of core names migration
+code actually touches: places, flag access, device queries.  Everything
+op-level (``core.ops.*``) is deliberately absent — the generated
+per-op fast path is replaced by the public tensor/functional API.
+"""
+from __future__ import annotations
+
+from paddle_tpu.framework import (  # noqa: F401
+    CPUPlace, CUDAPlace, TPUPlace, XPUPlace,
+)
+from paddle_tpu import CUDAPinnedPlace  # noqa: F401
+from paddle_tpu.framework import get_flags, set_flags  # noqa: F401
+
+
+def is_compiled_with_cuda() -> bool:
+    from paddle_tpu.framework import is_compiled_with_cuda as f
+
+    return f()
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def get_cuda_device_count() -> int:
+    return 0
+
+
+def globals():  # noqa: A001  (reference name: core.globals() flag map)
+    """Flag registry view (ref: pybind's global_value_getter_setter) —
+    read-only mapping of FLAGS_* values."""
+    from paddle_tpu.framework import flags as _flags
+
+    return {f"FLAGS_{k}" if not k.startswith("FLAGS_") else k: v["value"]
+            for k, v in _flags._REGISTRY.items()}
+
+
+class _OpsShim:
+    """core.ops.* — the build-time generated per-op C functions
+    (op_function_generator.cc:35).  Dygraph layers here call jnp
+    directly; anything poking core.ops gets a pointed error."""
+
+    def __getattr__(self, name):
+        from ..framework.errors import UnimplementedError
+
+        raise UnimplementedError(
+            f"core.ops.{name}: the generated pybind fast path does not "
+            f"exist — call the public API (paddle.{name} / "
+            f"paddle.nn.functional.{name}) which lowers to XLA directly")
+
+
+ops = _OpsShim()

@@ -1,0 +1,342 @@
+"""paddle.fluid.dygraph — the 1.x imperative API.
+
+Parity: python/paddle/fluid/dygraph/ (nn.py layer classes with 1.x
+constructor signatures, base.py guard/to_variable, checkpoint.py
+save/load_dygraph, parallel.py).  The classes here are thin adapters
+over the 2.0 layers: same parameters, 1.x argument names, built-in
+``act=`` activations — there is ONE implementation underneath.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+import paddle_tpu as _p
+from paddle_tpu import nn as _nn
+from paddle_tpu.nn import functional as _F
+from ...framework.errors import UnimplementedError
+
+from paddle_tpu.nn import Layer  # noqa: F401
+from paddle_tpu.nn import Sequential  # noqa: F401
+from paddle_tpu.nn import ParameterList, LayerList  # noqa: F401
+from paddle_tpu.nn import Pool2D, BilinearTensorProduct  # noqa: F401
+from paddle_tpu.distributed import (  # noqa: F401
+    DataParallel, ParallelEnv, prepare_context,
+)
+from paddle_tpu import jit  # noqa: F401
+from paddle_tpu.jit import ProgramTranslator, TracedLayer  # noqa: F401
+from paddle_tpu.jit import to_static as declarative  # noqa: F401
+from paddle_tpu import no_grad, grad  # noqa: F401
+from paddle_tpu import to_variable  # noqa: F401
+
+__all__ = [
+    "Layer", "guard", "to_variable", "no_grad", "grad", "enabled",
+    "Linear", "Conv2D", "Pool2D", "BatchNorm", "Embedding", "LayerNorm",
+    "Dropout", "GRUUnit", "PRelu", "BilinearTensorProduct", "NCE",
+    "Sequential", "ParameterList", "LayerList", "DataParallel",
+    "ParallelEnv", "prepare_context", "save_dygraph", "load_dygraph",
+    "declarative", "ProgramTranslator", "TracedLayer",
+]
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """1.x dygraph scope (ref: dygraph/base.py guard) — eager is the only
+    mode here, so this only optionally pins the device."""
+    if place is not None:
+        _p.set_device(place)
+    yield
+
+
+def enabled():
+    """Parity: fluid.dygraph.enabled — always True (single runtime)."""
+    return True
+
+
+_OPT_SLOT_SUFFIXES = (".moment", ".moment1", ".moment2", ".master",
+                      ".squared", ".linear", ".velocity", ".inf_norm",
+                      ".mean_square", ".mean_grad", ".avg_squared_grad",
+                      ".avg_squared_update")
+
+
+def save_dygraph(state_dict, model_path):
+    """Ref: dygraph/checkpoint.py save_dygraph — chooses .pdparams or
+    .pdopt by content like the reference does.  Optimizer state_dicts
+    here carry the step 'count', 'LR_Scheduler', or dotted slot keys."""
+    is_opt = ("count" in state_dict or "LR_Scheduler" in state_dict
+              or any(k.endswith(_OPT_SLOT_SUFFIXES) for k in state_dict)
+              or any(not hasattr(v, "shape") for v in state_dict.values()))
+    suffix = ".pdopt" if is_opt else ".pdparams"
+    return _p.save(state_dict, model_path + suffix)
+
+
+def load_dygraph(model_path, **configs):
+    """Ref: dygraph/checkpoint.py load_dygraph → (param_dict, opt_dict);
+    either may be None when the file doesn't exist."""
+    import os
+
+    params = opt = None
+    if os.path.exists(model_path + ".pdparams"):
+        params = _p.load(model_path + ".pdparams")
+    if os.path.exists(model_path + ".pdopt"):
+        opt = _p.load(model_path + ".pdopt")
+    if params is None and opt is None:
+        raise ValueError(
+            f"no .pdparams/.pdopt found for prefix {model_path!r}")
+    return params, opt
+
+
+class Linear(Layer):
+    """1.x Linear(input_dim, output_dim, act=...) (ref:
+    fluid/dygraph/nn.py:893) over the 2.0 weight layout."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        self._act = act
+        self._linear = _nn.Linear(input_dim, output_dim,
+                                  weight_attr=param_attr,
+                                  bias_attr=bias_attr)
+        self.weight = self._linear.weight
+        self.bias = self._linear.bias
+
+    def forward(self, input):
+        out = self._linear(input)
+        return getattr(_F, self._act)(out) if self._act else out
+
+
+class Conv2D(Layer):
+    """1.x Conv2D(num_channels, num_filters, filter_size, ..., act=)
+    (ref: fluid/dygraph/nn.py:44)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=None, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__()
+        self._act = act
+        self._conv = _nn.Conv2D(num_channels, num_filters, filter_size,
+                                stride=stride, padding=padding,
+                                dilation=dilation, groups=groups or 1,
+                                weight_attr=param_attr, bias_attr=bias_attr)
+        self.weight = self._conv.weight
+        self.bias = self._conv.bias
+
+    def forward(self, input):
+        out = self._conv(input)
+        return getattr(_F, self._act)(out) if self._act else out
+
+
+class BatchNorm(Layer):
+    """1.x BatchNorm(num_channels, act=, is_test=, momentum=, ...)
+    (ref: fluid/dygraph/nn.py:1145).  ``momentum`` keeps paddle's
+    running-stat convention (new = m·old + (1-m)·batch)."""
+
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", in_place=False,
+                 moving_mean_name=None, moving_variance_name=None,
+                 do_model_average_for_mean_and_var=True,
+                 use_global_stats=False, trainable_statistics=False):
+        super().__init__()
+        self._act = act
+        self._bn = _nn.BatchNorm(num_channels, momentum=momentum,
+                                 epsilon=epsilon, weight_attr=param_attr,
+                                 bias_attr=bias_attr,
+                                 data_format=data_layout,
+                                 use_global_stats=use_global_stats)
+        self.weight = self._bn.weight
+        self.bias = self._bn.bias
+        if is_test:
+            self.eval()
+
+    def forward(self, input):
+        out = self._bn(input)
+        return getattr(_F, self._act)(out) if self._act else out
+
+
+class Embedding(Layer):
+    """1.x Embedding(size=[vocab, dim], padding_idx=, ...) (ref:
+    fluid/dygraph/nn.py:1494)."""
+
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype="float32"):
+        super().__init__()
+        if is_distributed:
+            raise UnimplementedError(
+                "is_distributed embeddings: use "
+                "paddle.distributed.meta_parallel.VocabParallelEmbedding "
+                "(sharded tables replace the parameter server)")
+        self._emb = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                                  weight_attr=param_attr)
+        self.weight = self._emb.weight
+
+    def forward(self, input):
+        return self._emb(input)
+
+
+class LayerNorm(Layer):
+    """1.x LayerNorm(normalized_shape, scale=, shift=, act=) (ref:
+    fluid/dygraph/nn.py:1654)."""
+
+    def __init__(self, normalized_shape, scale=True, shift=True,
+                 epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        super().__init__()
+        self._act = act
+        self._ln = _nn.LayerNorm(normalized_shape, epsilon=epsilon,
+                                 weight_attr=param_attr if scale else False,
+                                 bias_attr=bias_attr if shift else False)
+
+    def forward(self, input):
+        out = self._ln(input)
+        return getattr(_F, self._act)(out) if self._act else out
+
+
+class Dropout(Layer):
+    """1.x Dropout(p, dropout_implementation=) (ref:
+    fluid/dygraph/nn.py:1385)."""
+
+    def __init__(self, p=0.5, seed=None, dropout_implementation=
+                 "downgrade_in_infer", is_test=False):
+        super().__init__()
+        self._mode = ("downscale_in_infer"
+                      if dropout_implementation == "downgrade_in_infer"
+                      else "upscale_in_train")
+        self._p = p
+        if is_test:
+            self.eval()
+
+    def forward(self, input):
+        return _F.dropout(input, p=self._p, training=self.training,
+                          mode=self._mode)
+
+
+class PRelu(Layer):
+    """1.x PRelu(mode, ...) (ref: fluid/dygraph/nn.py:2244): mode 'all'
+    (one alpha), 'channel' (per channel), 'element' (per element,
+    requires input_shape)."""
+
+    def __init__(self, mode, channel=None, input_shape=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__()
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            if channel is None:
+                raise ValueError("channel mode needs `channel`")
+            shape = [channel]
+        elif mode == "element":
+            if input_shape is None:
+                raise ValueError("element mode needs `input_shape`")
+            shape = list(input_shape)[1:]
+        else:
+            raise ValueError(f"unknown PRelu mode {mode!r}")
+        from paddle_tpu.nn.initializer import Constant
+
+        self.weight = self.create_parameter(
+            shape, attr=param_attr, default_initializer=Constant(0.25))
+        self._mode = mode
+
+    def forward(self, input):
+        x = jnp.asarray(input)
+        a = self.weight.value
+        if self._mode == "channel" and x.ndim > 2:
+            a = a.reshape((1, -1) + (1,) * (x.ndim - 2))
+        return jnp.where(x > 0, x, a * x)
+
+
+class GRUUnit(Layer):
+    """1.x GRUUnit — single-step GRU cell with the fused 1.x parameter
+    layout (ref: fluid/dygraph/nn.py:1828 over operators/gru_unit_op).
+    size = 3 × hidden."""
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__()
+        hidden = size // 3
+        self._hidden = hidden
+        self._origin_mode = origin_mode
+        self._act = activation
+        self._gate_act = gate_activation
+        # 1.x layout: weight [hidden, 3*hidden] (update|reset gates first
+        # 2*hidden, candidate last hidden), bias [1, 3*hidden]
+        self.weight = self.create_parameter([hidden, 3 * hidden],
+                                            attr=param_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([1, 3 * hidden], attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, input, hidden):
+        """input [B, 3*hidden] (pre-projected x), hidden [B, hidden] →
+        (new_hidden, reset_hidden_prev, gate)."""
+        x = jnp.asarray(input)
+        h = jnp.asarray(hidden)
+        H = self._hidden
+        w_gates = self.weight.value[:, : 2 * H]
+        w_cand = self.weight.value[:, 2 * H:]
+        gates = x[:, : 2 * H] + h @ w_gates
+        if self.bias is not None:
+            gates = gates + self.bias.value[0, : 2 * H]
+        gact = getattr(_F, self._gate_act)
+        u, r = jnp.split(gact(gates), 2, axis=-1)
+        rhp = r * h
+        c = x[:, 2 * H:] + rhp @ w_cand
+        if self.bias is not None:
+            c = c + self.bias.value[0, 2 * H:]
+        c = getattr(_F, self._act)(c)
+        if self._origin_mode:
+            new_h = u * h + (1 - u) * c
+        else:
+            new_h = (1 - u) * h + u * c
+        gate = jnp.concatenate([u, r, c], axis=-1)
+        return new_h, rhp, gate
+
+
+class NCE(Layer):
+    """1.x NCE layer — noise-contrastive estimation loss head (ref:
+    fluid/dygraph/nn.py:2006 over operators/nce_op).  Holds the
+    [num_total_classes, dim] weight table; forward computes the NCE loss
+    against ``sample_weights`` uniform negative sampling."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=10,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 is_sparse=False, dtype="float32"):
+        super().__init__()
+        if sampler != "uniform" or custom_dist is not None:
+            raise UnimplementedError(
+                "NCE: only uniform negative sampling is implemented")
+        self._num_classes = num_total_classes
+        self._num_neg = num_neg_samples
+        self.weight = self.create_parameter([num_total_classes, dim],
+                                            attr=param_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([num_total_classes, 1],
+                                           attr=bias_attr, is_bias=True))
+
+    def forward(self, input, label, sample_weight=None):
+        from paddle_tpu.nn.layer_base import current_rng_key
+
+        x = jnp.asarray(input)  # [B, D]
+        lab = jnp.asarray(label).reshape(-1)  # [B]
+        B = x.shape[0]
+        key = current_rng_key()
+        import jax
+
+        neg = jax.random.randint(key, (B, self._num_neg), 0,
+                                 self._num_classes)
+        ids = jnp.concatenate([lab[:, None], neg], axis=1)  # [B, 1+K]
+        w = self.weight.value[ids]  # [B, 1+K, D]
+        logits = jnp.einsum("bd,bkd->bk", x, w)
+        if self.bias is not None:
+            logits = logits + self.bias.value[ids, 0]
+        # NCE: positive → label 1, negatives → label 0, uniform noise
+        logq = jnp.log(jnp.asarray(self._num_neg / self._num_classes,
+                                   x.dtype))
+        logits = logits - logq
+        targets = jnp.zeros_like(logits).at[:, 0].set(1.0)
+        loss = _F.binary_cross_entropy_with_logits(logits, targets,
+                                                   reduction="none")
+        return loss.sum(-1, keepdims=True)

@@ -1,0 +1,41 @@
+"""paddle.fluid.initializer — 1.x initializer names.
+
+Parity: python/paddle/fluid/initializer.py — the 1.x surface exposes
+both class names (ConstantInitializer) and aliases (Constant); all map
+to the 2.0 nn.initializer implementations.
+"""
+from paddle_tpu.nn.initializer import (  # noqa: F401
+    Bilinear, Constant, Normal, TruncatedNormal, Uniform, XavierNormal,
+    XavierUniform, KaimingNormal, KaimingUniform, Assign,
+)
+
+# 1.x class spellings
+ConstantInitializer = Constant
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+UniformInitializer = Uniform
+NumpyArrayInitializer = Assign
+
+
+class Xavier(XavierNormal):
+    """1.x Xavier(uniform=True) switch (ref: initializer.py Xavier)."""
+
+    def __new__(cls, uniform=True, fan_in=None, fan_out=None, seed=0):
+        if uniform:
+            return XavierUniform(fan_in=fan_in, fan_out=fan_out)
+        return XavierNormal(fan_in=fan_in, fan_out=fan_out)
+
+
+class MSRA(KaimingNormal):
+    """1.x MSRA(uniform=True) switch (ref: initializer.py:639
+    MSRAInitializer — uniform is the DEFAULT there)."""
+
+    def __new__(cls, uniform=True, fan_in=None, seed=0):
+        if uniform:
+            return KaimingUniform(fan_in=fan_in)
+        return KaimingNormal(fan_in=fan_in)
+
+
+XavierInitializer = Xavier
+MSRAInitializer = MSRA
+BilinearInitializer = Bilinear

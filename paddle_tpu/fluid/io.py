@@ -1,0 +1,54 @@
+"""paddle.fluid.io — 1.x persistence + reader decorators.
+
+Parity: python/paddle/fluid/io.py (save/load_persistables:598,966,
+save/load_inference_model:1164,1374, program-state save/load:1669,1730)
++ the reader decorators re-exported there.
+"""
+from __future__ import annotations
+
+from paddle_tpu.framework.serialization import save, load  # noqa: F401
+from paddle_tpu.static import (  # noqa: F401
+    save_inference_model, load_inference_model, load_program_state,
+    set_program_state,
+)
+from paddle_tpu.io import DataLoader  # noqa: F401
+from paddle_tpu.reader import (  # noqa: F401
+    cache, map_readers, buffered, compose, chain, shuffle,
+    firstn, xmap_readers, multiprocess_reader,
+)
+from paddle_tpu import batch  # noqa: F401
+
+
+def _persistables(what):
+    from ..framework.errors import UnimplementedError
+
+    raise UnimplementedError(
+        f"fluid.io.{what} walked the Program for persistable Variables; "
+        f"state lives in Layers here — paddle.save(layer.state_dict(), "
+        f"path) / layer.set_state_dict(paddle.load(path))")
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    _persistables("save_persistables")
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    _persistables("load_persistables")
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    _persistables("save_params")
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    _persistables("load_params")
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    _persistables("save_vars")
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    _persistables("load_vars")

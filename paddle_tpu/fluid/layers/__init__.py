@@ -1,0 +1,721 @@
+"""paddle.fluid.layers — the 1.x functional surface.
+
+Parity: python/paddle/fluid/layers/ (~300 public names across nn.py,
+tensor.py, ops.py, loss.py, detection.py, control_flow.py,
+sequence_lod.py, rnn.py, metric_op.py).  Three tiers:
+
+* ops whose semantics survive eagerly are implemented: thin wrappers
+  translating 1.x argument names (``input``/``dim``/``keep_dim``...) to
+  the 2.0 implementations that already exist in paddle_tpu.tensor /
+  nn.functional — no second implementation, just the old calling
+  convention;
+* parameter-creating op-builders (fc, conv2d, batch_norm, ...) raise
+  ``UnimplementedError`` naming the Layer-class replacement — exactly
+  the set that also could not run in the reference's dygraph mode;
+* LoD-dependent sequence ops point at their dense/padded counterparts
+  (SURVEY §7g: dense padding + masks replace LoD).
+
+Every name of the reference module resolves: implemented, or an
+instructive error — never a bare AttributeError on real 1.x API.
+"""
+from __future__ import annotations
+
+from builtins import range as _range
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as _p
+from paddle_tpu import nn as _nn
+from paddle_tpu.nn import functional as _F
+from ...framework.errors import UnimplementedError
+
+# -- direct re-exports: same name, compatible signature -----------------
+from paddle_tpu.tensor import (  # noqa: F401
+    cast, concat, assign, argmin, argmax, argsort, ones, zeros, reverse,
+    isfinite, linspace, zeros_like, ones_like, diag, eye, triu,
+    gather, gather_nd, scatter, scatter_nd_add, scatter_nd, slice,
+    strided_slice, shape, rank, sign, where, unbind, unique,
+    shard_index, stack, unstack, flatten, squeeze, unsqueeze, transpose,
+    clip, log, pow, abs, exp, sqrt, rsqrt, ceil,
+    floor, cos, sin, tanh, round, reciprocal, square, cumsum,
+    less_than, less_equal, greater_than, greater_equal,
+    equal, not_equal, logical_and, logical_or, logical_xor, logical_not,
+    is_empty, mean,
+)
+from paddle_tpu import crop_tensor, increment  # noqa: F401
+from paddle_tpu.nn.functional import (  # noqa: F401
+    relu, selu, elu, relu6, swish, mish, prelu, leaky_relu, maxout,
+    log_loss, dice_loss, npair_loss, mse_loss, square_error_cost,
+    softmax_with_cross_entropy, label_smooth,
+)
+from paddle_tpu.nn.functional import (  # noqa: F401
+    row_conv, gather_tree, iou_similarity, ssd_loss, prior_box,
+    bipartite_match, target_assign, detection_output, box_coder,
+    box_clip, multiclass_nms, sequence_mask, linear_chain_crf,
+    crf_decoding, pixel_shuffle, unfold, temporal_shift,
+)
+from paddle_tpu.nn import (  # noqa: F401
+    BeamSearchDecoder, Decoder, dynamic_decode, RNNCellBase as RNNCell,
+    GRUCell, LSTMCell, clip_by_norm,
+)
+from paddle_tpu.metric import accuracy  # noqa: F401
+from ...static import Print, py_func, create_parameter, create_global_var  # noqa: F401
+
+
+# -- 1.x calling-convention wrappers ------------------------------------
+def _act(out, act):
+    if act:
+        fn = getattr(_F, act, None)
+        if fn is None:
+            raise UnimplementedError(f"activation {act!r} unknown")
+        return fn(out)
+    return out
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _p.sum(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _p.mean(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _p.max(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _p.min(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _p.prod(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    return _p.all(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    return _p.any(input, axis=dim, keepdim=keep_dim)
+
+
+def softmax(input, use_cudnn=False, name=None, axis=-1):
+    return _F.softmax(input, axis=axis)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    out = x @ y
+    return out if alpha == 1.0 else out * alpha
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    """1.x mul op: flatten x/y to 2-D around the given split dims then
+    matmul (ref: operators/mul_op.cc)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    xs = x.reshape((int(jnp.prod(jnp.asarray(x.shape[:x_num_col_dims]))), -1))
+    ys = y.reshape((int(jnp.prod(jnp.asarray(y.shape[:y_num_col_dims]))), -1))
+    out = xs @ ys
+    return out.reshape(x.shape[:x_num_col_dims] + y.shape[y_num_col_dims:])
+
+
+def topk(input, k, name=None):
+    return _p.topk(input, k)
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    return _F.one_hot(jnp.asarray(input).squeeze(-1)
+                      if jnp.asarray(input).ndim > 1
+                      and jnp.asarray(input).shape[-1] == 1 else input, depth)
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    from ...framework.dtype import convert_dtype
+
+    return jnp.full(tuple(int(s) for s in shape), value, convert_dtype(dtype))
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    from ...framework.dtype import convert_dtype
+
+    return jnp.zeros((), convert_dtype(dtype))
+
+
+def sums(input, out=None):
+    return _p.add_n(list(input))
+
+
+def range(start, end, step, dtype, name=None):
+    from ...framework.dtype import convert_dtype
+
+    return jnp.arange(_scalar(start), _scalar(end), _scalar(step),
+                      convert_dtype(dtype))
+
+
+def _scalar(v):
+    import numpy as np
+
+    return v if isinstance(v, (int, float)) else np.asarray(v).item()
+
+
+def has_inf(x):
+    return jnp.isinf(jnp.asarray(x)).any()
+
+
+def has_nan(x):
+    return jnp.isnan(jnp.asarray(x)).any()
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    mode = ("downscale_in_infer"
+            if dropout_implementation == "downgrade_in_infer"
+            else "upscale_in_train")
+    return _F.dropout(x, p=dropout_prob, training=not is_test, mode=mode)
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    return _p.split(input, num_or_sections, axis=dim)
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    return _act(_p.reshape(x, shape), act)
+
+
+def expand(x, expand_times, name=None):
+    return _p.tile(x, expand_times)
+
+
+def expand_as(x, target_tensor, name=None):
+    return _p.expand_as(x, target_tensor)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = jnp.asarray(x)
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return _act(out, act)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _act(_bcast(x, y, axis, jnp.add), act)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _act(_bcast(x, y, axis, jnp.subtract), act)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _act(_bcast(x, y, axis, jnp.multiply), act)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _act(_bcast(x, y, axis, jnp.divide), act)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _act(_bcast(x, y, axis, jnp.maximum), act)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _act(_bcast(x, y, axis, jnp.minimum), act)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _act(_bcast(x, y, axis, jnp.power), act)
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    return _act(_bcast(x, y, axis, jnp.mod), act)
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    return _act(_bcast(x, y, axis, jnp.floor_divide), act)
+
+
+def _bcast(x, y, axis, op):
+    """1.x elementwise broadcast: y's dims align to x starting at
+    ``axis`` (ref: operators/elementwise/elementwise_op_function.h)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y, x.dtype)
+    if axis != -1 and y.ndim < x.ndim:
+        y = y.reshape(y.shape + (1,) * (x.ndim - axis - y.ndim))
+    return op(x, y)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    return _F.normalize(x, p=2, axis=axis, epsilon=epsilon)
+
+
+def cos_sim(X, Y):
+    out = _F.cosine_similarity(X, Y, axis=-1)
+    return jnp.asarray(out)[..., None]
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    return _F.cross_entropy(input, label, soft_label=soft_label,
+                            ignore_index=ignore_index, reduction="none",
+                            use_softmax=False)[..., None]
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      name=None, normalize=False):
+    out = _F.binary_cross_entropy_with_logits(
+        x, jnp.asarray(label, jnp.asarray(x).dtype), reduction="none")
+    mask = jnp.asarray(label) != ignore_index
+    out = jnp.where(mask, out, 0.0)
+    if normalize:
+        out = out / jnp.maximum(mask.sum(), 1)
+    return out
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    return _F.kl_div(x, target, reduction=reduction)
+
+
+def huber_loss(input, label, delta):
+    return _F.smooth_l1_loss(input, label, reduction="none", delta=delta)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    """1.x smooth_l1 op (ref: operators/smooth_l1_loss_op.cc): per-row
+    summed smooth-L1 with optional elementwise weights; sigma scales the
+    quadratic window."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y, x.dtype)
+    s2 = (1.0 if sigma is None else float(sigma)) ** 2
+    d = (x - y) * (1.0 if inside_weight is None
+                   else jnp.asarray(inside_weight, x.dtype))
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * s2 * d * d, ad - 0.5 / s2)
+    if outside_weight is not None:
+        loss = loss * jnp.asarray(outside_weight, x.dtype)
+    return loss.reshape(loss.shape[0], -1).sum(-1, keepdims=True)
+
+
+def mean_iou(input, label, num_classes):
+    from paddle_tpu.metric import mean_iou as _miou
+
+    return _miou(input, label, num_classes)
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    from paddle_tpu.metric import chunk_eval as _ce
+
+    return _ce(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types, seq_length)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    from paddle_tpu.metric import Auc
+
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(input, label)
+    v = m.accumulate()
+    return jnp.asarray(v, jnp.float32), None, None
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    t, b, l, r = [int(p) for p in paddings]
+    pad = ([0, 0, 0, 0, t, b, l, r] if data_format == "NCHW"
+           else [0, 0, t, b, l, r, 0, 0])
+    return _p.pad(input, pad, mode="replicate" if mode == "edge" else mode,
+                  value=pad_value)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    pads = [(0, int(xs - ys)) for xs, ys in zip(x.shape, y.shape)]
+    return jnp.pad(y, pads, constant_values=pad_value)
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None, align_corners=True,
+                 align_mode=1, data_format="NCHW"):
+    mode = {"BILINEAR": "bilinear", "NEAREST": "nearest",
+            "TRILINEAR": "trilinear", "LINEAR": "linear",
+            "BICUBIC": "bicubic"}[resample]
+    return _F.interpolate(input, size=out_shape, scale_factor=scale,
+                          mode=mode, align_corners=align_corners,
+                          align_mode=align_mode, data_format=data_format)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1,
+                    data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "BILINEAR",
+                        actual_shape, align_corners, align_mode, data_format)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True,
+                   data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "NEAREST",
+                        actual_shape, align_corners, 1, data_format)
+
+
+def grid_sampler(x, grid, name=None):
+    return _F.grid_sample(x, grid)
+
+
+def unique_with_counts(x, dtype="int32"):
+    vals, idx, counts = _p.unique(x, return_inverse=True, return_counts=True)
+    return vals, idx, counts
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """Greedy CTC decoding, dense-padded form (ref:
+    fluid/layers/nn.py ctc_greedy_decoder over ctc_align_op): argmax per
+    step, merge repeats, drop blanks.  input ``[B, T, C]`` (batch-first
+    dense; the reference's LoD variant is replaced by ``input_length``).
+    Returns (decoded ``[B, T]`` padded with ``padding_value``,
+    lengths ``[B, 1]``)."""
+    import numpy as np
+
+    probs = np.asarray(input)
+    if probs.ndim != 3:
+        raise UnimplementedError(
+            "dense ctc_greedy_decoder expects [batch, time, classes]")
+    B, T, _ = probs.shape
+    lens = (np.asarray(input_length).reshape(B)
+            if input_length is not None else np.full(B, T))
+    out = np.full((B, T), padding_value, np.int64)
+    out_lens = np.zeros((B, 1), np.int64)
+    for b in _range(B):
+        path = probs[b, : lens[b]].argmax(-1)
+        prev = -1
+        k = 0
+        for t in path:
+            if t != prev and t != blank:
+                out[b, k] = t
+                k += 1
+            prev = t
+        out_lens[b, 0] = k
+    return jnp.asarray(out), jnp.asarray(out_lens)
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    """CTC loss (ref: operators/warpctc_op over the warp-ctc vendor lib —
+    here XLA computes the same dynamic program via F.ctc_loss).  Dense
+    logits ``[T, B, C]`` (time-major, reference layout when
+    input_length is given)."""
+    if input_length is None or label_length is None:
+        raise UnimplementedError(
+            "warpctc needs input_length/label_length (dense-padding "
+            "policy replaces LoD inputs — SURVEY §7g)")
+    return _F.ctc_loss(input, label, input_length, label_length,
+                       blank=blank, reduction="none")
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Levenshtein distance per pair (ref: operators/edit_distance_op).
+    Dense ``[B, T]`` int sequences + lengths; host computation (it's an
+    eval metric, same as the reference's CPU-only kernel)."""
+    import numpy as np
+
+    a = np.asarray(input)
+    b = np.asarray(label)
+    if a.ndim == 1:
+        a, b = a[None], b[None]
+    B = a.shape[0]
+    la = (np.asarray(input_length).reshape(B)
+          if input_length is not None else np.full(B, a.shape[1]))
+    lb = (np.asarray(label_length).reshape(B)
+          if label_length is not None else np.full(B, b.shape[1]))
+    ignored = set(ignored_tokens or ())
+    out = np.zeros((B, 1), np.float32)
+    seq_num = np.asarray([B], np.int64)
+    for i in _range(B):
+        s1 = [t for t in a[i, : la[i]] if t not in ignored]
+        s2 = [t for t in b[i, : lb[i]] if t not in ignored]
+        m, n = len(s1), len(s2)
+        dp = np.arange(n + 1, dtype=np.int64)
+        for r in _range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = r
+            for c in _range(1, n + 1):
+                dp[c] = min(prev[c] + 1, dp[c - 1] + 1,
+                            prev[c - 1] + (s1[r - 1] != s2[c - 1]))
+        d = float(dp[n])
+        out[i, 0] = d / n if (normalized and n) else d
+    return jnp.asarray(out), jnp.asarray(seq_num)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    """1.x hard_sigmoid keeps its slope/offset knobs (ref:
+    operators/activation_op.cc HardSigmoid; 2.0 hardsigmoid fixes
+    slope=1/6)."""
+    x = jnp.asarray(x)
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    x = jnp.asarray(x)
+    return x * jnp.clip(x + offset, 0.0, threshold) / scale
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    """Bounded relu (ref: activation_op BRelu)."""
+    return jnp.clip(jnp.asarray(x), t_min, t_max)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    """log(1 + e^min(max(x,-t),t)) (ref: activation_op SoftRelu)."""
+    x = jnp.clip(jnp.asarray(x), -threshold, threshold)
+    return jnp.log1p(jnp.exp(x))
+
+
+def size(input):
+    """Element count as a tensor (ref: size_op)."""
+    return _p.numel(input)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,
+                   name=None):
+    return _p.uniform(shape, dtype=dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32",
+                    name=None):
+    from ...framework.dtype import convert_dtype
+
+    out = _p.randn(list(shape))
+    return (out * std + mean).astype(convert_dtype(dtype))
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Functional RNN driver over a cell (ref: fluid/layers/rnn.py rnn —
+    the lax.scan loop lives in nn.RNN)."""
+    return _nn.RNN(cell, is_reverse=is_reverse, time_major=time_major)(
+        inputs, initial_states, sequence_length)
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    return _nn.BiRNN(cell_fw, cell_bw, time_major=time_major)(
+        inputs, initial_states, sequence_length)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize keeping aspect ratio so the SHORT side is out_short_len
+    (ref: fluid/layers/nn.py image_resize_short)."""
+    x = jnp.asarray(input)
+    h, w = x.shape[2], x.shape[3]
+    short, long_ = (h, w) if h < w else (w, h)
+    ratio = out_short_len / float(short)
+    out_shape = ([out_short_len, int(long_ * ratio)] if h < w
+                 else [int(long_ * ratio), out_short_len])
+    return image_resize(x, out_shape=out_shape, resample=resample)
+
+
+def resize_linear(input, out_shape=None, scale=None, name=None,
+                  actual_shape=None, align_corners=True, align_mode=1,
+                  data_format="NCW"):
+    return _F.interpolate(input, size=out_shape, scale_factor=scale,
+                          mode="linear", align_corners=align_corners,
+                          align_mode=align_mode, data_format=data_format)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    return _F.interpolate(input, size=out_shape, scale_factor=scale,
+                          mode="trilinear", align_corners=align_corners,
+                          align_mode=align_mode, data_format=data_format)
+
+
+# -- sequence ops: dense/padded counterparts ----------------------------
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    raise UnimplementedError(
+        "sequence_pad consumes LoD input; dense batches are already "
+        "padded here — build them with paddle.io.DataLoader collation "
+        "(SURVEY §7g dense-padding policy)")
+
+
+def sequence_unpad(x, length, name=None):
+    raise UnimplementedError(
+        "sequence_unpad: keep the lengths tensor alongside the padded "
+        "batch and mask with paddle.nn.functional.sequence_mask instead")
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    raise UnimplementedError(
+        "sequence_softmax is LoD-ragged; use softmax over the padded "
+        "axis with a sequence_mask of -inf on padding")
+
+
+def sequence_reverse(x, name=None):
+    raise UnimplementedError(
+        "sequence_reverse is LoD-ragged; for padded batches reverse the "
+        "valid prefix per row: paddle.flip + sequence_mask")
+
+
+# -- static-only op-builders / LoD machinery ----------------------------
+_STATIC_ONLY = {
+    # param-creating builders → Layer classes
+    "fc": "paddle.nn.Linear", "embedding": "paddle.nn.Embedding",
+    "conv2d": "paddle.nn.Conv2D", "conv3d": "paddle.nn.Conv3D",
+    "conv2d_transpose": "paddle.nn.Conv2DTranspose",
+    "conv3d_transpose": "paddle.nn.Conv3DTranspose",
+    "batch_norm": "paddle.nn.BatchNorm2D", "inplace_abn": "paddle.nn.BatchNorm2D",
+    "instance_norm": "paddle.nn.InstanceNorm2D",
+    "data_norm": "paddle.nn.BatchNorm1D",
+    "layer_norm": "paddle.nn.LayerNorm", "group_norm": "paddle.nn.GroupNorm",
+    "spectral_norm": "paddle.nn.SpectralNorm",
+    "nce": "paddle.nn.functional.softmax_with_cross_entropy over sampled logits",
+    "hsigmoid": "paddle.nn.HSigmoidLoss",
+    "bilinear_tensor_product": "paddle.nn.BilinearTensorProduct",
+    "pool2d": "paddle.nn.Pool2D / nn.functional.max_pool2d",
+    "pool3d": "paddle.nn.functional.max_pool3d",
+    "adaptive_pool2d": "paddle.nn.functional.adaptive_avg_pool2d",
+    "adaptive_pool3d": "paddle.nn.functional.adaptive_avg_pool3d",
+    "center_loss": "a Layer holding the centers buffer + mse update",
+    "deformable_conv": "paddle.vision-style deform conv (not implemented)",
+    "lrn": "paddle.nn.LocalResponseNorm",
+    "prroi_pool": "roi pooling family (not implemented)",
+    "psroi_pool": "roi pooling family (not implemented)",
+    "roi_pool": "roi pooling family (not implemented)",
+    "roi_align": "roi pooling family (not implemented)",
+    "deformable_roi_pooling": "roi pooling family (not implemented)",
+    # program control flow → lax / python
+    "While": "jax.lax.while_loop (compiled) or Python while (eager)",
+    "Switch": "jax.lax.switch", "IfElse": "jax.lax.cond",
+    "cond": "jax.lax.cond (compiled) or Python if (eager)",
+    "case": "jax.lax.switch", "switch_case": "jax.lax.switch",
+    "while_loop": "jax.lax.while_loop",
+    "DynamicRNN": "paddle.nn.RNN over padded batches",
+    "StaticRNN": "paddle.nn.RNN",
+    "array_write": "jax arrays are functional — collect in lax.scan",
+    "array_read": "jax arrays are functional — index normally",
+    "array_length": "len() of the Python list / leading dim",
+    "create_array": "a Python list or a preallocated jnp array",
+    "tensor_array_to_tensor": "jnp.stack / jnp.concatenate",
+    "reorder_lod_tensor_by_rank": "LoD machinery replaced by dense padding",
+    "Assert": "paddle_tpu.framework checks / chex assertions",
+    "autoincreased_step_counter": "track the step in the train loop state",
+    "fill_constant_batch_size_like": "jnp.full with the known batch size",
+    "uniform_random_batch_size_like": "paddle.uniform with the known shape",
+    "gaussian_random_batch_size_like": "paddle.randn with the known shape",
+    "sampling_id": "paddle.multinomial",
+    "random_crop": "paddle.vision.transforms.RandomCrop",
+    "im2sequence": "paddle.nn.functional.unfold",
+    "filter_by_instag": "boolean-mask gather (paddle.masked_select)",
+    "merge_selected_rows": "SelectedRows replaced by dense grads",
+    "get_tensor_from_selected_rows": "SelectedRows replaced by dense grads",
+    "continuous_value_model": "CTR-specific op; see models/wide_deep.py",
+    "hash": "CTR-specific hashing; use Python/np hashing at ingest",
+    "similarity_focus": "not implemented — open an issue if needed",
+    "affine_channel": "scale/shift with broadcasting (x * w + b)",
+    "space_to_depth": "paddle.nn.PixelUnshuffle",
+    "shuffle_channel": "paddle.nn.ChannelShuffle",
+    "fsp_matrix": "einsum('nchw,ndhw->ncd') / distillation utilities",
+    "add_position_encoding": "add a position embedding table",
+    "lod_reset": "LoD machinery replaced by dense padding + lengths",
+    "lod_append": "LoD machinery replaced by dense padding + lengths",
+    "sequence_conv": "conv1d over padded batches with sequence_mask",
+    "sequence_pool": "masked reduce over the padded time axis",
+    "sequence_concat": "concat padded batches + combined lengths",
+    "sequence_first_step": "x[:, 0]",
+    "sequence_last_step": "take_along_axis with lengths-1",
+    "sequence_slice": "lax.dynamic_slice per row",
+    "sequence_expand": "repeat/gather by lengths",
+    "sequence_expand_as": "repeat/gather by lengths",
+    "sequence_reshape": "reshape padded batches directly",
+    "sequence_scatter": "scatter with row offsets",
+    "sequence_enumerate": "sliding windows via jnp.stack of shifts",
+    # PS / distributed-specific
+    "Send": "XLA collectives (paddle.distributed)",
+    "Recv": "XLA collectives (paddle.distributed)",
+    # lr schedules (Program-variable based in 1.x)
+    "exponential_decay": "paddle.optimizer.lr.ExponentialDecay",
+    "natural_exp_decay": "paddle.optimizer.lr.NaturalExpDecay",
+    "inverse_time_decay": "paddle.optimizer.lr.InverseTimeDecay",
+    "polynomial_decay": "paddle.optimizer.lr.PolynomialDecay",
+    "piecewise_decay": "paddle.optimizer.lr.PiecewiseDecay",
+    "noam_decay": "paddle.optimizer.lr.NoamDecay",
+    "cosine_decay": "paddle.optimizer.lr.CosineAnnealingDecay",
+    "linear_lr_warmup": "paddle.optimizer.lr.LinearWarmup",
+    # io readers
+    "data": "paddle.static.data (InputSpec) + paddle.io.DataLoader",
+    "read_file": "paddle.io.DataLoader", "double_buffer":
+        "DataLoader device staging is double-buffered already",
+    "py_reader": "paddle.io.DataLoader",
+    "create_py_reader_by_data": "paddle.io.DataLoader",
+    "load": "paddle.load / inference.load_inference_model",
+    # rnn legacy
+    "dynamic_lstm": "paddle.nn.LSTM", "dynamic_lstmp": "paddle.nn.LSTM",
+    "dynamic_gru": "paddle.nn.GRU", "gru_unit": "paddle.nn.GRUCell",
+    "lstm_unit": "paddle.nn.LSTMCell", "lstm": "paddle.nn.LSTM",
+    "beam_search": "paddle.nn.BeamSearchDecoder + dynamic_decode",
+    "beam_search_decode": "paddle.nn.functional.gather_tree",
+    "DecodeHelper": "subclass paddle.nn.Decoder",
+    "TrainingHelper": "teacher forcing = run the RNN over the batch",
+    "GreedyEmbeddingHelper": "BeamSearchDecoder(beam_size=1)",
+    "SampleEmbeddingHelper": "sample from softmax inside a Decoder.step",
+    "BasicDecoder": "subclass paddle.nn.Decoder",
+    # detection long tail
+    "density_prior_box": "prior_box covers the SSD path; density variant "
+                         "not implemented",
+    "multi_box_head": "compose conv heads + prior_box",
+    "rpn_target_assign": "two-stage detectors not implemented",
+    "retinanet_target_assign": "two-stage detectors not implemented",
+    "sigmoid_focal_loss": "focal loss: BCE-with-logits with modulation",
+    "anchor_generator": "prior_box",
+    "roi_perspective_transform": "not implemented",
+    "generate_proposal_labels": "two-stage detectors not implemented",
+    "generate_proposals": "two-stage detectors not implemented",
+    "generate_mask_labels": "two-stage detectors not implemented",
+    "polygon_box_transform": "not implemented",
+    "yolov3_loss": "YOLO family not implemented",
+    "yolo_box": "YOLO family not implemented",
+    "locality_aware_nms": "multiclass_nms covers the standard path",
+    "matrix_nms": "multiclass_nms covers the standard path",
+    "retinanet_detection_output": "detection_output",
+    "distribute_fpn_proposals": "two-stage detectors not implemented",
+    "box_decoder_and_assign": "box_coder + target_assign",
+    "collect_fpn_proposals": "two-stage detectors not implemented",
+    # misc losses
+    "bpr_loss": "pairwise softmax loss over positive/negative logits",
+    "sampled_softmax_with_cross_entropy": "sample negatives at ingest + "
+                                          "softmax_with_cross_entropy",
+    "rank_loss": "paddle.nn.functional.margin_ranking_loss",
+    "margin_rank_loss": "paddle.nn.functional.margin_ranking_loss",
+    "teacher_student_sigmoid_loss": "distillation loss not implemented",
+    "warpctc_lod": "warpctc with explicit lengths",
+    "crop": "paddle.crop",
+    "maxout_legacy": "paddle.nn.functional.maxout",
+}
+
+
+def __getattr__(name):
+    hint = _STATIC_ONLY.get(name)
+    if hint is not None:
+        def shim(*a, **k):
+            raise UnimplementedError(
+                f"fluid.layers.{name} is 1.x Program/LoD API without an "
+                f"eager counterpart here; use: {hint}")
+
+        shim.__name__ = name
+        shim.__doc__ = f"1.x shim; eager equivalent: {hint}"
+        return shim
+    # final fallback: 2.0 tensor/functional name used through fluid.layers
+    for ns in (_p, _F):
+        if hasattr(ns, name):
+            return getattr(ns, name)
+    raise AttributeError(
+        f"module 'paddle_tpu.fluid.layers' has no attribute {name!r}")

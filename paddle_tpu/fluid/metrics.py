@@ -1,0 +1,205 @@
+"""paddle.fluid.metrics — 1.x running-metric accumulators.
+
+Parity: python/paddle/fluid/metrics.py (MetricBase:58, Accuracy:435 —
+weighted running mean over ``update(value, weight)``, Precision:272 /
+Recall:352 binary counters, ChunkEvaluator:513 consuming chunk_eval's
+count outputs, EditDistance:611, Auc:699, CompositeMetric:199).  Pure
+host-side numpy accumulators, same as the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.errors import InvalidArgumentError, UnimplementedError
+
+__all__ = [
+    "MetricBase", "CompositeMetric", "Precision", "Recall", "Accuracy",
+    "ChunkEvaluator", "EditDistance", "Auc", "DetectionMAP",
+]
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = str(name) if name is not None else self.__class__.__name__
+
+    def name(self):
+        return self._name
+
+    def reset(self):
+        """Reset every scalar/array state attr (ref :58 behavior)."""
+        for k, v in self.__dict__.items():
+            if k.startswith("_"):
+                continue
+            if isinstance(v, (int, float)):
+                setattr(self, k, 0)
+            elif isinstance(v, np.ndarray):
+                setattr(self, k, np.zeros_like(v))
+
+    def update(self, *a, **k):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class Accuracy(MetricBase):
+    """Weighted running mean of batch accuracies (ref :435)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        if not np.isscalar(weight) and np.asarray(weight).size != 1:
+            raise InvalidArgumentError("weight must be a scalar")
+        weight = float(np.asarray(weight).reshape(()))
+        if weight < 0:
+            raise InvalidArgumentError("weight must be non-negative")
+        self.value += float(np.asarray(value).reshape(())) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise InvalidArgumentError(
+                "call update() before eval() — no samples accumulated")
+        return self.value / self.weight
+
+
+class Precision(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def eval(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def eval(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+
+class ChunkEvaluator(MetricBase):
+    """Accumulates chunk_eval's count outputs (ref :513); eval →
+    (precision, recall, f1)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).reshape(()))
+        self.num_label_chunks += int(np.asarray(num_label_chunks).reshape(()))
+        self.num_correct_chunks += int(
+            np.asarray(num_correct_chunks).reshape(()))
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+
+class EditDistance(MetricBase):
+    """Accumulates edit_distance outputs (ref :611); eval →
+    (avg_distance, instance_error_rate)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        d = np.asarray(distances, np.float64).reshape(-1)
+        self.total_distance += float(d.sum())
+        self.seq_num += int(np.asarray(seq_num).reshape(()))
+        self.instance_error += int((d > 0).sum())
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise InvalidArgumentError(
+                "call update() before eval() — no sequences accumulated")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+
+class Auc(MetricBase):
+    """Bucketed ROC AUC (ref :699) — shares the 2.0 estimator."""
+
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        from paddle_tpu.metric import Auc as _Auc2
+
+        self._impl = _Auc2(curve=curve, num_thresholds=num_thresholds)
+
+    def update(self, preds, labels):
+        self._impl.update(np.asarray(preds), np.asarray(labels))
+
+    def reset(self):
+        self._impl.reset()
+
+    def eval(self):
+        return self._impl.accumulate()
+
+
+class CompositeMetric(MetricBase):
+    """Bundle of metrics updated with the same inputs (ref :199)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        if not isinstance(metric, MetricBase):
+            raise InvalidArgumentError("add_metric expects a MetricBase")
+        self._metrics.append(metric)
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class DetectionMAP:
+    """Ref :805 — builds Program ops (detection mAP pipeline); not
+    portable as a running metric object.  Compute AP from
+    detection_output results on host instead."""
+
+    def __init__(self, *a, **k):
+        raise UnimplementedError(
+            "fluid.metrics.DetectionMAP wires Program ops; evaluate mAP "
+            "on host from paddle.nn.functional.detection_output results")

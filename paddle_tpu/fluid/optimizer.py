@@ -1,0 +1,151 @@
+"""paddle.fluid.optimizer — 1.x optimizer names and conventions.
+
+Parity: python/paddle/fluid/optimizer.py (SGD:1185-area class list).
+The 1.x classes differ from 2.0 in name (``SGDOptimizer``) and argument
+spelling (``parameter_list``/``regularization``); each alias below
+adapts those and delegates — one optimizer implementation underneath
+(paddle_tpu/optimizer).  Program-rewriting wrappers (Pipeline/Recompute/
+GradientMerge/Lookahead...) map to the fleet DistributedStrategy or the
+2.0 weight-averaging optimizers.
+"""
+from __future__ import annotations
+
+from paddle_tpu import optimizer as _opt
+from ..framework.errors import UnimplementedError
+
+__all__ = [
+    "SGD", "SGDOptimizer", "Momentum", "MomentumOptimizer", "Adam",
+    "AdamOptimizer", "Adamax", "AdamaxOptimizer", "Adagrad",
+    "AdagradOptimizer", "Adadelta", "AdadeltaOptimizer", "RMSProp",
+    "RMSPropOptimizer", "Ftrl", "FtrlOptimizer", "Lamb", "LambOptimizer",
+    "LarsMomentum", "LarsMomentumOptimizer", "ExponentialMovingAverage",
+    "ModelAverage", "LookaheadOptimizer", "PipelineOptimizer",
+    "RecomputeOptimizer", "GradientMergeOptimizer", "DGCMomentumOptimizer",
+    "DpsgdOptimizer", "DecayedAdagradOptimizer",
+]
+
+
+def _one_x(cls, lr_default=0.001, **renames):
+    """Build a 1.x-convention subclass of a 2.0 optimizer: accepts
+    ``parameter_list`` and ``regularization`` spellings."""
+
+    class OneX(cls):
+        def __init__(self, learning_rate=lr_default, *args,
+                     parameter_list=None, regularization=None,
+                     grad_clip=None, name=None, **kwargs):
+            # positional extras (e.g. Momentum's momentum, Adam's betas)
+            # line up with the 2.0 signature and pass straight through
+            kwargs.setdefault("parameters", parameter_list)
+            kwargs.setdefault("weight_decay", regularization)
+            kwargs.setdefault("grad_clip", grad_clip)
+            super().__init__(learning_rate, *args, **kwargs)
+
+    OneX.__name__ = cls.__name__ + "Optimizer"
+    OneX.__qualname__ = OneX.__name__
+    OneX.__doc__ = (f"1.x spelling of paddle.optimizer.{cls.__name__} "
+                    f"(parameter_list/regularization arg names).")
+    return OneX
+
+
+SGDOptimizer = _one_x(_opt.SGD)
+MomentumOptimizer = _one_x(_opt.Momentum)
+AdamOptimizer = _one_x(_opt.Adam)
+AdamaxOptimizer = _one_x(_opt.Adamax)
+AdagradOptimizer = _one_x(_opt.Adagrad)
+AdadeltaOptimizer = _one_x(_opt.Adadelta)
+RMSPropOptimizer = _one_x(_opt.RMSProp)
+LambOptimizer = _one_x(_opt.Lamb)
+
+# the reference also exposes the short names from fluid.optimizer
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+Adagrad = AdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Lamb = LambOptimizer
+
+FtrlOptimizer = _one_x(_opt.Ftrl)
+Ftrl = FtrlOptimizer
+
+LarsMomentumOptimizer = _one_x(_opt.Lars)
+LarsMomentum = LarsMomentumOptimizer
+
+from paddle_tpu.optimizer import (  # noqa: E402
+    ExponentialMovingAverage as _EMA,
+    ModelAverage as _MA,
+    Lookahead as _Lookahead,
+)
+
+
+class ExponentialMovingAverage(_EMA):
+    """1.x EMA(decay, thres_steps) harvested parameters from the global
+    Program; there is no Program, so ``parameter_list`` is required
+    (pass ``layer.parameters()``)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None,
+                 parameter_list=None):
+        if parameter_list is None:
+            raise UnimplementedError(
+                "fluid.optimizer.ExponentialMovingAverage: pass "
+                "parameter_list=layer.parameters() — no global Program "
+                "exists to collect parameters from")
+        super().__init__(parameter_list, decay=decay,
+                         thres_steps=bool(thres_steps))
+
+
+class ModelAverage(_MA):
+    """1.x ModelAverage(average_window_rate, ...) — same Program note as
+    EMA above; ``parameter_list`` is required."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None,
+                 parameter_list=None):
+        if parameter_list is None:
+            raise UnimplementedError(
+                "fluid.optimizer.ModelAverage: pass "
+                "parameter_list=layer.parameters()")
+        super().__init__(parameter_list,
+                         average_window_rate=average_window_rate,
+                         min_average_window=min_average_window,
+                         max_average_window=max_average_window)
+
+
+class LookaheadOptimizer(_Lookahead):
+    """1.x spelling: LookaheadOptimizer(inner_optimizer, alpha, k)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        super().__init__(inner_optimizer, alpha=alpha, k=k)
+
+
+def _strategy_shim(name, field, instead):
+    class Shim:
+        def __init__(self, *a, **k):
+            raise UnimplementedError(
+                f"fluid.optimizer.{name} rewrote the Program; here the "
+                f"capability is a fleet strategy toggle: set "
+                f"DistributedStrategy().{field} (see {instead})")
+
+    Shim.__name__ = name
+    Shim.__qualname__ = name
+    return Shim
+
+
+PipelineOptimizer = _strategy_shim(
+    "PipelineOptimizer", "pipeline=True, pipeline_configs={...}",
+    "distributed/pipeline_parallel.py")
+RecomputeOptimizer = _strategy_shim(
+    "RecomputeOptimizer", "recompute=True, recompute_configs={...}",
+    "nn/recompute.py")
+GradientMergeOptimizer = _strategy_shim(
+    "GradientMergeOptimizer", "gradient_merge=True",
+    "optimizer/gradient_merge.py")
+DGCMomentumOptimizer = _strategy_shim(
+    "DGCMomentumOptimizer", "dgc=True, dgc_configs={...}",
+    "distributed/fleet/dgc.py")
+DpsgdOptimizer = _strategy_shim(
+    "DpsgdOptimizer", "(differential privacy not implemented)",
+    "paddle.optimizer")
+DecayedAdagradOptimizer = _strategy_shim(
+    "DecayedAdagradOptimizer", "(use Adagrad/RMSProp)", "paddle.optimizer")

@@ -1,0 +1,3 @@
+"""paddle.fluid.param_attr — ParamAttr + WeightNormParamAttr."""
+from paddle_tpu.nn import ParamAttr  # noqa: F401
+from paddle_tpu.static import WeightNormParamAttr  # noqa: F401

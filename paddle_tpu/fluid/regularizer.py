@@ -1,0 +1,5 @@
+"""paddle.fluid.regularizer — 1.x names over paddle_tpu.regularizer."""
+from paddle_tpu.regularizer import L1Decay, L2Decay  # noqa: F401
+
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay

@@ -1,0 +1,4 @@
+"""paddle.fluid.unique_name — alias of paddle.utils.unique_name."""
+from paddle_tpu.utils.unique_name import (  # noqa: F401
+    generate, guard, switch, UniqueNameGenerator,
+)

@@ -338,18 +338,27 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
     scores = jnp.asarray(scores)
     N, M = bboxes.shape[0], bboxes.shape[1]
     C = scores.shape[1]
-    K = C * M if keep_top_k is None or keep_top_k < 0 else min(
-        int(keep_top_k), C * M)
+    # drop the background class BEFORE the per-class NMS vmap — its
+    # result would be discarded, and the sequential NMS loop is the
+    # expensive part of this op
+    if 0 <= background_label < C:
+        fg_rows = [c for c in range(C) if c != background_label]
+        fg_labels = jnp.asarray(fg_rows, jnp.int32)
+        scores = scores[:, fg_labels, :]
+        Cf = C - 1
+    else:
+        fg_labels = jnp.arange(C, dtype=jnp.int32)
+        Cf = C
+    K = Cf * M if keep_top_k is None or keep_top_k < 0 else min(
+        int(keep_top_k), Cf * M)
 
-    def image(boxes, sc):  # boxes [M,4], sc [C,M]
+    def image(boxes, sc):  # boxes [M,4], sc [Cf,M]
         keep = jax.vmap(lambda s1: nms(
             boxes, s1, score_threshold, nms_top_k, nms_threshold,
-            nms_eta, normalized))(sc)  # [C, M]
-        if 0 <= background_label < C:
-            keep = keep.at[background_label].set(False)
+            nms_eta, normalized))(sc)  # [Cf, M]
         flat = jnp.where(keep.reshape(-1), sc.reshape(-1), -jnp.inf)
         top_s, top_i = jax.lax.top_k(flat, K)  # keep-top-k across classes
-        label = (top_i // M).astype(bboxes.dtype)
+        label = fg_labels[top_i // M].astype(bboxes.dtype)
         box = boxes[top_i % M]
         valid = jnp.isfinite(top_s)
         row = jnp.concatenate(
@@ -373,8 +382,11 @@ def detection_output(loc, scores, prior_box, prior_box_var,
     reference's index LoD)."""
     decoded = box_coder(prior_box, prior_box_var, jnp.asarray(loc),
                         code_type="decode_center_size")  # [N, M, 4]
+    # scores are logits; the reference softmaxes before NMS
+    # (detection.py:720) so score_threshold filters probabilities
+    probs = jax.nn.softmax(jnp.asarray(scores), axis=-1)
     out, nums = multiclass_nms(
-        decoded, jnp.swapaxes(jnp.asarray(scores), 1, 2), score_threshold,
+        decoded, jnp.swapaxes(probs, 1, 2), score_threshold,
         nms_top_k, keep_top_k, nms_threshold, True, nms_eta,
         background_label, return_num=True)
     return (out, nums) if return_index else out

@@ -301,7 +301,15 @@ class Layer:
     def named_parameters(self, prefix: str = "", include_sublayers: bool = True) -> Iterator[Tuple[str, Parameter]]:
         for name, p in self._parameters.items():
             if p is not None:
-                yield (f"{prefix}.{name}" if prefix else name), p
+                dotted = f"{prefix}.{name}" if prefix else name
+                if not p.name:
+                    # stamp the dotted path as the box's stable identity:
+                    # eager optimizer.step() matches jax.grad's name-keyed
+                    # grad dicts against box names (a positional zip is
+                    # unsound — jax returns dict pytrees in sorted-key
+                    # order, not traversal order)
+                    p.name = dotted
+                yield dotted, p
         if include_sublayers:
             for sname, sub in self._sub_layers.items():
                 if sub is None:

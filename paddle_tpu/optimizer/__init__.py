@@ -13,6 +13,7 @@ from .optimizer import (  # noqa: F401
     Adamax,
     RMSProp,
     Adadelta,
+    Ftrl,
     Lamb,
     Lars,
 )

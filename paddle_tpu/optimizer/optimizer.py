@@ -47,6 +47,7 @@ __all__ = [
     "Adam",
     "AdamW",
     "Adamax",
+    "Ftrl",
     "RMSProp",
     "Adadelta",
     "Lamb",
@@ -208,9 +209,17 @@ class Optimizer:
                 "optimizer was constructed without `parameters`; "
                 "pass parameters= for eager step() use"
             )
-        return OrderedDict(
-            (box.name or f"param_{i}", box) for i, box in enumerate(self._param_boxes)
-        )
+        out: "OrderedDict[str, Parameter]" = OrderedDict()
+        for i, box in enumerate(self._param_boxes):
+            name = box.name or f"param_{i}"
+            # two Layers' boxes can carry the same stamped name (e.g. two
+            # root-level Linears both traversed as 'weight') — suffix the
+            # later ones so no parameter silently shadows another in the
+            # update map or the state_dict slot keys
+            if name in out:
+                name = f"{name}_{i}"
+            out[name] = box
+        return out
 
     def step(self, grads=None):
         """Apply gradients to the bound Parameter boxes.
@@ -381,6 +390,50 @@ class Adagrad(Optimizer):
         m = slots["moment"] + jnp.square(g)
         slots["moment"] = m
         return w - lr * g / (jnp.sqrt(m) + self._epsilon), slots
+
+
+class Ftrl(Optimizer):
+    """FTRL-proximal (ref: operators/optimizers/ftrl_op.h:74-100):
+    squared-gradient accumulator + linear accumulator with L1 soft
+    threshold; ``lr_power=-0.5`` is the McMahan et al. schedule.  The
+    CTR-workhorse optimizer of the reference's PS mode — dense here
+    (sparse rows become dense grads under XLA)."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._l1 = l1
+        self._l2 = l2
+        self._lr_power = lr_power
+
+    def _init_slots(self, p, name):
+        slots = super()._init_slots(p, name)
+        acc = slots.get("master", p)
+        dt = jnp.float32 if _is_low_precision(acc) else acc.dtype
+        slots["squared"] = jnp.zeros_like(acc, dtype=dt)
+        slots["linear"] = jnp.zeros_like(acc, dtype=dt)
+        return slots
+
+    def _update(self, w, g, slots, lr, count):
+        g = g.astype(slots["squared"].dtype)
+        wf = w.astype(g.dtype)
+        sq, lin = slots["squared"], slots["linear"]
+        new_sq = sq + jnp.square(g)
+        if self._lr_power == -0.5:
+            sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+            y = jnp.sqrt(new_sq) / lr + 2.0 * self._l2
+        else:
+            sigma = (jnp.power(new_sq, -self._lr_power)
+                     - jnp.power(sq, -self._lr_power)) / lr
+            y = jnp.power(new_sq, -self._lr_power) / lr + 2.0 * self._l2
+        lin = lin + g - sigma * wf
+        x = jnp.sign(lin) * self._l1 - lin
+        new_w = jnp.where(jnp.abs(lin) > self._l1, x / y,
+                          jnp.zeros_like(wf))
+        slots["squared"], slots["linear"] = new_sq, lin
+        return new_w.astype(w.dtype), slots
 
 
 class Adam(Optimizer):

@@ -329,6 +329,27 @@ class TestDetectionOutput:
         for row in o[:n]:  # zero offsets decode back to the prior boxes
             assert any(np.allclose(row[2:], p, atol=1e-4) for p in priors)
 
+    def test_scores_are_softmaxed(self):
+        """The reference softmaxes logits before NMS (detection.py:720):
+        output scores must be probabilities, and a large negative logit
+        with the rest even MORE negative must still pass the 0.01
+        threshold (its probability is ~1)."""
+        M, C = 4, 3
+        mins = np.array([[0.0, 0.0], [0.3, 0.3], [0.6, 0.6], [0.1, 0.7]],
+                        np.float32)
+        priors = np.concatenate([mins, mins + 0.2], -1)
+        pvar = np.tile(np.array([[0.1, 0.1, 0.2, 0.2]], np.float32), (M, 1))
+        loc = np.zeros((1, M, 4), np.float32)
+        logits = np.full((1, M, C), -30.0, np.float32)
+        logits[0, :, 1] = -10.0  # class 1 dominates despite raw value < 0
+        out, nums = F.detection_output(loc, logits, priors, pvar,
+                                       keep_top_k=4, return_index=True)
+        n = int(np.asarray(nums)[0])
+        assert n > 0, "softmaxed scores must clear the 0.01 threshold"
+        rows = np.asarray(out)[0, :n]
+        assert (rows[:, 1] > 0.9).all(), "scores must be probabilities"
+        assert (rows[:, 0] == 1).all() and (rows[:, 0] != 0).all()
+
 
 class TestBoxClip:
     def test_clips_to_image(self):

@@ -1,0 +1,370 @@
+"""paddle.fluid 1.x compatibility namespace (layers wrappers, dygraph
+classes, optimizer spellings, metrics accumulators)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+from paddle_tpu.framework.errors import UnimplementedError
+
+
+class TestLayersWrappers:
+    def test_reduce_family(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_allclose(
+            np.asarray(fluid.layers.reduce_sum(x, dim=1)), [3.0, 12.0])
+        out = fluid.layers.reduce_mean(x, dim=0, keep_dim=True)
+        assert out.shape == (1, 3)
+        assert float(fluid.layers.reduce_max(x)) == 5.0
+
+    def test_elementwise_axis_broadcast(self):
+        """1.x axis semantics: y aligns to x starting at `axis`."""
+        x = np.ones((2, 3, 4), np.float32)
+        y = np.arange(3, dtype=np.float32)
+        out = np.asarray(fluid.layers.elementwise_add(x, y, axis=1))
+        np.testing.assert_allclose(out[0, :, 0], [1.0, 2.0, 3.0])
+        out = fluid.layers.elementwise_mul(x, y, axis=1, act="relu")
+        assert out.shape == (2, 3, 4)
+
+    def test_matmul_and_mul(self):
+        a = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        b = np.random.RandomState(1).randn(3, 5).astype(np.float32)
+        out = np.asarray(fluid.layers.matmul(a, b, transpose_x=True,
+                                             alpha=2.0))
+        np.testing.assert_allclose(out, 2.0 * a.T @ b, atol=1e-5)
+        c = np.random.RandomState(2).randn(2, 3, 4).astype(np.float32)
+        d = np.random.RandomState(3).randn(12, 5).astype(np.float32)
+        out = np.asarray(fluid.layers.mul(c, d, x_num_col_dims=1))
+        np.testing.assert_allclose(out, c.reshape(2, 12) @ d, atol=1e-4)
+
+    def test_misc_wrappers(self):
+        np.testing.assert_allclose(
+            np.asarray(fluid.layers.fill_constant([2, 2], "float32", 3.0)),
+            np.full((2, 2), 3.0))
+        one = fluid.layers.one_hot(np.array([[1], [0]], np.int64), 3)
+        np.testing.assert_allclose(np.asarray(one),
+                                   [[0, 1, 0], [1, 0, 0]], atol=1e-6)
+        out = fluid.layers.scale(np.ones(2, np.float32), scale=3.0,
+                                 bias=1.0, bias_after_scale=False)
+        np.testing.assert_allclose(np.asarray(out), [6.0, 6.0])
+        sm = fluid.layers.softmax(np.zeros((2, 4), np.float32))
+        np.testing.assert_allclose(np.asarray(sm), 0.25, atol=1e-6)
+        r = fluid.layers.range(0, 6, 2, "int32")
+        np.testing.assert_array_equal(np.asarray(r), [0, 2, 4])
+        assert not bool(fluid.layers.has_nan(np.zeros(2)))
+
+    def test_smooth_l1_matches_rowsum(self):
+        x = np.array([[0.0, 2.0]], np.float32)
+        y = np.array([[0.5, 0.0]], np.float32)
+        out = np.asarray(fluid.layers.smooth_l1(x, y))
+        want = 0.5 * 0.5 ** 2 + (2.0 - 0.5)
+        np.testing.assert_allclose(out, [[want]], atol=1e-6)
+
+    def test_sigmoid_ce_ignore_index(self):
+        x = np.zeros((1, 3), np.float32)
+        lab = np.array([[1, 0, -100]], np.float32)
+        out = np.asarray(fluid.layers.sigmoid_cross_entropy_with_logits(
+            x, lab, ignore_index=-100))
+        assert out[0, 2] == 0.0 and out[0, 0] > 0
+
+    def test_ctc_greedy_decoder(self):
+        # argmax path: [1,1,blank,2,2,blank] → merged [1,2]
+        T, C = 6, 4
+        probs = np.full((1, T, C), -5.0, np.float32)
+        path = [1, 1, 3, 2, 2, 3]  # blank=3
+        for t, c in enumerate(path):
+            probs[0, t, c] = 5.0
+        out, lens = fluid.layers.ctc_greedy_decoder(probs, blank=3)
+        assert int(lens[0, 0]) == 2
+        np.testing.assert_array_equal(np.asarray(out)[0, :2], [1, 2])
+
+    def test_edit_distance(self):
+        a = np.array([[1, 2, 3, 0]], np.int64)
+        b = np.array([[1, 3, 3, 0]], np.int64)
+        d, n = fluid.layers.edit_distance(a, b, normalized=False,
+                                          input_length=[3],
+                                          label_length=[3])
+        assert float(np.asarray(d)[0, 0]) == 1.0
+        assert int(np.asarray(n)[0]) == 1
+
+    def test_static_only_shims_raise_with_hint(self):
+        with pytest.raises(UnimplementedError) as ei:
+            fluid.layers.fc(None, size=10)
+        assert "paddle.nn.Linear" in str(ei.value)
+        with pytest.raises(UnimplementedError):
+            fluid.layers.sequence_pool(None, "max")
+        with pytest.raises(AttributeError):
+            fluid.layers.not_a_real_op
+
+    def test_detection_reexports(self):
+        assert fluid.layers.iou_similarity is not None
+        assert callable(fluid.layers.multiclass_nms)
+
+
+class TestDygraph1x:
+    def test_linear_act(self):
+        paddle.seed(0)
+        with fluid.dygraph.guard():
+            lin = fluid.dygraph.Linear(4, 3, act="relu")
+            out = lin(jnp.asarray(np.random.RandomState(0).randn(2, 4),
+                                  jnp.float32))
+            assert out.shape == (2, 3)
+            assert (np.asarray(out) >= 0).all()
+
+    def test_conv_bn_pipeline(self):
+        paddle.seed(1)
+        conv = fluid.dygraph.Conv2D(3, 8, 3, padding=1, act="relu")
+        bn = fluid.dygraph.BatchNorm(8)
+        x = jnp.asarray(np.random.RandomState(1).randn(2, 3, 8, 8),
+                        jnp.float32)
+        out = bn(conv(x))
+        assert out.shape == (2, 8, 8, 8)
+
+    def test_embedding_1x_size(self):
+        paddle.seed(2)
+        emb = fluid.dygraph.Embedding(size=[10, 4])
+        out = emb(jnp.asarray([[1, 2]], jnp.int64))
+        assert out.shape == (1, 2, 4)
+        with pytest.raises(UnimplementedError):
+            fluid.dygraph.Embedding(size=[10, 4], is_distributed=True)
+
+    def test_prelu_modes(self):
+        paddle.seed(3)
+        x = jnp.asarray([[-1.0, 2.0]], jnp.float32)
+        out = fluid.dygraph.PRelu("all")(x)
+        np.testing.assert_allclose(np.asarray(out), [[-0.25, 2.0]],
+                                   atol=1e-6)
+        p = fluid.dygraph.PRelu("channel", channel=4)
+        assert p.weight.shape == (4,)
+
+    def test_gru_unit_step(self):
+        paddle.seed(4)
+        H = 5
+        cell = fluid.dygraph.GRUUnit(3 * H)
+        x = jnp.asarray(np.random.RandomState(2).randn(2, 3 * H), jnp.float32)
+        h = jnp.zeros((2, H), jnp.float32)
+        new_h, rhp, gate = cell(x, h)
+        assert new_h.shape == (2, H)
+        assert gate.shape == (2, 3 * H)
+        assert np.isfinite(np.asarray(new_h)).all()
+
+    def test_nce_loss(self):
+        paddle.seed(5)
+        nce = fluid.dygraph.NCE(num_total_classes=20, dim=6,
+                                num_neg_samples=4)
+        x = jnp.asarray(np.random.RandomState(3).randn(3, 6), jnp.float32)
+        lab = jnp.asarray([[1], [2], [3]], jnp.int64)
+        loss = nce(x, lab)
+        assert loss.shape == (3, 1)
+        assert (np.asarray(loss) > 0).all()
+
+    def test_save_dygraph_classifies_opt_state(self, tmp_path):
+        import os
+        from paddle_tpu import nn
+
+        paddle.seed(20)
+        net = nn.Linear(2, 1)
+        opt = fluid.optimizer.AdamOptimizer(
+            0.001, parameter_list=net.parameters())
+        opt.step({n: jnp.ones_like(v) for n, v in
+                  net.param_pytree(trainable_only=True).items()})
+        prefix = str(tmp_path / "adam")
+        fluid.dygraph.save_dygraph(opt.state_dict(), prefix)
+        assert os.path.exists(prefix + ".pdopt"), \
+            "optimizer state must go to .pdopt, not .pdparams"
+
+    def test_save_load_dygraph(self, tmp_path):
+        paddle.seed(6)
+        lin = fluid.dygraph.Linear(3, 2)
+        prefix = str(tmp_path / "ckpt")
+        fluid.dygraph.save_dygraph(lin.state_dict(), prefix)
+        params, opt = fluid.dygraph.load_dygraph(prefix)
+        assert opt is None
+        lin2 = fluid.dygraph.Linear(3, 2)
+        lin2.set_state_dict(params)
+        x = jnp.ones((1, 3), jnp.float32)
+        np.testing.assert_allclose(np.asarray(lin(x)), np.asarray(lin2(x)),
+                                   atol=1e-6)
+
+
+class TestFluidOptimizer:
+    def test_1x_spellings_construct_and_step(self):
+        paddle.seed(7)
+        from paddle_tpu import nn
+
+        net = nn.Linear(4, 1)
+        opt = fluid.optimizer.SGDOptimizer(
+            learning_rate=0.1, parameter_list=net.parameters())
+        before = np.asarray(net.weight.value).copy()
+        grads = {n: jnp.ones_like(v)
+                 for n, v in net.param_pytree(trainable_only=True).items()}
+        opt.step(grads)
+        after = np.asarray(net.weight.value)
+        np.testing.assert_allclose(after, before - 0.1, atol=1e-6)
+
+    def test_momentum_positional(self):
+        from paddle_tpu import nn
+
+        net = nn.Linear(2, 1)
+        opt = fluid.optimizer.MomentumOptimizer(0.1, 0.9,
+                                                parameter_list=net.parameters())
+        assert opt._momentum == 0.9
+
+    def test_two_layers_no_name_collision(self):
+        """Two root-level Linears stamp the same dotted names; the
+        optimizer must still update all four parameters."""
+        from paddle_tpu import nn
+
+        paddle.seed(21)
+        l1, l2 = nn.Linear(3, 3), nn.Linear(3, 3)
+        opt = fluid.optimizer.SGDOptimizer(
+            0.5, parameter_list=l1.parameters() + l2.parameters())
+        before = [np.asarray(p.value).copy()
+                  for p in l1.parameters() + l2.parameters()]
+        opt.step([jnp.ones_like(p.value)
+                  for p in l1.parameters() + l2.parameters()])
+        after = [np.asarray(p.value)
+                 for p in l1.parameters() + l2.parameters()]
+        for b, a in zip(before, after):
+            np.testing.assert_allclose(a, b - 0.5, atol=1e-6)
+
+    def test_program_rewriters_raise(self):
+        for name in ["PipelineOptimizer", "RecomputeOptimizer",
+                     "GradientMergeOptimizer", "DGCMomentumOptimizer"]:
+            with pytest.raises(UnimplementedError):
+                getattr(fluid.optimizer, name)(None)
+
+
+class TestFtrl:
+    def _oracle(self, w, g, sq, lin, lr, l1, l2):
+        """ftrl_op.h:74-100 with lr_power=-0.5."""
+        new_sq = sq + g * g
+        lin = lin + g - (np.sqrt(new_sq) - np.sqrt(sq)) / lr * w
+        x = np.sign(lin) * l1 - lin
+        y = np.sqrt(new_sq) / lr + 2 * l2
+        w = np.where(np.abs(lin) > l1, x / y, 0.0)
+        return w, new_sq, lin
+
+    def test_matches_kernel_oracle(self):
+        from paddle_tpu import optimizer as popt
+        from paddle_tpu import nn
+
+        paddle.seed(8)
+        net = nn.Linear(3, 1, bias_attr=False)
+        opt = popt.Ftrl(learning_rate=0.1, l1=0.01, l2=0.1,
+                        parameters=net.parameters())
+        rng = np.random.RandomState(4)
+        w = np.asarray(net.weight.value).astype(np.float64)
+        sq = np.zeros_like(w)
+        lin = np.zeros_like(w)
+        for i in range(3):
+            g = rng.randn(*w.shape).astype(np.float32)
+            opt.step({"weight": jnp.asarray(g)})
+            w, sq, lin = self._oracle(w, g.astype(np.float64), sq, lin,
+                                      0.1, 0.01, 0.1)
+        np.testing.assert_allclose(np.asarray(net.weight.value), w,
+                                   atol=1e-5)
+
+    def test_trains(self):
+        from paddle_tpu import optimizer as popt
+        from paddle_tpu import nn
+        import jax
+
+        paddle.seed(9)
+        net = nn.Linear(4, 1)
+        opt = popt.Ftrl(learning_rate=0.5, parameters=net.parameters())
+        rng = np.random.RandomState(5)
+        x = jnp.asarray(rng.randn(64, 4), jnp.float32)
+        true_w = jnp.asarray(rng.randn(4, 1), jnp.float32)
+        y = x @ true_w
+
+        from paddle_tpu.nn import functional_call
+
+        def loss_fn(p):
+            return jnp.mean((functional_call(net, p, x) - y) ** 2)
+
+        first = None
+        for _ in range(30):
+            p = net.param_pytree(trainable_only=True)
+            val, g = jax.value_and_grad(loss_fn)(p)
+            first = first if first is not None else float(val)
+            opt.step(g)
+        assert float(val) < first * 0.5, (first, float(val))
+
+
+class TestFluidMetrics:
+    def test_accuracy_weighted_mean(self):
+        m = fluid.metrics.Accuracy()
+        m.update(0.8, 10)
+        m.update(0.6, 30)
+        np.testing.assert_allclose(m.eval(), (8 + 18) / 40)
+        with pytest.raises(Exception):
+            fluid.metrics.Accuracy().eval()
+
+    def test_chunk_evaluator_roundtrip(self):
+        from paddle_tpu import metric as M
+
+        label = [[2, 3, 6, 6, 0, 1, 1, 1, 6, 4]]
+        pred = [[2, 3, 6, 6, 0, 1, 6, 1, 6, 4]]
+        _, _, _, ni, nl, nc = M.chunk_eval(pred, label, "IOB", 3)
+        ev = fluid.metrics.ChunkEvaluator()
+        ev.update(ni, nl, nc)
+        p, r, f1 = ev.eval()
+        np.testing.assert_allclose(p, 0.5)
+        np.testing.assert_allclose(r, 2 / 3, rtol=1e-6)
+
+    def test_edit_distance_metric(self):
+        m = fluid.metrics.EditDistance()
+        m.update([1.0, 0.0], 2)
+        avg, err = m.eval()
+        assert avg == 0.5 and err == 0.5
+
+    def test_composite(self):
+        c = fluid.metrics.CompositeMetric()
+        c.add_metric(fluid.metrics.Precision())
+        c.add_metric(fluid.metrics.Recall())
+        c.update(np.array([1.0, 0.0, 1.0]), np.array([1, 0, 0]))
+        p, r = c.eval()
+        assert p == 0.5 and r == 1.0
+
+
+class TestFluidRoot:
+    def test_places_and_param_attr(self):
+        fluid.CPUPlace()
+        fluid.ParamAttr(name="w")
+        assert fluid.in_dygraph_mode()
+
+    def test_program_machinery_shims(self):
+        with pytest.raises(UnimplementedError):
+            fluid.Executor(fluid.CPUPlace())
+        with pytest.raises(UnimplementedError):
+            fluid.default_main_program()
+        with pytest.raises(UnimplementedError):
+            fluid.create_lod_tensor([[1]], [[1]])
+
+    def test_initializer_and_clip_aliases(self):
+        assert fluid.initializer.ConstantInitializer is \
+            fluid.initializer.Constant
+        x = fluid.initializer.Xavier(uniform=True)
+        assert type(x).__name__ == "XavierUniform"
+        m = fluid.initializer.MSRA()  # ref default: uniform=True (:639)
+        assert type(m).__name__ == "KaimingUniform"
+        assert type(fluid.initializer.MSRA(uniform=False)).__name__ == \
+            "KaimingNormal"
+        assert fluid.clip.GradientClipByNorm is fluid.clip.ClipGradByNorm
+        with pytest.raises(UnimplementedError):
+            fluid.clip.set_gradient_clip(None)
+
+    def test_core_shim(self):
+        assert isinstance(fluid.core.globals(), dict)
+        with pytest.raises(UnimplementedError):
+            fluid.core.ops.conv2d
+        assert fluid.core.get_cuda_device_count() == 0
+
+    def test_io_reader_decorators(self):
+        r = fluid.io.buffered(lambda: iter([1, 2, 3]), 2)
+        assert list(r()) == [1, 2, 3]
+        with pytest.raises(UnimplementedError):
+            fluid.io.save_persistables(None, "/tmp/x")
