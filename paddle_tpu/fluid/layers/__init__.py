@@ -54,6 +54,7 @@ from paddle_tpu.nn.functional import (  # noqa: F401
     bipartite_match, target_assign, detection_output, box_coder,
     box_clip, multiclass_nms, sequence_mask, linear_chain_crf,
     crf_decoding, pixel_shuffle, unfold, temporal_shift,
+    roi_align, roi_pool, sigmoid_focal_loss, yolo_box,
 )
 from paddle_tpu.nn import (  # noqa: F401
     BeamSearchDecoder, Decoder, dynamic_decode, RNNCellBase as RNNCell,
@@ -589,8 +590,6 @@ _STATIC_ONLY = {
     "lrn": "paddle.nn.LocalResponseNorm",
     "prroi_pool": "roi pooling family (not implemented)",
     "psroi_pool": "roi pooling family (not implemented)",
-    "roi_pool": "roi pooling family (not implemented)",
-    "roi_align": "roi pooling family (not implemented)",
     "deformable_roi_pooling": "roi pooling family (not implemented)",
     # program control flow → lax / python
     "While": "jax.lax.while_loop (compiled) or Python while (eager)",
@@ -674,7 +673,6 @@ _STATIC_ONLY = {
     "multi_box_head": "compose conv heads + prior_box",
     "rpn_target_assign": "two-stage detectors not implemented",
     "retinanet_target_assign": "two-stage detectors not implemented",
-    "sigmoid_focal_loss": "focal loss: BCE-with-logits with modulation",
     "anchor_generator": "prior_box",
     "roi_perspective_transform": "not implemented",
     "generate_proposal_labels": "two-stage detectors not implemented",
@@ -682,7 +680,6 @@ _STATIC_ONLY = {
     "generate_mask_labels": "two-stage detectors not implemented",
     "polygon_box_transform": "not implemented",
     "yolov3_loss": "YOLO family not implemented",
-    "yolo_box": "YOLO family not implemented",
     "locality_aware_nms": "multiclass_nms covers the standard path",
     "matrix_nms": "multiclass_nms covers the standard path",
     "retinanet_detection_output": "detection_output",

@@ -26,7 +26,8 @@ from ...framework.errors import InvalidArgumentError
 __all__ = [
     "iou_similarity", "box_coder", "bipartite_match", "target_assign",
     "mine_hard_examples", "ssd_loss", "prior_box", "nms",
-    "multiclass_nms", "detection_output", "box_clip",
+    "multiclass_nms", "detection_output", "box_clip", "roi_align",
+    "roi_pool", "sigmoid_focal_loss", "yolo_box",
 ]
 
 _EPS = 1e-6
@@ -467,3 +468,191 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.,),
         boxes = jnp.clip(boxes, 0.0, 1.0)
     var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
     return boxes, var
+
+
+def _roi_batch_ids(rois_num, R, N):
+    """rois_num ``[N]`` → per-roi image index ``[R]`` — the dense stand-in
+    for the reference's ROI LoD (roi_align_op.h:180-187), computed with a
+    static-shape comparison sweep so it jits."""
+    if rois_num is None:
+        return jnp.zeros((R,), jnp.int32)
+    counts = jnp.asarray(rois_num, jnp.int32)
+    bounds = jnp.cumsum(counts)  # [N]
+    return jnp.sum(jnp.arange(R)[:, None] >= bounds[None, :],
+                   axis=1).astype(jnp.int32)
+
+
+def _bilinear_at(feat, y, x):
+    """feat [C, H, W]; y/x same-shape sample grids → [C, *y.shape].
+    Transcribes PreCalcForBilinearInterpolate (roi_align_op.h:28-100):
+    points outside [-1, H]×[-1, W] contribute 0, in-range points clamp
+    low corners into the map."""
+    H, W = feat.shape[1], feat.shape[2]
+    outside = (y < -1.0) | (y > H) | (x < -1.0) | (x > W)
+    y = jnp.clip(y, 0.0, None)
+    x = jnp.clip(x, 0.0, None)
+    y_low = jnp.clip(jnp.floor(y).astype(jnp.int32), 0, H - 1)
+    x_low = jnp.clip(jnp.floor(x).astype(jnp.int32), 0, W - 1)
+    y = jnp.where(y_low >= H - 1, jnp.asarray(H - 1, y.dtype), y)
+    x = jnp.where(x_low >= W - 1, jnp.asarray(W - 1, x.dtype), x)
+    y_high = jnp.clip(y_low + 1, 0, H - 1)
+    x_high = jnp.clip(x_low + 1, 0, W - 1)
+    ly = (y - y_low).astype(feat.dtype)
+    lx = (x - x_low).astype(feat.dtype)
+    hy, hx = 1.0 - ly, 1.0 - lx
+    v = (feat[:, y_low, x_low] * hy * hx + feat[:, y_low, x_high] * hy * lx
+         + feat[:, y_high, x_low] * ly * hx
+         + feat[:, y_high, x_high] * ly * lx)
+    return jnp.where(outside, jnp.zeros((), feat.dtype), v)
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None,
+              name=None):
+    """RoI Align (ref: fluid/layers/nn.py:6985 over roi_align_op.h:140):
+    average of bilinear samples on a regular grid inside each output bin.
+
+    input ``[N, C, H, W]``, rois ``[R, 4]`` (x1 y1 x2 y2), ``rois_num``
+    ``[N]`` mapping rois to images (dense replacement for the ROI LoD;
+    omitted → all rois belong to image 0) → ``[R, C, PH, PW]``.
+
+    XLA static-shape note: the reference picks the sample-grid size per
+    ROI (``ceil(roi/bin)``) when ``sampling_ratio=-1``; a data-dependent
+    grid cannot compile, so -1 maps to the customary fixed grid of 2
+    (exact parity when ``sampling_ratio`` is set explicitly).
+    """
+    x = jnp.asarray(input)
+    rois = jnp.asarray(rois, x.dtype)
+    R = rois.shape[0]
+    grid = int(sampling_ratio) if sampling_ratio > 0 else 2
+    batch_ids = _roi_batch_ids(rois_num, R, x.shape[0])
+
+    ph_ix = jnp.arange(pooled_height, dtype=x.dtype)
+    pw_ix = jnp.arange(pooled_width, dtype=x.dtype)
+    g_ix = (jnp.arange(grid, dtype=x.dtype) + 0.5) / grid
+
+    def one(roi, bid):
+        xmin, ymin, xmax, ymax = roi * spatial_scale
+        rw = jnp.maximum(xmax - xmin, 1.0)
+        rh = jnp.maximum(ymax - ymin, 1.0)
+        bin_w = rw / pooled_width
+        bin_h = rh / pooled_height
+        # sample grids: [PH, gh] and [PW, gw]
+        ys = ymin + (ph_ix[:, None] + g_ix[None, :]) * bin_h
+        xs = xmin + (pw_ix[:, None] + g_ix[None, :]) * bin_w
+        yg = jnp.broadcast_to(ys[:, None, :, None],
+                              (pooled_height, pooled_width, grid, grid))
+        xg = jnp.broadcast_to(xs[None, :, None, :],
+                              (pooled_height, pooled_width, grid, grid))
+        vals = _bilinear_at(x[bid], yg, xg)  # [C, PH, PW, g, g]
+        return vals.mean(axis=(-2, -1))  # [C, PH, PW]
+
+    return jax.vmap(one)(rois, batch_ids)
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, name=None):
+    """RoI max pooling (ref: fluid/layers/nn.py roi_pool over
+    roi_pool_op.h:99-160): integer bin partition of the rounded ROI,
+    max per bin, empty bins → 0.  Same dense ``rois_num`` contract as
+    roi_align.  → ``[R, C, PH, PW]``."""
+    x = jnp.asarray(input)
+    rois = jnp.asarray(rois, x.dtype)
+    R = rois.shape[0]
+    H, W = x.shape[2], x.shape[3]
+    batch_ids = _roi_batch_ids(rois_num, R, x.shape[0])
+    ph = jnp.arange(pooled_height, dtype=x.dtype)
+    pw = jnp.arange(pooled_width, dtype=x.dtype)
+    neg_inf = jnp.asarray(-jnp.inf, x.dtype)
+
+    def one(roi, bid):
+        x0, y0, x1, y1 = jnp.round(roi * spatial_scale)
+        rh = jnp.maximum(y1 - y0 + 1, 1.0)
+        rw = jnp.maximum(x1 - x0 + 1, 1.0)
+        bin_h = rh / pooled_height
+        bin_w = rw / pooled_width
+        hstart = jnp.clip(jnp.floor(ph * bin_h) + y0, 0, H)
+        hend = jnp.clip(jnp.ceil((ph + 1) * bin_h) + y0, 0, H)
+        wstart = jnp.clip(jnp.floor(pw * bin_w) + x0, 0, W)
+        wend = jnp.clip(jnp.ceil((pw + 1) * bin_w) + x0, 0, W)
+        hgrid = jnp.arange(H, dtype=x.dtype)
+        wgrid = jnp.arange(W, dtype=x.dtype)
+        mask_h = (hgrid >= hstart[:, None]) & (hgrid < hend[:, None])
+        mask_w = (wgrid >= wstart[:, None]) & (wgrid < wend[:, None])
+        feat = x[bid]  # [C, H, W]
+        tmp = jnp.max(jnp.where(mask_h[:, None, :, None], feat[None], neg_inf),
+                      axis=2)  # [PH, C, W]
+        out = jnp.max(jnp.where(mask_w[None, None, :, :], tmp[:, :, None, :],
+                                neg_inf), axis=3)  # [PH, C, PW]
+        out = jnp.where(jnp.isfinite(out), out, 0.0)  # empty bin → 0
+        return jnp.transpose(out, (1, 0, 2))  # [C, PH, PW]
+
+    return jax.vmap(one)(rois, batch_ids)
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    """Focal loss for dense detection (ref: fluid/layers/detection.py
+    sigmoid_focal_loss over sigmoid_focal_loss_op.h:43-72): x ``[N, C]``
+    logits, label ``[N, 1]`` with classes 1..C, 0 = background
+    (negative for every class), -1 = ignored; scaled by 1/max(fg_num,1).
+    """
+    x = jnp.asarray(x)
+    label = jnp.asarray(label).reshape(-1, 1)
+    C = x.shape[1]
+    fg = jnp.maximum(jnp.asarray(fg_num, x.dtype).reshape(()), 1.0)
+    d = jnp.arange(C)[None, :]
+    c_pos = (label == d + 1).astype(x.dtype)
+    c_neg = ((label != -1) & (label != d + 1)).astype(x.dtype)
+    p = jax.nn.sigmoid(x)
+    # log(p) and log(1-p) in the kernel's stable forms
+    term_pos = jnp.power(1.0 - p, gamma) * jnp.log(jnp.maximum(p, 1e-37))
+    log1mp = -x * (x >= 0) - jnp.log1p(jnp.exp(x - 2.0 * x * (x >= 0)))
+    term_neg = jnp.power(p, gamma) * log1mp
+    return -(c_pos * term_pos * (alpha / fg)
+             + c_neg * term_neg * ((1.0 - alpha) / fg))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0):
+    """Decode a YOLOv3 detection head (ref: fluid/layers/detection.py:1131
+    over yolo_box_op.h:30-155).  x ``[N, A*(5+cls), H, W]``, img_size
+    ``[N, 2]`` (height, width) → (boxes ``[N, A*H*W, 4]`` corner format,
+    scores ``[N, A*H*W, cls]``); predictions below ``conf_thresh`` are
+    zeroed, matching the kernel's skip."""
+    x = jnp.asarray(x)
+    img_size = jnp.asarray(img_size)
+    N, _, H, W = x.shape
+    A = len(anchors) // 2
+    anc = jnp.asarray(anchors, x.dtype).reshape(A, 2)  # (w, h) pairs
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+    in_h = downsample_ratio * H
+    in_w = downsample_ratio * W
+
+    t = x.reshape(N, A, 5 + class_num, H, W)
+    img_h = img_size[:, 0].astype(x.dtype).reshape(N, 1, 1, 1)
+    img_w = img_size[:, 1].astype(x.dtype).reshape(N, 1, 1, 1)
+    grid_x = jnp.arange(W, dtype=x.dtype)
+    grid_y = jnp.arange(H, dtype=x.dtype).reshape(-1, 1)
+
+    cx = (grid_x + jax.nn.sigmoid(t[:, :, 0]) * scale + bias) * img_w / W
+    cy = (grid_y + jax.nn.sigmoid(t[:, :, 1]) * scale + bias) * img_h / H
+    bw = jnp.exp(t[:, :, 2]) * anc[:, 0].reshape(1, A, 1, 1) * img_w / in_w
+    bh = jnp.exp(t[:, :, 3]) * anc[:, 1].reshape(1, A, 1, 1) * img_h / in_h
+    conf = jax.nn.sigmoid(t[:, :, 4])
+    keep = conf >= conf_thresh
+
+    x0, y0 = cx - bw / 2, cy - bh / 2
+    x1, y1 = cx + bw / 2, cy + bh / 2
+    if clip_bbox:
+        x0 = jnp.clip(x0, 0.0, None)
+        y0 = jnp.clip(y0, 0.0, None)
+        x1 = jnp.minimum(x1, img_w - 1)
+        y1 = jnp.minimum(y1, img_h - 1)
+    boxes = jnp.stack([x0, y0, x1, y1], axis=-1)  # [N, A, H, W, 4]
+    boxes = jnp.where(keep[..., None], boxes, 0.0)
+    scores = conf[..., None] * jax.nn.sigmoid(
+        jnp.moveaxis(t[:, :, 5:], 2, -1))  # [N, A, H, W, cls]
+    scores = jnp.where(keep[..., None], scores, 0.0)
+    return (boxes.reshape(N, A * H * W, 4),
+            scores.reshape(N, A * H * W, class_num))

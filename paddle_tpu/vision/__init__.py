@@ -8,6 +8,7 @@ lower to XLA convolutions on the MXU; transforms are host-side numpy
 """
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
+from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
 from .image import get_image_backend, image_load, set_image_backend  # noqa: F401
 

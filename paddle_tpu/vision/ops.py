@@ -1,0 +1,13 @@
+"""paddle.vision.ops — vision operators.
+
+Forward-compat module (2.0+ moves roi/nms/yolo ops here; at the
+reference version they live in fluid.layers).  All implementations are
+in nn/functional/detection.py.
+"""
+from ..nn.functional.detection import (  # noqa: F401
+    box_coder, nms, multiclass_nms, prior_box, roi_align, roi_pool,
+    sigmoid_focal_loss, yolo_box,
+)
+
+__all__ = ["box_coder", "nms", "multiclass_nms", "prior_box", "roi_align",
+           "roi_pool", "sigmoid_focal_loss", "yolo_box"]

@@ -361,6 +361,173 @@ class TestBoxClip:
         np.testing.assert_allclose(out[0, 1], [1.0, 2.0, 3.0, 4.0])
 
 
+def _roi_align_np(feat, rois, batch_ids, ph, pw, scale, ratio):
+    """Transcribes roi_align_op.h:140-240 (fixed sampling grid)."""
+    R = rois.shape[0]
+    C, H, W = feat.shape[1:]
+    out = np.zeros((R, C, ph, pw), np.float64)
+
+    def bilinear(img, y, x):
+        if y < -1.0 or y > H or x < -1.0 or x > W:
+            return np.zeros(C)
+        y, x = max(y, 0.0), max(x, 0.0)
+        yl, xl = min(int(np.floor(y)), H - 1), min(int(np.floor(x)), W - 1)
+        if yl >= H - 1:
+            y = yl = H - 1
+        if xl >= W - 1:
+            x = xl = W - 1
+        yh, xh = min(yl + 1, H - 1), min(xl + 1, W - 1)
+        ly, lx = y - yl, x - xl
+        return (img[:, yl, xl] * (1 - ly) * (1 - lx)
+                + img[:, yl, xh] * (1 - ly) * lx
+                + img[:, yh, xl] * ly * (1 - lx)
+                + img[:, yh, xh] * ly * lx)
+
+    for r in range(R):
+        x0, y0, x1, y1 = rois[r] * scale
+        rw = max(x1 - x0, 1.0)
+        rh = max(y1 - y0, 1.0)
+        bw, bh = rw / pw, rh / ph
+        img = feat[batch_ids[r]]
+        for i in range(ph):
+            for j in range(pw):
+                acc = np.zeros(C)
+                for iy in range(ratio):
+                    for ix in range(ratio):
+                        y = y0 + i * bh + (iy + 0.5) * bh / ratio
+                        x = x0 + j * bw + (ix + 0.5) * bw / ratio
+                        acc += bilinear(img, y, x)
+                out[r, :, i, j] = acc / (ratio * ratio)
+    return out
+
+
+class TestRoiAlign:
+    def test_vs_oracle(self):
+        rng = np.random.RandomState(0)
+        feat = rng.randn(2, 3, 8, 8).astype(np.float32)
+        rois = np.array([[1.0, 1.0, 6.0, 6.0], [0.0, 0.0, 3.5, 5.0],
+                         [2.0, 3.0, 7.0, 7.5]], np.float32)
+        rois_num = np.array([2, 1], np.int32)
+        out = F.roi_align(feat, rois, pooled_height=2, pooled_width=2,
+                          spatial_scale=0.5, sampling_ratio=2,
+                          rois_num=rois_num)
+        want = _roi_align_np(feat, rois, [0, 0, 1], 2, 2, 0.5, 2)
+        np.testing.assert_allclose(np.asarray(out), want, atol=1e-5)
+
+    def test_jit_and_grad(self):
+        feat = jnp.asarray(np.random.RandomState(1).randn(1, 2, 6, 6),
+                           jnp.float32)
+        rois = jnp.asarray([[0.0, 0.0, 4.0, 4.0]], jnp.float32)
+        g = jax.grad(lambda f: jnp.sum(F.roi_align(
+            f, rois, 2, 2, 1.0, 2) ** 2))(feat)
+        assert np.isfinite(np.asarray(g)).all()
+        assert float(jnp.abs(g).sum()) > 0
+
+
+class TestRoiPool:
+    def test_max_per_bin(self):
+        """A ROI covering the whole map with 1x1 pooling is a global max."""
+        rng = np.random.RandomState(2)
+        feat = rng.randn(1, 2, 6, 6).astype(np.float32)
+        rois = np.array([[0.0, 0.0, 5.0, 5.0]], np.float32)
+        out = F.roi_pool(feat, rois, 1, 1, 1.0)
+        np.testing.assert_allclose(np.asarray(out)[0, :, 0, 0],
+                                   feat[0].max(axis=(1, 2)), atol=1e-6)
+
+    def test_bin_partition(self):
+        """2x2 pooling over a 4x4 ROI: each bin is a 2x2 quadrant max
+        (roi_pool_op.h integer partition)."""
+        feat = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rois = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+        out = np.asarray(F.roi_pool(feat, rois, 2, 2, 1.0))[0, 0]
+        np.testing.assert_allclose(out, [[5.0, 7.0], [13.0, 15.0]])
+
+    def test_rois_num_batching(self):
+        feat = np.zeros((2, 1, 4, 4), np.float32)
+        feat[1] = 7.0
+        rois = np.array([[0, 0, 3, 3], [0, 0, 3, 3]], np.float32)
+        out = np.asarray(F.roi_pool(feat, rois, 1, 1, 1.0,
+                                    rois_num=np.array([1, 1])))
+        assert out[0, 0, 0, 0] == 0.0 and out[1, 0, 0, 0] == 7.0
+
+
+class TestSigmoidFocalLoss:
+    def _oracle(self, x, label, fg, gamma, alpha):
+        N, C = x.shape
+        out = np.zeros_like(x, np.float64)
+        fg = max(fg, 1)
+        for i in range(N):
+            for d in range(C):
+                g = label[i, 0]
+                c_pos = float(g == d + 1)
+                c_neg = float((g != -1) and (g != d + 1))
+                p = 1.0 / (1.0 + np.exp(-x[i, d]))
+                term_pos = (1 - p) ** gamma * np.log(max(p, 1e-37))
+                xx = x[i, d]
+                term_neg = p ** gamma * (
+                    -xx * (xx >= 0) - np.log1p(np.exp(xx - 2 * xx * (xx >= 0))))
+                out[i, d] = (-c_pos * term_pos * alpha / fg
+                             - c_neg * term_neg * (1 - alpha) / fg)
+        return out
+
+    def test_vs_oracle(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(6, 4).astype(np.float32) * 3
+        label = np.array([[1], [0], [3], [-1], [4], [2]], np.int32)
+        out = F.sigmoid_focal_loss(x, label, fg_num=4)
+        want = self._oracle(x, label, 4, 2.0, 0.25)
+        np.testing.assert_allclose(np.asarray(out), want, atol=1e-5)
+        # ignored rows (label -1) contribute nothing
+        assert np.abs(np.asarray(out)[3]).sum() == 0
+
+    def test_grad_finite(self):
+        x = jnp.asarray(np.random.RandomState(4).randn(3, 5), jnp.float32)
+        label = jnp.asarray([[2], [0], [5]], jnp.int32)
+        g = jax.grad(lambda t: jnp.sum(F.sigmoid_focal_loss(t, label, 2)))(x)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestYoloBox:
+    def test_decode_geometry(self):
+        """Zero logits put each box center at (cell+0.5)/grid of the image
+        and size anchor*img/input; conf = 0.5 passes a 0.3 threshold."""
+        N, A, C, H, W = 1, 2, 3, 2, 2
+        x = np.zeros((N, A * (5 + C), H, W), np.float32)
+        img_size = np.array([[64, 64]], np.int32)
+        anchors = [10, 14, 23, 27]
+        boxes, scores = F.yolo_box(x, img_size, anchors, C,
+                                   conf_thresh=0.3, downsample_ratio=32)
+        assert boxes.shape == (1, A * H * W, 4)
+        assert scores.shape == (1, A * H * W, C)
+        b = np.asarray(boxes)
+        # first anchor, cell (0,0): center (0.5/2)*64 = 16, size 10/64*64=10
+        cx = (b[0, 0, 0] + b[0, 0, 2]) / 2
+        cy = (b[0, 0, 1] + b[0, 0, 3]) / 2
+        np.testing.assert_allclose([cx, cy], [16.0, 16.0], atol=1e-4)
+        np.testing.assert_allclose(b[0, 0, 2] - b[0, 0, 0], 10.0, atol=1e-4)
+        # scores = sigmoid(0) * sigmoid(0) = 0.25
+        np.testing.assert_allclose(np.asarray(scores)[0, 0], 0.25, atol=1e-5)
+
+    def test_conf_threshold_zeroes(self):
+        N, A, C, H, W = 1, 1, 2, 1, 1
+        x = np.zeros((N, A * (5 + C), H, W), np.float32)
+        x[0, 4] = -10.0  # conf ≈ 0 → below threshold
+        boxes, scores = F.yolo_box(x, np.array([[32, 32]], np.int32),
+                                   [10, 10], C, conf_thresh=0.5,
+                                   downsample_ratio=32)
+        assert np.abs(np.asarray(boxes)).sum() == 0
+        assert np.abs(np.asarray(scores)).sum() == 0
+
+    def test_clip_bbox(self):
+        N, A, C, H, W = 1, 1, 1, 1, 1
+        x = np.zeros((N, A * (5 + C), H, W), np.float32)
+        x[0, 2] = 3.0  # exp(3) * anchor → much wider than the image
+        boxes, _ = F.yolo_box(x, np.array([[32, 32]], np.int32), [30, 30],
+                              C, conf_thresh=0.1, downsample_ratio=32)
+        b = np.asarray(boxes)[0, 0]
+        assert b[0] >= 0 and b[2] <= 31.0
+
+
 class TestPriorBox:
     def test_shapes_and_ranges(self):
         feat = jnp.zeros((1, 8, 4, 6))
