@@ -540,30 +540,13 @@ def resize_trilinear(input, out_shape=None, scale=None, name=None,
                           align_mode=align_mode, data_format=data_format)
 
 
-# -- sequence ops: dense/padded counterparts ----------------------------
-def sequence_pad(x, pad_value, maxlen=None, name=None):
-    raise UnimplementedError(
-        "sequence_pad consumes LoD input; dense batches are already "
-        "padded here — build them with paddle.io.DataLoader collation "
-        "(SURVEY §7g dense-padding policy)")
-
-
-def sequence_unpad(x, length, name=None):
-    raise UnimplementedError(
-        "sequence_unpad: keep the lengths tensor alongside the padded "
-        "batch and mask with paddle.nn.functional.sequence_mask instead")
-
-
-def sequence_softmax(input, use_cudnn=False, name=None):
-    raise UnimplementedError(
-        "sequence_softmax is LoD-ragged; use softmax over the padded "
-        "axis with a sequence_mask of -inf on padding")
-
-
-def sequence_reverse(x, name=None):
-    raise UnimplementedError(
-        "sequence_reverse is LoD-ragged; for padded batches reverse the "
-        "valid prefix per row: paddle.flip + sequence_mask")
+# -- sequence ops: dense/padded implementations (nn/functional/sequence.py)
+from paddle_tpu.nn.functional import (  # noqa: F401,E402
+    sequence_pool, sequence_softmax, sequence_reverse, sequence_pad,
+    sequence_unpad, sequence_first_step, sequence_last_step,
+    sequence_expand, sequence_expand_as, sequence_enumerate,
+    sequence_concat,
+)
 
 
 # -- static-only op-builders / LoD machinery ----------------------------
@@ -627,16 +610,9 @@ _STATIC_ONLY = {
     "lod_reset": "LoD machinery replaced by dense padding + lengths",
     "lod_append": "LoD machinery replaced by dense padding + lengths",
     "sequence_conv": "conv1d over padded batches with sequence_mask",
-    "sequence_pool": "masked reduce over the padded time axis",
-    "sequence_concat": "concat padded batches + combined lengths",
-    "sequence_first_step": "x[:, 0]",
-    "sequence_last_step": "take_along_axis with lengths-1",
     "sequence_slice": "lax.dynamic_slice per row",
-    "sequence_expand": "repeat/gather by lengths",
-    "sequence_expand_as": "repeat/gather by lengths",
     "sequence_reshape": "reshape padded batches directly",
     "sequence_scatter": "scatter with row offsets",
-    "sequence_enumerate": "sliding windows via jnp.stack of shifts",
     # PS / distributed-specific
     "Send": "XLA collectives (paddle.distributed)",
     "Recv": "XLA collectives (paddle.distributed)",

@@ -3,3 +3,4 @@ python/paddle/fluid/incubate/)."""
 from . import checkpoint  # noqa: F401
 from . import sharded_checkpoint  # noqa: F401
 from . import reader  # noqa: F401
+from . import complex  # noqa: F401

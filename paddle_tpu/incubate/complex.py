@@ -1,0 +1,88 @@
+"""paddle.incubate.complex — complex tensor ops.
+
+Parity: python/paddle/incubate/complex/ (tensor/math.py,
+linalg.py:22 matmul, manipulation.py).  The reference carries complex
+values as a ComplexVariable (real/imag Variable pair) because its op
+library was real-only; XLA supports complex64/128 natively, so every op
+here is the plain jnp op on a complex array — the module exists so 1.x
+complex code keeps its import paths.
+
+Backend note: complex arithmetic runs fully on the CPU backend; the TPU
+backend lowers only part of the complex op set (e.g. complex matmul is
+unimplemented there) — same situation as the reference, whose complex
+support was CPU-first.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = [
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "trace", "sum", "kron", "matmul", "reshape",
+    "transpose",
+]
+
+
+def _c(x):
+    x = jnp.asarray(x)
+    if jnp.iscomplexobj(x):
+        return x
+    # f64 real parts promote to complex128, matching the reference's
+    # f64 real/imag pair semantics
+    return x.astype(jnp.result_type(x.dtype, jnp.complex64))
+
+
+def _axis_bcast(x, y, axis, op):
+    """Paddle 1.x elementwise axis alignment — shared with
+    fluid.layers._bcast (imported lazily: fluid loads after incubate)."""
+    from paddle_tpu.fluid.layers import _bcast
+
+    return _bcast(x, y, axis, op)
+
+
+def elementwise_add(x, y, axis=-1, name=None):
+    return _axis_bcast(_c(x), _c(y), axis, jnp.add)
+
+
+def elementwise_sub(x, y, axis=-1, name=None):
+    return _axis_bcast(_c(x), _c(y), axis, jnp.subtract)
+
+
+def elementwise_mul(x, y, axis=-1, name=None):
+    return _axis_bcast(_c(x), _c(y), axis, jnp.multiply)
+
+
+def elementwise_div(x, y, axis=-1, name=None):
+    return _axis_bcast(_c(x), _c(y), axis, jnp.divide)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.trace(_c(x), offset=offset, axis1=axis1, axis2=axis2)
+
+
+def sum(input, dim=None, keep_dim=False, name=None):
+    return jnp.sum(_c(input), axis=tuple(dim) if isinstance(dim, list)
+                   else dim, keepdims=keep_dim)
+
+
+def kron(x, y, name=None):
+    return jnp.kron(_c(x), _c(y))
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0,
+           name=None):
+    a, b = _c(x), _c(y)
+    if transpose_x:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_y:
+        b = jnp.swapaxes(b, -1, -2)
+    out = a @ b
+    return out if alpha == 1.0 else out * alpha
+
+
+def reshape(x, shape, inplace=False, name=None):
+    return jnp.reshape(_c(x), tuple(shape))
+
+
+def transpose(x, perm, name=None):
+    return jnp.transpose(_c(x), axes=perm)

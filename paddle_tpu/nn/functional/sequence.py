@@ -1,0 +1,211 @@
+"""Dense sequence ops — the LoD sequence_* family on padded batches.
+
+Parity: python/paddle/fluid/layers/sequence_lod.py (sequence_pool:261,
+sequence_expand:638, sequence_enumerate:1235, ...) over
+operators/sequence_ops/.  The reference threads ragged sequences
+through LoD offsets; the TPU-native convention (SURVEY §7g) is dense
+``[B, T, ...]`` batches plus a ``lengths [B]`` tensor — every op here
+takes that pair and masks padding exactly where the LoD kernels skipped
+it.  ``lengths=None`` means fully-packed rows.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.errors import InvalidArgumentError
+
+__all__ = [
+    "sequence_pool", "sequence_softmax", "sequence_reverse",
+    "sequence_first_step", "sequence_last_step", "sequence_expand",
+    "sequence_expand_as", "sequence_enumerate", "sequence_pad",
+    "sequence_unpad", "sequence_concat",
+]
+
+
+def _mask(x, lengths):
+    """[B, T] bool validity mask broadcastable into x [B, T, ...]."""
+    B, T = x.shape[0], x.shape[1]
+    if lengths is None:
+        return jnp.ones((B, T), bool)
+    lengths = jnp.asarray(lengths).reshape(B)
+    return jnp.arange(T)[None, :] < lengths[:, None]
+
+
+def _expand_mask(m, x):
+    return m.reshape(m.shape + (1,) * (x.ndim - 2))
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,
+                  lengths=None):
+    """Pool each sequence over its valid time steps (ref:
+    sequence_lod.py:261 — average/sum/sqrt/max/last/first).  Empty
+    sequences yield ``pad_value``, matching the kernel.  input
+    ``[B, T, D]`` → ``[B, D]``."""
+    x = jnp.asarray(input)
+    m = _expand_mask(_mask(x, lengths), x)
+    n = jnp.sum(m, axis=1)  # [B, 1...] valid counts
+    pool_type = pool_type.lower()
+    if pool_type == "sum":
+        out = jnp.sum(jnp.where(m, x, 0), axis=1)
+    elif pool_type == "average":
+        out = jnp.sum(jnp.where(m, x, 0), axis=1) / jnp.maximum(n, 1)
+    elif pool_type == "sqrt":
+        out = jnp.sum(jnp.where(m, x, 0), axis=1) / jnp.sqrt(
+            jnp.maximum(n, 1).astype(x.dtype))
+    elif pool_type == "max":
+        out = jnp.max(jnp.where(m, x, -jnp.inf), axis=1)
+    elif pool_type == "first":
+        out = x[:, 0]
+    elif pool_type == "last":
+        idx = (jnp.sum(_mask(x, lengths), axis=1) - 1).clip(0)
+        out = jnp.take_along_axis(
+            x, idx.reshape(-1, 1, *([1] * (x.ndim - 2))), axis=1
+        )[:, 0]
+    else:
+        raise InvalidArgumentError(
+            f"pool_type must be one of average/sum/sqrt/max/last/first, "
+            f"got {pool_type!r}")
+    empty = (n == 0)
+    return jnp.where(empty, jnp.asarray(pad_value, x.dtype), out)
+
+
+def sequence_first_step(input, lengths=None):
+    """x[:, 0] (ref: sequence_lod.py sequence_first_step)."""
+    return sequence_pool(input, "first", lengths=lengths)
+
+
+def sequence_last_step(input, lengths=None):
+    """x[:, len-1] per row (ref: sequence_lod.py sequence_last_step)."""
+    return sequence_pool(input, "last", lengths=lengths)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, lengths=None):
+    """Softmax over each row's valid steps; padding gets 0 (ref:
+    sequence_softmax_op — softmax within each sequence)."""
+    x = jnp.asarray(input)
+    m = _expand_mask(_mask(x, lengths), x)
+    z = jnp.where(m, x, -jnp.inf)
+    out = jax.nn.softmax(z, axis=1)
+    return jnp.where(m, out, 0.0)
+
+
+def sequence_reverse(x, name=None, lengths=None):
+    """Reverse each row's valid prefix in place, padding untouched (ref:
+    sequence_reverse_op).  x ``[B, T, ...]``."""
+    x = jnp.asarray(x)
+    B, T = x.shape[0], x.shape[1]
+    if lengths is None:
+        return jnp.flip(x, axis=1)
+    lengths = jnp.asarray(lengths).reshape(B)
+    t = jnp.arange(T)[None, :]
+    src = jnp.where(t < lengths[:, None], lengths[:, None] - 1 - t, t)
+    return jnp.take_along_axis(
+        x, src.reshape(B, T, *([1] * (x.ndim - 2))), axis=1)
+
+
+def sequence_expand(x, lengths, name=None):
+    """Repeat row i of ``x`` ``lengths[i]`` times along a new time axis
+    (dense form of ref sequence_expand :638 — there the repeat counts
+    come from y's LoD).  x ``[B, D]`` → ``[B, max(lengths), D]``;
+    XLA static shapes make the ragged result a padded batch whose
+    validity is the given ``lengths``."""
+    x = jnp.asarray(x)
+    lengths = jnp.asarray(lengths).reshape(x.shape[0])
+    T = int(jnp.max(lengths)) if not isinstance(
+        lengths, jax.core.Tracer) else None
+    if T is None:
+        raise InvalidArgumentError(
+            "sequence_expand needs concrete lengths (the output time "
+            "axis is max(lengths) — a data-dependent shape under jit); "
+            "call it eagerly or use jnp.repeat with a static total")
+    # single-tensor return (1.x API shape); validity is the caller's
+    # lengths — the padded batch + lengths pair IS the ragged value
+    return jnp.broadcast_to(x[:, None], (x.shape[0], T) + x.shape[1:])
+
+
+def sequence_expand_as(x, y, lengths=None, name=None):
+    """Tile each row of ``x`` across y's time axis (ref
+    sequence_expand_as): x ``[B, D]``, y ``[B, T, ...]`` →
+    ``[B, T, D]``."""
+    x = jnp.asarray(x)
+    T = jnp.asarray(y).shape[1]
+    return jnp.broadcast_to(x[:, None], (x.shape[0], T) + x.shape[1:])
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None,
+                       lengths=None):
+    """All length-``win_size`` sub-windows per position (ref:
+    sequence_lod.py:1235 over sequence_enumerate_op): window j of row i
+    is ``x[i, j : j+win]`` padded with ``pad_value`` past the row's
+    valid length.  input ``[B, T]`` → ``[B, T, win_size]``."""
+    x = jnp.asarray(input)
+    if x.ndim == 3 and x.shape[-1] == 1:
+        x = x[..., 0]
+    B, T = x.shape
+    valid = _mask(x, lengths)  # [B, T]
+    cols = jnp.arange(T)[:, None] + jnp.arange(win_size)[None, :]  # [T, W]
+    in_range = cols < T
+    gather = jnp.take(x, jnp.minimum(cols, T - 1), axis=1)  # [B, T, W]
+    win_valid = in_range[None] & jnp.take(
+        valid, jnp.minimum(cols, T - 1), axis=1)
+    # a window starting at an invalid (padding) position is all padding
+    win_valid = win_valid & valid[:, :, None]
+    return jnp.where(win_valid, gather,
+                     jnp.asarray(pad_value, x.dtype))
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None, lengths=None):
+    """Dense form (ref sequence_pad): the batch is already padded — this
+    re-pads to ``maxlen`` (trim or extend) and returns (padded,
+    lengths), the reference's output pair."""
+    x = jnp.asarray(x)
+    B, T = x.shape[0], x.shape[1]
+    lengths = (jnp.asarray(lengths).reshape(B) if lengths is not None
+               else jnp.full((B,), T))
+    target = int(maxlen) if maxlen is not None else T
+    pv = jnp.asarray(pad_value, x.dtype)
+    if target > T:
+        pad_block = jnp.broadcast_to(pv, (B, target - T) + x.shape[2:])
+        x = jnp.concatenate([x, pad_block], axis=1)
+    elif target < T:
+        x = x[:, :target]
+    m = jnp.arange(target)[None, :] < jnp.minimum(lengths, target)[:, None]
+    x = jnp.where(m.reshape(m.shape + (1,) * (x.ndim - 2)), x, pv)
+    return x, jnp.minimum(lengths, target)
+
+
+def sequence_unpad(x, length, name=None):
+    """Zero out the padding region and return the batch with its lengths
+    (ref sequence_unpad flattens to LoD; dense form keeps ``[B, T]`` +
+    lengths as THE ragged representation)."""
+    x = jnp.asarray(x)
+    m = _expand_mask(_mask(x, length), x)
+    return jnp.where(m, x, 0)  # single-tensor return, 1.x API shape
+
+
+def sequence_concat(input, lengths=None, name=None):
+    """Concatenate sequences row-wise along time (ref sequence_concat:
+    per-row LoD concat).  input: list of ``[B, Ti, ...]`` batches (+
+    optional list of lengths) → (``[B, ΣTi, ...]``, lengths).  With
+    full rows this is jnp.concatenate; ragged rows compact each row's
+    valid prefixes together."""
+    xs = [jnp.asarray(x) for x in input]
+    if lengths is None:
+        return jnp.concatenate(xs, axis=1)
+    B = xs[0].shape[0]
+    total_T = sum(x.shape[1] for x in xs)
+    lens = [jnp.asarray(l).reshape(B) for l in lengths]
+    # scatter each piece's valid prefix at its per-row offset
+    out = jnp.zeros((B, total_T) + xs[0].shape[2:], xs[0].dtype)
+    offset = jnp.zeros((B,), jnp.int32)
+    for x, l in zip(xs, lens):
+        T = x.shape[1]
+        t = jnp.arange(T)[None, :]
+        dest = offset[:, None] + t  # [B, T]
+        valid = t < l[:, None]
+        dest = jnp.where(valid, dest, total_T)  # drop padding (OOB)
+        bidx = jnp.broadcast_to(jnp.arange(B)[:, None], dest.shape)
+        out = out.at[bidx, dest].set(x, mode="drop")
+        offset = offset + l.astype(jnp.int32)
+    return out  # single-tensor return; row lengths = sum of input lengths

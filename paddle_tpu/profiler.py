@@ -30,10 +30,13 @@ __all__ = [
     "reset_profiler",
     "profiler",
     "summary",
+    "export_chrome_tracing",
 ]
 
 _lock = threading.Lock()
 _events: Dict[str, dict] = {}
+_spans: list = []  # (name, tid, start_us, dur_us) while profiling
+_SPAN_CAP = 200_000  # keep the host-side buffer bounded
 _trace_dir: Optional[str] = None
 _started = False
 
@@ -57,7 +60,8 @@ class RecordEvent:
         return self
 
     def __exit__(self, *exc):
-        dt = (time.perf_counter() - self._t0) * 1e3  # ms
+        t1 = time.perf_counter()
+        dt = (t1 - self._t0) * 1e3  # ms
         self._ann.__exit__(*exc)
         with _lock:
             e = _events.setdefault(
@@ -67,6 +71,9 @@ class RecordEvent:
             e["total"] += dt
             e["min"] = min(e["min"], dt)
             e["max"] = max(e["max"], dt)
+            if _started and len(_spans) < _SPAN_CAP:
+                _spans.append((self.name, threading.get_ident(),
+                               self._t0 * 1e6, dt * 1e3))
         return False
 
     def __call__(self, fn):
@@ -124,6 +131,30 @@ def reset_profiler():
     """Parity: fluid/profiler.py reset_profiler."""
     with _lock:
         _events.clear()
+        _spans.clear()
+
+
+def export_chrome_tracing(path: str) -> int:
+    """Write the recorded host spans as a chrome://tracing /
+    ui.perfetto.dev JSON file (capability of the reference's
+    tools/timeline.py, which converted profiler protos the same way).
+    Returns the number of spans written.  Device-side timelines come
+    from the XLA trace (``start_profiler(log_dir=...)``) — this covers
+    the host RecordEvent annotations."""
+    import json
+
+    with _lock:
+        spans = list(_spans)
+    events = [
+        {"name": name, "ph": "X", "pid": 0, "tid": tid,
+         "ts": round(ts_us, 3), "dur": round(dur_us, 3),
+         "cat": "host"}
+        for name, tid, ts_us, dur_us in spans
+    ]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms"}, f)
+    return len(events)
 
 
 def summary(sorted_key: Optional[str] = "total") -> str:

@@ -94,9 +94,17 @@ class TestLayersWrappers:
             fluid.layers.fc(None, size=10)
         assert "paddle.nn.Linear" in str(ei.value)
         with pytest.raises(UnimplementedError):
-            fluid.layers.sequence_pool(None, "max")
+            fluid.layers.sequence_slice(None, 0, 1)
         with pytest.raises(AttributeError):
             fluid.layers.not_a_real_op
+
+    def test_sequence_pool_dense(self):
+        """sequence_* upgraded from shims to dense implementations —
+        1.x positional args still bind correctly (is_test 3rd)."""
+        x = np.array([[[1.0], [3.0]], [[2.0], [0.0]]], np.float32)
+        out = fluid.layers.sequence_pool(x, "sum", False,
+                                         lengths=np.array([2, 1]))
+        np.testing.assert_allclose(np.asarray(out)[:, 0], [4.0, 2.0])
 
     def test_detection_reexports(self):
         assert fluid.layers.iou_similarity is not None

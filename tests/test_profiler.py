@@ -25,6 +25,31 @@ def clean_profiler():
     set_flags({"check_nan_inf": False, "benchmark": False})
 
 
+class TestChromeTracing:
+    def test_exports_spans_json(self, tmp_path):
+        import json
+
+        prof.start_profiler()
+        with prof.RecordEvent("train_step"):
+            with prof.RecordEvent("forward"):
+                pass
+        prof.stop_profiler(profile_path=None)
+        path = str(tmp_path / "timeline.json")
+        n = prof.export_chrome_tracing(path)
+        assert n == 2
+        data = json.load(open(path))
+        names = {e["name"] for e in data["traceEvents"]}
+        assert names == {"train_step", "forward"}
+        ev = data["traceEvents"][0]
+        assert ev["ph"] == "X" and ev["dur"] >= 0
+
+    def test_spans_only_recorded_while_profiling(self, tmp_path):
+        with prof.RecordEvent("outside"):
+            pass
+        n = prof.export_chrome_tracing(str(tmp_path / "t.json"))
+        assert n == 0
+
+
 class TestRecordEvent:
     def test_accumulates_stats(self):
         for _ in range(3):

@@ -1,0 +1,160 @@
+"""Dense sequence_* ops (LoD family on padded batches + lengths).
+
+Oracle style: hand-computed ragged examples transcribing the reference
+docstring cases (fluid/layers/sequence_lod.py)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.framework.errors import InvalidArgumentError
+
+
+def _batch():
+    """Rows: [1,3], [2,4,6], [5] (padded to T=3) — the reference
+    sequence_pool docstring example reshaped dense."""
+    x = np.array([[[1.0], [3.0], [0.0]],
+                  [[2.0], [4.0], [6.0]],
+                  [[5.0], [0.0], [0.0]]], np.float32)
+    lengths = np.array([2, 3, 1])
+    return jnp.asarray(x), jnp.asarray(lengths)
+
+
+class TestSequencePool:
+    @pytest.mark.parametrize("ptype,want", [
+        ("sum", [4.0, 12.0, 5.0]),
+        ("average", [2.0, 4.0, 5.0]),
+        ("sqrt", [4.0 / np.sqrt(2), 12.0 / np.sqrt(3), 5.0]),
+        ("max", [3.0, 6.0, 5.0]),
+        ("first", [1.0, 2.0, 5.0]),
+        ("last", [3.0, 6.0, 5.0]),
+    ])
+    def test_pool_types(self, ptype, want):
+        x, lengths = _batch()
+        out = F.sequence_pool(x, ptype, lengths=lengths)
+        np.testing.assert_allclose(np.asarray(out)[:, 0], want, atol=1e-6)
+
+    def test_empty_sequence_pad_value(self):
+        x = jnp.zeros((2, 3, 1), jnp.float32)
+        out = F.sequence_pool(x, "max", pad_value=-7.0,
+                              lengths=jnp.asarray([0, 2]))
+        assert float(out[0, 0]) == -7.0
+
+    def test_first_last_step_aliases(self):
+        x, lengths = _batch()
+        np.testing.assert_allclose(
+            np.asarray(F.sequence_first_step(x, lengths))[:, 0],
+            [1.0, 2.0, 5.0])
+        np.testing.assert_allclose(
+            np.asarray(F.sequence_last_step(x, lengths))[:, 0],
+            [3.0, 6.0, 5.0])
+
+    def test_bad_pool_type(self):
+        x, lengths = _batch()
+        with pytest.raises(InvalidArgumentError):
+            F.sequence_pool(x, "median", lengths=lengths)
+
+
+class TestSequenceSoftmaxReverse:
+    def test_softmax_masks_padding(self):
+        x, lengths = _batch()
+        out = np.asarray(F.sequence_softmax(x[..., 0], lengths=lengths))
+        np.testing.assert_allclose(out.sum(1), 1.0, atol=1e-6)
+        assert out[0, 2] == 0.0 and out[2, 1] == 0.0
+
+    def test_reverse_valid_prefix_only(self):
+        x, lengths = _batch()
+        out = np.asarray(F.sequence_reverse(x, lengths=lengths))[..., 0]
+        np.testing.assert_allclose(out[0], [3.0, 1.0, 0.0])
+        np.testing.assert_allclose(out[1], [6.0, 4.0, 2.0])
+        np.testing.assert_allclose(out[2], [5.0, 0.0, 0.0])
+
+    def test_reverse_no_lengths_flips(self):
+        x = jnp.asarray(np.arange(6).reshape(1, 6), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(F.sequence_reverse(x)), [[5, 4, 3, 2, 1, 0]])
+
+
+class TestSequenceEnumerate:
+    def test_reference_docstring_case(self):
+        """x rows [1,2,3], [4,5]; win 2 → windows with pad 0 at the row
+        ends (sequence_lod.py:1246)."""
+        x = jnp.asarray([[1, 2, 3], [4, 5, 0]], jnp.int64)
+        out = np.asarray(F.sequence_enumerate(x, 2,
+                                              lengths=jnp.asarray([3, 2])))
+        np.testing.assert_array_equal(
+            out[0], [[1, 2], [2, 3], [3, 0]])
+        np.testing.assert_array_equal(
+            out[1], [[4, 5], [5, 0], [0, 0]])
+
+
+class TestSequencePadUnpadConcat:
+    def test_pad_extends_and_trims(self):
+        x, lengths = _batch()
+        padded, lens = F.sequence_pad(x, -1.0, maxlen=5, lengths=lengths)
+        assert padded.shape == (3, 5, 1)
+        assert float(padded[0, 2, 0]) == -1.0
+        np.testing.assert_array_equal(np.asarray(lens), [2, 3, 1])
+        trimmed, lens2 = F.sequence_pad(x, 0.0, maxlen=2, lengths=lengths)
+        assert trimmed.shape == (3, 2, 1)
+        np.testing.assert_array_equal(np.asarray(lens2), [2, 2, 1])
+
+    def test_unpad_zeroes_padding(self):
+        x = jnp.ones((2, 3), jnp.float32)
+        out = F.sequence_unpad(x, jnp.asarray([1, 3]))
+        np.testing.assert_allclose(np.asarray(out),
+                                   [[1, 0, 0], [1, 1, 1]])
+
+    def test_concat_compacts_ragged_rows(self):
+        a = jnp.asarray([[[1.0], [2.0]], [[7.0], [0.0]]])
+        b = jnp.asarray([[[3.0]], [[8.0]]])
+        out = F.sequence_concat(
+            [a, b], lengths=[jnp.asarray([2, 1]), jnp.asarray([1, 1])])
+        np.testing.assert_allclose(np.asarray(out)[0, :, 0], [1, 2, 3])
+        np.testing.assert_allclose(np.asarray(out)[1, :2, 0], [7, 8])
+
+    def test_concat_dense_fastpath(self):
+        a = jnp.ones((2, 2, 1))
+        b = jnp.zeros((2, 1, 1))
+        out = F.sequence_concat([a, b])
+        assert out.shape == (2, 3, 1)
+
+
+class TestSequenceExpand:
+    def test_expand_as(self):
+        x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        y = jnp.zeros((2, 3, 5))
+        out = F.sequence_expand_as(x, y)
+        assert out.shape == (2, 3, 2)
+        np.testing.assert_allclose(np.asarray(out)[1, 2], [3.0, 4.0])
+
+    def test_expand_eager(self):
+        x = jnp.asarray([[1.0], [2.0]])
+        out = F.sequence_expand(x, jnp.asarray([2, 3]))
+        assert out.shape == (2, 3, 1)
+
+
+class TestJitability:
+    def test_pool_softmax_reverse_jit(self):
+        x, lengths = _batch()
+
+        @jax.jit
+        def f(x, lengths):
+            a = F.sequence_pool(x, "max", lengths=lengths)
+            b = F.sequence_softmax(x[..., 0], lengths=lengths)
+            c = F.sequence_reverse(x, lengths=lengths)
+            return a, b, c
+
+        a, b, c = f(x, lengths)
+        assert np.isfinite(np.asarray(a)).all()
+        assert np.isfinite(np.asarray(b)).all()
+
+    def test_grad_through_pool(self):
+        x, lengths = _batch()
+        g = jax.grad(lambda t: jnp.sum(
+            F.sequence_pool(t, "average", lengths=lengths)))(x)
+        gn = np.asarray(g)[..., 0]
+        assert gn[0, 2] == 0.0, "padding must get zero grad"
+        np.testing.assert_allclose(gn[0, 0], 0.5, atol=1e-6)
