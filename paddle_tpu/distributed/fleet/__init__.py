@@ -24,6 +24,9 @@ from ...framework.errors import InvalidArgumentError
 from .. import env as _env
 from ..mesh import build_mesh, get_mesh, set_mesh
 from . import metrics  # noqa: F401
+from . import utils  # noqa: F401
+from . import data_generator  # noqa: F401
+from .utils import LocalFS, HDFSClient  # noqa: F401  (ref fleet/utils)
 from .plan import ShardingPlan
 from .strategy import DistributedStrategy
 
