@@ -186,6 +186,74 @@ class TestBeamSearchDecode:
                                    np.asarray(eager_states.log_probs),
                                    atol=1e-5)
 
+    def test_trained_seq2seq_beam_decodes_copy_task(self):
+        """Book-test parity (reference book/test_machine_translation.py
+        decode path): train a GRU encoder-decoder on a copy task, then
+        beam-search decode with BeamSearchDecoder + dynamic_decode and
+        check the top beam reproduces the source."""
+        import jax
+
+        import paddle_tpu as paddle
+        from paddle_tpu import optimizer as popt
+        from paddle_tpu.nn import functional_call
+
+        V, H, T = 12, 32, 5
+        BOS, EOS = 0, 1
+        rng = np.random.RandomState(0)
+        src = rng.randint(2, V, size=(64, T)).astype(np.int32)
+        trg_in = np.concatenate(
+            [np.full((64, 1), BOS, np.int32), src[:, :-1]], axis=1)
+
+        class Seq2Seq(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(V, H)
+                self.enc = nn.GRU(H, H)
+                self.cell = nn.GRUCell(H, H)
+                self.out = nn.Linear(H, V)
+
+            def encode(self, s):
+                _, h = self.enc(self.emb(s))
+                return h[0]  # [B, H]
+
+            def forward(self, s, t_in):
+                h = self.encode(s)
+                xs = self.emb(t_in)  # [B, T, H]
+
+                def step(carry, xt):
+                    o, c = self.cell(xt, carry)
+                    return c, o
+
+                h_fin, outs = jax.lax.scan(
+                    step, h, jnp.swapaxes(xs, 0, 1))
+                return self.out(jnp.swapaxes(outs, 0, 1))
+
+            def loss(self, logits, labels):
+                lp = jax.nn.log_softmax(logits, -1)
+                picked = jnp.take_along_axis(
+                    lp, jnp.asarray(labels)[..., None].astype(jnp.int32), -1)
+                return -picked.mean()
+
+        paddle.seed(3)
+        net = Seq2Seq()
+        opt = popt.Adam(learning_rate=0.02, parameters=net.parameters())
+        grad_fn = jax.jit(jax.value_and_grad(
+            lambda p: net.loss(functional_call(net, p, src, trg_in), src)))
+        for i in range(120):
+            loss, g = grad_fn(net.param_pytree(trainable_only=True))
+            opt.step(g)
+        assert float(loss) < 0.15, f"copy task failed to train: {loss}"
+
+        decoder = nn.BeamSearchDecoder(
+            net.cell, start_token=BOS, end_token=EOS, beam_size=3,
+            embedding_fn=net.emb, output_fn=net.out)
+        h0 = net.encode(jnp.asarray(src[:8]))
+        outputs, _ = nn.dynamic_decode(decoder, inits=h0,
+                                       max_step_num=T - 1)
+        top = np.asarray(outputs)[:, :, 0]  # [8, T] best beam
+        acc = (top[:, :T] == src[:8, : top.shape[1]]).mean()
+        assert acc > 0.9, f"beam decode accuracy {acc}"
+
     def test_early_exit_eager_slices_time(self):
         """Eagerly, outputs are sliced to the steps actually run — an
         immediately-finishing decode is short even with a large cap."""
