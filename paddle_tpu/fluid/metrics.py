@@ -194,12 +194,138 @@ class CompositeMetric(MetricBase):
         return [m.eval() for m in self._metrics]
 
 
-class DetectionMAP:
-    """Ref :805 — builds Program ops (detection mAP pipeline); not
-    portable as a running metric object.  Compute AP from
-    detection_output results on host instead."""
+def _iou_corner(a, b):
+    """JaccardOverlap (detection_map_op.h:136) — zero for disjoint."""
+    if b[0] > a[2] or b[2] < a[0] or b[1] > a[3] or b[3] < a[1]:
+        return 0.0
+    iw = min(a[2], b[2]) - max(a[0], b[0])
+    ih = min(a[3], b[3]) - max(a[1], b[1])
+    inter = iw * ih
+    ua = ((a[2] - a[0]) * (a[3] - a[1])
+          + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+    return inter / ua if ua > 0 else 0.0
 
-    def __init__(self, *a, **k):
-        raise UnimplementedError(
-            "fluid.metrics.DetectionMAP wires Program ops; evaluate mAP "
-            "on host from paddle.nn.functional.detection_output results")
+
+class DetectionMAP:
+    """Running mean-average-precision evaluator (ref: metrics.py:805
+    over operators/detection_map_op.h).  The reference wires Program
+    ops; this is the same accumulation on host:
+
+    * ``update(detections, gt_labels, gt_boxes, difficult=None)`` —
+      per batch.  ``detections``: per-image ``[M, 6]`` rows of (label,
+      score, xmin, ymin, xmax, ymax) — exactly what
+      ``nn.functional.multiclass_nms`` / ``detection_output`` emit
+      (label=-1 padding rows are skipped); ``gt_labels``/``gt_boxes``:
+      per-image ``[G]`` / ``[G, 4]``.
+    * ``eval()`` — mAP under ``ap_version`` 'integral' or '11point'
+      (detection_map_op.h:456-483), matching greedily per class with
+      ``overlap_threshold``, clipping predictions to [0, 1] like the
+      kernel's ClipBBox.
+    """
+
+    def __init__(self, input=None, gt_label=None, gt_box=None,
+                 gt_difficult=None, class_num=None, background_label=0,
+                 overlap_threshold=0.5, evaluate_difficult=True,
+                 ap_version="integral"):
+        if ap_version not in ("integral", "11point"):
+            raise UnimplementedError(
+                f"ap_version must be 'integral' or '11point', "
+                f"got {ap_version!r}")
+        self.class_num = class_num
+        self.background_label = background_label
+        self.overlap_threshold = overlap_threshold
+        self.evaluate_difficult = evaluate_difficult
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self, executor=None, reset_program=None):
+        self._pos_count = {}  # label → #gt
+        self._tp = {}  # label → [(score, 0/1)]
+        self._fp = {}
+
+    def update(self, detections, gt_labels, gt_boxes, difficult=None):
+        n = len(gt_labels)
+        if len(detections) != n or len(gt_boxes) != n:
+            raise InvalidArgumentError(
+                "update() wants per-image lists of equal length")
+        for i in range(n):
+            labels = np.asarray(gt_labels[i]).reshape(-1).astype(int)
+            boxes = np.asarray(gt_boxes[i]).reshape(-1, 4)
+            diff = (np.asarray(difficult[i]).reshape(-1).astype(bool)
+                    if difficult is not None
+                    else np.zeros(len(labels), bool))
+            if not (len(labels) == len(boxes) == len(diff)):
+                raise InvalidArgumentError(
+                    f"image {i}: gt_labels ({len(labels)}), gt_boxes "
+                    f"({len(boxes)}) and difficult ({len(diff)}) must "
+                    f"have equal lengths")
+            gt_by_label = {}
+            for lab, box, d in zip(labels, boxes, diff):
+                gt_by_label.setdefault(int(lab), []).append((box, d))
+            for lab, items in gt_by_label.items():
+                count = (len(items) if self.evaluate_difficult
+                         else sum(1 for _, d in items if not d))
+                if count:
+                    self._pos_count[lab] = self._pos_count.get(lab, 0) + count
+
+            det = np.asarray(detections[i]).reshape(-1, 6)
+            det = det[det[:, 0] >= 0]  # drop NMS padding rows
+            det_by_label = {}
+            for row in det:
+                det_by_label.setdefault(int(row[0]), []).append(
+                    (float(row[1]), row[2:6]))
+            for lab, preds in det_by_label.items():
+                preds.sort(key=lambda p: -p[0])
+                gts = gt_by_label.get(lab)
+                if not gts:
+                    for score, _ in preds:
+                        self._tp.setdefault(lab, []).append((score, 0))
+                        self._fp.setdefault(lab, []).append((score, 1))
+                    continue
+                visited = [False] * len(gts)
+                for score, box in preds:
+                    box = np.clip(box, 0.0, 1.0)  # ClipBBox (:157)
+                    overlaps = [_iou_corner(box, g) for g, _ in gts]
+                    j = int(np.argmax(overlaps))
+                    if overlaps[j] > self.overlap_threshold:
+                        if self.evaluate_difficult or not gts[j][1]:
+                            hit = 0 if visited[j] else 1
+                            visited[j] = True
+                            self._tp.setdefault(lab, []).append((score, hit))
+                            self._fp.setdefault(lab, []).append(
+                                (score, 1 - hit))
+                    else:
+                        self._tp.setdefault(lab, []).append((score, 0))
+                        self._fp.setdefault(lab, []).append((score, 1))
+
+    def eval(self, executor=None, eval_program=None):
+        """→ mAP over classes with ground truth (detection_map_op.h:424)."""
+        total = 0.0
+        count = 0
+        for lab, num_pos in self._pos_count.items():
+            if lab == self.background_label:
+                continue
+            if lab not in self._tp:
+                count += 1
+                continue
+            pairs = sorted(zip(self._tp[lab], self._fp[lab]),
+                           key=lambda p: -p[0][0])
+            tp_sum = np.cumsum([t for (_, t), _ in pairs])
+            fp_sum = np.cumsum([f for _, (_, f) in pairs])
+            precision = tp_sum / np.maximum(tp_sum + fp_sum, 1e-12)
+            recall = tp_sum / num_pos
+            if self.ap_version == "11point":
+                ap = 0.0
+                for t in np.arange(0.0, 1.1, 0.1):
+                    mask = recall >= t - 1e-9
+                    ap += (precision[mask].max() if mask.any() else 0.0) / 11
+            else:  # integral
+                ap = 0.0
+                prev_r = 0.0
+                for p, r in zip(precision, recall):
+                    if abs(r - prev_r) > 1e-6:
+                        ap += p * abs(r - prev_r)
+                    prev_r = r
+            total += ap
+            count += 1
+        return total / count if count else 0.0

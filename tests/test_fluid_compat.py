@@ -329,6 +329,52 @@ class TestFluidMetrics:
         avg, err = m.eval()
         assert avg == 0.5 and err == 0.5
 
+    def test_detection_map_perfect_and_miss(self):
+        """One perfect detection + one total miss on two images →
+        AP(class 1) = 1, AP(class 2) = 0 → mAP 0.5 (both versions)."""
+        gt_boxes = [np.array([[0.1, 0.1, 0.5, 0.5]]),
+                    np.array([[0.2, 0.2, 0.6, 0.6]])]
+        gt_labels = [np.array([1]), np.array([2])]
+        det = [np.array([[1, 0.9, 0.1, 0.1, 0.5, 0.5]]),   # exact hit
+               np.array([[2, 0.8, 0.7, 0.7, 0.9, 0.9]])]   # no overlap
+        for version in ("integral", "11point"):
+            m = fluid.metrics.DetectionMAP(class_num=3, ap_version=version)
+            m.update(det, gt_labels, gt_boxes)
+            np.testing.assert_allclose(m.eval(), 0.5, atol=1e-6)
+
+    def test_detection_map_duplicate_counts_once(self):
+        """Two detections on one GT: the higher-scored is TP, the
+        duplicate is FP (visited-GT rule, detection_map_op.h:406-412)."""
+        gt_boxes = [np.array([[0.1, 0.1, 0.5, 0.5]])]
+        gt_labels = [np.array([1])]
+        det = [np.array([[1, 0.9, 0.1, 0.1, 0.5, 0.5],
+                         [1, 0.7, 0.12, 0.1, 0.5, 0.5]])]
+        m = fluid.metrics.DetectionMAP(class_num=2)
+        m.update(det, gt_labels, gt_boxes)
+        # precision at the TP point is 1.0, recall reaches 1.0 there
+        np.testing.assert_allclose(m.eval(), 1.0, atol=1e-6)
+
+    def test_detection_map_nms_padding_skipped(self):
+        gt_boxes = [np.array([[0.0, 0.0, 0.5, 0.5]])]
+        gt_labels = [np.array([1])]
+        det = [np.array([[1, 0.9, 0.0, 0.0, 0.5, 0.5],
+                         [-1, -1, -1, -1, -1, -1]])]  # multiclass_nms pad
+        m = fluid.metrics.DetectionMAP(class_num=2)
+        m.update(det, gt_labels, gt_boxes)
+        np.testing.assert_allclose(m.eval(), 1.0, atol=1e-6)
+
+    def test_detection_map_difficult_excluded(self):
+        gt_boxes = [np.array([[0.1, 0.1, 0.5, 0.5],
+                              [0.6, 0.6, 0.9, 0.9]])]
+        gt_labels = [np.array([1, 1])]
+        difficult = [np.array([0, 1])]
+        det = [np.array([[1, 0.9, 0.1, 0.1, 0.5, 0.5]])]
+        m = fluid.metrics.DetectionMAP(class_num=2,
+                                       evaluate_difficult=False)
+        m.update(det, gt_labels, gt_boxes, difficult=difficult)
+        # difficult GT excluded from the positive count → full recall
+        np.testing.assert_allclose(m.eval(), 1.0, atol=1e-6)
+
     def test_composite(self):
         c = fluid.metrics.CompositeMetric()
         c.add_metric(fluid.metrics.Precision())
