@@ -569,7 +569,7 @@ _STATIC_ONLY = {
     "adaptive_pool2d": "paddle.nn.functional.adaptive_avg_pool2d",
     "adaptive_pool3d": "paddle.nn.functional.adaptive_avg_pool3d",
     "center_loss": "a Layer holding the centers buffer + mse update",
-    "deformable_conv": "paddle.vision-style deform conv (not implemented)",
+    "deformable_conv": "paddle.nn.functional.deform_conv2d (explicit weight/offset/mask tensors; the 1.x builder created the params itself)",
     "lrn": "paddle.nn.LocalResponseNorm",
     "prroi_pool": "roi pooling family (not implemented)",
     "psroi_pool": "roi pooling family (not implemented)",

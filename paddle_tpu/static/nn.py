@@ -27,7 +27,7 @@ _EAGER = {
     "conv3d_transpose": "paddle.nn.Conv3DTranspose",
     "crf_decoding": "paddle.nn.functional.viterbi_decode (crf ops)",
     "data_norm": "paddle.nn.BatchNorm (data_norm was its PS-side twin)",
-    "deform_conv2d": "paddle.vision.ops (not yet implemented here)",
+    "deform_conv2d": "paddle.nn.functional.deform_conv2d / paddle.vision.ops.deform_conv2d",
     "group_norm": "paddle.nn.GroupNorm",
     "instance_norm": "paddle.nn.InstanceNorm2D",
     "layer_norm": "paddle.nn.LayerNorm",

@@ -8,6 +8,7 @@ from ..nn.functional.detection import (  # noqa: F401
     box_coder, nms, multiclass_nms, prior_box, roi_align, roi_pool,
     sigmoid_focal_loss, yolo_box,
 )
+from ..nn.functional.deform_conv import deform_conv2d  # noqa: F401
 
 __all__ = ["box_coder", "nms", "multiclass_nms", "prior_box", "roi_align",
-           "roi_pool", "sigmoid_focal_loss", "yolo_box"]
+           "roi_pool", "sigmoid_focal_loss", "yolo_box", "deform_conv2d"]

@@ -1,0 +1,129 @@
+"""Deformable convolution v1/v2 vs a numpy loop oracle transcribing
+modulated_deformable_im2col (operators/deformable_conv_op)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.framework.errors import InvalidArgumentError
+
+
+def _bilinear_np(img, y, x):
+    """Per-corner zero-padded bilinear (dmcn_im2col_bilinear)."""
+    C, H, W = img.shape
+    y0, x0 = int(np.floor(y)), int(np.floor(x))
+    ly, lx = y - y0, x - x0
+    out = np.zeros(C)
+    for dy, dx, w in ((0, 0, (1 - ly) * (1 - lx)), (0, 1, (1 - ly) * lx),
+                      (1, 0, ly * (1 - lx)), (1, 1, ly * lx)):
+        yc, xc = y0 + dy, x0 + dx
+        if 0 <= yc < H and 0 <= xc < W:
+            out += img[:, yc, xc] * w
+    return out
+
+
+def _deform_np(x, offset, weight, stride, padding, dilation, dg, mask):
+    N, Cin, H, W = x.shape
+    Cout, _, kh, kw = weight.shape
+    K = kh * kw
+    Ho, Wo = offset.shape[2], offset.shape[3]
+    off = offset.reshape(N, dg, K, 2, Ho, Wo)
+    rep = Cin // dg
+    out = np.zeros((N, Cout, Ho, Wo))
+    for n in range(N):
+        for ho in range(Ho):
+            for wo in range(Wo):
+                cols = np.zeros((Cin, K))
+                for k in range(K):
+                    i, j = divmod(k, kw)
+                    for g in range(dg):
+                        y = (ho * stride - padding + i * dilation
+                             + off[n, g, k, 0, ho, wo])
+                        xx = (wo * stride - padding + j * dilation
+                              + off[n, g, k, 1, ho, wo])
+                        v = _bilinear_np(x[n, g * rep:(g + 1) * rep], y, xx)
+                        if mask is not None:
+                            v = v * mask.reshape(
+                                N, dg, K, Ho, Wo)[n, g, k, ho, wo]
+                        cols[g * rep:(g + 1) * rep, k] = v
+                out[n, :, ho, wo] = np.einsum(
+                    "ck,ock->o", cols, weight.reshape(Cout, Cin, K))
+    return out
+
+
+class TestDeformConv2d:
+    def _inputs(self, N=1, Cin=4, H=6, W=6, Cout=3, k=3, dg=2,
+                with_mask=True):
+        rng = np.random.RandomState(0)
+        x = rng.randn(N, Cin, H, W).astype(np.float32)
+        Ho = Wo = H - k + 1  # stride 1, pad 0
+        offset = (rng.randn(N, 2 * dg * k * k, Ho, Wo) * 0.5).astype(
+            np.float32)
+        weight = rng.randn(Cout, Cin, k, k).astype(np.float32) * 0.2
+        mask = (rng.uniform(0.2, 1.0, (N, dg * k * k, Ho, Wo)).astype(
+            np.float32) if with_mask else None)
+        return x, offset, weight, mask
+
+    def test_v2_vs_oracle(self):
+        x, offset, weight, mask = self._inputs()
+        out = F.deform_conv2d(x, offset, weight, deformable_groups=2,
+                              mask=mask)
+        want = _deform_np(x, offset, weight, 1, 0, 1, 2, mask)
+        np.testing.assert_allclose(np.asarray(out), want, atol=1e-4)
+
+    def test_v1_no_mask(self):
+        x, offset, weight, _ = self._inputs(with_mask=False)
+        out = F.deform_conv2d(x, offset, weight, deformable_groups=2)
+        want = _deform_np(x, offset, weight, 1, 0, 1, 2, None)
+        np.testing.assert_allclose(np.asarray(out), want, atol=1e-4)
+
+    def test_zero_offsets_match_plain_conv(self):
+        """Zero offsets and unit mask reduce DCN to a standard conv."""
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        w = rng.randn(5, 3, 3, 3).astype(np.float32)
+        Ho = Wo = 6
+        offset = np.zeros((2, 2 * 9, Ho, Wo), np.float32)
+        out = F.deform_conv2d(x, offset, w, deformable_groups=1)
+        want = F.conv2d(jnp.asarray(x), jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   atol=1e-4)
+
+    def test_stride_padding_dilation(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(1, 2, 9, 9).astype(np.float32)
+        w = rng.randn(2, 2, 3, 3).astype(np.float32)
+        stride, pad, dil = 2, 1, 2
+        Ho = (9 + 2 * pad - dil * 2 - 1) // stride + 1
+        offset = (rng.randn(1, 18, Ho, Ho) * 0.3).astype(np.float32)
+        out = F.deform_conv2d(x, offset, w, stride=stride, padding=pad,
+                              dilation=dil)
+        want = _deform_np(x, offset, w, stride, pad, dil, 1, None)
+        np.testing.assert_allclose(np.asarray(out), want, atol=1e-4)
+
+    def test_grads_flow_to_offsets(self):
+        x, offset, weight, mask = self._inputs()
+        g_off = jax.grad(lambda o: jnp.sum(F.deform_conv2d(
+            x, o, weight, deformable_groups=2, mask=mask) ** 2))(
+            jnp.asarray(offset))
+        assert np.isfinite(np.asarray(g_off)).all()
+        assert float(jnp.abs(g_off).sum()) > 0
+
+    def test_groups_and_bias(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(1, 4, 5, 5).astype(np.float32)
+        w = rng.randn(6, 2, 3, 3).astype(np.float32)  # groups=2
+        offset = np.zeros((1, 18, 3, 3), np.float32)
+        bias = np.array([1.0, 0, 0, 0, 0, 0], np.float32)
+        out = F.deform_conv2d(x, offset, w, bias=bias, groups=2)
+        want = F.conv2d(jnp.asarray(x), jnp.asarray(w), groups=2)
+        np.testing.assert_allclose(
+            np.asarray(out)[:, 0], np.asarray(want)[:, 0] + 1.0, atol=1e-4)
+
+    def test_shape_validation(self):
+        x = np.zeros((1, 4, 5, 5), np.float32)
+        w = np.zeros((2, 4, 3, 3), np.float32)
+        with pytest.raises(InvalidArgumentError):
+            F.deform_conv2d(x, np.zeros((1, 7, 3, 3), np.float32), w)
