@@ -54,7 +54,8 @@ from paddle_tpu.nn.functional import (  # noqa: F401
     bipartite_match, target_assign, detection_output, box_coder,
     box_clip, multiclass_nms, sequence_mask, linear_chain_crf,
     crf_decoding, pixel_shuffle, unfold, temporal_shift,
-    roi_align, roi_pool, sigmoid_focal_loss, yolo_box,
+    roi_align, roi_pool, sigmoid_focal_loss, yolo_box, matrix_nms,
+    density_prior_box,
 )
 from paddle_tpu.nn import (  # noqa: F401
     BeamSearchDecoder, Decoder, dynamic_decode, RNNCellBase as RNNCell,
@@ -644,8 +645,6 @@ _STATIC_ONLY = {
     "SampleEmbeddingHelper": "sample from softmax inside a Decoder.step",
     "BasicDecoder": "subclass paddle.nn.Decoder",
     # detection long tail
-    "density_prior_box": "prior_box covers the SSD path; density variant "
-                         "not implemented",
     "multi_box_head": "compose conv heads + prior_box",
     "rpn_target_assign": "two-stage detectors not implemented",
     "retinanet_target_assign": "two-stage detectors not implemented",
@@ -657,7 +656,6 @@ _STATIC_ONLY = {
     "polygon_box_transform": "not implemented",
     "yolov3_loss": "YOLO family not implemented",
     "locality_aware_nms": "multiclass_nms covers the standard path",
-    "matrix_nms": "multiclass_nms covers the standard path",
     "retinanet_detection_output": "detection_output",
     "distribute_fpn_proposals": "two-stage detectors not implemented",
     "box_decoder_and_assign": "box_coder + target_assign",

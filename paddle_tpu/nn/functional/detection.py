@@ -27,7 +27,8 @@ __all__ = [
     "iou_similarity", "box_coder", "bipartite_match", "target_assign",
     "mine_hard_examples", "ssd_loss", "prior_box", "nms",
     "multiclass_nms", "detection_output", "box_clip", "roi_align",
-    "roi_pool", "sigmoid_focal_loss", "yolo_box",
+    "roi_pool", "sigmoid_focal_loss", "yolo_box", "matrix_nms",
+    "density_prior_box",
 ]
 
 _EPS = 1e-6
@@ -369,6 +370,133 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
 
     out, nums = jax.vmap(image)(bboxes, scores)
     return (out, nums) if return_num else out
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               name=None, return_rois_num=False):
+    """Matrix NMS (ref: fluid/layers/detection.py:3540 over
+    matrix_nms_op.cc NMSMatrix:100-166): instead of greedy suppression,
+    every candidate's score decays by ``min_j f(iou_ij, iou_max_j)``
+    over all higher-scored candidates j — gaussian
+    ``exp((max²-iou²)·σ)`` or linear ``(1-iou)/(1-max)``.
+
+    The whole computation is dense matrix algebra (no sequential loop),
+    which is exactly why it exists — it vectorizes perfectly on TPU.
+    bboxes ``[N, M, 4]``, scores ``[N, C, M]`` → dense ``[N, K, 6]``
+    rows (label, decayed_score, box), label=-1 padding, K=keep_top_k.
+    """
+    bboxes = jnp.asarray(bboxes)
+    scores = jnp.asarray(scores)
+    N, M = bboxes.shape[0], bboxes.shape[1]
+    C = scores.shape[1]
+    if 0 <= background_label < C:
+        fg = [c for c in range(C) if c != background_label]
+        fg_labels = jnp.asarray(fg, jnp.int32)
+        scores = scores[:, fg_labels, :]
+        Cf = C - 1
+    else:
+        fg_labels = jnp.arange(C, dtype=jnp.int32)
+        Cf = C
+    k = M if nms_top_k is None or nms_top_k < 0 else min(int(nms_top_k), M)
+    K = Cf * k if keep_top_k is None or keep_top_k < 0 else min(
+        int(keep_top_k), Cf * k)
+    idx = jnp.arange(k)
+    strict_lower = idx[:, None] > idx[None, :]  # j < i
+
+    def one_class(boxes, s):  # [M, 4], [M]
+        s = jnp.where(s > score_threshold, s, -jnp.inf)
+        top_s, order = jax.lax.top_k(s, k)
+        iou = iou_similarity(boxes[order], boxes[order], normalized)
+        iou_l = jnp.where(strict_lower, iou, 0.0)
+        iou_max = jnp.max(iou_l, axis=1)  # max over j<i (0 for i=0)
+        if use_gaussian:
+            decay_m = jnp.exp((iou_max[None, :] ** 2 - iou_l ** 2)
+                              * gaussian_sigma)
+        else:  # eps keeps a duplicate box (max_iou→1) from NaN-poisoning
+            decay_m = (1.0 - iou_l) / jnp.maximum(
+                1.0 - iou_max[None, :], _EPS)
+        decay = jnp.min(jnp.where(strict_lower, decay_m, 1.0), axis=1)
+        ds = decay * top_s
+        ds = jnp.where(jnp.isfinite(top_s) & (ds > post_threshold),
+                       ds, -jnp.inf)
+        return ds, order
+
+    def image(boxes, sc):  # [M, 4], [Cf, M]
+        ds, order = jax.vmap(lambda s1: one_class(boxes, s1))(sc)
+        flat = ds.reshape(-1)
+        top_s, top_i = jax.lax.top_k(flat, K)
+        cls = top_i // k
+        box = boxes[order.reshape(-1)[top_i]]
+        valid = jnp.isfinite(top_s)
+        row = jnp.concatenate(
+            [fg_labels[cls].astype(bboxes.dtype)[:, None],
+             top_s[:, None], box], axis=-1)
+        return (jnp.where(valid[:, None], row, -1.0),
+                valid.sum().astype(jnp.int32))
+
+    out, nums = jax.vmap(image)(bboxes, scores)
+    rets = (out,)
+    if return_index:
+        rets += (None,)  # reference Index is a ragged LoD; dense rows
+        #                  carry label+score directly, counts via rois_num
+    if return_rois_num:
+        rets += (nums,)
+    return rets[0] if len(rets) == 1 else rets
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    """Density prior boxes (ref: operators/detection/
+    density_prior_box_op.h:70-115): per feature-map cell, each
+    (fixed_size, density) pair lays a density×density sub-grid of
+    centers shifted by ``step_average/density``, one box per
+    fixed_ratio, clipped to [0, 1]."""
+    H, W = input.shape[2], input.shape[3]
+    IH, IW = image.shape[2], image.shape[3]
+    densities = [int(d) for d in (densities or [])]
+    fixed_sizes = [float(s) for s in (fixed_sizes or [])]
+    fixed_ratios = [float(r) for r in (fixed_ratios or [])]
+    if len(densities) != len(fixed_sizes):
+        raise InvalidArgumentError(
+            "densities and fixed_sizes must pair up")
+    step_w = float(steps[0]) or IW / W
+    step_h = float(steps[1]) or IH / H
+    step_avg = int((step_w + step_h) * 0.5)
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w  # [W]
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h  # [H]
+
+    rows = []
+    for size, density in zip(fixed_sizes, densities):
+        shift = step_avg // density
+        for ratio in fixed_ratios:
+            bw = size * ratio ** 0.5
+            bh = size / ratio ** 0.5
+            for di in range(density):
+                for dj in range(density):
+                    dx = -step_avg / 2.0 + shift / 2.0 + dj * shift
+                    dy = -step_avg / 2.0 + shift / 2.0 + di * shift
+                    rows.append((dx, dy, bw, bh))
+    K = len(rows)
+    d = jnp.asarray(rows, jnp.float32)  # [K, 4] (dx, dy, w, h)
+    ctr_x = jnp.broadcast_to(cx[None, :, None] + d[:, 0], (H, W, K))
+    ctr_y = jnp.broadcast_to(cy[:, None, None] + d[:, 1], (H, W, K))
+    boxes = jnp.stack([
+        jnp.maximum((ctr_x - d[:, 2] / 2) / IW, 0.0),
+        jnp.maximum((ctr_y - d[:, 3] / 2) / IH, 0.0),
+        jnp.minimum((ctr_x + d[:, 2] / 2) / IW, 1.0),
+        jnp.minimum((ctr_y + d[:, 3] / 2) / IH, 1.0),
+    ], axis=-1)  # [H, W, K, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
+    if flatten_to_2d:
+        return boxes.reshape(-1, 4), var.reshape(-1, 4)
+    return boxes, var
 
 
 def detection_output(loc, scores, prior_box, prior_box_var,

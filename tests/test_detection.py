@@ -351,6 +351,125 @@ class TestDetectionOutput:
         assert (rows[:, 0] == 1).all() and (rows[:, 0] != 0).all()
 
 
+def _matrix_nms_np(boxes, scores, score_thr, post_thr, top_k, gaussian,
+                   sigma):
+    """Transcribes NMSMatrix (matrix_nms_op.cc:100-166), one class."""
+    order = [i for i in np.argsort(-scores, kind="stable")
+             if scores[i] > score_thr]
+    if top_k > -1:
+        order = order[:top_k]
+    if not order:
+        return [], []
+    n = len(order)
+    iou = _iou_np(boxes[order], boxes[order])
+    iou_max = np.zeros(n)
+    for i in range(1, n):
+        iou_max[i] = iou[i, :i].max()
+    sel, ds_out = [], []
+    if scores[order[0]] > post_thr:
+        sel.append(order[0])
+        ds_out.append(scores[order[0]])
+    for i in range(1, n):
+        decay = 1.0
+        for j in range(i):
+            if gaussian:
+                d = np.exp((iou_max[j] ** 2 - iou[i, j] ** 2) * sigma)
+            else:
+                d = (1 - iou[i, j]) / (1 - iou_max[j])
+            decay = min(decay, d)
+        ds = decay * scores[order[i]]
+        if ds > post_thr:
+            sel.append(order[i])
+            ds_out.append(ds)
+    return sel, ds_out
+
+
+class TestMatrixNms:
+    @pytest.mark.parametrize("gaussian", [False, True])
+    def test_vs_oracle_single_class(self, gaussian):
+        rng = np.random.RandomState(0)
+        mins = rng.uniform(0, 0.6, (10, 2))
+        boxes = np.concatenate([mins, mins + rng.uniform(0.1, 0.4, (10, 2))],
+                               -1).astype(np.float32)
+        scores = rng.uniform(0, 1, (1, 2, 10)).astype(np.float32)
+        scores[0, 0] = 0.0  # background row (excluded)
+        out, nums = F.matrix_nms(boxes[None], scores, score_threshold=0.2,
+                                 post_threshold=0.1, nms_top_k=8,
+                                 keep_top_k=8, use_gaussian=gaussian,
+                                 background_label=0, return_rois_num=True)
+        sel, ds = _matrix_nms_np(boxes, scores[0, 1], 0.2, 0.1, 8,
+                                 gaussian, 2.0)
+        n = int(np.asarray(nums)[0])
+        assert n == len(sel)
+        got = np.asarray(out)[0, :n]
+        np.testing.assert_allclose(np.sort(got[:, 1])[::-1],
+                                   np.sort(ds)[::-1], atol=1e-5)
+        for row in got:
+            assert row[0] == 1
+            assert any(np.allclose(row[2:], boxes[s], atol=1e-5)
+                       for s in sel)
+
+    def test_decays_overlapping(self):
+        """A near-duplicate of a higher-scored box is heavily decayed."""
+        boxes = np.array([[0, 0, 1, 1], [0.01, 0, 1, 1],
+                          [2, 2, 3, 3]], np.float32)[None]
+        scores = np.array([[[0.9, 0.85, 0.8]]], np.float32)  # one class
+        out = F.matrix_nms(boxes, scores, score_threshold=0.0,
+                           post_threshold=0.0, nms_top_k=-1, keep_top_k=3,
+                           background_label=-1)
+        o = np.asarray(out)[0]
+        by_box = {tuple(round(float(v), 2) for v in r[2:]): r[1]
+                  for r in o if r[0] >= 0}
+        assert by_box[(0.0, 0.0, 1.0, 1.0)] > 0.89
+        assert by_box[(2.0, 2.0, 3.0, 3.0)] > 0.79  # disjoint: no decay
+        assert by_box[(0.01, 0.0, 1.0, 1.0)] < 0.1  # near-dup: crushed
+
+    def test_jit(self):
+        boxes = jnp.asarray(np.sort(np.random.RandomState(1).rand(1, 6, 4),
+                                    -1), jnp.float32)
+        scores = jnp.asarray(np.random.RandomState(2).rand(1, 3, 6),
+                             jnp.float32)
+        f = jax.jit(lambda b, s: F.matrix_nms(
+            b, s, 0.1, 0.05, nms_top_k=6, keep_top_k=4))
+        assert f(boxes, scores).shape == (1, 4, 6)
+
+
+class TestDensityPriorBox:
+    def test_shapes_and_counts(self):
+        feat = jnp.zeros((1, 8, 4, 4))
+        img = jnp.zeros((1, 3, 32, 32))
+        boxes, var = F.density_prior_box(
+            feat, img, densities=[2, 1], fixed_sizes=[4.0, 8.0],
+            fixed_ratios=[1.0, 2.0], clip=True)
+        # K = Σ ratios·density² = 2·4 + 2·1 = 10
+        assert boxes.shape == (4, 4, 10, 4)
+        assert var.shape == boxes.shape
+        b = np.asarray(boxes)
+        assert (b >= 0).all() and (b <= 1).all()
+
+    def test_density_grid_centers(self):
+        """density=2 lays a 2x2 sub-grid shifted by step_average/2
+        (density_prior_box_op.h:91-101)."""
+        feat = jnp.zeros((1, 1, 1, 1))
+        img = jnp.zeros((1, 3, 8, 8))
+        boxes, _ = F.density_prior_box(
+            feat, img, densities=[2], fixed_sizes=[2.0], fixed_ratios=[1.0])
+        b = np.asarray(boxes)[0, 0]  # [4, 4]
+        centers = ((b[:, :2] + b[:, 2:]) / 2) * 8
+        # cell center (4,4), step_avg 8, shift 4 → centers at 2 and 6
+        want = {(2.0, 2.0), (6.0, 2.0), (2.0, 6.0), (6.0, 6.0)}
+        got = {tuple(np.round(c, 4)) for c in centers}
+        assert got == want
+
+    def test_flatten_to_2d(self):
+        feat = jnp.zeros((1, 1, 2, 3))
+        img = jnp.zeros((1, 3, 16, 16))
+        boxes, var = F.density_prior_box(
+            feat, img, densities=[1], fixed_sizes=[4.0], fixed_ratios=[1.0],
+            flatten_to_2d=True)
+        assert boxes.shape == (6, 4) and var.shape == (6, 4)
+
+
 class TestBoxClip:
     def test_clips_to_image(self):
         boxes = np.array([[[-5.0, -2.0, 50.0, 60.0],
