@@ -194,16 +194,16 @@ class CompositeMetric(MetricBase):
         return [m.eval() for m in self._metrics]
 
 
-def _iou_corner(a, b):
-    """JaccardOverlap (detection_map_op.h:136) — zero for disjoint."""
-    if b[0] > a[2] or b[2] < a[0] or b[1] > a[3] or b[3] < a[1]:
-        return 0.0
-    iw = min(a[2], b[2]) - max(a[0], b[0])
-    ih = min(a[3], b[3]) - max(a[1], b[1])
+def _iou_one_to_many(a, bs):
+    """JaccardOverlap (detection_map_op.h:136) of one box against [G, 4]
+    — vectorized for the per-prediction matching loop."""
+    iw = np.minimum(a[2], bs[:, 2]) - np.maximum(a[0], bs[:, 0])
+    ih = np.minimum(a[3], bs[:, 3]) - np.maximum(a[1], bs[:, 1])
+    disjoint = (iw < 0) | (ih < 0)
     inter = iw * ih
     ua = ((a[2] - a[0]) * (a[3] - a[1])
-          + (b[2] - b[0]) * (b[3] - b[1]) - inter)
-    return inter / ua if ua > 0 else 0.0
+          + (bs[:, 2] - bs[:, 0]) * (bs[:, 3] - bs[:, 1]) - inter)
+    return np.where(disjoint | (ua <= 0), 0.0, inter / np.maximum(ua, 1e-12))
 
 
 class DetectionMAP:
@@ -283,9 +283,10 @@ class DetectionMAP:
                         self._fp.setdefault(lab, []).append((score, 1))
                     continue
                 visited = [False] * len(gts)
+                gt_arr = np.stack([g for g, _ in gts])
                 for score, box in preds:
                     box = np.clip(box, 0.0, 1.0)  # ClipBBox (:157)
-                    overlaps = [_iou_corner(box, g) for g, _ in gts]
+                    overlaps = _iou_one_to_many(box, gt_arr)
                     j = int(np.argmax(overlaps))
                     if overlaps[j] > self.overlap_threshold:
                         if self.evaluate_difficult or not gts[j][1]:

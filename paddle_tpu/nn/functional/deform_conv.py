@@ -77,6 +77,15 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     if offset.shape[1] != 2 * dg * K:
         raise InvalidArgumentError(
             f"offset channels {offset.shape[1]} != 2·dg·K = {2 * dg * K}")
+    want_ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    want_wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    if (Ho, Wo) != (want_ho, want_wo):
+        raise InvalidArgumentError(
+            f"offset spatial dims {(Ho, Wo)} don't match the conv output "
+            f"{(want_ho, want_wo)} for input {(H, W)}, kernel "
+            f"{(kh, kw)}, stride {(sh, sw)}, padding {(ph, pw)}, "
+            f"dilation {(dh, dw)} — the offset head must run at the "
+            f"output resolution")
 
     # regular tap positions: [K] each for h and w, plus output grid
     ki, kj = jnp.meshgrid(jnp.arange(kh), jnp.arange(kw), indexing="ij")

@@ -428,19 +428,20 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
         flat = ds.reshape(-1)
         top_s, top_i = jax.lax.top_k(flat, K)
         cls = top_i // k
-        box = boxes[order.reshape(-1)[top_i]]
+        box_idx = order.reshape(-1)[top_i]
+        box = boxes[box_idx]
         valid = jnp.isfinite(top_s)
         row = jnp.concatenate(
             [fg_labels[cls].astype(bboxes.dtype)[:, None],
              top_s[:, None], box], axis=-1)
         return (jnp.where(valid[:, None], row, -1.0),
+                jnp.where(valid, box_idx.astype(jnp.int32), -1),
                 valid.sum().astype(jnp.int32))
 
-    out, nums = jax.vmap(image)(bboxes, scores)
+    out, index, nums = jax.vmap(image)(bboxes, scores)
     rets = (out,)
     if return_index:
-        rets += (None,)  # reference Index is a ragged LoD; dense rows
-        #                  carry label+score directly, counts via rois_num
+        rets += (index,)  # [N, K] box index per kept row, -1 padding
     if return_rois_num:
         rets += (nums,)
     return rets[0] if len(rets) == 1 else rets
@@ -460,6 +461,10 @@ def density_prior_box(input, image, densities=None, fixed_sizes=None,
     densities = [int(d) for d in (densities or [])]
     fixed_sizes = [float(s) for s in (fixed_sizes or [])]
     fixed_ratios = [float(r) for r in (fixed_ratios or [])]
+    if not densities or not fixed_sizes or not fixed_ratios:
+        raise InvalidArgumentError(
+            "density_prior_box needs non-empty densities, fixed_sizes "
+            "and fixed_ratios (the reference op requires all three)")
     if len(densities) != len(fixed_sizes):
         raise InvalidArgumentError(
             "densities and fixed_sizes must pair up")

@@ -127,3 +127,7 @@ class TestDeformConv2d:
         w = np.zeros((2, 4, 3, 3), np.float32)
         with pytest.raises(InvalidArgumentError):
             F.deform_conv2d(x, np.zeros((1, 7, 3, 3), np.float32), w)
+        # offset at the wrong spatial resolution must be rejected
+        with pytest.raises(InvalidArgumentError) as ei:
+            F.deform_conv2d(x, np.zeros((1, 18, 5, 5), np.float32), w)
+        assert "output resolution" in str(ei.value)

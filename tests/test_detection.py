@@ -433,6 +433,16 @@ class TestMatrixNms:
             b, s, 0.1, 0.05, nms_top_k=6, keep_top_k=4))
         assert f(boxes, scores).shape == (1, 4, 6)
 
+    def test_return_index_points_at_boxes(self):
+        boxes = np.array([[[0, 0, 1, 1], [2, 2, 3, 3]]], np.float32)
+        scores = np.array([[[0.2, 0.9]]], np.float32)
+        out, index = F.matrix_nms(boxes, scores, 0.0, 0.0, nms_top_k=-1,
+                                  keep_top_k=2, background_label=-1,
+                                  return_index=True)
+        o, ix = np.asarray(out), np.asarray(index)
+        assert ix[0, 0] == 1  # highest score is box 1
+        np.testing.assert_allclose(o[0, 0, 2:], boxes[0, ix[0, 0]])
+
 
 class TestDensityPriorBox:
     def test_shapes_and_counts(self):
