@@ -54,8 +54,8 @@ from paddle_tpu.nn.functional import (  # noqa: F401
     bipartite_match, target_assign, detection_output, box_coder,
     box_clip, multiclass_nms, sequence_mask, linear_chain_crf,
     crf_decoding, pixel_shuffle, unfold, temporal_shift,
-    roi_align, roi_pool, sigmoid_focal_loss, yolo_box, matrix_nms,
-    density_prior_box,
+    roi_align, roi_pool, sigmoid_focal_loss, yolo_box, yolov3_loss,
+    matrix_nms, density_prior_box,
 )
 from paddle_tpu.nn import (  # noqa: F401
     BeamSearchDecoder, Decoder, dynamic_decode, RNNCellBase as RNNCell,
@@ -654,7 +654,6 @@ _STATIC_ONLY = {
     "generate_proposals": "two-stage detectors not implemented",
     "generate_mask_labels": "two-stage detectors not implemented",
     "polygon_box_transform": "not implemented",
-    "yolov3_loss": "YOLO family not implemented",
     "locality_aware_nms": "multiclass_nms covers the standard path",
     "retinanet_detection_output": "detection_output",
     "distribute_fpn_proposals": "two-stage detectors not implemented",

@@ -27,8 +27,8 @@ __all__ = [
     "iou_similarity", "box_coder", "bipartite_match", "target_assign",
     "mine_hard_examples", "ssd_loss", "prior_box", "nms",
     "multiclass_nms", "detection_output", "box_clip", "roi_align",
-    "roi_pool", "sigmoid_focal_loss", "yolo_box", "matrix_nms",
-    "density_prior_box",
+    "roi_pool", "sigmoid_focal_loss", "yolo_box", "yolov3_loss",
+    "matrix_nms", "density_prior_box",
 ]
 
 _EPS = 1e-6
@@ -370,6 +370,139 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
 
     out, nums = jax.vmap(image)(bboxes, scores)
     return (out, nums) if return_num else out
+
+
+def _sce(x, t):
+    """Stable sigmoid cross-entropy (yolov3_loss_op.h SigmoidCrossEntropy)."""
+    return jnp.maximum(x, 0.0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+def _center_iou(x1, y1, w1, h1, x2, y2, w2, h2):
+    """IoU of center-format boxes (yolov3_loss_op.h CalcBoxIoU)."""
+    ow = jnp.minimum(x1 + w1 / 2, x2 + w2 / 2) - jnp.maximum(
+        x1 - w1 / 2, x2 - w2 / 2)
+    oh = jnp.minimum(y1 + h1 / 2, y2 + h2 / 2) - jnp.maximum(
+        y1 - h1 / 2, y2 - h2 / 2)
+    inter = jnp.where((ow < 0) | (oh < 0), 0.0, ow * oh)
+    union = w1 * h1 + w2 * h2 - inter
+    return inter / jnp.maximum(union, _EPS)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss for one detection scale (ref:
+    fluid/layers/detection.py:1019 over yolov3_loss_op.h:240-320).
+
+    x ``[N, A·(5+cls), H, W]`` (A = len(anchor_mask)), gt_box
+    ``[N, B, 4]`` center-format (cx, cy, w, h) normalized to the image
+    (rows with w/h ≤ 0 are padding), gt_label ``[N, B]``, gt_score
+    ``[N, B]`` mixup weights (None → 1) → per-image loss ``[N]``.
+
+    Semantics kept from the kernel: predictions whose best IoU with any
+    GT exceeds ``ignore_thresh`` drop out of the objectness loss; each
+    GT matches the best whole-image anchor by wh-IoU and trains
+    location (sigmoid-CE x/y + L1 w/h, scaled by ``(2-w·h)·score``),
+    class (per-class sigmoid-CE with optional label smoothing) and
+    objectness only when that anchor belongs to this scale's
+    ``anchor_mask``.
+    """
+    x = jnp.asarray(x)
+    gt_box = jnp.asarray(gt_box, x.dtype)
+    gt_label = jnp.asarray(gt_label).astype(jnp.int32)
+    N, _, H, W = x.shape
+    A = len(anchor_mask)
+    B = gt_box.shape[1]
+    an_num = len(anchors) // 2
+    anc = jnp.asarray(anchors, x.dtype).reshape(an_num, 2)
+    mask = jnp.asarray(anchor_mask, jnp.int32)
+    in_size = downsample_ratio * H
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+    score = (jnp.asarray(gt_score, x.dtype) if gt_score is not None
+             else jnp.ones((N, B), x.dtype))
+    if use_label_smooth:
+        delta = min(1.0 / class_num, 1.0 / 40)
+        pos, neg = 1.0 - delta, delta
+    else:
+        pos, neg = 1.0, 0.0
+
+    t = x.reshape(N, A, 5 + class_num, H, W)
+    valid = (gt_box[..., 2] > 0) & (gt_box[..., 3] > 0)  # [N, B]
+
+    # -- ignore mask: best pred-vs-gt IoU per cell ---------------------
+    grid_x = jnp.arange(W, dtype=x.dtype)
+    grid_y = jnp.arange(H, dtype=x.dtype).reshape(-1, 1)
+    px = (grid_x + jax.nn.sigmoid(t[:, :, 0]) * scale + bias) / W
+    py = (grid_y + jax.nn.sigmoid(t[:, :, 1]) * scale + bias) / H
+    pw = jnp.exp(t[:, :, 2]) * anc[mask, 0].reshape(1, A, 1, 1) / in_size
+    ph = jnp.exp(t[:, :, 3]) * anc[mask, 1].reshape(1, A, 1, 1) / in_size
+    gx = gt_box[..., 0].reshape(N, 1, 1, 1, B)
+    gy = gt_box[..., 1].reshape(N, 1, 1, 1, B)
+    gw = gt_box[..., 2].reshape(N, 1, 1, 1, B)
+    gh = gt_box[..., 3].reshape(N, 1, 1, 1, B)
+    ious = _center_iou(px[..., None], py[..., None], pw[..., None],
+                       ph[..., None], gx, gy, gw, gh)  # [N, A, H, W, B]
+    ious = jnp.where(valid.reshape(N, 1, 1, 1, B), ious, 0.0)
+    best_iou = jnp.max(ious, axis=-1)
+    ignored = best_iou > ignore_thresh  # [N, A, H, W]
+
+    # -- per-GT best anchor over the whole anchor set ------------------
+    wh_iou = _center_iou(
+        jnp.zeros(()), jnp.zeros(()),
+        (anc[:, 0] / in_size).reshape(1, 1, an_num),
+        (anc[:, 1] / in_size).reshape(1, 1, an_num),
+        jnp.zeros(()), jnp.zeros(()),
+        gt_box[..., 2:3], gt_box[..., 3:4])  # [N, B, an_num]
+    best_n = jnp.argmax(wh_iou, axis=-1)  # [N, B]
+    # anchor index → slot in this scale's mask, or -1
+    mask_pos = jnp.full((an_num,), -1, jnp.int32).at[mask].set(
+        jnp.arange(A, dtype=jnp.int32))
+    mask_idx = mask_pos[best_n]  # [N, B]
+    matched = valid & (mask_idx >= 0) & (gt_label >= 0)
+
+    gi = (gt_box[..., 0] * W).astype(jnp.int32).clip(0, W - 1)
+    gj = (gt_box[..., 1] * H).astype(jnp.int32).clip(0, H - 1)
+    n_ix = jnp.broadcast_to(jnp.arange(N)[:, None], (N, B))
+    a_ix = jnp.maximum(mask_idx, 0)
+    pred_at = t[n_ix, a_ix, :, gj, gi]  # [N, B, 5+cls]
+
+    tx = gt_box[..., 0] * W - gi
+    ty = gt_box[..., 1] * H - gj
+    anc_w = anc[best_n, 0]
+    anc_h = anc[best_n, 1]
+    tw = jnp.log(jnp.maximum(gt_box[..., 2] * in_size / anc_w, _EPS))
+    th = jnp.log(jnp.maximum(gt_box[..., 3] * in_size / anc_h, _EPS))
+    loc_scale = (2.0 - gt_box[..., 2] * gt_box[..., 3]) * score
+    loc = (_sce(pred_at[..., 0], tx) + _sce(pred_at[..., 1], ty)
+           + jnp.abs(pred_at[..., 2] - tw)
+           + jnp.abs(pred_at[..., 3] - th)) * loc_scale
+    cls_t = jnp.where(
+        jnp.arange(class_num)[None, None, :] == gt_label[..., None],
+        pos, neg)
+    cls = jnp.sum(_sce(pred_at[..., 5:], cls_t), axis=-1) * score
+    per_gt = jnp.where(matched, loc + cls, 0.0)
+    loss = jnp.sum(per_gt, axis=1)  # [N]
+
+    # -- objectness mask: 0 neg, -1 ignored, score at matched cells ----
+    obj = jnp.where(ignored, -1.0, 0.0)
+    flat = obj.reshape(N, A * H * W)
+    cell = a_ix * (H * W) + gj * W + gi  # [N, B]
+    cell = jnp.where(matched, cell, A * H * W)  # drop unmatched
+    # kernel writes GTs in order t = 0..B-1, last write wins — scatter
+    # .at[].set applies updates in index order per buffer, so write
+    # sequentially to keep the tie semantics deterministic
+    def write(i, f):  # unmatched rows carry an OOB cell → dropped
+        return f.at[n_ix[:, i], cell[:, i]].set(score[:, i], mode="drop")
+
+    flat = jax.lax.fori_loop(0, B, write, flat)
+    obj = flat.reshape(N, A, H, W)
+    pobj = t[:, :, 4]
+    obj_loss = jnp.where(
+        obj > 1e-5, _sce(pobj, 1.0) * obj,
+        jnp.where(obj > -0.5, _sce(pobj, 0.0), 0.0))
+    loss = loss + jnp.sum(obj_loss, axis=(1, 2, 3))
+    return loss
 
 
 def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,

@@ -657,6 +657,129 @@ class TestYoloBox:
         assert b[0] >= 0 and b[2] <= 31.0
 
 
+def _yolov3_loss_np(x, gt_box, gt_label, anchors, anchor_mask, C,
+                    ignore_thresh, downsample, gt_score, label_smooth):
+    """Transcribes Yolov3LossKernel::Compute (yolov3_loss_op.h:255-320)."""
+    def sce(v, t):
+        return max(v, 0) - v * t + np.log1p(np.exp(-abs(v)))
+
+    def iou_c(b1, b2):
+        ow = min(b1[0] + b1[2] / 2, b2[0] + b2[2] / 2) - max(
+            b1[0] - b1[2] / 2, b2[0] - b2[2] / 2)
+        oh = min(b1[1] + b1[3] / 2, b2[1] + b2[3] / 2) - max(
+            b1[1] - b1[3] / 2, b2[1] - b2[3] / 2)
+        inter = 0.0 if ow < 0 or oh < 0 else ow * oh
+        return inter / (b1[2] * b1[3] + b2[2] * b2[3] - inter)
+
+    N, _, H, W = x.shape
+    A = len(anchor_mask)
+    B = gt_box.shape[1]
+    an_num = len(anchors) // 2
+    in_size = downsample * H
+    t = x.reshape(N, A, 5 + C, H, W)
+    if label_smooth:
+        d = min(1.0 / C, 1.0 / 40)
+        pos, neg = 1 - d, d
+    else:
+        pos, neg = 1.0, 0.0
+    loss = np.zeros(N)
+    for n in range(N):
+        obj = np.zeros((A, H, W))
+        for a in range(A):
+            for j in range(H):
+                for i in range(W):
+                    px = (i + 1 / (1 + np.exp(-t[n, a, 0, j, i]))) / W
+                    py = (j + 1 / (1 + np.exp(-t[n, a, 1, j, i]))) / H
+                    pw = (np.exp(t[n, a, 2, j, i])
+                          * anchors[2 * anchor_mask[a]] / in_size)
+                    ph = (np.exp(t[n, a, 3, j, i])
+                          * anchors[2 * anchor_mask[a] + 1] / in_size)
+                    best = 0.0
+                    for b in range(B):
+                        if gt_box[n, b, 2] <= 0 or gt_box[n, b, 3] <= 0:
+                            continue
+                        best = max(best, iou_c((px, py, pw, ph),
+                                               gt_box[n, b]))
+                    if best > ignore_thresh:
+                        obj[a, j, i] = -1
+        for b in range(B):
+            if gt_box[n, b, 2] <= 0 or gt_box[n, b, 3] <= 0:
+                continue
+            gx, gy, gw, gh = gt_box[n, b]
+            gi, gj = int(gx * W), int(gy * H)
+            best_iou, best_n = 0.0, 0
+            for an in range(an_num):
+                iou = iou_c((0, 0, anchors[2 * an] / in_size,
+                             anchors[2 * an + 1] / in_size),
+                            (0, 0, gw, gh))
+                if iou > best_iou:
+                    best_iou, best_n = iou, an
+            if best_n not in anchor_mask:
+                continue
+            a = anchor_mask.index(best_n)
+            s = gt_score[n, b]
+            tx, ty = gx * W - gi, gy * H - gj
+            tw = np.log(gw * in_size / anchors[2 * best_n])
+            th = np.log(gh * in_size / anchors[2 * best_n + 1])
+            sc = (2.0 - gw * gh) * s
+            loss[n] += (sce(t[n, a, 0, gj, gi], tx)
+                        + sce(t[n, a, 1, gj, gi], ty)
+                        + abs(t[n, a, 2, gj, gi] - tw)
+                        + abs(t[n, a, 3, gj, gi] - th)) * sc
+            obj[a, gj, gi] = s
+            for c in range(C):
+                tgt = pos if c == gt_label[n, b] else neg
+                loss[n] += sce(t[n, a, 5 + c, gj, gi], tgt) * s
+        for a in range(A):
+            for j in range(H):
+                for i in range(W):
+                    o = obj[a, j, i]
+                    if o > 1e-5:
+                        loss[n] += sce(t[n, a, 4, j, i], 1.0) * o
+                    elif o > -0.5:
+                        loss[n] += sce(t[n, a, 4, j, i], 0.0)
+    return loss
+
+
+class TestYolov3Loss:
+    def _inputs(self, N=2, H=4, W=4, C=3, B=3):
+        rng = np.random.RandomState(0)
+        anchors = [10, 13, 16, 30, 33, 23, 30, 61]
+        anchor_mask = [1, 2]
+        A = len(anchor_mask)
+        x = (rng.randn(N, A * (5 + C), H, W) * 0.5).astype(np.float32)
+        gt = rng.uniform(0.2, 0.8, (N, B, 4)).astype(np.float32)
+        gt[:, :, 2:] = rng.uniform(0.05, 0.4, (N, B, 2))
+        gt[1, 2] = 0.0  # padding row must be inert
+        lab = rng.randint(0, C, (N, B)).astype(np.int32)
+        score = rng.uniform(0.5, 1.0, (N, B)).astype(np.float32)
+        return x, gt, lab, anchors, anchor_mask, C, score
+
+    @pytest.mark.parametrize("smooth", [True, False])
+    def test_vs_oracle(self, smooth):
+        x, gt, lab, anchors, mask, C, score = self._inputs()
+        out = F.yolov3_loss(x, gt, lab, anchors, mask, C,
+                            ignore_thresh=0.5, downsample_ratio=32,
+                            gt_score=score, use_label_smooth=smooth)
+        want = _yolov3_loss_np(x, gt, lab, anchors, mask, C, 0.5, 32,
+                               score, smooth)
+        np.testing.assert_allclose(np.asarray(out), want, rtol=2e-4)
+
+    def test_default_score_and_grad(self):
+        x, gt, lab, anchors, mask, C, _ = self._inputs()
+        g = jax.grad(lambda t: jnp.sum(F.yolov3_loss(
+            t, gt, lab, anchors, mask, C, 0.5, 32)))(jnp.asarray(x))
+        assert np.isfinite(np.asarray(g)).all()
+        assert float(jnp.abs(g).sum()) > 0
+
+    def test_jit(self):
+        x, gt, lab, anchors, mask, C, score = self._inputs()
+        f = jax.jit(lambda x, gt, lab, score: F.yolov3_loss(
+            x, gt, lab, anchors, mask, C, 0.5, 32, gt_score=score))
+        out = f(x, gt, lab, score)
+        assert out.shape == (2,) and np.isfinite(np.asarray(out)).all()
+
+
 class TestPriorBox:
     def test_shapes_and_ranges(self):
         feat = jnp.zeros((1, 8, 4, 6))
