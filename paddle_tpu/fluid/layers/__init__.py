@@ -55,7 +55,7 @@ from paddle_tpu.nn.functional import (  # noqa: F401
     box_clip, multiclass_nms, sequence_mask, linear_chain_crf,
     crf_decoding, pixel_shuffle, unfold, temporal_shift,
     roi_align, roi_pool, sigmoid_focal_loss, yolo_box, yolov3_loss,
-    matrix_nms, density_prior_box,
+    matrix_nms, density_prior_box, anchor_generator, generate_proposals,
 )
 from paddle_tpu.nn import (  # noqa: F401
     BeamSearchDecoder, Decoder, dynamic_decode, RNNCellBase as RNNCell,
@@ -648,10 +648,8 @@ _STATIC_ONLY = {
     "multi_box_head": "compose conv heads + prior_box",
     "rpn_target_assign": "two-stage detectors not implemented",
     "retinanet_target_assign": "two-stage detectors not implemented",
-    "anchor_generator": "prior_box",
     "roi_perspective_transform": "not implemented",
     "generate_proposal_labels": "two-stage detectors not implemented",
-    "generate_proposals": "two-stage detectors not implemented",
     "generate_mask_labels": "two-stage detectors not implemented",
     "polygon_box_transform": "not implemented",
     "locality_aware_nms": "multiclass_nms covers the standard path",

@@ -28,8 +28,14 @@ __all__ = [
     "mine_hard_examples", "ssd_loss", "prior_box", "nms",
     "multiclass_nms", "detection_output", "box_clip", "roi_align",
     "roi_pool", "sigmoid_focal_loss", "yolo_box", "yolov3_loss",
-    "matrix_nms", "density_prior_box",
+    "matrix_nms", "density_prior_box", "anchor_generator",
+    "generate_proposals",
 ]
+
+import math as _math
+
+#: exp() clamp in proposal decoding (bbox_util.h kBBoxClipDefault)
+_BBOX_CLIP = _math.log(1000.0 / 16.0)
 
 _EPS = 1e-6
 
@@ -370,6 +376,114 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
 
     out, nums = jax.vmap(image)(bboxes, scores)
     return (out, nums) if return_num else out
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios,
+                     variances=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5, name=None):
+    """Faster-RCNN anchor grid (ref: operators/detection/
+    anchor_generator_op.h:30-90): per cell, one pixel-coordinate anchor
+    per (aspect_ratio, anchor_size) with the kernel's rounded base
+    extents.  → (anchors ``[H, W, K, 4]``, variances same shape)."""
+    H, W = input.shape[2], input.shape[3]
+    sw, sh = float(stride[0]), float(stride[1])
+    import math
+
+    whs = []
+    for ar in aspect_ratios:
+        base_w = round(math.sqrt(sw * sh / ar))
+        base_h = round(base_w * ar)
+        for size in anchor_sizes:
+            whs.append((size / sw * base_w, size / sh * base_h))
+    wh = jnp.asarray(whs, jnp.float32)  # [K, 2]
+    cx = jnp.arange(W, dtype=jnp.float32) * sw + offset * (sw - 1)
+    cy = jnp.arange(H, dtype=jnp.float32) * sh + offset * (sh - 1)
+    cxg = jnp.broadcast_to(cx[None, :, None], (H, W, wh.shape[0]))
+    cyg = jnp.broadcast_to(cy[:, None, None], (H, W, wh.shape[0]))
+    anchors = jnp.stack([
+        cxg - 0.5 * (wh[:, 0] - 1), cyg - 0.5 * (wh[:, 1] - 1),
+        cxg + 0.5 * (wh[:, 0] - 1), cyg + 0.5 * (wh[:, 1] - 1),
+    ], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           anchors.shape)
+    return anchors, var
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None,
+                       return_rois_num=False):
+    """RPN proposal generation (ref: fluid/layers/detection.py
+    generate_proposals over generate_proposals_op.cc:165-260): per
+    image, take the pre_nms_top_n highest-scoring anchors, decode their
+    deltas (center-format, +1-pixel widths, exp clamped at
+    log(1000/16), variance-scaled — bbox_util.h BoxCoder), clip to the
+    image, drop boxes smaller than min_size at original scale
+    (FilterBoxes with is_scale=true), greedy-NMS, keep post_nms_top_n.
+
+    scores ``[N, A, H, W]``, bbox_deltas ``[N, 4A, H, W]``, im_info
+    ``[N, 3]`` (h, w, scale), anchors/variances ``[H, W, A, 4]`` →
+    dense (rois ``[N, K, 4]``, roi_probs ``[N, K, 1]``) padded with
+    zero boxes / -1 scores; ``return_rois_num`` adds kept counts.
+    """
+    scores = jnp.asarray(scores)
+    deltas = jnp.asarray(bbox_deltas, scores.dtype)
+    im_info = jnp.asarray(im_info, scores.dtype)
+    anchors = jnp.asarray(anchors, scores.dtype).reshape(-1, 4)
+    variances = jnp.asarray(variances, scores.dtype).reshape(-1, 4)
+    N, A, H, W = scores.shape
+    M = A * H * W
+    # kernel transposes NCHW→NHWC then flattens rows of 4: order (h,w,a)
+    s_flat = jnp.transpose(scores, (0, 2, 3, 1)).reshape(N, M)
+    d_flat = jnp.transpose(deltas, (0, 2, 3, 1)).reshape(N, M, 4)
+    k = M if pre_nms_top_n <= 0 else min(int(pre_nms_top_n), M)
+    K = min(int(post_nms_top_n), k) if post_nms_top_n > 0 else k
+
+    def one(s, d, info):
+        top_s, idx = jax.lax.top_k(s, k)
+        anc = anchors[idx]
+        var = variances[idx]
+        dd = d[idx]
+        aw = anc[:, 2] - anc[:, 0] + 1.0
+        ah = anc[:, 3] - anc[:, 1] + 1.0
+        acx = anc[:, 0] + 0.5 * aw
+        acy = anc[:, 1] + 0.5 * ah
+        cx = var[:, 0] * dd[:, 0] * aw + acx
+        cy = var[:, 1] * dd[:, 1] * ah + acy
+        bw = jnp.exp(jnp.minimum(var[:, 2] * dd[:, 2], _BBOX_CLIP)) * aw
+        bh = jnp.exp(jnp.minimum(var[:, 3] * dd[:, 3], _BBOX_CLIP)) * ah
+        props = jnp.stack([cx - 0.5 * bw, cy - 0.5 * bh,
+                           cx + 0.5 * bw - 1, cy + 0.5 * bh - 1], axis=-1)
+        # clip to image window (ClipTiledBoxes)
+        imh, imw, imscale = info[0], info[1], info[2]
+        props = jnp.stack([
+            jnp.clip(props[:, 0], 0, imw - 1),
+            jnp.clip(props[:, 1], 0, imh - 1),
+            jnp.clip(props[:, 2], 0, imw - 1),
+            jnp.clip(props[:, 3], 0, imh - 1)], axis=-1)
+        # FilterBoxes, is_scale=true: min side at ORIGINAL image scale
+        ms = jnp.maximum(min_size, 1.0)
+        ws = (props[:, 2] - props[:, 0]) / imscale + 1.0
+        hs = (props[:, 3] - props[:, 1]) / imscale + 1.0
+        ctr_x = props[:, 0] + (props[:, 2] - props[:, 0] + 1) / 2
+        ctr_y = props[:, 1] + (props[:, 3] - props[:, 1] + 1) / 2
+        ok = ((ws >= ms) & (hs >= ms)
+              & (ctr_x <= imw) & (ctr_y <= imh))
+        s_kept = jnp.where(ok, top_s, -jnp.inf)
+        keep = nms(props, s_kept, score_threshold=-jnp.inf,
+                   nms_top_k=-1, nms_threshold=nms_thresh, nms_eta=eta,
+                   normalized=False)
+        final_s = jnp.where(keep & jnp.isfinite(s_kept), s_kept, -jnp.inf)
+        out_s, out_i = jax.lax.top_k(final_s, K)
+        valid = jnp.isfinite(out_s)
+        rois = jnp.where(valid[:, None], props[out_i], 0.0)
+        return rois, jnp.where(valid, out_s, -1.0)[:, None], \
+            valid.sum().astype(jnp.int32)
+
+    rois, probs, nums = jax.vmap(one)(s_flat, d_flat, im_info)
+    if return_rois_num:
+        return rois, probs, nums
+    return rois, probs
 
 
 def _sce(x, t):

@@ -480,6 +480,94 @@ class TestDensityPriorBox:
         assert boxes.shape == (6, 4) and var.shape == (6, 4)
 
 
+class TestAnchorGenerator:
+    def test_kernel_arithmetic(self):
+        """First cell, ratio 1, size 32, stride 16: base 16x16 rounded,
+        scaled by 2 → 32x32 centered at offset*(stride-1)=7.5."""
+        feat = jnp.zeros((1, 8, 2, 2))
+        anchors, var = F.anchor_generator(feat, anchor_sizes=[32, 64],
+                                          aspect_ratios=[1.0, 2.0],
+                                          stride=[16.0, 16.0])
+        assert anchors.shape == (2, 2, 4, 4) and var.shape == anchors.shape
+        a = np.asarray(anchors)[0, 0, 0]
+        np.testing.assert_allclose(a, [7.5 - 15.5, 7.5 - 15.5,
+                                       7.5 + 15.5, 7.5 + 15.5])
+        # ratio 2: base_w = round(sqrt(256/2)) = 11, base_h = 22
+        a2 = np.asarray(anchors)[0, 0, 2]
+        np.testing.assert_allclose(a2[2] - a2[0] + 1, 22.0)  # 32/16*11
+        np.testing.assert_allclose(a2[3] - a2[1] + 1, 44.0)
+
+    def test_centers_march_with_stride(self):
+        feat = jnp.zeros((1, 1, 2, 3))
+        anchors, _ = F.anchor_generator(feat, [32], [1.0],
+                                        stride=[16.0, 16.0])
+        a = np.asarray(anchors)
+        cx = (a[..., 0] + a[..., 2]) / 2
+        np.testing.assert_allclose(cx[0, 1] - cx[0, 0], 16.0)
+
+
+class TestGenerateProposals:
+    def _setup(self, N=1, A=2, H=3, W=3):
+        rng = np.random.RandomState(0)
+        scores = rng.uniform(0, 1, (N, A, H, W)).astype(np.float32)
+        deltas = (rng.randn(N, 4 * A, H, W) * 0.1).astype(np.float32)
+        im_info = np.array([[48.0, 48.0, 1.0]] * N, np.float32)
+        feat = jnp.zeros((N, 1, H, W))
+        anchors, var = F.anchor_generator(feat, [16], [1.0, 2.0],
+                                          stride=[16.0, 16.0])
+        return scores, deltas, im_info, anchors, var
+
+    def test_shapes_counts_and_window(self):
+        scores, deltas, im_info, anchors, var = self._setup()
+        rois, probs, nums = F.generate_proposals(
+            scores, deltas, im_info, anchors, var, pre_nms_top_n=12,
+            post_nms_top_n=6, nms_thresh=0.7, min_size=2.0,
+            return_rois_num=True)
+        assert rois.shape == (1, 6, 4) and probs.shape == (1, 6, 1)
+        n = int(np.asarray(nums)[0])
+        assert 0 < n <= 6
+        r = np.asarray(rois)[0, :n]
+        assert (r[:, 0] >= 0).all() and (r[:, 2] <= 47).all()
+        assert (r[:, 1] >= 0).all() and (r[:, 3] <= 47).all()
+        p = np.asarray(probs)[0, :n, 0]
+        assert (np.diff(p) <= 1e-6).all(), "sorted by score"
+        assert (np.asarray(probs)[0, n:, 0] == -1).all()
+
+    def test_nms_suppresses_duplicate_anchors(self):
+        """All-zero deltas → proposals equal the anchors; two identical
+        aspect-1 anchors per cell collapse to one proposal."""
+        N, H, W = 1, 2, 2
+        scores = np.random.RandomState(1).uniform(
+            0.2, 1, (N, 2, H, W)).astype(np.float32)
+        deltas = np.zeros((N, 8, H, W), np.float32)
+        im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+        feat = jnp.zeros((N, 1, H, W))
+        anchors, var = F.anchor_generator(feat, [16, 16], [1.0],
+                                          stride=[16.0, 16.0])
+        _, _, nums = F.generate_proposals(
+            scores, deltas, im_info, anchors, var, pre_nms_top_n=-1,
+            post_nms_top_n=8, nms_thresh=0.5, min_size=1.0,
+            return_rois_num=True)
+        assert int(np.asarray(nums)[0]) == H * W  # one per cell, not two
+
+    def test_min_size_filters(self):
+        scores, deltas, im_info, anchors, var = self._setup()
+        _, _, n_all = F.generate_proposals(
+            scores, deltas, im_info, anchors, var, post_nms_top_n=18,
+            nms_thresh=0.99, min_size=1.0, return_rois_num=True)
+        _, _, n_big = F.generate_proposals(
+            scores, deltas, im_info, anchors, var, post_nms_top_n=18,
+            nms_thresh=0.99, min_size=30.0, return_rois_num=True)
+        assert int(np.asarray(n_big)[0]) < int(np.asarray(n_all)[0])
+
+    def test_jit(self):
+        scores, deltas, im_info, anchors, var = self._setup()
+        f = jax.jit(lambda s, d, i: F.generate_proposals(
+            s, d, i, anchors, var, pre_nms_top_n=10, post_nms_top_n=5))
+        rois, probs = f(scores, deltas, im_info)
+        assert rois.shape == (1, 5, 4)
+
+
 class TestBoxClip:
     def test_clips_to_image(self):
         boxes = np.array([[[-5.0, -2.0, 50.0, 60.0],
