@@ -546,7 +546,7 @@ from paddle_tpu.nn.functional import (  # noqa: F401,E402
     sequence_pool, sequence_softmax, sequence_reverse, sequence_pad,
     sequence_unpad, sequence_first_step, sequence_last_step,
     sequence_expand, sequence_expand_as, sequence_enumerate,
-    sequence_concat,
+    sequence_concat, sequence_slice, sequence_scatter, sequence_reshape,
 )
 
 
@@ -611,9 +611,6 @@ _STATIC_ONLY = {
     "lod_reset": "LoD machinery replaced by dense padding + lengths",
     "lod_append": "LoD machinery replaced by dense padding + lengths",
     "sequence_conv": "conv1d over padded batches with sequence_mask",
-    "sequence_slice": "lax.dynamic_slice per row",
-    "sequence_reshape": "reshape padded batches directly",
-    "sequence_scatter": "scatter with row offsets",
     # PS / distributed-specific
     "Send": "XLA collectives (paddle.distributed)",
     "Recv": "XLA collectives (paddle.distributed)",

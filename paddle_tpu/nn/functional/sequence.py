@@ -19,7 +19,8 @@ __all__ = [
     "sequence_pool", "sequence_softmax", "sequence_reverse",
     "sequence_first_step", "sequence_last_step", "sequence_expand",
     "sequence_expand_as", "sequence_enumerate", "sequence_pad",
-    "sequence_unpad", "sequence_concat",
+    "sequence_unpad", "sequence_concat", "sequence_slice",
+    "sequence_scatter", "sequence_reshape",
 ]
 
 
@@ -182,6 +183,69 @@ def sequence_unpad(x, length, name=None):
     x = jnp.asarray(x)
     m = _expand_mask(_mask(x, length), x)
     return jnp.where(m, x, 0)  # single-tensor return, 1.x API shape
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-row sub-sequence extraction (ref: sequence_slice_op): row i
+    keeps ``input[i, offset[i] : offset[i]+length]``.  Dense form:
+    ``length`` is a shared static width (XLA static shapes); ragged
+    per-row lengths stay ragged via a lengths tensor downstream."""
+    x = jnp.asarray(input)
+    B, T = x.shape[0], x.shape[1]
+    offset = jnp.asarray(offset).reshape(B)
+    if not isinstance(length, int):
+        L = jnp.asarray(length).reshape(-1)
+        if isinstance(L, jax.core.Tracer) or L.shape[0] != 1:
+            raise InvalidArgumentError(
+                "dense sequence_slice needs one static window length "
+                "(the output time axis); keep per-row raggedness via a "
+                "lengths tensor instead")
+        length = int(L[0])
+    idx = offset[:, None] + jnp.arange(length)[None, :]  # [B, L]
+    idx = jnp.clip(idx, 0, T - 1)
+    return jnp.take_along_axis(
+        x, idx.reshape(B, length, *([1] * (x.ndim - 2))), axis=1)
+
+
+def sequence_scatter(input, index, updates, lengths=None, name=None):
+    """Scatter-add per-row updates at per-row positions (ref:
+    sequence_scatter_op: out = input; out[i, index_row_i] += updates).
+    Dense form: index ``[B, K]`` positions into each row, updates
+    ``[B, K, ...]``; entries past ``lengths`` (of the K axis) are
+    dropped."""
+    x = jnp.asarray(input)
+    B, T = x.shape[0], x.shape[1]
+    index = jnp.asarray(index).astype(jnp.int32)
+    updates = jnp.asarray(updates, x.dtype)
+    K = index.shape[1]
+    if lengths is not None:
+        valid = jnp.arange(K)[None, :] < jnp.asarray(lengths).reshape(B, 1)
+        index = jnp.where(valid, index, T)  # OOB → dropped
+    bidx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, K))
+    return x.at[bidx, index].add(updates, mode="drop")
+
+
+def sequence_reshape(input, new_dim, lengths=None, name=None):
+    """Re-chunk each row's features (ref: sequence_reshape_op: row
+    timesteps re-split so the feature width becomes ``new_dim``; row
+    lengths scale by D/new_dim).  input ``[B, T, D]`` with
+    ``T·D % new_dim == 0`` → (``[B, T·D/new_dim, new_dim]``, scaled
+    lengths)."""
+    x = jnp.asarray(input)
+    B, T, D = x.shape[0], x.shape[1], x.shape[2]
+    if (T * D) % new_dim:
+        raise InvalidArgumentError(
+            f"T·D = {T * D} not divisible by new_dim {new_dim}")
+    out = x.reshape(B, T * D // new_dim, new_dim)
+    if lengths is None:
+        return out
+    lengths = jnp.asarray(lengths).reshape(B)
+    if (D % new_dim) and (new_dim % D):
+        raise InvalidArgumentError(
+            f"per-row rescaling needs D ({D}) and new_dim ({new_dim}) "
+            f"divisible one way or the other")
+    new_len = lengths * D // new_dim
+    return out, new_len
 
 
 def sequence_concat(input, lengths=None, name=None):

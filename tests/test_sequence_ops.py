@@ -136,6 +136,31 @@ class TestSequenceExpand:
         assert out.shape == (2, 3, 1)
 
 
+class TestSliceScatterReshape:
+    def test_slice_per_row_offsets(self):
+        x = jnp.asarray(np.arange(24, dtype=np.float32).reshape(2, 4, 3))
+        out = np.asarray(F.sequence_slice(x, [1, 0], 2))
+        np.testing.assert_allclose(out[0], np.asarray(x)[0, 1:3])
+        np.testing.assert_allclose(out[1], np.asarray(x)[1, 0:2])
+        with pytest.raises(InvalidArgumentError):
+            F.sequence_slice(x, [0, 0], jnp.asarray([1, 2]))
+
+    def test_scatter_adds_and_masks(self):
+        base = jnp.ones((2, 4), jnp.float32)
+        out = np.asarray(F.sequence_scatter(
+            base, [[0, 2], [1, 3]], 2 * jnp.ones((2, 2)), lengths=[2, 1]))
+        np.testing.assert_allclose(out[0], [3, 1, 3, 1])
+        np.testing.assert_allclose(out[1], [1, 3, 1, 1])  # 2nd update dropped
+
+    def test_reshape_rescales_lengths(self):
+        x = jnp.zeros((2, 4, 3))
+        out, lens = F.sequence_reshape(x, 6, lengths=[4, 2])
+        assert out.shape == (2, 2, 6)
+        np.testing.assert_array_equal(np.asarray(lens), [2, 1])
+        with pytest.raises(InvalidArgumentError):
+            F.sequence_reshape(jnp.zeros((1, 3, 3)), 7)
+
+
 class TestJitability:
     def test_pool_softmax_reverse_jit(self):
         x, lengths = _batch()
