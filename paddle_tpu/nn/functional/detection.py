@@ -387,12 +387,14 @@ def anchor_generator(input, anchor_sizes, aspect_ratios,
     extents.  → (anchors ``[H, W, K, 4]``, variances same shape)."""
     H, W = input.shape[2], input.shape[3]
     sw, sh = float(stride[0]), float(stride[1])
-    import math
+
+    def _round_half_up(v):  # C++ round(): half away from zero — Python's
+        return _math.floor(v + 0.5)  # banker's rounding diverges at .5
 
     whs = []
     for ar in aspect_ratios:
-        base_w = round(math.sqrt(sw * sh / ar))
-        base_h = round(base_w * ar)
+        base_w = _round_half_up(_math.sqrt(sw * sh / ar))
+        base_h = _round_half_up(base_w * ar)
         for size in anchor_sizes:
             whs.append((size / sw * base_w, size / sh * base_h))
     wh = jnp.asarray(whs, jnp.float32)  # [K, 2]

@@ -201,10 +201,24 @@ def sequence_slice(input, offset, length, name=None):
                 "(the output time axis); keep per-row raggedness via a "
                 "lengths tensor instead")
         length = int(L[0])
+    if not isinstance(offset, jax.core.Tracer):
+        import numpy as _np
+
+        off_np = _np.asarray(offset)
+        if (off_np < 0).any() or (off_np + length > T).any():
+            raise InvalidArgumentError(
+                f"sequence_slice window [offset, offset+{length}) leaves "
+                f"the time axis of length {T} (the reference op enforces "
+                f"offset+length <= seq_len)")
     idx = offset[:, None] + jnp.arange(length)[None, :]  # [B, L]
-    idx = jnp.clip(idx, 0, T - 1)
-    return jnp.take_along_axis(
-        x, idx.reshape(B, length, *([1] * (x.ndim - 2))), axis=1)
+    in_range = (idx >= 0) & (idx < T)
+    gathered = jnp.take_along_axis(
+        x, jnp.clip(idx, 0, T - 1).reshape(B, length,
+                                           *([1] * (x.ndim - 2))), axis=1)
+    # under trace an OOB window can't raise — zero the escaped positions
+    # so the padding is visible, not duplicated frames
+    return jnp.where(in_range.reshape(B, length, *([1] * (x.ndim - 2))),
+                     gathered, 0)
 
 
 def sequence_scatter(input, index, updates, lengths=None, name=None):
@@ -244,6 +258,14 @@ def sequence_reshape(input, new_dim, lengths=None, name=None):
         raise InvalidArgumentError(
             f"per-row rescaling needs D ({D}) and new_dim ({new_dim}) "
             f"divisible one way or the other")
+    if not isinstance(lengths, jax.core.Tracer):
+        import numpy as _np
+
+        if (_np.asarray(lengths) * D % new_dim).any():
+            raise InvalidArgumentError(
+                f"a row's valid elements (lengths·{D}) are not divisible "
+                f"by new_dim {new_dim} — the reference op rejects this "
+                f"(sequence_reshape_op) rather than dropping data")
     new_len = lengths * D // new_dim
     return out, new_len
 

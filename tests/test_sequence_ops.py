@@ -144,6 +144,14 @@ class TestSliceScatterReshape:
         np.testing.assert_allclose(out[1], np.asarray(x)[1, 0:2])
         with pytest.raises(InvalidArgumentError):
             F.sequence_slice(x, [0, 0], jnp.asarray([1, 2]))
+        # window past the row end must error (reference contract)
+        with pytest.raises(InvalidArgumentError):
+            F.sequence_slice(x, [3, 0], 2)
+
+    def test_reshape_rejects_row_data_loss(self):
+        x = jnp.zeros((1, 4, 3))
+        with pytest.raises(InvalidArgumentError):
+            F.sequence_reshape(x, 6, lengths=[3])  # 9 elems % 6 != 0
 
     def test_scatter_adds_and_masks(self):
         base = jnp.ones((2, 4), jnp.float32)
