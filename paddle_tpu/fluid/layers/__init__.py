@@ -56,6 +56,7 @@ from paddle_tpu.nn.functional import (  # noqa: F401
     crf_decoding, pixel_shuffle, unfold, temporal_shift,
     roi_align, roi_pool, sigmoid_focal_loss, yolo_box, yolov3_loss,
     matrix_nms, density_prior_box, anchor_generator, generate_proposals,
+    box_decoder_and_assign,
 )
 from paddle_tpu.nn import (  # noqa: F401
     BeamSearchDecoder, Decoder, dynamic_decode, RNNCellBase as RNNCell,
@@ -652,7 +653,6 @@ _STATIC_ONLY = {
     "locality_aware_nms": "multiclass_nms covers the standard path",
     "retinanet_detection_output": "detection_output",
     "distribute_fpn_proposals": "two-stage detectors not implemented",
-    "box_decoder_and_assign": "box_coder + target_assign",
     "collect_fpn_proposals": "two-stage detectors not implemented",
     # misc losses
     "bpr_loss": "pairwise softmax loss over positive/negative logits",

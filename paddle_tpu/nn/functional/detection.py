@@ -29,7 +29,7 @@ __all__ = [
     "multiclass_nms", "detection_output", "box_clip", "roi_align",
     "roi_pool", "sigmoid_focal_loss", "yolo_box", "yolov3_loss",
     "matrix_nms", "density_prior_box", "anchor_generator",
-    "generate_proposals",
+    "generate_proposals", "box_decoder_and_assign",
 ]
 
 import math as _math
@@ -486,6 +486,45 @@ def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
     if return_rois_num:
         return rois, probs, nums
     return rois, probs
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip=None, name=None):
+    """Cascade-RCNN head decode + per-ROI class assignment (ref:
+    operators/detection/box_decoder_and_assign_op.h:30-100): decode the
+    per-class deltas against each ROI (+1-pixel center-size, shared
+    4-vector variance, exp clamp), then assign each ROI the decoded box
+    of its best NON-background class — background-best ROIs keep the
+    prior.
+
+    prior_box ``[R, 4]``, prior_box_var ``[4]``, target_box
+    ``[R, C·4]``, box_score ``[R, C]`` → (decode_box ``[R, C·4]``,
+    assigned ``[R, 4]``)."""
+    pb = jnp.asarray(prior_box)
+    var = jnp.asarray(prior_box_var, pb.dtype).reshape(4)
+    tb = jnp.asarray(target_box, pb.dtype)
+    scores = jnp.asarray(box_score, pb.dtype)
+    R = pb.shape[0]
+    C = scores.shape[1]
+    clip = _BBOX_CLIP if box_clip is None else float(box_clip)
+    d = tb.reshape(R, C, 4)
+    pw = pb[:, 2] - pb[:, 0] + 1.0
+    ph = pb[:, 3] - pb[:, 1] + 1.0
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+    cx = var[0] * d[..., 0] * pw[:, None] + pcx[:, None]
+    cy = var[1] * d[..., 1] * ph[:, None] + pcy[:, None]
+    bw = jnp.exp(jnp.minimum(var[2] * d[..., 2], clip)) * pw[:, None]
+    bh = jnp.exp(jnp.minimum(var[3] * d[..., 3], clip)) * ph[:, None]
+    decoded = jnp.stack([cx - bw / 2, cy - bh / 2,
+                         cx + bw / 2 - 1, cy + bh / 2 - 1], axis=-1)
+    # best class excluding background (class 0)
+    fg_scores = scores.at[:, 0].set(-jnp.inf) if C > 1 else scores
+    best = jnp.argmax(fg_scores, axis=1)
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    assigned = jnp.where((best > 0)[:, None], assigned, pb)
+    return decoded.reshape(R, C * 4), assigned
 
 
 def _sce(x, t):

@@ -568,6 +568,42 @@ class TestGenerateProposals:
         assert rois.shape == (1, 5, 4)
 
 
+class TestBoxDecoderAndAssign:
+    def test_decode_and_best_class(self):
+        rng = np.random.RandomState(0)
+        R, C = 4, 3
+        mins = rng.uniform(0, 20, (R, 2))
+        priors = np.concatenate([mins, mins + rng.uniform(4, 10, (R, 2))],
+                                -1).astype(np.float32)
+        var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+        deltas = (rng.randn(R, C * 4) * 0.2).astype(np.float32)
+        scores = rng.uniform(0, 1, (R, C)).astype(np.float32)
+        decoded, assigned = F.box_decoder_and_assign(priors, var, deltas,
+                                                     scores)
+        assert decoded.shape == (R, C * 4) and assigned.shape == (R, 4)
+        dec = np.asarray(decoded).reshape(R, C, 4)
+        # zero deltas for one (roi, class): decode must return the prior
+        # in +1-pixel center-size convention
+        deltas0 = deltas.copy()
+        deltas0[0, 4:8] = 0.0
+        dec0 = np.asarray(F.box_decoder_and_assign(
+            priors, var, deltas0, scores)[0]).reshape(R, C, 4)
+        np.testing.assert_allclose(dec0[0, 1], priors[0], atol=1e-4)
+        # assigned row = decoded box of argmax non-background class
+        best = scores[:, 1:].argmax(1) + 1
+        for r in range(R):
+            np.testing.assert_allclose(np.asarray(assigned)[r],
+                                       dec[r, best[r]], atol=1e-5)
+
+    def test_single_class_keeps_prior(self):
+        priors = np.array([[0, 0, 10, 10]], np.float32)
+        var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+        _, assigned = F.box_decoder_and_assign(
+            priors, var, np.ones((1, 4), np.float32),
+            np.ones((1, 1), np.float32))
+        np.testing.assert_allclose(np.asarray(assigned)[0], priors[0])
+
+
 class TestBoxClip:
     def test_clips_to_image(self):
         boxes = np.array([[[-5.0, -2.0, 50.0, 60.0],
