@@ -56,7 +56,7 @@ from paddle_tpu.nn.functional import (  # noqa: F401
     crf_decoding, pixel_shuffle, unfold, temporal_shift,
     roi_align, roi_pool, sigmoid_focal_loss, yolo_box, yolov3_loss,
     matrix_nms, density_prior_box, anchor_generator, generate_proposals,
-    box_decoder_and_assign,
+    box_decoder_and_assign, distribute_fpn_proposals, collect_fpn_proposals,
 )
 from paddle_tpu.nn import (  # noqa: F401
     BeamSearchDecoder, Decoder, dynamic_decode, RNNCellBase as RNNCell,
@@ -652,8 +652,6 @@ _STATIC_ONLY = {
     "polygon_box_transform": "not implemented",
     "locality_aware_nms": "multiclass_nms covers the standard path",
     "retinanet_detection_output": "detection_output",
-    "distribute_fpn_proposals": "two-stage detectors not implemented",
-    "collect_fpn_proposals": "two-stage detectors not implemented",
     # misc losses
     "bpr_loss": "pairwise softmax loss over positive/negative logits",
     "sampled_softmax_with_cross_entropy": "sample negatives at ingest + "

@@ -30,6 +30,7 @@ __all__ = [
     "roi_pool", "sigmoid_focal_loss", "yolo_box", "yolov3_loss",
     "matrix_nms", "density_prior_box", "anchor_generator",
     "generate_proposals", "box_decoder_and_assign",
+    "distribute_fpn_proposals", "collect_fpn_proposals",
 ]
 
 import math as _math
@@ -486,6 +487,63 @@ def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
     if return_rois_num:
         return rois, probs, nums
     return rois, probs
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """Route ROIs to FPN levels by scale (ref: operators/detection/
+    distribute_fpn_proposals_op.h:105-155): level =
+    clip(⌊log2(√area/refer_scale + 1e-6)⌋ + refer_level, min, max) with
+    +1-pixel areas.
+
+    Dense contract: fpn_rois ``[R, 4]`` → (list of ``[R, 4]``
+    zero-padded per-level tensors, restore_ind ``[R, 1]`` mapping each
+    input row to its position in the level-major compaction, list of
+    per-level valid counts — the dense stand-in for the per-level LoD).
+    """
+    rois = jnp.asarray(fpn_rois)
+    R = rois.shape[0]
+    L = max_level - min_level + 1
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    area = jnp.where((w < 0) | (h < 0), 0.0, (w + 1) * (h + 1))
+    lvl = jnp.floor(jnp.log2(jnp.sqrt(area) / refer_scale + 1e-6)
+                    + refer_level)
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32) - min_level
+    multi, counts = [], []
+    rank_in_level = jnp.zeros((R,), jnp.int32)
+    for i in range(L):
+        m = lvl == i
+        rank = jnp.cumsum(m.astype(jnp.int32)) - 1
+        dest = jnp.where(m, rank, R)  # padding rows dropped
+        out = jnp.zeros((R, 4), rois.dtype).at[dest].set(rois, mode="drop")
+        multi.append(out)
+        counts.append(m.sum().astype(jnp.int32))
+        rank_in_level = jnp.where(m, rank, rank_in_level)
+    offsets = jnp.cumsum(jnp.asarray([0] + [c for c in counts[:-1]]))
+    restore = (offsets[lvl] + rank_in_level).astype(jnp.int32)[:, None]
+    return multi, restore, counts
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """Gather the top ``post_nms_top_n`` ROIs across FPN levels by score
+    (ref: operators/detection/collect_fpn_proposals_op.h:109-148).
+    Dense contract: per-level ``[Ri, 4]`` rois + ``[Ri]`` scores (with
+    optional valid counts masking each level's padding) → (rois
+    ``[K, 4]`` zero-padded, kept count)."""
+    rois = jnp.concatenate([jnp.asarray(r) for r in multi_rois], axis=0)
+    parts = [jnp.asarray(s).reshape(-1) for s in multi_scores]
+    if rois_num_per_level is not None:
+        parts = [jnp.where(jnp.arange(s.shape[0]) < n, s, -jnp.inf)
+                 for s, n in zip(parts, rois_num_per_level)]
+    scores = jnp.concatenate(parts)
+    K = min(int(post_nms_top_n), scores.shape[0])
+    top_s, idx = jax.lax.top_k(scores, K)
+    valid = jnp.isfinite(top_s)
+    out = jnp.where(valid[:, None], rois[idx], 0.0)
+    return out, valid.sum().astype(jnp.int32)
 
 
 def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,

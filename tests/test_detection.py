@@ -604,6 +604,42 @@ class TestBoxDecoderAndAssign:
         np.testing.assert_allclose(np.asarray(assigned)[0], priors[0])
 
 
+class TestFpnRouting:
+    def test_distribute_levels_and_restore(self):
+        """16/32/64px boxes route to the min level, 256px to the refer
+        level (distribute_fpn_proposals_op.h:110-113 formula)."""
+        rois = np.array([[0, 0, 15, 15], [0, 0, 63, 63],
+                         [0, 0, 255, 255], [0, 0, 31, 31]], np.float32)
+        multi, restore, counts = F.distribute_fpn_proposals(
+            rois, 2, 5, 4, 224)
+        assert [int(c) for c in counts] == [3, 0, 1, 0]
+        np.testing.assert_array_equal(np.asarray(restore).ravel(),
+                                      [0, 1, 3, 2])
+        lvl2 = np.asarray(multi[0])
+        np.testing.assert_allclose(lvl2[0], rois[0])
+        np.testing.assert_allclose(lvl2[2], rois[3])  # compacted order
+        np.testing.assert_allclose(np.asarray(multi[2])[0], rois[2])
+        assert (lvl2[3] == 0).all(), "padding rows are zero"
+
+    def test_collect_top_k_across_levels(self):
+        rois = np.array([[0, 0, 15, 15], [0, 0, 63, 63],
+                         [0, 0, 255, 255], [0, 0, 31, 31]], np.float32)
+        multi, _, counts = F.distribute_fpn_proposals(rois, 2, 5, 4, 224)
+        scores = [np.full(4, 0.1 * (i + 1), np.float32)
+                  for i in range(4)]
+        scores[0][1] = 0.9  # the 64px box wins
+        out, n = F.collect_fpn_proposals(
+            [np.asarray(m) for m in multi], scores, 2, 5, 2,
+            rois_num_per_level=[int(c) for c in counts])
+        assert int(n) == 2
+        np.testing.assert_allclose(np.asarray(out)[0], rois[1])
+        # padded level entries (masked to -inf) must never be collected
+        out4, n4 = F.collect_fpn_proposals(
+            [np.asarray(m) for m in multi], scores, 2, 5, 16,
+            rois_num_per_level=[int(c) for c in counts])
+        assert int(n4) == 4
+
+
 class TestBoxClip:
     def test_clips_to_image(self):
         boxes = np.array([[[-5.0, -2.0, 50.0, 60.0],
