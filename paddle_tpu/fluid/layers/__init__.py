@@ -57,6 +57,7 @@ from paddle_tpu.nn.functional import (  # noqa: F401
     roi_align, roi_pool, sigmoid_focal_loss, yolo_box, yolov3_loss,
     matrix_nms, density_prior_box, anchor_generator, generate_proposals,
     box_decoder_and_assign, distribute_fpn_proposals, collect_fpn_proposals,
+    psroi_pool,
 )
 from paddle_tpu.nn import (  # noqa: F401
     BeamSearchDecoder, Decoder, dynamic_decode, RNNCellBase as RNNCell,
@@ -574,7 +575,6 @@ _STATIC_ONLY = {
     "deformable_conv": "paddle.nn.functional.deform_conv2d (explicit weight/offset/mask tensors; the 1.x builder created the params itself)",
     "lrn": "paddle.nn.LocalResponseNorm",
     "prroi_pool": "roi pooling family (not implemented)",
-    "psroi_pool": "roi pooling family (not implemented)",
     "deformable_roi_pooling": "roi pooling family (not implemented)",
     # program control flow → lax / python
     "While": "jax.lax.while_loop (compiled) or Python while (eager)",

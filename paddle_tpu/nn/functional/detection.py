@@ -30,7 +30,7 @@ __all__ = [
     "roi_pool", "sigmoid_focal_loss", "yolo_box", "yolov3_loss",
     "matrix_nms", "density_prior_box", "anchor_generator",
     "generate_proposals", "box_decoder_and_assign",
-    "distribute_fpn_proposals", "collect_fpn_proposals",
+    "distribute_fpn_proposals", "collect_fpn_proposals", "psroi_pool",
 ]
 
 import math as _math
@@ -1065,6 +1065,57 @@ def roi_pool(input, rois, pooled_height=1, pooled_width=1,
                                 neg_inf), axis=3)  # [PH, C, PW]
         out = jnp.where(jnp.isfinite(out), out, 0.0)  # empty bin → 0
         return jnp.transpose(out, (1, 0, 2))  # [C, PH, PW]
+
+    return jax.vmap(one)(rois, batch_ids)
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_num=None, name=None):
+    """Position-sensitive RoI average pooling (ref: operators/
+    psroi_pool_op.h:82-140, R-FCN): output bin (c, ph, pw) averages the
+    dedicated input channel ``(c·PH+ph)·PW+pw`` over the bin's integer
+    window; ROI coords are rounded then scaled, bins floor/ceil
+    partitioned, empty bins → 0.
+
+    input ``[N, C·PH·PW, H, W]``, rois ``[R, 4]`` (+ dense ``rois_num``)
+    → ``[R, C, PH, PW]``."""
+    x = jnp.asarray(input)
+    rois = jnp.asarray(rois, x.dtype)
+    N, Cin, H, W = x.shape
+    PH, PW = int(pooled_height), int(pooled_width)
+    C = int(output_channels)
+    if Cin != C * PH * PW:
+        raise InvalidArgumentError(
+            f"input channels {Cin} != output_channels·PH·PW = "
+            f"{C * PH * PW}")
+    R = rois.shape[0]
+    batch_ids = _roi_batch_ids(rois_num, R, N)
+    xs = x.reshape(N, C, PH, PW, H, W)
+    ph = jnp.arange(PH, dtype=x.dtype)
+    pw = jnp.arange(PW, dtype=x.dtype)
+    hgrid = jnp.arange(H, dtype=x.dtype)
+    wgrid = jnp.arange(W, dtype=x.dtype)
+
+    def one(roi, bid):
+        x0 = jnp.round(roi[0]) * spatial_scale
+        y0 = jnp.round(roi[1]) * spatial_scale
+        x1 = (jnp.round(roi[2]) + 1.0) * spatial_scale
+        y1 = (jnp.round(roi[3]) + 1.0) * spatial_scale
+        rh = jnp.maximum(y1 - y0, 0.1)
+        rw = jnp.maximum(x1 - x0, 0.1)
+        bh = rh / PH
+        bw = rw / PW
+        hstart = jnp.clip(jnp.floor(ph * bh + y0), 0, H)
+        hend = jnp.clip(jnp.ceil((ph + 1) * bh + y0), 0, H)
+        wstart = jnp.clip(jnp.floor(pw * bw + x0), 0, W)
+        wend = jnp.clip(jnp.ceil((pw + 1) * bw + x0), 0, W)
+        mh = ((hgrid >= hstart[:, None])
+              & (hgrid < hend[:, None])).astype(x.dtype)  # [PH, H]
+        mw = ((wgrid >= wstart[:, None])
+              & (wgrid < wend[:, None])).astype(x.dtype)  # [PW, W]
+        sums = jnp.einsum("cpqhw,ph,qw->cpq", xs[bid], mh, mw)
+        counts = jnp.einsum("ph,qw->pq", mh, mw)
+        return jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), 0.0)
 
     return jax.vmap(one)(rois, batch_ids)
 

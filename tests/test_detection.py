@@ -740,6 +740,48 @@ class TestRoiPool:
         assert out[0, 0, 0, 0] == 0.0 and out[1, 0, 0, 0] == 7.0
 
 
+class TestPsroiPool:
+    def test_position_sensitive_channels(self):
+        """Each output bin reads ONLY its dedicated channel: channel
+        (c·PH+ph)·PW+pw filled with a marker shows up at exactly
+        (c, ph, pw)."""
+        C, PH, PW, H, W = 2, 2, 2, 4, 4
+        x = np.zeros((1, C * PH * PW, H, W), np.float32)
+        for c in range(C):
+            for ph in range(PH):
+                for pw in range(PW):
+                    x[0, (c * PH + ph) * PW + pw] = 100 * c + 10 * ph + pw
+        rois = np.array([[0, 0, 3, 3]], np.float32)
+        out = np.asarray(F.psroi_pool(x, rois, C, 1.0, PH, PW))
+        for c in range(C):
+            for ph in range(PH):
+                for pw in range(PW):
+                    np.testing.assert_allclose(out[0, c, ph, pw],
+                                               100 * c + 10 * ph + pw)
+
+    def test_bin_average_oracle(self):
+        """1-channel output, 2x2 bins over a 4x4 ROI: each bin is the
+        mean of its quadrant in its dedicated channel."""
+        PH = PW = 2
+        x = np.zeros((1, 4, 4, 4), np.float32)
+        x[0, 0] = np.arange(16).reshape(4, 4)  # channel for (0,0,0)
+        rois = np.array([[0, 0, 3, 3]], np.float32)
+        out = np.asarray(F.psroi_pool(x, rois, 1, 1.0, PH, PW))
+        np.testing.assert_allclose(out[0, 0, 0, 0],
+                                   np.arange(16).reshape(4, 4)[:2, :2].mean())
+
+    def test_channel_validation_and_batching(self):
+        with pytest.raises(InvalidArgumentError):
+            F.psroi_pool(np.zeros((1, 7, 4, 4), np.float32),
+                         np.zeros((1, 4), np.float32), 2, 1.0, 2, 2)
+        x = np.zeros((2, 4, 4, 4), np.float32)
+        x[1] = 5.0
+        rois = np.array([[0, 0, 3, 3], [0, 0, 3, 3]], np.float32)
+        out = np.asarray(F.psroi_pool(x, rois, 1, 1.0, 2, 2,
+                                      rois_num=np.array([1, 1])))
+        assert out[0].max() == 0.0 and out[1].min() == 5.0
+
+
 class TestSigmoidFocalLoss:
     def _oracle(self, x, label, fg, gamma, alpha):
         N, C = x.shape
