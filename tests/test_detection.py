@@ -13,6 +13,7 @@ import jax.numpy as jnp
 
 import paddle_tpu as paddle
 from paddle_tpu.nn import functional as F
+from paddle_tpu.framework.errors import InvalidArgumentError
 
 
 def _iou_np(x, y, normalized=True):
