@@ -57,7 +57,7 @@ from paddle_tpu.nn.functional import (  # noqa: F401
     roi_align, roi_pool, sigmoid_focal_loss, yolo_box, yolov3_loss,
     matrix_nms, density_prior_box, anchor_generator, generate_proposals,
     box_decoder_and_assign, distribute_fpn_proposals, collect_fpn_proposals,
-    psroi_pool,
+    psroi_pool, locality_aware_nms,
 )
 from paddle_tpu.nn import (  # noqa: F401
     BeamSearchDecoder, Decoder, dynamic_decode, RNNCellBase as RNNCell,
@@ -650,7 +650,6 @@ _STATIC_ONLY = {
     "generate_proposal_labels": "two-stage detectors not implemented",
     "generate_mask_labels": "two-stage detectors not implemented",
     "polygon_box_transform": "not implemented",
-    "locality_aware_nms": "multiclass_nms covers the standard path",
     "retinanet_detection_output": "detection_output",
     # misc losses
     "bpr_loss": "pairwise softmax loss over positive/negative logits",

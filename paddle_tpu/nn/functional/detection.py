@@ -31,6 +31,7 @@ __all__ = [
     "matrix_nms", "density_prior_box", "anchor_generator",
     "generate_proposals", "box_decoder_and_assign",
     "distribute_fpn_proposals", "collect_fpn_proposals", "psroi_pool",
+    "locality_aware_nms",
 ]
 
 import math as _math
@@ -791,6 +792,71 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
     if return_rois_num:
         rets += (nums,)
     return rets[0] if len(rets) == 1 else rets
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """Locality-aware NMS for dense text detection / EAST (ref:
+    fluid/layers/detection.py locality_aware_nms over
+    locality_aware_nms_op.cc:96-135): a single ordered pass
+    score-weighted-merges each box into the current merge head while
+    they overlap above ``nms_threshold`` (head score accumulates), then
+    standard greedy NMS prunes the merged candidates.  Single class
+    (the reference op supports only C=1): bboxes ``[N, M, 4]``, scores
+    ``[N, 1, M]`` → dense ``[N, K, 6]`` rows, label 0, -1 padding."""
+    bboxes = jnp.asarray(bboxes)
+    scores = jnp.asarray(scores)
+    if scores.shape[1] != 1:
+        raise InvalidArgumentError(
+            "locality_aware_nms supports one class (the reference op's "
+            "documented limit) — use multiclass_nms/matrix_nms otherwise")
+    N, M = bboxes.shape[0], bboxes.shape[1]
+    K = M if keep_top_k is None or keep_top_k < 0 else min(
+        int(keep_top_k), M)
+
+    def merge_pass(boxes, s):
+        """Sequential input-order merge (the op relies on EAST's
+        row-major box ordering).  carry: (boxes, scores, head, skip)."""
+
+        def step(carry, i):
+            bx, sc, head, skip = carry
+            iou = iou_similarity(bx[i][None], bx[head][None],
+                                 normalized)[0, 0]
+            do_merge = (head != i) & (iou > nms_threshold)
+            merged = (bx[i] * sc[i] + bx[head] * sc[head]) / jnp.maximum(
+                sc[i] + sc[head], _EPS)
+            bx = bx.at[head].set(jnp.where(do_merge, merged, bx[head]))
+            sc = sc.at[head].set(jnp.where(do_merge, sc[head] + sc[i],
+                                           sc[head]))
+            # not merged → finalize old head, advance head to i
+            skip = skip.at[head].set(jnp.where(do_merge, skip[head], False))
+            head = jnp.where(do_merge, head, i)
+            return (bx, sc, head, skip), None
+
+        init = (boxes, s, jnp.asarray(0, jnp.int32),
+                jnp.ones((M,), bool))
+        (bx, sc, head, skip), _ = jax.lax.scan(
+            step, init, jnp.arange(M, dtype=jnp.int32))
+        skip = skip.at[head].set(False)
+        return bx, sc, skip
+
+    def image(boxes, sc):
+        bx, s2, skip = merge_pass(boxes, sc[0])
+        s2 = jnp.where(skip | (s2 <= score_threshold), -jnp.inf, s2)
+        keep = nms(bx, s2, score_threshold=-jnp.inf, nms_top_k=nms_top_k,
+                   nms_threshold=nms_threshold, nms_eta=nms_eta,
+                   normalized=normalized)
+        final = jnp.where(keep & jnp.isfinite(s2), s2, -jnp.inf)
+        top_s, top_i = jax.lax.top_k(final, K)
+        valid = jnp.isfinite(top_s)
+        row = jnp.concatenate([jnp.zeros((K, 1), boxes.dtype),
+                               top_s[:, None], bx[top_i]], axis=-1)
+        return (jnp.where(valid[:, None], row, -1.0),
+                valid.sum().astype(jnp.int32))
+
+    out, nums = jax.vmap(image)(bboxes, scores)
+    return out
 
 
 def density_prior_box(input, image, densities=None, fixed_sizes=None,

@@ -1003,3 +1003,32 @@ class TestPriorBox:
         b = np.asarray(boxes)
         cx = (b[..., 0] + b[..., 2]) / 2 * 20
         np.testing.assert_allclose(cx[0, :, 0], [5.0, 15.0], atol=1e-5)
+
+
+class TestLocalityAwareNms:
+    def test_merges_overlapping_run(self):
+        """Three near-identical consecutive boxes merge into one
+        score-weighted box with accumulated score; a disjoint box
+        survives separately."""
+        boxes = np.array([[[0, 0, 10, 10], [0.2, 0, 10.2, 10],
+                           [0.4, 0, 10.4, 10], [30, 30, 40, 40]]],
+                         np.float32)
+        scores = np.array([[[0.5, 0.3, 0.2, 0.9]]], np.float32)
+        out = np.asarray(F.locality_aware_nms(
+            boxes, scores, score_threshold=0.05, nms_top_k=-1,
+            keep_top_k=4, nms_threshold=0.5))
+        rows = out[0][out[0][:, 0] >= 0]
+        assert len(rows) == 2
+        by_score = rows[np.argsort(-rows[:, 1])]
+        np.testing.assert_allclose(by_score[0, 1], 1.0, atol=1e-5)  # merged
+        # weighted x-min: (0*.5 + (0.2*.3+(0*.5))/.8*... sequential merge:
+        # head after b1: x=(0.2*.3+0*.5)/.8=0.075, s=.8; after b2:
+        # x=(0.4*.2+0.075*.8)/1.0 = 0.14
+        np.testing.assert_allclose(by_score[0, 2], 0.14, atol=1e-4)
+        np.testing.assert_allclose(by_score[1, 2:], [30, 30, 40, 40])
+
+    def test_single_class_enforced(self):
+        with pytest.raises(InvalidArgumentError):
+            F.locality_aware_nms(np.zeros((1, 2, 4), np.float32),
+                                 np.zeros((1, 3, 2), np.float32),
+                                 0.1, -1, 2)
