@@ -1,49 +1,78 @@
-"""Benchmark: BERT-base training throughput, seq/sec on one chip.
+"""Benchmarks: the BASELINE.md configs, one JSON line per measured config.
 
-North star (BASELINE.json): BERT-base seq/sec/chip ≥ 0.9× the stock CUDA
-build on A100.  The reference publishes no in-tree numbers (BASELINE.md);
-``A100_REF_SEQ_PER_SEC`` (~1100 seq/s) stands in for the public NVIDIA
-DeepLearningExamples BERT-base phase-1 (seq 128, AMP, 1×A100) pretraining
-throughput — vs_baseline is measured/1100.
+North star (BASELINE.json): ResNet-50 imgs/sec/chip and BERT-base seq/sec/chip
+>= 0.9x the stock CUDA build on A100, identical converged accuracy.  The
+reference publishes no in-tree numbers (BASELINE.md), so the A100 constants
+below stand in from the public NVIDIA DeepLearningExamples results.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Config map (BASELINE.md "Benchmark configs to reproduce"):
+  1. MNIST MLP smoke          -> converged-accuracy gate (the reference's own
+                                 CI gate form: test_recognize_digits.py:126)
+  2. ResNet-50 AMP            -> imgs/sec/chip vs A100_REF_IMG_PER_SEC
+  3. BERT-base                -> seq/sec/chip vs A100_REF_SEQ_PER_SEC
+  4. 8-chip DP ResNet-50      -> NOT measurable here: this environment exposes
+                                 exactly one real chip (the 8-device mesh is
+                                 CPU-virtual, see __graft_entry__.dryrun_multichip)
+  5. Wide&Deep CTR            -> converged-AUC gate on learnable synthetic
+                                 clickthrough (PS capability = sharded tables)
+
+Measurement notes:
+  * BERT keeps the round-1/2 methodology (per-step dispatch, best of 3
+    windows) for round-over-round comparability.
+  * ResNet-50 chains N train steps inside one jitted lax.scan and fetches one
+    scalar: the real chip sits behind a network tunnel whose per-dispatch RTT
+    (~1s) swamps a ~50ms step.  scan-chaining measures device throughput the
+    way a real TPU training loop (local host, compiled loop) would see it.
+    Measured artifact size: per-step dispatch reads 60 img/s where the device
+    does 2.5k img/s.
+  * ResNet runs data_format="NHWC" (the TPU-preferred layout the vision
+    models expose) with bf16 params + f32 master weights - the AMP-equivalent
+    of the reference's AMP O1 CUDA runs.
+
+The last line is a combined headline: geomean of the two throughput ratios.
 """
 import json
+import math
+import sys
 import time
 
 import numpy as np
 
-# Public NVIDIA DeepLearningExamples BERT-base phase-1 (seq 128, AMP, 1×A100)
-# pretraining throughput is ~1.1k seq/s; used as the "stock CUDA on A100"
-# stand-in since the reference repo publishes no numbers (BASELINE.md).
+# Public NVIDIA DeepLearningExamples BERT-base phase-1 (seq 128, AMP, 1xA100)
+# pretraining throughput is ~1.1k seq/s.
 A100_REF_SEQ_PER_SEC = 1100.0
+# Public NVIDIA DeepLearningExamples ResNet-50 v1.5 mixed-precision training,
+# single A100: ~2.5k img/s.
+A100_REF_IMG_PER_SEC = 2500.0
+# Reference CI accuracy gate for the MNIST book test
+# (python/paddle/fluid/tests/book/test_recognize_digits.py:126 asserts the
+# trained accuracy threshold).
+MNIST_ACC_GATE = 0.97
+# Synthetic-clickthrough AUC gate for the CTR config (the reference's CTR CI
+# runs are loss-decrease asserts; AUC >= 0.8 on the learnable synthetic task
+# is the equivalent converged-behavior check).
+CTR_AUC_GATE = 0.8
 
-# AMP-equivalent config (reference benchmarks run AMP O1 on CUDA): bf16
-# params+activations with f32 master weights in the optimizer.  Standard
-# phase-1 MLM task shape: the decoder runs over max_predictions_per_seq
-# masked positions (the A100 baseline does the same), not the full sequence.
-BATCH = 256
-SEQ = 128
-MAX_PRED = 20
-WARMUP = 3
-ITERS = 10
-WINDOWS = 3  # timing windows; report the best — external interference on
-#              the shared tunnel backend only ever slows a window down
+
+def _emit(metric, value, unit, vs_baseline, **extra):
+    line = {"metric": metric, "value": round(float(value), 4), "unit": unit,
+            "vs_baseline": round(float(vs_baseline), 3)}
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+    return line
 
 
-def main():
-    import jax
-    import jax.numpy as jnp
-
+def bench_bert():
+    """Config 3: BERT-base MLM+NSP pretraining step, per-step dispatch."""
     import paddle_tpu as paddle
     from paddle_tpu import optimizer as popt
-    from paddle_tpu.models import GPTConfig  # noqa: F401  (import check)
     from paddle_tpu.models import BertForPretraining, bert_base
+
+    BATCH, SEQ, MAX_PRED, WARMUP, ITERS, WINDOWS = 256, 128, 20, 3, 10, 3
 
     paddle.seed(0)
     cfg = bert_base()
     net = BertForPretraining(cfg).astype("bfloat16")
-
     opt = popt.AdamW(learning_rate=1e-4, weight_decay=0.01,
                      multi_precision=True)
     model = paddle.Model(
@@ -60,7 +89,7 @@ def main():
     positions = np.stack([
         np.sort(rng.choice(SEQ, MAX_PRED, replace=False))
         for _ in range(BATCH)]).astype(np.int32)
-    mlm_labels = np.take_along_axis(ids, positions, axis=1)  # [B, MAX_PRED]
+    mlm_labels = np.take_along_axis(ids, positions, axis=1)
     nsp_labels = rng.randint(0, 2, size=(BATCH, 1)).astype(np.int32)
 
     def step():
@@ -71,26 +100,185 @@ def main():
 
     for _ in range(WARMUP):
         loss = step()
-    float(loss)  # value fetch: block_until_ready is a no-op on remote-tunnel
-                 # backends, only a D2H read truly waits for execution
+    float(loss)  # D2H read truly waits (block_until_ready is a no-op on the
+    #              remote-tunnel backend)
 
     best_dt = float("inf")
     for _ in range(WINDOWS):
         t0 = time.perf_counter()
         for _ in range(ITERS):
             loss = step()
-        final = float(loss)  # steps are param-chained; fetching the last
-        dt = time.perf_counter() - t0  # loss waits for the whole sequence
+        final = float(loss)  # steps are param-chained; the last loss waits
+        dt = time.perf_counter() - t0  # for the whole window
         assert np.isfinite(final)
         best_dt = min(best_dt, dt)
 
     seq_per_sec = BATCH * ITERS / best_dt
-    print(json.dumps({
-        "metric": "bert_base_train_seq_per_sec_per_chip",
-        "value": round(seq_per_sec, 2),
-        "unit": "seq/s",
-        "vs_baseline": round(seq_per_sec / A100_REF_SEQ_PER_SEC, 3),
-    }))
+    return _emit("bert_base_train_seq_per_sec_per_chip", round(seq_per_sec, 2),
+                 "seq/s", seq_per_sec / A100_REF_SEQ_PER_SEC)
+
+
+def bench_resnet50():
+    """Config 2: ResNet-50 AMP train step, scan-chained on device."""
+    import jax
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer as popt
+    from paddle_tpu.nn.layer_base import functional_call
+    from paddle_tpu.vision.models import resnet50
+
+    BATCH, N_STEPS, WINDOWS = 128, 20, 3
+
+    paddle.seed(0)
+    net = resnet50(data_format="NHWC").astype("bfloat16")
+    params = {k: v.value for k, v in net.named_parameters()}
+    bufs = {k: v.value for k, v in net.named_buffers()}
+    opt = popt.Momentum(learning_rate=0.1, momentum=0.9, multi_precision=True,
+                        weight_decay=1e-4)
+    opt_state = opt.init(params)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.uniform(-1, 1, (BATCH, 224, 224, 3))
+                    .astype(ml_dtypes.bfloat16))
+    y = jnp.asarray(rng.randint(0, 1000, (BATCH, 1)))
+    loss_layer = paddle.nn.CrossEntropyLoss()
+
+    def loss_fn(p, b):
+        out, nb = functional_call(net, p, x, buffers=b, training=True,
+                                  return_buffers=True)
+        return loss_layer(out.astype(jnp.float32), y), nb
+
+    @jax.jit
+    def run_window(p, os_, b):
+        def body(carry, _):
+            p, os_, b = carry
+            (lv, nb), g = jax.value_and_grad(loss_fn, has_aux=True)(p, b)
+            p2, os2 = opt.update(g, os_, p, lr=0.1)
+            return (p2, os2, nb), lv
+        (p, os_, b), losses = jax.lax.scan(body, (p, os_, b), None,
+                                           length=N_STEPS)
+        return losses[-1]
+
+    final = float(run_window(params, opt_state, bufs))  # compile + warm
+    assert np.isfinite(final)
+    best_dt = float("inf")
+    for _ in range(WINDOWS):
+        t0 = time.perf_counter()
+        final = float(run_window(params, opt_state, bufs))
+        dt = time.perf_counter() - t0
+        assert np.isfinite(final)
+        best_dt = min(best_dt, dt)
+
+    img_per_sec = BATCH * N_STEPS / best_dt
+    return _emit("resnet50_train_img_per_sec_per_chip", round(img_per_sec, 1),
+                 "img/s", img_per_sec / A100_REF_IMG_PER_SEC)
+
+
+def bench_mnist():
+    """Config 1: MNIST-shaped MLP smoke - converged-accuracy gate.
+
+    No egress, so the data is synthetic MNIST-shaped: 10 fixed prototype
+    images + pixel noise.  The gate form mirrors the reference CI
+    (test_recognize_digits.py:126): train briefly, assert accuracy.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu import optimizer as popt
+    from paddle_tpu.nn.layer_base import functional_call
+
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    protos = rng.uniform(0, 1, (10, 784)).astype(np.float32)
+
+    def batch(n, seed):
+        r = np.random.RandomState(seed)
+        y = r.randint(0, 10, n)
+        x = protos[y] + r.normal(0, 0.35, (n, 784)).astype(np.float32)
+        return (x - 0.5).astype(np.float32), y
+
+    net = nn.Sequential(nn.Linear(784, 128), nn.ReLU(),
+                        nn.Linear(128, 64), nn.ReLU(), nn.Linear(64, 10))
+    params = {k: v.value for k, v in net.named_parameters()}
+    opt = popt.SGD(learning_rate=0.05)
+    opt_state = opt.init(params)
+    xs, ys = batch(4096, 1)
+    xs, ys = jnp.asarray(xs), jnp.asarray(ys)
+
+    def loss_fn(p, x, y):
+        logits = functional_call(net, p, x)
+        lp = jax.nn.log_softmax(logits, -1)
+        return -jnp.take_along_axis(lp, y[:, None], 1).mean()
+
+    @jax.jit
+    def train(p, os_):
+        def body(carry, _):
+            p, os_ = carry
+            g = jax.grad(loss_fn)(p, xs, ys)
+            p2, os2 = opt.update(g, os_, p, lr=0.05)
+            return (p2, os2), ()
+        (p, os_), _ = jax.lax.scan(body, (p, os_), None, length=150)
+        return p
+
+    p = train(params, opt_state)
+    xt, yt = batch(2048, 2)
+    pred = np.asarray(jax.jit(functional_call, static_argnums=0)(net, p,
+                                                                jnp.asarray(xt)))
+    acc = float((pred.argmax(-1) == yt).mean())
+    return _emit("mnist_mlp_smoke_accuracy", acc, "accuracy",
+                 acc / MNIST_ACC_GATE)
+
+
+def bench_ctr():
+    """Config 5: Wide&Deep CTR - converged-AUC gate on synthetic clicks."""
+    import paddle_tpu as paddle
+    from paddle_tpu import metric as pmetric
+    from paddle_tpu import optimizer as popt
+    from paddle_tpu.models import wide_deep_tiny
+
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    n, fields, vocab, dense = 512, 4, 64, 4
+    ids = rng.randint(0, vocab, size=(n, fields)).astype(np.int32)
+    xd = rng.randn(n, dense).astype(np.float32)
+    y = (ids[:, :1] < vocab // 2).astype(np.float32)
+
+    net = wide_deep_tiny()
+    model = paddle.Model(net, inputs=["sparse", "dense"], labels=["label"])
+    model.prepare(optimizer=popt.Adam(learning_rate=1e-2), loss=net.loss)
+    for _ in range(40):
+        loss, _ = model.train_batch([ids, xd], [y])
+
+    import jax
+    logits = np.asarray(model.predict_batch([ids, xd])).reshape(-1)
+    prob = np.asarray(jax.nn.sigmoid(logits))  # Auc buckets expect [0,1]
+    auc = pmetric.Auc()
+    auc.update(np.stack([1 - prob, prob], -1), y)
+    a = float(auc.accumulate())
+    return _emit("wide_deep_ctr_auc", a, "auc", a / CTR_AUC_GATE)
+
+
+def main():
+    results, failed = {}, []
+    for name, fn in [("bert", bench_bert), ("resnet50", bench_resnet50),
+                     ("mnist", bench_mnist), ("ctr", bench_ctr)]:
+        try:
+            results[name] = fn()
+        except Exception as e:  # keep later configs running; failure visible
+            failed.append(name)
+            print(f"bench config {name!r} FAILED: {e!r}", file=sys.stderr)
+    if "bert" in results and "resnet50" in results:
+        g = math.sqrt(results["bert"]["vs_baseline"]
+                      * results["resnet50"]["vs_baseline"])
+        _emit("train_throughput_geomean_vs_a100", g, "ratio", g,
+              bert_seq_per_sec=results["bert"]["value"],
+              resnet50_img_per_sec=results["resnet50"]["value"])
+    if failed:
+        sys.exit(1)  # a green exit code must mean every config was measured
 
 
 if __name__ == "__main__":

@@ -196,6 +196,48 @@ class TestNorms:
         check(out_eval, (x - mu.reshape(1, 3, 1, 1)) / np.sqrt(var.reshape(1, 3, 1, 1) + 1e-5),
               rtol=1e-4, atol=1e-4)
 
+    def test_batch_norm_bf16_fast_path(self):
+        # AMP path: one-pass f32-accumulated stats + folded bf16 normalize
+        # must track the f32 two-pass oracle, and the functional stat update
+        # must preserve the running buffers' dtype (scan-carry invariant).
+        import jax.numpy as jnp
+
+        rs = np.random.RandomState(11)
+        x = rs.normal(2.0, 1.5, (8, 5, 4, 4)).astype(np.float32)
+        rm = rs.rand(5).astype(np.float32)
+        rv = (1 + rs.rand(5)).astype(np.float32)
+        g = rs.rand(5).astype(np.float32)
+        b = rs.rand(5).astype(np.float32)
+        ref, nm_ref, nv_ref = F.batch_norm(
+            pt.to_tensor(x), rm, rv, pt.to_tensor(g), pt.to_tensor(b),
+            training=True)
+        xb = jnp.asarray(x, jnp.bfloat16)
+        out, nm, nv = F.batch_norm(xb, rm, rv, pt.to_tensor(g),
+                                   pt.to_tensor(b), training=True)
+        assert jnp.asarray(out).dtype == jnp.bfloat16
+        assert np.asarray(nm).dtype == np.float32  # running dtype preserved
+        check(np.asarray(out, np.float32), np.asarray(ref), rtol=0.06,
+              atol=0.06)
+        check(nm, nm_ref, rtol=1e-2, atol=1e-2)
+        check(nv, nv_ref, rtol=2e-2, atol=2e-2)
+        # bf16 running buffers stay bf16 after the update
+        _, nm2, _ = F.batch_norm(xb, jnp.asarray(rm, jnp.bfloat16),
+                                 jnp.asarray(rv, jnp.bfloat16), training=True)
+        assert jnp.asarray(nm2).dtype == jnp.bfloat16
+
+    def test_batch_norm_nhwc(self):
+        rs = np.random.RandomState(12)
+        x = rs.rand(4, 3, 2, 2).astype(np.float32)
+        out_nchw, nm1, nv1 = F.batch_norm(pt.to_tensor(x), np.zeros(3, np.float32),
+                                          np.ones(3, np.float32), training=True)
+        out_nhwc, nm2, nv2 = F.batch_norm(
+            pt.to_tensor(x.transpose(0, 2, 3, 1)), np.zeros(3, np.float32),
+            np.ones(3, np.float32), training=True, data_format="NHWC")
+        check(np.asarray(out_nhwc).transpose(0, 3, 1, 2), np.asarray(out_nchw),
+              rtol=1e-5, atol=1e-6)
+        check(nm2, np.asarray(nm1), rtol=1e-5)
+        check(nv2, np.asarray(nv1), rtol=1e-5)
+
     def test_group_instance_norm(self):
         rs = np.random.RandomState(10)
         x = rs.rand(2, 4, 3, 3).astype(np.float32)

@@ -22,6 +22,20 @@ from paddle_tpu.vision.transforms import functional as TF
 
 # --- models -----------------------------------------------------------------
 
+def test_resnet_nhwc_matches_nchw():
+    # data_format="NHWC" is the TPU-preferred layout (bench.py uses it);
+    # same state_dict must produce identical outputs on transposed input
+    paddle.seed(0)
+    m1 = M.resnet18(num_classes=10)
+    m2 = M.resnet18(num_classes=10, data_format="NHWC")
+    m2.set_state_dict(m1.state_dict())
+    m1.eval(); m2.eval()
+    x = np.random.RandomState(0).uniform(-1, 1, (2, 3, 64, 64)).astype(np.float32)
+    o1 = np.asarray(m1(paddle.to_tensor(x)))
+    o2 = np.asarray(m2(paddle.to_tensor(x.transpose(0, 2, 3, 1))))
+    assert np.abs(o1 - o2).max() < 2e-4
+
+
 def test_lenet_forward():
     net = M.LeNet()
     out = net(np.zeros((2, 1, 28, 28), np.float32))

@@ -1,0 +1,59 @@
+"""Quick ResNet-50 throughput probe on the real chip (dev tool, not the gate).
+
+Usage: python tools/bench_resnet_probe.py [batch] [--f32bn]
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer as popt
+    from paddle_tpu.vision.models import resnet50
+
+    print("devices:", jax.devices())
+    paddle.seed(0)
+    net = resnet50().astype("bfloat16")
+    opt = popt.Momentum(learning_rate=0.1, momentum=0.9, multi_precision=True,
+                        weight_decay=1e-4)
+    model = paddle.Model(net, inputs=["image"], labels=["label"])
+    model.prepare(optimizer=opt,
+                  loss=paddle.nn.CrossEntropyLoss())
+
+    rng = np.random.RandomState(0)
+    import ml_dtypes
+    imgs = rng.uniform(-1, 1, size=(batch, 3, 224, 224)).astype(
+        ml_dtypes.bfloat16)
+    labels = rng.randint(0, 1000, size=(batch, 1)).astype(np.int64)
+
+    def step():
+        loss, _ = model._train_batch_device([imgs], [labels])
+        return loss
+
+    t0 = time.perf_counter()
+    loss = step()
+    print("compile+1st step:", time.perf_counter() - t0, "s")
+    for _ in range(2):
+        loss = step()
+    print("warm loss:", float(loss))
+
+    for w in range(3):
+        t0 = time.perf_counter()
+        for _ in range(10):
+            loss = step()
+        final = float(loss)
+        dt = time.perf_counter() - t0
+        assert np.isfinite(final)
+        print(f"window {w}: {batch * 10 / dt:.1f} img/s ({dt:.3f}s)")
+
+
+if __name__ == "__main__":
+    main()
