@@ -84,8 +84,15 @@ def box_coder(prior_box, prior_box_var, target_box,
         var = jnp.ones((4,), pb.dtype)
     else:
         var = jnp.asarray(prior_box_var, pb.dtype)
+    if var.ndim not in (1, 2) or var.shape[-1] != 4:
+        raise InvalidArgumentError(
+            f"prior_box_var must be a 4-vector or [P, 4], got {var.shape}")
+    if axis not in (0, 1):
+        raise InvalidArgumentError(f"axis must be 0 or 1, got {axis}")
 
     if code_type == "encode_center_size":
+        # encode ignores axis (box_coder_op.h EncodeCenterSize): target [M,4]
+        # x prior [P,4] -> [M,P,4]; a [P,4] prior_box_var divides per column
         tbw = tb[..., 2] - tb[..., 0] + off
         tbh = tb[..., 3] - tb[..., 1] + off
         tbx = tb[..., 0] + tbw * 0.5
@@ -96,13 +103,25 @@ def box_coder(prior_box, prior_box_var, target_box,
         ew = jnp.log(jnp.maximum(tbw[..., :, None] / pbw, _EPS))
         eh = jnp.log(jnp.maximum(tbh[..., :, None] / pbh, _EPS))
         out = jnp.stack([ex, ey, ew, eh], axis=-1)
+        if var.ndim == 2:  # [P, 4] broadcasts over [..., M, P, 4]
+            return out / var
         return out / var.reshape((1,) * (out.ndim - 1) + (4,))
     if code_type == "decode_center_size":
-        t = tb * var
-        cx = t[..., 0] * pbw + pbx
-        cy = t[..., 1] * pbh + pby
-        w = jnp.exp(t[..., 2]) * pbw
-        h = jnp.exp(t[..., 3]) * pbh
+        # decode (box_coder_op.h DecodeCenterSize): target [R, C, 4]; the
+        # prior index is the COLUMN when axis=0 and the ROW when axis=1.
+        if axis == 1 and tb.ndim >= 3:
+            expand = lambda a: a[..., :, None]  # [P] -> [P, 1] (rows)
+        else:
+            expand = lambda a: a
+        if var.ndim == 2 and axis == 1 and tb.ndim >= 3:
+            v = var[:, None, :]  # [R, 4] -> [R, 1, 4] (per-row priors)
+        else:
+            v = var  # 4-vector, or [C, 4] broadcasting over [R, C, 4]
+        t = tb * v
+        cx = t[..., 0] * expand(pbw) + expand(pbx)
+        cy = t[..., 1] * expand(pbh) + expand(pby)
+        w = jnp.exp(t[..., 2]) * expand(pbw)
+        h = jnp.exp(t[..., 3]) * expand(pbh)
         return jnp.stack([cx - w * 0.5, cy - h * 0.5,
                           cx + w * 0.5 - off, cy + h * 0.5 - off], axis=-1)
     raise InvalidArgumentError(
@@ -135,7 +154,9 @@ def _bipartite_match_single(dist, match_type, threshold):
     (col_match, col_dist, _), _ = jax.lax.scan(round_, init, None, length=G)
 
     if match_type == "per_prediction":
-        thr = _EPS if threshold is None else max(float(threshold), _EPS)
+        # the op attr defaults to 0.5 when unset (bipartite_match_op.cc
+        # SetDefault(0.5)); eps here would backfill any positive-IoU prior
+        thr = 0.5 if threshold is None else max(float(threshold), _EPS)
         best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
         best_dist = jnp.max(dist, axis=0)
         backfill = (col_match == -1) & (best_dist >= thr)
@@ -511,6 +532,15 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     lvl = jnp.floor(jnp.log2(jnp.sqrt(area) / refer_scale + 1e-6)
                     + refer_level)
     lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32) - min_level
+    if rois_num is not None:
+        # zero-padding rows (the dense contract, e.g. generate_proposals
+        # output) have +1-pixel area 1 and would route to min_level as real
+        # ROIs; send them to an out-of-range level so they drop everywhere.
+        # rois_num follows the module contract: per-image counts [N] (or a
+        # scalar total) over densely packed rois — padding is a global suffix,
+        # so the valid prefix is sum(rois_num).
+        valid = jnp.sum(jnp.asarray(rois_num, jnp.int32))
+        lvl = jnp.where(jnp.arange(R) < valid, lvl, L)
     multi, counts = [], []
     rank_in_level = jnp.zeros((R,), jnp.int32)
     for i in range(L):
@@ -522,7 +552,10 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
         counts.append(m.sum().astype(jnp.int32))
         rank_in_level = jnp.where(m, rank, rank_in_level)
     offsets = jnp.cumsum(jnp.asarray([0] + [c for c in counts[:-1]]))
-    restore = (offsets[lvl] + rank_in_level).astype(jnp.int32)[:, None]
+    # clip keeps padding rows (lvl == L sentinel) in bounds; their restore
+    # entries are meaningless, as in the reference's LoD contract
+    restore = (offsets[jnp.minimum(lvl, L - 1)]
+               + rank_in_level).astype(jnp.int32)[:, None]
     return multi, restore, counts
 
 
@@ -1069,6 +1102,15 @@ def roi_align(input, rois, pooled_height=1, pooled_width=1,
     x = jnp.asarray(input)
     rois = jnp.asarray(rois, x.dtype)
     R = rois.shape[0]
+    if sampling_ratio <= 0:
+        import warnings
+
+        warnings.warn(
+            "roi_align(sampling_ratio=-1): the reference uses an adaptive "
+            "per-ROI ceil(roi/bin) sample grid, which is data-dependent and "
+            "cannot compile to a static shape; using a fixed 2x2 grid. Set "
+            "sampling_ratio explicitly for exact parity with ported configs.",
+            RuntimeWarning, stacklevel=2)
     grid = int(sampling_ratio) if sampling_ratio > 0 else 2
     batch_ids = _roi_batch_ids(rois_num, R, x.shape[0])
 

@@ -172,6 +172,75 @@ class TestBoxCoder:
                                            targets[g], atol=1e-4)
 
 
+class TestBoxCoderAxisVar:
+    def _boxes(self, rng, n):
+        mins = rng.uniform(0, 5, (n, 2))
+        return np.concatenate([mins, mins + rng.uniform(1, 3, (n, 2))],
+                              1).astype(np.float32)
+
+    def _decode_np(self, priors, var, target, axis):
+        """Transcribes DecodeCenterSize (box_coder_op.h:119-185)."""
+        R, C, _ = target.shape
+        out = np.zeros_like(target)
+        for i in range(R):
+            for j in range(C):
+                k = j if axis == 0 else i
+                pw = priors[k, 2] - priors[k, 0]
+                ph = priors[k, 3] - priors[k, 1]
+                px = priors[k, 0] + pw / 2
+                py = priors[k, 1] + ph / 2
+                v = var if var.ndim == 1 else var[k]
+                cx = v[0] * target[i, j, 0] * pw + px
+                cy = v[1] * target[i, j, 1] * ph + py
+                w = np.exp(v[2] * target[i, j, 2]) * pw
+                h = np.exp(v[3] * target[i, j, 3]) * ph
+                out[i, j] = [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2]
+        return out
+
+    @pytest.mark.parametrize("axis", [0, 1])
+    @pytest.mark.parametrize("per_prior", [False, True])
+    def test_decode_axis_vs_oracle(self, axis, per_prior):
+        rng = np.random.RandomState(7)
+        R, C = 5, 6
+        P = C if axis == 0 else R
+        priors = self._boxes(rng, P)
+        var = (rng.uniform(0.05, 0.3, (P, 4)).astype(np.float32) if per_prior
+               else np.array([0.1, 0.1, 0.2, 0.2], np.float32))
+        target = rng.uniform(-0.5, 0.5, (R, C, 4)).astype(np.float32)
+        out = np.asarray(F.box_coder(priors, var, target,
+                                     code_type="decode_center_size",
+                                     axis=axis))
+        np.testing.assert_allclose(out, self._decode_np(priors, var, target,
+                                                        axis), atol=1e-4)
+
+    def test_encode_per_prior_var(self):
+        rng = np.random.RandomState(8)
+        priors = self._boxes(rng, 6)
+        targets = self._boxes(rng, 4)
+        pvar = rng.uniform(0.05, 0.3, (6, 4)).astype(np.float32)
+        enc = np.asarray(F.box_coder(priors, pvar, targets))
+        enc1 = np.asarray(F.box_coder(priors, None, targets))
+        np.testing.assert_allclose(enc, enc1 / pvar[None], atol=1e-5)
+
+    def test_bad_var_shape_raises(self):
+        rng = np.random.RandomState(9)
+        priors = self._boxes(rng, 3)
+        with pytest.raises(Exception):
+            F.box_coder(priors, np.ones((3, 3), np.float32), priors)
+
+
+class TestBipartiteDefaultThreshold:
+    def test_default_is_half(self):
+        # op attr dist_threshold defaults to 0.5 (bipartite_match_op.cc);
+        # a prior whose best IoU is 0.1 must stay unmatched by default
+        dist = np.array([[0.9, 0.1, 0.0],
+                         [0.0, 0.0, 0.0]], np.float32)
+        idx, _ = F.bipartite_match(dist, "per_prediction")
+        np.testing.assert_array_equal(np.asarray(idx)[0], [0, -1, -1])
+        idx2, _ = F.bipartite_match(dist, "per_prediction", 0.05)
+        np.testing.assert_array_equal(np.asarray(idx2)[0], [0, 0, -1])
+
+
 class TestSsdLoss:
     def _inputs(self, N=2, P=8, C=4, G=3):
         rng = np.random.RandomState(4)
@@ -621,6 +690,24 @@ class TestFpnRouting:
         np.testing.assert_allclose(lvl2[2], rois[3])  # compacted order
         np.testing.assert_allclose(np.asarray(multi[2])[0], rois[2])
         assert (lvl2[3] == 0).all(), "padding rows are zero"
+
+    def test_distribute_rois_num_masks_padding(self):
+        # zero-padded rows (area 1 after the +1 convention) must not be
+        # routed to min_level as real ROIs when rois_num says they are pad
+        rois = np.array([[0, 0, 15, 15], [0, 0, 255, 255],
+                         [0, 0, 0, 0], [0, 0, 0, 0]], np.float32)
+        multi, restore, counts = F.distribute_fpn_proposals(
+            rois, 2, 5, 4, 224, rois_num=2)
+        assert [int(c) for c in counts] == [1, 0, 1, 0]
+        np.testing.assert_array_equal(np.asarray(restore).ravel()[:2], [0, 1])
+        # without rois_num the padding rows (wrongly) land on min_level —
+        # the documented dense-contract hazard this argument exists to fix
+        _, _, counts_no = F.distribute_fpn_proposals(rois, 2, 5, 4, 224)
+        assert int(counts_no[0]) == 3
+        # per-image [N] counts (the module-wide rois_num contract) also work
+        multi2, _, counts2 = F.distribute_fpn_proposals(
+            rois, 2, 5, 4, 224, rois_num=np.array([1, 1]))
+        assert [int(c) for c in counts2] == [1, 0, 1, 0]
 
     def test_collect_top_k_across_levels(self):
         rois = np.array([[0, 0, 15, 15], [0, 0, 63, 63],

@@ -1,6 +1,6 @@
 """Quick ResNet-50 throughput probe on the real chip (dev tool, not the gate).
 
-Usage: python tools/bench_resnet_probe.py [batch] [--f32bn]
+Usage: python tools/bench_resnet_probe.py [batch]
 """
 import os
 import sys
