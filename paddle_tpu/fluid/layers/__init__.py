@@ -644,11 +644,7 @@ _STATIC_ONLY = {
     "BasicDecoder": "subclass paddle.nn.Decoder",
     # detection long tail
     "multi_box_head": "compose conv heads + prior_box",
-    "rpn_target_assign": "two-stage detectors not implemented",
-    "retinanet_target_assign": "two-stage detectors not implemented",
     "roi_perspective_transform": "not implemented",
-    "generate_proposal_labels": "two-stage detectors not implemented",
-    "generate_mask_labels": "two-stage detectors not implemented",
     "polygon_box_transform": "not implemented",
     "retinanet_detection_output": "detection_output",
     # misc losses

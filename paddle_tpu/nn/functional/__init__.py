@@ -9,6 +9,7 @@ from .attention import *  # noqa: F401,F403
 from .crf import *  # noqa: F401,F403
 from .extension import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
+from .detection_targets import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .deform_conv import *  # noqa: F401,F403
 from ...tensor.manipulation import pad  # noqa: F401  # paddle exposes pad under nn.functional too

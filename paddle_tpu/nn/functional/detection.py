@@ -518,27 +518,44 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     clip(⌊log2(√area/refer_scale + 1e-6)⌋ + refer_level, min, max) with
     +1-pixel areas.
 
-    Dense contract: fpn_rois ``[R, 4]`` → (list of ``[R, 4]``
-    zero-padded per-level tensors, restore_ind ``[R, 1]`` mapping each
-    input row to its position in the level-major compaction, list of
-    per-level valid counts — the dense stand-in for the per-level LoD).
+    Dense contract: fpn_rois ``[R, 4]`` packed (valid rows first, padding
+    a global suffix — ``rois_num`` scalar or per-image counts summing to
+    the valid prefix), OR ``[N, K, 4]`` per-image padded blocks straight
+    from :func:`generate_proposals` with ``rois_num [N]`` per-block valid
+    counts.  Returns (list of ``[R, 4]`` zero-padded per-level tensors,
+    restore_ind ``[R, 1]`` mapping each (flattened) input row to its
+    position in the level-major compaction, list of per-level valid
+    counts — the dense stand-in for the per-level LoD).
     """
     rois = jnp.asarray(fpn_rois)
-    R = rois.shape[0]
     L = max_level - min_level + 1
+    if rois.ndim == 3:
+        # per-image padded blocks (generate_proposals layout): mask each
+        # block's own padding tail before flattening
+        NB, K = rois.shape[0], rois.shape[1]
+        if rois_num is None:
+            block_valid = jnp.ones((NB, K), bool)
+        else:
+            counts_in = jnp.asarray(rois_num, jnp.int32).reshape(NB)
+            block_valid = jnp.arange(K)[None, :] < counts_in[:, None]
+        valid_mask = block_valid.reshape(-1)
+        rois = rois.reshape(-1, 4)
+    else:
+        valid_mask = None
+    R = rois.shape[0]
     w = rois[:, 2] - rois[:, 0]
     h = rois[:, 3] - rois[:, 1]
     area = jnp.where((w < 0) | (h < 0), 0.0, (w + 1) * (h + 1))
     lvl = jnp.floor(jnp.log2(jnp.sqrt(area) / refer_scale + 1e-6)
                     + refer_level)
     lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32) - min_level
-    if rois_num is not None:
-        # zero-padding rows (the dense contract, e.g. generate_proposals
-        # output) have +1-pixel area 1 and would route to min_level as real
-        # ROIs; send them to an out-of-range level so they drop everywhere.
-        # rois_num follows the module contract: per-image counts [N] (or a
-        # scalar total) over densely packed rois — padding is a global suffix,
-        # so the valid prefix is sum(rois_num).
+    if valid_mask is not None:
+        # zero-padding rows have +1-pixel area 1 and would route to
+        # min_level as real ROIs; send them to an out-of-range level so
+        # they drop from every level and count
+        lvl = jnp.where(valid_mask, lvl, L)
+    elif rois_num is not None:
+        # packed contract: padding is a global suffix of sum(rois_num)
         valid = jnp.sum(jnp.asarray(rois_num, jnp.int32))
         lvl = jnp.where(jnp.arange(R) < valid, lvl, L)
     multi, counts = [], []

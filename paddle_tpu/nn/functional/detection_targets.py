@@ -1,0 +1,637 @@
+"""Two-stage detector training target ops (Faster/Mask-RCNN, RetinaNet).
+
+Capability parity (reference):
+  rpn_target_assign        python/paddle/fluid/layers/detection.py:310 over
+                           operators/detection/rpn_target_assign_op.cc
+  retinanet_target_assign  detection.py:69, same kernel's GetAllFgBgGt
+  generate_proposal_labels detection.py:2590 over
+                           operators/detection/generate_proposal_labels_op.cc
+  generate_mask_labels     detection.py:2742 over
+                           operators/detection/generate_mask_labels_op.cc
+
+TPU-native design: the reference kernels are CPU loops with dynamic-length
+outputs (LoD) and reservoir sampling from a nondeterministic engine.  Here
+every op is a dense, vmapped, jit-able computation with FIXED capacities:
+
+  * ragged per-image ground truth arrives as zero-padded ``[N, G, ...]``
+    tensors plus a ``gt_num [N]`` count (the dense stand-in for LoD used
+    across this package);
+  * subsampling quotas are filled by top-k over PRNG-keyed candidate scores
+    (uniform over candidate sets, like reservoir sampling) — bit-identical
+    streams with the reference's ``std::minstd_rand`` are impossible (it
+    seeds from ``std::random_device``, so even two reference runs differ);
+    with ``use_random=False`` both implementations keep the first k
+    candidates in index order and agree exactly;
+  * variable-length outputs become capacity-sized tensors with padding rows
+    marked by label ``-1`` (classification) and zero weights (regression),
+    so downstream losses mask them with ``ignore_index=-1`` / the returned
+    weights instead of dynamic shapes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.errors import InvalidArgumentError
+from .detection import iou_similarity
+
+__all__ = [
+    "rpn_target_assign", "retinanet_target_assign",
+    "generate_proposal_labels", "generate_mask_labels",
+    "rasterize_polygon",
+]
+
+_EPS_TIE = 1e-5  # ScoreAssign epsilon (rpn_target_assign_op.cc:180)
+
+
+def _require_key(key, use_random, name):
+    del name
+    if key is not None:
+        return key
+    if not use_random:
+        return jax.random.PRNGKey(0)  # unused: sampling is first-k
+    from ...framework import random as _random
+
+    return _random.split_key()
+
+
+def _rank_desc(score):
+    """Rank of each element in a descending sort (0 = largest)."""
+    order = jnp.argsort(-score)
+    return jnp.zeros_like(order).at[order].set(jnp.arange(score.shape[0]))
+
+
+def _candidate_scores(mask, key, use_random):
+    """Random (or index-order) priority scores over a candidate mask;
+    non-candidates get -inf.  Taking the top-k of these is a uniform
+    k-subset of the candidates — ReservoirSampling's distribution; with
+    use_random=False the first k candidates win, exactly as the
+    reference's ``resize(num)``."""
+    m = mask.shape[0]
+    if use_random:
+        score = jax.random.uniform(key, (m,))
+    else:
+        score = -jnp.arange(m, dtype=jnp.float32)  # earlier index wins
+    return jnp.where(mask, score, -jnp.inf)
+
+
+def _sample_k(mask, k, key, use_random):
+    """Uniformly choose ≤k elements of a boolean candidate mask."""
+    k = min(int(k), mask.shape[0])
+    if k <= 0:
+        return jnp.zeros_like(mask)
+    score = _candidate_scores(mask, key, use_random)
+    _, idx = jax.lax.top_k(score, k)
+    sel = jnp.zeros_like(mask).at[idx].set(True)
+    return sel & mask  # drop -inf winners when candidates < k
+
+
+def _sample_dynamic(mask, k_dynamic, key, use_random):
+    """Like :func:`_sample_k` but with a traced (data-dependent) quota:
+    keep the candidates whose random rank is below ``k_dynamic``."""
+    score = _candidate_scores(mask, key, use_random)
+    return mask & (_rank_desc(score) < k_dynamic)
+
+
+def _compact_indices(mask, capacity, priority=None):
+    """Pack the True positions of ``mask [M]`` into ``capacity`` slots.
+
+    Returns (src [capacity] int32 — original index per slot, -1 padding,
+    valid [capacity] bool).  Order: ascending ``priority`` (default: index
+    order).  The standard dense compaction used across the detection ops.
+    """
+    m = mask.shape[0]
+    if priority is None:
+        order = jnp.arange(m)
+    else:
+        # stable order by (priority, index): scale priority into the gaps
+        order = priority * m + jnp.arange(m)
+    key = jnp.where(mask, order, jnp.iinfo(jnp.int32).max)
+    rank = jnp.argsort(key)  # selected first, by priority then index
+    src = rank[:capacity].astype(jnp.int32)
+    valid = jnp.arange(capacity) < mask.sum()
+    return jnp.where(valid, src, -1), valid
+
+
+def _box_to_delta(ex, gt, weights=None):
+    """BoxToDelta (bbox_util.h:56): +1-pixel center-size encoding of gt
+    against ex boxes; optional per-coordinate weight division."""
+    ew = ex[..., 2] - ex[..., 0] + 1.0
+    eh = ex[..., 3] - ex[..., 1] + 1.0
+    ex_x = ex[..., 0] + 0.5 * ew
+    ex_y = ex[..., 1] + 0.5 * eh
+    gw = gt[..., 2] - gt[..., 0] + 1.0
+    gh = gt[..., 3] - gt[..., 1] + 1.0
+    gx = gt[..., 0] + 0.5 * gw
+    gy = gt[..., 1] + 0.5 * gh
+    d = jnp.stack([(gx - ex_x) / ew, (gy - ex_y) / eh,
+                   jnp.log(gw / ew), jnp.log(gh / eh)], axis=-1)
+    if weights is not None:
+        d = d / jnp.asarray(weights, d.dtype)
+    return d
+
+
+def _rpn_assign_one(anchors, gt, is_crowd, gt_count, im_info, cfg, key):
+    """Per-image ScoreAssign + sampling (rpn_target_assign_op.cc:172-275).
+
+    Returns per-capacity-slot gather indices/targets; see caller.
+    """
+    (batch_size, straddle, pos_ov, neg_ov, fg_frac, use_random) = cfg
+    M = anchors.shape[0]
+    G = gt.shape[0]
+    im_h, im_w, im_scale = im_info[0], im_info[1], im_info[2]
+
+    if straddle >= 0:
+        inside = ((anchors[:, 0] >= -straddle) & (anchors[:, 1] >= -straddle)
+                  & (anchors[:, 2] < im_w + straddle)
+                  & (anchors[:, 3] < im_h + straddle))
+    else:
+        inside = jnp.ones((M,), bool)
+
+    valid_gt = (jnp.arange(G) < gt_count) & (is_crowd == 0)
+    gt_scaled = gt * im_scale
+
+    iou = iou_similarity(anchors, gt_scaled, box_normalized=False)  # [M, G]
+    pair_ok = inside[:, None] & valid_gt[None, :]
+    iou = jnp.where(pair_ok, iou, -1.0)
+
+    a2g_max = jnp.max(iou, axis=1)            # [M]; -1 where outside
+    a2g_arg = jnp.argmax(iou, axis=1)         # [M]
+    g2a_max = jnp.max(iou, axis=0)            # [G]; -1 for invalid gts
+
+    tie = pair_ok & (jnp.abs(iou - g2a_max[None, :]) < _EPS_TIE)
+    fg_cand = inside & (tie.any(axis=1) | (a2g_max >= pos_ov))
+    bg_cand = inside & (a2g_max < neg_ov)
+
+    key_fg, key_bg = jax.random.split(key)
+    if batch_size < 0:
+        # RetinaNet call shape (kernel passes batch=-1, fraction=-1):
+        # every candidate trains, no subsampling
+        fg_sel = fg_cand
+        bg_sel = bg_cand
+        fg_fake_num = fg_sel.sum()
+        F_cap = S_cap = M
+    else:
+        fg_quota = int(fg_frac * batch_size)
+        fg_sel = _sample_k(fg_cand, fg_quota, key_fg, use_random)
+        fg_fake_num = fg_sel.sum()
+        # bg quota is dynamic: batch_size - sampled fg (op.cc:226-233)
+        bg_sel = _sample_dynamic(bg_cand, batch_size - fg_fake_num,
+                                 key_bg, use_random)
+        F_cap = max(fg_quota, 1)
+        S_cap = batch_size
+
+    # the reference's two-directions overwrite: a sampled bg that was also
+    # sampled fg flips to label 0 and its loc slot becomes a zero-weight
+    # "fake" pointing at an arbitrary fg anchor (weight 0 ⇒ no gradient)
+    fake = fg_sel & bg_sel
+    real_fg = fg_sel & ~bg_sel
+    # loc slots: fakes first (priority 0), then real fg (priority 1)
+    loc_src, loc_valid = _compact_indices(
+        fake | real_fg, F_cap, priority=jnp.where(fake, 0, 1))
+    fg_first = jnp.argmax(fg_sel)  # substitute anchor for fake slots
+    is_fake_slot = loc_valid & fake[jnp.clip(loc_src, 0, M - 1)]
+    loc_anchor_idx = jnp.where(is_fake_slot, fg_first,
+                               jnp.clip(loc_src, 0, M - 1))
+    gt_idx = a2g_arg[loc_anchor_idx]
+    tgt_bbox = _box_to_delta(anchors[loc_anchor_idx], gt_scaled[gt_idx])
+    inside_w = (loc_valid & ~is_fake_slot).astype(anchors.dtype)[:, None]
+    inside_w = jnp.broadcast_to(inside_w, (F_cap, 4))
+    tgt_bbox = jnp.where(loc_valid[:, None], tgt_bbox, 0.0)
+    loc_index = jnp.where(loc_valid, loc_anchor_idx, 0).astype(jnp.int32)
+
+    # score slots: real fg (label 1) then bg (label 0)
+    score_src, score_valid = _compact_indices(
+        real_fg | bg_sel, S_cap, priority=jnp.where(real_fg, 0, 1))
+    safe_score = jnp.clip(score_src, 0, M - 1)
+    label = jnp.where(real_fg[safe_score], 1, 0)
+    label = jnp.where(score_valid, label, -1).astype(jnp.int32)
+    score_index = jnp.where(score_valid, safe_score, 0).astype(jnp.int32)
+
+    return (loc_index, loc_valid, tgt_bbox, inside_w,
+            score_index, score_valid, label, a2g_arg, real_fg, fg_fake_num)
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd, im_info, gt_num=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True, key=None):
+    """RPN training target assignment (ref: detection.py:310 over
+    rpn_target_assign_op.cc).
+
+    Dense contract: ``bbox_pred [N, M, 4]``, ``cls_logits [N, M, 1]``,
+    ``anchor_box [M, 4]``, ``gt_boxes [N, G, 4]`` zero-padded with
+    ``gt_num [N]`` valid counts (omitted → all G), ``is_crowd [N, G]``
+    int, ``im_info [N, 3]`` (h, w, scale).
+
+    Returns the reference 5-tuple with fixed capacities
+    ``F = N*max(int(rpn_fg_fraction*rpn_batch_size_per_im), 1)`` and
+    ``S = N*rpn_batch_size_per_im``:
+    (predicted_scores ``[S, 1]``, predicted_location ``[F, 4]``,
+    target_label ``[S, 1]`` — padding rows are ``-1`` (mask the
+    classification loss with ``ignore_index=-1``), target_bbox ``[F, 4]``,
+    bbox_inside_weight ``[F, 4]`` — 0 on fake-fg and padding rows).
+    """
+    bbox_pred = jnp.asarray(bbox_pred)
+    cls_logits = jnp.asarray(cls_logits)
+    anchors = jnp.asarray(anchor_box)
+    gt_boxes = jnp.asarray(gt_boxes)
+    if bbox_pred.ndim != 3 or gt_boxes.ndim != 3:
+        raise InvalidArgumentError(
+            "rpn_target_assign dense contract wants batched bbox_pred "
+            f"[N,M,4] and gt_boxes [N,G,4]; got {bbox_pred.shape}, "
+            f"{gt_boxes.shape}")
+    N, M = bbox_pred.shape[0], anchors.shape[0]
+    G = gt_boxes.shape[1]
+    is_crowd = jnp.asarray(is_crowd).reshape(N, G)
+    im_info = jnp.asarray(im_info, anchors.dtype)
+    gt_count = (jnp.full((N,), G, jnp.int32) if gt_num is None
+                else jnp.asarray(gt_num, jnp.int32))
+    key = _require_key(key, use_random, "rpn_target_assign")
+    cfg = (int(rpn_batch_size_per_im), float(rpn_straddle_thresh),
+           float(rpn_positive_overlap), float(rpn_negative_overlap),
+           float(rpn_fg_fraction), bool(use_random))
+
+    keys = jax.random.split(key, N)
+    outs = jax.vmap(
+        lambda g, c, n, ii, k: _rpn_assign_one(anchors, g, c, n, ii, cfg, k)
+    )(gt_boxes, is_crowd, gt_count, im_info, keys)
+    (loc_index, loc_valid, tgt_bbox, inside_w,
+     score_index, score_valid, label, _, _, _) = outs
+
+    F_cap = loc_index.shape[1]
+    S_cap = score_index.shape[1]
+    # unflatten gathers: per-image anchor index + i*M (the reference's
+    # "Add anchor offset" step), then gather from the flattened preds
+    img_off_loc = (jnp.arange(N)[:, None] * M + loc_index).reshape(-1)
+    img_off_score = (jnp.arange(N)[:, None] * M + score_index).reshape(-1)
+    pred_loc = bbox_pred.reshape(N * M, 4)[img_off_loc]
+    pred_scores = cls_logits.reshape(N * M, -1)[img_off_score][:, :1]
+    pred_loc = jnp.where(loc_valid.reshape(-1)[:, None], pred_loc, 0.0)
+    pred_scores = jnp.where(score_valid.reshape(-1)[:, None], pred_scores, 0.0)
+
+    return (pred_scores, pred_loc,
+            label.reshape(N * S_cap, 1),
+            tgt_bbox.reshape(N * F_cap, 4),
+            inside_w.reshape(N * F_cap, 4))
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            num_classes=1, gt_num=None,
+                            positive_overlap=0.5, negative_overlap=0.4,
+                            key=None):
+    """RetinaNet target assignment (ref: detection.py:69 over
+    rpn_target_assign_op.cc GetAllFgBgGt): like the RPN assigner but with
+    NO subsampling (every fg/bg anchor trains) and class labels from
+    ``gt_labels`` instead of binary objectness.
+
+    Dense contract as :func:`rpn_target_assign` plus ``gt_labels [N, G]``
+    int and ``cls_logits [N, M, num_classes]``.  Capacities are ``M`` per
+    image (no quota).  Returns (predicted_scores ``[N*M, num_classes]``,
+    predicted_location ``[N*M, 4]``, target_label ``[N*M, 1]`` with -1
+    padding, target_bbox ``[N*M, 4]``, bbox_inside_weight ``[N*M, 4]``,
+    fg_num ``[N, 1]`` — per-image foreground count + 1, the reference's
+    focal-loss normalizer).
+    """
+    bbox_pred = jnp.asarray(bbox_pred)
+    cls_logits = jnp.asarray(cls_logits)
+    anchors = jnp.asarray(anchor_box)
+    gt_boxes = jnp.asarray(gt_boxes)
+    N, M = bbox_pred.shape[0], anchors.shape[0]
+    G = gt_boxes.shape[1]
+    gt_labels = jnp.asarray(gt_labels).reshape(N, G)
+    is_crowd = jnp.asarray(is_crowd).reshape(N, G)
+    im_info = jnp.asarray(im_info, anchors.dtype)
+    gt_count = (jnp.full((N,), G, jnp.int32) if gt_num is None
+                else jnp.asarray(gt_num, jnp.int32))
+    key = _require_key(key, False, "retinanet_target_assign")
+
+    def one(gt, lbls, crowd, n, ii, k):
+        # batch=-1/frac=-1 ⇒ no sampling (kernel's RetinaNet call), so fg =
+        # all candidates, bg = all candidates; a tie-fg with iou < neg_ov
+        # still flips to bg (the same two-directions overwrite)
+        cfg = (-1, -1.0, float(positive_overlap), float(negative_overlap),
+               -1.0, False)
+        (loc_index, loc_valid, tgt_bbox, inside_w, score_index, score_valid,
+         label, a2g_arg, real_fg, fg_fake_num) = _rpn_assign_one(
+            anchors, gt, crowd, n, ii, cfg, k)
+        # class labels: fg rows take the matched gt's label
+        safe = jnp.clip(score_index, 0, M - 1)
+        cls = jnp.where(real_fg[safe], lbls[a2g_arg[safe]], 0)
+        label = jnp.where(label == 1, cls, label).astype(jnp.int32)
+        return (loc_index, loc_valid, tgt_bbox, inside_w, score_index,
+                score_valid, label, fg_fake_num)
+
+    keys = jax.random.split(key, N)
+    (loc_index, loc_valid, tgt_bbox, inside_w, score_index, score_valid,
+     label, fg_fake_num) = jax.vmap(one)(
+        gt_boxes, gt_labels, is_crowd, gt_count, im_info, keys)
+
+    img_off_loc = (jnp.arange(N)[:, None] * M + loc_index).reshape(-1)
+    img_off_score = (jnp.arange(N)[:, None] * M + score_index).reshape(-1)
+    pred_loc = bbox_pred.reshape(N * M, 4)[img_off_loc]
+    pred_scores = cls_logits.reshape(N * M, -1)[img_off_score]
+    pred_loc = jnp.where(loc_valid.reshape(-1)[:, None], pred_loc, 0.0)
+    pred_scores = jnp.where(score_valid.reshape(-1)[:, None], pred_scores, 0.0)
+    fg_num = (fg_fake_num + 1).astype(jnp.int32).reshape(N, 1)
+    return (pred_scores, pred_loc, label.reshape(-1, 1),
+            tgt_bbox.reshape(-1, 4), inside_w.reshape(-1, 4), fg_num)
+
+
+def _proposal_labels_one(rois, roi_count, gt_cls, crowd, gt, gt_count,
+                         im_info, max_ov_in, cfg, key):
+    """SampleRoisForOneImage (generate_proposal_labels_op.cc:305-446)."""
+    (B, fg_frac, fg_thresh, bg_hi, bg_lo, reg_w, C, use_random,
+     cascade, agnostic) = cfg
+    R, G = rois.shape[0], gt.shape[0]
+    P = G + R
+    im_scale = im_info[2]
+
+    rois = rois / im_scale
+    valid_gt = jnp.arange(G) < gt_count
+    valid_roi = jnp.arange(R) < roi_count
+    if cascade:
+        # FilterRoIs (op.cc:40): keep rois with positive +1-size and
+        # max_overlap < 1 from the previous stage
+        keep = ((rois[:, 2] - rois[:, 0] + 1) > 0) \
+            & ((rois[:, 3] - rois[:, 1] + 1) > 0) & (max_ov_in < 1.0)
+        valid_roi = valid_roi & keep
+
+    boxes = jnp.concatenate([gt, rois], axis=0)        # [P, 4]
+    valid_row = jnp.concatenate([valid_gt, valid_roi])
+    iou = iou_similarity(boxes, gt, box_normalized=False)  # [P, G]
+    iou = jnp.where(valid_row[:, None] & valid_gt[None, :], iou, -1.0)
+    max_ov = jnp.max(iou, axis=1)                      # [P]
+    # crowd gt rows are forced out of both pools (max = -1)
+    row_crowd = jnp.concatenate([(crowd != 0) & valid_gt,
+                                 jnp.zeros((R,), bool)])
+    max_ov = jnp.where(row_crowd, -1.0, max_ov)
+
+    fg_cand = max_ov >= fg_thresh
+    # if/elif in the kernel: an unsampled fg candidate never becomes bg,
+    # even when fg_thresh < bg_thresh_hi puts its overlap in the bg band
+    bg_cand = ~fg_cand & (max_ov >= bg_lo) & (max_ov < bg_hi)
+    # mapped gt: first column within eps of the row max (op.cc:186-193)
+    tie = (jnp.abs(max_ov[:, None] - iou) < _EPS_TIE) & valid_gt[None, :]
+    mapped_gt = jnp.argmax(tie, axis=1)
+
+    key_fg, key_bg = jax.random.split(key)
+    if cascade:
+        fg_sel, bg_sel = fg_cand, bg_cand
+        cap = P
+    else:
+        fg_quota = int(B * fg_frac)
+        fg_sel = _sample_k(fg_cand, fg_quota, key_fg, use_random)
+        bg_sel = _sample_dynamic(bg_cand, B - fg_sel.sum(), key_bg,
+                                 use_random)
+        cap = B
+
+    # fg rows first, then bg rows
+    src, valid = _compact_indices(fg_sel | bg_sel, cap,
+                                  priority=jnp.where(fg_sel, 0, 1))
+    safe = jnp.clip(src, 0, P - 1)
+    is_fg = valid & fg_sel[safe]
+    sampled_boxes = boxes[safe]
+    g_idx = mapped_gt[safe]
+    labels = jnp.where(is_fg, gt_cls[g_idx], 0)
+    labels = jnp.where(valid, labels, -1).astype(jnp.int32)
+    sampled_max_ov = jnp.where(valid, max_ov[safe], 0.0)
+
+    deltas = _box_to_delta(sampled_boxes, gt[g_idx], reg_w)
+    # expand to [cap, 4C] at the class slot (op.cc:415-436)
+    slot = jnp.where(is_fg, jnp.where(agnostic, 1, labels), 0)
+    onehot = jax.nn.one_hot(slot, C, dtype=deltas.dtype) \
+        * is_fg[:, None].astype(deltas.dtype)            # [cap, C]
+    bbox_targets = (onehot[:, :, None] * deltas[:, None, :]).reshape(cap,
+                                                                     4 * C)
+    w = jnp.repeat(onehot, 4, axis=1)                    # [cap, 4C]
+    rois_out = jnp.where(valid[:, None], sampled_boxes * im_scale, 0.0)
+    return (rois_out, labels, bbox_targets, w, w, sampled_max_ov,
+            (fg_sel | bg_sel).sum().astype(jnp.int32))
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, rois_num=None, gt_num=None,
+                             batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.25, bg_thresh_hi=0.5,
+                             bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False,
+                             max_overlap=None, return_max_overlap=False,
+                             key=None):
+    """Sample RoIs and build RCNN-head training targets (ref:
+    detection.py:2590 over generate_proposal_labels_op.cc).
+
+    Dense contract: ``rpn_rois [N, R, 4]`` zero-padded with ``rois_num
+    [N]`` valid counts, ``gt_classes/is_crowd [N, G]``, ``gt_boxes
+    [N, G, 4]`` with ``gt_num [N]``, ``im_info [N, 3]``.  Ground-truth
+    boxes join the proposal pool (op.cc:352 ``Concat(gt_boxes, rois)``).
+
+    Capacity per image: ``batch_size_per_im`` (or ``G+R`` when
+    ``is_cascade_rcnn`` — no sampling in cascade mode).  Returns
+    (rois ``[N*B, 4]``, labels_int32 ``[N*B, 1]`` with -1 padding,
+    bbox_targets ``[N*B, 4*class_nums]``, bbox_inside_weights,
+    bbox_outside_weights, [max_overlap ``[N*B]``]); classification loss
+    should use ``ignore_index=-1`` and the regression loss the weights.
+    """
+    if class_nums is None:
+        raise InvalidArgumentError("class_nums is required")
+    rois = jnp.asarray(rpn_rois)
+    gt_boxes = jnp.asarray(gt_boxes)
+    if rois.ndim != 3 or gt_boxes.ndim != 3:
+        raise InvalidArgumentError(
+            "generate_proposal_labels dense contract wants rpn_rois "
+            f"[N,R,4] and gt_boxes [N,G,4]; got {rois.shape}, "
+            f"{gt_boxes.shape}")
+    N, R = rois.shape[0], rois.shape[1]
+    G = gt_boxes.shape[1]
+    gt_classes = jnp.asarray(gt_classes).reshape(N, G)
+    is_crowd = jnp.asarray(is_crowd).reshape(N, G)
+    im_info = jnp.asarray(im_info, rois.dtype)
+    roi_count = (jnp.full((N,), R, jnp.int32) if rois_num is None
+                 else jnp.asarray(rois_num, jnp.int32))
+    gt_count = (jnp.full((N,), G, jnp.int32) if gt_num is None
+                else jnp.asarray(gt_num, jnp.int32))
+    max_ov_in = (jnp.zeros((N, R), rois.dtype) if max_overlap is None
+                 else jnp.asarray(max_overlap).reshape(N, R))
+    if is_cascade_rcnn and max_overlap is None:
+        raise InvalidArgumentError(
+            "max_overlap is required when is_cascade_rcnn=True "
+            "(generate_proposal_labels_op.cc InferShape)")
+    key = _require_key(key, use_random, "generate_proposal_labels")
+    cfg = (int(batch_size_per_im), float(fg_fraction), float(fg_thresh),
+           float(bg_thresh_hi), float(bg_thresh_lo),
+           tuple(float(w) for w in bbox_reg_weights), int(class_nums),
+           bool(use_random), bool(is_cascade_rcnn), bool(is_cls_agnostic))
+
+    keys = jax.random.split(key, N)
+    outs = jax.vmap(
+        lambda r, rc, gc, cr, g, gn, ii, mo, k: _proposal_labels_one(
+            r, rc, gc, cr, g, gn, ii, mo, cfg, k)
+    )(rois, roi_count, gt_classes, is_crowd, gt_boxes, gt_count, im_info,
+      max_ov_in, keys)
+    (rois_out, labels, tgt, in_w, out_w, max_ov, counts) = outs
+    cap = rois_out.shape[1]
+    res = (rois_out.reshape(N * cap, 4), labels.reshape(N * cap, 1),
+           tgt.reshape(N * cap, -1), in_w.reshape(N * cap, -1),
+           out_w.reshape(N * cap, -1))
+    if return_max_overlap:
+        return res + (max_ov.reshape(N * cap),)
+    return res
+
+
+def rasterize_polygon(verts, nv, resolution, box):
+    """Fill one polygon onto a ``resolution²`` grid relative to ``box``.
+
+    verts ``[V, 2]`` (x, y) with ``nv`` valid vertices; pixel centers
+    inside the polygon (even-odd crossing rule) are 1.  This replaces the
+    reference's COCO 5x-upsampled boundary-trace fill (mask_util.cc:45)
+    with a vectorized point-in-polygon test — identical on axis-aligned
+    shapes, ±1 boundary pixel on slanted edges.
+    """
+    M = int(resolution)
+    V = verts.shape[0]
+    x0, y0 = box[0], box[1]
+    w = jnp.maximum(box[2] - box[0], 1.0)
+    h = jnp.maximum(box[3] - box[1], 1.0)
+    px = (verts[:, 0] - x0) * M / w
+    py = (verts[:, 1] - y0) * M / h
+    # pixel centers
+    cx = jnp.arange(M) + 0.5
+    cy = jnp.arange(M) + 0.5
+    gx, gy = jnp.meshgrid(cx, cy)           # [M, M] (row = y)
+    idx = jnp.arange(V)
+    nxt = jnp.where(idx + 1 >= nv, 0, idx + 1)
+    valid_edge = idx < nv
+    x1, y1 = px[idx], py[idx]
+    x2, y2 = px[nxt], py[nxt]
+    # crossing-number: edge crosses the horizontal ray at gy
+    gyb = gy[None, :, :]
+    gxb = gx[None, :, :]
+    y1b, y2b = y1[:, None, None], y2[:, None, None]
+    x1b, x2b = x1[:, None, None], x2[:, None, None]
+    cond = ((y1b > gyb) != (y2b > gyb)) & valid_edge[:, None, None]
+    t = (gyb - y1b) / jnp.where(y2b == y1b, 1.0, y2b - y1b)
+    xi = x1b + t * (x2b - x1b)
+    cross = cond & (gxb < xi)
+    return (jnp.sum(cross, axis=0) % 2).astype(jnp.int32)  # [M, M]
+
+
+def _mask_labels_one(im_info, gt_cls, crowd, polys, poly_nv, poly_count,
+                     gt_count, rois, labels, roi_count, C, M):
+    """SampleMaskForOneImage (generate_mask_labels_op.cc:138-300), dense."""
+    G, Pp = polys.shape[0], polys.shape[1]
+    R = rois.shape[0]
+    im_scale = im_info[2]
+
+    valid_gt = (jnp.arange(G) < gt_count) & (gt_cls > 0) & (crowd == 0)
+    # Poly2Boxes: bbox of all polys of each gt
+    vx = polys[..., 0]
+    vy = polys[..., 1]
+    vmask = (jnp.arange(polys.shape[2])[None, None, :] < poly_nv[..., None]) \
+        & (jnp.arange(Pp)[None, :, None] < poly_count[:, None, None])
+    big = jnp.asarray(jnp.inf, vx.dtype)
+    bx0 = jnp.min(jnp.where(vmask, vx, big), axis=(1, 2))
+    by0 = jnp.min(jnp.where(vmask, vy, big), axis=(1, 2))
+    bx1 = jnp.max(jnp.where(vmask, vx, -big), axis=(1, 2))
+    by1 = jnp.max(jnp.where(vmask, vy, -big), axis=(1, 2))
+    poly_boxes = jnp.stack([bx0, by0, bx1, by1], axis=-1)  # [G, 4]
+    poly_boxes = jnp.where(valid_gt[:, None], poly_boxes, 0.0)
+
+    valid_roi = jnp.arange(R) < roi_count
+    fg = valid_roi & (labels > 0)
+    fg_num = fg.sum()
+    src, valid = _compact_indices(fg, R)
+    safe = jnp.clip(src, 0, R - 1)
+    rois_fg = rois[safe] / im_scale
+
+    ov = iou_similarity(rois_fg, poly_boxes, box_normalized=False)
+    ov = jnp.where(valid_gt[None, :], ov, -big)
+    g_for_roi = jnp.argmax(ov, axis=1)                    # [R]
+
+    def mask_for(gi, roi):
+        def poly_mask(p):
+            return rasterize_polygon(polys[gi, p], poly_nv[gi, p], M, roi)
+        masks = jax.vmap(poly_mask)(jnp.arange(Pp))
+        present = (jnp.arange(Pp) < poly_count[gi])[:, None, None]
+        return (jnp.sum(jnp.where(present, masks, 0), axis=0) > 0)
+
+    masks = jax.vmap(mask_for)(g_for_roi, rois_fg)        # [R, M, M] bool
+    cls = jnp.where(valid, labels[safe], 0)
+
+    # no-fg fallback (op.cc:260-284): one all-ignore mask on roi 0, class 0
+    no_fg = fg_num == 0
+    count = jnp.maximum(fg_num, 1)
+    roi0 = rois[0] / im_scale
+    rois_fg = jnp.where(no_fg, jnp.broadcast_to(roi0, rois_fg.shape), rois_fg)
+    first_bg = jnp.argmax(valid_roi & (labels == 0))
+    has_mask_idx = jnp.where(valid, safe, 0)
+    has_mask_idx = jnp.where(no_fg,
+                             jnp.full_like(has_mask_idx, first_bg),
+                             has_mask_idx).astype(jnp.int32)
+
+    # ExpandMaskTarget: [R, C*M*M], -1 everywhere except the class slot
+    flat = masks.reshape(R, M * M).astype(jnp.int32)
+    flat = jnp.where(no_fg, -1, flat)  # fallback mask is all ignore
+    onehot = jax.nn.one_hot(cls, C, dtype=jnp.int32)      # [R, C]
+    expand = jnp.where((onehot[:, :, None] > 0) & (cls[:, None, None] > 0),
+                       flat[:, None, :], -1).reshape(R, C * M * M)
+    row_valid = jnp.arange(R) < count
+    expand = jnp.where(row_valid[:, None], expand, -1)
+    rois_out = jnp.where(row_valid[:, None], rois_fg * im_scale, 0.0)
+    return rois_out, has_mask_idx, expand, count.astype(jnp.int32)
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution,
+                         gt_num=None, rois_num=None, poly_vertex_num=None,
+                         poly_num=None):
+    """Mask-RCNN mask head targets (ref: detection.py:2742 over
+    generate_mask_labels_op.cc).
+
+    Dense contract: ``gt_segms [N, G, Pp, V, 2]`` polygon vertex arrays
+    (zero-padded), ``poly_vertex_num [N, G, Pp]`` valid vertices per
+    polygon, ``poly_num [N, G]`` polygons per gt, ``rois [N, R, 4]`` +
+    ``labels_int32 [N, R]`` from :func:`generate_proposal_labels` (label
+    -1 padding allowed), per-image counts as elsewhere.
+
+    Returns (mask_rois ``[N*R, 4]``, roi_has_mask_int32 ``[N*R, 1]``
+    (index into the per-image roi list), mask_int32
+    ``[N*R, num_classes*resolution²]`` with -1 = ignore, mask_num ``[N]``
+    valid rows per image).
+    """
+    rois = jnp.asarray(rois)
+    segms = jnp.asarray(gt_segms)
+    if rois.ndim != 3 or segms.ndim != 5:
+        raise InvalidArgumentError(
+            "generate_mask_labels dense contract wants rois [N,R,4] and "
+            f"gt_segms [N,G,Pp,V,2]; got {rois.shape}, {segms.shape}")
+    N, R = rois.shape[0], rois.shape[1]
+    G, Pp, V = segms.shape[1], segms.shape[2], segms.shape[3]
+    labels = jnp.asarray(labels_int32).reshape(N, R)
+    gt_classes = jnp.asarray(gt_classes).reshape(N, G)
+    is_crowd = jnp.asarray(is_crowd).reshape(N, G)
+    im_info = jnp.asarray(im_info, rois.dtype)
+    nv = (jnp.full((N, G, Pp), V, jnp.int32) if poly_vertex_num is None
+          else jnp.asarray(poly_vertex_num, jnp.int32))
+    pc = (jnp.full((N, G), Pp, jnp.int32) if poly_num is None
+          else jnp.asarray(poly_num, jnp.int32))
+    gt_count = (jnp.full((N,), G, jnp.int32) if gt_num is None
+                else jnp.asarray(gt_num, jnp.int32))
+    roi_count = (jnp.full((N,), R, jnp.int32) if rois_num is None
+                 else jnp.asarray(rois_num, jnp.int32))
+
+    outs = jax.vmap(
+        lambda ii, gc, cr, pl, pnv, pcnt, gn, r, lb, rc: _mask_labels_one(
+            ii, gc, cr, pl, pnv, pcnt, gn, r, lb, rc,
+            int(num_classes), int(resolution))
+    )(im_info, gt_classes, is_crowd, segms, nv, pc, gt_count, rois, labels,
+      roi_count)
+    rois_out, has_mask, expand, counts = outs
+    return (rois_out.reshape(N * R, 4),
+            has_mask.reshape(N * R, 1),
+            expand.reshape(N * R, -1),
+            counts)

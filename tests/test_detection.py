@@ -704,10 +704,31 @@ class TestFpnRouting:
         # the documented dense-contract hazard this argument exists to fix
         _, _, counts_no = F.distribute_fpn_proposals(rois, 2, 5, 4, 224)
         assert int(counts_no[0]) == 3
-        # per-image [N] counts (the module-wide rois_num contract) also work
+        # per-image [N] counts over PACKED rois (valid prefix) also work
         multi2, _, counts2 = F.distribute_fpn_proposals(
             rois, 2, 5, 4, 224, rois_num=np.array([1, 1]))
         assert [int(c) for c in counts2] == [1, 0, 1, 0]
+
+    def test_distribute_blocked_input(self):
+        # [N, K, 4] per-image padded blocks straight from generate_proposals:
+        # each block's padding tail masks independently (interleaved padding)
+        blocks = np.zeros((2, 4, 4), np.float32)
+        blocks[0, 0] = [0, 0, 15, 15]
+        blocks[0, 1] = [0, 0, 31, 31]   # img0: 2 valid + 2 pad
+        blocks[1, :4] = [[0, 0, 63, 63], [0, 0, 255, 255],
+                         [0, 0, 15, 15], [0, 0, 199, 199]]  # img1: 4 valid
+        multi, restore, counts = F.distribute_fpn_proposals(
+            blocks, 2, 5, 4, 224, rois_num=np.array([2, 4]))
+        # valid rois: 16,32 (img0) + 64,256,16,200 (img1) → lvl2: 16,32,64,16
+        # lvl4: 200 → actually 200px → lvl4; 256 → lvl4
+        assert sum(int(c) for c in counts) == 6
+        assert int(counts[0]) == 4  # 15/31/63/15-px boxes at min level
+        # image-0 padding rows routed nowhere
+        lvl2 = np.asarray(multi[0])
+        np.testing.assert_allclose(lvl2[0], blocks[0, 0])
+        np.testing.assert_allclose(lvl2[1], blocks[0, 1])
+        np.testing.assert_allclose(lvl2[2], blocks[1, 0])
+        np.testing.assert_allclose(lvl2[3], blocks[1, 2])
 
     def test_collect_top_k_across_levels(self):
         rois = np.array([[0, 0, 15, 15], [0, 0, 63, 63],
