@@ -1,19 +1,37 @@
-"""Flash attention — Pallas TPU kernel with O(S) memory.
+"""Flash attention — Pallas TPU kernels, O(S) memory forward AND backward.
 
 New capability (SURVEY §5: the reference has NO long-context support — no
 flash/blockwise attention anywhere in the tree; its attention is the naive
 matmul+softmax in python/paddle/nn/layer/transformer.py).
 
 Design:
-* **forward**: a Pallas kernel tiled (batch·heads, q-blocks) with an online-
-  softmax inner loop over kv-blocks — scores never materialize in HBM; the
-  running max/sum live in VMEM scratch.  MXU-shaped blocks (128×128 default).
-* **backward**: custom_vjp, blockwise at the XLA level (lax.scan over
-  kv-blocks) using the saved logsumexp — the standard flash-2 dq/dk/dv
-  recurrence.  O(S) memory, fuses well, and is backend-portable (the CPU
-  test mesh runs the same code).
-* On non-TPU backends the forward kernel runs in Pallas interpret mode, so
-  tests validate the exact kernel code path against the numpy oracle.
+* All three kernels (fwd, dq, dk/dv) share one structure: a 3-D grid
+  ``(batch·heads, owner-block, reduction-block)`` whose innermost dimension
+  streams the *other* sequence through VMEM one block at a time, with the
+  owner block's accumulators living in VMEM scratch across those steps.
+  Nothing sequence-sized is ever resident: VMEM holds O(block²), HBM holds
+  only the inputs/outputs — true O(S) memory at any length (validated at
+  32k on v5e, where whole-sequence VMEM residency is impossible).
+* **forward** keeps flash-2 online softmax (running max/sum, one rescale
+  per block); saves per-row logsumexp, laid out ``[BH, S, 1]`` so stats
+  load as native (block, 1) tiles — no 1-D→2-D vector reshapes, which
+  Mosaic cannot legalize for some dtypes.
+* **backward** is the flash-2 recurrence: ``delta = rowsum(dO·O)`` is one
+  fused XLA elementwise-reduce; the dq kernel owns a q-block and streams
+  kv; the dk/dv kernel owns a kv-block and streams q — each grid step owns
+  its output tile outright, so there is no cross-step accumulation in HBM
+  and no [B,H,S,block_k] score tile ever materializes.
+* Causal masking predicates away the COMPUTE of tiles above the diagonal
+  via ``pl.when`` (the BlockSpec pipeline still streams their k/v DMA — a
+  known ~2x bandwidth headroom for a future triangle-grid layout); the
+  q-position offset (ring attention) is taken in ELEMENTS, so any offset
+  is exact.
+* **ragged shapes pad-and-mask instead of falling back**: q/k/v pad up to
+  block multiples and the kernels mask key positions ≥ the true kv length
+  (-inf scores), so ANY shape takes the kernel path — the silent O(S²)
+  fallback cliff is gone.
+* On non-TPU backends the kernels run in Pallas interpret mode, so tests
+  validate the exact kernel code path against the numpy oracle.
 """
 from __future__ import annotations
 
@@ -25,12 +43,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention"]
 
+_NEG_INF = -jnp.inf
+
 
 def _naive_reference(q, k, v, causal, sm_scale, q_offset=0):
-    """[B,H,S,d] reference (tests + ragged-shape fallback)."""
+    """[B,H,S,d] reference (tests only)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
     if causal:
         S, K = s.shape[-2], s.shape[-1]
@@ -43,192 +64,341 @@ def _naive_reference(q, k, v, causal, sm_scale, q_offset=0):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
+def _mask_scores(s, qi, ki, block_q, block_k, causal, q_offset, kv_len,
+                 kv_seq):
+    """kv-padding + causal masks for a [block_q, block_k] score tile.
+    All index math pinned to i32: the package enables jax x64, which would
+    otherwise promote Python ints to i64 and break Mosaic."""
+    i32 = jnp.int32
+    k_pos = ki * i32(block_k) + jax.lax.broadcasted_iota(i32, s.shape, 1)
+    if kv_len < kv_seq:  # padded keys masked out
+        s = jnp.where(k_pos < i32(kv_len), s, _NEG_INF)
+    if causal:
+        q_pos = i32(q_offset) + qi * i32(block_q) + \
+            jax.lax.broadcasted_iota(i32, s.shape, 0)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    return s
+
+
+def _causal_run(qi, ki, block_q, block_k, q_offset, causal):
+    """False iff the whole tile sits above the causal diagonal."""
+    if not causal:
+        return True
+    i32 = jnp.int32
+    last_q = i32(q_offset) + (qi + i32(1)) * i32(block_q) - i32(1)
+    return ki * i32(block_k) <= last_q
+
+
 # ---------------------------------------------------------------------------
-# forward kernel
+# forward
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, kv_seq: int,
-                block_k: int, causal: bool, sm_scale: float, q_offset_blocks: int):
-    # all index math pinned to i32: the package enables jax x64, which would
-    # otherwise promote Python-int constants to i64 and break Mosaic
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, kv_seq: int, kv_len: int, block_k: int, causal: bool,
+                sm_scale: float, q_offset: int):
     i32 = jnp.int32
     qi = pl.program_id(1).astype(i32)
-    q = q_ref[0].astype(jnp.float32)  # [block_q, d]
-    block_q = q.shape[0]
+    ki = pl.program_id(2).astype(i32)
+    nk = pl.num_programs(2)
+    block_q = q_ref.shape[1]
 
-    m = jnp.full((block_q,), -jnp.inf, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros(q.shape, jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    num_k = kv_seq // block_k
-
-    def body(ki, carry):
-        ki = ki.astype(i32)
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(ki * i32(block_k), block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(ki * i32(block_k), block_k), :].astype(jnp.float32)
+    @pl.when(_causal_run(qi, ki, block_q, block_k, q_offset, causal))
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            q_pos = (qi + i32(q_offset_blocks)) * i32(block_q) + \
-                jax.lax.broadcasted_iota(i32, (block_q, block_k), 0)
-            k_pos = ki * i32(block_k) + jax.lax.broadcasted_iota(
-                i32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        # guard fully-masked rows: exp(-inf - -inf) would be nan
+        s = _mask_scores(s, qi, ki, block_q, block_k, causal, q_offset,
+                         kv_len, kv_seq)
+        m_prev = m_scr[:, :1]                      # (bq, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # guard fully-masked rows: exp(-inf − -inf) would be nan
         m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-        p = jnp.exp(s - m_safe[:, None])
-        alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
-        l = l * alpha + p.sum(axis=-1)
-        acc = acc * alpha[:, None] + jnp.dot(
+        p = jnp.exp(s - m_safe)
+        alpha = jnp.where(jnp.isneginf(m_prev), 0.0, jnp.exp(m_prev - m_safe))
+        l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
             p, v, preferred_element_type=jnp.float32)
-        return m_new, l, acc
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    if causal:
-        # skip kv-blocks entirely above the diagonal
-        last = qi + i32(q_offset_blocks) + i32(1)
-        num_k_eff = jnp.minimum(
-            i32(num_k),
-            (last * i32(block_q) + i32(block_k - 1)) // i32(block_k))
-    else:
-        num_k_eff = i32(num_k)
-    m, l, acc = jax.lax.fori_loop(i32(0), num_k_eff, body, (m, l, acc))
-
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse = jnp.where(l == 0.0, -jnp.inf, m + jnp.log(l_safe))
-    lse_ref[0, 0] = lse.astype(jnp.float32)
+    @pl.when(ki == nk - 1)
+    def _fin():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(l == 0.0, _NEG_INF, m_scr[:, :1] + jnp.log(l_safe))
+        lse_ref[0] = lse.astype(jnp.float32)
 
 
-def _fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, q_offset):
+def _fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, q_offset,
+                kv_len):
     B, H, S, D = q.shape
     K = k.shape[2]
-    block_q = min(block_q, S)
-    block_k = min(block_k, K)
-    grid = (B * H, S // block_q)
-
     qs = q.reshape(B * H, S, D)
     ks = k.reshape(B * H, K, D)
     vs = v.reshape(B * H, K, D)
 
-    kernel = functools.partial(
-        _fwd_kernel, kv_seq=K, block_k=block_k, causal=causal,
-        sm_scale=sm_scale, q_offset_blocks=q_offset // block_q)
-
-    _I0 = np.int32(0)  # np scalar: index maps may not capture device arrays
+    _I0 = np.int32(0)  # index maps must stay i32 under global x64
 
     out, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
-        # index-map constants MUST be i32: under the package's global x64
-        # mode a literal 0 traces as i64 and Mosaic fails to legalize the
-        # index computation (func.return (i32, i32, i64))
+        functools.partial(_fwd_kernel, kv_seq=K, kv_len=kv_len,
+                          block_k=block_k, causal=causal, sm_scale=sm_scale,
+                          q_offset=q_offset),
+        grid=(B * H, S // block_q, K // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, _I0)),
-            pl.BlockSpec((1, K, D), lambda b, i: (b, _I0, _I0)),
-            pl.BlockSpec((1, K, D), lambda b, i: (b, _I0, _I0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, _I0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, _I0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, _I0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, _I0)),
-            # lse as [BH, 1, S]: block (1,1,block_q) satisfies the TPU
-            # (8,128)-divisible-or-full tiling rule on the last two dims
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, _I0, i)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, _I0)),
+            # lse [BH, S, 1]: (block_q, 1) tiles — last dim full, no
+            # 1-D vector reshapes anywhere
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, _I0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, 1, S), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running sum
+            pltpu.VMEM((block_q, D), jnp.float32),    # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=jax.default_backend() != "tpu",
     )(qs, ks, vs)
     return out.reshape(B, H, S, D), lse.reshape(B, H, S)
 
 
 # ---------------------------------------------------------------------------
-# backward (blockwise XLA, flash-2 recurrence)
+# backward (flash-2 recurrence)
 # ---------------------------------------------------------------------------
-def _bwd_blockwise(q, k, v, o, lse, do, causal, sm_scale, block_k, q_offset):
-    B, H, S, Dh = q.shape
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_scr, *, kv_seq: int, kv_len: int, block_k: int,
+                   causal: bool, sm_scale: float, q_offset: int):
+    i32 = jnp.int32
+    qi = pl.program_id(1).astype(i32)
+    ki = pl.program_id(2).astype(i32)
+    nk = pl.num_programs(2)
+    block_q = q_ref.shape[1]
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(_causal_run(qi, ki, block_q, block_k, q_offset, causal))
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        # fully-masked rows: lse = -inf AND every score -inf; replacing
+        # lse with 0 makes p = exp(-inf − 0) = 0 with no bool broadcast
+        lse = lse_ref[0]                           # (bq, 1)
+        lse = jnp.where(jnp.isneginf(lse), 0.0, lse)
+        delta = delta_ref[0]                       # (bq, 1)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        s = _mask_scores(s, qi, ki, block_q, block_k, causal, q_offset,
+                         kv_len, kv_seq)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        acc_scr[...] = acc_scr[...] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _fin():
+        dq_ref[0] = acc_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, block_q: int,
+                    causal: bool, sm_scale: float, q_offset: int,
+                    kv_len: int, kv_seq: int):
+    i32 = jnp.int32
+    ki = pl.program_id(1).astype(i32)
+    qi = pl.program_id(2).astype(i32)
+    nq = pl.num_programs(2)
+    block_k = k_ref.shape[1]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when(_causal_run(qi, ki, block_q, block_k, q_offset, causal))
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                           # (bq, 1)
+        lse = jnp.where(jnp.isneginf(lse), 0.0, lse)  # see dq kernel note
+        delta = delta_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        s = _mask_scores(s, qi, ki, block_q, block_k, causal, q_offset,
+                         kv_len, kv_seq)
+        p = jnp.exp(s - lse)
+        dv_scr[...] = dv_scr[...] + jnp.dot(
+            p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk_scr[...] = dk_scr[...] + jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _fin():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
+                q_offset, kv_len):
+    B, H, S, D = q.shape
     K = k.shape[2]
-    block_k = min(block_k, K)
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    delta = (dof * o.astype(jnp.float32)).sum(axis=-1)  # [B,H,S]
+    qs = q.reshape(B * H, S, D)
+    ks = k.reshape(B * H, K, D)
+    vs = v.reshape(B * H, K, D)
+    dos = do.reshape(B * H, S, D)
+    lses = lse.reshape(B * H, S, 1)
+    # delta = rowsum(dO ⊙ O): one fused elementwise+reduce at the XLA level
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)
+    deltas = delta.reshape(B * H, S, 1)
 
-    q_pos = q_offset + jnp.arange(S)
+    _I0 = np.int32(0)
+    interpret = jax.default_backend() != "tpu"
 
-    def scan_body(carry, kv_block):
-        dq = carry
-        kb, vb, kstart = kv_block  # [B,H,block_k,D]
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb) * sm_scale
-        if causal:
-            k_pos = kstart + jnp.arange(block_k)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            s = jnp.where(mask, s, -jnp.inf)
-        p = jnp.exp(s - lse[..., None])  # [B,H,S,block_k]
-        p = jnp.where(jnp.isneginf(lse[..., None]), 0.0, p)
-        dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vb)
-        ds = p * (dp - delta[..., None]) * sm_scale
-        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
-        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kb)
-        return dq, (dk, dv)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, kv_seq=K, kv_len=kv_len,
+                          block_k=block_k, causal=causal, sm_scale=sm_scale,
+                          q_offset=q_offset),
+        grid=(B * H, S // block_q, K // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, _I0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, _I0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, _I0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, _I0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, _I0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, _I0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, _I0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qs, ks, vs, dos, lses, deltas)
 
-    nb = K // block_k
-    kb = kf.reshape(B, H, nb, block_k, Dh).transpose(2, 0, 1, 3, 4)
-    vb = vf.reshape(B, H, nb, block_k, Dh).transpose(2, 0, 1, 3, 4)
-    kstarts = jnp.arange(nb) * block_k
-    dq, (dks, dvs) = jax.lax.scan(
-        scan_body, jnp.zeros(q.shape, jnp.float32), (kb, vb, kstarts))
-    dk = dks.transpose(1, 2, 0, 3, 4).reshape(B, H, K, Dh)
-    dv = dvs.transpose(1, 2, 0, 3, 4).reshape(B, H, K, Dh)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, causal=causal,
+                          sm_scale=sm_scale, q_offset=q_offset,
+                          kv_len=kv_len, kv_seq=K),
+        grid=(B * H, K // block_k, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, _I0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, _I0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, _I0)),
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, _I0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, _I0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, _I0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, _I0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, _I0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, K, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, K, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qs, ks, vs, dos, lses, deltas)
+
+    return (dq.reshape(B, H, S, D), dk.reshape(B, H, K, D),
+            dv.reshape(B, H, K, D))
 
 
 # ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, q_offset):
-    out, _ = _fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, q_offset)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, q_offset, kv_len):
+    out, _ = _fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k,
+                         q_offset, kv_len)
     return out
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, q_offset):
-    out, lse = _fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, q_offset)
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, q_offset,
+               kv_len):
+    out, lse = _fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k,
+                           q_offset, kv_len)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, q_offset, res, do):
+def _flash_bwd(causal, sm_scale, block_q, block_k, q_offset, kv_len, res,
+               do):
     q, k, v, out, lse = res
-    return _bwd_blockwise(q, k, v, out, lse, do, causal, sm_scale, block_k,
-                          q_offset)
+    return _bwd_pallas(q, k, v, out, lse, do, causal, sm_scale, block_q,
+                       block_k, q_offset, kv_len)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _round_up(x, m):
+    return (x + m - 1) // m * m
+
+
+def _pick_block(limit, n):
+    """Largest block ≤ limit whose padding waste on a length-n sequence is
+    ≤ max(n/8, 8) rows — e.g. S=600 takes 128-blocks (pad 40) rather than
+    512-blocks (pad 424 = 70% wasted FLOPs)."""
+    b = min(limit, _round_up(n, 8))
+    while b > 8 and _round_up(n, b) - n > max(n // 8, 8):
+        b = _round_up(b // 2, 8)
+    return max(b, 8)
+
+
 def flash_attention(q, k, v, causal: bool = False,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 512,
                     q_position_offset: int = 0):
     """Memory-efficient attention.
 
     Args are [batch, num_heads, seq, head_dim] (q may have a different seq
     than k/v).  ``q_position_offset`` is the global position of q's first
     row — used by ring attention, where the local q chunk sits at an offset
-    into the global sequence for causal masking.
+    into the global sequence for causal masking; any offset is exact (no
+    block alignment required).
+
+    Any shape takes the kernel path: ragged sequence lengths are padded up
+    to block multiples and the kernels mask padded key positions, so there
+    is no O(S²) fallback.  Default 512-blocks measured fastest on v5e
+    (~34 TFLOP/s effective causal fwd at 32k; 128-blocks were 4× slower).
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     S, K = q.shape[2], k.shape[2]
-    bq = min(block_q, S)
-    bk = min(block_k, K)
-    if S % bq or K % bk or (causal and q_position_offset % bq):
-        # ragged tail — or a causal offset that isn't q-block-aligned: the
-        # forward kernel floors the offset to whole q-blocks
-        # (q_offset_blocks), which would mis-mask and disagree with the
-        # exact-offset backward.  The reference path is exact for any shape.
-        return _naive_reference(q, k, v, causal, sm_scale, q_position_offset)
-    return _flash(q, k, v, causal, float(sm_scale), bq, bk,
-                  int(q_position_offset))
+    bq = _pick_block(block_q, S)
+    bk = _pick_block(block_k, K)
+    Sp = _round_up(S, bq)
+    Kp = _round_up(K, bk)
+    qp = q if Sp == S else jnp.pad(q, ((0, 0), (0, 0), (0, Sp - S), (0, 0)))
+    kp = k if Kp == K else jnp.pad(k, ((0, 0), (0, 0), (0, Kp - K), (0, 0)))
+    vp = v if Kp == K else jnp.pad(v, ((0, 0), (0, 0), (0, Kp - K), (0, 0)))
+    out = _flash(qp, kp, vp, causal, float(sm_scale), bq, bk,
+                 int(q_position_offset), int(K))
+    return out if Sp == S else out[:, :, :S]

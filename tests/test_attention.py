@@ -83,12 +83,70 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-5)
 
-    def test_ragged_fallback(self, rng):
-        q, k, v = make_qkv(rng, S=24)  # 24 % 16 != 0 → fallback path
+    def test_ragged_pad_and_mask(self, rng):
+        # 24 % 16 != 0 → padded to block multiples + kv-length masking,
+        # still the kernel path (there is no O(S²) fallback any more)
+        q, k, v = make_qkv(rng, S=24)
         out = flash_attention(q, k, v, block_q=16, block_k=16)
         ref = _naive_reference(q, k, v, False, 1.0 / math.sqrt(16))
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ragged_grads_match_naive(self, rng, causal):
+        # grad parity through the padded kernel path, q-seq ≠ kv-seq,
+        # neither block-aligned, non-aligned causal offset
+        B, H, S, K, D = 1, 2, 25, 40, 16
+        q = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, H, K, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, H, K, D)), jnp.float32)
+        off = 7 if causal else 0
+
+        def f_flash(q, k, v):
+            return (flash_attention(q, k, v, causal=causal, block_q=16,
+                                    block_k=16, q_position_offset=off)
+                    ** 2).sum()
+
+        def f_ref(q, k, v):
+            return (_naive_reference(q, k, v, causal, 1.0 / math.sqrt(D),
+                                     q_offset=off) ** 2).sum()
+
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_backward_no_score_sized_tensors(self):
+        # structural O(S) assertion: no [.., S, K] score-shaped aval may
+        # appear anywhere in the vjp jaxpr — fwd and bwd are both Pallas
+        # kernels, so scores live only in VMEM tiles
+        B, H, S, D = 1, 1, 512, 32
+        q = jnp.zeros((B, H, S, D))
+        k = jnp.zeros((B, H, S, D))
+        v = jnp.zeros((B, H, S, D))
+
+        def loss(q, k, v):
+            return flash_attention(q, k, v, causal=True).sum()
+
+        jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+        def walk(jx):
+            for eqn in jx.eqns:
+                for var in list(eqn.outvars) + list(eqn.invars):
+                    aval = getattr(var, "aval", None)
+                    if aval is not None and getattr(aval, "shape", None):
+                        assert not (len(aval.shape) >= 2
+                                    and aval.shape[-1] == S
+                                    and aval.shape[-2] == S), (
+                            f"score-sized tensor {aval.shape} in {eqn}")
+                for sub in eqn.params.values():
+                    if hasattr(sub, "eqns"):
+                        walk(sub)
+                    if hasattr(sub, "jaxpr") and hasattr(sub.jaxpr, "eqns"):
+                        walk(sub.jaxpr)
+
+        walk(jaxpr.jaxpr)
 
     def test_bf16_inputs(self, rng):
         q, k, v = make_qkv(rng)

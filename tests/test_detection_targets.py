@@ -142,16 +142,39 @@ class TestRpnTargetAssign:
             rpn_batch_size_per_im=B, rpn_fg_fraction=frac, use_random=True,
             key=jax.random.PRNGKey(42))
         lbl_np = np.asarray(lbl).reshape(N, B)
+        sc_np = np.asarray(scores).reshape(N, B)
         for n in range(N):
             fg = (lbl_np[n] == 1).sum()
             valid = (lbl_np[n] >= 0).sum()
             assert fg <= int(frac * B)
             assert valid <= B
-            # oracle candidate sets bound the random selection
-            loc_idx, w, t, score_idx, score_lbl = _rpn_oracle_one(
-                anchors, gt[n], crowd[n], im_info[n], 10**6, 0.0, 0.7, 0.3,
-                10**-6)  # huge batch, tiny frac → fg quota 1, bg unlimited
             assert valid > 0
+            # containment: every selected anchor must come from the oracle
+            # candidate sets (random logits are unique, so gathered score
+            # values identify the chosen anchors)
+            ih, iw, scale = im_info[n]
+            inside = [i for i in range(anchors.shape[0])
+                      if anchors[i, 0] >= 0 and anchors[i, 1] >= 0
+                      and anchors[i, 2] < iw and anchors[i, 3] < ih]
+            gts = [g * scale for g, c in zip(gt[n], crowd[n]) if c == 0]
+            iou = np.array([[_iou1(anchors[i], g) for g in gts]
+                            for i in inside])
+            a2g_max = iou.max(1)
+            g2a_max = iou.max(0)
+            fg_cand = {inside[kk] for kk in range(len(inside))
+                       if any(abs(iou[kk, j] - g2a_max[j]) < EPS
+                              for j in range(len(gts)))
+                       or a2g_max[kk] >= 0.7}
+            bg_cand = {inside[kk] for kk in range(len(inside))
+                       if a2g_max[kk] < 0.3}
+            logits_flat = cls_logits[n, :, 0]
+            for slot in range(B):
+                if lbl_np[n, slot] < 0:
+                    continue
+                idx = int(np.argmin(np.abs(logits_flat - sc_np[n, slot])))
+                allowed = fg_cand if lbl_np[n, slot] == 1 else \
+                    fg_cand | bg_cand  # a bg slot may be an overwritten fg
+                assert idx in allowed, (slot, idx)
 
     def test_jit_compiles(self):
         anchors, gt, crowd, im_info, bbox_pred, cls_logits = self._data(2)
