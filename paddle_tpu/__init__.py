@@ -85,6 +85,7 @@ from . import compat  # noqa: F401
 from . import dataset  # noqa: F401
 from . import jit  # noqa: F401
 from . import reader  # noqa: F401
+from . import slim  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import sysconfig  # noqa: F401
 from . import utils  # noqa: F401

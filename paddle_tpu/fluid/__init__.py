@@ -31,6 +31,7 @@ from . import io  # noqa: F401
 from . import core  # noqa: F401
 from . import metrics  # noqa: F401
 from . import unique_name  # noqa: F401
+from . import contrib  # noqa: F401
 from .param_attr import WeightNormParamAttr  # noqa: F401
 from paddle_tpu import profiler  # noqa: F401
 

@@ -1,0 +1,2 @@
+"""fluid.contrib compat namespace (reference: python/paddle/fluid/contrib)."""
+from . import slim  # noqa: F401

@@ -1,0 +1,2 @@
+"""fluid.contrib.slim compat — re-exports paddle_tpu.slim."""
+from paddle_tpu.slim import quantization  # noqa: F401

@@ -31,6 +31,10 @@ class Sequential(Layer):
         keys = list(self._sub_layers.keys())
         return self._sub_layers[keys[idx]]
 
+    def __setitem__(self, idx, layer):
+        keys = list(self._sub_layers.keys())
+        self._sub_layers[keys[idx]] = layer
+
     def __len__(self):
         return len(self._sub_layers)
 

@@ -51,7 +51,8 @@ def _padding(padding, n):
     return [tuple(p) for p in padding]
 
 
-def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n, channel_last):
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n, channel_last,
+             preferred_element_type=None):
     spatial = "DHW"[3 - n:]
     if channel_last:
         lhs_spec = "N" + spatial + "C"
@@ -72,6 +73,9 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n, channel_last
         rhs_dilation=_norm_tuple(dilation, n, "dilation"),
         dimension_numbers=dn,
         feature_group_count=groups,
+        # int8 path (slim.quantization Int8Conv2D) asks for an i32
+        # accumulator explicitly; float paths keep the default (see above)
+        preferred_element_type=preferred_element_type,
     )
     if bias is not None:
         b = jnp.asarray(bias, out.dtype)

@@ -1,0 +1,23 @@
+"""Model compression (slim) — quantization.
+
+Capability parity: python/paddle/fluid/contrib/slim/quantization (the
+reference's QAT program passes + imperative QAT + post-training
+quantization).  See :mod:`paddle_tpu.slim.quantization`.
+"""
+from . import quantization  # noqa: F401
+from .quantization import (  # noqa: F401
+    FakeQuantAbsMax,
+    FakeQuantChannelWiseAbsMax,
+    FakeQuantMovingAverage,
+    ImperativeQuantAware,
+    Int8Conv2D,
+    Int8Linear,
+    MovingAverageAbsMaxScale,
+    PostTrainingQuantization,
+    QuantizedConv2D,
+    QuantizedLinear,
+    fake_quant_dequant,
+    quantize_to_int8,
+)
+
+__all__ = quantization.__all__

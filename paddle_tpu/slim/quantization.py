@@ -1,0 +1,455 @@
+"""Quantization: QAT fake-quant layers, post-training quant, int8 layers.
+
+Capability parity (reference):
+  ImperativeQuantAware / QuantizedConv2D / QuantizedLinear / FakeQuant*
+      contrib/slim/quantization/imperative/qat.py:50, quant_nn.py:32-500
+  PostTrainingQuantization
+      contrib/slim/quantization/post_training_quantization.py:120
+  QuantizationTransformPass (static-graph fake-quant insertion)
+      contrib/slim/quantization/quantization_pass.py:211 — subsumed: there
+      is no Program IR here, the imperative wrappers ARE the transform.
+
+TPU-native design:
+  * fake quant-dequant is a straight-through estimator around
+    round/clip — everything stays jit-able and differentiable, and XLA
+    fuses the qdq arithmetic into the surrounding matmul/conv.
+  * observers are Layer buffers (scale/state/accum), updated functionally
+    in training mode exactly like BN running stats, so QAT works under
+    ``functional_call``/donated train steps and lax.scan loops.
+  * int8 inference layers store int8 weights and run the matmul/conv with
+    int8 operands accumulating in int32 on the MXU
+    (``preferred_element_type=int32``) — real low-precision compute, not
+    a dequantize-then-float emulation; the scales fold into one output
+    multiplier.  They export through the standard StableHLO path
+    (:mod:`paddle_tpu.inference`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.errors import InvalidArgumentError
+from ..nn import functional as F
+from ..nn.layer_base import Layer
+
+__all__ = [
+    "fake_quant_dequant", "FakeQuantAbsMax", "FakeQuantMovingAverage",
+    "FakeQuantChannelWiseAbsMax", "MovingAverageAbsMaxScale",
+    "QuantizedConv2D", "QuantizedLinear", "ImperativeQuantAware",
+    "quantize_to_int8", "Int8Linear", "Int8Conv2D",
+    "PostTrainingQuantization",
+]
+
+
+def fake_quant_dequant(x, scale, bits=8):
+    """Straight-through fake quantize-dequantize.
+
+    out = round(clip(x, ±scale) / scale * r) * scale / r,  r = 2^(b-1)-1
+    (quant_nn.py FakeQuantMovingAverage formula); the gradient is the
+    identity (the reference's fake_quantize_dequantize grad kernel).
+    """
+    r = float(2 ** (bits - 1) - 1)
+    scale = jnp.maximum(jnp.asarray(scale, jnp.float32), 1e-9)
+    xf = jnp.asarray(x, jnp.float32)
+    q = jnp.round(jnp.clip(xf, -scale, scale) / scale * r) * scale / r
+    return (xf + jax.lax.stop_gradient(q - xf)).astype(x.dtype)
+
+
+class FakeQuantAbsMax(Layer):
+    """Dynamic per-tensor abs-max fake quant (quant_nn.py:130): the scale
+    is recomputed from the current tensor every call — the reference's
+    weight quantizer."""
+
+    def __init__(self, name=None, quant_bits=8, dtype="float32"):
+        super().__init__()
+        self._quant_bits = quant_bits
+
+    def forward(self, x):
+        scale = jnp.max(jnp.abs(jnp.asarray(x, jnp.float32)))
+        return fake_quant_dequant(x, scale, self._quant_bits)
+
+
+class FakeQuantChannelWiseAbsMax(Layer):
+    """Per-output-channel abs-max weight fake quant (quant_nn.py:213).
+    ``channel_axis`` is the output-channel axis of the weight layout."""
+
+    def __init__(self, name=None, quant_bits=8, channel_axis=0,
+                 dtype="float32"):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._axis = channel_axis
+
+    def forward(self, x):
+        xf = jnp.asarray(x, jnp.float32)
+        axes = tuple(i for i in range(xf.ndim) if i != self._axis)
+        scale = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
+        return fake_quant_dequant(x, scale, self._quant_bits)
+
+
+class FakeQuantMovingAverage(Layer):
+    """Moving-average abs-max fake quant (quant_nn.py:32).
+
+    scale = (rate·accum + |x|max) / (rate·state + 1), with accum/state
+    accumulated over training steps; eval uses the stored scale.  The
+    stats are buffers so the update is functional (like BN)."""
+
+    def __init__(self, name=None, moving_rate=0.9, quant_bits=8,
+                 dtype="float32"):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._quant_bits = quant_bits
+        self.register_buffer("_scale", jnp.asarray([0.001], jnp.float32))
+        self.register_buffer("_state", jnp.asarray([1.0], jnp.float32))
+        self.register_buffer("_accum", jnp.asarray([1.0], jnp.float32))
+
+    def forward(self, x):
+        if self.training:
+            cur = jnp.max(jnp.abs(jnp.asarray(x, jnp.float32)))
+            state = self._state.value * self._moving_rate + 1.0
+            accum = self._accum.value * self._moving_rate + cur
+            scale = accum / state
+            self._state.value = state
+            self._accum.value = accum
+            self._scale.value = scale
+        else:
+            scale = self._scale.value
+        return fake_quant_dequant(x, scale.reshape(()), self._quant_bits)
+
+    @property
+    def scale(self):
+        return self._scale.value.reshape(())
+
+
+class MovingAverageAbsMaxScale(Layer):
+    """Output-scale observer (quant_nn.py:500): records the moving-average
+    abs-max of whatever flows through, passes the tensor unchanged."""
+
+    def __init__(self, name=None, moving_rate=0.9, dtype="float32"):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self.register_buffer("_scale", jnp.asarray([0.001], jnp.float32))
+        self.register_buffer("_state", jnp.asarray([1.0], jnp.float32))
+        self.register_buffer("_accum", jnp.asarray([1.0], jnp.float32))
+
+    def forward(self, x):
+        if self.training:
+            cur = jnp.max(jnp.abs(jnp.asarray(x, jnp.float32)))
+            state = self._state.value * self._moving_rate + 1.0
+            accum = self._accum.value * self._moving_rate + cur
+            self._state.value = state
+            self._accum.value = accum
+            self._scale.value = accum / state
+        return x
+
+    @property
+    def scale(self):
+        return self._scale.value.reshape(())
+
+
+def _replace_sublayer(model, dotted_name, new_layer):
+    """Swap the sublayer at a named_sublayers path: every registered child
+    lives in its parent's ``_sub_layers`` dict keyed by its path segment,
+    regardless of whether it was attached by attribute or container."""
+    parts = dotted_name.split(".")
+    parent = model
+    for p in parts[:-1]:
+        parent = parent._sub_layers[p]
+    parent._sub_layers[parts[-1]] = new_layer
+
+
+def _weight_quantizer(kind, bits, channel_axis, rate=0.9):
+    if kind == "abs_max":
+        return FakeQuantAbsMax(quant_bits=bits)
+    if kind == "channel_wise_abs_max":
+        return FakeQuantChannelWiseAbsMax(quant_bits=bits,
+                                          channel_axis=channel_axis)
+    if kind == "moving_average_abs_max":
+        return FakeQuantMovingAverage(moving_rate=rate, quant_bits=bits)
+    raise InvalidArgumentError(f"unknown weight_quantize_type {kind!r}")
+
+
+def _act_quantizer(kind, bits, rate):
+    if kind == "abs_max":
+        return FakeQuantAbsMax(quant_bits=bits)
+    if kind == "moving_average_abs_max":
+        return FakeQuantMovingAverage(moving_rate=rate, quant_bits=bits)
+    raise InvalidArgumentError(f"unknown activation_quantize_type {kind!r}")
+
+
+class QuantizedConv2D(Layer):
+    """Conv2D with fake-quantized input + weight (quant_nn.py:323).  Wraps
+    an existing Conv2D, sharing its Parameters."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
+        super().__init__()
+        self._inner = layer
+        # OIHW weights: output channel axis 0
+        self._fake_quant_weight = _weight_quantizer(
+            weight_quantize_type, weight_bits, channel_axis=0,
+            rate=moving_rate)
+        self._fake_quant_input = _act_quantizer(
+            activation_quantize_type, activation_bits, moving_rate)
+
+    def forward(self, x):
+        inner = self._inner
+        x = self._fake_quant_input(x)
+        w = self._fake_quant_weight(inner.weight.value)
+        return F.conv2d(x, w, inner._bias(), inner.stride, inner.padding,
+                        inner.dilation, inner.groups,
+                        inner.data_format or "NCHW")
+
+
+class QuantizedLinear(Layer):
+    """Linear with fake-quantized input + weight (quant_nn.py:419)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
+        super().__init__()
+        self._inner = layer
+        # (in, out) weights: output channel axis 1
+        self._fake_quant_weight = _weight_quantizer(
+            weight_quantize_type, weight_bits, channel_axis=1,
+            rate=moving_rate)
+        self._fake_quant_input = _act_quantizer(
+            activation_quantize_type, activation_bits, moving_rate)
+
+    def forward(self, x):
+        inner = self._inner
+        x = self._fake_quant_input(x)
+        w = self._fake_quant_weight(inner.weight.value)
+        out = jnp.asarray(x) @ w
+        if inner.bias is not None:
+            out = out + inner.bias.value
+        return out
+
+
+class ImperativeQuantAware:
+    """Rewrite a model in place for quantization-aware training
+    (qat.py:50): every quantizable sublayer is replaced by its fake-quant
+    counterpart.  Fine-tune, then :meth:`convert` for int8 inference."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 moving_rate=0.9,
+                 quantizable_layer_type=("Conv2D", "Linear")):
+        from .. import nn
+
+        if activation_quantize_type == "channel_wise_abs_max":
+            raise InvalidArgumentError(
+                "activations cannot quantize channel-wise")
+        self._kw = dict(weight_bits=weight_bits,
+                        activation_bits=activation_bits,
+                        moving_rate=moving_rate,
+                        weight_quantize_type=weight_quantize_type,
+                        activation_quantize_type=activation_quantize_type)
+        name_map = {"Conv2D": nn.Conv2D, "Linear": nn.Linear}
+        self._types = tuple(name_map[t] if isinstance(t, str) else t
+                            for t in quantizable_layer_type)
+
+    def quantize(self, model):
+        from .. import nn
+
+        for name, layer in list(model.named_sublayers()):
+            if not isinstance(layer, self._types):
+                continue
+            if isinstance(layer, nn.Conv2D):
+                q = QuantizedConv2D(layer, **self._kw)
+            else:
+                q = QuantizedLinear(layer, **self._kw)
+            _replace_sublayer(model, name, q)
+        return model
+
+    def convert(self, model):
+        """Freeze a fine-tuned QAT model to int8 inference layers, using
+        the trained moving-average activation scales (the reference's
+        QuantizationFreezePass + ConvertToInt8Pass in one step)."""
+        from .. import nn
+
+        for name, layer in list(model.named_sublayers()):
+            if not isinstance(layer, (QuantizedConv2D, QuantizedLinear)):
+                continue
+            act_q = layer._fake_quant_input
+            if not hasattr(act_q, "scale"):
+                raise InvalidArgumentError(
+                    "convert() needs a trained static activation scale: "
+                    "use activation_quantize_type='moving_average_abs_max' "
+                    "(abs_max recomputes per batch and cannot freeze, like "
+                    "the reference QuantizationFreezePass)")
+            act_scale = float(jnp.asarray(act_q.scale).reshape(()))
+            if isinstance(layer, QuantizedConv2D):
+                q = Int8Conv2D.from_float(layer._inner, act_scale)
+            else:
+                q = Int8Linear.from_float(layer._inner, act_scale)
+            _replace_sublayer(model, name, q)
+        return model
+
+
+def quantize_to_int8(w, channel_axis=None):
+    """w (float) → (int8 weights, float32 scale) by (channel-wise) abs-max."""
+    wf = jnp.asarray(w, jnp.float32)
+    if channel_axis is None:
+        scale = jnp.max(jnp.abs(wf))
+    else:
+        axes = tuple(i for i in range(wf.ndim) if i != channel_axis)
+        scale = jnp.max(jnp.abs(wf), axis=axes, keepdims=True)
+    scale = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(wf / scale * 127.0), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+class Int8Linear(Layer):
+    """Inference linear with int8 weights AND int8 activations: the matmul
+    runs on int8 operands with an int32 accumulator
+    (``preferred_element_type``), then one fused float rescale."""
+
+    def __init__(self, w_int8, w_scale, bias, act_scale):
+        super().__init__()
+        self.register_buffer("w_q", w_int8)
+        self.register_buffer("w_scale", jnp.asarray(w_scale, jnp.float32))
+        if bias is not None:
+            self.register_buffer("bias", jnp.asarray(bias, jnp.float32))
+        else:
+            self.bias = None
+        self.act_scale = float(act_scale)
+
+    @classmethod
+    def from_float(cls, linear, act_scale):
+        wq, ws = quantize_to_int8(linear.weight.value, channel_axis=1)
+        b = None if linear.bias is None else linear.bias.value
+        return cls(wq, ws, b, act_scale)
+
+    def forward(self, x):
+        xf = jnp.asarray(x, jnp.float32)
+        xq = jnp.clip(jnp.round(xf / self.act_scale * 127.0),
+                      -127, 127).astype(jnp.int8)
+        # dot_general handles any leading batch dims ([B, S, F] transformer
+        # inputs included); int8 operands, int32 accumulator
+        acc = jax.lax.dot_general(
+            xq, self.w_q.value, (((xq.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * (
+            self.w_scale.value.reshape(1, -1)
+            * (self.act_scale / (127.0 * 127.0)))
+        if self.bias is not None:
+            out = out + self.bias.value
+        return out.astype(x.dtype)
+
+
+class Int8Conv2D(Layer):
+    """Inference conv with int8 weights/activations, int32 MXU accumulate."""
+
+    def __init__(self, w_int8, w_scale, bias, act_scale, stride, padding,
+                 dilation, groups, data_format):
+        super().__init__()
+        self.register_buffer("w_q", w_int8)
+        self.register_buffer("w_scale", jnp.asarray(w_scale, jnp.float32))
+        if bias is not None:
+            self.register_buffer("bias", jnp.asarray(bias, jnp.float32))
+        else:
+            self.bias = None
+        self.act_scale = float(act_scale)
+        self._cfg = (stride, padding, dilation, groups, data_format)
+
+    @classmethod
+    def from_float(cls, conv, act_scale):
+        wq, ws = quantize_to_int8(conv.weight.value, channel_axis=0)
+        b = conv._bias()
+        return cls(wq, ws, b, act_scale, conv.stride, conv.padding,
+                   conv.dilation, conv.groups, conv.data_format or "NCHW")
+
+    def forward(self, x):
+        from ..nn.functional import conv as _conv
+
+        stride, padding, dilation, groups, data_format = self._cfg
+        xf = jnp.asarray(x, jnp.float32)
+        xq = jnp.clip(jnp.round(xf / self.act_scale * 127.0),
+                      -127, 127).astype(jnp.int8)
+        acc = _conv._conv_nd(xq, self.w_q.value, None, stride, padding,
+                             dilation, groups, 2,
+                             data_format in ("NHWC",),
+                             preferred_element_type=jnp.int32)
+        ch_axis = -1 if data_format == "NHWC" else 1
+        shape = [1] * acc.ndim
+        shape[ch_axis] = acc.shape[ch_axis]
+        scale = self.w_scale.value.reshape(shape) * (
+            self.act_scale / (127.0 * 127.0))
+        out = acc.astype(jnp.float32) * scale
+        if self.bias is not None:
+            b_shape = [1] * acc.ndim
+            b_shape[ch_axis] = acc.shape[ch_axis]
+            out = out + self.bias.value.reshape(b_shape)
+        return out.astype(x.dtype)
+
+
+class PostTrainingQuantization:
+    """Post-training quantization (post_training_quantization.py:120),
+    eager-style: feed calibration batches, observe activation abs-max at
+    every quantizable layer input, then freeze to int8 layers.
+
+    Usage::
+
+        ptq = PostTrainingQuantization(model)
+        for batch in calib_data:
+            ptq.collect(batch)           # runs the model, records scales
+        int8_model = ptq.quantize()      # model rewritten with Int8 layers
+    """
+
+    def __init__(self, model, algo="abs_max", activation_bits=8,
+                 weight_bits=8, quantizable_layer_type=("Conv2D", "Linear")):
+        from .. import nn
+
+        if algo not in ("abs_max", "avg"):
+            raise InvalidArgumentError(
+                f"algo must be abs_max or avg, got {algo!r} (KL calibration "
+                "is not implemented)")
+        if activation_bits != 8 or weight_bits != 8:
+            raise InvalidArgumentError("only 8-bit PTQ is implemented")
+        self._model = model
+        self._algo = algo
+        name_map = {"Conv2D": nn.Conv2D, "Linear": nn.Linear}
+        self._types = tuple(name_map[t] if isinstance(t, str) else t
+                            for t in quantizable_layer_type)
+        self._stats = {}   # layer name → list of batch abs-max
+        self._targets = {n: l for n, l in model.named_sublayers()
+                         if isinstance(l, self._types)}
+        self._hooks = []
+        for name, layer in self._targets.items():
+            self._hooks.append(layer.register_forward_pre_hook(
+                self._make_hook(name)))
+
+    def _make_hook(self, name):
+        def hook(layer, inputs):
+            x = inputs[0]
+            self._stats.setdefault(name, []).append(
+                float(jnp.max(jnp.abs(jnp.asarray(x, jnp.float32)))))
+            return None
+        return hook
+
+    def collect(self, *batch):
+        """Run one calibration batch through the model (eval mode)."""
+        self._model.eval()
+        return self._model(*batch)
+
+    def quantize(self):
+        """Freeze observed scales into Int8 layers; returns the model."""
+        from .. import nn
+
+        for h in self._hooks:
+            h.remove()
+        for name, layer in self._targets.items():
+            obs = self._stats.get(name)
+            if not obs:
+                raise InvalidArgumentError(
+                    f"no calibration data flowed through layer {name!r}")
+            act_scale = (max(obs) if self._algo == "abs_max"
+                         else sum(obs) / len(obs))
+            if isinstance(layer, nn.Conv2D):
+                q = Int8Conv2D.from_float(layer, act_scale)
+            else:
+                q = Int8Linear.from_float(layer, act_scale)
+            _replace_sublayer(self._model, name, q)
+        return self._model

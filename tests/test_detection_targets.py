@@ -43,8 +43,10 @@ def _delta(ex, gt, w=None):
     return d
 
 
-def _rpn_oracle_one(anchors, gt, crowd, im_info, B, straddle, pos, neg, frac):
-    """Transcribes rpn_target_assign_op.cc per image, use_random=False."""
+def _rpn_candidates(anchors, gt, crowd, im_info, straddle, pos, neg):
+    """Shared candidate-set computation (rpn_target_assign_op.cc:172-230):
+    returns (inside idx list, iou [inside x gts], fg cand, bg cand,
+    anchor→gt argmax) in inside-index space."""
     M = len(anchors)
     ih, iw, scale = im_info
     if straddle >= 0:
@@ -62,9 +64,16 @@ def _rpn_oracle_one(anchors, gt, crowd, im_info, B, straddle, pos, neg, frac):
     fg_cand = [k for k in range(len(inside))
                if any(abs(iou[k, j] - g2a_max[j]) < EPS
                       for j in range(len(gts))) or a2g_max[k] >= pos]
+    bg_cand = [k for k in range(len(inside)) if a2g_max[k] < neg]
+    return inside, gts, fg_cand, bg_cand, a2g_arg
+
+
+def _rpn_oracle_one(anchors, gt, crowd, im_info, B, straddle, pos, neg, frac):
+    """Transcribes rpn_target_assign_op.cc per image, use_random=False."""
+    inside, gts, fg_cand, bg_cand, a2g_arg = _rpn_candidates(
+        anchors, gt, crowd, im_info, straddle, pos, neg)
     quota = int(frac * B)
     fg_sel = fg_cand[:quota]
-    bg_cand = [k for k in range(len(inside)) if a2g_max[k] < neg]
     bg_sel = bg_cand[:B - len(fg_sel)]
     label = {}
     for k in fg_sel:
@@ -152,21 +161,10 @@ class TestRpnTargetAssign:
             # containment: every selected anchor must come from the oracle
             # candidate sets (random logits are unique, so gathered score
             # values identify the chosen anchors)
-            ih, iw, scale = im_info[n]
-            inside = [i for i in range(anchors.shape[0])
-                      if anchors[i, 0] >= 0 and anchors[i, 1] >= 0
-                      and anchors[i, 2] < iw and anchors[i, 3] < ih]
-            gts = [g * scale for g, c in zip(gt[n], crowd[n]) if c == 0]
-            iou = np.array([[_iou1(anchors[i], g) for g in gts]
-                            for i in inside])
-            a2g_max = iou.max(1)
-            g2a_max = iou.max(0)
-            fg_cand = {inside[kk] for kk in range(len(inside))
-                       if any(abs(iou[kk, j] - g2a_max[j]) < EPS
-                              for j in range(len(gts)))
-                       or a2g_max[kk] >= 0.7}
-            bg_cand = {inside[kk] for kk in range(len(inside))
-                       if a2g_max[kk] < 0.3}
+            inside, _, fg_c, bg_c, _ = _rpn_candidates(
+                anchors, gt[n], crowd[n], im_info[n], 0.0, 0.7, 0.3)
+            fg_cand = {inside[kk] for kk in fg_c}
+            bg_cand = {inside[kk] for kk in bg_c}
             logits_flat = cls_logits[n, :, 0]
             for slot in range(B):
                 if lbl_np[n, slot] < 0:

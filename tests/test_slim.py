@@ -1,0 +1,251 @@
+"""slim quantization: fake-quant numerics, QAT wrapping + fine-tune,
+PTQ calibration, int8 layer accuracy, and export round-trip.
+
+Reference parity targets: contrib/slim/quantization/imperative/qat.py:50,
+quant_nn.py:32-500, post_training_quantization.py:120.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import optimizer as popt
+from paddle_tpu.slim import (
+    FakeQuantAbsMax,
+    FakeQuantMovingAverage,
+    ImperativeQuantAware,
+    Int8Linear,
+    PostTrainingQuantization,
+    QuantizedConv2D,
+    QuantizedLinear,
+    fake_quant_dequant,
+    quantize_to_int8,
+)
+
+
+class TestFakeQuantDequant:
+    def test_formula_vs_numpy(self):
+        # out = round(clip(x)/s*127)*s/127 (quant_nn.py FakeQuant formula)
+        x = np.array([-2.0, -0.5, 0.0, 0.3, 0.77, 1.5], np.float32)
+        s = 1.0
+        out = np.asarray(fake_quant_dequant(jnp.asarray(x), s))
+        exp = np.round(np.clip(x, -s, s) * 127) / 127
+        np.testing.assert_allclose(out, exp, atol=1e-6)
+
+    def test_straight_through_gradient(self):
+        g = jax.grad(lambda x: fake_quant_dequant(x, 1.0).sum())(
+            jnp.asarray([0.3, 2.0]))
+        np.testing.assert_allclose(np.asarray(g), [1.0, 1.0])
+
+    def test_quantization_error_bound(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.uniform(-3, 3, (64,)).astype(np.float32))
+        s = float(jnp.max(jnp.abs(x)))
+        out = fake_quant_dequant(x, s)
+        assert float(jnp.max(jnp.abs(out - x))) <= s / 127 / 2 + 1e-6
+
+
+class TestObservers:
+    def test_moving_average_formula(self):
+        # scale = (rate·accum + |x|max) / (rate·state + 1)
+        fq = FakeQuantMovingAverage(moving_rate=0.9)
+        fq.train()
+        fq(jnp.asarray([2.0, -1.0]))
+        np.testing.assert_allclose(
+            float(fq.scale), (0.9 * 1.0 + 2.0) / (0.9 * 1.0 + 1), rtol=1e-6)
+        fq(jnp.asarray([4.0]))
+        accum = 0.9 * (0.9 + 2.0) + 4.0
+        state = 0.9 * 1.9 + 1.0
+        np.testing.assert_allclose(float(fq.scale), accum / state, rtol=1e-6)
+
+    def test_eval_uses_stored_scale(self):
+        fq = FakeQuantMovingAverage()
+        fq.train()
+        fq(jnp.asarray([1.0]))
+        s = float(fq.scale)
+        fq.eval()
+        fq(jnp.asarray([100.0]))  # must NOT move the scale
+        assert float(fq.scale) == s
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def _cnn():
+    return nn.Sequential(nn.Conv2D(1, 4, 3, padding=1), nn.ReLU(),
+                         nn.Conv2D(4, 2, 3, padding=1))
+
+
+class TestImperativeQuantAware:
+    def test_wraps_layers_in_place(self):
+        m = _mlp()
+        ImperativeQuantAware().quantize(m)
+        assert isinstance(m[0], QuantizedLinear)
+        assert isinstance(m[2], QuantizedLinear)
+        c = _cnn()
+        ImperativeQuantAware().quantize(c)
+        assert isinstance(c[0], QuantizedConv2D)
+
+    def test_qat_close_to_float(self):
+        paddle.seed(0)
+        m = _mlp()
+        rng = np.random.RandomState(0)
+        x = rng.uniform(-1, 1, (16, 8)).astype(np.float32)
+        m.eval()
+        ref = np.asarray(m(paddle.to_tensor(x)))
+        ImperativeQuantAware().quantize(m)
+        m.train()
+        m(paddle.to_tensor(x))  # observe scales
+        m.eval()
+        out = np.asarray(m(paddle.to_tensor(x)))
+        # int8 fake quant on a 2-layer MLP: small relative error
+        assert np.abs(out - ref).max() < 0.1 * np.abs(ref).max() + 0.05
+
+    def test_qat_trains(self):
+        # fine-tuning through the fake-quant STE must reduce loss
+        paddle.seed(1)
+        m = _mlp()
+        ImperativeQuantAware().quantize(m)
+        rng = np.random.RandomState(1)
+        x = rng.uniform(-1, 1, (64, 8)).astype(np.float32)
+        w = rng.uniform(-1, 1, (8, 4)).astype(np.float32)
+        y = x @ w
+        model = paddle.Model(m, inputs=["x"], labels=["y"])
+        model.prepare(optimizer=popt.Adam(learning_rate=0.01),
+                      loss=nn.MSELoss())
+        losses = [float(model.train_batch([x], [y])[0]) for _ in range(60)]
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    def test_convert_to_int8(self):
+        paddle.seed(2)
+        m = _mlp()
+        qat = ImperativeQuantAware()
+        qat.quantize(m)
+        rng = np.random.RandomState(2)
+        x = rng.uniform(-1, 1, (32, 8)).astype(np.float32)
+        m.train()
+        for _ in range(5):
+            m(paddle.to_tensor(x))
+        m.eval()
+        ref = np.asarray(m(paddle.to_tensor(x)))
+        qat.convert(m)
+        assert isinstance(m[0], Int8Linear)
+        out = np.asarray(m(paddle.to_tensor(x)))
+        assert np.abs(out - ref).max() < 0.1 * np.abs(ref).max() + 0.05
+
+
+class TestPostTrainingQuantization:
+    def test_ptq_linear_close_to_float(self):
+        paddle.seed(3)
+        m = _mlp()
+        rng = np.random.RandomState(3)
+        calib = [rng.uniform(-1, 1, (16, 8)).astype(np.float32)
+                 for _ in range(4)]
+        m.eval()
+        ref = np.asarray(m(paddle.to_tensor(calib[0])))
+        ptq = PostTrainingQuantization(m)
+        for b in calib:
+            ptq.collect(paddle.to_tensor(b))
+        qm = ptq.quantize()
+        out = np.asarray(qm(paddle.to_tensor(calib[0])))
+        assert np.abs(out - ref).max() < 0.1 * np.abs(ref).max() + 0.05
+
+    def test_ptq_conv(self):
+        paddle.seed(4)
+        m = _cnn()
+        rng = np.random.RandomState(4)
+        x = rng.uniform(-1, 1, (2, 1, 8, 8)).astype(np.float32)
+        m.eval()
+        ref = np.asarray(m(paddle.to_tensor(x)))
+        ptq = PostTrainingQuantization(m)
+        ptq.collect(paddle.to_tensor(x))
+        qm = ptq.quantize()
+        out = np.asarray(qm(paddle.to_tensor(x)))
+        assert np.abs(out - ref).max() < 0.15 * np.abs(ref).max() + 0.05
+
+    def test_no_calibration_raises(self):
+        m = _mlp()
+        ptq = PostTrainingQuantization(m)
+        with pytest.raises(Exception):
+            ptq.quantize()
+
+
+class TestInt8Numerics:
+    def test_int8_linear_3d_input(self):
+        # transformer-style [batch, seq, features] input must work
+        rng = np.random.RandomState(8)
+        lin = nn.Linear(6, 3)
+        x = rng.uniform(-1, 1, (2, 4, 6)).astype(np.float32)
+        q = Int8Linear.from_float(lin, float(np.abs(x).max()))
+        out = np.asarray(q(paddle.to_tensor(x)))
+        lin.eval()
+        ref = np.asarray(lin(paddle.to_tensor(x)))
+        assert out.shape == ref.shape
+        assert np.abs(out - ref).max() < 0.1 * np.abs(ref).max() + 0.05
+
+    def test_convert_abs_max_activation_rejected(self):
+        m = _mlp()
+        qat = ImperativeQuantAware(activation_quantize_type="abs_max")
+        qat.quantize(m)
+        with pytest.raises(Exception, match="moving_average_abs_max"):
+            qat.convert(m)
+
+    def test_int8_matmul_int32_accumulate(self):
+        # the quantized matmul must run on integer operands: compare the
+        # int8 path against an explicit integer-arithmetic oracle
+        rng = np.random.RandomState(5)
+        lin = nn.Linear(6, 3)
+        x = rng.uniform(-1, 1, (4, 6)).astype(np.float32)
+        act_scale = float(np.abs(x).max())
+        q = Int8Linear.from_float(lin, act_scale)
+        out = np.asarray(q(paddle.to_tensor(x)))
+        wq = np.asarray(q.w_q.value).astype(np.int32)
+        ws = np.asarray(q.w_scale.value)
+        xq = np.clip(np.round(x / act_scale * 127), -127, 127).astype(np.int32)
+        acc = xq @ wq
+        exp = acc.astype(np.float32) * (ws.reshape(1, -1)
+                                        * act_scale / (127 * 127))
+        exp = exp + np.asarray(lin.bias.value)
+        np.testing.assert_allclose(out, exp, rtol=1e-5, atol=1e-5)
+
+    def test_quantize_to_int8_channel_wise(self):
+        rng = np.random.RandomState(6)
+        w = rng.uniform(-2, 2, (5, 7)).astype(np.float32)
+        q, s = quantize_to_int8(w, channel_axis=1)
+        assert q.dtype == jnp.int8
+        recon = np.asarray(q).astype(np.float32) * np.asarray(s) / 127
+        np.testing.assert_allclose(recon, w, atol=np.abs(w).max() / 127 + 1e-6)
+
+
+class TestInt8Export:
+    def test_export_reload_roundtrip(self, tmp_path):
+        # int8 model → StableHLO export → reload → same outputs
+        from paddle_tpu import inference
+
+        paddle.seed(7)
+        m = _mlp()
+        rng = np.random.RandomState(7)
+        x = rng.uniform(-1, 1, (8, 8)).astype(np.float32)
+        ptq = PostTrainingQuantization(m)
+        ptq.collect(paddle.to_tensor(x))
+        qm = ptq.quantize()
+        qm.eval()
+        ref = np.asarray(qm(paddle.to_tensor(x)))
+
+        from paddle_tpu.inference import Config, create_predictor, \
+            save_inference_model
+        from paddle_tpu.static import InputSpec
+
+        prefix = os.path.join(str(tmp_path), "int8_model")
+        save_inference_model(prefix, qm, [InputSpec([None, 8], "float32")],
+                             platforms=("cpu",))
+        cfg = Config(prefix + ".pdmodel", prefix + ".pdiparams")
+        predictor = create_predictor(cfg)
+        out = predictor.run([x])[0]
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
