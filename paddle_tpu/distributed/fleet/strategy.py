@@ -67,3 +67,11 @@ class DistributedStrategy:
                 "sharding_degree", 0)) or 0  # 0 → span the data dimension
         if self.pipeline and self.pp_degree == 1:
             self.pp_degree = int(self.pipeline_configs.get("pp_degree", 1))
+        sched = str((self.pipeline_configs or {}).get(
+            "schedule", "gpipe")).lower()
+        # F-then-B is the reference's name for the fwd-all-then-bwd-all
+        # schedule — the GPipe execution this package already provides
+        if sched not in ("gpipe", "f-then-b", "1f1b"):
+            raise ValueError(
+                "pipeline_configs['schedule'] must be 'gpipe'/'F-then-B'/"
+                f"'1F1B' (case-insensitive), got {sched!r}")

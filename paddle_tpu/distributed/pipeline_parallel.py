@@ -39,7 +39,8 @@ from ..framework.errors import InvalidArgumentError
 from ..nn.layer_base import current_rng_key, functional_call
 from .mesh import get_mesh
 
-__all__ = ["pipeline_degree", "pipeline_blocks"]
+__all__ = ["pipeline_degree", "pipeline_blocks", "pipeline_train_step",
+           "ring_buffer_slots"]
 
 
 def pipeline_degree(mesh=None) -> int:
@@ -66,6 +67,7 @@ def pipeline_blocks(
     num_microbatches: Optional[int] = None,
     mesh=None,
     axis_name: str = "pipe",
+    params: Optional[Dict[str, jax.Array]] = None,
 ):
     """Run ``x`` through ``blocks`` (a homogeneous Layer stack) pipelined
     over the ``pipe`` mesh axis.  Semantically identical to
@@ -82,16 +84,23 @@ def pipeline_blocks(
     """
     mesh = mesh or get_mesh()
     pp = mesh.shape.get(axis_name, 1)
+    template = blocks[0]
     if pp == 1:
-        for b in blocks:
-            x = b(x)
+        if params is None:
+            for b in blocks:
+                x = b(x)
+        else:
+            for j in range(len(blocks)):
+                x = functional_call(
+                    template, {n: v[j] for n, v in params.items()}, x,
+                    rngs=current_rng_key()
+                    if getattr(template, "training", False) else None)
         return x
 
     L = len(blocks)
     if L % pp:
         raise InvalidArgumentError(
             f"pipeline: {L} blocks not divisible by pp={pp} stages")
-    template = blocks[0]
     if list(template.named_buffers()):
         raise InvalidArgumentError(
             "pipeline blocks must be buffer-free (running-stat updates "
@@ -110,7 +119,7 @@ def pipeline_blocks(
     training = bool(getattr(template, "training", False))
     base_key = current_rng_key() if training else jax.random.PRNGKey(0)
 
-    stacked = _stack_block_params(blocks)
+    stacked = _stack_block_params(blocks) if params is None else params
     stacked = {
         n: v.reshape((pp, per_stage) + v.shape[1:]) for n, v in stacked.items()
     }
@@ -172,3 +181,193 @@ def pipeline_blocks(
         check_vma=False,
     )
     return shmapped(stacked, x)
+
+
+def ring_buffer_slots(pp: int) -> int:
+    """Saved activations per stage under the 1F1B schedule: the maximum
+    number of in-flight microbatches at stage 0 is ``2·pp − 1`` — a
+    constant in ``num_microbatches``, which is the memory win 1F1B exists
+    to provide (GPipe holds all M)."""
+    return 2 * pp - 1
+
+
+def pipeline_train_step(
+    blocks: Sequence,
+    x: jax.Array,
+    labels,
+    loss_fn,
+    *,
+    num_microbatches: Optional[int] = None,
+    schedule: str = "1f1b",
+    mesh=None,
+    axis_name: str = "pipe",
+):
+    """One pipelined fwd+bwd pass: returns ``(mean_loss, grads)`` with
+    ``grads = {param_name_within_block: [L, ...]}`` stacked over blocks.
+
+    ``schedule="1f1b"`` interleaves each stage's forwards and backwards in
+    ONE lax.scan (the reference SectionWorker's 1F1B thread loop,
+    section_worker.cc:82-230, as a compiled SPMD schedule): at tick ``t``
+    stage ``s`` forwards microbatch ``t−s`` and backwards microbatch
+    ``t−(2·pp−2−s)``, so the last stage backs each microbatch the tick it
+    forwards it and live activations are bounded by
+    :func:`ring_buffer_slots` (2·pp−1) instead of M.  The backward
+    recomputes the stage forward from the saved stage INPUT (activation
+    rematerialization — the standard 1F1B companion), so per-microbatch
+    state is one activation, not a residual pytree.  Activations ppermute
+    down the ``pipe`` ring, cotangents ppermute up, both with the
+    one-tick lag the schedule provides naturally.
+
+    ``schedule="gpipe"`` runs :func:`pipeline_blocks` under
+    ``jax.value_and_grad`` (fwd-all-then-bwd-all) with the same signature
+    — the two schedules are interchangeable and gradient-equivalent.
+
+    ``loss_fn(y_mb, label_mb) → scalar`` must mean over its microbatch;
+    the returned loss is the mean over microbatches.  Gradients w.r.t.
+    ``x`` are not returned (training steps differentiate parameters).
+    """
+    mesh = mesh or get_mesh()
+    pp = mesh.shape.get(axis_name, 1)
+    L = len(blocks)
+    template = blocks[0]
+    stacked_flat = _stack_block_params(blocks)  # {n: [L, ...]}
+
+    schedule = str(schedule).lower()
+    if schedule == "f-then-b":  # the reference's name for fwd-all-bwd-all
+        schedule = "gpipe"
+    if schedule not in ("1f1b", "gpipe"):
+        raise InvalidArgumentError(
+            f"pipeline schedule must be '1f1b', 'gpipe' or 'F-then-B', "
+            f"got {schedule!r}")
+
+    labels = jnp.asarray(labels)
+    if schedule == "gpipe" or pp == 1:
+        def lfn(st):
+            y = pipeline_blocks(blocks, x,
+                                num_microbatches=num_microbatches,
+                                mesh=mesh, axis_name=axis_name, params=st)
+            return loss_fn(y, labels)
+
+        return jax.value_and_grad(lfn)(stacked_flat)
+
+    if L % pp:
+        raise InvalidArgumentError(
+            f"pipeline: {L} blocks not divisible by pp={pp} stages")
+    if list(template.named_buffers()):
+        raise InvalidArgumentError(
+            "pipeline blocks must be buffer-free (use LayerNorm)")
+    per_stage = L // pp
+    M = int(num_microbatches or pp)
+    B = x.shape[0]
+    if B % M:
+        raise InvalidArgumentError(
+            f"pipeline: batch {B} not divisible by {M} microbatches")
+    mb = B // M
+    RB = ring_buffer_slots(pp)
+
+    training = bool(getattr(template, "training", False))
+    base_key = current_rng_key() if training else jax.random.PRNGKey(0)
+
+    stacked = {n: v.reshape((pp, per_stage) + v.shape[1:])
+               for n, v in stacked_flat.items()}
+
+    def local(stage_params, xin, yin):
+        stage_params = {n: v[0] for n, v in stage_params.items()}
+        stage = lax.axis_index(axis_name)
+        micro = xin.reshape((M, mb) + xin.shape[1:])
+        lmicro = yin.reshape((M, mb) + yin.shape[1:])
+        act_shape = (mb,) + xin.shape[1:]
+
+        def stage_apply(pdict, h, mb_idx):
+            def body(h, idx_and_params):
+                j, pd = idx_and_params
+                key = jax.random.fold_in(
+                    jax.random.fold_in(base_key,
+                                       stage * per_stage + j), mb_idx)
+                return functional_call(template, pd, h, rngs=key), None
+
+            # scan over the ARGUMENT pdict (not the closure) — the backward
+            # tick takes jax.vjp w.r.t. it
+            h, _ = lax.scan(body, h, (jnp.arange(per_stage), pdict))
+            return h
+
+        zero_grads = jax.tree_util.tree_map(
+            lambda v: jnp.zeros_like(v, jnp.float32), stage_params)
+        carry0 = (
+            jnp.zeros(act_shape, x.dtype),           # fwd_recv
+            jnp.zeros(act_shape, jnp.float32),       # bwd_recv (cotangent)
+            jnp.zeros((RB,) + act_shape, x.dtype),   # saved stage inputs
+            zero_grads,                              # grad accumulator
+            jnp.zeros((), jnp.float32),              # loss accumulator
+        )
+        i32 = jnp.int32
+        is_last = stage == pp - 1
+
+        def tick(carry, t):
+            fwd_recv, bwd_recv, ring, grad_acc, loss_acc = carry
+            t = t.astype(i32)
+            f = t - stage
+            b = t - (i32(2 * pp - 2) - stage)
+            do_f = (f >= 0) & (f < M)
+            do_b = (b >= 0) & (b < M)
+
+            # ---- forward tick for microbatch f
+            f_safe = jnp.clip(f, 0, M - 1)
+            h_in = jnp.where(stage == 0,
+                             lax.dynamic_index_in_dim(micro, f_safe, 0,
+                                                      keepdims=False),
+                             fwd_recv)
+            y = stage_apply(stage_params, h_in, f_safe)
+            ring = jnp.where(
+                do_f,
+                lax.dynamic_update_index_in_dim(ring, h_in, f_safe % RB, 0),
+                ring)
+
+            # ---- last stage: per-microbatch loss + output cotangent; its
+            # backward microbatch b equals f, so dy feeds this very tick
+            lbl = lax.dynamic_index_in_dim(lmicro, f_safe, 0, keepdims=False)
+            loss_val, dy = jax.value_and_grad(
+                lambda yy: loss_fn(yy, lbl))(y.astype(jnp.float32))
+            loss_acc = loss_acc + jnp.where(do_f & is_last, loss_val, 0.0)
+            dy = dy / M  # total loss is the MEAN over microbatches
+
+            # ---- backward tick for microbatch b (recompute-from-input)
+            b_safe = jnp.clip(b, 0, M - 1)
+            h_saved = lax.dynamic_index_in_dim(ring, b_safe % RB, 0,
+                                               keepdims=False)
+            cot_in = jnp.where(is_last, dy, bwd_recv).astype(jnp.float32)
+            _, vjp = jax.vjp(
+                lambda p, h: stage_apply(p, h, b_safe).astype(jnp.float32),
+                stage_params, h_saved)
+            dparams, dh = vjp(cot_in)
+            grad_acc = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(do_b, g.astype(jnp.float32), 0.0),
+                grad_acc, dparams)
+
+            # ---- neighbor exchange: activations down, cotangents up
+            fwd_recv = lax.ppermute(
+                y, axis_name, [(i, (i + 1) % pp) for i in range(pp)])
+            bwd_recv = lax.ppermute(
+                jnp.where(do_b, dh.astype(jnp.float32), 0.0), axis_name,
+                [(i, (i - 1) % pp) for i in range(pp)])
+            return (fwd_recv, bwd_recv, ring, grad_acc, loss_acc), None
+
+        T = M + 2 * pp - 2
+        (fwd_recv, bwd_recv, ring, grad_acc, loss_acc), _ = lax.scan(
+            tick, carry0, jnp.arange(T))
+        loss = lax.psum(loss_acc, axis_name) / M
+        # grads live per-stage; shard_map reassembles the pp axis
+        grad_acc = jax.tree_util.tree_map(lambda g: g[None], grad_acc)
+        return loss, grad_acc
+
+    shmapped = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=({n: P(axis_name) for n in stacked}, P(), P()),
+        out_specs=(P(), {n: P(axis_name) for n in stacked}),
+        axis_names={axis_name},
+        check_vma=False,
+    )
+    loss, grads = shmapped(stacked, x, labels)
+    grads = {n: g.reshape((L,) + g.shape[2:]) for n, g in grads.items()}
+    return loss, grads

@@ -173,6 +173,16 @@ class Model:
                 # count to every pipeline-capable sublayer
                 pc = strategy.pipeline_configs or {}
                 micro = int(pc.get("accumulate_steps", 0)) or None
+                if pc.get("schedule", "gpipe").lower() == "1f1b":
+                    import warnings
+
+                    warnings.warn(
+                        "pipeline_configs['schedule']='1f1b' is a train-step"
+                        "-level schedule: drive it with distributed."
+                        "pipeline_parallel.pipeline_train_step (grads "
+                        "computed inside the interleaved schedule); "
+                        "Model.fit's in-forward pipeline runs GPipe",
+                        RuntimeWarning)
                 hits = 0
                 for sub in net.sublayers(include_self=True):
                     if hasattr(sub, "pipeline_microbatches"):

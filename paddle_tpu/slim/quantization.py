@@ -104,13 +104,7 @@ class FakeQuantMovingAverage(Layer):
 
     def forward(self, x):
         if self.training:
-            cur = jnp.max(jnp.abs(jnp.asarray(x, jnp.float32)))
-            state = self._state.value * self._moving_rate + 1.0
-            accum = self._accum.value * self._moving_rate + cur
-            scale = accum / state
-            self._state.value = state
-            self._accum.value = accum
-            self._scale.value = scale
+            scale = _update_moving_stats(self, x)
         else:
             scale = self._scale.value
         return fake_quant_dequant(x, scale.reshape(()), self._quant_bits)
@@ -133,12 +127,7 @@ class MovingAverageAbsMaxScale(Layer):
 
     def forward(self, x):
         if self.training:
-            cur = jnp.max(jnp.abs(jnp.asarray(x, jnp.float32)))
-            state = self._state.value * self._moving_rate + 1.0
-            accum = self._accum.value * self._moving_rate + cur
-            self._state.value = state
-            self._accum.value = accum
-            self._scale.value = accum / state
+            _update_moving_stats(self, x)
         return x
 
     @property
@@ -155,6 +144,18 @@ def _replace_sublayer(model, dotted_name, new_layer):
     for p in parts[:-1]:
         parent = parent._sub_layers[p]
     parent._sub_layers[parts[-1]] = new_layer
+
+
+def _update_moving_stats(obs, x):
+    """scale = (rate·accum + |x|max) / (rate·state + 1) — the one shared
+    moving-average observer update (quant_nn.py:81)."""
+    cur = jnp.max(jnp.abs(jnp.asarray(x, jnp.float32)))
+    state = obs._state.value * obs._moving_rate + 1.0
+    accum = obs._accum.value * obs._moving_rate + cur
+    obs._state.value = state
+    obs._accum.value = accum
+    obs._scale.value = accum / state
+    return obs._scale.value
 
 
 def _weight_quantizer(kind, bits, channel_axis, rate=0.9):
@@ -279,6 +280,11 @@ class ImperativeQuantAware:
                     "use activation_quantize_type='moving_average_abs_max' "
                     "(abs_max recomputes per batch and cannot freeze, like "
                     "the reference QuantizationFreezePass)")
+            if float(jnp.asarray(act_q._state.value).reshape(())) == 1.0:
+                raise InvalidArgumentError(
+                    f"activation observer for {name!r} never saw data: run "
+                    "training-mode forwards before convert() (the scale is "
+                    "still its init value)")
             act_scale = float(jnp.asarray(act_q.scale).reshape(()))
             if isinstance(layer, QuantizedConv2D):
                 q = Int8Conv2D.from_float(layer._inner, act_scale)
@@ -314,7 +320,7 @@ class Int8Linear(Layer):
             self.register_buffer("bias", jnp.asarray(bias, jnp.float32))
         else:
             self.bias = None
-        self.act_scale = float(act_scale)
+        self.act_scale = max(float(act_scale), 1e-9)
 
     @classmethod
     def from_float(cls, linear, act_scale):
@@ -351,7 +357,7 @@ class Int8Conv2D(Layer):
             self.register_buffer("bias", jnp.asarray(bias, jnp.float32))
         else:
             self.bias = None
-        self.act_scale = float(act_scale)
+        self.act_scale = max(float(act_scale), 1e-9)
         self._cfg = (stride, padding, dilation, groups, data_format)
 
     @classmethod

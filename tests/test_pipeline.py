@@ -148,3 +148,150 @@ class TestPipelineGPT:
         model = paddle.Model(net)
         model.prepare(optimizer=opt, loss=net.loss)
         assert net.gpt.pipeline_microbatches == 4
+
+
+class Test1F1B:
+    """pipeline_train_step schedule='1f1b' vs gpipe vs sequential
+    (ref: section_worker.cc:82-230 1F1B thread loop)."""
+
+    def _blocks(self, n=8, d=8):
+        paddle.seed(0)
+        blocks = nn.LayerList([nn.Linear(d, d) for _ in range(n)])
+        for b in blocks:
+            b.eval()
+        return blocks
+
+    def _seq_loss_grads(self, blocks, x, y, loss_fn):
+        params = {n: p.value for n, p in blocks.named_parameters()}
+
+        def loss(ps):
+            boxes = dict(blocks.named_parameters())
+            saved = {n: b.value for n, b in boxes.items()}
+            try:
+                for n, v in ps.items():
+                    boxes[n].value = v
+                h = x
+                for b in blocks:
+                    h = b(h)
+            finally:
+                for n, v in saved.items():
+                    boxes[n].value = v
+            return loss_fn(h, y)
+
+        return jax.value_and_grad(loss)(params)
+
+    @pytest.mark.parametrize("M", [4, 6])
+    def test_1f1b_matches_sequential_and_gpipe(self, M):
+        from paddle_tpu.distributed.pipeline_parallel import (
+            pipeline_train_step)
+
+        set_mesh(build_mesh(pp=4))
+        blocks = self._blocks()
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(12, 8), jnp.float32)
+        y = jnp.asarray(rng.randn(12, 8), jnp.float32)
+
+        def loss_fn(h, lbl):
+            return ((h - lbl) ** 2).mean()
+
+        v_seq, g_seq = self._seq_loss_grads(blocks, x, y, loss_fn)
+        # per-block grads from the flat dict: keys are "<i>.weight" etc.
+        l1, g1 = jax.jit(lambda xx, yy: pipeline_train_step(
+            blocks, xx, yy, loss_fn, num_microbatches=M,
+            schedule="1f1b"))(x, y)
+        l2, g2 = jax.jit(lambda xx, yy: pipeline_train_step(
+            blocks, xx, yy, loss_fn, num_microbatches=M,
+            schedule="gpipe"))(x, y)
+        np.testing.assert_allclose(float(l1), float(v_seq), rtol=1e-5)
+        np.testing.assert_allclose(float(l2), float(v_seq), rtol=1e-5)
+        for name in g1:  # stacked [L, ...] per within-block name
+            for j in range(len(blocks)):
+                np.testing.assert_allclose(
+                    np.asarray(g1[name][j]), np.asarray(g_seq[f"{j}.{name}"]),
+                    rtol=1e-4, atol=1e-5,
+                    err_msg=f"1f1b grad {name}[{j}]")
+                np.testing.assert_allclose(
+                    np.asarray(g2[name][j]), np.asarray(g_seq[f"{j}.{name}"]),
+                    rtol=1e-4, atol=1e-5,
+                    err_msg=f"gpipe grad {name}[{j}]")
+
+    def test_activation_memory_bounded_by_ring(self):
+        """The 1F1B carry holds ring_buffer_slots(pp) = 2pp-1 activations
+        per stage — CONSTANT in num_microbatches (GPipe's autodiff holds
+        all M).  Asserted structurally on the jaxpr scan carry."""
+        from paddle_tpu.distributed.pipeline_parallel import (
+            pipeline_train_step, ring_buffer_slots)
+
+        set_mesh(build_mesh(pp=4))
+        pp = 4
+        assert ring_buffer_slots(pp) == 7
+        blocks = self._blocks()
+        d = 8
+
+        def loss_fn(h, lbl):
+            return ((h - lbl) ** 2).mean()
+
+        for M, B in ((8, 16), (32, 64)):
+            x = jnp.zeros((B, d), jnp.float32)
+            y = jnp.zeros((B, d), jnp.float32)
+            jaxpr = jax.make_jaxpr(lambda xx, yy: pipeline_train_step(
+                blocks, xx, yy, loss_fn, num_microbatches=M,
+                schedule="1f1b"))(x, y)
+            mb = B // M
+
+            # find every scan and check carried activation stashes: any
+            # carry aval shaped [k, mb, d] must have k == 2pp-1, never M
+            def walk(jx, found):
+                for eqn in jx.eqns:
+                    if eqn.primitive.name == "scan":
+                        n_carry = eqn.params["num_carry"]
+                        for var in eqn.invars[eqn.params["num_consts"]:
+                                              eqn.params["num_consts"]
+                                              + n_carry]:
+                            shp = tuple(var.aval.shape)
+                            if len(shp) == 3 and shp[1:] == (mb, d):
+                                found.append(shp[0])
+                    for sub in eqn.params.values():
+                        if hasattr(sub, "eqns"):  # raw Jaxpr (shard_map)
+                            walk(sub, found)
+                        elif hasattr(sub, "jaxpr"):  # ClosedJaxpr
+                            walk(sub.jaxpr, found)
+                return found
+
+            sizes = walk(jaxpr.jaxpr, [])
+            assert sizes, "no ring-buffer carry found"
+            assert max(sizes) == ring_buffer_slots(pp), (M, sizes)
+            assert max(sizes) < M or M <= ring_buffer_slots(pp)
+
+    def test_1f1b_pp1_falls_back(self):
+        from paddle_tpu.distributed.pipeline_parallel import (
+            pipeline_train_step)
+
+        set_mesh(build_mesh())  # no pipe axis
+        blocks = self._blocks(4)
+        x = jnp.asarray(np.random.RandomState(5).randn(4, 8), jnp.float32)
+        y = jnp.zeros((4, 8), jnp.float32)
+        loss, grads = pipeline_train_step(
+            blocks, x, y, lambda h, l: ((h - l) ** 2).mean(),
+            schedule="1f1b")
+        v_seq, g_seq = self._seq_loss_grads(
+            blocks, x, y, lambda h, l: ((h - l) ** 2).mean())
+        np.testing.assert_allclose(float(loss), float(v_seq), rtol=1e-6)
+        for name in grads:
+            for j in range(len(blocks)):
+                np.testing.assert_allclose(
+                    np.asarray(grads[name][j]),
+                    np.asarray(g_seq[f"{j}.{name}"]), rtol=1e-5, atol=1e-6,
+                    err_msg=f"pp1 grad {name}[{j}]")
+
+    def test_bad_schedule_raises(self):
+        from paddle_tpu.distributed.pipeline_parallel import (
+            pipeline_train_step)
+
+        set_mesh(build_mesh(pp=2))
+        blocks = self._blocks(4)
+        x = jnp.zeros((4, 8), jnp.float32)
+        with pytest.raises(Exception, match="schedule"):
+            pipeline_train_step(blocks, x, x,
+                                lambda h, l: (h ** 2).mean(),
+                                schedule="interleaved")

@@ -189,6 +189,19 @@ class TestInt8Numerics:
         assert out.shape == ref.shape
         assert np.abs(out - ref).max() < 0.1 * np.abs(ref).max() + 0.05
 
+    def test_convert_untrained_observer_rejected(self):
+        m = _mlp()
+        qat = ImperativeQuantAware()
+        qat.quantize(m)
+        with pytest.raises(Exception, match="never saw data"):
+            qat.convert(m)
+
+    def test_zero_act_scale_no_nan(self):
+        lin = nn.Linear(4, 2)
+        q = Int8Linear.from_float(lin, 0.0)  # degenerate calibration
+        out = np.asarray(q(paddle.to_tensor(np.zeros((3, 4), np.float32))))
+        assert np.isfinite(out).all()
+
     def test_convert_abs_max_activation_rejected(self):
         m = _mlp()
         qat = ImperativeQuantAware(activation_quantize_type="abs_max")
