@@ -42,6 +42,10 @@ class DistributedStrategy:
         default_factory=lambda: {"method": "ring"})
     localsgd: bool = False
     localsgd_configs: Dict = field(default_factory=dict)
+    fp16_allreduce: bool = False  # comm-precision: cast grads for the
+    # cross-replica reduction (ref: fp16_allreduce_optimizer.py:18)
+    fp16_allreduce_configs: Dict = field(
+        default_factory=lambda: {"dtype": "float16"})
     dgc: bool = False
     dgc_configs: Dict = field(default_factory=dict)
     lamb: bool = False

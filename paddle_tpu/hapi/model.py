@@ -132,6 +132,11 @@ class Model:
                 has_aux=True,
             )
             (loss_val, (out, new_bufs)), grads = grad_fn(params)
+            plan = self._plan
+            if plan is not None and hasattr(plan, "transform_gradients"):
+                # comm-precision plans reduce per-replica grads explicitly
+                # (inside their shard_map body) — e.g. fp16_allreduce
+                grads = plan.transform_gradients(grads)
             new_params, new_opt_state = opt.update(grads, opt_state, params, lr=lr)
             return loss_val, out, new_params, new_opt_state, new_bufs
 
@@ -173,7 +178,15 @@ class Model:
                 # count to every pipeline-capable sublayer
                 pc = strategy.pipeline_configs or {}
                 micro = int(pc.get("accumulate_steps", 0)) or None
-                if pc.get("schedule", "gpipe").lower() == "1f1b":
+                sched = str(pc.get("schedule", "gpipe")).lower()
+                if sched not in ("gpipe", "f-then-b", "1f1b"):
+                    # validate at use time too: the paddle idiom assigns
+                    # pipeline_configs after construction, bypassing
+                    # DistributedStrategy.__post_init__
+                    raise InvalidArgumentError(
+                        "pipeline_configs['schedule'] must be 'gpipe'/"
+                        f"'F-then-B'/'1F1B', got {sched!r}")
+                if sched == "1f1b":
                     import warnings
 
                     warnings.warn(
@@ -224,6 +237,13 @@ class Model:
                 from ..distributed.fleet.dgc import DGCPlan
 
                 self._plan = DGCPlan(net, optimizer, strategy)
+            elif strategy.fp16_allreduce:
+                # reference: fp16_allreduce_optimizer.py — cast grads for
+                # the cross-replica reduction (see fleet/fp16_allreduce.py)
+                from ..distributed.fleet.fp16_allreduce import (
+                    Fp16AllReducePlan)
+
+                self._plan = Fp16AllReducePlan(net, optimizer, strategy)
             else:
                 self._plan = ShardingPlan(net, optimizer, strategy)
             self._plan.place_network()
