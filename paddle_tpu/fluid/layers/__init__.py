@@ -670,6 +670,7 @@ def __getattr__(name):
 
         shim.__name__ = name
         shim.__doc__ = f"1.x shim; eager equivalent: {hint}"
+        shim.__shim__ = True  # three-valued parity audit marker
         return shim
     # final fallback: 2.0 tensor/functional name used through fluid.layers
     for ns in (_p, _F):

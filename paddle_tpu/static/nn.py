@@ -55,6 +55,7 @@ def _make_shim(name, instead):
     shim.__name__ = name
     shim.__qualname__ = name
     shim.__doc__ = f"Op-builder shim; eager equivalent: {instead}"
+    shim.__shim__ = True  # three-valued parity audit marker
     return shim
 
 

@@ -5,15 +5,23 @@ reference tree's import surface.
 Counterpart of the reference's API-freeze tooling (tools/check_api_compatible.py
 + paddle/fluid/API.spec): instead of freezing signatures, this walks the
 reference package __init__ files, extracts every publicly imported name,
-and reports which ones paddle_tpu does not resolve.  Run from the repo
-root:
+and reports a THREE-VALUED classification per namespace:
+
+  implemented  resolves to a real implementation
+  shimmed      resolves to an honest hint-shim that raises
+               UnimplementedError naming the eager equivalent
+               (``__shim__`` marker set by fluid.layers.__getattr__)
+  missing      does not resolve at all
+
+Run from the repo root:
 
     python tools/api_parity_audit.py [--ref /root/reference/python/paddle]
 
-Exit status 1 when any audited namespace has missing names, so it can
-gate CI.  `fluid.layers`-style modules that resolve names lazily via
-__getattr__ are probed with getattr (hasattr), which those modules
-support by design (shims resolve; only unknown names raise).
+Exit status 1 when any audited namespace has MISSING names (shims are
+reported but do not fail the audit — they are present-by-contract, not
+implemented).  `fluid.layers`-style modules that resolve names lazily via
+__getattr__ are probed with getattr, which those modules support by
+design (shims resolve; only unknown names raise).
 """
 from __future__ import annotations
 
@@ -87,6 +95,20 @@ def fluid_layers_names(ref_root: str) -> set:
     return names
 
 
+def classify(module, names, waive_prefix=""):
+    """Split resolved names into (implemented, shimmed, missing)."""
+    impl, shims, missing = [], [], []
+    for n in sorted(names):
+        if f"{waive_prefix}.{n}" in WAIVED:
+            continue
+        if not hasattr(module, n):
+            missing.append(n)
+            continue
+        obj = getattr(module, n)
+        (shims if getattr(obj, "__shim__", False) else impl).append(n)
+    return impl, shims, missing
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ref", default="/root/reference/python/paddle")
@@ -95,6 +117,8 @@ def main() -> int:
 
     sys.path.insert(0, os.getcwd())
     total_missing = 0
+    total_shimmed = 0
+    total_impl = 0
     rows = []
     for mod, rel in NAMESPACES:
         names = ref_names(args.ref, rel)
@@ -104,32 +128,41 @@ def main() -> int:
         try:
             ours = importlib.import_module(target)
         except Exception as e:  # noqa: BLE001
-            rows.append((mod or "paddle", len(names), -1, [f"IMPORT: {e}"]))
+            rows.append((mod or "paddle", len(names), 0, [],
+                         [f"IMPORT: {e}"]))
             total_missing += len(names)
             continue
-        missing = sorted(
-            n for n in names
-            if not hasattr(ours, n)
-            and f"{mod}.{n}" not in WAIVED)
+        impl, shims, missing = classify(ours, names,
+                                        waive_prefix=mod)
         total_missing += len(missing)
-        rows.append((mod or "paddle", len(names), len(missing), missing))
+        total_shimmed += len(shims)
+        total_impl += len(impl)
+        rows.append((mod or "paddle", len(names), len(impl), shims, missing))
 
     # fluid.layers: aggregated __all__, resolved via __getattr__ shims
     lnames = fluid_layers_names(args.ref)
     if lnames:
         fl = importlib.import_module("paddle_tpu.fluid.layers")
-        missing = sorted(n for n in lnames if not hasattr(fl, n))
+        impl, shims, missing = classify(fl, lnames, waive_prefix="fluid.layers")
         total_missing += len(missing)
-        rows.append(("fluid.layers", len(lnames), len(missing), missing))
+        total_shimmed += len(shims)
+        total_impl += len(impl)
+        rows.append(("fluid.layers", len(lnames), len(impl), shims, missing))
 
     width = max(len(r[0]) for r in rows) + 2
-    for mod, n_ref, n_miss, missing in rows:
-        status = "OK " if n_miss == 0 else f"{n_miss:3d} missing"
-        print(f"{mod:<{width}} ref={n_ref:<4d} {status}")
-        if missing and (args.verbose or n_miss):
+    print(f"{'namespace':<{width}} {'ref':>5} {'impl':>5} {'shim':>5} "
+          f"{'miss':>5}")
+    for mod, n_ref, n_impl, shims, missing in rows:
+        print(f"{mod:<{width}} {n_ref:>5} {n_impl:>5} {len(shims):>5} "
+              f"{len(missing):>5}")
+        if args.verbose and shims:
+            for name in shims:
+                print(f"    ~ shim: {name}")
+        if missing:
             for name in missing[:20]:
-                print(f"    - {name}")
-    print(f"\ntotal missing: {total_missing}")
+                print(f"    - MISSING: {name}")
+    print(f"\nimplemented: {total_impl}  shimmed: {total_shimmed}  "
+          f"missing: {total_missing}")
     return 1 if total_missing else 0
 
 
