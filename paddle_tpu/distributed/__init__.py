@@ -51,6 +51,7 @@ def prepare_context(strategy=None):
 from ..nn.recompute import recompute  # noqa: F401  (fleet.utils.recompute parity)
 from . import launch  # noqa: F401  (module: python -m paddle_tpu.distributed.launch)
 from . import fleet  # noqa: F401
+from . import heartbeat  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from .sequence_parallel import (  # noqa: F401
     ring_attention,

@@ -1,0 +1,174 @@
+"""Worker liveness: heartbeat monitoring and hang detection.
+
+Capability parity: HeartBeatMonitor
+(reference: paddle/fluid/operators/distributed/heart_beat_monitor.h:51) —
+the chief pserver tracked per-trainer beat timestamps and logged workers
+whose beats went stale.  TPU-native shape: there is no RPC plane, so
+
+* :class:`HeartBeatMonitor` is the transport-agnostic chief-side state
+  machine — ``update(worker_id)`` records a beat, a daemon thread flags
+  workers stale past ``timeout`` and invokes ``on_lost`` exactly once per
+  outage (re-arming when the worker resumes);
+* :class:`FileHeartbeat` is the single-host transport: each trainer
+  touches an mtime file (``PADDLE_TPU_HEARTBEAT_FILE``), which
+  :func:`paddle_tpu.distributed.parallel.watch` polls — a HUNG trainer
+  (alive but not stepping, e.g. a wedged collective) is killed and
+  restarted under the normal restart budget, which plain exit-code
+  watching can never detect;
+* multi-host pods get liveness from the jax.distributed coordination
+  service at init/shutdown barriers; per-step liveness rides the same
+  file transport per host, monitored by that host's watchdog.
+
+The training loop emits beats automatically: ``Model.train_batch`` calls
+:func:`maybe_beat` (cheap — one ``os.utime`` at most once a second, and a
+no-op unless the env var is set).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..framework.errors import InvalidArgumentError
+
+__all__ = ["HeartBeatMonitor", "FileHeartbeat", "maybe_beat"]
+
+ENV_FILE = "PADDLE_TPU_HEARTBEAT_FILE"
+
+
+class HeartBeatMonitor:
+    """Chief-side per-worker liveness tracker.
+
+    ``update(worker_id)`` may be called from any thread (beat transport);
+    the monitor thread wakes every ``interval`` seconds and calls
+    ``on_lost(worker_id, age_seconds)`` for each worker whose last beat is
+    older than ``timeout``.  A worker is reported lost once per outage;
+    if it beats again it re-arms.  Workers that never beat are measured
+    from ``start()``.
+    """
+
+    def __init__(self, workers: int, timeout: float = 60.0,
+                 interval: Optional[float] = None,
+                 on_lost: Optional[Callable[[int, float], None]] = None):
+        if workers <= 0:
+            raise InvalidArgumentError("workers must be > 0")
+        if timeout <= 0:
+            raise InvalidArgumentError("timeout must be > 0")
+        self.workers = workers
+        self.timeout = float(timeout)
+        self.interval = float(interval if interval is not None
+                              else max(timeout / 4, 0.05))
+        self._on_lost = on_lost
+        self._beats: Dict[int, float] = {}
+        self._lost: Dict[int, bool] = {i: False for i in range(workers)}
+        self._lock = threading.Lock()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = time.monotonic()  # reset by start()
+
+    def update(self, worker_id: int) -> None:
+        if not 0 <= worker_id < self.workers:
+            raise InvalidArgumentError(
+                f"worker_id {worker_id} out of range [0, {self.workers})")
+        with self._lock:
+            self._beats[worker_id] = time.monotonic()
+            self._lost[worker_id] = False  # re-arm after recovery
+
+    def lost_workers(self):
+        with self._lock:
+            return sorted(i for i, lost in self._lost.items() if lost)
+
+    def _sweep(self) -> None:
+        now = time.monotonic()
+        fire = []
+        with self._lock:
+            for i in range(self.workers):
+                last = self._beats.get(i, self._t0)
+                age = now - last
+                if age > self.timeout and not self._lost[i]:
+                    self._lost[i] = True
+                    fire.append((i, age))
+        for i, age in fire:
+            from ..framework import monitor as _monitor
+            from ..framework.logging import vlog
+
+            _monitor.stat_add("lost_workers")
+            vlog(0, "heartbeat: worker %d lost (no beat for %.1fs)", i, age)
+            if self._on_lost is not None:
+                self._on_lost(i, age)
+
+    def _run(self) -> None:
+        while self._running:
+            self._sweep()
+            time.sleep(self.interval)
+
+    def start(self) -> "HeartBeatMonitor":
+        self._t0 = time.monotonic()
+        self._running = True
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="heartbeat-monitor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval * 4 + 1)
+            self._thread = None
+
+
+class FileHeartbeat:
+    """Trainer-side beat writer: touches ``path``'s mtime.  The watchdog
+    reads the mtime — no content parsing, atomic on every filesystem."""
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self.beat()
+
+    def beat(self) -> None:
+        try:
+            with open(self.path, "a"):
+                os.utime(self.path, None)
+        except OSError:
+            # liveness is a side channel: a pruned tempdir or full disk
+            # must never abort the training step it monitors
+            d = os.path.dirname(self.path)
+            try:
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                with open(self.path, "a"):
+                    os.utime(self.path, None)
+            except OSError:
+                pass
+
+    def age(self) -> float:
+        try:
+            return time.time() - os.stat(self.path).st_mtime
+        except OSError:
+            return float("inf")
+
+
+_last_beat = 0.0
+_writer: Optional[FileHeartbeat] = None
+
+
+def maybe_beat(min_interval: float = 1.0) -> None:
+    """Touch the heartbeat file named by ``PADDLE_TPU_HEARTBEAT_FILE`` at
+    most once per ``min_interval`` seconds; no-op when unset.  Called from
+    the training loop (Model.train_batch)."""
+    global _last_beat, _writer
+    path = os.environ.get(ENV_FILE)
+    if not path:
+        return
+    now = time.monotonic()
+    if now - _last_beat < min_interval:
+        return
+    if _writer is None or _writer.path != path:
+        _writer = FileHeartbeat(path)
+    else:
+        _writer.beat()
+    _last_beat = now

@@ -94,9 +94,11 @@ def launch(argv=None):
 
     argv = list(sys.argv[1:] if argv is None else argv)
     usage = ("usage: python -m paddle_tpu.distributed.launch "
-             "[--max-restarts=N] script.py [args...]")
+             "[--max-restarts=N] [--hang-timeout=SECONDS] "
+             "script.py [args...]")
     max_restarts = 0
     watched = False
+    hang_timeout = None
     while argv and argv[0].startswith("--"):
         flag = argv.pop(0)
         if flag == "--max-restarts" or flag.startswith("--max-restarts="):
@@ -107,6 +109,18 @@ def launch(argv=None):
                 max_restarts = int(value)
             except (IndexError, ValueError):
                 print(f"--max-restarts needs an integer value\n{usage}")
+                return 2
+        elif flag == "--hang-timeout" or flag.startswith("--hang-timeout="):
+            watched = True
+            try:
+                value = (flag.split("=", 1)[1] if "=" in flag
+                         else argv.pop(0))
+                hang_timeout = float(value)
+                if hang_timeout <= 0:
+                    raise ValueError
+            except (IndexError, ValueError):
+                print(f"--hang-timeout needs a positive number of "
+                      f"seconds\n{usage}")
                 return 2
         else:
             print(f"unknown launch flag {flag}\n{usage}")
@@ -119,27 +133,50 @@ def launch(argv=None):
         # child re-enters launch in-process mode so init_parallel_env runs
         # inside each (re)started trainer, exactly like the unwatched path
         return watch([sys.executable, "-m", "paddle_tpu.distributed.launch",
-                      script] + rest, max_restarts=max_restarts)
+                      script] + rest, max_restarts=max_restarts,
+                     hang_timeout=hang_timeout)
     sys.argv = [script] + rest
     _env.init_parallel_env()
     runpy.run_path(script, run_name="__main__")
     return 0
 
 
-def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0) -> int:
+def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
+          hang_timeout: Optional[float] = None,
+          startup_grace: Optional[float] = None) -> int:
     """Run ``cmd`` as a watched subprocess; restart on non-zero exit up to
     ``max_restarts`` times (reference: launch_utils.py watch_local_trainers /
     terminate_local_procs).  Returns the final exit code.  SIGTERM/SIGINT
-    to the watchdog tears the child down (pod preemption path)."""
+    to the watchdog tears the child down (pod preemption path).
+
+    ``hang_timeout`` arms liveness monitoring (reference:
+    heart_beat_monitor.h:51): the child gets a heartbeat file via
+    ``PADDLE_TPU_HEARTBEAT_FILE`` (the training loop touches it each
+    step); when its mtime goes stale past the timeout the child is KILLED
+    and the restart budget applies — catching hung ranks (wedged
+    collective, deadlocked input pipeline) that exit-code watching never
+    sees.  ``hang_timeout`` must exceed the longest legitimately silent
+    phase of the trainer (beats come from train/eval/predict batches, not
+    from inside user callbacks).  It arms only after the trainer's FIRST
+    beat (the
+    reference monitor skips UNINITED workers); until then a separate
+    ``startup_grace`` applies (default ``max(60, 4x hang_timeout)``) so
+    slow interpreter/plugin startup isn't mistaken for a hang."""
+    import os as _os
     import signal
     import subprocess
+    import tempfile
     import time
 
     from ..framework import monitor as _monitor
     from ..framework.logging import vlog
+    from .heartbeat import ENV_FILE, FileHeartbeat
 
+    if hang_timeout is not None and hang_timeout <= 0:
+        raise InvalidArgumentError("hang_timeout must be > 0 seconds")
     attempts = 0
     child = None
+    hb_dir = None
 
     def _teardown(signum, frame):
         if child is not None and child.poll() is None:
@@ -155,8 +192,46 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0) -> int:
     try:
         while True:
             vlog(1, "watchdog: starting %s (attempt %d)", cmd, attempts + 1)
-            child = subprocess.Popen(cmd)
-            rc = child.wait()
+            hb = None
+            env = None
+            if hang_timeout is not None:
+                if hb_dir is None:
+                    hb_dir = tempfile.mkdtemp(prefix="pt_hb_")
+                hb_path = _os.path.join(hb_dir, "beat")
+                try:  # fresh stamp per attempt, one dir per launch
+                    _os.unlink(hb_path)
+                except OSError:
+                    pass
+                hb = FileHeartbeat(hb_path)  # creates + stamps t0
+                env = dict(_os.environ, **{ENV_FILE: hb_path})
+            child = subprocess.Popen(cmd, env=env)
+            if hb is None:
+                rc = child.wait()
+            else:
+                grace = (startup_grace if startup_grace is not None
+                         else max(60.0, 4 * hang_timeout))
+                stamp0 = _os.stat(hb.path).st_mtime
+                poll = min(max(hang_timeout / 4, 0.05), 1.0)
+                while True:
+                    rc = child.poll()
+                    if rc is not None:
+                        break
+                    try:
+                        beaten = _os.stat(hb.path).st_mtime > stamp0
+                    except OSError:
+                        beaten = False
+                    limit = hang_timeout if beaten else grace
+                    if hb.age() > limit:
+                        vlog(0, "watchdog: trainer hung (no heartbeat for "
+                                "%.1fs) — killing", hb.age())
+                        _monitor.stat_add("hung_trainers")
+                        child.kill()
+                        rc = child.wait()
+                        # rc == 0 here means the child finished cleanly in
+                        # the race window before the kill landed — that is
+                        # a success, not a hang
+                        break
+                    time.sleep(poll)
             if rc == 0:
                 return 0
             vlog(1, "watchdog: trainer exited rc=%d", rc)

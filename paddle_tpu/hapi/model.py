@@ -300,6 +300,10 @@ class Model:
         only materialized at logging points)."""
         if self._train_step is None:
             raise InvalidArgumentError("call prepare(optimizer=..., loss=...) first")
+        from ..distributed.heartbeat import maybe_beat
+
+        maybe_beat()  # liveness signal for the launch watchdog (no-op
+        #               unless PADDLE_TPU_HEARTBEAT_FILE is set)
         batch = tuple(_tuplize(inputs)) + tuple(_tuplize(labels) if labels is not None else ())
         if self._plan is not None:
             batch = self._plan.shard_batch(batch)
@@ -357,6 +361,9 @@ class Model:
         async win applies to loss-only evaluation."""
         if self._eval_step is None:
             raise InvalidArgumentError("call prepare(loss=...) first")
+        from ..distributed.heartbeat import maybe_beat
+
+        maybe_beat()  # eval between epochs must not read as a hang
         batch = tuple(_tuplize(inputs)) + tuple(_tuplize(labels) if labels is not None else ())
         if self._plan is not None:
             batch = self._plan.shard_batch(batch)
@@ -369,6 +376,9 @@ class Model:
         return loss_val, metrics
 
     def predict_batch(self, inputs):
+        from ..distributed.heartbeat import maybe_beat
+
+        maybe_beat()
         if self._plan is not None:
             inputs = self._plan.shard_batch(tuple(_tuplize(inputs)))
         else:

@@ -1,0 +1,139 @@
+"""Worker liveness: HeartBeatMonitor + watch() hang detection.
+
+Reference capability: heart_beat_monitor.h:51 (chief-side trainer beat
+tracking) — here transport-agnostic monitor + mtime-file transport wired
+into the launch watchdog.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from paddle_tpu.distributed.heartbeat import (
+    ENV_FILE,
+    FileHeartbeat,
+    HeartBeatMonitor,
+)
+from paddle_tpu.distributed.parallel import watch
+
+
+class TestHeartBeatMonitor:
+    def test_stalled_worker_detected_within_deadline(self):
+        lost = []
+        mon = HeartBeatMonitor(workers=3, timeout=0.3, interval=0.05,
+                               on_lost=lambda i, age: lost.append(i))
+        mon.start()
+        try:
+            t0 = time.monotonic()
+            # workers 0 and 2 beat; worker 1 stalls
+            while time.monotonic() - t0 < 0.8:
+                mon.update(0)
+                mon.update(2)
+                time.sleep(0.05)
+            assert mon.lost_workers() == [1]
+            assert lost == [1]
+        finally:
+            mon.stop()
+
+    def test_lost_fires_once_and_rearms(self):
+        lost = []
+        mon = HeartBeatMonitor(workers=1, timeout=0.2, interval=0.05,
+                               on_lost=lambda i, age: lost.append(i))
+        mon.start()
+        try:
+            time.sleep(0.5)            # outage 1
+            assert lost == [0]
+            mon.update(0)              # recovery re-arms
+            assert mon.lost_workers() == []
+            time.sleep(0.5)            # outage 2
+            assert lost == [0, 0]
+        finally:
+            mon.stop()
+
+    def test_validation(self):
+        with pytest.raises(Exception):
+            HeartBeatMonitor(workers=0)
+        with pytest.raises(Exception):
+            HeartBeatMonitor(workers=2, timeout=-1)
+        mon = HeartBeatMonitor(workers=2)
+        with pytest.raises(Exception):
+            mon.update(5)
+
+
+class TestFileHeartbeat:
+    def test_beat_updates_age(self, tmp_path):
+        hb = FileHeartbeat(str(tmp_path / "beat"))
+        assert hb.age() < 5
+        time.sleep(0.05)
+        a1 = hb.age()
+        hb.beat()
+        assert hb.age() <= a1
+
+    def test_missing_file_is_infinitely_old(self, tmp_path):
+        hb = FileHeartbeat(str(tmp_path / "b"))
+        os.unlink(hb.path)
+        assert hb.age() == float("inf")
+
+
+class TestWatchHangDetection:
+    def _script(self, tmp_path, body):
+        p = tmp_path / "trainer.py"
+        p.write_text(textwrap.dedent(body))
+        return str(p)
+
+    def test_hung_trainer_killed_and_restarted(self, tmp_path):
+        # the trainer beats twice then hangs forever; the watchdog must
+        # kill it within the deadline and burn one restart, after which
+        # the marker file lets the second attempt exit cleanly
+        marker = tmp_path / "second_run"
+        script = self._script(tmp_path, f"""
+            import os, sys, time
+            marker = {str(marker)!r}
+            hb = os.environ["{ENV_FILE}"]
+            if os.path.exists(marker):
+                sys.exit(0)           # restarted run: succeed
+            open(marker, "w").close()
+            for _ in range(2):
+                with open(hb, "a"): os.utime(hb, None)
+                time.sleep(0.05)
+            time.sleep(3600)          # hang (no more beats)
+        """)
+        t0 = time.monotonic()
+        rc = watch([sys.executable, script], max_restarts=1, _sleep=0.05,
+                   hang_timeout=0.5, startup_grace=20.0)
+        dt = time.monotonic() - t0
+        assert rc == 0
+        assert dt < 30, f"hang not detected within deadline ({dt:.1f}s)"
+
+    def test_healthy_trainer_not_killed(self, tmp_path):
+        script = self._script(tmp_path, f"""
+            import os, time
+            hb = os.environ["{ENV_FILE}"]
+            for _ in range(10):
+                with open(hb, "a"): os.utime(hb, None)
+                time.sleep(0.05)
+        """)
+        rc = watch([sys.executable, script], max_restarts=0,
+                   hang_timeout=1.0)
+        assert rc == 0
+
+    def test_no_timeout_keeps_old_behavior(self, tmp_path):
+        script = self._script(tmp_path, "import sys; sys.exit(0)")
+        assert watch([sys.executable, script], max_restarts=0) == 0
+
+    def test_nonpositive_timeout_rejected(self, tmp_path):
+        script = self._script(tmp_path, "import sys; sys.exit(0)")
+        with pytest.raises(Exception, match="hang_timeout"):
+            watch([sys.executable, script], hang_timeout=0)
+
+    def test_beat_survives_pruned_tempdir(self, tmp_path):
+        import shutil
+
+        d = tmp_path / "sub"
+        hb = FileHeartbeat(str(d / "beat"))
+        shutil.rmtree(d)
+        hb.beat()  # must not raise; recreates the directory
+        assert hb.age() < 5
