@@ -35,6 +35,9 @@ from ..framework.errors import InvalidArgumentError
 __all__ = ["HeartBeatMonitor", "FileHeartbeat", "maybe_beat"]
 
 ENV_FILE = "PADDLE_TPU_HEARTBEAT_FILE"
+#: the training loop throttles beats to one per this many seconds —
+#: hang timeouts must comfortably exceed it (watch() enforces 2x)
+BEAT_MIN_INTERVAL = 1.0
 
 
 class HeartBeatMonitor:
@@ -90,13 +93,17 @@ class HeartBeatMonitor:
                     self._lost[i] = True
                     fire.append((i, age))
         for i, age in fire:
-            from ..framework import monitor as _monitor
-            from ..framework.logging import vlog
+            try:
+                from ..framework import monitor as _monitor
+                from ..framework.logging import vlog
 
-            _monitor.stat_add("lost_workers")
-            vlog(0, "heartbeat: worker %d lost (no beat for %.1fs)", i, age)
-            if self._on_lost is not None:
-                self._on_lost(i, age)
+                _monitor.stat_add("lost_workers")
+                vlog(0, "heartbeat: worker %d lost (no beat for %.1fs)",
+                     i, age)
+                if self._on_lost is not None:
+                    self._on_lost(i, age)
+            except Exception:  # noqa: BLE001 — a flaky callback must not
+                pass           # kill the monitor thread it reports through
 
     def _run(self) -> None:
         while self._running:
@@ -156,7 +163,7 @@ _last_beat = 0.0
 _writer: Optional[FileHeartbeat] = None
 
 
-def maybe_beat(min_interval: float = 1.0) -> None:
+def maybe_beat(min_interval: float = BEAT_MIN_INTERVAL) -> None:
     """Touch the heartbeat file named by ``PADDLE_TPU_HEARTBEAT_FILE`` at
     most once per ``min_interval`` seconds; no-op when unset.  Called from
     the training loop (Model.train_batch)."""

@@ -170,10 +170,14 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
 
     from ..framework import monitor as _monitor
     from ..framework.logging import vlog
-    from .heartbeat import ENV_FILE, FileHeartbeat
+    from .heartbeat import BEAT_MIN_INTERVAL, ENV_FILE, FileHeartbeat
 
-    if hang_timeout is not None and hang_timeout <= 0:
-        raise InvalidArgumentError("hang_timeout must be > 0 seconds")
+    if hang_timeout is not None and hang_timeout < 2 * BEAT_MIN_INTERVAL:
+        raise InvalidArgumentError(
+            f"hang_timeout must be >= {2 * BEAT_MIN_INTERVAL:g}s — the "
+            "training loop throttles beats to one per "
+            f"{BEAT_MIN_INTERVAL:g}s, so shorter timeouts kill healthy "
+            "trainers")
     attempts = 0
     child = None
     hb_dir = None
@@ -242,5 +246,9 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
             _monitor.stat_add("trainer_restarts")  # an actual restart
             time.sleep(_sleep)
     finally:
+        if hb_dir is not None:
+            import shutil
+
+            shutil.rmtree(hb_dir, ignore_errors=True)
         signal.signal(signal.SIGTERM, old_term)
         signal.signal(signal.SIGINT, old_int)

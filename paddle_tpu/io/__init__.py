@@ -22,7 +22,7 @@ from .sampler import (  # noqa: F401
     BatchSampler,
     DistributedBatchSampler,
 )
-from .in_memory_dataset import InMemoryDataset  # noqa: F401
+from .in_memory_dataset import InMemoryDataset, MultiSlotInMemoryDataset  # noqa: F401
 from .dataloader import (  # noqa: F401
     DataLoader,
     WorkerInfo,

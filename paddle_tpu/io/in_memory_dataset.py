@@ -31,35 +31,14 @@ import numpy as np
 
 from ..framework.errors import InvalidArgumentError, NotFoundError
 
-__all__ = ["InMemoryDataset"]
+__all__ = ["InMemoryDataset", "MultiSlotInMemoryDataset"]
 
 
-class InMemoryDataset:
-    """``slots``: ordered (name, width, dtype) column groups; every input
-    line must hold exactly ``sum(width)`` numeric fields."""
+class _IngestStoreBase:
+    """Shared surface over one native ingest store handle: filelist,
+    threaded load (FLAGS_paddle_num_threads default), shuffle, size,
+    release.  Subclasses own handle creation and batch assembly."""
 
-    def __init__(self, slots: Sequence[Tuple[str, int, str]]):
-        from ..native import ingest_lib
-
-        if not slots:
-            raise InvalidArgumentError("need at least one slot")
-        self._slots = [(str(n), int(w), np.dtype(d)) for n, w, d in slots]
-        for n, w, _ in self._slots:
-            if w <= 0:
-                raise InvalidArgumentError(f"slot {n!r} width must be > 0")
-        self._ncols = sum(w for _, w, _ in self._slots)
-        self._lib = ingest_lib()
-        self._h = self._lib.ingest_create(self._ncols)
-        if not self._h:
-            raise MemoryError("ingest_create failed")
-        self._filelist: List[str] = []
-
-    def __del__(self):
-        h, self._h = getattr(self, "_h", None), None
-        if h and getattr(self, "_lib", None):
-            self._lib.ingest_destroy(h)
-
-    # -- reference surface ---------------------------------------------------
     def set_filelist(self, files: Sequence[str]):
         self._filelist = [str(f) for f in files]
 
@@ -102,7 +81,37 @@ class InMemoryDataset:
     def __len__(self) -> int:
         return self.get_memory_data_size()
 
-    # -- batch iteration -----------------------------------------------------
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h and getattr(self, "_lib", None):
+            self._lib.ingest_destroy(h)
+
+    def __iter__(self):
+        raise InvalidArgumentError(
+            "iterate with batch_iter(batch_size=...) — sample-wise Python "
+            "iteration would defeat the native batch path")
+
+
+class InMemoryDataset(_IngestStoreBase):
+    """``slots``: ordered (name, width, dtype) column groups; every input
+    line must hold exactly ``sum(width)`` numeric fields."""
+
+    def __init__(self, slots: Sequence[Tuple[str, int, str]]):
+        from ..native import ingest_lib
+
+        if not slots:
+            raise InvalidArgumentError("need at least one slot")
+        self._slots = [(str(n), int(w), np.dtype(d)) for n, w, d in slots]
+        for n, w, _ in self._slots:
+            if w <= 0:
+                raise InvalidArgumentError(f"slot {n!r} width must be > 0")
+        self._ncols = sum(w for _, w, _ in self._slots)
+        self._lib = ingest_lib()
+        self._h = self._lib.ingest_create(self._ncols)
+        if not self._h:
+            raise MemoryError("ingest_create failed")
+        self._filelist: List[str] = []
+
     def batch_iter(self, batch_size: int, drop_last: bool = False
                    ) -> Iterator[Tuple[np.ndarray, ...]]:
         """Assemble minibatches natively; yields one ndarray per slot
@@ -132,7 +141,87 @@ class InMemoryDataset:
                 col += w
             yield tuple(out)
 
-    def __iter__(self):
-        raise InvalidArgumentError(
-            "iterate with batch_iter(batch_size=...) — sample-wise Python "
-            "iteration would defeat the native batch path")
+
+class MultiSlotInMemoryDataset(_IngestStoreBase):
+    """Typed multi-slot ingest over the reference's MultiSlot text format
+    (data_feed.h:302 MultiSlotDataFeed): each line holds, per declared
+    slot, ``<count> v1 ... vcount`` — exactly what
+    :mod:`paddle_tpu.distributed.fleet.data_generator` emits.
+
+    ``slots``: ordered ``(name, dtype, max_len)`` declarations with dtype
+    ``"float32"`` or ``"int64"``.  Variable-length slots come back as
+    ``(values [b, max_len] padded with zeros, lens [b] int64)``; slots
+    with ``max_len == 1`` yield just ``values [b, 1]`` (the common dense
+    feature / label case).
+
+    The parse/shuffle/batch path is the same C++ engine as
+    :class:`InMemoryDataset` — values are stored in their declared dtype
+    (int64 ids are exact at full width, unlike the dense f64 store).
+    """
+
+    _TYPE_TAGS = {"float32": 0, "int64": 1}
+
+    def __init__(self, slots):
+        from ..native import ingest_lib
+
+        if not slots:
+            raise InvalidArgumentError("need at least one slot")
+        self._slots = []
+        for n, dt, ml in slots:
+            if dt not in self._TYPE_TAGS:
+                raise InvalidArgumentError(
+                    f"slot {n!r} dtype must be float32/int64, got {dt!r}")
+            if int(ml) <= 0:
+                raise InvalidArgumentError(f"slot {n!r} max_len must be > 0")
+            self._slots.append((str(n), str(dt), int(ml)))
+        self._lib = ingest_lib()
+        n = len(self._slots)
+        types = (ctypes.c_int64 * n)(*[self._TYPE_TAGS[d]
+                                       for _, d, _ in self._slots])
+        lens = (ctypes.c_int64 * n)(*[ml for _, _, ml in self._slots])
+        self._h = self._lib.ingest_create_multislot(n, types, lens)
+        if not self._h:
+            raise MemoryError("ingest_create_multislot failed")
+        self._filelist: List[str] = []
+
+    def batch_iter(self, batch_size: int, drop_last: bool = False,
+                   return_lens: bool = False):
+        """Yields a tuple with one ``values`` array per slot, or
+        ``(values, lens)`` pairs when ``return_lens`` is set."""
+        if batch_size <= 0:
+            raise InvalidArgumentError("batch_size must be > 0")
+        return self._batch_gen(int(batch_size), bool(drop_last),
+                               bool(return_lens))
+
+    def _batch_gen(self, batch_size, drop_last, return_lens):
+        np_dt = {"float32": np.float32, "int64": np.int64}
+        bufs = [np.empty((batch_size, ml), np_dt[dt])
+                for _, dt, ml in self._slots]
+        lbufs = [np.empty((batch_size,), np.int64) for _ in self._slots]
+        pos = 0
+        while True:
+            got = None
+            for si in range(len(self._slots)):
+                g = self._lib.ingest_copy_slot(
+                    self._h, si, pos, batch_size,
+                    bufs[si].ctypes.data_as(ctypes.c_void_p),
+                    lbufs[si].ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_int64)))
+                if got is None:
+                    got = g
+                elif g != got:  # engine invariant: slots advance together
+                    raise InvalidArgumentError(
+                        "slot row counts diverged (corrupt store)")
+            if not got:
+                return
+            pos += got
+            if got < batch_size and drop_last:
+                return
+            out = []
+            for si in range(len(self._slots)):
+                vals = bufs[si][:got].copy()
+                if return_lens:
+                    out.append((vals, lbufs[si][:got].copy()))
+                else:
+                    out.append(vals)
+            yield tuple(out)

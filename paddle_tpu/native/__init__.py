@@ -77,5 +77,13 @@ def ingest_lib() -> ctypes.CDLL:
                                          ctypes.POINTER(ctypes.c_double),
                                          ctypes.c_int64, ctypes.c_int64]
         lib.ingest_clear.argtypes = [ctypes.c_void_p]
+        lib.ingest_create_multislot.restype = ctypes.c_void_p
+        lib.ingest_create_multislot.argtypes = [
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.ingest_copy_slot.restype = ctypes.c_int64
+        lib.ingest_copy_slot.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
         _lib = lib
         return _lib

@@ -103,7 +103,7 @@ class TestWatchHangDetection:
         """)
         t0 = time.monotonic()
         rc = watch([sys.executable, script], max_restarts=1, _sleep=0.05,
-                   hang_timeout=0.5, startup_grace=20.0)
+                   hang_timeout=2.0, startup_grace=30.0)
         dt = time.monotonic() - t0
         assert rc == 0
         assert dt < 30, f"hang not detected within deadline ({dt:.1f}s)"
@@ -117,17 +117,18 @@ class TestWatchHangDetection:
                 time.sleep(0.05)
         """)
         rc = watch([sys.executable, script], max_restarts=0,
-                   hang_timeout=1.0)
+                   hang_timeout=2.0)
         assert rc == 0
 
     def test_no_timeout_keeps_old_behavior(self, tmp_path):
         script = self._script(tmp_path, "import sys; sys.exit(0)")
         assert watch([sys.executable, script], max_restarts=0) == 0
 
-    def test_nonpositive_timeout_rejected(self, tmp_path):
+    def test_too_small_timeout_rejected(self, tmp_path):
         script = self._script(tmp_path, "import sys; sys.exit(0)")
-        with pytest.raises(Exception, match="hang_timeout"):
-            watch([sys.executable, script], hang_timeout=0)
+        for bad in (0, -1, 0.5, 1.9):
+            with pytest.raises(Exception, match="hang_timeout"):
+                watch([sys.executable, script], hang_timeout=bad)
 
     def test_beat_survives_pruned_tempdir(self, tmp_path):
         import shutil

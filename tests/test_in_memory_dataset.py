@@ -244,3 +244,173 @@ class TestReviewRegressions:
         ds = _dataset()
         with pytest.raises(InvalidArgumentError, match="batch_size"):
             ds.batch_iter(0)  # raises at call, not at first next()
+
+
+class TestMultiSlotDataset:
+    """Typed MultiSlot ingest (ref: data_feed.h:302 MultiSlotDataFeed) —
+    the `<count> v...` per-slot line format DataGenerator emits."""
+
+    def _write(self, tmp_path, lines, name="part.txt"):
+        p = tmp_path / name
+        p.write_text("\n".join(lines) + "\n")
+        return str(p)
+
+    def _ds(self):
+        from paddle_tpu.io import MultiSlotInMemoryDataset
+
+        return MultiSlotInMemoryDataset(
+            slots=[("ids", "int64", 4),      # variable-length sparse ids
+                   ("dense", "float32", 3),  # fixed dense features
+                   ("label", "int64", 1)])
+
+    def test_parse_types_padding_lengths(self, tmp_path):
+        ds = self._ds()
+        f = self._write(tmp_path, [
+            "2 11 22 3 0.5 1.5 2.5 1 1",
+            "4 1 2 3 4 3 9.0 8.0 7.0 1 0",
+            "0 3 1.0 2.0 3.0 1 1",          # empty ids slot
+        ])
+        ds.set_filelist([f])
+        assert ds.load_into_memory(thread_num=2) == 3
+        batches = list(ds.batch_iter(batch_size=3, return_lens=True))
+        assert len(batches) == 1
+        (ids, id_lens), (dense, _), (label, _) = batches[0]
+        assert ids.dtype == np.int64 and dense.dtype == np.float32
+        np.testing.assert_array_equal(id_lens, [2, 4, 0])
+        np.testing.assert_array_equal(ids[0], [11, 22, 0, 0])  # zero pad
+        np.testing.assert_array_equal(ids[1], [1, 2, 3, 4])
+        np.testing.assert_allclose(dense[1], [9.0, 8.0, 7.0])
+        np.testing.assert_array_equal(label.ravel(), [1, 0, 1])
+
+    def test_int64_ids_exact_at_full_width(self, tmp_path):
+        # the dense f64 store rounds ids past 2^53; the typed store must not
+        big = 2 ** 62 + 12345
+        ds = self._ds()
+        f = self._write(tmp_path, [f"1 {big} 3 0 0 0 1 7"])
+        ds.set_filelist([f])
+        ds.load_into_memory()
+        ids, _, _ = next(iter(ds.batch_iter(1)))
+        assert int(ids[0, 0]) == big
+
+    def test_shuffle_and_multifile(self, tmp_path):
+        files = []
+        for k in range(4):
+            files.append(self._write(
+                tmp_path,
+                [f"1 {k * 10 + i} 3 0 0 0 1 0" for i in range(10)],
+                name=f"part-{k}.txt"))
+        ds = self._ds()
+        ds.set_filelist(files)
+        assert ds.load_into_memory(thread_num=4) == 40
+        ds.global_shuffle(seed=7)
+        rows = []
+        for ids, dense, label in ds.batch_iter(8):
+            rows.extend(int(v) for v in ids[:, 0])
+        assert sorted(rows) == sorted(
+            k * 10 + i for k in range(4) for i in range(10))
+        assert rows != sorted(rows)  # actually shuffled
+
+    def test_overlong_slot_rejected(self, tmp_path):
+        ds = self._ds()
+        f = self._write(tmp_path, ["9 1 2 3 4 5 6 7 8 9 3 0 0 0 1 0"])
+        ds.set_filelist([f])
+        with pytest.raises(Exception, match="outside"):
+            ds.load_into_memory()
+
+    def test_int64_overflow_rejected(self, tmp_path):
+        # 2^64+1 must be rejected, not silently wrap to 1
+        ds = self._ds()
+        f = self._write(tmp_path,
+                        ["1 18446744073709551617 3 0 0 0 1 0"])
+        ds.set_filelist([f])
+        with pytest.raises(Exception, match="unparsable"):
+            ds.load_into_memory()
+
+    def test_malformed_line_rejected(self, tmp_path):
+        ds = self._ds()
+        f = self._write(tmp_path, ["2 1 x 3 0 0 0 1 0"])
+        ds.set_filelist([f])
+        with pytest.raises(Exception, match="unparsable"):
+            ds.load_into_memory()
+
+    def test_data_generator_roundtrip(self, tmp_path):
+        # the fleet DataGenerator's MultiSlot output parses natively
+        from paddle_tpu.distributed.fleet.data_generator import (
+            MultiSlotDataGenerator)
+
+        class Gen(MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def reader():
+                    k = int(line)
+                    yield [("ids", [k, k + 1]), ("dense", [0.5, 1.5, 2.5]),
+                           ("label", [k % 2])]
+                return reader
+
+        import io as _io
+
+        out_path = tmp_path / "gen.txt"
+        buf = _io.StringIO()
+        Gen().run_from_stdin(source=_io.StringIO("3\n8\n"), out=buf)
+        out_path.write_text(buf.getvalue())
+        from paddle_tpu.io import MultiSlotInMemoryDataset
+
+        ds = MultiSlotInMemoryDataset(
+            slots=[("ids", "int64", 4), ("dense", "float32", 3),
+                   ("label", "int64", 1)])
+        ds.set_filelist([str(out_path)])
+        assert ds.load_into_memory() == 2
+        (ids, lens), (dense, _), _ = next(
+            iter(ds.batch_iter(2, return_lens=True)))
+        np.testing.assert_array_equal(lens, [2, 2])
+        np.testing.assert_array_equal(ids[0, :2], [3, 4])
+        np.testing.assert_allclose(dense[0], [0.5, 1.5, 2.5], rtol=1e-6)
+
+    def test_native_beats_python_loader(self, tmp_path):
+        # the reason this engine is C++ (data_feed.h): parse throughput.
+        # Modest margin here to stay robust on shared CI; see
+        # tools/bench_ingest.py for the real (>=5x) numbers.
+        import time
+
+        rng = np.random.RandomState(0)
+        files = []
+        for k in range(4):
+            lines = []
+            for _ in range(4000):
+                ids = rng.randint(0, 10 ** 9, size=3)
+                dense = rng.rand(3)
+                lines.append(
+                    f"3 {ids[0]} {ids[1]} {ids[2]} "
+                    f"3 {dense[0]:.6f} {dense[1]:.6f} {dense[2]:.6f} "
+                    f"1 {k % 2}")
+            files.append(self._write(tmp_path, lines, name=f"b{k}.txt"))
+
+        from paddle_tpu.io import MultiSlotInMemoryDataset
+
+        ds = MultiSlotInMemoryDataset(
+            slots=[("ids", "int64", 3), ("dense", "float32", 3),
+                   ("label", "int64", 1)])
+        ds.set_filelist(files)
+        t0 = time.perf_counter()
+        n = ds.load_into_memory(thread_num=4)
+        t_native = time.perf_counter() - t0
+        assert n == 16000
+
+        def python_loader(paths):
+            out = []
+            for p in paths:
+                with open(p) as f:
+                    for line in f:
+                        toks = line.split()
+                        row, i = [], 0
+                        while i < len(toks):
+                            c = int(toks[i])
+                            row.append(toks[i + 1:i + 1 + c])
+                            i += 1 + c
+                        out.append(row)
+            return out
+
+        t0 = time.perf_counter()
+        ref = python_loader(files)
+        t_python = time.perf_counter() - t0
+        assert len(ref) == 16000
+        assert t_native < t_python, (t_native, t_python)
