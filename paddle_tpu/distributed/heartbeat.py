@@ -100,10 +100,17 @@ class HeartBeatMonitor:
                 _monitor.stat_add("lost_workers")
                 vlog(0, "heartbeat: worker %d lost (no beat for %.1fs)",
                      i, age)
-                if self._on_lost is not None:
+            except Exception:  # noqa: BLE001 — reporting must not kill
+                pass           # the monitor thread
+            if self._on_lost is not None:
+                try:
                     self._on_lost(i, age)
-            except Exception:  # noqa: BLE001 — a flaky callback must not
-                pass           # kill the monitor thread it reports through
+                except Exception:  # noqa: BLE001
+                    # the lost state stays latched (lost_workers() reports
+                    # it); record the callback failure instead of dying
+                    import traceback
+
+                    traceback.print_exc()
 
     def _run(self) -> None:
         while self._running:

@@ -574,8 +574,6 @@ _STATIC_ONLY = {
     "center_loss": "a Layer holding the centers buffer + mse update",
     "deformable_conv": "paddle.nn.functional.deform_conv2d (explicit weight/offset/mask tensors; the 1.x builder created the params itself)",
     "lrn": "paddle.nn.LocalResponseNorm",
-    "prroi_pool": "roi pooling family (not implemented)",
-    "deformable_roi_pooling": "roi pooling family (not implemented)",
     # program control flow → lax / python
     "While": "jax.lax.while_loop (compiled) or Python while (eager)",
     "Switch": "jax.lax.switch", "IfElse": "jax.lax.cond",
@@ -644,8 +642,6 @@ _STATIC_ONLY = {
     "BasicDecoder": "subclass paddle.nn.Decoder",
     # detection long tail
     "multi_box_head": "compose conv heads + prior_box",
-    "roi_perspective_transform": "not implemented",
-    "polygon_box_transform": "not implemented",
     "retinanet_detection_output": "detection_output",
     # misc losses
     "bpr_loss": "pairwise softmax loss over positive/negative logits",

@@ -159,10 +159,26 @@ void ParseFilesMultiSlot(const std::vector<std::string>* files, size_t begin,
     std::fseek(f, 0, SEEK_END);
     long fsz = std::ftell(f);
     std::fseek(f, 0, SEEK_SET);
-    if (fsz < 0) fsz = 0;
+    if (fsz < 0) {
+      std::fclose(f);
+      std::lock_guard<std::mutex> g(*err_mu);
+      if (err->empty())
+        *err = "cannot size " + (*files)[fi] + ": " + std::strerror(errno);
+      failed->store(true);
+      return;
+    }
     buf.resize(static_cast<size_t>(fsz) + 1);
     size_t got = std::fread(buf.data(), 1, static_cast<size_t>(fsz), f);
+    bool short_read = got != static_cast<size_t>(fsz) && std::ferror(f);
     std::fclose(f);
+    if (short_read) {
+      // a silent truncation here would be silent training-data loss
+      std::lock_guard<std::mutex> g(*err_mu);
+      if (err->empty())
+        *err = "short read on " + (*files)[fi] + ": " + std::strerror(errno);
+      failed->store(true);
+      return;
+    }
     buf[got] = '\0';
 
     int64_t lineno = 0;

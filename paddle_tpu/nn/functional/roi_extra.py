@@ -1,0 +1,332 @@
+"""RoI long-tail ops: precise/deformable pooling, perspective transform,
+EAST geometry decode.
+
+Capability parity (reference):
+  prroi_pool               fluid/layers/nn.py:13800 over prroi_pool_op.h
+  deformable_roi_pooling   fluid/layers/nn.py:14586 over
+                           deformable_psroi_pooling_op.h
+  roi_perspective_transform  fluid/layers/detection.py:2498 over
+                           detection/roi_perspective_transform_op.cc
+  polygon_box_transform    detection/polygon_box_transform_op.cc
+
+Dense TPU design: every op is a vmapped closed-form computation — PrRoI's
+exact bilinear integral becomes two separable weight matrices and one
+einsum per RoI (MXU work, no sample loops); the sampling ops reuse the
+package's clamped bilinear gather.  ``rois_num`` per-image counts follow
+the module-wide dense-LoD convention of :mod:`.detection`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.errors import InvalidArgumentError
+from .detection import _roi_batch_ids
+
+__all__ = ["prroi_pool", "deformable_roi_pooling",
+           "roi_perspective_transform", "polygon_box_transform"]
+
+
+def _hat_integral(p, a, b):
+    """∫_a^b max(0, 1-|x-p|) dx for pixel centers p (vector) over a scalar
+    window — the exact bilinear (hat basis) integral PrRoI pooling is
+    built on (prroi_pool_op.h PrRoIPoolingMatCalculation, in closed form
+    instead of per-corner case analysis)."""
+    def anti(u):  # ∫_{-1}^{u} (1-|v|)dv with u clamped to [-1, 1]
+        u = jnp.clip(u, -1.0, 1.0)
+        neg = 0.5 * (u + 1.0) ** 2
+        pos = 0.5 + u - 0.5 * u * u
+        return jnp.where(u <= 0, neg, pos)
+
+    return anti(b - p) - anti(a - p)
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    """Precise RoI pooling (ref: nn.py:13800 over prroi_pool_op.h): each
+    output bin is the EXACT integral of the bilinearly-interpolated
+    feature over the bin window divided by the window area — no sampling
+    grid, fully differentiable in the roi coordinates too.
+
+    input ``[N, C, H, W]``, rois ``[R, 4]``, ``batch_roi_nums [N]``
+    (dense LoD stand-in; omitted → all rois on image 0) →
+    ``[R, C, PH, PW]``.
+    """
+    x = jnp.asarray(input)
+    rois = jnp.asarray(rois, jnp.float32)
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    PH, PW = int(pooled_height), int(pooled_width)
+    batch_ids = _roi_batch_ids(batch_roi_nums, R, N)
+
+    py = jnp.arange(H, dtype=jnp.float32)
+    px = jnp.arange(W, dtype=jnp.float32)
+
+    def one(roi, bid):
+        x0, y0, x1, y1 = roi * spatial_scale
+        rw = jnp.maximum(x1 - x0, 0.0)
+        rh = jnp.maximum(y1 - y0, 0.0)
+        bw = rw / PW
+        bh = rh / PH
+        win = bw * bh
+        # separable integral weights: Wy [PH, H], Wx [PW, W]
+        ys = y0 + jnp.arange(PH, dtype=jnp.float32) * bh
+        xs = x0 + jnp.arange(PW, dtype=jnp.float32) * bw
+        Wy = jax.vmap(lambda a: _hat_integral(py, a, a + bh))(ys)
+        Wx = jax.vmap(lambda a: _hat_integral(px, a, a + bw))(xs)
+        feat = x[bid].astype(jnp.float32)        # [C, H, W]
+        out = jnp.einsum("ph,qw,chw->cpq", Wy, Wx, feat)
+        return jnp.where(win > 0, out / jnp.maximum(win, 1e-12), 0.0)
+
+    out = jax.vmap(one)(rois, batch_ids)
+    return out.astype(x.dtype)
+
+
+def _bilinear_clamped(feat, h, w):
+    """Pointwise bilinear with the deformable-psroi border convention
+    (deformable_psroi_pooling_op.h bilinear_interp): coordinates already
+    clamped into [0, H-1]x[0, W-1] by the caller."""
+    H, W = feat.shape
+    h0 = jnp.clip(jnp.floor(h).astype(jnp.int32), 0, H - 1)
+    w0 = jnp.clip(jnp.floor(w).astype(jnp.int32), 0, W - 1)
+    h1 = jnp.clip(h0 + 1, 0, H - 1)
+    w1 = jnp.clip(w0 + 1, 0, W - 1)
+    lh = h - h0
+    lw = w - w0
+    v00 = feat[h0, w0]
+    v01 = feat[h0, w1]
+    v10 = feat[h1, w0]
+    v11 = feat[h1, w1]
+    top = v00 + (v01 - v00) * lw
+    bot = v10 + (v11 - v10) * lw
+    return top + (bot - top) * lh
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1,
+                           position_sensitive=False, rois_num=None,
+                           name=None):
+    """Deformable (PS-)RoI pooling (ref: nn.py:14586 over
+    deformable_psroi_pooling_op.h): average of bilinear samples on a grid
+    displaced by learned per-part offsets ``trans``.
+
+    input ``[N, C, H, W]``; rois ``[R, 4]``; trans
+    ``[R, 2*num_classes? , part_h, part_w]`` (ignored when ``no_trans``);
+    ``position_sensitive`` divides channels by PH*PW (R-FCN style) →
+    output ``[R, C', PH, PW]``.
+    """
+    x = jnp.asarray(input)
+    rois = jnp.asarray(rois, jnp.float32)
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    PH, PW = int(pooled_height), int(pooled_width)
+    gh, gw = int(group_size[0]), int(group_size[1])
+    if part_size is None:
+        part_h, part_w = PH, PW
+    else:
+        part_h, part_w = int(part_size[0]), int(part_size[1])
+    sp = int(sample_per_part)
+    if position_sensitive:
+        if C % (PH * PW):
+            raise InvalidArgumentError(
+                f"position_sensitive: channels {C} not divisible by "
+                f"{PH}*{PW}")
+        out_dim = C // (PH * PW)
+    else:
+        if gh != 1 or gw != 1:
+            raise InvalidArgumentError(
+                "group_size != [1, 1] requires position_sensitive=True "
+                "(the channel group indexing is PS-RoI's)")
+        out_dim = C
+    batch_ids = _roi_batch_ids(rois_num, R, N)
+    if not no_trans:
+        trans = jnp.asarray(trans, jnp.float32)
+        num_classes = trans.shape[1] // 2
+        channels_each_class = max(out_dim // num_classes, 1)
+    else:
+        num_classes, channels_each_class = 1, out_dim
+
+    ph_ix = jnp.arange(PH)
+    pw_ix = jnp.arange(PW)
+    ct_ix = jnp.arange(out_dim)
+
+    def one(roi, bid, tr):
+        # the kernel rounds roi corners to ints then recenters by 0.5
+        x0 = jnp.round(roi[0]) * spatial_scale - 0.5
+        y0 = jnp.round(roi[1]) * spatial_scale - 0.5
+        x1 = (jnp.round(roi[2]) + 1.0) * spatial_scale - 0.5
+        y1 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x1 - x0, 0.1)
+        rh = jnp.maximum(y1 - y0, 0.1)
+        bw = rw / PW
+        bh = rh / PH
+        sub_w = bw / sp
+        sub_h = bh / sp
+        feat = x[bid].astype(jnp.float32)
+
+        def bin_val(ctop, ph, pw):
+            part_hi = jnp.floor(ph / PH * part_h).astype(jnp.int32)
+            part_wi = jnp.floor(pw / PW * part_w).astype(jnp.int32)
+            class_id = ctop // channels_each_class
+            if no_trans:
+                tx = jnp.float32(0.0)
+                ty = jnp.float32(0.0)
+            else:
+                tx = tr[2 * class_id, part_hi, part_wi] * trans_std
+                ty = tr[2 * class_id + 1, part_hi, part_wi] * trans_std
+            wstart = pw * bw + x0 + tx * rw
+            hstart = ph * bh + y0 + ty * rh
+            if position_sensitive:
+                g_w = jnp.clip(jnp.floor(pw * gw / PW).astype(jnp.int32),
+                               0, gw - 1)
+                g_h = jnp.clip(jnp.floor(ph * gh / PH).astype(jnp.int32),
+                               0, gh - 1)
+                c = (ctop * gh + g_h) * gw + g_w
+            else:
+                c = ctop
+            iw = jnp.arange(sp, dtype=jnp.float32)
+            ww = wstart + iw * sub_w                    # [sp]
+            hh = hstart + iw * sub_h                    # [sp]
+            wg, hg = jnp.meshgrid(ww, hh)
+            ok = ((wg >= -0.5) & (wg <= W - 0.5)
+                  & (hg >= -0.5) & (hg <= H - 0.5))
+            wc = jnp.clip(wg, 0.0, W - 1.0)
+            hc = jnp.clip(hg, 0.0, H - 1.0)
+            vals = _bilinear_clamped(feat[c], hc, wc)
+            cnt = ok.sum()
+            return jnp.where(cnt > 0,
+                             jnp.sum(jnp.where(ok, vals, 0.0))
+                             / jnp.maximum(cnt, 1), 0.0)
+
+        f = jax.vmap(jax.vmap(jax.vmap(bin_val, in_axes=(None, None, 0)),
+                              in_axes=(None, 0, None)),
+                     in_axes=(0, None, None))
+        return f(ct_ix, ph_ix, pw_ix)
+
+    tr_in = (jnp.zeros((R, 2, part_h, part_w), jnp.float32)
+             if no_trans else trans)
+    out = jax.vmap(one)(rois, batch_ids, tr_in)
+    return out.astype(x.dtype)
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              rois_num=None, name=None):
+    """Warp quadrilateral RoIs to a fixed rectangle by perspective
+    transform (ref: detection.py:2498 over
+    roi_perspective_transform_op.cc).
+
+    input ``[N, C, H, W]``; rois ``[R, 8]`` as (x1 y1 x2 y2 x3 y3 x4 y4)
+    clockwise from top-left → (out ``[R, C, TH, TW]``, mask
+    ``[R, 1, TH, TW]`` int32, transform_matrix ``[R, 9]``).
+    """
+    x = jnp.asarray(input)
+    rois = jnp.asarray(rois, jnp.float32)
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    TH, TW = int(transformed_height), int(transformed_width)
+    batch_ids = _roi_batch_ids(rois_num, R, N)
+
+    def matrix_for(rx, ry):
+        """get_transform_matrix (op.cc:110) verbatim semantics."""
+        x0, x1, x2, x3 = rx[0], rx[1], rx[2], rx[3]
+        y0, y1, y2, y3 = ry[0], ry[1], ry[2], ry[3]
+        len1 = jnp.sqrt((x0 - x1) ** 2 + (y0 - y1) ** 2)
+        len2 = jnp.sqrt((x1 - x2) ** 2 + (y1 - y2) ** 2)
+        len3 = jnp.sqrt((x2 - x3) ** 2 + (y2 - y3) ** 2)
+        len4 = jnp.sqrt((x3 - x0) ** 2 + (y3 - y0) ** 2)
+        est_h = (len2 + len4) / 2.0
+        est_w = (len1 + len3) / 2.0
+        norm_h = jnp.float32(max(2, TH))
+        norm_w = jnp.round(est_w * (norm_h - 1)
+                           / jnp.maximum(est_h, 1e-5)) + 1
+        norm_w = jnp.clip(norm_w, 2, TW)
+        dx1 = x1 - x2
+        dx2 = x3 - x2
+        dx3 = x0 - x1 + x2 - x3
+        dy1 = y1 - y2
+        dy2 = y3 - y2
+        dy3 = y0 - y1 + y2 - y3
+        den = dx1 * dy2 - dx2 * dy1 + 1e-5
+        m6 = (dx3 * dy2 - dx2 * dy3) / den / (norm_w - 1)
+        m7 = (dx1 * dy3 - dx3 * dy1) / den / (norm_h - 1)
+        m8 = jnp.float32(1.0)
+        m3 = (y1 - y0 + m6 * (norm_w - 1) * y1) / (norm_w - 1)
+        m4 = (y3 - y0 + m7 * (norm_h - 1) * y3) / (norm_h - 1)
+        m5 = y0
+        m0 = (x1 - x0 + m6 * (norm_w - 1) * x1) / (norm_w - 1)
+        m1 = (x3 - x0 + m7 * (norm_h - 1) * x3) / (norm_h - 1)
+        m2 = x0
+        return jnp.stack([m0, m1, m2, m3, m4, m5, m6, m7, m8])
+
+    def in_quad(px_, py_, rx, ry):
+        """Point-in-quadrilateral with the kernel's 1e-4 edge tolerance
+        (op.cc:46): on-edge points count as inside."""
+        on_edge = jnp.zeros_like(px_, bool)
+        n_cross = jnp.zeros_like(px_, jnp.int32)
+        for i in range(4):
+            xs, ys = rx[i], ry[i]
+            xe, ye = rx[(i + 1) % 4], ry[(i + 1) % 4]
+            horiz = jnp.abs(ys - ye) < 1e-4
+            on_h = (horiz & (jnp.abs(py_ - ys) < 1e-4)
+                    & (px_ >= jnp.minimum(xs, xe) - 1e-4)
+                    & (px_ <= jnp.maximum(xs, xe) + 1e-4))
+            ix = (py_ - ys) * (xe - xs) / jnp.where(horiz, 1.0, ye - ys) + xs
+            on_e = (~horiz & (jnp.abs(ix - px_) < 1e-4)
+                    & (py_ >= jnp.minimum(ys, ye) - 1e-4)
+                    & (py_ <= jnp.maximum(ys, ye) + 1e-4))
+            on_edge = on_edge | on_h | on_e
+            crossing = (~horiz
+                        & (py_ > jnp.minimum(ys, ye) + 1e-4)
+                        & (py_ <= jnp.maximum(ys, ye) + 1e-4)
+                        & (ix > px_))
+            n_cross = n_cross + crossing.astype(jnp.int32)
+        return on_edge | (n_cross % 2 == 1)
+
+    ow = jnp.arange(TW, dtype=jnp.float32)
+    oh = jnp.arange(TH, dtype=jnp.float32)
+    owg, ohg = jnp.meshgrid(ow, oh)          # [TH, TW]
+
+    def one(roi, bid):
+        rx = roi[0::2] * spatial_scale
+        ry = roi[1::2] * spatial_scale
+        m = matrix_for(rx, ry)
+        u = m[0] * owg + m[1] * ohg + m[2]
+        v = m[3] * owg + m[4] * ohg + m[5]
+        w = m[6] * owg + m[7] * ohg + m[8]
+        in_w = u / w
+        in_h = v / w
+        inside_q = in_quad(in_w, in_h, rx, ry)
+        in_range = ((in_w > -0.5) & (in_w < W - 0.5)
+                    & (in_h > -0.5) & (in_h < H - 0.5))
+        valid = inside_q & in_range
+        wc = jnp.clip(in_w, 0.0, W - 1.0)
+        hc = jnp.clip(in_h, 0.0, H - 1.0)
+        feat = x[bid].astype(jnp.float32)    # [C, H, W]
+        vals = jax.vmap(lambda fc: _bilinear_clamped(fc, hc, wc))(feat)
+        out = jnp.where(valid[None], vals, 0.0)
+        return out, valid.astype(jnp.int32)[None], m
+
+    out, mask, mats = jax.vmap(one)(rois, batch_ids)
+    return out.astype(x.dtype), mask, mats
+
+
+def polygon_box_transform(input, name=None):
+    """EAST geometry decode (ref: polygon_box_transform_op.cc): turn
+    per-pixel offset channels into absolute quad coordinates on the 4x
+    downsampled grid — even channels become ``4*w - v``, odd channels
+    ``4*h - v``.  input ``[N, G, H, W]`` (G even) → same shape.
+    """
+    x = jnp.asarray(input)
+    if x.ndim != 4 or x.shape[1] % 2:
+        raise InvalidArgumentError(
+            f"polygon_box_transform wants [N, 2k, H, W], got {x.shape}")
+    N, G, H, W = x.shape
+    wpos = 4.0 * jnp.arange(W, dtype=x.dtype)
+    hpos = 4.0 * jnp.arange(H, dtype=x.dtype)
+    even = wpos[None, None, None, :] - x
+    odd = hpos[None, None, :, None] - x
+    is_even = (jnp.arange(G) % 2 == 0)[None, :, None, None]
+    return jnp.where(is_even, even, odd)

@@ -12,3 +12,149 @@ from ..nn.functional.deform_conv import deform_conv2d  # noqa: F401
 
 __all__ = ["box_coder", "nms", "multiclass_nms", "prior_box", "roi_align",
            "roi_pool", "sigmoid_focal_loss", "yolo_box", "deform_conv2d"]
+
+
+_multi_box_head_cls = None
+
+
+def _build_multi_box_head():
+    """Build the MultiBoxHead Layer class once (lazy: vision.ops must not
+    import paddle_tpu.nn at module load)."""
+    global _multi_box_head_cls
+    if _multi_box_head_cls is None:
+        from .. import nn
+
+        class MultiBoxHead(nn.Layer):
+            """SSD multi-box head (ref: fluid/layers/detection.py:2102
+            multi_box_head) as an eager Layer: per feature map, a conv
+            pair produces location offsets and class confidences while
+            prior boxes generate on the same grid; everything
+            concatenates across maps.  The 1.x builder created its conv
+            parameters inside the op graph; here they live in the Layer
+            (``in_channels`` declares each feature map's channels).
+
+            Call with (inputs: list of [N, Ci, Hi, Wi], image) →
+            (mbox_locs [N, total, 4], mbox_confs [N, total, classes],
+            boxes [total, 4], variances [total, 4])."""
+
+            def __init__(self, in_channels, base_size, num_classes,
+                         aspect_ratios, min_ratio=None, max_ratio=None,
+                         min_sizes=None, max_sizes=None, steps=None,
+                         step_w=None, step_h=None, offset=0.5,
+                         variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                         clip=False, kernel_size=1, pad=0, stride=1,
+                         min_max_aspect_ratios_order=False):
+                super().__init__()
+                from ..framework.errors import InvalidArgumentError
+
+                num_layer = len(in_channels)
+                if num_layer <= 2:
+                    if min_sizes is None or max_sizes is None:
+                        raise InvalidArgumentError(
+                            "<=2 inputs need explicit min_sizes/max_sizes")
+                elif min_sizes is None and max_sizes is None:
+                    import math as _m
+
+                    min_sizes, max_sizes = [], []
+                    step = int(_m.floor((max_ratio - min_ratio)
+                                        / (num_layer - 2)))
+                    for ratio in range(min_ratio, max_ratio + 1, step):
+                        min_sizes.append(base_size * ratio / 100.0)
+                        max_sizes.append(base_size * (ratio + step) / 100.0)
+                    min_sizes = [base_size * 0.10] + min_sizes
+                    max_sizes = [base_size * 0.20] + max_sizes
+                if len(aspect_ratios) != num_layer:
+                    raise InvalidArgumentError(
+                        "aspect_ratios must match the number of inputs")
+                if steps is not None:
+                    step_w = step_h = steps
+                for nm, val in (("steps", steps), ("step_w", step_w),
+                                ("step_h", step_h)):
+                    if val is not None and (
+                            not isinstance(val, (list, tuple))
+                            or len(val) != num_layer):
+                        raise InvalidArgumentError(
+                            f"{nm} must be a list/tuple with one entry per "
+                            f"input ({num_layer}), got {val!r}")
+                self._cfg = dict(
+                    min_sizes=min_sizes, max_sizes=max_sizes,
+                    aspect_ratios=aspect_ratios, variance=tuple(variance),
+                    flip=flip, clip=clip, offset=offset,
+                    step_w=step_w, step_h=step_h,
+                    mmaro=min_max_aspect_ratios_order)
+                self.num_classes = int(num_classes)
+                self.loc_convs = nn.LayerList()
+                self.conf_convs = nn.LayerList()
+                for i, cin in enumerate(in_channels):
+                    npb = self._num_priors(i)
+                    self.loc_convs.append(nn.Conv2D(
+                        cin, npb * 4, kernel_size, stride=stride,
+                        padding=pad))
+                    self.conf_convs.append(nn.Conv2D(
+                        cin, npb * self.num_classes, kernel_size,
+                        stride=stride, padding=pad))
+
+            def _num_priors(self, i):
+                # EXACTLY prior_box's aspect-ratio dedup (detection.py
+                # prior_box): ars = [1] + new ratios (+ flips), K =
+                # len(min)*len(ars) + min(len(min), len(max))
+                ar = self._cfg["aspect_ratios"][i]
+                ar = list(ar) if isinstance(ar, (list, tuple)) else [ar]
+                ms = self._cfg["min_sizes"][i]
+                ms = list(ms) if isinstance(ms, (list, tuple)) else [ms]
+                mx = self._cfg["max_sizes"][i]
+                mx = list(mx) if isinstance(mx, (list, tuple)) else [mx]
+                ars = [1.0]
+                for a in ar:
+                    a = float(a)
+                    if not any(abs(a - e) < 1e-6 for e in ars):
+                        ars.append(a)
+                        if self._cfg["flip"]:
+                            ars.append(1.0 / a)
+                return len(ms) * len(ars) + min(len(ms), len(mx))
+
+            def forward(self, inputs, image):
+                import jax.numpy as jnp
+
+                from ..nn import functional as F
+
+                cfg = self._cfg
+                locs, confs, boxes, vars_ = [], [], [], []
+                for i, feat in enumerate(inputs):
+                    ms = cfg["min_sizes"][i]
+                    ms = list(ms) if isinstance(ms, (list, tuple)) else [ms]
+                    mx = cfg["max_sizes"][i]
+                    mx = list(mx) if isinstance(mx, (list, tuple)) else [mx]
+                    ar = cfg["aspect_ratios"][i]
+                    ar = list(ar) if isinstance(ar, (list, tuple)) else [ar]
+                    step = (cfg["step_w"][i] if cfg["step_w"] else 0.0,
+                            cfg["step_h"][i] if cfg["step_h"] else 0.0)
+                    box, var = F.prior_box(
+                        feat, image, ms, mx, ar, cfg["variance"],
+                        cfg["flip"], cfg["clip"], step, cfg["offset"],
+                        min_max_aspect_ratios_order=cfg["mmaro"])
+                    boxes.append(jnp.reshape(box, (-1, 4)))
+                    vars_.append(jnp.reshape(var, (-1, 4)))
+                    loc = self.loc_convs[i](feat)        # [N, P*4, H, W]
+                    N = loc.shape[0]
+                    loc = jnp.transpose(jnp.asarray(loc), (0, 2, 3, 1))
+                    locs.append(loc.reshape(N, -1, 4))
+                    conf = self.conf_convs[i](feat)
+                    conf = jnp.transpose(jnp.asarray(conf), (0, 2, 3, 1))
+                    confs.append(conf.reshape(N, -1, self.num_classes))
+                return (jnp.concatenate(locs, 1), jnp.concatenate(confs, 1),
+                        jnp.concatenate(boxes, 0), jnp.concatenate(vars_, 0))
+
+        MultiBoxHead.__module__ = __name__
+        MultiBoxHead.__qualname__ = "MultiBoxHead"
+        _multi_box_head_cls = MultiBoxHead
+    return _multi_box_head_cls
+
+
+def __getattr__(name):
+    if name == "MultiBoxHead":
+        return _build_multi_box_head()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__.append("MultiBoxHead")

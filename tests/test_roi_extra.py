@@ -1,0 +1,316 @@
+"""RoI long-tail ops vs transcribed C++ oracles.
+
+Oracles transcribe (SURVEY §4 OpTest style):
+  prroi_pool_op.h (exact bilinear integral), deformable_psroi_pooling_op.h
+  (offset sampling), roi_perspective_transform_op.cc (homography + in_quad),
+  polygon_box_transform_op.cc.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.nn import functional as F
+
+
+def _bilinear(feat, h, w):
+    H, W = feat.shape
+    h0, w0 = int(np.floor(h)), int(np.floor(w))
+    h0, w0 = max(0, min(h0, H - 1)), max(0, min(w0, W - 1))
+    h1, w1 = min(h0 + 1, H - 1), min(w0 + 1, W - 1)
+    lh, lw = h - h0, w - w0
+    top = feat[h0, w0] + (feat[h0, w1] - feat[h0, w0]) * lw
+    bot = feat[h1, w0] + (feat[h1, w1] - feat[h1, w0]) * lw
+    return top + (bot - top) * lh
+
+
+class TestPrRoIPool:
+    def _integral_oracle(self, feat, x0, y0, x1, y1, n=400):
+        """Numerical integral of the bilinear surface over the window
+        (dense quadrature stands in for the closed form)."""
+        H, W = feat.shape
+        xs = np.linspace(x0, x1, n, endpoint=False) + (x1 - x0) / n / 2
+        ys = np.linspace(y0, y1, n, endpoint=False) + (y1 - y0) / n / 2
+        total = 0.0
+        for y in ys:
+            for x in xs:
+                # hat-basis interpolation with zero outside the map
+                v = 0.0
+                for py in (int(np.floor(y)), int(np.floor(y)) + 1):
+                    for px in (int(np.floor(x)), int(np.floor(x)) + 1):
+                        if 0 <= py < H and 0 <= px < W:
+                            wgt = max(0.0, 1 - abs(x - px)) * \
+                                max(0.0, 1 - abs(y - py))
+                            v += feat[py, px] * wgt
+                total += v
+        area = (x1 - x0) * (y1 - y0)
+        return total * area / (n * n) / area if area > 0 else 0.0
+
+    def test_vs_numerical_integral(self):
+        rng = np.random.RandomState(0)
+        feat = rng.rand(1, 1, 6, 6).astype(np.float32)
+        rois = np.array([[0.7, 1.2, 4.3, 4.9]], np.float32)
+        out = np.asarray(F.prroi_pool(feat, rois, 1.0, 2, 2))
+        x0, y0, x1, y1 = rois[0]
+        bw, bh = (x1 - x0) / 2, (y1 - y0) / 2
+        for ph in range(2):
+            for pw in range(2):
+                want = self._integral_oracle(
+                    feat[0, 0], x0 + pw * bw, y0 + ph * bh,
+                    x0 + (pw + 1) * bw, y0 + (ph + 1) * bh)
+                # mean over the window = integral / area
+                np.testing.assert_allclose(out[0, 0, ph, pw], want,
+                                           rtol=2e-3, atol=2e-3)
+
+    def test_constant_field_is_identity(self):
+        feat = np.full((1, 3, 8, 8), 2.5, np.float32)
+        rois = np.array([[1.0, 1.0, 6.0, 6.0]], np.float32)
+        out = np.asarray(F.prroi_pool(feat, rois, 1.0, 3, 3))
+        np.testing.assert_allclose(out, 2.5, rtol=1e-5)
+
+    def test_differentiable_in_rois(self):
+        # the headline PrRoI property: gradients flow into coordinates
+        rng = np.random.RandomState(1)
+        feat = rng.rand(1, 1, 8, 8).astype(np.float32)
+
+        def f(r):
+            return F.prroi_pool(feat, r.reshape(1, 4), 1.0, 2, 2).sum()
+
+        g = jax.grad(f)(np.array([1.0, 1.0, 6.0, 6.0], np.float32))
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.abs(np.asarray(g)).max() > 0
+
+    def test_batch_roi_nums(self):
+        rng = np.random.RandomState(2)
+        feat = rng.rand(2, 1, 6, 6).astype(np.float32)
+        rois = np.array([[0, 0, 4, 4], [0, 0, 4, 4]], np.float32)
+        out = np.asarray(F.prroi_pool(feat, rois, 1.0, 2, 2,
+                                      batch_roi_nums=np.array([1, 1])))
+        # same roi, different images → different values
+        assert np.abs(out[0] - out[1]).max() > 1e-4
+
+
+class TestDeformableRoIPooling:
+    def _oracle(self, x, roi, trans, no_trans, scale, PH, PW, gh, gw,
+                part_h, part_w, sp, trans_std, ps):
+        """Transcribes DeformablePSROIPoolForwardCPUKernel."""
+        N, C, H, W = x.shape
+        out_dim = C // (PH * PW) if ps else C
+        nc = trans.shape[1] // 2 if not no_trans else 1
+        cec = max(out_dim // nc, 1)
+        x0 = round(roi[0]) * scale - 0.5
+        y0 = round(roi[1]) * scale - 0.5
+        x1 = (round(roi[2]) + 1.0) * scale - 0.5
+        y1 = (round(roi[3]) + 1.0) * scale - 0.5
+        rw, rh = max(x1 - x0, 0.1), max(y1 - y0, 0.1)
+        bw, bh = rw / PW, rh / PH
+        out = np.zeros((out_dim, PH, PW), np.float32)
+        for ct in range(out_dim):
+            for ph in range(PH):
+                for pw in range(PW):
+                    pth = int(np.floor(ph / PH * part_h))
+                    ptw = int(np.floor(pw / PW * part_w))
+                    cid = ct // cec
+                    tx = 0.0 if no_trans else \
+                        trans[0, 2 * cid, pth, ptw] * trans_std
+                    ty = 0.0 if no_trans else \
+                        trans[0, 2 * cid + 1, pth, ptw] * trans_std
+                    ws = pw * bw + x0 + tx * rw
+                    hs = ph * bh + y0 + ty * rh
+                    if ps:
+                        g_w = min(max(int(np.floor(pw * gw / PW)), 0), gw - 1)
+                        g_h = min(max(int(np.floor(ph * gh / PH)), 0), gh - 1)
+                        c = (ct * gh + g_h) * gw + g_w
+                    else:
+                        c = ct
+                    s, n = 0.0, 0
+                    for ih in range(sp):
+                        for iw in range(sp):
+                            w = ws + iw * (bw / sp)
+                            h = hs + ih * (bh / sp)
+                            if w < -0.5 or w > W - 0.5 or h < -0.5 \
+                                    or h > H - 0.5:
+                                continue
+                            w = min(max(w, 0.0), W - 1.0)
+                            h = min(max(h, 0.0), H - 1.0)
+                            s += _bilinear(x[0, c], h, w)
+                            n += 1
+                    out[ct, ph, pw] = s / n if n else 0.0
+        return out
+
+    @pytest.mark.parametrize("ps", [False, True])
+    def test_vs_oracle(self, ps):
+        rng = np.random.RandomState(3)
+        PH = PW = 2
+        C = 8 if ps else 3
+        x = rng.rand(1, C, 10, 10).astype(np.float32)
+        roi = np.array([1.0, 2.0, 7.0, 8.0], np.float32)
+        trans = rng.uniform(-1, 1, (1, 2, 2, 2)).astype(np.float32)
+        kw = dict(no_trans=False, spatial_scale=1.0,
+                  pooled_height=PH, pooled_width=PW, part_size=(2, 2),
+                  sample_per_part=3, trans_std=0.2,
+                  position_sensitive=ps,
+                  group_size=(2, 2) if ps else (1, 1))
+        out = np.asarray(F.deformable_roi_pooling(
+            x, roi.reshape(1, 4), trans, **kw))
+        want = self._oracle(x, roi, trans, False, 1.0, PH, PW,
+                            2 if ps else 1, 2 if ps else 1, 2, 2, 3, 0.2, ps)
+        np.testing.assert_allclose(out[0], want, rtol=1e-4, atol=1e-5)
+
+    def test_no_trans_matches_zero_offsets(self):
+        rng = np.random.RandomState(4)
+        x = rng.rand(1, 2, 8, 8).astype(np.float32)
+        roi = np.array([[1, 1, 6, 6]], np.float32)
+        a = np.asarray(F.deformable_roi_pooling(
+            x, roi, None, no_trans=True, pooled_height=2, pooled_width=2,
+            sample_per_part=2))
+        b = np.asarray(F.deformable_roi_pooling(
+            x, roi, np.zeros((1, 2, 2, 2), np.float32), no_trans=False,
+            pooled_height=2, pooled_width=2, part_size=(2, 2),
+            sample_per_part=2))
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+class TestRoiPerspectiveTransform:
+    def test_axis_aligned_quad_matches_bilinear(self):
+        # an axis-aligned rectangle quad degenerates to plain resampling
+        rng = np.random.RandomState(5)
+        x = rng.rand(1, 1, 10, 10).astype(np.float32)
+        quad = np.array([[2, 2, 7, 2, 7, 6, 2, 6]], np.float32)
+        TH = TW = 4
+        out, mask, mat = F.roi_perspective_transform(x, quad, TH, TW)
+        out = np.asarray(out)
+        mat = np.asarray(mat)[0]
+        # verify against the oracle homography sampling
+        for oh in range(TH):
+            for ow in range(TW):
+                u = mat[0] * ow + mat[1] * oh + mat[2]
+                v = mat[3] * ow + mat[4] * oh + mat[5]
+                w = mat[6] * ow + mat[7] * oh + mat[8]
+                in_w, in_h = u / w, v / w
+                want = _bilinear(x[0, 0], in_h, in_w)
+                if np.asarray(mask)[0, 0, oh, ow]:
+                    np.testing.assert_allclose(out[0, 0, oh, ow], want,
+                                               rtol=1e-4, atol=1e-5)
+
+    def test_corners_map_to_quad_corners(self):
+        x = np.zeros((1, 1, 20, 20), np.float32)
+        quad = np.array([[3, 2, 14, 4, 15, 11, 2, 12]], np.float32)
+        TH = TW = 8
+        _, _, mat = F.roi_perspective_transform(x, quad, TH, TW)
+        m = np.asarray(mat)[0]
+
+        def src(ow, oh):
+            u = m[0] * ow + m[1] * oh + m[2]
+            v = m[3] * ow + m[4] * oh + m[5]
+            w = m[6] * ow + m[7] * oh + m[8]
+            return u / w, v / w
+
+        # (0,0) maps to the first corner exactly (matrix[2], matrix[5])
+        np.testing.assert_allclose(src(0, 0), (3, 2), atol=1e-4)
+
+    def test_outside_is_masked_zero(self):
+        x = np.ones((1, 1, 10, 10), np.float32)
+        # tiny quad in the corner: most of the output grid maps outside
+        quad = np.array([[0, 0, 2, 0, 2, 2, 0, 2]], np.float32)
+        out, mask, _ = F.roi_perspective_transform(x, quad, 8, 8)
+        out, mask = np.asarray(out), np.asarray(mask)
+        assert (out[mask[:, :1] == 0] == 0).all() if mask.size else True
+
+
+class TestPolygonBoxTransform:
+    def test_vs_oracle(self):
+        rng = np.random.RandomState(6)
+        x = rng.rand(2, 4, 3, 5).astype(np.float32)
+        out = np.asarray(F.polygon_box_transform(x))
+        N, G, H, W = x.shape
+        want = np.empty_like(x)
+        for n in range(N):
+            for g in range(G):
+                for h in range(H):
+                    for w in range(W):
+                        if g % 2 == 0:
+                            want[n, g, h, w] = 4 * w - x[n, g, h, w]
+                        else:
+                            want[n, g, h, w] = 4 * h - x[n, g, h, w]
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+    def test_odd_channels_rejected(self):
+        with pytest.raises(Exception):
+            F.polygon_box_transform(np.zeros((1, 3, 2, 2), np.float32))
+
+
+def test_prroi_reference_param_order():
+    # fluid surface is (input, rois, spatial_scale, pooled_h, pooled_w)
+    x = np.ones((1, 1, 8, 8), np.float32)
+    rois = np.array([[0, 0, 8, 8]], np.float32)
+    out = F.prroi_pool(x, rois, 0.5, 2, 2)  # positional like 1.x callers
+    assert out.shape == (1, 1, 2, 2)
+
+
+def test_fluid_layers_resolve():
+    from paddle_tpu.fluid import layers as fl
+
+    assert fl.prroi_pool is F.prroi_pool
+    assert fl.deformable_roi_pooling is F.deformable_roi_pooling
+    assert fl.roi_perspective_transform is F.roi_perspective_transform
+    assert fl.polygon_box_transform is F.polygon_box_transform
+
+
+class TestMultiBoxHead:
+    @pytest.mark.parametrize("flip", [True, False])
+    @pytest.mark.parametrize("mmaro", [False, True])
+    def test_shapes_consistent(self, flip, mmaro):
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.ops import MultiBoxHead
+
+        paddle.seed(0)
+        head = MultiBoxHead(
+            in_channels=[6, 6, 6], base_size=300, num_classes=5,
+            aspect_ratios=[[2.0], [2.0, 3.0], [1.0, 2.0]],
+            min_ratio=20, max_ratio=90, flip=flip,
+            min_max_aspect_ratios_order=mmaro)
+        feats = [np.random.RandomState(i).rand(2, 6, s, s).astype(np.float32)
+                 for i, s in enumerate((6, 4, 2))]
+        img = np.zeros((2, 3, 300, 300), np.float32)
+        locs, confs, boxes, vars_ = head(feats, img)
+        assert locs.shape[0] == 2 and locs.shape[2] == 4
+        assert confs.shape[2] == 5
+        # the conv channel budget must agree with the generated priors
+        assert locs.shape[1] == boxes.shape[0] == confs.shape[1] \
+            == vars_.shape[0]
+
+    def test_size_ladder_matches_reference_schedule(self):
+        from paddle_tpu.vision.ops import MultiBoxHead
+
+        head = MultiBoxHead(
+            in_channels=[4, 4, 4, 4], base_size=200, num_classes=2,
+            aspect_ratios=[[2.0]] * 4, min_ratio=20, max_ratio=80)
+        ms = head._cfg["min_sizes"]
+        # first rung is base*0.10, then base*ratio/100 in floor-steps
+        np.testing.assert_allclose(ms[0], 20.0)
+        np.testing.assert_allclose(ms[1], 40.0)
+
+    def test_trains(self):
+        import jax.numpy as jnp
+
+        import paddle_tpu as paddle
+        from paddle_tpu.nn.layer_base import functional_call
+        from paddle_tpu.vision.ops import MultiBoxHead
+
+        paddle.seed(1)
+        head = MultiBoxHead(
+            in_channels=[4], base_size=100, num_classes=3,
+            aspect_ratios=[[2.0]], min_sizes=[[30.0]], max_sizes=[[60.0]])
+        feat = jnp.asarray(
+            np.random.RandomState(2).rand(1, 4, 4, 4).astype(np.float32))
+        img = jnp.zeros((1, 3, 100, 100), jnp.float32)
+        params = {k: v.value for k, v in head.named_parameters()}
+
+        def loss(p):
+            locs, confs, *_ = functional_call(head, p, [feat], img)
+            return (locs ** 2).mean() + (confs ** 2).mean()
+
+        g = jax.grad(loss)(params)
+        assert all(np.isfinite(np.asarray(v)).all() for v in
+                   jax.tree_util.tree_leaves(g))
