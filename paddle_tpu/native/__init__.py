@@ -18,7 +18,7 @@ import os
 import subprocess
 import threading
 
-__all__ = ["ingest_lib", "NativeBuildError"]
+__all__ = ["ingest_lib", "c_api_path", "NativeBuildError"]
 
 _CACHE_DIR = os.path.expanduser("~/.cache/paddle_tpu/native")
 _SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "ingest.cc")
@@ -31,16 +31,16 @@ class NativeBuildError(RuntimeError):
     pass
 
 
-def _build(src: str, tag: str) -> str:
+def _build(src: str, tag: str, extra_flags=()) -> str:
     with open(src, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
     out = os.path.join(_CACHE_DIR, f"{tag}-{digest}.so")
     if os.path.exists(out):
         return out
     os.makedirs(_CACHE_DIR, exist_ok=True)
-    tmp = out + f".tmp{os.getpid()}"
-    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           src, "-o", tmp]
+    tmp = out + f".tmp{os.getpid()}-{threading.get_ident()}"
+    cmd = (["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            src] + list(extra_flags) + ["-o", tmp])
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True)
     except FileNotFoundError as e:
@@ -87,3 +87,25 @@ def ingest_lib() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
         _lib = lib
         return _lib
+
+
+_CAPI_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "capi.cc")
+
+
+def c_api_path() -> str:
+    """Build (once, cached) and return the C inference ABI shared library
+    (paddle_tpu_c.h).  Unlike :func:`ingest_lib` this is linked by C/Go
+    programs, not loaded via ctypes here — the embedded interpreter would
+    clash with the running one."""
+    try:
+        cfg = lambda *a: subprocess.run(  # noqa: E731
+            ("python3-config",) + a, capture_output=True, text=True,
+            check=True).stdout.split()
+        includes = cfg("--includes")
+        ldflags = cfg("--ldflags", "--embed")
+    except (OSError, subprocess.CalledProcessError) as e:
+        raise NativeBuildError(f"python3-config not usable: {e}")
+    with _lock:
+        hdr_dir = os.path.dirname(_CAPI_SRC)
+        return _build(_CAPI_SRC, "capi",
+                      extra_flags=includes + [f"-I{hdr_dir}"] + ldflags)
