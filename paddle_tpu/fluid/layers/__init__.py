@@ -569,11 +569,8 @@ _STATIC_ONLY = {
     "bilinear_tensor_product": "paddle.nn.BilinearTensorProduct",
     "pool2d": "paddle.nn.Pool2D / nn.functional.max_pool2d",
     "pool3d": "paddle.nn.functional.max_pool3d",
-    "adaptive_pool2d": "paddle.nn.functional.adaptive_avg_pool2d",
-    "adaptive_pool3d": "paddle.nn.functional.adaptive_avg_pool3d",
     "center_loss": "a Layer holding the centers buffer + mse update",
     "deformable_conv": "paddle.nn.functional.deform_conv2d (explicit weight/offset/mask tensors; the 1.x builder created the params itself)",
-    "lrn": "paddle.nn.LocalResponseNorm",
     # program control flow → lax / python
     "While": "jax.lax.while_loop (compiled) or Python while (eager)",
     "Switch": "jax.lax.switch", "IfElse": "jax.lax.cond",
@@ -590,23 +587,12 @@ _STATIC_ONLY = {
     "reorder_lod_tensor_by_rank": "LoD machinery replaced by dense padding",
     "Assert": "paddle_tpu.framework checks / chex assertions",
     "autoincreased_step_counter": "track the step in the train loop state",
-    "fill_constant_batch_size_like": "jnp.full with the known batch size",
-    "uniform_random_batch_size_like": "paddle.uniform with the known shape",
-    "gaussian_random_batch_size_like": "paddle.randn with the known shape",
-    "sampling_id": "paddle.multinomial",
     "random_crop": "paddle.vision.transforms.RandomCrop",
-    "im2sequence": "paddle.nn.functional.unfold",
     "filter_by_instag": "boolean-mask gather (paddle.masked_select)",
     "merge_selected_rows": "SelectedRows replaced by dense grads",
     "get_tensor_from_selected_rows": "SelectedRows replaced by dense grads",
-    "continuous_value_model": "CTR-specific op; see models/wide_deep.py",
     "hash": "CTR-specific hashing; use Python/np hashing at ingest",
     "similarity_focus": "not implemented — open an issue if needed",
-    "affine_channel": "scale/shift with broadcasting (x * w + b)",
-    "space_to_depth": "paddle.nn.PixelUnshuffle",
-    "shuffle_channel": "paddle.nn.ChannelShuffle",
-    "fsp_matrix": "einsum('nchw,ndhw->ncd') / distillation utilities",
-    "add_position_encoding": "add a position embedding table",
     "lod_reset": "LoD machinery replaced by dense padding + lengths",
     "lod_append": "LoD machinery replaced by dense padding + lengths",
     "sequence_conv": "conv1d over padded batches with sequence_mask",
@@ -644,11 +630,8 @@ _STATIC_ONLY = {
     "multi_box_head": "compose conv heads + prior_box",
     "retinanet_detection_output": "detection_output",
     # misc losses
-    "bpr_loss": "pairwise softmax loss over positive/negative logits",
     "sampled_softmax_with_cross_entropy": "sample negatives at ingest + "
                                           "softmax_with_cross_entropy",
-    "rank_loss": "paddle.nn.functional.margin_ranking_loss",
-    "margin_rank_loss": "paddle.nn.functional.margin_ranking_loss",
     "teacher_student_sigmoid_loss": "distillation loss not implemented",
     "warpctc_lod": "warpctc with explicit lengths",
     "crop": "paddle.crop",

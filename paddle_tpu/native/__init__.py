@@ -31,9 +31,12 @@ class NativeBuildError(RuntimeError):
     pass
 
 
-def _build(src: str, tag: str, extra_flags=()) -> str:
-    with open(src, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+def _build(src: str, tag: str, extra_flags=(), extra_srcs=()) -> str:
+    h = hashlib.sha256()
+    for p in (src,) + tuple(extra_srcs):
+        with open(p, "rb") as f:
+            h.update(f.read())
+    digest = h.hexdigest()[:16]
     out = os.path.join(_CACHE_DIR, f"{tag}-{digest}.so")
     if os.path.exists(out):
         return out
@@ -97,15 +100,23 @@ def c_api_path() -> str:
     (paddle_tpu_c.h).  Unlike :func:`ingest_lib` this is linked by C/Go
     programs, not loaded via ctypes here — the embedded interpreter would
     clash with the running one."""
-    try:
-        cfg = lambda *a: subprocess.run(  # noqa: E731
-            ("python3-config",) + a, capture_output=True, text=True,
-            check=True).stdout.split()
-        includes = cfg("--includes")
-        ldflags = cfg("--ldflags", "--embed")
-    except (OSError, subprocess.CalledProcessError) as e:
-        raise NativeBuildError(f"python3-config not usable: {e}")
+    # flags from the RUNNING interpreter (sysconfig), not whatever
+    # python3-config is on PATH — a mismatched system interpreter would
+    # embed a runtime that cannot import this package
+    import sysconfig
+
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ldver = sysconfig.get_config_var("LDVERSION") \
+        or sysconfig.get_config_var("VERSION")
+    syslibs = ((sysconfig.get_config_var("LIBS") or "").split()
+               + (sysconfig.get_config_var("SYSLIBS") or "").split())
+    flags = [f"-I{inc}", f"-I{os.path.dirname(_CAPI_SRC)}"]
+    if libdir:
+        flags.append(f"-L{libdir}")
+    flags.append(f"-lpython{ldver}")
+    flags += syslibs
+    hdr = os.path.join(os.path.dirname(_CAPI_SRC), "paddle_tpu_c.h")
     with _lock:
-        hdr_dir = os.path.dirname(_CAPI_SRC)
-        return _build(_CAPI_SRC, "capi",
-                      extra_flags=includes + [f"-I{hdr_dir}"] + ldflags)
+        return _build(_CAPI_SRC, "capi", extra_flags=flags,
+                      extra_srcs=(hdr,))

@@ -78,22 +78,27 @@ bool ensure_python() {
   if (g_py_inited) return true;
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);
-    // Pin the backend before jax loads when asked (tests use cpu: the
-    // site-customized default may be a remote TPU plugin)
-    const char* plat = std::getenv("PADDLE_TPU_C_PLATFORM");
-    if (plat) {
-      std::string code = "import jax\n"
-                         "jax.config.update('jax_platforms', '" +
-                         std::string(plat) + "')\n";
-      if (PyRun_SimpleString(code.c_str()) != 0) {
-        set_error("failed to pin jax platform");
-        return false;
-      }
-    }
     // Release the GIL the initializing thread holds, or every other
     // thread's PyGILState_Ensure would deadlock (the header promises
-    // thread-compatibility)
+    // thread-compatibility).  Done BEFORE the pin step so every exit
+    // path below leaves the GIL released.
     PyEval_SaveThread();
+  }
+  // Pin the backend before jax loads when asked (tests use cpu: the
+  // site-customized default may be a remote TPU plugin).  Not under
+  // g_py_inited: a failed pin retries on the next call.
+  const char* plat = std::getenv("PADDLE_TPU_C_PLATFORM");
+  if (plat) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    std::string code = "import jax\n"
+                       "jax.config.update('jax_platforms', '" +
+                       std::string(plat) + "')\n";
+    int rc = PyRun_SimpleString(code.c_str());
+    PyGILState_Release(gil);
+    if (rc != 0) {
+      set_error("failed to pin jax platform");
+      return false;
+    }
   }
   g_py_inited = true;
   return true;
@@ -187,6 +192,8 @@ int pd_predictor_run(void* handle, const float** inputs,
           numel * sizeof(float), PyBUF_READ);
       if (!mv) { capture_py_error("memoryview"); ok = false; break; }
       PyObject* shape_t = PyTuple_New(ndims[i]);
+      if (!shape_t) { capture_py_error("alloc shape"); Py_DECREF(mv);
+                      ok = false; break; }
       for (int d = 0; d < ndims[i]; ++d)
         PyTuple_SET_ITEM(shape_t, d, PyLong_FromLongLong(shapes[i][d]));
       // np.frombuffer(mv, dtype=float32).reshape(shape) — the view
@@ -232,8 +239,17 @@ int pd_predictor_run(void* handle, const float** inputs,
     if (!bytes) { capture_py_error("tobytes"); break; }
     char* src = nullptr;
     Py_ssize_t blen = 0;
-    PyBytes_AsStringAndSize(bytes, &src, &blen);
+    if (PyBytes_AsStringAndSize(bytes, &src, &blen) != 0) {
+      capture_py_error("output bytes");
+      Py_DECREF(bytes);
+      break;
+    }
     *out_data = static_cast<float*>(std::malloc(blen));
+    if (*out_data == nullptr) {
+      set_error("output allocation failed");
+      Py_DECREF(bytes);
+      break;
+    }
     std::memcpy(*out_data, src, blen);
     Py_DECREF(bytes);
     rc = 0;

@@ -11,6 +11,7 @@ from .extension import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
 from .detection_targets import *  # noqa: F401,F403
 from .roi_extra import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .deform_conv import *  # noqa: F401,F403
 from ...tensor.manipulation import pad  # noqa: F401  # paddle exposes pad under nn.functional too

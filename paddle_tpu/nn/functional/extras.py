@@ -1,0 +1,268 @@
+"""Misc 1.x op promotions: the dense long tail of fluid.layers.
+
+Each function transcribes its reference kernel (cited per-op) — these are
+names that previously resolved as hint-shims; they are small dense
+computations with clean TPU formulations, so they get real
+implementations.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.errors import InvalidArgumentError
+
+__all__ = [
+    "adaptive_pool2d", "adaptive_pool3d", "add_position_encoding",
+    "affine_channel", "bpr_loss", "rank_loss", "margin_rank_loss",
+    "shuffle_channel", "space_to_depth", "fsp_matrix",
+    "continuous_value_model", "sampling_id",
+    "fill_constant_batch_size_like", "gaussian_random_batch_size_like",
+    "uniform_random_batch_size_like", "lrn", "im2sequence",
+]
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    """1.x adaptive pool (ref: fluid/layers/nn.py adaptive_pool2d) over the
+    2.0 functional ops."""
+    from . import pooling as P
+
+    if pool_type == "max":
+        if require_index:
+            return P.adaptive_max_pool2d(input, pool_size, return_mask=True)
+        return P.adaptive_max_pool2d(input, pool_size)
+    if pool_type == "avg":
+        if require_index:
+            raise InvalidArgumentError("require_index only with max pooling")
+        return P.adaptive_avg_pool2d(input, pool_size)
+    raise InvalidArgumentError(f"pool_type must be max/avg, got {pool_type!r}")
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    from . import pooling as P
+
+    if pool_type == "max":
+        if require_index:
+            return P.adaptive_max_pool3d(input, pool_size, return_mask=True)
+        return P.adaptive_max_pool3d(input, pool_size)
+    if pool_type == "avg":
+        if require_index:
+            raise InvalidArgumentError("require_index only with max pooling")
+        return P.adaptive_avg_pool3d(input, pool_size)
+    raise InvalidArgumentError(f"pool_type must be max/avg, got {pool_type!r}")
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    """out = alpha·x + beta·sinusoid (ref: add_position_encoding_op.h:77):
+    channel k < E/2 gets sin(pos / 10000^(k/(E/2−1))), channel E/2+k the
+    matching cos.  input ``[N, S, E]``."""
+    x = jnp.asarray(input)
+    if x.ndim != 3:
+        raise InvalidArgumentError(
+            f"add_position_encoding wants [N, S, E], got {x.shape}")
+    N, S, E = x.shape
+    if E % 2:
+        raise InvalidArgumentError(
+            f"add_position_encoding needs an even feature size, got {E} "
+            "(the encoding pairs sin/cos channels)")
+    half = E // 2
+    pos = jnp.arange(S, dtype=jnp.float32)[:, None]            # [S, 1]
+    k = jnp.arange(half, dtype=jnp.float32)[None, :]           # [1, half]
+    denom = jnp.where(half > 1,
+                      jnp.power(10000.0, k / jnp.maximum(half - 1, 1)),
+                      10000.0)
+    val = pos / denom                                          # [S, half]
+    enc = jnp.concatenate([jnp.sin(val), jnp.cos(val)], axis=1)  # [S, E]
+    return (x * alpha + enc[None].astype(x.dtype) * beta).astype(x.dtype)
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", act=None,
+                   name=None):
+    """Per-channel y = scale·x + bias (ref: affine_channel_op.cc)."""
+    x = jnp.asarray(x)
+    ch_axis = 1 if data_layout == "NCHW" else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    out = x
+    if scale is not None:
+        out = out * jnp.asarray(scale, x.dtype).reshape(shape)
+    if bias is not None:
+        out = out + jnp.asarray(bias, x.dtype).reshape(shape)
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act is not None:
+        raise InvalidArgumentError(f"unsupported act {act!r}")
+    return out
+
+
+def bpr_loss(input, label, name=None):
+    """Bayesian personalized ranking loss (ref: bpr_loss_op.h:52):
+    loss_i = −(1/(D−1)) Σ_{j≠y_i} log σ(x_iy − x_ij).  input ``[N, D]``
+    logits, label ``[N, 1]`` int → ``[N, 1]``."""
+    x = jnp.asarray(input, jnp.float32)
+    y = jnp.asarray(label).reshape(-1).astype(jnp.int32)
+    N, D = x.shape
+    pos = jnp.take_along_axis(x, y[:, None], axis=1)           # [N, 1]
+    # −log σ(pos − neg) = softplus(neg − pos); softplus is the
+    # overflow-stable form (log1p(exp(·)) infs past ~88)
+    sp = jax.nn.softplus(x - pos)                              # [N, D]
+    mask = jax.nn.one_hot(y, D, dtype=bool)
+    total = jnp.sum(jnp.where(mask, 0.0, sp), axis=1)
+    return (total / (D - 1))[:, None].astype(jnp.asarray(input).dtype)
+
+
+def rank_loss(label, left, right, name=None):
+    """RankNet loss (ref: rank_loss_op.h:40):
+    out = log(1 + exp(l − r)) − label·(l − r)."""
+    lbl = jnp.asarray(label, jnp.float32)
+    l = jnp.asarray(left, jnp.float32)
+    r = jnp.asarray(right, jnp.float32)
+    return jax.nn.softplus(l - r) - lbl * (l - r)  # overflow-stable log1p-exp
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    """out = relu(−label·(l − r) + margin) (ref: margin_rank_loss_op.h:60)."""
+    lbl = jnp.asarray(label, jnp.float32)
+    l = jnp.asarray(left, jnp.float32)
+    r = jnp.asarray(right, jnp.float32)
+    return jax.nn.relu(-lbl * (l - r) + margin)
+
+
+def shuffle_channel(x, group, name=None):
+    """Channel shuffle (ref: shuffle_channel_op.h): [N, C, H, W] with C =
+    g·n → regroup channels (g, n) → (n, g)."""
+    x = jnp.asarray(x)
+    N, C, H, W = x.shape
+    if C % group:
+        raise InvalidArgumentError(f"channels {C} not divisible by {group}")
+    return (x.reshape(N, group, C // group, H, W)
+            .transpose(0, 2, 1, 3, 4).reshape(N, C, H, W))
+
+
+def space_to_depth(x, blocksize, name=None):
+    """Rearrange spatial blocks into channels (ref: space_to_depth_op.h:41):
+    out[b, off·C + c, j, i] = in[b, c, j·bs + off//bs, i·bs + off%bs] —
+    offset-major channel order.  [N, C, H, W] → [N, C·bs², H/bs, W/bs]."""
+    x = jnp.asarray(x)
+    bs = int(blocksize)
+    N, C, H, W = x.shape
+    if H % bs or W % bs:
+        raise InvalidArgumentError(
+            f"spatial dims {(H, W)} not divisible by blocksize {bs}")
+    # [N, C, H/bs, bs, W/bs, bs] → offsets (bh, bw) lead the channel dim
+    r = x.reshape(N, C, H // bs, bs, W // bs, bs)
+    r = r.transpose(0, 3, 5, 1, 2, 4)          # [N, bh, bw, C, H/bs, W/bs]
+    return r.reshape(N, C * bs * bs, H // bs, W // bs)
+
+
+def fsp_matrix(x, y, name=None):
+    """Flow-of-solution-procedure matrix (ref: fsp_op.h:31): the
+    H·W-normalized gram between two feature maps —
+    out[n, i, j] = (1/(H·W)) Σ_hw x[n,i,h,w]·y[n,j,h,w]."""
+    xf = jnp.asarray(x, jnp.float32)
+    yf = jnp.asarray(y, jnp.float32)
+    H, W = xf.shape[2], xf.shape[3]
+    out = jnp.einsum("nihw,njhw->nij", xf, yf) / (H * W)
+    return out.astype(jnp.asarray(x).dtype)
+
+
+def continuous_value_model(input, cvm, use_cvm=True, name=None):
+    """CTR show/click feature transform (ref: cvm_op.h:26): with
+    ``use_cvm`` the first two columns become log(show+1) and
+    log(click+1)−log(show+1); without it they are dropped."""
+    x = jnp.asarray(input, jnp.float32)
+    if use_cvm:
+        c0 = jnp.log1p(x[:, 0:1])
+        c1 = jnp.log1p(x[:, 1:2]) - c0
+        return jnp.concatenate([c0, c1, x[:, 2:]], axis=1)
+    return x[:, 2:]
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64", name=None):
+    """Sample one index per row from the probability rows of ``x``
+    (ref: sampling_id_op.h): r ~ U[min, max), index = first cumsum ≥ r."""
+    from ...framework import random as _random
+
+    xf = jnp.asarray(x, jnp.float32)
+    key = (jax.random.PRNGKey(seed) if seed else _random.split_key())
+    r = jax.random.uniform(key, (xf.shape[0],), minval=float(min),
+                           maxval=float(max))
+    cum = jnp.cumsum(xf, axis=1)
+    idx = jnp.sum((cum < r[:, None]).astype(jnp.int32), axis=1)
+    return jnp.clip(idx, 0, xf.shape[1] - 1).astype(dtype)
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  force_cpu=False):
+    """shape with dim ``output_dim_idx`` taken from input's
+    ``input_dim_idx`` (ref: fill_constant_batch_size_like_op.cc)."""
+    shape = list(shape)
+    shape[output_dim_idx] = jnp.asarray(input).shape[input_dim_idx]
+    return jnp.full(tuple(shape), value, dtype=dtype)
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32", min=-1.0,
+                                   max=1.0, seed=0, input_dim_idx=0,
+                                   output_dim_idx=0):
+    from ...framework import random as _random
+
+    shape = list(shape)
+    shape[output_dim_idx] = jnp.asarray(input).shape[input_dim_idx]
+    key = jax.random.PRNGKey(seed) if seed else _random.split_key()
+    return jax.random.uniform(key, tuple(shape), minval=float(min),
+                              maxval=float(max)).astype(dtype)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    from ...framework import random as _random
+
+    shape = list(shape)
+    shape[output_dim_idx] = jnp.asarray(input).shape[input_dim_idx]
+    key = jax.random.PRNGKey(seed) if seed else _random.split_key()
+    return (jax.random.normal(key, tuple(shape)) * std + mean).astype(dtype)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format="NCHW"):
+    """1.x local response norm wrapper over the 2.0 functional (size=n)."""
+    from .norm import local_response_norm
+
+    return local_response_norm(input, size=n, alpha=alpha, beta=beta, k=k,
+                               data_format=data_format)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size
+                =None, out_stride=1, name=None):
+    """Sliding-window patch extraction (ref: im2sequence_op.h over the
+    kOCF im2col): [N, C, H, W] → [N·OH·OW, C·fh·fw] rows in output-
+    position order, columns channel-major (c, fh, fw).  The dense form
+    returns [N, OH·OW, C·fh·fw] (the LoD over images is the leading dim);
+    the ragged ``input_image_size``/``out_stride`` branch is not
+    supported — pad upstream."""
+    if input_image_size is not None:
+        raise InvalidArgumentError(
+            "im2sequence: per-image sizes are LoD machinery; pad upstream")
+    x = jnp.asarray(input)
+    fh, fw = ((filter_size, filter_size)
+              if isinstance(filter_size, int) else tuple(filter_size))
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    if isinstance(padding, int):
+        pu = pd_ = pl = pr = padding
+    elif len(padding) == 2:
+        pu, pl = padding
+        pd_, pr = padding
+    else:
+        pu, pl, pd_, pr = padding
+    N, C, H, W = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pu, pd_), (pl, pr)))
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, (fh, fw), (sh, sw), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # feature dim is C*fh*fw channel-major — exactly kOCF's column order
+    Np, F, OH, OW = patches.shape
+    return patches.reshape(N, F, OH * OW).transpose(0, 2, 1)

@@ -1,0 +1,183 @@
+"""Promoted 1.x long-tail ops vs transcribed kernel oracles.
+
+References: add_position_encoding_op.h, bpr_loss_op.h, rank_loss_op.h,
+margin_rank_loss_op.h, shuffle_channel_op.h, space_to_depth_op.h:41,
+fsp_op.h, cvm_op.h, sampling_id_op.h, im2sequence_op.h.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+
+class TestAddPositionEncoding:
+    def test_vs_oracle(self):
+        rng = np.random.RandomState(0)
+        N, S, E = 2, 5, 8
+        x = rng.rand(N, S, E).astype(np.float32)
+        alpha, beta = 0.7, 1.3
+        out = np.asarray(F.add_position_encoding(x, alpha, beta))
+        half = E // 2
+        want = np.empty_like(x)
+        for n in range(N):
+            for j in range(S):
+                for k in range(half):
+                    val = j / (10000.0 ** (k / (half - 1))) if half > 1 \
+                        else j / 10000.0
+                    want[n, j, k] = x[n, j, k] * alpha + np.sin(val) * beta
+                    want[n, j, half + k] = \
+                        x[n, j, half + k] * alpha + np.cos(val) * beta
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+class TestRankingLosses:
+    def test_bpr_vs_oracle(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(4, 6).astype(np.float32)
+        y = rng.randint(0, 6, (4, 1)).astype(np.int64)
+        out = np.asarray(F.bpr_loss(x, y)).ravel()
+        for i in range(4):
+            s = 0.0
+            for j in range(6):
+                if j == y[i, 0]:
+                    continue
+                s += -np.log(1.0 + np.exp(x[i, j] - x[i, y[i, 0]]))
+            np.testing.assert_allclose(out[i], -s / 5, rtol=1e-5)
+
+    def test_rank_loss(self):
+        lbl = np.array([1.0, 0.0], np.float32)
+        l = np.array([0.5, -0.2], np.float32)
+        r = np.array([0.1, 0.3], np.float32)
+        out = np.asarray(F.rank_loss(lbl, l, r))
+        want = np.log(1 + np.exp(l - r)) - lbl * (l - r)
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+    def test_margin_rank_loss(self):
+        out = np.asarray(F.margin_rank_loss(
+            np.array([1.0, -1.0], np.float32),
+            np.array([0.5, 0.5], np.float32),
+            np.array([0.1, 0.1], np.float32), margin=0.2))
+        np.testing.assert_allclose(out, [0.0, 0.6], rtol=1e-6, atol=1e-7)
+
+
+class TestChannelRearrange:
+    def test_shuffle_channel(self):
+        x = np.arange(1 * 6 * 2 * 2, dtype=np.float32).reshape(1, 6, 2, 2)
+        out = np.asarray(F.shuffle_channel(x, 2))
+        # (g=2, n=3) → (n=3, g=2): channels 0,3,1,4,2,5
+        np.testing.assert_array_equal(out[0, :, 0, 0],
+                                      x[0, [0, 3, 1, 4, 2, 5], 0, 0])
+
+    def test_space_to_depth_vs_index_oracle(self):
+        # transcribes space_to_depth_op.h:41 index math
+        rng = np.random.RandomState(2)
+        N, C, H, W, bs = 2, 3, 4, 6, 2
+        x = rng.rand(N, C, H, W).astype(np.float32)
+        out = np.asarray(F.space_to_depth(x, bs))
+        assert out.shape == (N, C * bs * bs, H // bs, W // bs)
+        oc, oh, ow = C * bs * bs, H // bs, W // bs
+        for b in range(N):
+            for k in range(oc):
+                for j in range(oh):
+                    for i in range(ow):
+                        c2 = k % C
+                        off = k // C
+                        h2 = j * bs + off // bs
+                        w2 = i * bs + off % bs
+                        np.testing.assert_allclose(out[b, k, j, i],
+                                                   x[b, c2, h2, w2])
+
+    def test_space_to_depth_validates(self):
+        with pytest.raises(Exception):
+            F.space_to_depth(np.zeros((1, 1, 3, 4), np.float32), 2)
+
+
+class TestFspCvm:
+    def test_fsp_matrix(self):
+        rng = np.random.RandomState(3)
+        x = rng.rand(2, 3, 4, 5).astype(np.float32)
+        y = rng.rand(2, 2, 4, 5).astype(np.float32)
+        out = np.asarray(F.fsp_matrix(x, y))
+        want = np.einsum("nihw,njhw->nij", x, y) / 20.0
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    def test_cvm(self):
+        x = np.array([[3.0, 1.0, 0.5, 0.6]], np.float32)
+        out = np.asarray(F.continuous_value_model(x, None, use_cvm=True))
+        np.testing.assert_allclose(
+            out[0], [np.log(4.0), np.log(2.0) - np.log(4.0), 0.5, 0.6],
+            rtol=1e-6)
+        out2 = np.asarray(F.continuous_value_model(x, None, use_cvm=False))
+        np.testing.assert_allclose(out2[0], [0.5, 0.6])
+
+
+class TestSamplingAndFills:
+    def test_sampling_id_degenerate_rows(self):
+        # a one-hot probability row must always sample its hot index
+        probs = np.eye(4, dtype=np.float32)
+        out = np.asarray(F.sampling_id(probs, seed=7))
+        np.testing.assert_array_equal(out, [0, 1, 2, 3])
+
+    def test_fill_like(self):
+        ref = np.zeros((5, 3), np.float32)
+        out = F.fill_constant_batch_size_like(ref, [1, 4], "float32", 2.5)
+        assert out.shape == (5, 4)
+        assert float(jnp.max(jnp.abs(out - 2.5))) == 0
+        u = F.uniform_random_batch_size_like(ref, [1, 2], seed=3)
+        g = F.gaussian_random_batch_size_like(ref, [1, 2], seed=3)
+        assert u.shape == (5, 2) and g.shape == (5, 2)
+
+
+class TestAdaptiveAndMisc:
+    def test_adaptive_pool2d(self):
+        rng = np.random.RandomState(4)
+        x = rng.rand(1, 2, 6, 6).astype(np.float32)
+        a = np.asarray(F.adaptive_pool2d(x, 3, "avg"))
+        b = np.asarray(F.adaptive_avg_pool2d(x, 3))
+        np.testing.assert_allclose(a, b)
+        m = np.asarray(F.adaptive_pool2d(x, 3, "max"))
+        np.testing.assert_allclose(m, np.asarray(F.adaptive_max_pool2d(x, 3)))
+        with pytest.raises(Exception):
+            F.adaptive_pool2d(x, 3, "sum")
+
+    def test_affine_channel(self):
+        rng = np.random.RandomState(5)
+        x = rng.rand(2, 3, 2, 2).astype(np.float32)
+        s = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([0.1, 0.2, 0.3], np.float32)
+        out = np.asarray(F.affine_channel(x, s, b))
+        want = x * s.reshape(1, 3, 1, 1) + b.reshape(1, 3, 1, 1)
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+    def test_lrn_matches_functional(self):
+        rng = np.random.RandomState(6)
+        x = rng.rand(1, 8, 4, 4).astype(np.float32)
+        from paddle_tpu.nn.functional.norm import local_response_norm
+
+        np.testing.assert_allclose(
+            np.asarray(F.lrn(x, n=5, k=2.0, alpha=1e-3)),
+            np.asarray(local_response_norm(x, size=5, alpha=1e-3, k=2.0)))
+
+    def test_im2sequence_vs_slices(self):
+        rng = np.random.RandomState(7)
+        x = rng.rand(1, 2, 5, 5).astype(np.float32)
+        out = np.asarray(F.im2sequence(x, filter_size=2, stride=1))
+        assert out.shape == (1, 16, 8)
+        # row (oh, ow) column order channel-major (c, fh, fw)
+        for oh in range(4):
+            for ow in range(4):
+                patch = x[0, :, oh:oh + 2, ow:ow + 2].reshape(-1)
+                np.testing.assert_allclose(out[0, oh * 4 + ow], patch,
+                                           rtol=1e-6)
+
+
+def test_fluid_resolution():
+    from paddle_tpu.fluid import layers as fl
+
+    for n in ("bpr_loss", "space_to_depth", "fsp_matrix", "im2sequence",
+              "add_position_encoding", "sampling_id"):
+        assert getattr(fl, n) is getattr(F, n)
