@@ -52,6 +52,8 @@ class Model:
     """
 
     def __init__(self, network: Layer, inputs=None, labels=None):
+        self._steps_per_execution = 1
+        self._multi_train_step = None
         from ..static import InputSpec
 
         self.network = network
@@ -82,9 +84,18 @@ class Model:
 
     # -- setup ---------------------------------------------------------------
     def prepare(self, optimizer: Optional[Optimizer] = None, loss=None,
-                metrics: Optional[Sequence[Metric]] = None, amp_configs=None):
+                metrics: Optional[Sequence[Metric]] = None, amp_configs=None,
+                steps_per_execution: int = 1):
         if loss is not None and not (isinstance(loss, Layer) or callable(loss)):
             raise InvalidArgumentError("loss must be a Layer or callable")
+        steps_per_execution = int(steps_per_execution)
+        if steps_per_execution < 1:
+            raise InvalidArgumentError("steps_per_execution must be >= 1")
+        if steps_per_execution > 1 and metrics:
+            raise InvalidArgumentError(
+                "steps_per_execution > 1 cannot update host-side metrics "
+                "per inner step; drop metrics or keep it at 1")
+        self._steps_per_execution = steps_per_execution
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = list(metrics or [])
@@ -250,11 +261,43 @@ class Model:
 
         if optimizer is not None:
             if self._plan is not None:
+                if self._steps_per_execution > 1:
+                    raise InvalidArgumentError(
+                        "steps_per_execution > 1 does not yet compose with "
+                        "fleet strategies (the plan wraps the single-step "
+                        "executable); run with the default strategy")
                 self._train_step = self._plan.jit_train_step(train_step)
             else:
                 # donate old params/opt_state/buffers: the update happens
                 # in-place in device memory
                 self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+            if self._steps_per_execution > 1:
+                k = self._steps_per_execution
+
+                # one dispatch runs k train steps under lax.scan — the
+                # Keras steps_per_execution idea, which matters doubly on
+                # TPU where a ~50ms step can be dominated by host dispatch
+                # (the LR is read once per execution; schedulers advance
+                # between executions, as in Keras)
+                def multi_step(params, opt_state, buffers, key, lr,
+                               *stacked):
+                    keys = jax.random.split(key, k)
+
+                    def body(carry, xs):
+                        p, s, b = carry
+                        key_t = xs[0]
+                        batch = xs[1:]
+                        loss_t, _, p, s, b = train_step(p, s, b, key_t, lr,
+                                                        *batch)
+                        return (p, s, b), loss_t
+
+                    (params, opt_state, buffers), losses = jax.lax.scan(
+                        body, (params, opt_state, buffers),
+                        (keys,) + stacked)
+                    return losses, params, opt_state, buffers
+
+                self._multi_train_step = jax.jit(
+                    multi_step, donate_argnums=(0, 1, 2))
         self._eval_step = jax.jit(eval_step)
         self._predict_step = jax.jit(predict_step)
         self._opt_state = None
@@ -287,6 +330,31 @@ class Model:
                     self._optimizer, params, buffers)
             else:
                 self._opt_state = self._optimizer.init(params)
+
+    def _train_batches_device(self, batches):
+        """Run len(batches) == steps_per_execution train steps in ONE
+        dispatch; returns the per-step loss vector (device array)."""
+        from ..distributed.heartbeat import maybe_beat
+
+        maybe_beat()
+        stacked = tuple(
+            jnp.stack([jnp.asarray(b[i]) for b in batches])
+            for i in range(len(batches[0])))
+        params, buffers = self._pull_state()
+        self._ensure_opt_state(params, buffers)
+        key = _random.default_generator().next_key()
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        losses, params, self._opt_state, buffers = self._multi_train_step(
+            params, self._opt_state, buffers, key, lr, *stacked)
+        self._push_state(params, buffers)
+        from ..framework import monitor as _monitor
+
+        _monitor.stat_add("total_train_steps", len(batches))
+        if _flag("check_nan_inf"):
+            self._check_nan_inf(losses, params, buffers)
+        if _flag("benchmark"):
+            jax.block_until_ready(losses)
+        return losses
 
     # -- batch-level API -----------------------------------------------------
     def train_batch(self, inputs, labels=None):
@@ -437,6 +505,11 @@ class Model:
             steps = len(train_loader)
         except TypeError:
             steps = None
+        if steps is not None and self._steps_per_execution > 1:
+            # the loop below fires callbacks once per EXECUTION (a full
+            # group of spe steps, or a single tail step)
+            full, rem = divmod(steps, self._steps_per_execution)
+            steps = full + rem
         cbks = _callbacks_mod.config_callbacks(
             callbacks, model=self, epochs=epochs, steps=steps,
             log_freq=log_freq, verbose=verbose, save_freq=save_freq,
@@ -449,8 +522,43 @@ class Model:
             for m in self._metrics:
                 m.reset()
             logs: Dict[str, Any] = {}
-            for step, batch in enumerate(train_loader):
+            spe = self._steps_per_execution
+
+            def _grouped(loader):
+                """steps_per_execution batching: yield ("multi", [k
+                batches]) for full UNIFORM groups, ("single", batch) for
+                ragged tails — both a short group at epoch end and a
+                smaller final batch (drop_last=False) that would break
+                jnp.stack (and everything when spe == 1)."""
+                pending = []
+                for b in loader:
+                    if spe == 1:
+                        yield "single", b
+                        continue
+                    b = _tuplize(b)
+                    if pending and (np.asarray(b[0]).shape[0]
+                                    != np.asarray(pending[0][0]).shape[0]):
+                        for p in pending:  # flush, preserving step order
+                            yield "single", p
+                        pending = []
+                    pending.append(b)
+                    if len(pending) == spe:
+                        yield "multi", pending
+                        pending = []
+                for b in pending:
+                    yield "single", b
+
+            for step, (kind, batch) in enumerate(_grouped(train_loader)):
                 cbks.on_train_batch_begin(step)
+                if kind == "multi":
+                    losses = self._train_batches_device(batch)
+                    logs = {"loss": losses.mean(),
+                            "batch_size": sum(np.asarray(b[0]).shape[0]
+                                              for b in batch)}
+                    cbks.on_train_batch_end(step, logs)
+                    if self.stop_training:
+                        break
+                    continue
                 batch = _tuplize(batch)
                 n_in = (self._n_inputs if self._n_inputs is not None
                         else max(len(batch) - self._n_labels, 1))

@@ -328,3 +328,73 @@ class TestMultiOutputMetricLogs:
 
         assert isinstance(seen["acc_top1"], numbers.Number)
         assert isinstance(seen["acc_top2"], numbers.Number)
+
+
+class TestStepsPerExecution:
+    """Keras-style steps_per_execution: k train steps per dispatch
+    (lax.scan) — the host-RTT amortization that matters on TPU."""
+
+    def _data(self, n=24, seed=0):
+        rng = np.random.RandomState(seed)
+        x = rng.randn(n, 6).astype(np.float32)
+        w = rng.randn(6, 1).astype(np.float32)
+        return x, (x @ w + 0.1).astype(np.float32)
+
+    def _model(self, spe):
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 1))
+        m = paddle.Model(net, inputs=["x"], labels=["y"])
+        m.prepare(optimizer=popt.SGD(learning_rate=0.05), loss=nn.MSELoss(),
+                  steps_per_execution=spe)
+        return m
+
+    def test_trajectory_matches_single_step(self):
+        x, y = self._data()
+        batches = [(x[i:i + 8], y[i:i + 8]) for i in range(0, 24, 8)]
+
+        m1 = self._model(1)
+        for bx, by in batches:
+            m1.train_batch([bx], [by])
+
+        m3 = self._model(3)
+        losses = np.asarray(m3._train_batches_device(
+            [(bx, by) for bx, by in batches]))
+        assert losses.shape == (3,)
+        p1 = {k: np.asarray(v.value)
+              for k, v in m1.network.named_parameters()}
+        p3 = {k: np.asarray(v.value)
+              for k, v in m3.network.named_parameters()}
+        for k in p1:
+            np.testing.assert_allclose(p3[k], p1[k], rtol=1e-5, atol=1e-6,
+                                       err_msg=k)
+
+    def test_fit_with_ragged_tail(self):
+        x, y = self._data(n=56)  # 7 batches of 8: 2 full groups + 1 single
+        m = self._model(3)
+        before = float(np.mean((np.asarray(m.predict_batch([x])) - y) ** 2))
+        m.fit(paddle.io.TensorDataset([x, y]), batch_size=8, epochs=3,
+              verbose=0)
+        after = float(np.mean((np.asarray(m.predict_batch([x])) - y) ** 2))
+        assert after < before * 0.8, (before, after)
+
+    def test_partial_batch_inside_group(self):
+        # 44 samples / batch 8 → 8,8,8,8,8,4: the 4-sample batch must NOT
+        # be stacked into a full group (jnp.stack shape mismatch)
+        x, y = self._data(n=44)
+        m = self._model(3)
+        m.fit(paddle.io.TensorDataset([x, y]), batch_size=8, epochs=2,
+              verbose=0)
+        pred = np.asarray(m.predict_batch([x]))
+        assert np.isfinite(pred).all()
+
+    def test_validation(self):
+        net = nn.Linear(4, 1)
+        m = paddle.Model(net, inputs=["x"], labels=["y"])
+        with pytest.raises(Exception, match="steps_per_execution"):
+            m.prepare(optimizer=popt.SGD(learning_rate=0.1),
+                      loss=nn.MSELoss(), steps_per_execution=0)
+        with pytest.raises(Exception, match="metrics"):
+            m.prepare(optimizer=popt.SGD(learning_rate=0.1),
+                      loss=nn.MSELoss(),
+                      metrics=[paddle.metric.Accuracy()],
+                      steps_per_execution=2)
