@@ -334,6 +334,10 @@ class Model:
     def _train_batches_device(self, batches):
         """Run len(batches) == steps_per_execution train steps in ONE
         dispatch; returns the per-step loss vector (device array)."""
+        if self._multi_train_step is None:
+            raise InvalidArgumentError(
+                "call prepare(optimizer=..., loss=..., "
+                "steps_per_execution=k) first")
         from ..distributed.heartbeat import maybe_beat
 
         maybe_beat()
@@ -505,11 +509,12 @@ class Model:
             steps = len(train_loader)
         except TypeError:
             steps = None
-        if steps is not None and self._steps_per_execution > 1:
-            # the loop below fires callbacks once per EXECUTION (a full
-            # group of spe steps, or a single tail step)
-            full, rem = divmod(steps, self._steps_per_execution)
-            steps = full + rem
+        if self._steps_per_execution > 1:
+            # the loop below fires callbacks once per EXECUTION, and the
+            # exact execution count depends on batch-size raggedness the
+            # loader only reveals while iterating — report unknown length
+            # rather than a wrong total
+            steps = None
         cbks = _callbacks_mod.config_callbacks(
             callbacks, model=self, epochs=epochs, steps=steps,
             log_freq=log_freq, verbose=verbose, save_freq=save_freq,
@@ -531,16 +536,18 @@ class Model:
                 smaller final batch (drop_last=False) that would break
                 jnp.stack (and everything when spe == 1)."""
                 pending = []
+                group_bs = None
                 for b in loader:
                     if spe == 1:
                         yield "single", b
                         continue
                     b = _tuplize(b)
-                    if pending and (np.asarray(b[0]).shape[0]
-                                    != np.asarray(pending[0][0]).shape[0]):
+                    if pending and np.shape(b[0])[0] != group_bs:
                         for p in pending:  # flush, preserving step order
                             yield "single", p
                         pending = []
+                    if not pending:
+                        group_bs = np.shape(b[0])[0]
                     pending.append(b)
                     if len(pending) == spe:
                         yield "multi", pending
@@ -553,7 +560,7 @@ class Model:
                 if kind == "multi":
                     losses = self._train_batches_device(batch)
                     logs = {"loss": losses.mean(),
-                            "batch_size": sum(np.asarray(b[0]).shape[0]
+                            "batch_size": sum(np.shape(b[0])[0]
                                               for b in batch)}
                     cbks.on_train_batch_end(step, logs)
                     if self.stop_training:

@@ -12,12 +12,14 @@ __all__ = ["MobileNetV1", "mobilenet_v1"]
 
 class ConvBNLayer(nn.Layer):
     def __init__(self, in_channels, out_channels, kernel_size, stride,
-                 padding, num_groups=1):
+                 padding, num_groups=1, data_format="NCHW"):
         super().__init__()
         self._conv = nn.Conv2D(in_channels, out_channels, kernel_size,
                                stride=stride, padding=padding,
-                               groups=num_groups, bias_attr=False)
-        self._norm_layer = nn.BatchNorm2D(out_channels)
+                               groups=num_groups, bias_attr=False,
+                               data_format=data_format)
+        self._norm_layer = nn.BatchNorm2D(out_channels,
+                                          data_format=data_format)
         self._act = nn.ReLU()
 
     def forward(self, x):
@@ -26,27 +28,30 @@ class ConvBNLayer(nn.Layer):
 
 class DepthwiseSeparable(nn.Layer):
     def __init__(self, in_channels, out_channels1, out_channels2, num_groups,
-                 stride, scale):
+                 stride, scale, data_format="NCHW"):
         super().__init__()
         self._depthwise_conv = ConvBNLayer(
             in_channels, int(out_channels1 * scale), 3, stride=stride,
-            padding=1, num_groups=int(num_groups * scale))
+            padding=1, num_groups=int(num_groups * scale),
+            data_format=data_format)
         self._pointwise_conv = ConvBNLayer(
             int(out_channels1 * scale), int(out_channels2 * scale), 1,
-            stride=1, padding=0)
+            stride=1, padding=0, data_format=data_format)
 
     def forward(self, x):
         return self._pointwise_conv(self._depthwise_conv(x))
 
 
 class MobileNetV1(nn.Layer):
-    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True,
+                 data_format="NCHW"):
         super().__init__()
         self.scale = scale
         self.num_classes = num_classes
         self.with_pool = with_pool
 
-        self.conv1 = ConvBNLayer(3, int(32 * scale), 3, stride=2, padding=1)
+        self.conv1 = ConvBNLayer(3, int(32 * scale), 3, stride=2, padding=1,
+                                 data_format=data_format)
 
         # (in, out1, out2, groups, stride) per depthwise-separable stage
         cfg = [
@@ -67,11 +72,13 @@ class MobileNetV1(nn.Layer):
         blocks = []
         for in_c, out1, out2, groups, stride in cfg:
             blocks.append(DepthwiseSeparable(
-                int(in_c * scale), out1, out2, groups, stride, scale))
+                int(in_c * scale), out1, out2, groups, stride, scale,
+                data_format=data_format))
         self.dwsl = nn.LayerList(blocks)
 
         if with_pool:
-            self.pool2d_avg = nn.AdaptiveAvgPool2D((1, 1))
+            self.pool2d_avg = nn.AdaptiveAvgPool2D((1, 1),
+                                                   data_format=data_format)
         if num_classes > 0:
             self.fc = nn.Linear(int(1024 * scale), num_classes)
 

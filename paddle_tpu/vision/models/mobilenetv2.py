@@ -1,5 +1,8 @@
-"""MobileNetV2 (parity: python/paddle/vision/models/mobilenetv2.py:104)."""
+"""MobileNetV2 (parity: python/paddle/vision/models/mobilenetv2.py:104).
+``data_format="NHWC"`` runs the TPU-preferred layout (same state_dict)."""
 from __future__ import annotations
+
+import functools
 
 from ... import nn
 
@@ -17,11 +20,12 @@ def _make_divisible(v, divisor=8, min_value=None):
 
 class ConvBNReLU(nn.Sequential):
     def __init__(self, in_planes, out_planes, kernel_size=3, stride=1,
-                 groups=1, norm_layer=nn.BatchNorm2D):
+                 groups=1, norm_layer=nn.BatchNorm2D, data_format="NCHW"):
         padding = (kernel_size - 1) // 2
         super().__init__(
             nn.Conv2D(in_planes, out_planes, kernel_size, stride=stride,
-                      padding=padding, groups=groups, bias_attr=False),
+                      padding=padding, groups=groups, bias_attr=False,
+                      data_format=data_format),
             norm_layer(out_planes),
             nn.ReLU6(),
         )
@@ -29,7 +33,7 @@ class ConvBNReLU(nn.Sequential):
 
 class InvertedResidual(nn.Layer):
     def __init__(self, inp, oup, stride, expand_ratio,
-                 norm_layer=nn.BatchNorm2D):
+                 norm_layer=nn.BatchNorm2D, data_format="NCHW"):
         super().__init__()
         self.stride = stride
         assert stride in (1, 2)
@@ -39,11 +43,14 @@ class InvertedResidual(nn.Layer):
         layers = []
         if expand_ratio != 1:
             layers.append(ConvBNReLU(inp, hidden_dim, kernel_size=1,
-                                     norm_layer=norm_layer))
+                                     norm_layer=norm_layer,
+                                     data_format=data_format))
         layers.extend([
             ConvBNReLU(hidden_dim, hidden_dim, stride=stride,
-                       groups=hidden_dim, norm_layer=norm_layer),
-            nn.Conv2D(hidden_dim, oup, 1, bias_attr=False),
+                       groups=hidden_dim, norm_layer=norm_layer,
+                       data_format=data_format),
+            nn.Conv2D(hidden_dim, oup, 1, bias_attr=False,
+                      data_format=data_format),
             norm_layer(oup),
         ])
         self.conv = nn.Sequential(*layers)
@@ -55,13 +62,15 @@ class InvertedResidual(nn.Layer):
 
 
 class MobileNetV2(nn.Layer):
-    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True,
+                 data_format="NCHW"):
         super().__init__()
         self.num_classes = num_classes
         self.with_pool = with_pool
         input_channel = 32
         last_channel = 1280
-        norm_layer = nn.BatchNorm2D
+        norm_layer = functools.partial(nn.BatchNorm2D,
+                                       data_format=data_format)
 
         # t (expand), c (channels), n (repeats), s (stride)
         inverted_residual_setting = [
@@ -77,21 +86,24 @@ class MobileNetV2(nn.Layer):
         input_channel = _make_divisible(input_channel * scale)
         self.last_channel = _make_divisible(last_channel * max(1.0, scale))
         features = [ConvBNReLU(3, input_channel, stride=2,
-                               norm_layer=norm_layer)]
+                               norm_layer=norm_layer,
+                               data_format=data_format)]
         for t, c, n, s in inverted_residual_setting:
             output_channel = _make_divisible(c * scale)
             for i in range(n):
                 stride = s if i == 0 else 1
                 features.append(InvertedResidual(
                     input_channel, output_channel, stride, expand_ratio=t,
-                    norm_layer=norm_layer))
+                    norm_layer=norm_layer, data_format=data_format))
                 input_channel = output_channel
         features.append(ConvBNReLU(input_channel, self.last_channel,
-                                   kernel_size=1, norm_layer=norm_layer))
+                                   kernel_size=1, norm_layer=norm_layer,
+                                   data_format=data_format))
         self.features = nn.Sequential(*features)
 
         if with_pool:
-            self.pool2d_avg = nn.AdaptiveAvgPool2D((1, 1))
+            self.pool2d_avg = nn.AdaptiveAvgPool2D((1, 1),
+                                                   data_format=data_format)
         if num_classes > 0:
             self.classifier = nn.Sequential(
                 nn.Dropout(0.2),

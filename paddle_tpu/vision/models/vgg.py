@@ -1,5 +1,10 @@
-"""VGG family (parity: python/paddle/vision/models/vgg.py:34-199)."""
+"""VGG family (parity: python/paddle/vision/models/vgg.py:34-199).
+``data_format="NHWC"`` runs the TPU-preferred layout with the same
+state_dict (the classifier sees NCHW-ordered features via one transpose
+before flatten)."""
 from __future__ import annotations
+
+import jax.numpy as jnp
 
 from ... import nn
 
@@ -9,10 +14,11 @@ __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19"]
 class VGG(nn.Layer):
     """``features`` is the conv trunk built by :func:`make_layers`."""
 
-    def __init__(self, features, num_classes=1000):
+    def __init__(self, features, num_classes=1000, data_format="NCHW"):
         super().__init__()
         self.features = features
         self.num_classes = num_classes
+        self.data_format = data_format
         if num_classes > 0:
             self.classifier = nn.Sequential(
                 nn.Linear(512 * 7 * 7, 4096),
@@ -27,21 +33,27 @@ class VGG(nn.Layer):
     def forward(self, x):
         x = self.features(x)
         if self.num_classes > 0:
+            if self.data_format == "NHWC":
+                # classifier weights are NCHW-flat: one cheap transpose
+                # keeps state_dicts layout-portable
+                x = jnp.transpose(jnp.asarray(x), (0, 3, 1, 2))
             x = x.reshape(x.shape[0], -1)
             x = self.classifier(x)
         return x
 
 
-def make_layers(cfg, batch_norm=False):
+def make_layers(cfg, batch_norm=False, data_format="NCHW"):
     layers = []
     in_channels = 3
     for v in cfg:
         if v == "M":
-            layers.append(nn.MaxPool2D(kernel_size=2, stride=2))
+            layers.append(nn.MaxPool2D(kernel_size=2, stride=2,
+                                       data_format=data_format))
         else:
-            layers.append(nn.Conv2D(in_channels, v, 3, padding=1))
+            layers.append(nn.Conv2D(in_channels, v, 3, padding=1,
+                                    data_format=data_format))
             if batch_norm:
-                layers.append(nn.BatchNorm2D(v))
+                layers.append(nn.BatchNorm2D(v, data_format=data_format))
             layers.append(nn.ReLU())
             in_channels = v
     return nn.Sequential(*layers)
@@ -58,7 +70,9 @@ _cfgs = {
 
 
 def _vgg(arch, cfg, batch_norm, pretrained, **kwargs):
-    model = VGG(make_layers(_cfgs[cfg], batch_norm=batch_norm), **kwargs)
+    df = kwargs.get("data_format", "NCHW")
+    model = VGG(make_layers(_cfgs[cfg], batch_norm=batch_norm,
+                            data_format=df), **kwargs)
     if pretrained:
         from ...framework import serialization
 

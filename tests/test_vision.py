@@ -36,6 +36,26 @@ def test_resnet_nhwc_matches_nchw():
     assert np.abs(o1 - o2).max() < 2e-4
 
 
+@pytest.mark.parametrize("mk,shape", [
+    (lambda df: M.LeNet(data_format=df), (2, 1, 28, 28)),
+    (lambda df: M.MobileNetV1(num_classes=5, data_format=df), (1, 3, 64, 64)),
+    (lambda df: M.MobileNetV2(num_classes=5, data_format=df), (1, 3, 64, 64)),
+    (lambda df: M.vgg11(batch_norm=True, num_classes=5, data_format=df),
+     (1, 3, 224, 224)),
+])
+def test_model_zoo_nhwc_matches_nchw(mk, shape):
+    # every zoo model runs the TPU-preferred layout off the SAME state_dict
+    paddle.seed(0)
+    m1 = mk("NCHW")
+    m2 = mk("NHWC")
+    m2.set_state_dict(m1.state_dict())
+    m1.eval(); m2.eval()
+    x = np.random.RandomState(0).uniform(-1, 1, shape).astype(np.float32)
+    o1 = np.asarray(m1(paddle.to_tensor(x)))
+    o2 = np.asarray(m2(paddle.to_tensor(x.transpose(0, 2, 3, 1))))
+    assert np.abs(o1 - o2).max() < 5e-4
+
+
 def test_lenet_forward():
     net = M.LeNet()
     out = net(np.zeros((2, 1, 28, 28), np.float32))
