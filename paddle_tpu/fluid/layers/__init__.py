@@ -600,14 +600,6 @@ _STATIC_ONLY = {
     "Send": "XLA collectives (paddle.distributed)",
     "Recv": "XLA collectives (paddle.distributed)",
     # lr schedules (Program-variable based in 1.x)
-    "exponential_decay": "paddle.optimizer.lr.ExponentialDecay",
-    "natural_exp_decay": "paddle.optimizer.lr.NaturalExpDecay",
-    "inverse_time_decay": "paddle.optimizer.lr.InverseTimeDecay",
-    "polynomial_decay": "paddle.optimizer.lr.PolynomialDecay",
-    "piecewise_decay": "paddle.optimizer.lr.PiecewiseDecay",
-    "noam_decay": "paddle.optimizer.lr.NoamDecay",
-    "cosine_decay": "paddle.optimizer.lr.CosineAnnealingDecay",
-    "linear_lr_warmup": "paddle.optimizer.lr.LinearWarmup",
     # io readers
     "data": "paddle.static.data (InputSpec) + paddle.io.DataLoader",
     "read_file": "paddle.io.DataLoader", "double_buffer":
@@ -657,3 +649,97 @@ def __getattr__(name):
             return getattr(ns, name)
     raise AttributeError(
         f"module 'paddle_tpu.fluid.layers' has no attribute {name!r}")
+
+
+# --- 1.x learning-rate decay functions (learning_rate_scheduler.py) ---------
+# The 1.x functions built a decayed-lr Variable into the Program; eager
+# equivalents return the matching paddle.optimizer.lr scheduler with the
+# EXACT 1.x per-step formula — pass the result as ``learning_rate`` to any
+# optimizer and step() it once per optimizer step (the 1.x global_step).
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    """Transformer Noam schedule (learning_rate_scheduler.py:53)."""
+    from paddle_tpu.optimizer import lr as _lr
+
+    return _lr.NoamDecay(d_model, warmup_steps, learning_rate)
+
+
+def _step_lambda(decay_steps, staircase, fn):
+    import math as _math
+
+    def lam(step):
+        d = step / decay_steps
+        if staircase:
+            d = _math.floor(d)
+        return fn(d)
+
+    return lam
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr · rate^(step/decay_steps) (learning_rate_scheduler.py:113)."""
+    from paddle_tpu.optimizer import lr as _lr
+
+    return _lr.LambdaDecay(learning_rate, _step_lambda(
+        decay_steps, staircase, lambda d: decay_rate ** d))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr · e^(−rate·step/decay_steps) (learning_rate_scheduler.py:174)."""
+    import math as _math
+
+    from paddle_tpu.optimizer import lr as _lr
+
+    return _lr.LambdaDecay(learning_rate, _step_lambda(
+        decay_steps, staircase, lambda d: _math.exp(-decay_rate * d)))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    """lr / (1 + rate·step/decay_steps) (learning_rate_scheduler.py:235)."""
+    from paddle_tpu.optimizer import lr as _lr
+
+    return _lr.LambdaDecay(learning_rate, _step_lambda(
+        decay_steps, staircase, lambda d: 1.0 / (1.0 + decay_rate * d)))
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    """(learning_rate_scheduler.py:296) — the 2.0 scheduler shares the
+    formula exactly."""
+    from paddle_tpu.optimizer import lr as _lr
+
+    return _lr.PolynomialDecay(learning_rate, decay_steps,
+                               end_lr=end_learning_rate, power=power,
+                               cycle=cycle)
+
+
+def piecewise_decay(boundaries, values):
+    """(learning_rate_scheduler.py:364)."""
+    from paddle_tpu.optimizer import lr as _lr
+
+    return _lr.PiecewiseDecay(boundaries, values)
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    """lr · ½(cos(epoch·π/epochs) + 1) with epoch = ⌊step/step_each_epoch⌋
+    (learning_rate_scheduler.py:442)."""
+    import math as _math
+
+    from paddle_tpu.optimizer import lr as _lr
+
+    def lam(step):
+        epoch = _math.floor(step / step_each_epoch)
+        return 0.5 * (_math.cos(epoch * _math.pi / epochs) + 1)
+
+    return _lr.LambdaDecay(learning_rate, lam)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    """(learning_rate_scheduler.py:488) — ``learning_rate`` may be a float
+    or another scheduler, as in 1.x."""
+    from paddle_tpu.optimizer import lr as _lr
+
+    return _lr.LinearWarmup(learning_rate, warmup_steps, start_lr, end_lr)

@@ -422,3 +422,73 @@ class TestFluidRoot:
         assert list(r()) == [1, 2, 3]
         with pytest.raises(UnimplementedError):
             fluid.io.save_persistables(None, "/tmp/x")
+
+
+class TestLrDecayFunctions:
+    """1.x fluid.layers lr decays return 2.0 schedulers with the exact
+    1.x per-step formulas (ref: fluid/layers/learning_rate_scheduler.py)."""
+
+    def _trace(self, sched, steps):
+        vals = []
+        for _ in range(steps):
+            vals.append(float(sched()))
+            sched.step()
+        return np.asarray(vals)
+
+    def test_exponential_decay(self):
+        from paddle_tpu.fluid import layers as fl
+
+        s = fl.exponential_decay(0.1, decay_steps=4, decay_rate=0.5)
+        got = self._trace(s, 9)
+        want = 0.1 * 0.5 ** (np.arange(9) / 4)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        s2 = fl.exponential_decay(0.1, 4, 0.5, staircase=True)
+        got2 = self._trace(s2, 9)
+        want2 = 0.1 * 0.5 ** np.floor(np.arange(9) / 4)
+        np.testing.assert_allclose(got2, want2, rtol=1e-6)
+
+    def test_natural_exp_and_inverse_time(self):
+        from paddle_tpu.fluid import layers as fl
+
+        g1 = self._trace(fl.natural_exp_decay(1.0, 2, 0.5), 5)
+        np.testing.assert_allclose(g1, np.exp(-0.5 * np.arange(5) / 2),
+                                   rtol=1e-6)
+        g2 = self._trace(fl.inverse_time_decay(1.0, 2, 0.5), 5)
+        np.testing.assert_allclose(g2, 1 / (1 + 0.5 * np.arange(5) / 2),
+                                   rtol=1e-6)
+
+    def test_cosine_decay(self):
+        from paddle_tpu.fluid import layers as fl
+
+        got = self._trace(fl.cosine_decay(2.0, step_each_epoch=3, epochs=4),
+                          12)
+        want = 2.0 * 0.5 * (np.cos(np.floor(np.arange(12) / 3)
+                                   * np.pi / 4) + 1)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_piecewise_noam_warmup_poly_resolve(self):
+        from paddle_tpu.fluid import layers as fl
+        from paddle_tpu.optimizer import lr as plr
+
+        assert isinstance(fl.piecewise_decay([2, 4], [1.0, 0.5, 0.1]),
+                          plr.PiecewiseDecay)
+        assert isinstance(fl.noam_decay(512, 4000), plr.NoamDecay)
+        assert isinstance(fl.linear_lr_warmup(0.1, 10, 0.0, 0.1),
+                          plr.LinearWarmup)
+        assert isinstance(fl.polynomial_decay(0.1, 100), plr.PolynomialDecay)
+
+    def test_usable_as_optimizer_lr(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, optimizer as popt
+        from paddle_tpu.fluid import layers as fl
+
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        sched = fl.exponential_decay(0.1, 2, 0.5)
+        m = paddle.Model(net, inputs=["x"], labels=["y"])
+        m.prepare(optimizer=popt.SGD(learning_rate=sched),
+                  loss=nn.MSELoss())
+        x = np.zeros((4, 4), np.float32)
+        y = np.zeros((4, 1), np.float32)
+        loss, _ = m.train_batch([x], [y])
+        assert np.isfinite(loss)
