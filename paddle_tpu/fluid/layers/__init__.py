@@ -599,7 +599,6 @@ _STATIC_ONLY = {
     # PS / distributed-specific
     "Send": "XLA collectives (paddle.distributed)",
     "Recv": "XLA collectives (paddle.distributed)",
-    # lr schedules (Program-variable based in 1.x)
     # io readers
     "data": "paddle.static.data (InputSpec) + paddle.io.DataLoader",
     "read_file": "paddle.io.DataLoader", "double_buffer":
@@ -678,9 +677,14 @@ def _step_lambda(decay_steps, staircase, fn):
 
 def exponential_decay(learning_rate, decay_steps, decay_rate,
                       staircase=False):
-    """lr · rate^(step/decay_steps) (learning_rate_scheduler.py:113)."""
+    """lr · rate^(step/decay_steps) (learning_rate_scheduler.py:113).
+    The continuous form maps onto the closed-form 2.0 scheduler (which
+    also supports jit-traced ``value_at``); staircase keeps a lambda."""
     from paddle_tpu.optimizer import lr as _lr
 
+    if not staircase:
+        return _lr.ExponentialDecay(learning_rate,
+                                    gamma=decay_rate ** (1.0 / decay_steps))
     return _lr.LambdaDecay(learning_rate, _step_lambda(
         decay_steps, staircase, lambda d: decay_rate ** d))
 
@@ -692,6 +696,9 @@ def natural_exp_decay(learning_rate, decay_steps, decay_rate,
 
     from paddle_tpu.optimizer import lr as _lr
 
+    if not staircase:
+        return _lr.NaturalExpDecay(learning_rate,
+                                   gamma=decay_rate / decay_steps)
     return _lr.LambdaDecay(learning_rate, _step_lambda(
         decay_steps, staircase, lambda d: _math.exp(-decay_rate * d)))
 
@@ -701,6 +708,9 @@ def inverse_time_decay(learning_rate, decay_steps, decay_rate,
     """lr / (1 + rate·step/decay_steps) (learning_rate_scheduler.py:235)."""
     from paddle_tpu.optimizer import lr as _lr
 
+    if not staircase:
+        return _lr.InverseTimeDecay(learning_rate,
+                                    gamma=decay_rate / decay_steps)
     return _lr.LambdaDecay(learning_rate, _step_lambda(
         decay_steps, staircase, lambda d: 1.0 / (1.0 + decay_rate * d)))
 
@@ -739,7 +749,32 @@ def cosine_decay(learning_rate, step_each_epoch, epochs):
 
 def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
     """(learning_rate_scheduler.py:488) — ``learning_rate`` may be a float
-    or another scheduler, as in 1.x."""
+    or another scheduler, as in 1.x.  1.x evaluated the inner decay on
+    the SHARED global_step counter, so a scheduler input gets a wrapper
+    that keeps the inner schedule on the global step (the 2.0
+    LinearWarmup starts the inner scheduler only after warmup)."""
     from paddle_tpu.optimizer import lr as _lr
 
-    return _lr.LinearWarmup(learning_rate, warmup_steps, start_lr, end_lr)
+    if not isinstance(learning_rate, _lr.LRScheduler):
+        return _lr.LinearWarmup(learning_rate, warmup_steps, start_lr,
+                                end_lr)
+
+    class _GlobalStepWarmup(_lr.LRScheduler):
+        def __init__(self, inner, warmup_steps, start_lr):
+            self.inner = inner
+            self.warmup_steps = warmup_steps
+            self.start_lr = start_lr
+            super().__init__(inner.base_lr, -1, False)
+
+        def get_lr(self):
+            # the inner decay runs on the global step, warmup or not
+            self.inner.last_epoch = self.last_epoch
+            decayed = self.inner.get_lr()
+            if self.last_epoch < self.warmup_steps:
+                return (decayed - self.start_lr) * self.last_epoch \
+                    / self.warmup_steps + self.start_lr
+            return decayed
+
+    # 1.x ramps from start_lr to the DECAYED lr (end_lr is the float-lr
+    # case's target); with a scheduler the ramp target follows the decay
+    return _GlobalStepWarmup(learning_rate, warmup_steps, start_lr)

@@ -477,6 +477,36 @@ class TestLrDecayFunctions:
                           plr.LinearWarmup)
         assert isinstance(fl.polynomial_decay(0.1, 100), plr.PolynomialDecay)
 
+    def test_value_at_functional_mode(self):
+        # continuous decays map to closed-form schedulers with value_at
+        import jax.numpy as jnp
+
+        from paddle_tpu.fluid import layers as fl
+
+        for sched, formula in [
+            (fl.exponential_decay(0.1, 4, 0.5),
+             lambda t: 0.1 * 0.5 ** (t / 4)),
+            (fl.natural_exp_decay(1.0, 2, 0.5),
+             lambda t: np.exp(-0.5 * t / 2)),
+            (fl.inverse_time_decay(1.0, 2, 0.5),
+             lambda t: 1 / (1 + 0.5 * t / 2)),
+        ]:
+            v = float(sched.value_at(jnp.asarray(6)))
+            np.testing.assert_allclose(v, formula(6.0), rtol=1e-6)
+
+    def test_warmup_inner_scheduler_on_global_step(self):
+        # 1.x semantics: the inner decay advances with the GLOBAL step,
+        # so right after warmup the lr reflects warmup_steps of decay
+        from paddle_tpu.fluid import layers as fl
+
+        inner = fl.exponential_decay(0.1, decay_steps=2, decay_rate=0.5)
+        s = fl.linear_lr_warmup(inner, warmup_steps=4, start_lr=0.0,
+                                end_lr=0.1)
+        vals = self._trace(s, 7)
+        # step 4 (first post-warmup): 0.1 * 0.5^(4/2) = 0.025, NOT 0.1
+        np.testing.assert_allclose(vals[4], 0.1 * 0.5 ** 2, rtol=1e-6)
+        np.testing.assert_allclose(vals[0], 0.0, atol=1e-9)
+
     def test_usable_as_optimizer_lr(self):
         import paddle_tpu as paddle
         from paddle_tpu import nn, optimizer as popt
