@@ -760,21 +760,39 @@ def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
                                 end_lr)
 
     class _GlobalStepWarmup(_lr.LRScheduler):
-        def __init__(self, inner, warmup_steps, start_lr):
+        """1.x semantics exactly: a LINEAR ramp start_lr → end_lr during
+        warmup (independent of the decay), then the inner decay evaluated
+        at the shared GLOBAL step."""
+
+        def __init__(self, inner, warmup_steps, start_lr, end_lr):
             self.inner = inner
             self.warmup_steps = warmup_steps
             self.start_lr = start_lr
+            self.end_lr = end_lr
             super().__init__(inner.base_lr, -1, False)
 
-        def get_lr(self):
-            # the inner decay runs on the global step, warmup or not
-            self.inner.last_epoch = self.last_epoch
-            decayed = self.inner.get_lr()
-            if self.last_epoch < self.warmup_steps:
-                return (decayed - self.start_lr) * self.last_epoch \
-                    / self.warmup_steps + self.start_lr
-            return decayed
+        def _inner_at(self, step):
+            # pure read of the inner schedule at an arbitrary step: the
+            # caller may still hold (and step) the inner scheduler
+            save = self.inner.last_epoch
+            try:
+                self.inner.last_epoch = step
+                return self.inner.get_lr()
+            finally:
+                self.inner.last_epoch = save
 
-    # 1.x ramps from start_lr to the DECAYED lr (end_lr is the float-lr
-    # case's target); with a scheduler the ramp target follows the decay
-    return _GlobalStepWarmup(learning_rate, warmup_steps, start_lr)
+        def get_lr(self):
+            if self.last_epoch < self.warmup_steps:
+                return (self.end_lr - self.start_lr) * self.last_epoch \
+                    / self.warmup_steps + self.start_lr
+            return self._inner_at(self.last_epoch)
+
+        def value_at(self, step):
+            import jax.numpy as _jnp
+
+            ramp = (self.end_lr - self.start_lr) * step \
+                / self.warmup_steps + self.start_lr
+            return _jnp.where(step < self.warmup_steps, ramp,
+                              self.inner.value_at(step))
+
+    return _GlobalStepWarmup(learning_rate, warmup_steps, start_lr, end_lr)

@@ -495,17 +495,28 @@ class TestLrDecayFunctions:
             np.testing.assert_allclose(v, formula(6.0), rtol=1e-6)
 
     def test_warmup_inner_scheduler_on_global_step(self):
-        # 1.x semantics: the inner decay advances with the GLOBAL step,
-        # so right after warmup the lr reflects warmup_steps of decay
+        # 1.x semantics: LINEAR ramp start->end during warmup, then the
+        # inner decay evaluated at the GLOBAL step
+        import jax.numpy as jnp
+
         from paddle_tpu.fluid import layers as fl
 
         inner = fl.exponential_decay(0.1, decay_steps=2, decay_rate=0.5)
         s = fl.linear_lr_warmup(inner, warmup_steps=4, start_lr=0.0,
                                 end_lr=0.1)
         vals = self._trace(s, 7)
+        np.testing.assert_allclose(vals[0], 0.0, atol=1e-9)
+        # mid-warmup: linear, NOT decay-modulated (1.x linear_step)
+        np.testing.assert_allclose(vals[2], 0.05, rtol=1e-6)
         # step 4 (first post-warmup): 0.1 * 0.5^(4/2) = 0.025, NOT 0.1
         np.testing.assert_allclose(vals[4], 0.1 * 0.5 ** 2, rtol=1e-6)
-        np.testing.assert_allclose(vals[0], 0.0, atol=1e-9)
+        # the caller-held inner scheduler is not corrupted by reads
+        assert inner.last_epoch == 0
+        # functional mode works through the warmup wrapper
+        np.testing.assert_allclose(float(s.value_at(jnp.asarray(2))), 0.05,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(float(s.value_at(jnp.asarray(6))),
+                                   0.1 * 0.5 ** 3, rtol=1e-6)
 
     def test_usable_as_optimizer_lr(self):
         import paddle_tpu as paddle

