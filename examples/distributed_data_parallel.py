@@ -1,0 +1,40 @@
+"""Data parallelism + ZeRO over whatever devices are visible.
+
+On a multi-chip host this shards the batch over all chips and the
+optimizer state over the `sharding` axis; on one chip it degrades to
+plain training.  For CPU experimentation:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/distributed_data_parallel.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as popt
+from paddle_tpu.distributed import fleet
+
+
+def main():
+    strategy = fleet.DistributedStrategy(sharding=True)  # DP + ZeRO slots
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+
+    net = nn.Sequential(nn.Linear(32, 64), nn.ReLU(), nn.Linear(64, 1))
+    opt = fleet.distributed_optimizer(
+        popt.AdamW(learning_rate=1e-3, multi_precision=True))
+    model = paddle.Model(net, inputs=["x"], labels=["y"])
+    model.prepare(optimizer=opt, loss=nn.MSELoss())
+
+    import jax
+
+    n = max(len(jax.devices()), 1)
+    rng = np.random.RandomState(0)
+    x = rng.randn(16 * n, 32).astype(np.float32)
+    y = rng.randn(16 * n, 1).astype(np.float32)
+    for step in range(5):
+        loss, _ = model.train_batch([x], [y])
+        print(f"step {step}: loss={loss:.5f} on {n} device(s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Export a model to the portable StableHLO format and serve it twice:
+from Python (Predictor) and from a real C program linked against the
+C ABI (paddle_tpu_c.h)."""
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.inference import Config, create_predictor, save_inference_model
+from paddle_tpu.native import c_api_path
+from paddle_tpu.static import InputSpec
+
+C_PROGRAM = r"""
+#include <stdint.h>
+#include <stdio.h>
+#include "paddle_tpu_c.h"
+int main(int argc, char** argv) {
+    void* p = pd_predictor_create(argv[1], argv[2]);
+    if (!p) { fprintf(stderr, "%s\n", pd_last_error()); return 1; }
+    float in[8];
+    for (int i = 0; i < 8; i++) in[i] = 0.25f * i;
+    const float* ins[1] = {in};
+    int64_t shape[2] = {1, 8};
+    const int64_t* shapes[1] = {shape};
+    int nd[1] = {2};
+    float* out; int64_t oshape[4]; int ond;
+    if (pd_predictor_run(p, ins, shapes, nd, 1, &out, oshape, 4, &ond)) {
+        fprintf(stderr, "%s\n", pd_last_error()); return 2;
+    }
+    printf("C output[0] = %f\n", out[0]);
+    pd_free(out);
+    pd_predictor_destroy(p);
+    return 0;
+}
+"""
+
+
+def main():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "model")
+        save_inference_model(prefix, net, [InputSpec([None, 8], "float32")],
+                             platforms=("cpu",))
+
+        # Python serving
+        pred = create_predictor(Config(prefix + ".pdmodel",
+                                       prefix + ".pdiparams"))
+        x = (0.25 * np.arange(8, dtype=np.float32)).reshape(1, 8)
+        out = pred.run([x])[0]
+        print("Python output[0] =", float(np.asarray(out)[0, 0]))
+
+        # C serving (same artifacts, same runtime)
+        lib = c_api_path()
+        csrc = os.path.join(td, "main.c")
+        open(csrc, "w").write(C_PROGRAM)
+        exe = os.path.join(td, "demo")
+        hdr = os.path.dirname(lib)
+        from paddle_tpu import native
+
+        subprocess.run(["gcc", csrc, lib,
+                        f"-I{os.path.dirname(native.__file__)}",
+                        "-o", exe, f"-Wl,-rpath,{hdr}"], check=True)
+        env = dict(os.environ, PADDLE_TPU_C_PLATFORM="cpu")
+        subprocess.run([exe, prefix + ".pdmodel", prefix + ".pdiparams"],
+                       check=True, env=env)
+
+
+if __name__ == "__main__":
+    main()

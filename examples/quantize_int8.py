@@ -1,0 +1,46 @@
+"""Post-training quantization: calibrate, freeze to int8 layers (real
+int8 matmuls with int32 accumulation on the MXU), export, reload."""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.inference import Config, create_predictor, save_inference_model
+from paddle_tpu.slim import PostTrainingQuantization
+from paddle_tpu.static import InputSpec
+from paddle_tpu.vision.models import LeNet
+
+
+def main():
+    paddle.seed(0)
+    net = LeNet()
+    net.eval()
+    rng = np.random.RandomState(0)
+    calib = [rng.uniform(0, 1, (16, 1, 28, 28)).astype(np.float32)
+             for _ in range(4)]
+    ref = np.asarray(net(paddle.to_tensor(calib[0])))
+
+    ptq = PostTrainingQuantization(net)
+    for batch in calib:
+        ptq.collect(paddle.to_tensor(batch))
+    qnet = ptq.quantize()
+    out = np.asarray(qnet(paddle.to_tensor(calib[0])))
+    err = np.abs(out - ref).max() / np.abs(ref).max()
+    print(f"int8 vs float relative error: {err:.4f}")
+
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "lenet_int8")
+        save_inference_model(prefix, qnet,
+                             [InputSpec([None, 1, 28, 28], "float32")],
+                             platforms=("cpu",))
+        pred = create_predictor(Config(prefix + ".pdmodel",
+                                       prefix + ".pdiparams"))
+        out2 = pred.run([calib[0]])[0]
+        print("export/reload max deviation:",
+              float(np.abs(np.asarray(out2) - out).max()))
+
+
+if __name__ == "__main__":
+    main()

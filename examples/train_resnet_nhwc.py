@@ -1,0 +1,27 @@
+"""ResNet-18 training the TPU-first way: NHWC layout, bf16 params with
+f32 master weights, and several optimizer steps per host dispatch."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as popt
+from paddle_tpu.vision.models import resnet18
+
+
+def main():
+    paddle.seed(0)
+    net = resnet18(num_classes=10, data_format="NHWC").astype("bfloat16")
+    opt = popt.Momentum(learning_rate=0.05, momentum=0.9,
+                        multi_precision=True, weight_decay=1e-4)
+    model = paddle.Model(net, inputs=["image"], labels=["label"])
+    model.prepare(optimizer=opt, loss=nn.CrossEntropyLoss(),
+                  steps_per_execution=4)
+
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (256, 64, 64, 3)).astype(np.float32)
+    y = rng.randint(0, 10, (256, 1)).astype(np.int64)
+    model.fit(paddle.io.TensorDataset([x, y]), batch_size=32, epochs=3,
+              verbose=1)
+
+
+if __name__ == "__main__":
+    main()

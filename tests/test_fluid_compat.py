@@ -518,6 +518,16 @@ class TestLrDecayFunctions:
         np.testing.assert_allclose(float(s.value_at(jnp.asarray(6))),
                                    0.1 * 0.5 ** 3, rtol=1e-6)
 
+    def test_warmup_lambda_inner_value_at_error_names_wrapper(self):
+        import jax.numpy as jnp
+        import pytest as _pytest
+
+        from paddle_tpu.fluid import layers as fl
+
+        s = fl.linear_lr_warmup(fl.cosine_decay(0.1, 10, 10), 4, 0.0, 0.1)
+        with _pytest.raises(NotImplementedError, match="linear_lr_warmup"):
+            s.value_at(jnp.asarray(2))
+
     def test_usable_as_optimizer_lr(self):
         import paddle_tpu as paddle
         from paddle_tpu import nn, optimizer as popt

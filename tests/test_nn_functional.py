@@ -196,6 +196,20 @@ class TestNorms:
         check(out_eval, (x - mu.reshape(1, 3, 1, 1)) / np.sqrt(var.reshape(1, 3, 1, 1) + 1e-5),
               rtol=1e-4, atol=1e-4)
 
+    def test_conv_amp_mixed_dtype_casts(self):
+        # f32 inputs into bf16 weights compute in bf16 (AMP convention),
+        # for plain AND transpose convs
+        import jax.numpy as jnp
+
+        rs = np.random.RandomState(20)
+        x = jnp.asarray(rs.rand(1, 2, 6, 6).astype(np.float32))
+        w = jnp.asarray(rs.rand(3, 2, 3, 3), jnp.bfloat16)
+        out = F.conv2d(x, w, padding=1)
+        assert out.dtype == jnp.bfloat16
+        wt = jnp.asarray(rs.rand(2, 3, 3, 3), jnp.bfloat16)
+        out_t = F.conv2d_transpose(x, wt, stride=2)
+        assert out_t.dtype == jnp.bfloat16
+
     def test_batch_norm_bf16_fast_path(self):
         # AMP path: one-pass f32-accumulated stats + folded bf16 normalize
         # must track the f32 two-pass oracle, and the functional stat update
