@@ -49,9 +49,23 @@ A100_REF_IMG_PER_SEC = 2500.0
 # trained accuracy threshold).
 MNIST_ACC_GATE = 0.97
 # Synthetic-clickthrough AUC gate for the CTR config (the reference's CTR CI
-# runs are loss-decrease asserts; AUC >= 0.8 on the learnable synthetic task
-# is the equivalent converged-behavior check).
+# runs are loss-decrease asserts).  The task is deliberately noisy — labels
+# are Bernoulli draws from a latent logit, Bayes-optimal AUC ~0.91 — so the
+# measured AUC sits strictly inside (gate, 1.0) and actually tracks
+# convergence quality instead of saturating at the ceiling.
 CTR_AUC_GATE = 0.8
+
+# Peak dense bf16 matmul throughput of the chip the bench runs on, used for
+# the MFU lines.  v5e ≈ 197 TFLOP/s; override via PADDLE_TPU_PEAK_TFLOPS when
+# the driver moves to other hardware.
+import os as _os
+TPU_PEAK_TFLOPS = float(_os.environ.get("PADDLE_TPU_PEAK_TFLOPS", "197"))
+
+# Model FLOPs per training unit (fwd+bwd ≈ 3× fwd):
+#   BERT-base: 6 * 110e6 params * 128 tokens ≈ 84.5 GFLOP / sequence
+#   ResNet-50: 3 * ~4.1 GFLOP fwd @224 ≈ 12.3 GFLOP / image
+BERT_TRAIN_GFLOP_PER_SEQ = 84.5
+RESNET50_TRAIN_GFLOP_PER_IMG = 12.3
 
 
 def _emit(metric, value, unit, vs_baseline, **extra):
@@ -114,8 +128,12 @@ def bench_bert():
         best_dt = min(best_dt, dt)
 
     seq_per_sec = BATCH * ITERS / best_dt
+    tflops = seq_per_sec * BERT_TRAIN_GFLOP_PER_SEQ / 1e3
     return _emit("bert_base_train_seq_per_sec_per_chip", round(seq_per_sec, 2),
-                 "seq/s", seq_per_sec / A100_REF_SEQ_PER_SEC)
+                 "seq/s", seq_per_sec / A100_REF_SEQ_PER_SEC,
+                 method="per_step_dispatch",
+                 achieved_tflops=round(tflops, 1),
+                 mfu=round(tflops / TPU_PEAK_TFLOPS, 3))
 
 
 def bench_resnet50():
@@ -173,8 +191,12 @@ def bench_resnet50():
         best_dt = min(best_dt, dt)
 
     img_per_sec = BATCH * N_STEPS / best_dt
+    tflops = img_per_sec * RESNET50_TRAIN_GFLOP_PER_IMG / 1e3
     return _emit("resnet50_train_img_per_sec_per_chip", round(img_per_sec, 1),
-                 "img/s", img_per_sec / A100_REF_IMG_PER_SEC)
+                 "img/s", img_per_sec / A100_REF_IMG_PER_SEC,
+                 method="scan_chained",
+                 achieved_tflops=round(tflops, 1),
+                 mfu=round(tflops / TPU_PEAK_TFLOPS, 3))
 
 
 def bench_mnist():
@@ -235,7 +257,11 @@ def bench_mnist():
 
 
 def bench_ctr():
-    """Config 5: Wide&Deep CTR - converged-AUC gate on synthetic clicks."""
+    """Config 5: Wide&Deep CTR - converged-AUC gate on noisy synthetic clicks.
+
+    Labels are Bernoulli draws from a latent logit (per-id effect + linear
+    dense effect); Bayes-optimal AUC on held-out data is ~0.91, so a healthy
+    converged model lands ~0.85-0.90 — strictly inside (gate, 1.0)."""
     import paddle_tpu as paddle
     from paddle_tpu import metric as pmetric
     from paddle_tpu import optimizer as popt
@@ -243,24 +269,38 @@ def bench_ctr():
 
     paddle.seed(0)
     rng = np.random.RandomState(0)
-    n, fields, vocab, dense = 512, 4, 64, 4
-    ids = rng.randint(0, vocab, size=(n, fields)).astype(np.int32)
-    xd = rng.randn(n, dense).astype(np.float32)
-    y = (ids[:, :1] < vocab // 2).astype(np.float32)
+    n, fields, vocab, dense = 4096, 4, 64, 4
+    table = rng.randn(vocab)
+    w_dense = rng.randn(dense) * 0.5
 
-    net = wide_deep_tiny()
+    def make(n, r):
+        ids = r.randint(0, vocab, size=(n, fields)).astype(np.int32)
+        xd = r.randn(n, dense).astype(np.float32)
+        s = 2.0 * (table[ids[:, 0]] + xd @ w_dense)[:, None]
+        y = (r.uniform(size=(n, 1)) < 1 / (1 + np.exp(-s))).astype(np.float32)
+        return ids, xd, y
+
+    ids, xd, y = make(n, rng)
+    ids_t, xd_t, y_t = make(n, np.random.RandomState(7))
+
+    # sparse=True + lazy_mode: the SelectedRows O(touched-rows) path — the
+    # production CTR configuration (tools/bench_sparse_embedding.py measures
+    # its vocab-independence)
+    net = wide_deep_tiny(sparse=True)
     model = paddle.Model(net, inputs=["sparse", "dense"], labels=["label"])
-    model.prepare(optimizer=popt.Adam(learning_rate=1e-2), loss=net.loss)
-    for _ in range(40):
+    model.prepare(optimizer=popt.Adam(learning_rate=1e-2, lazy_mode=True),
+                  loss=net.loss)
+    for _ in range(120):
         loss, _ = model.train_batch([ids, xd], [y])
 
     import jax
-    logits = np.asarray(model.predict_batch([ids, xd])).reshape(-1)
+    logits = np.asarray(model.predict_batch([ids_t, xd_t])).reshape(-1)
     prob = np.asarray(jax.nn.sigmoid(logits))  # Auc buckets expect [0,1]
     auc = pmetric.Auc()
-    auc.update(np.stack([1 - prob, prob], -1), y)
+    auc.update(np.stack([1 - prob, prob], -1), y_t)
     a = float(auc.accumulate())
-    return _emit("wide_deep_ctr_auc", a, "auc", a / CTR_AUC_GATE)
+    return _emit("wide_deep_ctr_auc", a, "auc", a / CTR_AUC_GATE,
+                 bayes_auc=0.91)
 
 
 def main():
@@ -277,7 +317,12 @@ def main():
                       * results["resnet50"]["vs_baseline"])
         _emit("train_throughput_geomean_vs_a100", g, "ratio", g,
               bert_seq_per_sec=results["bert"]["value"],
-              resnet50_img_per_sec=results["resnet50"]["value"])
+              resnet50_img_per_sec=results["resnet50"]["value"],
+              # the two inputs use different dispatch methodologies (see the
+              # per-config "method" fields); the geomean is a headline, not a
+              # like-for-like comparison.
+              methods={"bert": "per_step_dispatch",
+                       "resnet50": "scan_chained"})
     if failed:
         sys.exit(1)  # a green exit code must mean every config was measured
 

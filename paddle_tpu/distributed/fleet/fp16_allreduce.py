@@ -29,7 +29,17 @@ class Fp16AllReducePlan(ShardingPlan):
         super().__init__(network, optimizer, strategy, mesh)
         self._require_pure_dp("fp16_allreduce")
         cfg = strategy.fp16_allreduce_configs or {}
-        name = str(cfg.get("dtype", "float16"))
+        name = cfg.get("dtype")
+        if name is None:
+            # Pre-scaling by 1/n before the cast (see transform_gradients)
+            # trades psum overflow for underflow: grads below ~6e-8*n flush
+            # to zero in fp16.  That narrowing grows with replica count, so
+            # past 8 replicas default to bfloat16 — same wire bytes, f32
+            # exponent range, no underflow cliff.  An explicit dtype in
+            # fp16_allreduce_configs always wins.
+            n_replicas = self.mesh.shape.get("data", 1)
+            name = "float16" if n_replicas <= 8 else "bfloat16"
+        name = str(name)
         if name not in _DTYPES:
             raise InvalidArgumentError(
                 f"fp16_allreduce dtype must be float16/bfloat16, got {name!r}")

@@ -145,8 +145,7 @@ class FileHeartbeat:
 
     def beat(self) -> None:
         try:
-            with open(self.path, "a"):
-                os.utime(self.path, None)
+            self._write()
         except OSError:
             # liveness is a side channel: a pruned tempdir or full disk
             # must never abort the training step it monitors
@@ -154,10 +153,26 @@ class FileHeartbeat:
             try:
                 if d:
                     os.makedirs(d, exist_ok=True)
-                with open(self.path, "a"):
-                    os.utime(self.path, None)
+                self._write()
             except OSError:
                 pass
+
+    def _write(self) -> None:
+        # append a byte so st_size changes too: on filesystems with coarse
+        # mtime granularity a beat landing in the same timestamp quantum as
+        # the watchdog's initial stamp would otherwise be invisible.  Reset
+        # before the file grows meaningfully (truncation is itself a size
+        # change, so no beat is ever silent).
+        try:
+            if os.stat(self.path).st_size > 4096:
+                with open(self.path, "w"):
+                    pass
+                return
+        except OSError:
+            pass
+        with open(self.path, "a") as f:
+            f.write(".")
+        os.utime(self.path, None)
 
     def age(self) -> float:
         try:

@@ -105,17 +105,33 @@ class RowParallelLinear(Layer):
 
 
 class VocabParallelEmbedding(Layer):
-    """Embedding with the vocabulary dim sharded over ``model``."""
+    """Embedding with the vocabulary dim sharded over ``model``.
+
+    ``sparse=True``: gradients flow as SelectedRows through sparse-aware
+    train steps (framework/selected_rows.py) — the lazy optimizer's row
+    gather/scatter is itself partitioned by GSPMD over the vocab shards, so
+    the PS property (no O(vocab) work per step) holds on the sharded table
+    too."""
 
     def __init__(self, num_embeddings: int, embedding_dim: int,
-                 weight_attr=None, name=None):
+                 weight_attr=None, sparse: bool = False, name=None):
         super().__init__()
+        self.num_embeddings = num_embeddings
+        self.sparse = bool(sparse)
         self.weight = self.create_parameter(
             (num_embeddings, embedding_dim), attr=weight_attr,
             default_initializer=I.Normal(std=0.02))
         self.weight.partition_spec = ("model", None)
+        self.weight.sparse = self.sparse
 
     def forward(self, ids):
+        if self.sparse:
+            from ..framework.selected_rows import tap_lookup
+
+            rows = tap_lookup(self.weight, self.weight.value, ids,
+                              self.num_embeddings)
+            if rows is not None:
+                return constrain(rows, *([None] * rows.ndim))
         # gather from a vocab-sharded table: GSPMD partitions the take along
         # the sharded dim and all-reduces the partial lookups
         out = jnp.take(jnp.asarray(self.weight), jnp.asarray(ids), axis=0)

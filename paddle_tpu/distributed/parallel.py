@@ -214,16 +214,24 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
             else:
                 grace = (startup_grace if startup_grace is not None
                          else max(60.0, 4 * hang_timeout))
-                stamp0 = _os.stat(hb.path).st_mtime
+                st0 = _os.stat(hb.path)
                 poll = min(max(hang_timeout / 4, 0.05), 1.0)
+                beaten = False  # sticky: once any change is seen, switch
+                #                 from startup grace to the hang timeout
                 while True:
                     rc = child.poll()
                     if rc is not None:
                         break
-                    try:
-                        beaten = _os.stat(hb.path).st_mtime > stamp0
-                    except OSError:
-                        beaten = False
+                    if not beaten:
+                        try:
+                            st = _os.stat(hb.path)
+                            # mtime OR size change: beat() appends a byte,
+                            # so coarse-mtime filesystems still register a
+                            # first beat in the same timestamp quantum
+                            beaten = (st.st_mtime > st0.st_mtime
+                                      or st.st_size != st0.st_size)
+                        except OSError:
+                            pass
                     limit = hang_timeout if beaten else grace
                     if hb.age() > limit:
                         vlog(0, "watchdog: trainer hung (no heartbeat for "

@@ -54,6 +54,7 @@ from .errors import (  # noqa: F401
     enforce_eq,
 )
 from .flags import set_flags, get_flags, define_flag, flag  # noqa: F401
+from .selected_rows import SelectedRows, sparse_tape  # noqa: F401
 from .random import (  # noqa: F401
     Generator,
     seed,

@@ -1,0 +1,291 @@
+"""SelectedRows: sparse embedding gradients with O(touched-rows) updates.
+
+Reference capability: ``paddle/fluid/framework/selected_rows.h:41`` — the
+(rows, value) pair a sparse ``lookup_table`` backward emits so that a step
+touching k rows of an N-row table costs O(k), not O(N) — plus the lazy-mode
+optimizers that consume it (``python/paddle/fluid/optimizer.py:2026``
+``Adam(lazy_mode=True)``) and, at PS scale, the distributed lookup tables
+(``paddle/fluid/operators/distributed/large_scale_kv.h:773``).
+
+TPU-native design — the reference cannot be translated here, because
+``jax.grad`` of a gather **materializes a dense table-shaped cotangent**:
+differentiating ``table[ids]`` w.r.t. ``table`` scatter-adds into an O(N)
+zeros buffer, and a dense Adam step then rewrites all N rows of the
+moments.  Instead the sparse path restructures the differentiation itself:
+
+1. the embedding forward taps a **gradient tape**: it gathers rows from the
+   (non-differentiated) table and adds a zeros ``delta`` of row shape that
+   IS a differentiated argument of the train step — so ``d loss / d delta``
+   is exactly the per-row gradient, computed without any O(N) buffer;
+2. the tape returns the traced ``ids`` alongside, and the train step wraps
+   ``(ids, d_delta)`` into a :class:`SelectedRows`;
+3. ``Optimizer.update`` recognizes ``SelectedRows`` leaves: with
+   ``lazy_mode=True`` the rule gathers the k touched moment rows, updates
+   them, and scatters back — per-step cost O(k·D) independent of vocab N.
+   Duplicate ids are segment-summed first (:meth:`SelectedRows.merged`);
+   padding uses the out-of-range sentinel ``height``, which XLA's default
+   FILL_OR_DROP scatter mode drops silently.
+
+Everything stays inside one jitted train step: ``SelectedRows`` is a plain
+Python carrier of traced arrays and never crosses a jit boundary, so it
+needs no pytree registration (and generic ``tree_map``s therefore cannot
+accidentally scale its integer ids).
+
+For tables that exceed HBM, see ``paddle_tpu.incubate.host_embedding`` —
+the host-RAM pull/push table that mirrors the reference's parameter-server
+role (``large_scale_kv.h``) with the same O(k) per-step cost.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .errors import InvalidArgumentError
+
+__all__ = ["SelectedRows", "sparse_tape", "current_tape", "sparse_param_names"]
+
+
+class SelectedRows:
+    """A sparse slice of a ``[height, D]`` table: ``values[i]`` is the row
+    at ``ids[i]``.  Duplicate ids are allowed (they mean "sum"); ids equal
+    to ``height`` are padding and are dropped by scatter.
+
+    Mirrors ``paddle/fluid/framework/selected_rows.h:41`` (rows_, value_,
+    height_)."""
+
+    __slots__ = ("ids", "values", "height", "_is_merged")
+
+    def __init__(self, ids, values, height: int, _merged: bool = False):
+        self.ids = jnp.asarray(ids).reshape(-1)
+        values = jnp.asarray(values)
+        k = self.ids.shape[0]
+        if k:
+            self.values = values.reshape(k, -1)
+        else:  # reshape(0, -1) cannot infer the row dim
+            d = values.shape[-1] if values.ndim >= 2 else 0
+            self.values = values.reshape(0, d)
+        self.height = int(height)
+        self._is_merged = _merged
+
+    @property
+    def dim(self) -> int:
+        return self.values.shape[1]
+
+    # -- algebra used by the optimizer plumbing ------------------------------
+    def __mul__(self, other):  # grad clip / loss-scale: scales values
+        return SelectedRows(self.ids, self.values * other, self.height,
+                            self._is_merged)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return SelectedRows(self.ids, self.values / other, self.height,
+                            self._is_merged)
+
+    def astype(self, dtype):
+        return SelectedRows(self.ids, self.values.astype(dtype), self.height,
+                            self._is_merged)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def concat(self, other: "SelectedRows") -> "SelectedRows":
+        if other.height != self.height:
+            raise InvalidArgumentError(
+                f"SelectedRows height mismatch {self.height} vs {other.height}")
+        return SelectedRows(jnp.concatenate([self.ids, other.ids]),
+                            jnp.concatenate([self.values, other.values]),
+                            self.height)
+
+    def merged(self) -> "SelectedRows":
+        """Segment-sum duplicate ids (ref: math/selected_rows_functor.cc
+        MergeAdd).  Returns fixed-size (jit-static) output: k slots, the
+        tail padded with the drop sentinel ``height``."""
+        if self._is_merged or self.ids.shape[0] == 0:
+            return self
+        ids, values = self.ids, self.values
+        k = ids.shape[0]
+        order = jnp.argsort(ids)
+        sid = ids[order]
+        sval = values[order]
+        first = jnp.concatenate(
+            [jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+        seg = jnp.cumsum(first) - 1  # segment index per sorted element
+        summed = jax.ops.segment_sum(sval, seg, num_segments=k)
+        uniq = jnp.full((k,), self.height, dtype=sid.dtype)
+        uniq = uniq.at[seg].set(sid, mode="drop")
+        # drop padding rows' garbage: slots >= n_unique keep the sentinel id,
+        # and their summed value is 0 already (segment_sum of nothing)
+        return SelectedRows(uniq, summed, self.height, _merged=True)
+
+    def to_dense(self) -> jax.Array:
+        """Materialize the dense [height, D] gradient (O(N) — used by
+        non-lazy optimizers, matching the reference's dense fallback)."""
+        z = jnp.zeros((self.height, self.values.shape[1]), self.values.dtype)
+        return z.at[self.ids].add(self.values, mode="drop")
+
+    def l2_norm_sq(self) -> jax.Array:
+        """Sum of squares — exact for merged rows; for unmerged duplicates
+        this is the norm of the unmerged stack (callers wanting the exact
+        gradient norm should call ``.merged()`` first)."""
+        return jnp.sum(jnp.square(self.values.astype(jnp.float32)))
+
+    def __repr__(self):
+        return (f"SelectedRows(k={self.ids.shape[0]}, dim={self.dim}, "
+                f"height={self.height})")
+
+
+# ---------------------------------------------------------------------------
+# The gradient tape
+# ---------------------------------------------------------------------------
+_state = threading.local()
+
+
+def current_tape() -> Optional["_Tape"]:
+    return getattr(_state, "tape", None)
+
+
+class _Tape:
+    """Collects sparse-embedding taps during one traced forward.
+
+    Two modes:
+      * record (``deltas is None``): each tap records (box, ids-shape,
+        rows-shape/dtype) and returns plain gathered rows — used under
+        ``jax.eval_shape`` to discover delta shapes before differentiation.
+      * consume: each tap adds ``deltas[i]`` to its gathered rows (the
+        differentiable zeros) and records the traced ids for the caller.
+    """
+
+    def __init__(self, deltas: Optional[Sequence[jax.Array]] = None):
+        self.deltas = list(deltas) if deltas is not None else None
+        self.taps: List[Tuple[Any, jax.Array]] = []  # (box, traced ids)
+        self.specs: List[Tuple[Any, Tuple[int, ...], Any]] = []
+        self._i = 0
+
+    def tap(self, box, table: jax.Array, ids: jax.Array,
+            rows: jax.Array) -> jax.Array:
+        """Called from a sparse layer's forward with the gathered ``rows``
+        (= ``table[ids]``, already padding-masked).  Returns the rows the
+        layer should use downstream."""
+        if self.deltas is None:  # record mode
+            self.specs.append((box, rows.shape, rows.dtype))
+            return rows
+        if self._i >= len(self.deltas):
+            raise InvalidArgumentError(
+                "sparse tape: more embedding taps than recorded deltas — "
+                "the forward is not shape-deterministic across traces")
+        d = self.deltas[self._i]
+        self._i += 1
+        self.taps.append((box, ids))
+        return rows + d.astype(rows.dtype)
+
+
+class sparse_tape:
+    """Context manager installing a tape for the duration of a forward."""
+
+    def __init__(self, deltas: Optional[Sequence[jax.Array]] = None):
+        self._tape = _Tape(deltas)
+
+    def __enter__(self) -> _Tape:
+        if current_tape() is not None:
+            raise InvalidArgumentError("sparse_tape does not nest")
+        _state.tape = self._tape
+        return self._tape
+
+    def __exit__(self, *exc):
+        _state.tape = None
+        return False
+
+
+def tap_lookup(box, table, ids, num_embeddings: int,
+               padding_idx: Optional[int] = None):
+    """The sparse layer forward: gather rows from the non-differentiated
+    table and route them through the active tape (shared by nn.Embedding
+    and VocabParallelEmbedding so the tap protocol has one definition).
+    Returns the rows, or None when no tape is active (caller falls back to
+    the dense path)."""
+    tape = current_tape()
+    if tape is None:
+        return None
+    table = jnp.asarray(table)
+    ids = jnp.asarray(ids)
+    if padding_idx is not None:
+        # padded positions map to the drop sentinel: they gather fill-zeros
+        # here, and their delta-grad scatter is discarded by FILL_OR_DROP
+        ids = jnp.where(ids == padding_idx, num_embeddings, ids)
+    rows = jnp.take(jax.lax.stop_gradient(table), ids, axis=0,
+                    mode="fill", fill_value=0)
+    return tape.tap(box, table, ids, rows)
+
+
+def sparse_param_names(layer) -> Dict[int, str]:
+    """Map ``id(Parameter box) -> dotted param name`` for every parameter
+    flagged ``sparse`` on ``layer`` (set by ``nn.Embedding(sparse=True)``)."""
+    out = {}
+    for name, box in layer.named_parameters():
+        if getattr(box, "sparse", False):
+            out[id(box)] = name
+    return out
+
+
+def build_sparse_step(forward_loss: Callable, sparse_names: Dict[int, str],
+                      table_shapes: Dict[str, Tuple[int, int]]):
+    """Build the two-phase differentiation used by train steps with sparse
+    embeddings.  ``forward_loss(params) -> (loss, aux)`` closes over batch /
+    buffers / key; ``sparse_names`` maps box id -> param name.
+
+    Returns ``grad_fn(params) -> ((loss, aux), grads)`` where ``grads`` has
+    dense leaves for dense params and :class:`SelectedRows` leaves for the
+    sparse tables — and, critically, no O(N) cotangent is ever built for a
+    table."""
+    names = set(table_shapes)
+
+    def grad_fn(params):
+        dense_p = {k: v for k, v in params.items() if k not in names}
+        tables = {k: v for k, v in params.items() if k in names}
+
+        # phase 1: abstract probe to learn each tap's delta shape (trace-time
+        # only — eval_shape runs no FLOPs)
+        probe_tape = _Tape()
+
+        def probe():
+            _state.tape = probe_tape
+            try:
+                return forward_loss({**dense_p, **tables})
+            finally:
+                _state.tape = None
+
+        jax.eval_shape(probe)
+        deltas = [jnp.zeros(shape, dtype) for _, shape, dtype
+                  in probe_tape.specs]
+
+        # phase 2: differentiate w.r.t. (dense params, deltas).  The tap
+        # order is trace-deterministic, so the probe's box sequence aligns
+        # with this trace's ids (boxes are Python objects and cannot ride
+        # through has_aux).
+        boxes = [box for box, _, _ in probe_tape.specs]
+
+        def inner(dp, ds):
+            with sparse_tape(ds) as tape:
+                loss, aux = forward_loss({**dp, **tables})
+            ids_list = [ids for _, ids in tape.taps]
+            return loss, (aux, ids_list)
+
+        (loss, (aux, ids_list)), (dg, d_deltas) = jax.value_and_grad(
+            inner, argnums=(0, 1), has_aux=True)(dense_p, deltas)
+
+        grads: Dict[str, Any] = dict(dg)
+        for box, ids, gd in zip(boxes, ids_list, d_deltas):
+            name = sparse_names.get(id(box))
+            if name is None or name not in table_shapes:
+                continue  # tapped box not in this params dict (frozen)
+            sr = SelectedRows(ids, gd, table_shapes[name][0])
+            grads[name] = (grads[name].concat(sr)
+                           if isinstance(grads.get(name), SelectedRows) else sr)
+        return (loss, aux), grads
+
+    return grad_fn

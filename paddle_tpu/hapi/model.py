@@ -95,6 +95,16 @@ class Model:
             raise InvalidArgumentError(
                 "steps_per_execution > 1 cannot update host-side metrics "
                 "per inner step; drop metrics or keep it at 1")
+        if steps_per_execution > 1 and optimizer is not None and \
+                getattr(optimizer, "lr_scheduler", None) is not None:
+            import warnings
+
+            warnings.warn(
+                "steps_per_execution > 1: the learning rate is read once per "
+                "execution, so an LRScheduler advances per execution (every "
+                f"{steps_per_execution} optimizer steps), not per step — "
+                "matching Keras. Scale the scheduler's step granularity "
+                "accordingly.", UserWarning)
         self._steps_per_execution = steps_per_execution
         self._optimizer = optimizer
         self._loss = loss
@@ -137,12 +147,24 @@ class Model:
 
         opt = optimizer
 
+        from ..framework.selected_rows import (build_sparse_step,
+                                               sparse_param_names)
+
+        sparse_map = sparse_param_names(net)  # id(box) -> dotted name
+
         def train_step(params, opt_state, buffers, key, lr, *batch):
-            grad_fn = jax.value_and_grad(
-                lambda p: forward_loss(p, buffers, key, True, *batch),
-                has_aux=True,
-            )
-            (loss_val, (out, new_bufs)), grads = grad_fn(params)
+            fl = lambda p: forward_loss(p, buffers, key, True, *batch)
+            if sparse_map:
+                # Embedding(sparse=True) present: two-phase differentiation
+                # producing SelectedRows table grads — no O(vocab) cotangent
+                names = set(sparse_map.values())
+                shapes = {k: tuple(v.shape) for k, v in params.items()
+                          if k in names}
+                (loss_val, (out, new_bufs)), grads = build_sparse_step(
+                    fl, sparse_map, shapes)(params)
+            else:
+                grad_fn = jax.value_and_grad(fl, has_aux=True)
+                (loss_val, (out, new_bufs)), grads = grad_fn(params)
             plan = self._plan
             if plan is not None and hasattr(plan, "transform_gradients"):
                 # comm-precision plans reduce per-replica grads explicitly
@@ -258,6 +280,12 @@ class Model:
             else:
                 self._plan = ShardingPlan(net, optimizer, strategy)
             self._plan.place_network()
+            if sparse_map and hasattr(self._plan, "transform_gradients"):
+                raise InvalidArgumentError(
+                    "Embedding(sparse=True) does not compose with gradient-"
+                    "transforming fleet strategies (fp16_allreduce / dgc): "
+                    "their per-replica reductions tree_map dense leaves. "
+                    "Use the default or sharding strategy, or sparse=False")
 
         if optimizer is not None:
             if self._plan is not None:

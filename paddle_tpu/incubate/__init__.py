@@ -4,3 +4,5 @@ from . import checkpoint  # noqa: F401
 from . import sharded_checkpoint  # noqa: F401
 from . import reader  # noqa: F401
 from . import complex  # noqa: F401
+from . import host_embedding  # noqa: F401
+from .host_embedding import HostEmbeddingTable  # noqa: F401

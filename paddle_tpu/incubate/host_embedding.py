@@ -1,0 +1,179 @@
+"""Host-RAM embedding tables for vocabularies beyond HBM.
+
+Reference capability: the parameter-server large-scale KV tables
+(``paddle/fluid/operators/distributed/large_scale_kv.h:773`` — host-memory
+shards pulled/pushed per minibatch over RPC) and the distributed lookup
+table path (``python/paddle/fluid/transpiler/distribute_transpiler.py``).
+
+TPU-native design: the "parameter server" is the local host's RAM.  A
+:class:`HostEmbeddingTable` keeps the table (and its optimizer moments) as
+host numpy arrays — optionally disk-backed via ``np.memmap`` — and the
+device train step works on the k *pulled* rows only:
+
+    rows = table.pull(ids)                       # host gather  [B, F, D]
+    (loss, row_grads) = jit_step(params, rows, ...)  # rows are a normal
+                                                 # differentiable input
+    table.push(ids, row_grads)                   # host lazy Adam/SGD/Adagrad
+
+Because the rows enter the jitted step as an ordinary argument, their
+gradient comes straight out of ``jax.grad`` — no table-shaped cotangent
+exists anywhere, and HBM holds only O(B·F·D) of embedding data per step.
+
+This trades the HBM limit for PCIe/host bandwidth exactly the way the
+reference trades it for NIC bandwidth to a PS — the right call when the
+table (10⁷–10⁹ rows × dim, plus 2 Adam moments) cannot fit on chip.
+For tables that DO fit, prefer ``nn.Embedding(sparse=True)`` +
+``Adam(lazy_mode=True)`` (framework/selected_rows.py), which keeps the
+lookup on-device.
+
+Multi-host: shard the vocab across hosts with ``vocab_range`` (each host
+owns ``[lo, hi)`` and pulls/pushes only its slice), the same row-wise
+partitioning the reference's PS uses.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..framework.errors import InvalidArgumentError
+
+__all__ = ["HostEmbeddingTable"]
+
+_OPTS = ("sgd", "adagrad", "adam")
+
+
+class HostEmbeddingTable:
+    """A ``[num_embeddings, dim]`` table resident in host RAM with fused
+    lazy optimizer updates on ``push``.
+
+    Parameters
+    ----------
+    optimizer: "sgd" | "adagrad" | "adam" — the lazy row update applied by
+        :meth:`push` (Adam uses a global step count for bias correction,
+        like the device-side lazy Adam).
+    mmap_dir: when set, the table and moments live in ``np.memmap`` files
+        under this directory instead of RAM — the answer for tables larger
+        than host memory (the OS pages touched rows in/out).
+    vocab_range: ``(lo, hi)`` global-id ownership window for multi-host PS
+        sharding; ids outside the window are ignored by pull (zeros) and
+        push (dropped), so every host can be handed the full id batch.
+    """
+
+    def __init__(self, num_embeddings: int, dim: int, *,
+                 optimizer: str = "adam", learning_rate: float = 0.001,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-8, initializer=None,
+                 dtype=np.float32, mmap_dir: Optional[str] = None,
+                 vocab_range: Optional[Tuple[int, int]] = None,
+                 seed: int = 0):
+        if optimizer not in _OPTS:
+            raise InvalidArgumentError(
+                f"optimizer must be one of {_OPTS}, got {optimizer!r}")
+        self.num_embeddings = int(num_embeddings)
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        self.lr = float(learning_rate)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self._step = 0
+        self._lock = threading.Lock()
+        lo, hi = vocab_range or (0, self.num_embeddings)
+        if not (0 <= lo < hi <= self.num_embeddings):
+            raise InvalidArgumentError(f"bad vocab_range {vocab_range}")
+        self.vocab_range = (int(lo), int(hi))
+        n_local = hi - lo
+
+        def alloc(name):
+            if mmap_dir is None:
+                return np.zeros((n_local, self.dim), dtype)
+            os.makedirs(mmap_dir, exist_ok=True)
+            return np.memmap(os.path.join(mmap_dir, f"{name}.bin"),
+                             dtype=dtype, mode="w+",
+                             shape=(n_local, self.dim))
+
+        self.table = alloc("table")
+        if initializer is None:
+            # chunked init keeps peak temp memory bounded for huge tables
+            rng = np.random.default_rng(seed)
+            chunk = max(1, (1 << 22) // max(self.dim, 1))
+            for s in range(0, n_local, chunk):
+                e = min(s + chunk, n_local)
+                self.table[s:e] = rng.normal(
+                    0.0, 0.01, (e - s, self.dim)).astype(dtype)
+        else:
+            initializer(self.table)
+        self._slots: Dict[str, np.ndarray] = {}
+        if optimizer == "adagrad":
+            self._slots["moment"] = alloc("moment")
+        elif optimizer == "adam":
+            self._slots["moment1"] = alloc("moment1")
+            self._slots["moment2"] = alloc("moment2")
+
+    # -- PS verbs ------------------------------------------------------------
+    def pull(self, ids) -> np.ndarray:
+        """Gather rows for ``ids`` (any shape); out-of-window ids → zeros.
+        Returns ``ids.shape + (dim,)`` float32, ready for device_put."""
+        ids = np.asarray(ids)
+        lo, hi = self.vocab_range
+        local = ids.reshape(-1) - lo
+        ok = (local >= 0) & (local < hi - lo)
+        out = np.zeros((local.size, self.dim), self.table.dtype)
+        out[ok] = self.table[local[ok]]
+        return out.reshape(ids.shape + (self.dim,))
+
+    def push(self, ids, grads, lr: Optional[float] = None) -> None:
+        """Apply one lazy optimizer step on the rows named by ``ids`` with
+        per-position ``grads`` (shape ``ids.shape + (dim,)``).  Duplicate
+        ids are merged by summation first (the reference MergeAdd)."""
+        ids = np.asarray(ids).reshape(-1)
+        g = np.asarray(grads, dtype=np.float32).reshape(ids.size, self.dim)
+        lo, hi = self.vocab_range
+        local = ids - lo
+        ok = (local >= 0) & (local < hi - lo)
+        local, g = local[ok], g[ok]
+        if local.size == 0:
+            return
+        uniq, inv = np.unique(local, return_inverse=True)
+        merged = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(merged, inv, g)
+        lr = self.lr if lr is None else float(lr)
+        with self._lock:
+            self._step += 1
+            w = self.table[uniq].astype(np.float32)
+            if self.optimizer == "sgd":
+                w -= lr * merged
+            elif self.optimizer == "adagrad":
+                acc = self._slots["moment"][uniq] + merged ** 2
+                self._slots["moment"][uniq] = acc
+                w -= lr * merged / (np.sqrt(acc) + self.epsilon)
+            else:  # adam, lazy (bias correction off the global step)
+                b1, b2, t = self.beta1, self.beta2, self._step
+                m = b1 * self._slots["moment1"][uniq] + (1 - b1) * merged
+                v = b2 * self._slots["moment2"][uniq] + (1 - b2) * merged ** 2
+                self._slots["moment1"][uniq] = m
+                self._slots["moment2"][uniq] = v
+                mhat = m / (1 - b1 ** t)
+                vhat = v / (1 - b2 ** t)
+                w -= lr * mhat / (np.sqrt(vhat) + self.epsilon)
+            self.table[uniq] = w.astype(self.table.dtype)
+
+    # -- checkpoint ----------------------------------------------------------
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        d = {"table": np.asarray(self.table), "step": np.asarray(self._step)}
+        for k, v in self._slots.items():
+            d[k] = np.asarray(v)
+        return d
+
+    def set_state_dict(self, state: Dict[str, np.ndarray]) -> None:
+        self.table[...] = state["table"]
+        self._step = int(state.get("step", 0))
+        for k in self._slots:
+            if k in state:
+                self._slots[k][...] = state[k]
+
+    def __repr__(self):
+        lo, hi = self.vocab_range
+        return (f"HostEmbeddingTable({self.num_embeddings}x{self.dim}, "
+                f"opt={self.optimizer}, owns=[{lo},{hi}))")

@@ -172,7 +172,10 @@ class _StagingIterator:
                 self._q.get_nowait()
             except queue.Empty:
                 break
-        self._thread.join(timeout=10.0)
+        # GC can run __del__ on any thread — including the staging thread
+        # itself (join() from there raises "cannot join current thread").
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=10.0)
 
     def __del__(self):
         if not self._stop and self._thread.is_alive():

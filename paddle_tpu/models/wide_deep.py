@@ -35,14 +35,18 @@ class WideDeep(nn.Layer):
 
     def __init__(self, num_fields: int = 26, vocab_size: int = 10000,
                  embed_dim: int = 16, dense_dim: int = 13,
-                 hidden_sizes=(64, 32)):
+                 hidden_sizes=(64, 32), sparse: bool = False):
         super().__init__()
         self.num_fields = num_fields
         self.dense_dim = dense_dim
-        # deep tower: shared vocab-sharded table
-        self.embedding = VocabParallelEmbedding(vocab_size, embed_dim)
+        # deep tower: shared vocab-sharded table.  sparse=True switches the
+        # tables to SelectedRows gradients + lazy row updates — the O(k)
+        # per-step cost the reference's PS lookup tables provide
+        # (selected_rows.h:41); pair with Adam(lazy_mode=True).
+        self.embedding = VocabParallelEmbedding(vocab_size, embed_dim,
+                                                sparse=sparse)
         # wide tower: per-id scalar weight (a vocab-sharded linear term)
-        self.wide = VocabParallelEmbedding(vocab_size, 1)
+        self.wide = VocabParallelEmbedding(vocab_size, 1, sparse=sparse)
         layers = []
         d = dense_dim + num_fields * embed_dim
         for h in hidden_sizes:

@@ -222,7 +222,11 @@ int pd_predictor_run(void* handle, const float** inputs,
     PyObject* shape = PyObject_GetAttrString(out_f32, "shape");
     Py_ssize_t nd = shape ? PyTuple_Size(shape) : -1;
     if (nd < 0 || nd > out_shape_cap) {
-      set_error("output rank exceeds out_shape_cap");
+      set_error(nd < 0 ? "reading output shape failed"
+                       : "output rank exceeds out_shape_cap");
+      // a failed GetAttr/Size leaves a pending CPython exception; clear it
+      // so the next API call on this thread starts from a clean slate
+      PyErr_Clear();
       Py_XDECREF(shape);
       Py_DECREF(out_f32);
       break;
@@ -231,6 +235,12 @@ int pd_predictor_run(void* handle, const float** inputs,
     for (Py_ssize_t d = 0; d < nd; ++d) {
       out_shape[d] = PyLong_AsLongLong(PyTuple_GET_ITEM(shape, d));
       numel *= out_shape[d];
+    }
+    if (PyErr_Occurred()) {  // non-int shape entry: PyLong_AsLongLong == -1
+      capture_py_error("output shape entry");
+      Py_DECREF(shape);
+      Py_DECREF(out_f32);
+      break;
     }
     *out_ndim = static_cast<int>(nd);
     Py_DECREF(shape);

@@ -49,7 +49,15 @@ class Identity(Layer):
 
 
 class Embedding(Layer):
-    """Parity: paddle.nn.Embedding (ref: operators/lookup_table_v2_op)."""
+    """Parity: paddle.nn.Embedding (ref: operators/lookup_table_v2_op).
+
+    ``sparse=True`` marks the table for SelectedRows gradients: inside a
+    sparse-aware train step (hapi.Model builds one automatically) the
+    backward produces an O(touched-rows) ``(ids, rows)`` gradient instead of
+    a dense O(vocab) cotangent, and lazy-mode optimizers update only the
+    touched rows — see framework/selected_rows.py (ref:
+    paddle/fluid/framework/selected_rows.h:41).  Outside such a step the
+    flag is inert and gradients are dense (XLA scatter-add)."""
 
     def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
                  sparse=False, weight_attr=None, name=None):
@@ -61,10 +69,19 @@ class Embedding(Layer):
         self.weight = self.create_parameter(
             (num_embeddings, embedding_dim), attr=weight_attr,
             default_initializer=I.Normal(0.0, 1.0))
+        self.weight.sparse = bool(sparse)
         if padding_idx is not None:
             self.weight.value = self.weight.value.at[padding_idx].set(0.0)
 
     def forward(self, x):
+        if self.sparse:
+            from ..framework.selected_rows import tap_lookup
+
+            rows = tap_lookup(self.weight, self.weight.value, x,
+                              self.num_embeddings,
+                              padding_idx=self.padding_idx)
+            if rows is not None:
+                return rows
         return F.embedding(x, self.weight.value, padding_idx=self.padding_idx)
 
 

@@ -51,7 +51,7 @@ class Parameter:
     replicated) is the tensor-parallel placement annotation consumed by
     distributed.fleet.ShardingPlan."""
 
-    __slots__ = ("value", "name", "trainable", "partition_spec")
+    __slots__ = ("value", "name", "trainable", "partition_spec", "sparse")
 
     def __init__(self, value, name: str = "", trainable: bool = True,
                  partition_spec=None):
@@ -59,6 +59,10 @@ class Parameter:
         self.name = name
         self.trainable = trainable
         self.partition_spec = partition_spec
+        # sparse=True: gradients flow as SelectedRows through sparse-aware
+        # train steps (framework/selected_rows.py); set by
+        # nn.Embedding(sparse=True)
+        self.sparse = False
 
     # jnp.asarray(param) → the underlying array; makes params usable in ops.
     def __jax_array__(self):

@@ -11,7 +11,28 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..framework.selected_rows import SelectedRows
+
 __all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+# SelectedRows grads (sparse embeddings) are unregistered objects, so
+# tree_map sees them as leaves; clip their row values only.  Norms merge
+# duplicate ids first — the unmerged stack over-counts repeated rows.
+def _sq_norm(g):
+    if isinstance(g, SelectedRows):
+        return g.merged().l2_norm_sq()
+    return jnp.sum(jnp.square(g.astype(jnp.float32)))
+
+
+def _scaled(g, scale):
+    if isinstance(g, SelectedRows):
+        g = g.merged()
+        return SelectedRows(g.ids,
+                            (g.values.astype(jnp.float32) * scale)
+                            .astype(g.values.dtype),
+                            g.height, _merged=True)
+    return (g.astype(jnp.float32) * scale).astype(g.dtype)
 
 
 class ClipGradByValue:
@@ -22,7 +43,15 @@ class ClipGradByValue:
         self.min = float(min) if min is not None else -float(max)
 
     def __call__(self, grads):
-        return jax.tree_util.tree_map(lambda g: jnp.clip(g, self.min, self.max), grads)
+        def _clip(g):
+            if isinstance(g, SelectedRows):
+                g = g.merged()  # clamp the summed row grad, not the parts
+                return SelectedRows(g.ids, jnp.clip(g.values, self.min,
+                                                    self.max),
+                                    g.height, _merged=True)
+            return jnp.clip(g, self.min, self.max)
+
+        return jax.tree_util.tree_map(_clip, grads)
 
     def __repr__(self):
         return f"ClipGradByValue(min={self.min}, max={self.max})"
@@ -36,9 +65,9 @@ class ClipGradByNorm:
 
     def __call__(self, grads):
         def _clip(g):
-            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            norm = jnp.sqrt(_sq_norm(g))
             scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-12))
-            return (g.astype(jnp.float32) * scale).astype(g.dtype)
+            return _scaled(g, scale)
 
         return jax.tree_util.tree_map(_clip, grads)
 
@@ -61,13 +90,9 @@ class ClipGradByGlobalNorm:
         leaves = jax.tree_util.tree_leaves(grads)
         if not leaves:
             return grads
-        gnorm = jnp.sqrt(
-            sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
-        )
+        gnorm = jnp.sqrt(sum(_sq_norm(g) for g in leaves))
         scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
-        return jax.tree_util.tree_map(
-            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads
-        )
+        return jax.tree_util.tree_map(lambda g: _scaled(g, scale), grads)
 
     def __repr__(self):
         return f"ClipGradByGlobalNorm(clip_norm={self.clip_norm})"

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.errors import InvalidArgumentError
+from ..framework.selected_rows import SelectedRows
 from ..nn.layer_base import Parameter
 from .lr import LRScheduler
 
@@ -176,10 +177,26 @@ class Optimizer:
             slots["master"] = p.astype(jnp.float32)
         return slots
 
+    # How the rule treats a SelectedRows (sparse embedding) gradient:
+    #   "row"       — always update only the touched rows (the reference's
+    #                 sparse SGD/momentum/adagrad kernels, e.g.
+    #                 operators/optimizers/sgd_op.h SelectedRows branch);
+    #   "lazy_flag" — touched-rows iff lazy_mode=True, else densify (the
+    #                 reference Adam semantics, fluid/optimizer.py:2026);
+    #   "dense"     — always densify (rules needing whole-param statistics,
+    #                 e.g. Lamb's trust ratio).
+    _sparse_mode = "dense"
+    _lazy_mode = False
+
     def _rule(self, p, g, slots, lr, count, name):
         """Returns (new_param, new_slots). Subclasses implement _update on
         the f32 master view; this wrapper handles master-weight plumbing and
         L2 weight decay."""
+        if isinstance(g, SelectedRows):
+            if self._sparse_mode == "row" or (
+                    self._sparse_mode == "lazy_flag" and self._lazy_mode):
+                return self._sparse_row_rule(p, g, slots, lr, count, name)
+            g = g.merged().to_dense()
         out_dtype = p.dtype
         slots = dict(slots)
         master = slots.get("master")
@@ -198,6 +215,41 @@ class Optimizer:
 
     def _use_l2_decay(self, name: str) -> bool:
         return True
+
+    def _sparse_row_rule(self, p, g: "SelectedRows", slots, lr, count, name):
+        """Touched-rows-only update: gather the k touched rows of the param
+        and every slot, run the elementwise ``_update`` on the row view, and
+        scatter back — O(k·D), independent of the table height.  Duplicate
+        ids are segment-summed first; sentinel ids (== height) gather fill
+        zeros and their scatters are dropped."""
+        g = g.merged()
+        ids = g.ids
+        out_dtype = p.dtype
+        slots = dict(slots)
+        master = slots.get("master")
+        w = master if master is not None else p
+        w_rows = w.at[ids].get(mode="fill", fill_value=0)
+        g_rows = g.values.astype(w_rows.dtype)
+        if self._use_l2_decay(name):
+            if self._regularizer is not None:
+                g_rows = g_rows + self._regularizer(w_rows).astype(
+                    w_rows.dtype)
+            elif self._weight_decay:
+                g_rows = g_rows + self._weight_decay * w_rows
+        row_slots = {k: v.at[ids].get(mode="fill", fill_value=0)
+                     for k, v in slots.items() if k != "master"}
+        new_rows, new_row_slots = self._update(w_rows, g_rows, row_slots,
+                                               lr, count)
+        for k, v in new_row_slots.items():
+            slots[k] = slots[k].at[ids].set(v.astype(slots[k].dtype),
+                                            mode="drop")
+        if master is not None:
+            slots["master"] = master.at[ids].set(
+                new_rows.astype(master.dtype), mode="drop")
+            new_p = p.at[ids].set(new_rows.astype(out_dtype), mode="drop")
+        else:
+            new_p = w.at[ids].set(new_rows.astype(out_dtype), mode="drop")
+        return new_p, slots
 
     def _update(self, w, g, slots, lr, count):
         raise NotImplementedError
@@ -335,7 +387,11 @@ class Optimizer:
 # Concrete rules (reference kernels cited per class)
 # ---------------------------------------------------------------------------
 class SGD(Optimizer):
-    """param -= lr * grad  (ref: operators/optimizers/sgd_op.h)."""
+    """param -= lr * grad  (ref: operators/optimizers/sgd_op.h — whose
+    SelectedRows branch updates only touched rows; _sparse_mode="row"
+    matches it)."""
+
+    _sparse_mode = "row"
 
     def _update(self, w, g, slots, lr, count):
         return w - lr * g, slots
@@ -343,7 +399,10 @@ class SGD(Optimizer):
 
 class Momentum(Optimizer):
     """Heavy-ball / Nesterov momentum (ref: momentum_op.h:127 — velocity =
-    mu*velocity + grad; nesterov: p -= (grad + mu*velocity)*lr)."""
+    mu*velocity + grad; nesterov: p -= (grad + mu*velocity)*lr; its
+    SelectedRows kernel updates touched rows only)."""
+
+    _sparse_mode = "row"
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
@@ -370,7 +429,10 @@ class Momentum(Optimizer):
 
 
 class Adagrad(Optimizer):
-    """moment += g²; p -= lr * g / (sqrt(moment)+eps) (ref: adagrad_op.h)."""
+    """moment += g²; p -= lr * g / (sqrt(moment)+eps) (ref: adagrad_op.h —
+    sparse branch touches only the gradient's rows)."""
+
+    _sparse_mode = "row"
 
     def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
                  weight_decay=None, grad_clip=None, name=None,
@@ -396,8 +458,11 @@ class Ftrl(Optimizer):
     """FTRL-proximal (ref: operators/optimizers/ftrl_op.h:74-100):
     squared-gradient accumulator + linear accumulator with L1 soft
     threshold; ``lr_power=-0.5`` is the McMahan et al. schedule.  The
-    CTR-workhorse optimizer of the reference's PS mode — dense here
-    (sparse rows become dense grads under XLA)."""
+    CTR-workhorse optimizer of the reference's PS mode — with SelectedRows
+    gradients the accumulators update on touched rows only (the reference's
+    sparse ftrl kernel)."""
+
+    _sparse_mode = "row"
 
     def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
                  parameters=None, weight_decay=None, grad_clip=None,
@@ -438,7 +503,15 @@ class Ftrl(Optimizer):
 
 class Adam(Optimizer):
     """Adam (ref: adam_op.h:430 — bias-corrected via beta^t accumulators;
-    here beta^t is computed from the shared step count)."""
+    here beta^t is computed from the shared step count).
+
+    ``lazy_mode=True`` (ref: fluid/optimizer.py:2026): with a SelectedRows
+    gradient from ``Embedding(sparse=True)``, only the touched rows' params
+    AND moments update — O(touched) per step.  With ``lazy_mode=False`` a
+    sparse gradient is densified and every row's moments decay, exactly the
+    reference's non-lazy sparse Adam."""
+
+    _sparse_mode = "lazy_flag"
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
@@ -449,6 +522,7 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._lazy_mode = bool(lazy_mode)
 
     def _init_slots(self, p, name):
         slots = super()._init_slots(p, name)
@@ -500,6 +574,30 @@ class AdamW(Adam):
         return False
 
     def _rule(self, p, g, slots, lr, count, name):
+        if isinstance(g, SelectedRows) and self._lazy_mode:
+            # lazy semantics: rows absent from the minibatch are untouched
+            # entirely, so the decoupled decay too applies only to touched
+            # rows (XLA CSE dedupes the repeated merged() computation)
+            g = g.merged()
+            new_p, slots = super()._rule(p, g, slots, lr, count, name)
+            if self._coeff and (self._decay_fn is None
+                                or self._decay_fn(name)):
+                ids = g.ids
+                factor = 1.0 - lr * self._coeff
+                slots = dict(slots)
+                master = slots.get("master")
+                if master is not None:
+                    rows = master.at[ids].get(mode="fill", fill_value=0)
+                    rows = rows * factor
+                    slots["master"] = master.at[ids].set(rows, mode="drop")
+                    new_p = new_p.at[ids].set(rows.astype(new_p.dtype),
+                                              mode="drop")
+                else:
+                    rows = new_p.at[ids].get(mode="fill", fill_value=0)
+                    rows = (rows.astype(jnp.float32) * factor)
+                    new_p = new_p.at[ids].set(rows.astype(new_p.dtype),
+                                              mode="drop")
+            return new_p, slots
         new_p, slots = super()._rule(p, g, slots, lr, count, name)
         if self._coeff and (self._decay_fn is None or self._decay_fn(name)):
             master = slots.get("master")
