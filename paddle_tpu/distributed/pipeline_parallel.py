@@ -201,9 +201,27 @@ def pipeline_train_step(
     schedule: str = "1f1b",
     mesh=None,
     axis_name: str = "pipe",
+    params: Optional[Dict[str, jax.Array]] = None,
+    head_params=None,
+    head_loss_fn=None,
+    return_dx: bool = False,
+    rng_key: Optional[jax.Array] = None,
 ):
     """One pipelined fwd+bwd pass: returns ``(mean_loss, grads)`` with
     ``grads = {param_name_within_block: [L, ...]}`` stacked over blocks.
+
+    Full-model mode (how ``Model.fit`` drives 1F1B, matching the reference
+    SectionWorker where the first/last sections hold the embedding and the
+    loss): pass ``params`` (the traced stacked block params — so the step
+    differentiates the *caller's* pytree, not eager box snapshots),
+    ``head_loss_fn(y_mb, label_mb, head_params)`` with ``head_params`` (the
+    non-block parameters; the last stage differentiates both per
+    microbatch), and ``return_dx=True`` to get the cotangent w.r.t. ``x``
+    for the caller's embedding vjp.  Returns
+    ``(loss, block_grads, dx, head_grads)`` in that mode.  ``labels`` may
+    be any pytree of arrays with leading batch dim.  ``rng_key`` seeds
+    per-(block, microbatch) dropout; required under jit (the eager
+    generator cannot be read at trace time).
 
     ``schedule="1f1b"`` interleaves each stage's forwards and backwards in
     ONE lax.scan (the reference SectionWorker's 1F1B thread loop,
@@ -230,7 +248,14 @@ def pipeline_train_step(
     pp = mesh.shape.get(axis_name, 1)
     L = len(blocks)
     template = blocks[0]
-    stacked_flat = _stack_block_params(blocks)  # {n: [L, ...]}
+    stacked_flat = (params if params is not None
+                    else _stack_block_params(blocks))  # {n: [L, ...]}
+    if head_loss_fn is None and return_dx:
+        # dx without head params: synthesize the head closure from loss_fn
+        head_loss_fn = lambda yy, lbl, _hp: loss_fn(yy, lbl)  # noqa: E731
+    full_mode = head_loss_fn is not None
+    if full_mode and head_params is None:
+        head_params = {}
 
     schedule = str(schedule).lower()
     if schedule == "f-then-b":  # the reference's name for fwd-all-bwd-all
@@ -240,8 +265,23 @@ def pipeline_train_step(
             f"pipeline schedule must be '1f1b', 'gpipe' or 'F-then-B', "
             f"got {schedule!r}")
 
-    labels = jnp.asarray(labels)
+    labels = jax.tree_util.tree_map(jnp.asarray, labels)
     if schedule == "gpipe" or pp == 1:
+        if full_mode or return_dx:
+            # one differentiable graph: GPipe is plain value_and_grad over
+            # the same decomposition (used for 1f1b loss-parity checks and
+            # the pp=1 degenerate case)
+            def lfn(st, hp, xx):
+                y = pipeline_blocks(blocks, xx,
+                                    num_microbatches=num_microbatches,
+                                    mesh=mesh, axis_name=axis_name,
+                                    params=st)
+                return head_loss_fn(y, labels, hp)
+
+            loss, (g_blocks, g_head, dx) = jax.value_and_grad(
+                lfn, argnums=(0, 1, 2))(stacked_flat, head_params, x)
+            return loss, g_blocks, dx, g_head
+
         def lfn(st):
             y = pipeline_blocks(blocks, x,
                                 num_microbatches=num_microbatches,
@@ -266,16 +306,20 @@ def pipeline_train_step(
     RB = ring_buffer_slots(pp)
 
     training = bool(getattr(template, "training", False))
-    base_key = current_rng_key() if training else jax.random.PRNGKey(0)
+    if rng_key is not None:
+        base_key = rng_key
+    else:
+        base_key = current_rng_key() if training else jax.random.PRNGKey(0)
 
     stacked = {n: v.reshape((pp, per_stage) + v.shape[1:])
                for n, v in stacked_flat.items()}
 
-    def local(stage_params, xin, yin):
+    def local(stage_params, xin, yin, head_p):
         stage_params = {n: v[0] for n, v in stage_params.items()}
         stage = lax.axis_index(axis_name)
         micro = xin.reshape((M, mb) + xin.shape[1:])
-        lmicro = yin.reshape((M, mb) + yin.shape[1:])
+        lmicro = jax.tree_util.tree_map(
+            lambda a: a.reshape((M, a.shape[0] // M) + a.shape[1:]), yin)
         act_shape = (mb,) + xin.shape[1:]
 
         def stage_apply(pdict, h, mb_idx):
@@ -293,18 +337,29 @@ def pipeline_train_step(
 
         zero_grads = jax.tree_util.tree_map(
             lambda v: jnp.zeros_like(v, jnp.float32), stage_params)
+        zero_head = jax.tree_util.tree_map(
+            lambda v: jnp.zeros_like(v, jnp.float32), head_p)
         carry0 = (
             jnp.zeros(act_shape, x.dtype),           # fwd_recv
             jnp.zeros(act_shape, jnp.float32),       # bwd_recv (cotangent)
             jnp.zeros((RB,) + act_shape, x.dtype),   # saved stage inputs
             zero_grads,                              # grad accumulator
             jnp.zeros((), jnp.float32),              # loss accumulator
+            zero_head,                               # head grad accumulator
+            jnp.zeros((M,) + act_shape, jnp.float32)  # dx per microbatch
+            if return_dx else jnp.zeros((), jnp.float32),
         )
         i32 = jnp.int32
         is_last = stage == pp - 1
 
+        def mb_loss(yy, lbl, hp):
+            if full_mode:
+                return head_loss_fn(yy, lbl, hp)
+            return loss_fn(yy, lbl)
+
         def tick(carry, t):
-            fwd_recv, bwd_recv, ring, grad_acc, loss_acc = carry
+            (fwd_recv, bwd_recv, ring, grad_acc, loss_acc, head_acc,
+             dx_buf) = carry
             t = t.astype(i32)
             f = t - stage
             b = t - (i32(2 * pp - 2) - stage)
@@ -323,12 +378,20 @@ def pipeline_train_step(
                 lax.dynamic_update_index_in_dim(ring, h_in, f_safe % RB, 0),
                 ring)
 
-            # ---- last stage: per-microbatch loss + output cotangent; its
-            # backward microbatch b equals f, so dy feeds this very tick
-            lbl = lax.dynamic_index_in_dim(lmicro, f_safe, 0, keepdims=False)
-            loss_val, dy = jax.value_and_grad(
-                lambda yy: loss_fn(yy, lbl))(y.astype(jnp.float32))
+            # ---- last stage: per-microbatch loss + output cotangent (and,
+            # in full mode, the head/loss parameter grads); its backward
+            # microbatch b equals f, so dy feeds this very tick
+            lbl = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, f_safe, 0,
+                                                   keepdims=False), lmicro)
+            loss_val, (dy, dhead) = jax.value_and_grad(
+                lambda yy, hp: mb_loss(yy, lbl, hp), argnums=(0, 1))(
+                    y.astype(jnp.float32), head_p)
             loss_acc = loss_acc + jnp.where(do_f & is_last, loss_val, 0.0)
+            head_acc = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(do_f & is_last,
+                                           g.astype(jnp.float32), 0.0),
+                head_acc, dhead)
             dy = dy / M  # total loss is the MEAN over microbatches
 
             # ---- backward tick for microbatch b (recompute-from-input)
@@ -343,6 +406,13 @@ def pipeline_train_step(
             grad_acc = jax.tree_util.tree_map(
                 lambda a, g: a + jnp.where(do_b, g.astype(jnp.float32), 0.0),
                 grad_acc, dparams)
+            if return_dx:
+                # stage 0's input cotangent IS dloss/dx for microbatch b
+                dx_buf = jnp.where(
+                    do_b & (stage == 0),
+                    lax.dynamic_update_index_in_dim(
+                        dx_buf, dh.astype(jnp.float32), b_safe, 0),
+                    dx_buf)
 
             # ---- neighbor exchange: activations down, cotangents up
             fwd_recv = lax.ppermute(
@@ -350,24 +420,40 @@ def pipeline_train_step(
             bwd_recv = lax.ppermute(
                 jnp.where(do_b, dh.astype(jnp.float32), 0.0), axis_name,
                 [(i, (i - 1) % pp) for i in range(pp)])
-            return (fwd_recv, bwd_recv, ring, grad_acc, loss_acc), None
+            return (fwd_recv, bwd_recv, ring, grad_acc, loss_acc, head_acc,
+                    dx_buf), None
 
         T = M + 2 * pp - 2
-        (fwd_recv, bwd_recv, ring, grad_acc, loss_acc), _ = lax.scan(
-            tick, carry0, jnp.arange(T))
+        (fwd_recv, bwd_recv, ring, grad_acc, loss_acc, head_acc,
+         dx_buf), _ = lax.scan(tick, carry0, jnp.arange(T))
         loss = lax.psum(loss_acc, axis_name) / M
         # grads live per-stage; shard_map reassembles the pp axis
         grad_acc = jax.tree_util.tree_map(lambda g: g[None], grad_acc)
-        return loss, grad_acc
+        # head grads exist on the last stage only; dx on stage 0 only —
+        # psum replicates both across the ring
+        head_acc = jax.tree_util.tree_map(
+            lambda g: lax.psum(
+                jnp.where(is_last, g, jnp.zeros_like(g)), axis_name) / M,
+            head_acc)
+        dx_out = (lax.psum(
+            jnp.where(stage == 0, dx_buf, jnp.zeros_like(dx_buf)),
+            axis_name) if return_dx else dx_buf)
+        return loss, grad_acc, head_acc, dx_out
 
     shmapped = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=({n: P(axis_name) for n in stacked}, P(), P()),
-        out_specs=(P(), {n: P(axis_name) for n in stacked}),
+        in_specs=({n: P(axis_name) for n in stacked}, P(), P(), P()),
+        out_specs=(P(), {n: P(axis_name) for n in stacked}, P(), P()),
         axis_names={axis_name},
         check_vma=False,
     )
-    loss, grads = shmapped(stacked, x, labels)
+    loss, grads, head_grads, dx = shmapped(stacked, x, labels, head_params)
     grads = {n: g.reshape((L,) + g.shape[2:]) for n, g in grads.items()}
+    if full_mode or return_dx:
+        if return_dx:
+            dx = dx.reshape((B,) + x.shape[1:])
+        else:
+            dx = None
+        return loss, grads, dx, head_grads
     return loss, grads

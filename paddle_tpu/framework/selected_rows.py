@@ -241,7 +241,14 @@ def build_sparse_step(forward_loss: Callable, sparse_names: Dict[int, str],
     Returns ``grad_fn(params) -> ((loss, aux), grads)`` where ``grads`` has
     dense leaves for dense params and :class:`SelectedRows` leaves for the
     sparse tables — and, critically, no O(N) cotangent is ever built for a
-    table."""
+    table.
+
+    CONTRACT: sparse tables are excluded from the differentiated arguments,
+    so they receive gradients ONLY through tape taps (embedding lookups).
+    A forward that reads a sparse table any other way — tied heads,
+    explicit weight regularization — trains that use against a constant,
+    silently.  Such tables must stay ``sparse=False`` (see the
+    nn.Embedding docstring)."""
     names = set(table_shapes)
 
     def grad_fn(params):

@@ -185,6 +185,7 @@ class Model:
         # fleet path: distributed_optimizer tagged the optimizer — lower the
         # strategy to mesh shardings (replaces meta-opt minimize, SURVEY §3.4)
         self._plan = None
+        use_1f1b, pipe_micro = False, None
         strategy = getattr(optimizer, "_fleet_strategy", None)
         if strategy is not None:
             from ..distributed.fleet.plan import ShardingPlan
@@ -220,15 +221,24 @@ class Model:
                         "pipeline_configs['schedule'] must be 'gpipe'/"
                         f"'F-then-B'/'1F1B', got {sched!r}")
                 if sched == "1f1b":
-                    import warnings
-
-                    warnings.warn(
-                        "pipeline_configs['schedule']='1f1b' is a train-step"
-                        "-level schedule: drive it with distributed."
-                        "pipeline_parallel.pipeline_train_step (grads "
-                        "computed inside the interleaved schedule); "
-                        "Model.fit's in-forward pipeline runs GPipe",
-                        RuntimeWarning)
+                    if not hasattr(net, "pipeline_decompose"):
+                        raise InvalidArgumentError(
+                            "pipeline schedule '1f1b' needs the network to "
+                            "implement pipeline_decompose() -> {'pre', "
+                            "'blocks', 'post'} (GPTForCausalLM does); "
+                            "in-forward pipelining supports GPipe only")
+                    if self._metrics:
+                        raise InvalidArgumentError(
+                            "1F1B computes per-microbatch losses inside the "
+                            "interleaved schedule and does not assemble "
+                            "full-batch outputs — host-side metrics cannot "
+                            "update; drop metrics or use schedule='gpipe'")
+                    if list(net.named_buffers()):
+                        raise InvalidArgumentError(
+                            "1F1B pipeline sections must be buffer-free "
+                            "(running-stat updates cannot cross the "
+                            "interleaved schedule)")
+                    use_1f1b, pipe_micro = True, micro
                 hits = 0
                 for sub in net.sublayers(include_self=True):
                     if hasattr(sub, "pipeline_microbatches"):
@@ -286,6 +296,58 @@ class Model:
                     "transforming fleet strategies (fp16_allreduce / dgc): "
                     "their per-replica reductions tree_map dense leaves. "
                     "Use the default or sharding strategy, or sparse=False")
+
+        if use_1f1b:
+            # the production 1F1B path (VERDICT r3 #2, ref:
+            # section_worker.cc:82-230): the train step IS the interleaved
+            # schedule — per-microbatch fwd/bwd in one lax.scan over the
+            # `pipe` ring, embedding vjp fed by the schedule's dx, head/loss
+            # grads accumulated on the last stage, optimizer update in the
+            # same jitted computation
+            from ..distributed.pipeline_parallel import pipeline_train_step
+
+            d = net.pipeline_decompose()
+            blocks, pre_call, post_call = d["blocks"], d["pre"], d["post"]
+            box_names = {id(box): n for n, box in net.named_parameters()}
+            block_maps = [
+                {n: box_names[id(b_)] for n, b_ in blk.named_parameters()}
+                for blk in blocks]
+            inner = sorted(block_maps[0])
+            block_fullnames = {fn for m in block_maps for fn in m.values()}
+
+            def train_step(params, opt_state, buffers, key, lr, *batch):
+                inputs, labels = self._split_batch(batch)
+                other = {k: v for k, v in params.items()
+                         if k not in block_fullnames}
+                stacked = {n: jnp.stack([params[m[n]] for m in block_maps])
+                           for n in inner}
+                x_emb, pre_vjp = jax.vjp(lambda op: functional_call(
+                    net, op, *inputs, rngs=key, training=True,
+                    call=pre_call), other)
+
+                def head_loss(y_mb, lbl_mb, op):
+                    logits = functional_call(net, op, y_mb, training=True,
+                                             call=post_call)
+                    return loss_fn(*(_tuplize(logits) + tuple(lbl_mb)))
+
+                loss_val, g_blocks, dx, g_head = pipeline_train_step(
+                    blocks, x_emb, tuple(labels), None,
+                    num_microbatches=pipe_micro, schedule="1f1b",
+                    params=stacked, head_params=other,
+                    head_loss_fn=head_loss, return_dx=True, rng_key=key)
+                (d_pre,) = pre_vjp(dx.astype(x_emb.dtype))
+                grads = {}
+                for n in inner:
+                    for i, m in enumerate(block_maps):
+                        grads[m[n]] = g_blocks[n][i]
+                for k2 in other:
+                    grads[k2] = (jnp.asarray(d_pre[k2], jnp.float32)
+                                 + jnp.asarray(g_head[k2], jnp.float32))
+                new_params, new_opt_state = opt.update(grads, opt_state,
+                                                       params, lr=lr)
+                # out == loss: 1F1B never assembles full-batch logits
+                # (metrics are rejected in the strategy block above)
+                return loss_val, loss_val, new_params, new_opt_state, buffers
 
         if optimizer is not None:
             if self._plan is not None:

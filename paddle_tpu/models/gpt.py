@@ -266,3 +266,28 @@ class GPTForCausalLM(Layer):
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         return -ll.mean()
+
+    # -- 1F1B decomposition (consumed by Model.prepare when
+    #    pipeline_configs={"schedule": "1f1b"}; see hapi/model.py) ----------
+    def pipeline_pre(self, input_ids):
+        """Embedding prologue — the first section of the reference's cut
+        program (SectionWorker stage 0 holds the embedding lookup)."""
+        B, S = input_ids.shape
+        pos = jnp.arange(S, dtype=jnp.int32)[None, :]
+        x = self.gpt.wte(input_ids) + self.gpt.wpe(pos)
+        return self.gpt.drop(x)
+
+    def pipeline_post(self, h):
+        """Final norm + tied LM head — the last section (holds the loss in
+        the reference's SectionWorker; here the loss_fn composes outside)."""
+        h = self.gpt.ln_f(h)
+        logits = jnp.einsum("bsd,vd->bsv", h, jnp.asarray(self.gpt.wte.weight))
+        return constrain(logits, None, None, None)
+
+    def pipeline_decompose(self):
+        """(pre, blocks, post) for the interleaved 1F1B train step: ``pre``
+        and ``post`` run replicated over ``pipe``; ``blocks`` is the
+        homogeneous pipelined section."""
+        return {"pre": self.pipeline_pre,
+                "blocks": list(self.gpt.blocks),
+                "post": self.pipeline_post}

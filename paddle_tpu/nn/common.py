@@ -57,7 +57,14 @@ class Embedding(Layer):
     a dense O(vocab) cotangent, and lazy-mode optimizers update only the
     touched rows — see framework/selected_rows.py (ref:
     paddle/fluid/framework/selected_rows.h:41).  Outside such a step the
-    flag is inert and gradients are dense (XLA scatter-add)."""
+    flag is inert and gradients are dense (XLA scatter-add).
+
+    CONTRACT: with ``sparse=True`` the table receives gradients ONLY
+    through embedding lookups (this layer's forward).  Any other read of
+    ``weight`` — tied output heads, explicit regularization terms, custom
+    matmuls — trains it as a constant for that use (same as the reference,
+    where SelectedRows grads exist only for lookup_table ops).  Keep
+    ``sparse=False`` for tied-weight tables."""
 
     def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
                  sparse=False, weight_attr=None, name=None):

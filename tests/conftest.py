@@ -29,6 +29,37 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 
+# -- fast / slow lanes -------------------------------------------------------
+# `pytest -m fast` is the <5-minute inner-loop lane; the full (~20 min,
+# 1-core) suite stays the merge gate.  Files land in SLOW_FILES by measured
+# wall time (per-file totals from --durations, 2026-07-31); everything else
+# is auto-marked fast.  A file-level split keeps the list maintainable —
+# re-run `pytest --durations=120` and update when a file's cost changes class.
+SLOW_FILES = {
+    "test_vision.py", "test_models.py", "test_attention.py",
+    "test_sequence_parallel_model.py", "test_detection_targets.py",
+    "test_detection.py", "test_io.py", "test_launch_env.py",
+    "test_roi_extra.py", "test_pipeline.py", "test_strategies.py",
+    "test_extension_ops.py", "test_distributed.py", "test_heartbeat.py",
+    "test_nn_functional.py", "test_nn_layers.py", "test_fluid_compat.py",
+    "test_crf.py", "test_slim.py", "test_sparse_embedding.py",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "fast: quick lane (pytest -m fast, <5 min total)")
+    config.addinivalue_line(
+        "markers", "slow: heavy tests excluded from the fast lane")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        fname = os.path.basename(item.nodeid.split("::")[0])
+        item.add_marker(
+            pytest.mark.slow if fname in SLOW_FILES else pytest.mark.fast)
+
+
 @pytest.fixture
 def rng():
     return np.random.RandomState(0)

