@@ -35,15 +35,17 @@ from . import contrib  # noqa: F401
 from .param_attr import WeightNormParamAttr  # noqa: F401
 from paddle_tpu import profiler  # noqa: F401
 
-# 1.x entry points that ARE portable
+# 1.x entry points: the lazy-graph Program/Executor (static/graph.py)
 from paddle_tpu.static import (  # noqa: F401
-    data, cpu_places, cuda_places, name_scope,
-    # Program-machinery shims (raise on use, naming the eager path)
+    cpu_places, cuda_places, name_scope,
     Program, Executor, CompiledProgram, ParallelExecutor, Scope,
     Variable, global_scope, scope_guard, program_guard,
     default_main_program, default_startup_program, BuildStrategy,
     ExecutionStrategy,
 )
+# fluid.data declares a graph feed slot (a symbolic Variable), unlike
+# paddle.static.data which doubles as the 2.0 export InputSpec
+from paddle_tpu.static.graph import data  # noqa: F401
 from paddle_tpu.static import (  # noqa: F401
     save_inference_model, load_inference_model, load_program_state,
     set_program_state,

@@ -54,3 +54,9 @@ class _OpsShim:
 
 
 ops = _OpsShim()
+
+
+class EOFException(Exception):
+    """Raised by Executor.run when a started py_reader's pass ends
+    (ref: paddle/fluid/framework/../platform EOFException → the Python
+    ``fluid.core.EOFException`` 1.x training loops catch)."""

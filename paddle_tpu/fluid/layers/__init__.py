@@ -144,7 +144,17 @@ def one_hot(input, depth, allow_out_of_range=False):
 def fill_constant(shape, dtype, value, force_cpu=False, out=None):
     from ...framework.dtype import convert_dtype
 
-    return jnp.full(tuple(int(s) for s in shape), value, convert_dtype(dtype))
+    dt = convert_dtype(dtype)
+    shape = tuple(int(s) for s in shape)
+    from ...static.graph import in_program_guard, record_call as _rc
+
+    if in_program_guard():
+        # under program_guard the constant is a named graph Variable —
+        # 1.x While/StaticRNN loop state is initialized this way and the
+        # NAME is what the loop carries
+        return _rc(lambda: jnp.full(shape, value, dt),
+                   prefix="fill_constant")
+    return jnp.full(shape, value, dt)
 
 
 def create_tensor(dtype, name=None, persistable=False):
@@ -803,3 +813,328 @@ def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
             return _jnp.where(step < self.warmup_steps, ramp, decayed)
 
     return _GlobalStepWarmup(learning_rate, warmup_steps, start_lr, end_lr)
+
+
+# ======================================================================
+# Graph mode (static/graph.py): the 1.x build/run flow.
+# ======================================================================
+# Control flow — eager/traced/graph dispatch (control_flow.py in this
+# package; ref control_flow.py:2298/1110/971/449/2576/2715); the imports
+# SHADOW the eager-only re-exports above where the 1.x signature differs
+# (increment's in_place, less_than's cond= out-param).
+from .control_flow import (  # noqa: E402,F401
+    cond, while_loop, case, switch_case, While, StaticRNN, increment,
+    less_than, array_write, array_read, array_length, create_array,
+    tensor_array_to_tensor, Assert,
+)
+
+# Parameter-creating op-builders over the recorded graph (static/builders)
+from paddle_tpu.static.builders import (  # noqa: E402,F401
+    fc, embedding, conv2d, pool2d, batch_norm, layer_norm,
+    conv2d_transpose, conv3d, conv3d_transpose, instance_norm, group_norm,
+    spectral_norm, prelu, bilinear_tensor_product,
+)
+
+from paddle_tpu.static.graph import (  # noqa: E402
+    Variable as _GraphVar, data as _graph_data, maybe_record as _maybe_record,
+    record_call as _record_call,
+)
+
+
+def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
+         type=None, stop_gradient=True):
+    """1.x fluid.layers.data (ref: fluid/layers/io.py:54): unlike
+    fluid.data, prepends the implicit -1 batch dim unless the shape
+    already leads with -1 or append_batch_size=False."""
+    shape = list(shape)
+    if append_batch_size and (not shape or shape[0] != -1):
+        shape = [-1] + shape
+    return _graph_data(name, shape, dtype)
+
+
+def assign(input, output=None):
+    """1.x assign with the ``output=`` out-parameter: in graph mode the
+    value is written to output's NAME (the in-place idiom While-loop
+    bodies use); eager falls through to tensor.assign."""
+    if isinstance(output, _GraphVar):
+        return _record_call(lambda v: jnp.asarray(v), input,
+                            out_names=[output.name], prefix="assign")
+    if isinstance(input, _GraphVar):
+        return _record_call(lambda v: jnp.asarray(v), input, prefix="assign")
+    from paddle_tpu.tensor import assign as _assign
+
+    return _assign(input) if output is None else _assign(input, output)
+
+
+# SelectedRows ops — real now (framework/selected_rows.py)
+def merge_selected_rows(x, name=None):
+    """ref: operators/merge_selected_rows_op — segment-sums duplicate rows
+    of a SelectedRows gradient."""
+    from paddle_tpu.framework.selected_rows import SelectedRows
+
+    if not isinstance(x, SelectedRows):
+        raise UnimplementedError(
+            "merge_selected_rows expects a SelectedRows gradient — they "
+            "come from Embedding(sparse=True) inside a sparse-aware train "
+            "step (framework/selected_rows.py)")
+    return x.merged()
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """ref: operators/get_tensor_from_selected_rows_op — the [k, D] row
+    values of a SelectedRows."""
+    from paddle_tpu.framework.selected_rows import SelectedRows
+
+    if not isinstance(x, SelectedRows):
+        raise UnimplementedError(
+            "get_tensor_from_selected_rows expects a SelectedRows gradient")
+    return x.values
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """ref: operators/hash_op (XXH64 % hash_size per row).  Same
+    dimensionality-reduction capability with a splitmix64-style integer
+    mix instead of xxhash (documented deviation: hashed ids differ from
+    the reference's, which only matters when loading reference-trained
+    embeddings over hashed slots)."""
+    x = jnp.asarray(input, jnp.uint64)
+
+    def mix(v, seed):
+        v = v ^ jnp.uint64(seed * 0x9E3779B97F4A7C15 & 0xFFFFFFFFFFFFFFFF)
+        v = (v ^ (v >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+        v = (v ^ (v >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+        return v ^ (v >> jnp.uint64(31))
+
+    # combine the last-dim elements of each row into one key, then hash
+    # num_hash times with different seeds
+    key = x.reshape(x.shape[:-1] + (-1,))
+    row = key[..., 0]
+    for j in _range(1, key.shape[-1]):
+        row = mix(row, 1) + key[..., j]
+    outs = [(mix(row, seed + 1) % jnp.uint64(hash_size)).astype(jnp.int64)
+            for seed in _range(num_hash)]
+    return jnp.stack(outs, axis=-1)
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """ref: operators/sample_logits_op + softmax_with_cross_entropy —
+    softmax CE over the true class plus ``num_samples`` uniformly sampled
+    negatives (the sampled-softmax estimator for huge softmax layers)."""
+    logits = jnp.asarray(logits)
+    label = jnp.asarray(label).reshape(logits.shape[0], num_true)
+    n_cls = logits.shape[-1]
+    # seed==0 means "draw fresh" (1.x convention) — a fixed key would
+    # sample the SAME negatives every step, degenerating the estimator
+    if seed:
+        key = jax.random.PRNGKey(seed)
+    else:
+        from paddle_tpu.framework import random as _prandom
+
+        key = _prandom.default_generator().next_key()
+    neg = jax.random.randint(key, (logits.shape[0], int(num_samples)),
+                             0, n_cls)
+    if remove_accidental_hits:
+        # resample-by-shift: an accidental true hit moves to (id+1) % n
+        hit = (neg[..., None] == label[:, None, :]).any(-1)
+        neg = jnp.where(hit, (neg + 1) % n_cls, neg)
+    idx = jnp.concatenate([label, neg], axis=1)              # [B, T+S]
+    picked = jnp.take_along_axis(logits, idx, axis=1)
+    lse = jax.nn.logsumexp(picked, axis=1, keepdims=True)
+    true_logit = picked[:, :num_true]
+    return (lse - true_logit).reshape(label.shape[0], num_true)
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """ref: operators/teacher_student_sigmoid_loss_op.cc:107 — CTR
+    distillation loss: label in {-1} ∪ [0,1] ∪ (1,2] selects the
+    teacher/student mixing of sigmoid CE terms; x is clipped to the soft
+    bounds."""
+    x = jnp.clip(jnp.asarray(input, jnp.float32).reshape(-1),
+                 soft_max_lower_bound, soft_max_up_bound)
+    z = jnp.asarray(label, jnp.float32).reshape(-1)
+    log1pex = jnp.log1p(jnp.exp(-jnp.abs(x))) + jnp.maximum(x, 0.0)
+    # reference piecewise: z == -1 → pure negative CE; 0<=z<=1 → soft
+    # teacher CE with weight z; z>1 → student click CE + (z-1) scaling
+    neg = log1pex - 0  # -log sigmoid(-x) = log(1+e^x) = log1pex
+    soft = log1pex - x * z
+    stud = (log1pex - x) * (z - 1.0) + log1pex
+    loss = jnp.where(z < 0.0, neg, jnp.where(z <= 1.0, soft, stud))
+    return loss.reshape(jnp.asarray(input).shape[:-1] + (1,))
+
+
+def random_crop(x, shape, seed=None):
+    """ref: operators/random_crop_op — an INDEPENDENT random crop offset
+    per leading-dim instance (the reference draws per-instance), cropping
+    the trailing ``len(shape)`` dims to ``shape``."""
+    x = jnp.asarray(x)
+    shape = tuple(int(s) for s in shape)
+    k = len(shape)
+    from paddle_tpu.framework import random as _prandom
+
+    key = (jax.random.PRNGKey(seed) if seed else
+           _prandom.default_generator().next_key())
+    maxs = [x.shape[-k + i] - shape[i] for i in _range(k)]
+
+    def crop_one(sample, skey):
+        keys = jax.random.split(skey, k)
+        out = sample
+        for i in _range(k):
+            start = jax.random.randint(keys[i], (), 0, maxs[i] + 1)
+            out = jax.lax.dynamic_slice_in_dim(
+                out, start, shape[i], axis=sample.ndim - k + i)
+        return out
+
+    if x.ndim == k:  # single instance
+        return crop_one(x, key)
+    lead = x.shape[:-k]
+    flat = x.reshape((-1,) + x.shape[-k:])
+    keys = jax.random.split(key, flat.shape[0])
+    out = jax.vmap(crop_one)(flat, keys)
+    return out.reshape(lead + shape)
+
+
+# PyReader adapter — the 1.x feeding pipeline over io.DataLoader
+class _PyReaderAdapter:
+    """ref: fluid/layers/io.py py_reader / fluid/reader.py PyReader — a
+    capacity-bounded reader the Program pulls from.  Here the adapter owns
+    feed placeholder Variables; Executor.run() with no feed pulls the next
+    batch from every started reader (raising fluid.core.EOFException when
+    a pass ends, like the reference)."""
+
+    def __init__(self, capacity, shapes, dtypes, names):
+        self.capacity = capacity
+        self._vars = [
+            _graph_data(n, s, dt) for n, s, dt in zip(names, shapes, dtypes)]
+        self._source = None
+        self._iter = None
+        from paddle_tpu.static.graph import default_main_program
+
+        default_main_program()._readers = getattr(
+            default_main_program(), "_readers", [])
+        default_main_program()._readers.append(self)
+
+    # -- decoration (all three reference spellings) ----------------------
+    def decorate_sample_list_generator(self, generator, places=None):
+        self._source = generator
+
+    decorate_paddle_reader = decorate_sample_list_generator
+    decorate_batch_generator = decorate_sample_list_generator
+
+    def start(self):
+        if self._source is None:
+            raise UnimplementedError(
+                "py_reader: call decorate_sample_list_generator/"
+                "decorate_paddle_reader first")
+        self._iter = iter(self._source())
+
+    def reset(self):
+        self._iter = None
+
+    def next_feed(self):
+        from paddle_tpu.fluid.core import EOFException
+
+        if self._iter is None:
+            raise UnimplementedError("py_reader: call start() first")
+        try:
+            batch = next(self._iter)
+        except StopIteration:
+            self._iter = None
+            raise EOFException("pass end")
+        if isinstance(batch, (list, tuple)) and batch and isinstance(
+                batch[0], (list, tuple)):
+            # sample-list form: list of per-sample tuples → stack fields
+            import numpy as _np
+
+            batch = [_np.stack([_np.asarray(s[i]) for s in batch])
+                     for i in _range(len(batch[0]))]
+        return {v.name: b for v, b in zip(self._vars, batch)}
+
+    @property
+    def variables(self):
+        return list(self._vars)
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """ref: fluid/layers/io.py:415 py_reader — returns the reader object;
+    read its Variables with fluid.layers.read_file(reader)."""
+    from paddle_tpu.static.graph import default_main_program as _dmp
+
+    # unique per reader even unnamed (1.x uses unique_name): two readers
+    # must not collide on feed slot names
+    base = name or _dmp().unique_name("py_reader")
+    names = [f"{base}_{i}" for i in _range(len(shapes))]
+    return _PyReaderAdapter(capacity, shapes, dtypes, names)
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """ref: fluid/layers/io.py create_py_reader_by_data — py_reader whose
+    slots mirror existing data Variables."""
+    r = _PyReaderAdapter(capacity,
+                         [list(v.shape) for v in feed_list],
+                         [v.dtype for v in feed_list],
+                         [f"{name or 'py_reader'}_{v.name}" for v in feed_list])
+    return r
+
+
+def read_file(reader):
+    """ref: fluid/layers/io.py read_file — the reader's output Variables."""
+    if isinstance(reader, _PyReaderAdapter):
+        vs = reader.variables
+        return vs[0] if len(vs) == 1 else tuple(vs)
+    raise UnimplementedError(
+        "read_file expects a py_reader; for files use paddle.io.DataLoader")
+
+
+def double_buffer(reader, place=None, name=None):
+    """ref: fluid/layers/io.py double_buffer — device prefetch staging.
+    The DataLoader/Executor feed path is already double-buffered
+    (io/dataloader.py staging thread), so this is the identity."""
+    return reader
+
+
+# names implemented above are no longer shims
+for _impl in ("fc", "embedding", "conv2d", "conv3d", "conv2d_transpose",
+              "conv3d_transpose", "batch_norm", "layer_norm", "pool2d",
+              "instance_norm", "group_norm", "spectral_norm",
+              "bilinear_tensor_product", "cond", "while_loop", "case",
+              "switch_case", "While", "StaticRNN", "array_write",
+              "array_read", "array_length", "create_array",
+              "tensor_array_to_tensor", "Assert", "data", "py_reader",
+              "create_py_reader_by_data", "read_file", "double_buffer",
+              "merge_selected_rows", "get_tensor_from_selected_rows",
+              "hash", "random_crop", "sampled_softmax_with_cross_entropy",
+              "teacher_student_sigmoid_loss", "load"):
+    _STATIC_ONLY.pop(_impl, None)
+
+# `load` maps to the real serialization loader (fluid.io / paddle.load)
+from paddle_tpu.framework.serialization import load  # noqa: E402,F401
+
+# -- make the whole eager surface graph-capable: public functions called
+# with symbolic Variables record into the current Program instead of
+# executing (static/graph.py maybe_record); builders/control-flow handle
+# their own dispatch and are excluded
+import types as _types  # noqa: E402
+
+_NO_WRAP = {
+    "fc", "embedding", "conv2d", "conv3d", "conv2d_transpose",
+    "conv3d_transpose", "batch_norm", "layer_norm", "pool2d",
+    "instance_norm", "group_norm", "spectral_norm",
+    "bilinear_tensor_product", "cond", "while_loop", "case", "switch_case",
+    "increment", "less_than", "assign", "data", "py_reader",
+    "create_py_reader_by_data", "read_file", "double_buffer",
+    "array_write", "array_read", "array_length", "create_array",
+    "tensor_array_to_tensor", "Assert", "load",
+}
+for _n, _v in list(globals().items()):
+    if (isinstance(_v, _types.FunctionType) and not _n.startswith("_")
+            and _n not in _NO_WRAP):
+        globals()[_n] = _maybe_record(_v)
+del _n, _v

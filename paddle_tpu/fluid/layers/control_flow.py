@@ -1,0 +1,509 @@
+"""Control flow: cond / while_loop / case / switch_case / While / StaticRNN.
+
+Reference: python/paddle/fluid/layers/control_flow.py — ``cond`` (:2298),
+``while_loop`` (:1110), ``While`` (:971), ``StaticRNN`` (:449), ``case``
+(:2576), ``switch_case`` (:2715).  The reference builds
+conditional_block/while ops into the Program; here each name has the
+dispatch the execution mode calls for:
+
+* **eager** (concrete booleans): plain Python — ``cond`` is an ``if``,
+  ``while_loop`` a ``while``;
+* **traced** (inside jit / a tracer pred): ``lax.cond`` /
+  ``lax.while_loop`` / ``lax.switch`` — the XLA control-flow primitives
+  the reference's ops lower to conceptually;
+* **graph mode** (symbolic Variables from fluid.program_guard):
+  ``While``/``StaticRNN`` capture the ops their ``with`` blocks record
+  and replay them inside ``lax.while_loop``/``lax.scan`` at Executor.run
+  time, reproducing the 1.x block semantics (including the
+  ``less_than(..., cond=...)`` in-place idiom) without a Program
+  interpreter.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...framework.errors import InvalidArgumentError
+from ...static.graph import (Op, Variable, default_main_program, record_call,
+                             run_ops)
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "While",
+           "StaticRNN", "increment", "less_than", "array_write",
+           "array_read", "array_length", "create_array",
+           "tensor_array_to_tensor", "Assert"]
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def cond(pred, true_fn: Optional[Callable] = None,
+         false_fn: Optional[Callable] = None, name=None,
+         return_names=None):
+    """ref control_flow.py:2298 — both branches must return the same
+    structure.  Concrete pred → Python if (branches may be arbitrarily
+    dynamic); traced pred → lax.cond (both branches traced)."""
+    if true_fn is None and false_fn is None:
+        raise InvalidArgumentError("cond: need at least one branch fn")
+    true_fn = true_fn or (lambda: None)
+    false_fn = false_fn or (lambda: None)
+    if isinstance(pred, Variable):
+        raise InvalidArgumentError(
+            "cond over graph Variables: run the branch computation under "
+            "jit (@paddle.jit.to_static) where pred is traced, or use "
+            "fluid.layers.While for Program-style loops")
+    if _is_traced(pred):
+        return lax.cond(pred, true_fn, false_fn)
+    return true_fn() if bool(pred) else false_fn()
+
+
+def while_loop(cond_fn: Callable, body: Callable, loop_vars: Sequence,
+               is_test: bool = False, name=None):
+    """ref control_flow.py:1110 — ``loop_vars`` is a list/tuple pytree;
+    body returns the same structure.  Traced state → lax.while_loop
+    (shapes must be loop-invariant, the same constraint the reference's
+    while op has); concrete state → Python while."""
+    loop_vars = list(loop_vars)
+    traced = any(_is_traced(leaf)
+                 for leaf in jax.tree_util.tree_leaves(loop_vars)) or \
+        _is_traced(cond_fn(*loop_vars))
+    if traced:
+        out = lax.while_loop(lambda vs: cond_fn(*vs),
+                             lambda vs: tuple(body(*vs)) if isinstance(
+                                 body(*vs), (list, tuple)) else (body(*vs),),
+                             tuple(loop_vars))
+        return list(out)
+    while bool(cond_fn(*loop_vars)):
+        out = body(*loop_vars)
+        loop_vars = list(out) if isinstance(out, (list, tuple)) else [out]
+    return loop_vars
+
+
+def case(pred_fn_pairs, default: Optional[Callable] = None, name=None):
+    """ref control_flow.py:2576 — first true predicate wins.  Concrete
+    preds → sequential Python; any traced pred → nested lax.cond chain."""
+    if not pred_fn_pairs:
+        raise InvalidArgumentError("case: pred_fn_pairs is empty")
+    preds = [p for p, _ in pred_fn_pairs]
+    if default is None:
+        # reference behavior: the last fn doubles as the default
+        preds, fns = preds[:-1], [f for _, f in pred_fn_pairs]
+        default = fns[-1]
+        pairs = list(zip(preds, fns[:-1]))
+    else:
+        pairs = list(pred_fn_pairs)
+    if not any(_is_traced(p) for p, _ in pairs):
+        for p, fn in pairs:
+            if bool(p):
+                return fn()
+        return default()
+
+    def build(i):
+        if i == len(pairs):
+            return default
+        p, fn = pairs[i]
+        return lambda: lax.cond(p, fn, build(i + 1))
+
+    return build(0)()
+
+
+def switch_case(branch_index, branch_fns, default: Optional[Callable] = None,
+                name=None):
+    """ref control_flow.py:2715 — ``branch_fns`` is {int: fn} / [(int, fn)]
+    / [fn, ...].  Traced index → lax.switch over a dense table."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        items = sorted((int(i), f) for i, f in branch_fns)
+    else:
+        items = list(enumerate(branch_fns))
+    if not items:
+        raise InvalidArgumentError("switch_case: no branches")
+    if default is None:
+        default = items[-1][1]
+    if not _is_traced(branch_index):
+        lookup = dict(items)
+        return lookup.get(int(branch_index), default)()
+    # dense fn table over [0, max_key]; out-of-range clamps to default
+    max_key = items[-1][0]
+    table = [default] * (max_key + 2)
+    for i, f in items:
+        table[i] = f
+    idx = jnp.clip(jnp.asarray(branch_index, jnp.int32), 0, max_key + 1)
+    # unknown indices inside [0, max_key] that weren't listed hit default
+    return lax.switch(idx, table)
+
+
+def increment(x, value=1.0, in_place=True):
+    """ref control_flow.py increment — in graph mode, writes back to the
+    SAME variable name (the 1.x in-place contract While loops rely on)."""
+    if isinstance(x, Variable):
+        return record_call(lambda t: t + jnp.asarray(value, t.dtype), x,
+                           out_names=[x.name] if in_place else None,
+                           prefix="increment")
+    import paddle_tpu as _p
+
+    return _p.increment(x, value)
+
+
+def less_than(x, y, force_cpu=None, cond=None, name=None):
+    """ref layers/control_flow.py less_than — the ``cond=`` out-parameter
+    updates an existing bool Variable in place (how While loop conditions
+    re-arm each iteration)."""
+    if isinstance(x, Variable) or isinstance(y, Variable):
+        out_names = [cond.name] if isinstance(cond, Variable) else None
+        return record_call(lambda a, b: jnp.less(a, b), x, y,
+                           out_names=out_names, prefix="less_than")
+    from paddle_tpu.tensor import less_than as _lt
+
+    out = _lt(x, y)
+    return out
+
+
+# -- LoDTensorArray: a Python list eagerly, stacked tensors under trace ----
+def create_array(dtype="float32", initialized_list=None):
+    """ref control_flow.py create_array — eager arrays are Python lists."""
+    return list(initialized_list or [])
+
+
+def array_write(x, i, array=None):
+    """ref control_flow.py:1535 — writes x at index i, growing the array."""
+    if array is None:
+        array = []
+    i = int(i)
+    while len(array) <= i:
+        array.append(None)
+    array[i] = x
+    return array
+
+
+def array_read(array, i):
+    """ref control_flow.py:1662."""
+    if _is_traced(i):
+        return lax.dynamic_index_in_dim(jnp.stack(list(array)),
+                                        jnp.asarray(i, jnp.int32), 0,
+                                        keepdims=False)
+    return array[int(i)]
+
+
+def array_length(array):
+    """ref control_flow.py:1767."""
+    return jnp.asarray(len(array), jnp.int64)
+
+
+def tensor_array_to_tensor(input, axis=0, use_stack=False, name=None):
+    """ref tensor.py tensor_array_to_tensor — returns (tensor, sizes)."""
+    arrs = [jnp.asarray(a) for a in input]
+    if use_stack:
+        return jnp.stack(arrs, axis=axis), jnp.asarray(
+            [1] * len(arrs), jnp.int32)
+    sizes = jnp.asarray([a.shape[axis] for a in arrs], jnp.int32)
+    return jnp.concatenate(arrs, axis=axis), sizes
+
+
+def Assert(cond, data=None, summarize=20, name=None):
+    """ref control_flow.py Assert — eager check; under trace it becomes a
+    checkify-style no-op with a documented limitation (XLA has no abort)."""
+    if _is_traced(cond):
+        return  # compiled graphs cannot abort; parity with is_test prune
+    if not bool(jnp.all(jnp.asarray(cond))):
+        parts = [] if data is None else [np.asarray(d)[:summarize]
+                                         for d in data]
+        raise AssertionError(f"fluid.layers.Assert failed: {parts}")
+
+
+import numpy as np  # noqa: E402  (Assert uses it lazily)
+
+
+# -- graph-mode block control flow ------------------------------------------
+class _BlockCapture:
+    """Context manager: ops recorded inside land in ``self.ops`` instead of
+    staying on the program."""
+
+    def __init__(self):
+        self.ops: List[Op] = []
+        self._start = None
+
+    def __enter__(self):
+        self._prog = default_main_program()
+        self._start = len(self._prog.ops)
+        return self
+
+    def __exit__(self, *exc):
+        if exc[0] is None:
+            self.ops = self._prog.ops[self._start:]
+            del self._prog.ops[self._start:]
+            self._prog._version += 1
+        return False
+
+
+def _body_param_names(ops):
+    ps, bs = [], []
+    for op in ops:
+        ps.extend(op.param_names)
+        bs.extend(op.buffer_names)
+    return tuple(dict.fromkeys(ps)), tuple(dict.fromkeys(bs))
+
+
+def _external_reads(ops, produced0: set) -> List[Variable]:
+    """Variables read by ``ops`` that are not produced inside them."""
+    produced = set(produced0)
+    ext: Dict[str, Variable] = {}
+    is_var = lambda x: isinstance(x, Variable)  # noqa: E731
+    for op in ops:
+        for leaf in jax.tree_util.tree_leaves((op.args, op.kwargs),
+                                              is_leaf=is_var):
+            if isinstance(leaf, Variable) and leaf.name not in produced \
+                    and not leaf.is_parameter:
+                ext.setdefault(leaf.name, leaf)
+        produced.update(op.out_names)
+    return list(ext.values())
+
+
+class While:
+    """ref control_flow.py:971 — Program-block while loop:
+
+        i = fluid.layers.fill_constant([1], 'int64', 0)
+        cond = fluid.layers.less_than(i, limit)
+        loop = fluid.layers.While(cond)
+        with loop.block():
+            ...  # ops; update `cond` via less_than(..., cond=cond)
+
+    The block's recorded ops replay inside ``lax.while_loop``; every name
+    the block assigns (including in-place ``increment``/``less_than(cond=)``
+    writes) is loop-carried, and its post-loop value shadows the name for
+    subsequent ops — the 1.x mutation semantics."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        if not isinstance(cond, Variable):
+            raise InvalidArgumentError(
+                "While needs a graph-mode bool Variable; for eager/traced "
+                "loops use fluid.layers.while_loop")
+        self.cond_var = cond
+        self._cap = _BlockCapture()
+
+    def block(self):
+        return _WhileBlock(self)
+
+
+class _WhileBlock:
+    def __init__(self, w: While):
+        self.w = w
+
+    def __enter__(self):
+        self.w._cap.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self.w._cap.__exit__(*exc)
+        if exc[0] is not None:
+            return False
+        w = self.w
+        body_ops = w._cap.ops
+        pnames, bnames = _body_param_names(body_ops)
+        if bnames:
+            raise InvalidArgumentError(
+                "While blocks cannot contain buffered layers (running-stat "
+                "updates cannot cross lax.while_loop)")
+        cond_name = w.cond_var.name
+        # every name the body assigns is loop-carried (its post-loop value
+        # shadows the name for subsequent ops); read-before-write names are
+        # also external inputs supplying the initial carry
+        carried = [n for n in dict.fromkeys(
+            n for op in body_ops for n in op.out_names) if n != cond_name]
+        ext = _external_reads(body_ops, set())
+        ext = [e for e in ext if e.name != cond_name]
+        ext_names = [e.name for e in ext]
+        prog = default_main_program()
+        carry_shapes = {}
+        for n in carried:
+            if n not in ext_names:  # write-only: synthesize a zeros init
+                v = prog.vars.get(n)
+                if v is None or any(d is None for d in v.shape):
+                    raise InvalidArgumentError(
+                        f"While: cannot infer an initial value for loop "
+                        f"variable {n!r} (dynamic shape); assign it before "
+                        f"the loop")
+                carry_shapes[n] = (tuple(v.shape), v.dtype)
+
+        def fn(pv, bv, cond0, *ext_vals, training=False):
+            ext_env = dict(zip(ext_names, ext_vals))
+            carry0 = tuple(
+                ext_env[n] if n in ext_env
+                else jnp.zeros(*carry_shapes[n]) for n in carried)
+
+            def cond_f(state):
+                c, _ = state
+                return c.reshape(()).astype(bool)
+
+            def body_f(state):
+                c, carry = state
+                env = dict(ext_env)
+                env[cond_name] = c
+                env.update(zip(carried, carry))
+                run_ops(body_ops, env, pv, {}, training)
+                return env[cond_name], tuple(env[n] for n in carried)
+
+            final_c, final_carry = lax.while_loop(
+                cond_f, body_f, (cond0, carry0))
+            return (final_c,) + final_carry
+
+        # the op re-assigns the cond and every carried name: later ops see
+        # post-loop values
+        record_call(fn, w.cond_var, *ext,
+                    out_names=[cond_name] + carried,
+                    param_names=pnames, scoped=True, prefix="while")
+        return False
+
+
+class StaticRNN:
+    """ref control_flow.py:449 — build-once stepwise RNN:
+
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            word = rnn.step_input(x_seq)      # x_seq: [T, ...] seq-major
+            prev = rnn.memory(shape=[-1, H], batch_ref=word)
+            hidden = fluid.layers.fc(input=[word, prev], ...)
+            rnn.update_memory(prev, hidden)
+            rnn.step_output(hidden)
+        outs = rnn()
+
+    The with-block records ops once (exactly like the reference, which
+    traces the block into a sub-Program re-executed per step); execution
+    replays them under ``lax.scan`` over the leading (time) dim."""
+
+    def __init__(self, name=None):
+        self._cap = _BlockCapture()
+        self._seq_inputs: List[tuple] = []   # (placeholder, source var)
+        self._memories: List[dict] = []
+        self._outputs: List[Variable] = []
+        self._built = False
+
+    def step(self):
+        return _RNNStep(self)
+
+    def step_input(self, x):
+        if not isinstance(x, Variable):
+            raise InvalidArgumentError(
+                "StaticRNN.step_input needs a graph Variable [T, ...]; "
+                "eager RNNs: paddle.nn.RNN")
+        prog = default_main_program()
+        ph = Variable(prog, prog.unique_name("rnn_x"), x.shape[1:], x.dtype)
+        prog.add_var(ph)
+        self._seq_inputs.append((ph, x))
+        return ph
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=0):
+        prog = default_main_program()
+        if init is not None:
+            mshape, mdtype = init.shape, init.dtype
+        else:
+            if shape is None or batch_ref is None:
+                raise InvalidArgumentError(
+                    "StaticRNN.memory needs init= or (shape= and "
+                    "batch_ref=)")
+            mshape = tuple(batch_ref.shape[ref_batch_dim_idx]
+                           if d in (-1, None) else int(d) for d in shape)
+            mdtype = batch_ref.dtype
+        ph = Variable(prog, prog.unique_name("rnn_mem"), mshape, mdtype)
+        prog.add_var(ph)
+        self._memories.append({"ph": ph, "init": init,
+                               "init_value": init_value, "new": None})
+        return ph
+
+    def update_memory(self, mem, new):
+        for m in self._memories:
+            if m["ph"] is mem:
+                m["new"] = new
+                return
+        raise InvalidArgumentError("update_memory: unknown memory variable")
+
+    def step_output(self, out):
+        self._outputs.append(out)
+
+    output = step_output
+
+    def __call__(self, *args):
+        if not self._built:
+            raise InvalidArgumentError("StaticRNN: exit the step() block "
+                                       "before calling rnn()")
+        return self._result
+
+    def _finalize(self, body_ops):
+        pnames, bnames = _body_param_names(body_ops)
+        if bnames:
+            raise InvalidArgumentError(
+                "StaticRNN steps cannot contain buffered layers")
+        for m in self._memories:
+            if m["new"] is None:
+                raise InvalidArgumentError(
+                    "StaticRNN: every memory needs update_memory()")
+        if not self._outputs:
+            raise InvalidArgumentError("StaticRNN: no step_output declared")
+        seq_ph_names = [ph.name for ph, _ in self._seq_inputs]
+        mem_ph_names = [m["ph"].name for m in self._memories]
+        out_names = [o.name for o in self._outputs]
+        new_names = [m["new"].name for m in self._memories]
+        ext = _external_reads(
+            body_ops, set(seq_ph_names) | set(mem_ph_names))
+        ext = [e for e in ext
+               if e.name not in {v.name for _, v in self._seq_inputs}]
+        srcs = [v for _, v in self._seq_inputs]
+        inits = [m["init"] for m in self._memories if m["init"] is not None]
+        n_src = len(srcs)
+
+        mems = self._memories
+
+        def fn(pv, bv, *all_args, training=False):
+            xs_vals = all_args[:n_src]
+            rest = all_args[n_src:]
+            init_vals = list(rest[:len(inits)])
+            ext_vals = rest[len(inits):]
+            ext_env = dict(zip([e.name for e in ext], ext_vals))
+            carry0 = []
+            ii = 0
+            for m in mems:
+                if m["init"] is not None:
+                    carry0.append(init_vals[ii])
+                    ii += 1
+                else:
+                    shape = tuple(m["ph"].shape)
+                    carry0.append(jnp.full(shape, m["init_value"],
+                                           m["ph"].dtype))
+
+            def step_f(carry, xs_t):
+                env = dict(ext_env)
+                env.update(zip(seq_ph_names, xs_t))
+                env.update(zip(mem_ph_names, carry))
+                run_ops(body_ops, env, pv, dict(bv), training)
+                new_carry = tuple(env[n] for n in new_names)
+                outs = tuple(env[n] for n in out_names)
+                return new_carry, outs
+
+            _, stacked = lax.scan(step_f, tuple(carry0), tuple(xs_vals))
+            return stacked if len(out_names) > 1 else stacked[0]
+
+        result = record_call(fn, *srcs, *inits, *ext,
+                             param_names=pnames, scoped=True,
+                             prefix="static_rnn")
+        self._result = result
+        self._built = True
+
+
+class _RNNStep:
+    def __init__(self, rnn: StaticRNN):
+        self.rnn = rnn
+
+    def __enter__(self):
+        self.rnn._cap.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self.rnn._cap.__exit__(*exc)
+        if exc[0] is None:
+            self.rnn._finalize(self.rnn._cap.ops)
+        return False

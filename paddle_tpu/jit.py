@@ -98,12 +98,26 @@ class StaticFunction:
             raise InvalidArgumentError(
                 "to_static calls are positional-only (kwargs change the "
                 "trace signature); bind keywords before wrapping")
-        layer = self._layer
-        if layer is None:
-            return self._jitted(*args)
-        params = layer.param_pytree()
-        buffers = layer.buffer_pytree()
-        out, new_bufs = self._jitted(params, buffers, layer.training, *args)
+        try:
+            layer = self._layer
+            if layer is None:
+                return self._jitted(*args)
+            params = layer.param_pytree()
+            buffers = layer.buffer_pytree()
+            out, new_bufs = self._jitted(params, buffers, layer.training,
+                                         *args)
+        except jax.errors.TracerBoolConversionError as e:
+            # the contract violation the reference's AST transpiler
+            # rewrites away — here the fix is the callable control flow
+            raise InvalidArgumentError(
+                "to_static: Python `if`/`while` on a tensor value cannot "
+                "compile (the condition is traced, not concrete).  Rewrite "
+                "the branch with paddle.static.nn.cond / fluid.layers.cond "
+                "(data-dependent if), fluid.layers.while_loop (data-"
+                "dependent while), or fluid.layers.case / switch_case — "
+                "each dispatches to the compiled lax primitive under "
+                "to_static and stays plain Python eagerly.  Original: "
+                f"{e}") from e
         boxes = dict(layer.named_buffers())
         for name, v in new_bufs.items():  # eager BN-stat semantics
             boxes[name].value = v
@@ -117,9 +131,24 @@ class StaticFunction:
 def to_static(function=None, input_spec=None, **kwargs):
     """Decorator/wrapper: compile a Layer or function for execution.
 
-    Reference surface: paddle.jit.to_static (dygraph/jit.py) — there it
-    AST-transpiles to a Program; here tracing is native, so this is a thin
-    jit wrapper kept for source compatibility and the save() pathway.
+    Reference surface: paddle.jit.to_static (dygraph/jit.py) — there an
+    AST transpiler (dygraph_to_static/program_translator.py:708) rewrites
+    Python control flow into Program ops; here tracing is native and the
+    CONTRACT is explicit instead:
+
+    * tensor math, layer calls, Python control flow on CONCRETE values
+      (shapes, hyperparameters, loop-over-layers) compile as-is;
+    * data-dependent control flow must use the callable forms —
+      ``fluid.layers.cond(pred, t, f)`` for ``if tensor:``,
+      ``fluid.layers.while_loop`` for ``while tensor:``,
+      ``case``/``switch_case`` for chains — each is plain Python eagerly
+      and the compiled lax primitive under to_static (the same op the
+      reference transpiler emits);
+    * a Python ``if``/``while`` directly on a tensor raises an
+      InvalidArgumentError naming that rewrite (tested in
+      tests/test_static_jit_utils.py) rather than a raw tracer error.
+
+    Retracing follows jax.jit rules; see StaticFunction.
     """
     if function is None:
         return functools.partial(to_static, input_spec=input_spec, **kwargs)

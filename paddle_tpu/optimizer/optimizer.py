@@ -335,10 +335,38 @@ class Optimizer:
 
     clear_gradients = clear_grad
 
-    def minimize(self, loss_fn, params=None):
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        """Graph mode (ref: fluid/optimizer.py minimize + backward.py:1275
+        append_backward): binds this optimizer to the loss Variable's
+        Program — Executor.run then differentiates the recorded graph with
+        jax.grad and applies this optimizer's update inside the same jitted
+        step.  Returns ([], params) like the 1.x (ops, params_grads) pair.
+
+        For eager code, jit a train step with functional_call + jax.grad
+        (hapi.Model / fleet do this for you)."""
+        from ..static.graph import Variable as _GraphVar
+
+        if isinstance(loss, _GraphVar):
+            prog = loss.program
+            prog._optimizer = self
+            prog._loss_name = loss.name
+            prog._opt_state = None
+            only = None
+            if parameter_list is not None:
+                only = {getattr(p, "name", p) for p in parameter_list}
+            if no_grad_set:
+                frozen = {getattr(p, "name", p) for p in no_grad_set}
+                only = (only or set(prog.scope)) - frozen
+            prog._minimize_only = only  # None → all trainable params
+            updated = [v for v in prog.all_parameters()
+                       if only is None or v.name in only]
+            return [], [(v, None) for v in updated]
         raise InvalidArgumentError(
-            "static-graph minimize() does not exist here; jit a train step "
-            "using functional_call + jax.grad (see hapi.Model or fleet)"
+            "minimize() outside graph mode: jit a train step using "
+            "functional_call + jax.grad (see hapi.Model or fleet), or "
+            "build a Program under fluid.program_guard and pass its loss "
+            "Variable"
         )
 
     # -- state ---------------------------------------------------------------

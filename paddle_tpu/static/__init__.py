@@ -379,38 +379,77 @@ def _make_program_shim(name, instead):
     return shim
 
 
-Program = _make_program_shim(
-    "Program", "Model.prepare compiles the whole train step from traced "
-               "eager code")
-Executor = _make_program_shim(
-    "Executor", "Model.fit / Model.evaluate run the compiled step")
-CompiledProgram = _make_program_shim(
-    "CompiledProgram", "jit compilation happens automatically in "
-                       "Model.prepare / jit.to_static")
+# -- the lazy-graph Program/Executor (static/graph.py): the 1.x build/run
+#    flow as a recorded DAG jitted into one XLA computation per signature
+from .graph import (  # noqa: E402,F401
+    Program, Executor, Variable, program_guard, default_main_program,
+    default_startup_program, reset_default_programs,
+)
+
+
+class Scope:
+    """Param/buffer scope view over a Program (ref: fluid/executor.py
+    global_scope — variable store the Executor reads/writes).  Here the
+    store IS program.scope; this wrapper serves the find_var/get_tensor
+    reading idiom."""
+
+    def __init__(self, program=None):
+        self._program = program
+
+    class _Var:
+        def __init__(self, value):
+            self._value = value
+
+        def get_tensor(self):
+            import numpy as _np
+
+            return _np.asarray(self._value)
+
+    def find_var(self, name):
+        prog = self._program or default_main_program()
+        if name in prog.scope:
+            return Scope._Var(prog.scope[name])
+        if name in prog.buffers:
+            return Scope._Var(prog.buffers[name])
+        return None
+
+    def var_names(self):
+        prog = self._program or default_main_program()
+        return list(prog.scope) + list(prog.buffers)
+
+
+def global_scope() -> Scope:
+    return Scope()
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """Accepted for API parity: programs own their scopes here, so the
+    guard has nothing to swap — state isolation comes from building under
+    separate Programs."""
+    yield scope
+
+
+def CompiledProgram(program, build_strategy=None):
+    """ref: compiler.py CompiledProgram — jit compilation is automatic at
+    Executor.run here, so the 'compiled' program is the program."""
+    return program
+
+
 ParallelExecutor = _make_program_shim(
     "ParallelExecutor", "distributed.fleet shards the jitted step over a "
                         "device Mesh")
-Scope = _make_program_shim(
-    "Scope", "state lives in Layer parameter boxes")
-Variable = _make_program_shim(
-    "Variable", "tensors are jax.Array; declared inputs are InputSpec")
-global_scope = _make_program_shim(
-    "global_scope", "state lives in Layer parameter boxes")
-scope_guard = _make_program_shim(
-    "scope_guard", "state lives in Layer parameter boxes")
-program_guard = _make_program_shim(
-    "program_guard", "no Program objects exist — write eager code")
-default_main_program = _make_program_shim(
-    "default_main_program", "no Program objects exist")
-default_startup_program = _make_program_shim(
-    "default_startup_program", "parameter init happens at Layer "
-                               "construction")
 append_backward = _make_program_shim(
-    "append_backward", "gradients come from paddle.grad_fn (jax.grad) "
-                       "over a loss function")
+    "append_backward", "Executor.run differentiates the recorded graph "
+                       "with jax.grad when an optimizer is bound via "
+                       "minimize — no backward ops are appended")
 gradients = _make_program_shim(
     "gradients", "use paddle.grad_fn (jax.grad) / jax.vjp on a function")
-set_program_state = _make_program_shim(
-    "set_program_state", "layer.set_state_dict(state)")
+
+
+def set_program_state(program, state):
+    """ref: io.py set_program_state — load a state dict into the
+    program's parameter scope."""
+    program.set_state_dict(state)
 
 from . import nn  # noqa: E402,F401  (static.nn op-builder shims)

@@ -1,0 +1,317 @@
+"""1.x parameter-creating op-builders over the lazy graph.
+
+Reference capability: python/paddle/fluid/layers/nn.py — ``fc`` (:354),
+``embedding`` (:584), ``conv2d`` (:1800-area), ``batch_norm``, ``pool2d``,
+``layer_norm``, ... Each appends ops AND creates parameters in the
+Program; the param-reuse across iterations comes from the build-once /
+run-many split.  Here each builder instantiates the corresponding eager
+Layer ONCE at build time, registers its parameters/buffers in the
+program's scope, and records an Op that runs the layer functionally —
+giving the exact same build-once semantics (see static/graph.py).
+
+The builders require graph mode (a symbolic Variable input): called with
+arrays they raise, pointing at the eager Layer — in eager mode implicit
+parameter creation per call can never train (fresh weights each step),
+matching the reference where these names were unusable in dygraph too.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.errors import InvalidArgumentError
+from .graph import Variable, default_main_program, record_call
+
+__all__ = ["fc", "embedding", "conv2d", "pool2d", "batch_norm",
+           "layer_norm", "layer_op"]
+
+
+def _require_var(x, builder, eager):
+    if not isinstance(x, Variable):
+        raise InvalidArgumentError(
+            f"fluid.layers.{builder} creates parameters in a Program and "
+            f"needs graph mode: build under fluid.program_guard + run with "
+            f"fluid.Executor (static/graph.py), or use the eager {eager}")
+    return x
+
+
+def _act(out, act):
+    if not act:
+        return out
+    from ..nn import functional as F
+
+    fn = getattr(F, act, None)
+    if fn is None:
+        raise InvalidArgumentError(f"unknown activation {act!r}")
+    return fn(out)
+
+
+def layer_op(layer, x, *, prefix: str, act: Optional[str] = None,
+             post=None, extra_args=(), force_training: Optional[bool] = None):
+    """Register ``layer``'s params/buffers in the current program and
+    record an op running it via functional_call.  The shared machinery of
+    every builder below (and of contrib builders that want it).
+    ``force_training`` pins the layer's mode regardless of the run's
+    train/eval flag (batch_norm(is_test=True) semantics)."""
+    from ..nn.layer_base import functional_call
+
+    prog = default_main_program()
+    pmap, bmap = {}, {}
+    for ln, box in layer.named_parameters():
+        sname = prog.unique_name(f"{prefix}.{ln.replace('.', '_')}")
+        prog.register_param(sname, box.value, trainable=box.trainable)
+        pmap[sname] = ln
+    for ln, box in layer.named_buffers():
+        sname = prog.unique_name(f"{prefix}.{ln.replace('.', '_')}")
+        prog.register_buffer(sname, box.value)
+        bmap[sname] = ln
+    has_buf = bool(bmap)
+
+    def fn(pv, bv, xx, *extra, training=False):
+        if force_training is not None:
+            training = force_training
+        params = {pmap[n]: v for n, v in pv.items()}
+        bufs = {bmap[n]: v for n, v in bv.items()}
+        inv = {v: k for k, v in bmap.items()}
+        out, nb = functional_call(layer, params, xx, *extra,
+                                  buffers=bufs or None, training=training,
+                                  return_buffers=True)
+        if post is not None:
+            out = post(out)
+        out = _act(out, act)
+        if has_buf:
+            return out, {inv[ln]: v for ln, v in nb.items()}
+        return out
+
+    return record_call(fn, x, *extra_args, prefix=prefix,
+                       param_names=tuple(pmap), buffer_names=tuple(bmap),
+                       writes_buffers=has_buf)
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """ref: fluid/layers/nn.py:354 — flattens trailing dims from
+    ``num_flatten_dims`` on, applies xW+b, restores leading dims."""
+    x = _require_var(input, "fc", "paddle.nn.Linear")
+    from .. import nn
+
+    k = num_flatten_dims if num_flatten_dims >= 0 else len(x.shape) + num_flatten_dims
+    tail = x.shape[k:]
+    if any(d is None for d in tail):
+        raise InvalidArgumentError(
+            f"fc: flattened feature dims {tail} must be static")
+    in_features = int(np.prod(tail)) if tail else 1
+    layer = nn.Linear(in_features, size, weight_attr=param_attr,
+                      bias_attr=bias_attr)
+
+    pre = record_call(lambda t: t.reshape((-1, in_features)), x,
+                      prefix="fc_flat")
+    out = layer_op(layer, pre, prefix=name or "fc", act=act)
+    if k != 1:
+        out = record_call(
+            lambda t, orig: t.reshape(tuple(orig.shape[:k]) + (size,)),
+            out, x, prefix="fc_unflat")
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32", name=None):
+    """ref: fluid/layers/nn.py:584 (lookup_table_v2).  ``is_sparse`` maps
+    to the SelectedRows gradient path (nn.Embedding(sparse=True))."""
+    x = _require_var(input, "embedding", "paddle.nn.Embedding")
+    from .. import nn
+
+    layer = nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                         sparse=is_sparse, weight_attr=param_attr)
+    return layer_op(layer, x, prefix=name or "embedding")
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    """ref: fluid/layers/nn.py conv2d — NCHW, creates filter+bias."""
+    x = _require_var(input, "conv2d", "paddle.nn.Conv2D")
+    from .. import nn
+
+    in_channels = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    layer = nn.Conv2D(int(in_channels), num_filters, filter_size,
+                      stride=stride, padding=padding, dilation=dilation,
+                      groups=groups or 1, weight_attr=param_attr,
+                      bias_attr=bias_attr, data_format=data_format)
+    return layer_op(layer, x, prefix=name or "conv2d", act=act)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True, data_format="NCHW"):
+    """ref: fluid/layers/nn.py pool2d — stateless, but kept here so the
+    classic conv→pool build chains stay in one import."""
+    x = _require_var(input, "pool2d", "nn.functional.max_pool2d/avg_pool2d")
+    from ..nn import functional as F
+
+    def fn(xx):
+        if global_pooling:
+            axes = (2, 3) if data_format == "NCHW" else (1, 2)
+            red = jnp.max if pool_type == "max" else jnp.mean
+            return red(xx, axis=axes, keepdims=True)
+        if pool_type == "max":
+            return F.max_pool2d(xx, pool_size, stride=pool_stride,
+                                padding=pool_padding, ceil_mode=ceil_mode,
+                                data_format=data_format)
+        return F.avg_pool2d(xx, pool_size, stride=pool_stride,
+                            padding=pool_padding, ceil_mode=ceil_mode,
+                            exclusive=exclusive, data_format=data_format)
+
+    return record_call(fn, x, prefix=name or "pool2d")
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    """ref: fluid/layers/nn.py batch_norm — creates scale/shift params and
+    the moving mean/variance buffers; running stats update on training
+    runs (Executor.run of a program with an optimizer) and freeze on eval
+    runs, the is_test split the reference encodes at build time."""
+    x = _require_var(input, "batch_norm", "paddle.nn.BatchNorm2D")
+    from .. import nn
+
+    ch = x.shape[1] if data_layout == "NCHW" else x.shape[-1]
+    layer = nn.BatchNorm2D(int(ch), momentum=momentum, epsilon=epsilon,
+                           weight_attr=param_attr, bias_attr=bias_attr,
+                           data_format=data_layout)
+    frozen = True if (use_global_stats or is_test) else None
+    return layer_op(layer, x, prefix=name or "batch_norm", act=act,
+                    force_training=False if frozen else None)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """ref: fluid/layers/nn.py layer_norm — normalizes over dims from
+    begin_norm_axis on."""
+    x = _require_var(input, "layer_norm", "paddle.nn.LayerNorm")
+    from .. import nn
+
+    normalized = [int(d) for d in x.shape[begin_norm_axis:]]
+    layer = nn.LayerNorm(normalized, epsilon=epsilon,
+                         weight_attr=param_attr if scale else False,
+                         bias_attr=bias_attr if shift else False)
+    return layer_op(layer, x, prefix=name or "layer_norm", act=act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    """ref: fluid/layers/nn.py conv2d_transpose."""
+    x = _require_var(input, "conv2d_transpose", "paddle.nn.Conv2DTranspose")
+    from .. import nn
+
+    in_ch = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    layer = nn.Conv2DTranspose(
+        int(in_ch), num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups or 1, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_format)
+    return layer_op(layer, x, prefix=name or "conv2d_transpose", act=act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    """ref: fluid/layers/nn.py conv3d."""
+    x = _require_var(input, "conv3d", "paddle.nn.Conv3D")
+    from .. import nn
+
+    in_ch = x.shape[1] if data_format == "NCDHW" else x.shape[-1]
+    layer = nn.Conv3D(int(in_ch), num_filters, filter_size, stride=stride,
+                      padding=padding, dilation=dilation, groups=groups or 1,
+                      weight_attr=param_attr, bias_attr=bias_attr,
+                      data_format=data_format)
+    return layer_op(layer, x, prefix=name or "conv3d", act=act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    """ref: fluid/layers/nn.py conv3d_transpose."""
+    x = _require_var(input, "conv3d_transpose", "paddle.nn.Conv3DTranspose")
+    from .. import nn
+
+    in_ch = x.shape[1] if data_format == "NCDHW" else x.shape[-1]
+    layer = nn.Conv3DTranspose(
+        int(in_ch), num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups or 1, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_format)
+    return layer_op(layer, x, prefix=name or "conv3d_transpose", act=act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    """ref: fluid/layers/nn.py instance_norm (4-D NCHW input)."""
+    x = _require_var(input, "instance_norm", "paddle.nn.InstanceNorm2D")
+    from .. import nn
+
+    layer = nn.InstanceNorm2D(int(x.shape[1]), epsilon=epsilon,
+                              weight_attr=param_attr, bias_attr=bias_attr)
+    return layer_op(layer, x, prefix=name or "instance_norm")
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    """ref: fluid/layers/nn.py group_norm."""
+    x = _require_var(input, "group_norm", "paddle.nn.GroupNorm")
+    from .. import nn
+
+    ch = x.shape[1] if data_layout == "NCHW" else x.shape[-1]
+    layer = nn.GroupNorm(groups, int(ch), epsilon=epsilon,
+                         weight_attr=param_attr, bias_attr=bias_attr,
+                         data_format=data_layout)
+    return layer_op(layer, x, prefix=name or "group_norm", act=act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """ref: fluid/layers/nn.py spectral_norm — normalizes a weight
+    Variable by its largest singular value (power iteration)."""
+    x = _require_var(weight, "spectral_norm", "paddle.nn.SpectralNorm")
+    from .. import nn
+
+    layer = nn.SpectralNorm([int(d) for d in x.shape], dim=dim,
+                            power_iters=power_iters, eps=eps)
+    return layer_op(layer, x, prefix=name or "spectral_norm")
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    """ref: fluid/layers/nn.py prelu — learnable negative slope; mode
+    all/channel/element sets the alpha shape."""
+    v = _require_var(x, "prelu", "paddle.nn.PReLU")
+    from .. import nn
+
+    if mode == "all":
+        num = 1
+    elif mode == "channel":
+        num = int(v.shape[1] if data_format == "NCHW" else v.shape[-1])
+    else:
+        num = int(np.prod(v.shape[1:]))
+    layer = nn.PReLU(num_parameters=num, weight_attr=param_attr,
+                     data_format=data_format)
+    return layer_op(layer, v, prefix=name or "prelu")
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """ref: fluid/layers/nn.py bilinear_tensor_product."""
+    xv = _require_var(x, "bilinear_tensor_product",
+                      "paddle.nn.BilinearTensorProduct")
+    from .. import nn
+
+    layer = nn.BilinearTensorProduct(int(xv.shape[-1]), int(y.shape[-1]),
+                                     size, weight_attr=param_attr,
+                                     bias_attr=bias_attr)
+    return layer_op(layer, xv, prefix=name or "bilinear_tensor_product",
+                    act=act, extra_args=(y,))

@@ -1,0 +1,590 @@
+"""Lazy-graph Program/Executor — the 1.x static-graph API, TPU-native.
+
+Reference capability: the ProgramDesc build + Executor run flow
+(python/paddle/fluid/framework.py Program/Block/Variable,
+python/paddle/fluid/executor.py:575 Executor.run) — `fluid.data` declares
+placeholders, op-builders append ops to a Program, `optimizer.minimize`
+appends the backward + update ops (backward.py:1275 append_backward), and
+`exe.run(feed, fetch_list)` executes the graph.
+
+TPU-native design: the Program here is a *recorded DAG of eager callables*
+— each builder call appends an Op whose ``fn`` is the same jax function the
+eager API runs, with Variables as named edges.  ``Executor.run`` plays the
+record into ONE traced-and-jitted XLA computation per (feed-shape,
+fetch-set) signature — which is precisely what the reference's executor
+wishes it could do (its XLA/CINN backends try); there is no op-by-op
+interpreter loop at run time.  ``minimize`` does not append backward ops:
+run() differentiates the recorded graph with ``jax.grad`` (jaxpr replaces
+the transpiled backward Program) and applies the bound optimizer's
+functional update inside the same jit.
+
+Parameters are created ONCE at build time (solving the param-reuse problem
+that makes 1.x builders impossible in pure eager mode) and live in the
+program's scope as jax Arrays; ``exe.run(startup_program)`` (re)initializes
+them from their recorded init values.
+
+What is NOT here (documented contract, tested in tests/test_static_graph.py):
+clone(for_test=True) pruning beyond stopping param updates, per-op
+device/place assignment (XLA owns placement), LoD — dense padding as
+everywhere else in this framework.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dtype import convert_dtype
+from ..framework.errors import InvalidArgumentError, NotFoundError
+
+__all__ = [
+    "Variable", "Op", "Program", "Executor", "program_guard",
+    "default_main_program", "default_startup_program", "data",
+    "record_call", "maybe_record", "in_graph_mode", "reset_default_programs",
+]
+
+
+class Variable:
+    """A symbolic tensor: a named edge in the recorded graph.  Carries the
+    static (shape, dtype) computed at build time via jax.eval_shape; the
+    batch dim may be None/-1 (resolved by the feed at run time)."""
+
+    def __init__(self, program: "Program", name: str, shape, dtype,
+                 *, is_param: bool = False, stop_gradient: bool = False):
+        self.program = program
+        self.name = name
+        self.shape = tuple(None if d in (None, -1) else int(d) for d in shape)
+        self.dtype = convert_dtype(dtype)
+        self.is_parameter = is_param
+        self.stop_gradient = stop_gradient
+        self.persistable = is_param
+
+    # -- numpy-ish sugar: every overload records through the eager op -------
+    def _bin(self, other, fn, reverse=False):
+        a, b = (other, self) if reverse else (self, other)
+        return record_call(fn, a, b)
+
+    def __add__(self, o):
+        return self._bin(o, jnp.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._bin(o, jnp.subtract)
+
+    def __rsub__(self, o):
+        return self._bin(o, jnp.subtract, reverse=True)
+
+    def __mul__(self, o):
+        return self._bin(o, jnp.multiply)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._bin(o, jnp.divide)
+
+    def __rtruediv__(self, o):
+        return self._bin(o, jnp.divide, reverse=True)
+
+    def __pow__(self, o):
+        return self._bin(o, jnp.power)
+
+    def __neg__(self):
+        return record_call(jnp.negative, self)
+
+    def __matmul__(self, o):
+        return self._bin(o, jnp.matmul)
+
+    def __lt__(self, o):
+        return self._bin(o, jnp.less)
+
+    def __le__(self, o):
+        return self._bin(o, jnp.less_equal)
+
+    def __gt__(self, o):
+        return self._bin(o, jnp.greater)
+
+    def __ge__(self, o):
+        return self._bin(o, jnp.greater_equal)
+
+    def __getitem__(self, idx):
+        return record_call(lambda t: t[idx], self)
+
+    def astype(self, dtype):
+        dt = convert_dtype(dtype)
+        return record_call(lambda t: t.astype(dt), self)
+
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return record_call(lambda t: t.reshape(shape), self)
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def numpy(self):
+        raise InvalidArgumentError(
+            f"Variable {self.name!r} is symbolic (graph mode): values exist "
+            "only at Executor.run time — fetch it via fetch_list")
+
+    def __repr__(self):
+        kind = "Parameter" if self.is_parameter else "Variable"
+        return f"{kind}(name={self.name}, shape={self.shape}, dtype={self.dtype})"
+
+    def __hash__(self):
+        return id(self)
+
+    def __eq__(self, o):  # symbolic == records elementwise equal, like 1.x
+        if isinstance(o, (Variable, int, float, np.ndarray, jnp.ndarray)):
+            return self._bin(o, jnp.equal)
+        return NotImplemented
+
+
+class Op:
+    """One recorded step: ``outs = fn(*subst(args), **subst(kwargs))`` where
+    Variables in args/kwargs are substituted from the run-time environment.
+    ``param_names``/``buffer_names`` name scope entries fn also consumes
+    (layer-backed builders); ``writes_buffers`` marks fns returning
+    ``(out, new_buffer_dict)``."""
+
+    def __init__(self, fn: Callable, args, kwargs, out_names: List[str],
+                 single: bool, param_names=(), buffer_names=(),
+                 writes_buffers: bool = False, scoped: bool = None):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.out_names = out_names
+        self.single = single
+        self.param_names = tuple(param_names)
+        self.buffer_names = tuple(buffer_names)
+        self.writes_buffers = writes_buffers
+        # scoped ops use the fn(params, buffers, *args, training=...) calling
+        # convention even with empty param/buffer sets (control-flow blocks)
+        self.scoped = bool(param_names or buffer_names) if scoped is None \
+            else scoped
+
+
+class Program:
+    """The recorded graph + its parameter/buffer scope.
+
+    Mirrors fluid.framework.Program at the API level (global_block,
+    all_parameters, random_seed, clone); the "desc" is the op record."""
+
+    _counter = 0
+
+    def __init__(self):
+        Program._counter += 1
+        self.idx = Program._counter
+        self.ops: List[Op] = []
+        self.vars: Dict[str, Variable] = {}
+        # scope: name -> jax Array (parameters and buffers, host-persistent)
+        self.scope: Dict[str, jax.Array] = {}
+        self.buffers: Dict[str, jax.Array] = {}
+        self._init_values: Dict[str, jax.Array] = {}
+        self._param_trainable: Dict[str, bool] = {}
+        self._optimizer = None
+        self._loss_name: Optional[str] = None
+        self._opt_state = None
+        self._name_i = 0
+        self.random_seed = None
+        self._version = 0  # bumped per recorded op → invalidates jit cache
+
+    # -- naming --------------------------------------------------------------
+    def unique_name(self, prefix: str) -> str:
+        self._name_i += 1
+        return f"_{self.idx}_{prefix}_{self._name_i}"
+
+    def add_var(self, var: Variable):
+        self.vars[var.name] = var
+
+    def append_op(self, op: Op):
+        self.ops.append(op)
+        self._version += 1
+
+    # -- parameters ----------------------------------------------------------
+    def register_param(self, name: str, value, trainable: bool = True):
+        value = jnp.asarray(value)
+        self.scope[name] = value
+        self._init_values[name] = value
+        self._param_trainable[name] = trainable
+        v = Variable(self, name, value.shape, value.dtype, is_param=True,
+                     stop_gradient=not trainable)
+        self.add_var(v)
+        return v
+
+    def register_buffer(self, name: str, value):
+        value = jnp.asarray(value)
+        self.buffers[name] = value
+        self._init_values[name] = value
+
+    def all_parameters(self):
+        return [self.vars[n] for n in self.scope]
+
+    def list_vars(self):
+        return list(self.vars.values())
+
+    def global_block(self):
+        return self  # single-block MVP: the Program is its global block
+
+    @property
+    def blocks(self):
+        return [self]
+
+    def parameters_numpy(self) -> Dict[str, np.ndarray]:
+        return {n: np.asarray(v) for n, v in self.scope.items()}
+
+    def state_dict(self, mode: str = "all") -> Dict[str, np.ndarray]:
+        d = {n: np.asarray(v) for n, v in self.scope.items()}
+        d.update({n: np.asarray(v) for n, v in self.buffers.items()})
+        return d
+
+    def set_state_dict(self, state: Dict[str, Any]):
+        for n, v in state.items():
+            if n in self.scope:
+                self.scope[n] = jnp.asarray(v)
+            elif n in self.buffers:
+                self.buffers[n] = jnp.asarray(v)
+
+    def clone(self, for_test: bool = False) -> "Program":
+        """1.x clone: the test clone shares parameters and records the same
+        ops but never runs the optimizer update.  (Dropout/BN already
+        branch on a 'training' flag at run time here — run(train=False).)"""
+        if not for_test:
+            raise InvalidArgumentError(
+                "Program.clone(for_test=False) would need desc copying; "
+                "build a second program under program_guard instead")
+        import copy
+
+        p = copy.copy(self)
+        p._optimizer, p._loss_name, p._opt_state = None, None, None
+        return p
+
+    def _reinitialize(self):
+        for n, v in self._init_values.items():
+            if n in self.scope:
+                self.scope[n] = v
+            else:
+                self.buffers[n] = v
+        self._opt_state = None
+
+
+# -- default-program plumbing ------------------------------------------------
+_state = threading.local()
+
+
+def _progs():
+    if not hasattr(_state, "main"):
+        _state.main = Program()
+        _state.startup = Program()
+    return _state
+
+
+def default_main_program() -> Program:
+    return _progs().main
+
+
+def default_startup_program() -> Program:
+    return _progs().startup
+
+
+def reset_default_programs():
+    if hasattr(_state, "main"):
+        del _state.main, _state.startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    s = _progs()
+    prev = (s.main, s.startup)
+    s.main = main_program
+    s.startup = startup_program if startup_program is not None else s.startup
+    if startup_program is not None:
+        # exe.run(startup) must reinitialize THIS main's parameters even
+        # when invoked outside the guard (the 1.x flow)
+        startup_program._paired_main = main_program
+    s.guard_depth = getattr(s, "guard_depth", 0) + 1
+    try:
+        yield
+    finally:
+        s.main, s.startup = prev
+        s.guard_depth -= 1
+
+
+def in_program_guard() -> bool:
+    """True inside a ``with program_guard(...)`` block — where source-less
+    builders (fill_constant, py_reader slots) must create graph Variables
+    rather than eager arrays."""
+    return getattr(_progs(), "guard_depth", 0) > 0
+
+
+def in_graph_mode(*values) -> bool:
+    """True if any leaf of ``values`` is a symbolic Variable."""
+    return any(isinstance(leaf, Variable)
+               for leaf in jax.tree_util.tree_leaves(
+                   values, is_leaf=lambda x: isinstance(x, Variable)))
+
+
+# -- recording ---------------------------------------------------------------
+def _avals(program, tree):
+    """Replace Variables with ShapeDtypeStructs (batch None → 1 probe)."""
+
+    def sub(x):
+        if isinstance(x, Variable):
+            shape = tuple(1 if d is None else d for d in x.shape)
+            return jax.ShapeDtypeStruct(shape, x.dtype)
+        return x
+
+    return jax.tree_util.tree_map(
+        sub, tree, is_leaf=lambda x: isinstance(x, Variable))
+
+
+def record_call(fn: Callable, *args, out_names: Optional[Sequence[str]] = None,
+                n_out: Optional[int] = None, prefix: str = "tmp",
+                param_names=(), buffer_names=(), writes_buffers=False,
+                scoped: Optional[bool] = None, **kwargs):
+    """Append ``fn(*args, **kwargs)`` to the current program and return the
+    symbolic output Variable(s).  Output shapes/dtypes come from
+    jax.eval_shape over the recorded callable — the same shape inference
+    the runtime will see."""
+    prog = default_main_program()
+    # shape inference: eval_shape abstracts only its ARGUMENTS, so feed it
+    # exactly the Variable leaves (static ints/strings stay closed over)
+    is_var = lambda x: isinstance(x, Variable)  # noqa: E731
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs),
+                                                 is_leaf=is_var)
+    var_idx = [i for i, leaf in enumerate(leaves) if is_var(leaf)]
+    var_avals = [jax.ShapeDtypeStruct(
+        tuple(1 if d is None else d for d in leaves[i].shape),
+        leaves[i].dtype) for i in var_idx]
+
+    def probe(pv, bv, vals):
+        sub = list(leaves)
+        for i, v in zip(var_idx, vals):
+            sub[i] = v
+        a_args, a_kwargs = jax.tree_util.tree_unflatten(treedef, sub)
+        if _scoped:  # layer-backed / control-flow op convention
+            return fn(pv, bv, *a_args, training=False, **a_kwargs)
+        return fn(*a_args, **a_kwargs)
+
+    _scoped = bool(param_names or buffer_names) if scoped is None else scoped
+    pv = {n: jax.ShapeDtypeStruct(tuple(prog.scope[n].shape),
+                                  prog.scope[n].dtype) for n in param_names}
+    bv = {n: jax.ShapeDtypeStruct(tuple(prog.buffers[n].shape),
+                                  prog.buffers[n].dtype) for n in buffer_names}
+    out_aval = jax.eval_shape(probe, pv, bv, var_avals)
+    if writes_buffers:  # fn returns (out, new_buffers) — drop for shapes
+        out_aval = out_aval[0]
+
+    single = not isinstance(out_aval, (tuple, list))
+    avals = [out_aval] if single else list(out_aval)
+    if out_names is None:
+        out_names = [prog.unique_name(prefix) for _ in avals]
+    outs = []
+    for name, av in zip(out_names, avals):
+        shape = list(av.shape)
+        # heuristic: dim probed as 1 from a None input dim stays dynamic
+        # only if some input had None there; keep static shape (batch dims
+        # re-resolve per run signature anyway — shapes here are advisory)
+        v = Variable(prog, name, av.shape, av.dtype)
+        prog.add_var(v)
+        outs.append(v)
+    prog.append_op(Op(fn, args, kwargs, list(out_names), single,
+                      param_names, buffer_names, writes_buffers,
+                      scoped=_scoped))
+    return outs[0] if single else tuple(outs)
+
+
+def maybe_record(fn: Callable):
+    """Wrap an eager function so calls with symbolic Variables record into
+    the current program and calls with arrays stay eager — how the whole
+    fluid.layers / tensor surface becomes graph-capable without per-op
+    work."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        if in_graph_mode(args, kwargs):
+            return record_call(fn, *args, **kwargs)
+        return fn(*args, **kwargs)
+
+    return wrapped
+
+
+def data(name: str, shape, dtype="float32", lod_level: int = 0) -> Variable:
+    """fluid.data / static.data: a feed placeholder (ref: fluid/data.py).
+    dim -1/None = run-time (batch) dimension."""
+    prog = default_main_program()
+    v = Variable(prog, name, shape, dtype)
+    prog.add_var(v)
+    return v
+
+
+# -- execution ---------------------------------------------------------------
+def run_ops(ops, env: Dict[str, Any], params: Dict[str, Any],
+            buffers: Dict[str, Any], training: bool) -> None:
+    """Play a recorded op list against a name environment (mutates ``env``
+    and ``buffers``).  Shared by Executor and by control-flow blocks
+    (While/StaticRNN), whose bodies are captured op lists replayed inside
+    lax.while_loop/lax.scan."""
+
+    def subst(x):
+        if isinstance(x, Variable):
+            if x.name in env:
+                return env[x.name]
+            if x.name in params:
+                return params[x.name]
+            raise NotFoundError(
+                f"Variable {x.name!r} used before produced — was it "
+                f"created under a different program_guard, or is a feed "
+                f"missing?")
+        return x
+
+    is_var = lambda x: isinstance(x, Variable)  # noqa: E731
+    for op in ops:
+        args = jax.tree_util.tree_map(subst, op.args, is_leaf=is_var)
+        kwargs = jax.tree_util.tree_map(subst, op.kwargs, is_leaf=is_var)
+        if op.scoped:
+            pv = {n: params[n] for n in op.param_names}
+            bv = {n: buffers[n] for n in op.buffer_names}
+            out = op.fn(pv, bv, *args, training=training, **kwargs)
+        else:
+            out = op.fn(*args, **kwargs)
+        if op.writes_buffers:
+            out, nb = out
+            buffers.update(nb)
+        if op.single:
+            env[op.out_names[0]] = out
+        else:
+            for n, o in zip(op.out_names, out):
+                env[n] = o
+
+
+class Executor:
+    """Plays a recorded Program as one jitted XLA computation.
+
+    ``run(program, feed, fetch_list)``: executes the graph; if an optimizer
+    was bound via ``minimize``, the same jitted step differentiates the
+    recorded graph (jax.grad — the append_backward replacement) and applies
+    the functional update, donating old state.  Compiled executables are
+    cached per (program version, feed signature, fetch set, train flag).
+    """
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[Tuple, Callable] = {}
+
+    def close(self):
+        self._cache.clear()
+
+    def _execute(self, program, params, buffers, feeds, training):
+        env: Dict[str, Any] = dict(feeds)
+        new_buffers = dict(buffers)
+        run_ops(program.ops, env, params, new_buffers, training)
+        return env, new_buffers
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, scope=None, return_numpy: bool = True,
+            use_program_cache: bool = True, training: Optional[bool] = None):
+        program = program or default_main_program()
+        if not program.ops:
+            # running a startup program (re)initializes its paired main's
+            # parameters (builders register params on the MAIN program)
+            program._reinitialize()
+            target = getattr(program, "_paired_main", None)
+            if target is None and program is default_startup_program():
+                target = _progs().main
+            if target is not None:
+                target._reinitialize()
+            return []
+        feed = dict(feed or {})
+        if not feed:
+            # started py_readers feed the program (fluid.layers.py_reader);
+            # a finished pass raises fluid.core.EOFException like 1.x
+            for reader in getattr(program, "_readers", []):
+                if reader._iter is not None:
+                    feed.update(reader.next_feed())
+        fetch_list = list(fetch_list or [])
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list]
+        train = program._optimizer is not None
+        if training is None:
+            training = train
+
+        feed_vals = {k: jnp.asarray(v) for k, v in feed.items()}
+        sig = (program.idx, program._version, train, bool(training),
+               tuple(fetch_names),
+               tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                            for k, v in feed_vals.items())))
+        runner = self._cache.get(sig) if use_program_cache else None
+        if runner is None:
+            runner = self._build(program, fetch_names, train, bool(training))
+            if use_program_cache:
+                self._cache[sig] = runner
+        outs = runner(program, feed_vals)
+        if return_numpy:
+            outs = [np.asarray(o) for o in outs]
+        return outs
+
+    def _build(self, program, fetch_names, train, training):
+        if train:
+            opt = program._optimizer
+            loss_name = program._loss_name
+            trainable = {n for n, t in program._param_trainable.items() if t}
+            only = getattr(program, "_minimize_only", None)
+            if only is not None:  # minimize(parameter_list=/no_grad_set=)
+                trainable &= only
+
+            def step(params, opt_state, buffers, feeds, lr):
+                t_params = {n: v for n, v in params.items() if n in trainable}
+                f_params = {n: v for n, v in params.items()
+                            if n not in trainable}
+
+                def loss_fn(tp):
+                    env, nb = self._execute(
+                        program, {**tp, **f_params}, buffers, feeds, training)
+                    return env[loss_name].astype(jnp.float32).sum(), (env, nb)
+
+                (loss, (env, nb)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(t_params)
+                new_t, new_state = opt.update(grads, opt_state, t_params,
+                                              lr=lr)
+                fetched = [env[n] for n in fetch_names]
+                return fetched, {**new_t, **f_params}, new_state, nb
+
+            jitted = jax.jit(step, donate_argnums=(0, 1, 2))
+
+            def runner(prog, feeds):
+                if prog._opt_state is None:
+                    tp = {n: v for n, v in prog.scope.items() if n in trainable}
+                    prog._opt_state = opt.init(tp)
+                lr = jnp.asarray(opt.get_lr(), jnp.float32)
+                fetched, new_params, prog._opt_state, new_bufs = jitted(
+                    dict(prog.scope), prog._opt_state, dict(prog.buffers),
+                    feeds, lr)
+                prog.scope.update(new_params)
+                prog.buffers.update(new_bufs)
+                sched = opt.lr_scheduler
+                if sched is not None:
+                    sched.step()
+                return fetched
+
+            return runner
+
+        def fwd(params, buffers, feeds):
+            env, nb = self._execute(program, params, buffers, feeds, training)
+            return [env[n] for n in fetch_names], nb
+
+        jitted = jax.jit(fwd)
+
+        def runner(prog, feeds):
+            fetched, nb = jitted(dict(prog.scope), dict(prog.buffers), feeds)
+            if training:  # eval clone never persists running stats
+                prog.buffers.update(nb)
+            return fetched
+
+        return runner

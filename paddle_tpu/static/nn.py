@@ -1,47 +1,61 @@
-"""paddle.static.nn — op-builder shims.
+"""paddle.static.nn — op-builders over the lazy graph.
 
-Parity: python/paddle/static/nn/__init__.py.  Every name there appends
-ops to a Program; with no Program interpreter each shim raises at CALL
-time, naming the eager layer/functional equivalent (kept callable so
-``from paddle.static.nn import fc`` imports cleanly and fails with
-guidance only when actually used).
+Parity: python/paddle/static/nn/__init__.py.  The parameter-creating
+builders are REAL in graph mode (static/graph.py + static/builders.py):
+under ``program_guard`` each creates its Layer once, registers the
+parameters in the Program scope, and records an op the Executor plays
+inside one jitted XLA computation.  Control-flow names dispatch
+eager/traced/graph (fluid/layers/control_flow.py).
 
-``create_parameter`` and ``py_func`` ARE portable and delegate to the
-real implementations; ``cond``/``while_loop`` point at lax control flow.
+The remaining shims are ops whose eager/functional equivalent is the
+implementation (listed with their pointer) — they raise at call time
+naming it.
 """
 from __future__ import annotations
 
 from . import py_func, create_parameter  # noqa: F401  (real implementations)
 
-#: static.nn name → eager replacement
+# real param-creating builders (graph mode)
+from .builders import (  # noqa: F401
+    fc, embedding, conv2d, pool2d, batch_norm, layer_norm,
+    conv2d_transpose, conv3d, conv3d_transpose, instance_norm, group_norm,
+    spectral_norm, prelu, bilinear_tensor_product,
+)
+# stateless ops whose eager functional IS the implementation
+from ..nn.functional import (  # noqa: F401
+    crf_decoding, row_conv, deform_conv2d,
+)
+
+_CONTROL_FLOW = ("cond", "while_loop", "case", "switch_case")
+
+
+def __getattr__(name):  # deferred: fluid.layers imports paddle_tpu itself
+    if name in _CONTROL_FLOW:
+        from ..fluid.layers import control_flow as _cf
+
+        return getattr(_cf, name)
+    raise AttributeError(f"module 'paddle_tpu.static.nn' has no "
+                         f"attribute {name!r}")
+
+#: remaining static.nn names → the eager implementation they map to
 _EAGER = {
-    "fc": "paddle.nn.Linear (+ activation from nn.functional)",
-    "batch_norm": "paddle.nn.BatchNorm2D / nn.functional.batch_norm",
-    "embedding": "paddle.nn.Embedding",
-    "bilinear_tensor_product": "paddle.nn.BilinearTensorProduct",
-    "case": "jax.lax.switch over traced branches",
-    "cond": "jax.lax.cond (compiled) or plain Python if (eager)",
-    "conv2d": "paddle.nn.Conv2D / nn.functional.conv2d",
-    "conv2d_transpose": "paddle.nn.Conv2DTranspose",
-    "conv3d": "paddle.nn.Conv3D",
-    "conv3d_transpose": "paddle.nn.Conv3DTranspose",
-    "crf_decoding": "paddle.nn.functional.viterbi_decode (crf ops)",
-    "data_norm": "paddle.nn.BatchNorm (data_norm was its PS-side twin)",
-    "deform_conv2d": "paddle.nn.functional.deform_conv2d / paddle.vision.ops.deform_conv2d",
-    "group_norm": "paddle.nn.GroupNorm",
-    "instance_norm": "paddle.nn.InstanceNorm2D",
-    "layer_norm": "paddle.nn.LayerNorm",
-    "multi_box_head": "paddle.nn.functional.prior_box + detection heads",
+    "data_norm": "paddle.nn.BatchNorm1D (data_norm's global-stat "
+                 "normalization was its PS-side twin)",
+    "multi_box_head": "compose paddle.nn.functional.prior_box + conv heads",
     "nce": "paddle.nn.functional.softmax_with_cross_entropy on sampled "
-           "logits",
-    "prelu": "paddle.nn.PReLU",
-    "row_conv": "paddle.nn.RowConv / nn.functional.row_conv",
-    "spectral_norm": "paddle.nn.SpectralNorm",
-    "switch_case": "jax.lax.switch",
-    "while_loop": "jax.lax.while_loop",
+           "logits (fluid.layers.sampled_softmax_with_cross_entropy)",
+    "sequence_conv": "conv1d over padded batches with sequence_mask",
+    "sparse_embedding": "paddle.nn.Embedding(sparse=True) — the "
+                        "SelectedRows path (framework/selected_rows.py)",
 }
 
-__all__ = sorted(_EAGER) + ["py_func", "create_parameter"]
+__all__ = sorted(
+    ["fc", "embedding", "conv2d", "pool2d", "batch_norm", "layer_norm",
+     "conv2d_transpose", "conv3d", "conv3d_transpose", "instance_norm",
+     "group_norm", "spectral_norm", "prelu", "bilinear_tensor_product",
+     "cond", "while_loop", "case", "switch_case", "crf_decoding",
+     "row_conv", "deform_conv2d", "py_func", "create_parameter"]
+    + sorted(_EAGER))
 
 
 def _make_shim(name, instead):
@@ -49,8 +63,7 @@ def _make_shim(name, instead):
         from ..framework.errors import UnimplementedError
 
         raise UnimplementedError(
-            f"paddle.static.nn.{name} builds Program ops — this framework "
-            f"traces eager code instead (SURVEY §7); use: {instead}")
+            f"paddle.static.nn.{name}: use {instead}")
 
     shim.__name__ = name
     shim.__qualname__ = name

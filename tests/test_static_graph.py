@@ -1,0 +1,322 @@
+"""The lazy-graph Program/Executor: 1.x static-graph flows end to end.
+
+Reference capability: fluid/framework.py Program + executor.py:575
+Executor.run + backward.py:1275 append_backward (via minimize), exercised
+the way the reference's book tests drive them
+(python/paddle/fluid/tests/book/test_fit_a_line.py,
+test_recognize_digits.py) — plus the block control flow (While:971,
+StaticRNN:449) and the py_reader feed pipeline (layers/io.py:415).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework.errors import InvalidArgumentError
+from paddle_tpu.static.graph import reset_default_programs
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    import paddle_tpu as paddle
+
+    paddle.seed(0)  # builder param init draws from the global generator
+    reset_default_programs()
+    yield
+    reset_default_programs()
+
+
+def _programs():
+    return fluid.Program(), fluid.Program()
+
+
+class TestFitALine:
+    """The canonical 1.x regression: data → fc → mse → SGD.minimize →
+    exe.run loop (book/test_fit_a_line.py)."""
+
+    def test_trains_to_low_loss(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 13])
+            y = fluid.data("y", [-1, 1])
+            pred = fluid.layers.fc(input=x, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        X = rng.rand(64, 13).astype(np.float32)
+        Y = (X @ rng.randn(13))[:, None].astype(np.float32)
+        first = last = None
+        for _ in range(100):
+            out, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            first = first if first is not None else float(out)
+            last = float(out)
+        assert last < first * 0.02, (first, last)
+
+    def test_startup_rerun_reinitializes(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            y = fluid.data("y", [-1, 1])
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(
+                fluid.layers.fc(x, 1), y))
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        w0 = dict(main.parameters_numpy())
+        X = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+        Y = np.ones((8, 1), np.float32)
+        exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        assert any(not np.array_equal(v, main.parameters_numpy()[k])
+                   for k, v in w0.items())
+        exe.run(startup)  # back to init
+        for k, v in w0.items():
+            np.testing.assert_array_equal(v, main.parameters_numpy()[k])
+
+    def test_fetch_by_name_and_scope_read(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            out = fluid.layers.fc(x, 3)
+        exe = fluid.Executor()
+        X = np.ones((2, 4), np.float32)
+        r1, = exe.run(main, feed={"x": X}, fetch_list=[out])
+        r2, = exe.run(main, feed={"x": X}, fetch_list=[out.name])
+        np.testing.assert_array_equal(r1, r2)
+        # global_scope().find_var reads parameters (1.x idiom)
+        pname = main.all_parameters()[0].name
+        with fluid.program_guard(main, startup):
+            t = fluid.global_scope().find_var(pname)
+        assert t is not None and t.get_tensor().shape == (4, 3)
+
+
+class TestRecognizeDigits:
+    """conv2d → pool2d → batch_norm → fc(softmax) → cross_entropy, the
+    book/test_recognize_digits.py conv variant."""
+
+    def test_convnet_trains_and_bn_stats_update(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            img = fluid.data("img", [-1, 1, 12, 12])
+            label = fluid.data("label", [-1, 1], dtype="int64")
+            c = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                    act="relu")
+            p = fluid.layers.pool2d(c, pool_size=2, pool_stride=2)
+            b = fluid.layers.batch_norm(p)
+            pred = fluid.layers.fc(b, size=10, act="softmax")
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(pred, label))
+            fluid.optimizer.AdamOptimizer(
+                learning_rate=0.01).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        bufs0 = {k: np.asarray(v) for k, v in main.buffers.items()}
+        rng = np.random.RandomState(0)
+        protos = rng.rand(10, 1, 12, 12).astype(np.float32)
+        yb = rng.randint(0, 10, 64)
+        Xb = protos[yb] + 0.05 * rng.randn(64, 1, 12, 12).astype(np.float32)
+        first = last = None
+        for _ in range(25):
+            out, = exe.run(main,
+                           feed={"img": Xb,
+                                 "label": yb[:, None].astype(np.int64)},
+                           fetch_list=[loss])
+            first = first if first is not None else float(out)
+            last = float(out)
+        assert last < first * 0.3, (first, last)
+        # BN moving stats moved (buffer write-back through the jit)
+        assert any(not np.array_equal(v, np.asarray(main.buffers[k]))
+                   for k, v in bufs0.items())
+
+
+class TestWhileBlock:
+    def test_while_counts_and_mutates(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            i = fluid.layers.fill_constant([1], "int64", 0)
+            limit = fluid.layers.fill_constant([1], "int64", 10)
+            acc = fluid.layers.fill_constant([1], "float32", 0.0)
+            cond = fluid.layers.less_than(i, limit)
+            loop = fluid.layers.While(cond)
+            with loop.block():
+                fluid.layers.assign(acc + 1.5, output=acc)
+                fluid.layers.increment(i, value=1)
+                fluid.layers.less_than(i, limit, cond=cond)
+            post = acc * 2.0  # post-loop ops see final values
+        acc_v, i_v, post_v = fluid.Executor().run(
+            main, feed={}, fetch_list=[acc, i, post])
+        assert float(acc_v[0]) == 15.0
+        assert int(i_v[0]) == 10
+        assert float(post_v[0]) == 30.0
+
+
+class TestStaticRNN:
+    def test_cumsum_semantics(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            xseq = fluid.data("xseq", [6, 4, 3])  # [T, B, D] seq-major
+            rnn = fluid.layers.StaticRNN()
+            with rnn.step():
+                w = rnn.step_input(xseq)
+                prev = rnn.memory(shape=[4, 3], batch_ref=w)
+                h = prev + w
+                rnn.update_memory(prev, h)
+                rnn.step_output(h)
+            outs = rnn()
+        X = np.random.RandomState(0).randn(6, 4, 3).astype(np.float32)
+        o, = fluid.Executor().run(main, feed={"xseq": X},
+                                  fetch_list=[outs])
+        np.testing.assert_allclose(o, np.cumsum(X, axis=0), rtol=1e-5)
+
+    def test_rnn_with_fc_params_trains(self):
+        # parameters created INSIDE the step block train through the scan
+        main, startup = _programs()
+        T, B, D, H = 5, 8, 3, 4
+        with fluid.program_guard(main, startup):
+            xseq = fluid.data("xseq", [T, B, D])
+            target = fluid.data("target", [B, H])
+            rnn = fluid.layers.StaticRNN()
+            with rnn.step():
+                w = rnn.step_input(xseq)
+                prev = fluid.layers.StaticRNN.memory  # noqa: B009 (doc)
+                prev = rnn.memory(shape=[B, H], batch_ref=w)
+                joined = fluid.layers.concat([w, prev], axis=1)
+                h = fluid.layers.fc(joined, size=H, act="tanh")
+                rnn.update_memory(prev, h)
+                rnn.step_output(h)
+            outs = rnn()
+            last = outs[T - 1]
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(last, target))
+            fluid.optimizer.AdamOptimizer(learning_rate=0.02).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        X = rng.randn(T, B, D).astype(np.float32)
+        Y = np.tanh(rng.randn(B, H)).astype(np.float32)
+        first = lastl = None
+        for _ in range(60):
+            out, = exe.run(main, feed={"xseq": X, "target": Y},
+                           fetch_list=[loss])
+            first = first if first is not None else float(out)
+            lastl = float(out)
+        assert lastl < first * 0.3, (first, lastl)
+
+
+class TestPyReader:
+    def test_feed_pipeline_with_eof(self):
+        from paddle_tpu.fluid.core import EOFException
+
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            reader = fluid.layers.py_reader(
+                capacity=4, shapes=[[-1, 4], [-1, 1]],
+                dtypes=["float32", "float32"])
+            x, y = fluid.layers.read_file(reader)
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(
+                fluid.layers.fc(x, 1), y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+        rng = np.random.RandomState(0)
+
+        def gen():
+            for _ in range(5):
+                X = rng.rand(16, 4).astype(np.float32)
+                yield [X, (X.sum(1, keepdims=True)).astype(np.float32)]
+
+        reader.decorate_batch_generator(gen)
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for _epoch in range(3):
+            reader.start()
+            while True:
+                try:
+                    out, = exe.run(main, fetch_list=[loss])
+                    losses.append(float(out))
+                except EOFException:
+                    break
+        assert len(losses) == 15
+        assert losses[-1] < losses[0]
+
+
+class TestEagerControlFlow:
+    """cond/while_loop/case/switch_case as plain functions — eager and
+    under jit (the to_static contract for data-dependent control flow)."""
+
+    def test_cond_eager_and_traced(self):
+        t = lambda: jnp.asarray(1.0)  # noqa: E731
+        f = lambda: jnp.asarray(-1.0)  # noqa: E731
+        assert float(fluid.layers.cond(True, t, f)) == 1.0
+        assert float(fluid.layers.cond(False, t, f)) == -1.0
+
+        @jax.jit
+        def fn(x):
+            return fluid.layers.cond(x.mean() > 0, t, f)
+
+        assert float(fn(jnp.ones(3))) == 1.0
+        assert float(fn(-jnp.ones(3))) == -1.0
+
+    def test_while_loop_eager_and_traced(self):
+        c = lambda i, s: i < 5  # noqa: E731
+        b = lambda i, s: (i + 1, s + i)  # noqa: E731
+        i, s = fluid.layers.while_loop(c, b, [0, 0])
+        assert (i, s) == (5, 10)
+
+        @jax.jit
+        def fn(x):
+            i, s = fluid.layers.while_loop(
+                c, b, [jnp.asarray(0), x])
+            return s
+
+        assert int(fn(jnp.asarray(0))) == 10
+
+    def test_case_and_switch_case(self):
+        one = lambda: jnp.asarray(1)  # noqa: E731
+        two = lambda: jnp.asarray(2)  # noqa: E731
+        три = lambda: jnp.asarray(3)  # noqa: E731
+        assert int(fluid.layers.case([(False, one), (True, two)],
+                                     default=три)) == 2
+        assert int(fluid.layers.case([(False, one), (False, two)],
+                                     default=три)) == 3
+        assert int(fluid.layers.switch_case(1, {0: one, 1: two})) == 2
+
+        @jax.jit
+        def fn(i):
+            return fluid.layers.switch_case(i, {0: one, 1: two},
+                                            default=три)
+
+        assert int(fn(jnp.asarray(1))) == 2
+        assert int(fn(jnp.asarray(7))) == 3
+
+
+class TestGraphContract:
+    def test_builders_raise_outside_graph_mode(self):
+        with pytest.raises(InvalidArgumentError, match="graph mode"):
+            fluid.layers.fc(np.ones((2, 3), np.float32), 4)
+
+    def test_symbolic_numpy_read_raises(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            with pytest.raises(InvalidArgumentError, match="fetch"):
+                x.numpy()
+
+    def test_state_dict_roundtrip(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            out = fluid.layers.fc(x, 2)
+        exe = fluid.Executor()
+        X = np.ones((2, 4), np.float32)
+        r1, = exe.run(main, feed={"x": X}, fetch_list=[out])
+        state = main.state_dict()
+        state = {k: np.zeros_like(v) for k, v in state.items()}
+        fluid.set_program_state(main, state)
+        r2, = exe.run(main, feed={"x": X}, fetch_list=[out])
+        np.testing.assert_array_equal(r2, np.zeros_like(r1))

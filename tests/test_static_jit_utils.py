@@ -51,11 +51,16 @@ class TestStatic:
         es.num_threads = 4
         assert es.num_threads == 4
 
-    def test_program_machinery_raises_on_use(self):
-        for name in ["Program", "Executor", "CompiledProgram",
-                     "ParallelExecutor", "append_backward", "gradients",
-                     "default_main_program", "global_scope",
-                     "program_guard", "set_program_state"]:
+    def test_program_machinery_real_and_residual_shims(self):
+        # real now (static/graph.py): the 1.x build/run flow
+        assert isinstance(static.Program(), static.Program)
+        assert static.Executor() is not None
+        assert isinstance(static.default_main_program(), static.Program)
+        assert static.global_scope() is not None
+        with static.program_guard(static.Program(), static.Program()):
+            pass
+        # still shims: program-rewrite passes jax.grad replaces
+        for name in ["ParallelExecutor", "append_backward", "gradients"]:
             with pytest.raises(UnimplementedError):
                 getattr(static, name)()
 
@@ -80,13 +85,16 @@ class TestStatic:
         assert not v.trainable
         np.testing.assert_allclose(np.asarray(v.value), 1.5)
 
-    def test_static_nn_shims(self):
+    def test_static_nn_builders_real(self):
         from paddle_tpu.static import nn as snn
 
-        with pytest.raises(UnimplementedError) as ei:
+        # real in graph mode; outside a program the error names the layer
+        with pytest.raises(Exception) as ei:
             snn.fc(None, 10)
         assert "paddle.nn.Linear" in str(ei.value)
         assert callable(snn.create_parameter)  # the real one
+        with pytest.raises(UnimplementedError):  # residual shim tier
+            snn.nce(None, None, 10)
 
     def test_weight_norm_param_attr_points_at_hook(self):
         with pytest.raises(UnimplementedError) as ei:
@@ -192,3 +200,79 @@ class TestIncubateReader:
         monkeypatch.delenv("PADDLE_TRAINERS_NUM", raising=False)
         monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
         assert list(distributed_batch_reader(lambda: iter([7, 8]))()) == [7, 8]
+
+
+class TestToStaticControlFlowContract:
+    """VERDICT r3 #4: the to_static answer for data-dependent Python
+    control flow — the callable control-flow forms compile and match
+    eager, and a raw Python `if tensor:` raises an ACTIONABLE error (ref:
+    program_translator.py:708, whose AST pass this contract replaces)."""
+
+    def test_data_dependent_branch_compiles_and_matches_eager(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import jit, nn
+        import paddle_tpu.fluid as fluid
+
+        paddle.seed(0)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.pos = nn.Linear(4, 4)
+                self.neg = nn.Linear(4, 4)
+
+            def forward(self, x):
+                # book-style data-dependent branch (mean sign routes)
+                return fluid.layers.cond(
+                    x.mean() > 0,
+                    lambda: self.pos(x),
+                    lambda: self.neg(x) * 2.0)
+
+        net = Net()
+        compiled = jit.to_static(net)
+        xp = paddle.to_tensor(np.full((2, 4), 0.5, np.float32))
+        xn = paddle.to_tensor(np.full((2, 4), -0.5, np.float32))
+        for x in (xp, xn):
+            eager = net(x)
+            static_out = compiled(x)
+            np.testing.assert_allclose(np.asarray(eager),
+                                       np.asarray(static_out), rtol=1e-6)
+
+    def test_data_dependent_while_compiles(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import jit
+        import paddle_tpu.fluid as fluid
+        import jax.numpy as jnp
+
+        @jit.to_static
+        def halve_until_small(x):
+            def cond_fn(v):
+                return jnp.max(jnp.abs(v)) > 1.0
+
+            def body(v):
+                return v / 2.0
+
+            (out,) = fluid.layers.while_loop(cond_fn, body, [x])
+            return out
+
+        x = paddle.to_tensor(np.asarray([16.0, 3.0], np.float32))
+        out = np.asarray(halve_until_small(x))
+        assert np.max(np.abs(out)) <= 1.0
+        np.testing.assert_allclose(out, [1.0, 0.1875], rtol=1e-6)
+
+    def test_raw_python_if_raises_actionable_error(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import jit
+        from paddle_tpu.framework.errors import InvalidArgumentError
+
+        @jit.to_static
+        def bad(x):
+            if x.mean() > 0:  # Python branch on a traced value
+                return x
+            return -x
+
+        with pytest.raises(InvalidArgumentError, match="cond"):
+            bad(paddle.to_tensor(np.ones((2, 2), np.float32)))
