@@ -21,6 +21,7 @@ the global mesh for eager/global arrays.
 """
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional
 
@@ -41,26 +42,6 @@ __all__ = [
 ]
 
 
-def _chunk_attn_lse(q, k, v, sm_scale, causal, q_offset, k_offset):
-    """Local-chunk attention returning (out, lse); fully-masked rows give
-    out=0, lse=-inf so the ring merge ignores them."""
-    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
-    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * sm_scale
-    if causal:
-        q_pos = q_offset + jnp.arange(q.shape[2])
-        k_pos = k_offset + jnp.arange(k.shape[2])
-        s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, -jnp.inf)
-    m = s.max(axis=-1)
-    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
-    p = jnp.exp(s - m_safe[..., None])
-    l = p.sum(axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", p, vf)
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    out = out / l_safe[..., None]
-    lse = jnp.where(l == 0.0, -jnp.inf, m + jnp.log(l_safe))
-    return out, lse
-
-
 def _merge(o_a, lse_a, o_b, lse_b):
     """Numerically-stable combine of two normalized partial attentions."""
     m = jnp.maximum(lse_a, lse_b)
@@ -75,35 +56,158 @@ def _merge(o_a, lse_a, o_b, lse_b):
     return o, lse
 
 
-def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
-                   sm_scale: Optional[float] = None):
-    """Exact attention over a sequence sharded on ``axis_name``.
+def _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale):
+    """Ring forward on the FLASH kernels: every chunk's partial attention
+    is a Pallas call (O(block²) VMEM — no [S_local, S_local] score tensor
+    anywhere), merged with online-softmax statistics.  Causal chunk
+    dispatch (per ring step, per device):
 
-    Call INSIDE shard_map; q/k/v are the local chunks [B, H, S_local, D].
+    * step 0 (the device's own chunk): causal self-attention at offset 0 —
+      this takes the TRIANGLE grid inside the kernel;
+    * src < idx (chunk entirely below the diagonal): full non-causal
+      attention — no masking needed at all;
+    * src > idx (entirely above): the chunk contributes NOTHING — the
+      lax.cond branch returns zeros/-inf without running a kernel, so its
+      compute AND its kernel DMA are skipped (the ppermute still moves the
+      chunk onward for the devices that do need it).
     """
+    from ..ops.flash_attention import flash_attention_fwd_lse
+
     mesh = get_mesh()
     p = mesh.shape[axis_name]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     idx = lax.axis_index(axis_name)
-    s_local = q.shape[2]
-    q_offset = idx * s_local
-
     out = jnp.zeros(q.shape, jnp.float32)
     lse = jnp.full(q.shape[:3], -jnp.inf, jnp.float32)
     perm = [(j, (j + 1) % p) for j in range(p)]
 
+    def full_chunk(args):
+        qq, kk, vv = args
+        o, l = flash_attention_fwd_lse(qq, kk, vv, causal=False,
+                                       sm_scale=sm_scale)
+        return o.astype(jnp.float32), l
+
+    def skip_chunk(args):
+        qq = args[0]
+        return (jnp.zeros(qq.shape, jnp.float32),
+                jnp.full(qq.shape[:3], -jnp.inf, jnp.float32))
+
     kc, vc = k, v
     for step in range(p):
-        src = (idx - step) % p  # the global chunk currently held
-        o_i, lse_i = _chunk_attn_lse(
-            q, kc, vc, sm_scale, causal, q_offset, src * k.shape[2])
+        if step == 0:
+            o_i, lse_i = flash_attention_fwd_lse(
+                q, kc, vc, causal=causal, sm_scale=sm_scale)
+            o_i = o_i.astype(jnp.float32)
+        elif causal:
+            src = (idx - step) % p  # the global chunk currently held
+            o_i, lse_i = lax.cond(src < idx, full_chunk, skip_chunk,
+                                  (q, kc, vc))
+        else:
+            o_i, lse_i = full_chunk((q, kc, vc))
         out, lse = _merge(out, lse, o_i, lse_i)
         if step + 1 < p:
             # rotate KV around the ring (ICI neighbor transfer)
             kc = lax.ppermute(kc, axis_name, perm)
             vc = lax.ppermute(vc, axis_name, perm)
-    return out.astype(q.dtype)
+    return out.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis_name, causal, sm_scale):
+    out, _ = _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, sm_scale):
+    out, lse = _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, sm_scale, res, do):
+    """Ring backward, also on the flash kernels: with the GLOBAL merged
+    (out, lse) per q row, each (q, kv-chunk) pair's flash-2 backward is an
+    exact additive contribution (p = exp(s − lse_global) is linear over
+    chunks).  dk/dv accumulators travel the ring WITH their kv chunk; a
+    final ppermute delivers them to the chunk's owner."""
+    from ..ops.flash_attention import flash_attention_bwd_chunk
+
+    q, k, v, out, lse = res
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    mesh = get_mesh()
+    p = mesh.shape[axis_name]
+    idx = lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % p) for j in range(p)]
+    do = do.astype(jnp.float32)
+    # loop-invariant: computed once, reused by every ring step's kernel
+    delta = (do * out.astype(jnp.float32)).sum(-1)
+
+    def full_bwd(args):
+        qq, kk, vv = args
+        dq_i, dk_i, dv_i = flash_attention_bwd_chunk(
+            qq, kk, vv, out, lse, do, causal=False, sm_scale=sm_scale,
+            delta=delta)
+        return (dq_i.astype(jnp.float32), dk_i.astype(jnp.float32),
+                dv_i.astype(jnp.float32))
+
+    def skip_bwd(args):
+        qq, kk, vv = args
+        return (jnp.zeros(qq.shape, jnp.float32),
+                jnp.zeros(kk.shape, jnp.float32),
+                jnp.zeros(vv.shape, jnp.float32))
+
+    dq = jnp.zeros(q.shape, jnp.float32)
+    kc, vc = k, v
+    dkc = jnp.zeros(k.shape, jnp.float32)
+    dvc = jnp.zeros(v.shape, jnp.float32)
+    for step in range(p):
+        if step == 0:
+            dq_i, dk_i, dv_i = flash_attention_bwd_chunk(
+                q, kc, vc, out, lse, do, causal=causal, sm_scale=sm_scale,
+                delta=delta)
+            dq_i, dk_i, dv_i = (x.astype(jnp.float32)
+                                for x in (dq_i, dk_i, dv_i))
+        elif causal:
+            src = (idx - step) % p
+            dq_i, dk_i, dv_i = lax.cond(src < idx, full_bwd, skip_bwd,
+                                        (q, kc, vc))
+        else:
+            dq_i, dk_i, dv_i = full_bwd((q, kc, vc))
+        dq = dq + dq_i
+        dkc = dkc + dk_i
+        dvc = dvc + dv_i
+        if step + 1 < p:
+            kc = lax.ppermute(kc, axis_name, perm)
+            vc = lax.ppermute(vc, axis_name, perm)
+            dkc = lax.ppermute(dkc, axis_name, perm)
+            dvc = lax.ppermute(dvc, axis_name, perm)
+    # after p-1 rotations device i holds chunk (i+1) % p; one more step
+    # forward delivers each dk/dv to its chunk's owner
+    dkc = lax.ppermute(dkc, axis_name, perm)
+    dvc = lax.ppermute(dvc, axis_name, perm)
+    return dq.astype(q.dtype), dkc.astype(k.dtype), dvc.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
+                   sm_scale: Optional[float] = None):
+    """Exact attention over a sequence sharded on ``axis_name``, every
+    chunk computed by the Pallas flash kernel (fwd AND bwd — see
+    _ring_fwd_impl/_ring_flash_bwd; no O(S_local²) score tensor exists).
+
+    Call INSIDE shard_map; q/k/v are the local chunks [B, H, S_local, D].
+    """
+    mesh = get_mesh()
+    p = mesh.shape[axis_name]
+    if p == 1:
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    return _ring_flash(q, k, v, axis_name, causal,
+                       None if sm_scale is None else float(sm_scale))
 
 
 def ulysses_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
@@ -128,9 +232,12 @@ def ulysses_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
+    from ..ops.flash_attention import flash_attention
+
     q2, k2, v2 = reshard_in(q), reshard_in(k), reshard_in(v)
-    o2, _ = _chunk_attn_lse(q2, k2, v2, sm_scale, causal, 0, 0)
-    o2 = o2.astype(q.dtype)
+    # local full-sequence attention on the Pallas flash kernel (fwd+bwd):
+    # the custom_vjp composes with the surrounding all_to_alls under grad
+    o2 = flash_attention(q2, k2, v2, causal=causal, sm_scale=sm_scale)
     return lax.all_to_all(o2, axis_name, split_axis=2, concat_axis=1,
                           tiled=True)
 
