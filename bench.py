@@ -151,7 +151,11 @@ def bench_resnet50():
     # the ~0.3s tunnel dispatch RTT to <1% of the measurement
 
     paddle.seed(0)
-    net = resnet50(data_format="NHWC").astype("bfloat16")
+    # stem_space_to_depth: the 7x7/s2 stem re-expressed as 4x4/s1 on 2x2
+    # space-to-depth input (exact same math; vision/models/resnet.py) —
+    # C=3 of 128 MXU lanes was the single worst-utilization conv
+    net = resnet50(data_format="NHWC",
+                   stem_space_to_depth=True).astype("bfloat16")
     params = {k: v.value for k, v in net.named_parameters()}
     bufs = {k: v.value for k, v in net.named_buffers()}
     opt = popt.Momentum(learning_rate=0.1, momentum=0.9, multi_precision=True,

@@ -339,3 +339,32 @@ def test_lenet_convergence_synthetic_digits():
     acc = model._metrics[0].accumulate()
     assert loss < first * 0.5, (first, loss)
     assert acc > 0.7, acc
+
+
+def test_resnet_stem_space_to_depth_exact():
+    """stem_space_to_depth rewrites the 7x7/s2 stem as the equivalent
+    4x4/s1 conv on 2x2 space-to-depth input (tools/resnet_mfu_analysis.md)
+    — same parameters, same math, bit-level parity up to matmul reorder."""
+    import jax
+
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(0)
+    net1 = resnet18(data_format="NHWC")
+    net2 = resnet18(data_format="NHWC", stem_space_to_depth=True)
+    net2.set_state_dict(net1.state_dict())
+    net1.eval()
+    net2.eval()
+    import jax.numpy as jnp
+
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 224, 224, 3)
+                    .astype(np.float32))
+    o1, o2 = np.asarray(net1(x)), np.asarray(net2(x))
+    np.testing.assert_allclose(o1, o2, atol=1e-3)
+    # grads flow through the re-gathered stem weights
+    from paddle_tpu.nn.layer_base import functional_call
+
+    params = {k: v.value for k, v in net2.named_parameters()}
+    g = jax.grad(lambda p: functional_call(net2, p, x).sum())(params)
+    gw = np.asarray(g["conv1.weight"])
+    assert np.abs(gw).sum() > 0
