@@ -123,10 +123,15 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
     # ignoring them here would train with a different algorithm than asked
     from ...framework.errors import UnimplementedError
 
-    if st.localsgd and st.gradient_merge:
+    if (st.localsgd or st.adaptive_localsgd) and st.gradient_merge:
         raise InvalidArgumentError(
-            "strategy.localsgd does not compose with gradient_merge (the "
-            "reference meta-optimizers are mutually exclusive too)")
+            "strategy.localsgd/adaptive_localsgd does not compose with "
+            "gradient_merge (the reference meta-optimizers are mutually "
+            "exclusive too)")
+    if st.localsgd and st.adaptive_localsgd:
+        raise InvalidArgumentError(
+            "pick ONE of strategy.localsgd / strategy.adaptive_localsgd "
+            "(the reference meta-optimizers black-list each other)")
     if st.dgc:
         # reference: DGC meta-optimizer applies only to Momentum
         # (fleet/meta_optimizers/dgc_optimizer.py _can_apply); swap it for
@@ -134,7 +139,8 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
         from ...optimizer.dgc import DGCMomentum
         from ...optimizer.optimizer import Momentum as _Momentum
 
-        for other in ("localsgd", "lamb", "lars", "gradient_merge"):
+        for other in ("localsgd", "adaptive_localsgd", "lamb", "lars",
+                      "gradient_merge"):
             if getattr(st, other):
                 raise InvalidArgumentError(
                     f"strategy.dgc does not compose with {other} (the "
@@ -226,7 +232,8 @@ def distributed_model(model):
     net = model.network if isinstance(model, _HapiModel) else model
     if not isinstance(net, Layer):
         raise InvalidArgumentError("distributed_model expects a Layer or Model")
-    if _strategy is not None and _strategy.localsgd:
+    if _strategy is not None and (_strategy.localsgd
+                                  or _strategy.adaptive_localsgd):
         raise InvalidArgumentError(
             "strategy.localsgd only runs through Model.prepare/fit (the "
             "per-replica state and sync schedule live in the Model's plan); "

@@ -32,7 +32,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..collective import shard_map
 from .plan import ShardingPlan
 
-__all__ = ["LocalSGDPlan"]
+__all__ = ["LocalSGDPlan", "AdaptiveLocalSGDPlan"]
 
 
 class LocalSGDPlan(ShardingPlan):
@@ -81,8 +81,8 @@ class LocalSGDPlan(ShardingPlan):
     # -- step ----------------------------------------------------------------
     def jit_train_step(self, train_step):
         plan = self
-        mesh, axis, k = self.mesh, self.axis, self.k_steps
-        spec_l = P(axis)
+        mesh, axis = self.mesh, self.axis  # the sync period is read from
+        spec_l = P(axis)                   # plan.k_steps LIVE (adaptive)
 
         def make(sync: bool, n_batch: int):
             def step(params, opt_state, buffers, key, lr, *batch):
@@ -131,12 +131,72 @@ class LocalSGDPlan(ShardingPlan):
             # and after each Model.load (on_state_restored nulls it)
             t = (plan._t if plan._t is not None
                  else int(opt_state["count"])) + 1
-            sync = t < plan.begin_step or t % k == 0
+            if plan._last_sync is None:
+                # restored mid-window: re-anchor the cadence conservatively
+                plan._last_sync = t - 1
+            sync = t < plan.begin_step or \
+                (t - plan._last_sync) >= plan.k_steps
             kk = (bool(sync), len(batch))
             if kk not in compiled:
                 compiled[kk] = jax.jit(make(*kk), donate_argnums=(0, 1, 2))
             out = compiled[kk](params, opt_state, buffers, key, lr, *batch)
             plan._t = t  # advance only after a successful dispatch
+            if sync:
+                plan._last_sync = t
+            plan._after_step(t, bool(sync), out[0], lr)
             return out
 
         return wrapped
+
+    _last_sync: "int | None" = 0
+
+    def _after_step(self, t, synced, loss, lr):
+        """Hook for host-side schedule adaptation (AdaptiveLocalSGDPlan)."""
+
+    def on_state_restored(self):
+        super().on_state_restored()
+        self._last_sync = None
+
+
+class AdaptiveLocalSGDPlan(LocalSGDPlan):
+    """Step-adaptive LocalSGD (ref: fleet/meta_optimizers/
+    localsgd_optimizer.py:194 AdaptiveLocalSGDOptimizer): the sync period
+    adapts to training progress,
+
+        k = clip(ceil(sqrt(lr0 * loss / (lr * loss0) * init_k)), 1, 16)
+
+    recomputed at every sync point from the replica-averaged loss
+    (lr0/loss0 recorded at step 1, :352-433 in the reference) — early
+    training (loss near loss0) syncs often; as the loss falls the replicas
+    drift longer between syncs.  The host-side cadence makes this a pure
+    scheduling change: the compiled sync/local steps are identical to
+    LocalSGDPlan's."""
+
+    MAX_K, MIN_K = 16, 1  # the reference's clamp constants (:425-431)
+
+    def __init__(self, network, optimizer, strategy, mesh=None):
+        cfg = getattr(strategy, "adaptive_localsgd_configs", None) or {}
+        # reuse the parent's config plumbing: adaptive init_k seeds k_steps
+        super().__init__(network, optimizer, strategy, mesh)
+        self.init_k_steps = max(int(cfg.get("init_k_steps", 1)), 1)
+        self.begin_step = max(int(cfg.get("begin_step", 1)), 1)
+        self.k_steps = self.init_k_steps
+        self._loss0 = None
+        self._lr0 = None
+
+    def _after_step(self, t, synced, loss, lr):
+        import math
+
+        if self._loss0 is None:
+            # the reference's initialize() records (loss0, lr0) at step 1;
+            # on a checkpoint resume the fresh plan re-anchors the baseline
+            # at the first observed step instead of freezing k forever
+            self._loss0 = max(float(loss), 1e-12)
+            self._lr0 = max(float(lr), 1e-12)
+            return
+        if not synced:
+            return
+        ratio = (self._lr0 * max(float(loss), 0.0)) / \
+            (max(float(lr), 1e-12) * self._loss0)
+        next_k = math.ceil(math.sqrt(ratio * self.init_k_steps))
+        self.k_steps = int(min(self.MAX_K, max(self.MIN_K, next_k)))

@@ -42,6 +42,10 @@ class DistributedStrategy:
         default_factory=lambda: {"method": "ring"})
     localsgd: bool = False
     localsgd_configs: Dict = field(default_factory=dict)
+    adaptive_localsgd: bool = False  # step-adaptive sync period (ref:
+    # localsgd_optimizer.py:194 AdaptiveLocalSGDOptimizer)
+    adaptive_localsgd_configs: Dict = field(
+        default_factory=lambda: {"init_k_steps": 1, "begin_step": 1})
     fp16_allreduce: bool = False  # comm-precision: cast grads for the
     # cross-replica reduction (ref: fp16_allreduce_optimizer.py:18)
     fp16_allreduce_configs: Dict = field(

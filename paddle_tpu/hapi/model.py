@@ -268,7 +268,13 @@ class Model:
                         "strategy.sequence_parallel found no attention "
                         "layers exposing a `sequence_parallel` knob",
                         RuntimeWarning)
-            if strategy.localsgd:
+            if strategy.adaptive_localsgd:
+                # reference: localsgd_optimizer.py:194 — LocalSGD whose
+                # sync period adapts to loss progress (fleet/localsgd.py)
+                from ..distributed.fleet.localsgd import AdaptiveLocalSGDPlan
+
+                self._plan = AdaptiveLocalSGDPlan(net, optimizer, strategy)
+            elif strategy.localsgd:
                 # reference: localsgd_optimizer.py — per-replica training
                 # with periodic model averaging (see fleet/localsgd.py)
                 from ..distributed.fleet.localsgd import LocalSGDPlan

@@ -281,7 +281,8 @@ class Optimizer:
         replaced by explicit grads from ``jax.grad`` — see nn.layer_base.)
         """
         st = getattr(self, "_fleet_strategy", None)
-        if st is not None and getattr(st, "localsgd", False):
+        if st is not None and (getattr(st, "localsgd", False)
+                               or getattr(st, "adaptive_localsgd", False)):
             raise InvalidArgumentError(
                 "strategy.localsgd only runs through Model.prepare/fit — "
                 "the eager step() path has no per-replica state or sync "

@@ -176,3 +176,51 @@ class TestLocalSGDParity:
         fleet.init(is_collective=True, strategy=strat)
         with pytest.raises(InvalidArgumentError, match="gradient_merge"):
             fleet.distributed_optimizer(popt.SGD(learning_rate=0.1))
+
+
+class TestAdaptiveLocalSGD:
+    """strategy.adaptive_localsgd — step-adaptive sync cadence (ref:
+    fleet/meta_optimizers/localsgd_optimizer.py:194): k follows
+    ceil(sqrt(lr0*loss/(lr*loss0)*init_k)) clamped to [1, 16]."""
+
+    def _train(self, steps=8, init_k=2):
+        fleet._initialized = False
+        strategy = fleet.DistributedStrategy(
+            adaptive_localsgd=True,
+            adaptive_localsgd_configs={"init_k_steps": init_k,
+                                       "begin_step": 1})
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+        opt = fleet.distributed_optimizer(popt.SGD(learning_rate=0.05))
+        model = paddle.Model(net, inputs=["x"], labels=["y"])
+        model.prepare(optimizer=opt, loss=nn.MSELoss())
+        rng = np.random.RandomState(1)
+        x = rng.randn(16, 8).astype(np.float32)
+        y = rng.randn(16, 1).astype(np.float32)
+        losses, ks = [], []
+        for _ in range(steps):
+            loss, _ = model.train_batch([x], [y])
+            losses.append(float(np.asarray(loss)))
+            ks.append(model._plan.k_steps)
+        return model, np.asarray(losses), ks
+
+    def test_descends_and_k_adapts_within_bounds(self):
+        model, losses, ks = self._train()
+        assert losses[-1] < losses[0]
+        assert all(1 <= k <= 16 for k in ks)
+        # loss decreasing => ratio < 1 => adapted k can only shrink from
+        # init... with init_k=2 and falling loss, k must reach 1
+        assert ks[-1] == 1, ks
+
+    def test_replicas_stay_stacked_per_device(self):
+        model, _, _ = self._train(steps=3)
+        local = next(iter(model._plan and
+                          model._opt_state["local"]["params"].values()))
+        import jax
+
+        assert local.shape[0] == len(jax.devices())
+
+    def test_loss0_recorded_at_step_one(self):
+        model, losses, _ = self._train(steps=2)
+        assert abs(model._plan._loss0 - losses[0]) < 1e-6
