@@ -198,5 +198,8 @@ class AdaptiveLocalSGDPlan(LocalSGDPlan):
             return
         ratio = (self._lr0 * max(float(loss), 0.0)) / \
             (max(float(lr), 1e-12) * self._loss0)
+        if not math.isfinite(ratio):  # divergence spike: sync at max period
+            self.k_steps = self.MAX_K
+            return
         next_k = math.ceil(math.sqrt(ratio * self.init_k_steps))
         self.k_steps = int(min(self.MAX_K, max(self.MIN_K, next_k)))

@@ -270,12 +270,35 @@ class ExecutionStrategy(BuildStrategy):
 def load_program_state(model_path, var_list=None):
     """Read a saved state into {name: numpy} (ref: fluid/io.py:1730
     load_program_state).  Works on this framework's ``paddle.save``
-    artifacts — the Program-free half of the reference API."""
+    artifacts AND on reference-Paddle binary checkpoints — per-variable
+    persistables directories, combined params + __model__, and 2.x
+    pickled .pdparams (framework/paddle_import.py implements the
+    reference's binary formats from the in-tree spec)."""
+    import os as _os
+
+    if _os.path.isdir(model_path) or (
+            _os.path.isfile(model_path)
+            and not model_path.endswith(".pdparams")):
+        from ..framework.paddle_import import load_reference_state_dict
+
+        state = load_reference_state_dict(model_path)
+        return {k: np.asarray(v) for k, v in state.items()
+                if var_list is None or k in var_list}
     from ..framework.serialization import load as _load
 
     path = model_path if model_path.endswith(".pdparams") else (
         model_path + ".pdparams")
-    state = _load(path)
+    # format sniff, not exception-driven: our serializer's artifacts load
+    # with _load; a reference binary (LoDTensor stream starts u32 0) goes
+    # to the importer.  Corruption of OUR files keeps its own clear error.
+    with open(path, "rb") as _f:
+        _head = _f.read(4)
+    if _head == b"\x00\x00\x00\x00":
+        from ..framework.paddle_import import load_reference_state_dict
+
+        state = load_reference_state_dict(path)
+    else:
+        state = _load(path)
     return {k: np.asarray(v) for k, v in state.items()
             if var_list is None or k in var_list}
 
