@@ -1,0 +1,48 @@
+"""The classic 1.x static-graph flow, end to end.
+
+Build a Program with fluid.data + op-builders, bind an optimizer with
+minimize(), run startup, then drive the Executor — exactly the
+fit_a_line / recognize_digits book recipe.  Under the hood the recorded
+graph compiles into ONE jitted XLA computation per feed signature
+(static/graph.py); there is no op-by-op interpreter.
+
+    python examples/static_graph_1x.py
+"""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def main():
+    main_prog, startup_prog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup_prog):
+        img = fluid.data("img", [-1, 1, 28, 28])
+        label = fluid.data("label", [-1, 1], dtype="int64")
+        conv = fluid.layers.conv2d(img, num_filters=8, filter_size=5,
+                                   act="relu")
+        pool = fluid.layers.pool2d(conv, pool_size=2, pool_stride=2)
+        bn = fluid.layers.batch_norm(pool)
+        pred = fluid.layers.fc(bn, size=10, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.AdamOptimizer(learning_rate=0.01).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup_prog)
+
+    rng = np.random.RandomState(0)
+    protos = rng.rand(10, 1, 28, 28).astype(np.float32)
+    for step in range(60):
+        y = rng.randint(0, 10, 64)
+        x = protos[y] + 0.1 * rng.randn(64, 1, 28, 28).astype(np.float32)
+        loss_v, = exe.run(main_prog,
+                          feed={"img": x,
+                                "label": y[:, None].astype(np.int64)},
+                          fetch_list=[loss])
+        if step % 10 == 0:
+            print(f"step {step:3d}  loss {float(loss_v):.4f}")
+
+    print("final loss:", float(loss_v))
+
+
+if __name__ == "__main__":
+    main()

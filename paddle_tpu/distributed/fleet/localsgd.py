@@ -190,9 +190,13 @@ class AdaptiveLocalSGDPlan(LocalSGDPlan):
         if self._loss0 is None:
             # the reference's initialize() records (loss0, lr0) at step 1;
             # on a checkpoint resume the fresh plan re-anchors the baseline
-            # at the first observed step instead of freezing k forever
-            self._loss0 = max(float(loss), 1e-12)
-            self._lr0 = max(float(lr), 1e-12)
+            # at the first observed step instead of freezing k forever.  A
+            # non-finite first loss must not poison the baseline — wait
+            # for a finite one.
+            l0, r0 = float(loss), float(lr)
+            if math.isfinite(l0) and math.isfinite(r0):
+                self._loss0 = max(l0, 1e-12)
+                self._lr0 = max(r0, 1e-12)
             return
         if not synced:
             return

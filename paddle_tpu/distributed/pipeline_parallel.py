@@ -78,9 +78,16 @@ def pipeline_blocks(
     ``[s*L/pp, (s+1)*L/pp)`` and the batch is split into ``num_microbatches``
     chunks that flow stage→stage over ICI.
 
-    Constraints: ``len(blocks) % pp == 0``; batch divisible by
-    ``num_microbatches``; blocks take/return a single activation and hold no
-    buffers (BatchNorm-free — transformer blocks qualify).
+    Constraints (also enforced with errors below): ``len(blocks) % pp ==
+    0``; batch divisible by ``num_microbatches``; blocks must be
+    STRUCTURALLY IDENTICAL (same parameter tree — their weights stack into
+    one [pp, L/pp, ...] cube), take/return a SINGLE activation tensor, and
+    hold no buffers (BatchNorm-free; use LayerNorm).  Transformer block
+    stacks (GPT/BERT) satisfy all three; ResNet stages and detection
+    heads do not — pipeline those models with recompute + dp/tp instead.
+    The same constraints apply to the 1F1B schedule
+    (:func:`pipeline_train_step`) and to ``Model.prepare`` with
+    ``strategy.pipeline`` (hapi/model.py plumbs blocks through here).
     """
     mesh = mesh or get_mesh()
     pp = mesh.shape.get(axis_name, 1)

@@ -1138,3 +1138,97 @@ for _n, _v in list(globals().items()):
             and _n not in _NO_WRAP):
         globals()[_n] = _maybe_record(_v)
 del _n, _v
+
+
+# -- round-4 shim burn-down batch 2 -------------------------------------
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True, data_format="NCDHW"):
+    """ref: fluid/layers/nn.py pool3d (NCDHW)."""
+    x = jnp.asarray(input)
+    if global_pooling:
+        axes = (2, 3, 4) if data_format == "NCDHW" else (1, 2, 3)
+        red = jnp.max if pool_type == "max" else jnp.mean
+        return red(x, axis=axes, keepdims=True)
+    if pool_type == "max":
+        return _F.max_pool3d(x, pool_size, stride=pool_stride,
+                             padding=pool_padding, ceil_mode=ceil_mode,
+                             data_format=data_format)
+    return _F.avg_pool3d(x, pool_size, stride=pool_stride,
+                         padding=pool_padding, ceil_mode=ceil_mode,
+                         exclusive=exclusive, data_format=data_format)
+
+
+def beam_search_decode(ids, scores, beam_size=None, end_id=0, name=None):
+    """ref: fluid/layers/rnn.py beam_search_decode — back-trace the stored
+    per-step (ids, parents) into full sequences.  Dense form: ``ids`` and
+    ``scores`` are the per-step arrays a decode loop collected (list /
+    stacked [T, batch, beam]); parent pointers ride the high bits the way
+    paddle.nn.functional.gather_tree expects — this is a thin adapter over
+    it (the 1.x op's LoD plumbing is replaced by dense [T, B, W])."""
+    ids = jnp.stack([jnp.asarray(a) for a in ids]) \
+        if isinstance(ids, (list, tuple)) else jnp.asarray(ids)
+    scores = jnp.stack([jnp.asarray(a) for a in scores]) \
+        if isinstance(scores, (list, tuple)) else jnp.asarray(scores)
+    if ids.ndim != 3:
+        raise UnimplementedError(
+            "beam_search_decode expects dense [T, batch, beam] step ids "
+            "(collect them from the decode loop; LoD beams are replaced "
+            "by dense padding here)")
+    # the per-step parent beam indices must come through the scores slot
+    # (integer layout) — float log-probs carry no ancestry in dense form
+    # (the 1.x op recovered it from the LoD, which dense padding replaces)
+    if scores.dtype in (jnp.float32, jnp.float64, jnp.float16):
+        raise UnimplementedError(
+            "beam_search_decode(dense): pass the per-step PARENT indices "
+            "(int) in the scores argument, or use "
+            "paddle.nn.functional.gather_tree(ids, parents) / "
+            "paddle.nn.BeamSearchDecoder which track ancestry explicitly")
+    parents = scores.astype(jnp.int64)
+    seqs = _F.gather_tree(ids, parents)
+    return seqs, scores
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    """ref: operators/filter_by_instag_op — keep rows of ``ins`` whose tag
+    set intersects ``filter_tag``.  Dense form: ``ins_tag`` is [N] (one
+    tag per row) or [N, K] padded with -1; returns (filtered rows, the
+    kept row indices, loss-weight vector) like the reference's three
+    outputs."""
+    ins = jnp.asarray(ins)
+    tags = jnp.asarray(ins_tag)
+    if tags.ndim == 1:
+        tags = tags[:, None]
+    want = jnp.asarray(filter_tag).reshape(-1)
+    keep = (tags[..., None] == want[None, None, :]).any(axis=(1, 2))
+    idx = jnp.nonzero(keep)[0]  # eager: data-dependent size is fine
+    out = ins[idx]
+    if out.shape[0] == 0:
+        # fabricated placeholder row: loss weight 0 keeps it inert (the
+        # reference op does the same for the empty-match case)
+        out = jnp.full((1,) + ins.shape[1:], out_val_if_empty, ins.dtype)
+        idx = jnp.asarray([0])
+        loss_weight = jnp.zeros((1, 1), jnp.float32)
+    else:
+        loss_weight = jnp.ones((out.shape[0], 1), jnp.float32)
+    return out, idx.astype(jnp.int64), loss_weight
+
+
+for _impl in ("pool3d", "beam_search_decode", "filter_by_instag", "crop"):
+    _STATIC_ONLY.pop(_impl, None)
+# crop resolves through the 2.0 fallback (paddle.crop)
+
+for _n in ("pool3d", "beam_search_decode", "filter_by_instag"):
+    globals()[_n] = _maybe_record(globals()[_n])
+del _n
+
+
+# -- round-4 graph-builder batch 3 (param-creating, real in graph mode) --
+from paddle_tpu.static.builders import (  # noqa: E402,F401
+    nce, center_loss, sequence_conv, inplace_abn, hsigmoid,
+)
+
+for _impl in ("nce", "center_loss", "sequence_conv", "inplace_abn",
+              "hsigmoid"):
+    _STATIC_ONLY.pop(_impl, None)

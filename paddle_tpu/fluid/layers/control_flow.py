@@ -329,7 +329,7 @@ class _WhileBlock:
                         f"the loop")
                 carry_shapes[n] = (tuple(v.shape), v.dtype)
 
-        def fn(pv, bv, cond0, *ext_vals, training=False):
+        def fn(pv, bv, cond0, *ext_vals, training=False, rngs=None):
             ext_env = dict(zip(ext_names, ext_vals))
             carry0 = tuple(
                 ext_env[n] if n in ext_env
@@ -344,7 +344,7 @@ class _WhileBlock:
                 env = dict(ext_env)
                 env[cond_name] = c
                 env.update(zip(carried, carry))
-                run_ops(body_ops, env, pv, {}, training)
+                run_ops(body_ops, env, pv, {}, training, rng=rngs)
                 return env[cond_name], tuple(env[n] for n in carried)
 
             final_c, final_carry = lax.while_loop(
@@ -458,7 +458,7 @@ class StaticRNN:
 
         mems = self._memories
 
-        def fn(pv, bv, *all_args, training=False):
+        def fn(pv, bv, *all_args, training=False, rngs=None):
             xs_vals = all_args[:n_src]
             rest = all_args[n_src:]
             init_vals = list(rest[:len(inits)])
@@ -479,7 +479,7 @@ class StaticRNN:
                 env = dict(ext_env)
                 env.update(zip(seq_ph_names, xs_t))
                 env.update(zip(mem_ph_names, carry))
-                run_ops(body_ops, env, pv, dict(bv), training)
+                run_ops(body_ops, env, pv, dict(bv), training, rng=rngs)
                 new_carry = tuple(env[n] for n in new_names)
                 outs = tuple(env[n] for n in out_names)
                 return new_carry, outs

@@ -70,7 +70,7 @@ def layer_op(layer, x, *, prefix: str, act: Optional[str] = None,
         bmap[sname] = ln
     has_buf = bool(bmap)
 
-    def fn(pv, bv, xx, *extra, training=False):
+    def fn(pv, bv, xx, *extra, training=False, rngs=None):
         if force_training is not None:
             training = force_training
         params = {pmap[n]: v for n, v in pv.items()}
@@ -78,7 +78,7 @@ def layer_op(layer, x, *, prefix: str, act: Optional[str] = None,
         inv = {v: k for k, v in bmap.items()}
         out, nb = functional_call(layer, params, xx, *extra,
                                   buffers=bufs or None, training=training,
-                                  return_buffers=True)
+                                  rngs=rngs, return_buffers=True)
         if post is not None:
             out = post(out)
         out = _act(out, act)
@@ -315,3 +315,169 @@ def bilinear_tensor_product(x, y, size, act=None, name=None,
                                      bias_attr=bias_attr)
     return layer_op(layer, xv, prefix=name or "bilinear_tensor_product",
                     act=act, extra_args=(y,))
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=5, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """ref: fluid/layers/nn.py nce (operators/nce_op) — noise-contrastive
+    estimation over ``num_neg_samples`` uniformly sampled negatives:
+    per-sample loss = -log σ(s_pos) − Σ log σ(−s_neg).  Creates the
+    [num_classes, D] weight and [num_classes] bias in the Program like
+    every 1.x builder.  ``sampler`` other than 'uniform' and custom
+    distributions are not supported (documented deviation — the uniform
+    estimator carries the capability)."""
+    x = _require_var(input, "nce", "sampled softmax "
+                     "(fluid.layers.sampled_softmax_with_cross_entropy)")
+    if sampler != "uniform" or custom_dist is not None:
+        raise InvalidArgumentError(
+            "nce: only sampler='uniform' is implemented (log-uniform / "
+            "custom_dist sampling is a documented deviation)")
+    from ..nn.layer_base import Layer
+
+    D = int(x.shape[-1])
+
+    class _NCE(Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter(
+                (num_total_classes, D), attr=param_attr)
+            self.bias = self.create_parameter(
+                (num_total_classes,), attr=bias_attr, is_bias=True)
+
+        def forward(self, xx, lbl):
+            import jax as _jax
+            import jax.numpy as _jnp
+
+            from ..framework import random as _prandom
+            from ..nn.layer_base import current_rng_key
+
+            lbl = _jnp.asarray(lbl).reshape(-1)
+            pos_w = _jnp.take(self.weight.value, lbl, axis=0)
+            s_pos = (xx * pos_w).sum(-1) + _jnp.take(self.bias.value, lbl)
+            key = current_rng_key()
+            if key is None:
+                key = _prandom.default_generator().next_key()
+            neg = _jax.random.randint(
+                key, (xx.shape[0], int(num_neg_samples)),
+                0, num_total_classes)
+            neg_w = _jnp.take(self.weight.value, neg, axis=0)  # [B,S,D]
+            s_neg = _jnp.einsum("bd,bsd->bs", xx, neg_w) + \
+                _jnp.take(self.bias.value, neg)
+            loss = _jax.nn.softplus(-s_pos) + \
+                _jax.nn.softplus(s_neg).sum(-1)
+            return loss[:, None]
+
+    return layer_op(_NCE(), x, prefix=name or "nce", extra_args=(label,))
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True, name=None):
+    """ref: fluid/layers/loss.py center_loss (operators/center_loss_op) —
+    0.5·||x − center[label]||²; training updates the touched centers by
+    the running rule c ← c − α·Σ(c−x)/(1+n) (a buffer update, exactly the
+    reference's non-gradient center maintenance)."""
+    x = _require_var(input, "center_loss", "a Layer holding a centers "
+                     "buffer")
+    from ..nn.layer_base import Layer
+
+    D = int(x.shape[-1])
+
+    class _CenterLoss(Layer):
+        def __init__(self):
+            super().__init__()
+            import jax.numpy as _jnp
+
+            self.register_buffer(
+                "centers", _jnp.zeros((num_classes, D), _jnp.float32))
+
+        def forward(self, xx, lbl):
+            import jax.numpy as _jnp
+
+            lbl = _jnp.asarray(lbl).reshape(-1)
+            c = self.centers.value
+            diff = xx.astype(_jnp.float32) - _jnp.take(c, lbl, axis=0)
+            loss = 0.5 * _jnp.square(diff).sum(-1, keepdims=True)
+            if self.training and update_center:
+                counts = _jnp.zeros((num_classes,), _jnp.float32).at[
+                    lbl].add(1.0)
+                sums = _jnp.zeros_like(c).at[lbl].add(-diff)
+                upd = alpha * sums / (1.0 + counts)[:, None]
+                self.centers.value = c - upd
+            return loss
+
+    lay = _CenterLoss()
+    return layer_op(lay, x, prefix=name or "center_loss",
+                    extra_args=(label,))
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """ref: fluid/layers/nn.py sequence_conv (operators/sequence_conv_op)
+    — a context-window projection over the time dim.  Dense-padding form:
+    input is [B, T, D] (LoD → padded, §7g); each position projects the
+    concat of its ``filter_size`` context rows through a
+    [filter_size·D, num_filters] weight."""
+    x = _require_var(input, "sequence_conv",
+                     "conv1d over padded batches with sequence_mask")
+    from ..nn.layer_base import Layer
+
+    D = int(x.shape[-1])
+    start = (-(filter_size // 2) if padding_start is None
+             else int(padding_start))
+
+    class _SeqConv(Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter(
+                (filter_size * D, num_filters), attr=param_attr)
+            self.bias = (self.create_parameter(
+                (num_filters,), attr=bias_attr, is_bias=True)
+                if bias_attr is not False else None)
+
+        def forward(self, xx):
+            import jax.numpy as _jnp
+
+            T = xx.shape[1]
+            cols = []
+            for j in range(filter_size):
+                off = start + j
+                rolled = _jnp.roll(xx, -off, axis=1)
+                idx = _jnp.arange(T) + off
+                mask = ((idx >= 0) & (idx < T))[None, :, None]
+                cols.append(_jnp.where(mask, rolled, 0.0))
+            ctx = _jnp.concatenate(cols, axis=-1)      # [B, T, k·D]
+            out = ctx @ self.weight.value
+            if self.bias is not None:
+                out = out + self.bias.value
+            return out
+
+    return layer_op(_SeqConv(), x, prefix=name or "sequence_conv", act=act)
+
+
+def inplace_abn(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+                param_attr=None, bias_attr=None, data_layout="NCHW",
+                name=None, act_alpha=1.0, **kw):
+    """ref: fluid/layers/nn.py inplace_abn — batch norm with a fused
+    activation (the in-place memory trick is XLA's job here)."""
+    return batch_norm(input, act=act, is_test=is_test, momentum=momentum,
+                      epsilon=epsilon, param_attr=param_attr,
+                      bias_attr=bias_attr, data_layout=data_layout,
+                      name=name or "inplace_abn")
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """ref: fluid/layers/nn.py hsigmoid — hierarchical sigmoid loss;
+    builder over paddle.nn.HSigmoidLoss (creates the tree weights)."""
+    x = _require_var(input, "hsigmoid", "paddle.nn.HSigmoidLoss")
+    from .. import nn
+
+    layer = nn.HSigmoidLoss(int(x.shape[-1]), num_classes,
+                            weight_attr=param_attr, bias_attr=bias_attr,
+                            is_custom=is_custom, is_sparse=is_sparse)
+    extra = (label,) if path_table is None else (label, path_table,
+                                                 path_code)
+    return layer_op(layer, x, prefix=name or "hsigmoid", extra_args=extra)

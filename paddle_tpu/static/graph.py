@@ -426,11 +426,14 @@ def data(name: str, shape, dtype="float32", lod_level: int = 0) -> Variable:
 
 # -- execution ---------------------------------------------------------------
 def run_ops(ops, env: Dict[str, Any], params: Dict[str, Any],
-            buffers: Dict[str, Any], training: bool) -> None:
+            buffers: Dict[str, Any], training: bool, rng=None) -> None:
     """Play a recorded op list against a name environment (mutates ``env``
     and ``buffers``).  Shared by Executor and by control-flow blocks
     (While/StaticRNN), whose bodies are captured op lists replayed inside
-    lax.while_loop/lax.scan."""
+    lax.while_loop/lax.scan.  ``rng`` (a traced key) seeds per-op
+    randomness: scoped ops get fold_in(rng, op_index) via functional_call,
+    so dropout/NCE sampling differs per run instead of baking a trace-time
+    constant."""
 
     def subst(x):
         if isinstance(x, Variable):
@@ -445,13 +448,16 @@ def run_ops(ops, env: Dict[str, Any], params: Dict[str, Any],
         return x
 
     is_var = lambda x: isinstance(x, Variable)  # noqa: E731
-    for op in ops:
+    for op_i, op in enumerate(ops):
         args = jax.tree_util.tree_map(subst, op.args, is_leaf=is_var)
         kwargs = jax.tree_util.tree_map(subst, op.kwargs, is_leaf=is_var)
         if op.scoped:
             pv = {n: params[n] for n in op.param_names}
             bv = {n: buffers[n] for n in op.buffer_names}
-            out = op.fn(pv, bv, *args, training=training, **kwargs)
+            key = (jax.random.fold_in(rng, op_i) if rng is not None
+                   else None)
+            out = op.fn(pv, bv, *args, training=training, rngs=key,
+                        **kwargs)
         else:
             out = op.fn(*args, **kwargs)
         if op.writes_buffers:
@@ -481,10 +487,11 @@ class Executor:
     def close(self):
         self._cache.clear()
 
-    def _execute(self, program, params, buffers, feeds, training):
+    def _execute(self, program, params, buffers, feeds, training,
+                 rng=None):
         env: Dict[str, Any] = dict(feeds)
         new_buffers = dict(buffers)
-        run_ops(program.ops, env, params, new_buffers, training)
+        run_ops(program.ops, env, params, new_buffers, training, rng=rng)
         return env, new_buffers
 
     def run(self, program: Optional[Program] = None, feed=None,
@@ -539,14 +546,15 @@ class Executor:
             if only is not None:  # minimize(parameter_list=/no_grad_set=)
                 trainable &= only
 
-            def step(params, opt_state, buffers, feeds, lr):
+            def step(params, opt_state, buffers, feeds, lr, rng):
                 t_params = {n: v for n, v in params.items() if n in trainable}
                 f_params = {n: v for n, v in params.items()
                             if n not in trainable}
 
                 def loss_fn(tp):
                     env, nb = self._execute(
-                        program, {**tp, **f_params}, buffers, feeds, training)
+                        program, {**tp, **f_params}, buffers, feeds,
+                        training, rng=rng)
                     return env[loss_name].astype(jnp.float32).sum(), (env, nb)
 
                 (loss, (env, nb)), grads = jax.value_and_grad(
@@ -563,9 +571,12 @@ class Executor:
                     tp = {n: v for n, v in prog.scope.items() if n in trainable}
                     prog._opt_state = opt.init(tp)
                 lr = jnp.asarray(opt.get_lr(), jnp.float32)
+                from ..framework import random as _prandom
+
+                rng = _prandom.default_generator().next_key()
                 fetched, new_params, prog._opt_state, new_bufs = jitted(
                     dict(prog.scope), prog._opt_state, dict(prog.buffers),
-                    feeds, lr)
+                    feeds, lr, rng)
                 prog.scope.update(new_params)
                 prog.buffers.update(new_bufs)
                 sched = opt.lr_scheduler
@@ -575,14 +586,19 @@ class Executor:
 
             return runner
 
-        def fwd(params, buffers, feeds):
-            env, nb = self._execute(program, params, buffers, feeds, training)
+        def fwd(params, buffers, feeds, rng):
+            env, nb = self._execute(program, params, buffers, feeds,
+                                    training, rng=rng)
             return [env[n] for n in fetch_names], nb
 
         jitted = jax.jit(fwd)
 
         def runner(prog, feeds):
-            fetched, nb = jitted(dict(prog.scope), dict(prog.buffers), feeds)
+            from ..framework import random as _prandom
+
+            rng = _prandom.default_generator().next_key()
+            fetched, nb = jitted(dict(prog.scope), dict(prog.buffers),
+                                 feeds, rng)
             if training:  # eval clone never persists running stats
                 prog.buffers.update(nb)
             return fetched

@@ -19,7 +19,7 @@ from . import py_func, create_parameter  # noqa: F401  (real implementations)
 from .builders import (  # noqa: F401
     fc, embedding, conv2d, pool2d, batch_norm, layer_norm,
     conv2d_transpose, conv3d, conv3d_transpose, instance_norm, group_norm,
-    spectral_norm, prelu, bilinear_tensor_product,
+    spectral_norm, prelu, bilinear_tensor_product, nce, sequence_conv,
 )
 # stateless ops whose eager functional IS the implementation
 from ..nn.functional import (  # noqa: F401
@@ -42,9 +42,6 @@ _EAGER = {
     "data_norm": "paddle.nn.BatchNorm1D (data_norm's global-stat "
                  "normalization was its PS-side twin)",
     "multi_box_head": "compose paddle.nn.functional.prior_box + conv heads",
-    "nce": "paddle.nn.functional.softmax_with_cross_entropy on sampled "
-           "logits (fluid.layers.sampled_softmax_with_cross_entropy)",
-    "sequence_conv": "conv1d over padded batches with sequence_mask",
     "sparse_embedding": "paddle.nn.Embedding(sparse=True) — the "
                         "SelectedRows path (framework/selected_rows.py)",
 }
@@ -54,7 +51,8 @@ __all__ = sorted(
      "conv2d_transpose", "conv3d", "conv3d_transpose", "instance_norm",
      "group_norm", "spectral_norm", "prelu", "bilinear_tensor_product",
      "cond", "while_loop", "case", "switch_case", "crf_decoding",
-     "row_conv", "deform_conv2d", "py_func", "create_parameter"]
+     "row_conv", "deform_conv2d", "py_func", "create_parameter",
+     "nce", "sequence_conv"]
     + sorted(_EAGER))
 
 

@@ -320,3 +320,70 @@ class TestGraphContract:
         fluid.set_program_state(main, state)
         r2, = exe.run(main, feed={"x": X}, fetch_list=[out])
         np.testing.assert_array_equal(r2, np.zeros_like(r1))
+
+
+class TestBuilderBatch3:
+    """Round-4 graph builders: nce / center_loss / sequence_conv /
+    hsigmoid / inplace_abn (ref: fluid/layers/nn.py nce, loss.py
+    center_loss, nn.py sequence_conv/inplace_abn/hsigmoid)."""
+
+    def test_nce_center_seqconv_hsigmoid_train(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 16])
+            lbl = fluid.data("lbl", [-1, 1], dtype="int64")
+            seq = fluid.data("seq", [-1, 6, 8])
+            loss = (fluid.layers.mean(fluid.layers.nce(
+                        x, lbl, num_total_classes=50, num_neg_samples=4))
+                    + fluid.layers.mean(fluid.layers.center_loss(
+                        x, lbl, num_classes=50, alpha=0.1))
+                    + fluid.layers.mean(fluid.layers.sequence_conv(
+                        seq, num_filters=4, filter_size=3))
+                    + fluid.layers.mean(fluid.layers.hsigmoid(
+                        x, lbl, num_classes=50)))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.randn(8, 16).astype(np.float32),
+                "lbl": rng.randint(0, 50, (8, 1)).astype(np.int64),
+                "seq": rng.randn(8, 6, 8).astype(np.float32)}
+        first = last = None
+        for _ in range(12):
+            v, = exe.run(main, feed=feed, fetch_list=[loss])
+            first = first if first is not None else float(v)
+            last = float(v)
+        assert last < first
+        # center_loss maintains its centers BUFFER during training runs
+        assert any("center" in k for k in main.buffers)
+
+    def test_sequence_conv_matches_manual_context_projection(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            seq = fluid.data("seq", [2, 5, 3])
+            out = fluid.layers.sequence_conv(seq, num_filters=2,
+                                             filter_size=3)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        X = rng.randn(2, 5, 3).astype(np.float32)
+        o, = exe.run(main, feed={"seq": X}, fetch_list=[out])
+        w = next(v for k, v in main.scope.items() if "sequence_conv" in k
+                 and np.asarray(v).ndim == 2 and np.asarray(v).shape[0] == 9)
+        w = np.asarray(w)
+        b = next((np.asarray(v) for k, v in main.scope.items()
+                  if "sequence_conv" in k and np.asarray(v).ndim == 1), 0)
+        Xp = np.pad(X, ((0, 0), (1, 1), (0, 0)))  # context window ±1
+        ctx = np.concatenate([Xp[:, 0:5], Xp[:, 1:6], Xp[:, 2:7]], axis=-1)
+        np.testing.assert_allclose(o, ctx @ w + b, rtol=1e-4, atol=1e-5)
+
+    def test_inplace_abn_is_bn_with_act(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 3, 4, 4])
+            out = fluid.layers.inplace_abn(x, act="relu")
+        exe = fluid.Executor()
+        exe.run(startup)
+        X = np.random.RandomState(0).randn(2, 3, 4, 4).astype(np.float32)
+        o, = exe.run(main, feed={"x": X}, fetch_list=[out])
+        assert (o >= 0).all()  # activation applied
