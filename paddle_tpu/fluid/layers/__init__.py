@@ -1178,7 +1178,7 @@ def beam_search_decode(ids, scores, beam_size=None, end_id=0, name=None):
     # the per-step parent beam indices must come through the scores slot
     # (integer layout) — float log-probs carry no ancestry in dense form
     # (the 1.x op recovered it from the LoD, which dense padding replaces)
-    if scores.dtype in (jnp.float32, jnp.float64, jnp.float16):
+    if jnp.issubdtype(scores.dtype, jnp.floating):  # incl. bfloat16
         raise UnimplementedError(
             "beam_search_decode(dense): pass the per-step PARENT indices "
             "(int) in the scores argument, or use "
@@ -1191,6 +1191,13 @@ def beam_search_decode(ids, scores, beam_size=None, end_id=0, name=None):
 
 def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
                      out_val_if_empty=0):
+    from paddle_tpu.static.graph import Variable as _GVar
+
+    if isinstance(ins, _GVar) or isinstance(ins_tag, _GVar):
+        raise UnimplementedError(
+            "filter_by_instag produces a data-dependent row count and "
+            "cannot compile into a Program/jit; call it eagerly on host "
+            "arrays (e.g. at feed time) and feed the filtered batch")
     """ref: operators/filter_by_instag_op — keep rows of ``ins`` whose tag
     set intersects ``filter_tag``.  Dense form: ``ins_tag`` is [N] (one
     tag per row) or [N, K] padded with -1; returns (filtered rows, the
@@ -1219,16 +1226,64 @@ for _impl in ("pool3d", "beam_search_decode", "filter_by_instag", "crop"):
     _STATIC_ONLY.pop(_impl, None)
 # crop resolves through the 2.0 fallback (paddle.crop)
 
-for _n in ("pool3d", "beam_search_decode", "filter_by_instag"):
+for _n in ("pool3d", "beam_search_decode"):
     globals()[_n] = _maybe_record(globals()[_n])
-del _n
+del _n  # filter_by_instag stays eager-only (data-dependent output size)
 
 
 # -- round-4 graph-builder batch 3 (param-creating, real in graph mode) --
 from paddle_tpu.static.builders import (  # noqa: E402,F401
-    nce, center_loss, sequence_conv, inplace_abn, hsigmoid,
+    nce, center_loss, sequence_conv, inplace_abn, hsigmoid, lstm,
 )
 
 for _impl in ("nce", "center_loss", "sequence_conv", "inplace_abn",
-              "hsigmoid"):
+              "hsigmoid", "lstm"):
     _STATIC_ONLY.pop(_impl, None)
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    """ref: fluid/layers/rnn.py beam_search (operators/beam_search_op) —
+    one pruning step: from each batch's beam_size x K candidate expansions
+    keep the top beam_size.  Dense form: ``scores``/``ids`` are
+    [batch·beam, K]; returns (selected_ids, selected_scores[, parent_idx])
+    each [batch·beam, 1], parent_idx naming the source beam — feed the
+    collected parents to beam_search_decode/gather_tree.  Finished beams
+    (pre_ids == end_id) keep their score and re-emit end_id, as the
+    reference does."""
+    pre_ids = jnp.asarray(pre_ids).reshape(-1)
+    pre_scores = jnp.asarray(pre_scores).reshape(-1)
+    ids = jnp.asarray(ids)
+    scores = jnp.asarray(scores)
+    if ids.ndim != 2:
+        raise UnimplementedError(
+            "beam_search(dense) expects ids/scores [batch*beam, K]")
+    BK, K = scores.shape
+    batch = BK // int(beam_size)
+    if not is_accumulated:
+        scores = jnp.log(jnp.clip(scores, 1e-20)) + pre_scores[:, None]
+    # finished beams contribute exactly one candidate: (end_id, pre_score)
+    finished = (pre_ids == end_id)[:, None]
+    neg_inf = jnp.full_like(scores, -jnp.inf)
+    first_col = jnp.zeros((BK, K), bool).at[:, 0].set(True)
+    scores = jnp.where(finished, jnp.where(first_col, pre_scores[:, None],
+                                           neg_inf), scores)
+    ids = jnp.where(finished, jnp.full_like(ids, end_id), ids)
+    flat_s = scores.reshape(batch, int(beam_size) * K)
+    flat_i = ids.reshape(batch, int(beam_size) * K)
+    top_s, top_pos = jax.lax.top_k(flat_s, int(beam_size))
+    sel_ids = jnp.take_along_axis(flat_i, top_pos, axis=1)
+    parent = top_pos // K  # source beam within the batch
+    out_ids = sel_ids.reshape(-1, 1).astype(jnp.int64)
+    out_scores = top_s.reshape(-1, 1)
+    parent_idx = (parent + jnp.arange(batch)[:, None] * int(beam_size)
+                  ).reshape(-1).astype(jnp.int64)
+    if return_parent_idx:
+        return out_ids, out_scores, parent_idx
+    return out_ids, out_scores
+
+
+for _impl in ("beam_search",):
+    _STATIC_ONLY.pop(_impl, None)
+globals()["beam_search"] = _maybe_record(globals()["beam_search"])

@@ -336,19 +336,23 @@ class _WhileBlock:
                 else jnp.zeros(*carry_shapes[n]) for n in carried)
 
             def cond_f(state):
-                c, _ = state
+                c, _, _ = state
                 return c.reshape(()).astype(bool)
 
             def body_f(state):
-                c, carry = state
+                c, it, carry = state
                 env = dict(ext_env)
                 env[cond_name] = c
                 env.update(zip(carried, carry))
-                run_ops(body_ops, env, pv, {}, training, rng=rngs)
-                return env[cond_name], tuple(env[n] for n in carried)
+                # fresh randomness per iteration, not one draw for all
+                key = (jax.random.fold_in(rngs, it)
+                       if rngs is not None else None)
+                run_ops(body_ops, env, pv, {}, training, rng=key)
+                return (env[cond_name], it + 1,
+                        tuple(env[n] for n in carried))
 
-            final_c, final_carry = lax.while_loop(
-                cond_f, body_f, (cond0, carry0))
+            final_c, _, final_carry = lax.while_loop(
+                cond_f, body_f, (cond0, jnp.int32(0), carry0))
             return (final_c,) + final_carry
 
         # the op re-assigns the cond and every carried name: later ops see
@@ -475,16 +479,22 @@ class StaticRNN:
                     carry0.append(jnp.full(shape, m["init_value"],
                                            m["ph"].dtype))
 
-            def step_f(carry, xs_t):
+            def step_f(carry, t_and_xs):
+                t_idx, xs_t = t_and_xs
                 env = dict(ext_env)
                 env.update(zip(seq_ph_names, xs_t))
                 env.update(zip(mem_ph_names, carry))
-                run_ops(body_ops, env, pv, dict(bv), training, rng=rngs)
+                key = (jax.random.fold_in(rngs, t_idx)
+                       if rngs is not None else None)
+                run_ops(body_ops, env, pv, dict(bv), training, rng=key)
                 new_carry = tuple(env[n] for n in new_names)
                 outs = tuple(env[n] for n in out_names)
                 return new_carry, outs
 
-            _, stacked = lax.scan(step_f, tuple(carry0), tuple(xs_vals))
+            T = xs_vals[0].shape[0]
+            _, stacked = lax.scan(
+                step_f, tuple(carry0),
+                (jnp.arange(T, dtype=jnp.int32), tuple(xs_vals)))
             return stacked if len(out_names) > 1 else stacked[0]
 
         result = record_call(fn, *srcs, *inits, *ext,

@@ -481,3 +481,35 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
     extra = (label,) if path_table is None else (label, path_table,
                                                  path_code)
     return layer_op(layer, x, prefix=name or "hsigmoid", extra_args=extra)
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """ref: fluid/layers/rnn.py lstm (the cudnn-style fused multi-layer
+    LSTM) — builder over paddle.nn.LSTM on dense [B, T, D] input; returns
+    (out, last_h, last_c) like the reference."""
+    x = _require_var(input, "lstm", "paddle.nn.LSTM")
+    from .. import nn
+
+    layer = nn.LSTM(int(x.shape[-1]), hidden_size, num_layers=num_layers,
+                    direction="bidirect" if is_bidirec else "forward",
+                    dropout=dropout_prob)
+
+    prog = default_main_program()
+    from ..nn.layer_base import functional_call
+
+    pmap = {}
+    for ln, box in layer.named_parameters():
+        sname = prog.unique_name(f"lstm.{ln.replace('.', '_')}")
+        prog.register_param(sname, box.value, trainable=box.trainable)
+        pmap[sname] = ln
+
+    def fn(pv, bv, xx, h0, c0, *, training=False, rngs=None):
+        params = {pmap[n]: v for n, v in pv.items()}
+        out, (h, c) = functional_call(
+            layer, params, xx, (h0, c0), training=training, rngs=rngs)
+        return out, h, c
+
+    return record_call(fn, x, init_h, init_c, prefix=name or "lstm",
+                       param_names=tuple(pmap), scoped=True)

@@ -387,3 +387,46 @@ class TestBuilderBatch3:
         X = np.random.RandomState(0).randn(2, 3, 4, 4).astype(np.float32)
         o, = exe.run(main, feed={"x": X}, fetch_list=[out])
         assert (o >= 0).all()  # activation applied
+
+
+class TestBeamSearchAndLstm:
+    def test_beam_search_dense_pruning_and_finished_beams(self):
+        import paddle_tpu.fluid as fl
+
+        pre_ids = np.array([[0], [2]], np.int64)      # beam 0 finished
+        pre_scores = np.array([[-1.0], [-2.0]], np.float32)
+        ids = np.array([[10, 11, 12], [20, 21, 22]], np.int64)
+        scores = np.array([[-9, -9, -9], [-1.5, -2.1, -9]], np.float32)
+        si, ss, pi = fl.layers.beam_search(
+            pre_ids, pre_scores, ids, scores, beam_size=2, end_id=0,
+            return_parent_idx=True)
+        # finished beam re-emits end_id with its own score; live beam's
+        # best expansion wins the other slot
+        got = list(zip(np.asarray(si).ravel().tolist(),
+                       np.asarray(pi).ravel().tolist()))
+        assert (0, 0) in got and (20, 1) in got
+
+    def test_lstm_builder_trains(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 5, 8])
+            h0 = fluid.data("h0", [1, -1, 16])
+            c0 = fluid.data("c0", [1, -1, 16])
+            y = fluid.data("y", [-1, 16])
+            out, lh, lc = fluid.layers.lstm(x, h0, c0, 5, 16, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(lh[0], y))
+            fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.randn(4, 5, 8).astype(np.float32),
+                "h0": np.zeros((1, 4, 16), np.float32),
+                "c0": np.zeros((1, 4, 16), np.float32),
+                "y": np.tanh(rng.randn(4, 16)).astype(np.float32)}
+        first = last = None
+        for _ in range(25):
+            v, = exe.run(main, feed=feed, fetch_list=[loss])
+            first = first if first is not None else float(v)
+            last = float(v)
+        assert last < first * 0.8
