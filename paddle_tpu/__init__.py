@@ -161,14 +161,16 @@ def batch(reader, batch_size, drop_last=False):
 
 
 def in_dygraph_mode() -> bool:
-    """Parity: paddle.in_dygraph_mode — this framework has ONE runtime
-    (eager trace-to-XLA), so it is always 'dygraph'."""
-    return True
+    """Parity: paddle.in_dygraph_mode — True unless enable_static()
+    switched the process into graph-building mode (static/graph.py)."""
+    from .static import graph as _graph
+
+    return not _graph.static_mode_enabled()
 
 
 def in_dynamic_mode() -> bool:
-    """2.0 rename of in_dygraph_mode (same single-runtime answer)."""
-    return True
+    """2.0 rename of in_dygraph_mode."""
+    return in_dygraph_mode()
 
 
 def grad(outputs=None, inputs=None, grad_outputs=None, retain_graph=None,
@@ -228,22 +230,22 @@ def monkey_patch_variable():
 
 
 def disable_static(place=None):
-    """Parity no-op: eager IS the (only) mode — common 2.0 scripts call
-    this at the top and should keep working unchanged."""
+    """Leave graph-building mode (the 2.0 preamble); a no-op when it was
+    never entered."""
+    from .static import graph as _graph
+
+    _graph.set_static_mode(False)
 
 
 def enable_static():
-    """The reference's static Program mode does not exist here — whole-
-    graph compilation happens by tracing eager code (jaxpr replaces
-    Program, SURVEY §7).  Raises with the migration path."""
-    from .framework.errors import UnimplementedError
+    """Enter 1.x graph-building mode: ``paddle.static.data`` returns
+    graph Variables and builders/ops record into the default Program
+    (static/graph.py — the Program compiles into one XLA computation per
+    Executor.run signature).  ``program_guard`` works without this too;
+    the global toggle exists for the classic script preamble."""
+    from .static import graph as _graph
 
-    raise UnimplementedError(
-        "enable_static(): there is no Program interpreter in this "
-        "framework — eager code is traced and whole-graph compiled by "
-        "XLA already.  Use Model.prepare/fit (fused jit train step), "
-        "jit.to_static (compiled callables), or inference.save_inference_model "
-        "(AOT export) for the use cases static mode served")
+    _graph.set_static_mode(True)
 
 
 def enable_dygraph(place=None):

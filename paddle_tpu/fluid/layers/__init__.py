@@ -1191,18 +1191,17 @@ def beam_search_decode(ids, scores, beam_size=None, end_id=0, name=None):
 
 def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
                      out_val_if_empty=0):
-    from paddle_tpu.static.graph import Variable as _GVar
-
-    if isinstance(ins, _GVar) or isinstance(ins_tag, _GVar):
-        raise UnimplementedError(
-            "filter_by_instag produces a data-dependent row count and "
-            "cannot compile into a Program/jit; call it eagerly on host "
-            "arrays (e.g. at feed time) and feed the filtered batch")
     """ref: operators/filter_by_instag_op — keep rows of ``ins`` whose tag
     set intersects ``filter_tag``.  Dense form: ``ins_tag`` is [N] (one
     tag per row) or [N, K] padded with -1; returns (filtered rows, the
     kept row indices, loss-weight vector) like the reference's three
-    outputs."""
+    outputs.  Eager-only: the output row count is data-dependent and
+    cannot compile into a Program/jit."""
+    if any(isinstance(a, _GraphVar) for a in (ins, ins_tag, filter_tag)):
+        raise UnimplementedError(
+            "filter_by_instag produces a data-dependent row count and "
+            "cannot compile into a Program/jit; call it eagerly on host "
+            "arrays (e.g. at feed time) and feed the filtered batch")
     ins = jnp.asarray(ins)
     tags = jnp.asarray(ins_tag)
     if tags.ndim == 1:
@@ -1256,10 +1255,16 @@ def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
     pre_scores = jnp.asarray(pre_scores).reshape(-1)
     ids = jnp.asarray(ids)
     scores = jnp.asarray(scores)
-    if ids.ndim != 2:
+    if ids.ndim != 2 or scores.ndim != 2 or ids.shape != scores.shape:
         raise UnimplementedError(
-            "beam_search(dense) expects ids/scores [batch*beam, K]")
+            "beam_search(dense) expects matching ids/scores "
+            "[batch*beam, K]")
     BK, K = scores.shape
+    if BK % int(beam_size):
+        raise UnimplementedError(
+            f"beam_search: leading dim {BK} is not a multiple of "
+            f"beam_size {beam_size} — in graph mode declare the "
+            f"batch*beam dim statically (not -1)")
     batch = BK // int(beam_size)
     if not is_accumulated:
         scores = jnp.log(jnp.clip(scores, 1e-20)) + pre_scores[:, None]
@@ -1286,4 +1291,31 @@ def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
 
 for _impl in ("beam_search",):
     _STATIC_ONLY.pop(_impl, None)
-globals()["beam_search"] = _maybe_record(globals()["beam_search"])
+
+
+def _beam_search_graph_dispatch(fn):
+    import functools as _ft
+
+    @_ft.wraps(fn)
+    def wrapped(pre_ids, pre_scores, ids, scores, beam_size, end_id, **kw):
+        from paddle_tpu.static.graph import in_graph_mode, record_call
+
+        if in_graph_mode(pre_ids, pre_scores, ids, scores):
+            # the shape probe replaces -1 dims with 1, which cannot carry
+            # a batch*beam factorization — require a static leading dim
+            for v in (ids, scores):
+                if isinstance(v, _GraphVar) and v.shape[0] is None:
+                    raise UnimplementedError(
+                        "beam_search in graph mode needs a STATIC "
+                        "batch*beam leading dim (declare it instead of "
+                        "-1: the pruning factorizes that dim)")
+            return record_call(fn, pre_ids, pre_scores, ids, scores,
+                               beam_size, end_id, **kw)
+        return fn(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                  **kw)
+
+    return wrapped
+
+
+globals()["beam_search"] = _beam_search_graph_dispatch(
+    globals()["beam_search"])

@@ -112,9 +112,14 @@ def make_symbols(specs) -> dict:
 
 def data(name, shape, dtype="float32", lod_level=0):
     """Declare a feed slot (ref: static/input.py data / fluid/data.py:23).
-    Eager mapping: returns the ``InputSpec`` for that slot — the one
-    object here that plays the 'declared graph input' role (export
-    signatures, jit.save)."""
+    In graph mode (enable_static() / an active program_guard): a graph
+    Variable in the default Program.  Otherwise: the ``InputSpec`` for
+    that slot — the declared-graph-input role for export signatures and
+    jit.save."""
+    from .graph import data as _gdata, in_program_guard
+
+    if in_program_guard():
+        return _gdata(name, shape, dtype or "float32")
     return InputSpec(shape, dtype or "float32", name)
 
 

@@ -49,15 +49,10 @@ def _act(out, act):
     return fn(out)
 
 
-def layer_op(layer, x, *, prefix: str, act: Optional[str] = None,
-             post=None, extra_args=(), force_training: Optional[bool] = None):
-    """Register ``layer``'s params/buffers in the current program and
-    record an op running it via functional_call.  The shared machinery of
-    every builder below (and of contrib builders that want it).
-    ``force_training`` pins the layer's mode regardless of the run's
-    train/eval flag (batch_norm(is_test=True) semantics)."""
-    from ..nn.layer_base import functional_call
-
+def _register_layer_state(layer, prefix):
+    """Register a build-time Layer's params/buffers in the current
+    Program's scope; returns (scope-name → layer-name) maps.  Shared by
+    layer_op and the multi-output builders (lstm)."""
     prog = default_main_program()
     pmap, bmap = {}, {}
     for ln, box in layer.named_parameters():
@@ -68,6 +63,19 @@ def layer_op(layer, x, *, prefix: str, act: Optional[str] = None,
         sname = prog.unique_name(f"{prefix}.{ln.replace('.', '_')}")
         prog.register_buffer(sname, box.value)
         bmap[sname] = ln
+    return pmap, bmap
+
+
+def layer_op(layer, x, *, prefix: str, act: Optional[str] = None,
+             post=None, extra_args=(), force_training: Optional[bool] = None):
+    """Register ``layer``'s params/buffers in the current program and
+    record an op running it via functional_call.  The shared machinery of
+    every builder below (and of contrib builders that want it).
+    ``force_training`` pins the layer's mode regardless of the run's
+    train/eval flag (batch_norm(is_test=True) semantics)."""
+    from ..nn.layer_base import functional_call
+
+    pmap, bmap = _register_layer_state(layer, prefix)
     has_buf = bool(bmap)
 
     def fn(pv, bv, xx, *extra, training=False, rngs=None):
@@ -492,20 +500,21 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
     x = _require_var(input, "lstm", "paddle.nn.LSTM")
     from .. import nn
 
+    if x.shape[-1] is None:
+        raise InvalidArgumentError(
+            "lstm: the input feature dim must be static (it sizes the "
+            "gate weights); declare it instead of -1")
     layer = nn.LSTM(int(x.shape[-1]), hidden_size, num_layers=num_layers,
                     direction="bidirect" if is_bidirec else "forward",
                     dropout=dropout_prob)
 
-    prog = default_main_program()
     from ..nn.layer_base import functional_call
 
-    pmap = {}
-    for ln, box in layer.named_parameters():
-        sname = prog.unique_name(f"lstm.{ln.replace('.', '_')}")
-        prog.register_param(sname, box.value, trainable=box.trainable)
-        pmap[sname] = ln
+    pmap, _ = _register_layer_state(layer, name or "lstm")
 
     def fn(pv, bv, xx, h0, c0, *, training=False, rngs=None):
+        if is_test:  # eval semantics regardless of the run's train flag
+            training = False
         params = {pmap[n]: v for n, v in pv.items()}
         out, (h, c) = functional_call(
             layer, params, xx, (h0, c0), training=training, rngs=rngs)

@@ -315,11 +315,24 @@ def program_guard(main_program: Program, startup_program: Optional[Program] = No
         s.guard_depth -= 1
 
 
+_static_mode = False
+
+
+def set_static_mode(on: bool) -> None:
+    global _static_mode
+    _static_mode = bool(on)
+
+
+def static_mode_enabled() -> bool:
+    return _static_mode
+
+
 def in_program_guard() -> bool:
-    """True inside a ``with program_guard(...)`` block — where source-less
-    builders (fill_constant, py_reader slots) must create graph Variables
-    rather than eager arrays."""
-    return getattr(_progs(), "guard_depth", 0) > 0
+    """True inside a ``with program_guard(...)`` block OR after
+    paddle.enable_static() — where source-less builders (fill_constant,
+    py_reader slots) must create graph Variables rather than eager
+    arrays."""
+    return _static_mode or getattr(_progs(), "guard_depth", 0) > 0
 
 
 def in_graph_mode(*values) -> bool:

@@ -277,19 +277,24 @@ class TestTensorUtilities:
         assert abs(float(np.asarray(a).std()) - 0.5) < 0.1
         assert paddle.gaussian([2], dtype="float64").dtype == jnp.float64
 
-    def test_static_mode_shims(self):
-        from paddle_tpu.framework.errors import UnimplementedError
-
+    def test_static_mode_real(self):
         paddle.disable_static()  # common 2.0 preamble — must be a no-op
-        with pytest.raises(UnimplementedError, match="Program"):
-            paddle.enable_static()
-        # Program-machinery names exist (importable) but raise on USE,
-        # and the error doubles as AttributeError for feature probes
-        assert hasattr(paddle.static, "Program")
-        with pytest.raises(UnimplementedError, match="Model.fit"):
-            paddle.static.Executor()
-        with pytest.raises(AttributeError):
-            paddle.static.Program()
+        assert paddle.in_dygraph_mode()
+        # the 1.x preamble now actually enters graph-building mode
+        # (static/graph.py): static.data returns a Program Variable
+        paddle.enable_static()
+        try:
+            assert not paddle.in_dygraph_mode()
+            v = paddle.static.data("x_mode", [-1, 3])
+            from paddle_tpu.static.graph import Variable as GraphVar
+
+            assert isinstance(v, GraphVar)
+            assert paddle.static.Executor() is not None
+            assert isinstance(paddle.static.Program(),
+                              paddle.static.Program)
+        finally:
+            paddle.disable_static()
+        assert paddle.in_dygraph_mode()
         with pytest.raises(AttributeError):
             paddle.static.definitely_not_an_api
         spec = paddle.static.InputSpec([2, 3])
