@@ -825,7 +825,7 @@ def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
 from .control_flow import (  # noqa: E402,F401
     cond, while_loop, case, switch_case, While, StaticRNN, increment,
     less_than, array_write, array_read, array_length, create_array,
-    tensor_array_to_tensor, Assert,
+    tensor_array_to_tensor, Assert, Switch, IfElse,
 )
 
 # Parameter-creating op-builders over the recorded graph (static/builders)
@@ -1233,10 +1233,12 @@ del _n  # filter_by_instag stays eager-only (data-dependent output size)
 # -- round-4 graph-builder batch 3 (param-creating, real in graph mode) --
 from paddle_tpu.static.builders import (  # noqa: E402,F401
     nce, center_loss, sequence_conv, inplace_abn, hsigmoid, lstm,
+    data_norm, multi_box_head,
 )
 
 for _impl in ("nce", "center_loss", "sequence_conv", "inplace_abn",
-              "hsigmoid", "lstm"):
+              "hsigmoid", "lstm", "data_norm", "multi_box_head",
+              "Switch", "IfElse"):
     _STATIC_ONLY.pop(_impl, None)
 
 

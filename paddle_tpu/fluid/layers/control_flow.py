@@ -226,10 +226,12 @@ class _BlockCapture:
     def __init__(self):
         self.ops: List[Op] = []
         self._start = None
+        self.pre_vars: set = set()
 
     def __enter__(self):
         self._prog = default_main_program()
         self._start = len(self._prog.ops)
+        self.pre_vars = set(self._prog.vars)
         return self
 
     def __exit__(self, *exc):
@@ -516,4 +518,223 @@ class _RNNStep:
         self.rnn._cap.__exit__(*exc)
         if exc[0] is None:
             self.rnn._finalize(self.rnn._cap.ops)
+        return False
+
+
+class Switch:
+    """ref control_flow.py Switch (:fluid 1.x) — Program-block case
+    dispatch:
+
+        with fluid.layers.Switch() as switch:
+            with switch.case(cond1):
+                fluid.layers.assign(v1, output=out)
+            with switch.case(cond2):
+                ...
+            with switch.default():
+                fluid.layers.assign(v0, output=out)
+
+    Each case's captured ops replay under a nested lax.cond chain; the
+    FIRST true condition wins (reference semantics), and names assigned
+    in untaken cases keep their prior values (assign into pre-created
+    Variables, the 1.x idiom)."""
+
+    def __init__(self, name=None):
+        self._cases: List[tuple] = []   # (cond Variable | None, ops)
+        self._entered = False
+
+    def __enter__(self):
+        self._entered = True
+        return self
+
+    def case(self, condition):
+        if not isinstance(condition, Variable):
+            raise InvalidArgumentError(
+                "Switch.case needs a graph-mode bool Variable; eager "
+                "dispatch is fluid.layers.case / switch_case")
+        if any(c is None for c, _ in self._cases):
+            raise InvalidArgumentError(
+                "Switch: case() after default() would be unreachable "
+                "(the reference rejects this ordering too)")
+        return _SwitchCase(self, condition)
+
+    def default(self):
+        return _SwitchCase(self, None)
+
+    def __exit__(self, *exc):
+        if exc[0] is not None:
+            return False
+        if not self._cases:
+            raise InvalidArgumentError("Switch: no case blocks recorded")
+        # assemble one op: nested first-match-wins conds over the blocks.
+        # only MUTATIONS of pre-existing names are the Switch's outputs —
+        # temps created inside a case stay internal to its replay
+        pre = self._pre_vars
+        all_ops = [ops for _, ops in self._cases]
+        assigned = list(dict.fromkeys(
+            n for ops in all_ops for op in ops for n in op.out_names
+            if n in pre))
+        pnames, bnames = _body_param_names(
+            [op for ops in all_ops for op in ops])
+        if bnames:
+            raise InvalidArgumentError(
+                "Switch cases cannot contain buffered layers")
+        ext = _external_reads(
+            [op for ops in all_ops for op in ops], set())
+        ext_names = [e.name for e in ext]
+        conds = [c for c, _ in self._cases]
+        # names assigned by cases but not read inside them still need an
+        # incoming value (the no-match path keeps it): feed the program's
+        # pre-Switch Variable of the same name
+        prog = default_main_program()
+        for n in assigned:
+            if n not in ext_names:
+                v = prog.vars.get(n)
+                if v is None:
+                    raise InvalidArgumentError(
+                        f"Switch: assigned name {n!r} has no value before "
+                        f"the Switch (create it with fill_constant first)")
+                ext.append(v)
+                ext_names.append(n)
+
+        def fn(pv, bv, *args, training=False, rngs=None):
+            n_conds = sum(1 for c in conds if c is not None)
+            cond_vals = list(args[:n_conds])
+            ext_vals = args[n_conds:]
+            base_env = dict(zip(ext_names, ext_vals))
+
+            def run_block(ops):
+                env = dict(base_env)
+                run_ops(ops, env, pv, {}, training, rng=rngs)
+                return tuple(env[n] for n in assigned)
+
+            def chain(i, ci):
+                c, ops = self._cases[i]
+                if c is None:  # default: unconditional
+                    return run_block(ops)
+                this = lambda: run_block(ops)  # noqa: E731
+                if i + 1 < len(self._cases):
+                    rest = lambda: chain(i + 1, ci + 1)  # noqa: E731
+                else:
+                    rest = lambda: tuple(  # no match: keep incoming
+                        base_env[n] for n in assigned)  # noqa: E731
+                return lax.cond(cond_vals[ci].reshape(()).astype(bool),
+                                this, rest)
+
+            return chain(0, 0)
+
+        cond_args = [c for c in conds if c is not None]
+        record_call(fn, *cond_args, *ext, out_names=assigned,
+                    param_names=pnames, scoped=True, prefix="switch")
+        return False
+
+
+class _SwitchCase:
+    def __init__(self, switch: Switch, condition):
+        self._switch = switch
+        self._cond = condition
+        self._cap = _BlockCapture()
+
+    def __enter__(self):
+        self._cap.__enter__()
+        # names existing before the FIRST case are the mutable surface
+        if not hasattr(self._switch, "_pre_vars"):
+            self._switch._pre_vars = set(self._cap.pre_vars)
+        return self
+
+    def __exit__(self, *exc):
+        self._cap.__exit__(*exc)
+        if exc[0] is None:
+            self._switch._cases.append((self._cond, self._cap.ops))
+        return False
+
+
+class IfElse:
+    """ref control_flow.py IfElse — row-wise conditional: ``cond`` is a
+    [N, 1] bool mask; the true block sees (conceptually) the rows where
+    cond holds, the false block the rest, and outputs merge row-wise.
+
+    Dense form: both blocks run on the FULL batch (XLA computes both
+    sides of a select anyway) and ``output()`` pairs merge with
+    ``where(cond, true_row, false_row)`` — mathematically the reference's
+    split/merge for elementwise blocks, without LoD scatter plumbing."""
+
+    def __init__(self, cond, name=None):
+        if not isinstance(cond, Variable):
+            raise InvalidArgumentError(
+                "IfElse needs a graph-mode bool Variable mask [N, 1]")
+        self._cond = cond
+        self._blocks = {}   # True/False -> (ops, outputs)
+        self._cur = None
+        self._cur_outs: List[Variable] = []
+        self._cap = None
+
+    def _block(self, flag):
+        return _IfElseBlock(self, flag)
+
+    def true_block(self):
+        return self._block(True)
+
+    def false_block(self):
+        return self._block(False)
+
+    def input(self, x):
+        """Inside a block: the reference slices x to the branch's rows;
+        dense form passes it through (merging happens at output())."""
+        return x
+
+    def output(self, *outs):
+        if self._cur is None:
+            raise InvalidArgumentError(
+                "IfElse.output() must be called inside true_block()/"
+                "false_block()")
+        self._cur_outs.extend(outs)
+
+    def __call__(self):
+        t = self._blocks.get(True)
+        f = self._blocks.get(False)
+        if not t or not f:
+            raise InvalidArgumentError(
+                "IfElse: both true_block() and false_block() must run "
+                "and declare output()s")
+        t_ops, t_outs = t
+        f_ops, f_outs = f
+        if len(t_outs) != len(f_outs):
+            raise InvalidArgumentError(
+                "IfElse: the two blocks declared different output counts")
+        cond = self._cond
+        results = []
+        for to, fo in zip(t_outs, f_outs):
+            def merge(c, a, b):
+                c = jnp.asarray(c)
+                mask = c.reshape(c.shape[0], *([1] * (jnp.asarray(a).ndim - 1)))
+                return jnp.where(mask.astype(bool), a, b)
+
+            results.append(record_call(merge, cond, to, fo,
+                                       prefix="ifelse_merge"))
+        return results
+
+
+class _IfElseBlock:
+    def __init__(self, ie: IfElse, flag: bool):
+        self._ie = ie
+        self._flag = flag
+        self._cap = _BlockCapture()
+
+    def __enter__(self):
+        self._ie._cur = self._flag
+        self._ie._cur_outs = []
+        self._cap.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._cap.__exit__(*exc)
+        ie = self._ie
+        if exc[0] is None:
+            # re-append the block's ops: both branches execute on the full
+            # batch (dense row-select replaces the reference's LoD split)
+            prog = default_main_program()
+            for op in self._cap.ops:
+                prog.append_op(op)
+            ie._blocks[self._flag] = (self._cap.ops, list(ie._cur_outs))
+        ie._cur = None
         return False

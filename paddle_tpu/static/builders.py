@@ -522,3 +522,166 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
 
     return record_call(fn, x, init_h, init_c, prefix=name or "lstm",
                        param_names=tuple(pmap), scoped=True)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """ref: fluid/layers/nn.py:3220 data_norm (operators/data_norm_op.cc:
+    301 — mean = batch_sum/batch_size, scale = sqrt(batch_size/
+    batch_square_sum)) — global-statistics normalization for CTR features.
+    The three summaries live as buffers updated on training runs with the
+    reference's decay (its grad-op summary maintenance, here a forward
+    buffer update — same statistics, no Program rewrite)."""
+    x = _require_var(input, "data_norm", "paddle.nn.BatchNorm1D")
+    from ..nn.layer_base import Layer
+
+    if len(x.shape) != 2:
+        raise InvalidArgumentError(
+            "data_norm normalizes 2-D [batch, C] CTR feature slots (the "
+            "reference's primary use); for image tensors use batch_norm")
+    C = int(x.shape[-1])
+
+    class _DataNorm(Layer):
+        def __init__(self):
+            super().__init__()
+            import jax.numpy as _jnp
+
+            # reference startup init: size = sqsum = 1e4, sum = 0 → the
+            # initial scale is exactly 1
+            self.register_buffer("batch_size",
+                                 _jnp.full((C,), 1e4, _jnp.float32))
+            self.register_buffer("batch_sum", _jnp.zeros((C,), _jnp.float32))
+            self.register_buffer("batch_square_sum",
+                                 _jnp.full((C,), 1e4, _jnp.float32))
+            if enable_scale_and_shift:
+                self.scale_w = self.create_parameter((C,), attr=param_attr)
+                self.bias = self.create_parameter((C,), is_bias=True)
+
+        def forward(self, xx):
+            import jax.numpy as _jnp
+
+            xf = xx.astype(_jnp.float32).reshape(-1, C)
+            size = self.batch_size.value
+            mean = self.batch_sum.value / size
+            scale = _jnp.sqrt(size / self.batch_square_sum.value)
+            out = (xf - mean) * scale
+            if enable_scale_and_shift:
+                out = out * self.scale_w.value + self.bias.value
+            if self.training:
+                d = summary_decay_rate
+                n = xf.shape[0]
+                self.batch_size.value = d * size + n
+                self.batch_sum.value = d * self.batch_sum.value + xf.sum(0)
+                self.batch_square_sum.value = (
+                    d * self.batch_square_sum.value
+                    + _jnp.square(xf).sum(0))
+            return out.reshape(xx.shape).astype(xx.dtype)
+
+    return layer_op(_DataNorm(), x, prefix=name or "data_norm", act=act)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """ref: fluid/layers/detection.py multi_box_head — the SSD head: one
+    loc conv + one conf conv + one prior_box per feature map, gathered
+    into (mbox_locs, mbox_confs, boxes, variances).  Conv parameters are
+    created per map through the conv2d builder (graph mode); min/max
+    sizes follow the reference's ratio interpolation when not given."""
+    from ..nn import functional as F
+
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    for t in inputs:
+        _require_var(t, "multi_box_head", "compose nn.Conv2D + prior_box")
+    n_maps = len(inputs)
+    if min_sizes is None:
+        if n_maps < 3:
+            raise InvalidArgumentError(
+                "multi_box_head: the min/max-ratio interpolation needs at "
+                "least 3 feature maps (it divides by n_maps-2, "
+                "detection.py); pass explicit min_sizes/max_sizes for "
+                "fewer maps")
+        # reference interpolation (detection.py): ratios in percent over
+        # [min_ratio, max_ratio], first map at min_ratio/2
+        step_r = int((max_ratio - min_ratio) / (n_maps - 2))
+        min_sizes, max_sizes = [], []
+        for r in range(int(min_ratio), int(max_ratio) + 1,
+                       max(step_r, 1)):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step_r) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes
+        max_sizes = [base_size * 0.20] + max_sizes
+    min_sizes = [([s] if not isinstance(s, (list, tuple)) else list(s))
+                 for s in min_sizes]
+    max_sizes = [([s] if not isinstance(s, (list, tuple)) else list(s))
+                 for s in (max_sizes or [None] * n_maps)]
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i]
+        ar = [ar] if not isinstance(ar, (list, tuple)) else list(ar)
+        # mirror prior_box's EXACT expansion (detection.py prior_box: ars
+        # starts [1.0]; each new ratio adds itself and, when flip, its
+        # reciprocal; duplicates — notably ar == 1.0 — are skipped)
+        exp = [1.0]
+        for r in ar:
+            r = float(r)
+            if not any(__import__("math").isclose(r, e, abs_tol=1e-6)
+                       for e in exp):
+                exp.append(r)
+                if flip:
+                    exp.append(1.0 / r)
+        n_priors = len(min_sizes[i]) * len(exp)
+        if max_sizes[i] and max_sizes[i][0]:
+            n_priors += len(max_sizes[i])
+        loc = conv2d(feat, n_priors * 4, kernel_size, stride=stride,
+                     padding=pad, name=f"{name or 'mbox'}_loc{i}")
+        conf = conv2d(feat, n_priors * num_classes, kernel_size,
+                      stride=stride, padding=pad,
+                      name=f"{name or 'mbox'}_conf{i}")
+
+        def to_last(v, ch):
+            # [B, C, H, W] → [B, H*W*priors, ch]
+            return record_call(
+                lambda t: t.transpose(0, 2, 3, 1).reshape(
+                    t.shape[0], -1, ch), v, prefix="mbox_reshape")
+
+        locs.append(to_last(loc, 4))
+        confs.append(to_last(conf, num_classes))
+
+        step = (steps[i] if steps else 0.0)
+        sw = step_w[i] if step_w else step
+        sh = step_h[i] if step_h else step
+
+        def prior(feat_v, i=i, ar=ar, sw=sw, sh=sh):
+            def fn(fv, img):
+                b, v = F.prior_box(
+                    fv, img, min_sizes=min_sizes[i],
+                    max_sizes=[m for m in max_sizes[i] if m] or None,
+                    aspect_ratios=ar, variance=list(variance), flip=flip,
+                    clip=clip, steps=[sw, sh], offset=offset,
+                    min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+                import jax.numpy as _jnp
+
+                return (b.reshape(-1, 4), v.reshape(-1, 4))
+
+            return record_call(fn, feat_v, image, prefix="prior_box")
+
+        b, v = prior(feat)
+        boxes_all.append(b)
+        vars_all.append(v)
+
+    import jax.numpy as _jnp
+
+    cat = lambda vs, ax: record_call(  # noqa: E731
+        lambda *ts: _jnp.concatenate(ts, axis=ax), *vs, prefix="mbox_cat")
+    return (cat(locs, 1), cat(confs, 1), cat(boxes_all, 0),
+            cat(vars_all, 0))

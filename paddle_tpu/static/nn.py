@@ -20,6 +20,7 @@ from .builders import (  # noqa: F401
     fc, embedding, conv2d, pool2d, batch_norm, layer_norm,
     conv2d_transpose, conv3d, conv3d_transpose, instance_norm, group_norm,
     spectral_norm, prelu, bilinear_tensor_product, nce, sequence_conv,
+    data_norm, multi_box_head,
 )
 # stateless ops whose eager functional IS the implementation
 from ..nn.functional import (  # noqa: F401
@@ -39,9 +40,6 @@ def __getattr__(name):  # deferred: fluid.layers imports paddle_tpu itself
 
 #: remaining static.nn names → the eager implementation they map to
 _EAGER = {
-    "data_norm": "paddle.nn.BatchNorm1D (data_norm's global-stat "
-                 "normalization was its PS-side twin)",
-    "multi_box_head": "compose paddle.nn.functional.prior_box + conv heads",
     "sparse_embedding": "paddle.nn.Embedding(sparse=True) — the "
                         "SelectedRows path (framework/selected_rows.py)",
 }
@@ -52,7 +50,7 @@ __all__ = sorted(
      "group_norm", "spectral_norm", "prelu", "bilinear_tensor_product",
      "cond", "while_loop", "case", "switch_case", "crf_decoding",
      "row_conv", "deform_conv2d", "py_func", "create_parameter",
-     "nce", "sequence_conv"]
+     "nce", "sequence_conv", "data_norm", "multi_box_head"]
     + sorted(_EAGER))
 
 

@@ -430,3 +430,156 @@ class TestBeamSearchAndLstm:
             first = first if first is not None else float(v)
             last = float(v)
         assert last < first * 0.8
+
+
+class TestBuilderBatch4:
+    """Switch/IfElse block capture + data_norm + multi_box_head (ref:
+    fluid control_flow Switch/IfElse, nn.py:3220 data_norm,
+    detection.py multi_box_head)."""
+
+    def test_switch_first_match_wins_and_default(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            t = fluid.data("t", [1])
+            half = fluid.layers.fill_constant([1], "float32", 0.5)
+            one5 = fluid.layers.fill_constant([1], "float32", 1.5)
+            a = fluid.layers.fill_constant([1], "float32", 1.0)
+            b = fluid.layers.fill_constant([1], "float32", 2.0)
+            c = fluid.layers.fill_constant([1], "float32", 3.0)
+            out = fluid.layers.fill_constant([1], "float32", 0.0)
+            with fluid.layers.Switch() as sw:
+                with sw.case(t < half):
+                    fluid.layers.assign(a, output=out)
+                with sw.case(t < one5):
+                    fluid.layers.assign(b, output=out)
+                with sw.default():
+                    fluid.layers.assign(c, output=out)
+        exe = fluid.Executor()
+        for tv, want in [(0.1, 1.0), (1.0, 2.0), (9.0, 3.0)]:
+            r, = exe.run(main, feed={"t": np.array([tv], np.float32)},
+                         fetch_list=[out])
+            assert float(r[0]) == want, (tv, float(r[0]))
+
+    def test_ifelse_rowwise_merge(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            xv = fluid.data("x", [-1, 3])
+            cond = fluid.data("c", [-1, 1], dtype="bool")
+            ie = fluid.layers.IfElse(cond)
+            with ie.true_block():
+                ie.output(xv * 10.0)
+            with ie.false_block():
+                ie.output(-xv)
+            res, = ie()
+        X = np.arange(12).reshape(4, 3).astype(np.float32)
+        C = np.array([[True], [False], [True], [False]])
+        r, = fluid.Executor().run(main, feed={"x": X, "c": C},
+                                  fetch_list=[res])
+        np.testing.assert_allclose(r, np.where(C, X * 10, -X))
+
+    def test_data_norm_trains_and_updates_summaries(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            y = fluid.data("y", [-1, 1])
+            dn = fluid.layers.data_norm(x)
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(
+                fluid.layers.fc(dn, 1), y))
+            fluid.optimizer.SGD(0.005).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        X = (rng.randn(32, 4) * 5 + 3).astype(np.float32)
+        Y = rng.randn(32, 1).astype(np.float32)
+        b0 = {k: np.asarray(v) for k, v in main.buffers.items()}
+        first = last = None
+        for _ in range(30):
+            v, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            first = first if first is not None else float(v)
+            last = float(v)
+        assert last < first
+        assert any(not np.array_equal(v, np.asarray(main.buffers[k]))
+                   for k, v in b0.items())
+
+    def test_multi_box_head_shapes_align(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            f1 = fluid.data("f1", [-1, 8, 8, 8])
+            f2 = fluid.data("f2", [-1, 8, 4, 4])
+            f3 = fluid.data("f3", [-1, 8, 2, 2])
+            img = fluid.data("img", [-1, 3, 64, 64])
+            locs, confs, boxes, vrs = fluid.layers.multi_box_head(
+                [f1, f2, f3], img, base_size=64, num_classes=5,
+                # 1.0 in the list exercises prior_box's dedup, which the
+                # conv channel count must mirror exactly
+                aspect_ratios=[[1.0, 2.0], [2.0], [2.0]],
+                min_ratio=20, max_ratio=90, kernel_size=3, pad=1)
+        r = fluid.Executor().run(main, feed={
+            "f1": np.random.randn(2, 8, 8, 8).astype(np.float32),
+            "f2": np.random.randn(2, 8, 4, 4).astype(np.float32),
+            "f3": np.random.randn(2, 8, 2, 2).astype(np.float32),
+            "img": np.zeros((2, 3, 64, 64), np.float32)},
+            fetch_list=[locs, confs, boxes, vrs])
+        assert r[0].shape[2] == 4 and r[1].shape[2] == 5
+        assert r[2].shape == r[3].shape
+        assert r[0].shape[1] == r[2].shape[0]  # priors align with locs
+
+    def test_multi_box_head_two_maps_needs_explicit_sizes(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            f1 = fluid.data("f1", [-1, 8, 8, 8])
+            f2 = fluid.data("f2", [-1, 8, 4, 4])
+            img = fluid.data("img", [-1, 3, 64, 64])
+            with pytest.raises(InvalidArgumentError, match="min_sizes"):
+                fluid.layers.multi_box_head(
+                    [f1, f2], img, base_size=64, num_classes=5,
+                    aspect_ratios=[[2.0], [2.0]], min_ratio=20,
+                    max_ratio=90)
+            # explicit sizes work for any map count
+            locs, confs, boxes, vrs = fluid.layers.multi_box_head(
+                [f1, f2], img, base_size=64, num_classes=5,
+                aspect_ratios=[[2.0], [2.0]],
+                min_sizes=[12.8, 32.0], max_sizes=[32.0, 54.4],
+                kernel_size=3, pad=1)
+        r = fluid.Executor().run(main, feed={
+            "f1": np.random.randn(2, 8, 8, 8).astype(np.float32),
+            "f2": np.random.randn(2, 8, 4, 4).astype(np.float32),
+            "img": np.zeros((2, 3, 64, 64), np.float32)},
+            fetch_list=[locs, confs, boxes, vrs])
+        assert r[0].shape[1] == r[2].shape[0]
+
+    def test_switch_case_with_intermediate_expression(self):
+        # temps created INSIDE a case must stay internal (review finding)
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            t = fluid.data("t", [1])
+            half = fluid.layers.fill_constant([1], "float32", 0.5)
+            base = fluid.layers.fill_constant([1], "float32", 3.0)
+            out = fluid.layers.fill_constant([1], "float32", 0.0)
+            with fluid.layers.Switch() as sw:
+                with sw.case(t < half):
+                    fluid.layers.assign(base * 2.0 + 1.0, output=out)
+                with sw.default():
+                    fluid.layers.assign(base - 1.0, output=out)
+        exe = fluid.Executor()
+        lo, = exe.run(main, feed={"t": np.array([0.1], np.float32)},
+                      fetch_list=[out])
+        hi, = exe.run(main, feed={"t": np.array([0.9], np.float32)},
+                      fetch_list=[out])
+        assert float(lo[0]) == 7.0 and float(hi[0]) == 2.0
+
+    def test_switch_case_after_default_rejected(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            t = fluid.data("t", [1])
+            half = fluid.layers.fill_constant([1], "float32", 0.5)
+            out = fluid.layers.fill_constant([1], "float32", 0.0)
+            sw = fluid.layers.Switch()
+            with sw:
+                with sw.default():
+                    fluid.layers.assign(half, output=out)
+                with pytest.raises(InvalidArgumentError,
+                                   match="unreachable"):
+                    sw.case(t < half)
+                # give the block a valid ending
+                sw._cases = [c for c in sw._cases]

@@ -94,7 +94,7 @@ class TestStatic:
         assert "paddle.nn.Linear" in str(ei.value)
         assert callable(snn.create_parameter)  # the real one
         with pytest.raises(UnimplementedError):  # residual shim tier
-            snn.data_norm(None)
+            snn.sparse_embedding(None, None)
 
     def test_weight_norm_param_attr_points_at_hook(self):
         with pytest.raises(UnimplementedError) as ei:
