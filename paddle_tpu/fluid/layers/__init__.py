@@ -1233,12 +1233,12 @@ del _n  # filter_by_instag stays eager-only (data-dependent output size)
 # -- round-4 graph-builder batch 3 (param-creating, real in graph mode) --
 from paddle_tpu.static.builders import (  # noqa: E402,F401
     nce, center_loss, sequence_conv, inplace_abn, hsigmoid, lstm,
-    data_norm, multi_box_head,
+    data_norm, multi_box_head, deformable_conv,
 )
 
 for _impl in ("nce", "center_loss", "sequence_conv", "inplace_abn",
               "hsigmoid", "lstm", "data_norm", "multi_box_head",
-              "Switch", "IfElse"):
+              "Switch", "IfElse", "deformable_conv"):
     _STATIC_ONLY.pop(_impl, None)
 
 
@@ -1321,3 +1321,114 @@ def _beam_search_graph_dispatch(fn):
 
 globals()["beam_search"] = _beam_search_graph_dispatch(
     globals()["beam_search"])
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """ref: fluid/layers/nn.py autoincreased_step_counter — a persistable
+    int64 counter advanced by ``step`` on every executor run (the global
+    step).  Graph mode: a Program buffer updated in the recorded op
+    (training and eval runs both advance it, like the reference)."""
+    from paddle_tpu.static.graph import default_main_program, in_program_guard
+
+    if not in_program_guard():
+        raise UnimplementedError(
+            "autoincreased_step_counter is Program state: use it under "
+            "program_guard/enable_static, or track the step in your "
+            "train-loop state eagerly")
+    prog = default_main_program()
+    bname = counter_name or prog.unique_name("step_counter")
+    prog.register_buffer(bname, jnp.asarray(begin - step, jnp.int64))
+    from paddle_tpu.static.graph import record_call as _rc
+
+    def fn(pv, bv, *, training=False, rngs=None):
+        new = bv[bname] + jnp.int64(step)
+        return new, {bname: new}
+
+    return _rc(fn, buffer_names=(bname,), writes_buffers=True,
+               scoped=True, prefix="step_counter")
+
+
+for _impl in ("autoincreased_step_counter",):
+    _STATIC_ONLY.pop(_impl, None)
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """ref: fluid/layers/detection.py:3100 retinanet_detection_output
+    (operators/detection/retinanet_detection_output_op.cc) — per FPN
+    level: threshold (0.0 for the HIGHEST level, :retinanet op rule),
+    take the nms_top_k best (anchor, class) pairs, decode center-size
+    deltas against the level's anchors (+1 pixel convention, /im_scale,
+    clipped to the rounded original image); merge levels and run
+    per-class greedy NMS with eta adaptation, keep_top_k overall.
+
+    Eager post-processor (inference time): returns a list of per-image
+    [No_i, 6] arrays ``[label(1-based), score, x1, y1, x2, y2]`` — the
+    dense replacement for the reference's LoD-packed output."""
+    import numpy as _np
+
+    bboxes = [_np.asarray(b, _np.float32) for b in bboxes]
+    scores = [_np.asarray(s, _np.float32) for s in scores]
+    anchors = [_np.asarray(a, _np.float32) for a in anchors]
+    im_info = _np.asarray(im_info, _np.float32).reshape(-1, 3)
+    N = bboxes[0].shape[0]
+    C = scores[0].shape[-1]
+    L = len(scores)
+
+    def iou(a, b):  # +1 pixel convention, matching the op's NMS
+        ix1 = max(a[0], b[0]); iy1 = max(a[1], b[1])
+        ix2 = min(a[2], b[2]); iy2 = min(a[3], b[3])
+        iw = max(0.0, ix2 - ix1 + 1); ih = max(0.0, iy2 - iy1 + 1)
+        inter = iw * ih
+        ar = (a[2]-a[0]+1) * (a[3]-a[1]+1)
+        br = (b[2]-b[0]+1) * (b[3]-b[1]+1)
+        return inter / max(ar + br - inter, 1e-10)
+
+    out = []
+    for n in _range(N):
+        imh, imw, im_scale = im_info[n]
+        imh = round(float(imh) / im_scale)
+        imw = round(float(imw) / im_scale)
+        preds = {c: [] for c in _range(C)}
+        for l in _range(L):
+            sc = scores[l][n].reshape(-1)            # [A*C]
+            thr = score_threshold if l < L - 1 else 0.0
+            idx = _np.nonzero(sc > thr)[0]
+            if nms_top_k >= 0 and idx.size > nms_top_k:
+                idx = idx[_np.argsort(-sc[idx])[:int(nms_top_k)]]
+            for i in idx:
+                a_i, c_i = divmod(int(i), C)
+                anc = anchors[l][a_i]
+                d = bboxes[l][n, a_i]
+                aw = anc[2] - anc[0] + 1; ah = anc[3] - anc[1] + 1
+                acx = anc[0] + aw / 2; acy = anc[1] + ah / 2
+                cx = d[0] * aw + acx; cy = d[1] * ah + acy
+                w = _np.exp(d[2]) * aw; h = _np.exp(d[3]) * ah
+                box = _np.array([cx - w/2, cy - h/2,
+                                 cx + w/2 - 1, cy + h/2 - 1]) / im_scale
+                box[0::2] = _np.clip(box[0::2], 0, imw - 1)
+                box[1::2] = _np.clip(box[1::2], 0, imh - 1)
+                preds[c_i].append((float(sc[i]), box))
+        dets = []
+        for c_i, cand in preds.items():
+            cand.sort(key=lambda t: -t[0])
+            kept, thr_c = [], nms_threshold
+            for s_v, b_v in cand:
+                if all(iou(b_v, kb) <= thr_c for _, kb in kept):
+                    kept.append((s_v, b_v))
+                    if nms_eta < 1.0 and thr_c > 0.5:
+                        thr_c *= nms_eta
+            dets.extend((c_i, s_v, b_v) for s_v, b_v in kept)
+        dets.sort(key=lambda t: -t[1])
+        if keep_top_k >= 0:  # -1 = keep all (1.x convention)
+            dets = dets[:int(keep_top_k)]
+        out.append(_np.array(
+            [[c_i + 1, s_v, *b_v] for c_i, s_v, b_v in dets],
+            _np.float32).reshape(-1, 6))
+    return out
+
+
+for _impl in ("retinanet_detection_output",):
+    _STATIC_ONLY.pop(_impl, None)

@@ -636,14 +636,22 @@ class _SwitchCase:
 
     def __enter__(self):
         self._cap.__enter__()
-        # names existing before the FIRST case are the mutable surface
-        if not hasattr(self._switch, "_pre_vars"):
-            self._switch._pre_vars = set(self._cap.pre_vars)
+        # the mutable surface is every name existing when a case OPENS —
+        # variables created between cases are assignable by later cases,
+        # but temps created INSIDE earlier cases stay internal
+        sw = self._switch
+        if not hasattr(sw, "_pre_vars"):
+            sw._pre_vars = set()
+            sw._case_internal = set()
+        sw._pre_vars |= (set(self._cap.pre_vars) - sw._case_internal)
         return self
 
     def __exit__(self, *exc):
+        prog = default_main_program()
         self._cap.__exit__(*exc)
         if exc[0] is None:
+            self._switch._case_internal |= (
+                set(prog.vars) - set(self._cap.pre_vars))
             self._switch._cases.append((self._cond, self._cap.ops))
         return False
 

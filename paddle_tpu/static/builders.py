@@ -628,35 +628,6 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     for i, feat in enumerate(inputs):
         ar = aspect_ratios[i]
         ar = [ar] if not isinstance(ar, (list, tuple)) else list(ar)
-        # mirror prior_box's EXACT expansion (detection.py prior_box: ars
-        # starts [1.0]; each new ratio adds itself and, when flip, its
-        # reciprocal; duplicates — notably ar == 1.0 — are skipped)
-        exp = [1.0]
-        for r in ar:
-            r = float(r)
-            if not any(__import__("math").isclose(r, e, abs_tol=1e-6)
-                       for e in exp):
-                exp.append(r)
-                if flip:
-                    exp.append(1.0 / r)
-        n_priors = len(min_sizes[i]) * len(exp)
-        if max_sizes[i] and max_sizes[i][0]:
-            n_priors += len(max_sizes[i])
-        loc = conv2d(feat, n_priors * 4, kernel_size, stride=stride,
-                     padding=pad, name=f"{name or 'mbox'}_loc{i}")
-        conf = conv2d(feat, n_priors * num_classes, kernel_size,
-                      stride=stride, padding=pad,
-                      name=f"{name or 'mbox'}_conf{i}")
-
-        def to_last(v, ch):
-            # [B, C, H, W] → [B, H*W*priors, ch]
-            return record_call(
-                lambda t: t.transpose(0, 2, 3, 1).reshape(
-                    t.shape[0], -1, ch), v, prefix="mbox_reshape")
-
-        locs.append(to_last(loc, 4))
-        confs.append(to_last(conf, num_classes))
-
         step = (steps[i] if steps else 0.0)
         sw = step_w[i] if step_w else step
         sh = step_h[i] if step_h else step
@@ -678,6 +649,25 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         b, v = prior(feat)
         boxes_all.append(b)
         vars_all.append(v)
+        # the conv channel count comes from prior_box's OWN recorded
+        # output shape — a single source of truth for the per-position
+        # prior count (no duplicated ratio-expansion rules to drift)
+        H, W = int(feat.shape[2]), int(feat.shape[3])
+        n_priors = int(b.shape[0]) // (H * W)
+        loc = conv2d(feat, n_priors * 4, kernel_size, stride=stride,
+                     padding=pad, name=f"{name or 'mbox'}_loc{i}")
+        conf = conv2d(feat, n_priors * num_classes, kernel_size,
+                      stride=stride, padding=pad,
+                      name=f"{name or 'mbox'}_conf{i}")
+
+        def to_last(v2, ch):
+            # [B, C, H, W] → [B, H*W*priors, ch]
+            return record_call(
+                lambda t: t.transpose(0, 2, 3, 1).reshape(
+                    t.shape[0], -1, ch), v2, prefix="mbox_reshape")
+
+        locs.append(to_last(loc, 4))
+        confs.append(to_last(conf, num_classes))
 
     import jax.numpy as _jnp
 
@@ -685,3 +675,49 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         lambda *ts: _jnp.concatenate(ts, axis=ax), *vs, prefix="mbox_cat")
     return (cat(locs, 1), cat(confs, 1), cat(boxes_all, 0),
             cat(vars_all, 0))
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=1,
+                    deformable_groups=1, im2col_step=1, param_attr=None,
+                    bias_attr=None, modulated=True, name=None):
+    """ref: fluid/layers/nn.py deformable_conv (DCN v1/v2) — creates the
+    filter (+bias) in the Program and runs
+    nn.functional.deform_conv2d; ``modulated=False`` is v1 (no mask)."""
+    x = _require_var(input, "deformable_conv",
+                     "paddle.nn.functional.deform_conv2d")
+    from ..nn.layer_base import Layer
+
+    in_ch = int(x.shape[1])
+    ks = (filter_size if isinstance(filter_size, (list, tuple))
+          else (filter_size, filter_size))
+
+    class _DCN(Layer):
+        def __init__(self):
+            super().__init__()
+            from ..nn import initializer as I
+
+            self.weight = self.create_parameter(
+                (num_filters, in_ch // (groups or 1), ks[0], ks[1]),
+                attr=param_attr, default_initializer=I.XavierNormal())
+            self.bias = (self.create_parameter(
+                (num_filters,), attr=bias_attr, is_bias=True)
+                if bias_attr is not False else None)
+
+        def forward(self, xx, off, msk=None):
+            from ..nn import functional as F
+
+            return F.deform_conv2d(
+                xx, off, self.weight.value,
+                bias=self.bias.value if self.bias is not None else None,
+                stride=stride, padding=padding, dilation=dilation,
+                deformable_groups=deformable_groups, groups=groups or 1,
+                mask=msk if modulated else None)
+
+    if modulated and mask is None:
+        raise InvalidArgumentError(
+            "deformable_conv(modulated=True) is DCNv2 and requires the "
+            "mask input; pass modulated=False for DCNv1")
+    extra = (offset, mask) if modulated else (offset,)
+    return layer_op(_DCN(), x, prefix=name or "deformable_conv",
+                    extra_args=extra)

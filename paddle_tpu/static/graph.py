@@ -262,6 +262,7 @@ class Program:
 
         p = copy.copy(self)
         p._optimizer, p._loss_name, p._opt_state = None, None, None
+        p._is_test_clone = True  # freeze buffer write-back (BN stats)
         return p
 
     def _reinitialize(self):
@@ -612,7 +613,10 @@ class Executor:
             rng = _prandom.default_generator().next_key()
             fetched, nb = jitted(dict(prog.scope), dict(prog.buffers),
                                  feeds, rng)
-            if training:  # eval clone never persists running stats
+            # persist buffer updates (step counters; BN stats when the ops
+            # ran in training mode) — EXCEPT for clone(for_test=True)
+            # programs, whose running statistics must stay frozen
+            if not getattr(prog, "_is_test_clone", False):
                 prog.buffers.update(nb)
             return fetched
 

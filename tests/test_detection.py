@@ -1140,3 +1140,55 @@ class TestLocalityAwareNms:
             F.locality_aware_nms(np.zeros((1, 2, 4), np.float32),
                                  np.zeros((1, 3, 2), np.float32),
                                  0.1, -1, 2)
+
+
+class TestRetinanetDetectionOutput:
+    """fluid.layers.retinanet_detection_output (ref:
+    operators/detection/retinanet_detection_output_op.cc) — eager
+    post-processor: per-level top-k decode + merged per-class NMS."""
+
+    def test_decode_threshold_and_nms(self):
+        import paddle_tpu.fluid as fluid
+
+        # one image, 2 levels; identity deltas decode to the anchors
+        anchors_l0 = np.array([[0, 0, 9, 9], [0, 0, 9, 9],
+                               [30, 30, 39, 39]], np.float32)
+        anchors_l1 = np.array([[50, 50, 69, 69]], np.float32)
+        bboxes_l0 = np.zeros((1, 3, 4), np.float32)
+        bboxes_l1 = np.zeros((1, 1, 4), np.float32)
+        # class 0 scores: two overlapping anchors (NMS keeps one) + one far
+        scores_l0 = np.array([[[0.9, 0.0], [0.8, 0.0],
+                               [0.0, 0.7]]], np.float32)
+        # highest level: BELOW score_threshold but kept (threshold 0 rule)
+        scores_l1 = np.array([[[0.01, 0.0]]], np.float32)
+        im_info = np.array([[100, 100, 1.0]], np.float32)
+
+        outs = fluid.layers.retinanet_detection_output(
+            [bboxes_l0, bboxes_l1], [scores_l0, scores_l1],
+            [anchors_l0, anchors_l1], im_info,
+            score_threshold=0.05, nms_threshold=0.3, keep_top_k=100)
+        det = outs[0]
+        # kept: one of the two overlapping class-1 boxes, the far class-2
+        # box, and the highest-level low-score box (+ the 0.0-score
+        # entries are below even the 0-threshold? 0.0 > 0.0 is False ✓)
+        labels = sorted(det[:, 0].tolist())
+        assert labels == [1.0, 1.0, 2.0], det
+        # best detection first, decoded box == its anchor
+        assert det[0, 1] == np.float32(0.9)
+        np.testing.assert_allclose(det[0, 2:], [0, 0, 9, 9], atol=1e-4)
+        # suppressed: the 0.8 duplicate of the same anchor
+        assert not np.any(np.isclose(det[:, 1], 0.8))
+
+    def test_im_scale_and_clipping(self):
+        import paddle_tpu.fluid as fluid
+
+        anchors = np.array([[0, 0, 19, 19]], np.float32)
+        bboxes = np.zeros((1, 1, 4), np.float32)
+        scores = np.ones((1, 1, 1), np.float32)
+        # im_info height/width are SCALED dims; scale 2 → original 10x10,
+        # decoded box /2 then clipped to 9
+        im_info = np.array([[20, 20, 2.0]], np.float32)
+        outs = fluid.layers.retinanet_detection_output(
+            [bboxes], [scores], [anchors], im_info)
+        det = outs[0]
+        np.testing.assert_allclose(det[0, 2:], [0, 0, 9, 9], atol=1e-4)

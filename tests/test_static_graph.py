@@ -583,3 +583,53 @@ class TestBuilderBatch4:
                     sw.case(t < half)
                 # give the block a valid ending
                 sw._cases = [c for c in sw._cases]
+
+
+class TestStepCounter:
+    def test_autoincreased_step_counter_advances_per_run(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 2])
+            step = fluid.layers.autoincreased_step_counter(begin=1)
+        exe = fluid.Executor()
+        feed = {"x": np.zeros((2, 2), np.float32)}
+        vals = [int(exe.run(main, feed=feed, fetch_list=[step])[0])
+                for _ in range(3)]
+        assert vals == [1, 2, 3]
+
+    def test_test_clone_freezes_buffers(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 2])
+            step = fluid.layers.autoincreased_step_counter(begin=1)
+        clone = main.clone(for_test=True)
+        exe = fluid.Executor()
+        feed = {"x": np.zeros((2, 2), np.float32)}
+        v1 = int(exe.run(clone, feed=feed, fetch_list=[step])[0])
+        v2 = int(exe.run(clone, feed=feed, fetch_list=[step])[0])
+        assert v1 == v2 == 1  # frozen on the test clone
+
+
+class TestDeformableConvBuilder:
+    def test_dcn_v2_trains_in_graph_mode(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4, 8, 8])
+            off = fluid.data("off", [-1, 18, 8, 8])
+            msk = fluid.data("msk", [-1, 9, 8, 8])
+            y = fluid.layers.deformable_conv(
+                x, off, msk, num_filters=6, filter_size=3, padding=1)
+            loss = fluid.layers.mean(y * y)
+            fluid.optimizer.SGD(0.01).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.randn(2, 4, 8, 8).astype(np.float32),
+                "off": (rng.randn(2, 18, 8, 8) * 0.1).astype(np.float32),
+                "msk": np.ones((2, 9, 8, 8), np.float32)}
+        first = last = None
+        for _ in range(5):
+            v, = exe.run(main, feed=feed, fetch_list=[loss])
+            first = first if first is not None else float(v)
+            last = float(v)
+        assert last < first
