@@ -1432,3 +1432,61 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info,
 
 for _impl in ("retinanet_detection_output",):
     _STATIC_ONLY.pop(_impl, None)
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """ref: fluid/layers/nn.py similarity_focus (operators/
+    similarity_focus_op) — for each index slice along ``axis``, greedily
+    mark the min(B, C) largest values whose row AND column are both
+    unused, OR the masks over ``indexes``, broadcast along ``axis``.
+    Pure-jax greedy (fori_loop with row/column exclusion masks) — works
+    eagerly and records/compiles in graph mode."""
+    x = jnp.asarray(input)
+    if x.ndim != 4:
+        raise UnimplementedError(
+            "similarity_focus expects a 4-D tensor (ref op constraint)")
+    if axis not in (1, 2, 3):
+        raise UnimplementedError("similarity_focus: axis must be 1, 2 or 3")
+    A_dim = x.shape[axis]
+    for idx in indexes:  # reference enforces 0 <= index < dim
+        if not (0 <= int(idx) < A_dim):
+            raise UnimplementedError(
+                f"similarity_focus: index {idx} out of range for axis "
+                f"{axis} with size {A_dim}")
+    perm = [0, axis] + [d for d in _range(1, 4) if d != axis]
+    xt = jnp.transpose(x, perm)                      # [N, A, B, C]
+    N, A, B, Cd = xt.shape
+    K = min(B, Cd)
+
+    def one_slice(T):                                # [B, C] → mask
+        def body(_, state):
+            mask, used_r, used_c = state
+            blocked = used_r[:, None] | used_c[None, :]
+            cand = jnp.where(blocked, -jnp.inf, T.astype(jnp.float32))
+            f = jnp.argmax(cand)
+            r, c = f // Cd, f % Cd
+            return (mask.at[r, c].set(1.0), used_r.at[r].set(True),
+                    used_c.at[c].set(True))
+
+        mask, _, _ = jax.lax.fori_loop(
+            0, K, body, (jnp.zeros((B, Cd), jnp.float32),
+                         jnp.zeros((B,), bool), jnp.zeros((Cd,), bool)))
+        return mask
+
+    masks = jax.vmap(  # per batch: OR of the per-index greedy masks
+        lambda slices: jnp.max(jax.vmap(one_slice)(slices), axis=0))(
+            xt[:, jnp.asarray([int(i) for i in indexes])])
+    out = jnp.broadcast_to(masks[:, None], (N, A, B, Cd))
+    inv = list(_np_argsort(perm))
+    return jnp.transpose(out, inv).astype(x.dtype)
+
+
+def _np_argsort(seq):
+    import numpy as _np
+
+    return _np.argsort(seq)
+
+
+for _impl in ("similarity_focus",):
+    _STATIC_ONLY.pop(_impl, None)
+globals()["similarity_focus"] = _maybe_record(globals()["similarity_focus"])

@@ -547,3 +547,28 @@ class TestLrDecayFunctions:
         y = np.zeros((4, 1), np.float32)
         loss, _ = m.train_batch([x], [y])
         assert np.isfinite(loss)
+
+
+class TestSimilarityFocus:
+    def test_reference_docstring_example(self):
+        x = np.array([[[[0.8, 0.1], [0.4, 0.5]],
+                       [[0.9, 0.7], [0.9, 0.9]],
+                       [[0.8, 0.9], [0.1, 0.2]]],
+                      [[[0.2, 0.5], [0.3, 0.4]],
+                       [[0.9, 0.7], [0.8, 0.4]],
+                       [[0.0, 0.2], [0.4, 0.7]]]], np.float32)
+        out = np.asarray(fluid.layers.similarity_focus(x, axis=1,
+                                                       indexes=[0]))
+        exp0 = np.array([[1, 0], [0, 1]], np.float32)
+        exp1 = np.array([[0, 1], [1, 0]], np.float32)
+        for c in range(3):  # broadcast along the channel axis
+            np.testing.assert_array_equal(out[0, c], exp0)
+            np.testing.assert_array_equal(out[1, c], exp1)
+
+    def test_multi_index_or(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(1, 3, 4, 4).astype(np.float32)
+        a = np.asarray(fluid.layers.similarity_focus(x, 1, [0]))
+        b = np.asarray(fluid.layers.similarity_focus(x, 1, [2]))
+        both = np.asarray(fluid.layers.similarity_focus(x, 1, [0, 2]))
+        np.testing.assert_array_equal(both, np.maximum(a, b))
