@@ -61,7 +61,8 @@ from paddle_tpu.nn.functional import (  # noqa: F401
 )
 from paddle_tpu.nn import (  # noqa: F401
     BeamSearchDecoder, Decoder, dynamic_decode, RNNCellBase as RNNCell,
-    GRUCell, LSTMCell, clip_by_norm,
+    GRUCell, LSTMCell, clip_by_norm, DecodeHelper, TrainingHelper,
+    GreedyEmbeddingHelper, SampleEmbeddingHelper, BasicDecoder,
 )
 from paddle_tpu.metric import accuracy  # noqa: F401
 from ...static import Print, py_func, create_parameter, create_global_var  # noqa: F401
@@ -1448,6 +1449,9 @@ def similarity_focus(input, axis, indexes, name=None):
     if axis not in (1, 2, 3):
         raise UnimplementedError("similarity_focus: axis must be 1, 2 or 3")
     A_dim = x.shape[axis]
+    if not len(indexes):
+        raise UnimplementedError("similarity_focus: indexes must be "
+                                 "non-empty")
     for idx in indexes:  # reference enforces 0 <= index < dim
         if not (0 <= int(idx) < A_dim):
             raise UnimplementedError(
@@ -1477,16 +1481,12 @@ def similarity_focus(input, axis, indexes, name=None):
         lambda slices: jnp.max(jax.vmap(one_slice)(slices), axis=0))(
             xt[:, jnp.asarray([int(i) for i in indexes])])
     out = jnp.broadcast_to(masks[:, None], (N, A, B, Cd))
-    inv = list(_np_argsort(perm))
+    inv = [perm.index(i) for i in _range(4)]
     return jnp.transpose(out, inv).astype(x.dtype)
 
 
-def _np_argsort(seq):
-    import numpy as _np
-
-    return _np.argsort(seq)
-
-
-for _impl in ("similarity_focus",):
+for _impl in ("similarity_focus", "DecodeHelper", "TrainingHelper",
+              "GreedyEmbeddingHelper", "SampleEmbeddingHelper",
+              "BasicDecoder"):
     _STATIC_ONLY.pop(_impl, None)
 globals()["similarity_focus"] = _maybe_record(globals()["similarity_focus"])

@@ -32,7 +32,9 @@ import jax.numpy as jnp
 from ..framework.errors import InvalidArgumentError
 from .layer_base import Layer
 
-__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode",
+           "DecodeHelper", "TrainingHelper", "GreedyEmbeddingHelper",
+           "SampleEmbeddingHelper", "BasicDecoder"]
 
 _KINF = 1e9
 
@@ -312,3 +314,140 @@ class _DecodeHelperCell:
 
     def __call__(self, inputs, states):
         return self._cell(inputs, states, **self._kwargs)
+
+
+# ---------------------------------------------------------------------------
+# The sampling-helper family (reference: fluid/layers/rnn.py DecodeHelper
+# :1659, TrainingHelper :1728, GreedyEmbeddingHelper :1881,
+# SampleEmbeddingHelper :2012, BasicDecoder :2113) — the pre-2.0 seq2seq
+# decode surface.  Every method is traceable, so BasicDecoder composes
+# with dynamic_decode's single compiled while-loop.
+# ---------------------------------------------------------------------------
+class DecodeHelper:
+    """Sampling protocol consumed by :class:`BasicDecoder`:
+    ``initialize() -> (initial_inputs, initial_finished)``;
+    ``sample(time, outputs, states) -> sample_ids``;
+    ``next_inputs(time, outputs, states, sample_ids) ->
+    (finished, next_inputs, next_states)``."""
+
+    def initialize(self):
+        raise NotImplementedError
+
+    def sample(self, time, outputs, states):
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        raise NotImplementedError
+
+
+class TrainingHelper(DecodeHelper):
+    """Teacher forcing: feed the ground-truth sequence step by step
+    (ref rnn.py:1728).  ``inputs``: [batch, T, ...] (or [T, batch, ...]
+    with ``time_major``); ``sequence_length``: [batch] true lengths."""
+
+    def __init__(self, inputs, sequence_length, time_major=False):
+        self.inputs = inputs
+        self.sequence_length = jnp.asarray(sequence_length)
+        self.time_major = bool(time_major)
+        self._axis = 0 if self.time_major else 1
+
+    def _slice(self, t):
+        ax = self._axis
+
+        def take(x):
+            x = jnp.asarray(x)
+            tt = jnp.minimum(jnp.asarray(t, jnp.int32),
+                             x.shape[ax] - 1)
+            return jax.lax.dynamic_index_in_dim(x, tt, ax, keepdims=False)
+
+        return jax.tree_util.tree_map(take, self.inputs)
+
+    def initialize(self):
+        return self._slice(0), self.sequence_length == 0
+
+    def sample(self, time, outputs, states):
+        return jnp.argmax(outputs, axis=-1).astype(jnp.int64)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        next_time = jnp.asarray(time, jnp.int64) + 1
+        finished = next_time >= self.sequence_length
+        return finished, self._slice(next_time), states
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """Inference-time greedy sampling: argmax ids, re-embedded as the
+    next step's input (ref rnn.py:1881).  ``embedding_fn`` maps
+    [batch] int64 ids → inputs (use paddle.nn.Embedding / a lambda)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        self.embedding_fn = embedding_fn
+        self.start_tokens = jnp.asarray(start_tokens, jnp.int64)
+        self.end_token = jnp.asarray(int(end_token), jnp.int64)
+
+    def initialize(self):
+        finished = jnp.zeros(self.start_tokens.shape[:1], bool)
+        return self.embedding_fn(self.start_tokens), finished
+
+    def sample(self, time, outputs, states):
+        return jnp.argmax(outputs, axis=-1).astype(jnp.int64)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        finished = sample_ids == self.end_token
+        return finished, self.embedding_fn(sample_ids), states
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """Multinomial sampling from the per-step softmax (ref rnn.py:2012);
+    ``softmax_temperature`` sharpens/flattens the distribution."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token,
+                 softmax_temperature=None, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self.temperature = softmax_temperature
+        self._seed = seed
+        self._key = (jax.random.PRNGKey(seed) if seed is not None
+                     else None)
+
+    def initialize(self):
+        # unseeded: a FRESH key per decode run (two runs of the same
+        # helper must sample differently, like the reference); a given
+        # seed pins the whole run for reproducibility
+        if self._seed is None:
+            from ..framework import random as _prandom
+
+            self._key = _prandom.default_generator().next_key()
+        return super().initialize()
+
+    def sample(self, time, outputs, states):
+        logits = (outputs if self.temperature is None
+                  else outputs / self.temperature)
+        key = jax.random.fold_in(self._key, jnp.asarray(time, jnp.int32))
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int64)
+
+
+class BasicDecoder(Decoder):
+    """cell + helper composition (ref rnn.py:2113): one step = cell call
+    → optional output_fn → helper.sample → helper.next_inputs; outputs
+    are ``OutputWrapper(cell_outputs, sample_ids)`` per step."""
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("cell_outputs", "sample_ids"))
+
+    def __init__(self, cell, helper: DecodeHelper, output_fn=None):
+        self.cell = cell
+        self.helper = helper
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        initial_inputs, initial_finished = self.helper.initialize()
+        return initial_inputs, initial_cell_states, initial_finished
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_outputs, cell_states = self.cell(inputs, states, **kwargs)
+        if self.output_fn is not None:
+            cell_outputs = self.output_fn(cell_outputs)
+        sample_ids = self.helper.sample(time, cell_outputs, cell_states)
+        finished, next_inputs, next_states = self.helper.next_inputs(
+            time, cell_outputs, cell_states, sample_ids)
+        return (BasicDecoder.OutputWrapper(cell_outputs, sample_ids),
+                next_states, next_inputs, finished)

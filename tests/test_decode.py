@@ -274,3 +274,76 @@ class TestBeamSearchDecode:
             max_step_num=199)
         assert outputs.shape[1] <= 3, \
             f"early exit failed, decoded {outputs.shape[1]} steps"
+
+
+class TestDecodeHelpers:
+    """The pre-2.0 sampling-helper family (ref: fluid/layers/rnn.py
+    DecodeHelper:1659 / TrainingHelper:1728 / GreedyEmbeddingHelper:1881 /
+    SampleEmbeddingHelper:2012 / BasicDecoder:2113) over dynamic_decode's
+    compiled while-loop."""
+
+    def _parts(self):
+        paddle.seed(0)
+        B, T, D, V = 4, 6, 8, 12
+        return (B, T, D, V, nn.GRUCell(D, D), nn.Embedding(V, D),
+                nn.Linear(D, V))
+
+    def test_training_helper_teacher_forcing_parity(self):
+        B, T, D, V, cell, emb, proj = self._parts()
+        rng = np.random.RandomState(0)
+        X = rng.randn(B, T, D).astype(np.float32)
+        seqlen = np.array([6, 4, 6, 2])
+        dec = nn.BasicDecoder(cell, nn.TrainingHelper(jnp.asarray(X),
+                                                      seqlen),
+                              output_fn=lambda o: proj(o))
+        h0 = jnp.zeros((B, D))
+        outs, _, lens = nn.dynamic_decode(dec, inits=h0, max_step_num=T - 1,
+                                          return_length=True)
+        np.testing.assert_array_equal(np.asarray(lens), seqlen)
+        co = np.asarray(outs.cell_outputs)
+        h = h0
+        for t in range(co.shape[1]):
+            o, h = cell(jnp.asarray(X[:, t]), h)
+            np.testing.assert_allclose(co[:, t], np.asarray(proj(o)),
+                                       atol=1e-5)
+        # sample ids are argmax of the projected outputs
+        np.testing.assert_array_equal(
+            np.asarray(outs.sample_ids)[:, 0],
+            np.argmax(co[:, 0], axis=-1))
+
+    def test_greedy_embedding_helper_stops_at_end_token(self):
+        B, T, D, V, cell, emb, proj = self._parts()
+
+        # a rigged output_fn that always emits end_token after step 1
+        def out_fn(o):
+            logits = proj(o)
+            return logits.at[:, 1].add(1e4)  # end_token = 1 dominates
+
+        dec = nn.BasicDecoder(
+            cell, nn.GreedyEmbeddingHelper(lambda ids: emb(ids),
+                                           np.zeros(B, np.int64), 1),
+            output_fn=out_fn)
+        outs, _, lens = nn.dynamic_decode(dec, inits=jnp.zeros((B, D)),
+                                          max_step_num=5,
+                                          return_length=True)
+        assert np.asarray(outs.sample_ids)[:, 0].tolist() == [1] * B
+        assert np.asarray(lens).max() <= 2  # finished right away
+
+    def test_sample_embedding_helper_valid_and_seeded(self):
+        B, T, D, V, cell, emb, proj = self._parts()
+
+        def mk(seed):
+            dec = nn.BasicDecoder(
+                cell, nn.SampleEmbeddingHelper(lambda ids: emb(ids),
+                                               np.zeros(B, np.int64), 1,
+                                               seed=seed),
+                output_fn=lambda o: proj(o))
+            outs, _, _ = nn.dynamic_decode(dec, inits=jnp.zeros((B, D)),
+                                           max_step_num=5,
+                                           return_length=True)
+            return np.asarray(outs.sample_ids)
+
+        a, b, c = mk(3), mk(3), mk(4)
+        assert a.min() >= 0 and a.max() < V
+        np.testing.assert_array_equal(a, b)  # same seed → same samples
+        assert not np.array_equal(a, c)      # different seed differs
