@@ -1234,12 +1234,13 @@ del _n  # filter_by_instag stays eager-only (data-dependent output size)
 # -- round-4 graph-builder batch 3 (param-creating, real in graph mode) --
 from paddle_tpu.static.builders import (  # noqa: E402,F401
     nce, center_loss, sequence_conv, inplace_abn, hsigmoid, lstm,
-    data_norm, multi_box_head, deformable_conv,
+    data_norm, multi_box_head, deformable_conv, gru_unit, lstm_unit,
 )
 
 for _impl in ("nce", "center_loss", "sequence_conv", "inplace_abn",
               "hsigmoid", "lstm", "data_norm", "multi_box_head",
-              "Switch", "IfElse", "deformable_conv"):
+              "Switch", "IfElse", "deformable_conv", "gru_unit",
+              "lstm_unit"):
     _STATIC_ONLY.pop(_impl, None)
 
 

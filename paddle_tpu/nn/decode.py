@@ -411,7 +411,10 @@ class SampleEmbeddingHelper(GreedyEmbeddingHelper):
     def initialize(self):
         # unseeded: a FRESH key per decode run (two runs of the same
         # helper must sample differently, like the reference); a given
-        # seed pins the whole run for reproducibility
+        # seed pins the whole run for reproducibility.  NOTE: under an
+        # outer jax.jit this draw happens at trace time, so a jitted
+        # decode function reuses one key across calls — seed explicitly
+        # (or rebuild the helper) when wrapping dynamic_decode in jit.
         if self._seed is None:
             from ..framework import random as _prandom
 

@@ -721,3 +721,76 @@ def deformable_conv(input, offset, mask, num_filters, filter_size,
     extra = (offset, mask) if modulated else (offset,)
     return layer_op(_DCN(), x, prefix=name or "deformable_conv",
                     extra_args=extra)
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    """ref: fluid/layers/nn.py gru_unit (operators/gru_unit_op) — one GRU
+    step over PRE-PROJECTED input [B, 3*hidden] (the 1.x fused layout);
+    returns (new_hidden, reset_hidden_prev, gate) like the reference.
+    Builder over fluid.dygraph.GRUUnit (same parameter layout)."""
+    x = _require_var(input, "gru_unit", "paddle.nn.GRUCell")
+    if size % 3:
+        raise InvalidArgumentError(
+            f"gru_unit: size ({size}) is the FUSED gate width and must be "
+            f"3 x hidden (1.x convention)")
+    if x.shape[-1] is not None and int(x.shape[-1]) != int(size):
+        raise InvalidArgumentError(
+            f"gru_unit: input width {x.shape[-1]} must equal size {size} "
+            f"(the input arrives pre-projected to the fused 3*hidden "
+            f"layout)")
+    from ..fluid.dygraph import GRUUnit as _GRUUnit
+
+    layer = _GRUUnit(size, param_attr=param_attr, bias_attr=bias_attr,
+                     activation=activation, gate_activation=gate_activation,
+                     origin_mode=origin_mode)
+    return layer_op(layer, x, prefix="gru_unit", extra_args=(hidden,))
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """ref: fluid/layers/nn.py lstm_unit (operators/lstm_unit_op.h:64 —
+    gate order i, f(+forget_bias), o, g over fc([x, h]) → 4*D):
+    c' = f·c + i·g, h' = o·tanh(c').  Creates the fused fc parameters in
+    the Program; returns (hidden, cell)."""
+    x = _require_var(x_t, "lstm_unit", "paddle.nn.LSTMCell")
+    from ..nn.layer_base import Layer
+
+    if len(x.shape) != 2 or len(hidden_t_prev.shape) != 2 \
+            or len(cell_t_prev.shape) != 2:
+        raise InvalidArgumentError(
+            "lstm_unit expects rank-2 x_t/hidden_t_prev/cell_t_prev "
+            "(reference constraint)")
+    if hidden_t_prev.shape[-1] != cell_t_prev.shape[-1]:
+        raise InvalidArgumentError(
+            f"lstm_unit: hidden dim {hidden_t_prev.shape[-1]} != cell "
+            f"dim {cell_t_prev.shape[-1]}")
+    Dx = int(x.shape[-1])
+    Dh = int(hidden_t_prev.shape[-1])
+
+    class _LSTMUnit(Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter((Dx + Dh, 4 * Dh),
+                                                attr=param_attr)
+            self.bias = (self.create_parameter((4 * Dh,), attr=bias_attr,
+                                               is_bias=True)
+                         if bias_attr is not False else None)
+
+        def forward(self, xx, h, c):
+            import jax
+            import jax.numpy as _jnp
+
+            z = _jnp.concatenate([xx, h], axis=-1) @ self.weight.value
+            if self.bias is not None:
+                z = z + self.bias.value
+            i = jax.nn.sigmoid(z[:, :Dh])
+            f = jax.nn.sigmoid(z[:, Dh:2 * Dh] + forget_bias)
+            o = jax.nn.sigmoid(z[:, 2 * Dh:3 * Dh])
+            g = _jnp.tanh(z[:, 3 * Dh:])
+            c_new = f * c + i * g
+            return o * _jnp.tanh(c_new), c_new
+
+    return layer_op(_LSTMUnit(), x, prefix=name or "lstm_unit",
+                    extra_args=(hidden_t_prev, cell_t_prev))

@@ -633,3 +633,66 @@ class TestDeformableConvBuilder:
             first = first if first is not None else float(v)
             last = float(v)
         assert last < first
+
+
+class TestCellUnitBuilders:
+    """gru_unit / lstm_unit (ref: operators/gru_unit_op, lstm_unit_op.h:64
+    — gate order i, f(+forget_bias), o, g)."""
+
+    def test_lstm_unit_matches_kernel_math(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            xl = fluid.data("xl", [-1, 6])
+            hl = fluid.data("hl", [-1, 4])
+            cl = fluid.data("cl", [-1, 4])
+            h2, c2 = fluid.layers.lstm_unit(xl, hl, cl, forget_bias=1.0)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"xl": rng.randn(8, 6).astype(np.float32),
+                "hl": rng.randn(8, 4).astype(np.float32),
+                "cl": rng.randn(8, 4).astype(np.float32)}
+        h2v, c2v = exe.run(main, feed=feed, fetch_list=[h2, c2])
+        w = next(np.asarray(v) for k, v in main.scope.items()
+                 if "lstm_unit" in k and np.asarray(v).ndim == 2)
+        b = next((np.asarray(v) for k, v in main.scope.items()
+                  if "lstm_unit" in k and np.asarray(v).ndim == 1), 0)
+        z = np.concatenate([feed["xl"], feed["hl"]], -1) @ w + b
+        sig = lambda t: 1 / (1 + np.exp(-t))  # noqa: E731
+        i_, f_ = sig(z[:, :4]), sig(z[:, 4:8] + 1.0)
+        o_, g_ = sig(z[:, 8:12]), np.tanh(z[:, 12:])
+        c_exp = f_ * feed["cl"] + i_ * g_
+        np.testing.assert_allclose(c2v, c_exp, atol=1e-4)
+        np.testing.assert_allclose(h2v, o_ * np.tanh(c_exp), atol=1e-4)
+
+    def test_both_units_train(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            xg = fluid.data("xg", [-1, 12])
+            hg = fluid.data("hg", [-1, 4])
+            nh, rhp, gate = fluid.layers.gru_unit(xg, hg, size=12)
+            xl = fluid.data("xl", [-1, 6])
+            hl = fluid.data("hl", [-1, 4])
+            cl = fluid.data("cl", [-1, 4])
+            h2, c2 = fluid.layers.lstm_unit(xl, hl, cl)
+            y = fluid.data("y", [-1, 4])
+            loss = (fluid.layers.mean(
+                fluid.layers.square_error_cost(nh, y))
+                + fluid.layers.mean(
+                    fluid.layers.square_error_cost(h2, y)))
+            fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"xg": rng.randn(8, 12).astype(np.float32),
+                "hg": rng.randn(8, 4).astype(np.float32),
+                "xl": rng.randn(8, 6).astype(np.float32),
+                "hl": rng.randn(8, 4).astype(np.float32),
+                "cl": rng.randn(8, 4).astype(np.float32),
+                "y": np.tanh(rng.randn(8, 4)).astype(np.float32)}
+        first = last = None
+        for _ in range(30):
+            v, = exe.run(main, feed=feed, fetch_list=[loss])
+            first = first if first is not None else float(v)
+            last = float(v)
+        assert last < first * 0.7
