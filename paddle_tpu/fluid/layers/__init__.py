@@ -1235,12 +1235,13 @@ del _n  # filter_by_instag stays eager-only (data-dependent output size)
 from paddle_tpu.static.builders import (  # noqa: E402,F401
     nce, center_loss, sequence_conv, inplace_abn, hsigmoid, lstm,
     data_norm, multi_box_head, deformable_conv, gru_unit, lstm_unit,
+    dynamic_lstm, dynamic_lstmp, dynamic_gru,
 )
 
 for _impl in ("nce", "center_loss", "sequence_conv", "inplace_abn",
               "hsigmoid", "lstm", "data_norm", "multi_box_head",
               "Switch", "IfElse", "deformable_conv", "gru_unit",
-              "lstm_unit"):
+              "lstm_unit", "dynamic_lstm", "dynamic_lstmp", "dynamic_gru"):
     _STATIC_ONLY.pop(_impl, None)
 
 

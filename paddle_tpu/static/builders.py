@@ -794,3 +794,229 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
 
     return layer_op(_LSTMUnit(), x, prefix=name or "lstm_unit",
                     extra_args=(hidden_t_prev, cell_t_prev))
+
+
+def _lstm_scan(x_seq, w, b_gates, peep, h0, c0, gate_act, cell_act,
+               cand_act, is_reverse, proj=None, proj_act=None,
+               cell_clip=None, proj_clip=None):
+    """Shared scan for dynamic_lstm / dynamic_lstmp.  x_seq [B, T, 4H]
+    pre-projected; gate chunk order {c, i, f, o} (the 1.x fused layout);
+    peep = (W_ic, W_fc, W_oc) or None; proj = [H, P] projection or None
+    (lstmp: the recurrent state is the projection)."""
+    import jax
+
+    H = w.shape[1] // 4
+
+    def act(name):
+        import jax.numpy as _jnp
+
+        table = {"sigmoid": jax.nn.sigmoid, "tanh": _jnp.tanh,
+                 "relu": jax.nn.relu, "hard_sigmoid": jax.nn.hard_sigmoid,
+                 "identity": lambda t: t}
+        if name not in table:
+            raise InvalidArgumentError(
+                f"dynamic_lstm/lstmp: unsupported activation {name!r} "
+                f"(supported: {sorted(table)})")
+        return table[name]
+
+    ga, ca, cda = act(gate_act), act(cell_act), act(cand_act)
+    pa = act(proj_act) if proj_act else None
+    xs = jnp.swapaxes(x_seq, 0, 1)                   # [T, B, 4H]
+    if is_reverse:
+        xs = xs[::-1]
+
+    def step(carry, x_t):
+        h, c = carry
+        z = x_t + h @ w + b_gates                    # [B, 4H]
+        zc, zi, zf, zo = (z[:, :H], z[:, H:2 * H],
+                          z[:, 2 * H:3 * H], z[:, 3 * H:])
+        if peep is not None:
+            w_ic, w_fc, w_oc = peep
+            i = ga(zi + w_ic * c)
+            f = ga(zf + w_fc * c)
+        else:
+            i, f = ga(zi), ga(zf)
+        c_new = f * c + i * cda(zc)
+        if cell_clip is not None:
+            c_new = jnp.clip(c_new, -cell_clip, cell_clip)
+        o = ga(zo + (peep[2] * c_new if peep is not None else 0.0))
+        h_new = o * ca(c_new)
+        if proj is not None:
+            h_new = h_new @ proj
+            if pa is not None:
+                h_new = pa(h_new)
+            if proj_clip is not None:
+                h_new = jnp.clip(h_new, -proj_clip, proj_clip)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), xs)
+    if is_reverse:
+        hs, cs = hs[::-1], cs[::-1]
+    return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """ref: fluid/layers/rnn.py dynamic_lstm (operators/lstm_op) — the
+    fused LSTM over PRE-PROJECTED input.  Dense form (§7g): input is
+    [batch, T, 4*hidden] padded (the reference's LoD [T_total, 4H]);
+    recurrent weight [hidden, 4*hidden] in the {c, i, f, o} chunk order,
+    bias [1, 4H] (+3H peephole weights when use_peepholes).  Returns
+    (hidden [B, T, H], cell [B, T, H]).  Sequences are treated as
+    full-length T; mask ragged outputs with sequence_mask."""
+    x = _require_var(input, "dynamic_lstm", "paddle.nn.LSTM")
+    if size % 4:
+        raise InvalidArgumentError(
+            f"dynamic_lstm: size ({size}) must be 4 x hidden")
+    if x.shape[-1] is not None and int(x.shape[-1]) != int(size):
+        raise InvalidArgumentError(
+            f"dynamic_lstm: input width {x.shape[-1]} must equal size "
+            f"{size} (pre-projected fused layout)")
+    H = size // 4
+    from ..nn.layer_base import Layer
+
+    class _DynLSTM(Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter((H, 4 * H),
+                                                attr=param_attr,
+                                                dtype=dtype)
+            nb = 7 * H if use_peepholes else 4 * H
+            self.bias = self.create_parameter((1, nb), attr=bias_attr,
+                                              dtype=dtype, is_bias=True)
+
+        def forward(self, xx, *inits):
+            import jax.numpy as _jnp
+
+            B = xx.shape[0]
+            h0 = (inits[0] if inits else
+                  _jnp.zeros((B, H), xx.dtype))
+            c0 = (inits[1] if len(inits) > 1 else
+                  _jnp.zeros((B, H), xx.dtype))
+            b = self.bias.value[0]
+            peep = ((b[4 * H:5 * H], b[5 * H:6 * H], b[6 * H:])
+                    if use_peepholes else None)
+            return _lstm_scan(xx, self.weight.value, b[:4 * H], peep,
+                              h0, c0, gate_activation, cell_activation,
+                              candidate_activation, is_reverse)
+
+    if (h_0 is None) != (c_0 is None):
+        raise InvalidArgumentError(
+            "dynamic_lstm: h_0 and c_0 must be given together (the "
+            "reference allows None only for both)")
+    extra = (h_0, c_0) if h_0 is not None else ()
+    return layer_op(_DynLSTM(), x, prefix=name or "dynamic_lstm",
+                    extra_args=extra)
+
+
+def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None,
+                  param_attr=None, bias_attr=None, use_peepholes=True,
+                  is_reverse=False, gate_activation="sigmoid",
+                  cell_activation="tanh", candidate_activation="tanh",
+                  proj_activation="tanh", dtype="float32", name=None,
+                  cell_clip=None, proj_clip=None):
+    """ref: fluid/layers/rnn.py dynamic_lstmp (operators/lstmp_op) —
+    projected LSTM: the recurrent state is h_proj = proj_act(h @ W_proj)
+    with W_proj [hidden, proj_size]; recurrent weight [proj_size, 4H].
+    Returns (projection [B, T, P], cell [B, T, H])."""
+    x = _require_var(input, "dynamic_lstmp", "paddle.nn.LSTM")
+    if size % 4:
+        raise InvalidArgumentError(
+            f"dynamic_lstmp: size ({size}) must be 4 x hidden")
+    if x.shape[-1] is not None and int(x.shape[-1]) != int(size):
+        raise InvalidArgumentError(
+            f"dynamic_lstmp: input width {x.shape[-1]} must equal size "
+            f"{size} (pre-projected fused layout)")
+    H, P = size // 4, int(proj_size)
+    from ..nn.layer_base import Layer
+
+    class _DynLSTMP(Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter((P, 4 * H),
+                                                attr=param_attr,
+                                                dtype=dtype)
+            self.proj_weight = self.create_parameter((H, P),
+                                                     attr=param_attr,
+                                                     dtype=dtype)
+            nb = 7 * H if use_peepholes else 4 * H
+            self.bias = self.create_parameter((1, nb), attr=bias_attr,
+                                              dtype=dtype, is_bias=True)
+
+        def forward(self, xx, *inits):
+            import jax.numpy as _jnp
+
+            B = xx.shape[0]
+            h0 = (inits[0] if inits else _jnp.zeros((B, P), xx.dtype))
+            c0 = (inits[1] if len(inits) > 1 else
+                  _jnp.zeros((B, H), xx.dtype))
+            b = self.bias.value[0]
+            peep = ((b[4 * H:5 * H], b[5 * H:6 * H], b[6 * H:])
+                    if use_peepholes else None)
+            return _lstm_scan(xx, self.weight.value, b[:4 * H], peep,
+                              h0, c0, gate_activation, cell_activation,
+                              candidate_activation, is_reverse,
+                              proj=self.proj_weight.value,
+                              proj_act=proj_activation,
+                              cell_clip=cell_clip, proj_clip=proj_clip)
+
+    if (h_0 is None) != (c_0 is None):
+        raise InvalidArgumentError(
+            "dynamic_lstmp: h_0 and c_0 must be given together (the "
+            "reference allows None only for both)")
+    extra = (h_0, c_0) if h_0 is not None else ()
+    return layer_op(_DynLSTMP(), x, prefix=name or "dynamic_lstmp",
+                    extra_args=extra)
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None,
+                origin_mode=False, name=None):
+    """ref: fluid/layers/rnn.py dynamic_gru (operators/gru_op) — fused
+    GRU over PRE-PROJECTED input [batch, T, 3*hidden] (dense form of the
+    LoD [T_total, 3H]); same parameter layout as gru_unit
+    ([hidden, 3*hidden]: update|reset then candidate).  Returns hidden
+    [B, T, H]."""
+    x = _require_var(input, "dynamic_gru", "paddle.nn.GRU")
+    H = int(size)
+    if x.shape[-1] is not None and int(x.shape[-1]) != 3 * H:
+        raise InvalidArgumentError(
+            f"dynamic_gru: input width {x.shape[-1]} must be 3*size "
+            f"({3 * H}; size is the HIDDEN width here, unlike gru_unit)")
+    from ..fluid.dygraph import GRUUnit as _GRUUnit
+    from ..nn.layer_base import Layer
+
+    class _DynGRU(Layer):
+        def __init__(self):
+            super().__init__()
+            self.unit = _GRUUnit(3 * H, param_attr=param_attr,
+                                 bias_attr=bias_attr,
+                                 activation=candidate_activation,
+                                 gate_activation=gate_activation,
+                                 origin_mode=origin_mode)
+
+        def forward(self, xx, *inits):
+            import jax
+            import jax.numpy as _jnp
+
+            B = xx.shape[0]
+            h0 = inits[0] if inits else _jnp.zeros((B, H), xx.dtype)
+            xs = _jnp.swapaxes(xx, 0, 1)
+            if is_reverse:
+                xs = xs[::-1]
+
+            def step(h, x_t):
+                nh, _, _ = self.unit(x_t, h)
+                return nh, nh
+
+            _, hs = jax.lax.scan(step, h0, xs)
+            if is_reverse:
+                hs = hs[::-1]
+            return _jnp.swapaxes(hs, 0, 1)
+
+    extra = (h_0,) if h_0 is not None else ()
+    return layer_op(_DynGRU(), x, prefix=name or "dynamic_gru",
+                    extra_args=extra)

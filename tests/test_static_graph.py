@@ -696,3 +696,82 @@ class TestCellUnitBuilders:
             first = first if first is not None else float(v)
             last = float(v)
         assert last < first * 0.7
+
+
+class TestDynamicRNNBuilders:
+    """dynamic_lstm / dynamic_lstmp / dynamic_gru (ref: fluid/layers/
+    rnn.py over operators/lstm_op, lstmp_op, gru_op) — dense-padded
+    forms of the LoD fused RNNs, gate layout {c, i, f, o} with peepholes
+    appended to the bias."""
+
+    H = 4
+
+    def test_dynamic_lstm_matches_peephole_formula(self):
+        H = self.H
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            xl = fluid.data("xl", [-1, 5, 4 * H])
+            hid, cell = fluid.layers.dynamic_lstm(xl, size=4 * H)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"xl": rng.randn(8, 5, 4 * H).astype(np.float32)}
+        hv, cv = exe.run(main, feed=feed, fetch_list=[hid, cell])
+        w = next(np.asarray(v) for k, v in main.scope.items()
+                 if np.asarray(v).shape == (H, 4 * H))
+        b = next(np.asarray(v) for k, v in main.scope.items()
+                 if np.asarray(v).ndim == 2
+                 and np.asarray(v).shape[0] == 1)[0]
+        sig = lambda t: 1 / (1 + np.exp(-t))  # noqa: E731
+        # step 0 (h0 = c0 = 0): i/f peepholes vanish; W_oc peeps c_t
+        z0 = feed["xl"][:, 0] + b[:4 * H]
+        zc, zi, zf, zo = (z0[:, :H], z0[:, H:2 * H], z0[:, 2 * H:3 * H],
+                          z0[:, 3 * H:])
+        c0 = sig(zi) * np.tanh(zc)
+        h0 = sig(zo + b[6 * H:7 * H] * c0) * np.tanh(c0)
+        np.testing.assert_allclose(cv[:, 0], c0, atol=1e-4)
+        np.testing.assert_allclose(hv[:, 0], h0, atol=1e-4)
+        # step 1 uses the recurrence
+        z1 = feed["xl"][:, 1] + h0 @ w + b[:4 * H]
+        zc, zi, zf, zo = (z1[:, :H], z1[:, H:2 * H], z1[:, 2 * H:3 * H],
+                          z1[:, 3 * H:])
+        i1 = sig(zi + b[4 * H:5 * H] * c0)
+        f1 = sig(zf + b[5 * H:6 * H] * c0)
+        c1 = f1 * c0 + i1 * np.tanh(zc)
+        h1 = sig(zo + b[6 * H:7 * H] * c1) * np.tanh(c1)
+        np.testing.assert_allclose(cv[:, 1], c1, atol=1e-4)
+        np.testing.assert_allclose(hv[:, 1], h1, atol=1e-4)
+
+    def test_dynamic_family_trains_and_reverse_runs(self):
+        H = self.H
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            xl = fluid.data("xl", [-1, 5, 4 * H])
+            hid, cell = fluid.layers.dynamic_lstm(xl, size=4 * H,
+                                                  is_reverse=True)
+            xg = fluid.data("xg", [-1, 5, 3 * H])
+            gh = fluid.layers.dynamic_gru(xg, size=H)
+            xp = fluid.data("xp", [-1, 5, 4 * H])
+            pr, pc = fluid.layers.dynamic_lstmp(xp, size=4 * H,
+                                                proj_size=3)
+            assert pr.shape[-1] == 3 and pc.shape[-1] == H
+            y = fluid.data("y", [-1, H])
+            loss = (fluid.layers.mean(
+                fluid.layers.square_error_cost(hid[:, 0], y))
+                + fluid.layers.mean(
+                    fluid.layers.square_error_cost(gh[:, -1], y))
+                + fluid.layers.mean(pr * pr) * 0.1)
+            fluid.optimizer.AdamOptimizer(0.02).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"xl": rng.randn(8, 5, 4 * H).astype(np.float32),
+                "xg": rng.randn(8, 5, 3 * H).astype(np.float32),
+                "xp": rng.randn(8, 5, 4 * H).astype(np.float32),
+                "y": np.tanh(rng.randn(8, H)).astype(np.float32)}
+        first = last = None
+        for _ in range(40):
+            v, = exe.run(main, feed=feed, fetch_list=[loss])
+            first = first if first is not None else float(v)
+            last = float(v)
+        assert last < first * 0.6
