@@ -96,7 +96,7 @@ class TestLayersWrappers:
             fluid.layers.fc(None, size=10)
         assert "paddle.nn.Linear" in str(ei.value)
         with pytest.raises(UnimplementedError):
-            fluid.layers.dynamic_lstm(None, 8)
+            fluid.layers.lod_reset(None, None)
         with pytest.raises(AttributeError):
             fluid.layers.not_a_real_op
 
