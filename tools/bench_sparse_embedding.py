@@ -10,17 +10,26 @@ every moment row).
 Run anywhere (CPU or TPU):  python tools/bench_sparse_embedding.py
 Reference capability matched: selected_rows.h:41 + fluid/optimizer.py:2026.
 
-Measured on the 1-core CPU dev box (2026-07-31, suite idle):
+Measured on the 1-core CPU dev box (2026-07-31, suite idle; compute-
+dominated, so the asymptotics show directly):
     vocab=  100,000  sparse+lazy    6.5 ms
     vocab=1,000,000  sparse+lazy    5.9 ms
     vocab=10,000,000 sparse+lazy    6.8 ms     <- flat
     vocab=  100,000  dense         44.1 ms
     vocab=1,000,000  dense        934.8 ms     <- linear in vocab
+On the real v5e chip behind the shared tunnel the ~110 ms per-step
+dispatch RTT floors every configuration (sparse 116/116/144 ms at
+100k/1M/10M — ratio 1.25, still passing; a local-host TPU run would
+mirror the CPU asymptotics without the RTT floor).
 """
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def step_time(vocab, sparse, lazy, dim=64, B=256, F=4, iters=20):
