@@ -71,9 +71,13 @@ def while_loop(cond_fn: Callable, body: Callable, loop_vars: Sequence,
                  for leaf in jax.tree_util.tree_leaves(loop_vars)) or \
         _is_traced(cond_fn(*loop_vars))
     if traced:
-        out = lax.while_loop(lambda vs: cond_fn(*vs),
-                             lambda vs: tuple(body(*vs)) if isinstance(
-                                 body(*vs), (list, tuple)) else (body(*vs),),
+        def _body(vs):
+            # call body exactly once per trace: a tapped/effectful body
+            # (sparse-tape tap) must not double-record
+            out = body(*vs)
+            return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+
+        out = lax.while_loop(lambda vs: cond_fn(*vs), _body,
                              tuple(loop_vars))
         return list(out)
     while bool(cond_fn(*loop_vars)):

@@ -167,10 +167,13 @@ class _Tape:
         self._i = 0
 
     def tap(self, box, table: jax.Array, ids: jax.Array,
-            rows: jax.Array) -> jax.Array:
+            rows: jax.Array, valid: Optional[jax.Array] = None) -> jax.Array:
         """Called from a sparse layer's forward with the gathered ``rows``
         (= ``table[ids]``, already padding-masked).  Returns the rows the
-        layer should use downstream."""
+        layer should use downstream.  ``valid`` (bool, ids-shaped) masks the
+        differentiable delta at padding positions so their cotangent is
+        exactly zero — the dense path (F.embedding zeroing padding output)
+        blocks that gradient too, and clip-by-norm must see the same norm."""
         if self.deltas is None:  # record mode
             self.specs.append((box, rows.shape, rows.dtype))
             return rows
@@ -181,7 +184,10 @@ class _Tape:
         d = self.deltas[self._i]
         self._i += 1
         self.taps.append((box, ids))
-        return rows + d.astype(rows.dtype)
+        d = d.astype(rows.dtype)
+        if valid is not None:
+            d = jnp.where(valid[..., None], d, 0)
+        return rows + d
 
 
 class sparse_tape:
@@ -213,13 +219,17 @@ def tap_lookup(box, table, ids, num_embeddings: int,
         return None
     table = jnp.asarray(table)
     ids = jnp.asarray(ids)
+    valid = None
     if padding_idx is not None:
         # padded positions map to the drop sentinel: they gather fill-zeros
-        # here, and their delta-grad scatter is discarded by FILL_OR_DROP
+        # here, and their delta-grad scatter is discarded by FILL_OR_DROP;
+        # ``valid`` additionally zeroes the delta so phantom rows never
+        # inflate merged() gradient norms (clip parity with the dense path)
         ids = jnp.where(ids == padding_idx, num_embeddings, ids)
+        valid = ids != num_embeddings
     rows = jnp.take(jax.lax.stop_gradient(table), ids, axis=0,
                     mode="fill", fill_value=0)
-    return tape.tap(box, table, ids, rows)
+    return tape.tap(box, table, ids, rows, valid)
 
 
 def sparse_param_names(layer) -> Dict[int, str]:

@@ -281,24 +281,27 @@ def load_program_state(model_path, var_list=None):
     reference's binary formats from the in-tree spec)."""
     import os as _os
 
-    if _os.path.isdir(model_path) or (
-            _os.path.isfile(model_path)
-            and not model_path.endswith(".pdparams")):
+    if _os.path.isdir(model_path):
         from ..framework.paddle_import import load_reference_state_dict
 
         state = load_reference_state_dict(model_path)
         return {k: np.asarray(v) for k, v in state.items()
                 if var_list is None or k in var_list}
-    from ..framework.serialization import load as _load
+    from ..framework.serialization import load as _load, _MAGIC
 
-    path = model_path if model_path.endswith(".pdparams") else (
-        model_path + ".pdparams")
-    # format sniff, not exception-driven: our serializer's artifacts load
-    # with _load; a reference binary (LoDTensor stream starts u32 0) goes
-    # to the importer.  Corruption of OUR files keeps its own clear error.
+    path = model_path
+    if not _os.path.isfile(path) and not path.endswith(".pdparams"):
+        path = path + ".pdparams"
+    # format sniff by header, never by extension: our serializer's artifacts
+    # start with the PTPU magic and load with _load; a reference binary
+    # (LoDTensor stream starts u32 version 0) or a reference 2.x pickle
+    # (b'\x80' marker, no magic) goes to the importer — under ANY filename.
+    # Extension-based routing would misparse one of our own ``paddle.save``
+    # files stored under e.g. ``ckpt.bin``, or reject a reference pickle
+    # named ``ref_ckpt.bin``.  Corruption of OUR files keeps its own error.
     with open(path, "rb") as _f:
-        _head = _f.read(4)
-    if _head == b"\x00\x00\x00\x00":
+        _head = _f.read(len(_MAGIC))
+    if _head[:4] == b"\x00\x00\x00\x00" or _head[:1] == b"\x80":
         from ..framework.paddle_import import load_reference_state_dict
 
         state = load_reference_state_dict(path)

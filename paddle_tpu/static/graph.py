@@ -208,9 +208,13 @@ class Program:
 
     # -- parameters ----------------------------------------------------------
     def register_param(self, name: str, value, trainable: bool = True):
-        value = jnp.asarray(value)
+        # host copy FIRST (before the device upload): the jitted train step
+        # donates scope arrays, and a donated (deleted) init alias would
+        # crash a later exe.run(startup_program)
+        host = np.asarray(value)
+        value = jnp.asarray(host)
         self.scope[name] = value
-        self._init_values[name] = value
+        self._init_values[name] = host
         self._param_trainable[name] = trainable
         v = Variable(self, name, value.shape, value.dtype, is_param=True,
                      stop_gradient=not trainable)
@@ -218,9 +222,9 @@ class Program:
         return v
 
     def register_buffer(self, name: str, value):
-        value = jnp.asarray(value)
-        self.buffers[name] = value
-        self._init_values[name] = value
+        host = np.asarray(value)
+        self.buffers[name] = jnp.asarray(host)
+        self._init_values[name] = host
 
     def all_parameters(self):
         return [self.vars[n] for n in self.scope]
@@ -263,14 +267,22 @@ class Program:
         p = copy.copy(self)
         p._optimizer, p._loss_name, p._opt_state = None, None, None
         p._is_test_clone = True  # freeze buffer write-back (BN stats)
+        # snapshot the op list and vars and take a fresh idx: ops/symbols
+        # recorded on the original after cloning must not leak into the
+        # clone, and the Executor cache key (idx, _version, ...) must not
+        # collide with the original's compiled runners
+        p.ops = list(self.ops)
+        p.vars = dict(self.vars)
+        Program._counter += 1
+        p.idx = Program._counter
         return p
 
     def _reinitialize(self):
         for n, v in self._init_values.items():
             if n in self.scope:
-                self.scope[n] = v
+                self.scope[n] = jnp.asarray(v)
             else:
-                self.buffers[n] = v
+                self.buffers[n] = jnp.asarray(v)
         self._opt_state = None
 
 

@@ -204,6 +204,28 @@ class TestSparseSGDAndClip:
             np.asarray(net_s.emb.weight.value)[touched],
             np.asarray(net_d.emb.weight.value)[touched], atol=1e-6)
 
+    def test_global_norm_clip_parity_with_heavy_padding(self):
+        # ADVICE r4: the tape's delta at padded positions must carry a zero
+        # cotangent — phantom rows would inflate the sparse global norm vs
+        # the dense path (F.embedding blocks the padding gradient entirely),
+        # over-clipping heavily padded batches.
+        pad = 0
+        rng = np.random.RandomState(1)
+        ids = rng.randint(1, 50, (B, F)).astype(np.int32)
+        ids[:, 1:] = pad  # 2/3 of every sample is padding
+        y = rng.randn(B, 1).astype(np.float32)
+        clip = popt.clip.ClipGradByGlobalNorm(1e-3)  # tight → always active
+        net_s = make_net(sparse=True, padding_idx=pad)
+        train_once(net_s, popt.Adam(learning_rate=0.1, lazy_mode=True,
+                                    grad_clip=clip), ids, y)
+        net_d = make_net(sparse=False, padding_idx=pad)
+        train_once(net_d, popt.Adam(learning_rate=0.1, grad_clip=clip),
+                   ids, y)
+        touched = np.setdiff1d(np.unique(ids), [pad])
+        np.testing.assert_allclose(
+            np.asarray(net_s.emb.weight.value)[touched],
+            np.asarray(net_d.emb.weight.value)[touched], atol=1e-6)
+
     def test_weight_decay_applies_to_touched_rows(self):
         ids, y = batch()
         net = make_net(sparse=True)

@@ -78,6 +78,45 @@ class TestFitALine:
         for k, v in w0.items():
             np.testing.assert_array_equal(v, main.parameters_numpy()[k])
 
+    def test_init_values_are_donation_proof_host_copies(self):
+        # ADVICE r4 (medium): the jitted train step donates scope arrays;
+        # _init_values aliasing those jax Arrays meant a later
+        # exe.run(startup) restored deleted buffers (TPU crash).  They must
+        # be host (numpy) copies, re-uploaded on reinitialize.
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            y = fluid.data("y", [-1, 1])
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(
+                fluid.layers.fc(x, 1), y))
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        assert main._init_values, "expected registered params"
+        for v in main._init_values.values():
+            assert isinstance(v, np.ndarray), type(v)
+        exe = fluid.Executor()
+        exe.run(startup)
+        X = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+        Y = np.ones((8, 1), np.float32)
+        exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        exe.run(startup)  # restore — and train again on fresh buffers
+        out, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        assert np.isfinite(out).all()
+
+    def test_clone_snapshots_ops_and_gets_fresh_cache_key(self):
+        # ADVICE r4: copy.copy shared the ops LIST — ops recorded after
+        # cloning leaked into the clone while its cache key stayed stale.
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            out = fluid.layers.fc(x, 3)
+        test_prog = main.clone(for_test=True)
+        n_ops = len(test_prog.ops)
+        assert test_prog.idx != main.idx
+        with fluid.program_guard(main, startup):
+            fluid.layers.mean(out)  # recorded on the ORIGINAL only
+        assert len(test_prog.ops) == n_ops
+        assert len(main.ops) == n_ops + 1
+
     def test_fetch_by_name_and_scope_read(self):
         main, startup = _programs()
         with fluid.program_guard(main, startup):

@@ -80,6 +80,33 @@ class TestStatic:
         state = static.load_program_state(str(tmp_path / "m"))
         assert "weight" in state and state["weight"].shape == (3, 2)
 
+    def test_load_program_state_sniffs_header_not_extension(self, tmp_path):
+        # ADVICE r4: one of our own paddle.save artifacts under a
+        # non-.pdparams name must load via header sniff, not be routed to
+        # the reference-format importer by its extension.
+        paddle.seed(0)
+        lin = nn.Linear(3, 2)
+        path = str(tmp_path / "ckpt.bin")
+        paddle.save(lin.state_dict(), path)
+        state = static.load_program_state(path)
+        assert "weight" in state and state["weight"].shape == (3, 2)
+
+    def test_load_program_state_reference_pickle_any_name(self, tmp_path):
+        # a reference-Paddle 2.x pickled state dict under a non-.pdparams
+        # name must still route to the importer (pickle marker, no magic)
+        import pickle
+        path = str(tmp_path / "ref_ckpt.bin")
+        with open(path, "wb") as f:
+            pickle.dump({"weight": np.zeros((3, 2), np.float32)}, f,
+                        protocol=2)
+        state = static.load_program_state(path)
+        assert state["weight"].shape == (3, 2)
+
+    def test_load_program_state_missing_file_names_right_path(self, tmp_path):
+        with pytest.raises(FileNotFoundError) as ei:
+            static.load_program_state(str(tmp_path / "absent.pdparams"))
+        assert "absent.pdparams.pdparams" not in str(ei.value)
+
     def test_create_global_var(self):
         v = static.create_global_var([2, 2], 1.5, "float32")
         assert not v.trainable
