@@ -49,16 +49,25 @@ class Fp16AllReducePlan(ShardingPlan):
     def transform_gradients(self, grads):
         """Called by the train step between grad and update — inside this
         plan's shard_map body, so grads are PER-REPLICA here: reduce them
-        across replicas in the compressed dtype."""
+        across replicas in the compressed dtype.  SelectedRows leaves ride
+        the sparse allreduce (rows gathered, values on the wire in the
+        comm dtype) instead of a dense psum — the reference composes the
+        two the same way (details/sparse_all_reduce_op_handle.cc:1)."""
+        from ...framework.selected_rows import SelectedRows, all_gather_rows
+
         cd = self.comm_dtype
         n = self.mesh.shape[self.axis]
 
         def reduce(g):
+            if isinstance(g, SelectedRows):
+                return all_gather_rows(g, self.axis, scale=1.0 / n,
+                                       wire_dtype=cd)
             # pre-scale by 1/n BEFORE the cast: psum of fp16 values can
             # overflow (n*|g| > 65504) even when the mean is representable
             return lax.psum((g / n).astype(cd), self.axis).astype(g.dtype)
 
-        return jax.tree_util.tree_map(reduce, grads)
+        return jax.tree_util.tree_map(
+            reduce, grads, is_leaf=lambda x: isinstance(x, SelectedRows))
 
     def jit_train_step(self, train_step):
         mesh, axis = self.mesh, self.axis

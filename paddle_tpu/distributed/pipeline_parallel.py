@@ -211,6 +211,7 @@ def pipeline_train_step(
     params: Optional[Dict[str, jax.Array]] = None,
     head_params=None,
     head_loss_fn=None,
+    head_aux_fn=None,
     return_dx: bool = False,
     rng_key: Optional[jax.Array] = None,
 ):
@@ -243,6 +244,18 @@ def pipeline_train_step(
     down the ``pipe`` ring, cotangents ppermute up, both with the
     one-tick lag the schedule provides naturally.
 
+    ``head_aux_fn(y_mb, label_mb) → pytree`` (full mode only, optional):
+    a non-differentiated per-microbatch computation on the LAST stage —
+    how fetch-based metrics ride the schedule (the reference SectionWorker
+    serves metric fetches from its last section, section_worker.cc:82-230).
+    Each leaf must keep the microbatch dim first; leaves are written into
+    an (M, mb, ...) buffer at the stage's forward tick and returned
+    concatenated to full-batch order as a 5th output
+    ``(loss, block_grads, dx, head_grads, aux)``.  Model.prepare(metrics=)
+    under 1F1B computes ``metric.compute`` per microbatch here and feeds
+    ``metric.update`` on the host — no full-batch logits are ever
+    assembled.
+
     ``schedule="gpipe"`` runs :func:`pipeline_blocks` under
     ``jax.value_and_grad`` (fwd-all-then-bwd-all) with the same signature
     — the two schedules are interchangeable and gradient-equivalent.
@@ -273,6 +286,10 @@ def pipeline_train_step(
             f"got {schedule!r}")
 
     labels = jax.tree_util.tree_map(jnp.asarray, labels)
+    if head_aux_fn is not None and not full_mode:
+        raise InvalidArgumentError(
+            "pipeline_train_step: head_aux_fn needs full-model mode "
+            "(pass head_loss_fn)")
     if schedule == "gpipe" or pp == 1:
         if full_mode or return_dx:
             # one differentiable graph: GPipe is plain value_and_grad over
@@ -283,10 +300,15 @@ def pipeline_train_step(
                                     num_microbatches=num_microbatches,
                                     mesh=mesh, axis_name=axis_name,
                                     params=st)
-                return head_loss_fn(y, labels, hp)
+                aux = (jax.lax.stop_gradient(head_aux_fn(y, labels))
+                       if head_aux_fn is not None else None)
+                return head_loss_fn(y, labels, hp), aux
 
-            loss, (g_blocks, g_head, dx) = jax.value_and_grad(
-                lfn, argnums=(0, 1, 2))(stacked_flat, head_params, x)
+            (loss, aux), (g_blocks, g_head, dx) = jax.value_and_grad(
+                lfn, argnums=(0, 1, 2), has_aux=True)(
+                    stacked_flat, head_params, x)
+            if head_aux_fn is not None:
+                return loss, g_blocks, dx, g_head, aux
             return loss, g_blocks, dx, g_head
 
         def lfn(st):
@@ -346,6 +368,16 @@ def pipeline_train_step(
             lambda v: jnp.zeros_like(v, jnp.float32), stage_params)
         zero_head = jax.tree_util.tree_map(
             lambda v: jnp.zeros_like(v, jnp.float32), head_p)
+        if head_aux_fn is not None:
+            # per-microbatch metric rows (last stage): discover the aux
+            # structure abstractly, buffer (M, mb, ...) rows
+            lbl0 = jax.tree_util.tree_map(lambda a: a[0], lmicro)
+            aux_avals = jax.eval_shape(
+                head_aux_fn, jax.ShapeDtypeStruct(act_shape, x.dtype), lbl0)
+            aux_zero = jax.tree_util.tree_map(
+                lambda av: jnp.zeros((M,) + av.shape, av.dtype), aux_avals)
+        else:
+            aux_zero = jnp.zeros((), jnp.float32)
         carry0 = (
             jnp.zeros(act_shape, x.dtype),           # fwd_recv
             jnp.zeros(act_shape, jnp.float32),       # bwd_recv (cotangent)
@@ -355,6 +387,7 @@ def pipeline_train_step(
             zero_head,                               # head grad accumulator
             jnp.zeros((M,) + act_shape, jnp.float32)  # dx per microbatch
             if return_dx else jnp.zeros((), jnp.float32),
+            aux_zero,                                # metric rows
         )
         i32 = jnp.int32
         is_last = stage == pp - 1
@@ -366,7 +399,7 @@ def pipeline_train_step(
 
         def tick(carry, t):
             (fwd_recv, bwd_recv, ring, grad_acc, loss_acc, head_acc,
-             dx_buf) = carry
+             dx_buf, aux_buf) = carry
             t = t.astype(i32)
             f = t - stage
             b = t - (i32(2 * pp - 2) - stage)
@@ -399,6 +432,15 @@ def pipeline_train_step(
                 lambda a, g: a + jnp.where(do_f & is_last,
                                            g.astype(jnp.float32), 0.0),
                 head_acc, dhead)
+            if head_aux_fn is not None:
+                aux_mb = head_aux_fn(y, lbl)
+                aux_buf = jax.tree_util.tree_map(
+                    lambda buf, v: jnp.where(
+                        do_f & is_last,
+                        lax.dynamic_update_index_in_dim(
+                            buf, v.astype(buf.dtype), f_safe, 0),
+                        buf),
+                    aux_buf, aux_mb)
             dy = dy / M  # total loss is the MEAN over microbatches
 
             # ---- backward tick for microbatch b (recompute-from-input)
@@ -428,11 +470,11 @@ def pipeline_train_step(
                 jnp.where(do_b, dh.astype(jnp.float32), 0.0), axis_name,
                 [(i, (i - 1) % pp) for i in range(pp)])
             return (fwd_recv, bwd_recv, ring, grad_acc, loss_acc, head_acc,
-                    dx_buf), None
+                    dx_buf, aux_buf), None
 
         T = M + 2 * pp - 2
         (fwd_recv, bwd_recv, ring, grad_acc, loss_acc, head_acc,
-         dx_buf), _ = lax.scan(tick, carry0, jnp.arange(T))
+         dx_buf, aux_buf), _ = lax.scan(tick, carry0, jnp.arange(T))
         loss = lax.psum(loss_acc, axis_name) / M
         # grads live per-stage; shard_map reassembles the pp axis
         grad_acc = jax.tree_util.tree_map(lambda g: g[None], grad_acc)
@@ -445,22 +487,46 @@ def pipeline_train_step(
         dx_out = (lax.psum(
             jnp.where(stage == 0, dx_buf, jnp.zeros_like(dx_buf)),
             axis_name) if return_dx else dx_buf)
-        return loss, grad_acc, head_acc, dx_out
+        if head_aux_fn is not None:
+            # metric rows live on the last stage only; psum replicates
+            aux_buf = jax.tree_util.tree_map(
+                lambda a: lax.psum(
+                    jnp.where(is_last, a, jnp.zeros_like(a)), axis_name),
+                aux_buf)
+        return loss, grad_acc, head_acc, dx_out, aux_buf
 
+    if head_aux_fn is not None:
+        lbl0_host = jax.tree_util.tree_map(lambda a: a[:mb], labels)
+        aux_struct = jax.eval_shape(
+            head_aux_fn, jax.ShapeDtypeStruct((mb,) + x.shape[1:], x.dtype),
+            lbl0_host)
+        aux_spec = jax.tree_util.tree_map(lambda _: P(), aux_struct)
+    else:
+        aux_spec = P()
     shmapped = jax.shard_map(
         local,
         mesh=mesh,
         in_specs=({n: P(axis_name) for n in stacked}, P(), P(), P()),
-        out_specs=(P(), {n: P(axis_name) for n in stacked}, P(), P()),
+        out_specs=(P(), {n: P(axis_name) for n in stacked}, P(), P(),
+                   aux_spec),
         axis_names={axis_name},
         check_vma=False,
     )
-    loss, grads, head_grads, dx = shmapped(stacked, x, labels, head_params)
+    loss, grads, head_grads, dx, aux = shmapped(stacked, x, labels,
+                                                head_params)
     grads = {n: g.reshape((L,) + g.shape[2:]) for n, g in grads.items()}
+    if head_aux_fn is not None:
+        # (M, mb, ...) rows → full-batch order (each leaf keeps its
+        # microbatch dim first — metric.compute preserves the batch dim)
+        aux = jax.tree_util.tree_map(
+            lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]),
+            aux)
     if full_mode or return_dx:
         if return_dx:
             dx = dx.reshape((B,) + x.shape[1:])
         else:
             dx = None
+        if head_aux_fn is not None:
+            return loss, grads, dx, head_grads, aux
         return loss, grads, dx, head_grads
     return loss, grads

@@ -232,6 +232,30 @@ def tap_lookup(box, table, ids, num_embeddings: int,
     return tape.tap(box, table, ids, rows, valid)
 
 
+def all_gather_rows(sr: "SelectedRows", axis_name: str, scale=1.0,
+                    wire_dtype=None) -> "SelectedRows":
+    """Cross-replica SelectedRows reduction inside a ``shard_map`` body:
+    ``all_gather`` each replica's (ids, values) and concatenate — the
+    reference's sparse allreduce (details/sparse_all_reduce_op_handle.cc:1),
+    which gathers rows instead of densifying.  Duplicate ids across
+    replicas merge by scatter-add downstream, so ``scale=1/n`` yields mean
+    semantics matching the dense pmean.  ``wire_dtype`` sends values in a
+    reduced precision (the fp16_allreduce composition; ids stay int)."""
+    from jax import lax
+
+    vals = sr.values * scale
+    if wire_dtype is not None:
+        wire = vals.astype(wire_dtype)
+    else:
+        wire = vals
+    ids = lax.all_gather(sr.ids, axis_name)          # [ndp, k]
+    wire = lax.all_gather(wire, axis_name)           # [ndp, k, D]
+    return SelectedRows(ids.reshape(-1),
+                        wire.reshape((-1,) + wire.shape[2:]).astype(
+                            sr.values.dtype),
+                        sr.height)
+
+
 def sparse_param_names(layer) -> Dict[int, str]:
     """Map ``id(Parameter box) -> dotted param name`` for every parameter
     flagged ``sparse`` on ``layer`` (set by ``nn.Embedding(sparse=True)``)."""

@@ -62,16 +62,50 @@ class StaticFunction:
     """
 
     def __init__(self, fn, input_spec=None, _bound_layer=None):
+        from .dy2static import convert_to_static
+
         self._orig = fn
         self._input_spec = input_spec
         self._layer = fn if isinstance(fn, Layer) else _bound_layer
         if isinstance(fn, Layer):
-            self._jitted = _jit_layer_call(fn)
+            # transpile the forward's data-dependent control flow (the
+            # reference transpiles Layer.forward — program_translator.py);
+            # an instance-assigned bound forward is transpiled too, and the
+            # converted forward is swapped in THROUGH Layer.__call__ so
+            # forward pre/post hooks (quantization, weight-norm) stay live
+            import inspect as _inspect
+
+            inst_fwd = fn.__dict__.get("forward")
+            if inst_fwd is not None and _inspect.ismethod(inst_fwd):
+                target = inst_fwd.__func__
+            elif inst_fwd is None:
+                target = type(fn).forward
+            else:
+                target = None  # instance forward without self: keep native
+            conv = convert_to_static(target) if target is not None else None
+            if conv is None or conv is target:
+                inner = None  # nothing rewritten — plain layer call path
+            else:
+                _MISSING = object()
+
+                def inner(*a, _layer=fn, _conv=conv):
+                    prev = _layer.__dict__.get("forward", _MISSING)
+                    _layer.__dict__["forward"] = (
+                        lambda *aa, **kk: _conv(_layer, *aa, **kk))
+                    try:
+                        return _layer(*a)
+                    finally:
+                        if prev is _MISSING:
+                            del _layer.__dict__["forward"]
+                        else:
+                            _layer.__dict__["forward"] = prev
+            self._jitted = _jit_layer_call(fn, inner)
         elif _bound_layer is not None:
+            conv = convert_to_static(fn)
             self._jitted = _jit_layer_call(
-                _bound_layer, lambda *a: fn(_bound_layer, *a))
+                _bound_layer, lambda *a: conv(_bound_layer, *a))
         else:
-            self._jitted = jax.jit(fn)
+            self._jitted = jax.jit(convert_to_static(fn))
 
     def __get__(self, obj, objtype=None):
         """Method-decorator support: bind the wrapped function to the Layer
@@ -107,17 +141,20 @@ class StaticFunction:
             out, new_bufs = self._jitted(params, buffers, layer.training,
                                          *args)
         except jax.errors.TracerBoolConversionError as e:
-            # the contract violation the reference's AST transpiler
-            # rewrites away — here the fix is the callable control flow
+            # the AST-lite transpiler (paddle_tpu/dy2static.py) rewrites
+            # if/while/for-range on tensors; landing here means the
+            # construct was one it declines (return/break/raise inside a
+            # data-dependent branch, or control flow in an undecorated
+            # callee) — name the manual rewrites
             raise InvalidArgumentError(
-                "to_static: Python `if`/`while` on a tensor value cannot "
-                "compile (the condition is traced, not concrete).  Rewrite "
-                "the branch with paddle.static.nn.cond / fluid.layers.cond "
-                "(data-dependent if), fluid.layers.while_loop (data-"
-                "dependent while), or fluid.layers.case / switch_case — "
-                "each dispatches to the compiled lax primitive under "
-                "to_static and stays plain Python eagerly.  Original: "
-                f"{e}") from e
+                "to_static: this Python `if`/`while` on a tensor value "
+                "could not be transpiled.  The AST pass skips branches "
+                "containing return/break/continue/raise (assign a flag "
+                "and return after the branch) and does not transform "
+                "functions CALLED from the decorated one (decorate the "
+                "callee too).  Alternatively use the callable forms — "
+                "fluid.layers.cond / while_loop / case / switch_case.  "
+                f"Original: {e}") from e
         boxes = dict(layer.named_buffers())
         for name, v in new_bufs.items():  # eager BN-stat semantics
             boxes[name].value = v
@@ -238,26 +275,49 @@ class ProgramTranslator:
         return _to_static_enabled
 
 
+_code_level = 0
+
+
 def set_code_level(level: int = 100):
-    """Ref: dygraph_to_static logging_utils.set_code_level — printed the
-    AST-transformed code at each transpile stage.  No transpiler exists
-    (tracing is native); to inspect what compiles, use
-    jax.make_jaxpr(fn)(*args) / jax.jit(fn).lower(*args).as_text()."""
+    """Ref: dygraph_to_static logging_utils.set_code_level — print the
+    AST-transformed code.  With the AST-lite transpiler
+    (paddle_tpu/dy2static.py) this now prints the transformed source of
+    every function converted AFTER the call; the lowered XLA view stays
+    available via jax.jit(fn).lower(*args).as_text()."""
+    global _code_level
+    _code_level = level
+
+
+def get_code_level() -> int:
+    return _code_level
 
 
 def set_verbosity(level: int = 0, also_to_stdout: bool = False):
-    """Ref: logging_utils.set_verbosity — dy2static transpiler log level;
-    nothing to log without a transpiler (see set_code_level)."""
+    """Ref: logging_utils.set_verbosity — dy2static transpiler log level
+    (alias of set_code_level here: one transform stage, one printout)."""
+    set_code_level(level)
 
 
 class _Dy2Static:
-    """Namespace stand-in for paddle.jit.dy2static (the reference's AST
-    transpiler package, fluid/dygraph/dygraph_to_static/).  Tracing is
-    native here, so only the control surface survives."""
+    """Namespace for paddle.jit.dy2static — the AST-lite transpiler
+    (paddle_tpu/dy2static.py replaces fluid/dygraph/dygraph_to_static/:
+    ifelse/loop/logical transformers → lax.cond/while_loop dispatch)."""
 
     @property
     def ProgramTranslator(self):
         return ProgramTranslator
+
+    @property
+    def convert_to_static(self):
+        from .dy2static import convert_to_static
+
+        return convert_to_static
+
+    @property
+    def Dy2StaticError(self):
+        from .dy2static import Dy2StaticError
+
+        return Dy2StaticError
 
 
 dy2static = _Dy2Static()

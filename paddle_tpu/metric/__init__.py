@@ -57,7 +57,21 @@ class Accuracy(Metric):
 
     def compute(self, pred, label, *args):
         """pred [N, C] scores, label [N] or [N, 1] int → correctness matrix
-        [N, maxk] (done in numpy on host)."""
+        [N, maxk].  Host numpy eagerly; traced inputs (the 1F1B schedule
+        computes metrics per microbatch on the last stage — ref
+        section_worker.cc metric fetches) take the jnp path, mirroring the
+        reference where Metric.compute is graph-composable ops."""
+        import jax
+
+        if isinstance(pred, jax.core.Tracer) or isinstance(
+                label, jax.core.Tracer):
+            import jax.numpy as jnp
+
+            lbl = jnp.asarray(label).reshape(pred.shape[0], -1)[:, 0]
+            # clamp like the numpy path's [:, :maxk] slice silently does
+            k = min(self.maxk, int(pred.shape[-1]))
+            _, topk_idx = jax.lax.top_k(jnp.asarray(pred), k)
+            return (topk_idx == lbl[:, None]).astype(jnp.float32)
         pred = np.asarray(pred)
         label = np.asarray(label).reshape(pred.shape[0], -1)[:, 0]
         topk_idx = np.argsort(-pred, axis=-1)[:, : self.maxk]

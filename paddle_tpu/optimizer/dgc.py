@@ -86,10 +86,13 @@ class DGCMomentum(Optimizer):
 
     # -- update (runs inside shard_map; grads are LOCAL) ----------------------
     def update(self, grads, state, params, lr=None):
+        from ..framework.selected_rows import SelectedRows, all_gather_rows
+
         if lr is None:
             lr = self.get_lr()
         sparsity = self._sparsity_now
         axis = self._axis
+        ndp = lax.psum(1, axis)
         if self._grad_clip is not None and sparsity is not None:
             # sparse phase: per-replica clip before compression, like the
             # reference's dgc_clip_by_norm (operators/dgc_clip_by_norm_op.h)
@@ -97,8 +100,13 @@ class DGCMomentum(Optimizer):
         if sparsity is None:
             # dense warmup: average FIRST, clip the aggregated gradient —
             # keeps exact parity with plain DP Momentum (where GSPMD
-            # all-reduces before the optimizer sees the gradient)
-            grads = {n: lax.pmean(g.astype(jnp.float32), axis)
+            # all-reduces before the optimizer sees the gradient).
+            # SelectedRows grads (Embedding(sparse=True)) ride the sparse
+            # allreduce instead of a dense pmean — gathered BEFORE the
+            # clip so every replica sees the same norm
+            grads = {n: (all_gather_rows(g, axis, scale=1.0 / ndp).merged()
+                         if isinstance(g, SelectedRows)
+                         else lax.pmean(g.astype(jnp.float32), axis))
                      for n, g in grads.items() if g is not None}
             if self._grad_clip is not None:
                 grads = self._grad_clip(grads)
@@ -109,6 +117,32 @@ class DGCMomentum(Optimizer):
             if g is None:  # frozen / no gradient
                 new_params[name] = p
                 new_vel[name] = state["velocity"][name]
+                new_u[name] = state["u"][name]
+                new_v[name] = state["v"][name]
+                continue
+            if isinstance(g, SelectedRows):
+                # DGC never compresses sparse-embedding grads: rows ride
+                # the sparse allreduce and get plain momentum on touched
+                # rows only — the reference composes exactly this way
+                # (details/sparse_all_reduce_op_handle.cc:1)
+                if sparsity is not None:  # sparse phase: not yet gathered
+                    g = all_gather_rows(g, axis, scale=1.0 / ndp)
+                sr = g.merged()
+                ids, g_rows = sr.ids, sr.values.astype(jnp.float32)
+                w = p.astype(jnp.float32)
+                w_rows = w.at[ids].get(mode="fill", fill_value=0)
+                if self._regularizer is not None:
+                    g_rows = g_rows + self._regularizer(w_rows)
+                elif self._weight_decay:
+                    g_rows = g_rows + self._weight_decay * w_rows
+                vel = state["velocity"][name]
+                v_rows = vel.at[ids].get(mode="fill", fill_value=0)
+                v_new = self._momentum * v_rows + g_rows
+                step_dir = (g_rows + self._momentum * v_new
+                            if self._nesterov else v_new)
+                new_params[name] = w.at[ids].set(
+                    w_rows - lr * step_dir, mode="drop").astype(p.dtype)
+                new_vel[name] = vel.at[ids].set(v_new, mode="drop")
                 new_u[name] = state["u"][name]
                 new_v[name] = state["v"][name]
                 continue

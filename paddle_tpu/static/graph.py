@@ -267,12 +267,13 @@ class Program:
         p = copy.copy(self)
         p._optimizer, p._loss_name, p._opt_state = None, None, None
         p._is_test_clone = True  # freeze buffer write-back (BN stats)
-        # snapshot the op list and vars and take a fresh idx: ops/symbols
-        # recorded on the original after cloning must not leak into the
-        # clone, and the Executor cache key (idx, _version, ...) must not
-        # collide with the original's compiled runners
+        # snapshot the op LIST and take a fresh idx: ops recorded on the
+        # original after cloning must not replay in the clone, and the
+        # Executor cache key (idx, _version, ...) must not collide with
+        # the original's compiled runners.  vars and scope deliberately
+        # stay SHARED — 1.x test clones share parameters (training on the
+        # original must be visible here), and scope/vars must stay in sync
         p.ops = list(self.ops)
-        p.vars = dict(self.vars)
         Program._counter += 1
         p.idx = Program._counter
         return p

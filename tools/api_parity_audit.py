@@ -55,10 +55,10 @@ NAMESPACES = [
 ]
 
 #: reference names that are intentionally absent (internal machinery the
-#: TPU-native design replaces wholesale — each with the replacing design)
-WAIVED = {
-    "jit.dy2static": "no AST transpiler: tracing is native",
-}
+#: TPU-native design replaces wholesale — each with the replacing design).
+#: Empty since round 5: jit.dy2static is real now (paddle_tpu/dy2static.py,
+#: the AST-lite transpiler).
+WAIVED = {}
 
 
 def ref_names(ref_root: str, rel: str) -> set:
