@@ -170,11 +170,17 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
                               or optimizer._weight_decay),
                 grad_clip=optimizer._grad_clip,
             )
-    if st.a_sync:
+    if st.a_sync and int((st.a_sync_configs or {}).get("k_steps", 0)) <= 0:
         raise UnimplementedError(
-            "strategy.a_sync is parameter-server async mode (reference: "
-            "operators/distributed/communicator.h:268); PS does not exist "
-            "on TPU — use sharded embedding tables instead")
+            "strategy.a_sync with k_steps=0 is PURE parameter-server async "
+            "mode (reference: operators/distributed/communicator.h:268); "
+            "its stale-tolerance has no counterpart on a synchronous TPU "
+            "mesh.  Migrations that carry the capability: "
+            "a_sync_configs={'k_steps': N} for Geo-SGD (local steps + "
+            "periodic parameter-delta push, geo_sgd_transpiler.py parity), "
+            "strategy.localsgd for periodic model averaging, and "
+            "paddle.incubate.HostEmbeddingTable for beyond-HBM tables "
+            "(the PS role's big-table job)")
 
     from ...optimizer.optimizer import Lamb, Lars, Momentum
 

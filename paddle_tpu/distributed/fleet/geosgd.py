@@ -1,0 +1,146 @@
+"""Geo-SGD — local steps with periodic parameter-DELTA synchronization.
+
+Parity: the reference's Geo-SGD mode (transpiler/geo_sgd_transpiler.py:1 +
+operators/distributed/communicator.h:413 GeoCommunicator) — the
+stale-tolerant parameter-server strategy: workers train locally; every
+``k_steps`` each worker SENDS the delta of its parameters since its last
+send (divided by the worker count) and RECEIVES the server's aggregate
+drift, merging it into its local parameters WITHOUT resetting them.
+Replicas therefore keep their individual exploration between syncs — the
+property that distinguishes Geo from LocalSGD's full reset-to-average.
+
+TPU-native design: like LocalSGD, per-replica state rides stacked
+``[ndp, ...]`` inside the optimizer state under ``shard_map`` with no
+implicit gradient all-reduce.  The PS server's aggregate is the plan's
+Model-visible (replicated) parameter copy.  At a sync step:
+
+    delta_i     = local_i − snapshot_i          (per replica)
+    mean_delta  = pmean(delta_i)                (the Σ delta_i/n the
+                                                 server would apply)
+    global     += mean_delta                    (server state)
+    local_i    += mean_delta                    (recv merge — NO reset)
+    snapshot_i  = local_i                       (send-side old_param)
+
+With every replica starting from the same global, the FIRST window's
+global update equals LocalSGD's average exactly — asserted in
+tests/test_geosgd.py — while replicas keep their drift afterwards.
+
+Between syncs no collective appears in the HLO at all (separately
+compiled steps, the LocalSGD pattern).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ...framework.errors import InvalidArgumentError
+from ..collective import shard_map
+from .localsgd import LocalSGDPlan
+
+__all__ = ["GeoSgdPlan"]
+
+
+class GeoSgdPlan(LocalSGDPlan):
+    """LocalSGD's state layout and host dispatcher + Geo's delta-merge
+    sync rule (only :meth:`_make_step` differs)."""
+
+    _FEATURE = "a_sync (Geo-SGD)"
+
+    def __init__(self, network, optimizer, strategy, mesh=None):
+        super().__init__(network, optimizer, strategy, mesh)
+        cfg = getattr(strategy, "a_sync_configs", None) or {}
+        self.k_steps = int(cfg.get("k_steps", 0))
+        if self.k_steps <= 0:
+            raise InvalidArgumentError(
+                "GeoSgdPlan needs a_sync_configs={'k_steps': N>0} "
+                "(N local steps per delta push)")
+        self.begin_step = 1  # geo has no dense warmup in the reference
+        if getattr(strategy, "localsgd", False) or \
+                getattr(strategy, "adaptive_localsgd", False):
+            raise InvalidArgumentError(
+                "a_sync(geo) and localsgd are mutually exclusive sync "
+                "strategies — pick one")
+
+    # -- state ---------------------------------------------------------------
+    def init_opt_state(self, optimizer, params, buffers=None):
+        """LocalSGD's state plus per-replica ``snapshot`` (the
+        GeoCommunicator's send-side old_param copy)."""
+        state = super().init_opt_state(optimizer, params, buffers)
+        state["local"]["snapshot"] = jax.tree.map(
+            jnp.copy, state["local"]["params"])
+        return state
+
+    # -- step ----------------------------------------------------------------
+    def _make_step(self, train_step):
+        mesh, axis = self.mesh, self.axis
+        spec_l = P(axis)
+
+        def make(sync: bool, n_batch: int):
+            def step(params, opt_state, buffers, key, lr, *batch):
+                local = opt_state["local"]
+
+                def body(params, buffers, l_params, l_inner, l_bufs,
+                         l_snap, key, lr, *batch):
+                    sq = lambda t: jax.tree.map(lambda x: x[0], t)
+                    st = lambda t: jax.tree.map(lambda x: x[None], t)
+                    key = jax.random.fold_in(key, lax.axis_index(axis))
+                    loss, out, new_p, new_inner, new_b = train_step(
+                        sq(l_params), sq(l_inner), sq(l_bufs),
+                        key, lr, *batch)
+                    loss = lax.pmean(loss, axis)
+                    snap = sq(l_snap)
+                    if sync:
+                        # send: delta since the last push; the server-side
+                        # aggregate is pmean (= Σ delta/n of communicator.h)
+                        mean_delta = jax.tree.map(
+                            lambda p, s: lax.pmean(
+                                p.astype(jnp.float32)
+                                - s.astype(jnp.float32), axis),
+                            new_p, snap)
+                        g_params = jax.tree.map(
+                            lambda g, d: (g.astype(jnp.float32)
+                                          + d).astype(g.dtype),
+                            params, mean_delta)
+                        # recv merge: locals absorb the aggregate drift but
+                        # are NOT reset (the geo property)
+                        new_p = jax.tree.map(
+                            lambda p, d: (p.astype(jnp.float32)
+                                          + d).astype(p.dtype),
+                            new_p, mean_delta)
+                        new_snap = new_p
+                        # buffers (BN stats) have no delta semantics in the
+                        # reference; average AND re-seed the locals with
+                        # the average like LocalSGD (localsgd.py) — unlike
+                        # params, drifting per-replica running stats have
+                        # no error-feedback story
+                        new_b = jax.tree.map(
+                            lambda x: lax.pmean(x, axis), new_b)
+                        g_bufs = new_b
+                    else:
+                        g_params, g_bufs = params, buffers
+                        new_snap = snap
+                    return (loss, out, g_params, st(new_p), st(new_inner),
+                            st(new_b), st(new_snap), g_bufs)
+
+                in_specs = (P(), P(), spec_l, spec_l, spec_l, spec_l,
+                            P(), P()) + (spec_l,) * n_batch
+                out_specs = (P(), spec_l, P(), spec_l, spec_l, spec_l,
+                             spec_l, P())
+                (loss, out, g_params, nl_p, nl_i, nl_b, nl_s,
+                 g_bufs) = shard_map(
+                    body, mesh=mesh, in_specs=in_specs,
+                    out_specs=out_specs,
+                )(params, buffers, local["params"], local["inner"],
+                  local["buffers"], local["snapshot"], key, lr, *batch)
+                new_state = {
+                    "count": opt_state["count"] + 1,
+                    "local": {"params": nl_p, "inner": nl_i,
+                              "buffers": nl_b, "snapshot": nl_s},
+                }
+                return loss, out, g_params, new_state, g_bufs
+
+            return step
+
+        return make

@@ -39,9 +39,11 @@ class LocalSGDPlan(ShardingPlan):
     """ShardingPlan variant where the ``data`` axis holds independent
     replicas between sync points instead of a single GSPMD program."""
 
+    _FEATURE = "localsgd"  # the flag named in mesh-compat errors
+
     def __init__(self, network, optimizer, strategy, mesh=None):
         super().__init__(network, optimizer, strategy, mesh)
-        self._require_pure_dp("localsgd")
+        self._require_pure_dp(self._FEATURE)
         cfg = getattr(strategy, "localsgd_configs", None) or {}
         self.k_steps = max(int(cfg.get("k_steps", 1)), 1)
         self.begin_step = max(int(cfg.get("begin_step", 1)), 1)
@@ -80,7 +82,37 @@ class LocalSGDPlan(ShardingPlan):
 
     # -- step ----------------------------------------------------------------
     def jit_train_step(self, train_step):
+        """Host dispatcher shared by LocalSGD / AdaptiveLocalSGD / GeoSGD
+        (subclasses override :meth:`_make_step` for their sync rule)."""
         plan = self
+        make = self._make_step(train_step)
+        compiled = {}
+
+        def wrapped(params, opt_state, buffers, key, lr, *batch):
+            # host mirror of opt_state["count"]: one device read at start
+            # and after each Model.load (on_state_restored nulls it)
+            t = (plan._t if plan._t is not None
+                 else int(opt_state["count"])) + 1
+            if plan._last_sync is None:
+                # restored mid-window: re-anchor the cadence conservatively
+                plan._last_sync = t - 1
+            sync = t < plan.begin_step or \
+                (t - plan._last_sync) >= plan.k_steps
+            kk = (bool(sync), len(batch))
+            if kk not in compiled:
+                compiled[kk] = jax.jit(make(*kk), donate_argnums=(0, 1, 2))
+            out = compiled[kk](params, opt_state, buffers, key, lr, *batch)
+            plan._t = t  # advance only after a successful dispatch
+            if sync:
+                plan._last_sync = t
+            plan._after_step(t, bool(sync), out[0], lr)
+            return out
+
+        wrapped.compiled = compiled  # introspection (tests count collectives)
+        wrapped.make = make
+        return wrapped
+
+    def _make_step(self, train_step):
         mesh, axis = self.mesh, self.axis  # the sync period is read from
         spec_l = P(axis)                   # plan.k_steps LIVE (adaptive)
 
@@ -124,29 +156,7 @@ class LocalSGDPlan(ShardingPlan):
 
             return step
 
-        compiled = {}
-
-        def wrapped(params, opt_state, buffers, key, lr, *batch):
-            # host mirror of opt_state["count"]: one device read at start
-            # and after each Model.load (on_state_restored nulls it)
-            t = (plan._t if plan._t is not None
-                 else int(opt_state["count"])) + 1
-            if plan._last_sync is None:
-                # restored mid-window: re-anchor the cadence conservatively
-                plan._last_sync = t - 1
-            sync = t < plan.begin_step or \
-                (t - plan._last_sync) >= plan.k_steps
-            kk = (bool(sync), len(batch))
-            if kk not in compiled:
-                compiled[kk] = jax.jit(make(*kk), donate_argnums=(0, 1, 2))
-            out = compiled[kk](params, opt_state, buffers, key, lr, *batch)
-            plan._t = t  # advance only after a successful dispatch
-            if sync:
-                plan._last_sync = t
-            plan._after_step(t, bool(sync), out[0], lr)
-            return out
-
-        return wrapped
+        return make
 
     _last_sync: "int | None" = 0
 

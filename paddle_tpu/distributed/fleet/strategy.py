@@ -56,7 +56,13 @@ class DistributedStrategy:
     lamb_configs: Dict = field(default_factory=dict)
     lars: bool = False
     lars_configs: Dict = field(default_factory=dict)
-    a_sync: bool = False        # PS async mode — not supported on TPU
+    a_sync: bool = False        # PS async mode; with a_sync_configs
+    # {"k_steps": N>0} this is Geo-SGD (ref: geo_sgd_transpiler.py:1,
+    # communicator.h:413 GeoCommunicator) — local steps + periodic
+    # parameter-DELTA push, served here by GeoSgdPlan.  Pure async
+    # (k_steps=0) has no TPU counterpart and raises with the migration
+    # paths (GeoSGD / LocalSGD / incubate.HostEmbeddingTable).
+    a_sync_configs: Dict = field(default_factory=dict)
     hybrid_configs: Optional[Dict] = None
 
     def __post_init__(self):

@@ -263,7 +263,16 @@ class Model:
                         "strategy.sequence_parallel found no attention "
                         "layers exposing a `sequence_parallel` knob",
                         RuntimeWarning)
-            if strategy.adaptive_localsgd:
+            if strategy.a_sync and int(
+                    (strategy.a_sync_configs or {}).get("k_steps", 0)) > 0:
+                # reference Geo-SGD (geo_sgd_transpiler.py:1,
+                # communicator.h:413): local steps + periodic parameter-
+                # delta push — see fleet/geosgd.py (pure async k_steps=0
+                # was rejected at distributed_optimizer time)
+                from ..distributed.fleet.geosgd import GeoSgdPlan
+
+                self._plan = GeoSgdPlan(net, optimizer, strategy)
+            elif strategy.adaptive_localsgd:
                 # reference: localsgd_optimizer.py:194 — LocalSGD whose
                 # sync period adapts to loss progress (fleet/localsgd.py)
                 from ..distributed.fleet.localsgd import AdaptiveLocalSGDPlan

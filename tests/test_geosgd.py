@@ -1,0 +1,210 @@
+"""Geo-SGD (distributed/fleet/geosgd.py).
+
+Parity model: the reference's Geo-SGD strategy
+(transpiler/geo_sgd_transpiler.py:1, communicator.h:413 GeoCommunicator):
+k local steps per replica, then parameter-DELTA push/merge — replicas
+keep their drift (no reset-to-average), the server copy accumulates the
+mean drift.  First-window equivalence with LocalSGD is exact and is the
+cross-check the implementation is built around.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as popt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.mesh import build_mesh, set_mesh
+from paddle_tpu.framework.errors import UnimplementedError
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    set_mesh(build_mesh())
+    yield
+    set_mesh(build_mesh())
+    fleet._initialized = False
+    fleet._strategy = None
+
+
+def _make_model(strategy_kw, seed=0, lr=0.1):
+    fleet._initialized = False
+    strategy = fleet.DistributedStrategy(**strategy_kw)
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 1))
+    opt = fleet.distributed_optimizer(popt.SGD(learning_rate=lr))
+    model = paddle.Model(net, inputs=["x"], labels=["y"])
+    model.prepare(optimizer=opt, loss=nn.MSELoss())
+    return model
+
+
+def _batches(n, seed=3):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(16, 6).astype(np.float32),
+             rng.randn(16, 1).astype(np.float32)) for _ in range(n)]
+
+
+class TestGeoSgd:
+    def test_pure_async_still_raises_with_migration_paths(self):
+        fleet._initialized = False
+        strategy = fleet.DistributedStrategy(a_sync=True)
+        fleet.init(is_collective=True, strategy=strategy)
+        with pytest.raises(UnimplementedError) as ei:
+            fleet.distributed_optimizer(popt.SGD(learning_rate=0.1))
+        msg = str(ei.value)
+        assert "Geo-SGD" in msg and "localsgd" in msg \
+            and "HostEmbeddingTable" in msg
+
+    def test_first_window_matches_localsgd(self):
+        """From a common start, geo's global after the FIRST sync equals
+        LocalSGD's average (snapshot == global ⇒ global + mean(local −
+        snapshot) = mean(local)); both run identical per-replica steps."""
+        k = 3
+        batches = _batches(k)
+        geo = _make_model({"a_sync": True, "a_sync_configs": {"k_steps": k}})
+        lsgd = _make_model({"localsgd": True,
+                            "localsgd_configs": {"k_steps": k,
+                                                 "begin_step": 1}})
+        from paddle_tpu.distributed.fleet.geosgd import GeoSgdPlan
+        from paddle_tpu.distributed.fleet.localsgd import LocalSGDPlan
+
+        assert isinstance(geo._plan, GeoSgdPlan)
+        assert isinstance(lsgd._plan, LocalSGDPlan)
+        assert not isinstance(lsgd._plan, GeoSgdPlan)
+
+        for x, y in batches:
+            lg, _ = geo.train_batch([x], [y])
+            ll, _ = lsgd.train_batch([x], [y])
+            np.testing.assert_allclose(lg, ll, rtol=1e-6)
+        pg, _ = geo._pull_state()
+        pl, _ = lsgd._pull_state()
+        for name in pg:
+            np.testing.assert_allclose(np.asarray(pg[name]),
+                                       np.asarray(pl[name]),
+                                       rtol=1e-6, atol=1e-7,
+                                       err_msg=name)
+
+    def test_replicas_keep_drift_after_sync(self):
+        """The geo property: after a sync, per-replica locals are NOT equal
+        to the global (LocalSGD resets them; geo only merges the drift)."""
+        k = 2
+        geo = _make_model({"a_sync": True, "a_sync_configs": {"k_steps": k}})
+        for x, y in _batches(k):
+            geo.train_batch([x], [y])
+        local = geo._opt_state["local"]["params"]
+        g, _ = geo._pull_state()
+        name = next(iter(g))
+        stacked = np.asarray(local[name])  # [ndp, ...]
+        assert stacked.shape[0] >= 2
+        # replica 0 differs from replica 1 (each saw a different shard)
+        assert not np.allclose(stacked[0], stacked[1]), \
+            "replicas collapsed — geo must not reset locals"
+        # and neither equals the global
+        assert not np.allclose(stacked[0], np.asarray(g[name]))
+        # snapshot tracks the post-merge locals
+        snap = np.asarray(geo._opt_state["local"]["snapshot"][name])
+        np.testing.assert_allclose(snap, stacked, rtol=1e-6)
+
+    def test_trains_to_low_loss(self):
+        geo = _make_model({"a_sync": True,
+                           "a_sync_configs": {"k_steps": 4}}, lr=0.05)
+        rng = np.random.RandomState(0)
+        X = rng.randn(32, 6).astype(np.float32)
+        W = rng.randn(6, 1).astype(np.float32)
+        Y = X @ W
+        losses = [float(geo.train_batch([X], [Y])[0]) for _ in range(60)]
+        assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
+
+    def test_buffers_averaged_and_reseeded_on_sync(self):
+        """BN running stats have no delta semantics: at a sync the locals
+        must be replaced by the cross-replica average (the LocalSGD rule),
+        or per-replica stats drift forever."""
+        k = 2
+        fleet._initialized = False
+        strategy = fleet.DistributedStrategy(
+            a_sync=True, a_sync_configs={"k_steps": k})
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(6, 8), nn.BatchNorm1D(8),
+                            nn.Linear(8, 1))
+        opt = fleet.distributed_optimizer(popt.SGD(learning_rate=0.05))
+        model = paddle.Model(net, inputs=["x"], labels=["y"])
+        model.prepare(optimizer=opt, loss=nn.MSELoss())
+        for x, y in _batches(k):
+            model.train_batch([x], [y])
+        local_b = model._opt_state["local"]["buffers"]
+        _, g_bufs = model._pull_state()
+        name = next(n for n in g_bufs if "mean" in n or "variance" in n)
+        stacked = np.asarray(local_b[name])
+        for r in range(stacked.shape[0]):
+            np.testing.assert_allclose(stacked[r], np.asarray(g_bufs[name]),
+                                       rtol=1e-6,
+                                       err_msg=f"replica {r} not re-seeded")
+
+    def test_hybrid_mesh_error_names_geo(self):
+        fleet._initialized = False
+        strategy = fleet.DistributedStrategy(
+            a_sync=True, a_sync_configs={"k_steps": 2},
+            tensor_parallel=True,
+            tensor_parallel_configs={"tensor_parallel_degree": 2})
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        opt = fleet.distributed_optimizer(popt.SGD(learning_rate=0.1))
+        model = paddle.Model(net, inputs=["x"], labels=["y"])
+        with pytest.raises(Exception, match="Geo-SGD"):
+            model.prepare(optimizer=opt, loss=nn.MSELoss())
+
+    def test_exclusive_with_localsgd(self):
+        fleet._initialized = False
+        strategy = fleet.DistributedStrategy(
+            a_sync=True, a_sync_configs={"k_steps": 2}, localsgd=True)
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        opt = fleet.distributed_optimizer(popt.SGD(learning_rate=0.1))
+        model = paddle.Model(net, inputs=["x"], labels=["y"])
+        with pytest.raises(Exception, match="exclusive"):
+            model.prepare(optimizer=opt, loss=nn.MSELoss())
+
+    def test_no_param_collective_between_syncs(self):
+        """Between pushes the compiled local step carries only the loss
+        pmean — no parameter collective; the sync step carries the delta
+        pmeans.  The communication saving is structural, not simulated."""
+        k = 4
+        geo = _make_model({"a_sync": True, "a_sync_configs": {"k_steps": k}})
+        x, y = _batches(1)[0]
+        geo.train_batch([x], [y])  # t=1: local step → compiles (False, 2)
+
+        params, buffers = geo._pull_state()
+        key = jax.random.PRNGKey(0)
+        lr = jnp.asarray(0.1, jnp.float32)
+
+        def count_collectives(sync):
+            fn = geo._train_step.make(sync, 2)
+            jaxpr = jax.make_jaxpr(fn)(
+                params, geo._opt_state, buffers, key, lr,
+                jnp.asarray(x), jnp.asarray(y))
+            n = 0
+
+            def walk(jx):
+                nonlocal n
+                for eqn in jx.eqns:
+                    if "psum" in eqn.primitive.name:
+                        n += 1
+                    for sub in eqn.params.values():
+                        if hasattr(sub, "eqns"):
+                            walk(sub)
+                        elif hasattr(sub, "jaxpr"):
+                            walk(sub.jaxpr)
+
+            walk(jaxpr.jaxpr)
+            return n
+
+        local_n = count_collectives(False)
+        sync_n = count_collectives(True)
+        assert local_n == 1, f"local step has {local_n} collectives (loss only expected)"
+        assert sync_n > local_n
