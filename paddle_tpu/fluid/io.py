@@ -1,8 +1,16 @@
 """paddle.fluid.io — 1.x persistence + reader decorators.
 
 Parity: python/paddle/fluid/io.py (save/load_persistables:598,966,
-save/load_inference_model:1164,1374, program-state save/load:1669,1730)
-+ the reader decorators re-exported there.
+save_params:598, save_vars:168, save/load_inference_model:1164,1374,
+program-state save/load:1669,1730) + the reader decorators re-exported
+there.
+
+Since round 5 the save side emits the REFERENCE'S binary formats
+(framework/paddle_export.py — LoDTensor streams, sorted-name combined
+files, ``__model__`` ProgramDesc) and the load side reads them back
+through framework/paddle_import.py, so artifacts round-trip both with
+this framework and with reference-Paddle tooling (``protoc --decode``
+against framework.proto is part of the test gate).
 """
 from __future__ import annotations
 
@@ -19,36 +27,190 @@ from paddle_tpu.reader import (  # noqa: F401
 from paddle_tpu import batch  # noqa: F401
 
 
-def _persistables(what):
-    from ..framework.errors import UnimplementedError
+def _resolve_state(main_program, params_only: bool):
+    """State dict of a Program (the 1.x flow), a Layer (eager convenience),
+    or a plain {name: array} dict."""
+    from ..nn.layer_base import Layer
+    from ..static.graph import Program, default_main_program
 
-    raise UnimplementedError(
-        f"fluid.io.{what} walked the Program for persistable Variables; "
-        f"state lives in Layers here — paddle.save(layer.state_dict(), "
-        f"path) / layer.set_state_dict(paddle.load(path))")
+    import numpy as np
+
+    if main_program is None:
+        main_program = default_main_program()
+    if isinstance(main_program, Program):
+        if params_only:
+            return {n: np.asarray(v) for n, v in main_program.scope.items()}
+        return main_program.state_dict()
+    if isinstance(main_program, Layer):
+        if params_only:
+            return {n: np.asarray(p.value)
+                    for n, p in main_program.named_parameters()}
+        return {k: np.asarray(v)
+                for k, v in main_program.state_dict().items()}
+    if isinstance(main_program, dict):
+        return {n: np.asarray(v) for n, v in main_program.items()}
+    from ..framework.errors import InvalidArgumentError
+
+    raise InvalidArgumentError(
+        "main_program must be a static Program, a Layer, or a "
+        f"{{name: array}} dict, got {type(main_program).__name__}")
 
 
 def save_persistables(executor, dirname, main_program=None, filename=None):
-    _persistables("save_persistables")
+    """Write every persistable (parameters + buffers) in the REFERENCE
+    binary format (ref: fluid/io.py:598) — per-variable LoDTensor files,
+    or one sorted-name combined file when ``filename`` is given, plus a
+    ``__model__`` ProgramDesc naming them."""
+    from ..framework.paddle_export import save_reference_state
 
-
-def load_persistables(executor, dirname, main_program=None, filename=None):
-    _persistables("load_persistables")
+    save_reference_state(_resolve_state(main_program, params_only=False),
+                         dirname, filename=filename)
 
 
 def save_params(executor, dirname, main_program=None, filename=None):
-    _persistables("save_params")
+    """Parameters only (ref: fluid/io.py:598 save_params)."""
+    from ..framework.paddle_export import save_reference_state
+
+    save_reference_state(_resolve_state(main_program, params_only=True),
+                         dirname, filename=filename)
 
 
-def load_params(executor, dirname, main_program=None, filename=None):
-    _persistables("load_params")
+class _VarView:
+    """What a save_vars/load_vars ``predicate`` receives — the Variable
+    attributes 1.x predicates read (``lambda var: var.persistable``,
+    ``var.name.startswith(...)``; ref fluid/io.py:168)."""
+
+    __slots__ = ("name", "shape", "persistable")
+
+    def __init__(self, name, value):
+        import numpy as np
+
+        self.name = name
+        self.shape = tuple(np.shape(value))
+        self.persistable = True
 
 
 def save_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
-    _persistables("save_vars")
+    """Ref: fluid/io.py:168 — explicit variable list (names or Variables)
+    filtered by ``predicate`` (which receives a Variable-like view, as in
+    the reference)."""
+    state = _resolve_state(main_program, params_only=False)
+    if vars is not None:
+        names = [v if isinstance(v, str) else v.name for v in vars]
+        missing = [n for n in names if n not in state]
+        if missing:
+            from ..framework.errors import NotFoundError
+
+            raise NotFoundError(f"save_vars: no such variables {missing}")
+        state = {n: state[n] for n in names}
+    if predicate is not None:
+        state = {n: v for n, v in state.items()
+                 if predicate(_VarView(n, v))}
+    from ..framework.paddle_export import save_reference_state
+
+    save_reference_state(state, dirname, filename=filename)
+
+
+def _adapt_program_names(sd, program, partial: bool = False):
+    """Auto-generated names here carry a per-Program prefix (``_<idx>_``,
+    static/graph.py unique_name) the way the reference's global
+    unique_name counters shift across rebuilds — a checkpoint from one
+    build must load into an identically-built fresh Program.  Exact names
+    first; non-matches map by the idx-stripped name (builder order is
+    deterministic, so stripped names are unique per program).  Entries
+    that map nowhere raise (Program.set_state_dict would silently ignore
+    them and the restore would be partial) — unless ``partial`` (the
+    explicit-subset load_vars flow)."""
+    import re
+
+    strip = lambda n: re.sub(r"^_\d+_", "", n)  # noqa: E731
+    targets = list(program.scope) + list(program.buffers)
+    by_stripped = {}
+    for n in targets:
+        by_stripped.setdefault(strip(n), []).append(n)
+    out = {}
+    dropped = []
+    for n, v in sd.items():
+        if n in program.scope or n in program.buffers:
+            out[n] = v
+            continue
+        cands = by_stripped.get(strip(n), [])
+        if len(cands) == 1:
+            out[cands[0]] = v
+        else:
+            dropped.append(n)
+    if dropped and not partial:
+        from ..framework.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"checkpoint variables {dropped[:5]}"
+            f"{'…' if len(dropped) > 5 else ''} have no (unique) "
+            "counterpart in the target Program — was it built "
+            "differently? (load_vars with an explicit list allows "
+            "partial restores)")
+    return out
+
+
+def _load_into(dirname, main_program, filename):
+    from ..framework.paddle_import import load_reference_state_dict
+    from ..nn.layer_base import Layer
+    from ..static.graph import Program, default_main_program
+
+    sd = load_reference_state_dict(dirname, params_filename=filename)
+    target = main_program if main_program is not None \
+        else default_main_program()
+    if isinstance(target, Program):
+        target.set_state_dict(_adapt_program_names(sd, target))
+    elif isinstance(target, Layer):
+        from ..framework.paddle_import import adapt_state_dict
+
+        target.set_state_dict(adapt_state_dict(sd, target))
+    else:
+        from ..framework.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            "load target must be a static Program or a Layer")
+    return sd
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """Read a reference-format checkpoint back into the Program/Layer
+    (ref: fluid/io.py:966)."""
+    return _load_into(dirname, main_program, filename)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return _load_into(dirname, main_program, filename)
 
 
 def load_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
-    _persistables("load_vars")
+    from ..framework.paddle_import import load_reference_state_dict
+    from ..nn.layer_base import Layer
+    from ..static.graph import Program, default_main_program
+
+    sd = load_reference_state_dict(dirname, params_filename=filename)
+    if vars is not None:
+        names = [v if isinstance(v, str) else v.name for v in vars]
+        missing = [n for n in names if n not in sd]
+        if missing:
+            from ..framework.errors import NotFoundError
+
+            raise NotFoundError(
+                f"load_vars: checkpoint at {dirname!r} has no variables "
+                f"{missing}")
+        sd = {n: sd[n] for n in names}
+    if predicate is not None:
+        sd = {n: v for n, v in sd.items()
+              if predicate(_VarView(n, v))}
+    target = main_program if main_program is not None \
+        else default_main_program()
+    if isinstance(target, Program):
+        target.set_state_dict(_adapt_program_names(sd, target,
+                                                   partial=True))
+    elif isinstance(target, Layer):
+        # explicit subset: apply exact-name matches (adapt_state_dict's
+        # structural mapping needs the full set to line groups up)
+        target.set_state_dict(sd)
+    return sd

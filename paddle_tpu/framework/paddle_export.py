@@ -1,0 +1,242 @@
+"""Writer for reference-PaddlePaddle binary checkpoint formats.
+
+The inverse of :mod:`paddle_import` — emits artifacts the REFERENCE can
+read (and that round-trip through our own importer):
+
+* Tensor / LoDTensor streams (``tensor_util.cc TensorToStream``,
+  ``lod_tensor.cc:243 SerializeToStream``): ``u32 version(0)`` ·
+  ``u64 lod_level(0)`` · ``u32 version(0)`` · ``i32 desc_size`` ·
+  ``VarType.TensorDesc`` protobuf · raw bytes (row-major).
+* ``save_params``/``save_persistables`` layouts (``fluid/io.py:598``):
+  one file per variable named by the variable, or — with ``filename`` —
+  ONE stream of LoDTensors concatenated in SORTED variable-name order
+  (``fluid/io.py:344``).
+* ``save_inference_model``'s ``__model__`` (``fluid/io.py:1164``): a
+  serialized ``ProgramDesc`` (``framework.proto:198``) whose block 0
+  declares the persistable LoDTensor variables (name/dtype/shape), the
+  feed/fetch plumbing vars, and feed/fetch ops — enough for
+  ``protoc --decode`` against the reference's ``framework.proto`` and
+  for name recovery by any reader of the format (including ours).
+
+Like the importer, the protobuf wire format is emitted directly (varints
++ length-delimited fields with the framework.proto field numbers) — no
+protobuf runtime needed for the handful of messages involved.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .errors import InvalidArgumentError
+
+__all__ = ["write_lod_tensor_stream", "build_program_desc",
+           "save_reference_state", "save_reference_inference_model"]
+
+# inverse of paddle_import._DTYPES (framework.proto:105 VarType.Type)
+_DTYPE_CODES = {
+    np.dtype(np.bool_): 0, np.dtype(np.int16): 1, np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3, np.dtype(np.float16): 4,
+    np.dtype(np.float32): 5, np.dtype(np.float64): 6,
+    np.dtype(np.uint64): 19, np.dtype(np.uint8): 20, np.dtype(np.int8): 21,
+}
+_LOD_TENSOR = 7
+_FEED_MINIBATCH = 9
+_FETCH_LIST = 10
+
+
+def _dtype_code(dt: np.dtype) -> int:
+    dt = np.dtype(dt)
+    code = _DTYPE_CODES.get(dt)
+    if code is None:
+        try:
+            import ml_dtypes
+
+            if dt == np.dtype(ml_dtypes.bfloat16):
+                return 22  # BF16
+        except ImportError:
+            pass
+        raise InvalidArgumentError(
+            f"dtype {dt} has no VarType.Type code in the reference format")
+    return code
+
+
+# ---------------------------------------------------------------------------
+# protobuf wire encoding (proto2; only what the format needs)
+# ---------------------------------------------------------------------------
+def _varint(v: int) -> bytes:
+    if v < 0:  # two's complement int64/int32, sign-extended (10 bytes)
+        v += 1 << 64
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field_varint(fno: int, v: int) -> bytes:
+    return _varint(fno << 3) + _varint(v)
+
+
+def _field_bytes(fno: int, payload: bytes) -> bytes:
+    return _varint((fno << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _tensor_desc(dtype, shape) -> bytes:
+    # TensorDesc: data_type=1 (enum), dims=2 (repeated int64, unpacked —
+    # proto2 default, and what the reference's C++ emits)
+    out = _field_varint(1, _dtype_code(dtype))
+    for d in shape:
+        out += _field_varint(2, int(d))
+    return out
+
+
+def _var_type(kind: int, dtype=None, shape=None) -> bytes:
+    # VarType: type=1; lod_tensor=3 {tensor=1 TensorDesc} for LOD_TENSOR
+    out = _field_varint(1, kind)
+    if kind == _LOD_TENSOR:
+        out += _field_bytes(3, _field_bytes(1, _tensor_desc(dtype, shape)))
+    return out
+
+
+def _var_desc(name: str, kind: int, dtype=None, shape=None,
+              persistable: bool = False) -> bytes:
+    out = _field_bytes(1, name.encode())
+    out += _field_bytes(2, _var_type(kind, dtype, shape))
+    if persistable:
+        out += _field_varint(3, 1)
+    return out
+
+
+def _op_var(parameter: str, arguments: Sequence[str]) -> bytes:
+    out = _field_bytes(1, parameter.encode())
+    for a in arguments:
+        out += _field_bytes(2, a.encode())
+    return out
+
+
+def _op_attr_int(name: str, value: int) -> bytes:
+    # Attr: name=1, type=2 (INT=0), i=3
+    return (_field_bytes(1, name.encode()) + _field_varint(2, 0)
+            + _field_varint(3, value))
+
+
+def _op_desc(op_type: str, inputs, outputs, attrs=()) -> bytes:
+    out = b""
+    for param, args in inputs:
+        out += _field_bytes(1, _op_var(param, args))
+    for param, args in outputs:
+        out += _field_bytes(2, _op_var(param, args))
+    out += _field_bytes(3, op_type.encode())
+    for a in attrs:
+        out += _field_bytes(4, a)
+    return out
+
+
+def build_program_desc(var_specs: Sequence[dict],
+                       feed_names: Sequence[str] = (),
+                       fetch_names: Sequence[str] = ()) -> bytes:
+    """Serialize a ProgramDesc declaring ``var_specs``
+    (``[{"name", "shape", "dtype", "persistable"?}]``) plus the standard
+    feed/fetch plumbing (``fluid/io.py:1164 prepend_feed_ops /
+    append_fetch_ops``).  Decodes cleanly with
+    ``protoc --decode paddle.framework.proto.ProgramDesc framework.proto``.
+    """
+    # root block: idx=0, parent_idx=kNoneBlockIndex=-1 (proto_desc.h:23)
+    block = _field_varint(1, 0) + _field_varint(2, -1)
+    for spec in var_specs:
+        block += _field_bytes(3, _var_desc(
+            spec["name"], _LOD_TENSOR, spec["dtype"], spec["shape"],
+            persistable=bool(spec.get("persistable", True))))
+    ops = b""
+    if feed_names or fetch_names:
+        block += _field_bytes(3, _var_desc("feed", _FEED_MINIBATCH,
+                                           persistable=True))
+        block += _field_bytes(3, _var_desc("fetch", _FETCH_LIST,
+                                           persistable=True))
+        for i, name in enumerate(feed_names):
+            ops += _field_bytes(4, _op_desc(
+                "feed", [("X", ["feed"])], [("Out", [name])],
+                [_op_attr_int("col", i)]))
+        for i, name in enumerate(fetch_names):
+            ops += _field_bytes(4, _op_desc(
+                "fetch", [("X", [name])], [("Out", ["fetch"])],
+                [_op_attr_int("col", i)]))
+    block += ops
+    # ProgramDesc: blocks=1, version=4 {version=1}
+    return (_field_bytes(1, block)
+            + _field_bytes(4, _field_varint(1, 0)))
+
+
+# ---------------------------------------------------------------------------
+# tensor streams
+# ---------------------------------------------------------------------------
+def write_lod_tensor_stream(f, arr) -> None:
+    """One LoDTensor stream (format at module top; LoD level 0 — dense
+    padding replaces LoD in this framework)."""
+    arr = np.ascontiguousarray(np.asarray(arr))
+    f.write(struct.pack("<I", 0))           # LoDTensor version
+    f.write(struct.pack("<Q", 0))           # lod_level = 0
+    f.write(struct.pack("<I", 0))           # Tensor version
+    desc = _tensor_desc(arr.dtype, arr.shape)
+    f.write(struct.pack("<i", len(desc)))
+    f.write(desc)
+    f.write(arr.tobytes())
+
+
+def _state_specs(state: Dict[str, np.ndarray]):
+    return [{"name": n, "shape": tuple(np.shape(v)),
+             "dtype": np.asarray(v).dtype, "persistable": True}
+            for n, v in state.items()]
+
+
+def save_reference_state(state: Dict[str, np.ndarray], dirname: str,
+                         filename: Optional[str] = None,
+                         model_filename: str = "__model__",
+                         write_model: bool = True) -> None:
+    """``save_params``/``save_persistables`` layout: per-variable files,
+    or one combined file (sorted-name order) when ``filename`` is given.
+    A ``__model__`` ProgramDesc is written alongside so the directory is
+    self-describing (the reference reads names from the program; readers
+    of the combined file need it)."""
+    os.makedirs(dirname, exist_ok=True)
+    state = {n: np.asarray(v) for n, v in state.items()}
+    if write_model:
+        with open(os.path.join(dirname, model_filename), "wb") as f:
+            f.write(build_program_desc(_state_specs(state)))
+    if filename is None:
+        for name, arr in state.items():
+            if os.sep in name or (os.altsep and os.altsep in name):
+                raise InvalidArgumentError(
+                    f"variable name {name!r} is not a valid filename for "
+                    "per-variable save; pass filename= for a combined file")
+            with open(os.path.join(dirname, name), "wb") as f:
+                write_lod_tensor_stream(f, arr)
+    else:
+        with open(os.path.join(dirname, filename), "wb") as f:
+            for name in sorted(state):  # fluid/io.py:344 sorted-name order
+                write_lod_tensor_stream(f, state[name])
+
+
+def save_reference_inference_model(
+        dirname: str, feed_names: Sequence[str],
+        fetch_names: Sequence[str], state: Dict[str, np.ndarray],
+        model_filename: str = "__model__",
+        params_filename: Optional[str] = None) -> None:
+    """``save_inference_model`` layout (``fluid/io.py:1164``): ``__model__``
+    with feed/fetch plumbing + persistables, params per-variable or
+    combined (``params_filename``)."""
+    os.makedirs(dirname, exist_ok=True)
+    state = {n: np.asarray(v) for n, v in state.items()}
+    with open(os.path.join(dirname, model_filename), "wb") as f:
+        f.write(build_program_desc(_state_specs(state),
+                                   feed_names=feed_names,
+                                   fetch_names=fetch_names))
+    save_reference_state(state, dirname, filename=params_filename,
+                         write_model=False)

@@ -32,6 +32,7 @@ matching for renamed 1.x builder params, erroring on ambiguity).
 from __future__ import annotations
 
 import os
+import re
 import struct
 from typing import Dict, List, Optional, Tuple
 
@@ -225,26 +226,33 @@ def load_reference_state_dict(
         return _load_combined(os.path.join(path, params_filename),
                               os.path.join(path, model_filename))
 
-    # per-variable files: every regular file that parses as a LoDTensor
+    # per-variable files: every regular file that parses as a LoDTensor.
+    # With a __model__, iterate in PROGRAM (creation) order — structural
+    # matching in adapt_state_dict relies on it (the reference's builder
+    # names encode creation order the same way)
     out: Dict[str, np.ndarray] = {}
-    names = None
+    order = None
     model_path = os.path.join(path, model_filename)
     if os.path.exists(model_path):
         with open(model_path, "rb") as f:
-            names = {v["name"] for v in parse_program_persistables(f.read())}
-    for fname in sorted(os.listdir(path)):
+            order = [v["name"] for v in parse_program_persistables(f.read())]
+    fnames = (order if order is not None
+              else sorted(os.listdir(path)))
+    for fname in fnames:
         fpath = os.path.join(path, fname)
+        if order is not None and not os.path.isfile(fpath):
+            raise InvalidArgumentError(
+                f"__model__ lists variable {fname!r} but the file is "
+                f"missing from {path} — truncated/partial checkpoint")
         if not os.path.isfile(fpath) or fname == model_filename \
                 or fname.endswith((".pdmodel", ".py")):
-            continue
-        if names is not None and fname not in names:
             continue
         try:
             with open(fpath, "rb") as f:
                 out[fname] = read_lod_tensor_stream(f)
         except (InvalidArgumentError, struct.error, KeyError, IndexError,
                 ValueError):
-            if names is not None:  # the program said it should parse
+            if order is not None:  # the program said it should parse
                 raise
             continue  # directory stray, skip
     if not out:
@@ -256,7 +264,8 @@ def load_reference_state_dict(
 def _load_combined(params_path: str, model_path: str) -> Dict[str, np.ndarray]:
     with open(model_path, "rb") as f:
         varinfo = parse_program_persistables(f.read())
-    names = sorted(v["name"] for v in varinfo)  # fluid/io.py:344,873
+    order = [v["name"] for v in varinfo]
+    names = sorted(order)  # file layout: fluid/io.py:344,873 sorted order
     out = {}
     with open(params_path, "rb") as f:
         for name in names:
@@ -266,18 +275,44 @@ def _load_combined(params_path: str, model_path: str) -> Dict[str, np.ndarray]:
         raise InvalidArgumentError(
             "combined params file has trailing bytes — the __model__ "
             "variable list does not match the file")
-    return out
+    # expose PROGRAM (creation) order to structural matching
+    return {name: out[name] for name in order}
 
 
 # ---------------------------------------------------------------------------
 # mapping onto a paddle_tpu Layer
 # ---------------------------------------------------------------------------
+# the 1.x builder role suffixes (``conv2d_0.w_0`` …): w_0=weight/scale,
+# b_0=bias, w_1/w_2=BN moving mean/variance (fluid/layers/nn.py batch_norm
+# default names) ↔ this framework's 2.0 attribute names
+_ROLE_BY_ATTR = {"weight": "w_0", "bias": "b_0",
+                 "_mean": "w_1", "_variance": "w_2"}
+_1X_ROLE = re.compile(r"\.([wb]_\d+)$")
+
+
+def _natural_key(name: str):
+    return [int(t) if t.isdigit() else t
+            for t in re.split(r"(\d+)", name)]
+
+
 def adapt_state_dict(sd: Dict[str, np.ndarray], layer) -> Dict[str, np.ndarray]:
-    """Best-effort mapping of imported names onto ``layer.state_dict()``
-    names: exact name matches first (the 2.0 zoo's dotted names match this
-    framework's layers), then unique-shape assignment for renamed 1.x
-    builder params (conv2d_0.w_0, …).  Raises when a target has no match
-    or a shape is claimed by multiple leftover candidates."""
+    """Map imported names onto ``layer.state_dict()`` names.
+
+    1. Exact name matches (the 2.0 zoo's dotted names match this
+       framework's layers).
+    2. STRUCTURAL matching for renamed 1.x builder params
+       (``conv2d_0.w_0``, …): leftovers are grouped by
+       ``(shape, role)`` — role parsed from the 1.x suffix on the source
+       side and from the attribute name on the target side — and each
+       group is zipped in ORDER: target order is the layer's traversal
+       order, source order is the checkpoint's PROGRAM (creation) order
+       when a ``__model__`` provided it (load_reference_state_dict
+       preserves it), else natural-sorted names (``conv2d_2`` before
+       ``conv2d_10``).  Repeated same-shape params (ResNet's 3×3 stacks,
+       BERT's identical blocks) disambiguate by this order — the two
+       sides walk the same architecture.
+    3. Raises when a group's sizes differ or targets stay unmatched.
+    """
     target = layer.state_dict()
     remaining = dict(sd)
     out: Dict[str, np.ndarray] = {}
@@ -287,15 +322,74 @@ def adapt_state_dict(sd: Dict[str, np.ndarray], layer) -> Dict[str, np.ndarray]:
             out[name] = remaining.pop(name)
         else:
             unmatched.append(name)
-    for name in list(unmatched):
-        want = tuple(np.shape(target[name]))
-        cands = [k for k, v in remaining.items() if tuple(v.shape) == want]
-        if len(cands) == 1:
-            out[name] = remaining.pop(cands[0])
-            unmatched.remove(name)
+    if not unmatched:
+        return out
+
+    use_roles = all(_1X_ROLE.search(n) for n in remaining)
+
+    def src_key(name):
+        shape = tuple(remaining[name].shape)
+        if not use_roles:
+            return (shape,)
+        return (shape, _1X_ROLE.search(name).group(1))
+
+    def tgt_key(name):
+        shape = tuple(np.shape(target[name]))
+        if not use_roles:
+            return (shape,)
+        attr = name.rsplit(".", 1)[-1]
+        role = _ROLE_BY_ATTR.get(attr)
+        if role is None:
+            # unknown attribute (e.g. a custom buffer): its own bucket —
+            # matched only by an exactly-equal source role never produced
+            # by the map, so it surfaces as unmatched with a clear error
+            role = f"?{attr}"
+        return (shape, role)
+
+    src_names = list(remaining)
+    if not _is_program_ordered(sd):
+        src_names.sort(key=_natural_key)
+
+    def run_pass(skey, tkey):
+        problems = []
+        src_groups: Dict[tuple, list] = {}
+        for n in src_names:
+            if n in remaining:
+                src_groups.setdefault(skey(n), []).append(n)
+        tgt_groups: Dict[tuple, list] = {}
+        for n in unmatched:  # state_dict traversal order
+            tgt_groups.setdefault(tkey(n), []).append(n)
+        for key, tnames in tgt_groups.items():
+            snames = src_groups.get(key, [])
+            if len(snames) != len(tnames):
+                problems.append(
+                    f"{key}: {len(tnames)} targets vs {len(snames)} imports")
+                continue
+            for tn, sn in zip(tnames, snames):
+                out[tn] = remaining.pop(sn)
+                unmatched.remove(tn)
+        return problems
+
+    shape_skey = lambda n: (tuple(remaining[n].shape),)  # noqa: E731
+    shape_tkey = lambda n: (tuple(np.shape(target[n])),)  # noqa: E731
+    problems = run_pass(src_key if use_roles else shape_skey,
+                        tgt_key if use_roles else shape_tkey)
+    if unmatched and use_roles:
+        # roles that don't line up (hand-renamed checkpoints) retry on
+        # shape alone — the pre-r5 behavior, generalized to ordered groups
+        problems = run_pass(shape_skey, shape_tkey)
     if unmatched:
         raise InvalidArgumentError(
             f"could not map imported params onto {unmatched[:5]}… "
             f"({len(unmatched)} unmatched; {len(remaining)} unused imports "
-            f"{list(remaining)[:5]}…)")
+            f"{list(remaining)[:5]}…; group mismatches: {problems[:4]})")
     return out
+
+
+def _is_program_ordered(sd) -> bool:
+    """Heuristic: load_reference_state_dict preserves program order when a
+    __model__ described the checkpoint; a dict in sorted-name order was
+    more likely assembled without one (alphabetical ≠ creation order for
+    two-digit indices — conv2d_10 sorts before conv2d_2)."""
+    names = list(sd)
+    return names != sorted(names)

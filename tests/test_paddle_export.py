@@ -1,0 +1,237 @@
+"""Reference-format checkpoint WRITER (framework/paddle_export.py).
+
+Parity: the reference's binary save side — fluid/io.py:168 save_vars,
+:598 save_params/save_persistables, :1164 save_inference_model;
+tensor_util.cc TensorToStream, lod_tensor.cc:243 SerializeToStream,
+framework.proto:198 ProgramDesc.  Acceptance (VERDICT r4 missing #5):
+round-trip through our own importer bit-exact, and the ``__model__``
+ProgramDesc decodes cleanly with ``protoc --decode`` against the
+reference's framework.proto.
+"""
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid, nn
+from paddle_tpu.framework.paddle_export import (
+    build_program_desc, save_reference_inference_model,
+    save_reference_state)
+from paddle_tpu.framework.paddle_import import (
+    adapt_state_dict, load_reference_state_dict,
+    parse_program_persistables)
+
+REF_PROTO_DIR = "/root/reference/paddle/fluid/framework"
+HAVE_PROTOC = shutil.which("protoc") is not None and os.path.isdir(
+    REF_PROTO_DIR)
+
+
+def _state(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "fc_0.w_0": rng.randn(4, 3).astype(np.float32),
+        "fc_0.b_0": rng.randn(3).astype(np.float32),
+        "emb.weight": rng.randn(7, 2).astype(np.float64),
+        "step": np.asarray([12], np.int64),
+    }
+
+
+class TestRoundTrip:
+    def test_per_variable_files(self, tmp_path):
+        state = _state()
+        save_reference_state(state, str(tmp_path))
+        back = load_reference_state_dict(str(tmp_path))
+        assert set(back) == set(state)
+        for n, v in state.items():
+            np.testing.assert_array_equal(back[n], v)
+            assert back[n].dtype == v.dtype
+
+    def test_combined_file_sorted_order(self, tmp_path):
+        state = _state()
+        save_reference_state(state, str(tmp_path), filename="params")
+        back = load_reference_state_dict(str(tmp_path),
+                                         params_filename="params")
+        for n, v in state.items():
+            np.testing.assert_array_equal(back[n], v)
+
+    def test_bf16_and_bool_round_trip(self, tmp_path):
+        import ml_dtypes
+
+        state = {
+            "w_bf16": np.arange(6, dtype=np.float32).reshape(2, 3).astype(
+                ml_dtypes.bfloat16),
+            "mask": np.asarray([True, False, True]),
+        }
+        save_reference_state(state, str(tmp_path))
+        back = load_reference_state_dict(str(tmp_path))
+        np.testing.assert_array_equal(
+            back["w_bf16"].astype(np.float32),
+            state["w_bf16"].astype(np.float32))
+        assert back["w_bf16"].dtype == np.dtype(ml_dtypes.bfloat16)
+        np.testing.assert_array_equal(back["mask"], state["mask"])
+
+    def test_model_lists_persistables(self, tmp_path):
+        state = _state()
+        save_reference_state(state, str(tmp_path))
+        with open(tmp_path / "__model__", "rb") as f:
+            specs = parse_program_persistables(f.read())
+        assert {s["name"] for s in specs} == set(state)
+        by_name = {s["name"]: s for s in specs}
+        assert by_name["fc_0.w_0"]["shape"] == (4, 3)
+        assert by_name["emb.weight"]["dtype"] == np.dtype(np.float64)
+
+
+class TestInferenceModelLayout:
+    def test_feed_fetch_plumbing_and_params(self, tmp_path):
+        state = _state()
+        save_reference_inference_model(
+            str(tmp_path), ["x"], ["out"], state, params_filename="params")
+        back = load_reference_state_dict(str(tmp_path),
+                                         params_filename="params")
+        for n, v in state.items():
+            np.testing.assert_array_equal(back[n], v)
+
+    @pytest.mark.skipif(not HAVE_PROTOC, reason="protoc or proto missing")
+    def test_model_decodes_with_reference_proto(self, tmp_path):
+        state = _state()
+        save_reference_inference_model(str(tmp_path), ["img"], ["prob"],
+                                       state)
+        with open(tmp_path / "__model__", "rb") as f:
+            blob = f.read()
+        res = subprocess.run(
+            ["protoc", f"--proto_path={REF_PROTO_DIR}",
+             "--decode=paddle.framework.proto.ProgramDesc",
+             "framework.proto"],
+            input=blob, capture_output=True, timeout=60)
+        assert res.returncode == 0, res.stderr.decode()
+        text = res.stdout.decode()
+        # the decoded text names our vars, plumbing, and ops
+        for needle in ("fc_0.w_0", "emb.weight", "feed", "fetch",
+                       "persistable: true", "LOD_TENSOR", 'type: "feed"',
+                       'type: "fetch"', "parent_idx: -1"):
+            assert needle in text, f"{needle!r} missing from decode:\n{text[:800]}"
+
+
+class TestFluidIoSurface:
+    """fluid.io.save_* / load_* are the 1.x entry points over the writer."""
+
+    def _lenet_programs(self):
+        from paddle_tpu.static.graph import Program
+        import paddle_tpu.fluid as F
+
+        main, startup = Program(), Program()
+        with F.program_guard(main, startup):
+            img = F.data("img", [-1, 1, 12, 12])
+            label = F.data("label", [-1, 1], dtype="int64")
+            conv = F.layers.conv2d(img, num_filters=4, filter_size=3,
+                                   act="relu")
+            pool = F.layers.pool2d(conv, pool_size=2, pool_stride=2)
+            pred = F.layers.fc(pool, size=10, act="softmax")
+            loss = F.layers.mean(F.layers.cross_entropy(pred, label))
+            F.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup, loss, pred
+
+    def test_program_save_load_round_trip(self, tmp_path):
+        main, startup, loss, pred = self._lenet_programs()
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        img = rng.rand(4, 1, 12, 12).astype(np.float32)
+        lbl = rng.randint(0, 10, (4, 1)).astype(np.int64)
+        exe.run(main, feed={"img": img, "label": lbl}, fetch_list=[loss])
+        trained = {n: np.asarray(v) for n, v in main.scope.items()}
+
+        fluid.io.save_persistables(exe, str(tmp_path), main_program=main)
+
+        main2, startup2, loss2, pred2 = self._lenet_programs()
+        exe.run(startup2)
+        fluid.io.load_persistables(exe, str(tmp_path), main_program=main2)
+        for n, v in trained.items():
+            # same builder order → same auto names in the fresh program
+            n2 = n.replace(f"_{main.idx}_", f"_{main2.idx}_")
+            np.testing.assert_array_equal(np.asarray(main2.scope[n2]), v,
+                                          err_msg=n)
+        # and the predictions agree bit-for-bit
+        p1, = exe.run(main, feed={"img": img, "label": lbl},
+                      fetch_list=[pred], training=False)
+        p2, = exe.run(main2, feed={"img": img, "label": lbl},
+                      fetch_list=[pred2], training=False)
+        np.testing.assert_array_equal(p1, p2)
+
+    def test_layer_save_load_logits_parity(self, tmp_path):
+        """The verdict's acceptance: a trained LeNet exports in the
+        reference format and re-imports with exact logits parity."""
+        paddle.seed(0)
+        net = paddle.vision.models.LeNet()
+        x = jnp.asarray(np.random.RandomState(1).randn(
+            2, 1, 28, 28).astype(np.float32))
+        want = np.asarray(net(x))
+
+        fluid.io.save_params(None, str(tmp_path), main_program=net,
+                             filename="params")
+        paddle.seed(123)  # different init for the reload target
+        net2 = paddle.vision.models.LeNet()
+        fluid.io.load_params(None, str(tmp_path), main_program=net2,
+                             filename="params")
+        got = np.asarray(net2(x))
+        np.testing.assert_array_equal(got, want)
+
+    def test_save_vars_subset_and_predicate(self, tmp_path):
+        state = _state()
+        # predicate receives a Variable-like view (ref fluid/io.py:168)
+        fluid.io.save_vars(None, str(tmp_path), main_program=state,
+                           vars=["fc_0.w_0", "fc_0.b_0", "step"],
+                           predicate=lambda var: var.persistable
+                           and var.name.startswith("fc"))
+        back = load_reference_state_dict(str(tmp_path))
+        assert set(back) == {"fc_0.w_0", "fc_0.b_0"}
+
+    def test_missing_file_for_model_listed_var_raises(self, tmp_path):
+        state = _state()
+        save_reference_state(state, str(tmp_path))
+        os.remove(tmp_path / "fc_0.b_0")
+        with pytest.raises(Exception, match="missing"):
+            load_reference_state_dict(str(tmp_path))
+
+    def test_load_vars_missing_requested_name_raises(self, tmp_path):
+        state = _state()
+        save_reference_state(state, str(tmp_path))
+        with pytest.raises(Exception, match="no variables"):
+            fluid.io.load_vars(None, str(tmp_path), main_program=state
+                               and {}, vars=["nope.w_0"])
+
+    def test_foreign_checkpoint_into_program_raises(self, tmp_path):
+        from paddle_tpu.static.graph import Program
+        import paddle_tpu.fluid as F
+
+        save_reference_state({"alien.w_0": np.zeros((3, 3), np.float32)},
+                             str(tmp_path))
+        main, startup = Program(), Program()
+        with F.program_guard(main, startup):
+            x = F.data("x", [-1, 4])
+            F.layers.fc(x, 2)
+        with pytest.raises(Exception, match="counterpart"):
+            fluid.io.load_persistables(None, str(tmp_path),
+                                       main_program=main)
+
+    def test_load_program_state_reads_our_export(self, tmp_path):
+        from paddle_tpu import static
+
+        state = _state()
+        save_reference_state(state, str(tmp_path))
+        back = static.load_program_state(str(tmp_path))
+        for n, v in state.items():
+            np.testing.assert_array_equal(back[n], v)
+
+    def test_adapt_state_dict_reimports_layer(self, tmp_path):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        fluid.io.save_persistables(None, str(tmp_path), main_program=net)
+        sd = load_reference_state_dict(str(tmp_path))
+        mapped = adapt_state_dict(sd, net)
+        assert set(mapped) == set(net.state_dict())
