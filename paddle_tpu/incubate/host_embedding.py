@@ -19,6 +19,31 @@ Because the rows enter the jitted step as an ordinary argument, their
 gradient comes straight out of ``jax.grad`` — no table-shaped cotangent
 exists anywhere, and HBM holds only O(B·F·D) of embedding data per step.
 
+Overlap (the reference's async communicator, ``communicator.h:268``): the
+``*_async`` verbs run pull/push on ONE worker thread with a bounded FIFO
+queue, so batch ``t+1``'s gather and batch ``t``'s D2H + scatter-update
+hide under batch ``t``'s device step::
+
+    fut = table.pull_async(ids[0])
+    for t in range(T):
+        rows = fut.result()
+        if t + 1 < T:
+            fut = table.pull_async(ids[t + 1])   # overlaps device step t
+        loss, grows = jit_step(params, rows, *batch[t])  # async dispatch
+        table.push_async(ids[t], grows)          # D2H happens on the worker
+
+    table.flush()                                # barrier (checkpoint/eval)
+
+FIFO ordering means a pull enqueued AFTER a push observes it; the
+prefetch pull above is enqueued BEFORE step ``t``'s push, giving the
+one-step-stale read the reference's async PS has by design.
+
+Geo delta sync (``communicator.h:413 GeoCommunicator`` sparse path): with
+``geo=True`` every push also accumulates the applied row deltas;
+``pop_geo_deltas()`` hands them off every k steps and ``merge_deltas``
+applies a peer's — local training continues uninterrupted in between
+(fleet/geosgd.py is the dense analog).
+
 This trades the HBM limit for PCIe/host bandwidth exactly the way the
 reference trades it for NIC bandwidth to a PS — the right call when the
 table (10⁷–10⁹ rows × dim, plus 2 Adam moments) cannot fit on chip.
@@ -33,7 +58,9 @@ partitioning the reference's PS uses.
 from __future__ import annotations
 
 import os
+import queue
 import threading
+from concurrent.futures import Future
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -68,7 +95,8 @@ class HostEmbeddingTable:
                  epsilon: float = 1e-8, initializer=None,
                  dtype=np.float32, mmap_dir: Optional[str] = None,
                  vocab_range: Optional[Tuple[int, int]] = None,
-                 seed: int = 0):
+                 seed: int = 0, geo: bool = False,
+                 max_async_queue: int = 4):
         if optimizer not in _OPTS:
             raise InvalidArgumentError(
                 f"optimizer must be one of {_OPTS}, got {optimizer!r}")
@@ -110,17 +138,29 @@ class HostEmbeddingTable:
         elif optimizer == "adam":
             self._slots["moment1"] = alloc("moment1")
             self._slots["moment2"] = alloc("moment2")
+        # geo delta accumulation (GeoCommunicator sparse path):
+        # [(local_ids, deltas)] pairs, merged at exchange time
+        self.geo = bool(geo)
+        self._geo_acc: list = []
+        # async worker (started lazily on the first *_async call)
+        self._max_async_queue = int(max_async_queue)
+        self._q: Optional["queue.Queue"] = None
+        self._worker: Optional[threading.Thread] = None
+        self._worker_err: Optional[BaseException] = None
 
     # -- PS verbs ------------------------------------------------------------
     def pull(self, ids) -> np.ndarray:
         """Gather rows for ``ids`` (any shape); out-of-window ids → zeros.
-        Returns ``ids.shape + (dim,)`` float32, ready for device_put."""
+        Returns ``ids.shape + (dim,)`` float32, ready for device_put.
+        Lock-serialized against push so a concurrent async worker can
+        never expose a torn (half-updated) row."""
         ids = np.asarray(ids)
         lo, hi = self.vocab_range
         local = ids.reshape(-1) - lo
         ok = (local >= 0) & (local < hi - lo)
         out = np.zeros((local.size, self.dim), self.table.dtype)
-        out[ok] = self.table[local[ok]]
+        with self._lock:
+            out[ok] = self.table[local[ok]]
         return out.reshape(ids.shape + (self.dim,))
 
     def push(self, ids, grads, lr: Optional[float] = None) -> None:
@@ -142,6 +182,7 @@ class HostEmbeddingTable:
         with self._lock:
             self._step += 1
             w = self.table[uniq].astype(np.float32)
+            old_w = w.copy() if self.geo else None
             if self.optimizer == "sgd":
                 w -= lr * merged
             elif self.optimizer == "adagrad":
@@ -158,15 +199,138 @@ class HostEmbeddingTable:
                 vhat = v / (1 - b2 ** t)
                 w -= lr * mhat / (np.sqrt(vhat) + self.epsilon)
             self.table[uniq] = w.astype(self.table.dtype)
+            if self.geo:
+                # accumulate APPLIED deltas for the periodic geo exchange;
+                # per-push work is one append — merging happens once per
+                # exchange in pop_geo_deltas
+                self._geo_acc.append((uniq, w.astype(np.float32) - old_w))
+
+    # -- geo delta sync (GeoCommunicator sparse path, communicator.h:413) ----
+    def pop_geo_deltas(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Return-and-clear the accumulated row deltas since the last call
+        as ``(local_ids [k], deltas [k, dim])`` — what a worker SENDS every
+        k steps.  Scale by 1/n_workers before merging on peers (the
+        reference divides the send by the trainer count)."""
+        if not self.geo:
+            raise InvalidArgumentError(
+                "pop_geo_deltas needs HostEmbeddingTable(geo=True)")
+        self.flush()
+        with self._lock:
+            pairs, self._geo_acc = self._geo_acc, []
+        if not pairs:
+            return (np.zeros((0,), np.int64),
+                    np.zeros((0, self.dim), np.float32))
+        all_ids = np.concatenate([p[0] for p in pairs])
+        all_d = np.concatenate([p[1] for p in pairs])
+        uniq, inv = np.unique(all_ids, return_inverse=True)
+        deltas = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(deltas, inv, all_d)
+        lo, _ = self.vocab_range
+        return uniq.astype(np.int64) + lo, deltas
+
+    def merge_deltas(self, ids, deltas) -> None:
+        """Apply a peer's (already scaled) row deltas: ``table[ids] +=
+        deltas`` — raw addition, no optimizer state touched, exactly the
+        server-side GeoCommunicator apply."""
+        ids = np.asarray(ids).reshape(-1)
+        deltas = np.asarray(deltas, np.float32).reshape(ids.size, self.dim)
+        lo, hi = self.vocab_range
+        local = ids - lo
+        ok = (local >= 0) & (local < hi - lo)
+        local, deltas = local[ok], deltas[ok]
+        if local.size == 0:
+            return
+        uniq, inv = np.unique(local, return_inverse=True)
+        merged = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(merged, inv, deltas)
+        with self._lock:
+            self.table[uniq] = (self.table[uniq].astype(np.float32)
+                                + merged).astype(self.table.dtype)
+
+    # -- async overlap (the reference's async communicator) ------------------
+    def _ensure_worker(self):
+        with self._lock:
+            if self._worker is not None:
+                return
+            q = queue.Queue(maxsize=self._max_async_queue)
+
+            def loop():
+                while True:
+                    item = q.get()
+                    try:
+                        if item is None:
+                            return
+                        kind, args, fut = item
+                        try:
+                            if kind == "pull":
+                                fut.set_result(self.pull(args[0]))
+                            else:  # push
+                                ids, grads, lr = args
+                                # np.asarray here: a jax.Array grad blocks
+                                # on D2H on THIS thread, not the train loop
+                                self.push(ids, np.asarray(grads), lr=lr)
+                        except BaseException as e:
+                            if fut is not None:
+                                fut.set_exception(e)  # owner handles it
+                            else:  # surface on the next table call
+                                self._worker_err = e
+                    finally:
+                        q.task_done()
+
+            self._q = q
+            self._worker = threading.Thread(
+                target=loop, name="host-embedding-io", daemon=True)
+            self._worker.start()
+
+    def _check_worker(self):
+        if self._worker_err is not None:
+            e, self._worker_err = self._worker_err, None
+            raise e
+
+    def pull_async(self, ids) -> Future:
+        """Enqueue a row gather on the worker thread; returns a Future of
+        the ``[*, dim]`` array.  Enqueue batch t+1's pull before batch t's
+        push to overlap it with the device step (one-step-stale reads,
+        the async-PS semantics); enqueue it after for strict ordering."""
+        self._check_worker()
+        self._ensure_worker()
+        fut: Future = Future()
+        self._q.put(("pull", (np.asarray(ids),), fut))
+        return fut
+
+    def push_async(self, ids, grads, lr: Optional[float] = None) -> None:
+        """Enqueue a row update.  ``grads`` may be a device array — the
+        device→host read happens on the worker.  The bounded queue
+        applies backpressure so a slow host can never fall unboundedly
+        behind the device."""
+        self._check_worker()
+        self._ensure_worker()
+        self._q.put(("push", (np.asarray(ids), grads, lr), None))
+
+    def flush(self) -> None:
+        """Barrier: wait until every enqueued pull/push has completed
+        (checkpointing, eval, geo hand-off)."""
+        if self._worker is None:
+            return
+        self._q.join()
+        self._check_worker()
+
+    def close(self) -> None:
+        if self._worker is not None:
+            self._q.put(None)
+            self._worker.join()
+            self._worker, self._q = None, None
 
     # -- checkpoint ----------------------------------------------------------
     def state_dict(self) -> Dict[str, np.ndarray]:
+        self.flush()  # in-flight async pushes must land in the snapshot
         d = {"table": np.asarray(self.table), "step": np.asarray(self._step)}
         for k, v in self._slots.items():
             d[k] = np.asarray(v)
         return d
 
     def set_state_dict(self, state: Dict[str, np.ndarray]) -> None:
+        self.flush()
         self.table[...] = state["table"]
         self._step = int(state.get("step", 0))
         for k in self._slots:
