@@ -123,13 +123,20 @@ class HostEmbeddingTable:
 
         self.table = alloc("table")
         if initializer is None:
-            # chunked init keeps peak temp memory bounded for huge tables
-            rng = np.random.default_rng(seed)
+            # chunked init keeps peak temp memory bounded for huge tables.
+            # Chunks are GLOBAL-index aligned and seeded per chunk, so a
+            # vocab_range shard reproduces exactly its slice of the
+            # virtual full table — the multi-host bootstrap contract (all
+            # PS shards must agree on the same global init)
             chunk = max(1, (1 << 22) // max(self.dim, 1))
-            for s in range(0, n_local, chunk):
-                e = min(s + chunk, n_local)
-                self.table[s:e] = rng.normal(
-                    0.0, 0.01, (e - s, self.dim)).astype(dtype)
+            gs = (lo // chunk) * chunk
+            while gs < hi:
+                ge = min(gs + chunk, self.num_embeddings)
+                rng = np.random.default_rng([seed, gs])
+                vals = rng.normal(0.0, 0.01, (ge - gs, self.dim))
+                s, e = max(gs, lo), min(ge, hi)
+                self.table[s - lo:e - lo] = vals[s - gs:e - gs].astype(dtype)
+                gs = ge
         else:
             initializer(self.table)
         self._slots: Dict[str, np.ndarray] = {}
@@ -163,21 +170,30 @@ class HostEmbeddingTable:
             out[ok] = self.table[local[ok]]
         return out.reshape(ids.shape + (self.dim,))
 
+    def _merge_local(self, ids, vals) -> Tuple[np.ndarray, np.ndarray]:
+        """Window-filter global ids to local rows and merge duplicates by
+        summation (the reference MergeAdd) → (uniq_local_ids, merged)."""
+        ids = np.asarray(ids).reshape(-1)
+        vals = np.asarray(vals, np.float32).reshape(ids.size, self.dim)
+        lo, hi = self.vocab_range
+        local = ids.astype(np.int64) - lo
+        ok = (local >= 0) & (local < hi - lo)
+        local, vals = local[ok], vals[ok]
+        if local.size == 0:
+            return (np.zeros((0,), np.int64),
+                    np.zeros((0, self.dim), np.float32))
+        uniq, inv = np.unique(local, return_inverse=True)
+        merged = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(merged, inv, vals)
+        return uniq, merged
+
     def push(self, ids, grads, lr: Optional[float] = None) -> None:
         """Apply one lazy optimizer step on the rows named by ``ids`` with
         per-position ``grads`` (shape ``ids.shape + (dim,)``).  Duplicate
         ids are merged by summation first (the reference MergeAdd)."""
-        ids = np.asarray(ids).reshape(-1)
-        g = np.asarray(grads, dtype=np.float32).reshape(ids.size, self.dim)
-        lo, hi = self.vocab_range
-        local = ids - lo
-        ok = (local >= 0) & (local < hi - lo)
-        local, g = local[ok], g[ok]
-        if local.size == 0:
+        uniq, merged = self._merge_local(ids, grads)
+        if uniq.size == 0:
             return
-        uniq, inv = np.unique(local, return_inverse=True)
-        merged = np.zeros((uniq.size, self.dim), np.float32)
-        np.add.at(merged, inv, g)
         lr = self.lr if lr is None else float(lr)
         with self._lock:
             self._step += 1
@@ -200,10 +216,11 @@ class HostEmbeddingTable:
                 w -= lr * mhat / (np.sqrt(vhat) + self.epsilon)
             self.table[uniq] = w.astype(self.table.dtype)
             if self.geo:
-                # accumulate APPLIED deltas for the periodic geo exchange;
-                # per-push work is one append — merging happens once per
-                # exchange in pop_geo_deltas
-                self._geo_acc.append((uniq, w.astype(np.float32) - old_w))
+                # accumulate the deltas ACTUALLY APPLIED (post table-dtype
+                # rounding — fp16 tables must exchange the rounded delta or
+                # replicas drift); one append per push, merged at exchange
+                applied = self.table[uniq].astype(np.float32) - old_w
+                self._geo_acc.append((uniq, applied))
 
     # -- geo delta sync (GeoCommunicator sparse path, communicator.h:413) ----
     def pop_geo_deltas(self) -> Tuple[np.ndarray, np.ndarray]:
@@ -220,29 +237,19 @@ class HostEmbeddingTable:
         if not pairs:
             return (np.zeros((0,), np.int64),
                     np.zeros((0, self.dim), np.float32))
-        all_ids = np.concatenate([p[0] for p in pairs])
-        all_d = np.concatenate([p[1] for p in pairs])
-        uniq, inv = np.unique(all_ids, return_inverse=True)
-        deltas = np.zeros((uniq.size, self.dim), np.float32)
-        np.add.at(deltas, inv, all_d)
         lo, _ = self.vocab_range
-        return uniq.astype(np.int64) + lo, deltas
+        uniq, deltas = self._merge_local(
+            np.concatenate([p[0] for p in pairs]) + lo,
+            np.concatenate([p[1] for p in pairs]))
+        return uniq + lo, deltas
 
     def merge_deltas(self, ids, deltas) -> None:
         """Apply a peer's (already scaled) row deltas: ``table[ids] +=
         deltas`` — raw addition, no optimizer state touched, exactly the
         server-side GeoCommunicator apply."""
-        ids = np.asarray(ids).reshape(-1)
-        deltas = np.asarray(deltas, np.float32).reshape(ids.size, self.dim)
-        lo, hi = self.vocab_range
-        local = ids - lo
-        ok = (local >= 0) & (local < hi - lo)
-        local, deltas = local[ok], deltas[ok]
-        if local.size == 0:
+        uniq, merged = self._merge_local(ids, deltas)
+        if uniq.size == 0:
             return
-        uniq, inv = np.unique(local, return_inverse=True)
-        merged = np.zeros((uniq.size, self.dim), np.float32)
-        np.add.at(merged, inv, deltas)
         with self._lock:
             self.table[uniq] = (self.table[uniq].astype(np.float32)
                                 + merged).astype(self.table.dtype)
@@ -324,9 +331,13 @@ class HostEmbeddingTable:
     # -- checkpoint ----------------------------------------------------------
     def state_dict(self) -> Dict[str, np.ndarray]:
         self.flush()  # in-flight async pushes must land in the snapshot
-        d = {"table": np.asarray(self.table), "step": np.asarray(self._step)}
-        for k, v in self._slots.items():
-            d[k] = np.asarray(v)
+        with self._lock:
+            # true copies, not views: a checkpointer serializing this dict
+            # must not see pushes issued after the call
+            d = {"table": np.array(self.table),
+                 "step": np.asarray(self._step)}
+            for k, v in self._slots.items():
+                d[k] = np.array(v)
         return d
 
     def set_state_dict(self, state: Dict[str, np.ndarray]) -> None:
