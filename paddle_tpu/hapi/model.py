@@ -622,6 +622,14 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        """Train over ``train_data`` (ref: hapi/model.py Model.fit).
+
+        ``drop_last``: drop a final batch smaller than ``batch_size``.
+        Under a distributed plan an uneven final batch cannot split across
+        the data shards, so it is dropped regardless — pass
+        ``drop_last=True`` (or size the dataset to a multiple of
+        ``batch_size``) to acknowledge this and silence the warning.
+        """
         train_loader = self._as_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
         eval_loader = self._as_loader(eval_data, batch_size, False, False,
