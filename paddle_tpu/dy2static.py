@@ -37,6 +37,13 @@ test_loop.py):
   reference's ubiquitous ``mean(x).numpy()[0] > 5`` idiom compiles;
 * ternary expressions (``a if cond else b``) with tensor conditions.
 
+Calls into OTHER functions recursively transform (the reference's
+``convert_call``, convert_call_func.py): every call site in transformed
+code routes through :func:`conv_call`, which lazily converts plain
+user functions and bound methods on first use (cached; library/builtin
+callables pass through untouched) — so helpers with data-dependent
+control flow compile without decorating each one.
+
 What it deliberately does NOT cover, with the actionable error kept
 (the round-4 contract):
 
@@ -44,8 +51,6 @@ What it deliberately does NOT cover, with the actionable error kept
   branch or loop body — the construct is left untransformed and the
   tensor condition raises the InvalidArgumentError naming the rewrite
   (assign a flag, return after);
-* calls into OTHER functions containing data-dependent control flow
-  (the reference's convert_call recursion): decorate the callee too;
 * ``global``/``nonlocal`` in transformed scopes.
 
 Entry point: :func:`convert_to_static` (used by paddle.jit.to_static) —
@@ -139,6 +144,60 @@ def numpy_(x):
     if hasattr(x, "numpy"):
         return x.numpy()
     return np.asarray(x)
+
+
+#: modules whose functions are already traceable — converting them would
+#: only add parse overhead and risk (the reference's convert_call keeps a
+#: similar ignore list, dygraph_to_static/convert_call_func.py); covers
+#: the baked-in ML ecosystem plus stdlib staples
+_NO_CONVERT_PREFIXES = (
+    "jax", "jaxlib", "numpy", "paddle_tpu", "math", "functools",
+    "itertools", "builtins", "operator", "flax", "optax", "orbax", "chex",
+    "haiku", "einops", "torch", "transformers", "accelerate", "scipy",
+    "ml_dtypes", "re", "os", "json", "typing", "collections", "threading",
+    "contextlib", "dataclasses", "copy", "pickle", "warnings", "logging")
+
+_swap_lock = threading.Lock()
+
+
+def conv_call(fn):
+    """The reference's ``convert_call``: lazily transform a called
+    function so nested data-dependent control flow compiles without
+    decorating every helper.  Non-function callables (classes, builtins,
+    library functions) pass through untouched; closures decline (their
+    cells must stay LIVE — a rebuilt function would freeze them) and run
+    natively, surfacing the actionable error if they contain tensor
+    control flow; results are cached."""
+    import types
+
+    if isinstance(fn, types.MethodType):
+        conv = conv_call(fn.__func__)
+        return (fn if conv is fn.__func__
+                else types.MethodType(conv, fn.__self__))
+    if not isinstance(fn, types.FunctionType):
+        fwd = getattr(type(fn), "forward", None)
+        if fwd is not None and callable(fn) and hasattr(fn, "__dict__"):
+            # a Layer (or layer-like callable): transform its forward and
+            # install it ON THE INSTANCE once — __call__ keeps pre/post
+            # hooks live, and the converted forward is exact-semantics
+            # eagerly too, so the permanent install is behavior-preserving
+            conv = conv_call(fwd)
+            if conv is fwd:
+                return fn
+            with _swap_lock:
+                if fn.__dict__.get("__d2s_conv__") is not conv:
+                    fn.__dict__["forward"] = types.MethodType(conv, fn)
+                    fn.__dict__["__d2s_conv__"] = conv
+            return fn
+        return fn
+    if fn.__code__.co_freevars:
+        # closure helper: converting would snapshot cell contents and
+        # silently detach it from later nonlocal mutations — run natively
+        return fn
+    mod = getattr(fn, "__module__", "") or ""
+    if mod.split(".")[0] in _NO_CONVERT_PREFIXES:
+        return fn
+    return convert_to_static(fn)
 
 
 def bool_and(*fs):
@@ -843,7 +902,17 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
         return ast.Return(value=_const_tuple(
             [ast.Name(id=slots[p], ctx=ast.Load()) for p in paths]))
 
-    # -- .numpy() ------------------------------------------------------------
+    #: builtins whose call sites must stay syntactically bare — the
+    #: For-range detection matches on `range(...)`, and conv_call would
+    #: no-op them anyway
+    _BARE_CALLS = frozenset({
+        "range", "len", "print", "super", "isinstance", "issubclass",
+        "enumerate", "zip", "map", "filter", "float", "int", "bool",
+        "str", "type", "getattr", "setattr", "hasattr", "list", "tuple",
+        "dict", "set", "min", "max", "abs", "sum", "sorted", "repr",
+        "id", "iter", "next", "vars", "dir", "locals", "globals"})
+
+    # -- calls: .numpy() rewrite + convert_call recursion --------------------
     def visit_Call(self, node):
         node = self.generic_visit(node)
         if (isinstance(node.func, ast.Attribute)
@@ -852,6 +921,16 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
             self.changed = True
             return ast.copy_location(
                 _rt_call("numpy_", [node.func.value]), node)
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in self._BARE_CALLS:
+            return node
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == _RT:
+            return node  # our own runtime helpers
+        # route through conv_call (program_translator's convert_call):
+        # helpers with data-dependent control flow transform lazily
+        node.func = ast.copy_location(_rt_call("conv_call", [f]), f)
+        self.changed = True
         return node
 
     # -- ternary -------------------------------------------------------------
@@ -1131,6 +1210,7 @@ class _RuntimeNS:
     Undefined = Undefined
     UNDEF = UNDEF
     is_undef = staticmethod(_is_undef)
+    conv_call = staticmethod(conv_call)
     run_if = staticmethod(run_if)
     run_while = staticmethod(run_while)
     run_for_range = staticmethod(run_for_range)

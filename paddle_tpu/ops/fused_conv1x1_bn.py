@@ -1,0 +1,160 @@
+"""Fused 1x1-conv (GEMM) + BatchNorm-statistics Pallas kernel.
+
+ResNet-50's 1x1 convolutions carry ~55% of its FLOPs and are
+HBM-bandwidth-bound on v5e (tools/resnet_mfu_analysis.md: arithmetic
+intensity 32-128 flop/byte vs the chip's ~243 balance point), so the win
+is not more FLOP/s but FEWER passes over the activation tensor.  Train-
+mode BatchNorm needs the batch mean/var of the conv OUTPUT, which XLA
+computes as a separate reduction pass over Y after the conv custom call:
+
+    XLA:    Y = conv(x, w)      write Y          (pass 1)
+            mean/var over Y     read Y           (pass 2)
+            normalize+relu      read+write Y     (pass 3)
+
+This kernel folds the statistics into the GEMM epilogue — per-channel
+sum and sum-of-squares accumulate in VMEM scratch while the matmul tiles
+stream through the MXU, finalized on the last M-step of the sequential
+TPU grid:
+
+    here:   Y, Σ, Σ² = conv1x1_bn_stats(x, w)    write Y  (pass 1)
+            normalize+relu      read+write Y     (pass 2)
+
+i.e. one full read of Y removed (~25-33% of the tensor traffic on these
+bandwidth-bound layers).  The normalize pass stays in XLA where it fuses
+with the residual add and ReLU for free.
+
+Reference capability matched: the fused_ops family
+(paddle/fluid/operators/fused/conv_fusion_op.cc — cuDNN conv+bias+act
+fusion); the TPU-native answer fuses what the TPU is short on (HBM
+passes), not what cuDNN is short on (kernel launches).
+
+Layout: NHWC.  A 1x1/s1 conv is exactly ``X[M=N*H*W, K=Cin] @ W[K, N=Cout]``.
+Grid: (N-blocks, M-blocks) with M minor — the TPU grid is sequential, so
+the VMEM stats scratch accumulates across the M sweep of each N column
+and flushes once per N-block.  K is kept whole (ResNet's Cin ≤ 2048
+easily fits VMEM at bf16).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..framework.errors import InvalidArgumentError
+
+__all__ = ["conv1x1_bn_stats", "conv1x1_bn_relu"]
+
+
+def _kernel(x_ref, w_ref, y_ref, sum_ref, sq_ref, acc_s, acc_q):
+    mi = pl.program_id(1)
+    m_steps = pl.num_programs(1)
+
+    x = x_ref[...]
+    w = w_ref[...]
+    y = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+
+    @pl.when(mi == 0)
+    def _init():
+        acc_s[...] = jnp.zeros_like(acc_s)
+        acc_q[...] = jnp.zeros_like(acc_q)
+
+    # per-channel stats ride VMEM scratch across the sequential M sweep
+    acc_s[...] += jnp.sum(y, axis=0, keepdims=True)
+    acc_q[...] += jnp.sum(y * y, axis=0, keepdims=True)
+
+    @pl.when(mi == m_steps - 1)
+    def _flush():
+        sum_ref[...] = acc_s[...]
+        sq_ref[...] = acc_q[...]
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "block_n"))
+def conv1x1_bn_stats(x, w, *, block_m: int = 512, block_n: int = 256):
+    """``Y = X @ W`` plus per-output-channel ``(Σy, Σy²)`` in ONE pass.
+
+    x: ``[M, Cin]`` (flattened NHWC activations), w: ``[Cin, Cout]``.
+    Returns ``(y [M, Cout], sum [Cout] f32, sumsq [Cout] f32)``.
+    M and Cout are padded to block multiples internally (padding rows
+    contribute zeros to the stats — exact).
+    """
+    M, K = x.shape
+    K2, N = w.shape
+    if K != K2:
+        raise InvalidArgumentError(f"shape mismatch {x.shape} @ {w.shape}")
+    bm = min(block_m, max(M, 8))
+    bn = min(block_n, max(N, 128))
+    Mp = -(-M // bm) * bm
+    Np = -(-N // bn) * bn
+    xp = x if Mp == M else jnp.pad(x, ((0, Mp - M), (0, 0)))
+    wp = w if Np == N else jnp.pad(w, ((0, 0), (0, Np - N)))
+
+    interpret = jax.default_backend() != "tpu"  # CPU tests: interpret mode
+    y, s, q = pl.pallas_call(
+        _kernel,
+        interpret=interpret,
+        grid=(Np // bn, Mp // bm),  # M minor: sequential stats sweep
+        in_specs=[
+            pl.BlockSpec((bm, K), lambda n, m: (m, 0)),
+            pl.BlockSpec((K, bn), lambda n, m: (0, n)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, bn), lambda n, m: (m, n)),
+            pl.BlockSpec((1, bn), lambda n, m: (0, n)),
+            pl.BlockSpec((1, bn), lambda n, m: (0, n)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Mp, Np), x.dtype),
+            jax.ShapeDtypeStruct((1, Np), jnp.float32),
+            jax.ShapeDtypeStruct((1, Np), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, bn), jnp.float32),
+            pltpu.VMEM((1, bn), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+    )(xp, wp)
+    return y[:M, :N], s[0, :N], q[0, :N]
+
+
+def conv1x1_bn_relu(x, w, gamma, beta, *, epsilon: float = 1e-5,
+                    residual=None, momentum: float = 0.9,
+                    running_mean=None, running_var=None,
+                    block_m: int = 512, block_n: int = 256):
+    """Train-mode ``relu(BN(X @ W) [+ residual])`` in two passes instead of
+    XLA's three (see module doc).  x ``[M, Cin]`` NHWC-flattened.
+
+    Returns ``(out [M, Cout], new_running_mean, new_running_var)`` with
+    paddle's momentum convention (``new = momentum*old + (1-m)*batch``);
+    running stats pass through unchanged when not provided.
+    """
+    M = x.shape[0]
+    y, s, q = conv1x1_bn_stats(x, w, block_m=block_m, block_n=block_n)
+    mean = s / M
+    var = jnp.maximum(q / M - mean * mean, 0.0)
+    inv = jax.lax.rsqrt(var + epsilon)
+    scale = (gamma.astype(jnp.float32) * inv).astype(y.dtype)
+    shift = (beta.astype(jnp.float32)
+             - mean * gamma.astype(jnp.float32) * inv).astype(y.dtype)
+    out = y * scale + shift
+    if residual is not None:
+        out = out + residual.astype(out.dtype)
+    out = jax.nn.relu(out)
+    if (running_mean is None) != (running_var is None):
+        raise InvalidArgumentError(
+            "conv1x1_bn_relu: pass running_mean and running_var together "
+            "(or neither)")
+    if running_mean is not None:
+        n = jnp.asarray(M, jnp.float32)
+        unbiased = var * n / jnp.maximum(n - 1, 1)
+        running_mean = (momentum * running_mean.astype(jnp.float32)
+                        + (1 - momentum) * mean)
+        running_var = (momentum * running_var.astype(jnp.float32)
+                       + (1 - momentum) * unbiased)
+    return out, running_mean, running_var

@@ -43,7 +43,7 @@ SLOW_FILES = {
     "test_extension_ops.py", "test_distributed.py", "test_heartbeat.py",
     "test_nn_functional.py", "test_nn_layers.py", "test_fluid_compat.py",
     "test_crf.py", "test_slim.py", "test_sparse_embedding.py",
-    "test_multiprocess_dp.py",
+    "test_multiprocess_dp.py", "test_multiprocess_hybrid.py",
 }
 
 

@@ -404,6 +404,19 @@ class TestForRange:
         np.testing.assert_allclose(np.asarray(out), 0.0)
 
 
+def _helper_with_branch(x):
+    # an UNDECORATED helper with data-dependent control flow
+    if x.mean() > 0:
+        y = x - 1
+    else:
+        y = x + 1
+    return y
+
+
+def calls_helper(x):
+    return _helper_with_branch(x).sum()
+
+
 def _double(fn):
     import functools
 
@@ -564,6 +577,91 @@ class TestToStaticIntegration:
         out = jax.jit(conv)(jnp.ones((2,)))
         # the @_double decorator must still apply on top of the transform
         np.testing.assert_allclose(np.asarray(out), 4.0)
+
+    def test_undecorated_callee_transforms_via_conv_call(self):
+        # program_translator's convert_call: helpers reached FROM the
+        # decorated function transform lazily, no decoration needed
+        out = jax.jit(convert_to_static(calls_helper))(jnp.ones((3,)) * 4)
+        np.testing.assert_allclose(np.asarray(out), 3.0 * 3)  # 4-1 per elt
+        out = jax.jit(convert_to_static(calls_helper))(jnp.ones((3,)) * -4)
+        np.testing.assert_allclose(np.asarray(out), -3.0 * 3)
+
+    def test_sublayer_with_control_flow_transforms(self):
+        class Gate(nn.Layer):
+            def forward(self, x):
+                if x.mean() > 0:
+                    return x * 2
+                return x * -1
+
+        class Outer(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(3, 3)
+                self.gate = Gate()
+
+            def forward(self, x):
+                return self.gate(self.fc(x)).sum()
+
+        # note: Gate.forward has RETURN inside the tensor-if — declined by
+        # the pass, actionable error expected
+        paddle.seed(0)
+        net = Outer()
+        with pytest.raises(InvalidArgumentError, match="return"):
+            paddle.jit.to_static(net)(jnp.ones((2, 3)))
+
+        class Gate2(nn.Layer):
+            def forward(self, x):
+                if x.mean() > 0:
+                    y = x * 2
+                else:
+                    y = x * -1
+                return y
+
+        class Outer2(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(3, 3)
+                self.gate = Gate2()
+
+            def forward(self, x):
+                return self.gate(self.fc(x)).sum()
+
+        paddle.seed(0)
+        net2 = Outer2()
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 3),
+                        jnp.float32)
+        eager = float(np.asarray(net2(x)))
+        static = float(np.asarray(paddle.jit.to_static(net2)(x)))
+        assert abs(eager - static) < 1e-5
+
+    def test_closure_helpers_keep_live_cells(self):
+        # conv_call must NOT convert closure helpers: a rebuilt function
+        # would freeze the cell contents and detach it from later
+        # nonlocal mutations (e.g. a schedule-updated lr)
+        from paddle_tpu.dy2static import conv_call
+
+        k = {"v": 1.0}
+        scale = 1.0
+
+        def make():
+            nonlocal scale
+
+            def helper(x):
+                return x * scale
+
+            return helper
+
+        helper = make()
+
+        def outer(x):
+            return helper(x).sum()
+
+        conv = convert_to_static(outer)
+        assert conv_call(helper) is helper  # closure: runs natively
+        got1 = float(np.asarray(conv(jnp.ones((2,)))))
+        scale = 10.0
+        got2 = float(np.asarray(conv(jnp.ones((2,)))))
+        assert got1 == 2.0 and got2 == 20.0, (got1, got2)
 
     def test_set_code_level_prints(self, capsys):
         def g(x):

@@ -1,0 +1,99 @@
+"""Fused 1x1-conv + BN-stats Pallas kernel (ops/fused_conv1x1_bn.py).
+
+Numerics vs the unfused XLA reference on the CPU interpreter-backed
+pallas path; the performance question (does removing one pass over Y pay
+on the bandwidth-bound 1x1 layers?) is answered on the real chip by
+tools/resnet_epilogue_probe.py.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.fused_conv1x1_bn import (conv1x1_bn_relu,
+                                             conv1x1_bn_stats)
+
+
+def _ref_stats(x, w):
+    y = x.astype(np.float32) @ w.astype(np.float32)
+    return y, y.sum(0), (y * y).sum(0)
+
+
+class TestConv1x1BnStats:
+    @pytest.mark.parametrize("M,K,N", [(512, 256, 64), (1000, 64, 256),
+                                       (256, 2048, 512), (77, 128, 100)])
+    def test_matches_reference(self, M, K, N):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(M, K).astype(np.float32))
+        w = jnp.asarray(rng.randn(K, N).astype(np.float32))
+        y, s, q = conv1x1_bn_stats(x, w)
+        ry, rs, rq = _ref_stats(np.asarray(x), np.asarray(w))
+        # f32 accumulation-order differences grow with K (the dot and the
+        # scratch accumulate in different orders than numpy)
+        np.testing.assert_allclose(np.asarray(y), ry, rtol=1e-5,
+                                   atol=1e-3 * np.sqrt(K / 64))
+        np.testing.assert_allclose(np.asarray(s), rs, rtol=1e-5,
+                                   atol=0.05 * np.sqrt(M * K / 1e4))
+        np.testing.assert_allclose(np.asarray(q), rq, rtol=1e-5,
+                                   atol=1.0 * M * K / 1e4)
+
+    def test_bf16_inputs_f32_stats(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(384, 128), jnp.bfloat16)
+        w = jnp.asarray(rng.randn(128, 256), jnp.bfloat16)
+        y, s, q = conv1x1_bn_stats(x, w)
+        assert y.dtype == jnp.bfloat16
+        assert s.dtype == jnp.float32 and q.dtype == jnp.float32
+        ry = np.asarray(x, np.float32) @ np.asarray(w, np.float32)
+        np.testing.assert_allclose(np.asarray(y, np.float32), ry,
+                                   rtol=2e-2, atol=2e-1)
+        # stats accumulate the bf16-rounded MXU output in f32
+        np.testing.assert_allclose(np.asarray(s),
+                                   np.asarray(y, np.float32).sum(0),
+                                   rtol=1e-3, atol=2.0)
+
+
+class TestConv1x1BnRelu:
+    def test_matches_unfused_train_bn(self):
+        rng = np.random.RandomState(2)
+        M, K, N = 512, 64, 128
+        x = jnp.asarray(rng.randn(M, K).astype(np.float32))
+        w = jnp.asarray(rng.randn(K, N).astype(np.float32))
+        gamma = jnp.asarray(rng.rand(N).astype(np.float32) + 0.5)
+        beta = jnp.asarray(rng.randn(N).astype(np.float32))
+        res = jnp.asarray(rng.randn(M, N).astype(np.float32))
+        rm = jnp.zeros((N,), jnp.float32)
+        rv = jnp.ones((N,), jnp.float32)
+
+        out, nrm, nrv = conv1x1_bn_relu(x, w, gamma, beta, residual=res,
+                                        running_mean=rm, running_var=rv)
+
+        y = np.asarray(x) @ np.asarray(w)
+        mean, var = y.mean(0), y.var(0)
+        want = (np.asarray(gamma) * (y - mean) / np.sqrt(var + 1e-5)
+                + np.asarray(beta)) + np.asarray(res)
+        want = np.maximum(want, 0.0)
+        np.testing.assert_allclose(np.asarray(out), want,
+                                   rtol=1e-4, atol=1e-4)
+        unbiased = var * M / (M - 1)
+        np.testing.assert_allclose(np.asarray(nrm), 0.1 * mean, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(nrv),
+                                   0.9 * 1.0 + 0.1 * unbiased, rtol=1e-4)
+
+    def test_padding_rows_do_not_skew_stats(self):
+        # M=77 pads to a block multiple; padded zero rows must not enter
+        # mean/var (they contribute zero to Σ and Σ² and M uses the true
+        # row count)
+        rng = np.random.RandomState(3)
+        M, K, N = 77, 32, 48
+        x = jnp.asarray(rng.randn(M, K).astype(np.float32))
+        w = jnp.asarray(rng.randn(K, N).astype(np.float32))
+        g = jnp.ones((N,), jnp.float32)
+        b = jnp.zeros((N,), jnp.float32)
+        out, _, _ = conv1x1_bn_relu(x, w, g, b)
+        y = np.asarray(x) @ np.asarray(w)
+        want = np.maximum((y - y.mean(0)) / np.sqrt(y.var(0) + 1e-5), 0.0)
+        np.testing.assert_allclose(np.asarray(out), want,
+                                   rtol=1e-4, atol=1e-4)

@@ -1,0 +1,117 @@
+"""Does fusing BN statistics into the 1x1-conv GEMM pay on the chip?
+
+tools/resnet_mfu_analysis.md (round 4) named Pallas BN/ReLU-epilogue
+fusion as the bandwidth-side attack on ResNet-50's 1x1 layers.  This
+probe measures it directly at the bottleneck shapes, train-mode BN:
+
+  xla    conv1x1 -> batch mean/var -> normalize+relu   (XLA, 3 passes)
+  fused  conv1x1_bn_stats kernel   -> normalize+relu   (2 passes)
+  conv   bare conv1x1                                  (lower bound)
+
+Run:  python tools/resnet_epilogue_probe.py        (ambient TPU)
+One JSON line per (shape, variant); a closing line with the verdict.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+B = 128
+# ResNet-50 bottleneck 1x1s (NHWC): (H=W, Cin, Cout)
+SHAPES = [
+    (56, 256, 64),
+    (56, 64, 256),
+    (28, 512, 128),
+    (28, 128, 512),
+    (14, 1024, 256),
+    (14, 256, 1024),
+    (7, 2048, 512),
+    (7, 512, 2048),
+]
+
+
+def timed_chain(fn, x0, iters, *consts):
+    import jax
+    from jax import lax
+
+    @jax.jit
+    def chain(x, *consts):
+        def body(x, _):
+            return fn(x, *consts), None
+
+        out, _ = lax.scan(body, x, None, length=iters)
+        return out
+
+    out = chain(x0, *consts)
+    float(np.asarray(jax.tree_util.tree_leaves(out)[0].reshape(-1)[0]))
+    t0 = time.perf_counter()
+    out = chain(x0, *consts)
+    float(np.asarray(jax.tree_util.tree_leaves(out)[0].reshape(-1)[0]))
+    return time.perf_counter() - t0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.fused_conv1x1_bn import conv1x1_bn_relu
+
+    print(json.dumps({"devices": [str(d) for d in jax.devices()],
+                      "batch": B}), flush=True)
+    key = jax.random.PRNGKey(0)
+    iters = 100
+    totals = {"xla": 0.0, "fused": 0.0, "conv": 0.0}
+    for hw, cin, cout in SHAPES:
+        M = B * hw * hw
+        x = jax.random.normal(key, (M, cin), jnp.bfloat16)
+        w = jax.random.normal(key, (cin, cout), jnp.bfloat16) * 0.05
+        g = jnp.ones((cout,), jnp.float32)
+        bt = jnp.zeros((cout,), jnp.float32)
+        # carry-shape projector back to [M, cin]
+        p = jax.random.normal(key, (cout, cin), jnp.bfloat16) * 0.05
+
+        def xla_path(xx, w, g, bt, p):
+            y = (xx @ w).astype(jnp.float32)
+            mean = y.mean(0)
+            var = y.var(0)
+            out = jax.nn.relu((y - mean) * jax.lax.rsqrt(var + 1e-5)
+                              * g + bt).astype(jnp.bfloat16)
+            return out @ p
+
+        def fused_path(xx, w, g, bt, p):
+            out, _, _ = conv1x1_bn_relu(xx, w, g, bt)
+            return out @ p
+
+        def conv_path(xx, w, p):
+            return ((xx @ w) @ p).astype(jnp.bfloat16)
+
+        gflop = 2 * M * cin * cout * 2 * iters / 1e9  # incl. projector
+        for name, fn, consts in (
+                ("xla", xla_path, (w, g, bt, p)),
+                ("fused", fused_path, (w, g, bt, p)),
+                ("conv", conv_path, (w, p))):
+            sec = timed_chain(fn, x, iters, *consts)
+            ms = sec * 1e3 / iters
+            totals[name] += ms
+            print(json.dumps({
+                "shape": f"{hw}x{hw}x{cin}->{cout}", "variant": name,
+                "ms": round(ms, 4),
+                "tflops": round(gflop / iters / ms, 1)}), flush=True)
+
+    speedup = totals["xla"] / totals["fused"] if totals["fused"] else 0
+    print(json.dumps({
+        "metric": "conv1x1_bn_epilogue_fusion_speedup",
+        "xla_ms_total": round(totals["xla"], 3),
+        "fused_ms_total": round(totals["fused"], 3),
+        "bare_conv_ms_total": round(totals["conv"], 3),
+        "value": round(speedup, 3),
+        "pays": speedup > 1.05,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
