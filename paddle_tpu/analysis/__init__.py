@@ -1,0 +1,37 @@
+"""paddle_tpu.analysis — static analysis for the dual-mode framework.
+
+Four passes over one diagnostics core (see diagnostics.py for the rule
+catalog; README "Static analysis" for examples):
+
+* :func:`verify_program` — walks a recorded ``static.graph.Program``,
+  re-runs shape/dtype inference and flags dangling edges, duplicate names,
+  dead ops, parameter mutation and shapeless feeds (V1xx);
+* :func:`lint_function` / :func:`lint_module_source` — pre-flights source
+  before ``@to_static`` rewrites it: generator fallbacks, closure mutation,
+  return/break in tensor-dependent blocks, per-iteration host syncs
+  (D2xx/D3xx);
+* :class:`RetraceMonitor` — run-time signature-explosion detector over
+  ``jit.StaticFunction`` and ``Executor`` (R4xx);
+* :func:`check_plan` — validates a ``fleet.plan.ShardingPlan`` against the
+  mesh before anything hits ``pjit`` (P5xx).
+
+CLI: ``python -m paddle_tpu.analysis <module-or-script> ...`` (or
+``tools/analyze.py``); exits nonzero on error-severity findings.
+"""
+from .check_plan import check_plan  # noqa: F401
+from .diagnostics import (  # noqa: F401
+    RULES, Diagnostic, DiagnosticCollector, Location, Severity, has_errors,
+    render_json, render_text)
+from .lint_dy2static import (  # noqa: F401
+    lint_function, lint_module_source, lint_source)
+from .retrace import RetraceMonitor  # noqa: F401
+from .runner import analyze_module, analyze_target, main  # noqa: F401
+from .verify_program import verify_program  # noqa: F401
+
+__all__ = [
+    "Diagnostic", "DiagnosticCollector", "Location", "Severity", "RULES",
+    "render_text", "render_json", "has_errors",
+    "verify_program", "lint_function", "lint_source", "lint_module_source",
+    "RetraceMonitor", "check_plan",
+    "analyze_target", "analyze_module", "main",
+]

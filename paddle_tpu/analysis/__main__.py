@@ -1,0 +1,7 @@
+"""``python -m paddle_tpu.analysis`` entry point."""
+import sys
+
+from .runner import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,130 @@
+"""Sharding plan checker — validate a ``fleet.plan.ShardingPlan`` before
+launch.
+
+A bad partition spec today fails inside ``pjit`` ("sharding ... is not
+divisible", "unbound axis name ...") with a stack into XLA and, on a real
+pod, only after minutes of queueing.  This pass cross-checks every
+parameter's ``partition_spec`` against the mesh axes and the layer dims at
+build time:
+
+* P501 — spec names an axis the mesh doesn't have;
+* P502 — a parameter dim is not divisible by the product of its sharding
+  axis sizes;
+* P503 — the same mesh axis appears in two dims of one spec (an axis can
+  shard a tensor along at most one dimension);
+* P504 — spec rank exceeds the parameter rank;
+* P505 — ZeRO is on (``sharding`` axis > 1) but a parameter's optimizer
+  state has no dim divisible by the axis: its slots stay fully replicated,
+  silently forfeiting the memory the strategy asked for.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .diagnostics import Diagnostic, DiagnosticCollector, Location
+
+__all__ = ["check_plan"]
+
+
+def _axes_of(entry) -> tuple:
+    """A PartitionSpec dim entry is None, an axis name, or a tuple of
+    axis names."""
+    if entry is None:
+        return ()
+    if isinstance(entry, (tuple, list)):
+        return tuple(entry)
+    return (entry,)
+
+
+def check_plan(plan, collector: Optional[DiagnosticCollector] = None,
+               ) -> List[Diagnostic]:
+    out = DiagnosticCollector()
+    mesh = plan.mesh
+    axis_sizes = dict(mesh.shape)
+    loc = Location(file=f"<plan:{type(plan).__name__}>")
+
+    shapes = {}
+    for name, box in plan.network.named_parameters():
+        spec = plan.param_specs.get(name)
+        if spec is None:
+            continue
+        try:
+            shape = tuple(box.value.shape)
+        except Exception:  # deleted/donated array: metadata unavailable
+            continue
+        shapes[name] = shape
+        entries = tuple(spec)
+        if len(entries) > len(shape):
+            out.add("P504",
+                    f"parameter {name!r} (rank {len(shape)}) has a rank-"
+                    f"{len(entries)} partition spec {entries}",
+                    location=loc,
+                    hint="one spec entry per tensor dim (None = "
+                         "replicated)")
+            continue
+        seen_axes = {}
+        for d, entry in enumerate(entries):
+            factor = 1
+            for ax in _axes_of(entry):
+                if ax not in axis_sizes:
+                    out.add("P501",
+                            f"parameter {name!r} dim {d} is sharded over "
+                            f"axis {ax!r}, which is not in the mesh "
+                            f"(axes: {list(axis_sizes)})",
+                            location=loc,
+                            hint="match the spec to build_mesh axis names")
+                    continue
+                if ax in seen_axes:
+                    out.add("P503",
+                            f"parameter {name!r} books mesh axis {ax!r} "
+                            f"on both dim {seen_axes[ax]} and dim {d}",
+                            location=loc,
+                            hint="an axis can shard at most one dim; use "
+                                 "a different axis or replicate one dim")
+                    continue
+                seen_axes[ax] = d
+                factor *= axis_sizes[ax]
+            if factor > 1 and shape[d] % factor != 0:
+                out.add("P502",
+                        f"parameter {name!r} dim {d} (size {shape[d]}) is "
+                        f"not divisible by its sharding factor {factor} "
+                        f"({entry!r})",
+                        location=loc,
+                        hint=f"pad the dim to a multiple of {factor} or "
+                             f"replicate it")
+
+    # P505 — ZeRO slots that cannot shard (replicated-param/opt-state
+    # mismatch): _slot_spec falls back to the param spec when no dim
+    # divides the sharding axis, so compare its output against the input.
+    if getattr(plan, "_zero", False) and plan.optimizer is not None \
+            and not any(d.severity == "error" for d in out):
+        try:
+            import jax
+
+            avals = {n: jax.ShapeDtypeStruct(s, "float32")
+                     for n, s in shapes.items()}
+            slot_shapes = jax.eval_shape(plan.optimizer.init, avals)
+        except Exception:
+            slot_shapes = None  # optimizer without eval_shape-able init
+        if slot_shapes is not None:
+            from jax.sharding import PartitionSpec as P
+
+            for pname, pslots in slot_shapes.get("slots", {}).items():
+                pspec = plan.param_specs.get(pname, P())
+                for sname, leaf in pslots.items():
+                    if not leaf.shape:
+                        continue  # scalars can't shard
+                    if plan._slot_spec(pspec, leaf.shape) == pspec:
+                        out.add(
+                            "P505",
+                            f"ZeRO is enabled but optimizer slot "
+                            f"{pname!r}/{sname} (shape {leaf.shape}) has "
+                            f"no dim divisible by the 'sharding' axis "
+                            f"(size {axis_sizes.get('sharding')}); it "
+                            f"stays replicated on every device",
+                            location=loc,
+                            hint="pad the parameter or lower the "
+                                 "sharding degree")
+    if collector is not None:
+        collector.extend(out.diagnostics)
+    return out.diagnostics

@@ -1,0 +1,432 @@
+"""dy2static source linter — pre-flight a function before ``@to_static``.
+
+The AST-lite transpiler (paddle_tpu/dy2static.py) silently declines some
+constructs (generators trace natively; blocks containing return/break/raise
+are left untransformed and only fail later IF the condition turns out to be
+a traced tensor).  This linter runs the same block analysis *statically* —
+reusing the transpiler's own ``_IllegalInBlock``/``_AssignCollector``
+machinery — plus a syntactic tensor-taint pass, and reports each hazard
+with the exact source line instead of a trace-time stack into jax.
+
+Rules:
+
+* D201 — ``yield``/``async``: the transpiler keeps the function native, so
+  tensor control flow inside will NOT be rewritten (silent fallback today).
+* D202 — ``nonlocal``/``global`` inside a control-flow block: closure
+  mutation cannot cross a traced-block extraction.
+* D203 — ``return``/``raise`` inside a tensor-dependent ``if``/``while``
+  body: the transformer skips the whole block; a traced condition then
+  raises at run time.  Assign a flag and return after the block.
+* D204 — ``break``/``continue`` bound to a tensor-dependent loop: same
+  skip-then-fail pattern.
+* D301 — ``.numpy()``/``.item()``/``float()``/``int()``/``bool()`` on a
+  traced value inside a loop: a device→host sync per iteration (identity
+  under trace, a stall in the eager hot path).
+* D302 — ``print`` (and ``logging``/``warnings``) of a traced value inside
+  a loop: side effects on tracers run at trace time only — once, with
+  abstract values, not per step.
+
+Tensor-dependence is *syntactic taint*: function parameters (except
+``self``), results of ``paddle``/``jnp``/``jax``/``lax`` calls, layer calls
+on ``self.*``, and arithmetic over tainted values are suspect; ``is None``
+tests, ``.shape``/``.ndim``/``len()`` reads and plain attribute reads on
+``self`` are concrete.  The linter only fires the D203/D204 errors on
+suspect tests, which keeps it zero-false-positive on the bundled model zoo
+(enforced by tests/test_analysis.py).
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Callable, List, Optional, Sequence, Set
+
+from ..dy2static import _HasYield, _IllegalInBlock, _assigned_paths, _path_str
+from .diagnostics import Diagnostic, DiagnosticCollector, Location
+
+__all__ = ["lint_function", "lint_source", "lint_module_source"]
+
+
+class _IllegalCollector(_IllegalInBlock):
+    """The transpiler's block legality visitor, with node capture: records
+    WHAT made the block non-extractable and WHERE (the transpiler only
+    needs the bool)."""
+
+    def __init__(self):
+        super().__init__()
+        self.hits = []  # (kind, node)
+
+    def _hit(self, kind, node):
+        self.hits.append((kind, node))
+        self.found = True
+
+    def visit_Return(self, node):
+        self._hit("return", node)
+
+    def visit_Raise(self, node):
+        self._hit("raise", node)
+
+    def visit_Global(self, node):
+        self._hit("scope", node)
+
+    visit_Nonlocal = visit_Global
+
+    def visit_Break(self, node):
+        if self._loop_depth == 0:
+            self._hit("break", node)
+
+    def visit_Continue(self, node):
+        if self._loop_depth == 0:
+            self._hit("continue", node)
+
+
+def _collect_illegal(stmts: Sequence[ast.stmt]):
+    v = _IllegalCollector()
+    for s in stmts:
+        v.visit(s)
+    return v.hits
+
+
+#: attribute reads that stay concrete under trace (static metadata)
+_CONCRETE_ATTRS = {"shape", "ndim", "dtype", "name", "place", "size"}
+#: module roots whose calls produce traced tensors
+_TENSOR_MODULES = {"paddle", "paddle_tpu", "jnp", "jax", "lax", "F",
+                   "fluid", "layers"}
+#: builtins whose results are concrete regardless of the argument
+_CONCRETE_CALLS = {"len", "isinstance", "issubclass", "hasattr", "getattr",
+                   "type", "id", "repr", "str"}
+
+
+class _Taint:
+    """Flow-insensitive syntactic tensor-taint over one function body."""
+
+    def __init__(self, fdef: ast.FunctionDef):
+        args = fdef.args
+        names = [a.arg for a in (args.posonlyargs + args.args
+                                 + args.kwonlyargs)]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        if args.kwarg:
+            names.append(args.kwarg.arg)
+        self.names: Set[str] = {n for n in names if n not in ("self", "cls")}
+        # propagate through simple assignments, in order, to fixpoint-ish
+        # (two passes cover back-references without a full dataflow solve)
+        for _ in range(2):
+            for node in ast.walk(fdef):
+                if isinstance(node, ast.Assign) and self.suspect(node.value):
+                    for t in node.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                self.names.add(n.id)
+                elif isinstance(node, ast.AugAssign) \
+                        and isinstance(node.target, ast.Name) \
+                        and self.suspect(node.value):
+                    self.names.add(node.target.id)
+
+    def _root(self, node) -> Optional[str]:
+        while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+            node = node.func if isinstance(node, ast.Call) else node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    def suspect(self, node) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if node.attr in _CONCRETE_ATTRS:
+                return False
+            return self.suspect(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.suspect(node.value)
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                if f.id in _CONCRETE_CALLS:
+                    return False
+                if f.id in ("float", "int", "bool", "abs", "min", "max",
+                            "sum"):
+                    return any(self.suspect(a) for a in node.args)
+                return False  # plain helper call: unknown → not suspect
+            if isinstance(f, ast.Attribute):
+                root = self._root(f)
+                if root in _TENSOR_MODULES:
+                    # lowercase attrs are tensor-returning functions
+                    # (paddle.mean, jnp.tanh); Capitalized ones construct
+                    # objects (fluid.Executor, nn.CrossEntropyLoss)
+                    return f.attr[:1].islower()
+                if root == "self":
+                    # self.sublayer(x) produces tensors; self.training,
+                    # self.config.x reads stay concrete — only CALLS taint
+                    return True
+                # method on a tainted value: x.sum(), x.numpy(), ...
+                return self.suspect(f.value)
+            return False
+        if isinstance(node, ast.BinOp):
+            return self.suspect(node.left) or self.suspect(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.suspect(node.operand)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in node.ops):
+                return False  # identity/membership tests are concrete
+            return (self.suspect(node.left)
+                    or any(self.suspect(c) for c in node.comparators))
+        if isinstance(node, ast.BoolOp):
+            return any(self.suspect(v) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return (self.suspect(node.test) or self.suspect(node.body)
+                    or self.suspect(node.orelse))
+        return False
+
+
+def _is_host_sync_call(node: ast.Call, taint: _Taint) -> Optional[str]:
+    """'.numpy()'/'.item()' on a suspect value, or float()/int()/bool()
+    over a suspect expression — returns the offending spelling."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in ("numpy", "item") \
+            and not node.args and taint.suspect(f.value):
+        return f".{f.attr}()"
+    if isinstance(f, ast.Name) and f.id in ("float", "int", "bool") \
+            and node.args and any(taint.suspect(a) for a in node.args):
+        return f"{f.id}()"
+    return None
+
+
+class _FnLinter(ast.NodeVisitor):
+    """One function scope; nested defs get their own linter run."""
+
+    def __init__(self, taint: _Taint, out: DiagnosticCollector,
+                 loc_of: Callable[[ast.AST], Location]):
+        self.taint = taint
+        self.out = out
+        self.loc = loc_of
+        self._loop_depth = 0
+
+    # -- control-flow blocks -------------------------------------------------
+    def _check_block(self, node, stmts, what: str):
+        """D202/D203/D204 over a (possibly) tensor-dependent block."""
+        hits = _collect_illegal(stmts)
+        suspect = self.taint.suspect(node.test)
+        carried = ", ".join(_path_str(p) for p in _assigned_paths(stmts))
+        for kind, hit in hits:
+            if kind == "scope":
+                names = ", ".join(getattr(hit, "names", []) or [])
+                self.out.add(
+                    "D202",
+                    f"{ast.unparse(hit).split(chr(10))[0]} inside a "
+                    f"{what} block: closure mutation cannot cross a "
+                    f"traced-block extraction",
+                    location=self.loc(hit),
+                    hint=f"pass {names or 'the value'} through the block's "
+                         f"carried variables instead")
+            elif not suspect:
+                continue  # return/break in a concrete block is plain Python
+            elif kind in ("return", "raise"):
+                self.out.add(
+                    "D203",
+                    f"`{kind}` inside a tensor-dependent {what}: the "
+                    f"dy2static pass leaves this block untransformed and "
+                    f"the traced condition fails at run time",
+                    location=self.loc(hit),
+                    hint="assign a flag/result variable inside the block "
+                         "and return after it"
+                         + (f" (carried vars here: {carried})"
+                            if carried else ""))
+            else:  # break / continue
+                self.out.add(
+                    "D204",
+                    f"`{kind}` bound to a tensor-dependent {what}: traced "
+                    f"loops cannot exit early",
+                    location=self.loc(hit),
+                    hint="fold the condition into the loop test, or mask "
+                         "the remaining iterations")
+
+    def visit_If(self, node):
+        self._check_block(node, list(node.body) + list(node.orelse),
+                          "`if`")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_block(node, list(node.body), "`while` loop")
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    def visit_For(self, node):
+        if isinstance(node.iter, ast.Call) \
+                and self.taint.suspect(node.iter):
+            hits = [h for h in _collect_illegal(node.body)
+                    if h[0] in ("break", "continue")]
+            for kind, hit in hits:
+                self.out.add(
+                    "D204",
+                    f"`{kind}` bound to a tensor-bounded `for` loop: "
+                    f"traced loops cannot exit early",
+                    location=self.loc(hit),
+                    hint="fold the condition into the bound, or mask the "
+                         "remaining iterations")
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    # -- calls in hot paths --------------------------------------------------
+    def visit_Call(self, node):
+        if self._loop_depth > 0:
+            spelling = _is_host_sync_call(node, self.taint)
+            if spelling is not None:
+                self.out.add(
+                    "D301",
+                    f"{spelling} on a traced value inside a loop: a "
+                    f"device→host sync every iteration (and a baked "
+                    f"constant under trace)",
+                    location=self.loc(node),
+                    hint="keep the value on device; read it once after "
+                         "the loop")
+            f = node.func
+            is_print = isinstance(f, ast.Name) and f.id == "print"
+            is_log = (isinstance(f, ast.Attribute)
+                      and self.taint._root(f) in ("logging", "warnings",
+                                                  "logger", "log"))
+            if (is_print or is_log) and any(self.taint.suspect(a)
+                                            for a in node.args):
+                self.out.add(
+                    "D302",
+                    f"{ast.unparse(f)}(...) of a traced value inside a "
+                    f"loop: side effects on tracers run once at trace "
+                    f"time with abstract values, not per step",
+                    location=self.loc(node),
+                    hint="use jax.debug.print, or log outside the "
+                         "compiled region")
+        self.generic_visit(node)
+
+    # -- scope boundaries ----------------------------------------------------
+    def visit_FunctionDef(self, node):
+        pass  # nested defs are linted as their own scope by _lint_fdef
+
+    visit_AsyncFunctionDef = visit_ClassDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _lint_fdef(fdef, out: DiagnosticCollector, filename: str,
+               line_offset: int, qualname: Optional[str] = None):
+    name = qualname or fdef.name
+
+    def loc_of(node) -> Location:
+        return Location(file=filename,
+                        line=line_offset + getattr(node, "lineno", 1) - 1,
+                        function=name)
+
+    if isinstance(fdef, ast.AsyncFunctionDef):
+        out.add("D201",
+                f"async function {name!r}: dy2static keeps it native — "
+                f"tensor control flow inside will not be rewritten",
+                location=loc_of(fdef),
+                hint="make the traced portion a plain function")
+        return
+    y = _HasYield()
+    for s in fdef.body:
+        y.visit(s)
+    if y.found:
+        ynode = next((n for s in fdef.body for n in ast.walk(s)
+                      if isinstance(n, (ast.Yield, ast.YieldFrom))), fdef)
+        out.add("D201",
+                f"generator {name!r}: dy2static keeps it native — tensor "
+                f"control flow inside will not be rewritten",
+                location=loc_of(ynode),
+                hint="collect results in a list and return it")
+        return
+    taint = _Taint(fdef)
+    linter = _FnLinter(taint, out, loc_of)
+    for s in fdef.body:
+        linter.visit(s)
+    # nested function scopes, each with its own taint universe
+    for s in fdef.body:
+        for n in ast.walk(s):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _lint_fdef(n, out, filename, line_offset,
+                           qualname=f"{name}.<locals>.{n.name}")
+
+
+def lint_function(fn: Callable,
+                  collector: Optional[DiagnosticCollector] = None,
+                  ) -> List[Diagnostic]:
+    """Lint one function/method before decorating it with ``@to_static``.
+    Anchors every finding at the real ``file:line``."""
+    out = DiagnosticCollector()
+    fn = inspect.unwrap(fn)
+    if inspect.ismethod(fn):
+        fn = fn.__func__
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        filename = inspect.getsourcefile(fn) or "<unknown>"
+        offset = fn.__code__.co_firstlineno
+    except (OSError, TypeError):
+        return []  # no source — nothing to lint (builtins, C extensions)
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+    fdef = tree.body[0]
+    if isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        # ast lineno 1 is the `def`/decorator line at co_firstlineno
+        _lint_fdef(fdef, out, filename, offset,
+                   qualname=getattr(fn, "__qualname__", fdef.name))
+    if collector is not None:
+        collector.extend(out.diagnostics)
+    return out.diagnostics
+
+
+def _is_to_static_decorated(fdef) -> bool:
+    return any(tok in ast.unparse(d)
+               for d in fdef.decorator_list
+               for tok in ("to_static", "declarative"))
+
+
+def lint_module_source(src: str, filename: str = "<string>",
+                       all_functions: bool = False,
+                       collector: Optional[DiagnosticCollector] = None,
+                       ) -> List[Diagnostic]:
+    """Lint the dy2static-relevant functions of a module's source: those
+    decorated with ``to_static`` and every ``forward`` method (the two
+    things the transpiler transforms).  ``all_functions=True`` widens to
+    every def — useful for auditing scripts."""
+    out = DiagnosticCollector()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        out.add("D201", f"module does not parse: {e}",
+                location=Location(file=filename, line=e.lineno),
+                severity="error")
+        if collector is not None:
+            collector.extend(out.diagnostics)
+        return out.diagnostics
+
+    def walk(body, qual=""):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                target = (all_functions or _is_to_static_decorated(node)
+                          or (qual and node.name == "forward"))
+                if target:
+                    _lint_fdef(node, out, filename, 1,
+                               qualname=f"{qual}{node.name}")
+            elif isinstance(node, ast.ClassDef):
+                walk(node.body, qual=f"{node.name}.")
+    walk(tree.body)
+    if collector is not None:
+        collector.extend(out.diagnostics)
+    return out.diagnostics
+
+
+def lint_source(src: str, filename: str = "<string>",
+                collector: Optional[DiagnosticCollector] = None,
+                ) -> List[Diagnostic]:
+    """Lint a single function given as source text (testing convenience)."""
+    out = DiagnosticCollector()
+    tree = ast.parse(textwrap.dedent(src))
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _lint_fdef(node, out, filename, 1)
+    if collector is not None:
+        collector.extend(out.diagnostics)
+    return out.diagnostics

@@ -1,0 +1,156 @@
+"""Retrace hazard detector — catches jit signature explosions at run time.
+
+``jit.StaticFunction`` and ``static.graph.Executor`` publish one event per
+call / per compiled signature on ``framework.trace_events``.  The
+:class:`RetraceMonitor` subscribes, counts *distinct* signatures per site,
+and past a configurable budget diffs the signature stream to identify WHICH
+argument's shape, dtype, or static-value churn caused the explosion — the
+diagnostic a user otherwise reconstructs by hand from minutes-long compile
+stalls.
+
+Usage::
+
+    from paddle_tpu.analysis import RetraceMonitor
+    with RetraceMonitor(budget=8) as mon:
+        train_loop()
+    print(render_text(mon.diagnostics()))
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+from ..framework import trace_events
+from .diagnostics import Diagnostic, DiagnosticCollector, Location
+
+__all__ = ["RetraceMonitor"]
+
+
+def _churn_axes(values) -> str:
+    """Describe how a sequence of per-signature values varies."""
+    uniq = list(dict.fromkeys(values))
+    shown = ", ".join(map(str, uniq[:4]))
+    if len(uniq) > 4:
+        shown += f", … ({len(uniq)} distinct)"
+    return shown
+
+
+class RetraceMonitor:
+    """Context manager collecting per-site trace signatures.
+
+    ``budget``: distinct signatures per site before the site is reported.
+    The default 8 tolerates the legitimate signature set of a train loop
+    (train/eval × a couple of batch geometries) while catching the
+    pathological one-signature-per-step pattern within the first dozen
+    steps."""
+
+    def __init__(self, budget: int = 8):
+        self.budget = int(budget)
+        self._lock = threading.Lock()
+        self._sites: Dict[Tuple[str, str], List[dict]] = {}
+        self._seen: Dict[Tuple[str, str], set] = {}
+
+    # -- subscription --------------------------------------------------------
+    def install(self):
+        trace_events.register(self._on_event)
+        return self
+
+    def uninstall(self):
+        trace_events.unregister(self._on_event)
+
+    __enter__ = install
+
+    def __exit__(self, *exc):
+        self.uninstall()
+
+    def _on_event(self, site, info):
+        key = tuple(site)
+        sig = _freeze(info)
+        with self._lock:
+            seen = self._seen.setdefault(key, set())
+            if sig in seen:
+                return
+            seen.add(sig)
+            self._sites.setdefault(key, []).append(info)
+
+    # -- analysis ------------------------------------------------------------
+    def distinct_signatures(self, kind: str, name: str) -> int:
+        return len(self._sites.get((kind, name), ()))
+
+    def diagnostics(self) -> List[Diagnostic]:
+        out = DiagnosticCollector()
+        with self._lock:
+            sites = {k: list(v) for k, v in self._sites.items()}
+        for (kind, name), sigs in sites.items():
+            if len(sigs) <= self.budget:
+                continue
+            causes = (self._diff_jit(sigs) if kind == "jit"
+                      else self._diff_executor(sigs))
+            rule = "R401" if kind == "jit" else "R402"
+            what = ("to_static function" if kind == "jit"
+                    else "Executor program")
+            out.add(rule,
+                    f"{what} {name!r} compiled {len(sigs)} distinct "
+                    f"signatures (budget {self.budget}); churn: "
+                    f"{'; '.join(causes) if causes else 'unknown'}",
+                    location=Location(file=name, function=name),
+                    hint="pad inputs to a fixed shape bucket, cast feeds "
+                         "to one dtype, and hoist Python-value arguments "
+                         "out of the traced signature")
+        return out.diagnostics
+
+    @staticmethod
+    def _diff_jit(sigs: List[dict]) -> List[str]:
+        causes = []
+        n_args = max(len(s.get("args", ())) for s in sigs)
+        for i in range(n_args):
+            entries = [s["args"][i] for s in sigs
+                       if len(s.get("args", ())) > i]
+            shapes = [e[1] for e in entries if e[0] == "array"]
+            dtypes = [e[2] for e in entries if e[0] == "array"]
+            statics = [e[1] for e in entries if e[0] in ("static", "weak")]
+            if len(set(shapes)) > 1:
+                causes.append(f"arg {i} shape varies: "
+                              f"{_churn_axes(shapes)}")
+            if len(set(dtypes)) > 1:
+                causes.append(f"arg {i} dtype varies: "
+                              f"{_churn_axes(dtypes)}")
+            if len(set(statics)) > 1:
+                causes.append(f"arg {i} static value varies: "
+                              f"{_churn_axes(statics)}")
+        trainings = [s.get("training") for s in sigs]
+        if len(set(trainings)) > 2:
+            causes.append("training flag flips repeatedly")
+        return causes
+
+    @staticmethod
+    def _diff_executor(sigs: List[dict]) -> List[str]:
+        causes = []
+        feed_names = {n for s in sigs for n in s.get("feeds", {})}
+        for n in sorted(feed_names):
+            entries = [s["feeds"][n] for s in sigs if n in s.get("feeds", {})]
+            shapes = [e[0] for e in entries]
+            dtypes = [e[1] for e in entries]
+            if len(set(shapes)) > 1:
+                causes.append(f"feed {n!r} shape varies: "
+                              f"{_churn_axes(shapes)}")
+            if len(set(dtypes)) > 1:
+                causes.append(f"feed {n!r} dtype varies: "
+                              f"{_churn_axes(dtypes)}")
+        fetches = [s.get("fetch") for s in sigs]
+        if len(set(fetches)) > 1:
+            causes.append(f"fetch set varies ({len(set(fetches))} distinct)")
+        versions = [s.get("version") for s in sigs]
+        if len(set(versions)) > 1:
+            causes.append("program grew new ops between runs "
+                          f"({len(set(versions))} versions) — ops recorded "
+                          "inside the step loop")
+        return causes
+
+
+def _freeze(obj):
+    if isinstance(obj, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in obj.items()))
+    if isinstance(obj, (list, tuple)):
+        return tuple(_freeze(v) for v in obj)
+    return obj

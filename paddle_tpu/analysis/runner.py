@@ -1,0 +1,125 @@
+"""CLI runner: ``python -m paddle_tpu.analysis <module-or-script> ...``.
+
+For each target (an importable module name or a ``.py`` path) it runs every
+applicable pass:
+
+* the dy2static linter over the target's source (``@to_static`` functions
+  and ``forward`` methods; ``--all-functions`` widens to every def);
+* unless ``--no-exec``, the target is imported and its globals are swept
+  for ``static.graph.Program`` instances (program verifier) and
+  ``fleet.plan.ShardingPlan`` instances (plan checker); a non-empty default
+  main program recorded at import time is verified too.
+
+Exit status: nonzero iff an error-severity diagnostic was emitted
+(``--strict``: iff ANY finding).  ``--json`` switches the report to the
+machine lane.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import importlib.util
+import os
+import sys
+from typing import List, Optional
+
+from .check_plan import check_plan
+from .diagnostics import (Diagnostic, DiagnosticCollector, Severity,
+                          has_errors, render_json, render_text)
+from .lint_dy2static import lint_module_source
+from .verify_program import verify_program
+
+__all__ = ["analyze_target", "analyze_module", "main"]
+
+
+def _load_target(target: str):
+    """Import a module name or a .py path; returns (module, source_path)."""
+    if target.endswith(".py") or os.path.sep in target:
+        path = os.path.abspath(target)
+        name = "_paddle_tpu_analysis_" + \
+            os.path.splitext(os.path.basename(path))[0]
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+        return mod, path
+    mod = importlib.import_module(target)
+    return mod, getattr(mod, "__file__", None)
+
+
+def _source_path(target: str) -> Optional[str]:
+    if target.endswith(".py") or os.path.sep in target:
+        return os.path.abspath(target)
+    try:
+        spec = importlib.util.find_spec(target)
+    except (ImportError, ValueError, ModuleNotFoundError):
+        return None
+    return spec.origin if spec and spec.origin not in (None, "built-in") \
+        else None
+
+
+def analyze_module(mod, out: DiagnosticCollector):
+    """Sweep an imported module's globals for Programs and ShardingPlans."""
+    from ..distributed.fleet.plan import ShardingPlan
+    from ..static.graph import Program, default_main_program
+
+    seen = set()
+    for value in vars(mod).values():
+        if id(value) in seen:
+            continue
+        seen.add(id(value))
+        if isinstance(value, Program) and value.ops:
+            verify_program(value, collector=out)
+        elif isinstance(value, ShardingPlan):
+            check_plan(value, collector=out)
+    main_prog = default_main_program()
+    if main_prog.ops and id(main_prog) not in seen:
+        verify_program(main_prog, collector=out)
+
+
+def analyze_target(target: str, out: DiagnosticCollector,
+                   all_functions: bool = False,
+                   no_exec: bool = False) -> None:
+    src_path = _source_path(target)
+    if not no_exec:
+        mod, src_path2 = _load_target(target)
+        src_path = src_path or src_path2
+        analyze_module(mod, out)
+    if src_path and os.path.exists(src_path):
+        with open(src_path, "r", encoding="utf-8") as f:
+            lint_module_source(f.read(), filename=src_path,
+                               all_functions=all_functions, collector=out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="paddle_tpu static analysis: program verifier, "
+                    "dy2static linter, sharding plan checker")
+    p.add_argument("targets", nargs="+",
+                   help="module names or .py paths to analyze")
+    p.add_argument("--json", action="store_true",
+                   help="emit diagnostics as JSON")
+    p.add_argument("--all-functions", action="store_true",
+                   help="lint every function, not just @to_static/forward")
+    p.add_argument("--no-exec", action="store_true",
+                   help="lint source only; do not import the target")
+    p.add_argument("--strict", action="store_true",
+                   help="exit nonzero on ANY finding, not just errors")
+    args = p.parse_args(argv)
+
+    out = DiagnosticCollector()
+    for target in args.targets:
+        try:
+            analyze_target(target, out, all_functions=args.all_functions,
+                           no_exec=args.no_exec)
+        except Exception as e:  # noqa: BLE001 — a target that won't load is a finding
+            out.add("V102",
+                    f"target {target!r} failed to load: "
+                    f"{type(e).__name__}: {e}",
+                    severity=Severity.ERROR)
+    diags: List[Diagnostic] = out.diagnostics
+    print(render_json(diags) if args.json else render_text(diags))
+    if args.strict:
+        return 1 if diags else 0
+    return 1 if has_errors(diags) else 0

@@ -1,0 +1,225 @@
+"""Program verifier — static checks over a recorded ``static.graph.Program``.
+
+Walks the op DAG through ``Program.def_use()`` and re-runs the exact shape
+inference ``record_call`` performed at build time (jax.eval_shape over each
+op's fn with the declared input avals), cross-checking every Variable's
+declared ``(shape, dtype)``.  Catches, *before* Executor.run traces
+anything:
+
+* V101 — declared shape/dtype disagrees with re-run inference (a Variable
+  was tampered with, or an Op was constructed by hand with wrong metadata);
+* V102 — an op fails shape inference outright (would fail inside jax.jit
+  with a trace-deep stack);
+* V103 — a variable consumed but never produced: captured from a different
+  Program (the classic wrong-``program_guard`` bug), used before its
+  producing op, or simply missing (the runtime NotFoundError, hoisted to
+  build time);
+* V104 — duplicate variable names (the dict silently collapses them);
+* V105 — ops unreachable from any fetch root (dead code);
+* V106 — op outputs produced but never consumed (dangling edges);
+* V107 — a parameter mutated outside an optimizer update;
+* V108 — feed placeholders with fully-unknown shapes (every dim dynamic:
+  nothing for inference to anchor on, one recompile per batch shape).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+
+from ..static.graph import Program, Variable
+from .diagnostics import Diagnostic, DiagnosticCollector, Location
+
+__all__ = ["verify_program"]
+
+
+def _loc(program, op_i: Optional[int] = None) -> Location:
+    name = f"<program#{program.idx}>"
+    return Location(file=name, line=None if op_i is None else op_i + 1,
+                    function=None)
+
+
+def _declared_aval(v: Variable):
+    shape = tuple(1 if d is None else d for d in v.shape)
+    return jax.ShapeDtypeStruct(shape, v.dtype)
+
+
+def _infer_op(program, op, env):
+    """Replay record_call's shape inference for one op: substitute declared
+    avals for Variable leaves and eval_shape the recorded callable."""
+    is_var = lambda x: isinstance(x, Variable)  # noqa: E731
+    leaves, treedef = jax.tree_util.tree_flatten((op.args, op.kwargs),
+                                                 is_leaf=is_var)
+    sub = [env.get(x.name, _declared_aval(x)) if is_var(x) else x
+           for x in leaves]
+
+    def probe(pv, bv, vals):
+        a_args, a_kwargs = jax.tree_util.tree_unflatten(treedef, vals)
+        if op.scoped:
+            return op.fn(pv, bv, *a_args, training=False, **a_kwargs)
+        return op.fn(*a_args, **a_kwargs)
+
+    pv = {n: jax.ShapeDtypeStruct(tuple(program.scope[n].shape),
+                                  program.scope[n].dtype)
+          for n in op.param_names}
+    bv = {n: jax.ShapeDtypeStruct(tuple(program.buffers[n].shape),
+                                  program.buffers[n].dtype)
+          for n in op.buffer_names}
+    out = jax.eval_shape(probe, pv, bv, sub)
+    if op.writes_buffers:
+        out = out[0]
+    return [out] if op.single else list(out)
+
+
+def verify_program(program: Program, fetch_list: Optional[Sequence] = None,
+                   collector: Optional[DiagnosticCollector] = None,
+                   ) -> List[Diagnostic]:
+    """Run all V1xx checks; returns the diagnostics (also appended to
+    ``collector`` when given).  ``fetch_list`` (Variables or names) roots
+    the dead-code analysis; without it the bound loss (optimizer.minimize)
+    is used, and with neither, dead-code/dangling checks are skipped —
+    every sink is then a legitimate fetch candidate."""
+    out = DiagnosticCollector()
+    idx = program.def_use()
+
+    # V104 — duplicate names (collisions recorded by Program.add_var plus
+    # any name produced by more than one op)
+    dups = list(dict.fromkeys(program._dup_names))
+    for name, ops_i in idx.producers.items():
+        if len(ops_i) > 1 and name not in dups:
+            dups.append(name)
+    for name in dups:
+        out.add("V104",
+                f"variable name {name!r} declared more than once; the "
+                f"program dict keeps only the last declaration",
+                location=_loc(program),
+                hint="use Program.unique_name or distinct names per op "
+                     "output")
+
+    # V103 — consumed-never-produced / foreign / use-before-def
+    reported_v103 = set()
+    for op_i, ins in enumerate(idx.op_inputs):
+        for v in ins:
+            key = (v.name, id(v.program))
+            if key in reported_v103:
+                continue
+            if v.program is not program:
+                reported_v103.add(key)
+                out.add("V103",
+                        f"op #{op_i} consumes {v.name!r} from a different "
+                        f"Program (program#{v.program.idx}); values cannot "
+                        f"cross programs",
+                        location=_loc(program, op_i),
+                        hint="build all ops under the same program_guard, "
+                             "or feed the value explicitly")
+                continue
+            prods = idx.producers.get(v.name)
+            if prods is None:
+                if v.name not in program.vars:
+                    reported_v103.add(key)
+                    out.add("V103",
+                            f"op #{op_i} consumes {v.name!r} which no op "
+                            f"produces and no placeholder declares",
+                            location=_loc(program, op_i),
+                            hint="declare it with static.data(...) or "
+                                 "record the producing op first")
+                # else: a declared feed placeholder or parameter — fine
+            elif min(prods) > op_i:
+                reported_v103.add(key)
+                out.add("V103",
+                        f"op #{op_i} consumes {v.name!r} before op "
+                        f"#{min(prods)} produces it (ops out of "
+                        f"topological order)",
+                        location=_loc(program, op_i),
+                        hint="record ops in dependency order")
+
+    # V107 — parameter mutated outside the optimizer update (the optimizer
+    # path never appends ops: run() differentiates the graph instead, so
+    # ANY op writing a scope name is an illegal in-graph param mutation)
+    for op_i, op in enumerate(program.ops):
+        for n in op.out_names:
+            if n in program.scope:
+                out.add("V107",
+                        f"op #{op_i} writes parameter {n!r}; parameters "
+                        f"may only change through the bound optimizer's "
+                        f"update",
+                        location=_loc(program, op_i),
+                        hint="write to a fresh Variable, or use "
+                             "optimizer.minimize for updates")
+
+    # V108 — feed placeholders with fully-unknown shapes
+    for name in idx.feed_names():
+        v = program.vars[name]
+        if v.shape and all(d is None for d in v.shape):
+            out.add("V108",
+                    f"feed placeholder {name!r} has fully-unknown shape "
+                    f"{v.shape}; shape inference can only probe 1s and "
+                    f"every new feed shape recompiles",
+                    location=_loc(program),
+                    hint="declare static non-batch dims: "
+                         f"static.data({name!r}, shape=[-1, ...])")
+
+    # V101/V102 — re-run shape/dtype inference over the DAG
+    env = {}
+    for op_i, op in enumerate(program.ops):
+        try:
+            avals = _infer_op(program, op, env)
+        except Exception as e:  # noqa: BLE001 — any trace failure is the finding
+            out.add("V102",
+                    f"op #{op_i} fails shape inference: "
+                    f"{type(e).__name__}: {str(e).splitlines()[0][:200]}",
+                    location=_loc(program, op_i),
+                    hint="the op would fail identically inside jit at "
+                         "Executor.run time; fix its inputs/shapes")
+            continue
+        for name, av in zip(op.out_names, avals):
+            env[name] = av
+            v = program.vars.get(name)
+            if v is None:
+                continue
+            decl_shape = v.shape
+            ok_rank = len(decl_shape) == len(av.shape)
+            # None dims are run-time (batch) dims — probed as 1, excluded
+            ok_dims = ok_rank and all(
+                d is None or d == a for d, a in zip(decl_shape, av.shape))
+            if not ok_dims or str(v.dtype) != str(av.dtype):
+                out.add("V101",
+                        f"variable {name!r} declares (shape={decl_shape}, "
+                        f"dtype={v.dtype}) but op #{op_i} infers "
+                        f"(shape={av.shape}, dtype={av.dtype})",
+                        location=_loc(program, op_i),
+                        hint="the declaration was edited after recording, "
+                             "or the Op was constructed with stale "
+                             "metadata")
+
+    # -- reachability checks: need explicit roots ---------------------------
+    roots = None
+    if fetch_list:
+        roots = [f.name if isinstance(f, Variable) else str(f)
+                 for f in fetch_list]
+    elif program._loss_name is not None:
+        roots = [program._loss_name]
+    if roots is not None and program.ops:
+        live = idx.ops_reaching(roots)
+        root_set = set(roots)
+        for op_i, op in enumerate(program.ops):
+            if op_i not in live:
+                out.add("V105",
+                        f"op #{op_i} ({getattr(op.fn, '__name__', 'op')}) "
+                        f"does not contribute to any fetch root "
+                        f"{sorted(root_set)}",
+                        location=_loc(program, op_i),
+                        hint="dead code: drop the op or fetch its output")
+            else:
+                # V106 — dangling output edge of a LIVE op
+                for n in op.out_names:
+                    if n not in idx.consumers and n not in root_set:
+                        out.add("V106",
+                                f"op #{op_i} output {n!r} is never "
+                                f"consumed and is not fetched",
+                                location=_loc(program, op_i),
+                                hint="unused output: fetch it or ignore "
+                                     "deliberately")
+    if collector is not None:
+        collector.extend(out.diagnostics)
+    return out.diagnostics

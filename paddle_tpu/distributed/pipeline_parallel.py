@@ -37,6 +37,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..framework.errors import InvalidArgumentError
 from ..nn.layer_base import current_rng_key, functional_call
+from .collective import shard_map as _compat_shard_map
 from .mesh import get_mesh
 
 __all__ = ["pipeline_degree", "pipeline_blocks", "pipeline_train_step",
@@ -179,13 +180,12 @@ def pipeline_blocks(
             axis_name)
         return outputs.reshape(xin.shape)
 
-    shmapped = jax.shard_map(
+    shmapped = _compat_shard_map(
         local,
         mesh=mesh,
         in_specs=({n: P(axis_name) for n in stacked}, P()),
         out_specs=P(),
         axis_names={axis_name},
-        check_vma=False,
     )
     return shmapped(stacked, x)
 
@@ -503,14 +503,13 @@ def pipeline_train_step(
         aux_spec = jax.tree_util.tree_map(lambda _: P(), aux_struct)
     else:
         aux_spec = P()
-    shmapped = jax.shard_map(
+    shmapped = _compat_shard_map(
         local,
         mesh=mesh,
         in_specs=({n: P(axis_name) for n in stacked}, P(), P(), P()),
         out_specs=(P(), {n: P(axis_name) for n in stacked}, P(), P(),
                    aux_spec),
         axis_names={axis_name},
-        check_vma=False,
     )
     loss, grads, head_grads, dx, aux = shmapped(stacked, x, labels,
                                                 head_params)

@@ -79,9 +79,62 @@ from .framework.errors import InvalidArgumentError
 __all__ = ["convert_to_static", "Undefined", "UNDEF", "Dy2StaticError"]
 
 
+def _user_location():
+    """(func_name, filename, lineno) of the user code a dy2static error
+    belongs to: the innermost stack frame outside this framework and jax.
+    Generated block functions (``__d2s_*``) execute with the ORIGINAL
+    file/line info (compile() uses the source filename and copy_location
+    keeps the user's linenos), so their frame gives the exact user line;
+    the enclosing non-``__d2s_`` frame gives the function name."""
+    import sys
+
+    try:
+        f = sys._getframe(2)
+    except ValueError:
+        return None, None, None
+    filename = lineno = name = None
+    while f is not None:
+        mod = (f.f_globals.get("__name__") or "").split(".")[0]
+        if mod not in ("paddle_tpu", "jax", "jaxlib", "importlib",
+                       "contextlib", "functools"):
+            if lineno is None:
+                filename, lineno = f.f_code.co_filename, f.f_lineno
+            if not f.f_code.co_name.startswith("__d2s_"):
+                name = f.f_code.co_name
+                break
+        f = f.f_back
+    return name, filename, lineno
+
+
 class Dy2StaticError(InvalidArgumentError):
     """A transformed construct hit a case the AST-lite pass cannot
-    compile; the message names the manual rewrite."""
+    compile; the message names the manual rewrite.
+
+    Every instance carries the user source position (``func_name``,
+    ``filename``, ``lineno`` attributes, appended to the message) so
+    runtime errors and the static linter (paddle_tpu.analysis) point at
+    the same location.  Raise sites don't pass it explicitly — the
+    constructor locates the innermost non-framework frame."""
+
+    def __init__(self, message: str = "", *args, func_name=None,
+                 filename=None, lineno=None):
+        if func_name is None and lineno is None:
+            try:
+                func_name, filename, lineno = _user_location()
+            except Exception:
+                func_name = filename = lineno = None
+        self.func_name = func_name
+        self.filename = filename
+        self.lineno = lineno
+        if lineno is not None:
+            import os as _os
+
+            where = (f"{_os.path.basename(filename)}:{lineno}"
+                     if filename else f"line {lineno}")
+            if func_name:
+                where += f" in {func_name}"
+            message = f"{message} [at {where}]"
+        super().__init__(message, *args)
 
 
 # ---------------------------------------------------------------------------

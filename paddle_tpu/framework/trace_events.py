@@ -1,0 +1,44 @@
+"""Lightweight trace/compile event bus.
+
+``jit.StaticFunction`` and ``static.graph.Executor`` publish one event per
+compiled signature here; subscribers (the retrace hazard detector,
+paddle_tpu/analysis/retrace.py) diff the signature stream to name the
+argument whose shape/dtype churn is causing a signature explosion.  With no
+subscribers registered the publish sites are a single falsy check — zero
+cost on the hot path.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List
+
+__all__ = ["register", "unregister", "active", "notify"]
+
+_lock = threading.Lock()
+_observers: List[Callable] = []
+
+
+def register(fn: Callable) -> Callable:
+    """Subscribe ``fn(site, info)``: ``site`` is a ("jit"|"executor", name)
+    pair, ``info`` a dict of hashable signature components."""
+    with _lock:
+        if fn not in _observers:
+            _observers.append(fn)
+    return fn
+
+
+def unregister(fn: Callable) -> None:
+    with _lock:
+        try:
+            _observers.remove(fn)
+        except ValueError:
+            pass
+
+
+def active() -> bool:
+    return bool(_observers)
+
+
+def notify(site, info) -> None:
+    for fn in list(_observers):
+        fn(site, info)

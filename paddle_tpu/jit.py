@@ -22,8 +22,25 @@ from typing import Optional, Sequence
 import jax
 import numpy as np
 
+from .framework import trace_events
 from .framework.errors import InvalidArgumentError
 from .nn.layer_base import Layer, functional_call
+
+
+def _arg_signature(args):
+    """Abstract (shape, dtype) per array arg / repr hash per static arg —
+    the components jax.jit keys its trace cache on.  Published to
+    framework.trace_events so the retrace hazard detector
+    (paddle_tpu/analysis/retrace.py) can name the churning argument."""
+    sig = []
+    for a in args:
+        if hasattr(a, "shape") and hasattr(a, "dtype"):
+            sig.append(("array", tuple(a.shape), str(a.dtype)))
+        elif isinstance(a, (int, float, bool, complex)):
+            sig.append(("weak", type(a).__name__))
+        else:
+            sig.append(("static", repr(a)[:80]))
+    return tuple(sig)
 
 __all__ = ["to_static", "not_to_static", "save", "load", "TranslatedLayer",
            "ProgramTranslator", "TracedLayer", "set_code_level",
@@ -132,6 +149,14 @@ class StaticFunction:
             raise InvalidArgumentError(
                 "to_static calls are positional-only (kwargs change the "
                 "trace signature); bind keywords before wrapping")
+        if trace_events.active():
+            name = getattr(self._orig, "__qualname__",
+                           type(self._orig).__name__)
+            trace_events.notify(
+                ("jit", name),
+                {"args": _arg_signature(args),
+                 "training": (self._layer.training
+                              if self._layer is not None else None)})
         try:
             layer = self._layer
             if layer is None:

@@ -47,6 +47,9 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):  # jax < 0.6 spells it TPUCompilerParams
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 __all__ = ["flash_attention", "flash_attention_fwd_lse",
            "flash_attention_bwd_chunk"]
 

@@ -45,6 +45,9 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):  # jax < 0.6 spells it TPUCompilerParams
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 from ..framework.errors import InvalidArgumentError
 
 __all__ = ["conv1x1_bn_stats", "conv1x1_bn_relu"]

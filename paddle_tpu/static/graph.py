@@ -40,9 +40,10 @@ import numpy as np
 
 from ..framework.dtype import convert_dtype
 from ..framework.errors import InvalidArgumentError, NotFoundError
+from ..framework import trace_events
 
 __all__ = [
-    "Variable", "Op", "Program", "Executor", "program_guard",
+    "Variable", "Op", "Program", "DefUseIndex", "Executor", "program_guard",
     "default_main_program", "default_startup_program", "data",
     "record_call", "maybe_record", "in_graph_mode", "reset_default_programs",
 ]
@@ -53,11 +54,39 @@ class Variable:
     static (shape, dtype) computed at build time via jax.eval_shape; the
     batch dim may be None/-1 (resolved by the feed at run time)."""
 
+    @staticmethod
+    def _normalize_shape(name: str, shape) -> tuple:
+        """Dims must be ints (None/-1 = run-time batch dim).  String dims —
+        a silent bug source upstream, where int("3") used to slip through
+        and "N" crashed deep in jax — raise with a clear message."""
+        dims = []
+        for i, d in enumerate(shape):
+            if d is None:
+                dims.append(None)
+                continue
+            if isinstance(d, str):
+                raise InvalidArgumentError(
+                    f"Variable {name!r}: shape dim {i} is a string "
+                    f"({d!r}); dims must be integers — use None or -1 "
+                    f"for the run-time batch dimension")
+            try:
+                di = int(d)
+            except (TypeError, ValueError) as e:
+                raise InvalidArgumentError(
+                    f"Variable {name!r}: shape dim {i} ({d!r}) is not "
+                    f"convertible to an integer") from e
+            if di != d:
+                raise InvalidArgumentError(
+                    f"Variable {name!r}: shape dim {i} ({d!r}) is not an "
+                    f"integer")
+            dims.append(None if di == -1 else di)
+        return tuple(dims)
+
     def __init__(self, program: "Program", name: str, shape, dtype,
                  *, is_param: bool = False, stop_gradient: bool = False):
         self.program = program
         self.name = name
-        self.shape = tuple(None if d in (None, -1) else int(d) for d in shape)
+        self.shape = self._normalize_shape(name, shape)
         self.dtype = convert_dtype(dtype)
         self.is_parameter = is_param
         self.stop_gradient = stop_gradient
@@ -169,6 +198,54 @@ class Op:
             else scoped
 
 
+class DefUseIndex:
+    """Def-use view over a Program's op list (see Program.def_use).
+
+    ``producers``/``consumers`` map variable name → op positions;
+    ``op_inputs`` lists the Variable leaves each op consumes.  ``order``
+    is the topological op order (the record order)."""
+
+    def __init__(self, program: "Program", producers, consumers, op_inputs):
+        self.program = program
+        self.producers: Dict[str, List[int]] = producers
+        self.consumers: Dict[str, List[int]] = consumers
+        self.op_inputs: List[List[Variable]] = op_inputs
+
+    @property
+    def order(self) -> List[int]:
+        return list(range(len(self.program.ops)))
+
+    def feed_names(self) -> List[str]:
+        """Variables with no producing op that are not parameters/buffers —
+        the feed placeholders the program expects at run time."""
+        prog = self.program
+        return [n for n, v in prog.vars.items()
+                if n not in self.producers and not v.is_parameter
+                and n not in prog.scope and n not in prog.buffers]
+
+    def sink_names(self) -> List[str]:
+        """Produced-but-never-consumed variables — fetch candidates."""
+        return [n for n in self.producers if n not in self.consumers]
+
+    def ops_reaching(self, roots: Sequence[str]) -> set:
+        """Op positions on a def-use path to any root name (backward
+        reachability — everything else is dead code w.r.t. ``roots``)."""
+        live_ops: set = set()
+        stack = [n for n in roots if n in self.producers]
+        seen = set(stack)
+        while stack:
+            name = stack.pop()
+            for i in self.producers.get(name, ()):
+                if i in live_ops:
+                    continue
+                live_ops.add(i)
+                for v in self.op_inputs[i]:
+                    if v.name not in seen:
+                        seen.add(v.name)
+                        stack.append(v.name)
+        return live_ops
+
+
 class Program:
     """The recorded graph + its parameter/buffer scope.
 
@@ -193,6 +270,10 @@ class Program:
         self._name_i = 0
         self.random_seed = None
         self._version = 0  # bumped per recorded op → invalidates jit cache
+        # names re-declared with a DIFFERENT Variable object — the dict
+        # collapses them, so the collision is recorded here for the
+        # program verifier (analysis/verify_program.py, rule V104)
+        self._dup_names: List[str] = []
 
     # -- naming --------------------------------------------------------------
     def unique_name(self, prefix: str) -> str:
@@ -200,6 +281,9 @@ class Program:
         return f"_{self.idx}_{prefix}_{self._name_i}"
 
     def add_var(self, var: Variable):
+        prev = self.vars.get(var.name)
+        if prev is not None and prev is not var:
+            self._dup_names.append(var.name)
         self.vars[var.name] = var
 
     def append_op(self, op: Op):
@@ -238,6 +322,30 @@ class Program:
     @property
     def blocks(self):
         return [self]
+
+    # -- def-use / topological index -----------------------------------------
+    def def_use(self) -> "DefUseIndex":
+        """Build the def-use index over the recorded op DAG: per-name
+        producer/consumer op positions plus per-op input Variables.  Record
+        order IS topological order by construction (each op only references
+        Variables that already exist); the index is what the program
+        verifier (paddle_tpu/analysis) and future pruning passes walk."""
+        producers: Dict[str, List[int]] = {}
+        consumers: Dict[str, List[int]] = {}
+        op_inputs: List[List[Variable]] = []
+        is_var = lambda x: isinstance(x, Variable)  # noqa: E731
+        for i, op in enumerate(self.ops):
+            ins = [leaf for leaf in jax.tree_util.tree_leaves(
+                (op.args, op.kwargs), is_leaf=is_var) if is_var(leaf)]
+            op_inputs.append(ins)
+            for v in ins:
+                consumers.setdefault(v.name, []).append(i)
+            for n in op.param_names + op.buffer_names:
+                consumers.setdefault(n, []).append(i)
+            for n in op.out_names:
+                producers.setdefault(n, []).append(i)
+        return DefUseIndex(program=self, producers=producers,
+                           consumers=consumers, op_inputs=op_inputs)
 
     def parameters_numpy(self) -> Dict[str, np.ndarray]:
         return {n: np.asarray(v) for n, v in self.scope.items()}
@@ -556,6 +664,16 @@ class Executor:
                             for k, v in feed_vals.items())))
         runner = self._cache.get(sig) if use_program_cache else None
         if runner is None:
+            if trace_events.active():
+                # one event per compiled signature → the retrace hazard
+                # detector diffs these to name the churning feed
+                trace_events.notify(
+                    ("executor", f"program#{program.idx}"),
+                    {"feeds": {k: (tuple(v.shape), str(v.dtype))
+                               for k, v in feed_vals.items()},
+                     "fetch": tuple(fetch_names),
+                     "train": train, "training": bool(training),
+                     "version": program._version})
             runner = self._build(program, fetch_names, train, bool(training))
             if use_program_cache:
                 self._cache[sig] = runner

@@ -1,0 +1,455 @@
+"""paddle_tpu.analysis — one deliberately-broken fixture per rule, each
+asserting its rule fires exactly once, plus the zero-false-positive sweep
+over the bundled model zoo and a slow self-check that the analyzer stays
+warning-clean on examples/.
+
+Reference capability: the IrGraph/pass_builder checkers the reference runs
+inside the C++ IR — here hoisted to build time, over the recorded Program.
+"""
+import glob
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu import nn, optimizer as popt
+from paddle_tpu.analysis import (RetraceMonitor, check_plan, lint_source,
+                                 render_json, render_text, verify_program)
+from paddle_tpu.analysis.runner import main as analysis_main
+from paddle_tpu.distributed.fleet import ShardingPlan
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.dy2static import Dy2StaticError
+from paddle_tpu.framework.errors import InvalidArgumentError
+from paddle_tpu.static.graph import (Op, Variable, record_call,
+                                     reset_default_programs)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    paddle.seed(0)
+    reset_default_programs()
+    yield
+    reset_default_programs()
+
+
+def _rule_count(diags, rule):
+    return sum(1 for d in diags if d.rule == rule)
+
+
+def _programs():
+    return fluid.Program(), fluid.Program()
+
+
+# -- program verifier (V1xx) --------------------------------------------------
+class TestVerifyProgram:
+    def test_clean_program_no_findings(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            y = fluid.data("y", [-1, 1])
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(fluid.layers.fc(x, 1), y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        assert verify_program(main, fetch_list=[loss]) == []
+
+    def test_v101_tampered_declaration(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            z = fluid.layers.relu(x)
+        main.vars[z.name].shape = (None, 99)  # tamper after recording
+        diags = verify_program(main)
+        assert _rule_count(diags, "V101") == 1
+        assert "99" in [d for d in diags if d.rule == "V101"][0].message
+
+    def test_v102_op_fails_inference(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            y = fluid.data("y", [-1, 5])
+        main.append_op(Op(lambda a, b: jnp.matmul(a, b), (x, y), {},
+                          ["z"], True))
+        diags = verify_program(main)
+        assert _rule_count(diags, "V102") == 1
+
+    def test_v103_foreign_program_capture(self):
+        prog_a, prog_b = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog_a):
+            x = fluid.data("x", [-1, 4])
+        with fluid.program_guard(prog_b):
+            record_call(lambda t: t + 1.0, x, out_names=["y"])
+        diags = verify_program(prog_b)
+        assert _rule_count(diags, "V103") == 1
+        assert "different" in [d for d in diags
+                               if d.rule == "V103"][0].message
+
+    def test_v103_never_produced(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            ghost = Variable(main, "ghost", (2, 2), "float32")  # not added
+            record_call(lambda t: t * 2.0, ghost, out_names=["y"])
+        diags = verify_program(main)
+        assert _rule_count(diags, "V103") == 1
+        assert "no op produces" in [d for d in diags
+                                    if d.rule == "V103"][0].message
+
+    def test_v104_duplicate_names(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            fluid.data("x", [-1, 4])
+            fluid.data("x", [-1, 8])
+        diags = verify_program(main)
+        assert _rule_count(diags, "V104") == 1
+
+    def test_v105_dead_op(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            h = fluid.layers.relu(x)
+            record_call(lambda t: t * 3.0, x,
+                        out_names=["dead"])  # never reaches the fetch
+            loss = fluid.layers.mean(h)
+        diags = verify_program(main, fetch_list=[loss])
+        assert _rule_count(diags, "V105") == 1
+        assert _rule_count(diags, "V106") == 0  # dead op, not dangling
+
+    def test_v106_dangling_output(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            u, v = record_call(lambda t: (t + 1.0, t * 2.0), x,
+                               out_names=["u", "v"])
+            loss = fluid.layers.mean(u)
+        diags = verify_program(main, fetch_list=[loss])
+        assert _rule_count(diags, "V106") == 1
+        assert "'v'" in [d for d in diags if d.rule == "V106"][0].message
+
+    def test_v107_param_mutated(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            fluid.layers.fc(x, 2)
+        pname = next(iter(main.scope))
+        shape = tuple(main.scope[pname].shape)
+        main.append_op(Op(lambda: jnp.zeros(shape, jnp.float32), (), {},
+                          [pname], True))
+        diags = verify_program(main)
+        assert _rule_count(diags, "V107") == 1
+
+    def test_v108_fully_unknown_feed(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, -1])
+            fluid.layers.relu(x)
+        diags = verify_program(main)
+        assert _rule_count(diags, "V108") == 1
+
+    def test_no_roots_skips_reachability(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            fluid.layers.relu(x)  # a sink, but every sink is fetchable
+        diags = verify_program(main)  # no fetch_list, no bound loss
+        assert _rule_count(diags, "V105") == 0
+        assert _rule_count(diags, "V106") == 0
+
+
+# -- dy2static linter (D2xx/D3xx) --------------------------------------------
+class TestLintDy2static:
+    def test_d201_generator(self):
+        diags = lint_source("""
+            def f(x):
+                for i in range(3):
+                    yield x + i
+        """)
+        assert _rule_count(diags, "D201") == 1
+
+    def test_d202_global_in_block(self):
+        diags = lint_source("""
+            def f(x):
+                if x > 0:
+                    global COUNT
+                    COUNT = 1
+                return x
+        """)
+        assert _rule_count(diags, "D202") == 1
+
+    def test_d203_return_in_tensor_branch(self):
+        diags = lint_source("""
+            def f(x):
+                if x.sum() > 0:
+                    return x
+                return -x
+        """)
+        d203 = [d for d in diags if d.rule == "D203"]
+        assert len(d203) == 1
+        assert d203[0].location.line == 4  # the `return x` line
+
+    def test_d204_break_in_tensor_loop(self):
+        diags = lint_source("""
+            def f(x):
+                while x > 0:
+                    x = x - 1
+                    if x.sum() < 3:
+                        break
+                return x
+        """)
+        assert _rule_count(diags, "D204") == 1
+
+    def test_d301_host_sync_in_loop(self):
+        diags = lint_source("""
+            def f(x):
+                s = 0.0
+                for i in range(10):
+                    s = s + float(x)
+                return s
+        """)
+        assert _rule_count(diags, "D301") == 1
+
+    def test_d302_print_traced_in_loop(self):
+        diags = lint_source("""
+            def f(x):
+                for i in range(3):
+                    print(x)
+                return x
+        """)
+        assert _rule_count(diags, "D302") == 1
+
+    def test_concrete_control_flow_is_clean(self):
+        diags = lint_source("""
+            def f(x, mode=None):
+                if mode is None:
+                    return x
+                for i in range(len(x.shape)):
+                    if x.shape[i] == 1:
+                        continue
+                n = 5
+                while n > 0:
+                    n -= 1
+                    if n == 2:
+                        break
+                return x
+        """)
+        assert diags == []
+
+    def test_executor_results_are_host_values(self):
+        # regression: exe = fluid.Executor(); loss, = exe.run(...) must
+        # NOT taint — Executor.run returns numpy (examples/ idiom)
+        diags = lint_source("""
+            def main():
+                exe = fluid.Executor(fluid.CPUPlace())
+                for step in range(20):
+                    loss_v, = exe.run(prog, feed={}, fetch_list=[1])
+                    print(f"loss {float(loss_v):.4f}")
+        """)
+        assert diags == []
+
+
+# -- retrace hazard detector (R4xx) ------------------------------------------
+class TestRetraceMonitor:
+    def test_r401_jit_shape_churn(self):
+        @paddle.jit.to_static
+        def f(a):
+            return a + 1.0
+
+        with RetraceMonitor(budget=2) as mon:
+            for n in range(1, 5):
+                f(jnp.ones((n,), jnp.float32))
+        diags = mon.diagnostics()
+        r401 = [d for d in diags if d.rule == "R401"]
+        assert len(r401) == 1
+        assert "shape varies" in r401[0].message
+
+    def test_r402_executor_feed_churn(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            y = fluid.layers.mean(fluid.layers.relu(x))
+        exe = fluid.Executor()
+        exe.run(startup)
+        with RetraceMonitor(budget=2) as mon:
+            for n in range(1, 5):
+                exe.run(main, feed={"x": np.ones((n, 4), np.float32)},
+                        fetch_list=[y])
+        diags = mon.diagnostics()
+        r402 = [d for d in diags if d.rule == "R402"]
+        assert len(r402) == 1
+        assert "shape varies" in r402[0].message
+
+    def test_within_budget_is_silent(self):
+        @paddle.jit.to_static
+        def g(a):
+            return a * 2.0
+
+        with RetraceMonitor(budget=8) as mon:
+            for _ in range(20):  # same signature every call
+                g(jnp.ones((2,), jnp.float32))
+        assert mon.distinct_signatures(
+            "jit", g._orig.__qualname__ if hasattr(g, "_orig") else "g") <= 1
+        assert mon.diagnostics() == []
+
+
+# -- sharding plan checker (P5xx) --------------------------------------------
+class _OneParam(nn.Layer):
+    def __init__(self, shape, spec=None):
+        super().__init__()
+        self.w = self.create_parameter(list(shape))
+        if spec is not None:
+            self.w.partition_spec = spec
+
+
+class TestCheckPlan:
+    def test_p501_unknown_axis(self):
+        mesh = build_mesh(dp=4, mp=2)
+        plan = ShardingPlan(_OneParam((4, 4), spec=(None, "bogus")),
+                            None, None, mesh=mesh)
+        diags = check_plan(plan)
+        assert _rule_count(diags, "P501") == 1
+
+    def test_p502_not_divisible(self):
+        mesh = build_mesh(dp=4, mp=2)
+        plan = ShardingPlan(_OneParam((4, 3), spec=(None, "model")),
+                            None, None, mesh=mesh)
+        diags = check_plan(plan)
+        assert _rule_count(diags, "P502") == 1
+
+    def test_p503_axis_double_booked(self):
+        mesh = build_mesh(dp=4, mp=2)
+        plan = ShardingPlan(_OneParam((4, 4), spec=("model", "model")),
+                            None, None, mesh=mesh)
+        diags = check_plan(plan)
+        assert _rule_count(diags, "P503") == 1
+
+    def test_p504_rank_mismatch(self):
+        mesh = build_mesh(dp=4, mp=2)
+        plan = ShardingPlan(_OneParam((4,), spec=("model", None)),
+                            None, None, mesh=mesh)
+        diags = check_plan(plan)
+        assert _rule_count(diags, "P504") == 1
+
+    def test_p505_replicated_optimizer_state(self):
+        mesh = build_mesh(dp=4, sharding=2)
+        plan = ShardingPlan(_OneParam((3, 5)), popt.Momentum(),
+                            None, mesh=mesh)
+        diags = check_plan(plan)
+        assert _rule_count(diags, "P505") == 1
+
+    def test_valid_plan_is_clean(self):
+        mesh = build_mesh(dp=4, mp=2)
+        plan = ShardingPlan(_OneParam((4, 8), spec=(None, "model")),
+                            None, None, mesh=mesh)
+        assert check_plan(plan) == []
+
+
+# -- diagnostics core ---------------------------------------------------------
+class TestDiagnostics:
+    def test_render_and_json(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            fluid.data("x", [-1, 4])
+            fluid.data("x", [-1, 8])
+        diags = verify_program(main)
+        text = render_text(diags)
+        assert "[V104]" in text and "error" in text
+        import json
+        parsed = json.loads(render_json(diags))
+        assert parsed[0]["rule"] == "V104"
+        assert parsed[0]["severity"] == "error"
+
+    def test_exit_codes(self, tmp_path):
+        bad = tmp_path / "bad_module.py"
+        bad.write_text(textwrap.dedent("""
+            from paddle_tpu.jit import to_static
+
+            @to_static
+            def f(x):
+                if x.sum() > 0:
+                    return x
+                return -x
+        """))
+        # D203 is error severity → rc 1 even without --strict
+        assert analysis_main(["--no-exec", str(bad)]) == 1
+        ok = tmp_path / "ok_module.py"
+        ok.write_text("def f(x):\n    return x + 1\n")
+        assert analysis_main(["--no-exec", str(ok)]) == 0
+        assert analysis_main(["--no-exec", "--all-functions",
+                              str(ok)]) == 0
+
+
+# -- satellite regressions ----------------------------------------------------
+class TestVariableShapeValidation:
+    def test_string_dim_raises(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            with pytest.raises(InvalidArgumentError, match="string"):
+                fluid.data("x", ["batch", 4])
+
+    def test_int_like_dims_normalize(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            v = fluid.data("x", [np.int64(3), -1])
+        assert v.shape == (3, None)
+
+    def test_non_int_dim_raises(self):
+        main, startup = _programs()
+        with fluid.program_guard(main, startup):
+            with pytest.raises(InvalidArgumentError):
+                fluid.data("x", [2.5, 4])
+
+
+class TestDy2StaticErrorLocation:
+    def test_location_attached(self):
+        def culprit():
+            raise Dy2StaticError("boom")
+
+        with pytest.raises(Dy2StaticError) as ei:
+            culprit()
+        e = ei.value
+        assert e.func_name == "culprit"
+        assert e.filename and e.filename.endswith("test_analysis.py")
+        assert isinstance(e.lineno, int)
+        assert "[at " in str(e) and "culprit" in str(e)
+
+    def test_explicit_location_wins(self):
+        e = Dy2StaticError("bad", func_name="g", filename="m.py", lineno=7)
+        assert (e.func_name, e.filename, e.lineno) == ("g", "m.py", 7)
+        assert "m.py:7" in str(e)
+
+
+# -- zero-false-positive sweeps ----------------------------------------------
+ZOO = [
+    "paddle_tpu.models.bert",
+    "paddle_tpu.models.gpt",
+    "paddle_tpu.vision.models.resnet",
+    "paddle_tpu.vision.models.vgg",
+    "paddle_tpu.vision.models.lenet",
+    "paddle_tpu.vision.models.mobilenetv1",
+    "paddle_tpu.vision.models.mobilenetv2",
+]
+
+
+class TestZeroFalsePositives:
+    def test_model_zoo_is_clean(self, capsys):
+        rc = analysis_main(["--strict"] + ZOO)
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "no findings" in out
+
+    @pytest.mark.slow
+    def test_examples_are_warning_clean(self):
+        scripts = sorted(glob.glob(os.path.join(REPO, "examples", "*.py")))
+        assert scripts, "examples/ went missing"
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis", "--no-exec",
+             "--all-functions", "--strict"] + scripts,
+            cwd=REPO, capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stdout + r.stderr

@@ -1,0 +1,16 @@
+#!/usr/bin/env python
+"""Run the paddle_tpu static-analysis passes over modules or scripts.
+
+Thin wrapper over ``python -m paddle_tpu.analysis`` so the tool is
+discoverable next to the other repo tooling; see that module (or README
+"Static analysis") for flags and the rule catalog.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from paddle_tpu.analysis import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,13 +4,14 @@
 # tools/test_runner.py; this is the paddle_tpu equivalent).
 #
 # Stages (each timed, JSON summary at the end):
+#   analyze python -m paddle_tpu.analysis (static analysis, CPU, seconds)
 #   fast    pytest -m fast           (~3 min sanity lane)
 #   suite   pytest tests/            (full suite)
 #   audit   tools/api_parity_audit.py (implemented/shimmed/missing counts)
 #   dryrun  __graft_entry__.dryrun_multichip(8) on a virtual CPU mesh
 #   bench   python bench.py          (only when a real TPU answers)
 #
-# Usage:  tools/run_gates.sh [--skip fast|suite|audit|dryrun|bench]...
+# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|bench]...
 #         tools/run_gates.sh --only suite
 # Exit code: 0 iff every stage that ran passed.
 set -u
@@ -72,6 +73,13 @@ run_stage() {  # name cmd...
     rm -f "$log"
   fi
 }
+
+# static analysis first: cheapest gate, no device work (JAX_PLATFORMS=cpu)
+run_stage analyze env JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --strict \
+  paddle_tpu.models.bert paddle_tpu.models.gpt \
+  paddle_tpu.vision.models.resnet paddle_tpu.vision.models.vgg \
+  paddle_tpu.vision.models.lenet paddle_tpu.vision.models.mobilenetv1 \
+  paddle_tpu.vision.models.mobilenetv2
 
 run_stage fast   python -m pytest tests/ -m fast -q
 run_stage suite  python -m pytest tests/ -q
