@@ -66,6 +66,8 @@ RULES = {
     # -- retrace hazard detector (R4xx) ------------------------------------
     "R401": (Severity.WARNING, "to_static signature explosion (jit retraces)"),
     "R402": (Severity.WARNING, "Executor signature explosion (recompiles)"),
+    "R403": (Severity.WARNING,
+             "Executor compile-cache churn (LRU evictions past budget)"),
     # -- sharding plan checker (P5xx) --------------------------------------
     "P501": (Severity.ERROR, "partition spec names an axis not in the mesh"),
     "P502": (Severity.ERROR,

@@ -49,6 +49,9 @@ class RetraceMonitor:
         self._lock = threading.Lock()
         self._sites: Dict[Tuple[str, str], List[dict]] = {}
         self._seen: Dict[Tuple[str, str], set] = {}
+        # ("executor_cache", name) counter snapshots: latest value per
+        # executor, NOT deduped signature events (rule R403)
+        self._cache_sites: Dict[str, dict] = {}
 
     # -- subscription --------------------------------------------------------
     def install(self):
@@ -65,6 +68,13 @@ class RetraceMonitor:
 
     def _on_event(self, site, info):
         key = tuple(site)
+        if key[0] == "executor_cache":
+            # counter snapshot: keep only the latest per executor — routing
+            # these through the signature dedup below would mint a distinct
+            # "signature" per counter tick and inflate R402
+            with self._lock:
+                self._cache_sites[key[1]] = dict(info)
+            return
         sig = _freeze(info)
         with self._lock:
             seen = self._seen.setdefault(key, set())
@@ -76,6 +86,14 @@ class RetraceMonitor:
     # -- analysis ------------------------------------------------------------
     def distinct_signatures(self, kind: str, name: str) -> int:
         return len(self._sites.get((kind, name), ()))
+
+    def cache_stats(self, name: str = None):
+        """Latest compile-cache counter snapshot(s) observed: the dict for
+        one executor (``name`` like ``"executor#1"``), or all of them."""
+        with self._lock:
+            if name is not None:
+                return dict(self._cache_sites.get(name, {}))
+            return {k: dict(v) for k, v in self._cache_sites.items()}
 
     def diagnostics(self) -> List[Diagnostic]:
         out = DiagnosticCollector()
@@ -97,6 +115,26 @@ class RetraceMonitor:
                     hint="pad inputs to a fixed shape bucket, cast feeds "
                          "to one dtype, and hoist Python-value arguments "
                          "out of the traced signature")
+        with self._lock:
+            cache_sites = {k: dict(v) for k, v in self._cache_sites.items()}
+        for name, stats in cache_sites.items():
+            evictions = int(stats.get("evictions", 0))
+            if evictions <= self.budget:
+                continue
+            out.add("R403",
+                    f"{name} evicted {evictions} compiled runners "
+                    f"(budget {self.budget}; capacity "
+                    f"{stats.get('capacity')}, {stats.get('misses')} "
+                    f"misses / {stats.get('hits')} hits) — the working "
+                    f"set of run signatures exceeds the cache, so steps "
+                    f"recompile instead of reusing executables",
+                    location=Location(file=name, function=name),
+                    hint="raise FLAGS_executor_cache_capacity (or "
+                         "Executor(cache_capacity=...)), reduce distinct "
+                         "feed geometries, or enable "
+                         "sysconfig.enable_persistent_compilation_cache() "
+                         "so evicted entries recompile from the on-disk "
+                         "XLA cache")
         return out.diagnostics
 
     @staticmethod

@@ -95,3 +95,13 @@ define_flag("eager_delete_tensor_gb", 0.0,
             "Ignored on TPU: XLA owns buffer lifetimes; kept for parity "
             "(ref: FLAGS_eager_delete_tensor_gb).")
 define_flag("log_level", 0, "Verbosity for paddle_tpu host-side logging.")
+define_flag("executor_cache_capacity", 64,
+            "LRU capacity of each Executor's compiled-runner cache. Every "
+            "distinct (program version, feed signature, fetch set) pins one "
+            "XLA executable; unbounded growth is a slow leak, a too-small "
+            "cap recompiles every run (surfaced as analysis rule R403).")
+define_flag("persistent_compilation_cache", "",
+            "Non-empty: enable JAX's persistent compilation cache at this "
+            "directory ('1'/'true' picks a default under ~/.cache), so "
+            "repeated process launches skip XLA recompiles. See "
+            "sysconfig.enable_persistent_compilation_cache().")

@@ -6,6 +6,15 @@ paddle_tpu/analysis/retrace.py) diff the signature stream to name the
 argument whose shape/dtype churn is causing a signature explosion.  With no
 subscribers registered the publish sites are a single falsy check — zero
 cost on the hot path.
+
+Two event families share the bus, distinguished by ``site[0]``:
+
+* ``("jit"|"executor", name)`` — one event per compiled signature, ``info``
+  holds hashable signature components (diffed by the retrace detector);
+* ``("executor_cache", name)`` — compile-cache counter snapshots
+  (hits/misses/evictions/size/dispatches), published on every
+  ``Executor.run``/``run_steps``; latest value wins (cache-churn rule
+  R403), so these must NOT be deduped like signature events.
 """
 from __future__ import annotations
 

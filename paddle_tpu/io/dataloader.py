@@ -220,6 +220,7 @@ class DataLoader:
         worker_init_fn: Optional[Callable] = None,
         return_numpy: bool = False,
         sampler: Optional[Sampler] = None,
+        superbatch: int = 1,
     ):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
@@ -229,6 +230,12 @@ class DataLoader:
         self.timeout = timeout or None
         self.worker_init_fn = worker_init_fn
         self.return_numpy = return_numpy
+        # superbatch=k: yield k batches stacked along a new leading axis —
+        # the feed format Executor.run_steps / StaticFunction.run_steps
+        # scan over.  The stack happens before device staging, so the
+        # staging thread device_puts whole superbatches while the previous
+        # fused chain is still executing.
+        self.superbatch = max(int(superbatch), 1)
         self._iterable_mode = isinstance(dataset, IterableDataset)
 
         if self._iterable_mode:
@@ -305,6 +312,36 @@ class DataLoader:
                     pending.append(pool.submit(_fetch_batch, nxt))
                 yield fut.result(timeout=self.timeout)
 
+    def _iter_superbatch(self, source):
+        """Group ``superbatch`` consecutive batches and stack each field
+        along a new axis 0 — the stacked-feed format the fused multi-step
+        runners (Executor.run_steps) scan over.  A trailing group with
+        fewer than ``superbatch`` batches is still yielded (run_steps
+        infers the chain length from the leading dim); a batch whose
+        shapes differ from the group so far (e.g. a short final batch
+        when drop_last=False) flushes the group first rather than failing
+        the stack."""
+
+        def stack(buf):
+            return jax.tree_util.tree_map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs], axis=0),
+                *buf)
+
+        buf, sig = [], None
+        for batch in source:
+            s = tuple(repr(getattr(x, "shape", type(x)))
+                      for x in jax.tree_util.tree_leaves(batch))
+            if buf and s != sig:
+                yield stack(buf)
+                buf = []
+            sig = s
+            buf.append(batch)
+            if len(buf) == self.superbatch:
+                yield stack(buf)
+                buf = []
+        if buf:
+            yield stack(buf)
+
     def __iter__(self):
         if self._iterable_mode:
             source = self._iter_iterable()
@@ -312,6 +349,8 @@ class DataLoader:
             source = self._iter_workers()
         else:
             source = self._iter_sync()
+        if self.superbatch > 1:
+            source = self._iter_superbatch(source)
         if self.return_numpy:
             return iter(source)
         if self.use_buffer_reader:

@@ -20,6 +20,7 @@ import functools
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from .framework import trace_events
@@ -141,6 +142,11 @@ class StaticFunction:
         return cache[key]
 
     def __call__(self, *args, **kwargs):
+        iterations = kwargs.pop("iterations", None)
+        if iterations is not None:  # fused multi-step form
+            return self.run_steps(
+                *args, iterations=iterations,
+                fetch_every=kwargs.pop("fetch_every", 1), **kwargs)
         if not _to_static_enabled:  # ProgramTranslator.enable(False)
             if self._layer is not None and not isinstance(self._orig, Layer):
                 return self._orig(self._layer, *args, **kwargs)
@@ -184,6 +190,108 @@ class StaticFunction:
         for name, v in new_bufs.items():  # eager BN-stat semantics
             boxes[name].value = v
         return out
+
+    # -- fused multi-step execution ------------------------------------------
+    def run_steps(self, *stacked_args, iterations=None, fetch_every=1):
+        """Run N forward steps as ONE jitted ``lax.scan`` dispatch.
+
+        Each positional arg carries a leading ``iterations`` axis (the
+        superbatch format ``DataLoader(superbatch=k)`` yields); buffers (BN
+        running stats, step counters) are carried across the chain and
+        written back once at the end, so N calls cost one device round-trip
+        instead of N.  ``fetch_every=k`` keeps every k-th step's outputs
+        (selected inside the jit).  Returns outputs with a leading
+        ``N // fetch_every`` axis.  Equivalent to ``fn(..., iterations=N)``.
+        """
+        fetch_every = int(fetch_every)
+        if fetch_every < 1:
+            raise InvalidArgumentError("fetch_every must be >= 1")
+        if iterations is None:
+            for a in stacked_args:
+                if hasattr(a, "shape") and len(a.shape) >= 1:
+                    iterations = int(a.shape[0])
+                    break
+        if iterations is None:
+            raise InvalidArgumentError(
+                "run_steps needs iterations=N or at least one stacked "
+                "array argument to infer the chain length from")
+        n_steps = int(iterations)
+        if n_steps < 1:
+            raise InvalidArgumentError("run_steps needs iterations >= 1")
+        for a in stacked_args:
+            if hasattr(a, "shape") and (len(a.shape) < 1
+                                        or int(a.shape[0]) != n_steps):
+                raise InvalidArgumentError(
+                    f"run_steps: stacked arg has leading dim "
+                    f"{tuple(a.shape)[:1]}, expected iterations={n_steps}")
+
+        if not _to_static_enabled:  # eager fallback: real per-step loop
+            outs = [self(*[a[t] for a in stacked_args])
+                    for t in range(n_steps)]
+            outs = outs[fetch_every - 1::fetch_every]
+            return jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs, 0), *outs)
+
+        if trace_events.active():
+            name = getattr(self._orig, "__qualname__",
+                           type(self._orig).__name__)
+            trace_events.notify(
+                ("jit", name),
+                {"args": _arg_signature(stacked_args),
+                 "mode": f"run_steps[{n_steps}]",
+                 "training": (self._layer.training
+                              if self._layer is not None else None)})
+        layer = self._layer
+        if layer is None:  # pure function: no state to carry
+            chain = self._get_chain(None, fetch_every, n_steps)
+            return chain(tuple(stacked_args))
+        chain = self._get_chain(layer.training, fetch_every, n_steps)
+        out, new_bufs = chain(layer.param_pytree(), layer.buffer_pytree(),
+                              tuple(stacked_args))
+        boxes = dict(layer.named_buffers())
+        for name, v in new_bufs.items():
+            boxes[name].value = v
+        return out
+
+    def _get_chain(self, training, fetch_every, n_steps):
+        """Memoized scan-of-self._jitted chains, keyed like jax.jit would
+        key (training flag is a static arg; n_steps/fetch_every shape the
+        scan)."""
+        cache = self.__dict__.setdefault("_chain_cache", {})
+        key = (training, fetch_every, n_steps)
+        if key in cache:
+            return cache[key]
+        jitted = self._jitted
+
+        def subsample(ys):
+            if fetch_every > 1:
+                keep = jnp.arange(fetch_every - 1, n_steps, fetch_every)
+                ys = jax.tree_util.tree_map(lambda y: y[keep], ys)
+            return ys
+
+        if self._layer is None:
+            def chain(stacked):
+                def body(carry, xs):
+                    return carry, jitted(*xs)
+
+                _, ys = jax.lax.scan(body, 0, stacked, length=n_steps)
+                return subsample(ys)
+
+            cache[key] = jax.jit(chain)
+            return cache[key]
+
+        def chain(params, buffers, stacked):
+            def body(bufs, xs):
+                out, nb = jitted(params, bufs, training, *xs)
+                return nb, out
+
+            bufs, ys = jax.lax.scan(body, buffers, stacked, length=n_steps)
+            return subsample(ys), bufs
+
+        # donate buffers (carried through the scan, rewritten into the
+        # layer's boxes after) — NOT params, which stay live layer state
+        cache[key] = jax.jit(chain, donate_argnums=(1,))
+        return cache[key]
 
     @property
     def forward(self):

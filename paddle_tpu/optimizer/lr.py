@@ -74,6 +74,13 @@ class LRScheduler:
             f"{type(self).__name__} has no closed-form value_at; use eager step()/get_lr()"
         )
 
+    def supports_in_graph(self) -> bool:
+        """True when this schedule has a closed-form ``value_at(step)`` that
+        can be traced inside a fused ``Executor.run_steps`` chain.  Stateful
+        schedules (LambdaDecay, ReduceOnPlateau) return False and fall back
+        to a host-precomputed lr sequence."""
+        return type(self).value_at is not LRScheduler.value_at
+
     # Persist only the schedule *position* (paddle parity: lr.py keeps
     # last_epoch/last_lr) — hyperparameters belong to the constructor, so a
     # checkpoint never silently reverts a re-configured schedule.

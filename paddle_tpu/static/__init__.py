@@ -260,7 +260,12 @@ class BuildStrategy:
 
 class ExecutionStrategy(BuildStrategy):
     """Executor-thread knob bag (ref: details/execution_strategy.h:22) —
-    same accepted-but-inert contract as BuildStrategy."""
+    mostly the same accepted-but-inert contract as BuildStrategy, with one
+    live knob: ``num_iteration_per_run > 1`` passed via
+    ``Executor(strategy=...)`` becomes the default chain length for the
+    fused multi-step path (``Executor.run_steps`` with no explicit
+    ``iterations=``), matching the reference semantics of running several
+    iterations per ``exe.run`` call."""
 
     _DEFAULTS = {
         "num_threads": 0,

@@ -31,7 +31,9 @@ everywhere else in this framework.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import threading
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -605,6 +607,55 @@ def run_ops(ops, env: Dict[str, Any], params: Dict[str, Any],
                 env[n] = o
 
 
+class _CompileCache:
+    """LRU-bounded map: run signature → compiled runner.
+
+    An unbounded executor cache is a slow leak on long-lived processes
+    (every distinct feed geometry pins a compiled XLA executable forever);
+    a *churning* bounded cache is a perf bug (recompiles on every run).
+    Both are observable: hit/miss/eviction counters are published on the
+    ``framework.trace_events`` bus under an ``("executor_cache", name)``
+    site, and ``analysis.retrace`` turns sustained eviction churn into an
+    R403 diagnostic."""
+
+    def __init__(self, capacity: int):
+        self.capacity = max(int(capacity), 1)
+        self._entries: "OrderedDict[Tuple, Callable]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, sig) -> Optional[Callable]:
+        runner = self._entries.get(sig)
+        if runner is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        self._entries.move_to_end(sig)
+        return runner
+
+    def put(self, sig, runner) -> None:
+        self._entries[sig] = runner
+        self._entries.move_to_end(sig)
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+
+    def stats(self) -> Dict[str, int]:
+        return {"capacity": self.capacity, "size": len(self._entries),
+                "hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions}
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, sig) -> bool:
+        return sig in self._entries
+
+
 class Executor:
     """Plays a recorded Program as one jitted XLA computation.
 
@@ -612,15 +663,51 @@ class Executor:
     was bound via ``minimize``, the same jitted step differentiates the
     recorded graph (jax.grad — the append_backward replacement) and applies
     the functional update, donating old state.  Compiled executables are
-    cached per (program version, feed signature, fetch set, train flag).
+    cached per (program version, feed signature, fetch set, train flag) in
+    a bounded LRU (capacity from ``FLAGS_executor_cache_capacity`` or the
+    ``cache_capacity`` argument; counters on ``cache_stats()``).
+
+    ``run_steps(program, feed, fetch_list, iterations=N, fetch_every=k)``:
+    the fused multi-step path — chains N optimizer steps inside ONE jitted
+    ``lax.scan`` over batch-stacked feeds, so an epoch is one device
+    dispatch instead of N (the per-dispatch RTT, not compute, dominates a
+    per-step loop on remote accelerators).
+
+    ``strategy``: an ``ExecutionStrategy``; ``num_iteration_per_run > 1``
+    becomes the default chain length for ``run_steps``.
     """
 
-    def __init__(self, place=None):
+    _counter = 0
+
+    def __init__(self, place=None, strategy=None,
+                 cache_capacity: Optional[int] = None):
         self.place = place
-        self._cache: Dict[Tuple, Callable] = {}
+        self.strategy = strategy
+        Executor._counter += 1
+        self._idx = Executor._counter
+        if cache_capacity is None:
+            from ..framework.flags import flag
+
+            cache_capacity = flag("executor_cache_capacity")
+        self._cache = _CompileCache(cache_capacity)
+        self.dispatches = 0  # one per device round-trip (run / run_steps)
+        from ..sysconfig import maybe_enable_persistent_compilation_cache
+
+        maybe_enable_persistent_compilation_cache()
 
     def close(self):
         self._cache.clear()
+
+    def cache_stats(self) -> Dict[str, int]:
+        """Compile-cache counters plus the device dispatch count."""
+        s = self._cache.stats()
+        s["dispatches"] = self.dispatches
+        return s
+
+    def _publish_cache_stats(self):
+        if trace_events.active():
+            trace_events.notify(("executor_cache", f"executor#{self._idx}"),
+                                self.cache_stats())
 
     def _execute(self, program, params, buffers, feeds, training,
                  rng=None):
@@ -676,11 +763,219 @@ class Executor:
                      "version": program._version})
             runner = self._build(program, fetch_names, train, bool(training))
             if use_program_cache:
-                self._cache[sig] = runner
+                self._cache.put(sig, runner)
         outs = runner(program, feed_vals)
+        self.dispatches += 1
+        self._publish_cache_stats()
         if return_numpy:
             outs = [np.asarray(o) for o in outs]
         return outs
+
+    # -- fused multi-step execution -----------------------------------------
+    def run_steps(self, program: Optional[Program] = None, feed=None,
+                  fetch_list=None, iterations: Optional[int] = None,
+                  fetch_every: int = 1, constant_feeds=(),
+                  return_numpy: bool = True, training: Optional[bool] = None,
+                  use_program_cache: bool = True):
+        """Chain N optimizer steps inside ONE jitted ``lax.scan`` dispatch.
+
+        ``feed`` is either a dict of batch-stacked ("superbatch") arrays —
+        each non-constant feed carries a leading ``iterations`` axis (the
+        format ``DataLoader(superbatch=k)`` yields) — or an iterator of
+        per-step feed dicts (stacked on the host here).  ``constant_feeds``
+        names feeds held fixed across the chain; they are passed unstacked
+        and closed over instead of scanned (e.g. a fixed eval batch, or
+        a label table too big to replicate N times).
+
+        ``iterations`` defaults to the stacked leading dim, or to the bound
+        ``ExecutionStrategy.num_iteration_per_run`` when > 1.
+
+        Per-step host work moves into the traced loop: the learning rate is
+        computed in-graph as ``sched.value_at(base_epoch + t)`` when the
+        scheduler has a closed form (a host-precomputed ``[N]`` lr array is
+        scanned otherwise — metric-driven schedulers like ReduceOnPlateau
+        hold their current value across the chain), and per-step RNG keys
+        are ``fold_in(base_key, t)`` (the key *stream* differs from N
+        sequential ``run`` calls; the distribution does not).
+
+        Params, optimizer state, and buffers are donated across the whole
+        chain; ``fetch_every=k`` keeps every k-th step's fetches (selected
+        inside the jit, so only the subsample leaves the device).  Returns
+        one array per fetch with a leading ``N // fetch_every`` axis.
+        """
+        program = program or default_main_program()
+        if program._optimizer is None:
+            raise InvalidArgumentError(
+                "run_steps chains optimizer steps: bind one via "
+                "optimizer.minimize(loss) first (for eval loops, call "
+                "run() per batch or use jit.StaticFunction.run_steps)")
+        if not program.ops:
+            raise InvalidArgumentError("run_steps on an empty program")
+        fetch_list = list(fetch_list or [])
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list]
+        training = True if training is None else bool(training)
+        fetch_every = int(fetch_every)
+        if fetch_every < 1:
+            raise InvalidArgumentError("fetch_every must be >= 1")
+        constant = {f.name if isinstance(f, Variable) else str(f)
+                    for f in (constant_feeds or ())}
+
+        if iterations is None and self.strategy is not None:
+            n = int(getattr(self.strategy, "num_iteration_per_run", 1) or 1)
+            if n > 1:
+                iterations = n
+
+        if feed is None:
+            feed = {}
+        if not isinstance(feed, dict):
+            # an iterator/sequence of per-step feed dicts: stack on host
+            steps = list(itertools.islice(iter(feed), iterations)
+                         if iterations is not None else iter(feed))
+            if not steps:
+                raise InvalidArgumentError("run_steps: empty feed iterator")
+            iterations = len(steps)
+            feed = {k: (steps[0][k] if k in constant
+                        else np.stack([np.asarray(s[k]) for s in steps], 0))
+                    for k in steps[0]}
+
+        const_vals = {k: jnp.asarray(v) for k, v in feed.items()
+                      if k in constant}
+        stacked_vals = {k: jnp.asarray(v) for k, v in feed.items()
+                        if k not in constant}
+        if iterations is None:
+            if not stacked_vals:
+                raise InvalidArgumentError(
+                    "run_steps needs iterations=N when every feed is "
+                    "constant (nothing to infer the chain length from)")
+            iterations = int(next(iter(stacked_vals.values())).shape[0])
+        n_steps = int(iterations)
+        if n_steps < 1:
+            raise InvalidArgumentError("run_steps needs iterations >= 1")
+        for k, v in stacked_vals.items():
+            if v.ndim < 1 or int(v.shape[0]) != n_steps:
+                raise InvalidArgumentError(
+                    f"run_steps: stacked feed {k!r} has leading dim "
+                    f"{v.shape[:1]}, expected iterations={n_steps} — stack "
+                    f"per-step batches along a new axis 0, or list it in "
+                    f"constant_feeds")
+
+        opt = program._optimizer
+        sched = opt.lr_scheduler
+        if sched is None:
+            lr_mode = "const"
+        elif getattr(sched, "supports_in_graph", lambda: False)():
+            lr_mode = "graph"
+        else:
+            lr_mode = "host"
+
+        sig = (program.idx, "run_steps", program._version, n_steps,
+               fetch_every, training, lr_mode, tuple(fetch_names),
+               tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                            for k, v in stacked_vals.items())),
+               tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                            for k, v in const_vals.items())))
+        runner = self._cache.get(sig) if use_program_cache else None
+        if runner is None:
+            if trace_events.active():
+                trace_events.notify(
+                    ("executor", f"program#{program.idx}"),
+                    {"feeds": {k: (tuple(v.shape), str(v.dtype))
+                               for k, v in {**stacked_vals,
+                                            **const_vals}.items()},
+                     "fetch": tuple(fetch_names),
+                     "train": True, "training": training,
+                     "version": program._version,
+                     "mode": f"run_steps[{n_steps}]"})
+            runner = self._build_steps(program, fetch_names, training,
+                                       n_steps, fetch_every, lr_mode)
+            if use_program_cache:
+                self._cache.put(sig, runner)
+        outs = runner(program, stacked_vals, const_vals)
+        self.dispatches += 1
+        self._publish_cache_stats()
+        if return_numpy:
+            outs = [np.asarray(o) for o in outs]
+        return outs
+
+    def _build_steps(self, program, fetch_names, training, n_steps,
+                     fetch_every, lr_mode):
+        opt = program._optimizer
+        loss_name = program._loss_name
+        trainable = {n for n, t in program._param_trainable.items() if t}
+        only = getattr(program, "_minimize_only", None)
+        if only is not None:
+            trainable &= only
+        sched = opt.lr_scheduler
+
+        def chain(params, opt_state, buffers, stacked, const, lr_arg, rng):
+            def body(carry, xs):
+                params, opt_state, buffers = carry
+                if lr_mode == "host":
+                    t, feeds_t, lr_t = xs
+                else:
+                    t, feeds_t = xs
+                    lr_t = (sched.value_at(lr_arg + t)
+                            if lr_mode == "graph" else lr_arg)
+                feeds = {**feeds_t, **const}
+                rng_t = jax.random.fold_in(rng, t)
+                tp = {n: v for n, v in params.items() if n in trainable}
+                fp = {n: v for n, v in params.items() if n not in trainable}
+
+                def loss_fn(tp):
+                    env, nb = self._execute(
+                        program, {**tp, **fp}, buffers, feeds, training,
+                        rng=rng_t)
+                    return env[loss_name].astype(jnp.float32).sum(), (env, nb)
+
+                (loss, (env, nb)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(tp)
+                new_t, new_state = opt.update(grads, opt_state, tp, lr=lr_t)
+                fetched = [env[n] for n in fetch_names]
+                return ({**new_t, **fp}, new_state, nb), fetched
+
+            steps_idx = jnp.arange(n_steps, dtype=jnp.int32)
+            xs = ((steps_idx, stacked, lr_arg) if lr_mode == "host"
+                  else (steps_idx, stacked))
+            carry, ys = jax.lax.scan(body, (params, opt_state, buffers), xs)
+            if fetch_every > 1:
+                keep = jnp.arange(fetch_every - 1, n_steps, fetch_every)
+                ys = [y[keep] for y in ys]
+            params, opt_state, buffers = carry
+            return ys, params, opt_state, buffers
+
+        jitted = jax.jit(chain, donate_argnums=(0, 1, 2))
+
+        def runner(prog, stacked, const):
+            if prog._opt_state is None:
+                tp = {n: v for n, v in prog.scope.items() if n in trainable}
+                prog._opt_state = opt.init(tp)
+            if lr_mode == "graph":
+                lr_arg = jnp.asarray(sched.last_epoch, jnp.int32)
+            elif lr_mode == "host":
+                # host fallback: materialize the lr sequence by stepping
+                # the real scheduler — exactly what N sequential runs do
+                lrs = []
+                for _ in range(n_steps):
+                    lrs.append(float(opt.get_lr()))
+                    sched.step()
+                lr_arg = jnp.asarray(lrs, jnp.float32)
+            else:
+                lr_arg = jnp.asarray(opt.get_lr(), jnp.float32)
+            from ..framework import random as _prandom
+
+            rng = _prandom.default_generator().next_key()
+            fetched, new_params, prog._opt_state, new_bufs = jitted(
+                dict(prog.scope), prog._opt_state, dict(prog.buffers),
+                stacked, const, lr_arg, rng)
+            prog.scope.update(new_params)
+            prog.buffers.update(new_bufs)
+            if lr_mode == "graph":
+                for _ in range(n_steps):
+                    sched.step()
+            return fetched
+
+        return runner
 
     def _build(self, program, fetch_names, train, training):
         if train:
@@ -736,7 +1031,13 @@ class Executor:
                                     training, rng=rng)
             return [env[n] for n in fetch_names], nb
 
-        jitted = jax.jit(fwd)
+        # donate buffers (argnum 1): every key is rewritten from ``nb`` so
+        # stale device arrays are safely consumed.  NOT params — eval never
+        # writes them back, so donation would delete live scope arrays.
+        # Test clones skip write-back entirely (frozen BN stats), so their
+        # buffers must not be donated either.
+        donate = () if getattr(program, "_is_test_clone", False) else (1,)
+        jitted = jax.jit(fwd, donate_argnums=donate)
 
         def runner(prog, feeds):
             from ..framework import random as _prandom

@@ -10,7 +10,9 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["get_include", "get_lib"]
+__all__ = ["get_include", "get_lib",
+           "enable_persistent_compilation_cache",
+           "maybe_enable_persistent_compilation_cache"]
 
 
 def get_include() -> str:
@@ -26,3 +28,51 @@ def get_lib() -> str:
 
     os.makedirs(_CACHE_DIR, exist_ok=True)
     return _CACHE_DIR
+
+
+# -- persistent XLA compilation cache ----------------------------------------
+_pcc_enabled = False
+
+
+def enable_persistent_compilation_cache(cache_dir: str | None = None) -> str:
+    """Point JAX's persistent compilation cache at ``cache_dir`` so compiled
+    XLA executables survive process restarts (the in-process Executor LRU
+    only helps within one run).  Returns the directory used.
+
+    Idempotent; safe to call before or after the first compile — only
+    computations compiled afterwards are cached.
+    """
+    global _pcc_enabled
+    import jax
+
+    if not cache_dir:
+        cache_dir = os.path.join(
+            os.path.expanduser("~"), ".cache", "paddle_tpu", "xla_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # cache even fast compiles / small entries — knob names vary across
+    # jax releases, so best-effort
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(knob, val)
+        except (AttributeError, ValueError):
+            pass
+    _pcc_enabled = True
+    return cache_dir
+
+
+def maybe_enable_persistent_compilation_cache() -> None:
+    """Flag-gated hook (FLAGS_persistent_compilation_cache): called from
+    ``Executor.__init__`` so setting the flag/env var is all a user needs.
+    A value of ``1``/``true`` picks the default directory; any other
+    non-empty value is used as the directory itself."""
+    if _pcc_enabled:
+        return
+    from .framework.flags import flag
+
+    val = str(flag("persistent_compilation_cache") or "").strip()
+    if not val:
+        return
+    enable_persistent_compilation_cache(
+        None if val.lower() in ("1", "true", "yes", "on") else val)

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""perf-smoke gate: the fused multi-step path on a tiny MLP, CPU, seconds.
+
+Two lanes over identically-initialized programs and identical batches:
+
+  per_step   N Executor.run calls   -> N device dispatches
+  fused      Executor.run_steps(N)  -> ONE device dispatch (lax.scan chain)
+
+Asserts (1) the fused chain issues exactly one dispatch where the per-step
+lane issues N — the dispatch-amortization property the bench configs rely
+on — and (2) the two lanes produce numerically matching per-step losses
+and final parameters, so the fused path is exercised end-to-end on every
+gate run.  Emits one JSON line with both wall-clock timings (CPU timings
+are NOT a throughput claim; the property under test is dispatch count and
+equivalence).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_STEPS = 4
+
+
+def build():
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.static.graph import reset_default_programs
+
+    paddle.seed(0)  # identical init across lanes
+    reset_default_programs()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 16])
+        y = fluid.data("y", [-1, 1])
+        h = fluid.layers.fc(x, 32, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    return exe, main, loss
+
+
+def main():
+    rng = np.random.RandomState(0)
+    X = rng.rand(N_STEPS, 8, 16).astype(np.float32)
+    Y = rng.rand(N_STEPS, 8, 1).astype(np.float32)
+
+    exe_a, main_a, loss_a = build()
+    t0 = time.perf_counter()
+    per_step = [float(exe_a.run(main_a, feed={"x": X[t], "y": Y[t]},
+                                fetch_list=[loss_a])[0])
+                for t in range(N_STEPS)]
+    dt_per_step = time.perf_counter() - t0
+    params_a = {k: np.asarray(v) for k, v in main_a.parameters_numpy().items()}
+
+    exe_b, main_b, loss_b = build()
+    t0 = time.perf_counter()
+    fused, = exe_b.run_steps(main_b, feed={"x": X, "y": Y},
+                             fetch_list=[loss_b], iterations=N_STEPS)
+    dt_fused = time.perf_counter() - t0
+    params_b = {k: np.asarray(v) for k, v in main_b.parameters_numpy().items()}
+
+    assert exe_a.dispatches == N_STEPS, exe_a.cache_stats()
+    assert exe_b.dispatches == 1, exe_b.cache_stats()
+    np.testing.assert_allclose(np.asarray(fused).ravel(), per_step,
+                               rtol=1e-5, atol=1e-6)
+    # param names differ only in the program-idx prefix (_<idx>_fc_...)
+    key = lambda n: n.split("_", 2)[2]  # noqa: E731
+    remap = {key(k): v for k, v in params_a.items()}
+    for k, v in params_b.items():
+        np.testing.assert_allclose(v, remap[key(k)], rtol=1e-5, atol=1e-6)
+
+    print(json.dumps({
+        "metric": "perf_smoke_fused_chain",
+        "n_steps": N_STEPS,
+        "per_step_dispatches": exe_a.dispatches,
+        "fused_dispatches": exe_b.dispatches,
+        "per_step_wall_s": round(dt_per_step, 4),
+        "fused_wall_s": round(dt_fused, 4),
+        "losses_match": True, "params_match": True,
+    }), flush=True)
+    print(f"perf-smoke OK: {N_STEPS} steps -> {exe_b.dispatches} dispatch "
+          f"(per-step lane: {exe_a.dispatches})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,9 +9,10 @@
 #   suite   pytest tests/            (full suite)
 #   audit   tools/api_parity_audit.py (implemented/shimmed/missing counts)
 #   dryrun  __graft_entry__.dryrun_multichip(8) on a virtual CPU mesh
+#   perf-smoke tools/perf_smoke.py   (fused run_steps vs per-step, CPU, seconds)
 #   bench   python bench.py          (only when a real TPU answers)
 #
-# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|bench]...
+# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|bench]...
 #         tools/run_gates.sh --only suite
 # Exit code: 0 iff every stage that ran passed.
 set -u
@@ -85,6 +86,9 @@ run_stage fast   python -m pytest tests/ -m fast -q
 run_stage suite  python -m pytest tests/ -q
 run_stage audit  python tools/api_parity_audit.py
 run_stage dryrun python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+# fused multi-step path exercised on every gate run (CPU: dispatch-count
+# and numerical-equivalence property, not a throughput claim)
+run_stage perf-smoke env JAX_PLATFORMS=cpu python tools/perf_smoke.py
 
 # bench only when a real accelerator answers within 60s
 if want bench; then
