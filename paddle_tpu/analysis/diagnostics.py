@@ -76,6 +76,10 @@ RULES = {
     "P504": (Severity.ERROR, "partition spec rank exceeds parameter rank"),
     "P505": (Severity.WARNING,
              "ZeRO enabled but optimizer state stays replicated"),
+    # -- serving monitor (S6xx) ---------------------------------------------
+    "S601": (Severity.WARNING,
+             "serving bucket-miss churn (requests falling outside the "
+             "configured shape buckets)"),
 }
 
 

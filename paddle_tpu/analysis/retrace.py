@@ -52,6 +52,9 @@ class RetraceMonitor:
         # ("executor_cache", name) counter snapshots: latest value per
         # executor, NOT deduped signature events (rule R403)
         self._cache_sites: Dict[str, dict] = {}
+        # ("serving", name) engine snapshots: same latest-value semantics
+        # (rule S601)
+        self._serving_sites: Dict[str, dict] = {}
 
     # -- subscription --------------------------------------------------------
     def install(self):
@@ -75,6 +78,10 @@ class RetraceMonitor:
             with self._lock:
                 self._cache_sites[key[1]] = dict(info)
             return
+        if key[0] == "serving":
+            with self._lock:
+                self._serving_sites[key[1]] = dict(info)
+            return
         sig = _freeze(info)
         with self._lock:
             seen = self._seen.setdefault(key, set())
@@ -94,6 +101,15 @@ class RetraceMonitor:
             if name is not None:
                 return dict(self._cache_sites.get(name, {}))
             return {k: dict(v) for k, v in self._cache_sites.items()}
+
+    def serving_stats(self, name: str = None):
+        """Latest serving-engine snapshot(s) observed (queue depth, batch
+        occupancy, latency quantiles, bucket misses…): the dict for one
+        engine (``name`` like ``"engine#1"``), or all of them."""
+        with self._lock:
+            if name is not None:
+                return dict(self._serving_sites.get(name, {}))
+            return {k: dict(v) for k, v in self._serving_sites.items()}
 
     def diagnostics(self) -> List[Diagnostic]:
         out = DiagnosticCollector()
@@ -135,6 +151,29 @@ class RetraceMonitor:
                          "sysconfig.enable_persistent_compilation_cache() "
                          "so evicted entries recompile from the on-disk "
                          "XLA cache")
+        with self._lock:
+            serving_sites = {k: dict(v)
+                             for k, v in self._serving_sites.items()}
+        for name, stats in serving_sites.items():
+            misses = int(stats.get("bucket_misses", 0))
+            if misses <= self.budget:
+                continue
+            fallbacks = int(stats.get("fallback_runs", 0))
+            tail = (f"; {fallbacks} served by the unbatched polymorphic "
+                    f"fallback (one compile per distinct shape)"
+                    if fallbacks else "; rejected at submit")
+            out.add("S601",
+                    f"serving engine {name} saw {misses} bucket misses "
+                    f"(budget {self.budget}) out of "
+                    f"{stats.get('requests', 0)} requests{tail} — request "
+                    f"shapes are leaking outside the configured bucket "
+                    f"set, reopening the compile set the buckets exist "
+                    f"to close",
+                    location=Location(file=name, function=name),
+                    hint="add buckets covering the observed shapes (or "
+                         "widen existing ones) so every request pads into "
+                         "the closed executable set; keep "
+                         "allow_bucket_fallback for rare stragglers only")
         return out.diagnostics
 
     @staticmethod

@@ -231,17 +231,31 @@ def conv_call(fn):
         fwd = getattr(type(fn), "forward", None)
         if fwd is not None and callable(fn) and hasattr(fn, "__dict__"):
             # a Layer (or layer-like callable): transform its forward and
-            # install it ON THE INSTANCE once — __call__ keeps pre/post
-            # hooks live, and the converted forward is exact-semantics
-            # eagerly too, so the permanent install is behavior-preserving
+            # swap it in only FOR THE DURATION of the call, through
+            # __call__ so pre/post hooks stay live — mirroring jit.py's
+            # install/restore.  A permanent instance-dict install would
+            # mutate the user's object (and bound methods in __dict__
+            # break pickling)
             conv = conv_call(fwd)
             if conv is fwd:
                 return fn
-            with _swap_lock:
-                if fn.__dict__.get("__d2s_conv__") is not conv:
-                    fn.__dict__["forward"] = types.MethodType(conv, fn)
-                    fn.__dict__["__d2s_conv__"] = conv
-            return fn
+
+            def call_with_converted_forward(*a, _layer=fn, _conv=conv, **k):
+                _MISSING = object()
+                with _swap_lock:
+                    prev = _layer.__dict__.get("forward", _MISSING)
+                    _layer.__dict__["forward"] = (
+                        lambda *aa, **kk: _conv(_layer, *aa, **kk))
+                try:
+                    return _layer(*a, **k)
+                finally:
+                    with _swap_lock:
+                        if prev is _MISSING:
+                            _layer.__dict__.pop("forward", None)
+                        else:
+                            _layer.__dict__["forward"] = prev
+
+            return call_with_converted_forward
         return fn
     if fn.__code__.co_freevars:
         # closure helper: converting would snapshot cell contents and

@@ -7,14 +7,19 @@ argument whose shape/dtype churn is causing a signature explosion.  With no
 subscribers registered the publish sites are a single falsy check — zero
 cost on the hot path.
 
-Two event families share the bus, distinguished by ``site[0]``:
+Three event families share the bus, distinguished by ``site[0]``:
 
 * ``("jit"|"executor", name)`` — one event per compiled signature, ``info``
   holds hashable signature components (diffed by the retrace detector);
 * ``("executor_cache", name)`` — compile-cache counter snapshots
   (hits/misses/evictions/size/dispatches), published on every
   ``Executor.run``/``run_steps``; latest value wins (cache-churn rule
-  R403), so these must NOT be deduped like signature events.
+  R403), so these must NOT be deduped like signature events;
+* ``("serving", name)`` — serving-engine metric snapshots (queue depth,
+  batch occupancy, p50/p99 latency, tokens/s, bucket misses…), published
+  by ``serving.ServingMetrics`` after every batch/shed/expiry; latest
+  value wins (bucket-miss rule S601), same non-dedup semantics as
+  ``executor_cache``.
 """
 from __future__ import annotations
 

@@ -72,6 +72,16 @@ __all__ = ["HostEmbeddingTable"]
 _OPTS = ("sgd", "adagrad", "adam")
 
 
+def _own_copy(ids) -> np.ndarray:
+    """An array the async queue OWNS: ``np.asarray`` of a caller-held
+    numpy buffer is a view, and in-place reuse of that buffer before the
+    worker drains the queue would corrupt the deferred op.  Device arrays
+    already materialize a fresh host copy."""
+    if isinstance(ids, (np.ndarray, np.generic)):
+        return np.array(ids, copy=True)
+    return np.asarray(ids)
+
+
 class HostEmbeddingTable:
     """A ``[num_embeddings, dim]`` table resident in host RAM with fused
     lazy optimizer updates on ``push``.
@@ -302,7 +312,7 @@ class HostEmbeddingTable:
         self._check_worker()
         self._ensure_worker()
         fut: Future = Future()
-        self._q.put(("pull", (np.asarray(ids),), fut))
+        self._q.put(("pull", (_own_copy(ids),), fut))
         return fut
 
     def push_async(self, ids, grads, lr: Optional[float] = None) -> None:
@@ -312,7 +322,13 @@ class HostEmbeddingTable:
         behind the device."""
         self._check_worker()
         self._ensure_worker()
-        self._q.put(("push", (np.asarray(ids), grads, lr), None))
+        # host buffers are copied at enqueue time (views of caller-owned
+        # arrays corrupt the deferred update if the caller reuses them);
+        # device grads stay as-is — immutable, and the device→host read
+        # belongs on the worker
+        if isinstance(grads, (np.ndarray, np.generic)):
+            grads = np.array(grads, copy=True)
+        self._q.put(("push", (_own_copy(ids), grads, lr), None))
 
     def flush(self) -> None:
         """Barrier: wait until every enqueued pull/push has completed

@@ -226,6 +226,61 @@ class Predictor:
         flat = jax.tree_util.tree_leaves(out)
         return [np.asarray(o) for o in flat]
 
+    # -- serving hooks (paddle_tpu.serving.InferenceEngine) ------------------
+    def input_dtypes(self) -> List[np.dtype]:
+        return [np.dtype(i["dtype"]) for i in self._meta["inputs"]]
+
+    def aot_compile(self, input_shapes: Sequence[Sequence[int]]):
+        """Ahead-of-time compile the module for ONE fixed input geometry.
+
+        Returns the compiled executable; call it through
+        :meth:`run_compiled`.  The serving engine holds exactly one of
+        these per shape bucket — padding every request into a bucket
+        keeps the executable set closed (no retraces under live
+        traffic)."""
+        declared = self._meta["inputs"]
+        if len(input_shapes) != len(declared):
+            raise InvalidArgumentError(
+                f"aot_compile takes {len(declared)} input shapes, got "
+                f"{len(input_shapes)}")
+        ins = [jax.ShapeDtypeStruct(tuple(int(d) for d in s), dt)
+               for s, dt in zip(input_shapes, self.input_dtypes())]
+        shaped = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self._params)
+        b_shaped = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self._buffers)
+        return self._call.lower(shaped, b_shaped, *ins).compile()
+
+    def run_compiled(self, executable, inputs: Sequence) -> List[np.ndarray]:
+        """Run an :meth:`aot_compile` executable with the CURRENT weights
+        (so a hot :meth:`swap_weights` takes effect without recompiling —
+        params are arguments, not constants)."""
+        out = executable(self._params, self._buffers,
+                         *[np.asarray(x) for x in inputs])
+        return [np.asarray(o) for o in jax.tree_util.tree_leaves(out)]
+
+    def swap_weights(self, params_file: str) -> None:
+        """Hot-swap weights from a ``.pdiparams`` side-file without
+        re-export or recompile.  The new state must match the served
+        model's tree structure and leaf shapes/dtypes — a mismatched file
+        is rejected before it can poison in-flight batches."""
+        state = serialization.load(params_file)
+        if not isinstance(state, dict) or "params" not in state:
+            raise InvalidArgumentError(
+                f"{params_file} is not an inference params file")
+        new_p = jax.tree_util.tree_map(np.asarray, state["params"])
+        new_b = jax.tree_util.tree_map(np.asarray, state.get("buffers", {}))
+        for name, old, new in (("params", self._params, new_p),
+                               ("buffers", self._buffers, new_b)):
+            old_s = jax.tree_util.tree_map(lambda a: (a.shape, a.dtype), old)
+            new_s = jax.tree_util.tree_map(lambda a: (a.shape, a.dtype), new)
+            if old_s != new_s:
+                raise InvalidArgumentError(
+                    f"swap_weights: {params_file} {name} do not match the "
+                    f"served model (different tree structure or leaf "
+                    f"shapes/dtypes)")
+        self._params, self._buffers = new_p, new_b
+
 
 def create_predictor(config: Config) -> Predictor:
     if not config.prefix:

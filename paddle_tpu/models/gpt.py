@@ -92,16 +92,20 @@ class ParallelAttention(Layer):
 
         return get_mesh().shape.get("sep", 1)
 
-    def forward(self, x, attn_mask=None):
+    def _heads(self, x):
+        """qkv projection → per-head ``[B,H,S,hd]`` triples."""
         B, S, D = x.shape
         qkv = self.qkv(x)  # [B,S,3D] sharded on last dim
         qkv = qkv.reshape(B, S, 3, self.num_heads, self.head_dim)
         # heads inherit the model sharding of the projection output
         qkv = constrain(qkv, None, None, None, "model", None)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,hd]
-        q = q.transpose(0, 2, 1, 3)  # [B,H,S,hd]
-        k = k.transpose(0, 2, 1, 3)
-        v = v.transpose(0, 2, 1, 3)
+        return (q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3))
+
+    def forward(self, x, attn_mask=None):
+        B, S, D = x.shape
+        q, k, v = self._heads(x)
         ctx = None
         if (self.sequence_parallel and attn_mask is None
                 and self._sp_degree() > 1):
@@ -171,6 +175,38 @@ class ParallelAttention(Layer):
 
         return shard_map(local, mesh, (spec, spec, spec), spec)(q, k, v)
 
+    def forward_cached(self, x, kv, hit, mask):
+        """One attention step over a preallocated ring KV cache — the
+        serving decode path (paddle_tpu/serving/generation.py).
+
+        The new tokens' K/V are scattered into fixed ``[B,H,C,hd]`` cache
+        buffers (one-hot ``hit``), then attention runs over the WHOLE
+        cache under ``mask`` — every decode step has the same shapes, so
+        the jitted step never retraces and costs O(C) instead of
+        re-running the O(S²) prefix.  Dense path only (no flash/SP —
+        decode is bandwidth-bound at T=1); attention-prob dropout is
+        skipped (decode is inference).
+
+        x: ``[B,T,D]`` new-token activations; kv: ``{"k","v"}`` cache
+        buffers; hit: ``[B,T,C]`` bool one-hot slot writes; mask:
+        ``[B,T,C]`` attention validity.  Returns ``(out, new_kv)``.
+        """
+        B, T, D = x.shape
+        q, k, v = self._heads(x)  # [B,H,T,hd]
+        write = hit.any(axis=1)[:, None, :, None]  # [B,1,C,1]
+        h = hit.astype(x.dtype)
+        new_k = jnp.where(write, jnp.einsum("btc,bhtd->bhcd", h, k), kv["k"])
+        new_v = jnp.where(write, jnp.einsum("btc,bhtd->bhcd", h, v), kv["v"])
+        scores = jnp.einsum("bhqd,bhcd->bhqc", q, new_k) / math.sqrt(
+            self.head_dim)
+        scores = jnp.where(mask[:, None], scores,
+                           jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bhqc,bhcd->bhqd", probs, new_v)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, T, D)
+        ctx = constrain(ctx, None, None, "model")
+        return self.out(ctx), {"k": new_k, "v": new_v}
+
 
 class ParallelMLP(Layer):
     def __init__(self, cfg: GPTConfig):
@@ -198,6 +234,12 @@ class GPTBlock(Layer):
         x = x + self.attn(self.ln1(x), attn_mask)
         x = x + self.mlp(self.ln2(x))
         return x
+
+    def forward_cached(self, x, kv, hit, mask):
+        a, new_kv = self.attn.forward_cached(self.ln1(x), kv, hit, mask)
+        x = x + a
+        x = x + self.mlp(self.ln2(x))
+        return x, new_kv
 
 
 class GPTModel(Layer):
@@ -244,6 +286,59 @@ class GPTModel(Layer):
                 x = blk(x, attn_mask)
         return self.ln_f(x)
 
+    # -- KV-cache decode path (paddle_tpu.serving) --------------------------
+    def init_cache(self, batch_size: int, cache_len: Optional[int] = None,
+                   dtype=None):
+        """Preallocate a ring KV cache: per-layer ``[B,H,C,hd]`` K/V
+        buffers plus one shared ``[B,C]`` slot→absolute-position map
+        (``-1`` = empty).  Every decode step reads and writes arrays of
+        exactly these shapes, so the jitted step compiles once.  While the
+        absolute position stays below ``C`` attention is exact; past it
+        the ring overwrites the oldest entries (sliding-window decode)."""
+        cfg = self.cfg
+        C = int(cache_len or cfg.max_position)
+        hd = cfg.hidden_size // cfg.num_heads
+        dt = dtype or cfg.dtype
+        return {
+            "pos": jnp.full((batch_size, C), -1, jnp.int32),
+            "layers": [
+                {"k": jnp.zeros((batch_size, cfg.num_heads, C, hd), dt),
+                 "v": jnp.zeros((batch_size, cfg.num_heads, C, hd), dt)}
+                for _ in range(cfg.num_layers)
+            ],
+        }
+
+    def forward_cached(self, input_ids, positions, cache):
+        """Prefill/decode forward over :meth:`init_cache` state.
+
+        ``input_ids``/``positions`` are ``[B,T]`` — ``T`` is the prompt
+        bucket length for prefill, 1 for a decode step.  ``positions``
+        are ABSOLUTE token positions per sequence (``-1`` marks padding:
+        the token writes nothing and attends to nothing), so ragged
+        right-padded prompts and per-sequence decode offsets batch
+        together.  Returns ``(hidden [B,T,D], new_cache)``.
+        """
+        positions = jnp.asarray(positions, jnp.int32)
+        C = cache["pos"].shape[1]
+        x = self.wte(input_ids) + self.wpe(jnp.maximum(positions, 0))
+        x = self.drop(x)
+        slots = jnp.where(positions >= 0, positions % C, -1)
+        hit = slots[:, :, None] == jnp.arange(C)[None, None, :]  # [B,T,C]
+        written = hit.any(axis=1)  # [B,C]
+        new_pos = jnp.where(
+            written,
+            jnp.einsum("btc,bt->bc", hit.astype(jnp.int32), positions),
+            cache["pos"])
+        # a key is visible iff its slot holds a real token, causally
+        # before (or at) the query, and not yet evicted by the ring
+        kp, qp = new_pos[:, None, :], positions[:, :, None]
+        mask = (kp >= 0) & (kp <= qp) & (kp > qp - C)  # [B,T,C]
+        new_layers = []
+        for blk, kv in zip(self.blocks, cache["layers"]):
+            x, kv = blk.forward_cached(x, kv, hit, mask)
+            new_layers.append(kv)
+        return self.ln_f(x), {"pos": new_pos, "layers": new_layers}
+
 
 class GPTForCausalLM(Layer):
     """LM head ties the (vocab-sharded) input embedding."""
@@ -256,6 +351,28 @@ class GPTForCausalLM(Layer):
         h = self.gpt(input_ids, attn_mask)  # [B,S,D]
         logits = jnp.einsum("bsd,vd->bsv", h, jnp.asarray(self.gpt.wte.weight))
         return constrain(logits, None, None, None)
+
+    def forward_cached(self, input_ids, positions, cache, gather_last=None):
+        """KV-cache forward (see :meth:`GPTModel.forward_cached`).
+
+        With ``gather_last`` (per-sequence prompt lengths ``[B]``), only
+        the hidden state at position ``length-1`` is projected to logits
+        — the prefill path needs just the next-token distribution, and
+        skipping the ``[B,S,V]`` projection is the bulk of the prefill
+        FLOPs for large vocabularies.  Returns ``(logits, new_cache)``
+        with logits ``[B,T,V]`` (or ``[B,V]`` under ``gather_last``).
+        """
+        h, cache = self.gpt.forward_cached(input_ids, positions, cache)
+        if gather_last is not None:
+            idx = jnp.maximum(jnp.asarray(gather_last, jnp.int32) - 1, 0)
+            h = jnp.take_along_axis(
+                h, idx[:, None, None], axis=1)[:, 0]  # [B,D]
+            logits = jnp.einsum("bd,vd->bv", h,
+                                jnp.asarray(self.gpt.wte.weight))
+            return constrain(logits, None, None), cache
+        logits = jnp.einsum("bsd,vd->bsv", h,
+                            jnp.asarray(self.gpt.wte.weight))
+        return constrain(logits, None, None, None), cache
 
     def loss(self, logits, labels):
         """Shifted next-token cross entropy (labels = input_ids)."""

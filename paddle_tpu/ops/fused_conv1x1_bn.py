@@ -90,8 +90,13 @@ def conv1x1_bn_stats(x, w, *, block_m: int = 512, block_n: int = 256):
     K2, N = w.shape
     if K != K2:
         raise InvalidArgumentError(f"shape mismatch {x.shape} @ {w.shape}")
+    # Mosaic lowers (sublane, lane)-tiled blocks: bm must be a multiple of
+    # 8 and bn a multiple of 128, or non-aligned shapes (M=100, N=200)
+    # fail to lower on a real TPU.  Padding already keeps the stats exact.
     bm = min(block_m, max(M, 8))
     bn = min(block_n, max(N, 128))
+    bm = -(-bm // 8) * 8
+    bn = -(-bn // 128) * 128
     Mp = -(-M // bm) * bm
     Np = -(-N // bn) * bn
     xp = x if Mp == M else jnp.pad(x, ((0, Mp - M), (0, 0)))

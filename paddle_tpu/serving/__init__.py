@@ -1,0 +1,39 @@
+"""paddle_tpu.serving — dynamic-batching inference on a closed compile set.
+
+The serving stack turns the framework's AOT inference artifacts and
+KV-cache model paths into an online engine:
+
+* :mod:`~paddle_tpu.serving.bucketing` — shape buckets; every request is
+  padded to the smallest fitting bucket so XLA compiles exactly one
+  executable per bucket (the *closed compile set*), never one per
+  observed request shape.
+* :mod:`~paddle_tpu.serving.batcher` — request queue + micro-batcher
+  (``max_batch_size`` / ``max_queue_delay_ms``), with load shedding,
+  per-request deadlines and graceful drain.
+* :mod:`~paddle_tpu.serving.engine` — :class:`InferenceEngine`: bucketed
+  AOT predictors over an exported ``save_inference_model`` artifact, with
+  hot weight-swap from a ``.pdiparams`` side-file.
+* :mod:`~paddle_tpu.serving.generation` — :class:`GenerationEngine`:
+  prefill/decode greedy generation for ``models.GPTForCausalLM`` over a
+  preallocated ring KV cache (one decode executable total).
+* :mod:`~paddle_tpu.serving.metrics` — :class:`ServingMetrics`: queue
+  depth, batch occupancy, p50/p99 latency and tokens/s published as
+  ``("serving", <name>)`` events on ``framework.trace_events`` (consumed
+  by ``analysis`` rule S601).
+"""
+from .batcher import MicroBatcher, Request
+from .bucketing import Bucket, BucketSet, as_bucket
+from .engine import InferenceEngine
+from .generation import GenerationEngine
+from .metrics import ServingMetrics
+
+__all__ = [
+    "Bucket",
+    "BucketSet",
+    "as_bucket",
+    "MicroBatcher",
+    "Request",
+    "InferenceEngine",
+    "GenerationEngine",
+    "ServingMetrics",
+]

@@ -1,0 +1,209 @@
+"""Request queue + micro-batcher (Clipper NSDI'17-style admission layer).
+
+One worker thread owns the device: callers :meth:`~MicroBatcher.submit`
+requests and get ``concurrent.futures.Future``s back; the worker groups
+same-bucket requests into batches of up to ``max_batch_size``, waiting at
+most ``max_queue_delay_ms`` past the oldest request's arrival — the
+classic latency/throughput dial.
+
+Robustness contract:
+
+* **bounded queue** — past ``max_queue_depth`` pending requests, submit
+  sheds the load immediately (``UnavailableError``) instead of building an
+  unbounded latency backlog;
+* **deadlines** — a request whose ``deadline_ms`` elapses while queued
+  fails with ``ExecutionTimeoutError`` *before* wasting a device slot;
+* **graceful drain** — ``close(drain=True)`` stops admissions, serves
+  everything already queued, then joins the worker;
+* a runner exception fails only that batch's futures, never the worker.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..framework.errors import (
+    ExecutionTimeoutError,
+    UnavailableError,
+)
+from .metrics import ServingMetrics
+
+__all__ = ["Request", "MicroBatcher"]
+
+
+class Request:
+    """One queued inference request."""
+
+    __slots__ = ("inputs", "shapes", "bucket", "future", "enqueue_t",
+                 "deadline_t", "meta")
+
+    def __init__(self, inputs: Sequence, bucket: int,
+                 deadline_ms: Optional[float] = None, meta=None):
+        self.inputs = inputs
+        self.shapes = tuple(tuple(getattr(x, "shape", ())) for x in inputs)
+        self.bucket = bucket
+        self.future: Future = Future()
+        self.enqueue_t = time.monotonic()
+        self.deadline_t = (self.enqueue_t + deadline_ms / 1e3
+                           if deadline_ms is not None else None)
+        self.meta = meta
+
+
+class MicroBatcher:
+    """Generic bucket-grouping batcher.
+
+    ``router(inputs) -> int`` assigns a bucket key (raise to reject at
+    submit time); ``runner(bucket, requests) -> list`` executes one batch
+    and returns one result per request, in order.  ``capacity(bucket) ->
+    int`` bounds the batch size per bucket (defaults to the constant
+    ``max_batch_size``).  The engine layers (engine.py / generation.py)
+    provide all three and own the compiled executables.
+    """
+
+    def __init__(self, router: Callable[[Sequence], int],
+                 runner: Callable[[int, List[Request]], List[Any]],
+                 *, max_batch_size: int = 8, max_queue_delay_ms: float = 5.0,
+                 max_queue_depth: int = 256,
+                 capacity: Optional[Callable[[int], int]] = None,
+                 metrics: Optional[ServingMetrics] = None,
+                 name: str = "serving#0"):
+        if max_batch_size < 1 or max_queue_depth < 1:
+            raise UnavailableError(
+                "max_batch_size and max_queue_depth must be >= 1")
+        self._router = router
+        self._runner = runner
+        self._max_batch = int(max_batch_size)
+        self._delay_s = float(max_queue_delay_ms) / 1e3
+        self._max_depth = int(max_queue_depth)
+        self._capacity = capacity or (lambda bucket: self._max_batch)
+        self.metrics = metrics or ServingMetrics(name)
+
+        self._cv = threading.Condition()
+        # bucket → FIFO of requests; OrderedDict keeps bucket scan cheap
+        self._pending: Dict[int, deque] = OrderedDict()
+        self._depth = 0
+        self._closing = False
+        self._drain = True
+        self._worker = threading.Thread(
+            target=self._loop, name=f"{name}-batcher", daemon=True)
+        self._worker.start()
+
+    # -- admission -----------------------------------------------------------
+    def submit(self, inputs: Sequence, deadline_ms: Optional[float] = None,
+               meta=None) -> Future:
+        """Enqueue one request; returns a Future of the runner's
+        per-request result.  Sheds (raises ``UnavailableError``) when the
+        queue is full or the batcher is closed."""
+        bucket = self._router(inputs)  # may raise (e.g. bucket miss)
+        with self._cv:
+            if self._closing:
+                raise UnavailableError(f"{self.metrics.name}: shutting down")
+            self.metrics.incr("requests")
+            if self._depth >= self._max_depth:
+                self.metrics.incr("shed")
+                self.metrics.set_queue_depth(self._depth)
+                self.metrics.publish()
+                raise UnavailableError(
+                    f"{self.metrics.name}: queue depth {self._depth} at "
+                    f"limit {self._max_depth} — load shed (retry with "
+                    f"backoff)")
+            req = Request(inputs, bucket, deadline_ms, meta)
+            self._pending.setdefault(bucket, deque()).append(req)
+            self._depth += 1
+            self._cv.notify()
+        return req.future
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cv:
+            return self._depth
+
+    # -- worker --------------------------------------------------------------
+    def _oldest_bucket(self):
+        best, best_t = None, None
+        for b, dq in self._pending.items():
+            if dq and (best_t is None or dq[0].enqueue_t < best_t):
+                best, best_t = b, dq[0].enqueue_t
+        return best
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while self._depth == 0 and not self._closing:
+                    self._cv.wait(0.05)
+                if self._depth == 0 and self._closing:
+                    return
+                bucket = self._oldest_bucket()
+                dq = self._pending[bucket]
+                cap = max(1, int(self._capacity(bucket)))
+                wait = (dq[0].enqueue_t + self._delay_s) - time.monotonic()
+                if len(dq) < cap and wait > 0 and not self._closing:
+                    self._cv.wait(min(wait, 0.05))
+                    continue
+                batch = [dq.popleft() for _ in range(min(cap, len(dq)))]
+                if not dq:
+                    del self._pending[bucket]
+                self._depth -= len(batch)
+                depth = self._depth
+                drain = self._drain
+            if self._closing and not drain:
+                for r in batch:
+                    r.future.set_exception(
+                        UnavailableError(f"{self.metrics.name}: dropped at "
+                                         "shutdown (drain=False)"))
+                continue
+            self._dispatch(bucket, batch, cap, depth)
+
+    def _dispatch(self, bucket: int, batch: List[Request], cap: int,
+                  depth: int):
+        now = time.monotonic()
+        live = []
+        for r in batch:
+            if r.deadline_t is not None and now > r.deadline_t:
+                self.metrics.incr("expired")
+                r.future.set_exception(ExecutionTimeoutError(
+                    f"{self.metrics.name}: deadline exceeded after "
+                    f"{(now - r.enqueue_t) * 1e3:.1f}ms in queue"))
+            else:
+                live.append(r)
+        if not live:
+            self.metrics.publish()
+            return
+        try:
+            results = self._runner(bucket, live)
+            if len(results) != len(live):
+                raise UnavailableError(
+                    f"runner returned {len(results)} results for "
+                    f"{len(live)} requests")
+        except Exception as e:  # fail the batch, keep the worker alive
+            self.metrics.incr("errors", len(live))
+            for r in live:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            self.metrics.publish()
+            return
+        done = time.monotonic()
+        for r, res in zip(live, results):
+            self.metrics.observe_latency_ms((done - r.enqueue_t) * 1e3)
+            r.future.set_result(res)
+        self.metrics.observe_batch(len(live), cap, depth)
+        self.metrics.publish({"bucket": bucket})
+
+    # -- shutdown ------------------------------------------------------------
+    def close(self, drain: bool = True, timeout: Optional[float] = None):
+        """Stop admissions; serve (``drain=True``) or fail (``False``)
+        everything still queued, then join the worker."""
+        with self._cv:
+            self._closing = True
+            self._drain = drain
+            self._cv.notify_all()
+        self._worker.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

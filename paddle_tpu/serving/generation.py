@@ -1,0 +1,214 @@
+"""Batched greedy generation for ``models.GPTForCausalLM`` (Orca-style).
+
+The engine splits generation into **prefill** (the whole prompt in one
+forward, one jitted executable per prompt-length bucket) and **decode**
+(one token per step through a SINGLE jitted step function over the
+preallocated ring KV cache from ``GPTModel.init_cache``).  Every decode
+step sees arrays of exactly the same shape — ``[B]`` tokens, ``[B]``
+positions, the fixed-shape cache — so the steady-state compile set is
+``len(prompt_buckets) + 1`` no matter how many tokens are generated.
+
+Prompts are right-padded to their bucket with position ``-1`` (writes
+nothing to the cache, attends to nothing), so ragged prompts batch
+together and per-sequence decode offsets stay exact.
+"""
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.errors import InvalidArgumentError
+from ..nn.layer_base import functional_call
+from .batcher import MicroBatcher, Request
+from .metrics import ServingMetrics
+
+__all__ = ["GenerationEngine"]
+
+_gen_counter = [0]
+
+
+class GenerationEngine:
+    """Dynamic-batching greedy decoder over a ``GPTForCausalLM``.
+
+    ``prompt_buckets`` — prompt lengths requests are padded up to (the
+    prefill compile set); ``batch_size`` — the one decode batch width
+    (short batches run with dummy rows, occupancy is a metric, not a
+    shape); ``cache_len`` — KV ring capacity (default
+    ``cfg.max_position``; generation past it slides the window).
+    """
+
+    def __init__(self, model, *, prompt_buckets: Sequence[int],
+                 batch_size: int = 4, cache_len: Optional[int] = None,
+                 max_queue_delay_ms: float = 5.0, max_queue_depth: int = 256,
+                 eos_token_id: Optional[int] = None,
+                 name: Optional[str] = None):
+        if name is None:
+            _gen_counter[0] += 1
+            name = f"generate#{_gen_counter[0]}"
+        self.name = name
+        self._model = model
+        model.eval()
+        self._params = model.param_pytree()
+        self._buffers = model.buffer_pytree()
+        self._buckets = sorted({int(b) for b in prompt_buckets})
+        if not self._buckets or self._buckets[0] < 1:
+            raise InvalidArgumentError(
+                f"prompt_buckets must be positive lengths, got "
+                f"{prompt_buckets!r}")
+        self._batch = int(batch_size)
+        self._cache_len = cache_len
+        self._eos = eos_token_id
+        self._traces: Dict[str, int] = {"prefill": 0, "decode": 0}
+        self.metrics = ServingMetrics(name)
+
+        mdl, traces = model, self._traces
+
+        def prefill(params, buffers, ids, positions, lens, cache):
+            def body(ids, positions, lens, cache):
+                traces["prefill"] += 1  # python side effect: once per trace
+                logits, cache = mdl.forward_cached(
+                    ids, positions, cache, gather_last=lens)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+            return functional_call(mdl, params, ids, positions, lens, cache,
+                                   buffers=buffers, training=False, call=body)
+
+        def decode(params, buffers, tok, pos, cache):
+            def body(tok, pos, cache):
+                traces["decode"] += 1
+                logits, cache = mdl.forward_cached(
+                    tok[:, None], pos[:, None], cache)
+                return (jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32),
+                        cache)
+            return functional_call(mdl, params, tok, pos, cache,
+                                   buffers=buffers, training=False, call=body)
+
+        self._prefill = jax.jit(prefill)
+        self._decode = jax.jit(decode)
+        self._batcher = MicroBatcher(
+            self._route, self._run_batch,
+            max_batch_size=batch_size,
+            max_queue_delay_ms=max_queue_delay_ms,
+            max_queue_depth=max_queue_depth,
+            metrics=self.metrics, name=name)
+
+    # -- routing -------------------------------------------------------------
+    def _route(self, inputs: Sequence) -> int:
+        n = len(np.asarray(inputs[0]).reshape(-1))
+        for i, b in enumerate(self._buckets):
+            if n <= b:
+                return i
+        self.metrics.incr("bucket_misses")
+        self.metrics.publish()
+        raise InvalidArgumentError(
+            f"{self.name}: prompt length {n} exceeds the largest bucket "
+            f"({self._buckets[-1]}) — add a bucket or truncate the prompt")
+
+    @property
+    def compile_count(self) -> int:
+        """Traced executables so far: one per warmed prompt bucket plus
+        one shared decode step."""
+        return self._traces["prefill"] + self._traces["decode"]
+
+    def warmup(self) -> int:
+        """Trace every prompt bucket and the decode step on dummy data so
+        live traffic never pays compile latency.  Returns the (closed)
+        compile count: ``len(prompt_buckets) + 1``."""
+        B = self._batch
+        for sb in self._buckets:
+            ids = jnp.zeros((B, sb), jnp.int32)
+            pos = jnp.broadcast_to(jnp.arange(sb, dtype=jnp.int32), (B, sb))
+            lens = jnp.full((B,), sb, jnp.int32)
+            cache = self._model.gpt.init_cache(B, self._cache_len)
+            tok, cache = self._prefill(self._params, self._buffers,
+                                       ids, pos, lens, cache)
+            self._decode(self._params, self._buffers, tok,
+                         jnp.full((B,), sb, jnp.int32), cache)
+        self.metrics.set_counter("compiles", self.compile_count)
+        return self.compile_count
+
+    # -- batch execution -----------------------------------------------------
+    def _run_batch(self, bucket: int, requests: List[Request]
+                   ) -> List[np.ndarray]:
+        B, Sb = self._batch, self._buckets[bucket]
+        ids = np.zeros((B, Sb), np.int32)
+        positions = np.full((B, Sb), -1, np.int32)
+        lens = np.ones((B,), np.int32)  # dummy rows: 1 garbage (unread) slot
+        budgets = np.zeros((B,), np.int64)
+        for i, r in enumerate(requests):
+            prompt = np.asarray(r.inputs[0], np.int32).reshape(-1)
+            ids[i, : len(prompt)] = prompt
+            positions[i, : len(prompt)] = np.arange(len(prompt))
+            lens[i] = len(prompt)
+            budgets[i] = int(r.meta)
+        t0 = time.monotonic()
+        cache = self._model.gpt.init_cache(B, self._cache_len)
+        tok, cache = self._prefill(
+            self._params, self._buffers, jnp.asarray(ids),
+            jnp.asarray(positions), jnp.asarray(lens), cache)
+        pos = jnp.asarray(lens)  # absolute slot of the token just produced
+        out: List[List[int]] = [[] for _ in range(B)]
+        done = np.array([i >= len(requests) for i in range(B)])
+        n_tokens = 0
+        while True:
+            host_tok = np.asarray(tok)
+            for i in range(len(requests)):
+                if done[i]:
+                    continue
+                out[i].append(int(host_tok[i]))
+                n_tokens += 1
+                if (len(out[i]) >= budgets[i]
+                        or (self._eos is not None
+                            and host_tok[i] == self._eos)):
+                    done[i] = True
+            if done.all():
+                break
+            tok, cache = self._decode(self._params, self._buffers, tok, pos,
+                                      cache)
+            pos = pos + 1
+        self.metrics.observe_tokens(n_tokens, time.monotonic() - t0)
+        self.metrics.set_counter("compiles", self.compile_count)
+        return [np.asarray(o, np.int32) for o in out[: len(requests)]]
+
+    # -- public API ----------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int = 32,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Async generation; resolves to the ``[<=max_new_tokens]`` int32
+        array of greedily decoded tokens (stops after ``eos_token_id``)."""
+        if max_new_tokens < 1:
+            raise InvalidArgumentError("max_new_tokens must be >= 1")
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        return self._batcher.submit((prompt,), deadline_ms=deadline_ms,
+                                    meta=int(max_new_tokens))
+
+    def generate(self, prompt_ids, max_new_tokens: int = 32,
+                 timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking :meth:`submit`."""
+        return self.submit(prompt_ids, max_new_tokens).result(timeout)
+
+    def reload_weights(self) -> None:
+        """Re-snapshot weights from the live model (e.g. after
+        ``paddle_tpu.load`` into it) — next batch serves them, zero
+        recompiles (params are executable arguments)."""
+        self._params = self._model.param_pytree()
+        self._buffers = self._model.buffer_pytree()
+        self.metrics.publish({"weight_swap": 1})
+
+    def stats(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["compile_count"] = self.compile_count
+        snap["buckets"] = len(self._buckets)
+        return snap
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None):
+        self._batcher.close(drain=drain, timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

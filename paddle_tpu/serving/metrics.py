@@ -1,0 +1,98 @@
+"""Serving observability — counters + latency quantiles on the event bus.
+
+Every engine owns a :class:`ServingMetrics`; after each executed batch (and
+on every shed/expiry) a full snapshot is published as a
+``("serving", <engine-name>)`` event on ``framework.trace_events`` —
+latest-value semantics like the ``executor_cache`` family, NOT deduped
+signature events.  ``analysis.RetraceMonitor`` consumes the snapshots for
+rule S601 (bucket-miss churn); dashboards read them straight off the bus.
+
+Snapshot keys: ``requests, completed, shed, expired, errors,
+bucket_misses, fallback_runs, compiles, batches, queue_depth,
+batch_occupancy, p50_ms, p99_ms, tokens, tokens_per_s``.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Deque, Dict, Optional
+
+from ..framework import trace_events
+
+__all__ = ["ServingMetrics"]
+
+#: counter keys every snapshot carries (zero-initialized)
+_COUNTERS = ("requests", "completed", "shed", "expired", "errors",
+             "bucket_misses", "fallback_runs", "compiles", "batches",
+             "tokens")
+
+
+def _quantile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return float(sorted_vals[i])
+
+
+class ServingMetrics:
+    """Thread-safe counters, gauges, and a bounded latency reservoir."""
+
+    def __init__(self, name: str = "serving#0", window: int = 512):
+        self.name = name
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {k: 0 for k in _COUNTERS}
+        self._latency_ms: Deque[float] = collections.deque(maxlen=window)
+        self._occupancy: Deque[float] = collections.deque(maxlen=window)
+        self._queue_depth = 0
+        self._token_time_s = 0.0
+
+    def incr(self, key: str, n: int = 1):
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def set_counter(self, key: str, value: int):
+        with self._lock:
+            self._counters[key] = int(value)
+
+    def set_queue_depth(self, depth: int):
+        with self._lock:
+            self._queue_depth = int(depth)
+
+    def observe_batch(self, size: int, capacity: int, queue_depth: int):
+        with self._lock:
+            self._counters["batches"] += 1
+            self._counters["completed"] += size
+            self._occupancy.append(size / max(capacity, 1))
+            self._queue_depth = int(queue_depth)
+
+    def observe_latency_ms(self, ms: float):
+        with self._lock:
+            self._latency_ms.append(float(ms))
+
+    def observe_tokens(self, n: int, seconds: float):
+        with self._lock:
+            self._counters["tokens"] += int(n)
+            self._token_time_s += float(seconds)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            lat = sorted(self._latency_ms)
+            occ = list(self._occupancy)
+            snap = dict(self._counters)
+            snap["queue_depth"] = self._queue_depth
+            snap["batch_occupancy"] = (sum(occ) / len(occ)) if occ else 0.0
+            snap["p50_ms"] = _quantile(lat, 0.50)
+            snap["p99_ms"] = _quantile(lat, 0.99)
+            snap["tokens_per_s"] = (snap["tokens"] / self._token_time_s
+                                    if self._token_time_s > 0 else 0.0)
+        return snap
+
+    def publish(self, extra: Optional[dict] = None):
+        """Emit the snapshot on the trace_events bus (a single falsy check
+        when nothing subscribes — zero cost on the serve path)."""
+        if not trace_events.active():
+            return
+        snap = self.snapshot()
+        if extra:
+            snap.update(extra)
+        trace_events.notify(("serving", self.name), snap)

@@ -19,11 +19,21 @@ from paddle_tpu.distributed.parallel import launch, spawn
 
 @pytest.fixture
 def clean_env(monkeypatch):
-    """Reset the module singleton + scrub trainer vars around each test."""
+    """Reset the module singleton + scrub trainer vars around each test.
+
+    Also hermeticizes SPAWNED CHILDREN (launch/watch run real python
+    subprocesses that inherit os.environ): with a TPU tunnel configured
+    but down, an inherited ``PALLAS_AXON_POOL_IPS`` puts the child's jax
+    init into a 25+ minute backend retry loop — the child must see a
+    plain CPU environment regardless of the host's accelerator config.
+    """
     penv._initialized = False
     for k in ("COORDINATOR_ADDRESS", "PADDLE_TRAINER_ENDPOINTS",
-              "PADDLE_TRAINERS_NUM", "PADDLE_TRAINER_ID"):
+              "PADDLE_TRAINERS_NUM", "PADDLE_TRAINER_ID",
+              "PALLAS_AXON_POOL_IPS", "TPU_SKIP_MDS_QUERY",
+              "TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID"):
         monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     yield monkeypatch
     penv._initialized = False
 
@@ -192,6 +202,15 @@ for epoch, acp in train_epoch_range(4, m, {os.path.join(tmp_path, "ck")!r}):
         fh.write(f"{{epoch}}\\n")
     if epoch == 1 and os.environ.get("CRASH_ONCE") and not os.path.exists(
             {os.path.join(tmp_path, "crashed")!r}):
+        # checkpoint writes are async: wait for epoch 0's commit (its meta
+        # file) so the kill lands AFTER that commit, BEFORE epoch 1's —
+        # the scenario under test, made deterministic
+        import glob, time
+        deadline = time.time() + 30
+        while (not glob.glob({os.path.join(tmp_path, "ck")!r}
+                             + "/ckpt-*/meta.pdmeta")
+               and time.time() < deadline):
+            time.sleep(0.01)
         open({os.path.join(tmp_path, "crashed")!r}, "w").close()
         os._exit(9)  # hard kill AFTER epoch-1 work, BEFORE its commit
 ''')

@@ -10,9 +10,10 @@
 #   audit   tools/api_parity_audit.py (implemented/shimmed/missing counts)
 #   dryrun  __graft_entry__.dryrun_multichip(8) on a virtual CPU mesh
 #   perf-smoke tools/perf_smoke.py   (fused run_steps vs per-step, CPU, seconds)
+#   serving-smoke tools/serving_smoke.py (closed compile set + KV-decode identity)
 #   bench   python bench.py          (only when a real TPU answers)
 #
-# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|bench]...
+# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|bench]...
 #         tools/run_gates.sh --only suite
 # Exit code: 0 iff every stage that ran passed.
 set -u
@@ -89,6 +90,9 @@ run_stage dryrun python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 # fused multi-step path exercised on every gate run (CPU: dispatch-count
 # and numerical-equivalence property, not a throughput claim)
 run_stage perf-smoke env JAX_PLATFORMS=cpu python tools/perf_smoke.py
+# serving: closed compile set + exact padded/unpadded answers + KV-decode
+# token identity (CPU correctness gate, not a throughput claim)
+run_stage serving-smoke env JAX_PLATFORMS=cpu python tools/serving_smoke.py
 
 # bench only when a real accelerator answers within 60s
 if want bench; then
