@@ -43,6 +43,7 @@ The last line is a combined headline: geomean of the two throughput ratios.
 import contextlib
 import json
 import math
+import re
 import signal
 import sys
 import time
@@ -115,6 +116,30 @@ def _wall_clock_budget(seconds):
     finally:
         signal.setitimer(signal.ITIMER_REAL, 0.0)
         signal.signal(signal.SIGALRM, prev)
+
+
+# A dead accelerator surfaces as PJRT init failures of this shape — once
+# seen, every remaining device config would fail the same slow way
+# (each burning its full budget waiting on the tunnel), so the round
+# short-circuits instead.
+_BACKEND_DEAD_RE = re.compile(r"nable to initialize backend|UNAVAILABLE")
+
+
+def _probe_backend(budget_s):
+    """One bounded ``jax.devices()`` up front: returns ``(platform, None)``
+    when a backend came up, ``(None, reason)`` when init failed or hung.
+    Bounded at min(budget, 120s) — a dead tunnel otherwise blocks the
+    first config for its whole budget before the failure is visible."""
+    cap = min(budget_s, 120.0) if budget_s > 0 else 120.0
+    try:
+        with _wall_clock_budget(cap):
+            import jax
+
+            return jax.devices()[0].platform, None
+    except BenchTimeout:
+        return None, f"backend init exceeded {cap:g}s"
+    except Exception as e:  # PJRT raises RuntimeError subclasses; be broad
+        return None, repr(e)
 
 
 def bench_bert():
@@ -404,10 +429,26 @@ def bench_flash_32k():
 
 def main():
     budget_s = float(_os.environ.get("PADDLE_TPU_BENCH_BUDGET_S", "600"))
+    allow_cpu = _os.environ.get(
+        "PADDLE_TPU_BENCH_ALLOW_CPU", "") not in ("", "0")
+    platform, probe_err = _probe_backend(budget_s)
+    backend_dead = (probe_err is not None
+                    or (platform == "cpu" and not allow_cpu))
+    dead_reason = probe_err
+    if backend_dead and dead_reason is None:
+        dead_reason = ("jax initialized platform='cpu' — no accelerator; "
+                       "set PADDLE_TPU_BENCH_ALLOW_CPU=1 to measure anyway")
     results, failed = {}, []
     for name, fn in [("bert", bench_bert), ("resnet50", bench_resnet50),
                      ("mnist", bench_mnist), ("ctr", bench_ctr),
                      ("flash32k", bench_flash_32k)]:
+        if backend_dead:
+            # fail fast: don't let each remaining config rediscover the
+            # dead backend at one full budget apiece
+            failed.append(name)
+            _emit(f"{name}_failed", 0.0, "s", 0.0,
+                  status="backend_unavailable", reason=dead_reason)
+            continue
         t0 = time.perf_counter()
         try:
             with _wall_clock_budget(budget_s):
@@ -421,6 +462,9 @@ def main():
         except Exception as e:  # keep later configs running; failure visible
             failed.append(name)
             print(f"bench config {name!r} FAILED: {e!r}", file=sys.stderr)
+            if _BACKEND_DEAD_RE.search(repr(e)):
+                backend_dead = True
+                dead_reason = repr(e)
     if "bert" in results and "resnet50" in results:
         g = math.sqrt(results["bert"]["vs_baseline"]
                       * results["resnet50"]["vs_baseline"])
@@ -429,6 +473,14 @@ def main():
               resnet50_img_per_sec=results["resnet50"]["value"],
               methods={"bert": "run_steps_fused",
                        "resnet50": "run_steps_fused"})
+    # the summary line ALWAYS lands, whatever died above — a round with no
+    # final JSON line is indistinguishable from a crashed driver
+    status = ("backend_unavailable" if backend_dead
+              else "partial" if failed else "ok")
+    extra = {"reason": dead_reason} if backend_dead else {}
+    _emit("bench_summary", len(results), "configs",
+          1.0 if status == "ok" else 0.0, status=status,
+          measured=sorted(results), failed=failed, **extra)
     if failed:
         sys.exit(1)  # a green exit code must mean every config was measured
 

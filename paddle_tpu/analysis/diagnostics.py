@@ -80,6 +80,10 @@ RULES = {
     "S601": (Severity.WARNING,
              "serving bucket-miss churn (requests falling outside the "
              "configured shape buckets)"),
+    # -- kernel autotuner (K7xx) ---------------------------------------------
+    "K701": (Severity.WARNING,
+             "kernel autotuning inside a serving hot path (tuning cache "
+             "miss after warmup)"),
 }
 
 

@@ -55,6 +55,8 @@ class RetraceMonitor:
         # ("serving", name) engine snapshots: same latest-value semantics
         # (rule S601)
         self._serving_sites: Dict[str, dict] = {}
+        # ("autotune", kernel) tuner snapshots: latest per kernel (rule K701)
+        self._autotune_sites: Dict[str, dict] = {}
 
     # -- subscription --------------------------------------------------------
     def install(self):
@@ -81,6 +83,12 @@ class RetraceMonitor:
         if key[0] == "serving":
             with self._lock:
                 self._serving_sites[key[1]] = dict(info)
+            return
+        if key[0] == "autotune":
+            # tuner snapshot: latest counters per kernel — deduping would
+            # drop the counter ticks K701 exists to observe
+            with self._lock:
+                self._autotune_sites[key[1]] = dict(info)
             return
         sig = _freeze(info)
         with self._lock:
@@ -110,6 +118,15 @@ class RetraceMonitor:
             if name is not None:
                 return dict(self._serving_sites.get(name, {}))
             return {k: dict(v) for k, v in self._serving_sites.items()}
+
+    def autotune_stats(self, kernel: str = None):
+        """Latest autotuner snapshot(s) observed (resolution event, chosen
+        config, counter totals): the dict for one kernel (``kernel`` like
+        ``"flash_fwd"``), or all of them."""
+        with self._lock:
+            if kernel is not None:
+                return dict(self._autotune_sites.get(kernel, {}))
+            return {k: dict(v) for k, v in self._autotune_sites.items()}
 
     def diagnostics(self) -> List[Diagnostic]:
         out = DiagnosticCollector()
@@ -174,6 +191,25 @@ class RetraceMonitor:
                          "widen existing ones) so every request pads into "
                          "the closed executable set; keep "
                          "allow_bucket_fallback for rare stragglers only")
+        with self._lock:
+            autotune_sites = {k: dict(v)
+                              for k, v in self._autotune_sites.items()}
+        for kernel, stats in autotune_sites.items():
+            counters = stats.get("counters", {})
+            late = int(counters.get("searches_after_warm", 0))
+            if late <= 0:
+                continue
+            out.add("K701",
+                    f"kernel {kernel!r} ran {late} timed block-size "
+                    f"search(es) after serving warmup (last key "
+                    f"{stats.get('key')!r}) — a tuning cache miss in the "
+                    f"hot path stalls live requests behind compile+measure "
+                    f"of every candidate",
+                    location=Location(file=kernel, function=kernel),
+                    hint="pre-warm the tuner: run each kernel at its "
+                         "serving shapes before engine.warmup(), and ship "
+                         "the FLAGS_kernel_tuning_cache file so production "
+                         "processes start with every key resolved")
         return out.diagnostics
 
     @staticmethod

@@ -105,3 +105,20 @@ define_flag("persistent_compilation_cache", "",
             "directory ('1'/'true' picks a default under ~/.cache), so "
             "repeated process launches skip XLA recompiles. See "
             "sysconfig.enable_persistent_compilation_cache().")
+define_flag("kernel_autotune", "on",
+            "Pallas kernel tile-size tuning mode (ops/autotune.py): 'on' "
+            "runs a measured search on TPU and heuristic defaults "
+            "elsewhere; 'off' always takes the heuristic defaults; 'force' "
+            "measures even off-TPU (interpret mode — CI smoke only, the "
+            "timings are meaningless).")
+define_flag("kernel_tuning_cache", "",
+            "Persistent kernel-tuning cache (JSON). Empty picks the "
+            "default ~/.cache/paddle_tpu/kernel_tuning.json; '0'/'off' "
+            "disables persistence (winners live for the process only); "
+            "any other value is the cache file path. Pre-warm it by "
+            "running representative shapes once, then ship the file — "
+            "restarts and serving engines pay zero re-tuning.")
+define_flag("fused_epilogues", True,
+            "Let the BERT/GPT hot paths call the fused Pallas epilogues "
+            "(LayerNorm+residual, softmax-cross-entropy) on TPU. Off "
+            "falls back to the plain XLA ops everywhere.")

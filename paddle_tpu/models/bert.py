@@ -23,7 +23,7 @@ from ..distributed.meta_parallel import (
 )
 from ..nn import initializer as I
 from ..nn.layer_base import Layer
-from .gpt import GPTConfig, ParallelMLP
+from .gpt import GPTConfig, ParallelMLP, _fused_epilogues
 
 __all__ = [
     "BertConfig",
@@ -107,6 +107,16 @@ class BertLayer(Layer):
 
     def forward(self, x, attn_mask=None):
         # post-LN (original BERT): LN(x + sublayer(x))
+        if _fused_epilogues(x.shape[-1]):
+            from ..ops.fused_layernorm import layernorm_residual
+            _, x = layernorm_residual(
+                self.drop(self.attn(x, attn_mask)), x,
+                self.ln1.weight.value, self.ln1.bias.value,
+                epsilon=self.ln1.epsilon)
+            _, x = layernorm_residual(
+                self.mlp(x), x, self.ln2.weight.value, self.ln2.bias.value,
+                epsilon=self.ln2.epsilon)
+            return x
         x = self.ln1(x + self.drop(self.attn(x, attn_mask)))
         x = self.ln2(x + self.mlp(x))
         return x
@@ -185,14 +195,23 @@ class BertForPretraining(Layer):
 
     def loss(self, mlm_logits, nsp_logits, mlm_labels, nsp_labels,
              ignore_index: int = -100):
-        logp = jax.nn.log_softmax(mlm_logits, axis=-1)
         labels = jnp.asarray(mlm_labels)
         if labels.dtype in (jnp.int64, jnp.uint32, jnp.uint64):
             labels = labels.astype(jnp.int32)  # i32 gather on the big tensor
         safe = jnp.where(labels == ignore_index, 0, labels)
-        ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-        mask = (labels != ignore_index).astype(logp.dtype)
-        mlm_loss = -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        mask32 = (labels != ignore_index).astype(jnp.float32)
+        if _fused_epilogues():
+            from ..ops.fused_softmax_xent import softmax_cross_entropy
+            V = mlm_logits.shape[-1]
+            per = softmax_cross_entropy(mlm_logits.reshape(-1, V),
+                                        safe.reshape(-1))
+            mlm_loss = ((per * mask32.reshape(-1)).sum()
+                        / jnp.maximum(mask32.sum(), 1.0))
+        else:
+            logp = jax.nn.log_softmax(mlm_logits, axis=-1)
+            ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+            mask = mask32.astype(logp.dtype)
+            mlm_loss = -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
         nsp_logp = jax.nn.log_softmax(nsp_logits, axis=-1)
         nsp_loss = -jnp.take_along_axis(
             nsp_logp,

@@ -33,6 +33,16 @@ from ..nn.layer_base import Layer
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny", "gpt_small"]
 
 
+def _fused_epilogues(feature_dim=None) -> bool:
+    """Gate for the fused Pallas epilogues (same shape as _use_flash's
+    gate: a real TPU backend, aligned dims, no model/sep sharding)."""
+    try:
+        from ..ops.autotune import fused_epilogues_eligible
+    except ImportError:  # pallas/jax mismatch → plain XLA path
+        return False
+    return fused_epilogues_eligible(feature_dim)
+
+
 class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=None, max_position=1024,
@@ -231,6 +241,18 @@ class GPTBlock(Layer):
         self.mlp = ParallelMLP(cfg)
 
     def forward(self, x, attn_mask=None):
+        if _fused_epilogues(x.shape[-1]):
+            # fused residual+LN epilogue (ops/fused_layernorm.py): the
+            # attn-output residual add and ln2 run in one HBM pass; the
+            # kernel returns both the residual stream and the normalized
+            # activations the MLP consumes
+            from ..ops.fused_layernorm import layernorm_residual
+
+            a = self.attn(self.ln1(x), attn_mask)
+            s, h = layernorm_residual(a, x, self.ln2.weight.value,
+                                      self.ln2.bias.value,
+                                      epsilon=self.ln2.epsilon)
+            return s + self.mlp(h)
         x = x + self.attn(self.ln1(x), attn_mask)
         x = x + self.mlp(self.ln2(x))
         return x
@@ -380,6 +402,15 @@ class GPTForCausalLM(Layer):
         labels = jnp.asarray(labels)[:, 1:]
         if labels.dtype in (jnp.int64, jnp.uint32, jnp.uint64):
             labels = labels.astype(jnp.int32)
+        if _fused_epilogues():
+            # fused kernel (ops/fused_softmax_xent.py): online logsumexp
+            # over vocab blocks — the [B·S, V] log-prob tensor the
+            # XLA path writes to HBM never materializes
+            from ..ops.fused_softmax_xent import softmax_cross_entropy
+
+            V = logits.shape[-1]
+            return softmax_cross_entropy(logits.reshape(-1, V),
+                                         labels.reshape(-1)).mean()
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         return -ll.mean()

@@ -1,8 +1,30 @@
 """paddle_tpu.ops — Pallas TPU kernels for ops XLA won't fuse optimally.
 
 The reference's 650-kernel operator library (paddle/fluid/operators/) maps
-almost entirely to XLA-fused lax ops; this package holds the few hand
-kernels that beat the compiler (flash attention; more as profiling finds
-them).
+almost entirely to XLA-fused lax ops; this package holds the hand kernels
+that beat the compiler, plus the autotuner that picks their tile sizes:
+
+* ``flash_attention`` (+ ``flash_attention_fwd_lse`` /
+  ``flash_attention_bwd_chunk``) — O(S)-memory attention, forward and
+  backward, triangle-grid causal path (flash_attention.py);
+* ``conv1x1_bn_relu`` / ``conv1x1_bn_stats`` — 1x1-conv GEMM with the
+  train-mode BatchNorm statistics fused into the epilogue
+  (fused_conv1x1_bn.py);
+* ``layernorm_residual`` — residual add + LayerNorm in one HBM pass
+  (fused_layernorm.py);
+* ``softmax_cross_entropy`` — online-logsumexp label cross-entropy that
+  never materializes the [rows, vocab] probability matrix
+  (fused_softmax_xent.py);
+* ``autotune`` — measured block-size search with a persistent on-disk
+  cache; every kernel above resolves its tile parameters through it
+  (autotune.py).
 """
-from .flash_attention import flash_attention  # noqa: F401
+from . import autotune  # noqa: F401
+from .flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_bwd_chunk,
+    flash_attention_fwd_lse,
+)
+from .fused_conv1x1_bn import conv1x1_bn_relu, conv1x1_bn_stats  # noqa: F401
+from .fused_layernorm import layernorm_residual  # noqa: F401
+from .fused_softmax_xent import softmax_cross_entropy  # noqa: F401

@@ -50,6 +50,8 @@ from jax.experimental.pallas import tpu as pltpu
 if not hasattr(pltpu, "CompilerParams"):  # jax < 0.6 spells it TPUCompilerParams
     pltpu.CompilerParams = pltpu.TPUCompilerParams
 
+from . import autotune as _at
+
 __all__ = ["flash_attention", "flash_attention_fwd_lse",
            "flash_attention_bwd_chunk"]
 
@@ -367,8 +369,27 @@ def _bwd_dkv_kernel(*refs, block_q: int, causal: bool, sm_scale: float,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
-                q_offset, kv_len, delta=None):
+def _bwd_prepad(q, k, v, do, lse, delta, block_q, block_k):
+    """Clamp this backward kernel's blocks to ITS OWN padded problem and
+    pad every operand up to them — dq and dk/dv may run different tile
+    sizes than the forward (the autotuner picks each independently), so
+    each backward pallas_call re-establishes the block-multiple invariant
+    itself.  New padded q rows carry do = 0, so their (garbage-lse)
+    contributions to dq/dk/dv are exactly zero; padded kv columns are
+    masked by kv_len as everywhere else."""
+    S, K = q.shape[2], k.shape[2]
+    bq, bk, padq, padk = _blocks_and_pad(S, K, block_q, block_k)
+    return (bq, bk, padq(q), padk(k), padk(v), padq(do), padq(lse),
+            padq(delta))
+
+
+def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k,
+            q_offset, kv_len):
+    """dq half of the flash-2 backward: owns a q block, streams kv.
+    lse/delta are [B, H, S] (unpadded trailing length is fine)."""
+    S0 = q.shape[2]
+    bq, bk, q, k, v, do, lse, delta = _bwd_prepad(q, k, v, do, lse, delta,
+                                                  block_q, block_k)
     B, H, S, D = q.shape
     K = k.shape[2]
     qs = q.reshape(B * H, S, D)
@@ -376,14 +397,12 @@ def _bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
     vs = v.reshape(B * H, K, D)
     dos = do.reshape(B * H, S, D)
     lses = lse.reshape(B * H, S, 1)
-    if delta is None:
-        # delta = rowsum(dO ⊙ O): one fused elementwise+reduce in XLA
-        delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)
     deltas = delta.reshape(B * H, S, 1)
 
     _I0 = np.int32(0)
     interpret = jax.default_backend() != "tpu"
-    triangle = _use_triangle(causal, q_offset, S, K, block_q, block_k)
+    triangle = _use_triangle(causal, q_offset, S, K, bq, bk)
+    block_q, block_k = bq, bk
 
     dq_kern = functools.partial(_bwd_dq_kernel, kv_seq=K, kv_len=kv_len,
                                 block_k=block_k, causal=causal,
@@ -437,6 +456,28 @@ def _bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
             interpret=interpret,
         )(qs, ks, vs, dos, lses, deltas)
+    return dq.reshape(B, H, S, D)[:, :, :S0]
+
+
+def _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k,
+             q_offset, kv_len):
+    """dk/dv half of the flash-2 backward: owns a kv block, streams q."""
+    K0 = k.shape[2]
+    bq, bk, q, k, v, do, lse, delta = _bwd_prepad(q, k, v, do, lse, delta,
+                                                  block_q, block_k)
+    B, H, S, D = q.shape
+    K = k.shape[2]
+    qs = q.reshape(B * H, S, D)
+    ks = k.reshape(B * H, K, D)
+    vs = v.reshape(B * H, K, D)
+    dos = do.reshape(B * H, S, D)
+    lses = lse.reshape(B * H, S, 1)
+    deltas = delta.reshape(B * H, S, 1)
+
+    _I0 = np.int32(0)
+    interpret = jax.default_backend() != "tpu"
+    triangle = _use_triangle(causal, q_offset, S, K, bq, bk)
+    block_q, block_k = bq, bk
 
     dkv_shape = [
         jax.ShapeDtypeStruct((B * H, K, D), k.dtype),
@@ -504,32 +545,115 @@ def _bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
             interpret=interpret,
         )(qs, ks, vs, dos, lses, deltas)
 
-    return (dq.reshape(B, H, S, D), dk.reshape(B, H, K, D),
-            dv.reshape(B, H, K, D))
+    return (dk.reshape(B, H, K, D)[:, :, :K0],
+            dv.reshape(B, H, K, D)[:, :, :K0])
+
+
+# ---------------------------------------------------------------------------
+# autotuning (ops/autotune.py): the three kernels tune independently
+# ---------------------------------------------------------------------------
+def _seq_candidates(n):
+    """Block candidates for a length-n sequence dim, clamped to the PADDED
+    length — short serving buckets never pay full-width padded tiles."""
+    return _at.tile_candidates(n, base=(128, 256, 512, 1024))
+
+
+def _flash_space(q, k, v, *rest, causal=False, q_offset=0, **_):
+    """Candidate (block_q, block_k) pairs.  The plain-causal case keeps
+    square blocks only so every candidate stays on the triangle grid; the
+    rectangular cases keep the aspect ratio within [1/2, 2] (strongly
+    skewed tiles starve one of the matmul dims).  The VMEM estimate
+    covers the resident q/k/v/do blocks, the f32 accumulators and the
+    (block, 128) running-stat scratch."""
+    S, K, D = q.shape[2], k.shape[2], q.shape[3]
+    itemsize = np.dtype(q.dtype).itemsize
+    square_only = causal and q_offset == 0 and S == K
+    out = []
+    for bq in _seq_candidates(S):
+        for bk in _seq_candidates(K):
+            if square_only:
+                if bq != bk:
+                    continue
+            elif not 0.5 <= bq / bk <= 2.0:
+                continue
+            resident = ((2 * bq + 2 * bk) * D * itemsize
+                        + (2 * bq * 128 + (bq + 2 * bk) * D + 2 * bq) * 4)
+            if _at.vmem_fits(resident):
+                out.append({"block_q": bq, "block_k": bk})
+    return out
+
+
+def _flash_heuristic(*args, **_):
+    # the pre-autotuner defaults (512-blocks measured fastest on v5e at
+    # 32k); _pick_block clamps them to short sequences exactly as before
+    return {"block_q": 512, "block_k": 512}
+
+
+_TUNE_KW = ("causal", "q_offset")  # non-array kwargs that shape the kernel
+
+
+@_at.autotune("flash_fwd", params=("block_q", "block_k"),
+              space=_flash_space, heuristic=_flash_heuristic,
+              key_kwargs=_TUNE_KW)
+def _fwd_tuned(q, k, v, *, causal, sm_scale, q_offset, kv_len,
+               block_q, block_k):
+    S, K = q.shape[2], k.shape[2]
+    bq, bk, padq, padk = _blocks_and_pad(S, K, block_q, block_k)
+    out, lse = _fwd_pallas(padq(q), padk(k), padk(v), causal, sm_scale,
+                           bq, bk, q_offset, kv_len)
+    return out[:, :, :S], lse[:, :, :S]
+
+
+@_at.autotune("flash_bwd_dq", params=("block_q", "block_k"),
+              space=_flash_space, heuristic=_flash_heuristic,
+              key_kwargs=_TUNE_KW)
+def _dq_tuned(q, k, v, do, lse, delta, *, causal, sm_scale, q_offset,
+              kv_len, block_q, block_k):
+    return _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q,
+                   block_k, q_offset, kv_len)
+
+
+@_at.autotune("flash_bwd_dkv", params=("block_q", "block_k"),
+              space=_flash_space, heuristic=_flash_heuristic,
+              key_kwargs=_TUNE_KW)
+def _dkv_tuned(q, k, v, do, lse, delta, *, causal, sm_scale, q_offset,
+               kv_len, block_q, block_k):
+    return _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q,
+                    block_k, q_offset, kv_len)
 
 
 # ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, q_offset, kv_len):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, q_offset, kv_len,
+           tuned):
     out, _ = _fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k,
                          q_offset, kv_len)
     return out
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, q_offset,
-               kv_len):
+               kv_len, tuned):
     out, lse = _fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k,
                            q_offset, kv_len)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, q_offset, kv_len, res,
-               do):
+def _flash_bwd(causal, sm_scale, block_q, block_k, q_offset, kv_len, tuned,
+               res, do):
     q, k, v, out, lse = res
-    return _bwd_pallas(q, k, v, out, lse, do, causal, sm_scale, block_q,
-                       block_k, q_offset, kv_len)
+    # delta = rowsum(dO ⊙ O): one fused elementwise+reduce in XLA,
+    # loop-invariant across both backward kernels
+    delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    # `tuned` (the forward took autotuner blocks): let each backward
+    # kernel resolve its own tile sizes; explicit blocks pin both.
+    bq, bk = (None, None) if tuned else (block_q, block_k)
+    kw = dict(causal=causal, sm_scale=sm_scale, q_offset=q_offset,
+              kv_len=kv_len, block_q=bq, block_k=bk)
+    dq = _dq_tuned(q, k, v, do, lse, delta, **kw)
+    dk, dv = _dkv_tuned(q, k, v, do, lse, delta, **kw)
+    return dq, dk, dv
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -577,24 +701,24 @@ def _blocks_and_pad(S, K, block_q, block_k):
 def flash_attention_fwd_lse(q, k, v, causal: bool = False,
                             sm_scale: Optional[float] = None,
                             q_position_offset: int = 0,
-                            block_q: int = 512, block_k: int = 512):
+                            block_q: Optional[int] = None,
+                            block_k: Optional[int] = None):
     """Forward-only kernel run returning ``(out, lse)`` — the building
     block ring attention's custom_vjp forward uses to merge per-chunk
-    partials (sequence_parallel.py).  Not differentiable on its own."""
+    partials (sequence_parallel.py).  Not differentiable on its own.
+    Blocks default to the autotuner; pass them explicitly to pin."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    S, K = q.shape[2], k.shape[2]
-    bq, bk, padq, padk = _blocks_and_pad(S, K, block_q, block_k)
-    out, lse = _fwd_pallas(padq(q), padk(k), padk(v), causal,
-                           float(sm_scale), bq, bk,
-                           int(q_position_offset), int(K))
-    return out[:, :, :S], lse[:, :, :S]
+    return _fwd_tuned(q, k, v, causal=causal, sm_scale=float(sm_scale),
+                      q_offset=int(q_position_offset), kv_len=int(k.shape[2]),
+                      block_q=block_q, block_k=block_k)
 
 
 def flash_attention_bwd_chunk(q, k, v, out, lse, do, causal: bool = False,
                               sm_scale: Optional[float] = None,
                               q_position_offset: int = 0,
-                              block_q: int = 512, block_k: int = 512,
+                              block_q: Optional[int] = None,
+                              block_k: Optional[int] = None,
                               delta=None):
     """One chunk's flash-2 backward given the GLOBAL (merged) out/lse for
     the local q rows: returns this (q, kv-chunk) pair's additive
@@ -602,25 +726,28 @@ def flash_attention_bwd_chunk(q, k, v, out, lse, do, causal: bool = False,
     p = exp(s − lse_global) the backward is linear over kv chunks.  Ring
     attention's custom_vjp backward sums these around the ring; it passes
     the loop-invariant ``delta = rowsum(dO·O)`` so it is computed once,
-    not once per ring step."""
+    not once per ring step.  Blocks default to the autotuner (dq and
+    dk/dv resolve independently); pass them explicitly to pin both."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     S, K = q.shape[2], k.shape[2]
-    bq, bk, padq, padk = _blocks_and_pad(S, K, block_q, block_k)
-    lsep = lse if lse.shape[2] == _round_up(S, bq) else jnp.pad(
-        lse, ((0, 0), (0, 0), (0, _round_up(S, bq) - S)))
-    deltap = None if delta is None else (
-        delta if delta.shape[2] == _round_up(S, bq) else jnp.pad(
-            delta, ((0, 0), (0, 0), (0, _round_up(S, bq) - S))))
-    dq, dk, dv = _bwd_pallas(padq(q), padk(k), padk(v), padq(out), lsep,
-                             padq(do), causal, float(sm_scale), bq, bk,
-                             int(q_position_offset), int(K), delta=deltap)
+    # the kernels re-pad to their own blocks; normalize stats to length S
+    lse = lse[:, :, :S]
+    if delta is None:
+        delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    delta = delta[:, :, :S]
+    kw = dict(causal=causal, sm_scale=float(sm_scale),
+              q_offset=int(q_position_offset), kv_len=int(K),
+              block_q=block_q, block_k=block_k)
+    dq = _dq_tuned(q, k, v, do, lse, delta, **kw)
+    dk, dv = _dkv_tuned(q, k, v, do, lse, delta, **kw)
     return dq[:, :, :S], dk[:, :, :K], dv[:, :, :K]
 
 
 def flash_attention(q, k, v, causal: bool = False,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 512, block_k: int = 512,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     q_position_offset: int = 0):
     """Memory-efficient attention.
 
@@ -632,12 +759,28 @@ def flash_attention(q, k, v, causal: bool = False,
 
     Any shape takes the kernel path: ragged sequence lengths are padded up
     to block multiples and the kernels mask padded key positions, so there
-    is no O(S²) fallback.  Default 512-blocks measured fastest on v5e
-    (~34 TFLOP/s effective causal fwd at 32k; 128-blocks were 4× slower).
+    is no O(S²) fallback.
+
+    Block sizes default to the autotuner (``ops.autotune``): a measured
+    search on TPU — the forward and both backward kernels pick their tile
+    sizes independently, memoized persistently per shape bucket — and the
+    512-block heuristic elsewhere (512s measured fastest on v5e at 32k:
+    ~34 TFLOP/s effective causal fwd; 128-blocks were 4× slower).  Pass
+    ``block_q``/``block_k`` explicitly to pin all three kernels.
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     S, K = q.shape[2], k.shape[2]
+    tuned = block_q is None and block_k is None
+    if tuned:
+        cfg = _fwd_tuned.config(q, k, v, causal=causal,
+                                sm_scale=float(sm_scale),
+                                q_offset=int(q_position_offset),
+                                kv_len=int(K))
+        block_q, block_k = cfg["block_q"], cfg["block_k"]
+    else:
+        block_q = 512 if block_q is None else block_q
+        block_k = 512 if block_k is None else block_k
     bq = _pick_block(block_q, S)
     bk = _pick_block(block_k, K)
     Sp = _round_up(S, bq)
@@ -646,5 +789,5 @@ def flash_attention(q, k, v, causal: bool = False,
     kp = k if Kp == K else jnp.pad(k, ((0, 0), (0, 0), (0, Kp - K), (0, 0)))
     vp = v if Kp == K else jnp.pad(v, ((0, 0), (0, 0), (0, Kp - K), (0, 0)))
     out = _flash(qp, kp, vp, causal, float(sm_scale), bq, bk,
-                 int(q_position_offset), int(K))
+                 int(q_position_offset), int(K), tuned)
     return out if Sp == S else out[:, :, :S]

@@ -49,6 +49,7 @@ if not hasattr(pltpu, "CompilerParams"):  # jax < 0.6 spells it TPUCompilerParam
     pltpu.CompilerParams = pltpu.TPUCompilerParams
 
 from ..framework.errors import InvalidArgumentError
+from . import autotune as _at
 
 __all__ = ["conv1x1_bn_stats", "conv1x1_bn_relu"]
 
@@ -77,15 +78,33 @@ def _kernel(x_ref, w_ref, y_ref, sum_ref, sq_ref, acc_s, acc_q):
         sq_ref[...] = acc_q[...]
 
 
-@functools.partial(jax.jit, static_argnames=("block_m", "block_n"))
-def conv1x1_bn_stats(x, w, *, block_m: int = 512, block_n: int = 256):
-    """``Y = X @ W`` plus per-output-channel ``(Σy, Σy²)`` in ONE pass.
+def _space(x, w):
+    """Candidate (block_m, block_n) tiles: Mosaic-aligned, clamped to the
+    padded problem, filtered by the resident-VMEM estimate (x, w and y
+    blocks plus the two stats scratch rows)."""
+    M, K = x.shape
+    N = w.shape[1]
+    itemsize = np.dtype(x.dtype).itemsize
+    out = []
+    for bm in _at.tile_candidates(M, base=(128, 256, 512, 1024)):
+        for bn in _at.tile_candidates(N, multiple=_at.LANE,
+                                      base=(128, 256, 512)):
+            resident = (bm * K + K * bn + bm * bn) * itemsize + 2 * bn * 4
+            if _at.vmem_fits(resident):
+                out.append({"block_m": bm, "block_n": bn})
+    return out
 
-    x: ``[M, Cin]`` (flattened NHWC activations), w: ``[Cin, Cout]``.
-    Returns ``(y [M, Cout], sum [Cout] f32, sumsq [Cout] f32)``.
-    M and Cout are padded to block multiples internally (padding rows
-    contribute zeros to the stats — exact).
-    """
+
+def _heuristic(x, w):
+    # the pre-autotuner defaults — the in-kernel clamp keeps them valid
+    # (and bit-identical to the old behavior) at every shape
+    return {"block_m": 512, "block_n": 256}
+
+
+@_at.autotune("conv1x1_bn_stats", params=("block_m", "block_n"),
+              space=_space, heuristic=_heuristic)
+@functools.partial(jax.jit, static_argnames=("block_m", "block_n"))
+def _conv1x1_bn_stats(x, w, *, block_m: int, block_n: int):
     M, K = x.shape
     K2, N = w.shape
     if K != K2:
@@ -131,10 +150,27 @@ def conv1x1_bn_stats(x, w, *, block_m: int = 512, block_n: int = 256):
     return y[:M, :N], s[0, :N], q[0, :N]
 
 
+def conv1x1_bn_stats(x, w, *, block_m: Optional[int] = None,
+                     block_n: Optional[int] = None):
+    """``Y = X @ W`` plus per-output-channel ``(Σy, Σy²)`` in ONE pass.
+
+    x: ``[M, Cin]`` (flattened NHWC activations), w: ``[Cin, Cout]``.
+    Returns ``(y [M, Cout], sum [Cout] f32, sumsq [Cout] f32)``.
+    M and Cout are padded to block multiples internally (padding rows
+    contribute zeros to the stats — exact).
+
+    Tile sizes default to the autotuner (``ops.autotune``): measured on
+    TPU, the 512x256 heuristic elsewhere.  Pass ``block_m``/``block_n``
+    explicitly to bypass tuning.
+    """
+    return _conv1x1_bn_stats(x, w, block_m=block_m, block_n=block_n)
+
+
 def conv1x1_bn_relu(x, w, gamma, beta, *, epsilon: float = 1e-5,
                     residual=None, momentum: float = 0.9,
                     running_mean=None, running_var=None,
-                    block_m: int = 512, block_n: int = 256):
+                    block_m: Optional[int] = None,
+                    block_n: Optional[int] = None):
     """Train-mode ``relu(BN(X @ W) [+ residual])`` in two passes instead of
     XLA's three (see module doc).  x ``[M, Cin]`` NHWC-flattened.
 

@@ -31,6 +31,7 @@ __all__ = [
     "profiler",
     "summary",
     "export_chrome_tracing",
+    "register_summary_section",
 ]
 
 _lock = threading.Lock()
@@ -39,6 +40,21 @@ _spans: list = []  # (name, tid, start_us, dur_us) while profiling
 _SPAN_CAP = 200_000  # keep the host-side buffer bounded
 _trace_dir: Optional[str] = None
 _started = False
+_sections: list = []  # (render_fn, on_reset) extra summary() sections
+
+
+def register_summary_section(render_fn, on_reset=None) -> None:
+    """Let a subsystem append its own block to ``summary()``.
+
+    ``render_fn() -> str`` runs at summary time; an empty string means
+    "nothing to report" and the section is skipped (so ``summary()``
+    still returns ``""`` when there is nothing at all to show).
+    ``on_reset`` (optional) runs inside ``reset_profiler()`` so the
+    subsystem can snapshot its counters — sections report activity since
+    the last reset, matching the host-event table's lifecycle.  Used by
+    ``ops.autotune`` for the kernel-tuning cache statistics."""
+    with _lock:
+        _sections.append((render_fn, on_reset))
 
 
 class RecordEvent:
@@ -132,6 +148,9 @@ def reset_profiler():
     with _lock:
         _events.clear()
         _spans.clear()
+        hooks = [h for _, h in _sections if h is not None]
+    for hook in hooks:
+        hook()
 
 
 def export_chrome_tracing(path: str) -> int:
@@ -158,15 +177,19 @@ def export_chrome_tracing(path: str) -> int:
 
 
 def summary(sorted_key: Optional[str] = "total") -> str:
-    """The reference's PrintProfiler table (profiler.cc) from host events."""
+    """The reference's PrintProfiler table (profiler.cc) from host events,
+    followed by any registered subsystem sections (see
+    ``register_summary_section``)."""
     with _lock:
         rows = [
             (name, e["calls"], e["total"], e["total"] / e["calls"],
              e["min"], e["max"])
             for name, e in _events.items()
         ]
+        sections = [fn for fn, _ in _sections]
+    extra = [s for s in (fn() for fn in sections) if s]
     if not rows:
-        return ""
+        return "\n\n".join(extra) if extra else ""
     key_idx = {"calls": 1, "total": 2, "ave": 3, "min": 4, "max": 5}.get(
         sorted_key or "total", 2)
     rows.sort(key=lambda r: r[key_idx], reverse=True)
@@ -180,7 +203,8 @@ def summary(sorted_key: Optional[str] = "total") -> str:
         lines.append(
             f"{name:<{w}}{calls:>8}{total:>12.3f}{avg:>10.3f}"
             f"{mn:>10.3f}{mx:>10.3f}{total / grand:>8.2%}")
-    return "\n".join(lines)
+    table = "\n".join(lines)
+    return "\n\n".join([table] + extra) if extra else table
 
 
 @contextlib.contextmanager

@@ -123,6 +123,8 @@ class InferenceEngine:
         executable count."""
         for i in range(len(self._buckets)):
             self._executable(i)
+        from ..ops import autotune
+        autotune.mark_warm()  # later tuner searches are hot-path (K701)
         return self.compile_count
 
     # -- execution -----------------------------------------------------------

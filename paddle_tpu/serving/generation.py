@@ -129,6 +129,8 @@ class GenerationEngine:
             self._decode(self._params, self._buffers, tok,
                          jnp.full((B,), sb, jnp.int32), cache)
         self.metrics.set_counter("compiles", self.compile_count)
+        from ..ops import autotune
+        autotune.mark_warm()  # later tuner searches are hot-path (K701)
         return self.compile_count
 
     # -- batch execution -----------------------------------------------------

@@ -12,7 +12,8 @@ import os
 
 __all__ = ["get_include", "get_lib",
            "enable_persistent_compilation_cache",
-           "maybe_enable_persistent_compilation_cache"]
+           "maybe_enable_persistent_compilation_cache",
+           "kernel_tuning_cache_path"]
 
 
 def get_include() -> str:
@@ -76,3 +77,12 @@ def maybe_enable_persistent_compilation_cache() -> None:
         return
     enable_persistent_compilation_cache(
         None if val.lower() in ("1", "true", "yes", "on") else val)
+
+
+def kernel_tuning_cache_path() -> str | None:
+    """Where the Pallas kernel autotuner persists measured block sizes
+    (``FLAGS_kernel_tuning_cache``; the XLA executable cache above is a
+    separate store).  ``None`` when disk persistence is disabled."""
+    from .ops.autotune import cache_path
+
+    return cache_path()

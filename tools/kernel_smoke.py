@@ -1,0 +1,148 @@
+"""Kernel-autotuner gate: measured search + persistent cache, on CPU.
+
+One-command proof of the ``ops.autotune`` contracts, cheap enough for
+every gate run (forced measurement in Pallas interpret mode, tiny
+shapes):
+
+1. **Cold process** — with a fresh cache file and
+   ``FLAGS_kernel_autotune=force``, every kernel (flash fwd + both
+   backwards via grad, conv1x1+BN, layernorm_residual, softmax_xent)
+   resolves its tiles through a timed search: ``searches > 0``,
+   ``configs_timed > 0``, an ``("autotune", ...)`` trace event fires per
+   kernel, and the cache file lands on disk with one entry per key.
+2. **Warm process** — a second, separate process over the same cache
+   file does ZERO timed searches: every key resolves as ``disk_hits``
+   (then memory hits), so a production restart never re-measures.
+
+The parent spawns each phase as its own subprocess so the warm run
+proves *process-level* persistence (nothing survives but the file).
+Prints one JSON line; exit 0 iff both phases hold.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_CHILD = """
+import json, sys
+
+import numpy as np
+
+from paddle_tpu.framework import trace_events
+from paddle_tpu.ops import autotune
+from paddle_tpu.ops.flash_attention import flash_attention
+from paddle_tpu.ops.fused_conv1x1_bn import conv1x1_bn_stats
+from paddle_tpu.ops.fused_layernorm import layernorm_residual
+from paddle_tpu.ops.fused_softmax_xent import softmax_cross_entropy
+
+import jax
+import jax.numpy as jnp
+
+events = []
+trace_events.register(lambda site, info: events.append(
+    {"site": list(site), "event": info.get("event")}))
+
+rng = np.random.RandomState(0)
+
+# flash: fwd + grad (grad drives the two backward kernels' tuners)
+q = jnp.asarray(rng.randn(1, 2, 64, 32), jnp.float32)
+k = jnp.asarray(rng.randn(1, 2, 64, 32), jnp.float32)
+v = jnp.asarray(rng.randn(1, 2, 64, 32), jnp.float32)
+loss = lambda q, k, v: flash_attention(q, k, v, causal=True).sum()
+out = flash_attention(q, k, v, causal=True)
+gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+assert np.isfinite(np.asarray(out)).all() and np.isfinite(np.asarray(gq)).all()
+
+# conv1x1 + bn stats
+x = jnp.asarray(rng.randn(64, 16), jnp.float32)
+w = jnp.asarray(rng.randn(16, 32), jnp.float32)
+y, s, sq = conv1x1_bn_stats(x, w)
+np.testing.assert_allclose(np.asarray(y), np.asarray(x) @ np.asarray(w),
+                           rtol=1e-5, atol=1e-5)
+
+# layernorm + residual
+a = jnp.asarray(rng.randn(48, 32), jnp.float32)
+r = jnp.asarray(rng.randn(48, 32), jnp.float32)
+g = jnp.ones((32,), jnp.float32)
+b = jnp.zeros((32,), jnp.float32)
+sres, yn = layernorm_residual(a, r, g, b)
+np.testing.assert_allclose(np.asarray(sres), np.asarray(a + r),
+                           rtol=1e-6, atol=1e-6)
+
+# softmax cross-entropy
+logits = jnp.asarray(rng.randn(32, 96), jnp.float32)
+labels = jnp.asarray(rng.randint(0, 96, 32), jnp.int32)
+lo = softmax_cross_entropy(logits, labels)
+ref = -np.take_along_axis(
+    np.asarray(jax.nn.log_softmax(logits, -1)),
+    np.asarray(labels)[:, None], 1)[:, 0]
+np.testing.assert_allclose(np.asarray(lo), ref, rtol=1e-5, atol=1e-5)
+
+print(json.dumps({"counters": autotune.get_counters(),
+                  "events": events,
+                  "cache_path": autotune.cache_path()}))
+"""
+
+
+def _run_child(cache_file):
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               FLAGS_kernel_autotune="force",
+               FLAGS_kernel_tuning_cache=cache_file)
+    proc = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stdout + proc.stderr)
+        raise SystemExit(f"kernel_smoke child failed (rc={proc.returncode})")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main():
+    t0 = time.time()
+    fd, cache_file = tempfile.mkstemp(suffix=".json", prefix="ktune_")
+    os.close(fd)
+    os.unlink(cache_file)  # children create it; start truly cold
+    try:
+        cold = _run_child(cache_file)
+        warm = _run_child(cache_file)
+    finally:
+        if os.path.exists(cache_file):
+            entries = len(json.load(open(cache_file)).get("entries", {}))
+            os.unlink(cache_file)
+        else:
+            entries = 0
+
+    def total(per_kernel):  # get_counters() is {kernel: {counter: n}}
+        out = {}
+        for d in per_kernel.values():
+            for key, n in d.items():
+                out[key] = out.get(key, 0) + n
+        return out
+
+    cc, wc = total(cold["counters"]), total(warm["counters"])
+    cold_kernels = sorted({e["site"][1] for e in cold["events"]
+                           if e["site"][0] == "autotune"})
+    checks = {
+        # cold process: every kernel measured, events observed, file written
+        "cold_searches": cc["searches"] >= 5,
+        "cold_timed": cc["configs_timed"] > 0,
+        "cold_events": len(cold_kernels) >= 5,
+        "cache_entries": entries >= 5,
+        # warm process: pure disk hits — ZERO timed searches after restart
+        "warm_zero_searches": wc["searches"] == 0,
+        "warm_zero_timed": wc["configs_timed"] == 0,
+        "warm_disk_hits": wc["disk_hits"] >= 5,
+    }
+    ok = all(checks.values())
+    print(json.dumps({
+        "gate": "kernel_smoke", "ok": ok, "checks": checks,
+        "cold_counters": cc, "warm_counters": wc,
+        "kernels_tuned": cold_kernels, "cache_entries": entries,
+        "seconds": round(time.time() - t0, 1)}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,9 +11,10 @@
 #   dryrun  __graft_entry__.dryrun_multichip(8) on a virtual CPU mesh
 #   perf-smoke tools/perf_smoke.py   (fused run_steps vs per-step, CPU, seconds)
 #   serving-smoke tools/serving_smoke.py (closed compile set + KV-decode identity)
+#   kernel-smoke tools/kernel_smoke.py (autotuner search + warm-restart cache hit)
 #   bench   python bench.py          (only when a real TPU answers)
 #
-# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|bench]...
+# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|bench]...
 #         tools/run_gates.sh --only suite
 # Exit code: 0 iff every stage that ran passed.
 set -u
@@ -93,6 +94,9 @@ run_stage perf-smoke env JAX_PLATFORMS=cpu python tools/perf_smoke.py
 # serving: closed compile set + exact padded/unpadded answers + KV-decode
 # token identity (CPU correctness gate, not a throughput claim)
 run_stage serving-smoke env JAX_PLATFORMS=cpu python tools/serving_smoke.py
+# kernel autotuner: forced measured search in interpret mode, then a second
+# process that must resolve every key from the on-disk cache (zero searches)
+run_stage kernel-smoke env JAX_PLATFORMS=cpu python tools/kernel_smoke.py
 
 # bench only when a real accelerator answers within 60s
 if want bench; then
