@@ -90,6 +90,7 @@ from . import regularizer  # noqa: F401
 from . import sysconfig  # noqa: F401
 from . import utils  # noqa: F401
 from . import inference  # noqa: F401
+from . import resilience  # noqa: F401
 from . import serving  # noqa: F401
 from . import static  # noqa: F401
 from .static import InputSpec  # noqa: F401

@@ -84,6 +84,10 @@ RULES = {
     "K701": (Severity.WARNING,
              "kernel autotuning inside a serving hot path (tuning cache "
              "miss after warmup)"),
+    # -- resilience monitor (F8xx) -------------------------------------------
+    "F801": (Severity.WARNING,
+             "resilience instability in a warmed serving path (transient "
+             "retry storm or circuit flapping)"),
 }
 
 

@@ -57,6 +57,9 @@ class RetraceMonitor:
         self._serving_sites: Dict[str, dict] = {}
         # ("autotune", kernel) tuner snapshots: latest per kernel (rule K701)
         self._autotune_sites: Dict[str, dict] = {}
+        # ("resilience", retry:<name>|circuit:<name>|fault:<site>) counter
+        # snapshots: latest per policy / per circuit key (rule F801)
+        self._resilience_sites: Dict[str, dict] = {}
 
     # -- subscription --------------------------------------------------------
     def install(self):
@@ -89,6 +92,16 @@ class RetraceMonitor:
             # drop the counter ticks K701 exists to observe
             with self._lock:
                 self._autotune_sites[key[1]] = dict(info)
+            return
+        if key[0] == "resilience":
+            # retry/circuit/fault counter snapshots: latest value wins;
+            # circuit transitions carry per-key cumulative counters, so
+            # keep one slot per (breaker, key)
+            name = key[1]
+            if isinstance(info, dict) and info.get("kind") == "circuit":
+                name = f"{name}[{info.get('key')}]"
+            with self._lock:
+                self._resilience_sites[name] = dict(info)
             return
         sig = _freeze(info)
         with self._lock:
@@ -127,6 +140,16 @@ class RetraceMonitor:
             if kernel is not None:
                 return dict(self._autotune_sites.get(kernel, {}))
             return {k: dict(v) for k, v in self._autotune_sites.items()}
+
+    def resilience_stats(self, name: str = None):
+        """Latest resilience snapshot(s) observed — retry counters per
+        policy (``"retry:engine#1.runner"``), circuit transitions per
+        breaker key (``"circuit:engine#1[0]"``), fault-point firings
+        (``"fault:checkpoint.write"``): one dict, or all of them."""
+        with self._lock:
+            if name is not None:
+                return dict(self._resilience_sites.get(name, {}))
+            return {k: dict(v) for k, v in self._resilience_sites.items()}
 
     def diagnostics(self) -> List[Diagnostic]:
         out = DiagnosticCollector()
@@ -210,6 +233,42 @@ class RetraceMonitor:
                          "serving shapes before engine.warmup(), and ship "
                          "the FLAGS_kernel_tuning_cache file so production "
                          "processes start with every key resolved")
+        with self._lock:
+            res_sites = {k: dict(v)
+                         for k, v in self._resilience_sites.items()}
+        for name, stats in res_sites.items():
+            kind = stats.get("kind")
+            if kind == "retry":
+                late = int(stats.get("retries_after_warm", 0))
+                if late <= self.budget:
+                    continue
+                out.add("F801",
+                        f"retry policy {name!r} retried {late} transient "
+                        f"failures after serving warmup (budget "
+                        f"{self.budget}; {stats.get('giveups', 0)} "
+                        f"giveups) — a retry storm in the hot path hides "
+                        f"a persistently failing device behind added "
+                        f"latency instead of surfacing it",
+                        location=Location(file=name, function=name),
+                        hint="find the fault behind the retries (device "
+                             "health, OOM pressure); lower "
+                             "FLAGS_transient_max_retries or let the "
+                             "circuit breaker shed the traffic instead")
+            elif kind == "circuit":
+                flaps = int(stats.get("opens_after_warm", 0))
+                if flaps < 3:
+                    continue
+                out.add("F801",
+                        f"circuit {name} opened {flaps} times after "
+                        f"serving warmup ({stats.get('sheds', 0)} requests "
+                        f"shed) — flapping means the cooldown keeps "
+                        f"admitting probes into a fault that never "
+                        f"cleared",
+                        location=Location(file=name, function=name),
+                        hint="raise FLAGS_circuit_cooldown_ms (probe "
+                             "less often) or fix the underlying bucket "
+                             "failure; a circuit that reopens every "
+                             "cooldown is a fault, not protection")
         return out.diagnostics
 
     @staticmethod

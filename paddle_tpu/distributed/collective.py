@@ -97,6 +97,11 @@ _REDUCERS = {
 
 
 def _group_axis(group) -> str:
+    # the one seam every collective passes through — chaos plans inject
+    # device/interconnect failures here (site "collective.call")
+    from ..resilience.faults import fault_point
+
+    fault_point("collective.call")
     if group is None:
         return "data"
     if isinstance(group, str):

@@ -66,7 +66,8 @@ class HeartBeatMonitor:
         self._beats: Dict[int, float] = {}
         self._lost: Dict[int, bool] = {i: False for i in range(workers)}
         self._lock = threading.Lock()
-        self._running = False
+        self._stop = threading.Event()
+        self._stop.set()  # not running until start()
         self._thread: Optional[threading.Thread] = None
         self._t0 = time.monotonic()  # reset by start()
 
@@ -93,6 +94,10 @@ class HeartBeatMonitor:
                     self._lost[i] = True
                     fire.append((i, age))
         for i, age in fire:
+            if self._stop.is_set():
+                # stop() raced the sweep: the lost state stays latched for
+                # lost_workers(), but no callback fires after shutdown
+                return
             try:
                 from ..framework import monitor as _monitor
                 from ..framework.logging import vlog
@@ -113,22 +118,25 @@ class HeartBeatMonitor:
                     traceback.print_exc()
 
     def _run(self) -> None:
-        while self._running:
+        while not self._stop.is_set():
             self._sweep()
-            time.sleep(self.interval)
+            # Event.wait, not time.sleep: stop() interrupts the pause
+            # immediately instead of blocking shutdown for up to a full
+            # sweep interval
+            self._stop.wait(self.interval)
 
     def start(self) -> "HeartBeatMonitor":
         self._t0 = time.monotonic()
-        self._running = True
+        self._stop.clear()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="heartbeat-monitor")
         self._thread.start()
         return self
 
     def stop(self) -> None:
-        self._running = False
+        self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=self.interval * 4 + 1)
+            self._thread.join(timeout=self.interval + 1)
             self._thread = None
 
 

@@ -147,7 +147,10 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
     """Run ``cmd`` as a watched subprocess; restart on non-zero exit up to
     ``max_restarts`` times (reference: launch_utils.py watch_local_trainers /
     terminate_local_procs).  Returns the final exit code.  SIGTERM/SIGINT
-    to the watchdog tears the child down (pod preemption path).
+    to the watchdog tears the child down (pod preemption path).  A child
+    exiting ``resilience.PREEMPTION_EXIT_CODE`` (75 — it saved a final
+    checkpoint under SIGTERM) is restarted WITHOUT consuming the restart
+    budget: evictions are the platform's fault, not the trainer's.
 
     ``hang_timeout`` arms liveness monitoring (reference:
     heart_beat_monitor.h:51): the child gets a heartbeat file via
@@ -246,6 +249,19 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
                     time.sleep(poll)
             if rc == 0:
                 return 0
+            from ..resilience.preemption import PREEMPTION_EXIT_CODE
+
+            if rc == PREEMPTION_EXIT_CODE:
+                # clean preemption: the trainer saved a final checkpoint
+                # and exited 75 (resilience.preemption) — an eviction is
+                # the platform's fault, so restart WITHOUT consuming the
+                # failure budget
+                vlog(1, "watchdog: trainer preempted cleanly (rc=%d) — "
+                        "restarting without consuming the restart budget",
+                     rc)
+                _monitor.stat_add("preemption_restarts")
+                time.sleep(_sleep)
+                continue
             vlog(1, "watchdog: trainer exited rc=%d", rc)
             if attempts >= max_restarts:
                 vlog(1, "watchdog: restart budget exhausted (%d)", attempts)

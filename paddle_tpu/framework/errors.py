@@ -20,6 +20,9 @@ __all__ = [
     "UnavailableError",
     "PreconditionNotMetError",
     "ExecutionTimeoutError",
+    "TransientDeviceError",
+    "is_transient",
+    "wrap_transient",
     "enforce",
     "enforce_eq",
     "enforce_gt",
@@ -65,6 +68,66 @@ class PreconditionNotMetError(EnforceNotMet):
 
 class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
     pass
+
+
+class TransientDeviceError(UnavailableError):
+    """A device/runtime failure that is expected to clear on retry —
+    preempted donated buffer, transient ICI/DCN link error, runtime
+    RESOURCE_EXHAUSTED from a concurrent burst.  ``resilience.RetryPolicy``
+    retries these; anything else is fatal and propagates immediately."""
+
+
+#: lowercase substrings of XLA / jax runtime error messages that indicate a
+#: transient condition worth retrying (the runtime has no typed taxonomy —
+#: status strings are the stable surface, same approach as gRPC clients)
+_TRANSIENT_PATTERNS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "unavailable",
+    "deadline_exceeded",
+    "deadline exceeded",
+    "aborted",
+    "connection reset",
+    "broken pipe",
+    "socket closed",
+    "too many pings",
+    "transient",
+)
+
+#: exception type names (by class name, so jaxlib need not be imported
+#: here) whose messages are eligible for pattern classification
+_RUNTIME_ERROR_TYPES = ("XlaRuntimeError", "JaxRuntimeError", "RpcError")
+
+
+def is_transient(exc: BaseException) -> bool:
+    """True when ``exc`` should be retried: either already typed transient
+    (:class:`TransientDeviceError` / :class:`UnavailableError`) or a raw
+    XLA/jax runtime error whose status message matches a known-transient
+    pattern.  Typed framework errors other than Unavailable are *never*
+    transient — an InvalidArgumentError does not fix itself."""
+    if isinstance(exc, TransientDeviceError):
+        return True
+    if isinstance(exc, UnavailableError):
+        return True
+    if isinstance(exc, EnforceNotMet):
+        return False  # typed taxonomy: everything else is deterministic
+    name = type(exc).__name__
+    if name in _RUNTIME_ERROR_TYPES or isinstance(exc, (RuntimeError, OSError)):
+        msg = str(exc).lower()
+        return any(p in msg for p in _TRANSIENT_PATTERNS)
+    return False
+
+
+def wrap_transient(exc: BaseException) -> BaseException:
+    """Classify ``exc``: a recognizable transient runtime error comes back
+    wrapped as :class:`TransientDeviceError` (chained, so the original
+    stack survives); anything else is returned unchanged."""
+    if isinstance(exc, TransientDeviceError) or not is_transient(exc):
+        return exc
+    wrapped = TransientDeviceError(
+        f"transient device error ({type(exc).__name__}): {exc}")
+    wrapped.__cause__ = exc
+    return wrapped
 
 
 def enforce(cond, msg="", error_cls=InvalidArgumentError):

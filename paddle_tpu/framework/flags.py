@@ -122,3 +122,38 @@ define_flag("fused_epilogues", True,
             "Let the BERT/GPT hot paths call the fused Pallas epilogues "
             "(LayerNorm+residual, softmax-cross-entropy) on TPU. Off "
             "falls back to the plain XLA ops everywhere.")
+define_flag("fault_plan", "",
+            "Deterministic fault injection plan (resilience/faults.py). "
+            "Semicolon-separated rules of comma-separated key=value "
+            "fields, e.g. 'site=checkpoint.write,nth=3,error="
+            "TransientDeviceError;site=serving.runner,p=0.1,seed=7'. "
+            "Keys: site (required — a named fault_point), nth (fire on "
+            "exactly the Nth call), every (fire on every Nth call), p + "
+            "seed (seeded per-call probability), times (max fires), "
+            "error (class from framework.errors or builtins; default "
+            "TransientDeviceError), latency_ms (inject latency instead "
+            "of raising). Empty (default): every fault_point is a no-op "
+            "falsy check — zero hot-path cost, bit-identical runs.")
+define_flag("transient_max_retries", 3,
+            "Max attempts (1 = no retry) for operations retried on "
+            "transient device errors (errors.is_transient): Executor.run "
+            "dispatch, the async checkpoint writer, and serving batch "
+            "execution. See resilience.RetryPolicy.from_flags().")
+define_flag("retry_backoff_ms", 100.0,
+            "Base delay of the exponential backoff between transient-"
+            "error retries (doubles per attempt, +/-25% seeded jitter, "
+            "capped at 20x the base).")
+define_flag("circuit_failure_threshold", 0.5,
+            "Serving circuit breaker (resilience/circuit.py): open a "
+            "bucket's circuit when its failure rate over the last "
+            "FLAGS_circuit_window batches reaches this fraction.")
+define_flag("circuit_window", 8,
+            "Number of most-recent batch outcomes per bucket the circuit "
+            "breaker evaluates the failure rate over (it never opens "
+            "before observing a full window).")
+define_flag("circuit_cooldown_ms", 1000.0,
+            "How long an open circuit sheds before letting half-open "
+            "probe batches through to test recovery.")
+define_flag("circuit_half_open_probes", 1,
+            "Probe batches admitted in the half-open state; all must "
+            "succeed to close the circuit, any failure re-opens it.")

@@ -67,6 +67,9 @@ def save(obj: Any, path: str, protocol: int = 4, **configs):
     if d:
         os.makedirs(d, exist_ok=True)
     payload = _to_host(obj)
+    from ..resilience.faults import fault_point  # lazy: no import cycle
+
+    fault_point("serialization.save")
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:  # stream: no in-memory copy of the pickle
         f.write(_MAGIC)
@@ -91,7 +94,17 @@ def load(path: str, **configs) -> Any:
     with open(path, "rb") as f:
         magic = f.read(len(_MAGIC))
         if magic == _MAGIC:
-            return pickle.load(f)
+            try:
+                return pickle.load(f)
+            except Exception as e:
+                # a magic-headed file that fails to unpickle is a DAMAGED
+                # checkpoint (truncated write, bit flip), not a format
+                # mismatch — raise the typed error the checkpoint-fallback
+                # path (incubate.checkpoint.resume) keys off
+                raise InvalidArgumentError(
+                    f"{path!r} is a paddle_tpu checkpoint but its payload "
+                    f"is corrupt ({type(e).__name__}: {e}) — truncated or "
+                    f"bit-flipped write") from e
         # compat fallback ONLY for the reference's own checkpoint
         # extensions: a stray non-checkpoint pickle (or malicious file)
         # under another name is still rejected before unpickling

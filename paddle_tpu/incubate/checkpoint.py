@@ -13,21 +13,44 @@ CheckpointSaver there.  TPU-native differences:
   leaves a meta-less directory that resume skips;
 * everything rides the framework checkpoint format (serialization.py), so
   the files double as ordinary ``Model.load``-able artifacts.
+
+Resilience (see paddle_tpu.resilience):
+
+* the meta carries a per-file **sha256 manifest**; ``resume()`` verifies
+  digests and walks newest → oldest committed checkpoints, QUARANTINING a
+  corrupt directory (renamed ``corrupt-...``, kept for postmortem) and
+  falling back to the previous one instead of dying;
+* the async writer retries transient write failures
+  (``resilience.RetryPolicy``; OSError counts as transient for disk I/O)
+  and latches the FIRST unrecoverable error until ``close()`` — a
+  ``save()`` caller that swallows it cannot make ``close()`` lie — while
+  later queued snapshots keep draining;
+* ``final_save()`` is the synchronous bypass the SIGTERM preemption
+  handler (``resilience.install_preemption_handler``) uses for its one
+  last checkpoint before exiting with the clean-preemption code.
 """
 from __future__ import annotations
 
+import hashlib
 import os
 import queue
 import shutil
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
 from ..framework import random as _random
 from ..framework import serialization
-from ..framework.errors import InvalidArgumentError
+from ..framework.errors import (
+    EnforceNotMet,
+    InvalidArgumentError,
+    NotFoundError,
+    is_transient,
+)
+from ..resilience.faults import fault_point
+from ..resilience.retry import RetryPolicy
 
 __all__ = ["AutoCheckpoint", "train_epoch_range"]
 
@@ -35,6 +58,15 @@ _META = "meta.pdmeta"
 _PARAMS = "m.pdparams"
 _OPT = "m.pdopt"
 _PREFIX = "ckpt-"
+_QUARANTINE_PREFIX = "corrupt-"
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
 
 
 def _host(tree):
@@ -55,7 +87,8 @@ class AutoCheckpoint:
     """
 
     def __init__(self, model, save_dir: str, save_steps: Optional[int] = None,
-                 keep_max: int = 3, async_save: bool = True):
+                 keep_max: int = 3, async_save: bool = True,
+                 retry: Optional[RetryPolicy] = None):
         if keep_max < 1:
             raise InvalidArgumentError("keep_max must be >= 1")
         self.model = model
@@ -63,8 +96,14 @@ class AutoCheckpoint:
         self.save_steps = save_steps
         self.keep_max = keep_max
         self.async_save = async_save
+        self.last_epoch = 0    # most recent epoch handed to save()/step()
         self._counter = 0      # monotonic checkpoint id
         self._global_step = 0
+        # transient write failures (full disk burst, flaky network FS) are
+        # retried before they count; OSError is transient for disk I/O
+        self._retry = retry if retry is not None else RetryPolicy.from_flags(
+            name="checkpoint.write",
+            retry_on=lambda e: isinstance(e, OSError) or is_transient(e))
         # bounded: save() applies back-pressure rather than queueing an
         # unbounded pile of full host snapshots when disk is the bottleneck
         self._q: "queue.Queue" = queue.Queue(maxsize=2)
@@ -96,11 +135,17 @@ class AutoCheckpoint:
         return {"params": params, "opt": opt, "meta": meta}
 
     def _write(self, snap: Dict[str, Any]):
+        fault_point("checkpoint.write")
         name = f"{_PREFIX}{snap['meta']['counter']:010d}"
         d = os.path.join(self.save_dir, name)
         os.makedirs(d, exist_ok=True)
         serialization.save(snap["params"], os.path.join(d, _PARAMS))
         serialization.save(snap["opt"], os.path.join(d, _OPT))
+        # digest the payload files as written: resume() re-hashes and a
+        # mismatch (bit flip, torn write that still unpickles) quarantines
+        # the directory instead of restoring silently-wrong weights
+        snap["meta"]["manifest"] = {f: _sha256(os.path.join(d, f))
+                                    for f in (_PARAMS, _OPT)}
         # meta LAST: its presence commits the checkpoint
         serialization.save(snap["meta"], os.path.join(d, _META))
         from ..framework import monitor as _monitor
@@ -122,15 +167,25 @@ class AutoCheckpoint:
             if snap is None:
                 return
             try:
-                self._write(snap)
-            except BaseException as e:  # surfaced on next save()/close()
-                self._worker_err = e
+                self._retry.call(self._write, snap)
+            except BaseException as e:
+                # latch the FIRST failure (surfaced by save() and close();
+                # close() clears) and keep draining — one bad snapshot
+                # must not stop newer, healthier ones from committing
+                if self._worker_err is None:
+                    self._worker_err = e
+                from ..framework import monitor as _monitor
+
+                _monitor.stat_add("checkpoint_write_failures")
 
     def save(self, epoch: int, kind: str = "step"):
-        """Snapshot now (host copy sync, file write async)."""
+        """Snapshot now (host copy sync, file write async).  Raises the
+        first unrecovered writer error, which stays latched until
+        ``close()`` — a caller swallowing this cannot hide the failure
+        from shutdown."""
         if self._worker_err is not None:
-            err, self._worker_err = self._worker_err, None
-            raise err
+            raise self._worker_err
+        self.last_epoch = int(epoch)
         snap = self._snapshot(epoch)
         self._counter += 1
         snap["meta"]["counter"] = self._counter
@@ -142,10 +197,11 @@ class AutoCheckpoint:
                 self._worker.start()
             self._q.put(snap)
         else:
-            self._write(snap)
+            self._retry.call(self._write, snap)
 
     def step(self, epoch: int):
         """Count one train step; save when save_steps divides the count."""
+        self.last_epoch = int(epoch)
         self._global_step += 1
         if self.save_steps and self._global_step % self.save_steps == 0:
             self.save(epoch)
@@ -153,8 +209,22 @@ class AutoCheckpoint:
     def epoch_end(self, epoch: int):
         self.save(epoch, kind="epoch_end")
 
+    def final_save(self, epoch: Optional[int] = None):
+        """One SYNCHRONOUS checkpoint, bypassing the queue — the SIGTERM
+        preemption path (``resilience.PreemptionHandler``), where the
+        process exits immediately after and must not wait on a busy
+        worker.  Safe alongside an in-flight async write: distinct
+        counter → distinct directory, meta-last commits each."""
+        self._counter += 1
+        snap = self._snapshot(self.last_epoch if epoch is None
+                              else int(epoch))
+        snap["meta"]["counter"] = self._counter
+        snap["meta"]["kind"] = "preempt"
+        self._retry.call(self._write, snap)
+
     def close(self):
-        """Drain pending writes (call before process exit)."""
+        """Drain pending writes (call before process exit).  Raises the
+        latched first writer error, if any, then clears it."""
         if self._worker is not None:
             self._q.put(None)
             self._worker.join()
@@ -164,25 +234,77 @@ class AutoCheckpoint:
             raise err
 
     # -- read path -----------------------------------------------------------
-    def latest_dir(self) -> Optional[str]:
+    def committed_dirs(self) -> List[str]:
+        """Committed (meta-present) checkpoint directories, NEWEST first.
+        Quarantined ``corrupt-*`` directories are excluded."""
         if not os.path.isdir(self.save_dir):
-            return None
+            return []
         done = sorted(
-            n for n in os.listdir(self.save_dir)
-            if n.startswith(_PREFIX)
-            and os.path.exists(os.path.join(self.save_dir, n, _META)))
-        return os.path.join(self.save_dir, done[-1]) if done else None
+            (n for n in os.listdir(self.save_dir)
+             if n.startswith(_PREFIX)
+             and os.path.exists(os.path.join(self.save_dir, n, _META))),
+            reverse=True)
+        return [os.path.join(self.save_dir, n) for n in done]
+
+    def latest_dir(self) -> Optional[str]:
+        dirs = self.committed_dirs()
+        return dirs[0] if dirs else None
+
+    def _load_verified(self, d: str) -> Dict[str, Any]:
+        """Load + integrity-check one checkpoint dir.  Raises a typed
+        error (InvalidArgumentError / NotFoundError) on any corruption:
+        unreadable payload, missing file, or sha256 manifest mismatch."""
+        meta = serialization.load(os.path.join(d, _META))
+        for fname, want in (meta.get("manifest") or {}).items():
+            p = os.path.join(d, fname)
+            if not os.path.exists(p):
+                raise NotFoundError(f"checkpoint {d} lost file {fname}")
+            got = _sha256(p)
+            if got != want:
+                raise InvalidArgumentError(
+                    f"checkpoint {d} file {fname} digest mismatch "
+                    f"(manifest {want[:12]}…, on disk {got[:12]}…) — "
+                    f"bit flip or torn write")
+        params = serialization.load(os.path.join(d, _PARAMS))
+        opt = serialization.load(os.path.join(d, _OPT))
+        return {"params": params, "opt": opt, "meta": meta}
+
+    def _quarantine(self, d: str) -> None:
+        """Rename a corrupt checkpoint dir out of the committed set (kept
+        for postmortem; ``_prune`` and ``resume`` never look at it)."""
+        name = os.path.basename(d)
+        target = os.path.join(self.save_dir, _QUARANTINE_PREFIX + name)
+        if os.path.exists(target):  # re-quarantine after a partial cleanup
+            shutil.rmtree(target, ignore_errors=True)
+        os.rename(d, target)
+        from ..framework import monitor as _monitor
+        from ..framework.logging import vlog
+
+        _monitor.stat_add("checkpoints_quarantined")
+        vlog(0, "checkpoint: quarantined corrupt %s -> %s", d, target)
 
     def resume(self) -> Optional[Dict[str, Any]]:
-        """Load the newest committed checkpoint into the model; returns its
-        meta ({'epoch', 'global_step', ...}) or None on a fresh run."""
-        d = self.latest_dir()
-        if d is None:
+        """Load the newest HEALTHY committed checkpoint into the model;
+        returns its meta ({'epoch', 'global_step', ...}) or None on a
+        fresh run.  A checkpoint that fails integrity verification
+        (digest mismatch, unreadable payload) is quarantined and the walk
+        falls back to the next older one — corruption of the newest save
+        costs ``save_steps`` of progress, never the job."""
+        loaded = None
+        for d in self.committed_dirs():
+            try:
+                loaded = self._load_verified(d)
+                break
+            except EnforceNotMet:
+                self._quarantine(d)
+        if loaded is None:
             return None
         import jax.numpy as jnp
 
         model = self.model
-        params = serialization.load(os.path.join(d, _PARAMS))
+        params, opt, meta = loaded["params"], loaded["opt"], loaded["meta"]
+        # mismatches past this point are configuration bugs (wrong model
+        # for this save_dir), not corruption: raise, don't quarantine
         not_in_ckpt = [n for n in model.network.state_dict() if n not in params]
         if not_in_ckpt:
             raise InvalidArgumentError(
@@ -192,7 +314,6 @@ class AutoCheckpoint:
         if unmatched:
             raise InvalidArgumentError(
                 f"checkpoint {d} has keys the model lacks: {unmatched[:5]}")
-        opt = serialization.load(os.path.join(d, _OPT))
         if "state" in opt:
             model._opt_state = jax.tree_util.tree_map(jnp.asarray, opt["state"])
         optimizer = getattr(model, "_optimizer", None)
@@ -201,11 +322,11 @@ class AutoCheckpoint:
                 optimizer.lr_scheduler.set_state_dict(opt["LR_Scheduler"])
             elif optimizer.lr_scheduler is None and "lr" in opt:
                 optimizer.set_lr(float(opt["lr"]))
-        meta = serialization.load(os.path.join(d, _META))
         if meta.get("rng_state"):
             _random.default_generator().set_state(meta["rng_state"])
         self._counter = int(meta["counter"])
         self._global_step = int(meta["global_step"])
+        self.last_epoch = int(meta["epoch"])
         return meta
 
 

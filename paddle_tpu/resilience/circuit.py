@@ -1,0 +1,213 @@
+"""Per-key circuit breaker for the serving layer.
+
+One bad bucket executable (poisoned weights slice, a shape that trips a
+runtime bug) must not burn a device slot per request forever: after the
+failure rate over a sliding window crosses a threshold, the bucket's
+circuit OPENS and requests shed immediately with ``UnavailableError`` —
+the device keeps serving healthy buckets.  After a cool-down the circuit
+goes HALF_OPEN and admits a limited number of probe batches; all probes
+succeeding closes it, any probe failing re-opens it.
+
+State machine (per key)::
+
+    CLOSED --(failure rate >= threshold over full window)--> OPEN
+    OPEN   --(cooldown elapsed)--> HALF_OPEN
+    HALF_OPEN --(all probes succeed)--> CLOSED
+    HALF_OPEN --(any probe fails)--> OPEN
+
+Transitions are published as ``("resilience", "circuit:<name>")`` events
+on ``framework.trace_events``; re-opens after serving warmup count as
+*flapping* and feed analysis rule F801.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Callable, Dict, Optional
+
+from ..framework import trace_events
+from ..framework.errors import InvalidArgumentError
+from .retry import is_warm
+
+__all__ = ["CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN", "all_stats"]
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+#: live breakers, for the profiler "Faults & retries" summary section
+_breakers: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def all_stats() -> Dict[str, dict]:
+    """Aggregate snapshot of every live breaker, keyed by breaker name."""
+    return {b.name: b.stats() for b in list(_breakers)}
+
+
+class _KeyState:
+    __slots__ = ("state", "outcomes", "opened_at", "probes_left",
+                 "probe_successes", "opens", "opens_after_warm", "sheds",
+                 "failures", "successes")
+
+    def __init__(self, window: int):
+        self.state = CLOSED
+        self.outcomes: deque = deque(maxlen=window)  # True = success
+        self.opened_at = 0.0
+        self.probes_left = 0
+        self.probe_successes = 0
+        self.opens = 0
+        self.opens_after_warm = 0
+        self.sheds = 0
+        self.failures = 0
+        self.successes = 0
+
+
+class CircuitBreaker:
+    """Failure-rate circuit breaker over arbitrary hashable keys (the
+    serving engines key by bucket index).
+
+    Call :meth:`allow` before doing the work; on False, shed.  Report the
+    outcome with :meth:`record_success` / :meth:`record_failure`.  All
+    three are thread-safe.  Defaults come from the ``FLAGS_circuit_*``
+    flags; ``clock`` is injectable for tests.
+    """
+
+    def __init__(self, name: str = "circuit", *,
+                 failure_threshold: Optional[float] = None,
+                 window: Optional[int] = None,
+                 cooldown_ms: Optional[float] = None,
+                 half_open_probes: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        from ..framework.flags import flag
+
+        self.name = name
+        self.failure_threshold = float(
+            failure_threshold if failure_threshold is not None
+            else flag("circuit_failure_threshold"))
+        if not 0.0 < self.failure_threshold <= 1.0:
+            raise InvalidArgumentError(
+                "circuit failure_threshold must be in (0, 1]")
+        self.window = int(window if window is not None
+                          else flag("circuit_window"))
+        if self.window < 1:
+            raise InvalidArgumentError("circuit window must be >= 1")
+        self.cooldown_s = float(cooldown_ms if cooldown_ms is not None
+                                else flag("circuit_cooldown_ms")) / 1e3
+        self.half_open_probes = int(
+            half_open_probes if half_open_probes is not None
+            else flag("circuit_half_open_probes"))
+        if self.half_open_probes < 1:
+            raise InvalidArgumentError("half_open_probes must be >= 1")
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._keys: Dict[object, _KeyState] = {}
+        _breakers.add(self)
+
+    def _key(self, key) -> _KeyState:
+        ks = self._keys.get(key)
+        if ks is None:
+            ks = self._keys[key] = _KeyState(self.window)
+        return ks
+
+    # -- decision ------------------------------------------------------------
+    def allow(self, key) -> bool:
+        """May work for ``key`` proceed?  False means shed now (and the
+        shed is counted); an OPEN circuit whose cooldown has elapsed
+        transitions to HALF_OPEN here and admits probes."""
+        with self._lock:
+            ks = self._key(key)
+            if ks.state == CLOSED:
+                return True
+            if ks.state == OPEN:
+                if self._clock() - ks.opened_at < self.cooldown_s:
+                    ks.sheds += 1
+                    return False
+                ks.state = HALF_OPEN
+                ks.probes_left = self.half_open_probes
+                ks.probe_successes = 0
+                self._publish(key, ks, "half_open")
+            # HALF_OPEN: admit up to half_open_probes in-flight probes
+            if ks.probes_left > 0:
+                ks.probes_left -= 1
+                return True
+            ks.sheds += 1
+            return False
+
+    # -- outcome reporting ---------------------------------------------------
+    def record_success(self, key) -> None:
+        with self._lock:
+            ks = self._key(key)
+            ks.successes += 1
+            if ks.state == HALF_OPEN:
+                ks.probe_successes += 1
+                if ks.probe_successes >= self.half_open_probes:
+                    ks.state = CLOSED
+                    ks.outcomes.clear()
+                    self._publish(key, ks, "closed")
+                return
+            if ks.state == CLOSED:
+                ks.outcomes.append(True)
+
+    def record_failure(self, key) -> None:
+        with self._lock:
+            ks = self._key(key)
+            ks.failures += 1
+            if ks.state == HALF_OPEN:
+                self._open(key, ks)  # a failed probe re-opens immediately
+                return
+            if ks.state != CLOSED:
+                return
+            ks.outcomes.append(False)
+            if len(ks.outcomes) < self.window:
+                return  # never judge a partial window
+            rate = ks.outcomes.count(False) / len(ks.outcomes)
+            if rate >= self.failure_threshold:
+                self._open(key, ks)
+
+    def _open(self, key, ks: _KeyState) -> None:
+        ks.state = OPEN
+        ks.opened_at = self._clock()
+        ks.opens += 1
+        if is_warm():
+            ks.opens_after_warm += 1
+        ks.outcomes.clear()
+        from ..framework import monitor as _monitor
+
+        _monitor.stat_add("circuit_opens")
+        self._publish(key, ks, "open")
+
+    def _publish(self, key, ks: _KeyState, transition: str) -> None:
+        if not trace_events.active():
+            return
+        trace_events.notify(
+            ("resilience", f"circuit:{self.name}"),
+            {"kind": "circuit", "key": key, "transition": transition,
+             "state": ks.state, "opens": ks.opens,
+             "opens_after_warm": ks.opens_after_warm,
+             "failures": ks.failures, "successes": ks.successes,
+             "sheds": ks.sheds})
+
+    # -- introspection -------------------------------------------------------
+    def state(self, key) -> str:
+        with self._lock:
+            ks = self._keys.get(key)
+            return ks.state if ks is not None else CLOSED
+
+    def stats(self) -> dict:
+        """Aggregate + per-key counters (keys stringified for JSON)."""
+        with self._lock:
+            per_key = {
+                str(k): {"state": ks.state, "opens": ks.opens,
+                         "opens_after_warm": ks.opens_after_warm,
+                         "sheds": ks.sheds, "failures": ks.failures,
+                         "successes": ks.successes}
+                for k, ks in self._keys.items()}
+        agg = {f: sum(d[f] for d in per_key.values())
+               for f in ("opens", "opens_after_warm", "sheds", "failures",
+                         "successes")}
+        agg["open_keys"] = sum(1 for d in per_key.values()
+                               if d["state"] != CLOSED)
+        agg["keys"] = per_key
+        return agg

@@ -1,0 +1,261 @@
+"""Deterministic fault injection — named fault points driven by a plan.
+
+Recovery code that is never exercised is broken code waiting for an outage
+(the autotuner needed *measured* timing for the same reason; PR 4).  This
+module plants named :func:`fault_point` hooks at the I/O and dispatch seams
+of the framework; a :class:`FaultPlan` decides, deterministically, which
+calls fail and how.
+
+Installed sites (grep for ``fault_point(`` to audit):
+
+=====================  ====================================================
+``serialization.save``  framework checkpoint file write (serialization.py)
+``checkpoint.write``    async checkpoint worker write (incubate/checkpoint)
+``executor.dispatch``   compiled-runner dispatch in ``Executor.run``
+``collective.call``     every user-facing collective (distributed)
+``serving.runner``      micro-batcher batch execution (serving/batcher)
+=====================  ====================================================
+
+With no plan installed (the default) :func:`fault_point` is a single
+module-global falsy check — the same zero-cost discipline as
+``trace_events.active()`` — so production hot paths pay nothing and CPU
+runs stay bit-identical.
+
+A plan comes from ``FLAGS_fault_plan`` (env ``FLAGS_fault_plan=...`` — the
+chaos-smoke subprocess path), or programmatically::
+
+    plan = FaultPlan.parse("site=checkpoint.write,nth=2,error=OSError")
+    with plan:                      # install() / remove() also work
+        train()
+    plan.stats()                    # {'checkpoint.write': {'calls': ..,
+                                    #                       'fired': ..}}
+
+Determinism: ``nth``/``every`` fire on exact per-site call counts;
+probabilistic rules draw from a ``random.Random(seed)`` owned by the rule,
+so the same seed and the same call sequence reproduce the same firing
+pattern bit-for-bit.
+"""
+from __future__ import annotations
+
+import builtins
+import threading
+import time
+from random import Random
+from typing import Dict, List, Optional
+
+from ..framework import errors as _errors
+from ..framework.errors import InvalidArgumentError
+
+__all__ = ["FaultRule", "FaultPlan", "fault_point", "install", "remove",
+           "active", "install_from_flags"]
+
+#: the one installed plan; ``None`` keeps fault_point on its no-op path
+_plan: Optional["FaultPlan"] = None
+
+
+def fault_point(site: str) -> None:
+    """Hook called on the framework's failure-injection seams.  No-op
+    (one global read + falsy check) unless a :class:`FaultPlan` is
+    installed and has a rule for ``site``."""
+    plan = _plan
+    if plan is None:
+        return
+    plan._hit(site)
+
+
+def active() -> bool:
+    return _plan is not None
+
+
+def install(plan: "FaultPlan") -> "FaultPlan":
+    """Make ``plan`` the process-wide fault plan (replacing any other)."""
+    global _plan
+    _plan = plan
+    return plan
+
+
+def remove() -> None:
+    global _plan
+    _plan = None
+
+
+def _resolve_error(name: str):
+    cls = getattr(_errors, name, None) or getattr(builtins, name, None)
+    if cls is None or not (isinstance(cls, type)
+                           and issubclass(cls, BaseException)):
+        raise InvalidArgumentError(
+            f"fault plan error class {name!r} is not an exception in "
+            f"framework.errors or builtins")
+    return cls
+
+
+class FaultRule:
+    """One firing rule for one site.  Exactly one trigger:
+
+    * ``nth`` — fire on exactly the Nth call to the site (once);
+    * ``every`` — fire on every Nth call;
+    * ``p`` (+ ``seed``) — fire with probability ``p`` per call, drawn
+      from a rule-owned seeded RNG.
+
+    ``times`` caps total fires (any trigger).  The action is ``raise
+    error(...)`` unless ``latency_ms`` is given, which sleeps instead.
+    """
+
+    def __init__(self, site: str, *, nth: Optional[int] = None,
+                 every: Optional[int] = None, p: Optional[float] = None,
+                 seed: int = 0, times: Optional[int] = None,
+                 error: str = "TransientDeviceError",
+                 latency_ms: Optional[float] = None):
+        if not site:
+            raise InvalidArgumentError("fault rule needs a site=")
+        triggers = sum(x is not None for x in (nth, every, p))
+        if triggers != 1:
+            raise InvalidArgumentError(
+                f"fault rule for {site!r} needs exactly one of nth=, "
+                f"every=, p= (got {triggers})")
+        if p is not None and not 0.0 <= p <= 1.0:
+            raise InvalidArgumentError(f"p must be in [0, 1], got {p}")
+        self.site = site
+        self.nth = int(nth) if nth is not None else None
+        self.every = int(every) if every is not None else None
+        self.p = float(p) if p is not None else None
+        self.seed = int(seed)
+        self.times = int(times) if times is not None else None
+        self.error_name = error
+        self.error_cls = _resolve_error(error) if latency_ms is None else None
+        self.latency_ms = float(latency_ms) if latency_ms is not None else None
+        self._rng = Random(self.seed)
+        self.fired = 0
+
+    def should_fire(self, call_index: int) -> bool:
+        """``call_index`` is 1-based per site.  Probabilistic rules draw
+        exactly one variate per call, fire or not, so the decision stream
+        is a pure function of (seed, call sequence)."""
+        if self.p is not None:
+            draw = self._rng.random() < self.p
+        elif self.nth is not None:
+            draw = call_index == self.nth
+        else:
+            draw = call_index % self.every == 0
+        if not draw:
+            return False
+        if self.times is not None and self.fired >= self.times:
+            return False
+        return True
+
+    def fire(self, call_index: int) -> None:
+        self.fired += 1
+        from ..framework import monitor as _monitor
+        from ..framework import trace_events
+
+        _monitor.stat_add("fault_injections")
+        if trace_events.active():
+            trace_events.notify(
+                ("resilience", f"fault:{self.site}"),
+                {"kind": "fault", "site": self.site, "call": call_index,
+                 "fired": self.fired,
+                 "action": ("latency" if self.latency_ms is not None
+                            else self.error_name)})
+        if self.latency_ms is not None:
+            time.sleep(self.latency_ms / 1e3)
+            return
+        raise self.error_cls(
+            f"injected fault at {self.site!r} (call {call_index}, "
+            f"fire {self.fired})")
+
+    def describe(self) -> str:
+        trig = (f"nth={self.nth}" if self.nth is not None else
+                f"every={self.every}" if self.every is not None else
+                f"p={self.p},seed={self.seed}")
+        act = (f"latency_ms={self.latency_ms:g}"
+               if self.latency_ms is not None else self.error_name)
+        tail = f",times={self.times}" if self.times is not None else ""
+        return f"{self.site}[{trig}{tail} -> {act}]"
+
+
+class FaultPlan:
+    """A set of :class:`FaultRule`\\ s plus per-site call counters.
+
+    Thread-safe: sites are hit from the serving worker, the checkpoint
+    writer and the main thread concurrently; the decision (count + RNG
+    draw) happens under one lock, the action (sleep/raise) outside it.
+    """
+
+    def __init__(self, rules: List[FaultRule]):
+        self.rules = list(rules)
+        self._by_site: Dict[str, List[FaultRule]] = {}
+        for r in self.rules:
+            self._by_site.setdefault(r.site, []).append(r)
+        self._calls: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Parse the ``FLAGS_fault_plan`` mini-language (see flags.py)."""
+        rules = []
+        for chunk in spec.split(";"):
+            chunk = chunk.strip()
+            if not chunk:
+                continue
+            kw: Dict[str, str] = {}
+            for field in chunk.split(","):
+                if "=" not in field:
+                    raise InvalidArgumentError(
+                        f"fault plan field {field!r} is not key=value "
+                        f"(in {chunk!r})")
+                k, v = field.split("=", 1)
+                kw[k.strip()] = v.strip()
+            site = kw.pop("site", "")
+            num = {k: float(v) if k in ("p", "latency_ms") else int(v)
+                   for k, v in kw.items() if k != "error"}
+            if "error" in kw:
+                num["error"] = kw["error"]
+            rules.append(FaultRule(site, **num))
+        if not rules:
+            raise InvalidArgumentError(
+                f"fault plan {spec!r} contains no rules")
+        return cls(rules)
+
+    def _hit(self, site: str) -> None:
+        with self._lock:
+            rules = self._by_site.get(site)
+            if rules is None:
+                return
+            self._calls[site] = idx = self._calls.get(site, 0) + 1
+            to_fire = [r for r in rules if r.should_fire(idx)]
+        for r in to_fire:  # sleep/raise outside the lock
+            r.fire(idx)
+
+    def stats(self) -> Dict[str, dict]:
+        with self._lock:
+            return {site: {"calls": self._calls.get(site, 0),
+                           "fired": sum(r.fired for r in rules)}
+                    for site, rules in self._by_site.items()}
+
+    def describe(self) -> str:
+        return "; ".join(r.describe() for r in self.rules)
+
+    def install(self) -> "FaultPlan":
+        return install(self)
+
+    def remove(self) -> None:
+        if _plan is self:
+            remove()
+
+    def __enter__(self) -> "FaultPlan":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.remove()
+
+
+def install_from_flags() -> Optional[FaultPlan]:
+    """Install the plan named by ``FLAGS_fault_plan`` (usually seeded via
+    the ``FLAGS_fault_plan`` env var — the chaos-smoke subprocess path).
+    Returns the installed plan, or None when the flag is unset."""
+    from ..framework.flags import flag
+
+    spec = flag("fault_plan")
+    if not spec:
+        return None
+    return install(FaultPlan.parse(spec))

@@ -1,0 +1,113 @@
+"""Graceful preemption: SIGTERM → one final checkpoint → distinct exit.
+
+TPU pods are preempted with SIGTERM ahead of SIGKILL; a run that treats
+that as a crash loses up to ``save_steps`` of work and burns one unit of
+the watchdog's restart budget per eviction.  :class:`PreemptionHandler`
+installs a SIGTERM handler that writes ONE final synchronous checkpoint
+(``AutoCheckpoint.final_save`` — meta-last, so a SIGKILL landing mid-write
+still leaves the previous checkpoint committed) and exits with
+:data:`PREEMPTION_EXIT_CODE`.
+
+``distributed.parallel.watch`` recognizes that exit code as a *clean
+preemption*: the trainer is restarted WITHOUT consuming the
+``max_restarts`` failure budget — evictions are the platform's fault, not
+the trainer's.
+
+The exit code is 75 (BSD ``EX_TEMPFAIL`` — "temporary failure, retry"),
+deliberately distinct from 143 (default SIGTERM death) so a trainer that
+died *without* saving still consumes budget.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Callable, Optional
+
+__all__ = ["PreemptionHandler", "install_preemption_handler",
+           "PREEMPTION_EXIT_CODE"]
+
+#: sysexits.h EX_TEMPFAIL: the contract between the SIGTERM handler and
+#: the ``parallel.watch`` watchdog (restart without consuming budget)
+PREEMPTION_EXIT_CODE = 75
+
+
+class PreemptionHandler:
+    """SIGTERM → ``checkpoint.final_save(epoch)`` → ``exit(75)``.
+
+    ``checkpoint`` is an ``incubate.checkpoint.AutoCheckpoint`` (anything
+    with ``final_save(epoch)``); ``get_epoch`` supplies the epoch stamped
+    into the final checkpoint (default: the last epoch the checkpoint
+    object saw).  Install from the MAIN thread (CPython delivers signals
+    there).  ``_exit`` is injectable for tests.
+    """
+
+    def __init__(self, checkpoint, get_epoch: Optional[Callable[[], int]] = None,
+                 exit_code: int = PREEMPTION_EXIT_CODE,
+                 _exit: Callable[[int], None] = os._exit):
+        self.checkpoint = checkpoint
+        self.get_epoch = get_epoch
+        self.exit_code = int(exit_code)
+        self._exit = _exit
+        self._old_handler = None
+        self._installed = False
+        self._fired = threading.Event()
+
+    def install(self) -> "PreemptionHandler":
+        self._old_handler = signal.signal(signal.SIGTERM, self._on_sigterm)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            signal.signal(signal.SIGTERM, self._old_handler)
+            self._installed = False
+
+    def _on_sigterm(self, signum, frame) -> None:
+        if self._fired.is_set():  # a second SIGTERM mid-save: just die
+            self._exit(self.exit_code)
+            return
+        self._fired.set()
+        from ..framework import monitor as _monitor
+        from ..framework import trace_events
+        from ..framework.logging import vlog
+
+        _monitor.stat_add("preemptions")
+        epoch = None
+        try:
+            epoch = (self.get_epoch() if self.get_epoch is not None
+                     else getattr(self.checkpoint, "last_epoch", 0))
+            self.checkpoint.final_save(int(epoch))
+            vlog(0, "preemption: final checkpoint saved (epoch %s), "
+                    "exiting %d", epoch, self.exit_code)
+        except BaseException as e:  # noqa: BLE001 — the save is best
+            # effort; a failed final save must still exit promptly (the
+            # previous committed checkpoint stays the resume point)
+            _monitor.stat_add("preemption_save_failures")
+            vlog(0, "preemption: final save FAILED (%s: %s) — exiting %d "
+                    "anyway; resume falls back to the last committed "
+                    "checkpoint", type(e).__name__, e, self.exit_code)
+        if trace_events.active():
+            trace_events.notify(("resilience", "preemption"),
+                                {"kind": "preemption", "epoch": epoch,
+                                 "exit_code": self.exit_code})
+        self._exit(self.exit_code)
+
+    def __enter__(self) -> "PreemptionHandler":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+def install_preemption_handler(checkpoint,
+                               get_epoch: Optional[Callable[[], int]] = None
+                               ) -> PreemptionHandler:
+    """Convenience: build and install a :class:`PreemptionHandler`.
+
+    >>> acp = AutoCheckpoint(model, "ckpts", save_steps=100)
+    >>> handler = install_preemption_handler(acp)
+    >>> ...train...
+    >>> handler.uninstall()
+    """
+    return PreemptionHandler(checkpoint, get_epoch=get_epoch).install()

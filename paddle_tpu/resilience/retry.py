@@ -1,0 +1,195 @@
+"""Deadline-aware retry with exponential backoff and seeded jitter.
+
+:class:`RetryPolicy` is the one retry primitive the framework uses — the
+checkpoint async writer, ``Executor.run`` dispatch and serving batch
+execution all route transient failures (``errors.is_transient``) through
+it, so retry behavior is configured in exactly one place
+(``FLAGS_transient_max_retries`` / ``FLAGS_retry_backoff_ms``).
+
+Observability: every retry bumps a per-policy counter registry (surfaced
+in ``profiler.summary()``'s "Faults & retries" section and as
+``("resilience", "retry:<name>")`` trace events), plus the global
+``monitor.stat_add("transient_retries")``.  Retries that happen *after*
+:func:`mark_warm` — i.e. inside a warmed serving hot path — are counted
+separately; sustained ``retries_after_warm`` is what analysis rule F801
+calls a retry storm.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from random import Random
+from typing import Callable, Dict, Optional, Tuple, Union
+
+from ..framework import trace_events
+from ..framework.errors import InvalidArgumentError, is_transient
+
+__all__ = ["RetryPolicy", "mark_warm", "is_warm", "stats", "reset_stats"]
+
+_COUNTER_KEYS = ("attempts", "retries", "giveups", "deadline_giveups",
+                 "retries_after_warm")
+
+_lock = threading.Lock()
+_stats: Dict[str, Dict[str, int]] = {}
+_warm = False  # set by serving warmup; retries past it feed rule F801
+
+
+def mark_warm() -> None:
+    """Serving engines call this after ``warmup()``: retries from here on
+    are hot-path events (they stall live requests) and count toward the
+    F801 retry-storm rule."""
+    global _warm
+    _warm = True
+
+
+def is_warm() -> bool:
+    return _warm
+
+
+def _bump(name: str, key: str, n: int = 1) -> None:
+    with _lock:
+        d = _stats.setdefault(name, {k: 0 for k in _COUNTER_KEYS})
+        d[key] += n
+
+
+def stats(name: Optional[str] = None):
+    """Per-policy retry counters: one dict for ``name``, or all."""
+    with _lock:
+        if name is not None:
+            return dict(_stats.get(name, {k: 0 for k in _COUNTER_KEYS}))
+        return {k: dict(v) for k, v in _stats.items()}
+
+
+def reset_stats() -> None:
+    with _lock:
+        _stats.clear()
+
+
+def _publish(name: str) -> None:
+    if not trace_events.active():
+        return
+    snap = stats(name)
+    snap["kind"] = "retry"
+    trace_events.notify(("resilience", f"retry:{name}"), snap)
+
+
+class RetryPolicy:
+    """Bounded retry of transient failures.
+
+    ``max_attempts`` total calls (1 = no retry); between attempts sleeps
+    ``backoff_ms * multiplier**i`` capped at ``max_backoff_ms``, scaled by
+    a jitter factor in ``[1-jitter, 1+jitter]`` drawn from a policy-owned
+    ``random.Random(seed)`` — two policies with the same seed produce the
+    same backoff schedule, so chaos runs are reproducible.
+
+    ``deadline_ms`` bounds the whole call including backoff: a retry whose
+    sleep would cross the deadline is abandoned and the last error raised
+    — a caller-facing latency budget is never silently exceeded.
+
+    ``retry_on``: exception classifier — a predicate or a tuple of types;
+    default :func:`framework.errors.is_transient`.  Non-matching errors
+    propagate immediately, attempt 1.
+
+    ``sleep``/``clock`` are injectable for tests.
+    """
+
+    def __init__(self, max_attempts: Optional[int] = None,
+                 backoff_ms: Optional[float] = None, multiplier: float = 2.0,
+                 max_backoff_ms: Optional[float] = None, jitter: float = 0.25,
+                 seed: int = 0, deadline_ms: Optional[float] = None,
+                 retry_on: Union[None, Callable, Tuple] = None,
+                 name: str = "retry",
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic):
+        from ..framework.flags import flag
+
+        self.max_attempts = int(max_attempts if max_attempts is not None
+                                else flag("transient_max_retries"))
+        if self.max_attempts < 1:
+            raise InvalidArgumentError("max_attempts must be >= 1")
+        self.backoff_ms = float(backoff_ms if backoff_ms is not None
+                                else flag("retry_backoff_ms"))
+        self.multiplier = float(multiplier)
+        self.max_backoff_ms = float(max_backoff_ms if max_backoff_ms
+                                    is not None else 20 * self.backoff_ms)
+        if not 0.0 <= jitter < 1.0:
+            raise InvalidArgumentError("jitter must be in [0, 1)")
+        self.jitter = float(jitter)
+        self.seed = int(seed)
+        self.deadline_ms = (float(deadline_ms) if deadline_ms is not None
+                            else None)
+        if retry_on is None:
+            self._retryable = is_transient
+        elif callable(retry_on) and not isinstance(retry_on, type):
+            self._retryable = retry_on
+        else:
+            classes = retry_on if isinstance(retry_on, tuple) else (retry_on,)
+            self._retryable = lambda e: isinstance(e, classes)
+        self.name = name
+        self._sleep = sleep
+        self._clock = clock
+        self._rng = Random(self.seed)
+
+    @classmethod
+    def from_flags(cls, name: str = "retry", **overrides) -> "RetryPolicy":
+        """The flag-configured default policy used by the executor,
+        checkpoint writer and serving runner."""
+        return cls(name=name, **overrides)
+
+    def delay_s(self, retry_index: int) -> float:
+        """Backoff before the ``retry_index``-th retry (0-based), in
+        seconds, consuming one jitter draw from the policy RNG."""
+        base = min(self.backoff_ms * self.multiplier ** retry_index,
+                   self.max_backoff_ms)
+        factor = 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return base * factor / 1e3
+
+    def schedule(self, n: Optional[int] = None):
+        """The first ``n`` (default ``max_attempts - 1``) backoff delays a
+        fresh policy with this seed would sleep — for tests and docs; does
+        not consume this instance's RNG."""
+        probe = RetryPolicy(
+            max_attempts=self.max_attempts, backoff_ms=self.backoff_ms,
+            multiplier=self.multiplier, max_backoff_ms=self.max_backoff_ms,
+            jitter=self.jitter, seed=self.seed, name=self.name)
+        return [probe.delay_s(i)
+                for i in range(n if n is not None else self.max_attempts - 1)]
+
+    def call(self, fn: Callable, *args, **kwargs):
+        """Run ``fn(*args, **kwargs)``, retrying transient failures.
+        Raises the last error when attempts, the deadline, or the
+        classifier say stop."""
+        deadline = (self._clock() + self.deadline_ms / 1e3
+                    if self.deadline_ms is not None else None)
+        attempt = 0
+        while True:
+            attempt += 1
+            _bump(self.name, "attempts")
+            try:
+                return fn(*args, **kwargs)
+            except BaseException as e:
+                if not self._retryable(e):
+                    raise
+                if attempt >= self.max_attempts:
+                    _bump(self.name, "giveups")
+                    _publish(self.name)
+                    raise
+                delay = self.delay_s(attempt - 1)
+                if deadline is not None and self._clock() + delay > deadline:
+                    _bump(self.name, "deadline_giveups")
+                    _publish(self.name)
+                    raise
+                _bump(self.name, "retries")
+                if _warm:
+                    _bump(self.name, "retries_after_warm")
+                from ..framework import monitor as _monitor
+
+                _monitor.stat_add("transient_retries")
+                _publish(self.name)
+                self._sleep(delay)
+
+    def __call__(self, fn: Callable) -> Callable:
+        """Decorator form."""
+        def wrapped(*args, **kwargs):
+            return self.call(fn, *args, **kwargs)
+        return wrapped

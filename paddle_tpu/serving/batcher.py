@@ -12,10 +12,20 @@ Robustness contract:
   sheds the load immediately (``UnavailableError``) instead of building an
   unbounded latency backlog;
 * **deadlines** — a request whose ``deadline_ms`` elapses while queued
-  fails with ``ExecutionTimeoutError`` *before* wasting a device slot;
+  fails with ``ExecutionTimeoutError`` *before* wasting a device slot; the
+  worker SWEEPS expirations inside its wait loop, so a request stranded in
+  a bucket that never fills again still fails on time, even with zero new
+  traffic;
 * **graceful drain** — ``close(drain=True)`` stops admissions, serves
   everything already queued, then joins the worker;
-* a runner exception fails only that batch's futures, never the worker.
+* a runner exception fails only that batch's futures, never the worker;
+* **transient retry** — an optional ``resilience.RetryPolicy`` re-runs a
+  batch whose runner failed transiently (device hiccup) before the
+  failure reaches the futures;
+* **circuit breaking** — an optional per-bucket
+  ``resilience.CircuitBreaker``: while a bucket's circuit is open its
+  batches shed with ``UnavailableError`` instead of burning device slots,
+  and half-open probe batches drive recovery.
 """
 from __future__ import annotations
 
@@ -29,6 +39,7 @@ from ..framework.errors import (
     ExecutionTimeoutError,
     UnavailableError,
 )
+from ..resilience.faults import fault_point
 from .metrics import ServingMetrics
 
 __all__ = ["Request", "MicroBatcher"]
@@ -69,6 +80,7 @@ class MicroBatcher:
                  max_queue_depth: int = 256,
                  capacity: Optional[Callable[[int], int]] = None,
                  metrics: Optional[ServingMetrics] = None,
+                 breaker=None, retry=None,
                  name: str = "serving#0"):
         if max_batch_size < 1 or max_queue_depth < 1:
             raise UnavailableError(
@@ -79,6 +91,8 @@ class MicroBatcher:
         self._delay_s = float(max_queue_delay_ms) / 1e3
         self._max_depth = int(max_queue_depth)
         self._capacity = capacity or (lambda bucket: self._max_batch)
+        self._breaker = breaker  # resilience.CircuitBreaker, keyed by bucket
+        self._retry = retry      # resilience.RetryPolicy for the runner
         self.metrics = metrics or ServingMetrics(name)
 
         self._cv = threading.Condition()
@@ -129,26 +143,70 @@ class MicroBatcher:
                 best, best_t = b, dq[0].enqueue_t
         return best
 
+    def _take_expired_locked(self) -> List[Request]:
+        """Remove every queued request whose deadline has passed (caller
+        holds ``_cv``).  Cost is one scan of the pending set per worker
+        wakeup (<= every 50ms) — the price of deadlines that hold even
+        for a request stranded in a bucket no new traffic ever refills."""
+        now = time.monotonic()
+        expired: List[Request] = []
+        for b in list(self._pending):
+            dq = self._pending[b]
+            if not any(r.deadline_t is not None and now > r.deadline_t
+                       for r in dq):
+                continue
+            keep = deque()
+            for r in dq:
+                if r.deadline_t is not None and now > r.deadline_t:
+                    expired.append(r)
+                else:
+                    keep.append(r)
+            if keep:
+                self._pending[b] = keep
+            else:
+                del self._pending[b]
+        self._depth -= len(expired)
+        return expired
+
+    def _fail_expired(self, expired: List[Request]):
+        now = time.monotonic()
+        for r in expired:
+            self.metrics.incr("expired")
+            r.future.set_exception(ExecutionTimeoutError(
+                f"{self.metrics.name}: deadline exceeded after "
+                f"{(now - r.enqueue_t) * 1e3:.1f}ms in queue"))
+        if expired:
+            self.metrics.publish()
+
     def _loop(self):
         while True:
+            batch = None
             with self._cv:
-                while self._depth == 0 and not self._closing:
-                    self._cv.wait(0.05)
-                if self._depth == 0 and self._closing:
+                expired = self._take_expired_locked()
+                if self._depth == 0 and self._closing and not expired:
                     return
-                bucket = self._oldest_bucket()
-                dq = self._pending[bucket]
-                cap = max(1, int(self._capacity(bucket)))
-                wait = (dq[0].enqueue_t + self._delay_s) - time.monotonic()
-                if len(dq) < cap and wait > 0 and not self._closing:
-                    self._cv.wait(min(wait, 0.05))
-                    continue
-                batch = [dq.popleft() for _ in range(min(cap, len(dq)))]
-                if not dq:
-                    del self._pending[bucket]
-                self._depth -= len(batch)
-                depth = self._depth
-                drain = self._drain
+                if self._depth == 0:
+                    if not expired and not self._closing:
+                        self._cv.wait(0.05)
+                else:
+                    bucket = self._oldest_bucket()
+                    dq = self._pending[bucket]
+                    cap = max(1, int(self._capacity(bucket)))
+                    wait = ((dq[0].enqueue_t + self._delay_s)
+                            - time.monotonic())
+                    if len(dq) < cap and wait > 0 and not self._closing:
+                        self._cv.wait(min(wait, 0.05))
+                    else:
+                        batch = [dq.popleft()
+                                 for _ in range(min(cap, len(dq)))]
+                        if not dq:
+                            del self._pending[bucket]
+                        self._depth -= len(batch)
+                        depth = self._depth
+                        drain = self._drain
+            self._fail_expired(expired)
+            if batch is None:
+                continue
             if self._closing and not drain:
                 for r in batch:
                     r.future.set_exception(
@@ -172,19 +230,43 @@ class MicroBatcher:
         if not live:
             self.metrics.publish()
             return
-        try:
+        if self._breaker is not None and not self._breaker.allow(bucket):
+            # open circuit: shed without burning a device slot; callers
+            # see UnavailableError and should back off
+            self.metrics.incr("circuit_shed", len(live))
+            err = UnavailableError(
+                f"{self.metrics.name}: circuit open for bucket {bucket} — "
+                f"load shed (retry with backoff)")
+            for r in live:
+                r.future.set_exception(err)
+            self.metrics.publish({"bucket": bucket})
+            return
+
+        def _run_once():
+            fault_point("serving.runner")
             results = self._runner(bucket, live)
             if len(results) != len(live):
                 raise UnavailableError(
                     f"runner returned {len(results)} results for "
                     f"{len(live)} requests")
+            return results
+
+        try:
+            if self._retry is not None:
+                results = self._retry.call(_run_once)
+            else:
+                results = _run_once()
         except Exception as e:  # fail the batch, keep the worker alive
+            if self._breaker is not None:
+                self._breaker.record_failure(bucket)
             self.metrics.incr("errors", len(live))
             for r in live:
                 if not r.future.done():
                     r.future.set_exception(e)
             self.metrics.publish()
             return
+        if self._breaker is not None:
+            self._breaker.record_success(bucket)
         done = time.monotonic()
         for r, res in zip(live, results):
             self.metrics.observe_latency_ms((done - r.enqueue_t) * 1e3)

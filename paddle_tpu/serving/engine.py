@@ -21,6 +21,8 @@ import numpy as np
 
 from ..framework.errors import InvalidArgumentError
 from ..inference import Predictor
+from ..resilience import CircuitBreaker, RetryPolicy
+from ..resilience import retry as _retry_mod
 from .batcher import MicroBatcher, Request
 from .bucketing import BucketSet
 from .metrics import ServingMetrics
@@ -40,7 +42,11 @@ class InferenceEngine:
     ``max_queue_depth`` (load shedding), ``allow_bucket_fallback``
     (serve bucket misses through the slow batch-polymorphic path instead
     of rejecting — each distinct miss shape costs a fresh compile, which
-    is what analysis rule S601 flags).
+    is what analysis rule S601 flags), ``circuit_breaker`` (per-bucket
+    closed/open/half-open degradation: a persistently failing bucket
+    sheds with ``UnavailableError`` instead of burning device slots) and
+    ``retry_transient`` (re-run a batch once per transient device error
+    before failing its futures — see ``FLAGS_transient_max_retries``).
     """
 
     def __init__(self, path_prefix: str, buckets: Sequence, *,
@@ -50,6 +56,8 @@ class InferenceEngine:
                  unpad_outputs: bool = True,
                  device: Optional[str] = None,
                  params_file: Optional[str] = None,
+                 circuit_breaker: bool = True,
+                 retry_transient: bool = True,
                  name: Optional[str] = None):
         if name is None:
             _engine_counter[0] += 1
@@ -65,13 +73,18 @@ class InferenceEngine:
         self._executables: Dict[int, object] = {}
         self._fallback_shapes = set()
         self.metrics = ServingMetrics(name)
+        self.breaker = (CircuitBreaker(name) if circuit_breaker else None)
         self._batcher = MicroBatcher(
             self._route, self._run_batch,
             max_batch_size=max_batch_size,
             max_queue_delay_ms=max_queue_delay_ms,
             max_queue_depth=max_queue_depth,
             capacity=self._bucket_capacity,
-            metrics=self.metrics, name=name)
+            metrics=self.metrics,
+            breaker=self.breaker,
+            retry=(RetryPolicy.from_flags(name=f"{name}.runner")
+                   if retry_transient else None),
+            name=name)
 
     # -- routing / compile set ----------------------------------------------
     def _bucket_capacity(self, bucket: int) -> int:
@@ -125,6 +138,7 @@ class InferenceEngine:
             self._executable(i)
         from ..ops import autotune
         autotune.mark_warm()  # later tuner searches are hot-path (K701)
+        _retry_mod.mark_warm()  # later retry storms / flaps are F801
         return self.compile_count
 
     # -- execution -----------------------------------------------------------

@@ -24,6 +24,8 @@ import numpy as np
 
 from ..framework.errors import InvalidArgumentError
 from ..nn.layer_base import functional_call
+from ..resilience import CircuitBreaker, RetryPolicy
+from ..resilience import retry as _retry_mod
 from .batcher import MicroBatcher, Request
 from .metrics import ServingMetrics
 
@@ -46,6 +48,8 @@ class GenerationEngine:
                  batch_size: int = 4, cache_len: Optional[int] = None,
                  max_queue_delay_ms: float = 5.0, max_queue_depth: int = 256,
                  eos_token_id: Optional[int] = None,
+                 circuit_breaker: bool = True,
+                 retry_transient: bool = True,
                  name: Optional[str] = None):
         if name is None:
             _gen_counter[0] += 1
@@ -89,12 +93,17 @@ class GenerationEngine:
 
         self._prefill = jax.jit(prefill)
         self._decode = jax.jit(decode)
+        self.breaker = (CircuitBreaker(name) if circuit_breaker else None)
         self._batcher = MicroBatcher(
             self._route, self._run_batch,
             max_batch_size=batch_size,
             max_queue_delay_ms=max_queue_delay_ms,
             max_queue_depth=max_queue_depth,
-            metrics=self.metrics, name=name)
+            metrics=self.metrics,
+            breaker=self.breaker,
+            retry=(RetryPolicy.from_flags(name=f"{name}.runner")
+                   if retry_transient else None),
+            name=name)
 
     # -- routing -------------------------------------------------------------
     def _route(self, inputs: Sequence) -> int:
@@ -131,6 +140,7 @@ class GenerationEngine:
         self.metrics.set_counter("compiles", self.compile_count)
         from ..ops import autotune
         autotune.mark_warm()  # later tuner searches are hot-path (K701)
+        _retry_mod.mark_warm()  # later retry storms / flaps are F801
         return self.compile_count
 
     # -- batch execution -----------------------------------------------------

@@ -8,8 +8,8 @@ signature events.  ``analysis.RetraceMonitor`` consumes the snapshots for
 rule S601 (bucket-miss churn); dashboards read them straight off the bus.
 
 Snapshot keys: ``requests, completed, shed, expired, errors,
-bucket_misses, fallback_runs, compiles, batches, queue_depth,
-batch_occupancy, p50_ms, p99_ms, tokens, tokens_per_s``.
+bucket_misses, fallback_runs, compiles, batches, circuit_shed,
+queue_depth, batch_occupancy, p50_ms, p99_ms, tokens, tokens_per_s``.
 """
 from __future__ import annotations
 
@@ -24,7 +24,7 @@ __all__ = ["ServingMetrics"]
 #: counter keys every snapshot carries (zero-initialized)
 _COUNTERS = ("requests", "completed", "shed", "expired", "errors",
              "bucket_misses", "fallback_runs", "compiles", "batches",
-             "tokens")
+             "tokens", "circuit_shed")
 
 
 def _quantile(sorted_vals, q: float) -> float:

@@ -691,9 +691,28 @@ class Executor:
             cache_capacity = flag("executor_cache_capacity")
         self._cache = _CompileCache(cache_capacity)
         self.dispatches = 0  # one per device round-trip (run / run_steps)
+        from ..resilience.retry import RetryPolicy
+
+        # transient device errors (RESOURCE_EXHAUSTED/UNAVAILABLE/... from
+        # the XLA runtime) retry with backoff instead of killing the step
+        self._retry = RetryPolicy.from_flags(name=f"executor#{self._idx}")
         from ..sysconfig import maybe_enable_persistent_compilation_cache
 
         maybe_enable_persistent_compilation_cache()
+
+    def _dispatch(self, runner, program, feed_vals):
+        """One retried device round-trip — the seam every run() variant
+        funnels through (and the ``executor.dispatch`` fault point)."""
+        from ..resilience.faults import fault_point
+
+        def _once():
+            fault_point("executor.dispatch")
+            return runner(program, feed_vals)
+
+        outs = self._retry.call(_once)
+        self.dispatches += 1
+        self._publish_cache_stats()
+        return outs
 
     def close(self):
         self._cache.clear()
@@ -764,9 +783,7 @@ class Executor:
             runner = self._build(program, fetch_names, train, bool(training))
             if use_program_cache:
                 self._cache.put(sig, runner)
-        outs = runner(program, feed_vals)
-        self.dispatches += 1
-        self._publish_cache_stats()
+        outs = self._dispatch(runner, program, feed_vals)
         if return_numpy:
             outs = [np.asarray(o) for o in outs]
         return outs
@@ -891,9 +908,8 @@ class Executor:
                                        n_steps, fetch_every, lr_mode)
             if use_program_cache:
                 self._cache.put(sig, runner)
-        outs = runner(program, stacked_vals, const_vals)
-        self.dispatches += 1
-        self._publish_cache_stats()
+        outs = self._dispatch(lambda p, f: runner(p, f, const_vals),
+                              program, stacked_vals)
         if return_numpy:
             outs = [np.asarray(o) for o in outs]
         return outs

@@ -53,6 +53,32 @@ class TestHeartBeatMonitor:
         finally:
             mon.stop()
 
+    def test_stop_is_prompt_even_with_long_interval(self):
+        # stop() must interrupt the sweep pause (Event.wait), not ride
+        # out a full time.sleep(interval)
+        mon = HeartBeatMonitor(workers=1, timeout=60, interval=5.0)
+        mon.start()
+        t0 = time.monotonic()
+        mon.stop()
+        assert time.monotonic() - t0 < 1.0
+
+    def test_no_on_lost_after_stop(self):
+        # a sweep racing stop() may latch the lost state, but the
+        # callback must not fire after shutdown
+        fired = []
+
+        def on_lost(i, age):
+            fired.append(i)
+
+        mon = HeartBeatMonitor(workers=1, timeout=0.05, interval=0.02,
+                               on_lost=on_lost)
+        mon.start()
+        mon.stop()  # before the worker ever went stale-and-swept
+        fired_at_stop = list(fired)
+        time.sleep(0.3)  # were the thread still sweeping, it would fire
+        assert fired == fired_at_stop
+        assert mon._thread is None
+
     def test_validation(self):
         with pytest.raises(Exception):
             HeartBeatMonitor(workers=0)

@@ -1,0 +1,252 @@
+"""Chaos gate: crash-safe training resume + serving degradation (CPU).
+
+One-command proof of the resilience subsystem's two core contracts, run
+on every gate pass:
+
+1. **Training chaos** — a child trainer runs with an injected transient
+   checkpoint-write fault (``FLAGS_fault_plan`` via env, proving the
+   retry path), gets SIGKILLed mid-epoch once enough checkpoints have
+   committed, and the parent then resumes: restored params must be
+   BIT-IDENTICAL to the last committed checkpoint file and the committed
+   counter sequence must be gapless (the faulted write retried, not
+   skipped).  A byte flip in the newest checkpoint must make a second
+   resume quarantine it and land on the previous one.
+2. **Serving chaos** — an in-process InferenceEngine with an injected
+   non-transient runner fault: the per-bucket circuit must open, shed
+   with ``UnavailableError`` while open, recover through a half-open
+   probe once the fault plan is exhausted, and the batcher worker thread
+   must survive the whole episode.
+
+Also asserts the no-plan contract: with ``FLAGS_fault_plan`` unset,
+``fault_point`` is inert and two identical CPU runs are bit-identical.
+
+Prints one JSON line; exit 0 iff every gate holds.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TRAIN_FAULT_PLAN = "site=checkpoint.write,nth=2,error=TransientDeviceError"
+
+
+def _model(seed=0):
+    import paddle_tpu as pt
+    from paddle_tpu import nn, optimizer as popt
+
+    pt.seed(seed)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    model = pt.Model(net, inputs=["x"], labels=["y"])
+    model.prepare(optimizer=popt.Adam(learning_rate=1e-2),
+                  loss=nn.CrossEntropyLoss())
+    return model
+
+
+def train_child(ckpt_dir):
+    """Subprocess body: train forever (the parent SIGKILLs us)."""
+    import numpy as np
+
+    from paddle_tpu.incubate.checkpoint import AutoCheckpoint
+
+    model = _model(seed=1)
+    acp = AutoCheckpoint(model, ckpt_dir, save_steps=3, keep_max=100)
+    rng = np.random.RandomState(0)
+    epoch = 0
+    while True:
+        x = rng.randn(16, 4).astype(np.float32)
+        y = rng.randint(0, 2, size=(16,)).astype(np.int32)
+        model.train_batch([x], [y])
+        acp.step(epoch)
+        time.sleep(0.01)  # give the parent a window to SIGKILL mid-epoch
+
+
+def _committed(ckpt_dir):
+    from paddle_tpu.incubate.checkpoint import _META, _PREFIX
+
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return sorted(n for n in os.listdir(ckpt_dir)
+                  if n.startswith(_PREFIX)
+                  and os.path.exists(os.path.join(ckpt_dir, n, _META)))
+
+
+def gate_training_chaos(tmp):
+    import numpy as np
+
+    from paddle_tpu.framework import serialization
+    from paddle_tpu.incubate.checkpoint import AutoCheckpoint
+
+    ckpt_dir = os.path.join(tmp, "ck")
+    env = dict(os.environ, FLAGS_fault_plan=TRAIN_FAULT_PLAN)
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--train-child",
+         ckpt_dir],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.time() + 120
+        while len(_committed(ckpt_dir)) < 3 and time.time() < deadline:
+            if child.poll() is not None:
+                return {"pass": False,
+                        "error": f"trainer died rc={child.returncode} "
+                                 f"before 3 checkpoints committed"}
+            time.sleep(0.05)
+        committed = _committed(ckpt_dir)
+        if len(committed) < 3:
+            return {"pass": False, "error": "no 3 checkpoints within 120s"}
+    finally:
+        if child.poll() is None:
+            child.send_signal(signal.SIGKILL)  # crash, not clean shutdown
+        child.wait()
+
+    # the nth=2 write fault was TRANSIENT: the retry must have landed it,
+    # so committed counters are gapless from 1
+    counters = [serialization.load(os.path.join(ckpt_dir, n,
+                                                "meta.pdmeta"))["counter"]
+                for n in _committed(ckpt_dir)]
+    gapless = counters == list(range(1, len(counters) + 1))
+
+    # resume (fresh process state: different-seed model) and compare the
+    # restored params bit-for-bit against the last committed file
+    newest = _committed(ckpt_dir)[-1]
+    want = serialization.load(os.path.join(ckpt_dir, newest, "m.pdparams"))
+    m2 = _model(seed=9)
+    acp2 = AutoCheckpoint(m2, ckpt_dir)
+    meta = acp2.resume()
+    restored = {k: np.asarray(v) for k, v in m2.network.state_dict().items()}
+    identical = (meta is not None
+                 and set(want) == set(restored)
+                 and all(np.array_equal(want[k], restored[k])
+                         for k in want))
+
+    # corruption fallback: flip one byte in the newest payload; the next
+    # resume must quarantine it and land on the PREVIOUS checkpoint
+    p = os.path.join(ckpt_dir, newest, "m.pdparams")
+    blob = bytearray(open(p, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(p, "wb").write(bytes(blob))
+    prev = _committed(ckpt_dir)[-2]
+    prev_meta = serialization.load(os.path.join(ckpt_dir, prev,
+                                                "meta.pdmeta"))
+    m3 = _model(seed=13)
+    acp3 = AutoCheckpoint(m3, ckpt_dir)
+    meta3 = acp3.resume()
+    quarantined = any(n.startswith("corrupt-") for n in os.listdir(ckpt_dir))
+    fell_back = (meta3 is not None
+                 and meta3["counter"] == prev_meta["counter"])
+
+    ok = gapless and identical and quarantined and fell_back
+    return {"pass": bool(ok), "committed": len(counters),
+            "counters_gapless": bool(gapless),
+            "resume_bit_identical": bool(identical),
+            "corrupt_quarantined": bool(quarantined),
+            "fell_back_to_previous": bool(fell_back)}
+
+
+def gate_serving_chaos(tmp):
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.framework.errors import UnavailableError
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.resilience import FaultPlan
+    from paddle_tpu.serving import Bucket, InferenceEngine
+
+    pt.seed(7)
+    net = nn.Linear(8, 4)
+    prefix = os.path.join(tmp, "m")
+    pt.inference.save_inference_model(
+        prefix, net, [pt.static.InputSpec([None, 8], "float32")])
+
+    # a tight breaker so the episode fits in a smoke run
+    set_flags({"circuit_window": 2, "circuit_cooldown_ms": 200.0,
+               "circuit_half_open_probes": 1})
+    # non-transient fault (RuntimeError without a transient status string)
+    # so the retry path stays out of the way and failures hit the breaker;
+    # times matches the window: once the circuit opens the plan is spent,
+    # so the post-cooldown half-open probe succeeds
+    plan = FaultPlan.parse("site=serving.runner,every=1,times=2,"
+                           "error=RuntimeError")
+    x = np.ones((8,), np.float32)
+    outcomes = []
+    with InferenceEngine(prefix, [Bucket(((8,),))], max_batch_size=1,
+                         max_queue_delay_ms=1.0) as eng:
+        eng.warmup()
+        with plan:
+            for i in range(8):
+                try:
+                    eng.infer([x], timeout=10)
+                    outcomes.append("ok")
+                except UnavailableError:
+                    outcomes.append("shed")
+                except RuntimeError:
+                    outcomes.append("err")
+            # circuit open: wait out the cooldown; the fault plan's
+            # times=4 cap is exhausted, so the half-open probe succeeds
+            time.sleep(0.3)
+            recovered = np.allclose(eng.infer([x], timeout=10),
+                                    [np.asarray(net(x[None]))[0]],
+                                    atol=1e-5)
+        worker_alive = eng._batcher._worker.is_alive()
+        st = eng.stats()
+
+    opened = "shed" in outcomes
+    only_errs_then_sheds = ("err" in outcomes and outcomes.index("shed")
+                            > outcomes.index("err")) if opened else False
+    ok = opened and only_errs_then_sheds and recovered and worker_alive
+    return {"pass": bool(ok), "outcomes": outcomes,
+            "circuit_opened": bool(opened), "recovered": bool(recovered),
+            "worker_alive": bool(worker_alive),
+            "circuit_shed": st["circuit_shed"], "errors": st["errors"]}
+
+
+def gate_noop_determinism():
+    """With no fault plan, fault_point is inert and runs are bit-identical."""
+    import numpy as np
+
+    from paddle_tpu.resilience import faults
+
+    if faults.active():
+        return {"pass": False, "error": "a fault plan leaked into the gate"}
+
+    def run():
+        import jax.numpy as jnp
+
+        m = _model(seed=5)
+        rng = np.random.RandomState(3)
+        x = rng.randn(16, 4).astype(np.float32)
+        y = rng.randint(0, 2, size=(16,)).astype(np.int32)
+        losses = [float(np.asarray(m.train_batch([x], [y])[0]).reshape(-1)[0])
+                  for _ in range(3)]
+        del jnp
+        return losses
+
+    a, b = run(), run()
+    identical = a == b  # exact float equality: bit-identical CPU math
+    return {"pass": bool(identical), "losses": a}
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--train-child":
+        train_child(sys.argv[2])
+        return 0
+    t0 = time.time()
+    with tempfile.TemporaryDirectory() as tmp:
+        train = gate_training_chaos(tmp)
+        serving = gate_serving_chaos(tmp)
+        noop = gate_noop_determinism()
+    passed = train["pass"] and serving["pass"] and noop["pass"]
+    print(json.dumps({"pass": bool(passed), "training_chaos": train,
+                      "serving_chaos": serving, "noop_determinism": noop,
+                      "seconds": round(time.time() - t0, 1)}))
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,9 +12,10 @@
 #   perf-smoke tools/perf_smoke.py   (fused run_steps vs per-step, CPU, seconds)
 #   serving-smoke tools/serving_smoke.py (closed compile set + KV-decode identity)
 #   kernel-smoke tools/kernel_smoke.py (autotuner search + warm-restart cache hit)
+#   chaos-smoke tools/chaos_smoke.py (SIGKILL-resume bit identity + circuit recovery)
 #   bench   python bench.py          (only when a real TPU answers)
 #
-# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|bench]...
+# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|chaos-smoke|bench]...
 #         tools/run_gates.sh --only suite
 # Exit code: 0 iff every stage that ran passed.
 set -u
@@ -97,6 +98,9 @@ run_stage serving-smoke env JAX_PLATFORMS=cpu python tools/serving_smoke.py
 # kernel autotuner: forced measured search in interpret mode, then a second
 # process that must resolve every key from the on-disk cache (zero searches)
 run_stage kernel-smoke env JAX_PLATFORMS=cpu python tools/kernel_smoke.py
+# resilience: injected checkpoint-write fault + SIGKILL -> bit-identical
+# resume; injected serving fault -> circuit opens, sheds, recovers
+run_stage chaos-smoke env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 
 # bench only when a real accelerator answers within 60s
 if want bench; then
