@@ -85,6 +85,12 @@ def _emit(metric, value, unit, vs_baseline, **extra):
             "vs_baseline": round(float(vs_baseline), 3)}
     line.update(extra)
     print(json.dumps(line), flush=True)
+    try:  # mirror into FLAGS_metrics_jsonl (no-op when the flag is unset)
+        from paddle_tpu.observability import exporters as _obs_exp
+
+        _obs_exp.append_jsonl_record(dict(line, kind="bench"))
+    except Exception:
+        pass
     return line
 
 

@@ -91,6 +91,7 @@ from . import sysconfig  # noqa: F401
 from . import utils  # noqa: F401
 from . import inference  # noqa: F401
 from . import resilience  # noqa: F401
+from . import observability  # noqa: F401
 from . import serving  # noqa: F401
 from . import static  # noqa: F401
 from .static import InputSpec  # noqa: F401

@@ -88,6 +88,12 @@ RULES = {
     "F801": (Severity.WARNING,
              "resilience instability in a warmed serving path (transient "
              "retry storm or circuit flapping)"),
+    # -- training telemetry (M9xx) -------------------------------------------
+    "M901": (Severity.WARNING,
+             "data-starved training (input-pipeline wait dominates the "
+             "post-warmup step time)"),
+    "M902": (Severity.WARNING,
+             "HBM high-water above the alert fraction of device memory"),
 }
 
 

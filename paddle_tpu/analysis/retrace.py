@@ -60,6 +60,9 @@ class RetraceMonitor:
         # ("resilience", retry:<name>|circuit:<name>|fault:<site>) counter
         # snapshots: latest per policy / per circuit key (rule F801)
         self._resilience_sites: Dict[str, dict] = {}
+        # ("steptrace", name) training-telemetry snapshots: latest per loop
+        # (rules M901 / M902)
+        self._steptrace_sites: Dict[str, dict] = {}
 
     # -- subscription --------------------------------------------------------
     def install(self):
@@ -102,6 +105,11 @@ class RetraceMonitor:
                 name = f"{name}[{info.get('key')}]"
             with self._lock:
                 self._resilience_sites[name] = dict(info)
+            return
+        if key[0] == "steptrace":
+            # training-telemetry snapshot: cumulative sums, latest wins
+            with self._lock:
+                self._steptrace_sites[key[1]] = dict(info)
             return
         sig = _freeze(info)
         with self._lock:
@@ -150,6 +158,16 @@ class RetraceMonitor:
             if name is not None:
                 return dict(self._resilience_sites.get(name, {}))
             return {k: dict(v) for k, v in self._resilience_sites.items()}
+
+    def steptrace_stats(self, name: str = None):
+        """Latest training-telemetry snapshot(s) observed (step counts,
+        data-wait vs dispatch vs device time, rates, MFU, HBM high-water):
+        the dict for one loop (``name`` like ``"train"``), or all of
+        them."""
+        with self._lock:
+            if name is not None:
+                return dict(self._steptrace_sites.get(name, {}))
+            return {k: dict(v) for k, v in self._steptrace_sites.items()}
 
     def diagnostics(self) -> List[Diagnostic]:
         out = DiagnosticCollector()
@@ -269,6 +287,45 @@ class RetraceMonitor:
                              "less often) or fix the underlying bucket "
                              "failure; a circuit that reopens every "
                              "cooldown is a fault, not protection")
+        with self._lock:
+            step_sites = {k: dict(v)
+                          for k, v in self._steptrace_sites.items()}
+        for name, stats in step_sites.items():
+            steps = int(stats.get("steps_post_warm", 0))
+            data_ms = float(stats.get("data_wait_ms", 0.0))
+            busy_ms = (float(stats.get("dispatch_ms", 0.0))
+                       + float(stats.get("device_ms", 0.0)))
+            if steps > self.budget and data_ms > busy_ms:
+                total = data_ms + busy_ms
+                share = data_ms / total if total > 0 else 0.0
+                out.add("M901",
+                        f"training loop {name!r} spent "
+                        f"{data_ms:.0f}ms waiting on the input pipeline "
+                        f"vs {busy_ms:.0f}ms dispatching+computing over "
+                        f"{steps} post-warmup steps ({share:.0%} of step "
+                        f"time) — the device is idle while the host "
+                        f"fetches data",
+                        location=Location(file=name, function=name),
+                        hint="raise DataLoader prefetch_depth / "
+                             "num_workers, move preprocessing off the "
+                             "step path, or batch more examples per "
+                             "dispatch (Executor.run_steps)")
+            peak = float(stats.get("hbm_peak_bytes", 0.0))
+            limit = float(stats.get("hbm_limit_bytes", 0.0))
+            frac = float(stats.get("hbm_threshold", 0.9))
+            if limit > 0 and peak / limit >= frac:
+                out.add("M902",
+                        f"training loop {name!r} peaked at "
+                        f"{peak / 2**30:.2f}GiB HBM of "
+                        f"{limit / 2**30:.2f}GiB available "
+                        f"({peak / limit:.0%}, alert fraction "
+                        f"{frac:.0%}) — one larger batch or a fresh "
+                        f"allocation away from OOM",
+                        location=Location(file=name, function=name),
+                        hint="shard or offload optimizer state (ZeRO), "
+                             "enable rematerialization, lower the batch "
+                             "size, or raise FLAGS_hbm_high_water_frac "
+                             "if this headroom is intentional")
         return out.diagnostics
 
     @staticmethod

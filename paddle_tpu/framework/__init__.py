@@ -43,6 +43,7 @@ from .device import (  # noqa: F401
     is_compiled_with_tpu,
     is_compiled_with_cuda,
     get_jax_device,
+    memory_stats,
 )
 from .errors import (  # noqa: F401
     EnforceNotMet,

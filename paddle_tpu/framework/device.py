@@ -30,6 +30,7 @@ __all__ = [
     "is_compiled_with_tpu",
     "is_compiled_with_cuda",
     "get_jax_device",
+    "memory_stats",
     "XPUPlace",
 ]
 
@@ -160,3 +161,25 @@ def is_compiled_with_tpu() -> bool:
 
 def is_compiled_with_cuda() -> bool:
     return any(_kind_of(d) == "gpu" for d in jax.devices())
+
+
+def memory_stats(place=None) -> dict:
+    """Allocator statistics of one device (``peak_bytes_in_use``,
+    ``bytes_in_use``, ``bytes_limit``, ...) as reported by the backend.
+
+    ``place`` is a :class:`Place`, a ``jax.Device``, or None (the current
+    device).  Backends without allocator introspection (the CPU backend
+    returns None from ``Device.memory_stats()``) yield ``{}`` — callers
+    treat missing keys as "unreported", so the observability HBM gauges
+    simply read 0 off-TPU."""
+    if place is None:
+        dev = get_jax_device()
+    elif isinstance(place, Place):
+        dev = place.jax_device()
+    else:
+        dev = place
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        return {}
+    return dict(stats) if stats else {}

@@ -157,3 +157,23 @@ define_flag("circuit_cooldown_ms", 1000.0,
 define_flag("circuit_half_open_probes", 1,
             "Probe batches admitted in the half-open state; all must "
             "succeed to close the circuit, any failure re-opens it.")
+define_flag("metrics_port", 0,
+            "Prometheus text-exposition endpoint for the observability "
+            "registry (observability/exporters.py): 0 disables (default), "
+            "-1 binds an ephemeral port (read it back from "
+            "observability.status()), any other value is the TCP port. "
+            "Picked up by the first Executor via "
+            "observability.maybe_enable_from_flags().")
+define_flag("metrics_jsonl", "",
+            "Base path of the periodic JSONL metrics sink; written as "
+            "<base>.p<process_index>.jsonl (one file per host process — "
+            "observability.merge_jsonl collates them). Empty (default) "
+            "disables the sink. bench.py also emits its per-config "
+            "results through this lane when set.")
+define_flag("metrics_jsonl_interval_s", 10.0,
+            "Seconds between JSONL metric snapshots (plus one final "
+            "snapshot at close).")
+define_flag("hbm_high_water_frac", 0.9,
+            "Analysis rule M902 fires when the HBM high-water mark "
+            "(peak_bytes_in_use) reaches this fraction of the device's "
+            "bytes_limit — the early warning before a real OOM.")

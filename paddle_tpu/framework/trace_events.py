@@ -26,10 +26,12 @@ from __future__ import annotations
 import threading
 from typing import Callable, List
 
-__all__ = ["register", "unregister", "active", "notify"]
+__all__ = ["register", "unregister", "active", "notify",
+           "dropped_notifications"]
 
 _lock = threading.Lock()
 _observers: List[Callable] = []
+_dropped = 0
 
 
 def register(fn: Callable) -> Callable:
@@ -54,5 +56,24 @@ def active() -> bool:
 
 
 def notify(site, info) -> None:
+    """Fan ``(site, info)`` out to every observer.  A raising observer is
+    ISOLATED — publish sites sit inside ``Executor.run`` and the serving
+    worker loop, and a broken dashboard must not fail a training step —
+    and counted (``dropped_notifications()`` + the
+    ``trace_events_dropped_notifications`` monitor stat)."""
+    global _dropped
     for fn in list(_observers):
-        fn(site, info)
+        try:
+            fn(site, info)
+        except Exception:
+            with _lock:
+                _dropped += 1
+            from . import monitor
+
+            monitor.stat_add("trace_events_dropped_notifications")
+
+
+def dropped_notifications() -> int:
+    """Observer exceptions swallowed by :func:`notify` so far."""
+    with _lock:
+        return _dropped

@@ -27,6 +27,7 @@ import itertools
 import multiprocessing
 import queue
 import threading
+import time
 from concurrent.futures import ProcessPoolExecutor
 from typing import Callable, Optional
 
@@ -34,6 +35,7 @@ import jax
 import numpy as np
 
 from ..framework.errors import InvalidArgumentError
+from ..observability import steptrace as _steptrace
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler, Sampler
 
@@ -185,7 +187,15 @@ class _StagingIterator:
         return self
 
     def __next__(self):
-        item = self._q.get()
+        st = _steptrace._active
+        if st is None:
+            item = self._q.get()
+        else:
+            # data_wait_ms: how long the training loop blocked on the
+            # input pipeline for this batch (0 when prefetch kept up)
+            t0 = time.perf_counter()
+            item = self._q.get()
+            st.record_data_wait((time.perf_counter() - t0) * 1e3)
         if item is self._DONE:
             self._q.put(self._DONE)  # keep exhausted: further next() calls
             if self._err is not None:  # must re-raise, not block forever

@@ -29,6 +29,9 @@ __all__ = [
     "stop_profiler",
     "reset_profiler",
     "profiler",
+    "profiling_active",
+    "record_span",
+    "dropped_spans",
     "summary",
     "export_chrome_tracing",
     "register_summary_section",
@@ -36,8 +39,9 @@ __all__ = [
 
 _lock = threading.Lock()
 _events: Dict[str, dict] = {}
-_spans: list = []  # (name, tid, start_us, dur_us) while profiling
+_spans: list = []  # (name, tid, start_us, dur_us, cat, args) while profiling
 _SPAN_CAP = 200_000  # keep the host-side buffer bounded
+_dropped_spans = 0  # spans past the cap — counted, not silently lost
 _trace_dir: Optional[str] = None
 _started = False
 _sections: list = []  # (render_fn, on_reset) extra summary() sections
@@ -76,6 +80,7 @@ class RecordEvent:
         return self
 
     def __exit__(self, *exc):
+        global _dropped_spans
         t1 = time.perf_counter()
         dt = (t1 - self._t0) * 1e3  # ms
         self._ann.__exit__(*exc)
@@ -87,9 +92,12 @@ class RecordEvent:
             e["total"] += dt
             e["min"] = min(e["min"], dt)
             e["max"] = max(e["max"], dt)
-            if _started and len(_spans) < _SPAN_CAP:
-                _spans.append((self.name, threading.get_ident(),
-                               self._t0 * 1e6, dt * 1e3))
+            if _started:
+                if len(_spans) < _SPAN_CAP:
+                    _spans.append((self.name, threading.get_ident(),
+                                   self._t0 * 1e6, dt * 1e3, "host", None))
+                else:
+                    _dropped_spans += 1
         return False
 
     def __call__(self, fn):
@@ -143,11 +151,49 @@ def stop_profiler(sorted_key: Optional[str] = "total",
     return table
 
 
+def profiling_active() -> bool:
+    """True between start_profiler and stop_profiler — span producers
+    outside this module (the serving batcher) check it before paying the
+    span-assembly cost."""
+    return _started
+
+
+def record_span(name: str, start_s: float, dur_ms: float, *,
+                tid: Optional[int] = None, cat: str = "host",
+                args: Optional[dict] = None) -> bool:
+    """Record an externally-timed span (``start_s`` on the perf_counter /
+    monotonic clock base) into the chrome-trace buffer.  Used by the
+    serving layer for per-request queue/execute spans.  No-op unless the
+    profiler is running; respects (and counts overflow past) the span
+    cap.  Returns whether the span was kept."""
+    global _dropped_spans
+    if not _started:
+        return False
+    with _lock:
+        if not _started:
+            return False
+        if len(_spans) >= _SPAN_CAP:
+            _dropped_spans += 1
+            return False
+        _spans.append((name, tid if tid is not None
+                       else threading.get_ident(),
+                       start_s * 1e6, dur_ms * 1e3, cat, args))
+        return True
+
+
+def dropped_spans() -> int:
+    """Spans lost past ``_SPAN_CAP`` since the last reset."""
+    with _lock:
+        return _dropped_spans
+
+
 def reset_profiler():
     """Parity: fluid/profiler.py reset_profiler."""
+    global _dropped_spans
     with _lock:
         _events.clear()
         _spans.clear()
+        _dropped_spans = 0
         hooks = [h for _, h in _sections if h is not None]
     for hook in hooks:
         hook()
@@ -164,15 +210,19 @@ def export_chrome_tracing(path: str) -> int:
 
     with _lock:
         spans = list(_spans)
-    events = [
-        {"name": name, "ph": "X", "pid": 0, "tid": tid,
-         "ts": round(ts_us, 3), "dur": round(dur_us, 3),
-         "cat": "host"}
-        for name, tid, ts_us, dur_us in spans
-    ]
+        dropped = _dropped_spans
+    events = []
+    for name, tid, ts_us, dur_us, cat, args in spans:
+        ev = {"name": name, "ph": "X", "pid": 0, "tid": tid,
+              "ts": round(ts_us, 3), "dur": round(dur_us, 3),
+              "cat": cat}
+        if args:
+            ev["args"] = args
+        events.append(ev)
     with open(path, "w") as f:
         json.dump({"traceEvents": events,
-                   "displayTimeUnit": "ms"}, f)
+                   "displayTimeUnit": "ms",
+                   "otherData": {"dropped_spans": dropped}}, f)
     return len(events)
 
 
@@ -187,7 +237,12 @@ def summary(sorted_key: Optional[str] = "total") -> str:
             for name, e in _events.items()
         ]
         sections = [fn for fn, _ in _sections]
+        dropped = _dropped_spans
     extra = [s for s in (fn() for fn in sections) if s]
+    if dropped:
+        extra.append(f"[profiler] {dropped} span(s) dropped past the "
+                     f"{_SPAN_CAP} span cap — the chrome trace is "
+                     f"truncated; profile a shorter window")
     if not rows:
         return "\n\n".join(extra) if extra else ""
     key_idx = {"calls": 1, "total": 2, "ave": 3, "min": 4, "max": 5}.get(

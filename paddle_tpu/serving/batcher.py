@@ -29,12 +29,14 @@ Robustness contract:
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import OrderedDict, deque
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from .. import profiler
 from ..framework.errors import (
     ExecutionTimeoutError,
     UnavailableError,
@@ -44,12 +46,16 @@ from .metrics import ServingMetrics
 
 __all__ = ["Request", "MicroBatcher"]
 
+#: process-wide request span ids — one id follows a request
+#: submit → queue → batch → dispatch → complete across log/trace sinks
+_span_ids = itertools.count(1)
+
 
 class Request:
     """One queued inference request."""
 
     __slots__ = ("inputs", "shapes", "bucket", "future", "enqueue_t",
-                 "deadline_t", "meta")
+                 "deadline_t", "meta", "span_id")
 
     def __init__(self, inputs: Sequence, bucket: int,
                  deadline_ms: Optional[float] = None, meta=None):
@@ -61,6 +67,7 @@ class Request:
         self.deadline_t = (self.enqueue_t + deadline_ms / 1e3
                            if deadline_ms is not None else None)
         self.meta = meta
+        self.span_id = next(_span_ids)
 
 
 class MicroBatcher:
@@ -251,6 +258,7 @@ class MicroBatcher:
                     f"{len(live)} requests")
             return results
 
+        t_exec = time.monotonic()
         try:
             if self._retry is not None:
                 results = self._retry.call(_run_once)
@@ -268,8 +276,25 @@ class MicroBatcher:
         if self._breaker is not None:
             self._breaker.record_success(bucket)
         done = time.monotonic()
+        # per-request span breakdown: queue (submit → this dispatch) vs
+        # execute (the runner call, shared by the batch).  Chrome-trace
+        # spans only while a profiler run is live; time.monotonic and the
+        # profiler's perf_counter share CLOCK_MONOTONIC on Linux, so the
+        # serving spans line up with RecordEvent spans in one timeline.
+        execute_ms = (done - t_exec) * 1e3
+        tracing = profiler.profiling_active()
         for r, res in zip(live, results):
+            queue_ms = (t_exec - r.enqueue_t) * 1e3
             self.metrics.observe_latency_ms((done - r.enqueue_t) * 1e3)
+            self.metrics.observe_span(queue_ms, execute_ms)
+            if tracing:
+                args = {"span": r.span_id, "bucket": bucket}
+                profiler.record_span(f"{self.metrics.name}/queue",
+                                     r.enqueue_t, queue_ms,
+                                     cat="serving", args=args)
+                profiler.record_span(f"{self.metrics.name}/execute",
+                                     t_exec, execute_ms,
+                                     cat="serving", args=args)
             r.future.set_result(res)
         self.metrics.observe_batch(len(live), cap, depth)
         self.metrics.publish({"bucket": bucket})

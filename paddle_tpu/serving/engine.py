@@ -160,7 +160,10 @@ class InferenceEngine:
                 widths = [(0, cap - col.shape[0])] + [(0, 0)] * (col.ndim - 1)
                 col = np.pad(col, widths)
             stacked.append(col)
-        outs = self._pred.run_compiled(self._executable(bucket), stacked)
+        from .. import profiler
+
+        with profiler.RecordEvent(f"{self.name}/bucket[{bucket}]"):
+            outs = self._pred.run_compiled(self._executable(bucket), stacked)
         return [self._slice_out(bucket, outs, i, r)
                 for i, r in enumerate(requests)]
 

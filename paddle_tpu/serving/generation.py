@@ -157,31 +157,35 @@ class GenerationEngine:
             positions[i, : len(prompt)] = np.arange(len(prompt))
             lens[i] = len(prompt)
             budgets[i] = int(r.meta)
+        from .. import profiler
+
         t0 = time.monotonic()
         cache = self._model.gpt.init_cache(B, self._cache_len)
-        tok, cache = self._prefill(
-            self._params, self._buffers, jnp.asarray(ids),
-            jnp.asarray(positions), jnp.asarray(lens), cache)
+        with profiler.RecordEvent(f"{self.name}/prefill[{Sb}]"):
+            tok, cache = self._prefill(
+                self._params, self._buffers, jnp.asarray(ids),
+                jnp.asarray(positions), jnp.asarray(lens), cache)
         pos = jnp.asarray(lens)  # absolute slot of the token just produced
         out: List[List[int]] = [[] for _ in range(B)]
         done = np.array([i >= len(requests) for i in range(B)])
         n_tokens = 0
-        while True:
-            host_tok = np.asarray(tok)
-            for i in range(len(requests)):
-                if done[i]:
-                    continue
-                out[i].append(int(host_tok[i]))
-                n_tokens += 1
-                if (len(out[i]) >= budgets[i]
-                        or (self._eos is not None
-                            and host_tok[i] == self._eos)):
-                    done[i] = True
-            if done.all():
-                break
-            tok, cache = self._decode(self._params, self._buffers, tok, pos,
-                                      cache)
-            pos = pos + 1
+        with profiler.RecordEvent(f"{self.name}/decode"):
+            while True:
+                host_tok = np.asarray(tok)
+                for i in range(len(requests)):
+                    if done[i]:
+                        continue
+                    out[i].append(int(host_tok[i]))
+                    n_tokens += 1
+                    if (len(out[i]) >= budgets[i]
+                            or (self._eos is not None
+                                and host_tok[i] == self._eos)):
+                        done[i] = True
+                if done.all():
+                    break
+                tok, cache = self._decode(self._params, self._buffers, tok,
+                                          pos, cache)
+                pos = pos + 1
         self.metrics.observe_tokens(n_tokens, time.monotonic() - t0)
         self.metrics.set_counter("compiles", self.compile_count)
         return [np.asarray(o, np.int32) for o in out[: len(requests)]]

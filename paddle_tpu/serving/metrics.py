@@ -9,11 +9,13 @@ rule S601 (bucket-miss churn); dashboards read them straight off the bus.
 
 Snapshot keys: ``requests, completed, shed, expired, errors,
 bucket_misses, fallback_runs, compiles, batches, circuit_shed,
-queue_depth, batch_occupancy, p50_ms, p99_ms, tokens, tokens_per_s``.
+queue_depth, batch_occupancy, p50_ms, p99_ms, queue_p50_ms,
+queue_p99_ms, execute_p50_ms, execute_p99_ms, tokens, tokens_per_s``.
 """
 from __future__ import annotations
 
 import collections
+import math
 import threading
 from typing import Deque, Dict, Optional
 
@@ -28,9 +30,15 @@ _COUNTERS = ("requests", "completed", "shed", "expired", "errors",
 
 
 def _quantile(sorted_vals, q: float) -> float:
-    if not sorted_vals:
+    """Nearest-rank quantile with the CEIL rank convention: the q-th
+    quantile is element ``ceil(q*n)`` (1-based).  The old ``int(q*n)``
+    floor-and-use-as-0-based-index form over-read the tail for small
+    windows — e.g. p50 of [1,2,3,4] returned 3 (rank 3 of 4 = p75), and
+    any q < 1 could land on the max."""
+    n = len(sorted_vals)
+    if not n:
         return 0.0
-    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    i = min(max(math.ceil(q * n) - 1, 0), n - 1)
     return float(sorted_vals[i])
 
 
@@ -43,6 +51,8 @@ class ServingMetrics:
         self._counters: Dict[str, int] = {k: 0 for k in _COUNTERS}
         self._latency_ms: Deque[float] = collections.deque(maxlen=window)
         self._occupancy: Deque[float] = collections.deque(maxlen=window)
+        self._queue_ms: Deque[float] = collections.deque(maxlen=window)
+        self._execute_ms: Deque[float] = collections.deque(maxlen=window)
         self._queue_depth = 0
         self._token_time_s = 0.0
 
@@ -74,15 +84,43 @@ class ServingMetrics:
             self._counters["tokens"] += int(n)
             self._token_time_s += float(seconds)
 
+    def observe_span(self, queue_ms: float, execute_ms: float):
+        """Per-request span breakdown from the batcher: time queued
+        (submit → batch dispatch) vs time executing (runner call share).
+        Feeds the snapshot quantiles and — when the observability
+        registry is live — the ``paddle_tpu_serving_queue_ms`` /
+        ``_execute_ms`` histograms labeled by engine."""
+        with self._lock:
+            self._queue_ms.append(float(queue_ms))
+            self._execute_ms.append(float(execute_ms))
+        from .. import observability
+
+        if observability.enabled():
+            reg = observability.default_registry()
+            reg.histogram(
+                "paddle_tpu_serving_queue_ms",
+                "per-request time queued before batch dispatch",
+                ("engine",)).labels(self.name).observe(queue_ms)
+            reg.histogram(
+                "paddle_tpu_serving_execute_ms",
+                "per-request batch execution time",
+                ("engine",)).labels(self.name).observe(execute_ms)
+
     def snapshot(self) -> dict:
         with self._lock:
             lat = sorted(self._latency_ms)
             occ = list(self._occupancy)
+            qms = sorted(self._queue_ms)
+            xms = sorted(self._execute_ms)
             snap = dict(self._counters)
             snap["queue_depth"] = self._queue_depth
             snap["batch_occupancy"] = (sum(occ) / len(occ)) if occ else 0.0
             snap["p50_ms"] = _quantile(lat, 0.50)
             snap["p99_ms"] = _quantile(lat, 0.99)
+            snap["queue_p50_ms"] = _quantile(qms, 0.50)
+            snap["queue_p99_ms"] = _quantile(qms, 0.99)
+            snap["execute_p50_ms"] = _quantile(xms, 0.50)
+            snap["execute_p99_ms"] = _quantile(xms, 0.99)
             snap["tokens_per_s"] = (snap["tokens"] / self._token_time_s
                                     if self._token_time_s > 0 else 0.0)
         return snap

@@ -33,6 +33,7 @@ from __future__ import annotations
 import contextlib
 import itertools
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -43,6 +44,7 @@ import numpy as np
 from ..framework.dtype import convert_dtype
 from ..framework.errors import InvalidArgumentError, NotFoundError
 from ..framework import trace_events
+from ..observability import steptrace as _steptrace
 
 __all__ = [
     "Variable", "Op", "Program", "DefUseIndex", "Executor", "program_guard",
@@ -699,17 +701,38 @@ class Executor:
         from ..sysconfig import maybe_enable_persistent_compilation_cache
 
         maybe_enable_persistent_compilation_cache()
+        from .. import observability
 
-    def _dispatch(self, runner, program, feed_vals):
+        observability.maybe_enable_from_flags()
+
+    def _dispatch(self, runner, program, feed_vals, n_steps: int = 1,
+                  examples: int = 0):
         """One retried device round-trip — the seam every run() variant
-        funnels through (and the ``executor.dispatch`` fault point)."""
+        funnels through (and the ``executor.dispatch`` fault point).
+
+        With step telemetry active (``observability.enable()``) the
+        dispatch is split into host dispatch time and
+        ``block_until_ready``-timed device time; with it off the only
+        extra work is the one falsy module-attribute check below."""
         from ..resilience.faults import fault_point
 
         def _once():
             fault_point("executor.dispatch")
             return runner(program, feed_vals)
 
-        outs = self._retry.call(_once)
+        st = _steptrace._active
+        if st is None:
+            outs = self._retry.call(_once)
+        else:
+            t0 = time.perf_counter()
+            outs = self._retry.call(_once)
+            t1 = time.perf_counter()
+            jax.block_until_ready(outs)
+            t2 = time.perf_counter()
+            st.on_dispatch(f"executor#{self._idx}", n_steps=n_steps,
+                           examples=examples,
+                           dispatch_ms=(t1 - t0) * 1e3,
+                           device_ms=(t2 - t1) * 1e3)
         self.dispatches += 1
         self._publish_cache_stats()
         return outs
@@ -783,7 +806,13 @@ class Executor:
             runner = self._build(program, fetch_names, train, bool(training))
             if use_program_cache:
                 self._cache.put(sig, runner)
-        outs = self._dispatch(runner, program, feed_vals)
+        examples = 0
+        if _steptrace._active is not None and feed_vals:
+            # examples per step ≈ the largest leading feed dim (the batch)
+            examples = max((int(v.shape[0]) for v in feed_vals.values()
+                            if v.ndim >= 1), default=0)
+        outs = self._dispatch(runner, program, feed_vals,
+                              examples=examples)
         if return_numpy:
             outs = [np.asarray(o) for o in outs]
         return outs
@@ -908,8 +937,15 @@ class Executor:
                                        n_steps, fetch_every, lr_mode)
             if use_program_cache:
                 self._cache.put(sig, runner)
+        examples = 0
+        if _steptrace._active is not None and stacked_vals:
+            # stacked feeds are [n_steps, batch, ...] — examples per chain
+            per_step = max((int(v.shape[1]) for v in stacked_vals.values()
+                            if v.ndim >= 2), default=0)
+            examples = n_steps * per_step
         outs = self._dispatch(lambda p, f: runner(p, f, const_vals),
-                              program, stacked_vals)
+                              program, stacked_vals, n_steps=n_steps,
+                              examples=examples)
         if return_numpy:
             outs = [np.asarray(o) for o in outs]
         return outs
@@ -961,6 +997,7 @@ class Executor:
             return ys, params, opt_state, buffers
 
         jitted = jax.jit(chain, donate_argnums=(0, 1, 2))
+        cost: Dict[str, bool] = {}
 
         def runner(prog, stacked, const):
             if prog._opt_state is None:
@@ -981,6 +1018,16 @@ class Executor:
             from ..framework import random as _prandom
 
             rng = _prandom.default_generator().next_key()
+            st = _steptrace._active
+            if st is not None and not cost.get("done"):
+                # once per compiled chain: XLA's own FLOP count for the
+                # whole N-step dispatch (lowering only, no extra compile)
+                cost["done"] = True
+                st.set_flops(f"executor#{self._idx}",
+                             _steptrace.estimate_flops(
+                                 jitted, dict(prog.scope), prog._opt_state,
+                                 dict(prog.buffers), stacked, const, lr_arg,
+                                 rng))
             fetched, new_params, prog._opt_state, new_bufs = jitted(
                 dict(prog.scope), prog._opt_state, dict(prog.buffers),
                 stacked, const, lr_arg, rng)
@@ -1021,6 +1068,7 @@ class Executor:
                 return fetched, {**new_t, **f_params}, new_state, nb
 
             jitted = jax.jit(step, donate_argnums=(0, 1, 2))
+            cost: Dict[str, bool] = {}
 
             def runner(prog, feeds):
                 if prog._opt_state is None:
@@ -1030,6 +1078,14 @@ class Executor:
                 from ..framework import random as _prandom
 
                 rng = _prandom.default_generator().next_key()
+                st = _steptrace._active
+                if st is not None and not cost.get("done"):
+                    cost["done"] = True
+                    st.set_flops(f"executor#{self._idx}",
+                                 _steptrace.estimate_flops(
+                                     jitted, dict(prog.scope),
+                                     prog._opt_state, dict(prog.buffers),
+                                     feeds, lr, rng))
                 fetched, new_params, prog._opt_state, new_bufs = jitted(
                     dict(prog.scope), prog._opt_state, dict(prog.buffers),
                     feeds, lr, rng)
@@ -1054,11 +1110,19 @@ class Executor:
         # buffers must not be donated either.
         donate = () if getattr(program, "_is_test_clone", False) else (1,)
         jitted = jax.jit(fwd, donate_argnums=donate)
+        cost: Dict[str, bool] = {}
 
         def runner(prog, feeds):
             from ..framework import random as _prandom
 
             rng = _prandom.default_generator().next_key()
+            st = _steptrace._active
+            if st is not None and not cost.get("done"):
+                cost["done"] = True
+                st.set_flops(f"executor#{self._idx}",
+                             _steptrace.estimate_flops(
+                                 jitted, dict(prog.scope),
+                                 dict(prog.buffers), feeds, rng))
             fetched, nb = jitted(dict(prog.scope), dict(prog.buffers),
                                  feeds, rng)
             # persist buffer updates (step counters; BN stats when the ops

@@ -13,9 +13,10 @@
 #   serving-smoke tools/serving_smoke.py (closed compile set + KV-decode identity)
 #   kernel-smoke tools/kernel_smoke.py (autotuner search + warm-restart cache hit)
 #   chaos-smoke tools/chaos_smoke.py (SIGKILL-resume bit identity + circuit recovery)
+#   obs-smoke tools/obs_smoke.py   (metrics scrape + JSONL sink + serving spans)
 #   bench   python bench.py          (only when a real TPU answers)
 #
-# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|chaos-smoke|bench]...
+# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|chaos-smoke|obs-smoke|bench]...
 #         tools/run_gates.sh --only suite
 # Exit code: 0 iff every stage that ran passed.
 set -u
@@ -101,6 +102,9 @@ run_stage kernel-smoke env JAX_PLATFORMS=cpu python tools/kernel_smoke.py
 # resilience: injected checkpoint-write fault + SIGKILL -> bit-identical
 # resume; injected serving fault -> circuit opens, sheds, recovers
 run_stage chaos-smoke env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+# observability: live Prometheus scrape with advancing step counters,
+# JSONL snapshot sink, and serving spans in the chrome trace
+run_stage obs-smoke env JAX_PLATFORMS=cpu python tools/obs_smoke.py
 
 # bench only when a real accelerator answers within 60s
 if want bench; then
