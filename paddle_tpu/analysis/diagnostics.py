@@ -80,6 +80,9 @@ RULES = {
     "S601": (Severity.WARNING,
              "serving bucket-miss churn (requests falling outside the "
              "configured shape buckets)"),
+    "S602": (Severity.WARNING,
+             "serving router instability after warmup (replica health "
+             "flapping, or hedged requests pinned at their budget)"),
     # -- kernel autotuner (K7xx) ---------------------------------------------
     "K701": (Severity.WARNING,
              "kernel autotuning inside a serving hot path (tuning cache "

@@ -53,8 +53,11 @@ class RetraceMonitor:
         # executor, NOT deduped signature events (rule R403)
         self._cache_sites: Dict[str, dict] = {}
         # ("serving", name) engine snapshots: same latest-value semantics
-        # (rule S601)
+        # (rules S601 / S602 — router snapshots carry "router": 1)
         self._serving_sites: Dict[str, dict] = {}
+        # ("router", "<router>[<i>]") per-replica snapshots: latest state /
+        # outstanding / counters per replica (rule S602 context)
+        self._router_sites: Dict[str, dict] = {}
         # ("autotune", kernel) tuner snapshots: latest per kernel (rule K701)
         self._autotune_sites: Dict[str, dict] = {}
         # ("resilience", retry:<name>|circuit:<name>|fault:<site>) counter
@@ -89,6 +92,13 @@ class RetraceMonitor:
         if key[0] == "serving":
             with self._lock:
                 self._serving_sites[key[1]] = dict(info)
+            return
+        if key[0] == "router":
+            # per-replica counter snapshot: latest value wins — deduping
+            # would mint one "signature" per counter tick and leak router
+            # telemetry into the R401/R402 budgets
+            with self._lock:
+                self._router_sites[key[1]] = dict(info)
             return
         if key[0] == "autotune":
             # tuner snapshot: latest counters per kernel — deduping would
@@ -139,6 +149,15 @@ class RetraceMonitor:
             if name is not None:
                 return dict(self._serving_sites.get(name, {}))
             return {k: dict(v) for k, v in self._serving_sites.items()}
+
+    def router_stats(self, replica: str = None):
+        """Latest per-replica router snapshot(s) observed (state,
+        outstanding, probe/flap/hedge counters): the dict for one replica
+        (``replica`` like ``"router#1[0]"``), or all of them."""
+        with self._lock:
+            if replica is not None:
+                return dict(self._router_sites.get(replica, {}))
+            return {k: dict(v) for k, v in self._router_sites.items()}
 
     def autotune_stats(self, kernel: str = None):
         """Latest autotuner snapshot(s) observed (resolution event, chosen
@@ -232,6 +251,40 @@ class RetraceMonitor:
                          "widen existing ones) so every request pads into "
                          "the closed executable set; keep "
                          "allow_bucket_fallback for rare stragglers only")
+        for name, stats in serving_sites.items():
+            if not stats.get("router"):
+                continue  # engine snapshot, not a router's
+            flaps = int(stats.get("replica_flaps_after_warm", 0))
+            if flaps >= 3:
+                out.add("S602",
+                        f"router {name} saw {flaps} replica health flaps "
+                        f"after serving warmup ({stats.get('failovers', 0)} "
+                        f"failovers, {stats.get('healthy', 0)}/"
+                        f"{stats.get('replicas', 0)} replicas healthy) — a "
+                        f"replica that keeps re-admitting and re-tripping "
+                        f"bounces its share of traffic through failover "
+                        f"retries instead of staying shed",
+                        location=Location(file=name, function=name),
+                        hint="raise the breaker cooldown / half-open probe "
+                             "count (Router circuit_kw=...) so recovery "
+                             "needs sustained health, or fix the replica "
+                             "(device health, OOM pressure) before "
+                             "re-admitting it")
+            denied = int(stats.get("hedge_denied_after_warm", 0))
+            if denied > self.budget:
+                out.add("S602",
+                        f"router {name} denied {denied} hedged requests "
+                        f"after serving warmup (budget {self.budget}; "
+                        f"{stats.get('hedges', 0)} hedges sent, "
+                        f"{stats.get('hedge_wins', 0)} won) — the hedge "
+                        f"delay keeps firing on ordinary traffic, so the "
+                        f"budget cap is the only thing stopping the fleet "
+                        f"from serving every request twice",
+                        location=Location(file=name, function=name),
+                        hint="raise hedge_delay_ms (or leave it p99-"
+                             "derived and fix the latency regression "
+                             "moving the p99); hedges should be rare "
+                             "tail-cutters, not a steady second stream")
         with self._lock:
             autotune_sites = {k: dict(v)
                               for k, v in self._autotune_sites.items()}

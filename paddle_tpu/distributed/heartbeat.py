@@ -191,21 +191,33 @@ class FileHeartbeat:
 
 _last_beat = 0.0
 _writer: Optional[FileHeartbeat] = None
+_beat_lock = threading.Lock()
 
 
 def maybe_beat(min_interval: float = BEAT_MIN_INTERVAL) -> None:
     """Touch the heartbeat file named by ``PADDLE_TPU_HEARTBEAT_FILE`` at
     most once per ``min_interval`` seconds; no-op when unset.  Called from
-    the training loop (Model.train_batch)."""
+    the training loop (Model.train_batch) and the serving router's health
+    sweep — safe for concurrent callers: writer construction and the
+    last-beat stamp mutate under a lock, and a caller that finds another
+    thread mid-beat simply skips (that beat covers it) instead of
+    blocking its step behind a second disk write."""
     global _last_beat, _writer
     path = os.environ.get(ENV_FILE)
     if not path:
         return
-    now = time.monotonic()
-    if now - _last_beat < min_interval:
-        return
-    if _writer is None or _writer.path != path:
-        _writer = FileHeartbeat(path)
-    else:
-        _writer.beat()
-    _last_beat = now
+    if time.monotonic() - _last_beat < min_interval:
+        return  # unlocked fast path: a stale read only costs one acquire
+    if not _beat_lock.acquire(blocking=False):
+        return  # another thread is beating right now — its beat covers us
+    try:
+        now = time.monotonic()
+        if now - _last_beat < min_interval:
+            return
+        if _writer is None or _writer.path != path:
+            _writer = FileHeartbeat(path)
+        else:
+            _writer.beat()
+        _last_beat = now
+    finally:
+        _beat_lock.release()

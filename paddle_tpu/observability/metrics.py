@@ -323,6 +323,7 @@ _FAMILY_LABEL = {
     "resilience": "site",
     "autotune": "kernel",
     "steptrace": "name",
+    "router": "replica",
 }
 
 _bridge_fn: Optional[Callable] = None

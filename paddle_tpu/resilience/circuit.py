@@ -189,6 +189,14 @@ class CircuitBreaker:
              "failures": ks.failures, "successes": ks.successes,
              "sheds": ks.sheds})
 
+    def reset(self, key) -> None:
+        """Forget ``key``'s window and state entirely (back to CLOSED).
+        The serving router uses this when a drained replica is re-admitted
+        after a weight swap: outcomes recorded against the old weights
+        must not prejudice the new ones."""
+        with self._lock:
+            self._keys.pop(key, None)
+
     # -- introspection -------------------------------------------------------
     def state(self, key) -> str:
         with self._lock:

@@ -14,6 +14,7 @@ Installed sites (grep for ``fault_point(`` to audit):
 ``executor.dispatch``   compiled-runner dispatch in ``Executor.run``
 ``collective.call``     every user-facing collective (distributed)
 ``serving.runner``      micro-batcher batch execution (serving/batcher)
+``router.dispatch``     replica pick → engine submit (serving/router)
 =====================  ====================================================
 
 With no plan installed (the default) :func:`fault_point` is a single

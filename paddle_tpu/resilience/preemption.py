@@ -33,20 +33,28 @@ PREEMPTION_EXIT_CODE = 75
 
 
 class PreemptionHandler:
-    """SIGTERM → ``checkpoint.final_save(epoch)`` → ``exit(75)``.
+    """SIGTERM → ``on_preempt()`` → ``checkpoint.final_save(epoch)`` →
+    ``exit(75)``.
 
     ``checkpoint`` is an ``incubate.checkpoint.AutoCheckpoint`` (anything
-    with ``final_save(epoch)``); ``get_epoch`` supplies the epoch stamped
-    into the final checkpoint (default: the last epoch the checkpoint
-    object saw).  Install from the MAIN thread (CPython delivers signals
-    there).  ``_exit`` is injectable for tests.
+    with ``final_save(epoch)``), or ``None`` for serving processes that
+    have no training state to save; ``get_epoch`` supplies the epoch
+    stamped into the final checkpoint (default: the last epoch the
+    checkpoint object saw).  ``on_preempt`` is an optional best-effort
+    hook that runs FIRST — the serving router passes its
+    ``drain_all`` here so an eviction finishes in-flight requests before
+    the process exits.  Install from the MAIN thread (CPython delivers
+    signals there).  ``_exit`` is injectable for tests.
     """
 
-    def __init__(self, checkpoint, get_epoch: Optional[Callable[[], int]] = None,
+    def __init__(self, checkpoint=None,
+                 get_epoch: Optional[Callable[[], int]] = None,
                  exit_code: int = PREEMPTION_EXIT_CODE,
-                 _exit: Callable[[int], None] = os._exit):
+                 _exit: Callable[[int], None] = os._exit,
+                 on_preempt: Optional[Callable[[], None]] = None):
         self.checkpoint = checkpoint
         self.get_epoch = get_epoch
+        self.on_preempt = on_preempt
         self.exit_code = int(exit_code)
         self._exit = _exit
         self._old_handler = None
@@ -73,13 +81,23 @@ class PreemptionHandler:
         from ..framework.logging import vlog
 
         _monitor.stat_add("preemptions")
+        if self.on_preempt is not None:
+            try:
+                self.on_preempt()
+            except BaseException as e:  # noqa: BLE001 — draining is best
+                # effort; a stuck drain must not block the exit the
+                # platform is about to force with SIGKILL
+                _monitor.stat_add("preemption_drain_failures")
+                vlog(0, "preemption: on_preempt hook FAILED (%s: %s) — "
+                        "continuing to exit", type(e).__name__, e)
         epoch = None
         try:
-            epoch = (self.get_epoch() if self.get_epoch is not None
-                     else getattr(self.checkpoint, "last_epoch", 0))
-            self.checkpoint.final_save(int(epoch))
-            vlog(0, "preemption: final checkpoint saved (epoch %s), "
-                    "exiting %d", epoch, self.exit_code)
+            if self.checkpoint is not None:
+                epoch = (self.get_epoch() if self.get_epoch is not None
+                         else getattr(self.checkpoint, "last_epoch", 0))
+                self.checkpoint.final_save(int(epoch))
+                vlog(0, "preemption: final checkpoint saved (epoch %s), "
+                        "exiting %d", epoch, self.exit_code)
         except BaseException as e:  # noqa: BLE001 — the save is best
             # effort; a failed final save must still exit promptly (the
             # previous committed checkpoint stays the resume point)

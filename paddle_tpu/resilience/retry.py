@@ -159,8 +159,27 @@ class RetryPolicy:
         """Run ``fn(*args, **kwargs)``, retrying transient failures.
         Raises the last error when attempts, the deadline, or the
         classifier say stop."""
-        deadline = (self._clock() + self.deadline_ms / 1e3
-                    if self.deadline_ms is not None else None)
+        return self._call(self.deadline_ms, fn, args, kwargs)
+
+    def call_deadline(self, deadline_ms: Optional[float], fn: Callable,
+                      *args, **kwargs):
+        """Like :meth:`call`, additionally bounded by a caller-supplied
+        latency budget (e.g. the tightest per-request deadline of a
+        serving batch).  The effective deadline is the tighter of
+        ``deadline_ms`` and the policy's own ``deadline_ms`` — backoff
+        sleeps never blow through either."""
+        if deadline_ms is None:
+            effective = self.deadline_ms
+        elif self.deadline_ms is None:
+            effective = float(deadline_ms)
+        else:
+            effective = min(float(deadline_ms), self.deadline_ms)
+        return self._call(effective, fn, args, kwargs)
+
+    def _call(self, deadline_ms: Optional[float], fn: Callable, args,
+              kwargs):
+        deadline = (self._clock() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
         attempt = 0
         while True:
             attempt += 1

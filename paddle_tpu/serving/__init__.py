@@ -20,12 +20,20 @@ KV-cache model paths into an online engine:
   depth, batch occupancy, p50/p99 latency and tokens/s published as
   ``("serving", <name>)`` events on ``framework.trace_events`` (consumed
   by ``analysis`` rule S601).
+* :mod:`~paddle_tpu.serving.router` / :mod:`~paddle_tpu.serving.replica`
+  — :class:`Router`: the multi-replica control plane — health-checked
+  (active probes + per-replica circuit breaker) least-outstanding/p2c
+  balancing over N engine replicas, transparent failover, optional
+  hedged requests, zero-downtime drain and rolling weight swap
+  (consumed by ``analysis`` rule S602).
 """
 from .batcher import MicroBatcher, Request
 from .bucketing import Bucket, BucketSet, as_bucket
 from .engine import InferenceEngine
 from .generation import GenerationEngine
 from .metrics import ServingMetrics
+from .replica import Replica
+from .router import Router
 
 __all__ = [
     "Bucket",
@@ -36,4 +44,6 @@ __all__ = [
     "InferenceEngine",
     "GenerationEngine",
     "ServingMetrics",
+    "Replica",
+    "Router",
 ]

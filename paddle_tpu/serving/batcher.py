@@ -261,7 +261,16 @@ class MicroBatcher:
         t_exec = time.monotonic()
         try:
             if self._retry is not None:
-                results = self._retry.call(_run_once)
+                # bound retry backoff by the batch's TIGHTEST caller
+                # deadline: a backoff sleep must never blow through a
+                # request's latency budget before the failure surfaces
+                dls = [r.deadline_t for r in live if r.deadline_t is not None]
+                if dls:
+                    remaining_ms = max((min(dls) - t_exec) * 1e3, 0.0)
+                    results = self._retry.call_deadline(remaining_ms,
+                                                        _run_once)
+                else:
+                    results = self._retry.call(_run_once)
             else:
                 results = _run_once()
         except Exception as e:  # fail the batch, keep the worker alive
@@ -295,19 +304,39 @@ class MicroBatcher:
                 profiler.record_span(f"{self.metrics.name}/execute",
                                      t_exec, execute_ms,
                                      cat="serving", args=args)
-            r.future.set_result(res)
+            if not r.future.done():  # a timed-out drain may have failed it
+                r.future.set_result(res)
         self.metrics.observe_batch(len(live), cap, depth)
         self.metrics.publish({"bucket": bucket})
 
     # -- shutdown ------------------------------------------------------------
     def close(self, drain: bool = True, timeout: Optional[float] = None):
         """Stop admissions; serve (``drain=True``) or fail (``False``)
-        everything still queued, then join the worker."""
+        everything still queued, then join the worker.  If the join times
+        out (a wedged runner), everything STILL QUEUED fails with
+        ``UnavailableError`` instead of leaking pending futures forever —
+        the in-flight batch keeps its outcome whenever the worker
+        eventually unsticks (``drain_timeout`` counts these closes)."""
         with self._cv:
             self._closing = True
             self._drain = drain
             self._cv.notify_all()
         self._worker.join(timeout)
+        if not self._worker.is_alive():
+            return
+        with self._cv:
+            stranded = [r for dq in self._pending.values() for r in dq]
+            self._pending.clear()
+            self._depth = 0
+        self.metrics.incr("drain_timeout")
+        err = UnavailableError(
+            f"{self.metrics.name}: drain timed out after {timeout}s with "
+            f"the worker still busy — failing {len(stranded)} queued "
+            f"request(s)")
+        for r in stranded:
+            if not r.future.done():
+                r.future.set_exception(err)
+        self.metrics.publish()
 
     def __enter__(self):
         return self

@@ -191,6 +191,16 @@ class InferenceEngine:
         return out
 
     # -- public API ----------------------------------------------------------
+    def synthetic_inputs(self, bucket: int = 0) -> List[np.ndarray]:
+        """Zero-filled inputs exactly matching bucket ``bucket``'s shapes
+        and the artifact's declared dtypes — the router's default health
+        probe: it exercises the real routed/padded/compiled path without
+        depending on live traffic."""
+        b = self._buckets.buckets[bucket]
+        dtypes = self._pred.input_dtypes()
+        return [np.zeros(s, dtypes[i] if i < len(dtypes) else np.float32)
+                for i, s in enumerate(b.shapes)]
+
     def submit(self, inputs: Sequence,
                deadline_ms: Optional[float] = None) -> Future:
         """Async inference: one UNBATCHED request (no leading batch dim);

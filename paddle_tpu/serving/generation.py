@@ -191,6 +191,11 @@ class GenerationEngine:
         return [np.asarray(o, np.int32) for o in out[: len(requests)]]
 
     # -- public API ----------------------------------------------------------
+    def synthetic_inputs(self) -> np.ndarray:
+        """A one-token prompt — the router's default health probe decodes
+        one token through the real prefill+decode executables."""
+        return np.zeros((1,), np.int32)
+
     def submit(self, prompt_ids, max_new_tokens: int = 32,
                deadline_ms: Optional[float] = None) -> Future:
         """Async generation; resolves to the ``[<=max_new_tokens]`` int32

@@ -17,7 +17,7 @@ from __future__ import annotations
 import collections
 import math
 import threading
-from typing import Deque, Dict, Optional
+from typing import Deque, Dict, Optional, Sequence
 
 from ..framework import trace_events
 
@@ -26,7 +26,7 @@ __all__ = ["ServingMetrics"]
 #: counter keys every snapshot carries (zero-initialized)
 _COUNTERS = ("requests", "completed", "shed", "expired", "errors",
              "bucket_misses", "fallback_runs", "compiles", "batches",
-             "tokens", "circuit_shed")
+             "tokens", "circuit_shed", "drain_timeout")
 
 
 def _quantile(sorted_vals, q: float) -> float:
@@ -45,10 +45,16 @@ def _quantile(sorted_vals, q: float) -> float:
 class ServingMetrics:
     """Thread-safe counters, gauges, and a bounded latency reservoir."""
 
-    def __init__(self, name: str = "serving#0", window: int = 512):
+    def __init__(self, name: str = "serving#0", window: int = 512,
+                 extra_counters: Sequence[str] = ()):
         self.name = name
         self._lock = threading.Lock()
-        self._counters: Dict[str, int] = {k: 0 for k in _COUNTERS}
+        # extra_counters zero-initializes caller-specific keys (the
+        # router's failover/hedge/drain family) so every snapshot carries
+        # the full schema even before the first increment — consumers
+        # (bridge gauges, analysis rules) never see a key flicker in
+        self._counters: Dict[str, int] = {
+            k: 0 for k in (*_COUNTERS, *extra_counters)}
         self._latency_ms: Deque[float] = collections.deque(maxlen=window)
         self._occupancy: Deque[float] = collections.deque(maxlen=window)
         self._queue_ms: Deque[float] = collections.deque(maxlen=window)

@@ -1,0 +1,152 @@
+"""One serving engine behind the router: state, outstanding, telemetry.
+
+A :class:`Replica` wraps an ``InferenceEngine`` / ``GenerationEngine``
+(or anything duck-typed like one) with the three things the router needs
+that an engine does not track about itself:
+
+* an **admission state** — ``HEALTHY`` (takes traffic), ``UNHEALTHY``
+  (circuit tripped; only half-open probes may touch it), ``DRAINING``
+  (no new admissions, in-flight requests finishing) and ``DRAINED``
+  (idle, safe to swap weights / restart);
+* an **outstanding-request count** — the load signal for
+  least-outstanding / power-of-two-choices balancing, and the thing a
+  drain waits on;
+* **per-replica counters** published as ``("router", "<router>[<i>]")``
+  latest-value events on ``framework.trace_events`` (the observability
+  bridge turns them into ``paddle_tpu_router_*{replica=...}`` gauges).
+
+The health DECISION lives in the router (one ``CircuitBreaker`` keyed by
+replica index); the replica just holds the state and the numbers.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..framework import trace_events
+from ..framework.errors import InvalidArgumentError
+
+__all__ = ["Replica", "HEALTHY", "UNHEALTHY", "DRAINING", "DRAINED",
+           "STATE_CODES"]
+
+HEALTHY = "healthy"
+UNHEALTHY = "unhealthy"
+DRAINING = "draining"
+DRAINED = "drained"
+
+#: numeric encoding for the ``paddle_tpu_router_state_code`` gauge
+STATE_CODES = {HEALTHY: 0, UNHEALTHY: 1, DRAINING: 2, DRAINED: 3}
+
+_COUNTERS = ("dispatched", "completed", "failed", "probes",
+             "probe_failures", "flaps", "readmissions", "hedges",
+             "failovers_in")
+
+
+class Replica:
+    """Router-side bookkeeping for one engine.
+
+    ``engine`` needs ``submit(inputs, deadline_ms=..., **kw) -> Future``;
+    the router's default probe additionally uses ``synthetic_inputs()``
+    plus ``infer``/``generate``, and drain/swap use ``swap_weights`` /
+    ``close`` when present.  All mutators are thread-safe (completion
+    callbacks arrive on engine worker threads).
+    """
+
+    def __init__(self, engine, index: int, router_name: str = "router"):
+        if engine is None:
+            raise InvalidArgumentError(f"replica {index}: engine is None")
+        self.engine = engine
+        self.index = int(index)
+        self.name = f"{router_name}[{index}]"
+        self._cv = threading.Condition()
+        self._state = HEALTHY
+        self._outstanding = 0
+        self._counters = {k: 0 for k in _COUNTERS}
+
+    # -- state ---------------------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._cv:
+            return self._state
+
+    def set_state(self, new: str) -> str:
+        """Transition to ``new``; returns the previous state."""
+        if new not in STATE_CODES:
+            raise InvalidArgumentError(f"unknown replica state {new!r}")
+        with self._cv:
+            old, self._state = self._state, new
+            if new == UNHEALTHY and old != UNHEALTHY:
+                self._counters["flaps"] += 1
+            if new == HEALTHY and old in (UNHEALTHY, DRAINED):
+                self._counters["readmissions"] += 1
+            self._cv.notify_all()
+        self.publish()
+        return old
+
+    def admits(self) -> bool:
+        with self._cv:
+            return self._state == HEALTHY
+
+    # -- in-flight accounting ------------------------------------------------
+    @property
+    def outstanding(self) -> int:
+        with self._cv:
+            return self._outstanding
+
+    def begin(self, kind: str = "primary") -> None:
+        """One request dispatched to this replica (``kind`` is
+        ``primary`` / ``failover`` / ``hedge``)."""
+        with self._cv:
+            self._outstanding += 1
+            self._counters["dispatched"] += 1
+            if kind == "hedge":
+                self._counters["hedges"] += 1
+            elif kind == "failover":
+                self._counters["failovers_in"] += 1
+
+    def end(self, ok: bool) -> None:
+        with self._cv:
+            self._outstanding -= 1
+            self._counters["completed" if ok else "failed"] += 1
+            if self._outstanding <= 0:
+                self._cv.notify_all()
+
+    def wait_idle(self, timeout: Optional[float] = None) -> bool:
+        """Block until no request is outstanding (the drain barrier).
+        Returns False on timeout."""
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        with self._cv:
+            while self._outstanding > 0:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                self._cv.wait(remaining if remaining is not None else 0.1)
+            return True
+
+    def count(self, key: str, n: int = 1) -> None:
+        with self._cv:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    # -- telemetry -----------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._cv:
+            snap = dict(self._counters)
+            snap["state"] = self._state
+            snap["state_code"] = STATE_CODES[self._state]
+            snap["outstanding"] = self._outstanding
+        return snap
+
+    def publish(self) -> None:
+        """Emit the per-replica snapshot on the trace_events bus (single
+        falsy check when nothing subscribes)."""
+        if not trace_events.active():
+            return
+        trace_events.notify(("router", self.name), self.snapshot())
+
+    def __repr__(self) -> str:  # debugging aid, shows up in drain errors
+        return (f"Replica({self.name}, state={self.state}, "
+                f"outstanding={self.outstanding})")

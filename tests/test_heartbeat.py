@@ -104,6 +104,62 @@ class TestFileHeartbeat:
         assert hb.age() == float("inf")
 
 
+class TestMaybeBeat:
+    def _reset(self):
+        from paddle_tpu.distributed import heartbeat as hb
+
+        hb._last_beat = 0.0
+        hb._writer = None
+        return hb
+
+    def test_concurrent_callers_are_safe(self, tmp_path, monkeypatch):
+        # the serving router's health sweep and the training loop both
+        # call maybe_beat(); concurrent callers must neither crash nor
+        # corrupt the writer — one thread beats, the others skip
+        import threading
+
+        hb = self._reset()
+        path = str(tmp_path / "beat")
+        monkeypatch.setenv(ENV_FILE, path)
+        errors = []
+        start = threading.Barrier(8)
+
+        def hammer():
+            try:
+                start.wait(5)
+                for _ in range(200):
+                    hb.maybe_beat(min_interval=0.0)
+            except Exception as e:  # noqa: BLE001 — the assertion
+                errors.append(e)
+
+        threads = [threading.Thread(target=hammer) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert errors == []
+        assert os.path.exists(path)
+        assert hb._writer is not None and hb._writer.path == path
+        self._reset()
+
+    def test_throttles_to_min_interval(self, tmp_path, monkeypatch):
+        hb = self._reset()
+        path = str(tmp_path / "beat")
+        monkeypatch.setenv(ENV_FILE, path)
+        hb.maybe_beat(min_interval=3600.0)
+        size0 = os.stat(path).st_size
+        for _ in range(50):
+            hb.maybe_beat(min_interval=3600.0)  # all inside the interval
+        assert os.stat(path).st_size == size0
+        self._reset()
+
+    def test_noop_without_env(self, monkeypatch):
+        hb = self._reset()
+        monkeypatch.delenv(ENV_FILE, raising=False)
+        hb.maybe_beat(min_interval=0.0)  # must not raise or create files
+        assert hb._writer is None
+
+
 class TestWatchHangDetection:
     def _script(self, tmp_path, body):
         p = tmp_path / "trainer.py"

@@ -1,0 +1,782 @@
+"""paddle_tpu.serving router — the multi-replica control plane.
+
+Covers the control-plane contract: least-outstanding / p2c balancing,
+transparent failover losing zero ACCEPTED requests (under async replica
+failures, injected ``router.dispatch`` faults, and injected
+``serving.runner`` faults through real engines), deterministic hedging
+with an injectable timer and a respected budget, circuit-trip →
+half-open-probe recovery on an injectable clock, zero-downtime drain and
+rolling weight swap (no stale-weight result, no rejected traffic),
+SIGTERM drain-all, per-replica telemetry (trace_events family, analysis
+rule S602, observability gauges, profiler summary) — plus regression
+tests for the batcher's deadline-bounded retry and drain-timeout close.
+"""
+import os
+import signal
+import tempfile
+import threading
+import time
+import unittest
+from concurrent.futures import Future
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.analysis import RetraceMonitor
+from paddle_tpu.framework.errors import (
+    InvalidArgumentError,
+    TransientDeviceError,
+    UnavailableError,
+)
+from paddle_tpu.resilience import FaultPlan, FaultRule, RetryPolicy
+from paddle_tpu.resilience import retry as _retry_mod
+from paddle_tpu.serving import InferenceEngine, MicroBatcher, Router
+from paddle_tpu.serving.replica import (
+    DRAINED,
+    DRAINING,
+    HEALTHY,
+    UNHEALTHY,
+)
+
+
+class FakeEngine:
+    """Duck-typed engine: synchronous futures by default, manual
+    resolution (``manual=True``) for hedging/drain tests."""
+
+    def __init__(self, result="ok", fail_with=None, manual=False,
+                 probe_fail=False):
+        self.result = result
+        self.fail_with = fail_with   # exception INSTANCE → async failure
+        self.raise_sync = None       # exception INSTANCE → submit raises
+        self.manual = manual
+        self.probe_fail = probe_fail
+        self.pending = []            # unresolved futures (manual mode)
+        self.calls = 0
+        self.version = "v1"
+        self.closed = False
+
+    # router probe hooks
+    def synthetic_inputs(self):
+        return [np.zeros((1,), np.float32)]
+
+    def infer(self, inputs, timeout=None):
+        if self.probe_fail:
+            raise TransientDeviceError("probe failed")
+        return [self.result]
+
+    def submit(self, inputs, deadline_ms=None, **kw):
+        self.calls += 1
+        if self.raise_sync is not None:
+            raise self.raise_sync
+        f = Future()
+        if self.manual:
+            self.pending.append((f, inputs))
+            return f
+        if self.fail_with is not None:
+            f.set_exception(self.fail_with)
+        else:
+            f.set_result((self.result, self.version, inputs))
+        return f
+
+    def resolve(self, i=0):
+        f, inputs = self.pending.pop(i)
+        f.set_result((self.result, self.version, inputs))
+
+    def swap_weights(self, params_file):
+        self.version = params_file
+
+    def close(self, drain=True, timeout=None):
+        self.closed = True
+
+
+def make_router(engines, **kw):
+    kw.setdefault("probe_interval_s", None)  # no background thread
+    kw.setdefault("circuit_kw", {"failure_threshold": 1.0, "window": 2,
+                                 "cooldown_ms": 60_000,
+                                 "half_open_probes": 1})
+    return Router(engines, **kw)
+
+
+class TestRouterBalancing(unittest.TestCase):
+    def test_validation(self):
+        with self.assertRaises(InvalidArgumentError):
+            Router([])
+        with self.assertRaises(InvalidArgumentError):
+            make_router([FakeEngine()], policy="round_robin")
+        with self.assertRaises(InvalidArgumentError):
+            make_router([FakeEngine()], hedge_budget_frac=1.5)
+
+    def test_least_outstanding_prefers_idle_replica(self):
+        busy, idle = FakeEngine(manual=True), FakeEngine(manual=True)
+        r = make_router([busy, idle], policy="least")
+        try:
+            r.submit(1)              # both idle → lowest index (busy)
+            for _ in range(3):
+                r.submit(2)          # busy has 1 outstanding → idle wins
+            self.assertEqual(busy.calls, 2)  # 1 primary + 1 balanced back
+            self.assertEqual(idle.calls, 2)
+        finally:
+            for e in (busy, idle):
+                while e.pending:
+                    e.resolve()
+            r.close()
+
+    def test_p2c_spreads_load(self):
+        engines = [FakeEngine() for _ in range(4)]
+        r = make_router(engines, policy="p2c", seed=7)
+        try:
+            for i in range(80):
+                r.infer(i, timeout=5)
+            touched = sum(1 for e in engines if e.calls > 0)
+            self.assertGreaterEqual(touched, 3)  # not pinned to one replica
+        finally:
+            r.close()
+
+    def test_probe_required_for_active_probing(self):
+        class Bare:
+            def submit(self, inputs, deadline_ms=None):
+                f = Future(); f.set_result(inputs); return f
+
+        with self.assertRaises(InvalidArgumentError):
+            Router([Bare()], probe_interval_s=1.0)
+        r = Router([Bare()], probe_interval_s=None)  # passive-only is fine
+        r.close()
+
+
+class TestRouterFailover(unittest.TestCase):
+    def test_async_replica_failure_loses_zero_accepted_requests(self):
+        bad = FakeEngine(fail_with=TransientDeviceError("replica dead"))
+        engines = [bad, FakeEngine(), FakeEngine()]
+        r = make_router(engines)
+        try:
+            for i in range(20):
+                got = r.infer(i, timeout=5)
+                self.assertEqual(got[0], "ok")
+            s = r.stats()
+            self.assertEqual(s["accepted"], 20)
+            self.assertEqual(s["rejected"], 0)
+            self.assertEqual(s["completed"], 20)
+            self.assertEqual(s["errors"], 0)
+            self.assertGreater(s["failovers"], 0)
+            # the breaker tripped the dead replica out of rotation
+            self.assertEqual(r.replica(0).state, UNHEALTHY)
+            self.assertGreaterEqual(s["replica_flaps"], 1)
+        finally:
+            r.close()
+
+    def test_router_dispatch_fault_injection_zero_loss(self):
+        engines = [FakeEngine(), FakeEngine(), FakeEngine()]
+        r = make_router(engines,
+                        circuit_kw={"failure_threshold": 1.0, "window": 50,
+                                    "cooldown_ms": 60_000})
+        plan = FaultPlan([FaultRule("router.dispatch", every=2,
+                                    error="UnavailableError")])
+        try:
+            with plan:
+                for i in range(12):
+                    self.assertEqual(r.infer(i, timeout=5)[0], "ok")
+            self.assertEqual(plan.stats()["router.dispatch"]["fired"], 11)
+            s = r.stats()
+            self.assertEqual(s["completed"], 12)
+            self.assertEqual(s["errors"], 0)
+            self.assertGreater(s["dispatch_failovers"], 0)
+        finally:
+            r.close()
+
+    def test_sync_client_error_rejects_without_failover(self):
+        eng = FakeEngine()
+        eng.raise_sync = InvalidArgumentError("bad shape")
+        r = make_router([eng, FakeEngine()])
+        try:
+            with self.assertRaises(InvalidArgumentError):
+                r.submit(1)
+            s = r.stats()
+            self.assertEqual(s["rejected"], 1)
+            self.assertEqual(s["accepted"], 0)
+            self.assertEqual(s["dispatch_failovers"], 0)
+        finally:
+            r.close()
+
+    def test_all_replicas_failing_fails_future_not_worker(self):
+        err = TransientDeviceError("everything is down")
+        r = make_router([FakeEngine(fail_with=err),
+                         FakeEngine(fail_with=err)])
+        try:
+            fut = r.submit(1)
+            with self.assertRaises(TransientDeviceError):
+                fut.result(5)
+            # ACCEPTED but failed after exhausting both replicas; the
+            # router itself still serves once a replica works again
+            s = r.stats()
+            self.assertEqual(s["accepted"], 1)
+            self.assertEqual(s["errors"], 1)
+        finally:
+            r.close()
+
+    def test_no_healthy_replica_sheds_at_submit(self):
+        r = make_router([FakeEngine()])
+        try:
+            r.drain(0, timeout=1)
+            with self.assertRaises(UnavailableError):
+                r.submit(1)
+            self.assertEqual(r.stats()["rejected"], 1)
+        finally:
+            r.close()
+
+
+class ManualTimer:
+    """Recorded in a list instead of running; the test fires it."""
+
+    fired = None  # set per-test
+
+    def __init__(self, delay_s, fn):
+        self.delay_s = delay_s
+        self.fn = fn
+        self.cancelled = False
+        self.daemon = True
+
+    def start(self):
+        ManualTimer.fired.append(self)
+
+    def cancel(self):
+        self.cancelled = True
+
+
+class TestRouterHedging(unittest.TestCase):
+    def setUp(self):
+        ManualTimer.fired = []
+
+    def _hedged_router(self, engines, **kw):
+        kw.setdefault("hedge_delay_ms", 1000.0)
+        return make_router(engines, hedge=True, timer_factory=ManualTimer,
+                           **kw)
+
+    def test_hedge_first_result_wins_and_budget_respected(self):
+        slow, fast = FakeEngine(manual=True), FakeEngine(manual=True)
+        fast.result = "hedged"
+        r = self._hedged_router([slow, fast], policy="least",
+                                hedge_budget_frac=0.01)
+        try:
+            fut = r.submit(1)
+            self.assertEqual(len(ManualTimer.fired), 1)
+            ManualTimer.fired[0].fn()          # hedge delay elapses
+            self.assertEqual(fast.calls, 1)    # hedge went to the other one
+            fast.resolve()                     # hedge finishes first
+            self.assertEqual(fut.result(5)[0], "hedged")
+            slow.resolve()                     # straggler result discarded
+            s = r.stats()
+            self.assertEqual(s["hedges"], 1)
+            self.assertEqual(s["hedge_wins"], 1)
+
+            # budget: 2 requests at frac 0.01 → max(1, 0.02) = 1 hedge
+            fut2 = r.submit(2)
+            ManualTimer.fired[1].fn()
+            self.assertEqual(r.stats()["hedge_denied"], 1)
+            slow.resolve() if slow.pending else fast.resolve()
+            fut2.result(5)
+        finally:
+            r.close(drain=False)
+
+    def test_completion_cancels_pending_hedge_timer(self):
+        eng = FakeEngine(manual=True)
+        r = self._hedged_router([eng, FakeEngine(manual=True)],
+                                policy="least")
+        try:
+            fut = r.submit(1)
+            self.assertEqual(len(ManualTimer.fired), 1)
+            eng.resolve()  # primary completes before the hedge delay
+            self.assertEqual(fut.result(5)[0], "ok")
+            self.assertTrue(ManualTimer.fired[0].cancelled)
+            ManualTimer.fired[0].fn()  # late fire: no-op, future is done
+            self.assertEqual(r.stats()["hedges"], 0)
+
+            # a request completing synchronously never schedules a timer
+            sync = FakeEngine()
+            self.assertEqual(r.replicas[1].engine.pending, [])
+            r2 = self._hedged_router([sync, FakeEngine()])
+            r2.infer(2, timeout=5)
+            r2.close()
+            self.assertEqual(len(ManualTimer.fired), 1)
+        finally:
+            r.close(drain=False)
+
+    def test_hedge_failure_never_fails_the_primary(self):
+        primary = FakeEngine(manual=True)
+        hedge = FakeEngine(fail_with=TransientDeviceError("hedge died"))
+        r = self._hedged_router([primary, hedge], policy="least")
+        try:
+            fut = r.submit(1)
+            ManualTimer.fired[0].fn()   # hedge dispatch fails instantly
+            self.assertFalse(fut.done())  # primary still owns the flight
+            primary.resolve()
+            self.assertEqual(fut.result(5)[0], "ok")
+            self.assertEqual(r.stats()["errors"], 0)
+        finally:
+            r.close()
+
+    def test_no_delay_signal_means_no_hedge(self):
+        # p99-derived delay with zero traffic history → nothing scheduled
+        a, b = FakeEngine(manual=True), FakeEngine(manual=True)
+        r = make_router([a, b], hedge=True, hedge_delay_ms=None,
+                        policy="least", timer_factory=ManualTimer)
+        try:
+            fut = r.submit(1)
+            self.assertEqual(ManualTimer.fired, [])
+            a.resolve()
+            fut.result(5)
+        finally:
+            r.close()
+
+
+class TestRouterHealth(unittest.TestCase):
+    def test_circuit_trip_then_half_open_probe_readmission(self):
+        now = [0.0]
+        clock = lambda: now[0]  # noqa: E731
+        eng = FakeEngine(fail_with=TransientDeviceError("flaky"))
+        r = make_router([eng, FakeEngine()],
+                        circuit_kw={"failure_threshold": 1.0, "window": 2,
+                                    "cooldown_ms": 5000.0,
+                                    "half_open_probes": 1, "clock": clock})
+        try:
+            for i in range(4):
+                r.infer(i, timeout=5)
+            self.assertEqual(r.replica(0).state, UNHEALTHY)
+            self.assertEqual(r.healthy_count(), 1)
+
+            r.probe_now()  # cooldown not elapsed → no probe admitted
+            self.assertEqual(r.replica(0).state, UNHEALTHY)
+
+            eng.fail_with = None      # replica recovers...
+            now[0] = 6.0              # ...and the cooldown elapses
+            r.probe_now()             # half-open probe succeeds
+            self.assertEqual(r.replica(0).state, HEALTHY)
+            self.assertGreaterEqual(r.stats()["readmissions"], 1)
+            rep = r.replica(0).snapshot()
+            self.assertGreaterEqual(rep["probes"], 1)
+            self.assertGreaterEqual(rep["readmissions"], 1)
+        finally:
+            r.close()
+
+    def test_failed_half_open_probe_keeps_replica_out(self):
+        now = [0.0]
+        eng = FakeEngine(fail_with=TransientDeviceError("down"),
+                         probe_fail=True)
+        r = make_router([eng, FakeEngine()],
+                        circuit_kw={"failure_threshold": 1.0, "window": 2,
+                                    "cooldown_ms": 1000.0,
+                                    "clock": lambda: now[0]})
+        try:
+            for i in range(4):
+                r.infer(i, timeout=5)
+            self.assertEqual(r.replica(0).state, UNHEALTHY)
+            now[0] = 2.0
+            r.probe_now()  # probe fails → circuit re-opens
+            self.assertEqual(r.replica(0).state, UNHEALTHY)
+            self.assertGreaterEqual(r.stats()["probe_failures"], 1)
+        finally:
+            r.close()
+
+    def test_probe_failures_trip_an_idle_replica(self):
+        eng = FakeEngine(probe_fail=True)
+        r = make_router([eng, FakeEngine()])
+        try:
+            r.probe_now()
+            r.probe_now()  # window=2 fills with probe failures → trip
+            self.assertEqual(r.replica(0).state, UNHEALTHY)
+            self.assertEqual(r.replica(1).state, HEALTHY)
+        finally:
+            r.close()
+
+    def test_background_health_thread_probes(self):
+        eng = FakeEngine()
+        r = make_router([eng], probe_interval_s=0.02)
+        try:
+            deadline = time.monotonic() + 5
+            while (r.stats()["probes"] == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            self.assertGreater(r.stats()["probes"], 0)
+        finally:
+            r.close()
+
+    def test_background_sweep_never_overlaps_warmup(self):
+        # regression: the health thread starts at construction, so a probe
+        # could compile through a replica's batcher while warmup() traces
+        # over the (possibly shared) model — a JAX tracer leak.  The probe
+        # gate must hold sweeps out for the whole warmup pass.
+        in_warmup = threading.Event()
+        overlaps = []
+
+        class SlowWarmup(FakeEngine):
+            def warmup(self):
+                in_warmup.set()
+                time.sleep(0.05)
+                in_warmup.clear()
+                return 1
+
+        def probe(engine):
+            if in_warmup.is_set():
+                overlaps.append(engine)
+
+        r = make_router([SlowWarmup(), SlowWarmup()],
+                        probe_interval_s=0.005, probe_fn=probe)
+        try:
+            deadline = time.monotonic() + 5
+            while (r.stats()["probes"] == 0      # sweeps demonstrably live
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            self.assertEqual(r.warmup(), 2)
+            self.assertEqual(overlaps, [])
+        finally:
+            r.close()
+
+
+class TestRouterDrainAndSwap(unittest.TestCase):
+    def test_drain_stops_admissions_then_admit_restores(self):
+        a, b = FakeEngine(), FakeEngine()
+        r = make_router([a, b])
+        try:
+            self.assertTrue(r.drain(0, timeout=1))
+            self.assertEqual(r.replica(0).state, DRAINED)
+            before = a.calls
+            for i in range(5):
+                r.infer(i, timeout=5)
+            self.assertEqual(a.calls, before)  # all traffic went to b
+            self.assertTrue(r.admit(0))
+            self.assertEqual(r.replica(0).state, HEALTHY)
+        finally:
+            r.close()
+
+    def test_drain_waits_for_in_flight_requests(self):
+        eng = FakeEngine(manual=True)
+        r = make_router([eng])
+        try:
+            fut = r.submit(1)
+            done = []
+            t = threading.Thread(
+                target=lambda: done.append(r.drain(0, timeout=5)))
+            t.start()
+            time.sleep(0.05)
+            self.assertEqual(r.replica(0).state, DRAINING)
+            eng.resolve()               # in-flight request finishes
+            t.join(5)
+            self.assertEqual(done, [True])
+            self.assertEqual(fut.result(1)[0], "ok")
+        finally:
+            r.close()
+
+    def test_rolling_swap_no_downtime_no_stale_results(self):
+        engines = [FakeEngine() for _ in range(3)]
+        r = make_router(engines)
+        stop = threading.Event()
+        failures = []
+
+        def traffic():
+            i = 0
+            while not stop.is_set():
+                try:
+                    r.infer(i, timeout=5)
+                except Exception as e:  # noqa: BLE001 — the assertion
+                    failures.append(e)
+                i += 1
+
+        t = threading.Thread(target=traffic)
+        t.start()
+        try:
+            time.sleep(0.05)
+            swapped = r.swap_weights_rolling("v2", drain_timeout=5)
+            self.assertEqual(swapped, 3)
+        finally:
+            stop.set()
+            t.join(5)
+        try:
+            # zero rejected/failed requests during the roll
+            self.assertEqual(failures, [])
+            self.assertEqual(r.stats()["rejected"], 0)
+            # every replica serves the new weights; no stale result ever
+            for i in range(9):
+                self.assertEqual(r.infer(i, timeout=5)[1], "v2")
+            self.assertEqual(r.stats()["weight_swaps"], 3)
+            self.assertEqual(r.healthy_count(), 3)
+        finally:
+            r.close()
+
+    def test_swap_drain_timeout_aborts_and_keeps_replica_serving(self):
+        stuck = FakeEngine(manual=True)
+        r = make_router([stuck, FakeEngine()])
+        try:
+            r.submit(1)  # wedged in-flight request on replica 0
+            with self.assertRaises(UnavailableError):
+                r.swap_weights_rolling("v2", drain_timeout=0.05)
+            self.assertEqual(r.replica(0).state, HEALTHY)  # not a hole
+            self.assertEqual(stuck.version, "v1")  # swap never ran
+        finally:
+            stuck.resolve()
+            r.close()
+
+    def test_custom_swap_fn_for_generation_style_engines(self):
+        class Reloadable(FakeEngine):
+            def swap_weights(self, params_file):
+                raise AssertionError("swap_fn must be used instead")
+
+            def reload(self):
+                self.version = "reloaded"
+
+        engs = [Reloadable(), Reloadable()]
+        r = make_router(engs)
+        try:
+            r.swap_weights_rolling(swap_fn=lambda e: e.reload())
+            self.assertEqual([e.version for e in engs],
+                             ["reloaded", "reloaded"])
+            with self.assertRaises(InvalidArgumentError):
+                r.swap_weights_rolling()  # neither params_file nor swap_fn
+        finally:
+            r.close()
+
+    def test_sigterm_drains_all_replicas_then_exits_clean(self):
+        from paddle_tpu.resilience.preemption import PREEMPTION_EXIT_CODE
+
+        r = make_router([FakeEngine(), FakeEngine()])
+        exits = []
+        handler = r.install_sigterm_drain(timeout=5)
+        handler._exit = exits.append
+        try:
+            handler._on_sigterm(signal.SIGTERM, None)
+            self.assertEqual(exits, [PREEMPTION_EXIT_CODE])
+            self.assertTrue(all(rep.state == DRAINED
+                                for rep in r.replicas))
+        finally:
+            handler.uninstall()
+            r.close(drain=False)
+
+    def test_close_closes_owned_engines(self):
+        engines = [FakeEngine(), FakeEngine()]
+        r = make_router(engines)
+        r.close()
+        self.assertTrue(all(e.closed for e in engines))
+        with self.assertRaises(UnavailableError):
+            r.submit(1)
+
+
+def _export_tiny(tmpdir, name, seed=0):
+    class _TinyNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            pt.seed(seed)
+            self.fc = nn.Linear(8, 4)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    prefix = os.path.join(tmpdir, name)
+    pt.inference.save_inference_model(
+        prefix, _TinyNet(),
+        [pt.static.InputSpec([None, None, 8], "float32")])
+    return prefix
+
+
+class TestRouterRealEngines(unittest.TestCase):
+    """End-to-end over real InferenceEngine replicas with faults injected
+    at the ``serving.runner`` seam (the chaos-smoke scenario in-process)."""
+
+    def test_runner_faults_lose_zero_accepted_requests(self):
+        from paddle_tpu.serving import Bucket
+
+        with tempfile.TemporaryDirectory() as td:
+            prefix = _export_tiny(td, "m")
+            engines = [
+                InferenceEngine(prefix, [Bucket(((4, 8),))],
+                                max_queue_delay_ms=0.0,
+                                retry_transient=False,
+                                circuit_breaker=False,
+                                name=f"router-test-eng{i}")
+                for i in range(3)]
+            r = make_router(
+                engines,
+                circuit_kw={"failure_threshold": 1.0, "window": 50,
+                            "cooldown_ms": 60_000})
+            x = np.ones((2, 8), np.float32)
+            try:
+                want = r.infer([x], timeout=30)[0]  # warm + reference
+                plan = FaultPlan([FaultRule("serving.runner", every=3,
+                                            times=4)])
+                with plan:
+                    for _ in range(12):
+                        got = r.infer([x], timeout=30)[0]
+                        np.testing.assert_allclose(got, want, rtol=1e-5)
+                self.assertEqual(plan.stats()["serving.runner"]["fired"], 4)
+                s = r.stats()
+                self.assertEqual(s["errors"], 0)
+                self.assertEqual(s["rejected"], 0)
+                self.assertGreaterEqual(s["failovers"], 1)
+            finally:
+                r.close()
+
+
+class TestRouterTelemetry(unittest.TestCase):
+    def test_replica_events_feed_router_family_not_signature_dedup(self):
+        with RetraceMonitor(budget=3) as mon:
+            bad = FakeEngine(fail_with=TransientDeviceError("dead"))
+            r = make_router([bad, FakeEngine()], name="telemetry-router")
+            try:
+                for i in range(8):
+                    r.infer(i, timeout=5)
+                stats = mon.router_stats()
+                self.assertIn("telemetry-router[0]", stats)
+                self.assertEqual(stats["telemetry-router[0]"]["state"],
+                                 UNHEALTHY)
+                self.assertIn("state_code", stats["telemetry-router[0]"])
+                # router counters ride the ("serving", name) family
+                snap = mon.serving_stats("telemetry-router")
+                self.assertEqual(snap.get("router"), 1)
+                # replica snapshots never leak into R401/R402 dedup
+                self.assertEqual([d for d in mon.diagnostics()
+                                  if d.rule in ("R401", "R402")], [])
+            finally:
+                r.close()
+
+    def test_s602_fires_on_replica_flapping_after_warmup(self):
+        was_warm = _retry_mod._warm
+        _retry_mod.mark_warm()
+        try:
+            with RetraceMonitor() as mon:
+                eng = FakeEngine()
+                r = make_router(
+                    [eng, FakeEngine()], name="flappy",
+                    circuit_kw={"failure_threshold": 1.0, "window": 1,
+                                "cooldown_ms": 60_000})
+                try:
+                    for i in range(3):  # trip → re-admit → trip …
+                        eng.fail_with = TransientDeviceError("flap")
+                        r.infer(i, timeout=5)
+                        self.assertEqual(r.replica(0).state, UNHEALTHY)
+                        eng.fail_with = None
+                        self.assertTrue(r.admit(0))
+                    rules = [d.rule for d in mon.diagnostics()]
+                    self.assertIn("S602", rules)
+                finally:
+                    r.close()
+        finally:
+            _retry_mod._warm = was_warm
+
+    def test_s602_fires_on_hedge_storm(self):
+        was_warm = _retry_mod._warm
+        _retry_mod.mark_warm()
+        ManualTimer.fired = []
+        try:
+            with RetraceMonitor(budget=2) as mon:
+                r = make_router([FakeEngine(manual=True),
+                                 FakeEngine(manual=True)],
+                                name="stormy", hedge=True,
+                                hedge_delay_ms=1000.0,
+                                hedge_budget_frac=0.01,
+                                timer_factory=ManualTimer)
+                try:
+                    futs = [r.submit(i) for i in range(5)]
+                    for t in list(ManualTimer.fired):
+                        t.fn()  # 1 hedge allowed, 4 denied (> budget 2)
+                    self.assertGreater(r.stats()["hedge_denied_after_warm"],
+                                       2)
+                    self.assertIn("S602",
+                                  [d.rule for d in mon.diagnostics()])
+                finally:
+                    for rep in r.replicas:
+                        while rep.engine.pending:
+                            rep.engine.resolve()
+                    for f in futs:
+                        f.result(5)
+                    r.close()
+        finally:
+            _retry_mod._warm = was_warm
+
+    def test_observability_bridge_exports_replica_gauges(self):
+        from paddle_tpu.observability import (
+            MetricRegistry,
+            install_bridge,
+            uninstall_bridge,
+        )
+        from paddle_tpu.observability.exporters import render_prometheus
+
+        uninstall_bridge()
+        reg = MetricRegistry()
+        install_bridge(reg)
+        try:
+            r = make_router([FakeEngine()], name="obs-router")
+            try:
+                r.infer(1, timeout=5)
+                r.probe_now()
+            finally:
+                r.close()
+            text = render_prometheus(reg)
+            self.assertIn("paddle_tpu_router_state_code", text)
+            self.assertIn('replica="obs-router[0]"', text)
+            self.assertIn("paddle_tpu_serving_failovers", text)
+        finally:
+            uninstall_bridge()
+
+    def test_profiler_summary_has_router_section(self):
+        r = make_router([FakeEngine(), FakeEngine()], name="summary-router")
+        try:
+            r.infer(1, timeout=5)
+            text = pt.profiler.summary()
+            self.assertIn("Serving router", text)
+            self.assertIn("summary-router", text)
+        finally:
+            r.close()
+
+
+class TestBatcherRegressions(unittest.TestCase):
+    """The two batcher fixes shipped with the router."""
+
+    def test_retry_backoff_bounded_by_request_deadline(self):
+        # a persistently failing runner + a generous retry policy must
+        # surface the failure within the REQUEST's deadline, not after
+        # the policy's full backoff schedule
+        policy = RetryPolicy(max_attempts=100, backoff_ms=100.0,
+                             jitter=0.0, name="router-test-deadline")
+        mb = MicroBatcher(
+            lambda ins: 0,
+            lambda bucket, reqs: (_ for _ in ()).throw(
+                TransientDeviceError("always down")),
+            max_batch_size=1, max_queue_delay_ms=0.0, retry=policy,
+            name="deadline-batcher")
+        try:
+            t0 = time.monotonic()
+            fut = mb.submit((1,), deadline_ms=250.0)
+            with self.assertRaises(TransientDeviceError):
+                fut.result(10)
+            self.assertLess(time.monotonic() - t0, 5.0)
+            stats = _retry_mod.stats("router-test-deadline")
+            self.assertGreaterEqual(stats["deadline_giveups"], 1)
+            self.assertLess(stats["attempts"], 20)
+        finally:
+            mb.close(drain=False, timeout=1)
+
+    def test_close_drain_timeout_fails_queued_not_in_flight(self):
+        release = threading.Event()
+
+        def wedged_runner(bucket, reqs):
+            release.wait(30)
+            return [("served", bucket)] * len(reqs)
+
+        mb = MicroBatcher(lambda ins: ins[0], wedged_runner,
+                          max_batch_size=1, max_queue_delay_ms=0.0,
+                          name="wedged-batcher")
+        in_flight = mb.submit((0,))
+        time.sleep(0.1)          # let the worker pick it up and wedge
+        queued = mb.submit((1,))  # different bucket: stays queued
+        t0 = time.monotonic()
+        mb.close(drain=True, timeout=0.3)
+        self.assertLess(time.monotonic() - t0, 5.0)  # close returned
+        # the QUEUED request fails instead of leaking a pending future
+        with self.assertRaises(UnavailableError):
+            queued.result(1)
+        self.assertEqual(mb.metrics.snapshot()["drain_timeout"], 1)
+        # the in-flight batch keeps its outcome when the worker unsticks
+        release.set()
+        self.assertEqual(in_flight.result(10), ("served", 0))
+
+
+if __name__ == "__main__":
+    unittest.main()

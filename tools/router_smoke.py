@@ -1,0 +1,175 @@
+"""Router gate: replica kill mid-traffic, recovery, rolling swap (CPU).
+
+One-command proof of the serving control plane's three contracts, cheap
+enough for every gate run:
+
+1. **Failover** — hard-fail 1 of 3 replicas while traffic is flowing;
+   every ACCEPTED request must still complete with the right answer
+   (zero lost), the dead replica's circuit must trip it out of rotation.
+2. **Recovery** — after the cooldown, a half-open synthetic probe must
+   re-admit the (now healthy) replica.
+3. **Rolling weight swap** — ``swap_weights_rolling`` under live traffic
+   must reject zero requests, serve the NEW weights on every replica
+   afterwards, and compile nothing (the compile set stays closed).
+
+Prints one JSON line; exit 0 iff all three gates hold.
+"""
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.framework.errors import TransientDeviceError  # noqa: E402
+from paddle_tpu.serving import Bucket, InferenceEngine, Router  # noqa: E402
+
+
+class _Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 4)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+def _export(tmp, name, seed):
+    pt.seed(seed)
+    net = _Net()
+    prefix = os.path.join(tmp, name)
+    pt.inference.save_inference_model(
+        prefix, net, [pt.static.InputSpec([None, None, 8], "float32")])
+    return prefix, net
+
+
+class _Traffic:
+    """Background request stream; records every accepted request's fate."""
+
+    def __init__(self, router, x):
+        self.router = router
+        self.x = x
+        self.results = []
+        self.failures = []
+        self.rejected = 0
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                fut = self.router.submit([self.x])
+            except Exception:  # noqa: BLE001 — admission refusal
+                self.rejected += 1
+                continue
+            try:
+                self.results.append(fut.result(60)[0])
+            except Exception as e:  # noqa: BLE001 — an ACCEPTED loss
+                self.failures.append(repr(e))
+
+    def __enter__(self):
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._t.join(60)
+
+
+def main():
+    t0 = time.time()
+    COOLDOWN_MS = 1000.0
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix1, net1 = _export(tmp, "v1", seed=7)
+        prefix2, net2 = _export(tmp, "v2", seed=23)
+        x = np.random.RandomState(0).randn(3, 8).astype("float32")
+        want1 = np.asarray(net1(x[None]))[0]
+        want2 = np.asarray(net2(x[None]))[0]
+
+        engines = [InferenceEngine(prefix1, [Bucket(((4, 8),))],
+                                   max_queue_delay_ms=0.0,
+                                   retry_transient=False,
+                                   circuit_breaker=False,
+                                   name=f"smoke-eng{i}")
+                   for i in range(3)]
+        router = Router(engines, name="smoke-router",
+                        probe_interval_s=None,  # probes driven explicitly
+                        circuit_kw={"failure_threshold": 1.0, "window": 2,
+                                    "cooldown_ms": COOLDOWN_MS,
+                                    "half_open_probes": 1})
+        compiles_warm = router.warmup()
+
+        # -- gate 1: hard-fail replica 0 mid-traffic --------------------------
+        real_runner = engines[0]._batcher._runner
+
+        def dead_runner(bucket, reqs):
+            raise TransientDeviceError("smoke: replica 0 hard-failed")
+
+        with _Traffic(router, x) as traffic:
+            time.sleep(0.2)                        # healthy baseline
+            engines[0]._batcher._runner = dead_runner
+            while router.replica(0).state == "healthy":  # trip under load
+                time.sleep(0.01)
+            time.sleep(0.2)                        # keep serving degraded
+        exact = all(np.allclose(r, want1, atol=1e-5) for r in traffic.results)
+        s = router.stats()
+        g1 = {"accepted_failed": len(traffic.failures),
+              "rejected": traffic.rejected,
+              "completed": len(traffic.results),
+              "exact": bool(exact),
+              "failovers": s["failovers"],
+              "replica0_state": router.replica(0).state}
+        gate1 = (not traffic.failures and traffic.rejected == 0 and exact
+                 and s["failovers"] >= 1
+                 and g1["replica0_state"] == "unhealthy")
+
+        # -- gate 2: recovery after cooldown via half-open probe --------------
+        engines[0]._batcher._runner = real_runner   # the replica heals
+        router.probe_now()                          # cooldown NOT elapsed
+        still_out = router.replica(0).state == "unhealthy"
+        time.sleep(COOLDOWN_MS / 1e3 + 0.2)
+        router.probe_now()                          # half-open probe passes
+        g2 = {"held_through_cooldown": bool(still_out),
+              "replica0_state": router.replica(0).state,
+              "healthy": router.healthy_count(),
+              "readmissions": router.stats()["readmissions"]}
+        gate2 = (still_out and g2["replica0_state"] == "healthy"
+                 and g2["healthy"] == 3)
+
+        # -- gate 3: rolling weight swap under traffic, zero recompiles -------
+        with _Traffic(router, x) as traffic2:
+            time.sleep(0.1)
+            swapped = router.swap_weights_rolling(prefix2 + ".pdiparams",
+                                                  drain_timeout=30)
+            time.sleep(0.1)
+        fresh = [np.allclose(router.infer([x], timeout=60)[0], want2,
+                             atol=1e-5) for _ in range(6)]
+        compiles_after = sum(e.compile_count for e in engines)
+        g3 = {"swapped": swapped,
+              "accepted_failed": len(traffic2.failures),
+              "rejected": traffic2.rejected,
+              "completed": len(traffic2.results),
+              "fresh_weights": bool(all(fresh)),
+              "compiles_warm": compiles_warm,
+              "compiles_after": compiles_after}
+        gate3 = (swapped == 3 and not traffic2.failures
+                 and traffic2.rejected == 0 and all(fresh)
+                 and compiles_after == compiles_warm)
+        router.close()
+
+    passed = gate1 and gate2 and gate3
+    print(json.dumps({"pass": bool(passed),
+                      "failover": g1, "recovery": g2, "rolling_swap": g3,
+                      "seconds": round(time.time() - t0, 1)}))
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,9 +14,10 @@
 #   kernel-smoke tools/kernel_smoke.py (autotuner search + warm-restart cache hit)
 #   chaos-smoke tools/chaos_smoke.py (SIGKILL-resume bit identity + circuit recovery)
 #   obs-smoke tools/obs_smoke.py   (metrics scrape + JSONL sink + serving spans)
+#   router-smoke tools/router_smoke.py (replica kill -> zero-loss failover + rolling swap)
 #   bench   python bench.py          (only when a real TPU answers)
 #
-# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|chaos-smoke|obs-smoke|bench]...
+# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|chaos-smoke|obs-smoke|router-smoke|bench]...
 #         tools/run_gates.sh --only suite
 # Exit code: 0 iff every stage that ran passed.
 set -u
@@ -105,6 +106,10 @@ run_stage chaos-smoke env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 # observability: live Prometheus scrape with advancing step counters,
 # JSONL snapshot sink, and serving spans in the chrome trace
 run_stage obs-smoke env JAX_PLATFORMS=cpu python tools/obs_smoke.py
+# serving control plane: 1-of-3 replicas hard-failed mid-traffic -> every
+# accepted request completes via failover, half-open re-admission after the
+# cooldown, rolling swap_weights under load (zero rejects, zero recompiles)
+run_stage router-smoke env JAX_PLATFORMS=cpu python tools/router_smoke.py
 
 # bench only when a real accelerator answers within 60s
 if want bench; then
