@@ -433,6 +433,62 @@ def bench_flash_32k():
                  mfu=round(tflops / TPU_PEAK_TFLOPS, 3))
 
 
+def bench_gpt_generate():
+    """Serving headline: slot-level continuous-batching decode throughput
+    over a fixed-seed sweep of mixed prompt/output lengths.  vs_baseline
+    is the legacy run-batch-to-completion scheduler on the IDENTICAL
+    workload (same model, same requests, same submission order) — >1
+    means continuous batching is faster end-to-end."""
+    import time as _time
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import GenerationEngine
+
+    paddle.seed(1234)
+    cfg = GPTConfig(vocab_size=8192, hidden_size=256, num_layers=4,
+                    num_heads=8, max_position=512, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(17)
+    # ragged on both axes: prompts 4..48 tokens, outputs 4..64 tokens —
+    # the spread the legacy scheduler pays head-of-line blocking on
+    reqs = [(rng.randint(1, 8192, size=int(L)).astype(np.int32), int(n))
+            for L, n in zip(rng.randint(4, 49, size=48),
+                            rng.randint(4, 65, size=48))]
+    total_new = sum(n for _, n in reqs)
+
+    def run(continuous):
+        with GenerationEngine(
+                model, prompt_buckets=[16, 48], batch_size=8,
+                max_queue_delay_ms=1.0, continuous=continuous,
+                name=f"bench-gen-{'cont' if continuous else 'legacy'}"
+        ) as eng:
+            eng.warmup()
+            lat = []
+            t0 = _time.perf_counter()
+            futs = []
+            for p, n in reqs:
+                ts = _time.perf_counter()
+                f = eng.submit(p, n)
+                f.add_done_callback(
+                    lambda _, ts=ts: lat.append(_time.perf_counter() - ts))
+                futs.append(f)
+            toks = sum(len(f.result(600)) for f in futs)
+            assert toks == total_new
+            return toks / (_time.perf_counter() - t0), np.mean(lat) * 1e3
+
+    legacy_tps, legacy_lat = run(False)
+    tps, lat_ms = run(True)
+    return _emit("gpt_generate_tokens_per_sec", round(tps, 1), "tok/s",
+                 tps / legacy_tps,
+                 legacy_tokens_per_sec=round(legacy_tps, 1),
+                 mean_latency_ms=round(float(lat_ms), 1),
+                 legacy_mean_latency_ms=round(float(legacy_lat), 1),
+                 requests=len(reqs), new_tokens=total_new,
+                 method="continuous_batching_vs_legacy")
+
+
 def main():
     budget_s = float(_os.environ.get("PADDLE_TPU_BENCH_BUDGET_S", "600"))
     allow_cpu = _os.environ.get(
@@ -447,7 +503,8 @@ def main():
     results, failed = {}, []
     for name, fn in [("bert", bench_bert), ("resnet50", bench_resnet50),
                      ("mnist", bench_mnist), ("ctr", bench_ctr),
-                     ("flash32k", bench_flash_32k)]:
+                     ("flash32k", bench_flash_32k),
+                     ("gpt_generate", bench_gpt_generate)]:
         if backend_dead:
             # fail fast: don't let each remaining config rediscover the
             # dead backend at one full budget apiece

@@ -53,7 +53,7 @@ class RetraceMonitor:
         # executor, NOT deduped signature events (rule R403)
         self._cache_sites: Dict[str, dict] = {}
         # ("serving", name) engine snapshots: same latest-value semantics
-        # (rules S601 / S602 — router snapshots carry "router": 1)
+        # (rules S601 / S602 / S603 — router snapshots carry "router": 1)
         self._serving_sites: Dict[str, dict] = {}
         # ("router", "<router>[<i>]") per-replica snapshots: latest state /
         # outstanding / counters per replica (rule S602 context)
@@ -285,6 +285,29 @@ class RetraceMonitor:
                              "derived and fix the latency regression "
                              "moving the p99); hedges should be rare "
                              "tail-cutters, not a steady second stream")
+        for name, stats in serving_sites.items():
+            if stats.get("router"):
+                continue  # engine snapshots only
+            starved = int(stats.get("starved_steps_after_warm", 0))
+            depth = int(stats.get("queue_depth", 0))
+            if starved > self.budget and depth > 0:
+                out.add("S603",
+                        f"serving engine {name} ticked {starved} starved "
+                        f"decode steps after warmup (budget {self.budget}) "
+                        f"with {depth} request(s) still queued and "
+                        f"{stats.get('slots_free', '?')} slot(s) free — "
+                        f"admission is sustainedly deferred (typically an "
+                        f"open circuit breaker after device failures), so "
+                        f"queued requests age toward their deadlines while "
+                        f"decode capacity sits idle",
+                        location=Location(file=name, function=name),
+                        hint="check the engine's circuit breaker (repeated "
+                             "transient failures keep it open — fix the "
+                             "device fault or lower "
+                             "FLAGS_circuit_cooldown_ms) and the restart "
+                             "counters; if the queue is simply deeper than "
+                             "the slot count can drain, add batch_size "
+                             "slots or another replica")
         with self._lock:
             autotune_sites = {k: dict(v)
                               for k, v in self._autotune_sites.items()}

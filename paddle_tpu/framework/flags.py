@@ -157,6 +157,15 @@ define_flag("circuit_cooldown_ms", 1000.0,
 define_flag("circuit_half_open_probes", 1,
             "Probe batches admitted in the half-open state; all must "
             "succeed to close the circuit, any failure re-opens it.")
+define_flag("continuous_batching", True,
+            "GenerationEngine decode scheduling (serving/generation.py): "
+            "on (default), requests are admitted into and evicted from "
+            "individual decode slots at decode-step granularity against "
+            "the preallocated ring KV cache (Orca-style iteration-level "
+            "scheduling — a stalled long request holds one slot, never "
+            "the batch). Off falls back to the legacy run-batch-to-"
+            "completion path. Per-engine override: "
+            "GenerationEngine(continuous=...).")
 define_flag("metrics_port", 0,
             "Prometheus text-exposition endpoint for the observability "
             "registry (observability/exporters.py): 0 disables (default), "

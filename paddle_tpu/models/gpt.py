@@ -330,6 +330,36 @@ class GPTModel(Layer):
             ],
         }
 
+    def reset_slots(self, cache, slot_mask):
+        """Evict batch slots from a live cache: the slot→position map rows
+        of masked slots become ``-1`` (= empty; nothing attends to them),
+        unmasked rows pass through bit-identical.  K/V payloads stay —
+        attention visibility is decided solely by ``pos``, so clearing the
+        map is the whole eviction.  ``slot_mask``: ``[B]`` bool."""
+        mask = jnp.asarray(slot_mask, bool)[:, None]  # [B,1]
+        return {"pos": jnp.where(mask, jnp.int32(-1), cache["pos"]),
+                "layers": cache["layers"]}
+
+    def write_slots(self, cache, src, slot_mask):
+        """Scatter whole cache rows of ``src`` into ``cache`` where
+        ``slot_mask`` is set — the admission op of slot-level continuous
+        batching: a prompt is prefilled into a FRESH cache (only its slot
+        rows populated, everything else ``-1``/zeros) and this merges those
+        rows into the live cache.  Unmasked slots pass through
+        bit-identical, so admission never perturbs other requests' KV
+        state.  ``slot_mask``: ``[B]`` bool; ``src`` has the same
+        structure/shapes as ``cache``."""
+        m1 = jnp.asarray(slot_mask, bool)
+        m4 = m1[:, None, None, None]  # broadcast over [B,H,C,hd]
+        return {
+            "pos": jnp.where(m1[:, None], src["pos"], cache["pos"]),
+            "layers": [
+                {"k": jnp.where(m4, s["k"], d["k"]),
+                 "v": jnp.where(m4, s["v"], d["v"])}
+                for s, d in zip(src["layers"], cache["layers"])
+            ],
+        }
+
     def forward_cached(self, input_ids, positions, cache):
         """Prefill/decode forward over :meth:`init_cache` state.
 

@@ -15,11 +15,17 @@ KV-cache model paths into an online engine:
   hot weight-swap from a ``.pdiparams`` side-file.
 * :mod:`~paddle_tpu.serving.generation` — :class:`GenerationEngine`:
   prefill/decode greedy generation for ``models.GPTForCausalLM`` over a
-  preallocated ring KV cache (one decode executable total).
+  preallocated ring KV cache (one decode executable total).  By default
+  (``FLAGS_continuous_batching``) it runs slot-level continuous
+  batching: a persistent decode loop admits/evicts individual requests
+  at decode-step granularity, so a stalled long request holds one slot,
+  never the batch.
 * :mod:`~paddle_tpu.serving.metrics` — :class:`ServingMetrics`: queue
-  depth, batch occupancy, p50/p99 latency and tokens/s published as
-  ``("serving", <name>)`` events on ``framework.trace_events`` (consumed
-  by ``analysis`` rule S601).
+  depth, batch occupancy, p50/p99 latency, tokens/s and the continuous
+  batching slot-scheduler family (admitted/evicted/starved counters,
+  per-step occupancy gauges) published as ``("serving", <name>)`` events
+  on ``framework.trace_events`` (consumed by ``analysis`` rules
+  S601/S603).
 * :mod:`~paddle_tpu.serving.router` / :mod:`~paddle_tpu.serving.replica`
   — :class:`Router`: the multi-replica control plane — health-checked
   (active probes + per-replica circuit breaker) least-outstanding/p2c

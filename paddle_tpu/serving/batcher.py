@@ -79,19 +79,29 @@ class MicroBatcher:
     int`` bounds the batch size per bucket (defaults to the constant
     ``max_batch_size``).  The engine layers (engine.py / generation.py)
     provide all three and own the compiled executables.
+
+    **Pull mode** (``pull=True``): no worker thread runs — the queue is a
+    slot-granular hand-off for a consumer loop that owns the device (the
+    continuous-batching decode loop).  The consumer calls :meth:`poll` to
+    take requests one-at-a-time/FCFS instead of bucket-grouped batches,
+    :meth:`sweep` to enforce deadlines while its slots are full, and
+    :meth:`consumer_done` when it exits so :meth:`close` can return.
+    Submit-side behavior (shedding, deadlines, metrics) is identical.
     """
 
     def __init__(self, router: Callable[[Sequence], int],
-                 runner: Callable[[int, List[Request]], List[Any]],
+                 runner: Optional[Callable[[int, List[Request]], List[Any]]],
                  *, max_batch_size: int = 8, max_queue_delay_ms: float = 5.0,
                  max_queue_depth: int = 256,
                  capacity: Optional[Callable[[int], int]] = None,
                  metrics: Optional[ServingMetrics] = None,
-                 breaker=None, retry=None,
+                 breaker=None, retry=None, pull: bool = False,
                  name: str = "serving#0"):
         if max_batch_size < 1 or max_queue_depth < 1:
             raise UnavailableError(
                 "max_batch_size and max_queue_depth must be >= 1")
+        if runner is None and not pull:
+            raise UnavailableError("worker mode needs a runner")
         self._router = router
         self._runner = runner
         self._max_batch = int(max_batch_size)
@@ -108,9 +118,13 @@ class MicroBatcher:
         self._depth = 0
         self._closing = False
         self._drain = True
-        self._worker = threading.Thread(
-            target=self._loop, name=f"{name}-batcher", daemon=True)
-        self._worker.start()
+        self._pull_done = threading.Event()
+        if pull:
+            self._worker = None
+        else:
+            self._worker = threading.Thread(
+                target=self._loop, name=f"{name}-batcher", daemon=True)
+            self._worker.start()
 
     # -- admission -----------------------------------------------------------
     def submit(self, inputs: Sequence, deadline_ms: Optional[float] = None,
@@ -141,6 +155,83 @@ class MicroBatcher:
     def queue_depth(self) -> int:
         with self._cv:
             return self._depth
+
+    @property
+    def closing(self) -> bool:
+        with self._cv:
+            return self._closing
+
+    @property
+    def drain_on_close(self) -> bool:
+        with self._cv:
+            return self._drain
+
+    def oldest_wait_ms(self) -> float:
+        """Age of the oldest queued request (0 when the queue is empty) —
+        the ``queue_age_ms`` gauge of the continuous decode loop."""
+        with self._cv:
+            t = None
+            for dq in self._pending.values():
+                if dq and (t is None or dq[0].enqueue_t < t):
+                    t = dq[0].enqueue_t
+        return 0.0 if t is None else (time.monotonic() - t) * 1e3
+
+    # -- pull mode (slot-granular consumer) ----------------------------------
+    def poll(self, max_n: int, wait_s: float = 0.0) -> List[Request]:
+        """Pull-mode hand-off: remove and return up to ``max_n`` queued
+        requests, oldest-first ACROSS buckets (plain FCFS, no bucket
+        grouping — the slot scheduler re-groups by prompt bucket itself),
+        after failing any whose deadline already passed.  Blocks up to
+        ``wait_s`` while the queue is empty.  On ``close(drain=False)``
+        every queued request is failed instead of returned."""
+        deadline = time.monotonic() + max(float(wait_s), 0.0)
+        while True:
+            dropped: List[Request] = []
+            with self._cv:
+                expired = self._take_expired_locked()
+                if self._closing and not self._drain:
+                    dropped = [r for dq in self._pending.values() for r in dq]
+                    self._pending.clear()
+                    self._depth = 0
+                batch: List[Request] = []
+                while len(batch) < max_n and self._depth > 0:
+                    b = self._oldest_bucket()
+                    dq = self._pending[b]
+                    batch.append(dq.popleft())
+                    if not dq:
+                        del self._pending[b]
+                    self._depth -= 1
+                if (not batch and not expired and not dropped
+                        and not self._closing):
+                    remaining = deadline - time.monotonic()
+                    if remaining > 0:
+                        # <=50ms slices so deadline sweeps stay timely even
+                        # when the consumer parks here between admissions
+                        self._cv.wait(min(remaining, 0.05))
+                        continue
+            self._fail_expired(expired)
+            if dropped:
+                err = UnavailableError(
+                    f"{self.metrics.name}: dropped at shutdown "
+                    f"(drain=False)")
+                for r in dropped:
+                    if not r.future.done():
+                        r.future.set_exception(err)
+                self.metrics.publish()
+            return batch
+
+    def sweep(self):
+        """Deadline sweep only — the pull consumer calls this each decode
+        step while its slots are full (and it therefore isn't polling),
+        so queued requests still expire on time under zero admissions."""
+        with self._cv:
+            expired = self._take_expired_locked()
+        self._fail_expired(expired)
+
+    def consumer_done(self):
+        """Pull-mode consumer signals its loop has exited (queue drained
+        or dropped) so a blocked :meth:`close` can return."""
+        self._pull_done.set()
 
     # -- worker --------------------------------------------------------------
     def _oldest_bucket(self):
@@ -316,13 +407,19 @@ class MicroBatcher:
         out (a wedged runner), everything STILL QUEUED fails with
         ``UnavailableError`` instead of leaking pending futures forever —
         the in-flight batch keeps its outcome whenever the worker
-        eventually unsticks (``drain_timeout`` counts these closes)."""
+        eventually unsticks (``drain_timeout`` counts these closes).  In
+        pull mode the wait is on the consumer's :meth:`consumer_done`
+        signal instead of a worker join."""
         with self._cv:
             self._closing = True
             self._drain = drain
             self._cv.notify_all()
-        self._worker.join(timeout)
-        if not self._worker.is_alive():
+        if self._worker is None:
+            finished = self._pull_done.wait(timeout)
+        else:
+            self._worker.join(timeout)
+            finished = not self._worker.is_alive()
+        if finished:
             return
         with self._cv:
             stranded = [r for dq in self._pending.values() for r in dq]

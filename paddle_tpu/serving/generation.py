@@ -6,15 +6,38 @@ forward, one jitted executable per prompt-length bucket) and **decode**
 preallocated ring KV cache from ``GPTModel.init_cache``).  Every decode
 step sees arrays of exactly the same shape — ``[B]`` tokens, ``[B]``
 positions, the fixed-shape cache — so the steady-state compile set is
-``len(prompt_buckets) + 1`` no matter how many tokens are generated.
+closed no matter how many tokens are generated.
 
 Prompts are right-padded to their bucket with position ``-1`` (writes
 nothing to the cache, attends to nothing), so ragged prompts batch
 together and per-sequence decode offsets stay exact.
+
+**Continuous batching** (default, ``FLAGS_continuous_batching``): a
+persistent decode loop owns the ``B``-slot batch and schedules at
+decode-step granularity — each step it harvests finished slots
+(EOS / ``max_new_tokens`` budget), evicts them
+(``GPTModel.reset_slots``), and admits queued requests FCFS by
+prefilling into a FRESH cache and scattering exactly the admitted rows
+into the live one (``GPTModel.write_slots``), so admission never
+perturbs other slots' KV state and a stalled long request holds one
+slot, never the batch.  Because every per-row computation depends only
+on its own batch row, the tokens are bit-identical to the legacy
+run-batch-to-completion path (and to uncached greedy).  The loop is
+double-buffered: device step ``N+1`` is dispatched before step ``N``'s
+tokens are pulled to host, so host bookkeeping never serializes with
+the device; per-slot generation counters discard the (at most one)
+speculative token a completed slot's in-flight step still produces.
+
+The continuous compile set is ``len(prompt_buckets) + 2`` (per-bucket
+slot-admission prefill, the shared decode step, the slot eviction op),
+all traced in :meth:`warmup` — zero post-warmup recompiles.  The legacy
+path (``continuous=False``) keeps its ``len(prompt_buckets) + 1`` set.
 """
 from __future__ import annotations
 
+import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence
 
@@ -22,12 +45,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..framework.errors import InvalidArgumentError
+from .. import profiler
+from ..framework.errors import (
+    ExecutionTimeoutError,
+    InvalidArgumentError,
+    UnavailableError,
+    is_transient,
+)
+from ..framework.flags import flag
 from ..nn.layer_base import functional_call
 from ..resilience import CircuitBreaker, RetryPolicy
 from ..resilience import retry as _retry_mod
+from ..resilience.faults import fault_point
 from .batcher import MicroBatcher, Request
-from .metrics import ServingMetrics
+from .metrics import ServingMetrics, SLOT_COUNTERS
 
 __all__ = ["GenerationEngine"]
 
@@ -39,9 +70,13 @@ class GenerationEngine:
 
     ``prompt_buckets`` — prompt lengths requests are padded up to (the
     prefill compile set); ``batch_size`` — the one decode batch width
-    (short batches run with dummy rows, occupancy is a metric, not a
-    shape); ``cache_len`` — KV ring capacity (default
+    (free slots run as inert ``-1``-position rows, occupancy is a metric,
+    not a shape); ``cache_len`` — KV ring capacity (default
     ``cfg.max_position``; generation past it slides the window).
+
+    ``continuous`` — slot-level continuous batching (None reads
+    ``FLAGS_continuous_batching``); ``False`` is the legacy
+    run-batch-to-completion scheduler.
     """
 
     def __init__(self, model, *, prompt_buckets: Sequence[int],
@@ -50,6 +85,7 @@ class GenerationEngine:
                  eos_token_id: Optional[int] = None,
                  circuit_breaker: bool = True,
                  retry_transient: bool = True,
+                 continuous: Optional[bool] = None,
                  name: Optional[str] = None):
         if name is None:
             _gen_counter[0] += 1
@@ -67,8 +103,12 @@ class GenerationEngine:
         self._batch = int(batch_size)
         self._cache_len = cache_len
         self._eos = eos_token_id
-        self._traces: Dict[str, int] = {"prefill": 0, "decode": 0}
-        self.metrics = ServingMetrics(name)
+        self._continuous = bool(flag("continuous_batching")
+                                if continuous is None else continuous)
+        self._warm = False
+        self._traces: Dict[str, int] = {"prefill": 0, "decode": 0,
+                                        "admit": 0, "evict": 0}
+        self.metrics = ServingMetrics(name, extra_counters=SLOT_COUNTERS)
 
         mdl, traces = model, self._traces
 
@@ -91,19 +131,62 @@ class GenerationEngine:
             return functional_call(mdl, params, tok, pos, cache,
                                    buffers=buffers, training=False, call=body)
 
+        def admit(params, buffers, ids, positions, lens, mask, cache, tok):
+            # slot admission: prefill into a FRESH cache (only admitted
+            # rows carry real positions; the rest are -1 = inert), then
+            # scatter exactly the admitted rows — cache AND first token —
+            # into the live state.  Unmasked rows pass through
+            # bit-identical, so admission never perturbs live KV state,
+            # and the admitted rows run the exact same per-row math as
+            # the legacy prefill (token identity).
+            def body(ids, positions, lens, mask, cache, tok):
+                traces["admit"] += 1
+                fresh = mdl.gpt.init_cache(ids.shape[0], self._cache_len)
+                logits, fresh = mdl.forward_cached(
+                    ids, positions, fresh, gather_last=lens)
+                first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (jnp.where(mask, first, tok),
+                        mdl.gpt.write_slots(cache, fresh, mask))
+            return functional_call(mdl, params, ids, positions, lens, mask,
+                                   cache, tok, buffers=buffers,
+                                   training=False, call=body)
+
+        def evict(tok, cache, mask):
+            traces["evict"] += 1
+            return (jnp.where(mask, jnp.int32(0), tok),
+                    mdl.gpt.reset_slots(cache, mask))
+
         self._prefill = jax.jit(prefill)
         self._decode = jax.jit(decode)
+        self._admit = jax.jit(admit)
+        self._evict = jax.jit(evict)
         self.breaker = (CircuitBreaker(name) if circuit_breaker else None)
-        self._batcher = MicroBatcher(
-            self._route, self._run_batch,
-            max_batch_size=batch_size,
-            max_queue_delay_ms=max_queue_delay_ms,
-            max_queue_depth=max_queue_depth,
-            metrics=self.metrics,
-            breaker=self.breaker,
-            retry=(RetryPolicy.from_flags(name=f"{name}.runner")
-                   if retry_transient else None),
-            name=name)
+        self._retry_transient = bool(retry_transient)
+        if self._continuous:
+            # pull mode: no batcher worker — the decode loop below is the
+            # consumer, taking requests slot-by-slot (FCFS across buckets)
+            self._batcher = MicroBatcher(
+                self._route, None, pull=True,
+                max_batch_size=batch_size,
+                max_queue_delay_ms=max_queue_delay_ms,
+                max_queue_depth=max_queue_depth,
+                metrics=self.metrics,
+                name=name)
+            self._thread: Optional[threading.Thread] = threading.Thread(
+                target=self._slot_loop, name=f"{name}-decode", daemon=True)
+            self._thread.start()
+        else:
+            self._thread = None
+            self._batcher = MicroBatcher(
+                self._route, self._run_batch,
+                max_batch_size=batch_size,
+                max_queue_delay_ms=max_queue_delay_ms,
+                max_queue_depth=max_queue_depth,
+                metrics=self.metrics,
+                breaker=self.breaker,
+                retry=(RetryPolicy.from_flags(name=f"{name}.runner")
+                       if retry_transient else None),
+                name=name)
 
     # -- routing -------------------------------------------------------------
     def _route(self, inputs: Sequence) -> int:
@@ -119,31 +202,331 @@ class GenerationEngine:
 
     @property
     def compile_count(self) -> int:
-        """Traced executables so far: one per warmed prompt bucket plus
-        one shared decode step."""
-        return self._traces["prefill"] + self._traces["decode"]
+        """Traced executables so far: one per warmed prompt bucket (the
+        prefill or slot-admission executable) plus the shared decode step,
+        plus — continuous mode — the slot-eviction op."""
+        return sum(self._traces.values())
 
     def warmup(self) -> int:
-        """Trace every prompt bucket and the decode step on dummy data so
-        live traffic never pays compile latency.  Returns the (closed)
-        compile count: ``len(prompt_buckets) + 1``."""
+        """Trace the full compile set on dummy data so live traffic never
+        pays compile latency.  Returns the (closed) compile count:
+        ``len(prompt_buckets) + 2`` continuous, ``+ 1`` legacy."""
         B = self._batch
-        for sb in self._buckets:
-            ids = jnp.zeros((B, sb), jnp.int32)
-            pos = jnp.broadcast_to(jnp.arange(sb, dtype=jnp.int32), (B, sb))
-            lens = jnp.full((B,), sb, jnp.int32)
-            cache = self._model.gpt.init_cache(B, self._cache_len)
-            tok, cache = self._prefill(self._params, self._buffers,
-                                       ids, pos, lens, cache)
-            self._decode(self._params, self._buffers, tok,
-                         jnp.full((B,), sb, jnp.int32), cache)
+        if self._continuous:
+            # warmup must mirror LIVE argument placement, not just shapes:
+            # tok/cache enter every live call as jit outputs (committed),
+            # everything else as host transfers.  A placement mismatch is
+            # a silent XLA recompile the trace counter can't see.
+            mask = jnp.asarray(np.ones((B,), bool))
+            tok, cache = self._init_state()  # decode, fresh-state placement
+            for sb in self._buckets:
+                ids = jnp.asarray(np.zeros((B, sb), np.int32))
+                pos = jnp.asarray(np.broadcast_to(
+                    np.arange(sb, dtype=np.int32), (B, sb)))
+                lens = jnp.asarray(np.full((B,), sb, np.int32))
+                tok, cache = self._admit(self._params, self._buffers, ids,
+                                         pos, lens, mask, cache, tok)
+            # steady-state placement of the decode step — same jaxpr as
+            # the _init_state call (one trace), second XLA executable
+            tok, cache = self._decode(
+                self._params, self._buffers, tok,
+                jnp.asarray(np.full((B,), self._buckets[-1], np.int32)),
+                cache)
+            self._evict(tok, cache, mask)
+        else:
+            for sb in self._buckets:
+                ids = jnp.zeros((B, sb), jnp.int32)
+                pos = jnp.broadcast_to(jnp.arange(sb, dtype=jnp.int32),
+                                       (B, sb))
+                lens = jnp.full((B,), sb, jnp.int32)
+                cache = self._model.gpt.init_cache(B, self._cache_len)
+                tok, cache = self._prefill(self._params, self._buffers,
+                                           ids, pos, lens, cache)
+                self._decode(self._params, self._buffers, tok,
+                             jnp.full((B,), sb, jnp.int32), cache)
         self.metrics.set_counter("compiles", self.compile_count)
         from ..ops import autotune
         autotune.mark_warm()  # later tuner searches are hot-path (K701)
         _retry_mod.mark_warm()  # later retry storms / flaps are F801
+        self._warm = True  # starvation after this point is S603 material
         return self.compile_count
 
-    # -- batch execution -----------------------------------------------------
+    # -- continuous scheduler ------------------------------------------------
+    def _init_state(self):
+        """Fresh all-slots-empty (tok, cache) for the decode loop.
+
+        The fresh state is pushed through one decode step with every row
+        at position ``-1`` (inert: writes nothing, attends to nothing).
+        That step COMPUTES every cache array — unlike ``_evict``, whose
+        untouched K/V outputs JAX forwards straight from the inputs — so
+        the returned handles carry the exact jit-output placement all the
+        steady-state executables were compiled against.  Skipping this
+        would hand XLA host-built arrays instead and silently recompile
+        placement-specialised variants of admit/decode on first use."""
+        B = self._batch
+        return self._decode(self._params, self._buffers,
+                            jnp.asarray(np.zeros((B,), np.int32)),
+                            jnp.asarray(np.full((B,), -1, np.int32)),
+                            self._model.gpt.init_cache(B, self._cache_len))
+
+    def _expire_carry(self, carry: List[tuple]) -> List[tuple]:
+        """Deadline sweep for requests held outside the batcher queue
+        (breaker-deferred admissions, restart re-admissions)."""
+        now = time.monotonic()
+        keep: List[tuple] = []
+        for r, n in carry:
+            if r.deadline_t is not None and now > r.deadline_t:
+                self.metrics.incr("expired")
+                if not r.future.done():
+                    r.future.set_exception(ExecutionTimeoutError(
+                        f"{self.name}: deadline exceeded after "
+                        f"{(now - r.enqueue_t) * 1e3:.1f}ms awaiting a "
+                        f"decode slot"))
+            else:
+                keep.append((r, n))
+        if len(keep) != len(carry):
+            self.metrics.publish()
+        return keep
+
+    def _finish(self, s: dict, now: float):
+        """Resolve one completed slot: future, latency/span/token metrics,
+        breaker success."""
+        r: Request = s["req"]
+        queue_ms = (s["t0"] - r.enqueue_t) * 1e3
+        execute_ms = (now - s["t0"]) * 1e3
+        self.metrics.incr("completed")
+        self.metrics.observe_latency_ms((now - r.enqueue_t) * 1e3)
+        self.metrics.observe_span(queue_ms, execute_ms)
+        self.metrics.observe_tokens(len(s["out"]), max(now - s["t0"], 1e-9))
+        if profiler.profiling_active():
+            args = {"span": r.span_id}
+            profiler.record_span(f"{self.name}/queue", r.enqueue_t,
+                                 queue_ms, cat="serving", args=args)
+            profiler.record_span(f"{self.name}/decode", s["t0"],
+                                 execute_ms, cat="serving", args=args)
+        if self.breaker is not None:
+            self.breaker.record_success(0)
+        if not r.future.done():
+            r.future.set_result(np.asarray(s["out"], np.int32))
+
+    def _slot_loop(self):
+        """The persistent decode loop — sole owner of the device state.
+
+        Per iteration: admit queued requests into free slots (one
+        ``_admit`` dispatch for the whole group, padded to the group's
+        largest bucket), dispatch the next decode step for live slots,
+        then harvest the OLDEST in-flight step — so one step is always in
+        flight while the host books the previous one (double buffering).
+        Free slots ride along as position ``-1`` rows: they write nothing,
+        attend to nothing, and their argmax garbage is never harvested.
+        """
+        q = self._batcher
+        B = self._batch
+        max_restarts = (max(int(flag("transient_max_retries")) - 1, 0)
+                        if self._retry_transient else 0)
+        slots: List[Optional[dict]] = [None] * B
+        gens = [0] * B                      # guards stale speculative tokens
+        pos = np.full((B,), -1, np.int32)   # next decode position (-1 = free)
+        cache = None                        # device handles: live KV state
+        tok = None                          # ... and last dispatched tokens
+        pending: deque = deque()            # in-flight steps, oldest first
+        carry: List[tuple] = []             # (Request, n_restarts) to re-admit
+        last_pub = 0.0
+        try:
+            while True:
+                try:
+                    closing = q.closing
+                    if closing and not q.drain_on_close:
+                        err = UnavailableError(
+                            f"{self.name}: dropped at shutdown "
+                            f"(drain=False)")
+                        for i in range(B):
+                            s = slots[i]
+                            if s is not None and not s["req"].future.done():
+                                s["req"].future.set_exception(err)
+                            slots[i] = None
+                        for r, _ in carry:
+                            if not r.future.done():
+                                r.future.set_exception(err)
+                        pending.clear()
+                        q.poll(B, 0.0)  # fails everything still queued
+                        return
+                    live = [i for i in range(B) if slots[i] is not None]
+                    free = [i for i in range(B) if slots[i] is None]
+                    if (closing and not live and not pending and not carry
+                            and q.queue_depth == 0):
+                        return
+
+                    # ---- admission: FCFS; open circuit DEFERS (requests
+                    # stay queued/carried under deadline sweep), never sheds
+                    take: List[tuple] = []
+                    blocked_wait = False
+                    if carry:
+                        carry = self._expire_carry(carry)
+                    if free:
+                        take = carry[:len(free)]
+                        carry = carry[len(take):]
+                        want = len(free) - len(take)
+                        if want > 0:
+                            wait = (0.05 if not live and not pending
+                                    and not take else 0.0)
+                            blocked_wait = wait > 0
+                            take += [(r, 0)
+                                     for r in q.poll(want, wait_s=wait)]
+                        if (take and self.breaker is not None
+                                and not self.breaker.allow(0)):
+                            # the breaker verdict gates ADMISSION, not the
+                            # queue pop: deferred requests wait in carry
+                            # (FCFS position kept, deadlines still swept)
+                            carry = take + carry
+                            take = []
+                            q.sweep()
+                    if take:
+                        if cache is None:
+                            tok, cache = self._init_state()
+                        Sb = self._buckets[max(r.bucket for r, _ in take)]
+                        ids = np.zeros((B, Sb), np.int32)
+                        pp = np.full((B, Sb), -1, np.int32)
+                        lens = np.ones((B,), np.int32)
+                        mask = np.zeros((B,), bool)
+                        targets = []
+                        now = time.monotonic()
+                        for (r, nre), i in zip(take, free):
+                            prompt = np.asarray(r.inputs[0],
+                                                np.int32).reshape(-1)
+                            L = len(prompt)
+                            ids[i, :L] = prompt
+                            pp[i, :L] = np.arange(L)
+                            lens[i] = L
+                            mask[i] = True
+                            gens[i] += 1
+                            pos[i] = L
+                            slots[i] = {"req": r, "budget": int(r.meta),
+                                        "out": [], "t0": now,
+                                        "restarts": nre}
+                            targets.append((i, gens[i]))
+                        fault_point("serving.decode")
+                        with profiler.RecordEvent(
+                                f"{self.name}/admit[{Sb}]"):
+                            tok, cache = self._admit(
+                                self._params, self._buffers,
+                                jnp.asarray(ids), jnp.asarray(pp),
+                                jnp.asarray(lens), jnp.asarray(mask),
+                                cache, tok)
+                        pending.append((tok, targets))
+                        self.metrics.incr("admitted", len(take))
+                        self.metrics.incr("batches")
+                        live = [i for i in range(B) if slots[i] is not None]
+                    elif (free and not closing
+                          and (carry or q.queue_depth > 0)):
+                        # free slots + waiting requests + nothing admitted:
+                        # the starvation S603 watches for
+                        self.metrics.incr("starved_steps")
+                        if self._warm:
+                            self.metrics.incr("starved_steps_after_warm")
+
+                    # ---- decode dispatch (keep <= 2 steps in flight) ----
+                    dispatched = bool(take)
+                    if live and len(pending) < 2:
+                        # snapshot: jnp.asarray may ALIAS a numpy buffer
+                        # (zero-copy on CPU) and pos is mutated in place
+                        # below, racing the async dispatch
+                        dev_pos = jnp.asarray(pos.copy())
+                        if profiler.profiling_active():
+                            with profiler.RecordEvent(
+                                    f"{self.name}/decode.step"):
+                                tok, cache = self._decode(
+                                    self._params, self._buffers, tok,
+                                    dev_pos, cache)
+                        else:
+                            tok, cache = self._decode(
+                                self._params, self._buffers, tok,
+                                dev_pos, cache)
+                        pending.append((tok, [(i, gens[i]) for i in live]))
+                        for i in live:
+                            pos[i] += 1
+                        self.metrics.incr("decode_steps")
+                        self.metrics.observe_occupancy(len(live) / B)
+                        dispatched = True
+
+                    # ---- harvest the oldest in-flight step ----
+                    if pending and (len(pending) >= 2 or not dispatched):
+                        htok, targets = pending.popleft()
+                        with profiler.RecordEvent(f"{self.name}/harvest"):
+                            host = np.asarray(htok)  # the one device sync
+                        finished = np.zeros((B,), bool)
+                        now = time.monotonic()
+                        for i, g in targets:
+                            s = slots[i]
+                            if s is None or gens[i] != g:
+                                continue  # stale speculative token: discard
+                            t = int(host[i])
+                            s["out"].append(t)
+                            if (len(s["out"]) >= s["budget"]
+                                    or (self._eos is not None
+                                        and t == self._eos)):
+                                finished[i] = True
+                                self._finish(s, now)
+                                slots[i] = None
+                                pos[i] = -1
+                        if finished.any():
+                            tok, cache = self._evict(
+                                tok, cache, jnp.asarray(finished))
+                            self.metrics.incr("evicted",
+                                              int(finished.sum()))
+                            self.metrics.publish()
+                        dispatched = True
+
+                    if not dispatched and not blocked_wait:
+                        time.sleep(0.002)  # deferred/idle: don't spin hot
+
+                    now = time.monotonic()
+                    if now - last_pub >= 0.1:
+                        last_pub = now
+                        nlive = sum(1 for s in slots if s is not None)
+                        age = q.oldest_wait_ms()
+                        if carry:  # deferred requests are the oldest wait
+                            age = max(age,
+                                      (now - carry[0][0].enqueue_t) * 1e3)
+                        self.metrics.set_gauge("slot_occupancy", nlive / B)
+                        self.metrics.set_gauge("slots_free", B - nlive)
+                        self.metrics.set_gauge("queue_age_ms", age)
+                        self.metrics.set_queue_depth(
+                            q.queue_depth + len(carry))
+                        self.metrics.set_counter("compiles",
+                                                 self.compile_count)
+                        self.metrics.publish()
+                except Exception as e:
+                    # Device failure mid-flight.  Greedy decode is
+                    # deterministic, so a restart-from-scratch regenerates
+                    # the exact same tokens: requeue live requests (bounded
+                    # per request), reset device state, keep the loop alive.
+                    if self.breaker is not None:
+                        self.breaker.record_failure(0)
+                    survivors: List[tuple] = []
+                    for i in range(B):
+                        s = slots[i]
+                        slots[i] = None
+                        if s is None:
+                            continue
+                        if is_transient(e) and s["restarts"] < max_restarts:
+                            survivors.append((s["req"], s["restarts"] + 1))
+                        else:
+                            self.metrics.incr("errors")
+                            if not s["req"].future.done():
+                                s["req"].future.set_exception(e)
+                    pos[:] = -1
+                    pending.clear()
+                    cache = None
+                    tok = None
+                    carry = survivors + carry
+                    if survivors:
+                        self.metrics.incr("restarts")
+                    self.metrics.publish()
+        finally:
+            q.consumer_done()
+
+    # -- legacy batch execution ----------------------------------------------
     def _run_batch(self, bucket: int, requests: List[Request]
                    ) -> List[np.ndarray]:
         B, Sb = self._batch, self._buckets[bucket]
@@ -157,7 +540,6 @@ class GenerationEngine:
             positions[i, : len(prompt)] = np.arange(len(prompt))
             lens[i] = len(prompt)
             budgets[i] = int(r.meta)
-        from .. import profiler
 
         t0 = time.monotonic()
         cache = self._model.gpt.init_cache(B, self._cache_len)
@@ -165,10 +547,10 @@ class GenerationEngine:
             tok, cache = self._prefill(
                 self._params, self._buffers, jnp.asarray(ids),
                 jnp.asarray(positions), jnp.asarray(lens), cache)
-        pos = jnp.asarray(lens)  # absolute slot of the token just produced
         out: List[List[int]] = [[] for _ in range(B)]
         done = np.array([i >= len(requests) for i in range(B)])
         n_tokens = 0
+        n_step = 0  # decode offset past the prompt
         with profiler.RecordEvent(f"{self.name}/decode"):
             while True:
                 host_tok = np.asarray(tok)
@@ -183,9 +565,13 @@ class GenerationEngine:
                         done[i] = True
                 if done.all():
                     break
+                # positions stay a host counter: a fresh transfer per step
+                # keeps every decode call on the placement warmup traced
+                # (`pos + 1` on device would hand step 2 a committed array
+                # and silently recompile the step executable)
                 tok, cache = self._decode(self._params, self._buffers, tok,
-                                          pos, cache)
-                pos = pos + 1
+                                          jnp.asarray(lens + n_step), cache)
+                n_step += 1
         self.metrics.observe_tokens(n_tokens, time.monotonic() - t0)
         self.metrics.set_counter("compiles", self.compile_count)
         return [np.asarray(o, np.int32) for o in out[: len(requests)]]
@@ -193,7 +579,7 @@ class GenerationEngine:
     # -- public API ----------------------------------------------------------
     def synthetic_inputs(self) -> np.ndarray:
         """A one-token prompt — the router's default health probe decodes
-        one token through the real prefill+decode executables."""
+        one token through the real admission+decode executables."""
         return np.zeros((1,), np.int32)
 
     def submit(self, prompt_ids, max_new_tokens: int = 32,
@@ -213,8 +599,9 @@ class GenerationEngine:
 
     def reload_weights(self) -> None:
         """Re-snapshot weights from the live model (e.g. after
-        ``paddle_tpu.load`` into it) — next batch serves them, zero
-        recompiles (params are executable arguments)."""
+        ``paddle_tpu.load`` into it) — the next batch (legacy) or device
+        dispatch (continuous) serves them, zero recompiles (params are
+        executable arguments)."""
         self._params = self._model.param_pytree()
         self._buffers = self._model.buffer_pytree()
         self.metrics.publish({"weight_swap": 1})
@@ -223,10 +610,13 @@ class GenerationEngine:
         snap = self.metrics.snapshot()
         snap["compile_count"] = self.compile_count
         snap["buckets"] = len(self._buckets)
+        snap["continuous"] = self._continuous
         return snap
 
     def close(self, drain: bool = True, timeout: Optional[float] = None):
         self._batcher.close(drain=drain, timeout=timeout)
+        if self._thread is not None:
+            self._thread.join(timeout)
 
     def __enter__(self):
         return self

@@ -11,6 +11,13 @@ Snapshot keys: ``requests, completed, shed, expired, errors,
 bucket_misses, fallback_runs, compiles, batches, circuit_shed,
 queue_depth, batch_occupancy, p50_ms, p99_ms, queue_p50_ms,
 queue_p99_ms, execute_p50_ms, execute_p99_ms, tokens, tokens_per_s``.
+
+Continuous-batching engines add the slot-scheduler family: counters
+``admitted, evicted, decode_steps, restarts, starved_steps,
+starved_steps_after_warm`` plus per-step gauges (``set_gauge``) such as
+``slot_occupancy`` (live slots / batch), ``slots_free`` and
+``queue_age_ms`` (age of the oldest queued request).  Rule S603 reads
+the starvation counters.
 """
 from __future__ import annotations
 
@@ -27,6 +34,10 @@ __all__ = ["ServingMetrics"]
 _COUNTERS = ("requests", "completed", "shed", "expired", "errors",
              "bucket_misses", "fallback_runs", "compiles", "batches",
              "tokens", "circuit_shed", "drain_timeout")
+
+#: slot-scheduler counters (continuous batching; see ``extra_counters``)
+SLOT_COUNTERS = ("admitted", "evicted", "decode_steps", "restarts",
+                 "starved_steps", "starved_steps_after_warm")
 
 
 def _quantile(sorted_vals, q: float) -> float:
@@ -61,6 +72,7 @@ class ServingMetrics:
         self._execute_ms: Deque[float] = collections.deque(maxlen=window)
         self._queue_depth = 0
         self._token_time_s = 0.0
+        self._gauges: Dict[str, float] = {}
 
     def incr(self, key: str, n: int = 1):
         with self._lock:
@@ -73,6 +85,20 @@ class ServingMetrics:
     def set_queue_depth(self, depth: int):
         with self._lock:
             self._queue_depth = int(depth)
+
+    def set_gauge(self, key: str, value: float):
+        """Latest-value gauge folded into every snapshot (the continuous
+        decode loop's per-step slot occupancy / free-slot / queue-age
+        family rides this)."""
+        with self._lock:
+            self._gauges[key] = float(value)
+
+    def observe_occupancy(self, frac: float):
+        """One occupancy sample (0..1) for the ``batch_occupancy``
+        average — the slot scheduler's per-step equivalent of
+        :meth:`observe_batch`'s size/capacity sample."""
+        with self._lock:
+            self._occupancy.append(float(frac))
 
     def observe_batch(self, size: int, capacity: int, queue_depth: int):
         with self._lock:
@@ -119,6 +145,7 @@ class ServingMetrics:
             qms = sorted(self._queue_ms)
             xms = sorted(self._execute_ms)
             snap = dict(self._counters)
+            snap.update(self._gauges)
             snap["queue_depth"] = self._queue_depth
             snap["batch_occupancy"] = (sum(occ) / len(occ)) if occ else 0.0
             snap["p50_ms"] = _quantile(lat, 0.50)

@@ -373,10 +373,14 @@ class Router:
     # -- active health -------------------------------------------------------
     def _default_probe(self, engine) -> None:
         sample = engine.synthetic_inputs()
+        t = self._probe_timeout_s
         if hasattr(engine, "generate"):
-            engine.generate(sample, 1, timeout=self._probe_timeout_s)
+            # deadline-bound the queued side too: a probe against a busy
+            # continuous-batching engine self-expires instead of lingering
+            # as a ghost request that later burns a decode slot
+            engine.submit(sample, 1, deadline_ms=t * 1e3).result(t)
         else:
-            engine.infer(sample, timeout=self._probe_timeout_s)
+            engine.infer(sample, timeout=t)
 
     def _run_probe(self, rep: Replica) -> bool:
         self.metrics.incr("probes")

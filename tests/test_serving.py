@@ -291,8 +291,12 @@ class TestGenerationEngine(unittest.TestCase):
         return outs
 
     def test_token_identical_and_closed_compile_set(self):
+        # continuous=False pins the legacy run-batch-to-completion path;
+        # the continuous scheduler has its own suite
+        # (test_continuous_batching.py)
         with GenerationEngine(self.model, prompt_buckets=[8, 16],
-                              batch_size=2, max_queue_delay_ms=2.0) as eng:
+                              batch_size=2, max_queue_delay_ms=2.0,
+                              continuous=False) as eng:
             self.assertEqual(eng.warmup(), 3)  # 2 prefill buckets + 1 decode
             prompts = [np.arange(5) % 97, (np.arange(7) * 3) % 97,
                        (np.arange(11) * 5 + 2) % 97]
@@ -312,7 +316,7 @@ class TestGenerationEngine(unittest.TestCase):
         expect = probe[: probe.index(eos) + 1]
         self.assertLess(len(expect), 8)
         with GenerationEngine(self.model, prompt_buckets=[8], batch_size=1,
-                              max_queue_delay_ms=1.0,
+                              max_queue_delay_ms=1.0, continuous=False,
                               eos_token_id=eos) as eng:
             gen = eng.generate(np.arange(4) % 97, max_new_tokens=8,
                                timeout=120)

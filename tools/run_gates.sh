@@ -15,9 +15,10 @@
 #   chaos-smoke tools/chaos_smoke.py (SIGKILL-resume bit identity + circuit recovery)
 #   obs-smoke tools/obs_smoke.py   (metrics scrape + JSONL sink + serving spans)
 #   router-smoke tools/router_smoke.py (replica kill -> zero-loss failover + rolling swap)
+#   gen-smoke tools/gen_smoke.py (continuous batching: HOL p99, zero recompiles, probes)
 #   bench   python bench.py          (only when a real TPU answers)
 #
-# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|chaos-smoke|obs-smoke|router-smoke|bench]...
+# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|chaos-smoke|obs-smoke|router-smoke|gen-smoke|bench]...
 #         tools/run_gates.sh --only suite
 # Exit code: 0 iff every stage that ran passed.
 set -u
@@ -110,6 +111,10 @@ run_stage obs-smoke env JAX_PLATFORMS=cpu python tools/obs_smoke.py
 # accepted request completes via failover, half-open re-admission after the
 # cooldown, rolling swap_weights under load (zero rejects, zero recompiles)
 run_stage router-smoke env JAX_PLATFORMS=cpu python tools/router_smoke.py
+# continuous batching decode plane: 1 long + many short requests -> short
+# p99 at least 2x better than the legacy run-to-completion path, zero lost
+# requests, zero post-warmup XLA recompiles, router probes stay green
+run_stage gen-smoke env JAX_PLATFORMS=cpu python tools/gen_smoke.py
 
 # bench only when a real accelerator answers within 60s
 if want bench; then

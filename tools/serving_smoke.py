@@ -97,8 +97,13 @@ def gate_generation():
         identical = all(g.tolist() == ref(p, 5)
                         for p, g in zip(prompts, gens))
         st = eng.stats()
+        # continuous (default): per-bucket slot-admission prefill + decode
+        # + evict; legacy: per-bucket prefill + decode
+        expected = (len([8, 16]) + 2 if st["continuous"]
+                    else len([8, 16]) + 1)
         return {"token_identical": bool(identical),
-                "closed_compile_set": st["compile_count"] == 3,
+                "continuous": bool(st["continuous"]),
+                "closed_compile_set": st["compile_count"] == expected,
                 "compile_count": st["compile_count"],
                 "tokens": st["tokens"],
                 "tokens_per_s": round(st["tokens_per_s"], 1)}
