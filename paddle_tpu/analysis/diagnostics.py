@@ -97,6 +97,9 @@ RULES = {
              "post-warmup step time)"),
     "M902": (Severity.WARNING,
              "HBM high-water above the alert fraction of device memory"),
+    "M903": (Severity.WARNING,
+             "SLO error-budget burn after serving warmup (multi-window "
+             "burn-rate alert on live traffic)"),
 }
 
 

@@ -66,6 +66,8 @@ class RetraceMonitor:
         # ("steptrace", name) training-telemetry snapshots: latest per loop
         # (rules M901 / M902)
         self._steptrace_sites: Dict[str, dict] = {}
+        # ("slo", name) SLO-engine snapshots: latest per engine (rule M903)
+        self._slo_sites: Dict[str, dict] = {}
 
     # -- subscription --------------------------------------------------------
     def install(self):
@@ -120,6 +122,11 @@ class RetraceMonitor:
             # training-telemetry snapshot: cumulative sums, latest wins
             with self._lock:
                 self._steptrace_sites[key[1]] = dict(info)
+            return
+        if key[0] == "slo":
+            # SLO-engine tick snapshot: cumulative counters, latest wins
+            with self._lock:
+                self._slo_sites[key[1]] = dict(info)
             return
         sig = _freeze(info)
         with self._lock:
@@ -187,6 +194,15 @@ class RetraceMonitor:
             if name is not None:
                 return dict(self._steptrace_sites.get(name, {}))
             return {k: dict(v) for k, v in self._steptrace_sites.items()}
+
+    def slo_stats(self, name: str = None):
+        """Latest SLO-engine snapshot(s) observed (ticks, alerts,
+        per-objective burn rates, scale-signal counters): the dict for
+        one engine (``name`` like ``"slo#1"``), or all of them."""
+        with self._lock:
+            if name is not None:
+                return dict(self._slo_sites.get(name, {}))
+            return {k: dict(v) for k, v in self._slo_sites.items()}
 
     def diagnostics(self) -> List[Diagnostic]:
         out = DiagnosticCollector()
@@ -402,6 +418,28 @@ class RetraceMonitor:
                              "enable rematerialization, lower the batch "
                              "size, or raise FLAGS_hbm_high_water_frac "
                              "if this headroom is intentional")
+        with self._lock:
+            slo_sites = {k: dict(v) for k, v in self._slo_sites.items()}
+        for name, stats in slo_sites.items():
+            late = int(stats.get("alerts_after_warm", 0))
+            if late <= 0:
+                continue
+            burning = stats.get("alerting") or "objective(s)"
+            out.add("M903",
+                    f"SLO engine {name!r} fired {late} burn-rate "
+                    f"alert(s) after serving warmup ({burning} burning at "
+                    f"up to {float(stats.get('max_burn', 0.0)):.1f}x "
+                    f"budget; last scale signal "
+                    f"{stats.get('last_signal', 'none')!r}) — sustained "
+                    f"post-warmup budget burn means the fleet is eating "
+                    f"its error budget on live traffic, not on startup "
+                    f"transients",
+                    location=Location(file=name, function=name),
+                    hint="scale up (wire SloEngine.bind_router / "
+                         "Router.register_scale_hook into the deployment "
+                         "layer) or find the regression behind the burn "
+                         "(latency: check K701/F801/S60x; availability: "
+                         "check shed and circuit counters)")
         return out.diagnostics
 
     @staticmethod

@@ -186,3 +186,15 @@ define_flag("hbm_high_water_frac", 0.9,
             "Analysis rule M902 fires when the HBM high-water mark "
             "(peak_bytes_in_use) reaches this fraction of the device's "
             "bytes_limit — the early warning before a real OOM.")
+define_flag("trace_requests", False,
+            "End-to-end request tracing (observability/tracing.py): on, "
+            "Router.submit opens a root span per accepted request and "
+            "the replica-dispatch / batcher-queue / decode-slot layers "
+            "record child spans into a bounded per-process ring buffer "
+            "(merged into profiler.export_chrome_tracing output). Off "
+            "(default), every hook is a single falsy check. Picked up "
+            "by observability.maybe_enable_from_flags().")
+define_flag("trace_buffer_cap", 65536,
+            "Capacity of the request-tracing span ring buffer; the "
+            "oldest spans are dropped first past the cap (drops are "
+            "counted in Tracer.stats()).")

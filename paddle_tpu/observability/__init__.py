@@ -25,7 +25,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
-from . import exporters, metrics, steptrace  # noqa: F401
+from . import exporters, metrics, slo, steptrace, tracing  # noqa: F401
 from .exporters import (  # noqa: F401
     JsonlSink,
     PrometheusExporter,
@@ -43,6 +43,8 @@ from .metrics import (  # noqa: F401
     install_bridge,
     uninstall_bridge,
 )
+from .slo import Objective, ScaleSignal, SloEngine  # noqa: F401
+from .tracing import TraceContext, Tracer  # noqa: F401
 
 __all__ = [
     "MetricRegistry", "Counter", "Gauge", "Histogram",
@@ -50,7 +52,8 @@ __all__ = [
     "PrometheusExporter", "JsonlSink", "merge_jsonl",
     "append_jsonl_record", "install_bridge", "uninstall_bridge",
     "enable", "disable", "enabled", "status", "maybe_enable_from_flags",
-    "metrics", "exporters", "steptrace",
+    "Objective", "ScaleSignal", "SloEngine", "TraceContext", "Tracer",
+    "metrics", "exporters", "slo", "steptrace", "tracing",
 ]
 
 _lock = threading.RLock()
@@ -75,7 +78,8 @@ def _register_summary_section():
 
 def enable(port: Optional[int] = None, jsonl: Optional[str] = None,
            registry: Optional[MetricRegistry] = None,
-           jsonl_interval_s: Optional[float] = None) -> MetricRegistry:
+           jsonl_interval_s: Optional[float] = None,
+           trace: bool = False) -> MetricRegistry:
     """Turn observability on (idempotent; later calls can add an exporter
     or sink a first call didn't configure).
 
@@ -83,6 +87,8 @@ def enable(port: Optional[int] = None, jsonl: Optional[str] = None,
     = bind an ephemeral port (read it back from ``status()``), else the
     TCP port.  ``jsonl`` — base path of the periodic JSONL sink (written
     as ``<base>.p<process_index>.jsonl``); ``None``/empty = no sink.
+    ``trace`` — also enable end-to-end request tracing
+    (``tracing.enable()`` works standalone too).
     """
     global _exporter, _sink, _enabled
     from ..framework.flags import flag
@@ -94,6 +100,8 @@ def enable(port: Optional[int] = None, jsonl: Optional[str] = None,
         steptrace.install(reg)
         _register_summary_section()
         _enabled = True
+        if trace:
+            tracing.enable()
         if port and _exporter is None:
             _exporter = PrometheusExporter(reg, port=max(int(port), 0))
         if jsonl and _sink is None:
@@ -105,13 +113,14 @@ def enable(port: Optional[int] = None, jsonl: Optional[str] = None,
 
 
 def disable() -> None:
-    """Tear down the bridge, telemetry, endpoint and sink (the default
-    registry keeps its accumulated values; pass a fresh registry to the
-    next ``enable`` for a clean slate)."""
+    """Tear down the bridge, telemetry, tracing, endpoint and sink (the
+    default registry keeps its accumulated values; pass a fresh registry
+    to the next ``enable`` for a clean slate)."""
     global _exporter, _sink, _enabled
     with _lock:
         uninstall_bridge()
         steptrace.uninstall()
+        tracing.disable()
         if _exporter is not None:
             _exporter.close()
             _exporter = None
@@ -127,10 +136,12 @@ def enabled() -> bool:
 
 def status() -> dict:
     with _lock:
+        tr = tracing.active()
         return {
             "enabled": _enabled,
             "bridge": metrics.bridge_installed(),
             "steptrace": steptrace.active() is not None,
+            "tracing": tr.stats() if tr is not None else None,
             "port": _exporter.port if _exporter is not None else None,
             "url": _exporter.url if _exporter is not None else None,
             "jsonl": _sink.path if _sink is not None else None,
@@ -140,14 +151,19 @@ def status() -> dict:
 def maybe_enable_from_flags() -> bool:
     """Flag-driven auto-enable, called from ``Executor.__init__`` (the
     same pattern as the persistent compilation cache): when
-    ``FLAGS_metrics_port`` is nonzero or ``FLAGS_metrics_jsonl`` is
-    non-empty, enable with those settings.  Cheap no-op otherwise."""
+    ``FLAGS_metrics_port`` is nonzero, ``FLAGS_metrics_jsonl`` is
+    non-empty, or ``FLAGS_trace_requests`` is set, enable with those
+    settings.  Cheap no-op otherwise."""
     from ..framework.flags import flag
 
     port = int(flag("metrics_port"))
     jsonl = flag("metrics_jsonl")
-    if not port and not jsonl:
+    trace = bool(flag("trace_requests"))
+    if not port and not jsonl and not trace:
         return False
     with _lock:
-        enable(port=port or None, jsonl=jsonl or None)
+        if trace and not port and not jsonl:
+            tracing.enable()  # tracing alone: no registry machinery
+        else:
+            enable(port=port or None, jsonl=jsonl or None, trace=trace)
     return True

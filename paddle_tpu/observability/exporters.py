@@ -188,9 +188,12 @@ def merge_jsonl(base_or_paths, out_path: Optional[str] = None) -> List[dict]:
     """Collate per-process sink files (head-node helper).
 
     ``base_or_paths`` — the base path given to :class:`JsonlSink` (globs
-    ``<root>.p*<ext>``) or an explicit list of files.  Returns records
-    sorted by timestamp; writes them back out as JSONL when ``out_path``
-    is given."""
+    ``<root>.p*<ext>``) or an explicit list of files.  Crash-tolerant: a
+    process killed mid-write leaves a truncated (unparseable) trailing
+    line, which is skipped rather than poisoning the whole merge.
+    Returns records in a deterministic order — sorted by timestamp with
+    process index (then input position) as tie-breaker; writes them back
+    out as JSONL when ``out_path`` is given."""
     if isinstance(base_or_paths, (list, tuple)):
         paths: Sequence[str] = base_or_paths
     else:
@@ -202,11 +205,18 @@ def merge_jsonl(base_or_paths, out_path: Optional[str] = None) -> List[dict]:
             with open(p) as f:
                 for line in f:
                     line = line.strip()
-                    if line:
+                    if not line:
+                        continue
+                    try:
                         records.append(json.loads(line))
+                    except ValueError:
+                        continue  # truncated/corrupt line: skip, keep rest
         except OSError:
             continue
-    records.sort(key=lambda r: r.get("ts", 0.0))
+    records.sort(key=lambda r: (
+        r.get("ts", 0.0) if isinstance(r, dict) else 0.0,
+        r.get("process_index", r.get("pid", 0)) if isinstance(r, dict)
+        else 0))
     if out_path:
         with open(out_path, "w") as f:
             for r in records:

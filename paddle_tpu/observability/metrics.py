@@ -43,6 +43,17 @@ DEFAULT_MS_BUCKETS = (0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0,
 _NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
 
+#: per-metric label-cardinality cap — a runaway label (request ids,
+#: user strings) otherwise grows ``_Metric._children`` without bound
+DEFAULT_MAX_CHILDREN = 256
+
+#: reserved child key for label sets past the cap; rendered with every
+#: label value "other" plus ``overflow="true"``
+_OVERFLOW_KEY = ("__overflow__",)
+
+#: registry counter that tallies label sets routed to the overflow child
+DROPPED_LABELS_COUNTER = "paddle_tpu_metric_labels_dropped_total"
+
 
 def sanitize_name(name: str) -> str:
     """Coerce an arbitrary key into a legal Prometheus metric name."""
@@ -109,12 +120,16 @@ class _Metric:
 
     type: str = ""
 
-    def __init__(self, name: str, help_str: str, labelnames: Sequence[str]):
+    def __init__(self, name: str, help_str: str, labelnames: Sequence[str],
+                 *, max_children: int = DEFAULT_MAX_CHILDREN,
+                 overflow_cb: Optional[Callable[[str], None]] = None):
         if not _NAME_OK.match(name):
             raise ValueError(f"invalid metric name {name!r}")
         self.name = name
         self.help = help_str
         self.labelnames = tuple(labelnames)
+        self._max_children = int(max_children)  # <= 0 means unbounded
+        self._overflow_cb = overflow_cb
         self._lock = threading.Lock()
         self._children: Dict[Tuple[str, ...], object] = {}
         if not self.labelnames:
@@ -134,11 +149,33 @@ class _Metric:
             raise ValueError(
                 f"{self.name}: expected labels {self.labelnames}, got "
                 f"{values!r}")
+        overflowed = False
         with self._lock:
             child = self._children.get(values)
             if child is None:
-                child = self._children[values] = self._new_child()
+                if (self._max_children > 0
+                        and len(self._children) >= self._max_children):
+                    # cap hit: route this NEW label set to the shared
+                    # overflow child so the family stays bounded
+                    overflowed = True
+                    child = self._children.get(_OVERFLOW_KEY)
+                    if child is None:
+                        child = self._children[_OVERFLOW_KEY] = \
+                            self._new_child()
+                else:
+                    child = self._children[values] = self._new_child()
+        if overflowed and self._overflow_cb is not None:
+            # outside our lock: the callback increments a registry
+            # counter, which takes the registry + counter locks
+            self._overflow_cb(self.name)
         return child
+
+    def _label_dict(self, values: Tuple[str, ...]) -> Dict[str, str]:
+        if values == _OVERFLOW_KEY:
+            labels = {n: "other" for n in self.labelnames}
+            labels["overflow"] = "true"
+            return labels
+        return dict(zip(self.labelnames, values))
 
     def _default(self):
         if self.labelnames:
@@ -155,8 +192,7 @@ class _Metric:
         """``(sample_name, labels, value)`` triples for text exposition."""
         out = []
         for values, child in self.children():
-            labels = dict(zip(self.labelnames, values))
-            out.append((self.name, labels, child.value))
+            out.append((self.name, self._label_dict(values), child.value))
         return out
 
 
@@ -187,7 +223,7 @@ class Histogram(_Metric):
     type = "histogram"
 
     def __init__(self, name, help_str, labelnames,
-                 buckets: Sequence[float] = DEFAULT_MS_BUCKETS):
+                 buckets: Sequence[float] = DEFAULT_MS_BUCKETS, **kw):
         bs = sorted(float(b) for b in buckets)
         if not bs or bs != sorted(set(bs)):
             raise ValueError(f"{name}: buckets must be distinct, got "
@@ -195,7 +231,7 @@ class Histogram(_Metric):
         if not math.isinf(bs[-1]):
             bs.append(float("inf"))
         self.buckets = tuple(bs)
-        super().__init__(name, help_str, labelnames)
+        super().__init__(name, help_str, labelnames, **kw)
 
     def _new_child(self):
         return _HistogramChild(self.buckets)
@@ -206,7 +242,7 @@ class Histogram(_Metric):
     def expose(self):
         out = []
         for values, child in self.children():
-            labels = dict(zip(self.labelnames, values))
+            labels = self._label_dict(values)
             cum = 0
             for le, n in zip(child.buckets, child.counts):
                 cum += n
@@ -226,10 +262,11 @@ class MetricRegistry:
     different meanings is the bug this catches).
     """
 
-    def __init__(self):
+    def __init__(self, max_label_children: int = DEFAULT_MAX_CHILDREN):
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
         self._collectors: List[Callable] = []
+        self._max_label_children = int(max_label_children)
 
     def _get_or_create(self, cls, name, help_str, labelnames, **kw):
         with self._lock:
@@ -240,9 +277,27 @@ class MetricRegistry:
                         f"metric {name!r} already registered as "
                         f"{m.type} with labels {m.labelnames}")
                 return m
+            kw.setdefault("max_children", self._max_label_children)
+            kw.setdefault("overflow_cb", self._count_dropped_labels)
             m = cls(name, help_str, labelnames, **kw)
             self._metrics[name] = m
             return m
+
+    def _count_dropped_labels(self, metric_name: str) -> None:
+        # the drop counter itself is uncapped and has no overflow_cb —
+        # a capped-or-recursing accountant would hide the drops it counts
+        self._get_or_create(
+            Counter, DROPPED_LABELS_COUNTER,
+            "label sets routed to the overflow child past the per-metric "
+            "cardinality cap", ("metric",),
+            max_children=0, overflow_cb=None,
+        ).labels(metric_name).inc()
+
+    def get(self, name: str) -> Optional[_Metric]:
+        """The already-registered family (no create) — readers like the
+        SLO engine use this so they never conjure empty metrics."""
+        with self._lock:
+            return self._metrics.get(name)
 
     def counter(self, name: str, help_str: str = "",
                 labelnames: Sequence[str] = ()) -> Counter:
@@ -324,6 +379,7 @@ _FAMILY_LABEL = {
     "autotune": "kernel",
     "steptrace": "name",
     "router": "replica",
+    "slo": "engine",
 }
 
 _bridge_fn: Optional[Callable] = None

@@ -1,0 +1,522 @@
+"""SLO engine — declarative objectives, burn-rate alerts, scale signals.
+
+The registry (PR 6) and the serving snapshots answer "what is the p99
+*now*"; an operator needs "is this replica set burning its error budget
+faster than the objective allows, and should the fleet scale?".  This
+module turns the telemetry the repo already exports into that signal:
+
+* an :class:`Objective` declares a goal over one telemetry source —
+  :meth:`Objective.latency` (good = requests under a threshold, read
+  from a registry histogram's cumulative buckets),
+  :meth:`Objective.availability` (good = completed, bad = errors /
+  expired / shed, read from the ``("serving", ...)`` / ``("router",
+  ...)`` bus snapshots), :meth:`Objective.throughput` (a tokens/s
+  floor, sampled per tick from the same snapshots);
+* :class:`SloEngine` evaluates each objective over rolling windows with
+  **multi-window burn-rate alerting** (the Google-SRE shape: alert only
+  when the burn rate ``bad_fraction / (1 - goal)`` exceeds a window's
+  threshold in BOTH its long and short window — fast burns page fast,
+  slow burns page eventually, recovered burns stop paging);
+* every :meth:`SloEngine.tick` exports ``paddle_tpu_slo_*`` gauges,
+  publishes a ``("slo", <name>)`` bus snapshot (rule **M903** reads
+  ``alerts_after_warm``), renders into the ``profiler.summary()``
+  "SLO" section, and emits a :class:`ScaleSignal` (``up`` while any
+  objective alerts, ``down`` when every objective has a full quiet
+  window, ``steady`` otherwise) to registered callbacks —
+  ``engine.bind_router(router)`` delivers them to
+  ``Router.on_scale_signal``, closing the ROADMAP SLO-hooks item.
+
+Nothing here touches a hot path: the engine is pull-based (an explicit
+:meth:`tick` or the optional :meth:`start` thread) and its bus observer
+only *stores* snapshots the serving layer already publishes.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
+
+from ..framework import trace_events
+from ..framework.errors import InvalidArgumentError
+from . import metrics as _metrics
+from .metrics import MetricRegistry, default_registry, sanitize_name
+
+__all__ = ["Objective", "ScaleSignal", "SloEngine", "DEFAULT_WINDOWS"]
+
+#: (long_window_s, short_window_s, burn_rate_threshold) pairs — the SRE
+#: multiwindow defaults scaled to serving: a 14.4x burn (2% budget in
+#: ~1h) pages within minutes, a 6x burn within the long window
+DEFAULT_WINDOWS: Tuple[Tuple[float, float, float], ...] = (
+    (3600.0, 300.0, 14.4),
+    (21600.0, 1800.0, 6.0),
+)
+
+_slo_counter = [0]
+
+#: live engines, for the profiler "SLO" summary section
+_engines: "weakref.WeakSet" = weakref.WeakSet()
+
+#: snapshot keys counted as failed requests for availability objectives
+_BAD_KEYS = ("errors", "expired", "shed", "circuit_shed", "rejected")
+
+
+class ScaleSignal(NamedTuple):
+    """One scaling verdict: ``direction`` is ``up``/``down``/``steady``;
+    ``objective`` names the worst burner (empty when steady/down)."""
+
+    direction: str
+    reason: str
+    objective: str
+    burn_rate: float
+    at: float
+
+
+class Objective:
+    """One declarative objective: ``goal`` is the required good
+    fraction; ``windows`` are ``(long_s, short_s, burn_threshold)``
+    triples evaluated independently."""
+
+    __slots__ = ("name", "kind", "goal", "windows", "threshold_ms",
+                 "histogram", "labels", "site", "floor")
+
+    def __init__(self, name: str, kind: str, goal: float,
+                 windows=DEFAULT_WINDOWS, *, threshold_ms: float = 0.0,
+                 histogram: str = "", labels: Tuple[str, ...] = (),
+                 site: str = "", floor: float = 0.0):
+        if not 0.0 < float(goal) < 1.0:
+            raise InvalidArgumentError(
+                f"objective {name!r}: goal must be in (0, 1), got {goal}")
+        if kind not in ("latency", "availability", "throughput"):
+            raise InvalidArgumentError(
+                f"objective {name!r}: unknown kind {kind!r}")
+        ws = tuple((float(l), float(s), float(b)) for l, s, b in windows)
+        if not ws or any(s >= l or b <= 0 for l, s, b in ws):
+            raise InvalidArgumentError(
+                f"objective {name!r}: windows must be (long_s > short_s, "
+                f"burn_threshold > 0) triples, got {windows!r}")
+        self.name = name
+        self.kind = kind
+        self.goal = float(goal)
+        self.windows = ws
+        self.threshold_ms = float(threshold_ms)
+        self.histogram = histogram
+        self.labels = tuple(str(v) for v in labels)
+        self.site = site
+        self.floor = float(floor)
+
+    @property
+    def budget(self) -> float:
+        """The error budget: the tolerated bad fraction."""
+        return 1.0 - self.goal
+
+    @classmethod
+    def latency(cls, name: str, *, threshold_ms: float,
+                engine: str = "", goal: float = 0.99,
+                histogram: str = "paddle_tpu_serving_latency_ms",
+                labels: Optional[Tuple[str, ...]] = None,
+                windows=DEFAULT_WINDOWS) -> "Objective":
+        """p-latency objective: ``goal`` of requests complete within
+        ``threshold_ms`` (snapped up to the histogram's next bucket
+        edge).  Reads the cumulative buckets of ``histogram`` for the
+        child labeled ``engine`` (or an explicit ``labels`` tuple) —
+        the ``paddle_tpu_serving_latency_ms{engine=...}`` histogram the
+        serving layer feeds while observability is enabled."""
+        if labels is None:
+            labels = (engine,) if engine else ()
+        return cls(name, "latency", goal, windows,
+                   threshold_ms=threshold_ms, histogram=histogram,
+                   labels=tuple(labels))
+
+    @classmethod
+    def availability(cls, name: str, *, site: str, goal: float = 0.999,
+                     windows=DEFAULT_WINDOWS) -> "Objective":
+        """Availability objective over the ``("serving"/"router",
+        <site>)`` snapshots: good = ``completed``, bad = errors +
+        expired + shed (+ router rejections)."""
+        return cls(name, "availability", goal, windows, site=site)
+
+    @classmethod
+    def throughput(cls, name: str, *, site: str, floor_tokens_per_s: float,
+                   goal: float = 0.99,
+                   windows=DEFAULT_WINDOWS) -> "Objective":
+        """Decode-throughput floor: each tick with decode activity whose
+        snapshot ``tokens_per_s`` sits below the floor spends budget."""
+        return cls(name, "throughput", goal, windows, site=site,
+                   floor=floor_tokens_per_s)
+
+
+class _Series:
+    """Rolling (t, good_cum, total_cum) samples; deltas over a window
+    give the window's bad fraction without storing per-request data."""
+
+    __slots__ = ("_samples", "_horizon")
+
+    def __init__(self, horizon_s: float):
+        self._samples: deque = deque()
+        self._horizon = float(horizon_s) * 1.25 + 1.0
+
+    def add(self, t: float, good: float, total: float) -> None:
+        self._samples.append((t, float(good), float(total)))
+        while self._samples and t - self._samples[0][0] > self._horizon:
+            self._samples.popleft()
+
+    def window(self, now: float, w: float) -> Tuple[float, float]:
+        """(bad_fraction, total_delta) over the trailing ``w`` seconds —
+        baseline is the newest sample at or before ``now - w`` (or the
+        oldest sample for a still-filling window)."""
+        if len(self._samples) < 2:
+            return 0.0, 0.0
+        cutoff = now - w
+        base = self._samples[0]
+        for s in self._samples:
+            if s[0] <= cutoff:
+                base = s
+            else:
+                break
+        t1, g1, n1 = self._samples[-1]
+        _, g0, n0 = base
+        d_total = n1 - n0
+        if d_total <= 0:
+            return 0.0, 0.0
+        d_bad = d_total - (g1 - g0)
+        return max(d_bad, 0.0) / d_total, d_total
+
+    def span_s(self) -> float:
+        if len(self._samples) < 2:
+            return 0.0
+        return self._samples[-1][0] - self._samples[0][0]
+
+
+class SloEngine:
+    """Evaluate objectives, export gauges, emit scale signals.
+
+    ``clock`` is injectable for deterministic tests.  ``install()``
+    subscribes the snapshot observer (and thereby activates the
+    trace_events bus, so engines/routers start publishing);
+    ``close()``/context-exit tears everything down.  ``min_samples``
+    guards cold starts: a window alerts only once it has seen that many
+    requests.  ``scale_down_burn`` is the quiet threshold: when every
+    objective's worst burn stays under it for a full long window, the
+    signal is ``down``.
+    """
+
+    def __init__(self, objectives, *, name: Optional[str] = None,
+                 registry: Optional[MetricRegistry] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 min_samples: int = 1, scale_down_burn: float = 0.1):
+        objectives = list(objectives)
+        if not objectives:
+            raise InvalidArgumentError("SloEngine needs >= 1 objective")
+        names = [o.name for o in objectives]
+        if len(set(names)) != len(names):
+            raise InvalidArgumentError(
+                f"objective names must be unique, got {names}")
+        if name is None:
+            _slo_counter[0] += 1
+            name = f"slo#{_slo_counter[0]}"
+        self.name = name
+        self.objectives = objectives
+        self._registry = registry
+        self._clock = clock
+        self._min_samples = max(int(min_samples), 1)
+        self._down_burn = float(scale_down_burn)
+        self._lock = threading.Lock()
+        self._sites: Dict[Tuple[str, str], dict] = {}
+        self._series = {o.name: _Series(max(l for l, _, _ in o.windows))
+                        for o in objectives}
+        self._thr_cum: Dict[str, List[float]] = {
+            o.name: [0.0, 0.0, -1.0]  # good, total, last tokens seen
+            for o in objectives if o.kind == "throughput"}
+        self._results: Dict[str, dict] = {}
+        self._sinks: List[Callable[[ScaleSignal], None]] = []
+        self._counts = {"ticks": 0, "alerts": 0, "alerts_after_warm": 0,
+                        "scale_up_signals": 0, "scale_down_signals": 0,
+                        "scale_steady_signals": 0}
+        self._last_signal: Optional[ScaleSignal] = None
+        self._t_start = self._clock()
+        self._installed = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        _engines.add(self)
+        _register_profiler_section()
+
+    # -- wiring ---------------------------------------------------------------
+    def install(self) -> "SloEngine":
+        """Subscribe the bus observer (idempotent).  Registering an
+        observer makes ``trace_events.active()`` true, which is what
+        makes engines/routers publish the snapshots availability and
+        throughput objectives read."""
+        if not self._installed:
+            trace_events.register(self._on_event)
+            self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            trace_events.unregister(self._on_event)
+            self._installed = False
+
+    __enter__ = install
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _on_event(self, site, info) -> None:
+        fam = site[0]
+        if fam in ("serving", "router") and isinstance(info, dict):
+            with self._lock:
+                self._sites[(fam, str(site[1]))] = dict(info)
+
+    def on_scale(self, fn: Callable[[ScaleSignal], None]) -> Callable:
+        """Register a callback invoked with every tick's
+        :class:`ScaleSignal` (including ``steady``); returns ``fn``."""
+        self._sinks.append(fn)
+        return fn
+
+    def bind_router(self, router) -> None:
+        """Deliver this engine's scale signals to a
+        :class:`~paddle_tpu.serving.Router` (its ``on_scale_signal``
+        registration hook — the ROADMAP closing move)."""
+        self.on_scale(router.on_scale_signal)
+
+    # -- sampling -------------------------------------------------------------
+    def _snapshot_for(self, site: str) -> dict:
+        with self._lock:
+            snap = self._sites.get(("serving", site))
+            if snap is None:
+                snap = self._sites.get(("router", site))
+            return dict(snap) if snap else {}
+
+    def _sample(self, obj: Objective) -> Optional[Tuple[float, float]]:
+        """Cumulative (good, total) for one objective, or None when the
+        source has produced nothing yet."""
+        if obj.kind == "latency":
+            reg = self._registry or default_registry()
+            hist = reg.get(obj.histogram)
+            if hist is None or not isinstance(hist, _metrics.Histogram):
+                return None
+            child = dict(hist.children()).get(obj.labels)
+            if child is None:
+                return None
+            with child._lock:
+                counts = list(child.counts)
+                total = float(child.count)
+            if total <= 0:
+                return None
+            idx = bisect.bisect_left(hist.buckets, obj.threshold_ms)
+            good = float(sum(counts[:idx + 1]))
+            return good, total
+        snap = self._snapshot_for(obj.site)
+        if not snap:
+            return None
+        if obj.kind == "availability":
+            good = float(snap.get("completed", 0))
+            bad = float(sum(int(snap.get(k, 0)) for k in _BAD_KEYS))
+            total = good + bad
+            return (good, total) if total > 0 else None
+        # throughput: one sample per tick WITH decode activity (tokens
+        # advanced) — idle periods spend no budget
+        cum = self._thr_cum[obj.name]
+        tokens = float(snap.get("tokens", 0))
+        if tokens != cum[2]:
+            cum[2] = tokens
+            tps = float(snap.get("tokens_per_s", 0.0))
+            cum[0] += 1.0 if tps >= obj.floor else 0.0
+            cum[1] += 1.0
+        return (cum[0], cum[1]) if cum[1] > 0 else None
+
+    # -- evaluation -----------------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> dict:
+        """Sample every objective, evaluate the burn windows, export
+        gauges, publish the bus snapshot, and emit one scale signal.
+        Returns the snapshot dict."""
+        now = self._clock() if now is None else float(now)
+        reg = self._registry or default_registry()
+        g_burn = reg.gauge("paddle_tpu_slo_burn_rate",
+                           "error-budget burn rate (bad_frac / budget) "
+                           "per objective window",
+                           ("slo", "objective", "window"))
+        g_alert = reg.gauge("paddle_tpu_slo_alert",
+                            "1 while the objective's multi-window "
+                            "burn-rate alert fires", ("slo", "objective"))
+        g_goal = reg.gauge("paddle_tpu_slo_goal",
+                           "configured good-fraction goal",
+                           ("slo", "objective"))
+        g_ratio = reg.gauge("paddle_tpu_slo_good_ratio",
+                            "observed good fraction over the longest "
+                            "window", ("slo", "objective"))
+        alerting: List[str] = []
+        worst = ("", 0.0)
+        results: Dict[str, dict] = {}
+        for obj in self.objectives:
+            series = self._series[obj.name]
+            sample = self._sample(obj)
+            if sample is not None:
+                series.add(now, *sample)
+            max_burn, alert = 0.0, False
+            good_ratio, data = 1.0, False
+            for long_s, short_s, thr in obj.windows:
+                bad_l, n_l = series.window(now, long_s)
+                bad_s, n_s = series.window(now, short_s)
+                burn_l = bad_l / max(obj.budget, 1e-9)
+                burn_s = bad_s / max(obj.budget, 1e-9)
+                if n_l >= self._min_samples:
+                    data = True
+                    good_ratio = min(good_ratio, 1.0 - bad_l)
+                    max_burn = max(max_burn, burn_l)
+                    if (burn_l >= thr and burn_s >= thr
+                            and n_s >= self._min_samples):
+                        alert = True
+                g_burn.labels(self.name, obj.name,
+                              f"{int(long_s)}s").set(burn_l)
+            g_alert.labels(self.name, obj.name).set(1.0 if alert else 0.0)
+            g_goal.labels(self.name, obj.name).set(obj.goal)
+            g_ratio.labels(self.name, obj.name).set(good_ratio)
+            full = series.span_s() >= min(l for l, _, _ in obj.windows)
+            results[obj.name] = {"burn": max_burn, "alert": alert,
+                                 "good_ratio": good_ratio, "data": data,
+                                 "full_window": full}
+            if alert:
+                alerting.append(obj.name)
+                if max_burn >= worst[1]:
+                    worst = (obj.name, max_burn)
+        sig = self._decide(now, alerting, worst, results)
+        reg.gauge("paddle_tpu_slo_scale_signal",
+                  "latest scale verdict: 1 up / 0 steady / -1 down",
+                  ("slo",)).labels(self.name).set(
+            {"up": 1.0, "down": -1.0}.get(sig.direction, 0.0))
+        with self._lock:
+            self._results = results
+            self._counts["ticks"] += 1
+            self._counts["alerts"] += len(alerting)
+            if alerting and _is_warm():
+                self._counts["alerts_after_warm"] += len(alerting)
+            self._counts[f"scale_{sig.direction}_signals"] += 1
+            self._last_signal = sig
+        for fn in list(self._sinks):
+            try:
+                fn(sig)
+            except Exception:  # a broken sink must not stop evaluation
+                pass
+        snap = self.snapshot()
+        if trace_events.active():
+            trace_events.notify(("slo", self.name), snap)
+        return snap
+
+    def _decide(self, now, alerting, worst, results) -> ScaleSignal:
+        if alerting:
+            name, burn = worst
+            return ScaleSignal(
+                "up", f"{len(alerting)} objective(s) burning budget "
+                      f"above threshold ({', '.join(alerting)})",
+                name, burn, now)
+        with_data = [r for r in results.values() if r["data"]]
+        if (with_data and all(r["full_window"] for r in with_data)
+                and all(r["burn"] <= self._down_burn for r in with_data)):
+            burn = max((r["burn"] for r in with_data), default=0.0)
+            return ScaleSignal(
+                "down", f"all objectives under {self._down_burn}x burn "
+                        f"for a full window", "", burn, now)
+        burn = max((r["burn"] for r in with_data), default=0.0)
+        return ScaleSignal("steady", "within budget", "", burn, now)
+
+    # -- reporting ------------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Flat snapshot (bus + ``slo_stats``): tick/alert/signal
+        counters plus per-objective burn/alert fields (numeric, so the
+        observability bridge republishes them as gauges)."""
+        with self._lock:
+            snap = dict(self._counts)
+            results = {k: dict(v) for k, v in self._results.items()}
+            last = self._last_signal
+        snap["objectives"] = len(self.objectives)
+        snap["alerting"] = ",".join(
+            k for k, r in results.items() if r["alert"])
+        snap["max_burn"] = max(
+            (r["burn"] for r in results.values()), default=0.0)
+        snap["last_signal"] = last.direction if last else "none"
+        for k, r in results.items():
+            key = sanitize_name(k)
+            snap[f"{key}_burn"] = r["burn"]
+            snap[f"{key}_alert"] = 1 if r["alert"] else 0
+            snap[f"{key}_good_ratio"] = r["good_ratio"]
+        return snap
+
+    # -- background evaluation ------------------------------------------------
+    def start(self, interval_s: float = 5.0) -> "SloEngine":
+        """Evaluate every ``interval_s`` on a daemon thread (serving
+        deployments; tests drive :meth:`tick` directly)."""
+        self.install()
+        if self._thread is None:
+            self._stop.clear()
+
+            def _loop():
+                while not self._stop.wait(interval_s):
+                    try:
+                        self.tick()
+                    except Exception:  # keep the evaluator alive
+                        pass
+
+            self._thread = threading.Thread(
+                target=_loop, name=f"{self.name}-slo", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def close(self) -> None:
+        self.stop()
+        self.uninstall()
+
+
+def _is_warm() -> bool:
+    from ..resilience import retry as _retry_mod
+
+    return _retry_mod.is_warm()
+
+
+# -- profiler "SLO" summary section -------------------------------------------
+def _summary_section() -> str:
+    lines = []
+    for eng in sorted(list(_engines), key=lambda e: e.name):
+        with eng._lock:
+            counts = dict(eng._counts)
+            results = {k: dict(v) for k, v in eng._results.items()}
+            last = eng._last_signal
+        if not counts["ticks"]:
+            continue
+        lines.append(
+            f"  {eng.name:<12} ticks {counts['ticks']:>5}  alerts "
+            f"{counts['alerts']:>4} ({counts['alerts_after_warm']} after "
+            f"warm)  signals up/down/steady "
+            f"{counts['scale_up_signals']}/"
+            f"{counts['scale_down_signals']}/"
+            f"{counts['scale_steady_signals']}  last "
+            f"{last.direction if last else '-'}")
+        for name, r in sorted(results.items()):
+            lines.append(
+                f"    {name:<22} burn {r['burn']:>7.2f}x  good "
+                f"{r['good_ratio']:>7.2%}  "
+                f"{'ALERT' if r['alert'] else ('ok' if r['data'] else 'no data')}")
+    if not lines:
+        return ""
+    return "\n".join(["SLO"] + lines)
+
+
+_section_registered = [False]
+
+
+def _register_profiler_section() -> None:
+    if _section_registered[0]:
+        return
+    from .. import profiler
+
+    profiler.register_summary_section(_summary_section)
+    _section_registered[0] = True

@@ -1,0 +1,294 @@
+"""Distributed request tracing — where did request X spend its 40 ms?
+
+One logical request crosses four ownership boundaries on the serving
+path (``Router.submit`` → replica dispatch → ``MicroBatcher`` queue →
+decode slot), each on its own thread.  The metrics registry aggregates
+those hops; this module keeps them *joined*: a :class:`TraceContext`
+(``trace_id`` + parent ``span_id``) is created at ``Router.submit``,
+rides the request object through every layer, and each layer records its
+own span against it — failover attempts and hedges become sibling spans
+annotated with their outcome, the continuous-batching slot lifecycle
+becomes ``slot/admit`` / ``slot/decode`` (decode-step slices aggregated
+per slot) / ``slot/evict``.
+
+Spans land in a bounded per-process ring buffer (oldest dropped first,
+drops counted).  Three exits:
+
+* :func:`Tracer.chrome_events` — merged into
+  ``profiler.export_chrome_tracing`` output automatically (span ``ts``
+  shares the profiler's monotonic base, so trace spans line up with
+  ``RecordEvent`` spans in one timeline);
+* :func:`export_jsonl` — one span per line into the same
+  ``<base>.p<process_index>.jsonl`` layout as the metrics sink;
+* :func:`merge_chrome` — collates the per-process JSONL files into one
+  chrome trace (wall-clock aligned), the multihost lane of
+  ``exporters.merge_jsonl``.
+
+Discipline (PR 6): with tracing off every hook is ONE falsy check —
+producers test ``tracing._active is None`` (module attribute, no call)
+and requests carry ``trace=None``, so the serve path pays nothing.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = [
+    "TraceContext", "Span", "Tracer", "enable", "disable", "active",
+    "export_jsonl", "merge_chrome", "DEFAULT_BUFFER_CAP",
+]
+
+DEFAULT_BUFFER_CAP = 65536
+
+#: the live tracer — module ATTRIBUTE so hot paths gate on
+#: ``tracing._active is None`` without a function call
+_active: Optional["Tracer"] = None
+
+_ids = itertools.count(1)
+
+
+def _new_id() -> str:
+    """Process-unique span/trace id; the pid prefix keeps ids from
+    colliding across the per-process files :func:`merge_chrome` joins."""
+    return f"{os.getpid():x}-{next(_ids):x}"
+
+
+class TraceContext:
+    """What propagates: the trace plus the span to parent under."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.trace_id}, {self.span_id})"
+
+
+class Span:
+    """One open span; :meth:`end` (idempotent — first close wins, so a
+    hedge winner and a late ``_fail`` cannot double-record) computes the
+    duration and commits the record to the tracer's ring."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "kind",
+                 "args", "_t0", "_tracer", "_done")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 parent_id: Optional[str], kind: str,
+                 args: Optional[dict]):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = _new_id()
+        self.parent_id = parent_id
+        self.kind = kind
+        self.args = dict(args) if args else {}
+        self._t0 = time.monotonic()
+        self._tracer = tracer
+        self._done = False
+
+    def context(self) -> TraceContext:
+        """The context children (downstream layers) parent under."""
+        return TraceContext(self.trace_id, self.span_id)
+
+    def annotate(self, **kw) -> "Span":
+        self.args.update(kw)
+        return self
+
+    def end(self, **kw) -> None:
+        if self._done:
+            return
+        self._done = True
+        if kw:
+            self.args.update(kw)
+        t1 = time.monotonic()
+        self._tracer._commit(self.name, self.trace_id, self.span_id,
+                             self.parent_id, self.kind, self._t0,
+                             (t1 - self._t0) * 1e3, self.args)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.end(**({"outcome": f"error:{exc_type.__name__}"}
+                    if exc_type is not None else {}))
+
+
+class Tracer:
+    """Bounded per-process span ring + id minting.
+
+    Span records are plain dicts: ``ts`` (epoch seconds at span start —
+    the cross-process merge key), ``t0_us`` (monotonic microseconds —
+    the profiler-timeline key), ``dur_ms``, ``name``, ``trace_id``,
+    ``span_id``, ``parent_id``, ``kind``, ``pid``, ``tid``, ``args``.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_BUFFER_CAP):
+        self._lock = threading.Lock()
+        self._cap = max(int(capacity), 1)
+        self._buf: deque = deque()
+        self.started = 0
+        self.recorded = 0
+        self.dropped = 0
+
+    # -- span creation -------------------------------------------------------
+    def start_trace(self, name: str, kind: str = "request",
+                    **args) -> Span:
+        """Open a ROOT span (fresh ``trace_id``) — the router does this
+        once per accepted request."""
+        with self._lock:
+            self.started += 1
+        return Span(self, name, _new_id(), None, kind, args)
+
+    def start_span(self, name: str, parent: TraceContext,
+                   kind: str = "span", **args) -> Span:
+        """Open a child span under ``parent`` (e.g. one dispatch
+        attempt; siblings share the parent)."""
+        return Span(self, name, parent.trace_id, parent.span_id, kind,
+                    args)
+
+    def record(self, name: str, parent: TraceContext, t0_s: float,
+               dur_ms: float, kind: str = "span",
+               args: Optional[dict] = None) -> str:
+        """Commit an externally-timed span (``t0_s`` on the
+        monotonic/perf_counter base) under ``parent`` — the batcher and
+        slot loop time their phases themselves and record after the
+        fact."""
+        span_id = _new_id()
+        self._commit(name, parent.trace_id, span_id, parent.span_id,
+                     kind, t0_s, dur_ms, args)
+        return span_id
+
+    def _commit(self, name, trace_id, span_id, parent_id, kind, t0_s,
+                dur_ms, args) -> None:
+        rec = {
+            "ts": time.time() - dur_ms / 1e3,
+            "t0_us": t0_s * 1e6,
+            "dur_ms": float(dur_ms),
+            "name": name,
+            "trace_id": trace_id,
+            "span_id": span_id,
+            "parent_id": parent_id,
+            "kind": kind,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+        }
+        if args:
+            rec["args"] = dict(args)
+        with self._lock:
+            if len(self._buf) >= self._cap:
+                self._buf.popleft()
+                self.dropped += 1
+            self._buf.append(rec)
+            self.recorded += 1
+
+    # -- introspection / export ----------------------------------------------
+    def spans(self, trace_id: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            recs = list(self._buf)
+        if trace_id is not None:
+            recs = [r for r in recs if r["trace_id"] == trace_id]
+        return recs
+
+    def trace_ids(self) -> List[str]:
+        seen: Dict[str, None] = {}
+        for r in self.spans():
+            seen.setdefault(r["trace_id"])
+        return list(seen)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"capacity": self._cap, "buffered": len(self._buf),
+                    "started": self.started, "recorded": self.recorded,
+                    "dropped": self.dropped}
+
+    def chrome_events(self) -> List[dict]:
+        """Chrome ``traceEvents`` on the monotonic time base — what
+        ``profiler.export_chrome_tracing`` appends so request spans line
+        up with the host ``RecordEvent`` spans."""
+        return [_chrome_event(r, r["t0_us"]) for r in self.spans()]
+
+
+def _chrome_event(rec: dict, ts_us: float) -> dict:
+    args = {"trace_id": rec["trace_id"], "span_id": rec["span_id"],
+            "kind": rec["kind"]}
+    if rec.get("parent_id"):
+        args["parent_id"] = rec["parent_id"]
+    args.update(rec.get("args", {}))
+    return {"name": rec["name"], "ph": "X", "cat": "trace",
+            "pid": rec.get("pid", 0), "tid": rec.get("tid", 0),
+            "ts": round(ts_us, 3), "dur": round(rec["dur_ms"] * 1e3, 3),
+            "args": args}
+
+
+# -- module-level switch ------------------------------------------------------
+def enable(capacity: Optional[int] = None) -> Tracer:
+    """Turn request tracing on (idempotent: an existing tracer is kept
+    so enabling twice never drops buffered spans)."""
+    global _active
+    if _active is None:
+        if capacity is None:
+            from ..framework.flags import flag
+            capacity = int(flag("trace_buffer_cap"))
+        _active = Tracer(capacity)
+    return _active
+
+
+def disable() -> None:
+    """Tracing off: producers are back to one falsy check."""
+    global _active
+    _active = None
+
+
+def active() -> Optional[Tracer]:
+    return _active
+
+
+# -- cross-process export (the merge_jsonl lane) ------------------------------
+def export_jsonl(base: str, tracer: Optional[Tracer] = None,
+                 process_index: Optional[int] = None) -> str:
+    """Write the buffered spans one-JSON-per-line to the per-process
+    path (``trace.jsonl`` → ``trace.p<idx>.jsonl``); returns the path.
+    Every process exports its own file; :func:`merge_chrome` collates
+    them on the head node."""
+    from .exporters import process_jsonl_path
+
+    tr = tracer or _active
+    if tr is None:
+        raise RuntimeError("tracing is not enabled — nothing to export")
+    path = process_jsonl_path(base, process_index)
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "w") as f:
+        for rec in tr.spans():
+            f.write(json.dumps(rec) + "\n")
+    return path
+
+
+def merge_chrome(base_or_paths, out_path: str) -> int:
+    """Collate per-process span JSONL files into ONE chrome trace.
+
+    Uses the same glob/ordering contract as ``exporters.merge_jsonl``
+    (crash-tolerant: truncated trailing lines from a killed process are
+    skipped; records sort deterministically).  Cross-process alignment
+    uses the wall-clock ``ts`` (monotonic bases differ per process), so
+    spans from different hosts land on one timeline.  Returns the event
+    count written."""
+    from .exporters import merge_jsonl
+
+    records = [r for r in merge_jsonl(base_or_paths)
+               if isinstance(r, dict) and "trace_id" in r]
+    t0 = min((r["ts"] for r in records), default=0.0)
+    events = [_chrome_event(r, (r["ts"] - t0) * 1e6) for r in records]
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return len(events)

@@ -219,6 +219,14 @@ def export_chrome_tracing(path: str) -> int:
         if args:
             ev["args"] = args
         events.append(ev)
+    # request-tracing spans share the monotonic base (perf_counter and
+    # monotonic are both CLOCK_MONOTONIC on Linux), so they land on the
+    # same timeline as the RecordEvent spans
+    from .observability import tracing as _tracing
+
+    tr = _tracing._active
+    if tr is not None:
+        events.extend(tr.chrome_events())
     with open(path, "w") as f:
         json.dump({"traceEvents": events,
                    "displayTimeUnit": "ms",

@@ -41,6 +41,7 @@ from ..framework.errors import (
     ExecutionTimeoutError,
     UnavailableError,
 )
+from ..observability import tracing as _tracing
 from ..resilience.faults import fault_point
 from .metrics import ServingMetrics
 
@@ -55,10 +56,11 @@ class Request:
     """One queued inference request."""
 
     __slots__ = ("inputs", "shapes", "bucket", "future", "enqueue_t",
-                 "deadline_t", "meta", "span_id")
+                 "deadline_t", "meta", "span_id", "trace")
 
     def __init__(self, inputs: Sequence, bucket: int,
-                 deadline_ms: Optional[float] = None, meta=None):
+                 deadline_ms: Optional[float] = None, meta=None,
+                 trace_ctx=None):
         self.inputs = inputs
         self.shapes = tuple(tuple(getattr(x, "shape", ())) for x in inputs)
         self.bucket = bucket
@@ -68,6 +70,10 @@ class Request:
                            if deadline_ms is not None else None)
         self.meta = meta
         self.span_id = next(_span_ids)
+        # distributed-tracing parent (tracing.TraceContext) — None unless
+        # request tracing was enabled at submit, so the serve path pays
+        # nothing when tracing is off
+        self.trace = trace_ctx
 
 
 class MicroBatcher:
@@ -128,10 +134,12 @@ class MicroBatcher:
 
     # -- admission -----------------------------------------------------------
     def submit(self, inputs: Sequence, deadline_ms: Optional[float] = None,
-               meta=None) -> Future:
+               meta=None, trace_ctx=None) -> Future:
         """Enqueue one request; returns a Future of the runner's
         per-request result.  Sheds (raises ``UnavailableError``) when the
-        queue is full or the batcher is closed."""
+        queue is full or the batcher is closed.  ``trace_ctx`` is the
+        optional distributed-tracing parent the queue/execute spans are
+        recorded under."""
         bucket = self._router(inputs)  # may raise (e.g. bucket miss)
         with self._cv:
             if self._closing:
@@ -145,7 +153,7 @@ class MicroBatcher:
                     f"{self.metrics.name}: queue depth {self._depth} at "
                     f"limit {self._max_depth} — load shed (retry with "
                     f"backoff)")
-            req = Request(inputs, bucket, deadline_ms, meta)
+            req = Request(inputs, bucket, deadline_ms, meta, trace_ctx)
             self._pending.setdefault(bucket, deque()).append(req)
             self._depth += 1
             self._cv.notify()
@@ -383,6 +391,7 @@ class MicroBatcher:
         # serving spans line up with RecordEvent spans in one timeline.
         execute_ms = (done - t_exec) * 1e3
         tracing = profiler.profiling_active()
+        tr = _tracing._active
         for r, res in zip(live, results):
             queue_ms = (t_exec - r.enqueue_t) * 1e3
             self.metrics.observe_latency_ms((done - r.enqueue_t) * 1e3)
@@ -395,6 +404,12 @@ class MicroBatcher:
                 profiler.record_span(f"{self.metrics.name}/execute",
                                      t_exec, execute_ms,
                                      cat="serving", args=args)
+            if tr is not None and r.trace is not None:
+                targs = {"engine": self.metrics.name, "bucket": bucket}
+                tr.record("batcher/queue", r.trace, r.enqueue_t, queue_ms,
+                          kind="queue", args=targs)
+                tr.record("batcher/execute", r.trace, t_exec, execute_ms,
+                          kind="execute", args=targs)
             if not r.future.done():  # a timed-out drain may have failed it
                 r.future.set_result(res)
         self.metrics.observe_batch(len(live), cap, depth)

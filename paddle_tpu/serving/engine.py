@@ -202,10 +202,13 @@ class InferenceEngine:
                 for i, s in enumerate(b.shapes)]
 
     def submit(self, inputs: Sequence,
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               trace_ctx=None) -> Future:
         """Async inference: one UNBATCHED request (no leading batch dim);
-        resolves to the list of per-request outputs."""
-        return self._batcher.submit(inputs, deadline_ms=deadline_ms)
+        resolves to the list of per-request outputs.  ``trace_ctx``
+        optionally parents the batcher spans under a router trace."""
+        return self._batcher.submit(inputs, deadline_ms=deadline_ms,
+                                    trace_ctx=trace_ctx)
 
     def infer(self, inputs: Sequence,
               timeout: Optional[float] = None) -> List[np.ndarray]:

@@ -54,6 +54,7 @@ from ..framework.errors import (
 )
 from ..framework.flags import flag
 from ..nn.layer_base import functional_call
+from ..observability import tracing as _tracing
 from ..resilience import CircuitBreaker, RetryPolicy
 from ..resilience import retry as _retry_mod
 from ..resilience.faults import fault_point
@@ -304,6 +305,12 @@ class GenerationEngine:
                                  queue_ms, cat="serving", args=args)
             profiler.record_span(f"{self.name}/decode", s["t0"],
                                  execute_ms, cat="serving", args=args)
+        tr = _tracing._active
+        if tr is not None and r.trace is not None:
+            # one span per slot residency, decode-step slices aggregated
+            tr.record("slot/decode", r.trace, s["t0"], execute_ms,
+                      kind="decode", args={"engine": self.name,
+                                           "steps": len(s["out"])})
         if self.breaker is not None:
             self.breaker.record_success(0)
         if not r.future.done():
@@ -413,6 +420,22 @@ class GenerationEngine:
                                 jnp.asarray(ids), jnp.asarray(pp),
                                 jnp.asarray(lens), jnp.asarray(mask),
                                 cache, tok)
+                        tr = _tracing._active
+                        if tr is not None:
+                            adm_ms = (time.monotonic() - now) * 1e3
+                            for (r, _), i in zip(take, free):
+                                if r.trace is None:
+                                    continue
+                                tr.record("batcher/queue", r.trace,
+                                          r.enqueue_t,
+                                          (now - r.enqueue_t) * 1e3,
+                                          kind="queue",
+                                          args={"engine": self.name,
+                                                "bucket": r.bucket})
+                                tr.record("slot/admit", r.trace, now,
+                                          adm_ms, kind="prefill",
+                                          args={"engine": self.name,
+                                                "slot": i, "bucket": Sb})
                         pending.append((tok, targets))
                         self.metrics.incr("admitted", len(take))
                         self.metrics.incr("batches")
@@ -455,6 +478,7 @@ class GenerationEngine:
                         with profiler.RecordEvent(f"{self.name}/harvest"):
                             host = np.asarray(htok)  # the one device sync
                         finished = np.zeros((B,), bool)
+                        evicted_traces: List = []
                         now = time.monotonic()
                         for i, g in targets:
                             s = slots[i]
@@ -466,12 +490,21 @@ class GenerationEngine:
                                     or (self._eos is not None
                                         and t == self._eos)):
                                 finished[i] = True
+                                if s["req"].trace is not None:
+                                    evicted_traces.append(s["req"].trace)
                                 self._finish(s, now)
                                 slots[i] = None
                                 pos[i] = -1
                         if finished.any():
                             tok, cache = self._evict(
                                 tok, cache, jnp.asarray(finished))
+                            tr = _tracing._active
+                            if tr is not None and evicted_traces:
+                                ev_ms = (time.monotonic() - now) * 1e3
+                                for ctx in evicted_traces:
+                                    tr.record("slot/evict", ctx, now,
+                                              ev_ms, kind="evict",
+                                              args={"engine": self.name})
                             self.metrics.incr("evicted",
                                               int(finished.sum()))
                             self.metrics.publish()
@@ -547,6 +580,14 @@ class GenerationEngine:
             tok, cache = self._prefill(
                 self._params, self._buffers, jnp.asarray(ids),
                 jnp.asarray(positions), jnp.asarray(lens), cache)
+        tr = _tracing._active
+        if tr is not None:
+            pf_ms = (time.monotonic() - t0) * 1e3
+            for r in requests:
+                if r.trace is not None:
+                    tr.record("slot/prefill", r.trace, t0, pf_ms,
+                              kind="prefill",
+                              args={"engine": self.name, "bucket": Sb})
         out: List[List[int]] = [[] for _ in range(B)]
         done = np.array([i >= len(requests) for i in range(B)])
         n_tokens = 0
@@ -583,14 +624,18 @@ class GenerationEngine:
         return np.zeros((1,), np.int32)
 
     def submit(self, prompt_ids, max_new_tokens: int = 32,
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               trace_ctx=None) -> Future:
         """Async generation; resolves to the ``[<=max_new_tokens]`` int32
-        array of greedily decoded tokens (stops after ``eos_token_id``)."""
+        array of greedily decoded tokens (stops after ``eos_token_id``).
+        ``trace_ctx`` optionally parents the queue/slot spans under a
+        router trace."""
         if max_new_tokens < 1:
             raise InvalidArgumentError("max_new_tokens must be >= 1")
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         return self._batcher.submit((prompt,), deadline_ms=deadline_ms,
-                                    meta=int(max_new_tokens))
+                                    meta=int(max_new_tokens),
+                                    trace_ctx=trace_ctx)
 
     def generate(self, prompt_ids, max_new_tokens: int = 32,
                  timeout: Optional[float] = None) -> np.ndarray:

@@ -110,6 +110,16 @@ class ServingMetrics:
     def observe_latency_ms(self, ms: float):
         with self._lock:
             self._latency_ms.append(float(ms))
+        from .. import observability
+
+        if observability.enabled():
+            # the SLO engine's latency objectives read this histogram's
+            # cumulative buckets (Objective.latency); only completion
+            # winners reach here, so hedge losers never double-count
+            observability.default_registry().histogram(
+                "paddle_tpu_serving_latency_ms",
+                "end-to-end per-request latency (submit to completion)",
+                ("engine",)).labels(self.name).observe(ms)
 
     def observe_tokens(self, n: int, seconds: float):
         with self._lock:

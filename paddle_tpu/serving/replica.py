@@ -40,7 +40,7 @@ STATE_CODES = {HEALTHY: 0, UNHEALTHY: 1, DRAINING: 2, DRAINED: 3}
 
 _COUNTERS = ("dispatched", "completed", "failed", "probes",
              "probe_failures", "flaps", "readmissions", "hedges",
-             "failovers_in")
+             "failovers_in", "lost_races")
 
 
 class Replica:

@@ -56,6 +56,7 @@ from ..framework.errors import (
     UnavailableError,
     is_transient,
 )
+from ..observability import tracing as _tracing
 from ..resilience import circuit as _circuit
 from ..resilience import retry as _retry_mod
 from ..resilience.circuit import CircuitBreaker
@@ -73,7 +74,8 @@ _ROUTER_COUNTERS = (
     "hedges", "hedge_wins", "hedge_denied", "hedges_after_warm",
     "hedge_denied_after_warm", "replica_flaps", "replica_flaps_after_warm",
     "probes", "probe_failures", "readmissions", "drains", "drain_timeouts",
-    "weight_swaps",
+    "weight_swaps", "scale_up_signals", "scale_down_signals",
+    "scale_steady_signals",
 )
 
 #: live routers, for the profiler "Serving router" summary section
@@ -85,7 +87,7 @@ class _Flight:
     future plus the attempt bookkeeping failover/hedging needs."""
 
     __slots__ = ("inputs", "kw", "future", "t0", "deadline_t", "attempted",
-                 "live", "last_exc", "hedge_timer", "lock")
+                 "live", "last_exc", "hedge_timer", "lock", "span")
 
     def __init__(self, inputs, kw, t0, deadline_t):
         self.inputs = inputs
@@ -98,6 +100,7 @@ class _Flight:
         self.last_exc = None
         self.hedge_timer = None
         self.lock = threading.Lock()
+        self.span = None         # tracing root span (None unless tracing on)
 
 
 class Router:
@@ -186,6 +189,9 @@ class Router:
         self._hedge_budget_frac = float(hedge_budget_frac)
         self._timer_factory = (timer_factory
                                or (lambda d, fn: threading.Timer(d, fn)))
+
+        # -- SLO scale hooks (observability.slo feeds on_scale_signal) --
+        self._scale_hooks: List[Callable] = []
         _routers.add(self)
 
     # -- introspection -------------------------------------------------------
@@ -276,12 +282,29 @@ class Router:
             remaining = None
             if fl.deadline_t is not None:
                 remaining = max((fl.deadline_t - self._clock()) * 1e3, 0.0)
+            # one sibling span per attempt — primary/failover/hedge all
+            # share the root, annotated with their outcome on close
+            tr = _tracing._active
+            aspan = (tr.start_span("router/dispatch", fl.span.context(),
+                                   kind=kind, replica=rep.name)
+                     if tr is not None and fl.span is not None else None)
             try:
                 fault_point("router.dispatch")
-                fut = rep.engine.submit(fl.inputs, deadline_ms=remaining,
-                                        **fl.kw)
+                if aspan is not None:
+                    # trace_ctx only when an attempt span exists: engines
+                    # unaware of tracing never see the kwarg
+                    fut = rep.engine.submit(fl.inputs,
+                                            deadline_ms=remaining,
+                                            trace_ctx=aspan.context(),
+                                            **fl.kw)
+                else:
+                    fut = rep.engine.submit(fl.inputs,
+                                            deadline_ms=remaining, **fl.kw)
             except Exception as e:  # noqa: BLE001 — classified below
                 last = e
+                if aspan is not None:
+                    aspan.end(
+                        outcome=f"dispatch_error:{type(e).__name__}")
                 if self._failover_ok(e):
                     self._record_outcome(rep, ok=False)
                     self.metrics.incr("dispatch_failovers")
@@ -296,10 +319,10 @@ class Router:
                 fl.live += 1
             rep.begin(kind)
             fut.add_done_callback(
-                functools.partial(self._on_done, fl, rep, kind))
+                functools.partial(self._on_done, fl, rep, kind, aspan))
             return True
 
-    def _on_done(self, fl: _Flight, rep: Replica, kind: str,
+    def _on_done(self, fl: _Flight, rep: Replica, kind: str, aspan,
                  fut: Future) -> None:
         exc = fut.exception()
         rep.end(ok=exc is None)
@@ -311,7 +334,13 @@ class Router:
             try:
                 fl.future.set_result(fut.result())
             except InvalidStateError:
-                return  # another attempt already won this flight
+                # another attempt already won this flight — the losing
+                # attempt keeps its span (outcome=lost) but must not
+                # touch completion counters or latency quantiles
+                if aspan is not None:
+                    aspan.end(outcome="lost")
+                rep.count("lost_races")
+                return
             timer = fl.hedge_timer
             if timer is not None:
                 try:
@@ -322,8 +351,14 @@ class Router:
             if kind == "hedge":
                 self.metrics.incr("hedge_wins")
             self.metrics.observe_latency_ms((self._clock() - fl.t0) * 1e3)
+            if aspan is not None:
+                aspan.end(outcome="ok")
+            if fl.span is not None:
+                fl.span.end(outcome="ok", winner=kind)
             self._publish()
             return
+        if aspan is not None:
+            aspan.end(outcome=f"error:{type(exc).__name__}")
         eligible = self._failover_ok(exc)
         if eligible:
             self._record_outcome(rep, ok=False)
@@ -345,6 +380,8 @@ class Router:
             fl.future.set_exception(exc)
         except InvalidStateError:
             pass
+        if fl.span is not None:  # idempotent: a won flight already closed
+            fl.span.end(outcome=f"error:{type(exc).__name__}")
         self._publish()
 
     # -- passive health ------------------------------------------------------
@@ -492,10 +529,16 @@ class Router:
         deadline_t = (t0 + deadline_ms / 1e3
                       if deadline_ms is not None else None)
         fl = _Flight(inputs, engine_kw, t0, deadline_t)
+        tr = _tracing._active
+        if tr is not None:
+            fl.span = tr.start_trace("router/submit", kind="request",
+                                     router=self.name)
         try:
             self._dispatch(fl, kind="primary", sync=True)
-        except Exception:
+        except Exception as e:
             self.metrics.incr("rejected")
+            if fl.span is not None:
+                fl.span.end(outcome=f"rejected:{type(e).__name__}")
             self._publish()
             raise
         self.metrics.incr("accepted")
@@ -505,6 +548,31 @@ class Router:
     def infer(self, inputs, timeout: Optional[float] = None, **engine_kw):
         """Blocking :meth:`submit`."""
         return self.submit(inputs, **engine_kw).result(timeout)
+
+    # -- SLO scale signals ---------------------------------------------------
+    def register_scale_hook(self, fn: Callable) -> Callable:
+        """Register ``fn(signal)`` for every :meth:`on_scale_signal`
+        delivery (the seam a fleet autoscaler plugs into); returns ``fn``
+        so it can be used as a decorator."""
+        self._scale_hooks.append(fn)
+        return fn
+
+    def on_scale_signal(self, signal) -> None:
+        """Accept one ``observability.slo.ScaleSignal`` (the registration
+        hook ``SloEngine.bind_router`` wires up): count it, publish the
+        non-steady verdicts, and fan out to the registered hooks.  The
+        router does not resize itself — replica count is the deployment
+        layer's call; this is the audited hand-off point."""
+        key = {"up": "scale_up_signals", "down": "scale_down_signals"}.get(
+            getattr(signal, "direction", "steady"), "scale_steady_signals")
+        self.metrics.incr(key)
+        for fn in list(self._scale_hooks):
+            try:
+                fn(signal)
+            except Exception:  # noqa: BLE001 — a broken hook must not
+                pass           # break signal delivery
+        if key != "scale_steady_signals":
+            self._publish()
 
     def warmup(self) -> int:
         """Warm every replica engine (close its compile set), then run one

@@ -1,0 +1,720 @@
+"""End-to-end request tracing + SLO burn-rate engine.
+
+Covers the tracing layer (span trees, ring-buffer bounds, off-means-off,
+JSONL export + cross-process chrome merge, profiler timeline merge),
+its propagation through MicroBatcher and Router (failover and hedge
+attempts as sibling spans; the hedge loser never double-counts into
+latency quantiles), the SLO engine (latency / availability / throughput
+objectives, multi-window burn-rate alerting on an injected clock, scale
+signals delivered through the Router hook, ``paddle_tpu_slo_*`` gauges,
+analysis rule M903, the profiler "SLO" section), and the satellite
+hardening: per-metric label-cardinality caps with drop accounting and a
+crash-tolerant deterministic ``merge_jsonl``.
+"""
+import json
+import os
+import tempfile
+import threading
+import time
+import unittest
+from concurrent.futures import Future
+
+import numpy as np
+
+from paddle_tpu import observability as obs
+from paddle_tpu import profiler
+from paddle_tpu.analysis import RetraceMonitor
+from paddle_tpu.framework import trace_events
+from paddle_tpu.framework.errors import (
+    InvalidArgumentError,
+    TransientDeviceError,
+)
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import slo as slo_mod
+from paddle_tpu.observability import tracing
+from paddle_tpu.observability.exporters import merge_jsonl
+from paddle_tpu.observability.slo import Objective, SloEngine
+from paddle_tpu.resilience import retry as _retry_mod
+from paddle_tpu.serving import MicroBatcher, Router
+from paddle_tpu.serving.metrics import ServingMetrics
+
+
+class FakeEngine:
+    """Duck-typed replica engine (mirrors test_router's)."""
+
+    def __init__(self, result="ok", fail_with=None, manual=False):
+        self.result = result
+        self.fail_with = fail_with
+        self.manual = manual
+        self.pending = []
+        self.calls = 0
+        self.trace_ctxs = []
+
+    def synthetic_inputs(self):
+        return [np.zeros((1,), np.float32)]
+
+    def infer(self, inputs, timeout=None):
+        return [self.result]
+
+    def submit(self, inputs, deadline_ms=None, trace_ctx=None, **kw):
+        self.calls += 1
+        self.trace_ctxs.append(trace_ctx)
+        f = Future()
+        if self.manual:
+            self.pending.append(f)
+            return f
+        if self.fail_with is not None:
+            f.set_exception(self.fail_with)
+        else:
+            f.set_result(self.result)
+        return f
+
+    def resolve(self, i=0):
+        self.pending.pop(i).set_result(self.result)
+
+
+def make_router(engines, **kw):
+    kw.setdefault("probe_interval_s", None)
+    kw.setdefault("circuit_kw", {"failure_threshold": 1.0, "window": 2,
+                                 "cooldown_ms": 60_000,
+                                 "half_open_probes": 1})
+    return Router(engines, **kw)
+
+
+class TracingTestCase(unittest.TestCase):
+    def setUp(self):
+        obs.disable()
+        obs_metrics.set_default_registry(obs_metrics.MetricRegistry())
+
+    def tearDown(self):
+        obs.disable()
+        obs_metrics.set_default_registry(obs_metrics.MetricRegistry())
+
+
+class TestTracer(TracingTestCase):
+    def test_span_tree_shares_trace_id(self):
+        tr = tracing.enable(capacity=64)
+        root = tr.start_trace("router/submit", kind="request", router="r")
+        child = tr.start_span("router/dispatch", root.context(),
+                              kind="primary", replica="r[0]")
+        child.end(outcome="ok")
+        tr.record("batcher/queue", child.context(), time.monotonic(), 1.0,
+                  kind="queue")
+        root.end(outcome="ok")
+        spans = tr.spans()
+        self.assertEqual(len(spans), 3)
+        self.assertEqual(len({s["trace_id"] for s in spans}), 1)
+        by_name = {s["name"]: s for s in spans}
+        self.assertIsNone(by_name["router/submit"]["parent_id"])
+        self.assertEqual(by_name["router/dispatch"]["parent_id"],
+                         by_name["router/submit"]["span_id"])
+        self.assertEqual(by_name["batcher/queue"]["parent_id"],
+                         by_name["router/dispatch"]["span_id"])
+        self.assertEqual(by_name["router/dispatch"]["args"]["outcome"],
+                         "ok")
+
+    def test_span_end_is_idempotent(self):
+        tr = tracing.enable(capacity=64)
+        s = tr.start_trace("x")
+        s.end(outcome="ok")
+        s.end(outcome="error:late")  # the losing close must not re-record
+        spans = tr.spans()
+        self.assertEqual(len(spans), 1)
+        self.assertEqual(spans[0]["args"]["outcome"], "ok")
+
+    def test_ring_buffer_caps_and_counts_drops(self):
+        tr = tracing.enable(capacity=4)
+        root = tr.start_trace("root")
+        for i in range(10):
+            tr.record(f"s{i}", root.context(), time.monotonic(), 0.1)
+        st = tr.stats()
+        self.assertEqual(st["buffered"], 4)
+        self.assertEqual(st["dropped"], 6)
+        self.assertEqual([s["name"] for s in tr.spans()],
+                         ["s6", "s7", "s8", "s9"])
+
+    def test_enable_is_idempotent_and_disable_clears(self):
+        tr = tracing.enable(capacity=8)
+        self.assertIs(tracing.enable(), tr)
+        self.assertIs(tracing._active, tr)
+        tracing.disable()
+        self.assertIsNone(tracing._active)
+        self.assertIsNone(tracing.active())
+
+    def test_export_jsonl_and_merge_chrome(self):
+        tr = tracing.enable(capacity=64)
+        root = tr.start_trace("root")
+        tr.record("child", root.context(), time.monotonic(), 2.0)
+        root.end()
+        with tempfile.TemporaryDirectory() as d:
+            base = os.path.join(d, "trace.jsonl")
+            p = tracing.export_jsonl(base, process_index=0)
+            self.assertTrue(p.endswith(".p0.jsonl"))
+            out = os.path.join(d, "merged.json")
+            n = tracing.merge_chrome(base, out)
+            self.assertEqual(n, 2)
+            doc = json.load(open(out))
+            names = {e["name"] for e in doc["traceEvents"]}
+            self.assertEqual(names, {"root", "child"})
+            for e in doc["traceEvents"]:
+                self.assertIn("trace_id", e["args"])
+
+    def test_profiler_chrome_export_includes_trace_spans(self):
+        tr = tracing.enable(capacity=64)
+        root = tr.start_trace("traced/request")
+        root.end()
+        with profiler.profiler():
+            with profiler.RecordEvent("host/work"):
+                pass
+        with tempfile.TemporaryDirectory() as d:
+            out = os.path.join(d, "chrome.json")
+            profiler.export_chrome_tracing(out)
+            names = {e["name"] for e in json.load(open(out))["traceEvents"]}
+        self.assertIn("host/work", names)
+        self.assertIn("traced/request", names)
+
+
+class TestBatcherTracing(TracingTestCase):
+    def test_batcher_records_queue_and_execute_spans(self):
+        tr = tracing.enable(capacity=64)
+        root = tr.start_trace("router/submit")
+        mb = MicroBatcher(lambda inputs: 0,
+                          lambda bucket, reqs: [r.inputs for r in reqs],
+                          max_queue_delay_ms=0.0, name="trace-eng")
+        try:
+            fut = mb.submit((1,), trace_ctx=root.context())
+            fut.result(5.0)
+        finally:
+            mb.close()
+        names = {s["name"]: s for s in tr.spans()}
+        self.assertIn("batcher/queue", names)
+        self.assertIn("batcher/execute", names)
+        for n in ("batcher/queue", "batcher/execute"):
+            self.assertEqual(names[n]["trace_id"], root.trace_id)
+            self.assertEqual(names[n]["parent_id"], root.span_id)
+            self.assertEqual(names[n]["args"]["engine"], "trace-eng")
+
+    def test_tracing_off_records_nothing(self):
+        self.assertIsNone(tracing._active)
+        mb = MicroBatcher(lambda inputs: 0,
+                          lambda bucket, reqs: [0 for _ in reqs],
+                          max_queue_delay_ms=0.0)
+        try:
+            mb.submit((1,)).result(5.0)
+        finally:
+            mb.close()
+        tr = tracing.enable(capacity=8)  # fresh tracer, after the fact
+        self.assertEqual(tr.stats()["recorded"], 0)
+
+
+class TestRouterTracing(TracingTestCase):
+    def test_submit_creates_root_and_dispatch_spans(self):
+        tr = tracing.enable(capacity=64)
+        e = FakeEngine()
+        r = make_router([e])
+        try:
+            r.submit(1).result(5.0)
+        finally:
+            r.close()
+        spans = {s["name"]: s for s in tr.spans()}
+        self.assertIn("router/submit", spans)
+        self.assertIn("router/dispatch", spans)
+        self.assertEqual(spans["router/dispatch"]["parent_id"],
+                         spans["router/submit"]["span_id"])
+        self.assertEqual(spans["router/submit"]["args"]["winner"],
+                         "primary")
+        # the engine received the attempt span as its trace parent
+        self.assertEqual(e.trace_ctxs[0].span_id,
+                         spans["router/dispatch"]["span_id"])
+
+    def test_engines_see_no_trace_kwarg_when_tracing_off(self):
+        class Strict:
+            def __init__(self):
+                self.calls = 0
+
+            def submit(self, inputs, deadline_ms=None):  # no **kw
+                self.calls += 1
+                f = Future()
+                f.set_result("ok")
+                return f
+
+        r = make_router([Strict()])
+        try:
+            self.assertEqual(r.submit(1).result(5.0), "ok")
+        finally:
+            r.close()
+
+    def test_failover_attempts_are_sibling_spans(self):
+        tr = tracing.enable(capacity=64)
+        bad = FakeEngine(fail_with=TransientDeviceError("boom"))
+        good = FakeEngine(result="recovered")
+        r = make_router([bad, good], policy="least")
+        try:
+            self.assertEqual(r.submit(1).result(5.0), "recovered")
+        finally:
+            r.close()
+        dispatches = [s for s in tr.spans()
+                      if s["name"] == "router/dispatch"]
+        self.assertEqual(len(dispatches), 2)
+        self.assertEqual(len({s["parent_id"] for s in dispatches}), 1)
+        outcomes = {s["kind"]: s["args"]["outcome"] for s in dispatches}
+        self.assertEqual(outcomes["primary"],
+                         "error:TransientDeviceError")
+        self.assertEqual(outcomes["failover"], "ok")
+        root = [s for s in tr.spans() if s["name"] == "router/submit"][0]
+        self.assertEqual(root["args"]["winner"], "failover")
+
+    def test_hedge_loser_span_without_double_counting(self):
+        tr = tracing.enable(capacity=64)
+        a, b = FakeEngine(manual=True), FakeEngine(manual=True)
+        timers = []
+
+        class T:
+            def __init__(self, fn):
+                self.fn = fn
+
+            def start(self):
+                timers.append(self)
+
+            def cancel(self):
+                pass
+
+        r = make_router([a, b], policy="least", hedge=True,
+                        hedge_delay_ms=1.0,
+                        timer_factory=lambda d, fn: T(fn))
+        try:
+            fut = r.submit(1)
+            timers[0].fn()                      # fire the hedge now
+            self.assertEqual(a.calls + b.calls, 2)
+            primary = a if a.pending else b
+            hedge = b if primary is a else a
+            primary.resolve()                   # primary wins the race
+            fut.result(5.0)
+            hedge.resolve()                     # loser completes late
+            for _ in range(100):                # let the callback land
+                if any(rep.snapshot().get("lost_races")
+                       for rep in r.replicas):
+                    break
+                time.sleep(0.01)
+            snap = r.metrics.snapshot()
+            self.assertEqual(snap["completed"], 1)
+            self.assertEqual(snap["hedges"], 1)
+            self.assertEqual(snap["hedge_wins"], 0)
+            # exactly ONE latency sample — the loser never double-counts
+            self.assertEqual(len(r.metrics._latency_ms), 1)
+            self.assertEqual(sum(rep.snapshot().get("lost_races", 0)
+                                 for rep in r.replicas), 1)
+        finally:
+            r.close()
+        dispatches = [s for s in tr.spans()
+                      if s["name"] == "router/dispatch"]
+        self.assertEqual(len(dispatches), 2)
+        outcomes = sorted(s["args"]["outcome"] for s in dispatches)
+        self.assertEqual(outcomes, ["lost", "ok"])
+        kinds = {s["kind"] for s in dispatches}
+        self.assertEqual(kinds, {"primary", "hedge"})
+
+    def test_hedge_loser_skips_latency_histogram(self):
+        obs.enable()  # registry mirror on: winner-only observation
+        a, b = FakeEngine(manual=True), FakeEngine(manual=True)
+        timers = []
+
+        class T:
+            def __init__(self, fn):
+                self.fn = fn
+
+            def start(self):
+                timers.append(self)
+
+            def cancel(self):
+                pass
+
+        r = make_router([a, b], policy="least", hedge=True,
+                        hedge_delay_ms=1.0,
+                        timer_factory=lambda d, fn: T(fn))
+        try:
+            fut = r.submit(1)
+            timers[0].fn()
+            (a if a.pending else b).resolve()
+            fut.result(5.0)
+            (a if a.pending else b).resolve()
+            time.sleep(0.05)
+            hist = obs.default_registry().get(
+                "paddle_tpu_serving_latency_ms")
+            self.assertIsNotNone(hist)
+            child = dict(hist.children())[(r.name,)]
+            self.assertEqual(child.count, 1)
+        finally:
+            r.close()
+
+    def test_rejected_submit_closes_root_span(self):
+        tr = tracing.enable(capacity=64)
+        e = FakeEngine()
+        e.raise_sync = InvalidArgumentError("bad input")
+        e.submit = lambda *a, **k: (_ for _ in ()).throw(
+            InvalidArgumentError("bad input"))
+        r = make_router([e])
+        try:
+            with self.assertRaises(InvalidArgumentError):
+                r.submit(1)
+        finally:
+            r.close()
+        root = [s for s in tr.spans() if s["name"] == "router/submit"]
+        self.assertEqual(len(root), 1)
+        self.assertTrue(
+            root[0]["args"]["outcome"].startswith("rejected:"))
+
+
+class TestScaleHooks(TracingTestCase):
+    def test_router_counts_and_fans_out_signals(self):
+        r = make_router([FakeEngine()])
+        got = []
+        try:
+            r.register_scale_hook(got.append)
+            up = slo_mod.ScaleSignal("up", "burning", "p99", 14.4, 0.0)
+            r.on_scale_signal(up)
+            r.on_scale_signal(
+                slo_mod.ScaleSignal("down", "quiet", "", 0.0, 1.0))
+            r.on_scale_signal(
+                slo_mod.ScaleSignal("steady", "ok", "", 0.2, 2.0))
+            snap = r.metrics.snapshot()
+            self.assertEqual(snap["scale_up_signals"], 1)
+            self.assertEqual(snap["scale_down_signals"], 1)
+            self.assertEqual(snap["scale_steady_signals"], 1)
+            self.assertEqual([s.direction for s in got],
+                             ["up", "down", "steady"])
+        finally:
+            r.close()
+
+    def test_broken_hook_does_not_break_delivery(self):
+        r = make_router([FakeEngine()])
+        got = []
+        try:
+            r.register_scale_hook(
+                lambda s: (_ for _ in ()).throw(RuntimeError("boom")))
+            r.register_scale_hook(got.append)
+            r.on_scale_signal(slo_mod.ScaleSignal("up", "", "", 1.0, 0.0))
+            self.assertEqual(len(got), 1)
+        finally:
+            r.close()
+
+
+class TestSloEngine(TracingTestCase):
+    def _latency_engine(self, reg, clk, goal=0.99,
+                        windows=((60.0, 10.0, 10.0),), **kw):
+        return SloEngine(
+            [Objective.latency("p99_latency", threshold_ms=50.0,
+                               engine="e1", goal=goal, windows=windows)],
+            registry=reg, clock=lambda: clk[0], **kw)
+
+    def test_objective_validation(self):
+        with self.assertRaises(InvalidArgumentError):
+            Objective("x", "latency", goal=1.5)
+        with self.assertRaises(InvalidArgumentError):
+            Objective("x", "latency", goal=0.99,
+                      windows=((10.0, 60.0, 14.4),))  # short >= long
+        with self.assertRaises(InvalidArgumentError):
+            SloEngine([])
+        o = Objective.latency("p", threshold_ms=50)
+        with self.assertRaises(InvalidArgumentError):
+            SloEngine([o, Objective.latency("p", threshold_ms=10)])
+
+    def test_latency_burn_rate_alert_and_recovery(self):
+        reg = obs_metrics.MetricRegistry()
+        clk = [0.0]
+        eng = self._latency_engine(reg, clk)
+        h = reg.histogram("paddle_tpu_serving_latency_ms", "",
+                          ("engine",))
+        for _ in range(100):
+            h.labels("e1").observe(5.0)
+        snap = eng.tick()
+        self.assertEqual(snap["p99_latency_alert"], 0)
+        clk[0] += 5.0
+        for _ in range(100):
+            h.labels("e1").observe(500.0)  # 50% bad -> 50x burn
+        snap = eng.tick()
+        self.assertEqual(snap["p99_latency_alert"], 1)
+        self.assertGreater(snap["p99_latency_burn"], 10.0)
+        self.assertEqual(snap["last_signal"], "up")
+        # recovery: a long healthy stretch drains both windows
+        for _ in range(30):
+            clk[0] += 5.0
+            for _ in range(200):
+                h.labels("e1").observe(5.0)
+            snap = eng.tick()
+        self.assertEqual(snap["p99_latency_alert"], 0)
+        eng.close()
+
+    def test_slo_gauges_exported(self):
+        reg = obs_metrics.MetricRegistry()
+        clk = [0.0]
+        eng = self._latency_engine(reg, clk)
+        reg.histogram("paddle_tpu_serving_latency_ms", "",
+                      ("engine",)).labels("e1").observe(5.0)
+        eng.tick()
+        for name in ("paddle_tpu_slo_burn_rate", "paddle_tpu_slo_alert",
+                     "paddle_tpu_slo_goal", "paddle_tpu_slo_good_ratio",
+                     "paddle_tpu_slo_scale_signal"):
+            self.assertIsNotNone(reg.get(name), name)
+        g = reg.get("paddle_tpu_slo_goal")
+        self.assertEqual(
+            dict(g.children())[(eng.name, "p99_latency")].value, 0.99)
+        eng.close()
+
+    def test_availability_objective_from_bus_snapshots(self):
+        reg = obs_metrics.MetricRegistry()
+        clk = [0.0]
+        eng = SloEngine(
+            [Objective.availability("avail", site="e1", goal=0.9,
+                                    windows=((60.0, 10.0, 5.0),))],
+            registry=reg, clock=lambda: clk[0])
+        eng.install()
+        try:
+            trace_events.notify(("serving", "e1"),
+                                {"completed": 100, "errors": 0})
+            eng.tick()
+            clk[0] += 5.0
+            trace_events.notify(("serving", "e1"),
+                                {"completed": 100, "errors": 80,
+                                 "shed": 20})
+            snap = eng.tick()
+            self.assertEqual(snap["avail_alert"], 1)
+            self.assertEqual(snap["last_signal"], "up")
+        finally:
+            eng.close()
+
+    def test_throughput_floor_objective(self):
+        reg = obs_metrics.MetricRegistry()
+        clk = [0.0]
+        eng = SloEngine(
+            [Objective.throughput("tps", site="e1",
+                                  floor_tokens_per_s=100.0, goal=0.5,
+                                  windows=((60.0, 10.0, 1.5),))],
+            registry=reg, clock=lambda: clk[0])
+        eng.install()
+        try:
+            tokens = 0
+            for _ in range(4):  # every tick below the floor spends budget
+                tokens += 10
+                trace_events.notify(
+                    ("serving", "e1"),
+                    {"tokens": tokens, "tokens_per_s": 20.0})
+                eng.tick()
+                clk[0] += 3.0
+            snap = eng.snapshot()
+            self.assertEqual(snap["tps_alert"], 1)
+            # idle ticks (tokens unchanged) must NOT spend budget
+            before = dict(eng._thr_cum)
+            eng.tick()
+            self.assertEqual(eng._thr_cum, before)
+        finally:
+            eng.close()
+
+    def test_scale_signal_down_after_quiet_full_window(self):
+        reg = obs_metrics.MetricRegistry()
+        clk = [0.0]
+        eng = self._latency_engine(reg, clk,
+                                   windows=((20.0, 5.0, 10.0),))
+        h = reg.histogram("paddle_tpu_serving_latency_ms", "",
+                          ("engine",))
+        sigs = []
+        eng.on_scale(sigs.append)
+        for _ in range(10):
+            for _ in range(50):
+                h.labels("e1").observe(5.0)
+            eng.tick()
+            clk[0] += 5.0
+        self.assertEqual(sigs[-1].direction, "down")
+        self.assertIn("steady", [s.direction for s in sigs])
+        eng.close()
+
+    def test_bind_router_delivers_signals(self):
+        reg = obs_metrics.MetricRegistry()
+        clk = [0.0]
+        r = make_router([FakeEngine()])
+        eng = self._latency_engine(reg, clk)
+        try:
+            eng.bind_router(r)
+            h = reg.histogram("paddle_tpu_serving_latency_ms", "",
+                              ("engine",))
+            for _ in range(100):
+                h.labels("e1").observe(500.0)
+            eng.tick()
+            clk[0] += 5.0
+            for _ in range(100):
+                h.labels("e1").observe(500.0)
+            eng.tick()
+            self.assertGreaterEqual(
+                r.metrics.snapshot()["scale_up_signals"], 1)
+        finally:
+            eng.close()
+            r.close()
+
+    def test_m903_fires_after_warm_burn(self):
+        reg = obs_metrics.MetricRegistry()
+        clk = [0.0]
+        was_warm = _retry_mod._warm
+        mon = RetraceMonitor().install()
+        eng = self._latency_engine(reg, clk)
+        eng.install()
+        try:
+            _retry_mod.mark_warm()
+            h = reg.histogram("paddle_tpu_serving_latency_ms", "",
+                              ("engine",))
+            for _ in range(100):
+                h.labels("e1").observe(500.0)
+            eng.tick()
+            clk[0] += 5.0
+            for _ in range(100):
+                h.labels("e1").observe(500.0)
+            eng.tick()
+            stats = mon.slo_stats(eng.name)
+            self.assertGreaterEqual(stats.get("alerts_after_warm", 0), 1)
+            rules = [d.rule for d in mon.diagnostics()]
+            self.assertIn("M903", rules)
+            m903 = [d for d in mon.diagnostics() if d.rule == "M903"][0]
+            self.assertIn("budget", m903.message)
+        finally:
+            _retry_mod._warm = was_warm
+            eng.close()
+            mon.uninstall()
+
+    def test_no_m903_when_alerts_precede_warmup(self):
+        reg = obs_metrics.MetricRegistry()
+        clk = [0.0]
+        was_warm = _retry_mod._warm
+        mon = RetraceMonitor().install()
+        eng = self._latency_engine(reg, clk)
+        eng.install()
+        try:
+            _retry_mod._warm = False
+            h = reg.histogram("paddle_tpu_serving_latency_ms", "",
+                              ("engine",))
+            for _ in range(100):
+                h.labels("e1").observe(500.0)
+            eng.tick()
+            clk[0] += 5.0
+            for _ in range(100):
+                h.labels("e1").observe(500.0)
+            eng.tick()
+            self.assertNotIn("M903",
+                             [d.rule for d in mon.diagnostics()])
+        finally:
+            _retry_mod._warm = was_warm
+            eng.close()
+            mon.uninstall()
+
+    def test_profiler_summary_has_slo_section(self):
+        reg = obs_metrics.MetricRegistry()
+        clk = [0.0]
+        eng = self._latency_engine(reg, clk)
+        reg.histogram("paddle_tpu_serving_latency_ms", "",
+                      ("engine",)).labels("e1").observe(5.0)
+        eng.tick()
+        text = profiler.summary()
+        self.assertIn("SLO", text)
+        self.assertIn("p99_latency", text)
+        eng.close()
+
+    def test_start_stop_background_thread(self):
+        reg = obs_metrics.MetricRegistry()
+        eng = SloEngine(
+            [Objective.latency("p", threshold_ms=50.0, engine="e1")],
+            registry=reg)
+        eng.start(interval_s=0.01)
+        for _ in range(200):
+            if eng.snapshot()["ticks"] > 0:
+                break
+            time.sleep(0.01)
+        self.assertGreater(eng.snapshot()["ticks"], 0)
+        eng.close()
+        self.assertIsNone(eng._thread)
+
+
+class TestLabelCardinalityCap(TracingTestCase):
+    def test_counter_overflow_routes_and_counts(self):
+        reg = obs_metrics.MetricRegistry(max_label_children=2)
+        c = reg.counter("t_total", "", ("k",))
+        c.labels("a").inc()
+        c.labels("b").inc()
+        c.labels("c").inc()      # past the cap
+        c.labels("d").inc(2.0)   # shares the overflow child
+        c.labels("a").inc()      # existing children stay addressable
+        samples = {tuple(sorted(l.items())): v for _, l, v in c.expose()}
+        self.assertEqual(samples[(("k", "a"),)], 2.0)
+        self.assertEqual(
+            samples[(("k", "other"), ("overflow", "true"))], 3.0)
+        drop = reg.get(obs_metrics.DROPPED_LABELS_COUNTER)
+        self.assertEqual(
+            {l["metric"]: v for _, l, v in drop.expose()},
+            {"t_total": 2.0})
+
+    def test_histogram_overflow_exposes_overflow_child(self):
+        reg = obs_metrics.MetricRegistry(max_label_children=1)
+        h = reg.histogram("h_ms", "", ("k",))
+        h.labels("a").observe(1.0)
+        h.labels("b").observe(2.0)
+        rows = h.expose()
+        over = [l for _, l, _ in rows if l.get("overflow") == "true"]
+        self.assertTrue(over)
+        self.assertTrue(all(l["k"] == "other" for l in over))
+
+    def test_drop_counter_itself_is_uncapped(self):
+        reg = obs_metrics.MetricRegistry(max_label_children=1)
+        for i in range(5):
+            c = reg.counter(f"m{i}_total", "", ("k",))
+            c.labels("a").inc()
+            c.labels("b").inc()  # each metric overflows once
+        drop = reg.get(obs_metrics.DROPPED_LABELS_COUNTER)
+        self.assertEqual(len(drop.children()), 5)
+
+    def test_unlabeled_metrics_unaffected(self):
+        reg = obs_metrics.MetricRegistry(max_label_children=1)
+        g = reg.gauge("g1", "")
+        g.set(7.0)
+        self.assertEqual(g.expose(), [("g1", {}, 7.0)])
+
+
+class TestMergeJsonl(TracingTestCase):
+    def test_skips_truncated_lines_and_sorts_deterministically(self):
+        with tempfile.TemporaryDirectory() as d:
+            base = os.path.join(d, "m.jsonl")
+            with open(os.path.join(d, "m.p0.jsonl"), "w") as f:
+                f.write(json.dumps({"ts": 2.0, "process_index": 0}) + "\n")
+                f.write(json.dumps({"ts": 1.0, "process_index": 0}) + "\n")
+                f.write('{"ts": 3.0, "process_in')  # killed mid-write
+            with open(os.path.join(d, "m.p1.jsonl"), "w") as f:
+                f.write(json.dumps({"ts": 1.0, "process_index": 1}) + "\n")
+                f.write("\n")
+            recs = merge_jsonl(base)
+            self.assertEqual(len(recs), 3)  # truncated line skipped
+            self.assertEqual([(r["ts"], r["process_index"]) for r in recs],
+                             [(1.0, 0), (1.0, 1), (2.0, 0)])
+            # same input -> byte-identical merged output
+            out1 = os.path.join(d, "o1.jsonl")
+            out2 = os.path.join(d, "o2.jsonl")
+            merge_jsonl(base, out1)
+            merge_jsonl(base, out2)
+            self.assertEqual(open(out1).read(), open(out2).read())
+
+
+class TestServingLatencyMirror(TracingTestCase):
+    def test_observe_latency_feeds_registry_histogram(self):
+        obs.enable()
+        m = ServingMetrics("mirror-eng")
+        m.observe_latency_ms(12.0)
+        m.observe_latency_ms(700.0)
+        h = obs.default_registry().get("paddle_tpu_serving_latency_ms")
+        child = dict(h.children())[("mirror-eng",)]
+        self.assertEqual(child.count, 2)
+        self.assertAlmostEqual(child.sum, 712.0)
+
+    def test_off_means_no_histogram(self):
+        m = ServingMetrics("quiet-eng")
+        m.observe_latency_ms(12.0)
+        self.assertIsNone(
+            obs.default_registry().get("paddle_tpu_serving_latency_ms"))
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -16,9 +16,10 @@
 #   obs-smoke tools/obs_smoke.py   (metrics scrape + JSONL sink + serving spans)
 #   router-smoke tools/router_smoke.py (replica kill -> zero-loss failover + rolling swap)
 #   gen-smoke tools/gen_smoke.py (continuous batching: HOL p99, zero recompiles, probes)
+#   slo-smoke tools/slo_smoke.py (request tracing end-to-end + SLO burn-rate alert)
 #   bench   python bench.py          (only when a real TPU answers)
 #
-# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|chaos-smoke|obs-smoke|router-smoke|gen-smoke|bench]...
+# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|chaos-smoke|obs-smoke|router-smoke|gen-smoke|slo-smoke|bench]...
 #         tools/run_gates.sh --only suite
 # Exit code: 0 iff every stage that ran passed.
 set -u
@@ -115,6 +116,10 @@ run_stage router-smoke env JAX_PLATFORMS=cpu python tools/router_smoke.py
 # p99 at least 2x better than the legacy run-to-completion path, zero lost
 # requests, zero post-warmup XLA recompiles, router probes stay green
 run_stage gen-smoke env JAX_PLATFORMS=cpu python tools/gen_smoke.py
+# request tracing + SLO: full router->slot span tree in the merged chrome
+# export with zero post-warmup compiles, injected decode latency -> burn-rate
+# alert + M903 + scale-up signal through the router hook, off means off
+run_stage slo-smoke env JAX_PLATFORMS=cpu python tools/slo_smoke.py
 
 # bench only when a real accelerator answers within 60s
 if want bench; then
