@@ -43,6 +43,7 @@ class GradScaler:
         self._decr_every = decr_every_n_nan_or_inf
         self._dynamic = use_dynamic_loss_scaling
         self._state = self.init_state()
+        self._skipped_steps = 0  # inf/nan steps skipped by eager step()
 
     # -- functional core -----------------------------------------------------
     def init_state(self) -> Dict[str, jax.Array]:
@@ -120,9 +121,12 @@ class GradScaler:
         vals = [v for _, v in items]
         unscaled, found_inf = self.unscale_and_check(vals, self._state)
         self._found_inf = bool(found_inf)
-        if not self._found_inf:
+        if self._found_inf:
+            self._skipped_steps += 1
+        else:
             out = dict(zip(keys, unscaled)) if is_dict else list(unscaled)
             optimizer.step(out)
+        self._publish()
 
     def update(self):
         if self._enable and self._dynamic:
@@ -130,6 +134,23 @@ class GradScaler:
                 jnp.asarray,
                 self.next_state(self._state, jnp.asarray(getattr(self, "_found_inf", False))),
             )
+            self._publish()
+
+    def _publish(self) -> None:
+        """Snapshot scale + skip counters onto the trace-events bus as an
+        ``("amp", "grad_scaler")`` event — latest value wins at consumers
+        (RetraceMonitor.amp_stats).  Gated on an active observer so the
+        common no-dashboard path pays one falsy check, no device syncs."""
+        from ..framework import trace_events
+
+        if not trace_events.active():
+            return
+        trace_events.notify(("amp", "grad_scaler"), {
+            "scale": float(self._state["scale"]),
+            "skipped_steps": int(self._skipped_steps),
+            "good_steps": int(self._state["good_steps"]),
+            "bad_steps": int(self._state["bad_steps"]),
+        })
 
     def minimize(self, optimizer, scaled_loss=None, grads=None):
         self.step(optimizer, grads)

@@ -68,6 +68,11 @@ class RetraceMonitor:
         self._steptrace_sites: Dict[str, dict] = {}
         # ("slo", name) SLO-engine snapshots: latest per engine (rule M903)
         self._slo_sites: Dict[str, dict] = {}
+        # ("supervisor", name) divergence-guard counter snapshots: latest
+        # per supervisor (rule F802)
+        self._supervisor_sites: Dict[str, dict] = {}
+        # ("amp", name) grad-scaler snapshots: latest per scaler
+        self._amp_sites: Dict[str, dict] = {}
 
     # -- subscription --------------------------------------------------------
     def install(self):
@@ -127,6 +132,16 @@ class RetraceMonitor:
             # SLO-engine tick snapshot: cumulative counters, latest wins
             with self._lock:
                 self._slo_sites[key[1]] = dict(info)
+            return
+        if key[0] == "supervisor":
+            # divergence-guard counter snapshot: cumulative, latest wins
+            with self._lock:
+                self._supervisor_sites[key[1]] = dict(info)
+            return
+        if key[0] == "amp":
+            # grad-scaler snapshot (scale, skipped steps): latest wins
+            with self._lock:
+                self._amp_sites[key[1]] = dict(info)
             return
         sig = _freeze(info)
         with self._lock:
@@ -203,6 +218,25 @@ class RetraceMonitor:
             if name is not None:
                 return dict(self._slo_sites.get(name, {}))
             return {k: dict(v) for k, v in self._slo_sites.items()}
+
+    def supervisor_stats(self, name: str = None):
+        """Latest training-supervisor counter snapshot(s) observed
+        (rollbacks, repeat trips, skipped batches, exact resumes, watchdog
+        trips, fatal divergences): the dict for one supervisor (``name``
+        like ``"supervisor"``), or all of them."""
+        with self._lock:
+            if name is not None:
+                return dict(self._supervisor_sites.get(name, {}))
+            return {k: dict(v) for k, v in self._supervisor_sites.items()}
+
+    def amp_stats(self, name: str = None):
+        """Latest grad-scaler snapshot(s) observed (loss scale, skipped
+        steps, good/bad step counters): the dict for one scaler (``name``
+        like ``"grad_scaler"``), or all of them."""
+        with self._lock:
+            if name is not None:
+                return dict(self._amp_sites.get(name, {}))
+            return {k: dict(v) for k, v in self._amp_sites.items()}
 
     def diagnostics(self) -> List[Diagnostic]:
         out = DiagnosticCollector()
@@ -440,6 +474,30 @@ class RetraceMonitor:
                          "layer) or find the regression behind the burn "
                          "(latency: check K701/F801/S60x; availability: "
                          "check shed and circuit counters)")
+        with self._lock:
+            sup_sites = {k: dict(v)
+                         for k, v in self._supervisor_sites.items()}
+        for name, stats in sup_sites.items():
+            repeats = int(stats.get("repeat_trips", 0))
+            if repeats < 1:
+                continue
+            out.add("F802",
+                    f"training supervisor {name!r} re-diverged "
+                    f"{repeats} time(s) after rolling back to the same "
+                    f"checkpoint ({stats.get('rollbacks', 0)} rollbacks, "
+                    f"{stats.get('skipped_batches', 0)} batches skipped, "
+                    f"{stats.get('fatal_divergences', 0)} fatal) — a "
+                    f"rollback loop means the divergence is reproducible "
+                    f"from the restored state, so restarting cannot fix "
+                    f"it: the cause is the model/optimizer state or the "
+                    f"data, not a transient fault",
+                    location=Location(file=name, function=name),
+                    hint="widen the poison window "
+                         "(TrainingSupervisor(skip_batches=...)) if a bad "
+                         "data shard spans several batches; otherwise "
+                         "lower the learning rate / loss scale or inspect "
+                         "the checkpoint itself — the restored state is "
+                         "already on the divergence trajectory")
         return out.diagnostics
 
     @staticmethod

@@ -18,6 +18,7 @@ directly: ``psum``/``pmean``/``pmax``/``ppermute``/``all_to_all`` re-exports.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import List, Optional
 
 import jax
@@ -48,7 +49,8 @@ def shard_map(f, mesh, in_specs, out_specs, axis_names=None):
     return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                       check_vma=False, **kw)
 
-from ..framework.errors import InvalidArgumentError
+from ..framework.errors import InvalidArgumentError, TransientDeviceError
+from ..framework.flags import flag as _flag
 from .mesh import get_mesh
 
 __all__ = [
@@ -109,6 +111,57 @@ def _group_axis(group) -> str:
     return getattr(group, "axis", "data")
 
 
+def _watchdog(fn):
+    """Straggler watchdog: with FLAGS_collective_timeout_s set, the wrapped
+    collective runs (through device completion — block_until_ready) in a
+    worker thread under a deadline; a wedged interconnect raises
+    ``TransientDeviceError`` into the retry/restart path instead of
+    hanging the rank forever.  Disabled (the default 0.0) the wrapper is a
+    single falsy flag check."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        timeout = _flag("collective_timeout_s")
+        if not timeout:
+            return fn(*args, **kwargs)
+        done = threading.Event()
+        box: dict = {}
+
+        def _run():
+            try:
+                box["value"] = jax.block_until_ready(fn(*args, **kwargs))
+            except BaseException as e:  # surfaced in the caller below
+                box["error"] = e
+            finally:
+                done.set()
+
+        # daemon: a wedged device call may never return — the thread must
+        # not block interpreter shutdown after the deadline fires
+        t = threading.Thread(target=_run, daemon=True,
+                             name=f"collective-watchdog-{fn.__name__}")
+        t.start()
+        if not done.wait(float(timeout)):
+            from ..framework import monitor as _monitor
+            from ..framework.logging import vlog
+            from ..resilience import supervisor as _supervisor
+
+            _monitor.stat_add("collective_watchdog_trips")
+            _supervisor.record("watchdog_trips")
+            vlog(0, "collective: %s exceeded the %.1fs watchdog deadline "
+                    "— raising TransientDeviceError", fn.__name__, timeout)
+            raise TransientDeviceError(
+                f"collective {fn.__name__} did not complete within "
+                f"FLAGS_collective_timeout_s={timeout:g}s — wedged "
+                f"interconnect or straggler rank; the call keeps running "
+                f"on its watchdog thread but this rank treats it as a "
+                f"transient device failure")
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
+
+    return wrapper
+
+
 def _stacked(tensor, axis: str):
     mesh = get_mesh()
     n = mesh.shape[axis]
@@ -136,6 +189,7 @@ def _all_reduce_impl(tensor, op, axis):
     return _all_reduce_jit(tensor, op, axis, get_mesh())
 
 
+@_watchdog
 def all_reduce(tensor, op: str = ReduceOp.SUM, group=None, sync_op: bool = True):
     """Every rank slot ends with the reduction over all rank slots."""
     axis = _group_axis(group)
@@ -143,6 +197,7 @@ def all_reduce(tensor, op: str = ReduceOp.SUM, group=None, sync_op: bool = True)
     return _all_reduce_impl(tensor, op, axis)
 
 
+@_watchdog
 def all_gather(tensor_or_list, tensor=None, group=None, sync_op: bool = True) -> List[jax.Array]:
     """Returns the list of per-rank tensors (replicated everywhere).
 
@@ -167,6 +222,7 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op: bool = True) ->
     return result
 
 
+@_watchdog
 def reduce(tensor, dst: int = 0, op: str = ReduceOp.SUM, group=None, sync_op: bool = True):
     """Rank ``dst``'s slot gets the reduction; other slots keep their value."""
     axis = _group_axis(group)
@@ -181,6 +237,7 @@ def reduce(tensor, dst: int = 0, op: str = ReduceOp.SUM, group=None, sync_op: bo
     return shard_map(f, mesh=mesh, in_specs=P(axis), out_specs=P(axis))(tensor)
 
 
+@_watchdog
 def broadcast(tensor, src: int = 0, group=None, sync_op: bool = True):
     """Every rank slot ends with rank ``src``'s value."""
     axis = _group_axis(group)
@@ -196,6 +253,7 @@ def broadcast(tensor, src: int = 0, group=None, sync_op: bool = True):
     return shard_map(f, mesh=mesh, in_specs=P(axis), out_specs=P(axis))(tensor)
 
 
+@_watchdog
 def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op: bool = True):
     """Rank i's slot gets ``tensor_list[i]`` (from rank src).  With the
     stacked representation the rows ARE the per-rank values, so this
@@ -207,6 +265,7 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op: bool = 
     return tensor  # row i is already rank i's result
 
 
+@_watchdog
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op: bool = True):
     """result[i][j] = input[j][i] over the group axis (ragged-free)."""
     axis = _group_axis(group)
@@ -232,6 +291,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op: bool = T
     return result
 
 
+@_watchdog
 def barrier(group=None):
     """Block until all prior device work completes (XLA programs are
     compiler-ordered; the host-visible barrier is block_until_ready)."""

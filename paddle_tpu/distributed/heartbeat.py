@@ -163,7 +163,12 @@ class FileHeartbeat:
                     os.makedirs(d, exist_ok=True)
                 self._write()
             except OSError:
-                pass
+                # still suppressed, but COUNTED: a dead heartbeat disk
+                # otherwise surfaces only as a mystery hang-kill minutes
+                # later — the counter names the real failure
+                from ..framework import monitor as _monitor
+
+                _monitor.stat_add("heartbeat_write_failures")
 
     def _write(self) -> None:
         # append a byte so st_size changes too: on filesystems with coarse

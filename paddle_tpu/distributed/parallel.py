@@ -23,7 +23,14 @@ from ..nn.layer_base import Layer
 from . import env as _env
 from .mesh import get_mesh
 
-__all__ = ["DataParallel", "spawn", "launch"]
+__all__ = ["DataParallel", "spawn", "launch", "RESTART_STORM_EXIT_CODE"]
+
+#: watch() exit code when the restart-storm window trips: the trainer
+#: crash-looped (storm_restarts restarts inside storm_window seconds), so
+#: restarting again would hot-spin the host.  Distinct from the child's own
+#: codes so schedulers can tell "gave up on a crash loop" from "trainer
+#: failed once and exhausted the budget".
+RESTART_STORM_EXIT_CODE = 77
 
 
 class DataParallel(Layer):
@@ -143,7 +150,10 @@ def launch(argv=None):
 
 def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
           hang_timeout: Optional[float] = None,
-          startup_grace: Optional[float] = None) -> int:
+          startup_grace: Optional[float] = None,
+          backoff_cap: float = 60.0,
+          storm_window: Optional[float] = None, storm_restarts: int = 5,
+          peer_monitor=None) -> int:
     """Run ``cmd`` as a watched subprocess; restart on non-zero exit up to
     ``max_restarts`` times (reference: launch_utils.py watch_local_trainers /
     terminate_local_procs).  Returns the final exit code.  SIGTERM/SIGINT
@@ -164,7 +174,24 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
     beat (the
     reference monitor skips UNINITED workers); until then a separate
     ``startup_grace`` applies (default ``max(60, 4x hang_timeout)``) so
-    slow interpreter/plugin startup isn't mistaken for a hang."""
+    slow interpreter/plugin startup isn't mistaken for a hang.
+
+    Restart pacing: the delay before each failure restart doubles from
+    ``_sleep`` up to ``backoff_cap`` (a crash-looping trainer must not
+    hot-spin the host); preemption restarts keep the base delay (evictions
+    are the platform's fault).  ``storm_window``/``storm_restarts`` arm
+    the storm detector: ``storm_restarts`` restarts of ANY kind inside
+    ``storm_window`` seconds → give up with
+    :data:`RESTART_STORM_EXIT_CODE` even if the budget has room.
+
+    ``peer_monitor`` (a started ``heartbeat.HeartBeatMonitor`` fed by the
+    gang's beat transport) arms the gang-restore decision: when a peer
+    goes lost (``lost_workers()`` non-empty) this watchdog kills its OWN
+    healthy child and restarts it — a rank whose peer died is wedged in a
+    collective it can never finish, and only a gang restart re-forms the
+    group.  Gang restarts don't consume the failure budget (a peer's
+    death is not this trainer's fault)."""
+    import collections
     import os as _os
     import signal
     import subprocess
@@ -181,9 +208,25 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
             "training loop throttles beats to one per "
             f"{BEAT_MIN_INTERVAL:g}s, so shorter timeouts kill healthy "
             "trainers")
+    if storm_window is not None and (storm_window <= 0 or storm_restarts < 1):
+        raise InvalidArgumentError(
+            "storm_window must be > 0 and storm_restarts >= 1")
     attempts = 0
+    failure_restarts = 0  # drives the exponential backoff
+    restart_times = collections.deque(maxlen=max(storm_restarts, 1))
     child = None
     hb_dir = None
+
+    def _storm_tripped() -> bool:
+        """Record one restart; True when the storm window just filled."""
+        now = time.monotonic()
+        restart_times.append(now)
+        if storm_window is None or len(restart_times) < storm_restarts:
+            return False
+        return now - restart_times[0] <= storm_window
+
+    def _peers_lost():
+        return peer_monitor.lost_workers() if peer_monitor is not None else ()
 
     def _teardown(signum, frame):
         if child is not None and child.poll() is None:
@@ -212,8 +255,25 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
                 hb = FileHeartbeat(hb_path)  # creates + stamps t0
                 env = dict(_os.environ, **{ENV_FILE: hb_path})
             child = subprocess.Popen(cmd, env=env)
-            if hb is None:
+            gang_restart = False
+            if hb is None and peer_monitor is None:
                 rc = child.wait()
+            elif hb is None:
+                # no hang monitoring, but gang liveness still needs polling
+                while True:
+                    rc = child.poll()
+                    if rc is not None:
+                        break
+                    lost = _peers_lost()
+                    if lost:
+                        vlog(0, "watchdog: peer worker(s) %s lost — gang "
+                                "restart of the local trainer", lost)
+                        _monitor.stat_add("gang_restores")
+                        gang_restart = True
+                        child.kill()
+                        rc = child.wait()
+                        break
+                    time.sleep(0.05)
             else:
                 grace = (startup_grace if startup_grace is not None
                          else max(60.0, 4 * hang_timeout))
@@ -224,6 +284,15 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
                 while True:
                     rc = child.poll()
                     if rc is not None:
+                        break
+                    lost = _peers_lost()
+                    if lost:
+                        vlog(0, "watchdog: peer worker(s) %s lost — gang "
+                                "restart of the local trainer", lost)
+                        _monitor.stat_add("gang_restores")
+                        gang_restart = True
+                        child.kill()
+                        rc = child.wait()
                         break
                     if not beaten:
                         try:
@@ -247,8 +316,22 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
                         # a success, not a hang
                         break
                     time.sleep(poll)
-            if rc == 0:
+            if rc == 0 and not gang_restart:
                 return 0
+            if _storm_tripped():
+                # N restarts inside W seconds: the trainer is crash-looping
+                # (or the gang keeps dying) — more restarts would hot-spin
+                # the host, so give up with the distinct storm code
+                vlog(0, "watchdog: %d restarts inside %.1fs — restart "
+                        "storm, giving up (exit %d)", storm_restarts,
+                     storm_window, RESTART_STORM_EXIT_CODE)
+                _monitor.stat_add("restart_storms")
+                return RESTART_STORM_EXIT_CODE
+            if gang_restart:
+                # a peer died: this child was healthy, the restart exists
+                # only to re-form the gang — no budget, base delay
+                time.sleep(_sleep)
+                continue
             from ..resilience.preemption import PREEMPTION_EXIT_CODE
 
             if rc == PREEMPTION_EXIT_CODE:
@@ -268,7 +351,11 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
                 return rc
             attempts += 1
             _monitor.stat_add("trainer_restarts")  # an actual restart
-            time.sleep(_sleep)
+            # exponential backoff: 1x, 2x, 4x ... capped — a trainer that
+            # dies instantly must not restart at full poll speed
+            time.sleep(min(_sleep * (2 ** failure_restarts),
+                           max(backoff_cap, _sleep)))
+            failure_restarts += 1
     finally:
         if hb_dir is not None:
             import shutil

@@ -21,6 +21,7 @@ __all__ = [
     "PreconditionNotMetError",
     "ExecutionTimeoutError",
     "TransientDeviceError",
+    "DivergenceError",
     "is_transient",
     "wrap_transient",
     "enforce",
@@ -75,6 +76,14 @@ class TransientDeviceError(UnavailableError):
     preempted donated buffer, transient ICI/DCN link error, runtime
     RESOURCE_EXHAUSTED from a concurrent burst.  ``resilience.RetryPolicy``
     retries these; anything else is fatal and propagates immediately."""
+
+
+class DivergenceError(EnforceNotMet):
+    """Training diverged beyond what rollback can fix: the supervisor
+    (``resilience.TrainingSupervisor``) exhausted its rollback budget or
+    kept tripping at the same restored step — restarting from the same
+    checkpoint would loop forever, so the run must stop with the
+    diagnostic instead."""
 
 
 #: lowercase substrings of XLA / jax runtime error messages that indicate a

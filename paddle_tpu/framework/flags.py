@@ -134,6 +134,16 @@ define_flag("fault_plan", "",
             "TransientDeviceError), latency_ms (inject latency instead "
             "of raising). Empty (default): every fault_point is a no-op "
             "falsy check — zero hot-path cost, bit-identical runs.")
+define_flag("collective_timeout_s", 0.0,
+            "Collective/straggler watchdog deadline in seconds "
+            "(distributed/collective.py): non-zero, every host-level "
+            "collective (all_reduce, all_gather, barrier, ...) runs under "
+            "a deadline and a wedged call raises TransientDeviceError "
+            "into the retry/restart path instead of hanging the rank "
+            "forever.  0.0 (default): disabled — the hook is a single "
+            "falsy flag check, zero hot-path cost.  Set it well above "
+            "the slowest legitimate collective (including the compile "
+            "on first call).")
 define_flag("transient_max_retries", 3,
             "Max attempts (1 = no retry) for operations retried on "
             "transient device errors (errors.is_transient): Executor.run "

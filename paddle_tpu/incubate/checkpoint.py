@@ -88,7 +88,7 @@ class AutoCheckpoint:
 
     def __init__(self, model, save_dir: str, save_steps: Optional[int] = None,
                  keep_max: int = 3, async_save: bool = True,
-                 retry: Optional[RetryPolicy] = None):
+                 retry: Optional[RetryPolicy] = None, data_loader=None):
         if keep_max < 1:
             raise InvalidArgumentError("keep_max must be >= 1")
         self.model = model
@@ -99,6 +99,17 @@ class AutoCheckpoint:
         self.last_epoch = 0    # most recent epoch handed to save()/step()
         self._counter = 0      # monotonic checkpoint id
         self._global_step = 0
+        # extra-state providers: name -> (get, set); snapshotted into
+        # meta["extra_state"] and restored by resume() after the RNG state
+        self._extra: Dict[str, tuple] = {}
+        # dirs protected from _prune(): the latest committed one is always
+        # implicitly safe (keep_max >= 1), pins cover dirs a concurrent
+        # rollback is reading while the async writer keeps committing
+        self._pinned: set = set()
+        self._pin_lock = threading.Lock()
+        if data_loader is not None:
+            self.attach("data_loader", data_loader.state_dict,
+                        data_loader.set_state_dict)
         # transient write failures (full disk burst, flaky network FS) are
         # retried before they count; OSError is transient for disk I/O
         self._retry = retry if retry is not None else RetryPolicy.from_flags(
@@ -109,6 +120,15 @@ class AutoCheckpoint:
         self._q: "queue.Queue" = queue.Queue(maxsize=2)
         self._worker: Optional[threading.Thread] = None
         self._worker_err: Optional[BaseException] = None
+
+    def attach(self, name: str, get, set) -> None:
+        """Register an extra-state provider: ``get()`` is snapshotted into
+        every checkpoint's meta under ``extra_state[name]`` and ``set``
+        is called with that snapshot on ``resume()`` (after the RNG state
+        is restored).  The data-loader position rides this — pass
+        ``data_loader=`` to the constructor — and any other loop state
+        (EMA trackers, curriculum schedules) can too."""
+        self._extra[name] = (get, set)
 
     # -- write path ----------------------------------------------------------
     def _snapshot(self, epoch: int) -> Dict[str, Any]:
@@ -132,6 +152,9 @@ class AutoCheckpoint:
             "kind": "step",  # save()/epoch_end overwrite as appropriate
             "rng_state": _random.default_generator().get_state(),
         }
+        if self._extra:
+            meta["extra_state"] = {name: get()
+                                   for name, (get, _set) in self._extra.items()}
         return {"params": params, "opt": opt, "meta": meta}
 
     def _write(self, snap: Dict[str, Any]):
@@ -154,12 +177,29 @@ class AutoCheckpoint:
         self._prune()
 
     def _prune(self):
+        with self._pin_lock:
+            pinned = set(self._pinned)
         done = sorted(
             n for n in os.listdir(self.save_dir)
             if n.startswith(_PREFIX)
             and os.path.exists(os.path.join(self.save_dir, n, _META)))
-        for n in done[: -self.keep_max]:
+        # keep the keep_max newest; never delete the latest committed dir
+        # (it is the rollback restore target) or a dir currently being
+        # read by resume() — the async writer would otherwise race a
+        # concurrent rollback out of its restore source
+        keep = set(done[-self.keep_max:])
+        for n in done:
+            if n in keep or n in pinned:
+                continue
             shutil.rmtree(os.path.join(self.save_dir, n), ignore_errors=True)
+
+    def _pin(self, name: str) -> None:
+        with self._pin_lock:
+            self._pinned.add(name)
+
+    def _unpin(self, name: str) -> None:
+        with self._pin_lock:
+            self._pinned.discard(name)
 
     def _worker_loop(self):
         while True:
@@ -209,17 +249,19 @@ class AutoCheckpoint:
     def epoch_end(self, epoch: int):
         self.save(epoch, kind="epoch_end")
 
-    def final_save(self, epoch: Optional[int] = None):
+    def final_save(self, epoch: Optional[int] = None, kind: str = "preempt"):
         """One SYNCHRONOUS checkpoint, bypassing the queue — the SIGTERM
         preemption path (``resilience.PreemptionHandler``), where the
         process exits immediately after and must not wait on a busy
-        worker.  Safe alongside an in-flight async write: distinct
-        counter → distinct directory, meta-last commits each."""
+        worker, and the supervisor's rollback baseline (``kind=
+        "baseline"``), which must be committed before training starts.
+        Safe alongside an in-flight async write: distinct counter →
+        distinct directory, meta-last commits each."""
         self._counter += 1
         snap = self._snapshot(self.last_epoch if epoch is None
                               else int(epoch))
         snap["meta"]["counter"] = self._counter
-        snap["meta"]["kind"] = "preempt"
+        snap["meta"]["kind"] = kind
         self._retry.call(self._write, snap)
 
     def close(self):
@@ -292,11 +334,15 @@ class AutoCheckpoint:
         costs ``save_steps`` of progress, never the job."""
         loaded = None
         for d in self.committed_dirs():
+            name = os.path.basename(d)
+            self._pin(name)  # the async writer must not prune mid-read
             try:
                 loaded = self._load_verified(d)
                 break
             except EnforceNotMet:
                 self._quarantine(d)
+            finally:
+                self._unpin(name)
         if loaded is None:
             return None
         import jax.numpy as jnp
@@ -324,6 +370,16 @@ class AutoCheckpoint:
                 optimizer.set_lr(float(opt["lr"]))
         if meta.get("rng_state"):
             _random.default_generator().set_state(meta["rng_state"])
+        extra = meta.get("extra_state") or {}
+        for name, (_get, set_state) in self._extra.items():
+            if name in extra:
+                set_state(extra[name])
+        if "data_loader" in extra and "data_loader" in self._extra:
+            # position + shuffle RNG restored alongside the model state:
+            # the resumed run replays the exact remaining batch order
+            from ..resilience import supervisor as _supervisor
+
+            _supervisor.record("exact_resumes")
         self._counter = int(meta["counter"])
         self._global_step = int(meta["global_step"])
         self.last_epoch = int(meta["epoch"])
@@ -331,20 +387,25 @@ class AutoCheckpoint:
 
 
 def train_epoch_range(max_epoch: int, model, save_dir: str,
-                      save_steps: Optional[int] = None, keep_max: int = 3):
+                      save_steps: Optional[int] = None, keep_max: int = 3,
+                      data_loader=None):
     """Resumable epoch loop (reference: acp.train_epoch_range,
     auto_checkpoint.py:265).  Yields ``(epoch, acp)`` starting after the
     last *completed* epoch; checkpoints at each epoch end and drains writes
     when the range completes.  Resuming from a mid-epoch ``step()`` save
-    re-enters THAT epoch (its remaining batches would otherwise be skipped);
-    batches already seen before the save are replayed from restored state.
+    re-enters THAT epoch.  With ``data_loader=`` given, the loader's
+    position and shuffle RNG are checkpointed too and the re-entered epoch
+    resumes at the exact next batch in the original order — the resumed
+    run is bit-identical to an uninterrupted one (without it, iterating
+    the loader replays the epoch from its first batch).
 
-    >>> for epoch, acp in train_epoch_range(10, model, "ckpts", save_steps=50):
+    >>> for epoch, acp in train_epoch_range(10, model, "ckpts", save_steps=50,
+    ...                                     data_loader=loader):
     ...     for batch in loader:
     ...         model.train_batch(...); acp.step(epoch)
     """
     acp = AutoCheckpoint(model, save_dir, save_steps=save_steps,
-                         keep_max=keep_max)
+                         keep_max=keep_max, data_loader=data_loader)
     meta = acp.resume()
     if meta is None:
         start = 0

@@ -204,11 +204,53 @@ class _StagingIterator:
         return item
 
 
+class _CountingIterator:
+    """Final wrapper around whatever iterator ``__iter__`` built: counts
+    batches as they are DELIVERED to the consumer.  The prefetch thread
+    runs ahead of the training loop, so sampler-side counters over-count —
+    this is the only place the "how far did the run actually get" number
+    exists, and it is what ``DataLoader.state_dict()`` snapshots for exact
+    resume.  Forwards ``close()`` so breaking out mid-epoch still unwinds
+    the staging thread / worker pool."""
+
+    def __init__(self, inner, loader, base: int):
+        self._inner = inner
+        self._loader = loader
+        loader._delivered = int(base)
+        loader._exhausted = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            batch = next(self._inner)
+        except StopIteration:
+            self._loader._exhausted = True
+            raise
+        self._loader._delivered += self._loader._batch_span(batch)
+        return batch
+
+    def close(self):
+        close = getattr(self._inner, "close", None)
+        if close is not None:
+            close()
+
+
 class DataLoader:
     """Iterate a Dataset in collated, device-staged batches.
 
     Accepted arguments mirror paddle.io.DataLoader (feed_list/places are
     legacy static-graph knobs, accepted and ignored).
+
+    Exact resume: ``state_dict()`` snapshots the in-epoch position
+    (batches delivered to the consumer — prefetch depth never
+    over-counts) plus the batch sampler's shuffle-RNG state;
+    ``set_state_dict()`` arms the next ``__iter__`` to regenerate the
+    same order and skip the consumed prefix.  ``incubate.checkpoint.
+    AutoCheckpoint(data_loader=...)`` captures/restores this alongside
+    the model RNG so resumed runs are bit-identical to uninterrupted
+    ones.
     """
 
     def __init__(
@@ -247,6 +289,9 @@ class DataLoader:
         # fused chain is still executing.
         self.superbatch = max(int(superbatch), 1)
         self._iterable_mode = isinstance(dataset, IterableDataset)
+        self._delivered = 0       # sampler-batches delivered this epoch
+        self._exhausted = True    # no epoch in progress yet
+        self._pending: Optional[dict] = None
 
         if self._iterable_mode:
             if batch_sampler is not None:
@@ -352,7 +397,52 @@ class DataLoader:
         if buf:
             yield stack(buf)
 
+    def _batch_span(self, batch) -> int:
+        """Sampler-batches a delivered item represents: 1 normally; with
+        superbatch>1, the stacked group's leading dim (exact even for the
+        ragged tail group)."""
+        if self.superbatch <= 1:
+            return 1
+        leaves = jax.tree_util.tree_leaves(batch)
+        if leaves and hasattr(leaves[0], "shape") and leaves[0].shape:
+            return int(leaves[0].shape[0])
+        return 1
+
+    def state_dict(self) -> dict:
+        """In-epoch position + shuffle-RNG snapshot for exact resume."""
+        if self._iterable_mode:
+            raise InvalidArgumentError(
+                "DataLoader over an IterableDataset cannot snapshot its "
+                "position (the stream owns its own state) — implement "
+                "state capture on the dataset itself")
+        out = {"delivered": int(self._delivered),
+               "exhausted": bool(self._exhausted)}
+        bs_state = self.batch_sampler.state_dict()
+        # the sampler-side next_batch runs ahead of the consumer under
+        # prefetch; the delivered count is the truthful position
+        bs_state["next_batch"] = int(self._delivered)
+        out["batch_sampler"] = bs_state
+        return out
+
+    def set_state_dict(self, state: dict) -> None:
+        """Arm the NEXT ``__iter__`` to resume from ``state``.  A snapshot
+        taken between epochs (``exhausted``) arms nothing — the next epoch
+        starts fresh, exactly as the uninterrupted run would."""
+        if self._iterable_mode:
+            raise InvalidArgumentError(
+                "DataLoader over an IterableDataset cannot restore a "
+                "position snapshot")
+        if state.get("exhausted", False):
+            self._pending = None
+            return
+        self._pending = dict(state)
+
     def __iter__(self):
+        pending, self._pending = self._pending, None
+        base = 0
+        if pending is not None:
+            self.batch_sampler.set_state_dict(pending.get("batch_sampler", {}))
+            base = int(pending.get("delivered", 0))
         if self._iterable_mode:
             source = self._iter_iterable()
         elif self.num_workers > 0:
@@ -362,7 +452,11 @@ class DataLoader:
         if self.superbatch > 1:
             source = self._iter_superbatch(source)
         if self.return_numpy:
-            return iter(source)
-        if self.use_buffer_reader:
-            return _StagingIterator(source, self.prefetch_factor, to_device=True)
-        return (jax.tree_util.tree_map(jax.device_put, b) for b in source)
+            it = iter(source)
+        elif self.use_buffer_reader:
+            it = _StagingIterator(source, self.prefetch_factor, to_device=True)
+        else:
+            it = (jax.tree_util.tree_map(jax.device_put, b) for b in source)
+        if self._iterable_mode:
+            return it  # no positional state to track on a raw stream
+        return _CountingIterator(it, self, base)

@@ -64,6 +64,13 @@ class RandomSampler(Sampler):
         self.replacement = replacement
         self._num_samples = num_samples
         self.generator = generator
+        # exact-resume support: the derived int seed fully determines one
+        # epoch's permutation, so snapshotting it (state_dict) lets a
+        # restored run regenerate the SAME order without re-consuming the
+        # key source — the checkpoint's rng_state already reflects the
+        # original draw.
+        self._last_seed: Optional[int] = None
+        self._replay_seed: Optional[int] = None
         if not replacement and num_samples is not None and num_samples > len(data_source):
             raise InvalidArgumentError("num_samples > dataset size without replacement")
 
@@ -71,23 +78,34 @@ class RandomSampler(Sampler):
     def num_samples(self):
         return self._num_samples if self._num_samples is not None else len(self.data_source)
 
-    def _rng(self):
+    def _draw_seed(self) -> int:
         if self.generator is not None:
             next_key = getattr(self.generator, "next_key", None)
             if next_key is not None:
                 # a paddle_tpu Generator: each epoch pulls a fresh key so
                 # the permutation differs per epoch but replays under seed()
                 key = np.asarray(next_key(), dtype=np.uint32).ravel()
-                return np.random.RandomState(int(key[-1]) & 0x7FFFFFFF)
+                return int(key[-1]) & 0x7FFFFFFF
             # an int seed: vary per epoch deterministically
             self._epoch = getattr(self, "_epoch", -1) + 1
-            return np.random.RandomState((int(self.generator) + self._epoch) & 0x7FFFFFFF)
+            return (int(self.generator) + self._epoch) & 0x7FFFFFFF
         # default: the framework generator, so paddle.seed() reproduces
         # shuffle order (consistent with random_split)
         from ..framework import random as _random
 
         key = np.asarray(_random.default_generator().next_key(), dtype=np.uint32).ravel()
-        return np.random.RandomState(int(key[-1]) & 0x7FFFFFFF)
+        return int(key[-1]) & 0x7FFFFFFF
+
+    def _rng(self):
+        if self._replay_seed is not None:
+            # restored state: reuse the seed that generated the epoch being
+            # re-entered, WITHOUT drawing from the key source (the original
+            # draw is already baked into the restored generator state)
+            s, self._replay_seed = self._replay_seed, None
+        else:
+            s = self._draw_seed()
+        self._last_seed = s
+        return np.random.RandomState(s)
 
     def __iter__(self):
         n = len(self.data_source)
@@ -98,6 +116,16 @@ class RandomSampler(Sampler):
 
     def __len__(self):
         return self.num_samples
+
+    def state_dict(self) -> dict:
+        """Shuffle-RNG snapshot for exact resume (see BatchSampler)."""
+        return {"last_seed": self._last_seed,
+                "epoch_counter": getattr(self, "_epoch", None)}
+
+    def set_state_dict(self, state: dict) -> None:
+        self._replay_seed = state.get("last_seed")
+        if state.get("epoch_counter") is not None:
+            self._epoch = int(state["epoch_counter"])
 
 
 class WeightedRandomSampler(Sampler):
@@ -127,7 +155,13 @@ class BatchSampler(Sampler):
 
     Matches the reference's constructor contract: either ``dataset`` (+
     shuffle) or an explicit ``sampler``.
-    """
+
+    Exact resume: ``state_dict()`` snapshots (next-batch index, shuffle-RNG
+    seed); after ``set_state_dict()`` the NEXT ``__iter__`` regenerates the
+    same index stream and skips the already-consumed batches, so a restored
+    run sees the remaining batches in the original order.  ``DataLoader``
+    overrides the batch index with its delivered count (prefetch makes the
+    sampler-side count run ahead of the consumer)."""
 
     def __init__(self, dataset=None, sampler: Optional[Sampler] = None,
                  shuffle: bool = False, batch_size: int = 1, drop_last: bool = False):
@@ -148,13 +182,50 @@ class BatchSampler(Sampler):
             self.sampler = RandomSampler(dataset) if shuffle else SequenceSampler(dataset)
         self.batch_size = batch_size
         self.drop_last = drop_last
+        self._yielded = 0
+        self._pending: Optional[dict] = None
+
+    def _consume_pending(self) -> int:
+        """Apply restored state (if any) to the sampler; return the number
+        of leading batches to skip."""
+        pending, self._pending = self._pending, None
+        if pending is None:
+            return 0
+        sampler_state = pending.get("sampler")
+        if sampler_state is not None and hasattr(self.sampler, "set_state_dict"):
+            self.sampler.set_state_dict(sampler_state)
+        return int(pending.get("next_batch", 0))
 
     def __iter__(self):
-        return _batched(self.sampler, self.batch_size, self.drop_last)
+        skip = self._consume_pending()
+        stream = _batched(self.sampler, self.batch_size, self.drop_last)
+        for _ in range(skip):
+            if next(stream, None) is None:
+                break
+        self._yielded = skip
+        for batch in stream:
+            self._yielded += 1
+            yield batch
 
     def __len__(self):
         n = len(self.sampler)
         return n // self.batch_size if self.drop_last else (n + self.batch_size - 1) // self.batch_size
+
+    def state_dict(self) -> dict:
+        """(next-batch index, shuffle RNG) snapshot.  ``next_batch`` counts
+        batches the sampler HANDED OUT — exact for synchronous iteration;
+        a prefetching consumer (DataLoader) substitutes its delivered
+        count."""
+        out = {"next_batch": int(self._yielded)}
+        sd = getattr(self.sampler, "state_dict", None)
+        if sd is not None:
+            out["sampler"] = sd()
+        return out
+
+    def set_state_dict(self, state: dict) -> None:
+        """Arm the NEXT ``__iter__`` to replay from ``state`` (regenerate
+        the permutation from the snapshotted seed, skip consumed batches)."""
+        self._pending = dict(state)
 
 
 class DistributedBatchSampler(BatchSampler):
@@ -185,10 +256,16 @@ class DistributedBatchSampler(BatchSampler):
         self.epoch = 0
         self.num_samples = int(math.ceil(len(dataset) / num_replicas))
         self.total_size = self.num_samples * num_replicas
+        self._yielded = 0
+        self._pending: Optional[dict] = None
 
     def __iter__(self):
+        pending, self._pending = self._pending, None
+        skip = int(pending.get("next_batch", 0)) if pending else 0
         n = len(self.dataset)
         if self.shuffle:
+            # the permutation is a pure function of the epoch — restoring
+            # ``epoch`` (set_state_dict) regenerates it exactly
             rng = np.random.RandomState(self.epoch)
             indices = rng.permutation(n).tolist()
         else:
@@ -199,7 +276,14 @@ class DistributedBatchSampler(BatchSampler):
             indices += indices[: self.total_size - len(indices)]
         local = indices[self.rank : self.total_size : self.num_replicas]
         assert len(local) == self.num_samples
-        yield from _batched(local, self.batch_size, self.drop_last)
+        stream = _batched(local, self.batch_size, self.drop_last)
+        for _ in range(skip):
+            if next(stream, None) is None:
+                break
+        self._yielded = skip
+        for batch in stream:
+            self._yielded += 1
+            yield batch
 
     def __len__(self):
         if self.drop_last:
@@ -208,3 +292,13 @@ class DistributedBatchSampler(BatchSampler):
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
+
+    def state_dict(self) -> dict:
+        """(epoch, next-batch index) snapshot — the per-rank shard order is
+        a pure function of the epoch, so this is the complete state."""
+        return {"epoch": int(self.epoch), "next_batch": int(self._yielded)}
+
+    def set_state_dict(self, state: dict) -> None:
+        if "epoch" in state:
+            self.epoch = int(state["epoch"])
+        self._pending = {"next_batch": int(state.get("next_batch", 0))}

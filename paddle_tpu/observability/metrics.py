@@ -380,6 +380,8 @@ _FAMILY_LABEL = {
     "steptrace": "name",
     "router": "replica",
     "slo": "engine",
+    "supervisor": "name",
+    "amp": "scaler",
 }
 
 _bridge_fn: Optional[Callable] = None

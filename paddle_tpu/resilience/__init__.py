@@ -36,8 +36,9 @@ from .faults import (  # noqa: F401
 from .preemption import (  # noqa: F401
     PREEMPTION_EXIT_CODE, PreemptionHandler, install_preemption_handler)
 from .retry import RetryPolicy, is_warm, mark_warm  # noqa: F401
+from .supervisor import DivergenceError, TrainingSupervisor  # noqa: F401
 
-from . import circuit, faults, retry  # noqa: F401
+from . import circuit, faults, retry, supervisor  # noqa: F401
 
 __all__ = [
     "RetryPolicy", "mark_warm", "is_warm",
@@ -45,19 +46,22 @@ __all__ = [
     "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
     "PreemptionHandler", "install_preemption_handler",
     "PREEMPTION_EXIT_CODE",
+    "TrainingSupervisor", "DivergenceError",
 ]
 
 
 # -- profiler "Faults & retries" summary section -----------------------------
 _retry_base: dict = {}
 _fault_base: dict = {}
+_supervisor_base: dict = {}
 
 
 def _on_profiler_reset() -> None:
-    global _retry_base, _fault_base
+    global _retry_base, _fault_base, _supervisor_base
     _retry_base = retry.stats()
     plan = faults._plan
     _fault_base = plan.stats() if plan is not None else {}
+    _supervisor_base = supervisor.stats()
 
 
 def _summary_section() -> str:
@@ -93,11 +97,30 @@ def _summary_section() -> str:
     return "\n".join(["Faults & retries"] + lines)
 
 
+def _supervisor_section() -> str:
+    """Divergence-guard activity since the last profiler reset —
+    profiler.summary() appends this as "Training supervisor"."""
+    d = supervisor.stats()
+    delta = {k: d[k] - _supervisor_base.get(k, 0) for k in d}
+    if not any(delta.values()):
+        return ""
+    return "\n".join([
+        "Training supervisor",
+        f"  rollbacks {delta.get('rollbacks', 0):>6}  "
+        f"repeat-trips {delta.get('repeat_trips', 0):>4}  "
+        f"fatal {delta.get('fatal_divergences', 0):>3}",
+        f"  skipped-batches {delta.get('skipped_batches', 0):>6}  "
+        f"exact-resumes {delta.get('exact_resumes', 0):>4}  "
+        f"watchdog-trips {delta.get('watchdog_trips', 0):>4}",
+    ])
+
+
 def _register_profiler_section() -> None:
     from .. import profiler
 
     profiler.register_summary_section(_summary_section,
                                       on_reset=_on_profiler_reset)
+    profiler.register_summary_section(_supervisor_section)
 
 
 _register_profiler_section()

@@ -1,0 +1,263 @@
+"""Training supervisor: divergence rollback over exact-resume checkpoints.
+
+The serving half of the stack degrades gracefully (circuit breakers,
+failover, hedging); this module is the *training-loop* dual — the
+reference's launch_utils watch loop + heart_beat_monitor kept trainers
+alive, but nothing guarded the run's *numerics*.  A single NaN batch
+poisons every parameter within a step, and without rollback the job burns
+its remaining budget training garbage.
+
+:class:`TrainingSupervisor` wraps the epoch loop with three guarantees:
+
+* **Divergence guard** — ``guard(loss)`` checks every step's loss (and
+  optionally grad-norm) for non-finite values and EMA-relative spikes;
+* **Rollback** — on a trip it restores the last committed
+  :class:`~paddle_tpu.incubate.checkpoint.AutoCheckpoint` (params, opt
+  state, RNG, **data-loader position** — so the replay is exact), marks
+  the poison batch window, and the :meth:`steps` iterator re-enters the
+  epoch from the restored position, *skipping* the poison batches;
+* **Bounded budget** — a run that keeps tripping after rolling back to
+  the same checkpoint is not recoverable by replay; after
+  ``max_rollbacks_per_target`` repeats (or ``max_rollbacks`` total) the
+  supervisor raises :class:`~paddle_tpu.framework.errors.DivergenceError`
+  with the full diagnostic instead of looping forever.
+
+Telemetry rides the existing rails: ``("supervisor", ...)`` snapshots on
+``framework.trace_events`` (analysis rule F802 fires on a rollback loop),
+counters on ``framework.monitor``, and a "Training supervisor" section in
+``profiler.summary()``.  Disabled (``enable=False``), every hook is a
+single falsy check.
+
+Usage::
+
+    acp = AutoCheckpoint(model, "ckpts", save_steps=50, data_loader=loader)
+    sup = TrainingSupervisor(acp)
+    acp.resume()
+    for epoch in range(n):
+        for batch in sup.steps(loader, epoch):
+            loss, _ = model.train_batch(...)
+            if sup.guard(loss):
+                acp.step(epoch)
+        acp.epoch_end(epoch)
+"""
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+from ..framework import trace_events
+from ..framework.errors import DivergenceError, InvalidArgumentError
+
+__all__ = ["TrainingSupervisor", "DivergenceError", "stats", "record"]
+
+
+# -- module-level telemetry ---------------------------------------------------
+_STAT_FIELDS = ("rollbacks", "repeat_trips", "skipped_batches",
+                "watchdog_trips", "exact_resumes", "fatal_divergences")
+_stats: Dict[str, int] = {k: 0 for k in _STAT_FIELDS}
+_stats_lock = threading.Lock()
+
+
+def record(field: str, n: int = 1) -> None:
+    """Bump a supervisor counter and publish the ("supervisor", ...)
+    snapshot (latest-value-wins family, like the other counter events).
+    Called from this module, ``AutoCheckpoint.resume`` (exact_resumes)
+    and the collective watchdog (watchdog_trips)."""
+    with _stats_lock:
+        _stats[field] = _stats.get(field, 0) + n
+        snap = dict(_stats)
+    from ..framework import monitor as _monitor
+
+    _monitor.stat_add(f"supervisor_{field}", n)
+    trace_events.notify(("supervisor", "supervisor"), snap)
+
+
+def stats() -> Dict[str, int]:
+    """Process-wide supervisor counters (rollbacks, skipped_batches,
+    watchdog_trips, exact_resumes, ...)."""
+    with _stats_lock:
+        return dict(_stats)
+
+
+class TrainingSupervisor:
+    """Per-run divergence guard + rollback driver (see module doc).
+
+    ``acp`` must be an :class:`AutoCheckpoint` constructed with
+    ``data_loader=`` (or an equivalent ``attach`` provider) for the
+    rollback replay to be exact; without it the re-entered epoch replays
+    from its first batch.
+
+    Divergence trips when the loss (or grad-norm) is non-finite, or — past
+    ``warmup_steps`` healthy steps — exceeds ``spike_factor``× its EMA.
+    ``skip_batches`` delivered batches ending at the poison one are
+    skipped on replay (a NaN often comes from the *data*, and replaying
+    the same batch into the same weights diverges identically).
+    """
+
+    def __init__(self, acp, *, enable: bool = True,
+                 spike_factor: float = 10.0, ema_beta: float = 0.9,
+                 warmup_steps: int = 5, skip_batches: int = 1,
+                 max_rollbacks: int = 8, max_rollbacks_per_target: int = 1):
+        if spike_factor <= 1.0:
+            raise InvalidArgumentError("spike_factor must be > 1")
+        if not 0.0 < ema_beta < 1.0:
+            raise InvalidArgumentError("ema_beta must be in (0, 1)")
+        if skip_batches < 0 or max_rollbacks < 1 or max_rollbacks_per_target < 1:
+            raise InvalidArgumentError(
+                "skip_batches >= 0, max_rollbacks >= 1 and "
+                "max_rollbacks_per_target >= 1 required")
+        self.acp = acp
+        self._enabled = bool(enable)
+        self.spike_factor = float(spike_factor)
+        self.ema_beta = float(ema_beta)
+        self.warmup_steps = int(warmup_steps)
+        self.skip_batches = int(skip_batches)
+        self.max_rollbacks = int(max_rollbacks)
+        self.max_rollbacks_per_target = int(max_rollbacks_per_target)
+        # guard state (process-local on purpose: riding the checkpoint
+        # would let a rollback rewind its own budget)
+        self._ema: Optional[float] = None
+        self._healthy_steps = 0
+        self._rollbacks = 0
+        self._per_target: Dict[int, int] = {}
+        # poison batch windows: {(epoch, delivered_index)} to skip on replay
+        self._poison: set = set()
+        self._recent: deque = deque(maxlen=64)  # (epoch, idx) yielded
+        self._cur: Optional[tuple] = None
+        self._restart = False
+        self._epoch = 0
+
+    # -- loop driver ---------------------------------------------------------
+    def steps(self, loader, epoch: int):
+        """Iterate ``loader`` for one epoch under supervision: yields
+        batches, skipping poisoned ones, and — after a ``guard`` trip —
+        re-creates the loader iterator so the restored data-pipeline state
+        takes effect and the replay continues from the checkpointed
+        position."""
+        if not self._enabled:  # disabled: plain iteration, zero extra cost
+            yield from loader
+            return
+        self._epoch = int(epoch)
+        if self.acp.latest_dir() is None:
+            # commit a rollback baseline before the first step: a trip on
+            # step 0 must have somewhere to restore to
+            self.acp.final_save(epoch, kind="baseline")
+        # positions come from the DataLoader's delivered counter (absolute
+        # within the epoch, survives the rollback restore); a plain
+        # iterable gets a local counter — its replay restarts the epoch,
+        # so local positions are absolute too
+        counted = hasattr(loader, "_delivered")
+        while True:
+            self._restart = False
+            it = iter(loader)
+            local = 0
+            try:
+                for batch in it:
+                    local += 1
+                    idx = int(loader._delivered) if counted else local
+                    pos = (self._epoch, idx)
+                    if pos in self._poison:
+                        record("skipped_batches")
+                        from ..framework.logging import vlog
+
+                        vlog(0, "supervisor: skipping poison batch "
+                                "epoch=%d idx=%d", self._epoch, idx)
+                        continue
+                    self._cur = pos
+                    self._recent.append(pos)
+                    yield batch
+                    if self._restart:
+                        break
+            finally:
+                if self._restart:
+                    close = getattr(it, "close", None)
+                    if close is not None:
+                        close()
+            if not self._restart:
+                return
+
+    # -- per-step guard ------------------------------------------------------
+    def guard(self, loss, grad_norm=None) -> bool:
+        """Check one step's loss (and optional grad-norm).  Healthy: update
+        the EMA and return True (commit the step — call ``acp.step``).
+        Diverged: roll back to the last committed checkpoint, arm the
+        poison-batch skip, and return False (the ``steps`` iterator
+        re-enters from the restored position)."""
+        if not self._enabled:
+            return True
+        bad = not math.isfinite(float(loss))
+        if grad_norm is not None:
+            bad = bad or not math.isfinite(float(grad_norm))
+        if not bad and self._ema is not None and self._healthy_steps >= self.warmup_steps:
+            bad = abs(float(loss)) > self.spike_factor * max(abs(self._ema), 1e-12)
+        if not bad:
+            v = float(loss)
+            self._ema = (v if self._ema is None
+                         else self.ema_beta * self._ema + (1 - self.ema_beta) * v)
+            self._healthy_steps += 1
+            return True
+        self._trip(loss, grad_norm)
+        return False
+
+    def _trip(self, loss, grad_norm) -> None:
+        from ..framework.logging import vlog
+
+        # mark the poison window BEFORE restoring (restore resets nothing
+        # here — poison state is process-local, like the budgets)
+        window = list(self._recent)[-max(self.skip_batches, 0):] if self.skip_batches else []
+        for pos in window:
+            self._poison.add(pos)
+        meta = self.acp.resume()
+        if meta is None:
+            record("fatal_divergences")
+            raise DivergenceError(
+                f"training diverged (loss={loss!r}, grad_norm={grad_norm!r}) "
+                f"at epoch {self._epoch} with no committed checkpoint to "
+                f"roll back to")
+        target = int(meta["counter"])
+        self._per_target[target] = self._per_target.get(target, 0) + 1
+        repeats = self._per_target[target] - 1
+        if repeats:
+            record("repeat_trips")
+        self._rollbacks += 1
+        record("rollbacks")
+        from ..framework import monitor as _monitor
+
+        _monitor.stat_add("divergence_rollbacks")
+        vlog(0, "supervisor: divergence (loss=%r) — rolled back to "
+                "checkpoint %d (global step %d), skipping %d batch(es)",
+             loss, target, meta.get("global_step", -1), len(window))
+        if repeats >= self.max_rollbacks_per_target:
+            record("fatal_divergences")
+            raise DivergenceError(
+                f"training re-diverged after {repeats + 1} rollback(s) to "
+                f"the same checkpoint (counter {target}, global step "
+                f"{meta.get('global_step')}, epoch {meta.get('epoch')}): "
+                f"loss={loss!r}, grad_norm={grad_norm!r}, "
+                f"{len(self._poison)} batch position(s) already "
+                f"quarantined — the divergence is not batch-local "
+                f"(bad LR schedule / numerics?), replay cannot fix it")
+        if self._rollbacks > self.max_rollbacks:
+            record("fatal_divergences")
+            raise DivergenceError(
+                f"rollback budget exhausted ({self._rollbacks} rollbacks > "
+                f"max_rollbacks={self.max_rollbacks}) — the run keeps "
+                f"diverging faster than checkpoints commit; last trip: "
+                f"loss={loss!r} at epoch {self._epoch}")
+        # EMA restarts its warmup: restored weights give a different loss
+        # scale, and a stale EMA would instantly re-trip on the replay
+        self._ema = None
+        self._healthy_steps = 0
+        self._restart = True
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def rollbacks(self) -> int:
+        return self._rollbacks
+
+    @property
+    def poisoned(self) -> set:
+        """Batch positions (epoch, delivered-index) quarantined so far."""
+        return set(self._poison)

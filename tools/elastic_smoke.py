@@ -1,0 +1,315 @@
+"""Elastic-training gate: divergence rollback, exact resume, watchdog (CPU).
+
+One-command proof of the training supervisor's contracts, run on every
+gate pass:
+
+1. **NaN rollback** — a supervised train loop with one injected NaN loss
+   must trip exactly ONE rollback, skip the poison batch, and complete
+   with finite losses (rule F802 stays silent on this clean path).
+2. **SIGKILL mid-epoch → exact resume** — a child trainer checkpointing
+   through ``AutoCheckpoint(data_loader=...)`` is SIGKILLed mid-epoch;
+   rerunning it resumes and must produce final params BIT-IDENTICAL to
+   an uninterrupted run (the "batches are replayed" caveat is gone).
+3. **Wedged collective** — with ``FLAGS_collective_timeout_s`` armed and
+   a latency fault at the ``collective.call`` site, the all-reduce must
+   raise ``TransientDeviceError`` within the deadline instead of hanging.
+4. **Rollback loop → F802** — a run whose every step diverges must die
+   with ``DivergenceError`` after the per-target budget, and analysis
+   rule F802 must fire on the RetraceMonitor that watched it.
+5. **Disabled hooks** — with the supervisor disabled and the watchdog
+   flag at 0.0, the guarded loop is bit-identical to a bare one and no
+   baseline checkpoint is committed.
+
+Prints one JSON line; exit 0 iff every gate holds.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _model(seed=0):
+    import paddle_tpu as pt
+    from paddle_tpu import nn, optimizer as popt
+
+    pt.seed(seed)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    model = pt.Model(net, inputs=["x"], labels=["y"])
+    model.prepare(optimizer=popt.Adam(learning_rate=1e-2),
+                  loss=nn.CrossEntropyLoss())
+    return model
+
+
+def _loader():
+    import numpy as np
+
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io.dataset import TensorDataset
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 4).astype(np.float32)
+    y = rng.randint(0, 2, size=(32,)).astype(np.int64)
+    return DataLoader(TensorDataset([x, y]), batch_size=4, shuffle=True,
+                      return_numpy=True)
+
+
+def _committed(ckpt_dir):
+    from paddle_tpu.incubate.checkpoint import _META, _PREFIX
+
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return sorted(n for n in os.listdir(ckpt_dir)
+                  if n.startswith(_PREFIX)
+                  and os.path.exists(os.path.join(ckpt_dir, n, _META)))
+
+
+def elastic_child(ckpt_dir, out_path):
+    """Subprocess body: 3 supervised epochs over a shuffled exact-resume
+    loader, checkpointing every 3 steps; dumps final params and exits 0.
+    The parent may SIGKILL us mid-epoch — rerunning resumes exactly."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.incubate.checkpoint import AutoCheckpoint
+
+    pt.seed(77)
+    loader = _loader()
+    model = _model(seed=1)
+    acp = AutoCheckpoint(model, ckpt_dir, save_steps=3, async_save=False,
+                         data_loader=loader)
+    acp.resume()
+    for epoch in range(acp.last_epoch, 3):
+        for x, y in loader:
+            model.train_batch([x], [y])
+            acp.step(epoch)
+            time.sleep(0.04)  # widen the parent's mid-epoch kill window
+        acp.epoch_end(epoch)
+    acp.close()
+    np.savez(out_path,
+             **{k: np.asarray(v)
+                for k, v in model.network.state_dict().items()})
+    return 0
+
+
+def _run_child(ckpt_dir, out_path, kill_after_commits=None):
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--elastic-child",
+         ckpt_dir, out_path],
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    if kill_after_commits is None:
+        child.wait()
+        return child.returncode
+    deadline = time.time() + 120
+    try:
+        while len(_committed(ckpt_dir)) < kill_after_commits:
+            if child.poll() is not None:
+                return child.returncode  # finished before the kill window
+            if time.time() > deadline:
+                return -999
+            time.sleep(0.02)
+    finally:
+        if child.poll() is None:
+            child.send_signal(signal.SIGKILL)
+        child.wait()
+    return -signal.SIGKILL
+
+
+def gate_sigkill_exact_resume(tmp):
+    import numpy as np
+
+    ref_out = os.path.join(tmp, "ref.npz")
+    rc = _run_child(os.path.join(tmp, "ck-ref"), ref_out)
+    if rc != 0 or not os.path.exists(ref_out):
+        return {"pass": False, "error": f"uninterrupted child rc={rc}"}
+
+    ck = os.path.join(tmp, "ck-kill")
+    got_out = os.path.join(tmp, "got.npz")
+    rc = _run_child(ck, got_out, kill_after_commits=2)
+    if rc == -999:
+        return {"pass": False, "error": "no 2 commits within 120s"}
+    killed = rc == -signal.SIGKILL
+    rc2 = _run_child(ck, got_out)  # resume in a fresh process
+    if rc2 != 0 or not os.path.exists(got_out):
+        return {"pass": False, "error": f"resumed child rc={rc2}"}
+
+    ref = dict(np.load(ref_out))
+    got = dict(np.load(got_out))
+    identical = (set(ref) == set(got)
+                 and all(np.array_equal(ref[k], got[k]) for k in ref))
+    return {"pass": bool(killed and identical), "killed_mid_run": killed,
+            "final_params_bit_identical": bool(identical)}
+
+
+def gate_nan_rollback(tmp, monitor):
+    """One injected NaN → exactly one rollback, finite completion, and no
+    F802 on the watching monitor (clean path)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.incubate.checkpoint import AutoCheckpoint
+    from paddle_tpu.resilience import TrainingSupervisor
+    from paddle_tpu.resilience import supervisor as sup_mod
+
+    pt.seed(44)
+    loader = _loader()
+    model = _model(seed=1)
+    acp = AutoCheckpoint(model, os.path.join(tmp, "ck-nan"), save_steps=3,
+                         async_save=False, data_loader=loader)
+    sup = TrainingSupervisor(acp, warmup_steps=2)
+    base = sup_mod.stats()
+    step, injected, losses = 0, False, []
+    for epoch in range(2):
+        for x, y in sup.steps(loader, epoch):
+            loss, _ = model.train_batch([x], [y])
+            step += 1
+            lv = float(np.asarray(loss))
+            if step == 5 and not injected:
+                injected, lv = True, float("nan")
+            if sup.guard(lv):
+                losses.append(lv)
+                acp.step(epoch)
+        acp.epoch_end(epoch)
+    acp.close()
+    d = {k: sup_mod.stats()[k] - base[k] for k in base}
+    f802_silent = not [x for x in monitor.diagnostics() if x.rule == "F802"]
+    ok = (sup.rollbacks == 1 and d["rollbacks"] == 1
+          and d["skipped_batches"] >= 1 and d["exact_resumes"] == 1
+          and d["fatal_divergences"] == 0
+          and bool(losses) and all(np.isfinite(losses)) and f802_silent)
+    return {"pass": bool(ok), "rollbacks": sup.rollbacks,
+            "skipped_batches": d["skipped_batches"],
+            "exact_resumes": d["exact_resumes"],
+            "finite_completion": bool(losses) and bool(np.all(np.isfinite(losses))),
+            "f802_silent_on_clean_path": f802_silent}
+
+
+def gate_wedged_collective():
+    import numpy as np
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.framework.errors import TransientDeviceError
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.resilience import FaultPlan
+    from paddle_tpu.resilience import supervisor as sup_mod
+
+    base = sup_mod.stats()["watchdog_trips"]
+    plan = FaultPlan.parse("site=collective.call,every=1,latency_ms=10000")
+    set_flags({"collective_timeout_s": 0.5})
+    raised = elapsed = None
+    try:
+        with plan:
+            t0 = time.monotonic()
+            try:
+                dist.all_reduce(np.ones((dist.get_world_size() or 1, 2),
+                                        np.float32))
+                raised = False
+            except TransientDeviceError:
+                raised = True
+            elapsed = time.monotonic() - t0
+    finally:
+        set_flags({"collective_timeout_s": 0.0})
+    trips = sup_mod.stats()["watchdog_trips"] - base
+    ok = raised and elapsed < 5.0 and trips == 1
+    return {"pass": bool(ok), "raised_within_deadline": bool(raised),
+            "seconds": round(elapsed, 2), "watchdog_trips": trips}
+
+
+def gate_rollback_loop_f802(tmp, monitor):
+    import paddle_tpu as pt
+    from paddle_tpu.framework.errors import DivergenceError
+    from paddle_tpu.incubate.checkpoint import AutoCheckpoint
+    from paddle_tpu.resilience import TrainingSupervisor
+
+    pt.seed(44)
+    loader = _loader()
+    model = _model(seed=1)
+    acp = AutoCheckpoint(model, os.path.join(tmp, "ck-loop"),
+                         save_steps=100, async_save=False,
+                         data_loader=loader)
+    sup = TrainingSupervisor(acp, skip_batches=0)
+    fatal = False
+    try:
+        for x, y in sup.steps(loader, 0):
+            model.train_batch([x], [y])
+            if sup.guard(float("nan")):
+                acp.step(0)
+    except DivergenceError:
+        fatal = True
+    finally:
+        acp.close()
+    fired = bool([x for x in monitor.diagnostics() if x.rule == "F802"])
+    return {"pass": bool(fatal and fired), "fatal_divergence": fatal,
+            "f802_fired": fired}
+
+
+def gate_disabled_hooks(tmp):
+    """Disabled supervisor + watchdog off: the wrapped loop is a plain
+    loop — identical losses to the bare one, no baseline committed."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.framework.flags import get_flags
+    from paddle_tpu.incubate.checkpoint import AutoCheckpoint
+    from paddle_tpu.resilience import TrainingSupervisor
+
+    def run(wrapped):
+        pt.seed(31)
+        loader = _loader()
+        model = _model(seed=1)
+        losses = []
+        if wrapped:
+            acp = AutoCheckpoint(model, os.path.join(tmp, "ck-off"),
+                                 async_save=False, data_loader=loader)
+            sup = TrainingSupervisor(acp, enable=False)
+            for x, y in sup.steps(loader, 0):
+                loss, _ = model.train_batch([x], [y])
+                assert sup.guard(float(np.asarray(loss)))
+                losses.append(float(np.asarray(loss)))
+            acp.close()
+            return losses, acp.latest_dir()
+        for x, y in loader:
+            loss, _ = model.train_batch([x], [y])
+            losses.append(float(np.asarray(loss)))
+        return losses, None
+
+    bare, _ = run(wrapped=False)
+    guarded, latest = run(wrapped=True)
+    identical = bare == guarded  # exact float equality: falsy hooks only
+    no_baseline = latest is None
+    watchdog_off = get_flags("collective_timeout_s")["collective_timeout_s"] == 0.0
+    ok = identical and no_baseline and watchdog_off
+    return {"pass": bool(ok), "losses_bit_identical": bool(identical),
+            "no_baseline_checkpoint": bool(no_baseline),
+            "watchdog_flag_off": bool(watchdog_off)}
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--elastic-child":
+        return elastic_child(sys.argv[2], sys.argv[3])
+    from paddle_tpu.analysis import RetraceMonitor
+
+    t0 = time.time()
+    with tempfile.TemporaryDirectory() as tmp:
+        with RetraceMonitor() as monitor:
+            nan = gate_nan_rollback(tmp, monitor)
+            loop = gate_rollback_loop_f802(tmp, monitor)
+        wedge = gate_wedged_collective()
+        disabled = gate_disabled_hooks(tmp)
+        resume = gate_sigkill_exact_resume(tmp)
+    gates = {"nan_rollback": nan, "rollback_loop_f802": loop,
+             "wedged_collective": wedge, "disabled_hooks": disabled,
+             "sigkill_exact_resume": resume}
+    passed = all(g["pass"] for g in gates.values())
+    print(json.dumps({"pass": bool(passed), **gates,
+                      "seconds": round(time.time() - t0, 1)}))
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

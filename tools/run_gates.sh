@@ -17,9 +17,10 @@
 #   router-smoke tools/router_smoke.py (replica kill -> zero-loss failover + rolling swap)
 #   gen-smoke tools/gen_smoke.py (continuous batching: HOL p99, zero recompiles, probes)
 #   slo-smoke tools/slo_smoke.py (request tracing end-to-end + SLO burn-rate alert)
+#   elastic-smoke tools/elastic_smoke.py (NaN rollback + exact resume + collective watchdog)
 #   bench   python bench.py          (only when a real TPU answers)
 #
-# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|chaos-smoke|obs-smoke|router-smoke|gen-smoke|slo-smoke|bench]...
+# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|chaos-smoke|obs-smoke|router-smoke|gen-smoke|slo-smoke|elastic-smoke|bench]...
 #         tools/run_gates.sh --only suite
 # Exit code: 0 iff every stage that ran passed.
 set -u
@@ -120,6 +121,11 @@ run_stage gen-smoke env JAX_PLATFORMS=cpu python tools/gen_smoke.py
 # export with zero post-warmup compiles, injected decode latency -> burn-rate
 # alert + M903 + scale-up signal through the router hook, off means off
 run_stage slo-smoke env JAX_PLATFORMS=cpu python tools/slo_smoke.py
+# elastic training: injected NaN -> exactly one rollback + finite finish,
+# SIGKILL mid-epoch -> bit-identical resume (shuffle order, RNG, params),
+# wedged collective -> watchdog raises within the deadline, F802 on a
+# rollback loop, disabled supervisor is a plain loop
+run_stage elastic-smoke env JAX_PLATFORMS=cpu python tools/elastic_smoke.py
 
 # bench only when a real accelerator answers within 60s
 if want bench; then
