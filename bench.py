@@ -458,11 +458,13 @@ def bench_gpt_generate():
                             rng.randint(4, 65, size=48))]
     total_new = sum(n for _, n in reqs)
 
-    def run(continuous):
+    def run(continuous, paged=False):
         with GenerationEngine(
                 model, prompt_buckets=[16, 48], batch_size=8,
                 max_queue_delay_ms=1.0, continuous=continuous,
-                name=f"bench-gen-{'cont' if continuous else 'legacy'}"
+                paged=paged,
+                name=f"bench-gen-"
+                     f"{'paged' if paged else 'cont' if continuous else 'legacy'}"
         ) as eng:
             eng.warmup()
             lat = []
@@ -480,11 +482,17 @@ def bench_gpt_generate():
 
     legacy_tps, legacy_lat = run(False)
     tps, lat_ms = run(True)
+    # paged KV + speculative decoding on the identical workload (default
+    # pool = the same HBM the dense ring uses; no shared prefixes here,
+    # so this isolates the paging/speculation overhead-vs-win alone)
+    paged_tps, paged_lat = run(True, paged=True)
     return _emit("gpt_generate_tokens_per_sec", round(tps, 1), "tok/s",
                  tps / legacy_tps,
                  legacy_tokens_per_sec=round(legacy_tps, 1),
+                 paged_tokens_per_sec=round(paged_tps, 1),
                  mean_latency_ms=round(float(lat_ms), 1),
                  legacy_mean_latency_ms=round(float(legacy_lat), 1),
+                 paged_mean_latency_ms=round(float(paged_lat), 1),
                  requests=len(reqs), new_tokens=total_new,
                  method="continuous_batching_vs_legacy")
 

@@ -53,7 +53,8 @@ class RetraceMonitor:
         # executor, NOT deduped signature events (rule R403)
         self._cache_sites: Dict[str, dict] = {}
         # ("serving", name) engine snapshots: same latest-value semantics
-        # (rules S601 / S602 / S603 — router snapshots carry "router": 1)
+        # (rules S601 / S602 / S603 / S604 — router snapshots carry
+        # "router": 1)
         self._serving_sites: Dict[str, dict] = {}
         # ("router", "<router>[<i>]") per-replica snapshots: latest state /
         # outstanding / counters per replica (rule S602 context)
@@ -358,6 +359,33 @@ class RetraceMonitor:
                              "counters; if the queue is simply deeper than "
                              "the slot count can drain, add batch_size "
                              "slots or another replica")
+            # S604: paged-KV page-pool exhaustion that is a LEAK, not
+            # load — admission deferred with zero free pages while pages
+            # sit refcounted that no live slot table and no registered
+            # prefix references.  Genuine pressure (free=0, leaked=0)
+            # stays S603 territory; leaked>0 means eviction returned a
+            # slot but not its pages.
+            leaked = int(stats.get("kv_pages_leaked", 0))
+            if (starved > self.budget and leaked > 0
+                    and int(stats.get("kv_pages_free", -1)) == 0):
+                out.add("S604",
+                        f"serving engine {name} deferred admission for "
+                        f"{starved} steps after warmup with 0 free KV "
+                        f"pages while {leaked} page(s) are still "
+                        f"refcounted by no slot table and no shared "
+                        f"prefix — a page leak: evicted slots returned "
+                        f"to the scheduler without returning their pages "
+                        f"to the free list, so the pool shrinks until "
+                        f"admission deadlocks",
+                        location=Location(file=name, function=name),
+                        hint="audit PagePool release/decref pairing "
+                             "(every admit/ensure_writable allocation "
+                             "must be released exactly once at eviction "
+                             "or preemption) and drop stale shared "
+                             "prefixes (PagePool.drop_prefix) — leaked "
+                             "pages never return on their own; restart "
+                             "the engine to rebuild the pool as a "
+                             "stopgap")
         with self._lock:
             autotune_sites = {k: dict(v)
                               for k, v in self._autotune_sites.items()}

@@ -176,6 +176,27 @@ define_flag("continuous_batching", True,
             "the batch). Off falls back to the legacy run-batch-to-"
             "completion path. Per-engine override: "
             "GenerationEngine(continuous=...).")
+define_flag("paged_kv", False,
+            "GenerationEngine KV-cache layout (serving/generation.py): on, "
+            "the continuous-batching decode loop stores KV in fixed-size "
+            "pages behind a slot→page-table indirection (vLLM-style "
+            "PagedAttention) instead of one dense ring region per slot — "
+            "pages are allocated on demand, shared copy-on-write across "
+            "slots with a common prefix, and returned to a free list at "
+            "eviction, so the same HBM budget holds strictly more "
+            "resident slots. Tokens stay bit-identical to the dense "
+            "path. Requires continuous batching. Per-engine override: "
+            "GenerationEngine(paged=...).")
+define_flag("kv_page_size", 16,
+            "Tokens per KV page in paged mode. Smaller pages waste less "
+            "memory on the last partial page per sequence but grow the "
+            "page table; must divide the engine's max_len.")
+define_flag("speculative_k", 4,
+            "Speculative decoding draft length in paged mode: an n-gram "
+            "proposer (prompt-lookup) drafts up to k tokens per slot and "
+            "one batched verify step accepts the longest matching prefix "
+            "— token-identical to plain greedy, up to k+1 tokens per "
+            "step when drafts hit. 0 disables speculation.")
 define_flag("metrics_port", 0,
             "Prometheus text-exposition endpoint for the observability "
             "registry (observability/exporters.py): 0 disables (default), "

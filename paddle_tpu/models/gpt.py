@@ -217,6 +217,42 @@ class ParallelAttention(Layer):
         ctx = constrain(ctx, None, None, "model")
         return self.out(ctx), {"k": new_k, "v": new_v}
 
+    def forward_paged(self, x, kv, write_page, write_off, gather_tab, mask):
+        """One attention step over a PAGED KV pool — the paged serving
+        decode path (see :meth:`GPTModel.init_paged_cache`).
+
+        The new tokens' K/V are scattered into the shared page pool at
+        host-resolved physical coordinates (``write_page``/``write_off``,
+        flattened ``[B*T]``; the pool's last page is the write-drop page
+        for padding), then each slot's logical cache view is gathered
+        back through its page-table row (``gather_tab`` ``[B,G]``,
+        entries pre-clipped to valid pages) and attention runs over the
+        gathered ``[B,H,C,hd]`` view exactly as the dense ring path does
+        — same einsums, same mask semantics, so tokens stay
+        bit-identical.  ``mask``: ``[B,T,C]`` attention validity computed
+        from the host-owned slot→position map.
+        """
+        B, T, D = x.shape
+        H, hd = self.num_heads, self.head_dim
+        q, k, v = self._heads(x)  # [B,H,T,hd]
+        kw = k.transpose(0, 2, 1, 3).reshape(B * T, H, hd)
+        vw = v.transpose(0, 2, 1, 3).reshape(B * T, H, hd)
+        new_k = kv["k"].at[write_page, :, write_off].set(kw)
+        new_v = kv["v"].at[write_page, :, write_off].set(vw)
+        G, page = gather_tab.shape[1], kv["k"].shape[2]
+        kview = jnp.take(new_k, gather_tab, axis=0)  # [B,G,H,page,hd]
+        vview = jnp.take(new_v, gather_tab, axis=0)
+        kview = kview.transpose(0, 2, 1, 3, 4).reshape(B, H, G * page, hd)
+        vview = vview.transpose(0, 2, 1, 3, 4).reshape(B, H, G * page, hd)
+        scores = jnp.einsum("bhqd,bhcd->bhqc", q, kview) / math.sqrt(hd)
+        scores = jnp.where(mask[:, None], scores,
+                           jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bhqc,bhcd->bhqd", probs, vview)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, T, D)
+        ctx = constrain(ctx, None, None, "model")
+        return self.out(ctx), {"k": new_k, "v": new_v}
+
 
 class ParallelMLP(Layer):
     def __init__(self, cfg: GPTConfig):
@@ -259,6 +295,13 @@ class GPTBlock(Layer):
 
     def forward_cached(self, x, kv, hit, mask):
         a, new_kv = self.attn.forward_cached(self.ln1(x), kv, hit, mask)
+        x = x + a
+        x = x + self.mlp(self.ln2(x))
+        return x, new_kv
+
+    def forward_paged(self, x, kv, write_page, write_off, gather_tab, mask):
+        a, new_kv = self.attn.forward_paged(self.ln1(x), kv, write_page,
+                                            write_off, gather_tab, mask)
         x = x + a
         x = x + self.mlp(self.ln2(x))
         return x, new_kv
@@ -360,6 +403,91 @@ class GPTModel(Layer):
             ],
         }
 
+    # -- paged KV cache (vLLM-style PagedAttention; Kwon et al. 2023) -------
+    def init_paged_cache(self, num_pages: int, page_size: int, dtype=None):
+        """Preallocate a paged KV pool: per-layer ``[P+1, H, page, hd]``
+        K/V page arrays shared by ALL slots.  Which physical page holds
+        which slot's tokens is decided per call by a host-owned page
+        table (see :meth:`forward_paged`) — the indirection that lets
+        pages be allocated on demand, shared copy-on-write between slots
+        (common system prompts prefill once), and returned to a free
+        list at eviction.  Index ``P`` (the last page) is the write-DROP
+        page: padding tokens scatter there and nothing ever gathers it,
+        so every call keeps static shapes with no dynamic masking."""
+        cfg = self.cfg
+        hd = cfg.hidden_size // cfg.num_heads
+        dt = dtype or cfg.dtype
+        P, pg = int(num_pages), int(page_size)
+        return {
+            "layers": [
+                {"k": jnp.zeros((P + 1, cfg.num_heads, pg, hd), dt),
+                 "v": jnp.zeros((P + 1, cfg.num_heads, pg, hd), dt)}
+                for _ in range(cfg.num_layers)
+            ],
+        }
+
+    def copy_pages(self, cache, src, dst):
+        """Copy whole pages ``src[i] → dst[i]`` inside the pool — the
+        copy-on-write op: before a slot's first divergent write into a
+        page whose refcount is >1, the host allocates a fresh page and
+        dispatches this copy, so siblings sharing the original page are
+        never perturbed.  ``src``/``dst`` are fixed-size ``[K]`` int32
+        vectors; ``-1`` entries are no-ops (the copy lands in the
+        write-drop page), so the op always runs at one static shape."""
+        src = jnp.maximum(jnp.asarray(src, jnp.int32), 0)
+        dst = jnp.asarray(dst, jnp.int32)
+        P = cache["layers"][0]["k"].shape[0] - 1
+        dst = jnp.where(dst >= 0, dst, P)
+        return {
+            "layers": [
+                {"k": l["k"].at[dst].set(l["k"][src]),
+                 "v": l["v"].at[dst].set(l["v"][src])}
+                for l in cache["layers"]
+            ],
+        }
+
+    def forward_paged(self, input_ids, positions, pos_map, table, cache):
+        """Prefill/decode forward over :meth:`init_paged_cache` state.
+
+        Same contract as :meth:`forward_cached` — ``input_ids`` /
+        ``positions`` are ``[B,T]`` with absolute positions and ``-1`` =
+        padding — but the cache metadata is HOST-owned and passed per
+        call: ``table`` ``[B,G]`` maps each slot's logical pages to
+        physical pool pages (``-1`` = unmapped), and ``pos_map``
+        ``[B,C]`` (``C = G*page``) is the slot→absolute-position map
+        *after this call's writes* (the host knows exactly which
+        positions it is writing, so it marks them up front; stale or
+        rejected-draft entries stay ``-1`` and are invisible).  All
+        shapes are static, so the jitted step compiles once.  Returns
+        ``(hidden [B,T,D], new_cache)``.
+        """
+        positions = jnp.asarray(positions, jnp.int32)
+        pos_map = jnp.asarray(pos_map, jnp.int32)
+        table = jnp.asarray(table, jnp.int32)
+        P = cache["layers"][0]["k"].shape[0] - 1
+        page = cache["layers"][0]["k"].shape[2]
+        G = table.shape[1]
+        C = G * page
+        x = self.wte(input_ids) + self.wpe(jnp.maximum(positions, 0))
+        x = self.drop(x)
+        slots = jnp.where(positions >= 0, positions % C, -1)
+        g = jnp.clip(slots // page, 0, G - 1)
+        off = jnp.clip(slots % page, 0, page - 1)
+        phys = jnp.take_along_axis(table, g, axis=1)  # [B,T]
+        # padding tokens and unmapped pages write into the drop page P
+        phys = jnp.where((slots >= 0) & (phys >= 0), phys, P)
+        write_page = phys.reshape(-1)
+        write_off = off.reshape(-1)
+        kp, qp = pos_map[:, None, :], positions[:, :, None]
+        mask = (kp >= 0) & (kp <= qp) & (kp > qp - C)  # [B,T,C]
+        gather_tab = jnp.maximum(table, 0)  # unmapped → page 0; mask hides it
+        new_layers = []
+        for blk, kv in zip(self.blocks, cache["layers"]):
+            x, kv = blk.forward_paged(x, kv, write_page, write_off,
+                                      gather_tab, mask)
+            new_layers.append(kv)
+        return self.ln_f(x), {"layers": new_layers}
+
     def forward_cached(self, input_ids, positions, cache):
         """Prefill/decode forward over :meth:`init_cache` state.
 
@@ -415,6 +543,24 @@ class GPTForCausalLM(Layer):
         with logits ``[B,T,V]`` (or ``[B,V]`` under ``gather_last``).
         """
         h, cache = self.gpt.forward_cached(input_ids, positions, cache)
+        if gather_last is not None:
+            idx = jnp.maximum(jnp.asarray(gather_last, jnp.int32) - 1, 0)
+            h = jnp.take_along_axis(
+                h, idx[:, None, None], axis=1)[:, 0]  # [B,D]
+            logits = jnp.einsum("bd,vd->bv", h,
+                                jnp.asarray(self.gpt.wte.weight))
+            return constrain(logits, None, None), cache
+        logits = jnp.einsum("bsd,vd->bsv", h,
+                            jnp.asarray(self.gpt.wte.weight))
+        return constrain(logits, None, None, None), cache
+
+    def forward_paged(self, input_ids, positions, pos_map, table, cache,
+                      gather_last=None):
+        """Paged KV forward (see :meth:`GPTModel.forward_paged`).  Same
+        ``gather_last`` contract as :meth:`forward_cached`: per-sequence
+        prompt lengths ``[B]`` project only the last hidden state."""
+        h, cache = self.gpt.forward_paged(input_ids, positions, pos_map,
+                                          table, cache)
         if gather_last is not None:
             idx = jnp.maximum(jnp.asarray(gather_last, jnp.int32) - 1, 0)
             h = jnp.take_along_axis(

@@ -19,13 +19,23 @@ KV-cache model paths into an online engine:
   (``FLAGS_continuous_batching``) it runs slot-level continuous
   batching: a persistent decode loop admits/evicts individual requests
   at decode-step granularity, so a stalled long request holds one slot,
-  never the batch.
+  never the batch.  With ``FLAGS_paged_kv`` the per-slot ring regions
+  become one shared page pool behind a slot→page-table indirection
+  (PagedAttention): pages allocate on demand, shared-prefix pages are
+  reused copy-on-write, eviction is a host table edit, and an n-gram
+  proposer drives speculative decoding — all bit-identical to dense
+  greedy on the same closed compile set.
+* :mod:`~paddle_tpu.serving.paging` — :class:`PagePool`: the host-side
+  page accounting behind paged mode — refcounts, the free list, CoW
+  copy scheduling and the shared-prefix registry.
 * :mod:`~paddle_tpu.serving.metrics` — :class:`ServingMetrics`: queue
-  depth, batch occupancy, p50/p99 latency, tokens/s and the continuous
+  depth, batch occupancy, p50/p99 latency, tokens/s, the continuous
   batching slot-scheduler family (admitted/evicted/starved counters,
-  per-step occupancy gauges) published as ``("serving", <name>)`` events
-  on ``framework.trace_events`` (consumed by ``analysis`` rules
-  S601/S603).
+  per-step occupancy gauges) and the paged-KV page-accounting family
+  (``kv_pages_free``/``kv_pages_shared`` gauges, ``cow_copies``),
+  published as ``("serving", <name>)`` events on
+  ``framework.trace_events`` (consumed by ``analysis`` rules
+  S601/S603/S604).
 * :mod:`~paddle_tpu.serving.router` / :mod:`~paddle_tpu.serving.replica`
   — :class:`Router`: the multi-replica control plane — health-checked
   (active probes + per-replica circuit breaker) least-outstanding/p2c
@@ -38,6 +48,7 @@ from .bucketing import Bucket, BucketSet, as_bucket
 from .engine import InferenceEngine
 from .generation import GenerationEngine
 from .metrics import ServingMetrics
+from .paging import PagePool
 from .replica import Replica
 from .router import Router
 
@@ -50,6 +61,7 @@ __all__ = [
     "InferenceEngine",
     "GenerationEngine",
     "ServingMetrics",
+    "PagePool",
     "Replica",
     "Router",
 ]

@@ -32,6 +32,32 @@ The continuous compile set is ``len(prompt_buckets) + 2`` (per-bucket
 slot-admission prefill, the shared decode step, the slot eviction op),
 all traced in :meth:`warmup` — zero post-warmup recompiles.  The legacy
 path (``continuous=False``) keeps its ``len(prompt_buckets) + 1`` set.
+
+**Paged KV cache** (``FLAGS_paged_kv``, requires continuous mode): the
+per-slot dense ring regions are replaced by ONE shared page pool
+(``GPTModel.init_paged_cache``) behind a host-owned slot→page-table
+indirection (``serving/paging.py``) — vLLM-style PagedAttention.  Pages
+are allocated on demand as sequences grow, shared copy-on-write across
+slots admitted with a common ``prefix_key`` (the system prompt prefills
+once), and returned to a free list at eviction (a pure table edit — no
+device call), so the same HBM budget holds strictly more resident
+slots; when the pool runs dry mid-decode the newest slot is preempted
+and requeued (greedy decode is deterministic, so regeneration is
+bit-identical).  The paged step is a unified decode/verify executable
+of static width ``1 + FLAGS_speculative_k``: an n-gram proposer
+(prompt-lookup) drafts up to k tokens per slot per step and the longest
+prefix matching the model's own argmax is accepted — token-identical to
+plain greedy, up to k+1 tokens per step when text repeats.  The loop
+runs serialized (each step harvested before the next dispatch) because
+drafting and page accounting depend on the previous step's tokens.  The
+paged compile set is closed and traced in :meth:`warmup`:
+``len(prompt_buckets) + 3`` with speculation (per-bucket admission, the
+unified step, its ``[B, 1]`` no-draft fast trace, the page-copy op) or
+``+ 2`` without.  The loop self-measures both step variants and drafts
+only when the predicted accepted tokens out-earn the wide step's extra
+cost, with per-slot exponential backoff after zero-accept verifies — on
+compute-bound hosts speculation turns itself off instead of losing
+throughput.
 """
 from __future__ import annotations
 
@@ -59,7 +85,8 @@ from ..resilience import CircuitBreaker, RetryPolicy
 from ..resilience import retry as _retry_mod
 from ..resilience.faults import fault_point
 from .batcher import MicroBatcher, Request
-from .metrics import ServingMetrics, SLOT_COUNTERS
+from .metrics import PAGED_COUNTERS, ServingMetrics, SLOT_COUNTERS
+from .paging import PagePool
 
 __all__ = ["GenerationEngine"]
 
@@ -78,6 +105,14 @@ class GenerationEngine:
     ``continuous`` — slot-level continuous batching (None reads
     ``FLAGS_continuous_batching``); ``False`` is the legacy
     run-batch-to-completion scheduler.
+
+    ``paged`` — paged KV cache + speculative decoding (None reads
+    ``FLAGS_paged_kv``; requires continuous mode).  ``kv_pages`` sizes
+    the shared page pool (default ``batch_size * cache_len /
+    kv_page_size`` — the same HBM the dense ring would use; size it
+    DOWN to hold more slots in the same budget, the whole point of
+    paging).  ``kv_page_size`` / ``speculative_k`` default to
+    ``FLAGS_kv_page_size`` / ``FLAGS_speculative_k``.
     """
 
     def __init__(self, model, *, prompt_buckets: Sequence[int],
@@ -87,6 +122,10 @@ class GenerationEngine:
                  circuit_breaker: bool = True,
                  retry_transient: bool = True,
                  continuous: Optional[bool] = None,
+                 paged: Optional[bool] = None,
+                 kv_pages: Optional[int] = None,
+                 kv_page_size: Optional[int] = None,
+                 speculative_k: Optional[int] = None,
                  name: Optional[str] = None):
         if name is None:
             _gen_counter[0] += 1
@@ -106,10 +145,32 @@ class GenerationEngine:
         self._eos = eos_token_id
         self._continuous = bool(flag("continuous_batching")
                                 if continuous is None else continuous)
+        self._paged = bool(flag("paged_kv") if paged is None else paged)
+        if self._paged and not self._continuous:
+            raise InvalidArgumentError(
+                "paged_kv requires continuous batching (the legacy "
+                "run-batch path owns no persistent device state to page)")
+        self._C = int(cache_len or model.gpt.cfg.max_position)
+        self._page = int(flag("kv_page_size")
+                         if kv_page_size is None else kv_page_size)
+        self._spec_k = max(int(flag("speculative_k")
+                               if speculative_k is None else speculative_k),
+                           0)
+        self._pool: Optional[PagePool] = None
+        if self._paged:
+            if self._buckets[-1] > self._C:
+                raise InvalidArgumentError(
+                    f"largest prompt bucket ({self._buckets[-1]}) exceeds "
+                    f"cache_len ({self._C}) — paged admission cannot map it")
+            self._kv_pages = (int(kv_pages) if kv_pages is not None
+                              else self._batch * (self._C // self._page))
+            self._pool = self._new_pool()  # validates page geometry
         self._warm = False
         self._traces: Dict[str, int] = {"prefill": 0, "decode": 0,
-                                        "admit": 0, "evict": 0}
-        self.metrics = ServingMetrics(name, extra_counters=SLOT_COUNTERS)
+                                        "admit": 0, "evict": 0, "cow": 0}
+        self.metrics = ServingMetrics(
+            name, extra_counters=(SLOT_COUNTERS + PAGED_COUNTERS
+                                  if self._paged else SLOT_COUNTERS))
 
         mdl, traces = model, self._traces
 
@@ -157,10 +218,57 @@ class GenerationEngine:
             return (jnp.where(mask, jnp.int32(0), tok),
                     mdl.gpt.reset_slots(cache, mask))
 
+        # -- paged-mode executables (see serving/paging.py).  Admission
+        # prefills STRAIGHT into the shared pool: each slot writes only
+        # its own pages (padding rows scatter into the write-drop page),
+        # so unlike the dense path no fresh-cache + row-scatter merge is
+        # needed — live slots' KV is untouched by construction.
+        def padmit(params, buffers, ids, positions, pos_map, table, lens,
+                   cache):
+            def body(ids, positions, pos_map, table, lens, cache):
+                traces["admit"] += 1
+                logits, cache = mdl.forward_paged(
+                    ids, positions, pos_map, table, cache, gather_last=lens)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+            return functional_call(mdl, params, ids, positions, pos_map,
+                                   table, lens, cache, buffers=buffers,
+                                   training=False, call=body)
+
+        def pstep(params, buffers, packed, cache):
+            # the unified decode/verify step: T = 1 + speculative_k
+            # columns (or the [B, 1] no-draft fast trace); rows with
+            # position -1 (no draft / free slot) are inert.  All int32
+            # per-step inputs ride ONE packed [B, 2T + C + G] transfer
+            # (ids | positions | pos_map | table) — the serialized loop
+            # is dispatch-bound and one host transfer beats four.
+            # out[:, j] is the model's greedy next token after consuming
+            # ids[:, :j+1] — column 0 is the plain decode token, columns
+            # 1.. verify the drafts.
+            def body(packed, cache):
+                traces["decode"] += 1
+                C = self._C
+                G = C // self._page
+                Tp = (packed.shape[1] - C - G) // 2
+                logits, cache = mdl.forward_paged(
+                    packed[:, :Tp], packed[:, Tp:2 * Tp],
+                    packed[:, 2 * Tp:2 * Tp + C], packed[:, 2 * Tp + C:],
+                    cache)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+            return functional_call(mdl, params, packed, cache,
+                                   buffers=buffers, training=False,
+                                   call=body)
+
+        def cow(cache, src, dst):
+            traces["cow"] += 1
+            return mdl.gpt.copy_pages(cache, src, dst)
+
         self._prefill = jax.jit(prefill)
         self._decode = jax.jit(decode)
         self._admit = jax.jit(admit)
         self._evict = jax.jit(evict)
+        self._padmit = jax.jit(padmit)
+        self._step = jax.jit(pstep)
+        self._cow = jax.jit(cow)
         self.breaker = (CircuitBreaker(name) if circuit_breaker else None)
         self._retry_transient = bool(retry_transient)
         if self._continuous:
@@ -174,7 +282,9 @@ class GenerationEngine:
                 metrics=self.metrics,
                 name=name)
             self._thread: Optional[threading.Thread] = threading.Thread(
-                target=self._slot_loop, name=f"{name}-decode", daemon=True)
+                target=(self._paged_loop if self._paged
+                        else self._slot_loop),
+                name=f"{name}-decode", daemon=True)
             self._thread.start()
         else:
             self._thread = None
@@ -205,15 +315,71 @@ class GenerationEngine:
     def compile_count(self) -> int:
         """Traced executables so far: one per warmed prompt bucket (the
         prefill or slot-admission executable) plus the shared decode step,
-        plus — continuous mode — the slot-eviction op."""
+        plus — continuous mode — the slot-eviction op, or — paged mode —
+        the page-copy (CoW) op and, when speculation is on, the ``[B, 1]``
+        no-draft fast trace of the decode/verify step; paged eviction is a
+        pure host table edit with no executable at all."""
         return sum(self._traces.values())
 
     def warmup(self) -> int:
         """Trace the full compile set on dummy data so live traffic never
         pays compile latency.  Returns the (closed) compile count:
-        ``len(prompt_buckets) + 2`` continuous, ``+ 1`` legacy."""
+        ``len(prompt_buckets) + 2`` continuous (or paged without
+        speculation), ``len(prompt_buckets) + 3`` paged with speculation
+        (the extra ``[B, 1]`` no-draft fast trace), ``+ 1`` legacy."""
         B = self._batch
-        if self._continuous:
+        if self._paged:
+            # placement discipline as below: ids/positions/pos_map/table
+            # always enter as host transfers, the pool as a jit output —
+            # _init_pool covers the one fresh-pool placement.
+            G = self._C // self._page
+            pm0 = jnp.asarray(np.full((B, self._C), -1, np.int32))
+            tb0 = jnp.asarray(np.full((B, G), -1, np.int32))
+            cache = self._init_pool()
+            for sb in self._buckets:
+                ids = jnp.asarray(np.zeros((B, sb), np.int32))
+                pos = jnp.asarray(np.broadcast_to(
+                    np.arange(sb, dtype=np.int32), (B, sb)))
+                lens = jnp.asarray(np.full((B,), sb, np.int32))
+                _, cache = self._padmit(self._params, self._buffers, ids,
+                                        pos, pm0, tb0, lens, cache)
+            T = 1 + self._spec_k
+            _, cache = self._step(
+                self._params, self._buffers,
+                self._pack_step(
+                    np.zeros((B, T), np.int32),
+                    np.full((B, T), -1, np.int32)), cache)
+            if self._spec_k:
+                # the no-draft fast path: a second [B, 1]-shaped trace of
+                # the same step fn.  T=1 attention/logits are ~T x
+                # cheaper, and the decode loop drops to this executable
+                # whenever no live slot is drafting (proposer throttled
+                # or sliding-window region)
+                _, cache = self._step(
+                    self._params, self._buffers,
+                    self._pack_step(
+                        np.zeros((B, 1), np.int32),
+                        np.full((B, 1), -1, np.int32)), cache)
+                # seed the loop's wide-vs-fast cost model with one timed
+                # (warm, blocked) call per trace; the loop refines both
+                # online from its own iteration times
+                timed = {}
+                for key, Tt in (("wide", T), ("fast", 1)):
+                    pk = self._pack_step(np.zeros((B, Tt), np.int32),
+                                         np.full((B, Tt), -1, np.int32))
+                    best = None
+                    for _ in range(2):
+                        t0 = time.monotonic()
+                        o, cache = self._step(self._params, self._buffers,
+                                              pk, cache)
+                        np.asarray(o)
+                        ms = (time.monotonic() - t0) * 1e3
+                        best = ms if best is None else min(best, ms)
+                    timed[key] = best
+                self._it_wide0, self._it_fast0 = timed["wide"], timed["fast"]
+            neg = jnp.asarray(np.full((B,), -1, np.int32))
+            self._cow(cache, neg, neg)
+        elif self._continuous:
             # warmup must mirror LIVE argument placement, not just shapes:
             # tok/cache enter every live call as jit outputs (committed),
             # everything else as host transfers.  A placement mismatch is
@@ -315,6 +481,521 @@ class GenerationEngine:
             self.breaker.record_success(0)
         if not r.future.done():
             r.future.set_result(np.asarray(s["out"], np.int32))
+
+    # -- paged scheduler -----------------------------------------------------
+    def _new_pool(self) -> PagePool:
+        return PagePool(self._batch, self._kv_pages, self._page, self._C)
+
+    def _init_pool(self):
+        """Fresh empty page pool for the paged decode loop, pushed through
+        one inert unified step (every row position ``-1``) — same
+        placement rationale as :meth:`_init_state`: the returned handles
+        carry the jit-output placement every steady-state executable was
+        compiled against, and the fresh-pool placement variant of the
+        step gets built here, during warmup, not on first live use."""
+        B, T = self._batch, 1 + self._spec_k
+        _, cache = self._step(
+            self._params, self._buffers,
+            self._pack_step(np.zeros((B, T), np.int32),
+                            np.full((B, T), -1, np.int32)),
+            self._model.gpt.init_paged_cache(self._kv_pages, self._page))
+        return cache
+
+    def _pack_step(self, ids: np.ndarray, positions: np.ndarray,
+                   pos_map: Optional[np.ndarray] = None,
+                   table: Optional[np.ndarray] = None) -> np.ndarray:
+        """One ``[B, 2T + C + G]`` int32 row per slot carrying every
+        per-step host input of the unified step (``ids | positions |
+        pos_map | table``).  ``None`` pos_map/table mean all ``-1``
+        (inert warmup shapes).  The concatenate also snapshots the
+        host-owned pool state, so async dispatch never races a later
+        table edit."""
+        B, C = self._batch, self._C
+        G = C // self._page
+        if pos_map is None:
+            pos_map = np.full((B, C), -1, np.int32)
+        if table is None:
+            table = np.full((B, G), -1, np.int32)
+        return np.concatenate(
+            [np.asarray(ids, np.int32), np.asarray(positions, np.int32),
+             np.asarray(pos_map, np.int32), np.asarray(table, np.int32)],
+            axis=1)
+
+    @staticmethod
+    def _ngram_drafts(hist: List[int], k: int, n: int = 2) -> List[int]:
+        """Prompt-lookup proposer (the n-gram degenerate case of
+        speculative decoding — no draft model): find the most recent
+        earlier occurrence of the history's final ``n``-gram and propose
+        the ``k`` tokens that followed it.  Pure host work; free when it
+        misses, up to ``k`` extra tokens per verify step when text
+        repeats (templated / structured output, copied spans)."""
+        if k <= 0 or len(hist) < n + 1:
+            return []
+        tail = hist[-n:]
+        for s in range(len(hist) - n - 1, -1, -1):
+            if hist[s:s + n] == tail:
+                return [int(t) for t in hist[s + n: s + n + k]]
+        return []
+
+    @staticmethod
+    def _unpack_paged(r: Request):
+        """Paged-mode request meta: ``(budget, prefix_key, prefix_len)``
+        (see :meth:`submit`)."""
+        budget, key, plen = r.meta
+        prompt = np.asarray(r.inputs[0], np.int32).reshape(-1)
+        return prompt, key, min(int(plen), len(prompt)), int(budget)
+
+    def _paged_loop(self):
+        """The persistent paged decode loop — sole owner of the device
+        pool AND the host page accounting (``PagePool``).
+
+        Per iteration: admit queued requests FCFS while the free list
+        covers their page demand (prefill lands straight in the pool —
+        shared-prefix pages come mapped, not recomputed), then one
+        unified decode/verify step for all live slots with n-gram drafts
+        in the extra columns, then immediate harvest — accept the
+        longest draft prefix matching the model's own argmax, invalidate
+        the rest via the position map.  CoW page copies collected from
+        admission / first-divergent-write are dispatched before the step
+        they protect.  Pool exhaustion mid-decode preempts the NEWEST
+        slot (its request requeues and regenerates bit-identically);
+        eviction is a pure host table edit.  The loop is serialized (no
+        double buffering) because drafting and page accounting need the
+        previous step's tokens before the next dispatch.
+
+        Steps where no slot drafts run a ``[B, 1]`` fast trace of the
+        same step fn instead of the wide ``[B, 1+k]`` verify trace, and
+        the wide path is gated by a cost model: the loop measures its own
+        fast/wide iteration times and speculates only when the predicted
+        accepted tokens (per-slot trailing acceptance) clear break-even —
+        on accelerators the two traces cost about the same so the bar is
+        ~0; on a compute-bound host the loop turns selective by itself.
+        """
+        q = self._batcher
+        B, C, page = self._batch, self._C, self._page
+        k_max, eos = self._spec_k, self._eos
+        T = 1 + k_max
+        max_restarts = (max(int(flag("transient_max_retries")) - 1, 0)
+                        if self._retry_transient else 0)
+        slots: List[Optional[dict]] = [None] * B
+        pos = np.full((B,), -1, np.int64)  # next write position (-1 = free)
+        pool = self._pool if self._pool is not None else self._new_pool()
+        self._pool = pool
+        cache = None                       # device handles: the page pool
+        carry: List[tuple] = []            # (Request, n_restarts) to re-admit
+        last_pub = 0.0
+        # self-measured iteration costs (ms) of the [B, 1] fast trace vs
+        # the wide [B, T] verify trace — seeded by warmup's timed calls
+        # when available (optimistic before that: no bar until both are
+        # known) and refined online from real iteration times
+        it_fast: Optional[float] = getattr(self, "_it_fast0", None)
+        it_wide: Optional[float] = getattr(self, "_it_wide0", None)
+
+        def dispatch_cow(pairs):
+            # chunk (src, dst, owner_slot) copies through the fixed-[B]
+            # CoW op; -1 entries land in the write-drop page
+            nonlocal cache
+            while pairs:
+                chunk, pairs = pairs[:B], pairs[B:]
+                src = np.full((B,), -1, np.int32)
+                dst = np.full((B,), -1, np.int32)
+                for j, (s_, d_, _own) in enumerate(chunk):
+                    src[j], dst[j] = s_, d_
+                cache = self._cow(cache, jnp.asarray(src), jnp.asarray(dst))
+
+        def preempt_newest() -> Optional[int]:
+            victims = [v for v in range(B) if slots[v] is not None]
+            if not victims:
+                return None
+            v = max(victims, key=lambda i: (slots[i]["t0"], i))
+            vs = slots[v]
+            pool.release(v)
+            slots[v] = None
+            pos[v] = -1
+            # regeneration from the prompt is deterministic greedy —
+            # the requeued request produces bit-identical tokens
+            carry.insert(0, (vs["req"], vs["restarts"]))
+            self.metrics.incr("preempted")
+            return v
+
+        try:
+            while True:
+                try:
+                    closing = q.closing
+                    if closing and not q.drain_on_close:
+                        err = UnavailableError(
+                            f"{self.name}: dropped at shutdown "
+                            f"(drain=False)")
+                        for i in range(B):
+                            s = slots[i]
+                            if s is not None and not s["req"].future.done():
+                                s["req"].future.set_exception(err)
+                            slots[i] = None
+                        for r, _ in carry:
+                            if not r.future.done():
+                                r.future.set_exception(err)
+                        q.poll(B, 0.0)  # fails everything still queued
+                        return
+                    live = [i for i in range(B) if slots[i] is not None]
+                    free = [i for i in range(B) if slots[i] is None]
+                    if (closing and not live and not carry
+                            and q.queue_depth == 0):
+                        return
+
+                    # ---- admission: FCFS, gated by the breaker AND the
+                    # page budget; neither sheds — deferred requests wait
+                    # in carry under the deadline sweep
+                    take: List[tuple] = []
+                    blocked_wait = False
+                    if carry:
+                        carry = self._expire_carry(carry)
+                    if free:
+                        cand = carry[:len(free)]
+                        carry = carry[len(cand):]
+                        want = len(free) - len(cand)
+                        if want > 0:
+                            wait = (0.05 if not live and not cand else 0.0)
+                            blocked_wait = wait > 0
+                            cand += [(r, 0)
+                                     for r in q.poll(want, wait_s=wait)]
+                        if (cand and self.breaker is not None
+                                and not self.breaker.allow(0)):
+                            carry = cand + carry
+                            cand = []
+                            q.sweep()
+                        budget_pages = pool.free_pages
+                        for ci, (r, nre) in enumerate(cand):
+                            prompt, key, _, _ = self._unpack_paged(r)
+                            need = pool.pages_needed(prompt, key)
+                            if need > budget_pages and ci == 0 and not live:
+                                # nothing left to preempt: reclaim every
+                                # registered prefix before giving up
+                                pool.drop_all_prefixes()
+                                budget_pages = pool.free_pages
+                                need = pool.pages_needed(prompt, key)
+                            if need > budget_pages:
+                                # head-of-line blocks: keep FCFS order
+                                carry = cand[ci:] + carry
+                                break
+                            take.append((r, nre))
+                            budget_pages -= need
+                    if take:
+                        if cache is None:
+                            cache = self._init_pool()
+                        now = time.monotonic()
+                        Sb = self._buckets[max(r.bucket for r, _ in take)]
+                        ids = np.zeros((B, Sb), np.int32)
+                        pp = np.full((B, Sb), -1, np.int32)
+                        lens = np.ones((B,), np.int32)
+                        cow_pairs: List[tuple] = []
+                        to_register: List[tuple] = []
+                        admitted: List[tuple] = []
+                        for (r, nre), i in zip(take, free):
+                            prompt, key, plen, budget = self._unpack_paged(r)
+                            pairs, shared = pool.admit(i, prompt, key)
+                            cow_pairs += [(s_, d_, i) for s_, d_ in pairs]
+                            L = len(prompt)
+                            ids[i, :L - shared] = prompt[shared:]
+                            pp[i, :L - shared] = np.arange(shared, L)
+                            lens[i] = L - shared
+                            pos[i] = L
+                            slots[i] = {"req": r, "budget": budget,
+                                        "out": [], "t0": now,
+                                        "restarts": nre,
+                                        "hist": [int(t) for t in prompt]}
+                            admitted.append((r, i))
+                            if key is not None and plen > 0:
+                                # registered AFTER this prefill lands, so
+                                # same-batch siblings never map pages whose
+                                # boundary CoW would copy data not yet
+                                # written
+                                to_register.append((key, i, prompt[:plen]))
+                        dispatch_cow(cow_pairs)
+                        fault_point("serving.decode")
+                        with profiler.RecordEvent(
+                                f"{self.name}/admit[{Sb}]"):
+                            first, cache = self._padmit(
+                                self._params, self._buffers,
+                                jnp.asarray(ids), jnp.asarray(pp),
+                                jnp.asarray(pool.pos_map.copy()),
+                                jnp.asarray(pool.table.copy()),
+                                jnp.asarray(lens), cache)
+                            host_first = np.asarray(first)  # serial harvest
+                        tr = _tracing._active
+                        if tr is not None:
+                            adm_ms = (time.monotonic() - now) * 1e3
+                            for r, i in admitted:
+                                if r.trace is None:
+                                    continue
+                                tr.record("batcher/queue", r.trace,
+                                          r.enqueue_t,
+                                          (now - r.enqueue_t) * 1e3,
+                                          kind="queue",
+                                          args={"engine": self.name,
+                                                "bucket": r.bucket})
+                                tr.record("slot/admit", r.trace, now,
+                                          adm_ms, kind="prefill",
+                                          args={"engine": self.name,
+                                                "slot": i, "bucket": Sb})
+                        for key, i, toks in to_register:
+                            pool.register_prefix(key, i, toks)
+                        now = time.monotonic()
+                        n_evicted = 0
+                        for _, i in admitted:
+                            s = slots[i]
+                            t = int(host_first[i])
+                            s["out"].append(t)
+                            s["hist"].append(t)
+                            if (len(s["out"]) >= s["budget"]
+                                    or (eos is not None and t == eos)):
+                                pool.release(i)
+                                self._finish(s, now)
+                                slots[i] = None
+                                pos[i] = -1
+                                n_evicted += 1
+                        self.metrics.incr("admitted", len(admitted))
+                        self.metrics.incr("batches")
+                        if n_evicted:
+                            self.metrics.incr("evicted", n_evicted)
+                        live = [i for i in range(B) if slots[i] is not None]
+                    elif (free and not closing
+                          and (carry or q.queue_depth > 0)):
+                        # free slots + waiting requests + nothing admitted:
+                        # S603 starvation — and, with the page gauges on
+                        # the same snapshot, S604's page-leak signal
+                        self.metrics.incr("starved_steps")
+                        if self._warm:
+                            self.metrics.incr("starved_steps_after_warm")
+
+                    # ---- unified decode/verify step (serialized) ----
+                    dispatched = bool(take)
+                    if live:
+                        # pass 1 — propose: drafts only while the ring has
+                        # spare slots (once positions reach C, every slot
+                        # holds a live window position, and a multi-token
+                        # step's later writes would destroy KV the
+                        # earlier rows still gather — the sliding-window
+                        # region decodes one token per step, exactly like
+                        # the dense path)
+                        props: Dict[int, List[int]] = {}
+                        for i in list(live):
+                            s = slots[i]
+                            p = int(pos[i])
+                            kq = min(k_max, max(C - 1 - p, 0))
+                            if kq and s.get("spec_cool", 0) > 0:
+                                # per-sequence backoff: recent drafts all
+                                # rejected — rest the proposer a while
+                                s["spec_cool"] -= 1
+                                kq = 0
+                            props[i] = (self._ngram_drafts(s["hist"], kq)
+                                        if kq else [])
+                        # cost-aware go/no-go: the wide [B, T] verify
+                        # trace charges every slot for one slot's drafts.
+                        # Using the loop's own measured iteration costs,
+                        # go wide only when the predicted accepted tokens
+                        # (per-slot acceptance EMA) beat the break-even
+                        # bar.  On accelerators wide ~ fast and the bar
+                        # ~0 (always speculate); on a compute-bound host
+                        # the loop turns selective automatically.
+                        drafting = [i for i in live if props[i]]
+                        if it_fast is None:  # warmup ran after loop start
+                            it_fast = getattr(self, "_it_fast0", None)
+                        if it_wide is None:
+                            it_wide = getattr(self, "_it_wide0", None)
+                        if (drafting and it_fast is not None
+                                and it_wide is not None
+                                and it_wide > it_fast):
+                            bar = len(live) * (it_wide - it_fast) / it_fast
+                            pred = sum(slots[i].get("spec_ema", k_max)
+                                       for i in drafting)
+                            if pred < bar:
+                                for i in drafting:
+                                    props[i] = []
+                        # pass 2 — commit: page accounting + step inputs
+                        ids = np.zeros((B, T), np.int32)
+                        pp = np.full((B, T), -1, np.int32)
+                        cow_pairs = []
+                        for i in list(live):
+                            s = slots[i]
+                            if s is None:
+                                continue
+                            p = int(pos[i])
+                            prop = props.get(i, [])
+                            while slots[i] is not None:
+                                try:
+                                    for j in range(len(prop) + 1):
+                                        pr = pool.ensure_writable(i, p + j)
+                                        if pr is not None:
+                                            cow_pairs.append(
+                                                (pr[0], pr[1], i))
+                                    break
+                                except MemoryError:
+                                    v = preempt_newest()
+                                    if v is not None:
+                                        # drop the victim's pending
+                                        # copies: its freed dst pages may
+                                        # be re-allocated this very step
+                                        cow_pairs = [
+                                            t for t in cow_pairs
+                                            if t[2] != v]
+                            s = slots[i]
+                            if s is None:
+                                continue  # preempted itself
+                            for j in range(len(prop) + 1):
+                                pool.pos_map[i, (p + j) % C] = p + j
+                            ids[i, 0] = s["hist"][-1]
+                            pp[i, 0] = p
+                            for j, d in enumerate(prop):
+                                ids[i, 1 + j] = d
+                                pp[i, 1 + j] = p + 1 + j
+                            s["_prop"] = prop
+                        live = [i for i in range(B) if slots[i] is not None]
+                    if live:
+                        dispatch_cow(cow_pairs)
+                        fault_point("serving.decode")
+                        # no slot drafting this step -> the [B, 1] fast
+                        # trace (same fn, same math on column 0; rejected
+                        # columns simply don't exist to compute)
+                        Td = (T if any(slots[i] is not None
+                                       and slots[i].get("_prop")
+                                       for i in live) else 1)
+                        t_step = time.monotonic()
+                        with profiler.RecordEvent(
+                                f"{self.name}/decode.step"):
+                            out, cache = self._step(
+                                self._params, self._buffers,
+                                self._pack_step(
+                                    ids[:, :Td], pp[:, :Td],
+                                    pool.pos_map, pool.table), cache)
+                            host = np.asarray(out)  # serial harvest
+                        dt = (time.monotonic() - t_step) * 1e3
+                        if Td == 1:
+                            it_fast = (dt if it_fast is None
+                                       else 0.8 * it_fast + 0.2 * dt)
+                        else:
+                            it_wide = (dt if it_wide is None
+                                       else 0.8 * it_wide + 0.2 * dt)
+                        self.metrics.incr("decode_steps")
+                        self.metrics.observe_occupancy(len(live) / B)
+                        now = time.monotonic()
+                        n_evicted = 0
+                        evicted_traces: List = []
+                        for i in live:
+                            s = slots[i]
+                            prop = s.pop("_prop", [])
+                            p = int(pos[i])
+                            a = 0
+                            while a < len(prop) and prop[a] == int(
+                                    host[i, a]):
+                                a += 1
+                            # rejected drafts: their KV is stale — unmark
+                            # it (overwritten when the real token arrives)
+                            for j in range(a + 1, len(prop) + 1):
+                                pool.pos_map[i, (p + j) % C] = -1
+                            if prop:
+                                self.metrics.incr("spec_drafted",
+                                                  len(prop))
+                                self.metrics.incr("spec_accepted", a)
+                                # trailing acceptance estimate feeding
+                                # the wide-step break-even decision
+                                s["spec_ema"] = (
+                                    0.5 * s.get("spec_ema", float(k_max))
+                                    + 0.5 * a)
+                                if a == 0:
+                                    # exponential draft backoff (max 32
+                                    # steps): proposer is cold on this
+                                    # sequence; any acceptance resets it
+                                    s["spec_fail"] = min(
+                                        s.get("spec_fail", 0) + 1, 5)
+                                    s["spec_cool"] = 1 << s["spec_fail"]
+                                else:
+                                    s["spec_fail"] = 0
+                            pos[i] = p + a + 1
+                            done = False
+                            for j in range(a + 1):
+                                t = int(host[i, j])
+                                s["out"].append(t)
+                                s["hist"].append(t)
+                                if (len(s["out"]) >= s["budget"]
+                                        or (eos is not None and t == eos)):
+                                    done = True
+                                    break
+                            if done:
+                                if s["req"].trace is not None:
+                                    evicted_traces.append(s["req"].trace)
+                                pool.release(i)
+                                self._finish(s, now)
+                                slots[i] = None
+                                pos[i] = -1
+                                n_evicted += 1
+                        if n_evicted:
+                            tr = _tracing._active
+                            if tr is not None and evicted_traces:
+                                ev_ms = (time.monotonic() - now) * 1e3
+                                for ctx in evicted_traces:
+                                    tr.record("slot/evict", ctx, now,
+                                              ev_ms, kind="evict",
+                                              args={"engine": self.name})
+                            self.metrics.incr("evicted", n_evicted)
+                            self.metrics.publish()
+                        dispatched = True
+
+                    if not dispatched and not blocked_wait:
+                        time.sleep(0.002)  # deferred/idle: don't spin hot
+
+                    now = time.monotonic()
+                    if now - last_pub >= 0.1:
+                        last_pub = now
+                        nlive = sum(1 for s in slots if s is not None)
+                        age = q.oldest_wait_ms()
+                        if carry:
+                            age = max(age,
+                                      (now - carry[0][0].enqueue_t) * 1e3)
+                        self.metrics.set_gauge("slot_occupancy", nlive / B)
+                        self.metrics.set_gauge("slots_free", B - nlive)
+                        self.metrics.set_gauge("queue_age_ms", age)
+                        ps = pool.stats()
+                        self.metrics.set_gauge("kv_pages_free",
+                                               ps["kv_pages_free"])
+                        self.metrics.set_gauge("kv_pages_shared",
+                                               ps["kv_pages_shared"])
+                        self.metrics.set_gauge("kv_pages_leaked",
+                                               ps["kv_pages_leaked"])
+                        self.metrics.set_counter("cow_copies",
+                                                 ps["cow_copies"])
+                        self.metrics.set_queue_depth(
+                            q.queue_depth + len(carry))
+                        self.metrics.set_counter("compiles",
+                                                 self.compile_count)
+                        self.metrics.publish()
+                except Exception as e:
+                    # Device failure mid-flight: same restart contract as
+                    # the dense loop, plus fresh page accounting — the
+                    # pool metadata and device pool are rebuilt together
+                    # (registered prefixes re-register off future donors)
+                    if self.breaker is not None:
+                        self.breaker.record_failure(0)
+                    survivors: List[tuple] = []
+                    for i in range(B):
+                        s = slots[i]
+                        slots[i] = None
+                        if s is None:
+                            continue
+                        if is_transient(e) and s["restarts"] < max_restarts:
+                            survivors.append((s["req"], s["restarts"] + 1))
+                        else:
+                            self.metrics.incr("errors")
+                            if not s["req"].future.done():
+                                s["req"].future.set_exception(e)
+                    pos[:] = -1
+                    cache = None
+                    pool = self._pool = self._new_pool()
+                    carry = survivors + carry
+                    if survivors:
+                        self.metrics.incr("restarts")
+                    self.metrics.publish()
+        finally:
+            q.consumer_done()
 
     def _slot_loop(self):
         """The persistent decode loop — sole owner of the device state.
@@ -625,17 +1306,27 @@ class GenerationEngine:
 
     def submit(self, prompt_ids, max_new_tokens: int = 32,
                deadline_ms: Optional[float] = None,
-               trace_ctx=None) -> Future:
+               trace_ctx=None, prefix_key: Optional[str] = None,
+               prefix_len: int = 0) -> Future:
         """Async generation; resolves to the ``[<=max_new_tokens]`` int32
         array of greedily decoded tokens (stops after ``eos_token_id``).
         ``trace_ctx`` optionally parents the queue/slot spans under a
-        router trace."""
+        router trace.
+
+        Paged mode only: ``prefix_key`` + ``prefix_len`` declare
+        ``prompt_ids[:prefix_len]`` as a shareable prefix (e.g. the
+        system prompt) — the first such request prefills it once and
+        registers its pages; later requests with the same key (and the
+        same leading tokens — verified, divergence falls back to a cold
+        admission) map those pages read-only, copy-on-write.  Ignored by
+        the dense paths."""
         if max_new_tokens < 1:
             raise InvalidArgumentError("max_new_tokens must be >= 1")
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        meta = ((int(max_new_tokens), prefix_key, int(prefix_len))
+                if self._paged else int(max_new_tokens))
         return self._batcher.submit((prompt,), deadline_ms=deadline_ms,
-                                    meta=int(max_new_tokens),
-                                    trace_ctx=trace_ctx)
+                                    meta=meta, trace_ctx=trace_ctx)
 
     def generate(self, prompt_ids, max_new_tokens: int = 32,
                  timeout: Optional[float] = None) -> np.ndarray:
@@ -656,6 +1347,9 @@ class GenerationEngine:
         snap["compile_count"] = self.compile_count
         snap["buckets"] = len(self._buckets)
         snap["continuous"] = self._continuous
+        snap["paged"] = self._paged
+        if self._paged and self._pool is not None:
+            snap.update(self._pool.stats())
         return snap
 
     def close(self, drain: bool = True, timeout: Optional[float] = None):

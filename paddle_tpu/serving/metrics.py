@@ -18,6 +18,15 @@ starved_steps_after_warm`` plus per-step gauges (``set_gauge``) such as
 ``slot_occupancy`` (live slots / batch), ``slots_free`` and
 ``queue_age_ms`` (age of the oldest queued request).  Rule S603 reads
 the starvation counters.
+
+Paged-KV engines (``FLAGS_paged_kv``) add the page-accounting family:
+counters ``cow_copies`` (copy-on-write page copies), ``spec_drafted`` /
+``spec_accepted`` (speculative-decoding draft economics) and
+``preempted`` (slots evicted to reclaim pages), plus gauges
+``kv_pages_free``, ``kv_pages_shared`` (refcount > 1) and
+``kv_pages_leaked`` (held by no table and no prefix — rule S604's
+signal).  The Prometheus bridge picks all of these up for free off the
+same snapshot.
 """
 from __future__ import annotations
 
@@ -38,6 +47,10 @@ _COUNTERS = ("requests", "completed", "shed", "expired", "errors",
 #: slot-scheduler counters (continuous batching; see ``extra_counters``)
 SLOT_COUNTERS = ("admitted", "evicted", "decode_steps", "restarts",
                  "starved_steps", "starved_steps_after_warm")
+
+#: page-accounting counters (paged KV mode; see ``extra_counters``)
+PAGED_COUNTERS = ("cow_copies", "spec_drafted", "spec_accepted",
+                  "preempted")
 
 
 def _quantile(sorted_vals, q: float) -> float:

@@ -1,0 +1,305 @@
+"""Paged KV cache + copy-on-write prefix sharing + speculative decoding
+(serving/paging.py, serving/generation.py paged mode, models/gpt.py
+``forward_paged``/``init_paged_cache``/``copy_pages``).
+
+Covers the paged scheduler's contract: token identity with uncached
+greedy AND the dense ring path under staggered mid-decode admission; the
+closed paged compile set (``len(prompt_buckets) + 3`` with speculation
+on — the extra trace is the ``[B, 1]`` no-draft fast step — zero
+post-warmup retraces); CoW isolation (a sibling's divergent write never perturbs a
+shared prefix page); speculative accept/reject bit-identity vs plain
+greedy (including past the ring-wrap point where drafting disables);
+pool-exhaustion preemption; ``PagePool`` accounting invariants; and
+analysis rule S604 (admission starved by a page leak).
+"""
+import time
+import unittest
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.framework.errors import InvalidArgumentError, UnavailableError
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.serving import GenerationEngine, PagePool
+
+
+class TestPagePool(unittest.TestCase):
+    def test_alloc_release_refcounts(self):
+        pool = PagePool(num_slots=2, num_pages=8, page_size=4, max_len=16)
+        self.assertEqual(pool.free_pages, 8)
+        prompt = np.arange(6, dtype=np.int32)  # 2 pages
+        pairs, shared = pool.admit(0, prompt)
+        self.assertEqual((pairs, shared), ([], 0))
+        self.assertEqual(pool.free_pages, 6)
+        self.assertEqual(pool.pos_map[0, 5], 5)
+        self.assertEqual(pool.pos_map[0, 6], -1)
+        pool.release(0)
+        self.assertEqual(pool.free_pages, 8)
+        self.assertTrue((pool.table[0] == -1).all())
+        self.assertEqual(pool.leaked_pages(), 0)
+
+    def test_prefix_sharing_and_cow(self):
+        pool = PagePool(num_slots=3, num_pages=12, page_size=4, max_len=16)
+        prompt = np.arange(10, dtype=np.int32)  # pages 0-1 full, page 2 part
+        pool.admit(0, prompt)
+        pool.register_prefix("sys", 0, prompt)
+        base = pool.free_pages
+        # sibling shares 2 full pages, CoWs the partial boundary page
+        sib = np.concatenate([prompt, [50, 51]]).astype(np.int32)
+        pairs, shared = pool.admit(1, sib, prefix_key="sys")
+        self.assertEqual(shared, 10)
+        self.assertEqual(len(pairs), 1)  # the boundary-page copy
+        self.assertEqual(pool.pages_needed(sib, "sys"), 1)
+        self.assertEqual(pool.free_pages, base - 1)
+        self.assertGreaterEqual(pool.shared_pages, 2)
+        # full shared pages are mapped, not copied
+        self.assertEqual(pool.table[1, 0], pool.table[0, 0])
+        self.assertEqual(pool.table[1, 1], pool.table[0, 1])
+        self.assertNotEqual(pool.table[1, 2], pool.table[0, 2])
+        # divergent-token prompt must NOT share, even with the key
+        other = np.arange(10, dtype=np.int32)[::-1].copy()
+        pairs, shared = pool.admit(2, other, prefix_key="sys")
+        self.assertEqual((pairs, shared), ([], 0))
+        # the registry holds a ref on the boundary page, so the donor's
+        # own next write CoWs it — registered prefix data stays pristine
+        # for siblings admitted later
+        old = int(pool.table[0, 2])
+        pr = pool.ensure_writable(0, 10)
+        self.assertIsNotNone(pr)
+        self.assertEqual(pr[0], old)
+        self.assertNotEqual(int(pool.table[0, 2]), old)
+        # but a write into a FULL shared page (ring wrap) does CoW
+        pr = pool.ensure_writable(1, 16)  # wraps to slot 0, page 0 shared
+        self.assertIsNotNone(pr)
+        self.assertEqual(pr[0], pool.table[0, 0])
+        self.assertNotEqual(pool.table[1, 0], pool.table[0, 0])
+        # registry pins pages past every holder's release
+        pool.release(0), pool.release(1), pool.release(2)
+        self.assertEqual(pool.leaked_pages(), 0)
+        self.assertLess(pool.free_pages, 12)
+        pool.drop_prefix("sys")
+        self.assertEqual(pool.free_pages, 12)
+
+    def test_exhaustion_raises_and_rolls_back(self):
+        pool = PagePool(num_slots=2, num_pages=4, page_size=4, max_len=16)
+        pool.admit(0, np.arange(12, dtype=np.int32))  # 3 pages
+        with self.assertRaises(MemoryError):
+            pool.admit(1, np.arange(8, dtype=np.int32))  # needs 2, 1 free
+        # failed admission rolled back completely
+        self.assertTrue((pool.table[1] == -1).all())
+        self.assertEqual(pool.free_pages, 1)
+        self.assertEqual(pool.leaked_pages(), 0)
+
+    def test_geometry_validation(self):
+        with self.assertRaises(ValueError):
+            PagePool(num_slots=1, num_pages=8, page_size=5, max_len=16)
+        with self.assertRaises(ValueError):  # pool can't hold one slot
+            PagePool(num_slots=1, num_pages=2, page_size=4, max_len=16)
+
+
+class TestPagedGeneration(unittest.TestCase):
+    @classmethod
+    def setUpClass(cls):
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        pt.seed(4321)
+        cls.cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                            num_heads=4, max_position=64, dropout=0.0)
+        cls.model = GPTForCausalLM(cls.cfg)
+        cls.model.eval()
+
+    def _ref_greedy(self, prompt, n, eos=None):
+        import jax.numpy as jnp
+        ids, outs = list(map(int, prompt)), []
+        for _ in range(n):
+            logits = np.asarray(self.model(jnp.asarray([ids], jnp.int32)))[0]
+            nxt = int(np.argmax(logits[-1]))
+            outs.append(nxt)
+            ids.append(nxt)
+            if eos is not None and nxt == eos:
+                break
+        return outs
+
+    def test_token_identity_staggered_admission(self):
+        # the continuous-batching interleavings, paged: a long request
+        # pins a slot while shorts churn through the other as pages
+        # allocate and free underneath — every output must match
+        # uncached greedy
+        prompts = [(np.arange(10) * 5 + 2) % 97, np.arange(3) % 97,
+                   (np.arange(6) * 3) % 97, (np.arange(4) * 7 + 1) % 97,
+                   (np.arange(5) * 11 + 3) % 97]
+        budgets = [14, 3, 4, 5, 3]
+        refs = [self._ref_greedy(p, b) for p, b in zip(prompts, budgets)]
+        with GenerationEngine(self.model, prompt_buckets=[8, 16],
+                              batch_size=2, paged=True, kv_page_size=8,
+                              speculative_k=3,
+                              name="pg-stagger") as eng:
+            # 2 admits + unified step + its [B, 1] fast trace + CoW op;
+            # eviction is a host table edit with no executable
+            self.assertEqual(eng.warmup(), 5)
+            futs = [eng.submit(prompts[0], budgets[0]),
+                    eng.submit(prompts[1], budgets[1])]
+            for p, b in zip(prompts[2:], budgets[2:]):
+                time.sleep(0.02)
+                futs.append(eng.submit(p, b))
+            gens = [f.result(120) for f in futs]
+            for g, ref in zip(gens, refs):
+                self.assertEqual(g.tolist(), ref)
+            # page churn never reopened the compile set
+            self.assertEqual(eng.compile_count, 5)
+            st = eng.stats()
+            self.assertTrue(st["paged"])
+            self.assertEqual(st["kv_pages_free"],
+                             eng._pool.num_pages)  # all returned
+            self.assertEqual(st["kv_pages_leaked"], 0)
+
+    def test_cow_prefix_sharing_isolation(self):
+        # four requests share a system prompt under one prefix_key; the
+        # prefix prefills once, siblings CoW the boundary page, and
+        # every completion must still match uncached greedy computed
+        # WITHOUT any sharing — divergent writes never reach a shared
+        # page
+        sys_p = (np.arange(11) * 7 + 3) % 97
+        prompts = [np.concatenate([sys_p, e]).astype(np.int32)
+                   for e in ([5, 9, 2], [5, 9, 2, 44], [61], [30, 8])]
+        budgets = [6, 5, 8, 7]
+        refs = [self._ref_greedy(p, b) for p, b in zip(prompts, budgets)]
+        with GenerationEngine(self.model, prompt_buckets=[16],
+                              batch_size=2, cache_len=64, paged=True,
+                              kv_page_size=8, speculative_k=2,
+                              name="pg-cow") as eng:
+            eng.warmup()
+            outs = []
+            for p, b in zip(prompts, budgets):
+                outs.append(eng.submit(p, b, prefix_key="sys",
+                                       prefix_len=len(sys_p)))
+            for o, ref in zip(outs, refs):
+                self.assertEqual(o.result(120).tolist(), ref)
+            st = eng.stats()
+            # the boundary page was CoW'd for at least one sibling and
+            # full prefix pages were actually mapped shared
+            self.assertGreater(st["cow_copies"], 0)
+            self.assertGreater(st["prefix_hits"], 0)
+            self.assertEqual(st["kv_pages_leaked"], 0)
+            # 1 admit + step + fast step + cow
+            self.assertEqual(eng.compile_count, 4)
+
+    def test_speculative_bit_identity_and_ring_wrap(self):
+        # repetitive continuations make the n-gram proposer hit; accepted
+        # AND rejected drafts must leave tokens bit-identical to the
+        # dense ring engine — including past position C where drafting
+        # disables and the window slides
+        p = (np.arange(6) * 9 + 4) % 97
+        with GenerationEngine(self.model, prompt_buckets=[8], batch_size=2,
+                              cache_len=32, paged=True, kv_page_size=8,
+                              speculative_k=3, name="pg-spec") as eng, \
+             GenerationEngine(self.model, prompt_buckets=[8], batch_size=2,
+                              cache_len=32, paged=False,
+                              name="pg-spec-dense") as dense:
+            eng.warmup()
+            dense.warmup()
+            ref = dense.generate(p, 45, timeout=120).tolist()
+            out = eng.generate(p, 45, timeout=120).tolist()
+            self.assertEqual(out, ref)
+            st = eng.stats()
+            self.assertGreater(st["spec_drafted"], 0)
+            self.assertGreaterEqual(st["spec_drafted"], st["spec_accepted"])
+            # speculation paid off: fewer steps than tokens decoded
+            self.assertLess(st["decode_steps"], 45)
+
+    def test_pool_exhaustion_preempts_and_recovers(self):
+        # a pool too small for both requests' full decode: the newest
+        # slot is preempted mid-flight, requeued, and regenerated —
+        # outputs still exact
+        pa = (np.arange(4) * 13 + 1) % 97
+        pb = (np.arange(4) * 5 + 2) % 97
+        refs = [self._ref_greedy(pa, 26), self._ref_greedy(pb, 26)]
+        with GenerationEngine(self.model, prompt_buckets=[8], batch_size=2,
+                              cache_len=32, paged=True, kv_page_size=4,
+                              kv_pages=9, speculative_k=0,
+                              circuit_breaker=False,
+                              name="pg-preempt") as eng:
+            eng.warmup()
+            fa = eng.submit(pa, 26)
+            fb = eng.submit(pb, 26)
+            self.assertEqual(fa.result(120).tolist(), refs[0])
+            self.assertEqual(fb.result(120).tolist(), refs[1])
+            st = eng.stats()
+            self.assertGreaterEqual(st["preempted"], 1)
+            self.assertEqual(st["kv_pages_leaked"], 0)
+            self.assertEqual(st["kv_pages_free"], 9)
+
+    def test_transient_failure_restarts_rebuild_pool(self):
+        from paddle_tpu.resilience.faults import FaultPlan
+        with GenerationEngine(self.model, prompt_buckets=[8], batch_size=2,
+                              paged=True, kv_page_size=8, speculative_k=2,
+                              circuit_breaker=False,
+                              name="pg-restart") as eng:
+            eng.warmup()
+            p = (np.arange(5) * 9 + 4) % 97
+            ref = self._ref_greedy(p, 6)
+            self.assertEqual(eng.generate(p, 6, timeout=120).tolist(), ref)
+            plan = FaultPlan.parse(
+                "site=serving.decode,nth=1,error=TransientDeviceError")
+            with plan:
+                self.assertEqual(
+                    eng.generate(p, 6, timeout=120).tolist(), ref)
+            self.assertEqual(plan.stats()["serving.decode"]["fired"], 1)
+            st = eng.stats()
+            self.assertGreaterEqual(st["restarts"], 1)
+            # the rebuilt pool starts clean
+            self.assertEqual(st["kv_pages_leaked"], 0)
+
+    def test_flag_and_mode_validation(self):
+        set_flags({"paged_kv": True})
+        try:
+            eng = GenerationEngine(self.model, prompt_buckets=[8],
+                                   batch_size=1, name="pg-flag")
+            try:
+                self.assertTrue(eng.stats()["paged"])
+                p = np.arange(3) % 97
+                self.assertEqual(eng.generate(p, 3, timeout=120).tolist(),
+                                 self._ref_greedy(p, 3))
+            finally:
+                eng.close()
+        finally:
+            set_flags({"paged_kv": False})
+        with self.assertRaises(InvalidArgumentError):
+            GenerationEngine(self.model, prompt_buckets=[8], batch_size=1,
+                             paged=True, continuous=False, name="pg-bad")
+
+    def test_s604_fires_on_page_leak(self):
+        from paddle_tpu.analysis import RetraceMonitor
+        with RetraceMonitor(budget=8) as mon:
+            eng = GenerationEngine(self.model, prompt_buckets=[8],
+                                   batch_size=1, cache_len=32, paged=True,
+                                   kv_page_size=8, name="pg-leak")
+            try:
+                eng.warmup()
+                # inject a page leak: drain the free list with refcounts
+                # held by no slot table and no prefix registry — exactly
+                # the state a release/decref pairing bug produces
+                pool = eng._pool
+                while pool.alloc() is not None:
+                    pass
+                self.assertEqual(pool.free_pages, 0)
+                self.assertGreater(pool.leaked_pages(), 0)
+                fut = eng.submit(np.arange(3) % 97, 4)
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    if eng.stats()["starved_steps_after_warm"] > 8:
+                        break
+                    time.sleep(0.02)
+                self.assertGreater(
+                    eng.stats()["starved_steps_after_warm"], 8)
+                time.sleep(0.25)  # let a publish tick carry the gauges
+                diags = [d for d in mon.diagnostics() if d.rule == "S604"]
+                self.assertTrue(diags, mon.diagnostics())
+                self.assertIn("page leak", diags[0].message)
+            finally:
+                eng.close(drain=False, timeout=10)
+            self.assertIsInstance(fut.exception(timeout=5),
+                                  UnavailableError)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -14,8 +14,15 @@ every gate run:
 3. **Router probe compat** — a health-probed :class:`Router` over two
    continuous engines stays green (``synthetic_inputs`` probes succeed,
    routed generations are token-identical).
+4. **Paged KV + speculative decoding** — the same mixed shared-prefix
+   workload through a paged engine holding TWICE the resident slots of
+   the dense baseline in the SAME HBM budget (dense ``2 slots x 256``
+   ring = 32 pages of 16; paged pool = those same 32 pages backing 4
+   slots): peak resident slots strictly higher, tokens/s no worse,
+   tokens bit-identical to uncached greedy, zero post-warmup XLA
+   compiles on the paged compile set (``len(prompt_buckets) + 3``).
 
-Prints one JSON line; exit 0 iff all three gates hold.
+Prints one JSON line; exit 0 iff all four gates hold.
 """
 import json
 import os
@@ -37,6 +44,18 @@ LONG_TOKENS = 240  # prompt 12 + 240 stays inside the 256-slot ring (exact)
 SHORTS = 6
 SHORT_TOKENS = 3
 
+# paged gate geometry: the dense baseline's HBM budget (2 slots x 256
+# ring slots) expressed in pages of 16 — the paged engine gets exactly
+# that page pool and must hold strictly more resident slots in it
+CACHE = 256
+PAGE_SIZE = 16
+DENSE_SLOTS = 2
+POOL_PAGES = DENSE_SLOTS * CACHE // PAGE_SIZE  # 32 pages = same bytes
+PAGED_SLOTS = 4
+PAGED_REQS = 12
+PAGED_TOKENS = 32
+PREFIX_LEN = 20  # shared system prompt: 1 full page + a CoW'd boundary
+
 # ground truth for "zero post-warmup recompiles": count actual XLA backend
 # compile requests, which fire even when the jaxpr cache hits (e.g. the
 # silent placement-specialised recompiles the trace counter cannot see)
@@ -52,6 +71,19 @@ def _model():
     # legacy path's head-of-line stall is long enough to measure cleanly
     cfg = GPTConfig(vocab_size=97, hidden_size=128, num_layers=2,
                     num_heads=4, max_position=256, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _paged_model():
+    pt.seed(13)
+    # hidden 32 keeps the CPU decode step dispatch-dominated rather than
+    # FLOP-dominated — the regime the paged gate is about (accelerator
+    # decode is latency-bound, so batching 4 slots x 5 verify positions
+    # into one step costs ~one step, not 20 token-forwards)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=4, max_position=CACHE, dropout=0.0)
     model = GPTForCausalLM(cfg)
     model.eval()
     return model
@@ -163,18 +195,104 @@ def gate_router_probe(model):
         router.close(timeout=30)  # close_engines=True: replicas too
 
 
+def gate_paged(model):
+    """Dense 2-slot ring vs a paged 4-slot engine over the SAME 32-page
+    HBM budget, on one shared-prefix workload: strictly more resident
+    slots, tokens/s no worse, bit-identical, zero post-warmup compiles."""
+    rng = np.random.RandomState(7)
+    sysp = rng.randint(1, 97, size=PREFIX_LEN).astype(np.int32)
+    prompts = [np.concatenate([sysp, rng.randint(1, 97, size=2 + (k % 7))])
+               .astype(np.int32) for k in range(PAGED_REQS)]
+    refs = [_ref(model, p, PAGED_TOKENS) for p in prompts]
+
+    def run(paged):
+        if paged:
+            eng = GenerationEngine(
+                model, prompt_buckets=[32], batch_size=PAGED_SLOTS,
+                cache_len=CACHE, continuous=True, paged=True,
+                kv_pages=POOL_PAGES, kv_page_size=PAGE_SIZE,
+                speculative_k=4, name="gen-smoke-paged")
+        else:
+            eng = GenerationEngine(
+                model, prompt_buckets=[32], batch_size=DENSE_SLOTS,
+                cache_len=CACHE, continuous=True, name="gen-smoke-dense")
+        nslots = PAGED_SLOTS if paged else DENSE_SLOTS
+        with eng:
+            warm = eng.warmup()
+            xla0 = _XLA_COMPILES[0]
+            t0 = time.monotonic()
+            futs = [eng.submit(p, PAGED_TOKENS, prefix_key="sys",
+                               prefix_len=PREFIX_LEN) for p in prompts]
+            # peak resident slots: admitted/evicted counters update at
+            # the event (the occupancy gauge only publishes every 0.1s)
+            peak, pend = 0, set(range(len(futs)))
+            while pend:
+                pend = {k for k in pend if not futs[k].done()}
+                st = eng.stats()
+                peak = max(peak, min(int(st.get("admitted", 0))
+                                     - int(st.get("evicted", 0)), nslots))
+                time.sleep(0.005)
+            wall = time.monotonic() - t0
+            outs = []
+            for f in futs:
+                try:
+                    outs.append(f.result(1).tolist())
+                except Exception:
+                    outs.append(None)
+            st = eng.stats()
+        return {"warm": warm, "wall": wall, "outs": outs, "peak": peak,
+                "xla": _XLA_COMPILES[0] - xla0,
+                "compiles": st["compile_count"], "stats": st}
+
+    # interleaved best-of-2 walls so a background-noise spike on either
+    # run can't decide the throughput comparison
+    dense, paged = run(False), run(True)
+    d2, p2 = run(False), run(True)
+    dense["wall"] = min(dense["wall"], d2["wall"])
+    paged["wall"] = min(paged["wall"], p2["wall"])
+    total = PAGED_REQS * PAGED_TOKENS
+    d_tps, p_tps = total / dense["wall"], total / paged["wall"]
+    pst = paged["stats"]
+    drafted = int(pst.get("spec_drafted", 0))
+    return {
+        "token_identical": bool(paged["outs"] == refs),
+        "dense_identical": bool(dense["outs"] == refs),
+        "hbm_budget_pages": POOL_PAGES,  # DENSE_SLOTS * CACHE / PAGE_SIZE
+        "dense_peak_slots": dense["peak"],
+        "paged_peak_slots": paged["peak"],
+        "resident_slots_up": bool(paged["peak"] > dense["peak"]),
+        "dense_tokens_per_s": round(d_tps, 1),
+        "paged_tokens_per_s": round(p_tps, 1),
+        "tps_not_worse": bool(p_tps >= d_tps),
+        # buckets [32] -> admit + verify step + [B,1] fast step + cow
+        "closed_compile_set": (paged["compiles"] == 1 + 3
+                               and paged["xla"] == 0),
+        "xla_recompiles_post_warmup": paged["xla"],
+        "prefix_hits": int(pst.get("prefix_hits", 0)),
+        "cow_copies": int(pst.get("cow_copies", 0)),
+        "spec_accept_rate": round(
+            int(pst.get("spec_accepted", 0)) / drafted, 2) if drafted else 0.0,
+        "preempted": int(pst.get("preempted", 0)),
+    }
+
+
 def main():
     t0 = time.time()
     model = _model()
     hol = gate_hol(model)
     probe = gate_router_probe(model)
+    paged = gate_paged(_paged_model())
     passed = (hol["token_identical"] and hol["matches_legacy"]
               and hol["closed_compile_set"] and hol["lost"] == 0
               and hol["hol_2x"]
               and probe["routed_identical"]
               and probe["healthy"] == probe["replicas"]
-              and probe["probe_failures"] == 0)
+              and probe["probe_failures"] == 0
+              and paged["token_identical"] and paged["dense_identical"]
+              and paged["resident_slots_up"] and paged["tps_not_worse"]
+              and paged["closed_compile_set"])
     print(json.dumps({"pass": bool(passed), "hol": hol, "probe": probe,
+                      "paged": paged,
                       "seconds": round(time.time() - t0, 1)}))
     return 0 if passed else 1
 

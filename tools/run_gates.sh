@@ -115,7 +115,10 @@ run_stage obs-smoke env JAX_PLATFORMS=cpu python tools/obs_smoke.py
 run_stage router-smoke env JAX_PLATFORMS=cpu python tools/router_smoke.py
 # continuous batching decode plane: 1 long + many short requests -> short
 # p99 at least 2x better than the legacy run-to-completion path, zero lost
-# requests, zero post-warmup XLA recompiles, router probes stay green
+# requests, zero post-warmup XLA recompiles, router probes stay green;
+# paged KV gate: same HBM budget holds strictly more resident slots with
+# CoW shared-prefix reuse + speculative decoding, tokens bit-identical to
+# dense greedy and tokens/s no worse, closed compile set (buckets + 3)
 run_stage gen-smoke env JAX_PLATFORMS=cpu python tools/gen_smoke.py
 # request tracing + SLO: full router->slot span tree in the merged chrome
 # export with zero post-warmup compiles, injected decode latency -> burn-rate
