@@ -439,8 +439,6 @@ def bench_gpt_generate():
     is the legacy run-batch-to-completion scheduler on the IDENTICAL
     workload (same model, same requests, same submission order) — >1
     means continuous batching is faster end-to-end."""
-    import time as _time
-
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
     from paddle_tpu.serving import GenerationEngine
@@ -450,13 +448,18 @@ def bench_gpt_generate():
                     num_heads=8, max_position=512, dropout=0.0)
     model = GPTForCausalLM(cfg)
     model.eval()
-    rng = np.random.RandomState(17)
     # ragged on both axes: prompts 4..48 tokens, outputs 4..64 tokens —
-    # the spread the legacy scheduler pays head-of-line blocking on
-    reqs = [(rng.randint(1, 8192, size=int(L)).astype(np.int32), int(n))
-            for L, n in zip(rng.randint(4, 49, size=48),
-                            rng.randint(4, 65, size=48))]
-    total_new = sum(n for _, n in reqs)
+    # the spread the legacy scheduler pays head-of-line blocking on.
+    # RequestTrace.synthetic replicates the historical inline RandomState
+    # draws bit-identically, and the same trace drives the serving-config
+    # measured search (tools/tune_smoke.py), so bench and tuner score the
+    # identical workload.
+    from paddle_tpu.tuning import RequestTrace, replay as _replay
+
+    trace = RequestTrace.synthetic()
+    trace_out = _os.environ.get("PADDLE_TPU_TRACE_OUT", "")
+    if trace_out:
+        trace.save(trace_out)
 
     def run(continuous, paged=False):
         with GenerationEngine(
@@ -467,18 +470,8 @@ def bench_gpt_generate():
                      f"{'paged' if paged else 'cont' if continuous else 'legacy'}"
         ) as eng:
             eng.warmup()
-            lat = []
-            t0 = _time.perf_counter()
-            futs = []
-            for p, n in reqs:
-                ts = _time.perf_counter()
-                f = eng.submit(p, n)
-                f.add_done_callback(
-                    lambda _, ts=ts: lat.append(_time.perf_counter() - ts))
-                futs.append(f)
-            toks = sum(len(f.result(600)) for f in futs)
-            assert toks == total_new
-            return toks / (_time.perf_counter() - t0), np.mean(lat) * 1e3
+            stats = _replay(eng, trace)
+            return stats["tokens_per_sec"], stats["mean_ms"]
 
     legacy_tps, legacy_lat = run(False)
     tps, lat_ms = run(True)
@@ -493,7 +486,7 @@ def bench_gpt_generate():
                  mean_latency_ms=round(float(lat_ms), 1),
                  legacy_mean_latency_ms=round(float(legacy_lat), 1),
                  paged_mean_latency_ms=round(float(paged_lat), 1),
-                 requests=len(reqs), new_tokens=total_new,
+                 requests=len(trace), new_tokens=trace.total_new_tokens,
                  method="continuous_batching_vs_legacy")
 
 

@@ -73,6 +73,7 @@ from . import distributed  # noqa: F401
 from .distributed import DataParallel  # noqa: F401
 from . import amp  # noqa: F401
 from . import ops  # noqa: F401
+from . import tuning  # noqa: F401
 from . import metric  # noqa: F401
 from . import profiler  # noqa: F401
 from . import models  # noqa: F401

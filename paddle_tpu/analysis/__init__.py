@@ -18,7 +18,7 @@ catalog; README "Static analysis" for examples):
 CLI: ``python -m paddle_tpu.analysis <module-or-script> ...`` (or
 ``tools/analyze.py``); exits nonzero on error-severity findings.
 """
-from .check_plan import check_plan  # noqa: F401
+from .check_plan import check_plan, is_valid_plan  # noqa: F401
 from .diagnostics import (  # noqa: F401
     RULES, Diagnostic, DiagnosticCollector, Location, Severity, has_errors,
     render_json, render_text)
@@ -32,6 +32,6 @@ __all__ = [
     "Diagnostic", "DiagnosticCollector", "Location", "Severity", "RULES",
     "render_text", "render_json", "has_errors",
     "verify_program", "lint_function", "lint_source", "lint_module_source",
-    "RetraceMonitor", "check_plan",
+    "RetraceMonitor", "check_plan", "is_valid_plan",
     "analyze_target", "analyze_module", "main",
 ]

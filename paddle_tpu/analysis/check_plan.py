@@ -16,14 +16,19 @@ build time:
 * P505 — ZeRO is on (``sharding`` axis > 1) but a parameter's optimizer
   state has no dim divisible by the axis: its slots stay fully replicated,
   silently forfeiting the memory the strategy asked for.
+
+:func:`is_valid_plan` is the same P501–P504 rule set as a short-circuit
+boolean — the measured-search plan tuner calls it once per candidate to
+reject invalid mesh-axis assignments before any compile, without paying
+a DiagnosticCollector (or the P505 ``jax.eval_shape``) per candidate.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Iterator, List, Optional, Tuple
 
 from .diagnostics import Diagnostic, DiagnosticCollector, Location
 
-__all__ = ["check_plan"]
+__all__ = ["check_plan", "is_valid_plan"]
 
 
 def _axes_of(entry) -> tuple:
@@ -36,6 +41,82 @@ def _axes_of(entry) -> tuple:
     return (entry,)
 
 
+def _param_shapes(plan) -> dict:
+    """``{name: shape}`` for every spec'd parameter — from a duck-typed
+    ``param_shapes`` mapping (candidate plan views in the tuner) or the
+    live network (real ShardingPlans)."""
+    shapes = getattr(plan, "param_shapes", None)
+    if shapes is not None:
+        return {n: tuple(s) for n, s in shapes.items()
+                if n in plan.param_specs}
+    out = {}
+    for name, box in plan.network.named_parameters():
+        if plan.param_specs.get(name) is None:
+            continue
+        try:
+            out[name] = tuple(box.value.shape)
+        except Exception:  # deleted/donated array: metadata unavailable
+            continue
+    return out
+
+
+def _plan_violations(shapes: dict, param_specs: dict, axis_sizes: dict,
+                     ) -> Iterator[Tuple[str, str, str]]:
+    """Yield P501–P504 violations as ``(rule, message, hint)`` — the
+    shared core under both the diagnostic collector and the boolean
+    pre-filter."""
+    for name, shape in shapes.items():
+        entries = tuple(param_specs[name])
+        if len(entries) > len(shape):
+            yield ("P504",
+                   f"parameter {name!r} (rank {len(shape)}) has a rank-"
+                   f"{len(entries)} partition spec {entries}",
+                   "one spec entry per tensor dim (None = replicated)")
+            continue
+        seen_axes = {}
+        for d, entry in enumerate(entries):
+            factor = 1
+            for ax in _axes_of(entry):
+                if ax not in axis_sizes:
+                    yield ("P501",
+                           f"parameter {name!r} dim {d} is sharded over "
+                           f"axis {ax!r}, which is not in the mesh "
+                           f"(axes: {list(axis_sizes)})",
+                           "match the spec to build_mesh axis names")
+                    continue
+                if ax in seen_axes:
+                    yield ("P503",
+                           f"parameter {name!r} books mesh axis {ax!r} "
+                           f"on both dim {seen_axes[ax]} and dim {d}",
+                           "an axis can shard at most one dim; use a "
+                           "different axis or replicate one dim")
+                    continue
+                seen_axes[ax] = d
+                factor *= axis_sizes[ax]
+            if factor > 1 and shape[d] % factor != 0:
+                yield ("P502",
+                       f"parameter {name!r} dim {d} (size {shape[d]}) is "
+                       f"not divisible by its sharding factor {factor} "
+                       f"({entry!r})",
+                       f"pad the dim to a multiple of {factor} or "
+                       f"replicate it")
+
+
+def is_valid_plan(plan, mesh=None) -> bool:
+    """True iff ``plan`` passes P501–P504 against ``mesh`` (default: the
+    plan's own mesh).  Short-circuits on the first violation and skips
+    P505 (which needs ``jax.eval_shape``), so the measured-search engine
+    can pre-filter thousands of candidate axis assignments cheaply.
+    ``plan`` may be a real ShardingPlan or any object with
+    ``param_specs`` plus either ``param_shapes`` or ``network``."""
+    if mesh is None:
+        mesh = plan.mesh
+    shapes = _param_shapes(plan)
+    for _ in _plan_violations(shapes, plan.param_specs, dict(mesh.shape)):
+        return False
+    return True
+
+
 def check_plan(plan, collector: Optional[DiagnosticCollector] = None,
                ) -> List[Diagnostic]:
     out = DiagnosticCollector()
@@ -43,55 +124,10 @@ def check_plan(plan, collector: Optional[DiagnosticCollector] = None,
     axis_sizes = dict(mesh.shape)
     loc = Location(file=f"<plan:{type(plan).__name__}>")
 
-    shapes = {}
-    for name, box in plan.network.named_parameters():
-        spec = plan.param_specs.get(name)
-        if spec is None:
-            continue
-        try:
-            shape = tuple(box.value.shape)
-        except Exception:  # deleted/donated array: metadata unavailable
-            continue
-        shapes[name] = shape
-        entries = tuple(spec)
-        if len(entries) > len(shape):
-            out.add("P504",
-                    f"parameter {name!r} (rank {len(shape)}) has a rank-"
-                    f"{len(entries)} partition spec {entries}",
-                    location=loc,
-                    hint="one spec entry per tensor dim (None = "
-                         "replicated)")
-            continue
-        seen_axes = {}
-        for d, entry in enumerate(entries):
-            factor = 1
-            for ax in _axes_of(entry):
-                if ax not in axis_sizes:
-                    out.add("P501",
-                            f"parameter {name!r} dim {d} is sharded over "
-                            f"axis {ax!r}, which is not in the mesh "
-                            f"(axes: {list(axis_sizes)})",
-                            location=loc,
-                            hint="match the spec to build_mesh axis names")
-                    continue
-                if ax in seen_axes:
-                    out.add("P503",
-                            f"parameter {name!r} books mesh axis {ax!r} "
-                            f"on both dim {seen_axes[ax]} and dim {d}",
-                            location=loc,
-                            hint="an axis can shard at most one dim; use "
-                                 "a different axis or replicate one dim")
-                    continue
-                seen_axes[ax] = d
-                factor *= axis_sizes[ax]
-            if factor > 1 and shape[d] % factor != 0:
-                out.add("P502",
-                        f"parameter {name!r} dim {d} (size {shape[d]}) is "
-                        f"not divisible by its sharding factor {factor} "
-                        f"({entry!r})",
-                        location=loc,
-                        hint=f"pad the dim to a multiple of {factor} or "
-                             f"replicate it")
+    shapes = _param_shapes(plan)
+    for rule, message, hint in _plan_violations(shapes, plan.param_specs,
+                                                axis_sizes):
+        out.add(rule, message, location=loc, hint=hint)
 
     # P505 — ZeRO slots that cannot shard (replicated-param/opt-state
     # mismatch): _slot_spec falls back to the param spec when no dim

@@ -389,22 +389,33 @@ class RetraceMonitor:
         with self._lock:
             autotune_sites = {k: dict(v)
                               for k, v in self._autotune_sites.items()}
-        for kernel, stats in autotune_sites.items():
+        for name, stats in autotune_sites.items():
             counters = stats.get("counters", {})
             late = int(counters.get("searches_after_warm", 0))
             if late <= 0:
                 continue
+            # the measured-search engine tunes more than kernels: every
+            # config space (kernel tiles, sharding plans, serving dials)
+            # publishes on the same bus, and a post-warmup search is a
+            # hot-path stall whichever space it came from
+            space = stats.get("space", "kernel")
+            what = {"kernel": "kernel", "plan": "sharding plan",
+                    "serving": "serving config"}.get(space, space)
+            detail = {"kernel": "timed block-size",
+                      "plan": "timed train-step",
+                      "serving": "timed trace-replay"}.get(space, "measured")
             out.add("K701",
-                    f"kernel {kernel!r} ran {late} timed block-size "
+                    f"{what} {name!r} ran {late} {detail} "
                     f"search(es) after serving warmup (last key "
                     f"{stats.get('key')!r}) — a tuning cache miss in the "
                     f"hot path stalls live requests behind compile+measure "
                     f"of every candidate",
-                    location=Location(file=kernel, function=kernel),
-                    hint="pre-warm the tuner: run each kernel at its "
-                         "serving shapes before engine.warmup(), and ship "
-                         "the FLAGS_kernel_tuning_cache file so production "
-                         "processes start with every key resolved")
+                    location=Location(file=name, function=name),
+                    hint="pre-warm the tuner: resolve each search key at "
+                         "its serving shapes before engine.warmup(), and "
+                         "ship the FLAGS_kernel_tuning_cache file so "
+                         "production processes start with every key "
+                         "resolved")
         with self._lock:
             res_sites = {k: dict(v)
                          for k, v in self._resilience_sites.items()}

@@ -50,6 +50,14 @@ class DistributedStrategy:
     # cross-replica reduction (ref: fp16_allreduce_optimizer.py:18)
     fp16_allreduce_configs: Dict = field(
         default_factory=lambda: {"dtype": "float16"})
+    # collective schedule dials the measured-search plan tuner owns
+    # (paddle.fleet analogue: fuse_grad_size_in_MB / comm overlap in
+    # graph_execution_optimizer).  0 = one reduction per gradient leaf
+    # (the historical behavior); >0 asks plans to fuse reductions into
+    # ~N MB buckets.  overlap_grad_sync keeps XLA free to run the grad
+    # collectives concurrently with independent compute (latency hiding).
+    allreduce_bucket_mb: int = 0
+    overlap_grad_sync: bool = True
     dgc: bool = False
     dgc_configs: Dict = field(default_factory=dict)
     lamb: bool = False
@@ -89,3 +97,17 @@ class DistributedStrategy:
             raise ValueError(
                 "pipeline_configs['schedule'] must be 'gpipe'/'F-then-B'/"
                 f"'1F1B' (case-insensitive), got {sched!r}")
+
+    def apply_tuned(self, config: Dict) -> "DistributedStrategy":
+        """Apply a measured-search plan winner's collective dials (the
+        ``tuning.plan_space`` config keys this class owns) in place and
+        return self.  Unknown keys — the per-group axis assignment, which
+        ``tuning.apply_plan`` lowers onto parameter annotations — are
+        ignored here."""
+        if "fp16_allreduce" in config:
+            self.fp16_allreduce = bool(config["fp16_allreduce"])
+        if "allreduce_bucket_mb" in config:
+            self.allreduce_bucket_mb = int(config["allreduce_bucket_mb"])
+        if "overlap_grad_sync" in config:
+            self.overlap_grad_sync = bool(config["overlap_grad_sync"])
+        return self

@@ -118,6 +118,14 @@ define_flag("kernel_tuning_cache", "",
             "any other value is the cache file path. Pre-warm it by "
             "running representative shapes once, then ship the file — "
             "restarts and serving engines pay zero re-tuning.")
+define_flag("measured_search", "on",
+            "Measured search over sharding plans and serving configs "
+            "(tuning/plan_space.py, tuning/serving_space.py): 'on' lets "
+            "tune_plan/tune_serving compile+time candidates on the real "
+            "backend when a caller asks; 'off' returns the hand-set "
+            "defaults untimed. Kernel tile tuning keeps its own "
+            "FLAGS_kernel_autotune; all spaces share "
+            "FLAGS_kernel_tuning_cache for persisted winners.")
 define_flag("fused_epilogues", True,
             "Let the BERT/GPT hot paths call the fused Pallas epilogues "
             "(LayerNorm+residual, softmax-cross-entropy) on TPU. Off "

@@ -1,4 +1,5 @@
-"""Measured Pallas kernel autotuning with a persistent on-disk cache.
+"""Measured Pallas kernel autotuning — the ``"kernel"`` client of the
+generic measured-search engine in ``paddle_tpu.tuning.engine``.
 
 The hand kernels in this package ship tile-size defaults that were tuned
 on one shape class (flash attention's 512-blocks on 32k sequences, the
@@ -7,7 +8,7 @@ kernels are famously block-size-sensitive, and the measured gap is real:
 BENCH_r04 has ResNet-50 at 0.17 MFU and 32k causal flash at 0.38 while
 BERT reaches 0.50.  The Triton/AutoTVM answer — a small template space,
 compile + time each candidate on the real shapes, memoize the winner —
-is what this module provides, TPU-native:
+lives in the engine; this module keeps what is kernel-specific:
 
 * candidate generators respect Mosaic's (8, 128) f32 tile (sublane
   multiples of 8, lane multiples of 128) and a VMEM-footprint estimate,
@@ -16,14 +17,15 @@ is what this module provides, TPU-native:
   shapes/dtypes; off-TPU (interpret mode, CI) the registered heuristic
   default is returned without timing — interpret-mode timings would tune
   for the wrong machine;
-* winners are memoized in-process and in a JSON cache keyed by
+* winners are memoized in-process and in the shared JSON cache keyed by
   ``(kernel, shape bucket, dtype, device kind)`` so training restarts and
-  serving engines pay zero re-tuning (``FLAGS_kernel_tuning_cache``);
+  serving engines pay zero re-tuning (``FLAGS_kernel_tuning_cache`` —
+  the same file also holds sharding-plan and serving-config winners);
 * every resolution publishes an ``("autotune", kernel)`` event on
   ``framework.trace_events`` (hit / disk_hit / search / heuristic, plus
   counter snapshots) — ``analysis.RetraceMonitor`` turns a measured
   search after ``mark_warm()`` into rule K701, the serving-hot-path twin
-  of R403/S601 — and a "Kernel autotune" section rides along in
+  of R403/S601 — and a "Measured search" section rides along in
   ``profiler.summary()``.
 
 Usage::
@@ -40,23 +42,30 @@ Usage::
 from __future__ import annotations
 
 import functools
-import json
-import math
-import os
-import threading
-import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..framework import trace_events
 from ..framework.errors import InvalidArgumentError
 from ..framework.flags import flag
+from ..tuning import engine as _engine
+from ..tuning.engine import (  # noqa: F401  (re-exported API)
+    _COUNTER_KEYS,
+    cache_path,
+    clear_cache,
+    get_counters,
+    is_warm,
+    mark_warm,
+    measure_ms,
+    reset_counters,
+    reset_warm,
+)
 
 __all__ = [
     "autotune", "TunedKernel", "tile_candidates", "vmem_fits",
     "cache_path", "clear_cache", "get_counters", "reset_counters",
-    "mark_warm", "is_warm", "registered_kernels", "fused_epilogues_eligible",
+    "mark_warm", "is_warm", "reset_warm", "registered_kernels",
+    "fused_epilogues_eligible",
 ]
 
 # -- Mosaic tiling / VMEM constants ------------------------------------------
@@ -67,18 +76,10 @@ VMEM_BYTES = 16 * 1024 * 1024  # per-core VMEM (v4/v5e/v5p all ~16 MB)
 #: double-buffering headroom for the pipelined DMA in/out streams
 VMEM_BUDGET_FRAC = 0.7
 
-_lock = threading.RLock()
 _REGISTRY: Dict[str, "TunedKernel"] = {}
-_mem_cache: Dict[str, dict] = {}          # key -> config (measured or disk)
-_heuristic_cache: Dict[str, dict] = {}    # key -> config (untimed fallback)
-_counters: Dict[str, Dict[str, int]] = {}
-_warm = False                              # set by serving warmup; see K701
 
-_disk_state = {"path": None, "entries": None}  # lazily-loaded JSON cache
-
-
-def _next_pow2(n: int) -> int:
-    return 1 << max(int(n) - 1, 0).bit_length()
+_bucket_shape = _engine.bucket_shape
+_device_kind = _engine.device_kind
 
 
 def _round_up(x: int, m: int) -> int:
@@ -105,154 +106,8 @@ def vmem_fits(nbytes: int, frac: float = VMEM_BUDGET_FRAC) -> bool:
     return nbytes <= int(VMEM_BYTES * frac)
 
 
-def _bucket_shape(shape) -> Tuple[int, ...]:
-    """Shape bucket for the cache key: each dim rounds up to a power of
-    two, so nearby geometries (ragged batches, serving buckets) share one
-    tuning entry.  The kernels clamp blocks to the real shape at call
-    time, so a winner from a larger bucket member stays valid."""
-    return tuple(_next_pow2(d) for d in shape)
-
-
-def _device_kind() -> str:
-    import jax
-
-    try:
-        return jax.devices()[0].device_kind
-    except Exception:  # backend not initialized / unreachable
-        return jax.default_backend()
-
-
 def _is_arraylike(a) -> bool:
     return hasattr(a, "shape") and hasattr(a, "dtype")
-
-
-# -- persistent cache --------------------------------------------------------
-def cache_path() -> Optional[str]:
-    """Resolved on-disk cache path (``FLAGS_kernel_tuning_cache``), or
-    ``None`` when persistence is disabled."""
-    val = str(flag("kernel_tuning_cache") or "").strip()
-    if val.lower() in ("0", "off", "none", "false", "disabled"):
-        return None
-    if not val:
-        return os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
-                            "kernel_tuning.json")
-    return val
-
-
-def _disk_entries() -> Dict[str, dict]:
-    """The loaded disk cache, reloaded when the flag re-points it."""
-    path = cache_path()
-    if path is None:
-        return {}
-    if _disk_state["path"] != path or _disk_state["entries"] is None:
-        entries = {}
-        try:
-            with open(path) as f:
-                data = json.load(f)
-            if isinstance(data, dict):
-                entries = {k: v for k, v in data.get("entries", {}).items()
-                           if isinstance(v, dict) and "config" in v}
-        except (OSError, ValueError):
-            entries = {}
-        _disk_state["path"] = path
-        _disk_state["entries"] = entries
-    return _disk_state["entries"]
-
-
-def _disk_store(key: str, kernel: str, config: dict, best_ms: float) -> None:
-    path = cache_path()
-    if path is None:
-        return
-    entries = dict(_disk_entries())
-    # merge with concurrent writers: reread before rewrite
-    try:
-        with open(path) as f:
-            on_disk = json.load(f).get("entries", {})
-        if isinstance(on_disk, dict):
-            entries = {**on_disk, **entries}
-    except (OSError, ValueError):
-        pass
-    entries[key] = {"kernel": kernel, "config": dict(config),
-                    "best_ms": round(float(best_ms), 4)}
-    try:
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump({"version": 1, "entries": entries}, f, indent=0,
-                      sort_keys=True)
-        os.replace(tmp, path)
-    except OSError:
-        return  # read-only cache dir: winners stay process-local
-    _disk_state["path"] = path
-    _disk_state["entries"] = entries
-
-
-def clear_cache(memory: bool = True, disk: bool = False) -> None:
-    """Drop tuned winners.  ``disk=True`` also deletes the JSON file."""
-    with _lock:
-        if memory:
-            _mem_cache.clear()
-            _heuristic_cache.clear()
-        _disk_state["path"] = None
-        _disk_state["entries"] = None
-    if disk:
-        path = cache_path()
-        if path is not None:
-            try:
-                os.remove(path)
-            except OSError:
-                pass
-
-
-# -- counters / events -------------------------------------------------------
-_COUNTER_KEYS = ("hits", "disk_hits", "searches", "heuristic",
-                 "configs_timed", "search_failures", "searches_after_warm")
-
-
-def _bump(kernel: str, field: str, n: int = 1) -> Dict[str, int]:
-    c = _counters.setdefault(kernel, {k: 0 for k in _COUNTER_KEYS})
-    c[field] += n
-    return c
-
-
-def get_counters(kernel: Optional[str] = None) -> Dict:
-    """Counter snapshot(s): one kernel's dict, or ``{kernel: dict}``."""
-    with _lock:
-        if kernel is not None:
-            return dict(_counters.get(kernel,
-                                      {k: 0 for k in _COUNTER_KEYS}))
-        return {k: dict(v) for k, v in _counters.items()}
-
-
-def reset_counters() -> None:
-    with _lock:
-        _counters.clear()
-
-
-def mark_warm() -> None:
-    """Declare tuning warmup over (serving engines call this after
-    ``warmup()``): any measured search past this point is tuning work on
-    a hot path — a cache miss the pre-warmed JSON cache should have
-    absorbed — and is flagged by analysis rule K701."""
-    global _warm
-    with _lock:
-        _warm = True
-
-
-def is_warm() -> bool:
-    return _warm
-
-
-def _publish(kernel: str, event: str, key: str, config: dict, **extra):
-    with _lock:
-        counters = dict(_counters.get(kernel,
-                                      {k: 0 for k in _COUNTER_KEYS}))
-        warm = _warm
-    if trace_events.active():
-        info = {"event": event, "key": key, "config": dict(config),
-                "warm": warm, "counters": counters}
-        info.update(extra)
-        trace_events.notify(("autotune", kernel), info)
 
 
 def registered_kernels() -> List[str]:
@@ -283,17 +138,11 @@ def _synthetic_args(args):
 
 
 def _time_once(fn, args) -> float:
-    """Compile + best-of-3 wall time (ms) for one candidate."""
+    """Compile + best-of-3 wall time (ms) for one candidate (the untimed
+    warm call and best-of-N live in ``engine.measure_ms``)."""
     import jax
 
-    jitted = jax.jit(fn)
-    jax.block_until_ready(jitted(*args))  # compile + warm
-    best = math.inf
-    for _ in range(3):
-        t0 = time.perf_counter()
-        jax.block_until_ready(jitted(*args))
-        best = min(best, time.perf_counter() - t0)
-    return best * 1e3
+    return measure_ms(jax.jit(fn), args, repeats=3)
 
 
 class TunedKernel:
@@ -344,17 +193,8 @@ class TunedKernel:
         """The (deduped) candidate configs for these args; the heuristic
         default is always in the running."""
         kw = {k: v for k, v in kwargs.items() if k not in self.params}
-        cands = list(self.space(*args, **kw))
-        default = self.heuristic(*args, **kw)
-        seen, out = set(), []
-        for c in cands + [default]:
-            c = {k: int(v) if isinstance(v, (bool, np.integer)) or
-                 isinstance(v, int) else v for k, v in c.items()}
-            sig = tuple(sorted(c.items()))
-            if sig not in seen:
-                seen.add(sig)
-                out.append(c)
-        return out
+        return _engine.dedup_candidates(self.space(*args, **kw),
+                                        self.heuristic(*args, **kw))
 
     # -- resolution ----------------------------------------------------------
     def config(self, *args, **kwargs) -> dict:
@@ -368,72 +208,22 @@ class TunedKernel:
         mode = str(flag("kernel_autotune")).lower()
         measurable = mode == "force" or (
             mode != "off" and jax.default_backend() == "tpu")
+        synth = None  # built once, only if a search actually measures
 
-        with _lock:
-            cfg = _mem_cache.get(key)
-            if cfg is None and not measurable:
-                cfg = _heuristic_cache.get(key)
-            if cfg is not None:
-                _bump(self.name, "hits")
-        if cfg is not None:
-            _publish(self.name, "hit", key, cfg)
-            return dict(cfg)
+        def measure(cand: dict) -> float:
+            nonlocal synth
+            if synth is None:
+                synth = _synthetic_args(args)
+            merged = {**kw, **cand}
+            return _time_once(lambda *a, _m=merged: self.fn(*a, **_m),
+                              synth)
 
-        if measurable:
-            disk = _disk_entries().get(key)
-            if disk is not None:
-                cfg = dict(disk["config"])
-                with _lock:
-                    _mem_cache[key] = cfg
-                    _bump(self.name, "disk_hits")
-                _publish(self.name, "disk_hit", key, cfg)
-                return dict(cfg)
-            return self._search(key, args, kw)
-
-        cfg = self.heuristic(*args, **kw)
-        with _lock:
-            _heuristic_cache[key] = dict(cfg)
-            _bump(self.name, "heuristic")
-        _publish(self.name, "heuristic", key, cfg)
-        return dict(cfg)
-
-    def _search(self, key: str, args, kw) -> dict:
-        from .. import profiler
-
-        cands = self.candidates(*args, **kw)
-        default = self.heuristic(*args, **kw)
-        synth = _synthetic_args(args)
-        best_cfg, best_ms, timed = dict(default), math.inf, 0
-        with profiler.RecordEvent(f"autotune/{self.name}"):
-            for cand in cands:
-                merged = {**kw, **cand}
-                try:
-                    ms = _time_once(
-                        lambda *a, _m=merged: self.fn(*a, **_m), synth)
-                except Exception:  # candidate fails to lower: skip it
-                    with _lock:
-                        _bump(self.name, "search_failures")
-                    continue
-                timed += 1
-                if ms < best_ms:
-                    best_cfg, best_ms = dict(cand), ms
-        if timed == 0:  # nothing lowered — fall back, don't poison caches
-            with _lock:
-                _bump(self.name, "heuristic")
-            _publish(self.name, "heuristic", key, default,
-                     note="all candidates failed")
-            return dict(default)
-        with _lock:
-            _mem_cache[key] = dict(best_cfg)
-            _bump(self.name, "searches")
-            _bump(self.name, "configs_timed", timed)
-            if _warm:
-                _bump(self.name, "searches_after_warm")
-        _disk_store(key, self.name, best_cfg, best_ms)
-        _publish(self.name, "search", key, best_cfg,
-                 best_ms=round(best_ms, 4), n_candidates=len(cands),
-                 n_timed=timed)
-        return dict(best_cfg)
+        return _engine.resolve(
+            "kernel", self.name, key,
+            candidates=lambda: self.space(*args, **kw),
+            measure=measure,
+            heuristic=lambda: self.heuristic(*args, **kw),
+            measurable=measurable)
 
     # -- call ----------------------------------------------------------------
     def __call__(self, *args, **kwargs):
@@ -481,49 +271,3 @@ def fused_epilogues_eligible(feature_dim: Optional[int] = None) -> bool:
     mesh = get_mesh()
     return (mesh.shape.get("model", 1) == 1
             and mesh.shape.get("sep", 1) == 1)
-
-
-# -- profiler summary section ------------------------------------------------
-_section_base: Dict[str, Dict[str, int]] = {}
-
-
-def _on_profiler_reset() -> None:
-    with _lock:
-        _section_base.clear()
-        _section_base.update({k: dict(v) for k, v in _counters.items()})
-
-
-def _summary_section() -> str:
-    """Counter deltas since the profiler was last reset, as a table the
-    ``profiler.summary()`` host-event report appends."""
-    with _lock:
-        rows = []
-        for kernel in sorted(_counters):
-            base = _section_base.get(kernel, {})
-            d = {k: _counters[kernel][k] - base.get(k, 0)
-                 for k in _COUNTER_KEYS}
-            if any(d.values()):
-                rows.append((kernel, d))
-    if not rows:
-        return ""
-    path = cache_path() or "<in-memory only>"
-    w = max(len(r[0]) for r in rows) + 2
-    lines = [f"Kernel autotune (cache: {path})",
-             f"{'Kernel':<{w}}{'Searches':>10}{'Timed':>8}{'Hits':>8}"
-             f"{'Disk':>8}{'Heur':>8}{'AfterWarm':>11}"]
-    for kernel, d in rows:
-        lines.append(
-            f"{kernel:<{w}}{d['searches']:>10}{d['configs_timed']:>8}"
-            f"{d['hits']:>8}{d['disk_hits']:>8}{d['heuristic']:>8}"
-            f"{d['searches_after_warm']:>11}")
-    return "\n".join(lines)
-
-
-def _register_profiler_section() -> None:
-    from .. import profiler
-
-    profiler.register_summary_section(_summary_section,
-                                      on_reset=_on_profiler_reset)
-
-
-_register_profiler_section()

@@ -49,6 +49,21 @@ class InferenceEngine:
     before failing its futures — see ``FLAGS_transient_max_retries``).
     """
 
+    @classmethod
+    def from_tuned(cls, path_prefix: str, config: Dict, **overrides):
+        """Build an engine from a measured-search serving config (a
+        ``tuning.serving_space`` winner): ``buckets`` plus
+        ``max_batch_size``/``batch_size`` and ``max_queue_delay_ms`` map
+        onto constructor arguments; keyword ``overrides`` win."""
+        kw = {}
+        batch = config.get("max_batch_size", config.get("batch_size"))
+        if batch is not None:
+            kw["max_batch_size"] = int(batch)
+        if config.get("max_queue_delay_ms") is not None:
+            kw["max_queue_delay_ms"] = float(config["max_queue_delay_ms"])
+        kw.update(overrides)
+        return cls(path_prefix, config["buckets"], **kw)
+
     def __init__(self, path_prefix: str, buckets: Sequence, *,
                  max_batch_size: int = 8, max_queue_delay_ms: float = 5.0,
                  max_queue_depth: int = 256, pad_value=0,

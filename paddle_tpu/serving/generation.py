@@ -115,6 +115,29 @@ class GenerationEngine:
     ``FLAGS_kv_page_size`` / ``FLAGS_speculative_k``.
     """
 
+    @classmethod
+    def from_tuned(cls, model, config: Dict, **overrides):
+        """Build an engine from a measured-search serving config (a
+        ``tuning.serving_space`` winner, in-process or replayed from the
+        tuning cache).  Config keys map onto constructor arguments:
+        ``buckets`` → ``prompt_buckets``, plus ``batch_size`` /
+        ``max_queue_delay_ms`` / ``kv_page_size`` / ``speculative_k`` /
+        ``paged`` / ``continuous`` verbatim; keyword ``overrides`` win
+        over the config (e.g. a caller-pinned ``name``)."""
+        kw = {}
+        if "buckets" in config:
+            kw["prompt_buckets"] = [int(b) for b in config["buckets"]]
+        for k in ("batch_size", "kv_page_size", "speculative_k"):
+            if config.get(k) is not None:
+                kw[k] = int(config[k])
+        if config.get("max_queue_delay_ms") is not None:
+            kw["max_queue_delay_ms"] = float(config["max_queue_delay_ms"])
+        for k in ("paged", "continuous"):
+            if config.get(k) is not None:
+                kw[k] = bool(config[k])
+        kw.update(overrides)
+        return cls(model, **kw)
+
     def __init__(self, model, *, prompt_buckets: Sequence[int],
                  batch_size: int = 4, cache_len: Optional[int] = None,
                  max_queue_delay_ms: float = 5.0, max_queue_depth: int = 256,

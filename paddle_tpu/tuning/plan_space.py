@@ -1,0 +1,258 @@
+"""Sharding-plan measured search — the ``"plan"`` client of the engine.
+
+SNIPPETS-style "naive sharding" picks one partition spec by hand and
+hopes; this module enumerates per-parameter-group mesh-axis assignments
+over the existing ``data/sharding/model/sep/pipe`` axes plus the
+collective schedule dials (`fp16_allreduce`, gradient bucketing,
+overlap), rejects invalid assignments with
+``analysis.check_plan.is_valid_plan`` BEFORE any compile, and times the
+survivors as real train steps (the caller supplies the step measure —
+typically ``Executor.run_steps`` on the real program).  The winner is
+persisted in the shared tuning cache keyed
+``plan | tag | param-bucket | mesh | device_kind`` and applied via
+:func:`apply_plan` (parameter ``partition_spec`` annotations +
+``DistributedStrategy.apply_tuned``).
+
+A candidate config is JSON-plain::
+
+    {"axes": {"<group>": "model" | "sharding" | "none", ...},
+     "fp16_allreduce": 0 | 1,
+     "allreduce_bucket_mb": 0 | 16 | 64,
+     "overlap_grad_sync": 0 | 1}
+
+Enumeration is deliberately naive — an axis is proposed for a group's
+first large-enough dim whether or not it divides; that is exactly the
+class of mistake the P501/P502/P503 pre-filter exists to catch, and it
+keeps the filter on the load-bearing path.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..framework.flags import flag
+from . import engine
+
+__all__ = ["param_groups", "plan_candidates", "tune_plan", "apply_plan",
+           "make_step_measure"]
+
+#: mesh axes a parameter group may be assigned to ("none" = replicated);
+#: ``data`` stays the batch axis and is never a parameter axis here
+PARAM_AXES = ("none", "model", "sharding", "sep", "pipe")
+
+#: collective schedule dials and their sweep values
+COLLECTIVE_DIALS = {
+    "fp16_allreduce": (0, 1),
+    "allreduce_bucket_mb": (0, 16, 64),
+    "overlap_grad_sync": (1, 0),
+}
+
+
+def param_groups(shapes: Dict[str, tuple]) -> Dict[str, Dict[str, tuple]]:
+    """Group parameter names by their first dotted component — layers
+    tune together (one axis choice per module, not per tensor), which
+    keeps the space polynomial in modules instead of exponential in
+    tensors."""
+    groups: Dict[str, Dict[str, tuple]] = {}
+    for name, shape in shapes.items():
+        groups.setdefault(name.split(".", 1)[0], {})[name] = tuple(shape)
+    return groups
+
+
+def network_shapes(network) -> Dict[str, tuple]:
+    out = {}
+    for name, box in network.named_parameters():
+        try:
+            out[name] = tuple(box.value.shape)
+        except Exception:  # deleted/donated array: metadata unavailable
+            continue
+    return out
+
+
+def _specs_for(groups: Dict[str, Dict[str, tuple]], axes: Dict[str, str],
+               mesh_shape: Dict[str, int]) -> Dict[str, tuple]:
+    """Lower a per-group axis assignment onto per-parameter partition
+    specs: the group's axis goes on each parameter's FIRST dim at least
+    as large as the axis (naive on purpose — divisibility is the
+    pre-filter's job, see module docstring)."""
+    specs: Dict[str, tuple] = {}
+    for gname, params in groups.items():
+        ax = axes.get(gname, "none")
+        size = mesh_shape.get(ax, 1)
+        for pname, shape in params.items():
+            if ax == "none" or size <= 1:
+                specs[pname] = ()
+                continue
+            d = next((i for i, s in enumerate(shape) if s >= size), None)
+            if d is None:
+                specs[pname] = ()
+                continue
+            spec = [None] * (d + 1)
+            spec[d] = ax
+            specs[pname] = tuple(spec)
+    return specs
+
+
+class _PlanView:
+    """Duck-typed stand-in ``check_plan.is_valid_plan`` accepts: shapes
+    and specs without a live network or a constructed ShardingPlan."""
+
+    def __init__(self, shapes: Dict[str, tuple],
+                 specs: Dict[str, tuple], mesh):
+        self.param_shapes = shapes
+        self.param_specs = specs
+        self.mesh = mesh
+
+
+def is_valid_candidate(config: dict, groups: Dict[str, Dict[str, tuple]],
+                       mesh) -> bool:
+    """P501–P504 pre-filter for one candidate: materialize its specs and
+    run the boolean checker — no DiagnosticCollector, no compile."""
+    from ..analysis import is_valid_plan
+
+    shapes = {n: s for g in groups.values() for n, s in g.items()}
+    specs = _specs_for(groups, config.get("axes", {}), dict(mesh.shape))
+    return is_valid_plan(_PlanView(shapes, specs, mesh))
+
+
+def plan_candidates(groups: Dict[str, Dict[str, tuple]], mesh, *,
+                    base: Optional[dict] = None,
+                    max_candidates: int = 64) -> List[dict]:
+    """Enumerate candidate plans: the full (axes × dials) product when it
+    fits ``max_candidates``, else a coordinate sweep around ``base`` (one
+    group or one dial varied at a time) — the AutoTVM-style fallback that
+    keeps measurement cost linear in the number of knobs."""
+    mesh_shape = dict(mesh.shape)
+    # only propose axes that exist with size > 1 (plus replication)
+    axis_opts = ["none"] + [a for a in PARAM_AXES[1:]
+                            if mesh_shape.get(a, 1) > 1]
+    gnames = sorted(groups)
+    base = dict(base or {})
+    base_axes = dict(base.get("axes") or {g: "none" for g in gnames})
+    for g in gnames:
+        base_axes.setdefault(g, "none")
+    base_cfg = {
+        "axes": {g: base_axes[g] for g in gnames},
+        "fp16_allreduce": int(base.get("fp16_allreduce", 0)),
+        "allreduce_bucket_mb": int(base.get("allreduce_bucket_mb", 0)),
+        "overlap_grad_sync": int(base.get("overlap_grad_sync", 1)),
+    }
+
+    def cfg(axes, dials):
+        return {"axes": dict(axes), **dials}
+
+    total = (len(axis_opts) ** len(gnames)) * int(
+        np.prod([len(v) for v in COLLECTIVE_DIALS.values()]))
+    out: List[dict] = [base_cfg]
+    if total <= max_candidates:
+        dial_items = sorted(COLLECTIVE_DIALS.items())
+        for combo in itertools.product(*(axis_opts for _ in gnames)):
+            axes = dict(zip(gnames, combo))
+            for dvals in itertools.product(*(v for _, v in dial_items)):
+                dials = {k: int(v) for (k, _), v
+                         in zip(dial_items, dvals)}
+                out.append(cfg(axes, dials))
+    else:
+        base_dials = {k: base_cfg[k] for k in COLLECTIVE_DIALS}
+        for g in gnames:  # one group's axis at a time
+            for ax in axis_opts:
+                axes = dict(base_cfg["axes"])
+                axes[g] = ax
+                out.append(cfg(axes, base_dials))
+        for dial, values in sorted(COLLECTIVE_DIALS.items()):
+            for v in values:  # one dial at a time
+                dials = dict(base_dials)
+                dials[dial] = int(v)
+                out.append(cfg(base_cfg["axes"], dials))
+    return engine.dedup_candidates(out[:max_candidates + 1], base_cfg)
+
+
+def _param_bucket(groups: Dict[str, Dict[str, tuple]]) -> str:
+    """Pow2-bucketed total parameter count: nearby model sizes share one
+    plan entry, mirroring the kernel space's shape bucketing."""
+    total = sum(int(np.prod(s)) if s else 1
+                for g in groups.values() for s in g.values())
+    return f"p{engine.next_pow2(max(total, 1))}"
+
+
+def tune_plan(tag: str, *, measure: Callable[[dict], float],
+              network=None, shapes: Optional[Dict[str, tuple]] = None,
+              mesh=None, base: Optional[dict] = None,
+              max_candidates: int = 64,
+              details: Optional[dict] = None) -> dict:
+    """Measured search over sharding plans for one workload ``tag``.
+
+    ``measure(config) -> ms`` times a candidate END TO END — apply the
+    config (``apply_plan``/``apply_tuned``), build the program, and run
+    real train steps (``Executor.run_steps``); see
+    :func:`make_step_measure`.  Lower is better; raise
+    :class:`engine.CandidateError` to reject.  Off (``
+    FLAGS_measured_search=off``) the base/default plan is returned
+    untimed.  The winner persists in the shared tuning cache."""
+    if mesh is None:
+        from ..distributed.mesh import get_mesh
+
+        mesh = get_mesh()
+    if shapes is None:
+        shapes = network_shapes(network)
+    groups = param_groups(shapes)
+    key = "|".join([tag, _param_bucket(groups), engine.mesh_key(mesh),
+                    engine.device_kind()])
+    measurable = str(flag("measured_search")).lower() != "off"
+    base_cfg: List[dict] = []
+
+    def heuristic() -> dict:
+        if not base_cfg:
+            base_cfg.append(plan_candidates(groups, mesh, base=base,
+                                            max_candidates=0)[0])
+        return base_cfg[0]
+
+    return engine.resolve(
+        "plan", tag, key,
+        candidates=lambda: plan_candidates(groups, mesh, base=base,
+                                           max_candidates=max_candidates),
+        measure=measure,
+        heuristic=heuristic,
+        measurable=measurable,
+        prefilter=lambda c: is_valid_candidate(c, groups, mesh),
+        details=details)
+
+
+def make_step_measure(run_step: Callable[[dict], object], *,
+                      repeats: int = 2) -> Callable[[dict], float]:
+    """Adapt a "apply config then run N train steps" callable into the
+    engine's measure contract with the warm-call + best-of-N discipline:
+    ``run_step(config)`` must apply the candidate and execute the step
+    batch (e.g. ``exe.run_steps(..., iterations=k)``), returning the
+    fetched values (blocked on inside ``measure_ms``)."""
+
+    def measure(config: dict) -> float:
+        return engine.measure_ms(run_step, (config,), repeats=repeats)
+
+    return measure
+
+
+def apply_plan(config: dict, *, network=None, strategy=None, mesh=None):
+    """Apply a plan winner: lower the per-group axis assignment onto
+    parameter ``partition_spec`` annotations (the hook
+    ``ShardingPlan.__init__`` reads) and the collective dials onto the
+    strategy.  Returns ``(strategy, specs)``."""
+    specs: Dict[str, tuple] = {}
+    if network is not None:
+        if mesh is None:
+            from ..distributed.mesh import get_mesh
+
+            mesh = get_mesh()
+        shapes = network_shapes(network)
+        groups = param_groups(shapes)
+        specs = _specs_for(groups, config.get("axes", {}),
+                           dict(mesh.shape))
+        for name, box in network.named_parameters():
+            spec = specs.get(name, ())
+            box.partition_spec = tuple(spec) if any(
+                a is not None for a in spec) else None
+    if strategy is not None:
+        strategy.apply_tuned(config)
+    return strategy, specs

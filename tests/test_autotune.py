@@ -37,12 +37,12 @@ def _clean_tuner_state():
     leaves the flags at their defaults."""
     autotune.clear_cache()
     autotune.reset_counters()
-    autotune._warm = False
+    autotune.reset_warm()
     yield
     set_flags({"kernel_autotune": "on", "kernel_tuning_cache": ""})
     autotune.clear_cache()
     autotune.reset_counters()
-    autotune._warm = False
+    autotune.reset_warm()
 
 
 # one tiny registered kernel so cache/counter tests don't depend on the
@@ -313,6 +313,6 @@ class TestProfilerSection:
         profiler.reset_profiler()
         _probe.config(_arr(24, 24))  # heuristic resolution on CPU
         s = profiler.summary()
-        assert "Kernel autotune" in s and "test_probe" in s
+        assert "Measured search" in s and "test_probe" in s
         profiler.reset_profiler()
         assert profiler.summary() == ""  # deltas cleared with the rest

@@ -12,6 +12,7 @@
 #   perf-smoke tools/perf_smoke.py   (fused run_steps vs per-step, CPU, seconds)
 #   serving-smoke tools/serving_smoke.py (closed compile set + KV-decode identity)
 #   kernel-smoke tools/kernel_smoke.py (autotuner search + warm-restart cache hit)
+#   tune-smoke tools/tune_smoke.py  (plan + serving measured search, warm replay, K701)
 #   chaos-smoke tools/chaos_smoke.py (SIGKILL-resume bit identity + circuit recovery)
 #   obs-smoke tools/obs_smoke.py   (metrics scrape + JSONL sink + serving spans)
 #   router-smoke tools/router_smoke.py (replica kill -> zero-loss failover + rolling swap)
@@ -20,7 +21,7 @@
 #   elastic-smoke tools/elastic_smoke.py (NaN rollback + exact resume + collective watchdog)
 #   bench   python bench.py          (only when a real TPU answers)
 #
-# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|chaos-smoke|obs-smoke|router-smoke|gen-smoke|slo-smoke|elastic-smoke|bench]...
+# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|tune-smoke|chaos-smoke|obs-smoke|router-smoke|gen-smoke|slo-smoke|elastic-smoke|bench]...
 #         tools/run_gates.sh --only suite
 # Exit code: 0 iff every stage that ran passed.
 set -u
@@ -103,6 +104,12 @@ run_stage serving-smoke env JAX_PLATFORMS=cpu python tools/serving_smoke.py
 # kernel autotuner: forced measured search in interpret mode, then a second
 # process that must resolve every key from the on-disk cache (zero searches)
 run_stage kernel-smoke env JAX_PLATFORMS=cpu python tools/kernel_smoke.py
+# measured search beyond kernels: sharding-plan candidates timed as real
+# fused train steps + serving dials timed against the deterministic bench
+# trace, winners persisted (schema v2); a second process replays both from
+# disk with zero searches, K701 silent on hits and firing on an injected
+# post-warm search
+run_stage tune-smoke env JAX_PLATFORMS=cpu python tools/tune_smoke.py
 # resilience: injected checkpoint-write fault + SIGKILL -> bit-identical
 # resume; injected serving fault -> circuit opens, sheds, recovers
 run_stage chaos-smoke env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
