@@ -69,6 +69,9 @@ class RetraceMonitor:
         self._steptrace_sites: Dict[str, dict] = {}
         # ("slo", name) SLO-engine snapshots: latest per engine (rule M903)
         self._slo_sites: Dict[str, dict] = {}
+        # ("pool", name) replica-pool actuator snapshots: latest per pool
+        # (rule S605 — post-warmup scale thrash)
+        self._pool_sites: Dict[str, dict] = {}
         # ("supervisor", name) divergence-guard counter snapshots: latest
         # per supervisor (rule F802)
         self._supervisor_sites: Dict[str, dict] = {}
@@ -133,6 +136,12 @@ class RetraceMonitor:
             # SLO-engine tick snapshot: cumulative counters, latest wins
             with self._lock:
                 self._slo_sites[key[1]] = dict(info)
+            return
+        if key[0] == "pool":
+            # replica-pool actuator snapshot: cumulative counters, latest
+            # wins (S605 reads the thrash counters)
+            with self._lock:
+                self._pool_sites[key[1]] = dict(info)
             return
         if key[0] == "supervisor":
             # divergence-guard counter snapshot: cumulative, latest wins
@@ -219,6 +228,16 @@ class RetraceMonitor:
             if name is not None:
                 return dict(self._slo_sites.get(name, {}))
             return {k: dict(v) for k, v in self._slo_sites.items()}
+
+    def pool_stats(self, name: str = None):
+        """Latest replica-pool actuator snapshot(s) observed (scale
+        ups/downs, deferral counters, thrash events, replica gauges):
+        the dict for one pool (``name`` like ``"pool#1"``), or all of
+        them."""
+        with self._lock:
+            if name is not None:
+                return dict(self._pool_sites.get(name, {}))
+            return {k: dict(v) for k, v in self._pool_sites.items()}
 
     def supervisor_stats(self, name: str = None):
         """Latest training-supervisor counter snapshot(s) observed
@@ -386,6 +405,34 @@ class RetraceMonitor:
                              "pages never return on their own; restart "
                              "the engine to rebuild the pool as a "
                              "stopgap")
+        with self._lock:
+            pool_sites = {k: dict(v) for k, v in self._pool_sites.items()}
+        for name, stats in pool_sites.items():
+            # S605: post-warmup scale thrash — the autoscaling loop
+            # reversed itself inside its own thrash window more than
+            # once after warmup, i.e. the actuator is amplifying noise
+            # instead of tracking load.  One reversal can be a genuine
+            # load edge; repeated reversals mean the hysteresis/cooldown
+            # dials are too tight for the signal's variance.
+            thrash = int(stats.get("thrash_events_after_warm", 0))
+            if thrash >= 2:
+                out.add("S605",
+                        f"replica pool {name} reversed scaling direction "
+                        f"{thrash} times after warmup inside its thrash "
+                        f"window ({stats.get('scale_ups', 0)} up(s) / "
+                        f"{stats.get('scale_downs', 0)} down(s), bounds "
+                        f"{stats.get('min_replicas', '?')}.."
+                        f"{stats.get('max_replicas', '?')}) — each "
+                        f"reversal cold-starts or drains a replica for "
+                        f"nothing, burning warmup compiles and churning "
+                        f"the fleet while the load never changed",
+                        location=Location(file=name, function=name),
+                        hint="damp the loop: raise cooldown_s or the "
+                             "up/down_consecutive streaks on the "
+                             "ReplicaPool, widen the SloEngine burn "
+                             "thresholds (scale_down_burn), or pin "
+                             "min_replicas at the observed steady-state "
+                             "fleet size")
         with self._lock:
             autotune_sites = {k: dict(v)
                               for k, v in self._autotune_sites.items()}

@@ -446,6 +446,39 @@ class GPTModel(Layer):
             ],
         }
 
+    def gather_pages(self, cache, idx):
+        """Read whole pages out of the pool — the export half of the
+        prefill→decode KV hand-off (serving/pool.py): ``idx`` is a
+        fixed-size ``[K]`` int32 vector of physical page numbers (``-1``
+        reads the all-zero write-drop page, so the op always runs at one
+        static shape).  Returns one stacked ``[L, 2, K, H, page, hd]``
+        array (layer-major, k/v interleaved) so the hand-off rides a
+        single host transfer instead of ``2L`` small ones."""
+        P = cache["layers"][0]["k"].shape[0] - 1
+        idx = jnp.asarray(idx, jnp.int32)
+        idx = jnp.where(idx >= 0, idx, P)
+        return jnp.stack([jnp.stack([l["k"][idx], l["v"][idx]])
+                          for l in cache["layers"]])
+
+    def scatter_pages(self, cache, kv, dst):
+        """Write :meth:`gather_pages` payloads into the pool — the import
+        half of the KV hand-off: ``kv`` is the ``[L, 2, K, H, page, hd]``
+        export and ``dst`` the ``[K]`` int32 target pages the adopting
+        host allocated (``-1`` lands in the write-drop page).  Same
+        static-shape contract as :meth:`copy_pages`, so the adopting
+        engine's compile set stays closed."""
+        kv = jnp.asarray(kv)
+        dst = jnp.asarray(dst, jnp.int32)
+        P = cache["layers"][0]["k"].shape[0] - 1
+        dst = jnp.where(dst >= 0, dst, P)
+        return {
+            "layers": [
+                {"k": l["k"].at[dst].set(kv[i, 0].astype(l["k"].dtype)),
+                 "v": l["v"].at[dst].set(kv[i, 1].astype(l["v"].dtype))}
+                for i, l in enumerate(cache["layers"])
+            ],
+        }
+
     def forward_paged(self, input_ids, positions, pos_map, table, cache):
         """Prefill/decode forward over :meth:`init_paged_cache` state.
 

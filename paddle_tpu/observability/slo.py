@@ -65,13 +65,18 @@ _BAD_KEYS = ("errors", "expired", "shed", "circuit_shed", "rejected")
 
 class ScaleSignal(NamedTuple):
     """One scaling verdict: ``direction`` is ``up``/``down``/``steady``;
-    ``objective`` names the worst burner (empty when steady/down)."""
+    ``objective`` names the worst burner (empty when steady/down).
+    ``seq`` is the engine's monotonic tick counter — consumers that may
+    see signals re-ordered (an async actuator, a fan-out bus) discard
+    any signal whose ``seq`` is not newer than the last one they acted
+    on.  ``-1`` means unsequenced (hand-built test signals)."""
 
     direction: str
     reason: str
     objective: str
     burn_rate: float
     at: float
+    seq: int = -1
 
 
 class Objective:
@@ -236,6 +241,7 @@ class SloEngine:
                         "scale_up_signals": 0, "scale_down_signals": 0,
                         "scale_steady_signals": 0}
         self._last_signal: Optional[ScaleSignal] = None
+        self._seq = 0  # monotonic per-tick signal sequence (ScaleSignal.seq)
         self._t_start = self._clock()
         self._installed = False
         self._stop = threading.Event()
@@ -384,6 +390,9 @@ class SloEngine:
                 if max_burn >= worst[1]:
                     worst = (obj.name, max_burn)
         sig = self._decide(now, alerting, worst, results)
+        with self._lock:
+            self._seq += 1
+            sig = sig._replace(seq=self._seq)
         reg.gauge("paddle_tpu_slo_scale_signal",
                   "latest scale verdict: 1 up / 0 steady / -1 down",
                   ("slo",)).labels(self.name).set(

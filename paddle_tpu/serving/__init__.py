@@ -41,16 +41,33 @@ KV-cache model paths into an online engine:
   (active probes + per-replica circuit breaker) least-outstanding/p2c
   balancing over N engine replicas, transparent failover, optional
   hedged requests, zero-downtime drain and rolling weight swap
-  (consumed by ``analysis`` rule S602).
+  (consumed by ``analysis`` rule S602), plus dynamic fleet membership
+  (``add_replica`` / ``remove_replica`` — replicas join through the
+  half-open probe/admit path and retire through graceful drain).
+* :mod:`~paddle_tpu.serving.pool` — :class:`ReplicaPool`: the replica
+  lifecycle actuator closing the autoscaling loop — consumes
+  ``SloEngine`` scale signals, cold-starts warmed replicas off the
+  serving path, retires them via drain, with hysteresis / cooldown /
+  bounds / sequence-ordering guards (consumed by ``analysis`` rule
+  S605); and :class:`DisaggServer`: the prefill/decode-disaggregated
+  front-end piping :class:`~paddle_tpu.serving.generation.KVHandoff`
+  page hand-offs from prefill-role to decode-role targets.
+* :mod:`~paddle_tpu.serving.scenarios` — deterministic open-loop
+  traffic scenarios (diurnal ramps, flash crowds, heavy-tail budgets,
+  poison requests) and the :func:`run_scenario` harness that drives a
+  serving stack through them with zero-loss accounting.
 """
 from .batcher import MicroBatcher, Request
 from .bucketing import Bucket, BucketSet, as_bucket
 from .engine import InferenceEngine
-from .generation import GenerationEngine
+from .generation import GenerationEngine, KVHandoff
 from .metrics import ServingMetrics
 from .paging import PagePool
+from .pool import DisaggServer, ReplicaPool
 from .replica import Replica
 from .router import Router
+from .scenarios import (Scenario, ScenarioRequest, diurnal, flash_crowd,
+                        heavy_tail, poison, run_scenario)
 
 __all__ = [
     "Bucket",
@@ -60,8 +77,18 @@ __all__ = [
     "Request",
     "InferenceEngine",
     "GenerationEngine",
+    "KVHandoff",
     "ServingMetrics",
     "PagePool",
     "Replica",
     "Router",
+    "ReplicaPool",
+    "DisaggServer",
+    "Scenario",
+    "ScenarioRequest",
+    "diurnal",
+    "flash_crowd",
+    "heavy_tail",
+    "poison",
+    "run_scenario",
 ]

@@ -65,7 +65,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -85,12 +85,35 @@ from ..resilience import CircuitBreaker, RetryPolicy
 from ..resilience import retry as _retry_mod
 from ..resilience.faults import fault_point
 from .batcher import MicroBatcher, Request
-from .metrics import PAGED_COUNTERS, ServingMetrics, SLOT_COUNTERS
+from .metrics import (HANDOFF_COUNTERS, PAGED_COUNTERS, ServingMetrics,
+                      SLOT_COUNTERS)
 from .paging import PagePool
 
-__all__ = ["GenerationEngine"]
+__all__ = ["GenerationEngine", "KVHandoff"]
 
 _gen_counter = [0]
+
+
+class KVHandoff(NamedTuple):
+    """The prefill→decode hand-off payload (disaggregated serving).
+
+    A prefill-role engine resolves a ``submit(..., handoff=True)`` future
+    with one of these instead of a token array: the prompt's KV pages
+    exported as a single host array plus the first generated token (the
+    prefill already computed its logits, so the token rides along for
+    free).  A decode-role engine accepts it via
+    ``submit(prompt, ..., handoff=<KVHandoff>)`` and adopts the pages
+    into its own pool (``PagePool.adopt`` + ``GPTModel.scatter_pages``)
+    — decode resumes at position ``length`` exactly as if it had
+    prefilled locally, so tokens are bit-identical to the co-located
+    path.  ``done`` short-circuits the decode leg entirely (budget of 1,
+    or EOS on the first token)."""
+
+    prompt: np.ndarray    # [length] int32 prompt tokens
+    first_token: int      # greedy token from the prompt's last logit
+    kv: np.ndarray        # [layers, 2, K, heads, page, hd] exported pages
+    length: int           # resident KV covers positions 0..length-1
+    done: bool            # True: no decode needed (budget 1 / EOS)
 
 
 class GenerationEngine:
@@ -105,6 +128,15 @@ class GenerationEngine:
     ``continuous`` — slot-level continuous batching (None reads
     ``FLAGS_continuous_batching``); ``False`` is the legacy
     run-batch-to-completion scheduler.
+
+    ``role`` — prefill/decode disaggregation (paged mode only):
+    ``'prefill'`` engines serve ``submit(..., handoff=True)`` by
+    exporting the prompt's KV pages as a :class:`KVHandoff` (plus the
+    first token) without ever decoding; ``'decode'`` engines adopt such
+    hand-offs and decode from them, so a prefill burst on one replica
+    can never stall another replica's decode steps.  ``'any'`` (default)
+    is the co-located engine — its compile set and behavior are
+    untouched by the seam.
 
     ``paged`` — paged KV cache + speculative decoding (None reads
     ``FLAGS_paged_kv``; requires continuous mode).  ``kv_pages`` sizes
@@ -135,6 +167,8 @@ class GenerationEngine:
         for k in ("paged", "continuous"):
             if config.get(k) is not None:
                 kw[k] = bool(config[k])
+        if config.get("role"):
+            kw["role"] = str(config["role"])
         kw.update(overrides)
         return cls(model, **kw)
 
@@ -149,6 +183,7 @@ class GenerationEngine:
                  kv_pages: Optional[int] = None,
                  kv_page_size: Optional[int] = None,
                  speculative_k: Optional[int] = None,
+                 role: str = "any",
                  name: Optional[str] = None):
         if name is None:
             _gen_counter[0] += 1
@@ -179,6 +214,14 @@ class GenerationEngine:
         self._spec_k = max(int(flag("speculative_k")
                                if speculative_k is None else speculative_k),
                            0)
+        if role not in ("any", "prefill", "decode"):
+            raise InvalidArgumentError(
+                f"role must be 'any', 'prefill' or 'decode', got {role!r}")
+        if role != "any" and not self._paged:
+            raise InvalidArgumentError(
+                f"role={role!r} requires paged KV (the hand-off moves "
+                f"pages, not dense ring regions)")
+        self._role = role
         self._pool: Optional[PagePool] = None
         if self._paged:
             if self._buckets[-1] > self._C:
@@ -188,11 +231,18 @@ class GenerationEngine:
             self._kv_pages = (int(kv_pages) if kv_pages is not None
                               else self._batch * (self._C // self._page))
             self._pool = self._new_pool()  # validates page geometry
+            # hand-off payloads carry whole prompt pages at ONE static
+            # width: enough pages for the largest prompt bucket, padded
+            # with -1 (the write-drop page) — so export/import each stay
+            # a single executable regardless of prompt length
+            self._Gh = -(-self._buckets[-1] // self._page)
         self._warm = False
         self._traces: Dict[str, int] = {"prefill": 0, "decode": 0,
-                                        "admit": 0, "evict": 0, "cow": 0}
+                                        "admit": 0, "evict": 0, "cow": 0,
+                                        "export": 0, "import": 0}
         self.metrics = ServingMetrics(
             name, extra_counters=(SLOT_COUNTERS + PAGED_COUNTERS
+                                  + HANDOFF_COUNTERS
                                   if self._paged else SLOT_COUNTERS))
 
         mdl, traces = model, self._traces
@@ -285,6 +335,19 @@ class GenerationEngine:
             traces["cow"] += 1
             return mdl.gpt.copy_pages(cache, src, dst)
 
+        # hand-off seam (prefill/decode disaggregation): export gathers a
+        # slot's prompt pages into one host-bound array, import scatters
+        # such an array into freshly adopted pages.  Only traced in
+        # warmup when `role` says this engine will actually use them —
+        # a default-role engine's compile set is unchanged.
+        def pexport(cache, idx):
+            traces["export"] += 1
+            return mdl.gpt.gather_pages(cache, idx)
+
+        def pimport(cache, kv, dst):
+            traces["import"] += 1
+            return mdl.gpt.scatter_pages(cache, kv, dst)
+
         self._prefill = jax.jit(prefill)
         self._decode = jax.jit(decode)
         self._admit = jax.jit(admit)
@@ -292,6 +355,8 @@ class GenerationEngine:
         self._padmit = jax.jit(padmit)
         self._step = jax.jit(pstep)
         self._cow = jax.jit(cow)
+        self._export = jax.jit(pexport)
+        self._import = jax.jit(pimport)
         self.breaker = (CircuitBreaker(name) if circuit_breaker else None)
         self._retry_transient = bool(retry_transient)
         if self._continuous:
@@ -349,7 +414,10 @@ class GenerationEngine:
         pays compile latency.  Returns the (closed) compile count:
         ``len(prompt_buckets) + 2`` continuous (or paged without
         speculation), ``len(prompt_buckets) + 3`` paged with speculation
-        (the extra ``[B, 1]`` no-draft fast trace), ``+ 1`` legacy."""
+        (the extra ``[B, 1]`` no-draft fast trace), ``+ 1`` legacy.
+        Role-specialized engines add exactly one more: the page-export
+        trace (``role='prefill'``) or the page-import trace
+        (``role='decode'``); default-role engines trace neither."""
         B = self._batch
         if self._paged:
             # placement discipline as below: ids/positions/pos_map/table
@@ -402,6 +470,17 @@ class GenerationEngine:
                 self._it_wide0, self._it_fast0 = timed["wide"], timed["fast"]
             neg = jnp.asarray(np.full((B,), -1, np.int32))
             self._cow(cache, neg, neg)
+            # role-gated hand-off traces: a prefill replica exports, a
+            # decode replica imports — default-role engines trace NEITHER
+            # (their compile set is byte-for-byte the pre-disaggregation
+            # one).  Inert -1 page indices hit only the write-drop page.
+            idx0 = np.full((self._Gh,), -1, np.int32)
+            if self._role == "prefill":
+                np.asarray(self._export(cache, idx0))
+            elif self._role == "decode":
+                cache = self._import(
+                    cache, np.zeros(self._handoff_shape(),
+                                    self._model.gpt.cfg.dtype), idx0)
         elif self._continuous:
             # warmup must mirror LIVE argument placement, not just shapes:
             # tok/cache enter every live call as jit outputs (committed),
@@ -503,11 +582,21 @@ class GenerationEngine:
         if self.breaker is not None:
             self.breaker.record_success(0)
         if not r.future.done():
-            r.future.set_result(np.asarray(s["out"], np.int32))
+            res = s.get("result")  # hand-off producers resolve a KVHandoff
+            r.future.set_result(res if res is not None
+                                else np.asarray(s["out"], np.int32))
 
     # -- paged scheduler -----------------------------------------------------
     def _new_pool(self) -> PagePool:
         return PagePool(self._batch, self._kv_pages, self._page, self._C)
+
+    def _handoff_shape(self):
+        """Static shape of a :class:`KVHandoff` payload: whole pages for
+        the largest prompt bucket, every layer's k and v stacked into one
+        array so the hand-off is a single host transfer each way."""
+        cfg = self._model.gpt.cfg
+        hd = cfg.hidden_size // cfg.num_heads
+        return (cfg.num_layers, 2, self._Gh, cfg.num_heads, self._page, hd)
 
     def _init_pool(self):
         """Fresh empty page pool for the paged decode loop, pushed through
@@ -562,11 +651,13 @@ class GenerationEngine:
 
     @staticmethod
     def _unpack_paged(r: Request):
-        """Paged-mode request meta: ``(budget, prefix_key, prefix_len)``
-        (see :meth:`submit`)."""
-        budget, key, plen = r.meta
+        """Paged-mode request meta: ``(budget, prefix_key, prefix_len,
+        handoff)`` (see :meth:`submit`) — ``handoff`` is ``None`` for a
+        plain request, ``True`` to produce a :class:`KVHandoff`, or a
+        :class:`KVHandoff` instance to adopt."""
+        budget, key, plen, hand = r.meta
         prompt = np.asarray(r.inputs[0], np.int32).reshape(-1)
-        return prompt, key, min(int(plen), len(prompt)), int(budget)
+        return prompt, key, min(int(plen), len(prompt)), int(budget), hand
 
     def _paged_loop(self):
         """The persistent paged decode loop — sole owner of the device
@@ -688,33 +779,90 @@ class GenerationEngine:
                             q.sweep()
                         budget_pages = pool.free_pages
                         for ci, (r, nre) in enumerate(cand):
-                            prompt, key, _, _ = self._unpack_paged(r)
-                            need = pool.pages_needed(prompt, key)
+                            prompt, key, _, _, hand = self._unpack_paged(r)
+                            if isinstance(hand, KVHandoff):
+                                # adoption maps fresh private pages only
+                                need = -(-hand.length // page)
+                            else:
+                                need = pool.pages_needed(prompt, key)
                             if need > budget_pages and ci == 0 and not live:
                                 # nothing left to preempt: reclaim every
                                 # registered prefix before giving up
                                 pool.drop_all_prefixes()
                                 budget_pages = pool.free_pages
-                                need = pool.pages_needed(prompt, key)
+                                if not isinstance(hand, KVHandoff):
+                                    need = pool.pages_needed(prompt, key)
                             if need > budget_pages:
                                 # head-of-line blocks: keep FCFS order
                                 carry = cand[ci:] + carry
                                 break
                             take.append((r, nre))
                             budget_pages -= need
+                    n_adopted = 0
                     if take:
                         if cache is None:
                             cache = self._init_pool()
                         now = time.monotonic()
-                        Sb = self._buckets[max(r.bucket for r, _ in take)]
+                        # hand-off adoptions first: no prefill compute at
+                        # all — map fresh pages, scatter the exported KV
+                        # in, seed the slot with the donor's first token;
+                        # decode resumes at position `length` exactly as
+                        # if this engine had prefilled the prompt itself
+                        pre: List[tuple] = []
+                        n_adevicted = 0
+                        for (r, nre), i in zip(take, free):
+                            prompt, _, _, budget, hand = \
+                                self._unpack_paged(r)
+                            if not isinstance(hand, KVHandoff):
+                                pre.append(((r, nre), i))
+                                continue
+                            pool.adopt(i, hand.length)
+                            npg = -(-hand.length // page)
+                            dst = np.full((self._Gh,), -1, np.int32)
+                            dst[:npg] = pool.table[i, :npg]
+                            with profiler.RecordEvent(
+                                    f"{self.name}/adopt"):
+                                cache = self._import(
+                                    cache, np.asarray(hand.kv), dst)
+                            t = int(hand.first_token)
+                            slots[i] = {"req": r, "budget": budget,
+                                        "out": [t], "t0": now,
+                                        "restarts": nre,
+                                        "hist": [int(x) for x in prompt]
+                                        + [t]}
+                            pos[i] = hand.length
+                            n_adopted += 1
+                            self.metrics.incr("handoffs_in")
+                            tr = _tracing._active
+                            if tr is not None and r.trace is not None:
+                                tr.record(
+                                    "slot/admit", r.trace, now,
+                                    (time.monotonic() - now) * 1e3,
+                                    kind="adopt",
+                                    args={"engine": self.name, "slot": i})
+                            if (hand.done or budget <= 1
+                                    or (eos is not None and t == eos)):
+                                pool.release(i)
+                                self._finish(slots[i], time.monotonic())
+                                slots[i] = None
+                                pos[i] = -1
+                                n_adevicted += 1
+                        if n_adopted:
+                            self.metrics.incr("admitted", n_adopted)
+                        if n_adevicted:
+                            self.metrics.incr("evicted", n_adevicted)
+                    if take and pre:
+                        Sb = self._buckets[max(r.bucket
+                                               for (r, _), _ in pre)]
                         ids = np.zeros((B, Sb), np.int32)
                         pp = np.full((B, Sb), -1, np.int32)
                         lens = np.ones((B,), np.int32)
                         cow_pairs: List[tuple] = []
                         to_register: List[tuple] = []
                         admitted: List[tuple] = []
-                        for (r, nre), i in zip(take, free):
-                            prompt, key, plen, budget = self._unpack_paged(r)
+                        for (r, nre), i in pre:
+                            prompt, key, plen, budget, hand = \
+                                self._unpack_paged(r)
                             pairs, shared = pool.admit(i, prompt, key)
                             cow_pairs += [(s_, d_, i) for s_, d_ in pairs]
                             L = len(prompt)
@@ -725,6 +873,7 @@ class GenerationEngine:
                             slots[i] = {"req": r, "budget": budget,
                                         "out": [], "t0": now,
                                         "restarts": nre,
+                                        "handoff": hand is True,
                                         "hist": [int(t) for t in prompt]}
                             admitted.append((r, i))
                             if key is not None and plen > 0:
@@ -767,6 +916,34 @@ class GenerationEngine:
                         for _, i in admitted:
                             s = slots[i]
                             t = int(host_first[i])
+                            if s.get("handoff"):
+                                # produce: export the prompt's pages while
+                                # they are still mapped and resolve with
+                                # the KVHandoff (the first token rides
+                                # along) — prefill replicas never decode,
+                                # so the slot turns over immediately
+                                L = len(s["hist"])
+                                npg = -(-L // page)
+                                idx = np.full((self._Gh,), -1, np.int32)
+                                idx[:npg] = pool.table[i, :npg]
+                                with profiler.RecordEvent(
+                                        f"{self.name}/export"):
+                                    kvh = np.asarray(
+                                        self._export(cache, idx))
+                                s["out"].append(t)
+                                s["result"] = KVHandoff(
+                                    np.asarray(s["hist"][:L], np.int32),
+                                    t, kvh, L,
+                                    bool(s["budget"] <= 1
+                                         or (eos is not None
+                                             and t == eos)))
+                                self.metrics.incr("handoffs_out")
+                                pool.release(i)
+                                self._finish(s, now)
+                                slots[i] = None
+                                pos[i] = -1
+                                n_evicted += 1
+                                continue
                             s["out"].append(t)
                             s["hist"].append(t)
                             if (len(s["out"]) >= s["budget"]
@@ -780,6 +957,7 @@ class GenerationEngine:
                         self.metrics.incr("batches")
                         if n_evicted:
                             self.metrics.incr("evicted", n_evicted)
+                    if take:
                         live = [i for i in range(B) if slots[i] is not None]
                     elif (free and not closing
                           and (carry or q.queue_depth > 0)):
@@ -1330,7 +1508,7 @@ class GenerationEngine:
     def submit(self, prompt_ids, max_new_tokens: int = 32,
                deadline_ms: Optional[float] = None,
                trace_ctx=None, prefix_key: Optional[str] = None,
-               prefix_len: int = 0) -> Future:
+               prefix_len: int = 0, handoff=None) -> Future:
         """Async generation; resolves to the ``[<=max_new_tokens]`` int32
         array of greedily decoded tokens (stops after ``eos_token_id``).
         ``trace_ctx`` optionally parents the queue/slot spans under a
@@ -1342,11 +1520,45 @@ class GenerationEngine:
         registers its pages; later requests with the same key (and the
         same leading tokens — verified, divergence falls back to a cold
         admission) map those pages read-only, copy-on-write.  Ignored by
-        the dense paths."""
+        the dense paths.
+
+        ``handoff`` is the prefill/decode disaggregation seam (also
+        paged-only).  ``handoff=True`` on a ``role='prefill'`` engine
+        resolves the future with a :class:`KVHandoff` — the prompt's KV
+        pages plus the first token — instead of decoding.  Passing that
+        :class:`KVHandoff` (with the same ``prompt_ids``) to a
+        ``role='decode'`` engine adopts the pages and decodes the
+        remaining ``max_new_tokens - 1`` tokens, bit-identical to the
+        co-located path.  Plain submits (``handoff=None``) work on every
+        role — that is what router health probes send."""
         if max_new_tokens < 1:
             raise InvalidArgumentError("max_new_tokens must be >= 1")
+        if handoff is not None:
+            if not self._paged:
+                raise InvalidArgumentError(
+                    f"{self.name}: handoff requires paged KV")
+            if handoff is True:
+                if self._role != "prefill":
+                    raise InvalidArgumentError(
+                        f"{self.name}: handoff=True (produce) requires "
+                        f"role='prefill', this engine is "
+                        f"role={self._role!r}")
+            elif isinstance(handoff, KVHandoff):
+                if self._role != "decode":
+                    raise InvalidArgumentError(
+                        f"{self.name}: adopting a KVHandoff requires "
+                        f"role='decode', this engine is "
+                        f"role={self._role!r}")
+                if int(handoff.length) > self._C:
+                    raise InvalidArgumentError(
+                        f"{self.name}: handoff length {handoff.length} "
+                        f"exceeds cache_len ({self._C})")
+            else:
+                raise InvalidArgumentError(
+                    f"handoff must be None, True, or a KVHandoff, got "
+                    f"{type(handoff).__name__}")
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
-        meta = ((int(max_new_tokens), prefix_key, int(prefix_len))
+        meta = ((int(max_new_tokens), prefix_key, int(prefix_len), handoff)
                 if self._paged else int(max_new_tokens))
         return self._batcher.submit((prompt,), deadline_ms=deadline_ms,
                                     meta=meta, trace_ctx=trace_ctx)
@@ -1371,6 +1583,7 @@ class GenerationEngine:
         snap["buckets"] = len(self._buckets)
         snap["continuous"] = self._continuous
         snap["paged"] = self._paged
+        snap["role"] = self._role
         if self._paged and self._pool is not None:
             snap.update(self._pool.stats())
         return snap

@@ -52,6 +52,11 @@ SLOT_COUNTERS = ("admitted", "evicted", "decode_steps", "restarts",
 PAGED_COUNTERS = ("cow_copies", "spec_drafted", "spec_accepted",
                   "preempted")
 
+#: prefill/decode disaggregation counters (paged KV mode): hand-offs a
+#: prefill-role engine exported (``handoffs_out``) and a decode-role
+#: engine adopted (``handoffs_in``)
+HANDOFF_COUNTERS = ("handoffs_out", "handoffs_in")
+
 
 def _quantile(sorted_vals, q: float) -> float:
     """Nearest-rank quantile with the CEIL rank convention: the q-th

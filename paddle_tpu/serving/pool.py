@@ -1,0 +1,423 @@
+"""Replica lifecycle actuator + prefill/decode disaggregation front-end.
+
+:class:`ReplicaPool` closes the autoscaling loop.  ``SloEngine`` emits
+``ScaleSignal`` verdicts, ``Router.on_scale_signal`` fans them out to
+registered hooks — and until now nothing *acted*.  The pool is that
+actuator: it owns replica lifecycle end to end.
+
+Scale-up happens OFF the serving path: the pool builds a fresh engine
+from its factory, runs AOT ``warmup()`` (closing the engine's compile
+set — zero post-warmup XLA compiles is the serving invariant), and only
+then hands it to :meth:`Router.add_replica`, which routes the newcomer
+through the existing half-open probe/admit path.  The router never
+balances onto a replica that has not been warmed and probed.  Scale-down
+retires the youngest pool-owned replica through the router's graceful
+drain — a drain timeout *aborts* the removal (capacity hole beats lost
+in-flight work).
+
+The pool is deliberately skeptical of its input:
+
+* **Hysteresis** — ``up_consecutive`` / ``down_consecutive`` streaks of
+  same-direction signals are required before acting (scale-down defaults
+  to the slower trigger).
+* **Cooldown** — at most one action per ``cooldown_s`` window, so a
+  burn-rate oscillating around its threshold cannot flap the fleet.
+* **Bounds** — ``min_replicas`` / ``max_replicas`` are hard walls.
+* **Ordering** — signals carry ``ScaleSignal.seq``; anything not newer
+  than the last accepted sequence is discarded as stale (an async
+  actuator plus a fan-out bus can reorder deliveries).
+
+Every decision — acted on or deferred — is counted and published as a
+``("pool", <name>)`` snapshot on ``framework.trace_events``.  A
+*thrash event* (an executed action opposite to the previous one inside
+``thrash_window_s``) after warmup is the signal analysis rule **S605**
+fires on: the loop is fighting itself and the dials need damping.
+
+:class:`DisaggServer` is the request-path half of disaggregation: it
+fronts a prefill-role target and a decode-role target (engines or
+routers of engines), submits each request to prefill with
+``handoff=True``, then pipes the resulting :class:`KVHandoff` into the
+decode target.  Prefill bursts queue on prefill replicas; decode slots
+only ever run single-token steps — a flash crowd of long prompts cannot
+inflate decode p99.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..framework import trace_events
+from ..framework.errors import InvalidArgumentError
+from ..resilience import retry as _retry_mod
+from .metrics import ServingMetrics
+
+__all__ = ["ReplicaPool", "DisaggServer"]
+
+_pool_counter = [0]
+_disagg_counter = [0]
+
+#: every pool snapshot carries these counters (zero-initialized so the
+#: analysis rules never see a key flicker in)
+_POOL_COUNTERS = (
+    "signals", "stale_signals", "scale_ups", "scale_downs",
+    "deferred_streak", "deferred_cooldown", "deferred_bounds",
+    "deferred_inflight", "drain_aborts", "action_errors",
+    "warmup_compiles", "thrash_events", "thrash_events_after_warm",
+)
+
+
+class ReplicaPool:
+    """Consume ``ScaleSignal``s and actuate fleet size on a router.
+
+    ``engine_factory`` is a zero-arg callable returning a fresh,
+    un-warmed engine; the pool warms it before the router sees it and
+    closes it after retirement (it only ever closes engines it created).
+    ``async_actions=False`` executes actions inline on the signal
+    delivery thread — deterministic, for tests and the scenario harness.
+    ``clock`` only drives the hysteresis/cooldown arithmetic (inject a
+    scenario clock); :attr:`action_spans` always records real
+    ``time.monotonic`` so XLA compile events can be attributed to pool
+    actions.
+    """
+
+    def __init__(self, router, engine_factory: Callable[[], object], *,
+                 min_replicas: int = 1, max_replicas: int = 4,
+                 cooldown_s: float = 30.0, up_consecutive: int = 1,
+                 down_consecutive: int = 2,
+                 thrash_window_s: Optional[float] = None,
+                 drain_timeout_s: Optional[float] = 30.0,
+                 warmup: bool = True, async_actions: bool = True,
+                 register: bool = True, name: Optional[str] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if min_replicas < 1 or max_replicas < min_replicas:
+            raise InvalidArgumentError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{min_replicas}..{max_replicas}")
+        if up_consecutive < 1 or down_consecutive < 1:
+            raise InvalidArgumentError("consecutive thresholds must be >= 1")
+        if name is None:
+            _pool_counter[0] += 1
+            name = f"pool#{_pool_counter[0]}"
+        self.name = name
+        self.router = router
+        self._factory = engine_factory
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self._cooldown_s = float(cooldown_s)
+        self._up_consecutive = int(up_consecutive)
+        self._down_consecutive = int(down_consecutive)
+        self._thrash_window_s = (2.0 * float(cooldown_s)
+                                 if thrash_window_s is None
+                                 else float(thrash_window_s))
+        self._drain_timeout_s = drain_timeout_s
+        self._warmup = bool(warmup)
+        self._async = bool(async_actions)
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._counts: Dict[str, int] = {k: 0 for k in _POOL_COUNTERS}
+        self._last_seq = -1
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_action_t: Optional[float] = None
+        self._last_action_dir: Optional[str] = None
+        self._actions_inflight = 0
+        self._owned: Dict[int, object] = {}  # replica index -> engine
+        self._closing = False
+        #: real-clock (t0, t1) of every executed action, for attributing
+        #: XLA compile events to off-path warmups in the scenario harness
+        self.action_spans: List[Tuple[float, float]] = []
+        if register:
+            router.register_scale_hook(self.on_scale_signal)
+
+    # -- signal intake -------------------------------------------------------
+    def on_scale_signal(self, signal) -> None:
+        """One ``ScaleSignal`` in; at most one fleet action out.  Safe to
+        register directly on ``Router.register_scale_hook`` (exceptions
+        there are counted, not raised — but this method aims to never
+        raise: action failures land in ``action_errors``)."""
+        direction = self._decide(signal)
+        if direction is None:
+            return
+        if self._async:
+            threading.Thread(target=self._execute, args=(direction,),
+                             name=f"{self.name}-{direction}",
+                             daemon=True).start()
+        else:
+            self._execute(direction)
+
+    def _decide(self, signal) -> Optional[str]:
+        """Hysteresis / ordering / cooldown / bounds gauntlet.  Returns
+        the action to execute (``up``/``down``) or None, with
+        ``_actions_inflight`` already bumped for a returned action."""
+        with self._lock:
+            if self._closing:
+                return None
+            self._counts["signals"] += 1
+            seq = int(getattr(signal, "seq", -1))
+            if seq >= 0:
+                if seq <= self._last_seq:
+                    self._counts["stale_signals"] += 1
+                    self._publish()
+                    return None
+                self._last_seq = seq
+            direction = getattr(signal, "direction", "steady")
+            if direction == "up":
+                self._up_streak += 1
+                self._down_streak = 0
+            elif direction == "down":
+                self._down_streak += 1
+                self._up_streak = 0
+            else:
+                self._up_streak = self._down_streak = 0
+                return None  # steady: nothing to consider
+            streak, need = ((self._up_streak, self._up_consecutive)
+                            if direction == "up" else
+                            (self._down_streak, self._down_consecutive))
+            now = self._clock()
+            if streak < need:
+                self._counts["deferred_streak"] += 1
+                self._publish()
+                return None
+            if self._actions_inflight:
+                self._counts["deferred_inflight"] += 1
+                self._publish()
+                return None
+            if (self._last_action_t is not None
+                    and now - self._last_action_t < self._cooldown_s):
+                self._counts["deferred_cooldown"] += 1
+                self._publish()
+                return None
+            n = len(self.router.replicas)
+            if ((direction == "up" and n >= self.max_replicas)
+                    or (direction == "down" and n <= self.min_replicas)):
+                self._counts["deferred_bounds"] += 1
+                self._publish()
+                return None
+            # committed: this signal becomes an action
+            if (self._last_action_dir is not None
+                    and self._last_action_dir != direction
+                    and self._last_action_t is not None
+                    and now - self._last_action_t <= self._thrash_window_s):
+                self._counts["thrash_events"] += 1
+                if _retry_mod.is_warm():
+                    self._counts["thrash_events_after_warm"] += 1
+            self._last_action_t = now
+            self._last_action_dir = direction
+            self._up_streak = self._down_streak = 0
+            self._actions_inflight += 1
+            return direction
+
+    # -- actuation -----------------------------------------------------------
+    def _execute(self, direction: str) -> None:
+        t0 = time.monotonic()
+        try:
+            if direction == "up":
+                self._scale_up()
+            else:
+                self._scale_down()
+        except Exception:  # noqa: BLE001 — a failed action must not kill
+            with self._lock:  # the delivery thread; it is counted and
+                self._counts["action_errors"] += 1  # visible in stats
+        finally:
+            with self._lock:
+                self._actions_inflight -= 1
+                self.action_spans.append((t0, time.monotonic()))
+            self._publish()
+
+    def _scale_up(self) -> None:
+        """Cold-start one replica OFF the serving path: factory → AOT
+        warmup → half-open admission via ``Router.add_replica``."""
+        engine = self._factory()
+        try:
+            if self._warmup and hasattr(engine, "warmup"):
+                compiles = int(engine.warmup() or 0)
+                with self._lock:
+                    self._counts["warmup_compiles"] += compiles
+            idx = self.router.add_replica(engine)
+        except BaseException:
+            close = getattr(engine, "close", None)
+            if close is not None:
+                try:
+                    close(drain=False)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+        with self._lock:
+            self._owned[idx] = engine
+            self._counts["scale_ups"] += 1
+
+    def _scale_down(self) -> None:
+        """Retire the youngest pool-owned replica (never a seed replica
+        while an owned one exists) through the router's graceful drain.
+        A drain timeout aborts the removal — counted, replica restored."""
+        with self._lock:
+            owned = sorted(self._owned)
+        live = {r.index for r in self.router.replicas}
+        victims = [i for i in owned if i in live]
+        victim = victims[-1] if victims else (max(live) if live else None)
+        if victim is None:
+            raise InvalidArgumentError(f"{self.name}: no replica to retire")
+        ok = self.router.remove_replica(victim, drain=True,
+                                        timeout=self._drain_timeout_s)
+        if not ok:
+            with self._lock:
+                self._counts["drain_aborts"] += 1
+            return
+        with self._lock:
+            engine = self._owned.pop(victim, None)
+            self._counts["scale_downs"] += 1
+        if engine is not None:
+            close = getattr(engine, "close", None)
+            if close is not None:
+                close(drain=False)
+
+    # -- reporting -----------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            snap = dict(self._counts)
+            snap["actions_inflight"] = self._actions_inflight
+            snap["owned_replicas"] = len(self._owned)
+            snap["last_seq"] = self._last_seq
+        snap["replicas"] = len(self.router.replicas)
+        snap["min_replicas"] = self.min_replicas
+        snap["max_replicas"] = self.max_replicas
+        return snap
+
+    def _publish(self) -> None:
+        if trace_events.active():
+            trace_events.notify(("pool", self.name), self.stats())
+
+    def close(self) -> None:
+        """Stop acting on signals (the hook stays registered but becomes
+        a no-op).  Does not resize the fleet on the way out."""
+        with self._lock:
+            self._closing = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class DisaggServer:
+    """Prefill/decode-disaggregated front-end over two serving targets.
+
+    ``prefill`` and ``decode`` are anything with the engine ``submit``
+    contract — a ``role='prefill'`` / ``role='decode'``
+    :class:`GenerationEngine`, or a ``Router`` over a fleet of them.
+    Each request runs prefill with ``handoff=True``; the resulting
+    :class:`KVHandoff` (prompt KV pages + first token) is piped into the
+    decode target, which adopts the pages and decodes the rest.  Results
+    are bit-identical to a co-located engine.  A hand-off already
+    ``done`` (single-token budget, or the first token was EOS) resolves
+    immediately without touching decode (``handoff_short_circuits``).
+    """
+
+    def __init__(self, prefill, decode, *, name: Optional[str] = None):
+        if name is None:
+            _disagg_counter[0] += 1
+            name = f"disagg#{_disagg_counter[0]}"
+        self.name = name
+        self.prefill = prefill
+        self.decode = decode
+        self.metrics = ServingMetrics(
+            name, extra_counters=("handoffs", "handoff_short_circuits",
+                                  "handoff_errors"))
+
+    def submit(self, prompt_ids, max_new_tokens: int = 32,
+               deadline_ms: Optional[float] = None, **kw) -> Future:
+        """Async two-stage generation; resolves to the same int32 token
+        array a co-located engine would return.  ``deadline_ms`` spans
+        both stages — decode gets whatever prefill left of it.
+        Admission errors (oversize prompts, closed engines) propagate
+        from here synchronously, exactly like a single engine."""
+        outer: Future = Future()
+        t0 = time.monotonic()
+        self.metrics.incr("requests")
+        f1 = self.prefill.submit(prompt_ids, max_new_tokens=max_new_tokens,
+                                 deadline_ms=deadline_ms, handoff=True,
+                                 **kw)
+
+        def _stage2(fut: Future) -> None:
+            try:
+                hand = fut.result()
+            except BaseException as exc:  # noqa: BLE001
+                self.metrics.incr("errors")
+                outer.set_exception(exc)
+                return
+            try:
+                self.metrics.incr("handoffs")
+                if hand.done:
+                    self.metrics.incr("handoff_short_circuits")
+                    self._finish(outer, np.asarray([hand.first_token],
+                                                   np.int32), t0)
+                    return
+                remaining = None
+                if deadline_ms is not None:
+                    spent = (time.monotonic() - t0) * 1e3
+                    remaining = max(float(deadline_ms) - spent, 1.0)
+                f2 = self.decode.submit(hand.prompt,
+                                        max_new_tokens=max_new_tokens,
+                                        deadline_ms=remaining,
+                                        handoff=hand, **kw)
+                f2.add_done_callback(_stage3)
+            except BaseException as exc:  # noqa: BLE001 — always resolve
+                self.metrics.incr("handoff_errors")
+                outer.set_exception(exc)
+
+        def _stage3(fut: Future) -> None:
+            try:
+                self._finish(outer, fut.result(), t0)
+            except BaseException as exc:  # noqa: BLE001
+                self.metrics.incr("errors")
+                outer.set_exception(exc)
+
+        f1.add_done_callback(_stage2)
+        return outer
+
+    def _finish(self, outer: Future, tokens: np.ndarray, t0: float) -> None:
+        self.metrics.incr("completed")
+        self.metrics.observe_latency_ms((time.monotonic() - t0) * 1e3)
+        outer.set_result(tokens)
+        self.metrics.publish()
+
+    def generate(self, prompt_ids, max_new_tokens: int = 32,
+                 timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking :meth:`submit`."""
+        return self.submit(prompt_ids,
+                           max_new_tokens=max_new_tokens).result(timeout)
+
+    def warmup(self) -> int:
+        total = 0
+        for tgt in (self.prefill, self.decode):
+            if hasattr(tgt, "warmup"):
+                total += int(tgt.warmup() or 0)
+        return total
+
+    def stats(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["prefill"] = self.prefill.stats()
+        snap["decode"] = self.decode.stats()
+        return snap
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        for tgt in (self.prefill, self.decode):
+            close = getattr(tgt, "close", None)
+            if close is None:
+                continue
+            try:
+                close(drain=drain, timeout=timeout)
+            except TypeError:
+                close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

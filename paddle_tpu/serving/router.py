@@ -47,7 +47,7 @@ import time
 import weakref
 from concurrent.futures import Future, InvalidStateError
 from random import Random
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 from ..framework import trace_events
 from ..framework.errors import (
@@ -75,7 +75,8 @@ _ROUTER_COUNTERS = (
     "hedge_denied_after_warm", "replica_flaps", "replica_flaps_after_warm",
     "probes", "probe_failures", "readmissions", "drains", "drain_timeouts",
     "weight_swaps", "scale_up_signals", "scale_down_signals",
-    "scale_steady_signals",
+    "scale_steady_signals", "scale_hook_errors",
+    "replicas_added", "replicas_removed",
 )
 
 #: live routers, for the profiler "Serving router" summary section
@@ -152,6 +153,12 @@ class Router:
         self._failover = bool(failover)
         self._replicas: List[Replica] = [
             Replica(e, i, name) for i, e in enumerate(engines)]
+        # membership is dynamic (add_replica/remove_replica): indices are
+        # STABLE identities, never recycled — the circuit breaker, the
+        # balancing exclusion sets and in-flight callbacks all key on them
+        self._by_index: Dict[int, Replica] = {
+            r.index: r for r in self._replicas}
+        self._next_index = len(engines)
         self._lock = threading.Lock()
         self._rng = Random(int(seed))
         self._clock = clock
@@ -200,16 +207,16 @@ class Router:
         return list(self._replicas)
 
     def replica(self, index: int) -> Replica:
-        return self._replicas[index]
+        return self._by_index[index]
 
     def healthy_count(self) -> int:
-        return sum(1 for r in self._replicas if r.state == HEALTHY)
+        return sum(1 for r in list(self._replicas) if r.state == HEALTHY)
 
     def stats(self) -> dict:
         snap = self.metrics.snapshot()
         snap.update(self._router_extra())
         snap["replicas_detail"] = {r.name: r.snapshot()
-                                   for r in self._replicas}
+                                   for r in list(self._replicas)}
         return snap
 
     def _router_extra(self) -> dict:
@@ -222,7 +229,8 @@ class Router:
             self.metrics.publish(self._router_extra())
 
     def _state_summary(self) -> str:
-        return ", ".join(f"{r.name}={r.state}" for r in self._replicas)
+        return ", ".join(f"{r.name}={r.state}"
+                         for r in list(self._replicas))
 
     # -- balancing -----------------------------------------------------------
     def _pick(self, excluded) -> Optional[int]:
@@ -277,7 +285,10 @@ class Router:
                     raise exc
                 self._fail(fl, exc)
                 return False
-            rep = self._replicas[idx]
+            with self._lock:
+                rep = self._by_index.get(idx)
+            if rep is None:
+                continue  # removed between pick and dispatch: repick
             fl.attempted.add(idx)
             remaining = None
             if fl.deadline_t is not None:
@@ -440,7 +451,7 @@ class Router:
             self._probe_sweep()
 
     def _probe_sweep(self) -> None:
-        for rep in self._replicas:
+        for rep in list(self._replicas):
             if self._closing:
                 return
             st = rep.state
@@ -566,12 +577,17 @@ class Router:
         key = {"up": "scale_up_signals", "down": "scale_down_signals"}.get(
             getattr(signal, "direction", "steady"), "scale_steady_signals")
         self.metrics.incr(key)
+        errs = 0
         for fn in list(self._scale_hooks):
             try:
                 fn(signal)
             except Exception:  # noqa: BLE001 — a broken hook must not
-                pass           # break signal delivery
-        if key != "scale_steady_signals":
+                errs += 1      # break delivery to the other hooks, but a
+                #                dead autoscaler has to be VISIBLE:
+                #                scale_hook_errors rides router_stats()
+        if errs:
+            self.metrics.incr("scale_hook_errors", errs)
+        if key != "scale_steady_signals" or errs:
             self._publish()
 
     def warmup(self) -> int:
@@ -582,19 +598,97 @@ class Router:
         # with warmup tracing (possibly over a shared model) leaks tracers
         total = 0
         with self._probe_gate:
-            for rep in self._replicas:
+            for rep in list(self._replicas):
                 if hasattr(rep.engine, "warmup"):
                     total += int(rep.engine.warmup() or 0)
         if self._probe_ok:
             self.probe_now()
         return total
 
+    # -- fleet membership (the ReplicaPool actuator's primitives) ------------
+    def add_replica(self, engine, *, probe: bool = True) -> int:
+        """Grow the fleet by one engine, entering through the half-open
+        probe/admit path: the replica joins in DRAINED state (invisible
+        to balancing), then :meth:`admit` probes it and flips it HEALTHY
+        — live traffic never sees a replica that has not answered a
+        probe.  The caller is responsible for warming the engine OFF the
+        serving path first (``ReplicaPool`` does AOT warmup before
+        calling this).  Returns the new replica's stable index; raises
+        ``UnavailableError`` (and backs the replica out) when the
+        admission probe fails."""
+        if self._closing:
+            raise UnavailableError(f"{self.name}: router closed")
+        if engine is None:
+            raise InvalidArgumentError("add_replica needs an engine")
+        probe_able = (self._probe_fn is not self._default_probe
+                      or (hasattr(engine, "synthetic_inputs")
+                          and (hasattr(engine, "infer")
+                               or hasattr(engine, "generate"))))
+        if self._probe_interval_s is not None and not probe_able:
+            raise InvalidArgumentError(
+                f"{self.name}: active probing is on — a new replica needs "
+                f"synthetic_inputs() + infer()/generate()")
+        with self._lock:
+            idx = self._next_index
+            self._next_index += 1
+            rep = Replica(engine, idx, self.name)
+            rep.set_state(DRAINED)  # joins via admit(), not directly
+            self._replicas.append(rep)
+            self._by_index[idx] = rep
+        self.metrics.incr("replicas_added")
+        if not self.admit(idx, probe=probe and self._probe_ok):
+            with self._lock:
+                self._by_index.pop(idx, None)
+                try:
+                    self._replicas.remove(rep)
+                except ValueError:  # pragma: no cover - concurrent remove
+                    pass
+            self._publish()
+            raise UnavailableError(
+                f"{self.name}: new replica {rep.name} failed its "
+                f"admission probe and was backed out")
+        return idx
+
+    def remove_replica(self, index: int, *, drain: bool = True,
+                       timeout: Optional[float] = None,
+                       close_engine: bool = False) -> bool:
+        """Retire replica ``index`` through the graceful-drain machinery:
+        stop admissions, wait out its in-flight requests, then drop it
+        from the fleet (its circuit-breaker key resets; the index is
+        never recycled).  On drain timeout the replica is restored to
+        HEALTHY and the method returns False — a capacity hole beats
+        lost in-flight work.  ``close_engine=True`` also closes the
+        engine after removal (the pool closes engines it owns)."""
+        rep = self._by_index[index]
+        if drain and rep.state != DRAINED:
+            if not self.drain(index, timeout=timeout):
+                rep.set_state(HEALTHY)
+                self._publish()
+                return False
+        with self._lock:
+            self._by_index.pop(index, None)
+            try:
+                self._replicas.remove(rep)
+            except ValueError:  # pragma: no cover - concurrent remove
+                pass
+        self.breaker.reset(index)
+        self.metrics.incr("replicas_removed")
+        if close_engine:
+            close = getattr(rep.engine, "close", None)
+            if close is not None:
+                try:
+                    close(drain=drain, timeout=timeout)
+                except TypeError:
+                    close()
+        self._publish()
+        return True
+
     # -- drain / rolling swap ------------------------------------------------
     def drain(self, index: int, timeout: Optional[float] = None) -> bool:
         """Stop admissions to replica ``index`` and wait out its
         in-flight requests.  Returns False on timeout (state stays
         DRAINING; the replica keeps finishing its backlog)."""
-        rep = self._replicas[index]
+        rep = self._by_index[index]
         rep.set_state(DRAINING)
         self.metrics.incr("drains")
         ok = rep.wait_idle(timeout)
@@ -609,7 +703,7 @@ class Router:
         """Re-admit a drained/unhealthy replica: optional synthetic
         probe, then a fresh circuit window and HEALTHY state.  Returns
         False (replica stays out) when the probe fails."""
-        rep = self._replicas[index]
+        rep = self._by_index[index]
         if probe and self._probe_ok and not self._run_probe(rep):
             return False
         self.breaker.reset(rep.index)
@@ -621,13 +715,14 @@ class Router:
     def drain_all(self, timeout: Optional[float] = None) -> bool:
         """Stop admissions everywhere, then wait out every replica's
         in-flight requests (the SIGTERM path)."""
-        for rep in self._replicas:
+        reps = list(self._replicas)
+        for rep in reps:
             rep.set_state(DRAINING)
-        self.metrics.incr("drains", len(self._replicas))
+        self.metrics.incr("drains", len(reps))
         deadline = (time.monotonic() + timeout
                     if timeout is not None else None)
         ok = True
-        for rep in self._replicas:
+        for rep in reps:
             remaining = None
             if deadline is not None:
                 remaining = max(deadline - time.monotonic(), 0.0)
@@ -657,7 +752,7 @@ class Router:
             def swap_fn(engine):
                 engine.swap_weights(params_file)
         swapped = 0
-        for rep in self._replicas:
+        for rep in list(self._replicas):
             if not self.drain(rep.index, timeout=drain_timeout):
                 # abort: an un-swapped replica serving old weights beats
                 # a hole in capacity
@@ -705,7 +800,7 @@ class Router:
         if drain:
             self.drain_all(timeout)
         if self._close_engines:
-            for rep in self._replicas:
+            for rep in list(self._replicas):
                 close = getattr(rep.engine, "close", None)
                 if close is None:
                     continue

@@ -1,0 +1,284 @@
+"""Deterministic open-loop traffic scenarios for serving chaos drills.
+
+A :class:`Scenario` is a fully materialized, seeded request schedule —
+arrival time, prompt length, token budget, poison flag per request —
+built once by a generator (:func:`diurnal`, :func:`flash_crowd`,
+:func:`heavy_tail`, :func:`poison`) and replayable bit-for-bit.  The
+runner (:func:`run_scenario`) plays it **open-loop**: arrivals follow
+the schedule regardless of how the system is coping, exactly the
+condition an autoscaler must survive (closed-loop load generators
+accidentally backpressure themselves and hide capacity collapse —
+Kingman's law only bites when the arrival process doesn't care).
+
+The same scenario driven at the same ``time_scale`` submits the exact
+same prompts in the exact same order, so two fleets (say, a co-located
+baseline and a prefill/decode-disaggregated one) can be compared
+request-for-request, including token-level output identity.
+
+``tools/scenario_smoke.py`` wires these into the full serving stack —
+router + ``SloEngine`` + ``ReplicaPool`` — and gates on the loop's
+invariants: zero accepted requests lost, bounded scale actions, closed
+post-warmup compile sets.
+"""
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutureTimeout
+from typing import Callable, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["Scenario", "ScenarioRequest", "diurnal", "flash_crowd",
+           "heavy_tail", "poison", "run_scenario"]
+
+
+class ScenarioRequest(NamedTuple):
+    """One scheduled arrival.  ``t`` is scenario time in seconds from
+    scenario start; ``poison=True`` marks a request *built to be
+    rejected* (oversize prompt) — the harness asserts it never gets
+    accepted."""
+
+    t: float
+    prompt_len: int
+    max_new_tokens: int
+    poison: bool = False
+
+
+class Scenario(NamedTuple):
+    """A named, seeded, time-sorted request schedule."""
+
+    name: str
+    duration_s: float
+    events: Tuple[ScenarioRequest, ...]
+    seed: int
+
+
+def _finalize(name: str, duration_s: float, events: List[ScenarioRequest],
+              seed: int) -> Scenario:
+    events.sort(key=lambda e: e.t)
+    return Scenario(name, float(duration_s), tuple(events), int(seed))
+
+
+def _arrivals(rs: np.random.RandomState, rate_fn, duration_s: float,
+              max_rate: float) -> List[float]:
+    """Poisson-process arrival times with time-varying ``rate_fn(t)`` by
+    thinning (Lewis & Shedler): draw at the envelope ``max_rate``, keep
+    each point with probability ``rate_fn(t)/max_rate``."""
+    out: List[float] = []
+    t = 0.0
+    while True:
+        t += rs.exponential(1.0 / max_rate)
+        if t >= duration_s:
+            return out
+        if rs.uniform() * max_rate < rate_fn(t):
+            out.append(t)
+
+
+def diurnal(*, duration_s: float = 20.0, base_rps: float = 4.0,
+            peak_rps: float = 16.0, periods: float = 1.0,
+            prompt_len: Tuple[int, int] = (4, 12),
+            max_new_tokens: Tuple[int, int] = (4, 8),
+            seed: int = 0) -> Scenario:
+    """Sinusoidal ramp between ``base_rps`` and ``peak_rps`` over
+    ``periods`` full cycles — the compressed diurnal curve every serving
+    fleet rides."""
+    rs = np.random.RandomState(seed)
+    mid = (base_rps + peak_rps) / 2.0
+    amp = (peak_rps - base_rps) / 2.0
+
+    def rate(t):
+        return mid - amp * np.cos(2.0 * np.pi * periods * t / duration_s)
+
+    events = [
+        ScenarioRequest(t, int(rs.randint(prompt_len[0], prompt_len[1] + 1)),
+                        int(rs.randint(max_new_tokens[0],
+                                       max_new_tokens[1] + 1)))
+        for t in _arrivals(rs, rate, duration_s, peak_rps)]
+    return _finalize(f"diurnal@{seed}", duration_s, events, seed)
+
+
+def flash_crowd(*, duration_s: float = 12.0, base_rps: float = 3.0,
+                burst_rps: float = 30.0, burst_at: float = 0.25,
+                burst_frac: float = 0.25,
+                prompt_len: Tuple[int, int] = (4, 12),
+                burst_prompt_len: Optional[Tuple[int, int]] = None,
+                max_new_tokens: Tuple[int, int] = (4, 8),
+                burst_max_new_tokens: Optional[Tuple[int, int]] = None,
+                seed: int = 0) -> Scenario:
+    """Steady trickle with a rectangular burst window starting at
+    ``burst_at`` (fraction of the scenario) and lasting ``burst_frac``
+    of it.  ``burst_prompt_len`` / ``burst_max_new_tokens`` optionally
+    give burst arrivals their own ranges — long prompts with tiny token
+    budgets make the burst prefill-heavy, the exact shape prefill/decode
+    disaggregation exists to absorb."""
+    rs = np.random.RandomState(seed)
+    b0 = burst_at * duration_s
+    b1 = b0 + burst_frac * duration_s
+
+    def rate(t):
+        return burst_rps if b0 <= t < b1 else base_rps
+
+    events = []
+    for t in _arrivals(rs, rate, duration_s, burst_rps):
+        in_burst = b0 <= t < b1
+        rng = (burst_prompt_len if burst_prompt_len and in_burst
+               else prompt_len)
+        brange = (burst_max_new_tokens
+                  if burst_max_new_tokens and in_burst else max_new_tokens)
+        events.append(ScenarioRequest(
+            t, int(rs.randint(rng[0], rng[1] + 1)),
+            int(rs.randint(brange[0], brange[1] + 1))))
+    return _finalize(f"flash_crowd@{seed}", duration_s, events, seed)
+
+
+def heavy_tail(*, duration_s: float = 12.0, rps: float = 6.0,
+               prompt_len: Tuple[int, int] = (4, 12),
+               tail_alpha: float = 1.3, max_budget: int = 24,
+               seed: int = 0) -> Scenario:
+    """Constant arrival rate, Pareto-tailed token budgets (``1 +
+    Pareto(tail_alpha)`` capped at ``max_budget``) — a few requests hog
+    decode slots for a long time, the classic head-of-line stressor for
+    continuous batching."""
+    rs = np.random.RandomState(seed)
+    events = []
+    for t in _arrivals(rs, lambda _t: rps, duration_s, rps):
+        budget = 1 + int(rs.pareto(tail_alpha) * 2.0)
+        events.append(ScenarioRequest(
+            t, int(rs.randint(prompt_len[0], prompt_len[1] + 1)),
+            min(budget, int(max_budget))))
+    return _finalize(f"heavy_tail@{seed}", duration_s, events, seed)
+
+
+def poison(*, duration_s: float = 8.0, rps: float = 6.0,
+           poison_frac: float = 0.25, oversize_len: int = 4096,
+           prompt_len: Tuple[int, int] = (4, 12),
+           max_new_tokens: Tuple[int, int] = (4, 8),
+           seed: int = 0) -> Scenario:
+    """Healthy traffic with a fraction of oversize-prompt requests mixed
+    in.  Poison arrivals must be rejected at admission (no bucket fits)
+    without disturbing the healthy requests around them."""
+    rs = np.random.RandomState(seed)
+    events = []
+    for t in _arrivals(rs, lambda _t: rps, duration_s, rps):
+        if rs.uniform() < poison_frac:
+            events.append(ScenarioRequest(t, int(oversize_len), 4, True))
+        else:
+            events.append(ScenarioRequest(
+                t, int(rs.randint(prompt_len[0], prompt_len[1] + 1)),
+                int(rs.randint(max_new_tokens[0], max_new_tokens[1] + 1))))
+    return _finalize(f"poison@{seed}", duration_s, events, seed)
+
+
+def run_scenario(target, scenario: Scenario, *, time_scale: float = 1.0,
+                 vocab: int = 97, deadline_ms: Optional[float] = None,
+                 tick: Optional[Callable[[float], None]] = None,
+                 tick_s: float = 0.25, result_timeout_s: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep) -> dict:
+    """Play ``scenario`` against ``target`` (engine / router /
+    ``DisaggServer`` — anything with the ``submit`` contract) in open
+    loop: each request is submitted at ``event.t * time_scale`` wall
+    seconds after start whether or not earlier ones completed.
+
+    ``tick(elapsed_scenario_s)`` fires every ``tick_s`` scenario seconds
+    between arrivals — the hook the harness uses to pump
+    ``SloEngine.tick()`` so scaling decisions interleave with traffic
+    deterministically (well-ordered, single thread).
+
+    Prompt tokens are drawn from ``RandomState(scenario.seed)`` in event
+    order, so two runs of one scenario submit byte-identical prompts —
+    the basis for output-identity comparisons across fleet layouts.
+
+    Returns a report dict: ``accepted / rejected / completed / failed /
+    lost`` totals (``lost`` counts accepted requests whose future never
+    resolved within ``result_timeout_s`` — the number that must be
+    zero), ``poison_accepted`` (must be zero), and a
+    ``records`` list with per-request ``{t, prompt_len, max_new_tokens,
+    latency_ms, ok, tokens}`` for per-class latency analysis.
+    """
+    rs = np.random.RandomState(scenario.seed)
+    prompts = [rs.randint(1, int(vocab), size=ev.prompt_len).astype(np.int32)
+               for ev in scenario.events]
+    t_start = clock()
+    inflight: List[Tuple[int, float, Future]] = []
+    done_t: dict = {}  # event index -> completion wall time, stamped by
+    records: List[dict] = []  # the future's own callback, NOT at harvest
+    accepted = rejected = poison_accepted = 0
+    next_tick = tick_s
+
+    def _pump(elapsed_scn: float) -> None:
+        nonlocal next_tick
+        while tick is not None and next_tick <= elapsed_scn:
+            tick(next_tick)
+            next_tick += tick_s
+
+    for i, ev in enumerate(scenario.events):
+        due = t_start + ev.t * time_scale
+        while True:
+            now = clock()
+            _pump((now - t_start) / max(time_scale, 1e-9))
+            if now >= due:
+                break
+            step = min(due - now, tick_s * time_scale)
+            sleep(max(step, 0.0))
+        try:
+            fut = target.submit(prompts[i], max_new_tokens=ev.max_new_tokens,
+                                deadline_ms=deadline_ms)
+        except Exception:  # noqa: BLE001 — a submit-time raise IS the
+            # rejection contract (InvalidArgumentError from the bucket
+            # router, UnavailableError from a closed/saturated fleet)
+            rejected += 1
+            records.append({"t": ev.t, "prompt_len": ev.prompt_len,
+                            "max_new_tokens": ev.max_new_tokens,
+                            "poison": ev.poison, "ok": False,
+                            "rejected": True, "latency_ms": 0.0,
+                            "tokens": None})
+            continue
+        accepted += 1
+        if ev.poison:
+            poison_accepted += 1
+        fut.add_done_callback(
+            lambda _f, j=i: done_t.setdefault(j, clock()))
+        inflight.append((i, clock(), fut))
+    _pump(scenario.duration_s)
+
+    completed = failed = lost = 0
+    deadline_t = clock() + result_timeout_s
+    for i, t_sub, fut in inflight:
+        ev = scenario.events[i]
+        rec = {"t": ev.t, "prompt_len": ev.prompt_len,
+               "max_new_tokens": ev.max_new_tokens, "poison": ev.poison,
+               "rejected": False, "tokens": None}
+        try:
+            out = fut.result(timeout=max(deadline_t - clock(), 0.1))
+            rec["ok"] = True
+            rec["latency_ms"] = (done_t.get(i, clock()) - t_sub) * 1e3
+            rec["tokens"] = np.asarray(out).tolist()
+            completed += 1
+        except _FutureTimeout:
+            # an accepted request whose future never resolved is LOST —
+            # the invariant every drain / failover / hand-off path exists
+            # to protect.  (A DeadlineExceeded *answer* is merely failed.)
+            rec["ok"] = False
+            rec["latency_ms"] = (clock() - t_sub) * 1e3
+            rec["error"] = "lost"
+            lost += 1
+        except Exception as exc:  # noqa: BLE001 — classified, not raised
+            rec["ok"] = False
+            rec["latency_ms"] = (done_t.get(i, clock()) - t_sub) * 1e3
+            rec["error"] = type(exc).__name__
+            failed += 1
+        records.append(rec)
+    return {
+        "scenario": scenario.name,
+        "events": len(scenario.events),
+        "accepted": accepted,
+        "rejected": rejected,
+        "completed": completed,
+        "failed": failed,
+        "lost": lost,
+        "poison_accepted": poison_accepted,
+        "wall_s": clock() - t_start,
+        "records": records,
+    }

@@ -13,6 +13,7 @@
 #   serving-smoke tools/serving_smoke.py (closed compile set + KV-decode identity)
 #   kernel-smoke tools/kernel_smoke.py (autotuner search + warm-restart cache hit)
 #   tune-smoke tools/tune_smoke.py  (plan + serving measured search, warm replay, K701)
+#   scenario-smoke tools/scenario_smoke.py (autoscaling loop under traffic chaos + disagg)
 #   chaos-smoke tools/chaos_smoke.py (SIGKILL-resume bit identity + circuit recovery)
 #   obs-smoke tools/obs_smoke.py   (metrics scrape + JSONL sink + serving spans)
 #   router-smoke tools/router_smoke.py (replica kill -> zero-loss failover + rolling swap)
@@ -21,7 +22,7 @@
 #   elastic-smoke tools/elastic_smoke.py (NaN rollback + exact resume + collective watchdog)
 #   bench   python bench.py          (only when a real TPU answers)
 #
-# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|tune-smoke|chaos-smoke|obs-smoke|router-smoke|gen-smoke|slo-smoke|elastic-smoke|bench]...
+# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|tune-smoke|scenario-smoke|chaos-smoke|obs-smoke|router-smoke|gen-smoke|slo-smoke|elastic-smoke|bench]...
 #         tools/run_gates.sh --only suite
 # Exit code: 0 iff every stage that ran passed.
 set -u
@@ -110,6 +111,12 @@ run_stage kernel-smoke env JAX_PLATFORMS=cpu python tools/kernel_smoke.py
 # disk with zero searches, K701 silent on hits and firing on an injected
 # post-warm search
 run_stage tune-smoke env JAX_PLATFORMS=cpu python tools/tune_smoke.py
+# autoscaling loop: seeded traffic chaos (flash crowd / diurnal / heavy tail /
+# poison) drives SloEngine -> ReplicaPool; fleet scales up AND down in bounds
+# with zero lost requests, S605 silent, closed per-engine compile sets; then
+# the prefill-heavy burst replayed colo vs prefill/decode-disaggregated:
+# decode-class p99 strictly better, tokens bit-identical
+run_stage scenario-smoke env JAX_PLATFORMS=cpu python tools/scenario_smoke.py
 # resilience: injected checkpoint-write fault + SIGKILL -> bit-identical
 # resume; injected serving fault -> circuit opens, sheds, recovers
 run_stage chaos-smoke env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
