@@ -490,6 +490,68 @@ def bench_gpt_generate():
                  method="continuous_batching_vs_legacy")
 
 
+def bench_gpt_moe():
+    """Expert-parallel training headline: a 8-expert top-2 MoE GPT vs the
+    dense GPT it drops into, trained on the IDENTICAL token budget (same
+    batch, sequence length, steps, data).  vs_baseline is dense step time
+    over MoE step time — >1 means the routed model steps faster than the
+    dense one of the same *activated* width; the line also reports the
+    expert overflow fraction (capacity-dropped tokens / routed tokens) at
+    the trained router, the quantity moe_capacity_factor trades against
+    step time."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer as popt
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.moe import stats as moe_stats
+
+    B, S, STEPS, WARM = 8, 128, 20, 3
+    rng = np.random.RandomState(17)
+    batches = [rng.randint(0, 8192, size=(B, S)).astype(np.int32)
+               for _ in range(STEPS + WARM)]
+
+    def run(experts):
+        paddle.seed(77)
+        cfg = GPTConfig(vocab_size=8192, hidden_size=256, num_layers=4,
+                        num_heads=8, max_position=S, dropout=0.0,
+                        moe_experts=experts, moe_top_k=2)
+        net = GPTForCausalLM(cfg)
+        model = paddle.Model(net)
+        model.prepare(optimizer=popt.Adam(learning_rate=1e-4),
+                      loss=net.loss)
+        for ids in batches[:WARM]:  # compile + adam-state warm
+            model.train_batch([ids], [ids])
+        t0 = time.perf_counter()
+        for ids in batches[WARM:]:
+            loss, _ = model.train_batch([ids], [ids])
+        step_ms = (time.perf_counter() - t0) / STEPS * 1e3
+        overflow = 0.0
+        if experts:
+            # overflow at the trained router: eager forward under a stats
+            # collector (GPTModel, not the ForCausalLM wrapper — the
+            # wrapper opens its own inner collector for the aux loss)
+            net.eval()
+            with moe_stats.collect() as ms:
+                net.gpt(jnp.asarray(batches[-1]))
+            counts = ms.counts(experts)
+            routed, dropped = int(counts[0].sum()), int(counts[1].sum())
+            overflow = dropped / max(routed + dropped, 1)
+        return step_ms, float(loss), overflow
+
+    dense_ms, dense_loss, _ = run(0)
+    moe_ms, moe_loss, overflow = run(8)
+    return _emit("gpt_moe_train_step_ms", round(moe_ms, 1), "ms",
+                 dense_ms / moe_ms,
+                 dense_step_ms=round(dense_ms, 1),
+                 experts=8, top_k=2,
+                 tokens_per_step=B * S, steps=STEPS,
+                 expert_overflow_frac=round(overflow, 4),
+                 moe_loss=round(moe_loss, 3),
+                 dense_loss=round(dense_loss, 3),
+                 method="train_batch_same_token_budget")
+
+
 def main():
     budget_s = float(_os.environ.get("PADDLE_TPU_BENCH_BUDGET_S", "600"))
     allow_cpu = _os.environ.get(
@@ -505,7 +567,8 @@ def main():
     for name, fn in [("bert", bench_bert), ("resnet50", bench_resnet50),
                      ("mnist", bench_mnist), ("ctr", bench_ctr),
                      ("flash32k", bench_flash_32k),
-                     ("gpt_generate", bench_gpt_generate)]:
+                     ("gpt_generate", bench_gpt_generate),
+                     ("gpt_moe", bench_gpt_moe)]:
         if backend_dead:
             # fail fast: don't let each remaining config rediscover the
             # dead backend at one full budget apiece

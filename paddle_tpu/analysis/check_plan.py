@@ -15,10 +15,14 @@ build time:
 * P504 — spec rank exceeds the parameter rank;
 * P505 — ZeRO is on (``sharding`` axis > 1) but a parameter's optimizer
   state has no dim divisible by the axis: its slots stay fully replicated,
-  silently forfeiting the memory the strategy asked for.
+  silently forfeiting the memory the strategy asked for;
+* P506 — the ``expert`` mesh axis is booked for a parameter that is not an
+  expert weight (dotted name has no ``expert`` component): non-expert
+  parameters are replicated over ``expert`` by construction (paddle_tpu/moe),
+  so sharding one over that axis silently computes with a 1/ep slice.
 
-:func:`is_valid_plan` is the same P501–P504 rule set as a short-circuit
-boolean — the measured-search plan tuner calls it once per candidate to
+:func:`is_valid_plan` is the same P501–P504 + P506 rule set as a
+short-circuit boolean — the measured-search plan tuner calls it once per candidate to
 reject invalid mesh-axis assignments before any compile, without paying
 a DiagnosticCollector (or the P505 ``jax.eval_shape``) per candidate.
 """
@@ -62,7 +66,7 @@ def _param_shapes(plan) -> dict:
 
 def _plan_violations(shapes: dict, param_specs: dict, axis_sizes: dict,
                      ) -> Iterator[Tuple[str, str, str]]:
-    """Yield P501–P504 violations as ``(rule, message, hint)`` — the
+    """Yield P501–P504/P506 violations as ``(rule, message, hint)`` — the
     shared core under both the diagnostic collector and the boolean
     pre-filter."""
     for name, shape in shapes.items():
@@ -93,6 +97,15 @@ def _plan_violations(shapes: dict, param_specs: dict, axis_sizes: dict,
                     continue
                 seen_axes[ax] = d
                 factor *= axis_sizes[ax]
+                if ax == "expert" and "expert" not in name:
+                    yield ("P506",
+                           f"parameter {name!r} books the 'expert' mesh "
+                           f"axis but is not an expert weight (no "
+                           f"'expert' in its dotted name); non-expert "
+                           f"parameters replicate over 'expert'",
+                           "reserve the expert axis for MoE expert "
+                           "weights (paddle_tpu/moe stacks them under "
+                           "an 'experts' attribute)")
             if factor > 1 and shape[d] % factor != 0:
                 yield ("P502",
                        f"parameter {name!r} dim {d} (size {shape[d]}) is "

@@ -53,8 +53,8 @@ class RetraceMonitor:
         # executor, NOT deduped signature events (rule R403)
         self._cache_sites: Dict[str, dict] = {}
         # ("serving", name) engine snapshots: same latest-value semantics
-        # (rules S601 / S602 / S603 / S604 — router snapshots carry
-        # "router": 1)
+        # (rules S601 / S602 / S603 / S604 / S606 — router snapshots
+        # carry "router": 1)
         self._serving_sites: Dict[str, dict] = {}
         # ("router", "<router>[<i>]") per-replica snapshots: latest state /
         # outstanding / counters per replica (rule S602 context)
@@ -405,6 +405,54 @@ class RetraceMonitor:
                              "pages never return on their own; restart "
                              "the engine to rebuild the pool as a "
                              "stopgap")
+            # S606: sustained post-warmup expert-routing pathology on an
+            # MoE engine — either the capacity buckets overflow on most
+            # decode steps (tokens silently dropped from their chosen
+            # experts) or some experts never receive a token at all
+            # (dead: their parameters are pure memory/HBM waste).  A few
+            # overflow steps are normal traffic skew; a majority is a
+            # provisioning bug.
+            sampled = int(stats.get("moe_sampled_steps_after_warm", 0))
+            if sampled >= 8:
+                overflow = int(stats.get(
+                    "moe_overflow_steps_after_warm", 0))
+                dead = int(stats.get("moe_dead_experts", 0))
+                routed = int(stats.get("moe_routed_tokens", 0))
+                if overflow / sampled >= 0.5:
+                    out.add("S606",
+                            f"serving engine {name} overflowed expert "
+                            f"capacity on {overflow} of {sampled} decode "
+                            f"steps after warmup "
+                            f"({stats.get('moe_dropped_tokens', 0)} "
+                            f"token-expert assignments dropped of "
+                            f"{routed} routed) — the router's load is "
+                            f"sustainedly exceeding the static capacity "
+                            f"buckets, so tokens silently lose their "
+                            f"chosen experts and quality degrades "
+                            f"batch-dependently",
+                            location=Location(file=name, function=name),
+                            hint="raise moe_capacity_factor (static "
+                                 "capacity = ceil(k*N*cf/E)) or rebalance "
+                                 "the router (train longer with the "
+                                 "load-balance loss, or raise "
+                                 "moe_balance_weight)")
+                elif dead > 0 and routed > 0:
+                    out.add("S606",
+                            f"serving engine {name} has {dead} dead "
+                            f"expert(s): zero tokens routed to them "
+                            f"across {sampled} post-warmup decode steps "
+                            f"({routed} token-expert assignments total) "
+                            f"— their parameters occupy HBM on every "
+                            f"device of the expert axis without "
+                            f"contributing a FLOP",
+                            location=Location(file=name, function=name),
+                            hint="retrain with a higher "
+                                 "moe_balance_weight (the Switch loss "
+                                 "pushes routing toward uniform), lower "
+                                 "moe_experts to the population actually "
+                                 "used, or add router jitter "
+                                 "(moe_jitter) so cold experts see "
+                                 "exploration traffic")
         with self._lock:
             pool_sites = {k: dict(v) for k, v in self._pool_sites.items()}
         for name, stats in pool_sites.items():

@@ -43,6 +43,24 @@ def shard_map(f, mesh, in_specs, out_specs, axis_names=None):
     if _LEGACY_SHARD_MAP:
         auto = (frozenset(mesh.axis_names) - frozenset(axis_names)
                 if axis_names is not None else frozenset())
+        if auto and jax.default_backend() == "cpu":
+            # XLA's CPU SPMD partitioner can't lower PARTIAL-auto bodies:
+            # lax.axis_index emits a PartitionId it rejects outright, and
+            # pipe-axis ppermute/all_gather trip a manual-subgroup CHECK
+            # in spmd_partitioner.cc.  Fall back to fully-manual (every
+            # axis manual) — numerically identical, the auto axes just
+            # lose their sharding hints, so the body's ``constrain``
+            # calls (which would now name manual axes) are suppressed.
+            from .mesh import suppress_constraints
+
+            @functools.wraps(f)
+            def f_manual(*args, **kwargs):
+                with suppress_constraints():
+                    return f(*args, **kwargs)
+
+            return _shard_map(f_manual, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False,
+                              auto=frozenset())
         return _shard_map(f, mesh=mesh, in_specs=in_specs,
                           out_specs=out_specs, check_rep=False, auto=auto)
     kw = {"axis_names": set(axis_names)} if axis_names is not None else {}

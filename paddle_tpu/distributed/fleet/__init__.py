@@ -67,7 +67,8 @@ def init(role_maker=None, is_collective: bool = True,
     strategy = strategy or DistributedStrategy()
     devices = list(devices) if devices is not None else jax.devices()
     n = len(devices)
-    fixed = strategy.mp_degree * strategy.pp_degree * strategy.sep_degree
+    fixed = (strategy.mp_degree * strategy.pp_degree * strategy.sep_degree
+             * strategy.ep_degree)
     sharding_degree = strategy.sharding_degree
     dp = strategy.dp_degree
     if strategy.sharding and sharding_degree in (0, 1):
@@ -86,6 +87,7 @@ def init(role_maker=None, is_collective: bool = True,
         pp=strategy.pp_degree,
         sep=strategy.sep_degree,
         sharding=max(sharding_degree, 1),
+        ep=strategy.ep_degree,
         devices=devices,
     )
     set_mesh(mesh)

@@ -23,6 +23,7 @@ class DistributedStrategy:
     pp_degree: int = 1          # pipeline stages
     sep_degree: int = 1         # sequence/context parallel
     sharding_degree: int = 1    # ZeRO optimizer-state sharding
+    ep_degree: int = 1          # expert parallel (MoE; paddle_tpu/moe)
 
     # feature toggles (proto parity)
     amp: bool = False
@@ -40,6 +41,8 @@ class DistributedStrategy:
     sequence_parallel: bool = False
     sequence_parallel_configs: Dict = field(
         default_factory=lambda: {"method": "ring"})
+    expert_parallel: bool = False
+    expert_parallel_configs: Dict = field(default_factory=dict)
     localsgd: bool = False
     localsgd_configs: Dict = field(default_factory=dict)
     adaptive_localsgd: bool = False  # step-adaptive sync period (ref:
@@ -81,6 +84,11 @@ class DistributedStrategy:
             self.sep_degree = self.hybrid_configs.get("sep_degree", self.sep_degree)
             self.sharding_degree = self.hybrid_configs.get(
                 "sharding_degree", self.sharding_degree)
+            self.ep_degree = self.hybrid_configs.get(
+                "ep_degree", self.ep_degree)
+        if self.expert_parallel and self.ep_degree == 1:
+            self.ep_degree = int(self.expert_parallel_configs.get(
+                "expert_parallel_degree", 1))
         if self.tensor_parallel and self.mp_degree == 1:
             self.mp_degree = int(self.tensor_parallel_configs.get(
                 "tensor_parallel_degree", 1))

@@ -7,14 +7,18 @@ axis is a *named mesh dimension* (``data``, ``model``, ``pipe``, ``sep``)
 and XLA lowers collectives onto ICI/DCN along those axes.
 
 Axis-order convention (outer→inner): ``pipe``, ``data``, ``sharding``,
-``sep``, ``model`` — the model axis is innermost so tensor-parallel
-collectives (the most latency-sensitive) map onto directly-wired ICI
-neighbors, while data/pipeline axes can span DCN.  This mirrors the
-scaling-book recipe rather than anything in the reference (which has no
-TP/PP mesh concept at all).
+``sep``, ``expert``, ``model`` — the model axis is innermost so
+tensor-parallel collectives (the most latency-sensitive) map onto
+directly-wired ICI neighbors; the ``expert`` axis (MoE all-to-alls, see
+paddle_tpu/moe) sits next-innermost so dispatch/combine also ride ICI,
+while data/pipeline axes can span DCN.  This mirrors the scaling-book
+recipe rather than anything in the reference (which has no TP/PP mesh
+concept at all).
 """
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -29,13 +33,15 @@ __all__ = [
     "set_mesh",
     "mesh_axis_size",
     "data_axes",
+    "suppress_constraints",
+    "constraints_suppressed",
     "PartitionSpec",
     "NamedSharding",
     "Mesh",
 ]
 
 # canonical axis names, outer→inner
-AXIS_ORDER = ("pipe", "data", "sharding", "sep", "model")
+AXIS_ORDER = ("pipe", "data", "sharding", "sep", "expert", "model")
 
 _global_mesh: Optional[Mesh] = None
 
@@ -46,26 +52,29 @@ def build_mesh(
     pp: int = 1,
     sep: int = 1,
     sharding: int = 1,
+    ep: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     """Construct the hybrid-parallel mesh.  ``dp=0`` means "all remaining
     devices".  Degrees multiply to the device count."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    fixed = mp * pp * sep * sharding
+    fixed = mp * pp * sep * sharding * ep
     if fixed <= 0:
         raise InvalidArgumentError("parallel degrees must be positive")
     if dp in (0, -1, None):
         if n % fixed != 0:
             raise InvalidArgumentError(
-                f"device count {n} not divisible by mp*pp*sep*sharding={fixed}"
+                f"device count {n} not divisible by mp*pp*sep*sharding*ep="
+                f"{fixed}"
             )
         dp = n // fixed
     if dp * fixed != n:
         raise InvalidArgumentError(
-            f"dp*mp*pp*sep*sharding = {dp * fixed} != device count {n}"
+            f"dp*mp*pp*sep*sharding*ep = {dp * fixed} != device count {n}"
         )
-    sizes = {"pipe": pp, "data": dp, "sharding": sharding, "sep": sep, "model": mp}
+    sizes = {"pipe": pp, "data": dp, "sharding": sharding, "sep": sep,
+             "expert": ep, "model": mp}
     shape = [sizes[a] for a in AXIS_ORDER]
     arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, AXIS_ORDER)
@@ -89,6 +98,31 @@ def get_mesh() -> Mesh:
 def mesh_axis_size(axis: str, mesh: Optional[Mesh] = None) -> int:
     mesh = mesh or get_mesh()
     return mesh.shape[axis]
+
+
+_suppress_tls = threading.local()
+
+
+def constraints_suppressed() -> bool:
+    """True while inside a :func:`suppress_constraints` scope (per thread)."""
+    return getattr(_suppress_tls, "depth", 0) > 0
+
+
+@contextlib.contextmanager
+def suppress_constraints():
+    """Make ``meta_parallel.constrain`` a no-op while tracing.
+
+    Needed when a region is traced inside a FULLY-manual ``shard_map``:
+    every mesh axis is manual there, so ``with_sharding_constraint`` over
+    ``model``/``data`` is both illegal (jax rejects specs naming manual
+    axes) and meaningless (the body already sees per-device values).  The
+    pipeline schedules use this on backends where partial-auto shard_map
+    can't lower (see ``collective.shard_map``)."""
+    _suppress_tls.depth = getattr(_suppress_tls, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _suppress_tls.depth -= 1
 
 
 def data_axes(mesh: Optional[Mesh] = None) -> List[str]:

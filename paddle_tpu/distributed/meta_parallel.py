@@ -23,6 +23,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..framework import random as _random
 from ..nn import initializer as I
 from ..nn.layer_base import Layer, Parameter, current_rng_key
+from . import mesh as mesh_mod
 from .mesh import get_mesh
 
 __all__ = [
@@ -34,8 +35,10 @@ __all__ = [
 
 
 def constrain(x, *spec):
-    """Apply a sharding constraint when tracing (no-op eagerly)."""
-    if isinstance(x, jax.core.Tracer):
+    """Apply a sharding constraint when tracing (no-op eagerly, and a
+    no-op inside ``mesh.suppress_constraints`` scopes — fully-manual
+    shard_map bodies, where specs naming manual axes are rejected)."""
+    if isinstance(x, jax.core.Tracer) and not mesh_mod.constraints_suppressed():
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(get_mesh(), P(*spec)))
     return x

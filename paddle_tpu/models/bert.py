@@ -40,7 +40,8 @@ class BertConfig:
     def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=3072, max_position=512,
                  type_vocab_size=2, dropout=0.1, layer_norm_epsilon=1e-12,
-                 dtype="float32"):
+                 dtype="float32", moe_experts=0, moe_top_k=2,
+                 moe_capacity_factor=1.25, moe_jitter=0.01):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -51,6 +52,12 @@ class BertConfig:
         self.dropout = dropout
         self.layer_norm_epsilon = layer_norm_epsilon
         self.dtype = dtype
+        #: > 0 swaps each layer's dense MLP for a routed MoELayer with
+        #: that many experts (paddle_tpu/moe; knobs mirror GPTConfig)
+        self.moe_experts = moe_experts
+        self.moe_top_k = moe_top_k
+        self.moe_capacity_factor = moe_capacity_factor
+        self.moe_jitter = moe_jitter
 
 
 def bert_base(**kw):
@@ -98,10 +105,20 @@ class BertLayer(Layer):
         super().__init__()
         gcfg = GPTConfig(hidden_size=cfg.hidden_size,
                          intermediate_size=cfg.intermediate_size,
-                         dropout=cfg.dropout)
+                         dropout=cfg.dropout,
+                         moe_experts=getattr(cfg, "moe_experts", 0),
+                         moe_top_k=getattr(cfg, "moe_top_k", 2),
+                         moe_capacity_factor=getattr(
+                             cfg, "moe_capacity_factor", 1.25),
+                         moe_jitter=getattr(cfg, "moe_jitter", 0.01))
         self.attn = BertSelfAttention(cfg)
         self.ln1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
-        self.mlp = ParallelMLP(gcfg)
+        if gcfg.moe_experts:
+            from ..moe import MoELayer
+
+            self.mlp = MoELayer(gcfg)
+        else:
+            self.mlp = ParallelMLP(gcfg)
         self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
         self.drop = nn.Dropout(cfg.dropout)
 

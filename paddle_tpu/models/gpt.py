@@ -47,7 +47,9 @@ class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=None, max_position=1024,
                  dropout=0.1, layer_norm_epsilon=1e-5, dtype="float32",
-                 sequence_parallel=None):
+                 sequence_parallel=None, moe_experts=0, moe_top_k=2,
+                 moe_capacity_factor=1.25, moe_jitter=0.01,
+                 moe_balance_weight=0.01):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -60,6 +62,16 @@ class GPTConfig:
         #: None | "ring" | "ulysses" — long-sequence attention over the
         #: ``sep`` mesh axis (see distributed/sequence_parallel.py)
         self.sequence_parallel = sequence_parallel
+        #: > 0 swaps every block's dense ParallelMLP for a
+        #: ``moe.MoELayer`` with that many experts (paddle_tpu/moe);
+        #: expert weights shard over the ``expert`` mesh axis
+        self.moe_experts = moe_experts
+        self.moe_top_k = moe_top_k
+        self.moe_capacity_factor = moe_capacity_factor
+        self.moe_jitter = moe_jitter
+        #: multiplier on the summed per-layer load-balance loss added to
+        #: :meth:`GPTForCausalLM.loss`
+        self.moe_balance_weight = moe_balance_weight
 
 
 def gpt_tiny(**kw):
@@ -274,7 +286,12 @@ class GPTBlock(Layer):
         self.ln1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
         self.attn = ParallelAttention(cfg)
         self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
-        self.mlp = ParallelMLP(cfg)
+        if getattr(cfg, "moe_experts", 0):
+            from ..moe import MoELayer
+
+            self.mlp = MoELayer(cfg)
+        else:
+            self.mlp = ParallelMLP(cfg)
 
     def forward(self, x, attn_mask=None):
         if _fused_epilogues(x.shape[-1]):
@@ -561,7 +578,17 @@ class GPTForCausalLM(Layer):
         self.gpt = GPTModel(cfg)
 
     def forward(self, input_ids, attn_mask=None):
-        h = self.gpt(input_ids, attn_mask)  # [B,S,D]
+        if getattr(self.gpt.cfg, "moe_experts", 0):
+            # collect the blocks' load-balance losses; loss() consumes
+            # the stash within the SAME trace (hapi/bench compose
+            # forward+loss in one step function)
+            from ..moe import stats as moe_stats
+
+            with moe_stats.collect() as ms:
+                h = self.gpt(input_ids, attn_mask)  # [B,S,D]
+            self._moe_aux = ms.total_aux()
+        else:
+            h = self.gpt(input_ids, attn_mask)  # [B,S,D]
         logits = jnp.einsum("bsd,vd->bsv", h, jnp.asarray(self.gpt.wte.weight))
         return constrain(logits, None, None, None)
 
@@ -606,7 +633,9 @@ class GPTForCausalLM(Layer):
         return constrain(logits, None, None, None), cache
 
     def loss(self, logits, labels):
-        """Shifted next-token cross entropy (labels = input_ids)."""
+        """Shifted next-token cross entropy (labels = input_ids), plus
+        ``moe_balance_weight ×`` the summed load-balance loss the MoE
+        blocks recorded during :meth:`forward` (same trace)."""
         logits = logits[:, :-1]
         labels = jnp.asarray(labels)[:, 1:]
         if labels.dtype in (jnp.int64, jnp.uint32, jnp.uint64):
@@ -618,11 +647,19 @@ class GPTForCausalLM(Layer):
             from ..ops.fused_softmax_xent import softmax_cross_entropy
 
             V = logits.shape[-1]
-            return softmax_cross_entropy(logits.reshape(-1, V),
-                                         labels.reshape(-1)).mean()
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-        return -ll.mean()
+            out = softmax_cross_entropy(logits.reshape(-1, V),
+                                        labels.reshape(-1)).mean()
+        else:
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(logp, labels[..., None],
+                                     axis=-1)[..., 0]
+            out = -ll.mean()
+        aux = getattr(self, "_moe_aux", None)
+        if aux is not None:
+            self._moe_aux = None  # consume: never leak across traces
+            out = out + jnp.asarray(self.gpt.cfg.moe_balance_weight,
+                                    out.dtype) * aux
+        return out
 
     # -- 1F1B decomposition (consumed by Model.prepare when
     #    pipeline_configs={"schedule": "1f1b"}; see hapi/model.py) ----------

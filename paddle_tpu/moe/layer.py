@@ -1,0 +1,183 @@
+"""Mixture-of-experts FFN — GShard-style top-k routing over the
+``expert`` mesh axis.
+
+``MoELayer`` is a drop-in replacement for the dense ``ParallelMLP``:
+same ``(x) -> y`` signature, same hidden→intermediate→hidden GELU FFN —
+but the FFN weights are stacked ``[E, ...]`` per expert and each token is
+processed by only the ``top_k`` experts its learned router picks.  The
+design keeps every shape static so the layer composes with jit, scan
+and the serving engine's closed compile set:
+
+* **Router** — a replicated ``[D, E]`` gate; softmax over experts, then
+  ``jax.lax.top_k``.  Training applies multiplicative jitter to the gate
+  INPUT (GShard §3.1) drawn from :func:`current_rng_key`, so routing is
+  deterministic under a fixed seed and exactly greedy in eval.
+* **Capacity** — each expert accepts at most ``C = ceil(k*N*cf/E)``
+  tokens (static, from shapes alone).  Slot positions come from a cumsum
+  over the one-hot assignment flattened SLOT-MAJOR: every token's 1st
+  choice beats any token's 2nd choice, and within a choice rank earlier
+  tokens win — the deterministic tie-break the tests pin down.  Overflow
+  tokens are dropped for that expert (their combine weight contributes
+  nothing; with ``k > 1`` another expert usually still serves them).
+* **Dispatch/combine** — one-hot einsums into/out of the ``[E, C, D]``
+  capacity buffer, constrained to ``("expert", None, None)`` so GSPMD
+  lowers them to all-to-alls over the ``expert`` mesh axis; the layer
+  itself never calls a collective (same SPMD idiom as meta_parallel).
+* **Expert FFN** — stacked weights named ``expert_*`` (the P506
+  contract) with ``("expert", ...)`` partition specs.  On TPU with
+  lane-aligned dims the matmuls go through the ``grouped_matmul`` Pallas
+  kernel, which skips padding rows in-register; elsewhere the reference
+  masked einsum (bit-identical by the kernel's parity test).
+* **Aux loss** — the Switch Transformer load-balance loss
+  ``E * Σ_e f_e · P_e`` (``f_e`` = fraction of selections, ``P_e`` =
+  mean router probability); ≈ 1 when perfectly balanced.  It and the
+  per-expert routed/dropped counters ride the trace-scoped
+  :mod:`paddle_tpu.moe.stats` collector, keeping ``forward`` signature-
+  compatible with the dense MLP.
+
+Dense equivalence (the dryrun gate): with identically initialized
+experts, ``top_k=1`` and capacity ≥ tokens, the combine weight is
+``p/p == 1.0`` exactly and dispatch/combine are one-hot einsums
+(``1.0*x + 0.0*pad``), so forward AND backward are bit-identical to the
+dense MLP.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..distributed.meta_parallel import constrain
+from ..nn import initializer as I
+from ..nn.layer_base import Layer, current_rng_key
+from . import stats as moe_stats
+
+__all__ = ["MoELayer"]
+
+
+class MoELayer(Layer):
+    """Top-k routed expert FFN; config knobs: ``moe_experts`` (E),
+    ``moe_top_k``, ``moe_capacity_factor``, ``moe_jitter`` plus the dense
+    MLP's ``hidden_size``/``intermediate_size``/``dropout``."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        D = cfg.hidden_size
+        F = cfg.intermediate_size
+        E = int(cfg.moe_experts)
+        if E < 1:
+            raise ValueError(f"MoELayer needs moe_experts >= 1, got {E}")
+        self.num_experts = E
+        self.top_k = max(1, min(int(getattr(cfg, "moe_top_k", 2)), E))
+        self.capacity_factor = float(getattr(cfg, "moe_capacity_factor",
+                                             1.25))
+        self.jitter = float(getattr(cfg, "moe_jitter", 0.0))
+        # replicated router gate; explicit fans so the stacked expert
+        # weights initialize with the same scale a [D, F] dense layer gets
+        self.gate = self.create_parameter(
+            (D, E), default_initializer=I.XavierNormal())
+        self.expert_fc1 = self.create_parameter(
+            (E, D, F), default_initializer=I.XavierNormal(fan_in=D,
+                                                          fan_out=F))
+        self.expert_fc1.partition_spec = ("expert", None, None)
+        self.expert_b1 = self.create_parameter((E, F), is_bias=True)
+        self.expert_b1.partition_spec = ("expert", None)
+        self.expert_fc2 = self.create_parameter(
+            (E, F, D), default_initializer=I.XavierNormal(fan_in=F,
+                                                          fan_out=D))
+        self.expert_fc2.partition_spec = ("expert", None, None)
+        self.expert_b2 = self.create_parameter((E, D), is_bias=True)
+        self.expert_b2.partition_spec = ("expert", None)
+        self.act = nn.GELU()
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def capacity(self, num_tokens: int) -> int:
+        """Static per-expert slot count for ``num_tokens`` routed rows."""
+        return max(1, math.ceil(self.top_k * num_tokens *
+                                self.capacity_factor / self.num_experts))
+
+    def _expert_ffn(self, xe, group_sizes):
+        """[E, C, D] -> [E, C, D]; rows past group_sizes[e] may hold
+        garbage (FFN of a zero row is the bias path) — combine's one-hot
+        weights never read them."""
+        w1, w2 = self.expert_fc1.value, self.expert_fc2.value
+        b1, b2 = self.expert_b1.value, self.expert_b2.value
+        if self._use_kernel(xe):
+            from ..ops.grouped_matmul import grouped_matmul
+
+            h = grouped_matmul(xe, w1, group_sizes) + b1[:, None, :]
+            h = self.act(h)
+            return grouped_matmul(h, w2, group_sizes) + b2[:, None, :]
+        h = jnp.einsum("ecd,edf->ecf", xe, w1) + b1[:, None, :]
+        h = self.act(h)
+        return jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+
+    def _use_kernel(self, xe) -> bool:
+        from ..ops.autotune import fused_epilogues_eligible
+
+        D = xe.shape[-1]
+        F = self.expert_fc1.value.shape[-1]
+        return (fused_epilogues_eligible(D)
+                and fused_epilogues_eligible(F))
+
+    def forward(self, x):
+        x = jnp.asarray(x)
+        lead = x.shape[:-1]
+        D = x.shape[-1]
+        E, k = self.num_experts, self.top_k
+        xf = x.reshape(-1, D)
+        N = xf.shape[0]
+        C = self.capacity(N)
+
+        gate_in = xf
+        if self.training and self.jitter > 0.0:
+            eps = self.jitter
+            gate_in = xf * jax.random.uniform(
+                current_rng_key(), xf.shape, dtype=xf.dtype,
+                minval=1.0 - eps, maxval=1.0 + eps)
+        logits = gate_in @ self.gate.value
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        top_p, top_e = jax.lax.top_k(probs, k)          # [N, k]
+        # normalized combine weights.  For top-1 that is p/p: value 1.0
+        # and derivative exactly zero, so spell it as the constant — the
+        # autodiff of the quotient leaves last-ulp noise that would break
+        # the dense-parity bit-identity; the router trains through the
+        # balance loss (k == 1) or the relative weights (k > 1)
+        if k == 1:
+            combine_w = jnp.ones_like(top_p)
+        else:
+            combine_w = top_p / top_p.sum(-1, keepdims=True)
+
+        onehot = jax.nn.one_hot(top_e, E, dtype=jnp.int32)   # [N, k, E]
+        # position-in-expert: cumsum in slot-major-then-token order, so
+        # 1st choices beat 2nd choices and earlier tokens beat later ones
+        flat = onehot.transpose(1, 0, 2).reshape(k * N, E)
+        pos_flat = jnp.cumsum(flat, axis=0) - flat
+        pos = pos_flat.reshape(k, N, E).transpose(1, 0, 2)   # [N, k, E]
+        slot = (pos * onehot).sum(-1)                        # [N, k]
+        kept = (slot < C) & (onehot.sum(-1) > 0)
+        # one_hot of a negative index is all-zero: dropped slots vanish
+        cap_oh = jax.nn.one_hot(jnp.where(kept, slot, -1), C,
+                                dtype=jnp.float32)           # [N, k, C]
+        oh_f = onehot.astype(jnp.float32)
+        disp = jnp.einsum("nke,nkc->nec", oh_f, cap_oh)      # [N, E, C]
+        comb = jnp.einsum("nke,nkc,nk->nec", oh_f, cap_oh,
+                          combine_w.astype(jnp.float32))
+
+        xe = jnp.einsum("nec,nd->ecd", disp.astype(xf.dtype), xf)
+        xe = constrain(xe, "expert", None, None)
+        selected = onehot.sum((0, 1))                        # [E] i32
+        routed = jnp.minimum(selected, C).astype(jnp.int32)
+        ye = self._expert_ffn(xe, routed)
+        ye = constrain(ye, "expert", None, None)
+        y = jnp.einsum("nec,ecd->nd", comb.astype(ye.dtype), ye)
+
+        # Switch load-balance loss: E * sum_e f_e * P_e  (≈ 1 balanced)
+        f = selected.astype(jnp.float32) / float(N * k)
+        P = probs.mean(0)
+        aux = float(E) * jnp.sum(f * P)
+        moe_stats.record(aux, routed, (selected - routed).astype(jnp.int32))
+
+        return self.drop(y.reshape(*lead, D))

@@ -10,6 +10,8 @@ that beat the compiler, plus the autotuner that picks their tile sizes:
 * ``conv1x1_bn_relu`` / ``conv1x1_bn_stats`` — 1x1-conv GEMM with the
   train-mode BatchNorm statistics fused into the epilogue
   (fused_conv1x1_bn.py);
+* ``grouped_matmul`` — one masked matmul over the MoE experts' ragged
+  capacity-bucketed row groups (grouped_matmul.py);
 * ``layernorm_residual`` — residual add + LayerNorm in one HBM pass
   (fused_layernorm.py);
 * ``softmax_cross_entropy`` — online-logsumexp label cross-entropy that
@@ -27,4 +29,5 @@ from .flash_attention import (  # noqa: F401
 )
 from .fused_conv1x1_bn import conv1x1_bn_relu, conv1x1_bn_stats  # noqa: F401
 from .fused_layernorm import layernorm_residual  # noqa: F401
+from .grouped_matmul import grouped_matmul  # noqa: F401
 from .fused_softmax_xent import softmax_cross_entropy  # noqa: F401

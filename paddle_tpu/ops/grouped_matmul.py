@@ -1,0 +1,179 @@
+"""Grouped matmul over ragged per-expert row groups — Pallas TPU kernel.
+
+The MoE expert FFN (paddle_tpu/moe) is E independent matmuls whose row
+counts are decided at runtime by the router: expert ``e`` owns the first
+``group_sizes[e]`` rows of its ``[C, D]`` capacity bucket and the rest is
+padding.  Under XLA the natural spelling is a batched einsum over the
+full ``[E, C, D]`` buffer — every padding row burns MXU cycles and HBM
+bandwidth.  This kernel runs one matmul per (expert, row-block,
+col-block) grid step and masks the padding rows in-register, so the
+output is exactly the masked einsum while each block stays in VMEM:
+
+    XLA:    y = einsum("ecd,edf->ecf", x * rowmask, w)   (mask in HBM)
+    here:   y = grouped_matmul(x, w, group_sizes)        (mask in VMEM)
+
+Rows at or beyond ``group_sizes[e]`` are exactly zero in the output, so
+downstream combine sums can trust the padding without re-masking.  The
+backward is the closed-form VJP in plain XLA (two masked einsums — they
+batch over E and fuse fine; no second custom kernel needed):
+
+    dx = einsum("ecf,edf->ecd", dy, w) * rowmask
+    dw = einsum("ecd,ecf->edf", x * rowmask, dy)
+
+``group_sizes`` gets a symbolic-zero (float0) cotangent.
+
+Tile sizes come from ``ops.autotune`` (kernel name "grouped_matmul");
+the contraction dim D stays whole per block, so eligibility on real
+TPUs wants ``D % 128 == 0`` (same shape class as the other epilogues).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+if not hasattr(pltpu, "CompilerParams"):  # jax < 0.6 spells it TPUCompilerParams
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+from ..framework.errors import InvalidArgumentError
+from . import autotune as _at
+
+__all__ = ["grouped_matmul"]
+
+
+def _kernel(gs_ref, x_ref, w_ref, o_ref):
+    i = pl.program_id(1)
+    bm, bn = o_ref.shape[1], o_ref.shape[2]
+    gs = gs_ref[0, 0]
+    acc = jnp.dot(x_ref[0].astype(jnp.float32),
+                  w_ref[0].astype(jnp.float32),
+                  preferred_element_type=jnp.float32)
+    # global row ids of this block; rows past the group's fill are padding
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bm, bn), 0) + i * bm
+    acc = jnp.where(rows < gs, acc, 0.0)
+    o_ref[0] = acc.astype(o_ref.dtype)
+
+
+def _gmm_pallas(x, w, group_sizes, block_m, block_n):
+    """[E, C, D] @ [E, D, F] with per-expert valid-row counts [E] i32."""
+    E, C, D = x.shape
+    F = w.shape[2]
+    bm = min(block_m, max(C, 8))
+    bm = -(-bm // 8) * 8
+    bn = min(block_n, max(F, 128))
+    bn = -(-bn // 128) * 128
+    Cp = -(-C // bm) * bm
+    Fp = -(-F // bn) * bn
+    if Cp != C:
+        x = jnp.pad(x, ((0, 0), (0, Cp - C), (0, 0)))
+    if Fp != F:
+        w = jnp.pad(w, ((0, 0), (0, 0), (0, Fp - F)))
+    gs2 = group_sizes.reshape(E, 1).astype(jnp.int32)
+
+    interpret = jax.default_backend() != "tpu"
+    out = pl.pallas_call(
+        _kernel,
+        interpret=interpret,
+        grid=(E, Cp // bm, Fp // bn),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda e, i, j: (e, 0)),
+            pl.BlockSpec((1, bm, D), lambda e, i, j: (e, i, 0)),
+            pl.BlockSpec((1, D, bn), lambda e, i, j: (e, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, bm, bn), lambda e, i, j: (e, i, j)),
+        out_shape=jax.ShapeDtypeStruct((E, Cp, Fp), x.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
+    )(gs2, x, w)
+    return out[:, :C, :F]
+
+
+def _space(x, w, group_sizes, **_):
+    E, C, D = x.shape
+    F = w.shape[2]
+    itemsize = np.dtype(x.dtype).itemsize
+    out = []
+    for bm in _at.tile_candidates(C, base=(64, 128, 256, 512)):
+        for bn in _at.tile_candidates(F, multiple=_at.LANE,
+                                      base=(128, 256, 512)):
+            # resident: x row block, w col block, f32 acc + out block
+            resident = (bm * D + D * bn) * itemsize + bm * bn * (4 + itemsize)
+            if _at.vmem_fits(resident):
+                out.append({"block_m": bm, "block_n": bn})
+    return out
+
+
+@_at.autotune("grouped_matmul", params=("block_m", "block_n"), space=_space,
+              heuristic=lambda *a, **k: {"block_m": 128, "block_n": 128})
+def _gmm_measured(x, w, group_sizes, *, block_m, block_n):
+    return _gmm_pallas(x, w, group_sizes, block_m, block_n)
+
+
+def _rowmask(group_sizes, C):
+    # [E, C, 1] — 1.0 for valid rows, 0.0 for capacity padding
+    rows = jnp.arange(C, dtype=jnp.int32)[None, :]
+    return (rows < group_sizes[:, None]).astype(jnp.float32)[..., None]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _gmm(x, w, group_sizes, block_m, block_n):
+    return _gmm_pallas(x, w, group_sizes, block_m, block_n)
+
+
+def _gmm_fwd(x, w, group_sizes, block_m, block_n):
+    y = _gmm_pallas(x, w, group_sizes, block_m, block_n)
+    return y, (x, w, group_sizes)
+
+
+def _gmm_bwd(block_m, block_n, res, dy):
+    x, w, group_sizes = res
+    mask = _rowmask(group_sizes, x.shape[1]).astype(dy.dtype)
+    dx = jnp.einsum("ecf,edf->ecd", dy, w) * mask
+    dw = jnp.einsum("ecd,ecf->edf", x * mask.astype(x.dtype), dy)
+    return (dx.astype(x.dtype), dw.astype(w.dtype),
+            np.zeros(group_sizes.shape, jax.dtypes.float0))
+
+
+_gmm.defvjp(_gmm_fwd, _gmm_bwd)
+
+
+def grouped_matmul(x, w, group_sizes, *, block_m: Optional[int] = None,
+                   block_n: Optional[int] = None):
+    """Per-expert matmul over ragged row groups in one kernel launch.
+
+    x: ``[E, C, D]`` capacity-bucketed rows (expert-major), w: ``[E, D,
+    F]`` stacked expert weights, group_sizes: ``[E]`` integer valid-row
+    counts.  Returns ``[E, C, F]`` equal to ``einsum("ecd,edf->ecf", x *
+    rowmask, w)`` — rows at or beyond ``group_sizes[e]`` are exactly
+    zero.  Differentiable in x and w; ``group_sizes`` gets a
+    symbolic-zero cotangent.  Blocks default to the autotuner.
+    """
+    x = jnp.asarray(x)
+    w = jnp.asarray(w)
+    group_sizes = jnp.asarray(group_sizes)
+    if x.ndim != 3 or w.ndim != 3:
+        raise InvalidArgumentError(
+            f"grouped_matmul: x {x.shape} / w {w.shape} must be rank 3")
+    E, C, D = x.shape
+    if w.shape[0] != E or w.shape[1] != D:
+        raise InvalidArgumentError(
+            f"grouped_matmul: w {w.shape} does not match x {x.shape} "
+            f"(want [E={E}, D={D}, F])")
+    if group_sizes.shape != (E,):
+        raise InvalidArgumentError(
+            f"grouped_matmul: group_sizes {group_sizes.shape} != ({E},)")
+    if not jnp.issubdtype(group_sizes.dtype, jnp.integer):
+        raise InvalidArgumentError(
+            f"grouped_matmul: group_sizes dtype {group_sizes.dtype} is "
+            f"not integer")
+    group_sizes = group_sizes.astype(jnp.int32)
+    if block_m is None or block_n is None:
+        cfg = _gmm_measured.config(x, w, group_sizes)
+        block_m = cfg["block_m"] if block_m is None else block_m
+        block_n = cfg["block_n"] if block_n is None else block_n
+    return _gmm(x, w, group_sizes, int(block_m), int(block_n))

@@ -85,8 +85,8 @@ from ..resilience import CircuitBreaker, RetryPolicy
 from ..resilience import retry as _retry_mod
 from ..resilience.faults import fault_point
 from .batcher import MicroBatcher, Request
-from .metrics import (HANDOFF_COUNTERS, PAGED_COUNTERS, ServingMetrics,
-                      SLOT_COUNTERS)
+from .metrics import (HANDOFF_COUNTERS, MOE_COUNTERS, PAGED_COUNTERS,
+                      ServingMetrics, SLOT_COUNTERS)
 from .paging import PagePool
 
 __all__ = ["GenerationEngine", "KVHandoff"]
@@ -240,10 +240,20 @@ class GenerationEngine:
         self._traces: Dict[str, int] = {"prefill": 0, "decode": 0,
                                         "admit": 0, "evict": 0, "cow": 0,
                                         "export": 0, "import": 0}
-        self.metrics = ServingMetrics(
-            name, extra_counters=(SLOT_COUNTERS + PAGED_COUNTERS
-                                  + HANDOFF_COUNTERS
-                                  if self._paged else SLOT_COUNTERS))
+        # MoE models report per-expert routing health: the decode-step
+        # bodies below collect [2, E] routed/dropped counts inside the
+        # trace and a wrapper pops them off the jit output (_moe_tap) —
+        # a 0-expert config builds the exact same executables as before
+        self._moe_experts = int(getattr(
+            getattr(getattr(model, "gpt", None), "cfg", None),
+            "moe_experts", 0) or 0)
+        self._moe_pending = None
+        self._moe_routed_cum = np.zeros(max(self._moe_experts, 1), np.int64)
+        extra = (SLOT_COUNTERS + PAGED_COUNTERS + HANDOFF_COUNTERS
+                 if self._paged else SLOT_COUNTERS)
+        if self._moe_experts:
+            extra = extra + MOE_COUNTERS
+        self.metrics = ServingMetrics(name, extra_counters=extra)
 
         mdl, traces = model, self._traces
 
@@ -259,6 +269,15 @@ class GenerationEngine:
         def decode(params, buffers, tok, pos, cache):
             def body(tok, pos, cache):
                 traces["decode"] += 1
+                if self._moe_experts:
+                    from ..moe import stats as moe_stats
+
+                    with moe_stats.collect() as ms:
+                        logits, cache = mdl.forward_cached(
+                            tok[:, None], pos[:, None], cache)
+                    return (jnp.argmax(logits[:, 0],
+                                       axis=-1).astype(jnp.int32),
+                            cache, ms.counts(self._moe_experts))
                 logits, cache = mdl.forward_cached(
                     tok[:, None], pos[:, None], cache)
                 return (jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32),
@@ -322,6 +341,16 @@ class GenerationEngine:
                 C = self._C
                 G = C // self._page
                 Tp = (packed.shape[1] - C - G) // 2
+                if self._moe_experts:
+                    from ..moe import stats as moe_stats
+
+                    with moe_stats.collect() as ms:
+                        logits, cache = mdl.forward_paged(
+                            packed[:, :Tp], packed[:, Tp:2 * Tp],
+                            packed[:, 2 * Tp:2 * Tp + C],
+                            packed[:, 2 * Tp + C:], cache)
+                    return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                            cache, ms.counts(self._moe_experts))
                 logits, cache = mdl.forward_paged(
                     packed[:, :Tp], packed[:, Tp:2 * Tp],
                     packed[:, 2 * Tp:2 * Tp + C], packed[:, 2 * Tp + C:],
@@ -354,6 +383,9 @@ class GenerationEngine:
         self._evict = jax.jit(evict)
         self._padmit = jax.jit(padmit)
         self._step = jax.jit(pstep)
+        if self._moe_experts:
+            self._decode = self._moe_tap(self._decode)
+            self._step = self._moe_tap(self._step)
         self._cow = jax.jit(cow)
         self._export = jax.jit(pexport)
         self._import = jax.jit(pimport)
@@ -517,8 +549,54 @@ class GenerationEngine:
         from ..ops import autotune
         autotune.mark_warm()  # later tuner searches are hot-path (K701)
         _retry_mod.mark_warm()  # later retry storms / flaps are F801
+        # drop the last warmup step's pending expert counts so the
+        # dummy-data routing never lands in the post-warm S606 window
+        self._moe_pending = None
         self._warm = True  # starvation after this point is S603 material
         return self.compile_count
+
+    # -- MoE routing-health tap --------------------------------------------
+    def _moe_tap(self, fn):
+        """Wrap a jitted decode-step callable whose body returns a
+        trailing ``[2, E]`` per-expert (routed, dropped) counts array:
+        pop it off the output so every call site keeps its original
+        arity, and harvest the PREVIOUS call's counts — the one-step
+        deferral means the ``np.asarray`` sync always lands on an array
+        whose computation already finished, so the tap never serializes
+        the double-buffered decode loop."""
+
+        def tapped(*args, **kwargs):
+            out = fn(*args, **kwargs)
+            self._moe_harvest()
+            self._moe_pending = out[-1]
+            return out[:-1]
+
+        return tapped
+
+    def _moe_harvest(self):
+        """Fold the pending counts sample into the metrics: token totals,
+        post-warm sampled/overflow step counters (rule S606's ratio) and
+        the overflow-fraction / dead-expert gauges."""
+        pend = self._moe_pending
+        if pend is None:
+            return
+        self._moe_pending = None
+        c = np.asarray(pend)
+        routed, dropped = int(c[0].sum()), int(c[1].sum())
+        self._moe_routed_cum += c[0].astype(np.int64)
+        m = self.metrics
+        m.incr("moe_routed_tokens", routed)
+        m.incr("moe_dropped_tokens", dropped)
+        if self._warm:
+            m.incr("moe_sampled_steps_after_warm")
+            if dropped > 0:
+                m.incr("moe_overflow_steps_after_warm")
+        total = routed + dropped
+        m.set_gauge("moe_overflow_frac",
+                    (dropped / total) if total else 0.0)
+        if int(self._moe_routed_cum.sum()) > 0:
+            m.set_gauge("moe_dead_experts",
+                        int((self._moe_routed_cum == 0).sum()))
 
     # -- continuous scheduler ------------------------------------------------
     def _init_state(self):

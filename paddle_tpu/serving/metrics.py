@@ -27,6 +27,10 @@ counters ``cow_copies`` (copy-on-write page copies), ``spec_drafted`` /
 ``kv_pages_leaked`` (held by no table and no prefix — rule S604's
 signal).  The Prometheus bridge picks all of these up for free off the
 same snapshot.
+
+Engines serving MoE models (``GPTConfig.moe_experts > 0``) add the
+expert-routing family (``MOE_COUNTERS`` + the ``moe_overflow_frac`` /
+``moe_dead_experts`` gauges) — rule S606 reads it.
 """
 from __future__ import annotations
 
@@ -56,6 +60,15 @@ PAGED_COUNTERS = ("cow_copies", "spec_drafted", "spec_accepted",
 #: prefill-role engine exported (``handoffs_out``) and a decode-role
 #: engine adopted (``handoffs_in``)
 HANDOFF_COUNTERS = ("handoffs_out", "handoffs_in")
+
+#: expert-routing counters (MoE models; see ``extra_counters``): routed
+#: / capacity-dropped token totals plus post-warmup sampled/overflow
+#: step counts.  Together with the ``moe_overflow_frac`` and
+#: ``moe_dead_experts`` gauges these are rule S606's signal (sustained
+#: post-warmup expert overflow, or experts that never receive a token).
+MOE_COUNTERS = ("moe_routed_tokens", "moe_dropped_tokens",
+                "moe_sampled_steps_after_warm",
+                "moe_overflow_steps_after_warm")
 
 
 def _quantile(sorted_vals, q: float) -> float:

@@ -2,7 +2,7 @@
 
 SNIPPETS-style "naive sharding" picks one partition spec by hand and
 hopes; this module enumerates per-parameter-group mesh-axis assignments
-over the existing ``data/sharding/model/sep/pipe`` axes plus the
+over the existing ``data/sharding/model/sep/expert/pipe`` axes plus the
 collective schedule dials (`fp16_allreduce`, gradient bucketing,
 overlap), rejects invalid assignments with
 ``analysis.check_plan.is_valid_plan`` BEFORE any compile, and times the
@@ -39,8 +39,10 @@ __all__ = ["param_groups", "plan_candidates", "tune_plan", "apply_plan",
            "make_step_measure"]
 
 #: mesh axes a parameter group may be assigned to ("none" = replicated);
-#: ``data`` stays the batch axis and is never a parameter axis here
-PARAM_AXES = ("none", "model", "sharding", "sep", "pipe")
+#: ``data`` stays the batch axis and is never a parameter axis here.
+#: ``expert`` is proposed like any other axis — the P506 pre-filter
+#: rejects it on non-expert parameter groups before any compile
+PARAM_AXES = ("none", "model", "sharding", "sep", "expert", "pipe")
 
 #: collective schedule dials and their sweep values
 COLLECTIVE_DIALS = {

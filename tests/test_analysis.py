@@ -348,6 +348,24 @@ class TestCheckPlan:
                             None, None, mesh=mesh)
         assert check_plan(plan) == []
 
+    def test_p506_expert_axis_on_non_expert_param(self):
+        mesh = build_mesh(dp=4, ep=2)
+        plan = ShardingPlan(_OneParam((4, 4), spec=("expert", None)),
+                            None, None, mesh=mesh)
+        diags = check_plan(plan)
+        assert _rule_count(diags, "P506") == 1
+
+    def test_p506_silent_on_expert_weights(self):
+        class _Experts(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.expert_fc1 = self.create_parameter([2, 4, 4])
+                self.expert_fc1.partition_spec = ("expert", None, None)
+
+        mesh = build_mesh(dp=4, ep=2)
+        assert check_plan(ShardingPlan(_Experts(), None, None,
+                                       mesh=mesh)) == []
+
 
 # -- diagnostics core ---------------------------------------------------------
 class TestDiagnostics:

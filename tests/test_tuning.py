@@ -38,7 +38,8 @@ def _clean_tuner_state():
 def _mesh(**axes):
     """check_plan and the key builder only read ``mesh.shape``, so a
     stub carries any axis geometry on a single-device CPU test host."""
-    shape = {"pipe": 1, "data": 1, "sharding": 1, "sep": 1, "model": 1}
+    shape = {"pipe": 1, "data": 1, "sharding": 1, "sep": 1, "expert": 1,
+             "model": 1}
     shape.update(axes)
     return SimpleNamespace(shape=shape)
 
@@ -85,6 +86,29 @@ class TestPlanSpace:
                 and c["axes"].get("fc") == "none"]
         assert good, "space must keep the valid assignment"
         assert all(plan_space.is_valid_candidate(c, groups, mesh)
+                   for c in good)
+
+    def test_expert_axis_proposed_and_p506_prefiltered(self):
+        """With expert=4 in the mesh the space proposes the 'expert'
+        axis like any other, but P506 rejects it on non-expert parameter
+        groups before any measurement ('emb.weight' dim0=32 divides by 4,
+        so only the name rule can catch it); a stacked expert-weight
+        group keeps the assignment."""
+        mesh = _mesh(expert=4)
+        groups = plan_space.param_groups(SHAPES)
+        cands = plan_space.plan_candidates(groups, mesh)
+        on_expert = [c for c in cands
+                     if c["axes"].get("emb") == "expert"]
+        assert on_expert, "space must propose the expert axis"
+        assert all(not plan_space.is_valid_candidate(c, groups, mesh)
+                   for c in on_expert)
+        moe_groups = plan_space.param_groups(
+            {"expert_fc1.w": (4, 16, 32), "expert_b1.b": (4, 32)})
+        mcands = plan_space.plan_candidates(moe_groups, mesh)
+        good = [c for c in mcands
+                if set(c["axes"].values()) == {"expert"}]
+        assert good, "space must propose expert sharding for experts"
+        assert all(plan_space.is_valid_candidate(c, moe_groups, mesh)
                    for c in good)
 
     def test_search_skips_prefiltered_and_picks_valid_winner(self):

@@ -14,6 +14,7 @@
 #   kernel-smoke tools/kernel_smoke.py (autotuner search + warm-restart cache hit)
 #   tune-smoke tools/tune_smoke.py  (plan + serving measured search, warm replay, K701)
 #   scenario-smoke tools/scenario_smoke.py (autoscaling loop under traffic chaos + disagg)
+#   moe-smoke tools/moe_smoke.py (expert-sharded decode: closed set + balanced routing)
 #   chaos-smoke tools/chaos_smoke.py (SIGKILL-resume bit identity + circuit recovery)
 #   obs-smoke tools/obs_smoke.py   (metrics scrape + JSONL sink + serving spans)
 #   router-smoke tools/router_smoke.py (replica kill -> zero-loss failover + rolling swap)
@@ -22,7 +23,7 @@
 #   elastic-smoke tools/elastic_smoke.py (NaN rollback + exact resume + collective watchdog)
 #   bench   python bench.py          (only when a real TPU answers)
 #
-# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|tune-smoke|scenario-smoke|chaos-smoke|obs-smoke|router-smoke|gen-smoke|slo-smoke|elastic-smoke|bench]...
+# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|tune-smoke|scenario-smoke|moe-smoke|chaos-smoke|obs-smoke|router-smoke|gen-smoke|slo-smoke|elastic-smoke|bench]...
 #         tools/run_gates.sh --only suite
 # Exit code: 0 iff every stage that ran passed.
 set -u
@@ -117,6 +118,12 @@ run_stage tune-smoke env JAX_PLATFORMS=cpu python tools/tune_smoke.py
 # the prefill-heavy burst replayed colo vs prefill/decode-disaggregated:
 # decode-class p99 strictly better, tokens bit-identical
 run_stage scenario-smoke env JAX_PLATFORMS=cpu python tools/scenario_smoke.py
+# expert-sharded decode: 4-expert top-2 GPT behind the continuous engine
+# with per-step routing inside the jitted step -> closed compile set, zero
+# post-warmup XLA compiles, tokens bit-identical to eager greedy under
+# ample capacity, every expert live (no dead experts / overflow), S606
+# silent; the 0-expert build must publish no moe keys at all
+run_stage moe-smoke env JAX_PLATFORMS=cpu python tools/moe_smoke.py
 # resilience: injected checkpoint-write fault + SIGKILL -> bit-identical
 # resume; injected serving fault -> circuit opens, sheds, recovers
 run_stage chaos-smoke env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
