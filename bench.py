@@ -490,6 +490,95 @@ def bench_gpt_generate():
                  method="continuous_batching_vs_legacy")
 
 
+def _bench_gpt_generate_quant(mode):
+    """Quantized serving headline for one mode ('int8' / 'fp8'): the same
+    seeded RequestTrace as bench_gpt_generate through a paged continuous
+    engine quantized end-to-end (weights via ops.quantized_matmul, KV
+    pages stored at the low precision with per-token scales) vs the
+    float engine on the IDENTICAL workload.  vs_baseline is quantized
+    tokens/s over float tokens/s; the line also reports the KV pool's
+    measured HBM high-water at both precisions (the resident-slot
+    economics) and a quantized-vs-float kernel microbench at a serving
+    Linear shape."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import GenerationEngine
+    from paddle_tpu.tuning import RequestTrace, replay as _replay
+
+    paddle.seed(1234)
+    cfg = GPTConfig(vocab_size=8192, hidden_size=256, num_layers=4,
+                    num_heads=8, max_position=512, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    trace = RequestTrace.synthetic()
+
+    def run(quantized):
+        with GenerationEngine(
+                model, prompt_buckets=[16, 48], batch_size=8,
+                max_queue_delay_ms=1.0, continuous=True, paged=True,
+                quantized=quantized,
+                name=f"bench-gen-{quantized or 'float'}") as eng:
+            eng.warmup()
+            stats = _replay(eng, trace)
+            pool = model.gpt.init_paged_cache(
+                eng._kv_pages, eng._page, dtype=eng._kv_qdtype())
+            pool_bytes = sum(int(t.nbytes) for layer in pool["layers"]
+                             for t in layer.values())
+            return stats["tokens_per_sec"], stats["mean_ms"], pool_bytes
+
+    float_tps, float_lat, float_bytes = run(None)
+    tps, lat_ms, pool_bytes = run(mode)
+
+    # kernel microbench: the quantized Linear hot path vs the float
+    # matmul it replaces, at a decode-step shape (warm, blocked timing)
+    from paddle_tpu.ops.quantized_matmul import quantized_linear
+    from paddle_tpu.slim.quantization import _quantize_weight
+
+    M, K, N = 64, cfg.hidden_size, 4 * cfg.hidden_size
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(M, K).astype(np.float32))
+    w = jnp.asarray(rng.randn(K, N).astype(np.float32) * 0.02)
+    wq, scale = _quantize_weight(w, mode)
+    qf = jax.jit(lambda a: quantized_linear(a, wq, scale))
+    ff = jax.jit(lambda a: a @ w)
+
+    def best_ms(fn):
+        np.asarray(fn(x))  # compile
+        best = None
+        for _ in range(5):
+            t0 = time.perf_counter()
+            np.asarray(fn(x))
+            dt = (time.perf_counter() - t0) * 1e3
+            best = dt if best is None else min(best, dt)
+        return best
+
+    kq_ms, kf_ms = best_ms(qf), best_ms(ff)
+    return _emit(f"gpt_generate_{mode}_tokens_per_sec", round(tps, 1),
+                 "tok/s", tps / float_tps,
+                 float_tokens_per_sec=round(float_tps, 1),
+                 mean_latency_ms=round(float(lat_ms), 1),
+                 float_mean_latency_ms=round(float(float_lat), 1),
+                 kv_pool_bytes=pool_bytes,
+                 float_kv_pool_bytes=float_bytes,
+                 kv_hbm_ratio=round(pool_bytes / float_bytes, 3),
+                 kernel_quant_ms=round(kq_ms, 3),
+                 kernel_float_ms=round(kf_ms, 3),
+                 kernel_speedup=round(kf_ms / kq_ms, 2),
+                 requests=len(trace), new_tokens=trace.total_new_tokens,
+                 method="quantized_vs_float_same_trace")
+
+
+def bench_gpt_generate_int8():
+    return _bench_gpt_generate_quant("int8")
+
+
+def bench_gpt_generate_fp8():
+    return _bench_gpt_generate_quant("fp8")
+
+
 def bench_gpt_moe():
     """Expert-parallel training headline: a 8-expert top-2 MoE GPT vs the
     dense GPT it drops into, trained on the IDENTICAL token budget (same
@@ -568,6 +657,8 @@ def main():
                      ("mnist", bench_mnist), ("ctr", bench_ctr),
                      ("flash32k", bench_flash_32k),
                      ("gpt_generate", bench_gpt_generate),
+                     ("gpt_generate_int8", bench_gpt_generate_int8),
+                     ("gpt_generate_fp8", bench_gpt_generate_fp8),
                      ("gpt_moe", bench_gpt_moe)]:
         if backend_dead:
             # fail fast: don't let each remaining config rediscover the

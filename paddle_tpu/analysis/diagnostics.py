@@ -100,6 +100,11 @@ RULES = {
     "M903": (Severity.WARNING,
              "SLO error-budget burn after serving warmup (multi-window "
              "burn-rate alert on live traffic)"),
+    # -- quantized serving monitor (Q8xx) ------------------------------------
+    "Q801": (Severity.WARNING,
+             "quantization integrity hazard (post-warmup dequantize "
+             "fallback in a quantized engine, or never-calibrated "
+             "observers at convert time)"),
 }
 
 

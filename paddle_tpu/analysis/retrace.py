@@ -77,6 +77,10 @@ class RetraceMonitor:
         self._supervisor_sites: Dict[str, dict] = {}
         # ("amp", name) grad-scaler snapshots: latest per scaler
         self._amp_sites: Dict[str, dict] = {}
+        # ("quant", name) quantization snapshots: latest per site — slim
+        # calibration (PTQ/QAT observer coverage) and quantized serving
+        # engines (post-warmup dequantize-fallback steps).  Rule Q801.
+        self._quant_sites: Dict[str, dict] = {}
 
     # -- subscription --------------------------------------------------------
     def install(self):
@@ -152,6 +156,12 @@ class RetraceMonitor:
             # grad-scaler snapshot (scale, skipped steps): latest wins
             with self._lock:
                 self._amp_sites[key[1]] = dict(info)
+            return
+        if key[0] == "quant":
+            # quantization snapshot (calibration coverage / engine
+            # fallback counters): cumulative, latest wins (rule Q801)
+            with self._lock:
+                self._quant_sites[key[1]] = dict(info)
             return
         sig = _freeze(info)
         with self._lock:
@@ -257,6 +267,18 @@ class RetraceMonitor:
             if name is not None:
                 return dict(self._amp_sites.get(name, {}))
             return {k: dict(v) for k, v in self._amp_sites.items()}
+
+    def quant_stats(self, name: str = None):
+        """Latest quantization snapshot(s) observed: ``kind='calibration'``
+        (slim PTQ/QAT observer coverage — ``layers`` / ``calibrated`` /
+        ``uncalibrated_layers``) or ``kind='engine'`` (a quantized serving
+        engine's mode + post-warmup fallback step counter).  The dict for
+        one site (``name`` like ``"ptq"`` or an engine name), or all of
+        them."""
+        with self._lock:
+            if name is not None:
+                return dict(self._quant_sites.get(name, {}))
+            return {k: dict(v) for k, v in self._quant_sites.items()}
 
     def diagnostics(self) -> List[Diagnostic]:
         out = DiagnosticCollector()
@@ -632,6 +654,52 @@ class RetraceMonitor:
                          "lower the learning rate / loss scale or inspect "
                          "the checkpoint itself — the restored state is "
                          "already on the divergence trajectory")
+        with self._lock:
+            quant_sites = {k: dict(v)
+                           for k, v in self._quant_sites.items()}
+        for name, stats in quant_sites.items():
+            kind = stats.get("kind")
+            if kind == "engine":
+                # Q801 (engine side): a quantized engine serving
+                # post-warmup decode steps with a FLOAT weight tree bound
+                # — every step silently runs full-precision math (the
+                # dequantize fallback), paying quantized HBM prices for
+                # float throughput
+                late = int(stats.get("fallback_steps_after_warm", 0))
+                if late <= 0:
+                    continue
+                out.add("Q801",
+                        f"quantized serving engine {name} "
+                        f"(mode={stats.get('mode')!r}) served {late} "
+                        f"post-warmup decode step(s) with a "
+                        f"non-quantized weight tree bound — the Linear "
+                        f"hot paths silently took the float leg, so the "
+                        f"engine runs at full precision while reporting "
+                        f"(and provisioning for) {stats.get('mode')!r}",
+                        location=Location(file=name, function=name),
+                        hint="rebind quantized trees: swap_weights with "
+                             "a slim.export_quantized artifact of the "
+                             "same mode, or reload_weights() (quantized "
+                             "engines re-quantize on reload); a bare "
+                             "tree assignment bypasses the quantize hook")
+            elif kind == "calibration":
+                # Q801 (calibration side): observers that never saw data
+                # — their layers would quantize off a default/stale range
+                stale = int(stats.get("uncalibrated_layers", 0))
+                if stale <= 0:
+                    continue
+                out.add("Q801",
+                        f"quantization calibration {name!r} left {stale} "
+                        f"of {stats.get('layers', '?')} observed layer(s) "
+                        f"uncalibrated (no activations recorded) — "
+                        f"quantizing them would clip/scale off a never-"
+                        f"fitted range and silently wreck those layers' "
+                        f"numerics",
+                        location=Location(file=name, function=name),
+                        hint="run calibration batches through "
+                             "PTQ.collect() (or more QAT train steps) "
+                             "until every observed layer has statistics "
+                             "before calling quantize()/convert()")
         return out.diagnostics
 
     @staticmethod

@@ -33,6 +33,32 @@ __all__ = [
     "constrain",
 ]
 
+#: weight dtypes the quantized serving path stores (slim.quantize_weights)
+_QUANT_DTYPES = ("int8", "float8_e4m3fn")
+
+
+def _quantized_forward(layer, x):
+    """Quantized Linear leg shared by Column/RowParallelLinear: the
+    weight arrived int8/fp8 (``slim.quantize_weights`` in place, or a
+    quantized tree bound by ``functional_call``), so route through
+    ``ops.quantized_matmul`` with the per-channel ``weight_scale``
+    buffer and the bias fused into the epilogue.  The dtype branch is
+    static under trace — a float weight never pays for this check."""
+    from ..ops.quantized_matmul import quantized_linear
+
+    scale = layer._buffers.get("weight_scale")
+    if scale is None:
+        from ..framework.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"{type(layer).__name__}: weight is "
+            f"{jnp.asarray(layer.weight).dtype} but no weight_scale "
+            f"buffer is registered — quantize via slim.quantize_weights "
+            f"/ slim.quantize_model_trees, not a bare dtype cast")
+    bias = None if layer.bias is None else jnp.asarray(layer.bias)
+    return quantized_linear(jnp.asarray(x), jnp.asarray(layer.weight),
+                            scale.value, bias)
+
 
 def constrain(x, *spec):
     """Apply a sharding constraint when tracing (no-op eagerly, and a
@@ -65,9 +91,12 @@ class ColumnParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
-        y = jnp.matmul(jnp.asarray(x), jnp.asarray(self.weight))
-        if self.bias is not None:
-            y = y + jnp.asarray(self.bias)
+        if str(jnp.asarray(self.weight).dtype) in _QUANT_DTYPES:
+            y = _quantized_forward(self, x)
+        else:
+            y = jnp.matmul(jnp.asarray(x), jnp.asarray(self.weight))
+            if self.bias is not None:
+                y = y + jnp.asarray(self.bias)
         if self.gather_output:
             y = constrain(y, *([None] * y.ndim))
         else:
@@ -100,6 +129,9 @@ class RowParallelLinear(Layer):
         x = jnp.asarray(x)
         if self.input_is_parallel:
             x = constrain(x, *([None] * (x.ndim - 1) + ["model"]))
+        if str(jnp.asarray(self.weight).dtype) in _QUANT_DTYPES:
+            y = _quantized_forward(self, x)
+            return constrain(y, *([None] * y.ndim))
         y = jnp.matmul(x, jnp.asarray(self.weight))
         y = constrain(y, *([None] * y.ndim))
         if self.bias is not None:

@@ -41,7 +41,8 @@ class BertConfig:
                  num_heads=12, intermediate_size=3072, max_position=512,
                  type_vocab_size=2, dropout=0.1, layer_norm_epsilon=1e-12,
                  dtype="float32", moe_experts=0, moe_top_k=2,
-                 moe_capacity_factor=1.25, moe_jitter=0.01):
+                 moe_capacity_factor=1.25, moe_jitter=0.01,
+                 quantization="none"):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -58,6 +59,14 @@ class BertConfig:
         self.moe_top_k = moe_top_k
         self.moe_capacity_factor = moe_capacity_factor
         self.moe_jitter = moe_jitter
+        #: "none" | "int8" | "fp8" — serving weight quantization (same
+        #: contract as ``GPTConfig.quantization``: parallel-linear
+        #: weights quantize at init, forwards dispatch on weight dtype)
+        if quantization not in ("none", "int8", "fp8"):
+            raise ValueError(
+                f"quantization must be 'none', 'int8' or 'fp8', got "
+                f"{quantization!r}")
+        self.quantization = quantization
 
 
 def bert_base(**kw):
@@ -168,6 +177,13 @@ class BertModel(Layer):
         self.layers = nn.LayerList([BertLayer(cfg) for _ in range(cfg.num_layers)])
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
         self.pooler_act = nn.Tanh()
+        if getattr(cfg, "quantization", "none") != "none":
+            # same init-time weight quantization as GPTModel: the
+            # parallel linears (attention qkv/out + the shared
+            # ParallelMLP) dispatch on weight dtype
+            from ..slim.quantization import quantize_weights
+
+            quantize_weights(self, cfg.quantization)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         """attention_mask: [B, S] with 1 = attend, 0 = pad."""

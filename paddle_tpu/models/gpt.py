@@ -43,13 +43,29 @@ def _fused_epilogues(feature_dim=None) -> bool:
     return fused_epilogues_eligible(feature_dim)
 
 
+def _quantize_kv(t, qdtype):
+    """Quantize-on-write for paged KV: ``t`` float ``[N, H, hd]`` →
+    (quantized values, ``[N, H]`` float32 dequant multipliers), one
+    abs-max scale per written token per head."""
+    tf = jnp.asarray(t, jnp.float32)
+    amax = jnp.maximum(jnp.max(jnp.abs(tf), axis=-1), 1e-9)  # [N, H]
+    if jnp.dtype(qdtype) == jnp.int8:
+        q = jnp.clip(jnp.round(tf * (127.0 / amax[..., None])),
+                     -127, 127).astype(jnp.int8)
+        return q, amax / 127.0
+    fp8_max = 448.0  # largest finite e4m3fn; clip BEFORE the cast
+    q = jnp.clip(tf * (fp8_max / amax[..., None]),
+                 -fp8_max, fp8_max).astype(jnp.float8_e4m3fn)
+    return q, amax / fp8_max
+
+
 class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=None, max_position=1024,
                  dropout=0.1, layer_norm_epsilon=1e-5, dtype="float32",
                  sequence_parallel=None, moe_experts=0, moe_top_k=2,
                  moe_capacity_factor=1.25, moe_jitter=0.01,
-                 moe_balance_weight=0.01):
+                 moe_balance_weight=0.01, quantization="none"):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -72,6 +88,16 @@ class GPTConfig:
         #: multiplier on the summed per-layer load-balance loss added to
         #: :meth:`GPTForCausalLM.loss`
         self.moe_balance_weight = moe_balance_weight
+        #: "none" | "int8" | "fp8" — serving weight quantization: the
+        #: parallel-linear hot paths store int8/fp8-e4m3 weights plus
+        #: per-channel scales (``slim.quantize_weights`` runs at model
+        #: init) and route through ``ops.quantized_matmul``.  "none" is
+        #: bitwise-identical to the unquantized model.
+        if quantization not in ("none", "int8", "fp8"):
+            raise ValueError(
+                f"quantization must be 'none', 'int8' or 'fp8', got "
+                f"{quantization!r}")
+        self.quantization = quantization
 
 
 def gpt_tiny(**kw):
@@ -249,13 +275,33 @@ class ParallelAttention(Layer):
         q, k, v = self._heads(x)  # [B,H,T,hd]
         kw = k.transpose(0, 2, 1, 3).reshape(B * T, H, hd)
         vw = v.transpose(0, 2, 1, 3).reshape(B * T, H, hd)
-        new_k = kv["k"].at[write_page, :, write_off].set(kw)
-        new_v = kv["v"].at[write_page, :, write_off].set(vw)
+        quantized = "k_scale" in kv  # static: pool dtype fixed at init
+        if quantized:
+            (kw, ks), (vw, vs) = (_quantize_kv(kw, kv["k"].dtype),
+                                  _quantize_kv(vw, kv["v"].dtype))
+            new_ks = kv["k_scale"].at[write_page, :, write_off].set(ks)
+            new_vs = kv["v_scale"].at[write_page, :, write_off].set(vs)
+        new_k = kv["k"].at[write_page, :, write_off].set(
+            kw.astype(kv["k"].dtype))
+        new_v = kv["v"].at[write_page, :, write_off].set(
+            vw.astype(kv["v"].dtype))
         G, page = gather_tab.shape[1], kv["k"].shape[2]
         kview = jnp.take(new_k, gather_tab, axis=0)  # [B,G,H,page,hd]
         vview = jnp.take(new_v, gather_tab, axis=0)
         kview = kview.transpose(0, 2, 1, 3, 4).reshape(B, H, G * page, hd)
         vview = vview.transpose(0, 2, 1, 3, 4).reshape(B, H, G * page, hd)
+        if quantized:
+            # dequantize the gathered view: one multiplier per (page
+            # entry, head), broadcast over hd — drop-page entries carry
+            # scale 0 and are masked out below anyway
+            ksview = jnp.take(new_ks, gather_tab, axis=0)  # [B,G,H,page]
+            vsview = jnp.take(new_vs, gather_tab, axis=0)
+            ksview = ksview.transpose(0, 2, 1, 3).reshape(B, H, G * page)
+            vsview = vsview.transpose(0, 2, 1, 3).reshape(B, H, G * page)
+            kview = (kview.astype(jnp.float32)
+                     * ksview[..., None]).astype(q.dtype)
+            vview = (vview.astype(jnp.float32)
+                     * vsview[..., None]).astype(q.dtype)
         scores = jnp.einsum("bhqd,bhcd->bhqc", q, kview) / math.sqrt(hd)
         scores = jnp.where(mask[:, None], scores,
                            jnp.finfo(scores.dtype).min)
@@ -263,7 +309,10 @@ class ParallelAttention(Layer):
         ctx = jnp.einsum("bhqc,bhcd->bhqd", probs, vview)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, T, D)
         ctx = constrain(ctx, None, None, "model")
-        return self.out(ctx), {"k": new_k, "v": new_v}
+        out = {"k": new_k, "v": new_v}
+        if quantized:
+            out["k_scale"], out["v_scale"] = new_ks, new_vs
+        return self.out(ctx), out
 
 
 class ParallelMLP(Layer):
@@ -337,6 +386,14 @@ class GPTModel(Layer):
         #: microbatch count for the pipeline schedule (None → pp); set by
         #: Model.prepare from strategy.pipeline_configs["accumulate_steps"]
         self.pipeline_microbatches = None
+        if getattr(cfg, "quantization", "none") != "none":
+            # quantize the parallel-linear weights in place (int8/fp8 +
+            # per-channel scale buffers); their forwards dispatch on the
+            # weight dtype, so no layer swap is needed.  Lazy import:
+            # slim ↔ models would otherwise cycle.
+            from ..slim.quantization import quantize_weights
+
+            quantize_weights(self, cfg.quantization)
 
     def forward(self, input_ids, attn_mask=None):
         from ..distributed.pipeline_parallel import (
@@ -430,18 +487,33 @@ class GPTModel(Layer):
         (common system prompts prefill once), and returned to a free
         list at eviction.  Index ``P`` (the last page) is the write-DROP
         page: padding tokens scatter there and nothing ever gathers it,
-        so every call keeps static shapes with no dynamic masking."""
+        so every call keeps static shapes with no dynamic masking.
+
+        ``dtype=int8`` (or ``float8_e4m3fn``) switches the pool to
+        QUANTIZED KV pages: each layer additionally holds per-entry
+        ``k_scale``/``v_scale`` ``[P+1, H, page]`` float32 tensors (one
+        scale per written token per head), K/V quantize on write in
+        :meth:`forward_paged`'s scatter and dequantize on gather in
+        attention — the same HBM budget holds ~2-4× the tokens, and the
+        host-side page table / CoW machinery is untouched (table edits
+        are dtype-blind)."""
         cfg = self.cfg
         hd = cfg.hidden_size // cfg.num_heads
         dt = dtype or cfg.dtype
         P, pg = int(num_pages), int(page_size)
-        return {
-            "layers": [
-                {"k": jnp.zeros((P + 1, cfg.num_heads, pg, hd), dt),
+        quantized = str(jnp.dtype(dt)) in ("int8", "float8_e4m3fn")
+
+        def layer():
+            l = {"k": jnp.zeros((P + 1, cfg.num_heads, pg, hd), dt),
                  "v": jnp.zeros((P + 1, cfg.num_heads, pg, hd), dt)}
-                for _ in range(cfg.num_layers)
-            ],
-        }
+            if quantized:
+                l["k_scale"] = jnp.zeros((P + 1, cfg.num_heads, pg),
+                                         jnp.float32)
+                l["v_scale"] = jnp.zeros((P + 1, cfg.num_heads, pg),
+                                         jnp.float32)
+            return l
+
+        return {"layers": [layer() for _ in range(cfg.num_layers)]}
 
     def copy_pages(self, cache, src, dst):
         """Copy whole pages ``src[i] → dst[i]`` inside the pool — the
@@ -455,10 +527,11 @@ class GPTModel(Layer):
         dst = jnp.asarray(dst, jnp.int32)
         P = cache["layers"][0]["k"].shape[0] - 1
         dst = jnp.where(dst >= 0, dst, P)
+        # every per-layer tensor is page-major, so one indexed copy per
+        # key covers quantized pools' k_scale/v_scale for free
         return {
             "layers": [
-                {"k": l["k"].at[dst].set(l["k"][src]),
-                 "v": l["v"].at[dst].set(l["v"][src])}
+                {key: t.at[dst].set(t[src]) for key, t in l.items()}
                 for l in cache["layers"]
             ],
         }
@@ -470,12 +543,23 @@ class GPTModel(Layer):
         reads the all-zero write-drop page, so the op always runs at one
         static shape).  Returns one stacked ``[L, 2, K, H, page, hd]``
         array (layer-major, k/v interleaved) so the hand-off rides a
-        single host transfer instead of ``2L`` small ones."""
+        single host transfer instead of ``2L`` small ones.
+
+        Quantized pools return ``(pages, scales)`` — the quantized
+        ``[L, 2, K, H, page, hd]`` stack plus its ``[L, 2, K, H, page]``
+        float32 scale stack — so a hand-off never round-trips through
+        float (the adopting engine's pool stores the exact same bits)."""
         P = cache["layers"][0]["k"].shape[0] - 1
         idx = jnp.asarray(idx, jnp.int32)
         idx = jnp.where(idx >= 0, idx, P)
-        return jnp.stack([jnp.stack([l["k"][idx], l["v"][idx]])
-                          for l in cache["layers"]])
+        out = jnp.stack([jnp.stack([l["k"][idx], l["v"][idx]])
+                         for l in cache["layers"]])
+        if "k_scale" in cache["layers"][0]:
+            scales = jnp.stack(
+                [jnp.stack([l["k_scale"][idx], l["v_scale"][idx]])
+                 for l in cache["layers"]])
+            return out, scales
+        return out
 
     def scatter_pages(self, cache, kv, dst):
         """Write :meth:`gather_pages` payloads into the pool — the import
@@ -483,18 +567,33 @@ class GPTModel(Layer):
         export and ``dst`` the ``[K]`` int32 target pages the adopting
         host allocated (``-1`` lands in the write-drop page).  Same
         static-shape contract as :meth:`copy_pages`, so the adopting
-        engine's compile set stays closed."""
+        engine's compile set stays closed.
+
+        For a quantized pool ``kv`` is the ``(pages, scales)`` pair
+        :meth:`gather_pages` exported."""
+        scales = None
+        if isinstance(kv, (tuple, list)):
+            kv, scales = kv
+            scales = jnp.asarray(scales)
         kv = jnp.asarray(kv)
         dst = jnp.asarray(dst, jnp.int32)
         P = cache["layers"][0]["k"].shape[0] - 1
         dst = jnp.where(dst >= 0, dst, P)
-        return {
-            "layers": [
-                {"k": l["k"].at[dst].set(kv[i, 0].astype(l["k"].dtype)),
-                 "v": l["v"].at[dst].set(kv[i, 1].astype(l["v"].dtype))}
-                for i, l in enumerate(cache["layers"])
-            ],
-        }
+        new_layers = []
+        for i, l in enumerate(cache["layers"]):
+            nl = {"k": l["k"].at[dst].set(kv[i, 0].astype(l["k"].dtype)),
+                  "v": l["v"].at[dst].set(kv[i, 1].astype(l["v"].dtype))}
+            if "k_scale" in l:
+                if scales is None:
+                    raise ValueError(
+                        "scatter_pages: quantized pool needs the "
+                        "(pages, scales) pair gather_pages exported")
+                nl["k_scale"] = l["k_scale"].at[dst].set(
+                    scales[i, 0].astype(jnp.float32))
+                nl["v_scale"] = l["v_scale"].at[dst].set(
+                    scales[i, 1].astype(jnp.float32))
+            new_layers.append(nl)
+        return {"layers": new_layers}
 
     def forward_paged(self, input_ids, positions, pos_map, table, cache):
         """Prefill/decode forward over :meth:`init_paged_cache` state.

@@ -12,6 +12,9 @@ that beat the compiler, plus the autotuner that picks their tile sizes:
   (fused_conv1x1_bn.py);
 * ``grouped_matmul`` — one masked matmul over the MoE experts' ragged
   capacity-bucketed row groups (grouped_matmul.py);
+* ``quantized_matmul`` / ``fp8_matmul`` — int8×int8→int32 (and
+  fp8-e4m3) matmul with the dequant + bias epilogue fused, the serving
+  quantization hot path (quantized_matmul.py);
 * ``layernorm_residual`` — residual add + LayerNorm in one HBM pass
   (fused_layernorm.py);
 * ``softmax_cross_entropy`` — online-logsumexp label cross-entropy that
@@ -30,4 +33,9 @@ from .flash_attention import (  # noqa: F401
 from .fused_conv1x1_bn import conv1x1_bn_relu, conv1x1_bn_stats  # noqa: F401
 from .fused_layernorm import layernorm_residual  # noqa: F401
 from .grouped_matmul import grouped_matmul  # noqa: F401
+from .quantized_matmul import (  # noqa: F401
+    fp8_matmul,
+    quantized_linear,
+    quantized_matmul,
+)
 from .fused_softmax_xent import softmax_cross_entropy  # noqa: F401

@@ -86,7 +86,7 @@ from ..resilience import retry as _retry_mod
 from ..resilience.faults import fault_point
 from .batcher import MicroBatcher, Request
 from .metrics import (HANDOFF_COUNTERS, MOE_COUNTERS, PAGED_COUNTERS,
-                      ServingMetrics, SLOT_COUNTERS)
+                      QUANT_COUNTERS, ServingMetrics, SLOT_COUNTERS)
 from .paging import PagePool
 
 __all__ = ["GenerationEngine", "KVHandoff"]
@@ -111,7 +111,10 @@ class KVHandoff(NamedTuple):
 
     prompt: np.ndarray    # [length] int32 prompt tokens
     first_token: int      # greedy token from the prompt's last logit
-    kv: np.ndarray        # [layers, 2, K, heads, page, hd] exported pages
+    kv: object            # [layers, 2, K, heads, page, hd] exported pages
+    #                       (array), or a (pages, scales) pair when the
+    #                       donor pool is quantized — both engines must
+    #                       share the same `quantized` mode
     length: int           # resident KV covers positions 0..length-1
     done: bool            # True: no decode needed (budget 1 / EOS)
 
@@ -145,6 +148,18 @@ class GenerationEngine:
     DOWN to hold more slots in the same budget, the whole point of
     paging).  ``kv_page_size`` / ``speculative_k`` default to
     ``FLAGS_kv_page_size`` / ``FLAGS_speculative_k``.
+
+    ``quantized`` — serve at reduced precision (``'int8'`` / ``'fp8'``):
+    the bound weight trees are quantized once at construction
+    (``slim.quantize_model_trees`` — the model object keeps its float
+    weights), Linear hot paths dispatch to ``ops.quantized_matmul``, and
+    in paged mode the KV page pool stores int8/fp8 pages with per-token
+    scale planes (quantize-on-write, dequantize-on-gather), so the same
+    HBM budget holds ~4x (int8 vs f32) the resident pages.  The whole
+    compile set is traced at low precision in :meth:`warmup` — the
+    zero-post-warmup-recompile guarantee carries over unchanged — and
+    :meth:`swap_weights` hot-swaps ``slim.export_quantized`` artifacts
+    with zero recompiles.
     """
 
     @classmethod
@@ -169,6 +184,8 @@ class GenerationEngine:
                 kw[k] = bool(config[k])
         if config.get("role"):
             kw["role"] = str(config["role"])
+        if config.get("quantization") not in (None, "none"):
+            kw["quantized"] = str(config["quantization"])
         kw.update(overrides)
         return cls(model, **kw)
 
@@ -184,6 +201,7 @@ class GenerationEngine:
                  kv_page_size: Optional[int] = None,
                  speculative_k: Optional[int] = None,
                  role: str = "any",
+                 quantized: Optional[str] = None,
                  name: Optional[str] = None):
         if name is None:
             _gen_counter[0] += 1
@@ -191,8 +209,23 @@ class GenerationEngine:
         self.name = name
         self._model = model
         model.eval()
-        self._params = model.param_pytree()
-        self._buffers = model.buffer_pytree()
+        if quantized is not None and quantized not in ("int8", "fp8"):
+            raise InvalidArgumentError(
+                f"quantized must be None, 'int8' or 'fp8', got "
+                f"{quantized!r}")
+        self._quantized = quantized
+        if quantized is not None:
+            # quantize once at construction, into the bound trees — the
+            # model object keeps its float weights (training / other
+            # engines untouched); the executables only ever see the
+            # quantized leaves, so the compile set is quantized end to end
+            from ..slim.quantization import quantize_model_trees
+            self._params, self._buffers = quantize_model_trees(
+                model, quantized)
+        else:
+            self._params = model.param_pytree()
+            self._buffers = model.buffer_pytree()
+        self._quant_active = self._tree_quant_active(self._params)
         self._buckets = sorted({int(b) for b in prompt_buckets})
         if not self._buckets or self._buckets[0] < 1:
             raise InvalidArgumentError(
@@ -237,6 +270,7 @@ class GenerationEngine:
             # a single executable regardless of prompt length
             self._Gh = -(-self._buckets[-1] // self._page)
         self._warm = False
+        self._quant_fallback = 0
         self._traces: Dict[str, int] = {"prefill": 0, "decode": 0,
                                         "admit": 0, "evict": 0, "cow": 0,
                                         "export": 0, "import": 0}
@@ -253,6 +287,8 @@ class GenerationEngine:
                  if self._paged else SLOT_COUNTERS)
         if self._moe_experts:
             extra = extra + MOE_COUNTERS
+        if self._quantized:
+            extra = extra + QUANT_COUNTERS
         self.metrics = ServingMetrics(name, extra_counters=extra)
 
         mdl, traces = model, self._traces
@@ -508,11 +544,11 @@ class GenerationEngine:
             # one).  Inert -1 page indices hit only the write-drop page.
             idx0 = np.full((self._Gh,), -1, np.int32)
             if self._role == "prefill":
-                np.asarray(self._export(cache, idx0))
+                # device_get, not np.asarray: a quantized pool exports a
+                # (pages, scales) pair, not a single array
+                jax.device_get(self._export(cache, idx0))
             elif self._role == "decode":
-                cache = self._import(
-                    cache, np.zeros(self._handoff_shape(),
-                                    self._model.gpt.cfg.dtype), idx0)
+                cache = self._import(cache, self._handoff_zero(), idx0)
         elif self._continuous:
             # warmup must mirror LIVE argument placement, not just shapes:
             # tok/cache enter every live call as jit outputs (committed),
@@ -553,6 +589,7 @@ class GenerationEngine:
         # dummy-data routing never lands in the post-warm S606 window
         self._moe_pending = None
         self._warm = True  # starvation after this point is S603 material
+        self._emit_quant()
         return self.compile_count
 
     # -- MoE routing-health tap --------------------------------------------
@@ -597,6 +634,101 @@ class GenerationEngine:
         if int(self._moe_routed_cum.sum()) > 0:
             m.set_gauge("moe_dead_experts",
                         int((self._moe_routed_cum == 0).sum()))
+
+    # -- quantized serving ---------------------------------------------------
+    @staticmethod
+    def _tree_quant_active(params) -> bool:
+        """True iff the bound parameter tree carries any int8/fp8 leaf —
+        the executables' dtype-dispatched Linear forwards take the
+        quantized leg exactly when this holds."""
+        from ..slim.quantization import _is_quantized_dtype
+        return any(_is_quantized_dtype(getattr(leaf, "dtype", None))
+                   for leaf in jax.tree_util.tree_leaves(params))
+
+    def _kv_qdtype(self):
+        """Page-pool storage dtype for this engine's quantization mode
+        (``None`` = the model's float dtype, the pre-quantization pool)."""
+        if self._quantized == "int8":
+            return jnp.int8
+        if self._quantized == "fp8":
+            return jnp.float8_e4m3fn
+        return None
+
+    def _handoff_zero(self):
+        """An all-zeros hand-off payload matching what
+        :meth:`GPTModel.gather_pages` exports from this engine's pool —
+        a plain array for float pools, a ``(pages, scales)`` pair for
+        quantized ones (warmup's import trace must see the live pytree
+        structure or adoption would retrace on first use)."""
+        shape = self._handoff_shape()
+        qdt = self._kv_qdtype()
+        if qdt is None:
+            return np.zeros(shape, self._model.gpt.cfg.dtype)
+        return (np.zeros(shape, np.dtype(qdt)),
+                np.zeros(shape[:-1], np.float32))
+
+    def _note_quant_step(self):
+        """Per-decode-step fallback bookkeeping for quantized engines: a
+        post-warmup step dispatched while the bound tree is NOT quantized
+        silently runs float math — count it (rule Q801's engine signal)."""
+        if self._quantized and self._warm and not self._quant_active:
+            self.metrics.incr("quant_fallback_steps_after_warm")
+            self._quant_fallback += 1
+            if self._quant_fallback == 1 or self._quant_fallback % 100 == 0:
+                self._emit_quant()
+
+    def _emit_quant(self):
+        """Publish the engine-side quantization snapshot on the event bus
+        (``("quant", <engine>)`` — latest-value semantics, consumed by
+        ``analysis.RetraceMonitor.quant_stats`` / rule Q801)."""
+        if not self._quantized:
+            return
+        from ..framework import trace_events
+        if not trace_events.active():
+            return
+        trace_events.notify(("quant", self.name), {
+            "kind": "engine", "mode": self._quantized,
+            "quant_active": bool(self._quant_active),
+            "fallback_steps_after_warm": int(self._quant_fallback)})
+
+    def swap_weights(self, params_file: str) -> None:
+        """Hot-swap the served weights from a ``.pdiparams`` side-file —
+        e.g. a ``slim.export_quantized`` artifact — with ZERO recompiles:
+        params/buffers are executable *arguments*, so any file whose tree
+        structure and leaf shapes/dtypes match the currently bound trees
+        slots straight into the next dispatch.  A mismatched file (wrong
+        model, wrong quantization mode) is rejected before it can poison
+        in-flight batches.  Same contract as ``Predictor.swap_weights``;
+        ``Router.swap_weights_rolling`` drives this one replica at a
+        time behind drained traffic."""
+        from ..framework import serialization
+        state = serialization.load(params_file)
+        if not isinstance(state, dict) or "params" not in state:
+            raise InvalidArgumentError(
+                f"{params_file} is not a params side-file")
+        tag = state.get("quantization")
+        if tag is not None and tag != (self._quantized or "none"):
+            raise InvalidArgumentError(
+                f"{self.name}: {params_file} is a {tag!r}-quantized "
+                f"artifact but this engine serves "
+                f"{self._quantized or 'none'!r}")
+        new_p = jax.tree_util.tree_map(np.asarray, state["params"])
+        new_b = jax.tree_util.tree_map(np.asarray, state.get("buffers", {}))
+        for part, old, new in (("params", self._params, new_p),
+                               ("buffers", self._buffers, new_b)):
+            old_s = jax.tree_util.tree_map(
+                lambda a: (a.shape, np.dtype(a.dtype).name), old)
+            new_s = jax.tree_util.tree_map(
+                lambda a: (a.shape, np.dtype(a.dtype).name), new)
+            if old_s != new_s:
+                raise InvalidArgumentError(
+                    f"swap_weights: {params_file} {part} do not match "
+                    f"the served model (different tree structure or "
+                    f"leaf shapes/dtypes)")
+        self._params, self._buffers = new_p, new_b
+        self._quant_active = self._tree_quant_active(new_p)
+        self.metrics.publish({"weight_swap": 1})
+        self._emit_quant()
 
     # -- continuous scheduler ------------------------------------------------
     def _init_state(self):
@@ -688,7 +820,8 @@ class GenerationEngine:
             self._params, self._buffers,
             self._pack_step(np.zeros((B, T), np.int32),
                             np.full((B, T), -1, np.int32)),
-            self._model.gpt.init_paged_cache(self._kv_pages, self._page))
+            self._model.gpt.init_paged_cache(self._kv_pages, self._page,
+                                             dtype=self._kv_qdtype()))
         return cache
 
     def _pack_step(self, ids: np.ndarray, positions: np.ndarray,
@@ -898,10 +1031,14 @@ class GenerationEngine:
                             npg = -(-hand.length // page)
                             dst = np.full((self._Gh,), -1, np.int32)
                             dst[:npg] = pool.table[i, :npg]
+                            # quantized pools hand off (pages, scales)
+                            # pairs; float pools a single array
+                            kvp = (tuple(hand.kv)
+                                   if isinstance(hand.kv, (tuple, list))
+                                   else np.asarray(hand.kv))
                             with profiler.RecordEvent(
                                     f"{self.name}/adopt"):
-                                cache = self._import(
-                                    cache, np.asarray(hand.kv), dst)
+                                cache = self._import(cache, kvp, dst)
                             t = int(hand.first_token)
                             slots[i] = {"req": r, "budget": budget,
                                         "out": [t], "t0": now,
@@ -1006,7 +1143,8 @@ class GenerationEngine:
                                 idx[:npg] = pool.table[i, :npg]
                                 with profiler.RecordEvent(
                                         f"{self.name}/export"):
-                                    kvh = np.asarray(
+                                    # tuple-shaped for quantized pools
+                                    kvh = jax.device_get(
                                         self._export(cache, idx))
                                 s["out"].append(t)
                                 s["result"] = KVHandoff(
@@ -1155,6 +1293,7 @@ class GenerationEngine:
                             it_wide = (dt if it_wide is None
                                        else 0.8 * it_wide + 0.2 * dt)
                         self.metrics.incr("decode_steps")
+                        self._note_quant_step()
                         self.metrics.observe_occupancy(len(live) / B)
                         now = time.monotonic()
                         n_evicted = 0
@@ -1429,6 +1568,7 @@ class GenerationEngine:
                         for i in live:
                             pos[i] += 1
                         self.metrics.incr("decode_steps")
+                        self._note_quant_step()
                         self.metrics.observe_occupancy(len(live) / B)
                         dispatched = True
 
@@ -1650,10 +1790,19 @@ class GenerationEngine:
         """Re-snapshot weights from the live model (e.g. after
         ``paddle_tpu.load`` into it) — the next batch (legacy) or device
         dispatch (continuous) serves them, zero recompiles (params are
-        executable arguments)."""
-        self._params = self._model.param_pytree()
-        self._buffers = self._model.buffer_pytree()
+        executable arguments).  Quantized engines re-quantize the fresh
+        float weights on the way in, so the tree shapes/dtypes the
+        executables were traced against are preserved."""
+        if self._quantized:
+            from ..slim.quantization import quantize_model_trees
+            self._params, self._buffers = quantize_model_trees(
+                self._model, self._quantized)
+        else:
+            self._params = self._model.param_pytree()
+            self._buffers = self._model.buffer_pytree()
+        self._quant_active = self._tree_quant_active(self._params)
         self.metrics.publish({"weight_swap": 1})
+        self._emit_quant()
 
     def stats(self) -> dict:
         snap = self.metrics.snapshot()
@@ -1662,6 +1811,7 @@ class GenerationEngine:
         snap["continuous"] = self._continuous
         snap["paged"] = self._paged
         snap["role"] = self._role
+        snap["quantization"] = self._quantized or "none"
         if self._paged and self._pool is not None:
             snap.update(self._pool.stats())
         return snap

@@ -70,6 +70,13 @@ MOE_COUNTERS = ("moe_routed_tokens", "moe_dropped_tokens",
                 "moe_sampled_steps_after_warm",
                 "moe_overflow_steps_after_warm")
 
+#: quantized-serving counters (``GenerationEngine(quantized=...)``):
+#: post-warmup decode steps served while the bound weight tree was NOT
+#: quantized (a float tree slipped past the quantize hook, so every step
+#: silently pays dequantize-free float math at quantized prices) — rule
+#: Q801's engine-side signal.
+QUANT_COUNTERS = ("quant_fallback_steps_after_warm",)
+
 
 def _quantile(sorted_vals, q: float) -> float:
     """Nearest-rank quantile with the CEIL rank convention: the q-th

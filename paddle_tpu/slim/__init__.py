@@ -16,8 +16,12 @@ from .quantization import (  # noqa: F401
     PostTrainingQuantization,
     QuantizedConv2D,
     QuantizedLinear,
+    export_quantized,
     fake_quant_dequant,
+    quantize_model_trees,
+    quantize_to_fp8,
     quantize_to_int8,
+    quantize_weights,
 )
 
 __all__ = quantization.__all__

@@ -36,9 +36,27 @@ __all__ = [
     "fake_quant_dequant", "FakeQuantAbsMax", "FakeQuantMovingAverage",
     "FakeQuantChannelWiseAbsMax", "MovingAverageAbsMaxScale",
     "QuantizedConv2D", "QuantizedLinear", "ImperativeQuantAware",
-    "quantize_to_int8", "Int8Linear", "Int8Conv2D",
-    "PostTrainingQuantization",
+    "quantize_to_int8", "quantize_to_fp8", "Int8Linear", "Int8Conv2D",
+    "PostTrainingQuantization", "quantize_weights",
+    "quantize_model_trees", "export_quantized",
 ]
+
+#: serving quantization modes (``GPTConfig.quantization`` values minus
+#: "none"); fp8 is the e4m3 convention of Micikevicius et al. 2022
+QUANT_MODES = ("int8", "fp8")
+
+#: largest finite float8_e4m3fn value — e4m3fn has no inf, overflow on
+#: cast becomes NaN, so quantizers must clip to ±448 BEFORE the cast
+FP8_E4M3_MAX = 448.0
+
+
+def _notify_quant(name, **info):
+    """Latest-value ``("quant", <site>)`` telemetry on the event bus —
+    RetraceMonitor.quant_stats() / rule Q801 consume these snapshots."""
+    from ..framework import trace_events
+
+    if trace_events.active():
+        trace_events.notify(("quant", name), dict(info))
 
 
 def fake_quant_dequant(x, scale, bits=8):
@@ -270,9 +288,17 @@ class ImperativeQuantAware:
         QuantizationFreezePass + ConvertToInt8Pass in one step)."""
         from .. import nn
 
-        for name, layer in list(model.named_sublayers()):
-            if not isinstance(layer, (QuantizedConv2D, QuantizedLinear)):
-                continue
+        targets = [(n, l) for n, l in list(model.named_sublayers())
+                   if isinstance(l, (QuantizedConv2D, QuantizedLinear))]
+        stale = sum(
+            1 for _, l in targets
+            if hasattr(l._fake_quant_input, "_state")
+            and float(jnp.asarray(
+                l._fake_quant_input._state.value).reshape(())) == 1.0)
+        _notify_quant("qat", kind="calibration", layers=len(targets),
+                      calibrated=len(targets) - stale,
+                      uncalibrated_layers=stale)
+        for name, layer in targets:
             act_q = layer._fake_quant_input
             if not hasattr(act_q, "scale"):
                 raise InvalidArgumentError(
@@ -305,6 +331,158 @@ def quantize_to_int8(w, channel_axis=None):
     scale = jnp.maximum(scale, 1e-9)
     q = jnp.clip(jnp.round(wf / scale * 127.0), -127, 127).astype(jnp.int8)
     return q, scale
+
+
+def quantize_to_fp8(w, channel_axis=None):
+    """w (float) → (fp8-e4m3 weights, float32 scale) by (channel-wise)
+    abs-max, mirroring :func:`quantize_to_int8`: dequant is
+    ``q * scale / FP8_E4M3_MAX``.  The clip BEFORE the cast matters:
+    e4m3fn has no inf, so an overflowing cast silently produces NaN."""
+    wf = jnp.asarray(w, jnp.float32)
+    if channel_axis is None:
+        scale = jnp.max(jnp.abs(wf))
+    else:
+        axes = tuple(i for i in range(wf.ndim) if i != channel_axis)
+        scale = jnp.max(jnp.abs(wf), axis=axes, keepdims=True)
+    scale = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(wf / scale * FP8_E4M3_MAX,
+                 -FP8_E4M3_MAX, FP8_E4M3_MAX).astype(jnp.float8_e4m3fn)
+    return q, scale
+
+
+_QUANT_DTYPES = ("int8", "float8_e4m3fn")
+
+
+def _is_quantized_dtype(dtype) -> bool:
+    return str(jnp.dtype(dtype)) in _QUANT_DTYPES
+
+
+def _quantize_weight(w, mode):
+    """One [in, out] weight → (quantized weight, [out] float32 dequant
+    multiplier): ``w ≈ w_q.astype(f32) * weight_scale`` per channel."""
+    if mode == "int8":
+        q, scale = quantize_to_int8(w, channel_axis=w.ndim - 1)
+        return q, (scale / 127.0).reshape(-1).astype(jnp.float32)
+    if mode == "fp8":
+        q, scale = quantize_to_fp8(w, channel_axis=w.ndim - 1)
+        return q, (scale / FP8_E4M3_MAX).reshape(-1).astype(jnp.float32)
+    raise InvalidArgumentError(
+        f"quantization mode must be one of {QUANT_MODES}, got {mode!r}")
+
+
+def _serving_targets(model):
+    """The Linear hot paths the quantized serving stack routes: every
+    tensor-parallel linear (GPT qkv/out/fc1/fc2, BERT attention + the
+    shared ParallelMLP all build on these two classes)."""
+    from ..distributed.meta_parallel import (ColumnParallelLinear,
+                                             RowParallelLinear)
+
+    return [(n, l) for n, l in model.named_sublayers(include_self=True)
+            if isinstance(l, (ColumnParallelLinear, RowParallelLinear))]
+
+
+def quantize_weights(model, mode="int8"):
+    """Quantize a model's parallel-linear weights IN PLACE for serving.
+
+    Each ColumnParallelLinear / RowParallelLinear weight becomes an int8
+    (or fp8-e4m3) tensor plus a per-output-channel ``weight_scale``
+    buffer; the layers' forwards dispatch on the weight dtype, so the
+    swap needs no layer replacement.  Idempotent: already-quantized
+    layers are left alone.  Returns the model."""
+    if mode not in QUANT_MODES:
+        raise InvalidArgumentError(
+            f"quantization mode must be one of {QUANT_MODES}, got {mode!r}")
+    for _, layer in _serving_targets(model):
+        w = layer.weight.value
+        if _is_quantized_dtype(w.dtype):
+            continue
+        wq, ws = _quantize_weight(w, mode)
+        spec = getattr(layer.weight, "partition_spec", None)
+        layer.weight.value = wq
+        if "weight_scale" in layer._buffers:
+            layer.weight_scale.value = ws
+        else:
+            layer.register_buffer("weight_scale", ws)
+        if spec is not None:
+            layer.weight.partition_spec = spec
+    return model
+
+
+def quantize_model_trees(model, mode="int8"):
+    """Non-mutating tree quantization for serving engines: returns
+    ``(params, buffers)`` flat pytrees with the parallel-linear weights
+    quantized and ``weight_scale`` entries filled in, while the model's
+    own weights stay float.
+
+    The scale BUFFER BOXES are registered on the model when absent —
+    ``functional_call`` binds tree leaves by dotted name onto existing
+    boxes only.  That registration is benign for float engines sharing
+    the model: the float forward never reads the scales, and a
+    same-structure float tree simply carries the unit scales along.
+    This is what lets ``tuning.serving_space`` sweep the quantization
+    dial none→int8→fp8 over ONE model without cross-candidate damage."""
+    if mode not in QUANT_MODES:
+        raise InvalidArgumentError(
+            f"quantization mode must be one of {QUANT_MODES}, got {mode!r}")
+    targets = _serving_targets(model)
+    for _, layer in targets:
+        if "weight_scale" not in layer._buffers:
+            layer.register_buffer(
+                "weight_scale",
+                jnp.ones((layer.weight.value.shape[-1],), jnp.float32))
+    params = model.param_pytree()
+    buffers = model.buffer_pytree()
+    for name, layer in targets:
+        dot = f"{name}." if name else ""
+        wkey, skey = f"{dot}weight", f"{dot}weight_scale"
+        w = params[wkey]
+        if _is_quantized_dtype(w.dtype):
+            continue
+        wq, ws = _quantize_weight(w, mode)
+        params[wkey] = wq
+        buffers[skey] = ws
+    return params, buffers
+
+
+def export_quantized(model, path, mode="int8"):
+    """Write a quantized weight artifact: ``<path>.pdiparams`` holding
+    the quantized params/buffers trees (plus the mode tag), and a
+    ``<path>.pdiparams.manifest.json`` sidecar carrying the artifact's
+    sha256 — the same integrity convention the checkpoint manifest uses.
+
+    The artifact is a drop-in for ``Predictor.swap_weights`` /
+    ``GenerationEngine.swap_weights`` / ``Router.swap_weights_rolling``
+    against an engine built with the matching ``quantized=`` mode: the
+    trees keep the exact (shape, dtype) structure those engines compiled
+    against, so the hot swap costs zero recompiles.  Returns the
+    ``.pdiparams`` path."""
+    import json
+    import os
+
+    from ..framework import serialization
+    from ..incubate.checkpoint import _sha256
+
+    params, buffers = quantize_model_trees(model, mode)
+    prefix = (path[: -len(".pdiparams")]
+              if path.endswith(".pdiparams") else path)
+    artifact = prefix + ".pdiparams"
+    serialization.save(
+        {"params": params, "buffers": buffers, "quantization": mode},
+        artifact)
+    manifest = {
+        "format": "paddle_tpu.quantized_weights.v1",
+        "quantization": mode,
+        "file": os.path.basename(artifact),
+        "sha256": _sha256(artifact),
+        "num_params": len(params),
+        "num_buffers": len(buffers),
+    }
+    mpath = artifact + ".manifest.json"
+    tmp = mpath + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    os.replace(tmp, mpath)
+    return artifact
 
 
 class Int8Linear(Layer):
@@ -419,7 +597,8 @@ class PostTrainingQuantization:
         name_map = {"Conv2D": nn.Conv2D, "Linear": nn.Linear}
         self._types = tuple(name_map[t] if isinstance(t, str) else t
                             for t in quantizable_layer_type)
-        self._stats = {}   # layer name → list of batch abs-max
+        self._stats = {}   # layer name → list of host batch abs-max floats
+        self._pending = {}  # layer name → list of DEVICE abs-max scalars
         self._targets = {n: l for n, l in model.named_sublayers()
                          if isinstance(l, self._types)}
         self._hooks = []
@@ -430,15 +609,34 @@ class PostTrainingQuantization:
     def _make_hook(self, name):
         def hook(layer, inputs):
             x = inputs[0]
-            self._stats.setdefault(name, []).append(
-                float(jnp.max(jnp.abs(jnp.asarray(x, jnp.float32)))))
+            # accumulate the per-layer abs-max ON DEVICE: a float() here
+            # would force one blocking device→host sync per quantizable
+            # layer per batch (the calibration host-sync storm); collect()
+            # drains the whole pending tree in a single transfer instead
+            self._pending.setdefault(name, []).append(
+                jnp.max(jnp.abs(jnp.asarray(x, jnp.float32))))
             return None
         return hook
 
     def collect(self, *batch):
-        """Run one calibration batch through the model (eval mode)."""
+        """Run one calibration batch through the model (eval mode), then
+        sync every layer's pending device maxima in ONE transfer."""
         self._model.eval()
-        return self._model(*batch)
+        out = self._model(*batch)
+        pending, self._pending = self._pending, {}
+        if pending:
+            host = jax.device_get(pending)
+            for name, vals in host.items():
+                self._stats.setdefault(name, []).extend(
+                    float(v) for v in vals)
+        self._emit_calibration()
+        return out
+
+    def _emit_calibration(self):
+        seen = sum(1 for n in self._targets if self._stats.get(n))
+        _notify_quant("ptq", kind="calibration",
+                      layers=len(self._targets), calibrated=seen,
+                      uncalibrated_layers=len(self._targets) - seen)
 
     def quantize(self):
         """Freeze observed scales into Int8 layers; returns the model."""
@@ -446,6 +644,7 @@ class PostTrainingQuantization:
 
         for h in self._hooks:
             h.remove()
+        self._emit_calibration()  # final snapshot feeds rule Q801
         for name, layer in self._targets.items():
             obs = self._stats.get(name)
             if not obs:

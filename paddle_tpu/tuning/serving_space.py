@@ -16,7 +16,8 @@ A candidate config is JSON-plain and maps onto
 ``GenerationEngine.from_tuned`` / ``InferenceEngine.from_tuned``::
 
     {"buckets": [16, 48], "batch_size": 8, "max_queue_delay_ms": 1.0,
-     "kv_page_size": 64, "speculative_k": 4, "paged": 1}
+     "kv_page_size": 64, "speculative_k": 4, "paged": 1,
+     "quantization": "int8"}
 
 Winners persist in the shared tuning cache keyed
 ``serving | tag | trace digest | mesh | device_kind`` — a tuned config
@@ -39,6 +40,10 @@ DIAL_SWEEPS = {
     "max_queue_delay_ms": (0.5, 1.0, 2.0, 5.0),
     "kv_page_size": (32, 64, 128),
     "speculative_k": (0, 2, 4),
+    # serving precision is a measured dial like any other: the replay
+    # scores quantized candidates on the same trace, so int8/fp8 wins
+    # only where its tokens/s actually beats the float engine's
+    "quantization": ("none", "int8", "fp8"),
 }
 
 

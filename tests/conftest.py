@@ -56,6 +56,9 @@ def pytest_configure(config):
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
+        if (item.get_closest_marker("fast")
+                or item.get_closest_marker("slow")):
+            continue  # an explicit per-test lane beats the file default
         fname = os.path.basename(item.nodeid.split("::")[0])
         item.add_marker(
             pytest.mark.slow if fname in SLOW_FILES else pytest.mark.fast)

@@ -471,3 +471,55 @@ class TestZeroFalsePositives:
             cwd=REPO, capture_output=True, text=True,
             env={**os.environ, "JAX_PLATFORMS": "cpu"})
         assert r.returncode == 0, r.stdout + r.stderr
+
+
+class _QuantMLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 8)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+class TestQuantGuard:
+    """Q801: quantization integrity (engine fallback / stale observers)."""
+
+    def test_q801_engine_fallback(self):
+        from paddle_tpu.framework import trace_events
+        with RetraceMonitor() as mon:
+            # the snapshot a quantized GenerationEngine emits when
+            # post-warmup decode steps run with a float tree bound
+            trace_events.notify(("quant", "engine#q"), {
+                "kind": "engine", "mode": "int8", "quant_active": False,
+                "fallback_steps_after_warm": 5})
+        assert mon.quant_stats("engine#q")["fallback_steps_after_warm"] == 5
+        diags = [d for d in mon.diagnostics() if d.rule == "Q801"]
+        assert len(diags) == 1
+        assert "non-quantized weight tree" in diags[0].message
+        assert "swap_weights" in diags[0].hint
+
+    def test_q801_uncalibrated_observers(self):
+        from paddle_tpu.framework.errors import InvalidArgumentError
+        from paddle_tpu.slim import PostTrainingQuantization
+        with RetraceMonitor() as mon:
+            ptq = PostTrainingQuantization(_QuantMLP())
+            with pytest.raises(InvalidArgumentError):
+                ptq.quantize()  # zero calibration batches collected
+        diags = [d for d in mon.diagnostics() if d.rule == "Q801"]
+        assert len(diags) == 1
+        assert "uncalibrated" in diags[0].message
+        assert "collect()" in diags[0].hint
+
+    def test_calibrated_and_active_is_silent(self):
+        from paddle_tpu.framework import trace_events
+        from paddle_tpu.slim import PostTrainingQuantization
+        with RetraceMonitor() as mon:
+            ptq = PostTrainingQuantization(_QuantMLP())
+            ptq.collect(paddle.to_tensor(
+                np.ones((4, 8), np.float32)))
+            ptq.quantize()
+            trace_events.notify(("quant", "engine#ok"), {
+                "kind": "engine", "mode": "int8", "quant_active": True,
+                "fallback_steps_after_warm": 0})
+        assert [d for d in mon.diagnostics() if d.rule == "Q801"] == []

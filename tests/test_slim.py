@@ -287,3 +287,81 @@ class TestInt8Export:
         predictor = create_predictor(cfg)
         out = predictor.run([x])[0]
         np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def _tiny_gpt(seed=5):
+    # quantize_weights/export_quantized target the parallel-linear hot
+    # paths (GPT qkv/out/fc1/fc2), not plain nn.Linear
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(seed)
+    m = GPTForCausalLM(GPTConfig(vocab_size=53, hidden_size=32,
+                                 num_layers=2, num_heads=4,
+                                 max_position=32, dropout=0.0))
+    m.eval()
+    return m
+
+
+@pytest.mark.fast  # cheap units in a SLOW_FILES file: tiny GPT, <5s
+class TestQuantizedWeightExport:
+    @pytest.mark.parametrize("mode", ["int8", "fp8"])
+    def test_export_quantized_roundtrip(self, tmp_path, mode):
+        # artifact + sha256 manifest; reloaded trees dequantize back to
+        # the float weights within one quantization step
+        import hashlib
+        import json as _json
+
+        from paddle_tpu.framework import serialization
+        from paddle_tpu.slim import export_quantized
+
+        m = _tiny_gpt()
+        float_params = m.param_pytree()
+        artifact = export_quantized(
+            m, os.path.join(str(tmp_path), "m"), mode=mode)
+        manifest = _json.load(open(artifact + ".manifest.json"))
+        assert manifest["quantization"] == mode
+        assert manifest["format"] == "paddle_tpu.quantized_weights.v1"
+        digest = hashlib.sha256(open(artifact, "rb").read()).hexdigest()
+        assert manifest["sha256"] == digest
+
+        state = serialization.load(artifact)
+        assert state["quantization"] == mode
+        qdt = "int8" if mode == "int8" else "float8_e4m3fn"
+        qkeys = [k for k, v in state["params"].items()
+                 if str(np.asarray(v).dtype) == qdt]
+        # qkv/out/fc1/fc2 per block, 2 blocks
+        assert len(qkeys) == 8
+        for k in qkeys:
+            scale = np.asarray(
+                state["buffers"][k.replace("weight", "weight_scale")])
+            recon = np.asarray(state["params"][k], np.float32) * scale
+            w = np.asarray(float_params[k])
+            amax = np.abs(w).max(axis=tuple(range(w.ndim - 1)))
+            tol = amax / 127 + 1e-6 if mode == "int8" else amax * 0.0625
+            assert (np.abs(recon - w).max(
+                axis=tuple(range(w.ndim - 1))) <= tol).all()
+        # the model itself stays float (export is non-mutating) and
+        # layernorms/embeddings/biases never quantize
+        assert str(np.asarray(float_params[qkeys[0]]).dtype) == "float32"
+        assert all(str(np.asarray(v).dtype) in ("float32", qdt)
+                   for v in state["params"].values())
+
+    def test_quantize_weights_fp8_forward_close(self):
+        # in-place fp8 conversion: logits track float within the e4m3
+        # mantissa budget, weights actually stored as float8_e4m3fn
+        from paddle_tpu.slim import quantize_weights
+
+        m = _tiny_gpt(seed=9)
+        rng = np.random.RandomState(9)
+        ids = paddle.to_tensor(
+            rng.randint(1, 53, size=(2, 12)).astype(np.int32))
+        ref = np.asarray(m(ids))
+        quantize_weights(m, "fp8")
+        qkv = m.gpt.blocks[0].attn.qkv
+        assert str(jnp.asarray(qkv.weight).dtype) == "float8_e4m3fn"
+        # scale buffers ride the per-layer buffer tree (swap contract)
+        assert qkv._buffers["weight_scale"].value.shape == (
+            jnp.asarray(qkv.weight).shape[-1],)
+        out = np.asarray(m(ids))
+        assert out.shape == ref.shape
+        assert np.max(np.abs(out - ref)) <= 0.15 * np.abs(ref).max()
