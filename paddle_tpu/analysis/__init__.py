@@ -1,6 +1,6 @@
 """paddle_tpu.analysis — static analysis for the dual-mode framework.
 
-Four passes over one diagnostics core (see diagnostics.py for the rule
+Five passes over one diagnostics core (see diagnostics.py for the rule
 catalog; README "Static analysis" for examples):
 
 * :func:`verify_program` — walks a recorded ``static.graph.Program``,
@@ -13,12 +13,18 @@ catalog; README "Static analysis" for examples):
 * :class:`RetraceMonitor` — run-time signature-explosion detector over
   ``jit.StaticFunction`` and ``Executor`` (R4xx);
 * :func:`check_plan` — validates a ``fleet.plan.ShardingPlan`` against the
-  mesh before anything hits ``pjit`` (P5xx).
+  mesh before anything hits ``pjit`` (P5xx);
+* :func:`check_concurrency_paths` — AST lock-order / blocking-call /
+  shared-write lint over the framework's OWN threaded source (C10xx);
+  runtime companion in :mod:`paddle_tpu.framework.locking`.
 
 CLI: ``python -m paddle_tpu.analysis <module-or-script> ...`` (or
-``tools/analyze.py``); exits nonzero on error-severity findings.
+``tools/analyze.py``); ``--concurrency <file-or-dir> ...`` runs the
+source-only C10xx sweep; exits nonzero on error-severity findings.
 """
 from .check_plan import check_plan, is_valid_plan  # noqa: F401
+from .concurrency import (  # noqa: F401
+    check_concurrency_paths, check_concurrency_source)
 from .diagnostics import (  # noqa: F401
     RULES, Diagnostic, DiagnosticCollector, Location, Severity, has_errors,
     render_json, render_text)
@@ -33,5 +39,6 @@ __all__ = [
     "render_text", "render_json", "has_errors",
     "verify_program", "lint_function", "lint_source", "lint_module_source",
     "RetraceMonitor", "check_plan", "is_valid_plan",
+    "check_concurrency_source", "check_concurrency_paths",
     "analyze_target", "analyze_module", "main",
 ]

@@ -1,0 +1,766 @@
+"""Concurrency lint over the framework's own threaded source (C10xx).
+
+The other analysis passes audit USER programs (traced graphs, dy2static
+source, sharding plans).  This one audits the framework itself: the
+serving/resilience stack spans ~30 files of worker loops, health sweeps,
+actuator threads and async writers, and a single lock-order inversion
+there is a silent pod-wide hang.  The pass parses each module's AST —
+nothing is imported — and checks four properties:
+
+* **C1001** — a cycle in the static lock-acquisition graph.  ``with
+  self._a:`` nested under ``with self._b:`` adds the edge ``_b -> _a``;
+  edges accumulate across every file of the sweep, and a cycle means two
+  code paths take the same locks in opposite order.  Self-nesting a
+  non-reentrant ``Lock`` is the degenerate one-node cycle.
+* **C1002** — a blocking call made while a lock is held: device syncs
+  (``block_until_ready``), the dispatch/collective sites the resilience
+  layer marks with ``fault_point``, ``queue.get``, thread ``join``,
+  ``sleep``, future ``result``, collective ops, or a ``Condition.wait``
+  taken while some OTHER lock is still held.
+* **C1003** — an attribute written both from a thread entry point
+  (``Thread(target=...)``, timer/done callbacks, trace-event observers)
+  and from caller-facing methods, with at least one write unguarded.
+* **C1006** — ``Condition.wait`` outside an enclosing predicate loop
+  (``wait_for`` carries its own re-check loop and is exempt).
+
+Lock identity is resolved per class (``self._lock = threading.Lock()``)
+and per module (``_beat_lock = threading.Lock()``); self-method calls are
+followed one level, so helpers that acquire or block are charged to the
+locked caller, and ``_locked``-suffix helpers only ever invoked under a
+lock count as guarded.  A trailing ``# lock-order: <why>`` comment on
+(or directly above) the anchor line suppresses any C10xx finding at that
+line — the comment text is the justification, and the package-wide gate
+sweep treats unannotated error findings as failures.
+
+The runtime companion is :mod:`paddle_tpu.framework.locking`, which
+checks the same two order/hold properties on the LIVE edge set (C1004 /
+C1005) when ``FLAGS_lock_sanitizer`` is on.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .diagnostics import DiagnosticCollector, Location, Severity
+
+__all__ = [
+    "SUPPRESS_MARK", "ConcurrencyAnalyzer",
+    "check_concurrency_source", "check_concurrency_paths",
+    "iter_python_files",
+]
+
+SUPPRESS_MARK = "lock-order:"
+
+# threading / framework.locking constructors that create a lock-like
+# object, mapped to their reentrancy class.
+_LOCK_FACTORIES = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",          # threading.Condition wraps an RLock
+    "Semaphore": "lock",
+    "BoundedSemaphore": "lock",
+    "OrderedLock": "lock",
+    "OrderedRLock": "rlock",
+    "OrderedCondition": "condition",
+}
+
+# blocking-call surface, seeded from the resilience fault_point site list
+# (executor.dispatch / collective.call / checkpoint.write / serving.runner
+# are all marked by a literal ``fault_point(...)`` call at the site).
+_BLOCKING_ATTRS = {
+    "block_until_ready": "device sync",
+    "sleep": "sleep",
+    "fault_point": "fault-point site",
+    "wait_idle": "drain",
+    "drain": "drain",
+    "barrier": "collective",
+    "all_reduce": "collective",
+    "all_gather": "collective",
+    "all_to_all": "collective",
+    "reduce_scatter": "collective",
+    "broadcast": "collective",
+    "psum": "collective",
+    "pmean": "collective",
+}
+_BLOCKING_NAMES = {"sleep": "sleep", "fault_point": "fault-point site"}
+
+# receiver-name heuristics for ambiguous attrs (str.join / dict.get are
+# not blocking; Thread.join / Queue.get are)
+_THREADY = ("thread", "worker", "proc", "timer")
+_QUEUEY = ("queue", "jobs", "inbox", "mailbox")
+_FUTUREY = ("fut", "future", "promise")
+
+_ENTRY_CALLEES = ("thread", "timer", "register", "add_done_callback",
+                  "call_later", "spawn", "factory")
+
+
+def _name_text(node: ast.AST) -> str:
+    """Best-effort identifier text of an expression (for heuristics)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Call):
+        return _name_text(node.func)
+    return ""
+
+
+def _is_self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _MethodInfo:
+    """Per-method event log produced by the statement walker."""
+
+    __slots__ = ("name", "lineno", "acquires", "blocking", "writes",
+                 "self_calls", "waits")
+
+    def __init__(self, name: str, lineno: int):
+        self.name = name
+        self.lineno = lineno
+        # [(lock_key, line, held_keys_before, nonblocking_try)]
+        self.acquires: List[Tuple] = []
+        # [(what, line, held_keys)]  — held may be empty (for 1-level
+        # propagation into locked callers)
+        self.blocking: List[Tuple] = []
+        # attr -> [(line, held_keys)]
+        self.writes: Dict[str, List[Tuple]] = {}
+        # [(callee, line, held_keys)]
+        self.self_calls: List[Tuple] = []
+        # [(line, loop_depth, other_held_keys)]
+        self.waits: List[Tuple] = []
+
+
+class _ClassInfo:
+    __slots__ = ("name", "locks", "methods", "entries", "filename")
+
+    def __init__(self, name: str, filename: str):
+        self.name = name
+        self.filename = filename
+        self.locks: Dict[str, str] = {}      # attr -> kind
+        self.methods: Dict[str, _MethodInfo] = {}
+        self.entries: Set[str] = set()       # thread/timer/observer targets
+
+
+class ConcurrencyAnalyzer:
+    """Accumulates lock-graph edges across files; per-file rules fire as
+    each source is added, the cross-file cycle check runs in
+    :meth:`finalize`."""
+
+    def __init__(self) -> None:
+        # (a_key, b_key) -> (file, line, suppressed)
+        self.edges: Dict[Tuple, Tuple[str, int, bool]] = {}
+        self.kinds: Dict[Tuple, str] = {}    # lock_key -> kind
+        self.names: Dict[Tuple, str] = {}    # lock_key -> display name
+
+    # -- per-file entry ------------------------------------------------------
+    def add_source(self, source: str, filename: str,
+                   collector: DiagnosticCollector) -> None:
+        try:
+            tree = ast.parse(source, filename=filename)
+        except SyntaxError as e:
+            collector.add("V102",
+                          f"{filename} failed to parse: {e}",
+                          severity=Severity.ERROR)
+            return
+        lines = source.splitlines()
+        suppressed = {
+            i + 1 for i, ln in enumerate(lines) if SUPPRESS_MARK in ln
+        }
+        fileinfo = _FileLint(filename, suppressed, self, collector)
+        fileinfo.run(tree)
+
+    def _suppressed_at(self, supp: Set[int], line: int) -> bool:
+        return line in supp or (line - 1) in supp
+
+    def add_edge(self, a: Tuple, b: Tuple, filename: str, line: int,
+                 suppressed: bool) -> None:
+        prev = self.edges.get((a, b))
+        if prev is None or (prev[2] and not suppressed):
+            self.edges[(a, b)] = (filename, line, suppressed)
+
+    # -- cross-file finish ---------------------------------------------------
+    def finalize(self, collector: DiagnosticCollector) -> None:
+        adj: Dict[Tuple, List[Tuple]] = {}
+        for (a, b), (_f, _l, supp) in self.edges.items():
+            if supp:
+                continue
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        for scc in _tarjan(adj):
+            if len(scc) == 1:
+                n = scc[0]
+                if n not in adj.get(n, ()):
+                    continue  # not a self-loop
+            cyc_edges = [((a, b), self.edges[(a, b)])
+                         for a in scc for b in adj.get(a, ())
+                         if b in scc and (a, b) in self.edges]
+            if not cyc_edges:
+                continue
+            cyc_edges.sort(key=lambda e: (e[1][0], e[1][1]))
+            desc = ", ".join(
+                f"{self.names.get(a, a[1])} -> {self.names.get(b, b[1])} "
+                f"({os.path.basename(f)}:{ln})"
+                for (a, b), (f, ln, _s) in cyc_edges)
+            anchor_file, anchor_line, _ = cyc_edges[-1][1]
+            collector.add(
+                "C1001",
+                f"lock-order cycle: {desc}",
+                location=Location(file=anchor_file, line=anchor_line),
+                hint="pick one global order for these locks and release "
+                     "the outer one before taking the inner on every "
+                     "path, or annotate the acquire with "
+                     "'# lock-order: <why>'")
+
+
+def _tarjan(adj: Dict[Tuple, List[Tuple]]) -> List[List[Tuple]]:
+    """Strongly connected components (iterative Tarjan)."""
+    index: Dict[Tuple, int] = {}
+    low: Dict[Tuple, int] = {}
+    on_stack: Set[Tuple] = set()
+    stack: List[Tuple] = []
+    sccs: List[List[Tuple]] = []
+    counter = [0]
+
+    for root in list(adj):
+        if root in index:
+            continue
+        work = [(root, iter(adj.get(root, ())))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(adj.get(nxt, ()))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+    return sccs
+
+
+class _FileLint:
+    """One module's pass: lock inventory, per-method walk, class rules."""
+
+    def __init__(self, filename: str, suppressed: Set[int],
+                 analyzer: ConcurrencyAnalyzer,
+                 collector: DiagnosticCollector):
+        self.filename = filename
+        self.suppressed = suppressed
+        self.analyzer = analyzer
+        self.out = collector
+        self.module_locks: Dict[str, str] = {}   # name -> kind
+        self._cls: Optional[_ClassInfo] = None
+        self._meth: Optional[_MethodInfo] = None
+
+    # -- helpers -------------------------------------------------------------
+    def _supp(self, line: int) -> bool:
+        return self.analyzer._suppressed_at(self.suppressed, line)
+
+    def _short(self) -> str:
+        return os.path.basename(self.filename)
+
+    def _scope(self) -> str:
+        cls = self._cls.name if self._cls else "<module>"
+        return f"{self.filename}::{cls}"
+
+    def _register_lock(self, key: Tuple, kind: str, display: str) -> None:
+        self.analyzer.kinds[key] = kind
+        self.analyzer.names[key] = display
+
+    def _lock_factory_kind(self, value: ast.AST) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        return _LOCK_FACTORIES.get(_name_text(value.func))
+
+    def _lock_of(self, expr: ast.AST) -> Optional[Tuple]:
+        """Resolve an expression to a known lock key, or None."""
+        attr = _is_self_attr(expr)
+        if attr is not None and self._cls and attr in self._cls.locks:
+            return (f"{self.filename}::{self._cls.name}", attr)
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return (f"{self.filename}::<module>", expr.id)
+        return None
+
+    def _kind(self, key: Tuple) -> str:
+        return self.analyzer.kinds.get(key, "lock")
+
+    def _display(self, key: Tuple) -> str:
+        return self.analyzer.names.get(key, key[1])
+
+    # -- phase 1: inventory --------------------------------------------------
+    def run(self, tree: ast.Module) -> None:
+        # module-level locks
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                kind = self._lock_factory_kind(stmt.value)
+                if kind:
+                    name = stmt.targets[0].id
+                    self.module_locks[name] = kind
+                    mod = os.path.splitext(self._short())[0]
+                    self._register_lock(
+                        (f"{self.filename}::<module>", name), kind,
+                        f"{mod}.{name}")
+        for stmt in tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                self._run_class(stmt)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._run_function(stmt)
+
+    def _run_function(self, fn) -> None:
+        """Module-level function: walk against module locks only."""
+        self._meth = _MethodInfo(fn.name, fn.lineno)
+        self._visit_body(fn.body, [], 0)
+        self._report_direct(self._meth, fn.name)
+        self._meth = None
+
+    def _run_class(self, cls: ast.ClassDef) -> None:
+        info = _ClassInfo(cls.name, self.filename)
+        self._cls = info
+        fns = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        # inventory: self.<attr> = threading.Lock()/… anywhere in the class
+        for fn in fns:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    attr = _is_self_attr(node.targets[0])
+                    if attr is None:
+                        continue
+                    kind = self._lock_factory_kind(node.value)
+                    if kind:
+                        info.locks[attr] = kind
+                        self._register_lock(
+                            (f"{self.filename}::{cls.name}", attr), kind,
+                            f"{cls.name}.{attr}")
+        # thread entry points: self.M handed to Thread/Timer/register/
+        # add_done_callback/partial, or lambdas passed to timer factories
+        for fn in fns:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    self._collect_entries(node, info)
+        # per-method walk
+        for fn in fns:
+            m = _MethodInfo(fn.name, fn.lineno)
+            info.methods[fn.name] = m
+            self._meth = m
+            self._visit_body(fn.body, [], 0)
+            self._meth = None
+        self._finish_class(info)
+        self._cls = None
+
+    def _collect_entries(self, call: ast.Call, info: _ClassInfo) -> None:
+        callee = _name_text(call.func).lower()
+        if not any(t in callee for t in _ENTRY_CALLEES):
+            return
+        args = list(call.args) + [kw.value for kw in call.keywords]
+        for a in args:
+            self._entry_arg(a, info)
+
+    def _entry_arg(self, a: ast.expr, info: _ClassInfo) -> None:
+        attr = _is_self_attr(a)
+        if attr is not None:
+            info.entries.add(attr)
+        elif isinstance(a, ast.Call) and _name_text(a.func) == "partial":
+            for pa in a.args[:1]:
+                self._entry_arg(pa, info)
+        elif isinstance(a, ast.IfExp):
+            self._entry_arg(a.body, info)
+            self._entry_arg(a.orelse, info)
+        elif isinstance(a, (ast.Tuple, ast.List)):
+            for el in a.elts:
+                self._entry_arg(el, info)
+        elif isinstance(a, ast.Lambda):
+            for node in ast.walk(a.body):
+                if isinstance(node, ast.Call):
+                    lattr = _is_self_attr(node.func)
+                    if lattr is not None:
+                        info.entries.add(lattr)
+
+    # -- phase 2: statement walk --------------------------------------------
+    def _visit_body(self, stmts: Sequence[ast.stmt], held: List[Tuple],
+                    loop_depth: int) -> None:
+        for stmt in stmts:
+            self._visit_stmt(stmt, held, loop_depth)
+
+    def _visit_stmt(self, stmt: ast.stmt, held: List[Tuple],
+                    loop_depth: int) -> None:
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired: List[Tuple] = []
+            for item in stmt.items:
+                self._scan_expr(item.context_expr, held, loop_depth)
+                lk = self._lock_of(item.context_expr)
+                if lk is not None:
+                    self._on_acquire(lk, item.context_expr.lineno, held)
+                    held.append(lk)
+                    acquired.append(lk)
+            self._visit_body(stmt.body, held, loop_depth)
+            for lk in reversed(acquired):
+                if lk in held:
+                    held.remove(lk)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def = deferred callback: runs with nothing held
+            self._visit_body(stmt.body, [], 0)
+        elif isinstance(stmt, ast.If):
+            self._scan_expr(stmt.test, held, loop_depth)
+            self._visit_body(stmt.body, list(held), loop_depth)
+            self._visit_body(stmt.orelse, list(held), loop_depth)
+        elif isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            if isinstance(stmt, ast.While):
+                self._scan_expr(stmt.test, held, loop_depth + 1)
+            else:
+                self._scan_expr(stmt.iter, held, loop_depth)
+            self._visit_body(stmt.body, list(held), loop_depth + 1)
+            self._visit_body(stmt.orelse, list(held), loop_depth)
+        elif isinstance(stmt, ast.Try):
+            self._visit_body(stmt.body, held, loop_depth)
+            for h in stmt.handlers:
+                self._visit_body(h.body, list(held), loop_depth)
+            self._visit_body(stmt.orelse, list(held), loop_depth)
+            self._visit_body(stmt.finalbody, held, loop_depth)
+        elif isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            value = stmt.value
+            if value is not None:
+                self._scan_expr(value, held, loop_depth)
+            for t in targets:
+                self._record_write_target(t, held)
+                if isinstance(t, (ast.Subscript, ast.Attribute)):
+                    # index / receiver expressions may contain calls
+                    for child in ast.iter_child_nodes(t):
+                        if isinstance(child, ast.expr):
+                            self._scan_expr(child, held, loop_depth)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._scan_expr(child, held, loop_depth)
+                elif isinstance(child, ast.stmt):
+                    self._visit_stmt(child, held, loop_depth)
+
+    def _record_write_target(self, target: ast.AST,
+                             held: List[Tuple]) -> None:
+        if self._cls is None or self._meth is None:
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._record_write_target(el, held)
+            return
+        attr = _is_self_attr(target)
+        if attr is None and isinstance(target, ast.Subscript):
+            attr = _is_self_attr(target.value)
+        if attr is None and isinstance(target, ast.Attribute):
+            # self.x.y = … mutates the object held in x
+            attr = _is_self_attr(target.value)
+        if attr is not None:
+            self._meth.writes.setdefault(attr, []).append(
+                (target.lineno, tuple(held)))
+
+    # -- expression scan -----------------------------------------------------
+    def _scan_expr(self, expr: ast.expr, held: List[Tuple],
+                   loop_depth: int) -> None:
+        if isinstance(expr, ast.Lambda):
+            self._scan_expr(expr.body, [], 0)
+            return
+        if isinstance(expr, ast.Call):
+            self._handle_call(expr, held, loop_depth)
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr) and not isinstance(
+                    expr, ast.Lambda):
+                self._scan_expr(child, held, loop_depth)
+
+    def _handle_call(self, call: ast.Call, held: List[Tuple],
+                     loop_depth: int) -> None:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            recv = func.value
+            attr = func.attr
+            lk = self._lock_of(recv)
+            if lk is not None and attr == "acquire":
+                nonblocking = self._is_nonblocking_acquire(call)
+                if not nonblocking:
+                    self._on_acquire(lk, call.lineno, held)
+                held.append(lk)
+                return
+            if lk is not None and attr == "release":
+                if lk in held:
+                    held.remove(lk)
+                return
+            if attr in ("wait", "wait_for") and lk is not None \
+                    and self._kind(lk) == "condition":
+                others = tuple(h for h in held if h != lk)
+                if self._meth is not None:
+                    self._meth.waits.append(
+                        (call.lineno, loop_depth, others,
+                         attr == "wait_for"))
+                return
+            if attr == "notify" or attr == "notify_all":
+                return
+        what = self._blocking_what(call)
+        if what is not None and self._meth is not None:
+            self._meth.blocking.append((what, call.lineno, tuple(held)))
+        sattr = _is_self_attr(func) if isinstance(func, ast.Attribute) \
+            else None
+        if sattr is not None and self._meth is not None:
+            self._meth.self_calls.append((sattr, call.lineno, tuple(held)))
+
+    @staticmethod
+    def _is_nonblocking_acquire(call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "blocking" and \
+                    isinstance(kw.value, ast.Constant) and \
+                    kw.value.value is False:
+                return True
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and call.args[0].value is False:
+            return True
+        return False
+
+    def _blocking_what(self, call: ast.Call) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            kind = _BLOCKING_NAMES.get(func.id)
+            return f"{func.id} ({kind})" if kind else None
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        kind = _BLOCKING_ATTRS.get(attr)
+        if kind:
+            return f"{attr} ({kind})"
+        recv = _name_text(func.value).lower()
+        if attr == "join" and any(t in recv for t in _THREADY):
+            return "join (thread join)"
+        if attr == "get" and any(t in recv for t in _QUEUEY):
+            return "get (queue wait)"
+        if attr == "result" and any(t in recv for t in _FUTUREY):
+            return "result (future wait)"
+        return None
+
+    # -- acquire event -------------------------------------------------------
+    def _on_acquire(self, lk: Tuple, line: int, held: List[Tuple]) -> None:
+        if self._meth is not None:
+            self._meth.acquires.append((lk, line, tuple(held)))
+        supp = self._supp(line)
+        for h in held:
+            if h == lk:
+                if self._kind(lk) == "lock":
+                    # non-reentrant self-nesting: guaranteed deadlock
+                    self.analyzer.add_edge(lk, lk, self.filename, line,
+                                           supp)
+                continue
+            self.analyzer.add_edge(h, lk, self.filename, line, supp)
+
+    # -- phase 3: per-method / per-class rules ------------------------------
+    def _report_direct(self, m: _MethodInfo, qual: str) -> None:
+        """C1002 on direct blocking-under-lock + C1006 on bare waits."""
+        for what, line, heldk in m.blocking:
+            if heldk and not self._supp(line):
+                names = ", ".join(self._display(h) for h in heldk)
+                self.out.add(
+                    "C1002",
+                    f"{names} held across blocking call {what}",
+                    location=Location(file=self.filename, line=line,
+                                      function=qual),
+                    hint="shrink the critical section: snapshot state "
+                         "under the lock, release, then block (or "
+                         "annotate '# lock-order: <why>')")
+        for line, depth, others, is_wait_for in m.waits:
+            if is_wait_for:
+                continue  # wait_for re-checks its predicate internally
+            if m.name in ("wait", "wait_for"):
+                continue  # a wrapper delegating wait(): the PREDICATE
+                # loop lives at the wrapper's call sites, not here
+            if depth == 0 and not self._supp(line):
+                self.out.add(
+                    "C1006",
+                    "Condition.wait outside a predicate loop — a "
+                    "spurious or stolen wakeup silently drops the wait",
+                    location=Location(file=self.filename, line=line,
+                                      function=qual),
+                    hint="wrap the wait in 'while not <predicate>:' and "
+                         "re-check the deadline after every wakeup")
+        for line, depth, others, _wf in m.waits:
+            if others and not self._supp(line):
+                names = ", ".join(self._display(h) for h in others)
+                self.out.add(
+                    "C1002",
+                    f"{names} held across Condition.wait (the wait "
+                    f"releases only its own lock)",
+                    location=Location(file=self.filename, line=line,
+                                      function=qual),
+                    hint="release the outer lock before waiting")
+
+    def _finish_class(self, info: _ClassInfo) -> None:
+        # direct per-method findings
+        for name, m in info.methods.items():
+            self._report_direct(m, f"{info.name}.{name}")
+        # one-level self-call propagation: edges + C1002 into locked callers
+        for name, m in info.methods.items():
+            for callee, line, heldk in m.self_calls:
+                if not heldk:
+                    continue
+                cm = info.methods.get(callee)
+                if cm is None:
+                    continue
+                supp = self._supp(line)
+                for lk, aline, _h in cm.acquires:
+                    for h in heldk:
+                        if h == lk and self._kind(lk) != "lock":
+                            continue
+                        self.analyzer.add_edge(
+                            h, lk, self.filename, line,
+                            supp or self._supp(aline))
+                if not supp:
+                    for what, bline, _bh in cm.blocking:
+                        names = ", ".join(self._display(h) for h in heldk)
+                        if self._supp(bline):
+                            continue
+                        self.out.add(
+                            "C1002",
+                            f"{names} held across {callee}(), which makes "
+                            f"blocking call {what} "
+                            f"({self._short()}:{bline})",
+                            location=Location(file=self.filename,
+                                              line=line,
+                                              function=f"{info.name}."
+                                                       f"{name}"),
+                            hint="release before calling the helper, or "
+                                 "annotate '# lock-order: <why>'")
+        self._check_shared_writes(info)
+
+    def _check_shared_writes(self, info: _ClassInfo) -> None:
+        """C1003: attr written from an async entry domain AND from
+        caller-facing methods, with at least one unguarded write."""
+        if not info.entries:
+            return
+        closure = set(info.entries)
+        for e in list(info.entries):
+            em = info.methods.get(e)
+            if em is None:
+                continue
+            for callee, _line, _held in em.self_calls:
+                closure.add(callee)
+        # private helpers only ever self-called under a lock count guarded
+        call_ctx: Dict[str, List[Tuple]] = {}
+        for m in info.methods.values():
+            for callee, _line, heldk in m.self_calls:
+                call_ctx.setdefault(callee, []).append(heldk)
+        guarded_helpers = {
+            name for name, ctxs in call_ctx.items()
+            if name.startswith("_") and name not in info.entries
+            and ctxs and all(ctxs)
+        }
+        # flatten writes
+        per_attr: Dict[str, List[Tuple[str, int, Tuple]]] = {}
+        for mname, m in info.methods.items():
+            if mname == "__init__":
+                continue
+            for attr, evs in m.writes.items():
+                if attr in info.locks:
+                    continue
+                for line, heldk in evs:
+                    per_attr.setdefault(attr, []).append(
+                        (mname, line, heldk))
+        for attr, evs in sorted(per_attr.items()):
+            async_evs = [e for e in evs if e[0] in closure]
+            sync_evs = [e for e in evs if e[0] not in closure]
+            if not async_evs or not sync_evs:
+                continue
+            unguarded = [e for e in evs
+                         if not e[2] and e[0] not in guarded_helpers]
+            if not unguarded:
+                continue
+            # one '# lock-order:' annotation at ANY write site documents
+            # the handoff protocol for the whole attribute
+            if any(self._supp(e[1]) for e in evs):
+                continue
+            mname, line, _h = min(unguarded, key=lambda e: e[1])
+            amname, aline, _ = async_evs[0]
+            smname, sline, _ = sync_evs[0]
+            self.out.add(
+                "C1003",
+                f"{info.name}.{attr} written from thread entry path "
+                f"{amname}() (line {aline}) and caller path {smname}() "
+                f"(line {sline}) with no guarding lock",
+                location=Location(file=self.filename, line=line,
+                                  function=f"{info.name}.{mname}"),
+                hint="guard every write with one lock, confine the "
+                     "attribute to a single thread, or annotate "
+                     "'# lock-order: <why>' documenting the handoff "
+                     "protocol")
+
+
+# -- public entry points ----------------------------------------------------
+
+def check_concurrency_source(source: str, filename: str = "<source>",
+                             collector: Optional[DiagnosticCollector]
+                             = None):
+    """Run the full C10xx pass over one source blob; returns the
+    diagnostics list (and fills ``collector`` when given)."""
+    out = collector if collector is not None else DiagnosticCollector()
+    analyzer = ConcurrencyAnalyzer()
+    analyzer.add_source(source, filename, out)
+    analyzer.finalize(out)
+    return out.diagnostics
+
+
+def iter_python_files(root: str) -> List[str]:
+    if os.path.isfile(root):
+        return [root]
+    found: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__", ".git"))
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                found.append(os.path.join(dirpath, fn))
+    return found
+
+
+def check_concurrency_paths(paths: Sequence[str],
+                            collector: Optional[DiagnosticCollector]
+                            = None):
+    """Sweep files/directories; edges union across ALL files so a cycle
+    spanning two modules is still caught."""
+    out = collector if collector is not None else DiagnosticCollector()
+    analyzer = ConcurrencyAnalyzer()
+    for path in paths:
+        for f in iter_python_files(path):
+            try:
+                with open(f, "r", encoding="utf-8") as fh:
+                    src = fh.read()
+            except OSError as e:
+                out.add("V102", f"cannot read {f}: {e}",
+                        severity=Severity.ERROR)
+                continue
+            analyzer.add_source(src, f, out)
+    analyzer.finalize(out)
+    return out.diagnostics

@@ -105,6 +105,27 @@ RULES = {
              "quantization integrity hazard (post-warmup dequantize "
              "fallback in a quantized engine, or never-calibrated "
              "observers at convert time)"),
+    # -- concurrency: static lint + runtime sanitizer (C10xx) ----------------
+    "C1001": (Severity.ERROR,
+              "lock-order inversion (cycle in the static lock-acquisition "
+              "graph — two code paths take the same locks in opposite "
+              "order)"),
+    "C1002": (Severity.WARNING,
+              "lock held across a blocking call (executor dispatch, "
+              "device sync, queue wait, sleep, or collective — every "
+              "other thread contending for the lock stalls behind it)"),
+    "C1003": (Severity.WARNING,
+              "attribute written from two thread entry points with no "
+              "guarding lock (racy shared state)"),
+    "C1004": (Severity.ERROR,
+              "runtime lock-order cycle detected by the lock sanitizer "
+              "at acquire time (potential deadlock)"),
+    "C1005": (Severity.WARNING,
+              "lock held longer than FLAGS_lock_hold_warn_ms (long "
+              "critical section stalls every contending thread)"),
+    "C1006": (Severity.WARNING,
+              "Condition.wait outside a predicate re-check loop (misses "
+              "spurious wakeups and stolen wakeups)"),
 }
 
 

@@ -81,6 +81,10 @@ class RetraceMonitor:
         # calibration (PTQ/QAT observer coverage) and quantized serving
         # engines (post-warmup dequantize-fallback steps).  Rule Q801.
         self._quant_sites: Dict[str, dict] = {}
+        # ("concurrency", lock) lock-sanitizer snapshots: latest per lock
+        # name, published on every C1004/C1005 violation (framework/
+        # locking.py); the violation details ride last_rule/last_message
+        self._concurrency_sites: Dict[str, dict] = {}
 
     # -- subscription --------------------------------------------------------
     def install(self):
@@ -162,6 +166,12 @@ class RetraceMonitor:
             # fallback counters): cumulative, latest wins (rule Q801)
             with self._lock:
                 self._quant_sites[key[1]] = dict(info)
+            return
+        if key[0] == "concurrency":
+            # lock-sanitizer snapshot per lock name: cumulative counters,
+            # latest wins (rules C1004 / C1005)
+            with self._lock:
+                self._concurrency_sites[key[1]] = dict(info)
             return
         sig = _freeze(info)
         with self._lock:
@@ -279,6 +289,17 @@ class RetraceMonitor:
             if name is not None:
                 return dict(self._quant_sites.get(name, {}))
             return {k: dict(v) for k, v in self._quant_sites.items()}
+
+    def concurrency_stats(self, name: str = None):
+        """Latest lock-sanitizer snapshot(s) observed (cumulative
+        acquire/edge/cycle/long-hold counters plus the violation that
+        triggered the publish): the dict for one lock name (``name`` like
+        ``"Router._lock"``), or all of them."""
+        with self._lock:
+            if name is not None:
+                return dict(self._concurrency_sites.get(name, {}))
+            return {k: dict(v)
+                    for k, v in self._concurrency_sites.items()}
 
     def diagnostics(self) -> List[Diagnostic]:
         out = DiagnosticCollector()
@@ -700,6 +721,23 @@ class RetraceMonitor:
                              "PTQ.collect() (or more QAT train steps) "
                              "until every observed layer has statistics "
                              "before calling quantize()/convert()")
+        with self._lock:
+            conc_sites = {k: dict(v)
+                          for k, v in self._concurrency_sites.items()}
+        for name, stats in conc_sites.items():
+            rule = stats.get("last_rule")
+            if rule not in ("C1004", "C1005"):
+                continue
+            out.add(rule,
+                    f"lock sanitizer: {stats.get('last_message', name)} "
+                    f"(cumulative: {int(stats.get('cycles', 0))} "
+                    f"cycle(s), {int(stats.get('long_holds', 0))} "
+                    f"long hold(s))",
+                    location=Location(file=name, function=name),
+                    hint="see framework/locking.py — fix the acquisition "
+                         "order (C1004) or shrink the critical section / "
+                         "construct the lock with warn=False when the "
+                         "long hold is by design (C1005)")
         return out.diagnostics
 
     @staticmethod

@@ -106,9 +106,34 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="lint source only; do not import the target")
     p.add_argument("--strict", action="store_true",
                    help="exit nonzero on ANY finding, not just errors")
+    p.add_argument("--concurrency", action="store_true",
+                   help="run the C10xx concurrency lint instead: targets "
+                        "are .py files or directories (swept recursively), "
+                        "parsed only, never imported")
     args = p.parse_args(argv)
 
     out = DiagnosticCollector()
+    if args.concurrency:
+        from .concurrency import check_concurrency_paths
+        paths = []
+        for target in args.targets:
+            if os.path.exists(target):
+                paths.append(target)
+            else:
+                src = _source_path(target)
+                if src is None:
+                    out.add("V102",
+                            f"target {target!r} is neither a path nor an "
+                            f"importable module",
+                            severity=Severity.ERROR)
+                else:
+                    paths.append(src)
+        check_concurrency_paths(paths, collector=out)
+        diags = out.diagnostics
+        print(render_json(diags) if args.json else render_text(diags))
+        if args.strict:
+            return 1 if diags else 0
+        return 1 if has_errors(diags) else 0
     for target in args.targets:
         try:
             analyze_target(target, out, all_functions=args.all_functions,

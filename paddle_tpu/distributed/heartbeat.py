@@ -31,6 +31,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from ..framework.errors import InvalidArgumentError
+from ..framework.locking import OrderedLock
 
 __all__ = ["HeartBeatMonitor", "FileHeartbeat", "maybe_beat"]
 
@@ -65,7 +66,7 @@ class HeartBeatMonitor:
         self._on_lost = on_lost
         self._beats: Dict[int, float] = {}
         self._lost: Dict[int, bool] = {i: False for i in range(workers)}
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("HeartBeatMonitor._lock")
         self._stop = threading.Event()
         self._stop.set()  # not running until start()
         self._thread: Optional[threading.Thread] = None
@@ -196,7 +197,7 @@ class FileHeartbeat:
 
 _last_beat = 0.0
 _writer: Optional[FileHeartbeat] = None
-_beat_lock = threading.Lock()
+_beat_lock = OrderedLock("heartbeat._beat_lock")
 
 
 def maybe_beat(min_interval: float = BEAT_MIN_INTERVAL) -> None:

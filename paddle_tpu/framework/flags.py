@@ -237,3 +237,17 @@ define_flag("trace_buffer_cap", 65536,
             "Capacity of the request-tracing span ring buffer; the "
             "oldest spans are dropped first past the cap (drops are "
             "counted in Tracer.stats()).")
+define_flag("lock_sanitizer", False,
+            "Runtime lock-order sanitizer (framework/locking.py): on, "
+            "every OrderedLock/OrderedRLock/OrderedCondition acquire "
+            "checks the cumulative cross-thread acquisition-order graph "
+            "and records a C1004 violation on a would-be cycle (instead "
+            "of deadlocking), and every release checks the hold time "
+            "against FLAGS_lock_hold_warn_ms (C1005). Off (default), "
+            "acquire/release adds a single falsy check. Static "
+            "companion: python -m paddle_tpu.analysis --concurrency.")
+define_flag("lock_hold_warn_ms", 500.0,
+            "Lock-hold duration (milliseconds) past which the lock "
+            "sanitizer records a C1005 long-hold violation on release. "
+            "Condition.wait time does not count (the wait releases the "
+            "lock). <= 0 disables the hold check.")

@@ -1,0 +1,418 @@
+"""Runtime lock-order sanitizer (C1004/C1005) — the live companion to
+``paddle_tpu.analysis.concurrency``.
+
+The static pass proves properties about lock ACQUISITIONS IT CAN SEE;
+this module checks the ones it can't — order edges that only materialize
+two call levels deep, through callbacks, or across subsystems — on the
+real running threads.  The serving/resilience stack's locks are created
+through three drop-in wrappers:
+
+* :class:`OrderedLock` / :class:`OrderedRLock` / :class:`OrderedCondition`
+  — same API as the ``threading`` primitives, plus a stable ``name``
+  (``"Router._lock"``) shared by every instance playing that role.
+
+With ``FLAGS_lock_sanitizer`` off (default) each wrapper method is the
+real primitive behind ONE falsy check — nothing is recorded.  On
+(env ``FLAGS_lock_sanitizer=1`` or :func:`enable`), every thread keeps a
+held-lock stack and the process accumulates a global name-level edge set
+``held -> acquired``.  At acquire time a would-be cycle in that graph is
+recorded as a **C1004** violation (with the path) instead of ever
+deadlocking — the edge is checked BEFORE blocking on the primitive, so
+an ABBA pair is caught the first time the second order appears, even if
+the threads never actually collide.  At release time a hold longer than
+``FLAGS_lock_hold_warn_ms`` is recorded as **C1005** (``Condition.wait``
+time is excluded: the wait releases the lock).  Locks constructed with
+``warn=False`` opt out of the hold check only — intentionally coarse
+gates (e.g. the router's ``_probe_gate``, held across warmup compiles by
+design) stay cycle-checked without drowning the hold signal.
+
+Violations surface three ways: :func:`stats` / :func:`violations` for
+gates and tests, ``("concurrency", <lock>)`` trace events consumed by
+``analysis.RetraceMonitor.concurrency_stats()`` (which re-emits them as
+C1004/C1005 diagnostics), and a "lock sanitizer" profiler summary
+section.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import trace_events
+from .flags import flag
+
+__all__ = [
+    "OrderedLock", "OrderedRLock", "OrderedCondition",
+    "enable", "disable", "active", "reset", "stats", "violations",
+]
+
+_MAX_VIOLATIONS = 256
+
+# THE off-switch: module-global None.  Every wrapper method is
+# ``if _active is None: <real primitive op>`` — one falsy check.
+_active: Optional["_Sanitizer"] = None
+_section_registered = False
+
+
+class _Sanitizer:
+    """Process-wide order/hold checker.  Internal lock ``_glock`` is a
+    leaf: never held across user code, so it cannot join a user cycle."""
+
+    def __init__(self, clock=None):
+        self._clock = clock if clock is not None else time.monotonic
+        self._tls = threading.local()
+        self._glock = threading.Lock()
+        self._edges: Dict[str, set] = {}        # held name -> {acquired}
+        self._violations: List[dict] = []
+        self.cycles = 0
+        self.long_holds = 0
+        self.acquires = 0                        # approximate (unlocked)
+
+    # -- per-thread state ----------------------------------------------------
+    def _state(self):
+        st = getattr(self._tls, "st", None)
+        if st is None:
+            st = self._tls.st = {"stack": [], "depth": {}}
+        return st
+
+    # -- violation plumbing --------------------------------------------------
+    def _record(self, rule: str, lock: str, message: str) -> None:
+        with self._glock:
+            if rule == "C1004":
+                self.cycles += 1
+            else:
+                self.long_holds += 1
+            if len(self._violations) < _MAX_VIOLATIONS:
+                self._violations.append({
+                    "rule": rule, "lock": lock,
+                    "thread": threading.current_thread().name,
+                    "message": message,
+                })
+        if trace_events.active():
+            trace_events.notify(("concurrency", lock), dict(
+                self.snapshot(), last_rule=rule, last_message=message))
+
+    def snapshot(self) -> dict:
+        with self._glock:
+            return {
+                "enabled": True,
+                "acquires": self.acquires,
+                "edges": sum(len(v) for v in self._edges.values()),
+                "cycles": self.cycles,
+                "long_holds": self.long_holds,
+            }
+
+    def reset(self) -> None:
+        with self._glock:
+            self._edges.clear()
+            self._violations.clear()
+            self.cycles = self.long_holds = self.acquires = 0
+
+    # -- order check ---------------------------------------------------------
+    def _check_and_add_edges(self, name: str, held: List[str]) -> None:
+        for h in held:
+            if h == name:
+                continue
+            with self._glock:
+                outs = self._edges.setdefault(h, set())
+                if name in outs:
+                    continue
+                path = self._find_path(name, h)
+                outs.add(name)
+            if path is not None:
+                chain = " -> ".join([name] + path)
+                self._record(
+                    "C1004", name,
+                    f"acquiring {name} while holding {h} closes the "
+                    f"lock-order cycle {chain} -> {name}")
+
+    def _find_path(self, src: str, dst: str) -> Optional[List[str]]:
+        """DFS ``src -> … -> dst`` in the edge graph (caller holds
+        ``_glock``); returns the node path after ``src`` or None."""
+        stack = [(src, [])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            for nxt in self._edges.get(node, ()):
+                if nxt in seen:
+                    continue
+                if nxt == dst:
+                    return path + [nxt]
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    # -- wrapper entry points ------------------------------------------------
+    def acquire(self, wrapper, blocking: bool, timeout) -> bool:
+        name = wrapper._name
+        st = self._state()
+        depth = st["depth"].get(name, 0)
+        if depth == 0 and blocking:
+            # check BEFORE blocking: a would-be deadlock is recorded,
+            # not experienced
+            self._check_and_add_edges(name, [e[0] for e in st["stack"]])
+        ok = wrapper._inner_acquire(blocking, timeout)
+        if ok:
+            self.acquires += 1
+            st["depth"][name] = depth + 1
+            if depth == 0:
+                st["stack"].append((name, self._clock(), wrapper._warn))
+        return ok
+
+    def release(self, wrapper) -> None:
+        name = wrapper._name
+        st = self._state()
+        depth = st["depth"].get(name, 0)
+        if depth == 1:
+            st["depth"].pop(name, None)
+            self._end_hold(st, name)
+        elif depth > 1:
+            st["depth"][name] = depth - 1
+        wrapper._inner_release()
+
+    def _end_hold(self, st, name: str) -> None:
+        for i in range(len(st["stack"]) - 1, -1, -1):
+            if st["stack"][i][0] == name:
+                _n, t0, warn = st["stack"].pop(i)
+                if warn:
+                    limit = flag("lock_hold_warn_ms")
+                    if limit and limit > 0:
+                        held_ms = (self._clock() - t0) * 1e3
+                        if held_ms > limit:
+                            self._record(
+                                "C1005", name,
+                                f"{name} held {held_ms:.1f}ms "
+                                f"(> FLAGS_lock_hold_warn_ms={limit:g})")
+                return
+
+    def wait(self, wrapper, timeout) -> bool:
+        """Condition.wait: the inner wait releases the lock, so the
+        held-stack entry is popped around it and hold timing restarts
+        on wakeup."""
+        name = wrapper._name
+        st = self._state()
+        depth = st["depth"].pop(name, 0)
+        if depth:
+            self._end_hold(st, name)
+        try:
+            return wrapper._cond.wait(timeout)
+        finally:
+            if depth:
+                st["depth"][name] = depth
+                st["stack"].append((name, self._clock(), wrapper._warn))
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+# ---------------------------------------------------------------------------
+
+_anon_counter = [0]
+
+
+def _auto_name(kind: str) -> str:
+    _anon_counter[0] += 1
+    return f"{kind}#{_anon_counter[0]}"
+
+
+class OrderedLock:
+    """``threading.Lock`` with a role name; sanitizer-aware."""
+
+    __slots__ = ("_lock", "_name", "_warn")
+    _reentrant = False
+
+    def __init__(self, name: Optional[str] = None, *, warn: bool = True):
+        self._lock = threading.Lock()
+        self._name = name or _auto_name("OrderedLock")
+        self._warn = warn
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def _inner_acquire(self, blocking, timeout):
+        return self._lock.acquire(blocking, timeout)
+
+    def _inner_release(self):
+        self._lock.release()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if _active is None:
+            return self._lock.acquire(blocking, timeout)
+        return _active.acquire(self, blocking, timeout)
+
+    def release(self) -> None:
+        if _active is None:
+            self._lock.release()
+            return
+        _active.release(self)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self._name}>"
+
+
+class OrderedRLock(OrderedLock):
+    """``threading.RLock`` with a role name; reentry adds no edges."""
+
+    __slots__ = ()
+    _reentrant = True
+
+    def __init__(self, name: Optional[str] = None, *, warn: bool = True):
+        self._lock = threading.RLock()
+        self._name = name or _auto_name("OrderedRLock")
+        self._warn = warn
+
+
+class OrderedCondition:
+    """``threading.Condition`` with a role name; the condition's own
+    lock IS the named lock (pass an Ordered* wrapper to share one)."""
+
+    __slots__ = ("_cond", "_name", "_warn")
+    _reentrant = True  # backed by an RLock unless an explicit Lock given
+
+    def __init__(self, lock=None, name: Optional[str] = None, *,
+                 warn: bool = True):
+        if lock is None:
+            self._cond = threading.Condition()
+        elif isinstance(lock, OrderedLock):
+            self._cond = threading.Condition(lock._lock)
+            name = name or lock._name
+        else:
+            self._cond = threading.Condition(lock)
+        self._name = name or _auto_name("OrderedCondition")
+        self._warn = warn
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def _inner_acquire(self, blocking, timeout):
+        return self._cond.acquire(blocking, timeout)
+
+    def _inner_release(self):
+        self._cond.release()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if _active is None:
+            return self._cond.acquire(blocking, timeout)
+        return _active.acquire(self, blocking, timeout)
+
+    def release(self) -> None:
+        if _active is None:
+            self._cond.release()
+            return
+        _active.release(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if _active is None:
+            return self._cond.wait(timeout)
+        return _active.wait(self, timeout)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        """Stdlib semantics, routed through the sanitized :meth:`wait`."""
+        endtime = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + timeout
+                waittime = endtime - time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait(None)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def __repr__(self):
+        return f"<OrderedCondition {self._name}>"
+
+
+# ---------------------------------------------------------------------------
+# module controls
+# ---------------------------------------------------------------------------
+
+def enable(clock=None) -> None:
+    """Turn the sanitizer on (idempotent; a custom ``clock`` — for tests
+    — replaces ``time.monotonic`` in hold timing)."""
+    global _active, _section_registered
+    if _active is not None and clock is None:
+        return
+    _active = _Sanitizer(clock=clock)
+    if not _section_registered:
+        _section_registered = True
+        try:
+            from .. import profiler
+            profiler.register_summary_section(_render_summary,
+                                              on_reset=None)
+        except Exception:  # pragma: no cover — profiler optional here
+            pass
+
+
+def disable() -> None:
+    global _active
+    _active = None
+
+
+def active() -> bool:
+    return _active is not None
+
+
+def reset() -> None:
+    if _active is not None:
+        _active.reset()
+
+
+def stats() -> dict:
+    if _active is None:
+        return {"enabled": False, "acquires": 0, "edges": 0,
+                "cycles": 0, "long_holds": 0}
+    return _active.snapshot()
+
+
+def violations() -> List[dict]:
+    if _active is None:
+        return []
+    with _active._glock:
+        return list(_active._violations)
+
+
+def _render_summary() -> str:
+    if _active is None:
+        return ""
+    s = _active.snapshot()
+    lines = ["== lock sanitizer ==",
+             f"acquires: {s['acquires']}  order edges: {s['edges']}  "
+             f"cycles (C1004): {s['cycles']}  "
+             f"long holds (C1005): {s['long_holds']}"]
+    for v in violations()[:8]:
+        lines.append(f"  [{v['rule']}] {v['message']} "
+                     f"(thread {v['thread']})")
+    return "\n".join(lines)
+
+
+if flag("lock_sanitizer"):
+    enable()

@@ -42,6 +42,7 @@ import jax
 import numpy as np
 
 from ..framework import random as _random
+from ..framework.locking import OrderedLock
 from ..framework import serialization
 from ..framework.errors import (
     EnforceNotMet,
@@ -106,7 +107,7 @@ class AutoCheckpoint:
         # implicitly safe (keep_max >= 1), pins cover dirs a concurrent
         # rollback is reading while the async writer keeps committing
         self._pinned: set = set()
-        self._pin_lock = threading.Lock()
+        self._pin_lock = OrderedLock("AutoCheckpoint._pin_lock")
         if data_loader is not None:
             self.attach("data_loader", data_loader.state_dict,
                         data_loader.set_state_dict)
@@ -272,6 +273,9 @@ class AutoCheckpoint:
             self._worker.join()
             self._worker = None
         if self._worker_err is not None:
+            # read-and-clear is safe unguarded: it happens after the
+            # _worker.join() above, so the writer thread is dead and
+            # lock-order: the join IS the synchronization edge
             err, self._worker_err = self._worker_err, None
             raise err
 

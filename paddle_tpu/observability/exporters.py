@@ -18,6 +18,7 @@ import threading
 import time
 from typing import List, Optional, Sequence
 
+from ..framework.locking import OrderedLock
 from .metrics import MetricRegistry, default_registry
 
 __all__ = [
@@ -148,7 +149,7 @@ class JsonlSink:
         parent = os.path.dirname(os.path.abspath(self.path))
         os.makedirs(parent, exist_ok=True)
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("JsonlSink._lock")
         self._thread = threading.Thread(
             target=self._run, name="metrics-jsonl", daemon=True)
         self._thread.start()

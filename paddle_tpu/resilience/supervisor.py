@@ -48,6 +48,7 @@ from collections import deque
 from typing import Dict, Optional
 
 from ..framework import trace_events
+from ..framework.locking import OrderedLock
 from ..framework.errors import DivergenceError, InvalidArgumentError
 
 __all__ = ["TrainingSupervisor", "DivergenceError", "stats", "record"]
@@ -57,7 +58,7 @@ __all__ = ["TrainingSupervisor", "DivergenceError", "stats", "record"]
 _STAT_FIELDS = ("rollbacks", "repeat_trips", "skipped_batches",
                 "watchdog_trips", "exact_resumes", "fatal_divergences")
 _stats: Dict[str, int] = {k: 0 for k in _STAT_FIELDS}
-_stats_lock = threading.Lock()
+_stats_lock = OrderedLock("supervisor._stats_lock")
 
 
 def record(field: str, n: int = 1) -> None:

@@ -37,6 +37,7 @@ from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from .. import profiler
+from ..framework.locking import OrderedCondition
 from ..framework.errors import (
     ExecutionTimeoutError,
     UnavailableError,
@@ -118,7 +119,7 @@ class MicroBatcher:
         self._retry = retry      # resilience.RetryPolicy for the runner
         self.metrics = metrics or ServingMetrics(name)
 
-        self._cv = threading.Condition()
+        self._cv = OrderedCondition(name="MicroBatcher._cv")
         # bucket → FIFO of requests; OrderedDict keeps bucket scan cheap
         self._pending: Dict[int, deque] = OrderedDict()
         self._depth = 0

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..framework.errors import InvalidArgumentError
+from ..framework.locking import OrderedLock
 from ..inference import Predictor
 from ..resilience import CircuitBreaker, RetryPolicy
 from ..resilience import retry as _retry_mod
@@ -84,7 +85,7 @@ class InferenceEngine:
         self._max_batch = int(max_batch_size)
         self._allow_fallback = bool(allow_bucket_fallback)
         self._unpad = bool(unpad_outputs)
-        self._exe_lock = threading.Lock()
+        self._exe_lock = OrderedLock("InferenceEngine._exe_lock")
         self._executables: Dict[int, object] = {}
         self._fallback_shapes = set()
         self.metrics = ServingMetrics(name)

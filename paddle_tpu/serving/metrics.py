@@ -40,6 +40,7 @@ import threading
 from typing import Deque, Dict, Optional, Sequence
 
 from ..framework import trace_events
+from ..framework.locking import OrderedLock
 
 __all__ = ["ServingMetrics"]
 
@@ -97,7 +98,7 @@ class ServingMetrics:
     def __init__(self, name: str = "serving#0", window: int = 512,
                  extra_counters: Sequence[str] = ()):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("ServingMetrics._lock")
         # extra_counters zero-initializes caller-specific keys (the
         # router's failover/hedge/drain family) so every snapshot carries
         # the full schema even before the first increment — consumers

@@ -51,6 +51,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..framework import trace_events
+from ..framework.locking import OrderedRLock
 from ..framework.errors import InvalidArgumentError
 from ..resilience import retry as _retry_mod
 from .metrics import ServingMetrics
@@ -117,7 +118,7 @@ class ReplicaPool:
         self._warmup = bool(warmup)
         self._async = bool(async_actions)
         self._clock = clock
-        self._lock = threading.RLock()
+        self._lock = OrderedRLock("ReplicaPool._lock")
         self._counts: Dict[str, int] = {k: 0 for k in _POOL_COUNTERS}
         self._last_seq = -1
         self._up_streak = 0
@@ -152,7 +153,18 @@ class ReplicaPool:
     def _decide(self, signal) -> Optional[str]:
         """Hysteresis / ordering / cooldown / bounds gauntlet.  Returns
         the action to execute (``up``/``down``) or None, with
-        ``_actions_inflight`` already bumped for a returned action."""
+        ``_actions_inflight`` already bumped for a returned action.
+
+        The counter snapshot is published AFTER ``_lock`` is released:
+        trace-event observers are arbitrary subscriber code, and fanning
+        out to them under the pool lock puts every observer in this
+        lock's critical section (C1002 territory)."""
+        direction, publish = self._decide_inner(signal)
+        if publish:
+            self._publish()
+        return direction
+
+    def _decide_inner(self, signal):
         with self._lock:
             if self._closing:
                 return None
@@ -161,8 +173,7 @@ class ReplicaPool:
             if seq >= 0:
                 if seq <= self._last_seq:
                     self._counts["stale_signals"] += 1
-                    self._publish()
-                    return None
+                    return None, True
                 self._last_seq = seq
             direction = getattr(signal, "direction", "steady")
             if direction == "up":
@@ -173,30 +184,26 @@ class ReplicaPool:
                 self._up_streak = 0
             else:
                 self._up_streak = self._down_streak = 0
-                return None  # steady: nothing to consider
+                return None, False  # steady: nothing to consider
             streak, need = ((self._up_streak, self._up_consecutive)
                             if direction == "up" else
                             (self._down_streak, self._down_consecutive))
             now = self._clock()
             if streak < need:
                 self._counts["deferred_streak"] += 1
-                self._publish()
-                return None
+                return None, True
             if self._actions_inflight:
                 self._counts["deferred_inflight"] += 1
-                self._publish()
-                return None
+                return None, True
             if (self._last_action_t is not None
                     and now - self._last_action_t < self._cooldown_s):
                 self._counts["deferred_cooldown"] += 1
-                self._publish()
-                return None
+                return None, True
             n = len(self.router.replicas)
             if ((direction == "up" and n >= self.max_replicas)
                     or (direction == "down" and n <= self.min_replicas)):
                 self._counts["deferred_bounds"] += 1
-                self._publish()
-                return None
+                return None, True
             # committed: this signal becomes an action
             if (self._last_action_dir is not None
                     and self._last_action_dir != direction
@@ -209,7 +216,7 @@ class ReplicaPool:
             self._last_action_dir = direction
             self._up_streak = self._down_streak = 0
             self._actions_inflight += 1
-            return direction
+            return direction, False
 
     # -- actuation -----------------------------------------------------------
     def _execute(self, direction: str) -> None:

@@ -25,6 +25,7 @@ import time
 from typing import Optional
 
 from ..framework import trace_events
+from ..framework.locking import OrderedCondition
 from ..framework.errors import InvalidArgumentError
 
 __all__ = ["Replica", "HEALTHY", "UNHEALTHY", "DRAINING", "DRAINED",
@@ -59,7 +60,7 @@ class Replica:
         self.engine = engine
         self.index = int(index)
         self.name = f"{router_name}[{index}]"
-        self._cv = threading.Condition()
+        self._cv = OrderedCondition(name="Replica._cv")
         self._state = HEALTHY
         self._outstanding = 0
         self._counters = {k: 0 for k in _COUNTERS}

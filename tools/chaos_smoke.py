@@ -20,6 +20,11 @@ on every gate pass:
 Also asserts the no-plan contract: with ``FLAGS_fault_plan`` unset,
 ``fault_point`` is inert and two identical CPU runs are bit-identical.
 
+Both chaos paths run under the runtime lock-order sanitizer
+(``FLAGS_lock_sanitizer=1``, inherited by the child trainer): a final
+gate asserts zero C1004 cycles and zero C1005 long holds even while
+faults fire, the circuit flaps, and the trainer is SIGKILLed.
+
 Prints one JSON line; exit 0 iff every gate holds.
 """
 import json
@@ -31,6 +36,7 @@ import tempfile
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("FLAGS_lock_sanitizer", "1")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 TRAIN_FAULT_PLAN = "site=checkpoint.write,nth=2,error=TransientDeviceError"
@@ -241,9 +247,21 @@ def main():
         train = gate_training_chaos(tmp)
         serving = gate_serving_chaos(tmp)
         noop = gate_noop_determinism()
-    passed = train["pass"] and serving["pass"] and noop["pass"]
+
+    from paddle_tpu.framework import locking
+    lk = locking.stats()
+    sanitizer = {"pass": bool(lk["enabled"] and lk["cycles"] == 0
+                              and lk["long_holds"] == 0),
+                 "enabled": lk["enabled"], "acquires": lk["acquires"],
+                 "edges": lk["edges"], "cycles": lk["cycles"],
+                 "long_holds": lk["long_holds"],
+                 "violations": locking.violations()[:4]}
+
+    passed = (train["pass"] and serving["pass"] and noop["pass"]
+              and sanitizer["pass"])
     print(json.dumps({"pass": bool(passed), "training_chaos": train,
                       "serving_chaos": serving, "noop_determinism": noop,
+                      "lock_sanitizer": sanitizer,
                       "seconds": round(time.time() - t0, 1)}))
     return 0 if passed else 1
 

@@ -12,7 +12,11 @@ enough for every gate run:
    must reject zero requests, serve the NEW weights on every replica
    afterwards, and compile nothing (the compile set stays closed).
 
-Prints one JSON line; exit 0 iff all three gates hold.
+The whole episode runs under the runtime lock-order sanitizer
+(``FLAGS_lock_sanitizer=1``): a fourth gate asserts zero C1004 cycles
+and zero C1005 long holds across the router/batcher/replica lock set.
+
+Prints one JSON line; exit 0 iff all four gates hold.
 """
 import json
 import os
@@ -22,6 +26,7 @@ import threading
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("FLAGS_lock_sanitizer", "1")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
@@ -164,9 +169,20 @@ def main():
                  and compiles_after == compiles_warm)
         router.close()
 
-    passed = gate1 and gate2 and gate3
+    # -- gate 4: lock sanitizer saw the whole episode, zero violations ----
+    from paddle_tpu.framework import locking
+    lk = locking.stats()
+    g4 = {"enabled": lk["enabled"], "acquires": lk["acquires"],
+          "edges": lk["edges"], "cycles": lk["cycles"],
+          "long_holds": lk["long_holds"],
+          "violations": locking.violations()[:4]}
+    gate4 = (lk["enabled"] and lk["acquires"] > 0
+             and lk["cycles"] == 0 and lk["long_holds"] == 0)
+
+    passed = gate1 and gate2 and gate3 and gate4
     print(json.dumps({"pass": bool(passed),
                       "failover": g1, "recovery": g2, "rolling_swap": g3,
+                      "lock_sanitizer": g4,
                       "seconds": round(time.time() - t0, 1)}))
     return 0 if passed else 1
 

@@ -93,6 +93,13 @@ run_stage analyze env JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --strict \
   paddle_tpu.vision.models.resnet paddle_tpu.vision.models.vgg \
   paddle_tpu.vision.models.lenet paddle_tpu.vision.models.mobilenetv1 \
   paddle_tpu.vision.models.mobilenetv2
+# concurrency lint over the framework's OWN source: lock-order inversions,
+# locks held across blocking calls, unguarded cross-thread writes, bare
+# Condition.waits (C10xx).  Error severity (a lock-order cycle) fails the
+# gate; the fixture zoo in tests/test_concurrency_analysis.py proves the
+# rules FIRE, this sweep proves the tree is clean
+run_stage analyze-concurrency env JAX_PLATFORMS=cpu \
+  python -m paddle_tpu.analysis --concurrency paddle_tpu/
 
 run_stage fast   python -m pytest tests/ -m fast -q
 run_stage suite  python -m pytest tests/ -q
@@ -126,15 +133,19 @@ run_stage scenario-smoke env JAX_PLATFORMS=cpu python tools/scenario_smoke.py
 # silent; the 0-expert build must publish no moe keys at all
 run_stage moe-smoke env JAX_PLATFORMS=cpu python tools/moe_smoke.py
 # resilience: injected checkpoint-write fault + SIGKILL -> bit-identical
-# resume; injected serving fault -> circuit opens, sheds, recovers
-run_stage chaos-smoke env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+# resume; injected serving fault -> circuit opens, sheds, recovers —
+# all under the runtime lock sanitizer (zero C1004/C1005 asserted)
+run_stage chaos-smoke env JAX_PLATFORMS=cpu FLAGS_lock_sanitizer=1 \
+  python tools/chaos_smoke.py
 # observability: live Prometheus scrape with advancing step counters,
 # JSONL snapshot sink, and serving spans in the chrome trace
 run_stage obs-smoke env JAX_PLATFORMS=cpu python tools/obs_smoke.py
 # serving control plane: 1-of-3 replicas hard-failed mid-traffic -> every
 # accepted request completes via failover, half-open re-admission after the
 # cooldown, rolling swap_weights under load (zero rejects, zero recompiles)
-run_stage router-smoke env JAX_PLATFORMS=cpu python tools/router_smoke.py
+# — all under the runtime lock sanitizer (zero C1004/C1005 asserted)
+run_stage router-smoke env JAX_PLATFORMS=cpu FLAGS_lock_sanitizer=1 \
+  python tools/router_smoke.py
 # continuous batching decode plane: 1 long + many short requests -> short
 # p99 at least 2x better than the legacy run-to-completion path, zero lost
 # requests, zero post-warmup XLA recompiles, router probes stay green;
