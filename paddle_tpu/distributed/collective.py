@@ -319,3 +319,102 @@ def barrier(group=None):
     token = jnp.zeros((n,), jnp.int32)
     out = _all_reduce_impl(token, ReduceOp.SUM, axis)
     jax.block_until_ready(out)
+
+
+# ---------------------------------------------------------------------------
+# Overlap schedules: WHERE the decode-path collectives land, as a tunable.
+#
+# The megatron layers never call collectives directly — they annotate
+# (`constrain`) and GSPMD inserts the tensor/expert-parallel all-reduces at
+# the annotation points.  GSPMD is semantics-preserving, so moving an
+# annotation never changes the value, only WHERE the reduce materializes —
+# which decides how much neighboring compute XLA's latency-hiding scheduler
+# can overlap the ICI transfer with.  A decode step is latency-bound, so
+# the placement is worth real microseconds per layer; instead of
+# hand-picking, the dials below are searched by `tuning.plan_space.
+# tune_decode_schedule` on REAL decode steps (the `overlap_grad_sync`
+# treatment, applied to inference collectives).
+#
+# Dials (all 0/1, read at TRACE time — retrace after changing them):
+#   defer_row_reduce     — RowParallelLinear skips its immediate
+#                          output-replication constrain; the all-reduce
+#                          slides to the next annotation (after bias/
+#                          residual), freeing the scheduler to overlap it
+#                          with the adjacent elementwise work.
+#   mlp_collective_split — GPTBlock splits the decode residual stream
+#                          around the MLP: the MLP's row-parallel reduce is
+#                          deferred past the residual add and pinned there,
+#                          so it can run concurrently with the add.
+_OVERLAP_DIALS = ("defer_row_reduce", "mlp_collective_split")
+_overlap_schedule = {k: 0 for k in _OVERLAP_DIALS}
+_overlap_lock = threading.Lock()
+
+
+def get_overlap_schedule() -> dict:
+    """The active overlap-schedule dials (a copy)."""
+    with _overlap_lock:
+        return dict(_overlap_schedule)
+
+
+def set_overlap_schedule(config: Optional[dict] = None, **dials) -> dict:
+    """Set overlap dials (unknown keys rejected; unset dials keep their
+    value).  Returns the previous schedule.  Functions traced AFTER the
+    call see the new placement; already-compiled executables keep the
+    schedule they were traced under."""
+    from ..framework.errors import InvalidArgumentError
+
+    merged = dict(config or ())
+    merged.update(dials)
+    for k in merged:
+        if k not in _OVERLAP_DIALS:
+            raise InvalidArgumentError(
+                f"unknown overlap dial {k!r} (have {_OVERLAP_DIALS})")
+    with _overlap_lock:
+        prev = dict(_overlap_schedule)
+        for k, v in merged.items():
+            _overlap_schedule[k] = int(v)
+    return prev
+
+
+class overlap_schedule:
+    """Context manager: apply overlap dials for the trace inside, restore
+    the previous schedule on exit."""
+
+    def __init__(self, config: Optional[dict] = None, **dials):
+        self._new = dict(config or ())
+        self._new.update(dials)
+
+    def __enter__(self):
+        self._prev = set_overlap_schedule(self._new)
+        return get_overlap_schedule()
+
+    def __exit__(self, *exc):
+        set_overlap_schedule(self._prev)
+
+
+def all_reduce_start(x, axis_name: str):
+    """Stage an in-graph all-reduce (for explicit ``shard_map`` bodies):
+    returns an opaque handle; the reduce itself happens at
+    :func:`all_reduce_finish`.  The pair is a SCHEDULING seam, not an
+    async runtime: everything the caller computes between start and
+    finish is, by data dependence, free to execute while the reduce is
+    in flight — XLA's latency-hiding scheduler does the actual overlap
+    (the same contract as `overlap_grad_sync` staging for grad syncs).
+    """
+    return (x, str(axis_name))
+
+
+def all_reduce_finish(handle):
+    """Complete a staged in-graph all-reduce: the ``lax.psum`` over the
+    axis captured at :func:`all_reduce_start`."""
+    x, axis_name = handle
+    return lax.psum(x, axis_name)
+
+
+__all__ += [
+    "all_reduce_start",
+    "all_reduce_finish",
+    "get_overlap_schedule",
+    "set_overlap_schedule",
+    "overlap_schedule",
+]

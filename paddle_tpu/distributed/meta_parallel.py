@@ -126,14 +126,24 @@ class RowParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        from .collective import get_overlap_schedule
+
         x = jnp.asarray(x)
         if self.input_is_parallel:
             x = constrain(x, *([None] * (x.ndim - 1) + ["model"]))
+        # overlap dial (trace-time): deferring the output-replication
+        # constrain slides the model-axis all-reduce to the NEXT
+        # annotation point downstream.  GSPMD is semantics-preserving —
+        # the value (bias add included) is unchanged; only the reduce's
+        # placement, and thus what the latency-hiding scheduler can
+        # overlap it with, moves.  See collective.set_overlap_schedule.
+        defer = bool(get_overlap_schedule().get("defer_row_reduce"))
         if str(jnp.asarray(self.weight).dtype) in _QUANT_DTYPES:
             y = _quantized_forward(self, x)
-            return constrain(y, *([None] * y.ndim))
+            return y if defer else constrain(y, *([None] * y.ndim))
         y = jnp.matmul(x, jnp.asarray(self.weight))
-        y = constrain(y, *([None] * y.ndim))
+        if not defer:
+            y = constrain(y, *([None] * y.ndim))
         if self.bias is not None:
             y = y + jnp.asarray(self.bias)
         return y

@@ -130,6 +130,11 @@ define_flag("fused_epilogues", True,
             "Let the BERT/GPT hot paths call the fused Pallas epilogues "
             "(LayerNorm+residual, softmax-cross-entropy) on TPU. Off "
             "falls back to the plain XLA ops everywhere.")
+define_flag("paged_flash", True,
+            "Let the paged serving decode path dispatch to the Pallas "
+            "paged-flash-decode kernel (ops/paged_attention.py) on TPU. "
+            "Off keeps the gather-then-attend reference path everywhere "
+            "(always the CPU path — it is the bit-identical fallback).")
 define_flag("fault_plan", "",
             "Deterministic fault injection plan (resilience/faults.py). "
             "Semicolon-separated rules of comma-separated key=value "

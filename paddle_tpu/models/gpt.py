@@ -43,6 +43,18 @@ def _fused_epilogues(feature_dim=None) -> bool:
     return fused_epilogues_eligible(feature_dim)
 
 
+def _paged_flash(head_dim, page_size) -> bool:
+    """Gate for the Pallas paged-flash-decode kernel (same shape as
+    ``_fused_epilogues``: TPU backend, aligned dims, no model/sep
+    sharding).  Off-gate, ``forward_paged`` keeps the gather-then-attend
+    path — the bit-identical CPU/fallback reference."""
+    try:
+        from ..ops.paged_attention import paged_flash_eligible
+    except ImportError:  # pallas/jax mismatch → plain XLA path
+        return False
+    return paged_flash_eligible(head_dim, page_size)
+
+
 def _quantize_kv(t, qdtype):
     """Quantize-on-write for paged KV: ``t`` float ``[N, H, hd]`` →
     (quantized values, ``[N, H]`` float32 dequant multipliers), one
@@ -286,6 +298,24 @@ class ParallelAttention(Layer):
         new_v = kv["v"].at[write_page, :, write_off].set(
             vw.astype(kv["v"].dtype))
         G, page = gather_tab.shape[1], kv["k"].shape[2]
+        out = {"k": new_k, "v": new_v}
+        if quantized:
+            out["k_scale"], out["v_scale"] = new_ks, new_vs
+        if _paged_flash(hd, page):
+            # TPU hot path: page-table walk + dequant + online softmax in
+            # ONE Pallas kernel over the post-scatter pool — the [B,H,C,hd]
+            # float KV view is never materialized (ops/paged_attention.py).
+            # The scatter above is identical on both paths, so the cache
+            # state (and the CPU fallback below) stays bit-identical.
+            from ..ops.paged_attention import paged_flash_decode
+
+            ctx = paged_flash_decode(
+                q, new_k, new_v, gather_tab, mask,
+                new_ks if quantized else None,
+                new_vs if quantized else None)  # [B,H,T,hd]
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(B, T, D)
+            ctx = constrain(ctx, None, None, "model")
+            return self.out(ctx), out
         kview = jnp.take(new_k, gather_tab, axis=0)  # [B,G,H,page,hd]
         vview = jnp.take(new_v, gather_tab, axis=0)
         kview = kview.transpose(0, 2, 1, 3, 4).reshape(B, H, G * page, hd)
@@ -309,9 +339,6 @@ class ParallelAttention(Layer):
         ctx = jnp.einsum("bhqc,bhcd->bhqd", probs, vview)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, T, D)
         ctx = constrain(ctx, None, None, "model")
-        out = {"k": new_k, "v": new_v}
-        if quantized:
-            out["k_scale"], out["v_scale"] = new_ks, new_vs
         return self.out(ctx), out
 
 
@@ -366,10 +393,26 @@ class GPTBlock(Layer):
         return x, new_kv
 
     def forward_paged(self, x, kv, write_page, write_off, gather_tab, mask):
+        from ..distributed.collective import (
+            get_overlap_schedule,
+            overlap_schedule,
+        )
+
         a, new_kv = self.attn.forward_paged(self.ln1(x), kv, write_page,
                                             write_off, gather_tab, mask)
         x = x + a
-        x = x + self.mlp(self.ln2(x))
+        if get_overlap_schedule().get("mlp_collective_split"):
+            # overlap dial: trace the MLP with its row-parallel reduce
+            # deferred, then pin the reduce AFTER the residual add — the
+            # model-axis all-reduce and the add can overlap (the "split
+            # around the MLP" schedule; value unchanged, GSPMD resolves
+            # the partial sums at the constrain).  Searched by
+            # tuning.plan_space.tune_decode_schedule on real decode steps.
+            with overlap_schedule(defer_row_reduce=1):
+                m = self.mlp(self.ln2(x))
+            x = constrain(x + m, *([None] * x.ndim))
+        else:
+            x = x + self.mlp(self.ln2(x))
         return x, new_kv
 
 

@@ -7,11 +7,17 @@ that beat the compiler, plus the autotuner that picks their tile sizes:
 * ``flash_attention`` (+ ``flash_attention_fwd_lse`` /
   ``flash_attention_bwd_chunk``) — O(S)-memory attention, forward and
   backward, triangle-grid causal path (flash_attention.py);
-* ``conv1x1_bn_relu`` / ``conv1x1_bn_stats`` — 1x1-conv GEMM with the
-  train-mode BatchNorm statistics fused into the epilogue
+* ``conv1x1_bn_relu`` / ``conv1x1_bn_stats`` / ``bn_apply_relu`` —
+  1x1-conv GEMM with the train-mode BatchNorm statistics fused into the
+  epilogue, plus the one-pass normalize + residual-add + ReLU apply
+  kernel the ResNet bottleneck tail dispatches to
   (fused_conv1x1_bn.py);
 * ``grouped_matmul`` — one masked matmul over the MoE experts' ragged
   capacity-bucketed row groups (grouped_matmul.py);
+* ``paged_flash_decode`` — flash-decode attention over a paged KV pool
+  with the page-table walk in-kernel and per-page int8/fp8 dequant
+  fused into the online-softmax loop, the paged serving decode hot
+  path (paged_attention.py);
 * ``quantized_matmul`` / ``fp8_matmul`` — int8×int8→int32 (and
   fp8-e4m3) matmul with the dequant + bias epilogue fused, the serving
   quantization hot path (quantized_matmul.py);
@@ -30,9 +36,17 @@ from .flash_attention import (  # noqa: F401
     flash_attention_bwd_chunk,
     flash_attention_fwd_lse,
 )
-from .fused_conv1x1_bn import conv1x1_bn_relu, conv1x1_bn_stats  # noqa: F401
+from .fused_conv1x1_bn import (  # noqa: F401
+    bn_apply_relu,
+    conv1x1_bn_relu,
+    conv1x1_bn_stats,
+)
 from .fused_layernorm import layernorm_residual  # noqa: F401
 from .grouped_matmul import grouped_matmul  # noqa: F401
+from .paged_attention import (  # noqa: F401
+    paged_flash_decode,
+    paged_flash_eligible,
+)
 from .quantized_matmul import (  # noqa: F401
     fp8_matmul,
     quantized_linear,

@@ -102,8 +102,9 @@ def _causal_run(qi, ki, block_q, block_k, q_offset, causal):
 # (lower triangle, T = nq(nq+1)/2 tiles) and kv-row ki is touched by
 # qi ∈ [ki, nq) (upper triangle).  Flattening the active set into the grid
 # means masked tiles never exist as grid steps — their k/v DMA is skipped
-# outright, not just their compute (the ~2x causal bandwidth win; this was
-# the self-acknowledged TODO at the top of this file).  The (qi, ki) per
+# outright, not just their compute (the ~2x causal bandwidth win over the
+# rectangular grid below, which must visit every tile and rely on
+# _causal_run to skip compute).  The (qi, ki) per
 # flat step comes from a host-precomputed i32 table delivered via scalar
 # prefetch (PrefetchScalarGridSpec) — index maps stay table lookups, which
 # Mosaic lowers directly (the splash-attention pattern); closed-form sqrt

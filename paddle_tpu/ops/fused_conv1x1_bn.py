@@ -51,7 +51,7 @@ if not hasattr(pltpu, "CompilerParams"):  # jax < 0.6 spells it TPUCompilerParam
 from ..framework.errors import InvalidArgumentError
 from . import autotune as _at
 
-__all__ = ["conv1x1_bn_stats", "conv1x1_bn_relu"]
+__all__ = ["conv1x1_bn_stats", "conv1x1_bn_relu", "bn_apply_relu"]
 
 
 def _kernel(x_ref, w_ref, y_ref, sum_ref, sq_ref, acc_s, acc_q):
@@ -166,9 +166,103 @@ def conv1x1_bn_stats(x, w, *, block_m: Optional[int] = None,
     return _conv1x1_bn_stats(x, w, block_m=block_m, block_n=block_n)
 
 
+def _apply_kernel(*refs, has_residual):
+    # normalize + (residual add) + relu on one (bm, bn) tile: Y and the
+    # residual are each read once, the output written once.
+    if has_residual:
+        y_ref, sc_ref, sh_ref, r_ref, o_ref = refs
+    else:
+        y_ref, sc_ref, sh_ref, o_ref = refs
+    out = y_ref[...].astype(jnp.float32) * sc_ref[...] + sh_ref[...]
+    if has_residual:
+        out = out + r_ref[...].astype(jnp.float32)
+    o_ref[...] = jnp.maximum(out, 0.0).astype(o_ref.dtype)
+
+
+def _apply_space(y, scale, shift, residual):
+    M, N = y.shape
+    itemsize = np.dtype(y.dtype).itemsize
+    n_tiles = 3 if residual is not None else 2
+    out = []
+    for bm in _at.tile_candidates(M, base=(256, 512, 1024)):
+        for bn in _at.tile_candidates(N, multiple=_at.LANE,
+                                      base=(128, 256, 512)):
+            resident = n_tiles * bm * bn * itemsize + 2 * bn * 4
+            if _at.vmem_fits(resident):
+                out.append({"block_m": bm, "block_n": bn})
+    return out
+
+
+def _apply_heuristic(y, scale, shift, residual):
+    return {"block_m": 512, "block_n": 256}
+
+
+@_at.autotune("conv1x1_bn_apply", params=("block_m", "block_n"),
+              space=_apply_space, heuristic=_apply_heuristic)
+@functools.partial(jax.jit, static_argnames=("block_m", "block_n"))
+def _bn_apply(y, scale, shift, residual, *, block_m: int, block_n: int):
+    M, N = y.shape
+    bm = min(block_m, max(M, 8))
+    bn = min(block_n, max(N, 128))
+    bm = -(-bm // 8) * 8
+    bn = -(-bn // 128) * 128
+    Mp = -(-M // bm) * bm
+    Np = -(-N // bn) * bn
+    yp = y if (Mp, Np) == (M, N) else jnp.pad(y, ((0, Mp - M), (0, Np - N)))
+    scp = scale.reshape(1, N).astype(jnp.float32)
+    shp = shift.reshape(1, N).astype(jnp.float32)
+    if Np != N:
+        scp = jnp.pad(scp, ((0, 0), (0, Np - N)))
+        shp = jnp.pad(shp, ((0, 0), (0, Np - N)))
+    has_residual = residual is not None
+    operands = [yp, scp, shp]
+    in_specs = [
+        pl.BlockSpec((bm, bn), lambda n, m: (m, n)),
+        pl.BlockSpec((1, bn), lambda n, m: (0, n)),
+        pl.BlockSpec((1, bn), lambda n, m: (0, n)),
+    ]
+    if has_residual:
+        rp = residual if (Mp, Np) == (M, N) else jnp.pad(
+            residual, ((0, Mp - M), (0, Np - N)))
+        operands.append(rp)
+        in_specs.append(pl.BlockSpec((bm, bn), lambda n, m: (m, n)))
+
+    interpret = jax.default_backend() != "tpu"
+    out = pl.pallas_call(
+        functools.partial(_apply_kernel, has_residual=has_residual),
+        interpret=interpret,
+        grid=(Np // bn, Mp // bm),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bm, bn), lambda n, m: (m, n)),
+        out_shape=jax.ShapeDtypeStruct((Mp, Np), y.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+    )(*operands)
+    return out[:M, :N]
+
+
+def bn_apply_relu(y, scale, shift, residual=None, *,
+                  block_m: Optional[int] = None,
+                  block_n: Optional[int] = None):
+    """Fused BN-normalize + residual-add + ReLU epilogue:
+    ``relu(y*scale + shift [+ residual])`` in ONE pass over ``y``.
+
+    The XLA tail of :func:`conv1x1_bn_relu` is elementwise, but it sits
+    downstream of a Pallas custom call XLA cannot fuse INTO, so whether
+    the normalize, the residual read and the ReLU land in one fusion is
+    the compiler's choice.  This kernel pins them: one read of ``y``, one
+    read of the residual, one write of the output — the guaranteed
+    2-pass schedule of the module doc.  y ``[M, Cout]``, scale/shift
+    ``[Cout]`` (f32 math), residual optional ``[M, Cout]``.
+    """
+    return _bn_apply(y, scale, shift, residual,
+                     block_m=block_m, block_n=block_n)
+
+
 def conv1x1_bn_relu(x, w, gamma, beta, *, epsilon: float = 1e-5,
                     residual=None, momentum: float = 0.9,
                     running_mean=None, running_var=None,
+                    fused_epilogue: bool = False,
                     block_m: Optional[int] = None,
                     block_n: Optional[int] = None):
     """Train-mode ``relu(BN(X @ W) [+ residual])`` in two passes instead of
@@ -177,6 +271,10 @@ def conv1x1_bn_relu(x, w, gamma, beta, *, epsilon: float = 1e-5,
     Returns ``(out [M, Cout], new_running_mean, new_running_var)`` with
     paddle's momentum convention (``new = momentum*old + (1-m)*batch``);
     running stats pass through unchanged when not provided.
+
+    ``fused_epilogue=True`` routes the normalize + residual-add + ReLU
+    tail through :func:`bn_apply_relu` (one pinned pass) instead of
+    leaving the elementwise tail to XLA's fusion heuristics.
     """
     M = x.shape[0]
     y, s, q = conv1x1_bn_stats(x, w, block_m=block_m, block_n=block_n)
@@ -186,10 +284,14 @@ def conv1x1_bn_relu(x, w, gamma, beta, *, epsilon: float = 1e-5,
     scale = (gamma.astype(jnp.float32) * inv).astype(y.dtype)
     shift = (beta.astype(jnp.float32)
              - mean * gamma.astype(jnp.float32) * inv).astype(y.dtype)
-    out = y * scale + shift
-    if residual is not None:
-        out = out + residual.astype(out.dtype)
-    out = jax.nn.relu(out)
+    if fused_epilogue:
+        res = None if residual is None else residual.astype(y.dtype)
+        out = bn_apply_relu(y, scale, shift, res)
+    else:
+        out = y * scale + shift
+        if residual is not None:
+            out = out + residual.astype(out.dtype)
+        out = jax.nn.relu(out)
     if (running_mean is None) != (running_var is None):
         raise InvalidArgumentError(
             "conv1x1_bn_relu: pass running_mean and running_var together "

@@ -418,6 +418,7 @@ class GenerationEngine:
         self._admit = jax.jit(admit)
         self._evict = jax.jit(evict)
         self._padmit = jax.jit(padmit)
+        self._pstep = pstep  # raw fn: the overlap-schedule search re-jits
         self._step = jax.jit(pstep)
         if self._moe_experts:
             self._decode = self._moe_tap(self._decode)
@@ -495,6 +496,12 @@ class GenerationEngine:
             pm0 = jnp.asarray(np.full((B, self._C), -1, np.int32))
             tb0 = jnp.asarray(np.full((B, G), -1, np.int32))
             cache = self._init_pool()
+            # sharded decode only: measured search over the collective
+            # overlap schedule, BEFORE the production traces below (they
+            # must be traced under the winning dials) and before
+            # mark_warm (K701 stays silent; a warm restart replays the
+            # winner from the tuning cache with zero searches)
+            self._tune_overlap_schedule(cache)
             for sb in self._buckets:
                 ids = jnp.asarray(np.zeros((B, sb), np.int32))
                 pos = jnp.asarray(np.broadcast_to(
@@ -591,6 +598,72 @@ class GenerationEngine:
         self._warm = True  # starvation after this point is S603 material
         self._emit_quant()
         return self.compile_count
+
+    # -- sharded-decode overlap schedule -----------------------------------
+    def _tune_overlap_schedule(self, cache):
+        """Measured search over the collective overlap schedule
+        (``tuning.plan_space.DECODE_DIALS``) on REAL decode steps.  Only
+        meshes with a tensor/expert-parallel axis have collectives in
+        the decode step, so everywhere else (single chip, CPU tests,
+        the smoke gates) this is a no-op and the compile set is
+        untouched.  Search traces are warmup throwaways: the trace
+        counters are restored so ``compile_count`` keeps describing the
+        production set."""
+        from ..distributed.mesh import get_mesh
+
+        mesh = get_mesh()
+        if (mesh.shape.get("model", 1) == 1
+                and mesh.shape.get("expert", 1) == 1):
+            return
+        from ..tuning import engine as _tengine
+        from ..tuning import plan_space
+
+        B, T = self._batch, 1 + self._spec_k
+        pk = self._pack_step(np.zeros((B, T), np.int32),
+                             np.full((B, T), -1, np.int32))
+        snap = dict(self._traces)
+
+        def measure(cfg):
+            prev = plan_space.apply_decode_schedule(cfg)
+            try:
+                step = jax.jit(self._pstep)  # fresh trace under cfg dials
+                return _tengine.measure_ms(
+                    step, (self._params, self._buffers, pk, cache),
+                    repeats=2)
+            finally:
+                plan_space.apply_decode_schedule(prev)
+
+        winner = plan_space.tune_decode_schedule(
+            f"B{B}xT{T}xC{self._C}", measure=measure, mesh=mesh,
+            details={"engine": self.name})
+        self._traces.clear()
+        self._traces.update(snap)
+        plan_space.apply_decode_schedule(winner)
+        self._overlap_schedule = winner
+
+    def _decode_attn_frac(self) -> float:
+        """Attention's share of one decode step, from the bandwidth
+        roofline: bytes attention must move per step (every live slot's
+        logical KV view, plus the f32 scale planes on quantized pools)
+        over those plus the weight bytes the rest of the step streams.
+        Decode is memory-bound, so the byte ratio tracks the time ratio
+        well enough to split the measured step wall time into the
+        ``decode_attn_ms`` / ``decode_rest_ms`` gauges.  Computed once —
+        pool geometry and weights are fixed after warmup."""
+        frac = getattr(self, "_attn_frac", None)
+        if frac is None:
+            cfg = self._model.gpt.cfg
+            H = cfg.num_heads
+            hd = cfg.hidden_size // H
+            qdtype = self._kv_qdtype()
+            per_entry = hd * np.dtype(qdtype or np.float32).itemsize
+            if qdtype is not None:
+                per_entry += 4  # the per-(token, head) f32 dequant scale
+            kv = cfg.num_layers * 2 * self._batch * H * self._C * per_entry
+            w = sum(int(x.nbytes)
+                    for x in jax.tree_util.tree_leaves(self._params))
+            frac = self._attn_frac = kv / max(kv + w, 1)
+        return frac
 
     # -- MoE routing-health tap --------------------------------------------
     def _moe_tap(self, fn):
@@ -1292,6 +1365,15 @@ class GenerationEngine:
                         else:
                             it_wide = (dt if it_wide is None
                                        else 0.8 * it_wide + 0.2 * dt)
+                        # per-step attention-vs-rest breakdown gauges on
+                        # the ("serving", ·) bus — the paged-flash-decode
+                        # kernel's win shows up in Prometheus/profiler
+                        # dashboards, not just bench (see ServingMetrics)
+                        frac = self._decode_attn_frac()
+                        self.metrics.set_gauge("decode_step_ms", dt)
+                        self.metrics.set_gauge("decode_attn_ms", dt * frac)
+                        self.metrics.set_gauge("decode_rest_ms",
+                                               dt * (1.0 - frac))
                         self.metrics.incr("decode_steps")
                         self._note_quant_step()
                         self.metrics.observe_occupancy(len(live) / B)

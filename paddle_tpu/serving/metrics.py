@@ -19,6 +19,14 @@ starved_steps_after_warm`` plus per-step gauges (``set_gauge``) such as
 ``queue_age_ms`` (age of the oldest queued request).  Rule S603 reads
 the starvation counters.
 
+The paged decode loop also publishes the per-step latency-breakdown
+gauges ``decode_step_ms`` (measured step wall time), ``decode_attn_ms``
+and ``decode_rest_ms`` — the measured step time split by the engine's
+bandwidth-roofline attention share (KV bytes vs weight bytes; see
+``GenerationEngine._decode_attn_frac``), so the paged-flash-decode
+kernel's win is visible on Prometheus/profiler dashboards, not just in
+bench lines.
+
 Paged-KV engines (``FLAGS_paged_kv``) add the page-accounting family:
 counters ``cow_copies`` (copy-on-write page copies), ``spec_drafted`` /
 ``spec_accepted`` (speculative-decoding draft economics) and

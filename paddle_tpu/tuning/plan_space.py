@@ -36,7 +36,8 @@ from ..framework.flags import flag
 from . import engine
 
 __all__ = ["param_groups", "plan_candidates", "tune_plan", "apply_plan",
-           "make_step_measure"]
+           "make_step_measure", "DECODE_DIALS", "decode_schedule_candidates",
+           "tune_decode_schedule", "apply_decode_schedule"]
 
 #: mesh axes a parameter group may be assigned to ("none" = replicated);
 #: ``data`` stays the batch axis and is never a parameter axis here.
@@ -234,6 +235,72 @@ def make_step_measure(run_step: Callable[[dict], object], *,
         return engine.measure_ms(run_step, (config,), repeats=repeats)
 
     return measure
+
+
+# ---------------------------------------------------------------------------
+# Sharded-decode overlap schedules (the serving twin of the collective
+# dials above).  The dials live in distributed.collective and move WHERE
+# the tensor/expert-parallel all-reduces land in the traced decode step
+# (GSPMD placement freedom — value-preserving by construction), which a
+# latency-bound decode step cares about; see collective.set_overlap_schedule.
+
+#: sharded-decode overlap dials and their sweep values; the all-zeros
+#: base is the historical placement (reduce immediately at every
+#: RowParallelLinear output) and is always a candidate.
+DECODE_DIALS = {
+    "defer_row_reduce": (0, 1),
+    "mlp_collective_split": (0, 1),
+}
+
+
+def decode_schedule_candidates(base: Optional[dict] = None) -> List[dict]:
+    """The full dial product (4 configs), base first."""
+    base_cfg = {k: int((base or {}).get(k, 0)) for k in DECODE_DIALS}
+    items = sorted(DECODE_DIALS.items())
+    out = [base_cfg]
+    for combo in itertools.product(*(v for _, v in items)):
+        out.append({k: int(v) for (k, _), v in zip(items, combo)})
+    return engine.dedup_candidates(out, base_cfg)
+
+
+def tune_decode_schedule(tag: str, *, measure: Callable[[dict], float],
+                         mesh=None, base: Optional[dict] = None,
+                         details: Optional[dict] = None) -> dict:
+    """Measured search over sharded-decode overlap schedules.
+
+    ``measure(config) -> ms`` must apply the config
+    (:func:`apply_decode_schedule`), RETRACE the decode step (the dials
+    are trace-time), and time real decode steps — the serving engines
+    wire this into ``warmup()`` so the search lands before
+    ``mark_warm()`` and K701 stays silent.  The winner persists in the
+    shared tuning cache (``plan`` space, key ``decode_schedule:<tag> |
+    mesh | device_kind``): a warm restart replays it from disk with zero
+    searches.  Off (``FLAGS_measured_search=off``) the base placement is
+    returned untimed."""
+    if mesh is None:
+        from ..distributed.mesh import get_mesh
+
+        mesh = get_mesh()
+    name = f"decode_schedule:{tag}"
+    key = "|".join([name, engine.mesh_key(mesh), engine.device_kind()])
+    measurable = str(flag("measured_search")).lower() != "off"
+    base_cfg = {k: int((base or {}).get(k, 0)) for k in DECODE_DIALS}
+    return engine.resolve(
+        "plan", name, key,
+        candidates=lambda: decode_schedule_candidates(base),
+        measure=measure,
+        heuristic=lambda: base_cfg,
+        measurable=measurable,
+        details=details)
+
+
+def apply_decode_schedule(config: dict) -> dict:
+    """Install a decode-schedule winner; functions traced afterwards pick
+    it up.  Returns the previous schedule (for restore)."""
+    from ..distributed.collective import set_overlap_schedule
+
+    return set_overlap_schedule(
+        {k: int(config.get(k, 0)) for k in DECODE_DIALS})
 
 
 def apply_plan(config: dict, *, network=None, strategy=None, mesh=None):

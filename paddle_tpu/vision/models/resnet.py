@@ -76,13 +76,55 @@ class BottleneckBlock(nn.Layer):
         self.downsample = downsample
         self.stride = stride
 
+    def _fused_tail(self, out, identity):
+        """conv3 (1x1) → train-mode bn3 → +identity → relu through the
+        fused Pallas pair (``conv1x1_bn_stats`` + ``bn_apply_relu``): two
+        passes over the conv output instead of XLA's three, with the
+        residual read and ReLU pinned into the second.  Eligible only in
+        training (eval BN needs no batch stats — XLA already folds it),
+        NHWC layout, and under ``fused_epilogues_eligible`` (real TPU,
+        lane-aligned channels, unsharded mesh).  Returns None when
+        ineligible — the caller's plain path is the reference."""
+        cv, bn = self.conv3, self.bn3
+        if not (self.training and cv.data_format == "NHWC"
+                and cv.kernel_size == (1, 1) and cv.stride == 1
+                and cv.groups == 1 and cv.bias is None
+                and bn.weight is not None and bn.bias is not None
+                and not bn.use_global_stats):
+            return None
+        from ...ops.autotune import fused_epilogues_eligible
+
+        cout = cv.out_channels
+        if not fused_epilogues_eligible(cout):
+            return None
+        import jax.numpy as jnp
+
+        from ...ops.fused_conv1x1_bn import conv1x1_bn_relu
+
+        x = jnp.asarray(out)
+        n, h, w_, cin = x.shape
+        w = jnp.asarray(cv.weight.value).reshape(cout, cin).T  # [Cin, Cout]
+        y, nrm, nrv = conv1x1_bn_relu(
+            x.reshape(-1, cin), w,
+            jnp.asarray(bn.weight.value), jnp.asarray(bn.bias.value),
+            epsilon=bn.epsilon, momentum=bn.momentum,
+            residual=jnp.asarray(identity).reshape(-1, cout),
+            running_mean=bn._mean.value, running_var=bn._variance.value,
+            fused_epilogue=True)
+        bn._mean.value = nrm
+        bn._variance.value = nrv
+        return y.reshape(n, h, w_, cout)
+
     def forward(self, x):
         identity = x
         out = self.relu(self.bn1(self.conv1(x)))
         out = self.relu(self.bn2(self.conv2(out)))
-        out = self.bn3(self.conv3(out))
         if self.downsample is not None:
             identity = self.downsample(x)
+        fused = self._fused_tail(out, identity)
+        if fused is not None:
+            return fused
+        out = self.bn3(self.conv3(out))
         return self.relu(out + identity)
 
 

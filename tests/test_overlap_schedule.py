@@ -1,0 +1,152 @@
+"""Sharded-decode overlap schedules (distributed/collective.py dials +
+tuning/plan_space.py measured search).
+
+The dials are trace-time placement hints for GSPMD — semantics-
+preserving by construction — so CPU equivalence (same values under
+every schedule) plus search/cache/counter machinery is the whole
+testable surface here; which schedule WINS is a real-chip question the
+serving warmup answers (``GenerationEngine._tune_overlap_schedule``).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distributed.collective import (all_reduce_finish,
+                                               all_reduce_start,
+                                               get_overlap_schedule,
+                                               overlap_schedule,
+                                               set_overlap_schedule)
+from paddle_tpu.framework.errors import InvalidArgumentError
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.tuning import engine, plan_space
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    engine.clear_cache()
+    engine.reset_counters()
+    engine.reset_warm()
+    yield
+    set_overlap_schedule({k: 0 for k in get_overlap_schedule()})
+    set_flags({"measured_search": "on", "kernel_tuning_cache": ""})
+    engine.clear_cache()
+    engine.reset_counters()
+    engine.reset_warm()
+
+
+class TestDialRegistry:
+    def test_set_get_restore(self):
+        assert get_overlap_schedule() == {"defer_row_reduce": 0,
+                                          "mlp_collective_split": 0}
+        prev = set_overlap_schedule(defer_row_reduce=1)
+        assert prev["defer_row_reduce"] == 0
+        assert get_overlap_schedule()["defer_row_reduce"] == 1
+        set_overlap_schedule(prev)
+        assert get_overlap_schedule()["defer_row_reduce"] == 0
+
+    def test_unknown_dial_rejected(self):
+        with pytest.raises(InvalidArgumentError):
+            set_overlap_schedule(warp_speed=1)
+
+    def test_context_manager_restores_on_error(self):
+        with pytest.raises(RuntimeError):
+            with overlap_schedule(mlp_collective_split=1):
+                assert get_overlap_schedule()["mlp_collective_split"] == 1
+                raise RuntimeError("trace failed")
+        assert get_overlap_schedule()["mlp_collective_split"] == 0
+
+    def test_start_finish_pair_is_a_psum(self):
+        # the pair is a scheduling seam: the reduce's value is exactly
+        # lax.psum, and work between start and finish is data-independent
+        def f(x):
+            h = all_reduce_start(x, "i")
+            local = x * 2.0  # overlappable work
+            return all_reduce_finish(h) + local
+
+        x = jnp.arange(4.0)
+        out = jax.vmap(f, axis_name="i")(x)
+        np.testing.assert_allclose(np.asarray(out),
+                                   x.sum() + 2.0 * np.asarray(x))
+
+
+class TestScheduleEquivalence:
+    def test_row_parallel_defer_is_value_preserving(self):
+        from paddle_tpu.distributed.meta_parallel import RowParallelLinear
+
+        layer = RowParallelLinear(16, 8)
+        x = jnp.asarray(np.random.RandomState(0).randn(4, 16),
+                        jnp.float32)
+        base = jax.jit(layer)(x)
+        with overlap_schedule(defer_row_reduce=1):
+            deferred = jax.jit(layer)(x)
+        np.testing.assert_array_equal(np.asarray(base),
+                                      np.asarray(deferred))
+
+    def test_gpt_forward_identical_under_every_schedule(self):
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+        cfg = GPTConfig(vocab_size=61, hidden_size=32, num_layers=2,
+                        num_heads=4, max_position=32, dropout=0.0)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        ids = jnp.asarray([[3, 1, 4, 1, 5, 9, 2, 6]], jnp.int32)
+        base = np.asarray(model(ids))
+        for cand in plan_space.decode_schedule_candidates()[1:]:
+            with overlap_schedule(cand):
+                out = np.asarray(model(ids))
+            np.testing.assert_array_equal(base, out)
+
+
+class TestMeasuredSearch:
+    def test_candidates_full_product_base_first(self):
+        cands = plan_space.decode_schedule_candidates()
+        assert cands[0] == {"defer_row_reduce": 0,
+                            "mlp_collective_split": 0}
+        assert len(cands) == 4  # 2 dials x {0,1}, base deduped
+        assert len({tuple(sorted(c.items())) for c in cands}) == 4
+
+    def test_search_persists_and_replays(self, tmp_path):
+        set_flags({"kernel_tuning_cache": str(tmp_path / "tune.json")})
+
+        def score(cfg):  # deterministic: full overlap wins
+            return 10.0 - 4.0 * cfg["defer_row_reduce"] \
+                - 2.0 * cfg["mlp_collective_split"]
+
+        win = plan_space.tune_decode_schedule("B8xT5xC256", measure=score)
+        assert win == {"defer_row_reduce": 1, "mlp_collective_split": 1}
+        c = engine.get_counters("decode_schedule:B8xT5xC256")
+        assert c["searches"] == 1 and c["configs_timed"] == 4
+
+        # warm replay: memory hit, zero further searches
+        again = plan_space.tune_decode_schedule("B8xT5xC256", measure=score)
+        assert again == win
+        c = engine.get_counters("decode_schedule:B8xT5xC256")
+        assert c["searches"] == 1 and c["hits"] == 1
+
+        # cold-process replay: disk hit, zero searches (K701 stays
+        # silent on a warm restart)
+        engine.clear_cache(memory=True, disk=False)
+        engine.reset_counters()
+        disk = plan_space.tune_decode_schedule("B8xT5xC256", measure=score)
+        assert disk == win
+        c = engine.get_counters("decode_schedule:B8xT5xC256")
+        assert c["searches"] == 0 and c["disk_hits"] == 1
+
+    def test_search_off_returns_base_untimed(self):
+        set_flags({"measured_search": "off"})
+        calls = []
+        win = plan_space.tune_decode_schedule(
+            "off", measure=lambda cfg: calls.append(cfg) or 0.0)
+        assert win == {"defer_row_reduce": 0, "mlp_collective_split": 0}
+        assert not calls
+        assert engine.get_counters("decode_schedule:off")["heuristic"] == 1
+
+    def test_apply_returns_previous(self):
+        prev = plan_space.apply_decode_schedule({"defer_row_reduce": 1})
+        assert prev == {"defer_row_reduce": 0, "mlp_collective_split": 0}
+        assert get_overlap_schedule() == {"defer_row_reduce": 1,
+                                          "mlp_collective_split": 0}
+        plan_space.apply_decode_schedule(prev)
+        assert get_overlap_schedule()["defer_row_reduce"] == 0

@@ -1,0 +1,208 @@
+"""Paged flash-decode kernel (ops/paged_attention.py).
+
+Per-candidate numerical equivalence against the gather-then-attend
+reference (the serving path's bit-identical CPU fallback) across float,
+int8 and fp8-e4m3 pools, drop-page masking, ragged page counts and the
+speculative ``1+k`` verify width — all on the CPU interpreter.  The
+performance question lives on the real chip (bench.py gpt_generate).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.framework.errors import InvalidArgumentError
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.ops.paged_attention import (_paged_decode,
+                                            paged_flash_decode,
+                                            paged_flash_eligible)
+
+
+def _ref_attend(q, k_pool, v_pool, tables, mask, k_scale=None, v_scale=None):
+    """Gather-then-attend oracle: materialize each slot's logical cache
+    from the pool (dequantizing in full, as the fallback path does), then
+    plain masked softmax attention.  Fully-masked rows emit softmax over
+    a uniform -1e30 row — garbage by construction — so callers compare
+    valid rows only."""
+    B, H, T, hd = q.shape
+    page = k_pool.shape[2]
+    tab = np.maximum(np.asarray(tables), 0)
+    k = np.asarray(k_pool, np.float32)[tab]  # [B, G, H, page, hd]
+    v = np.asarray(v_pool, np.float32)[tab]
+    if k_scale is not None:
+        k = k * np.asarray(k_scale, np.float32)[tab][..., None]
+        v = v * np.asarray(v_scale, np.float32)[tab][..., None]
+    B_, G = tab.shape
+    k = k.transpose(0, 2, 1, 3, 4).reshape(B, H, G * page, hd)
+    v = v.transpose(0, 2, 1, 3, 4).reshape(B, H, G * page, hd)
+    s = np.einsum("bhtd,bhcd->bhtc", np.asarray(q, np.float32),
+                  k) / np.sqrt(hd)
+    s = np.where(np.asarray(mask)[:, None], s, -1e30)
+    m = s.max(-1, keepdims=True)
+    p = np.exp(s - m)
+    return np.einsum("bhtc,bhcd->bhtd",
+                     p / np.maximum(p.sum(-1, keepdims=True), 1e-30), v)
+
+
+def _geometry(rng, B=3, H=4, hd=16, page=16, G=4, T=1, dtype=np.float32):
+    """A ragged paged layout: slot b holds ``lengths[b]`` tokens across
+    its first ceil(len/page) table entries; the rest are unmapped (-1)."""
+    P = B * G  # enough physical pages for a 1:1 mapping + 1 drop page
+    k_pool = rng.randn(P + 1, H, page, hd).astype(dtype)
+    v_pool = rng.randn(P + 1, H, page, hd).astype(dtype)
+    lengths = [G * page - 1 - 3 * b for b in range(B)]  # ragged, >= T
+    tables = np.full((B, G), -1, np.int32)
+    nxt = 0
+    for b in range(B):
+        for g in range(-(-lengths[b] // page)):
+            tables[b, g] = nxt
+            nxt += 1
+    q = rng.randn(B, H, T, hd).astype(np.float32)
+    kp = np.arange(G * page)
+    mask = np.zeros((B, T, G * page), bool)
+    for b in range(B):
+        mapped = np.repeat(tables[b] >= 0, page)
+        for t in range(T):
+            mask[b, t] = mapped & (kp <= lengths[b] - T + t)
+    return q, k_pool, v_pool, tables, mask
+
+
+def _quantize(pool, dtype):
+    """Per-(page entry, head) abs-max quantization, the serving layout:
+    scale [P+1, H, page] f32 applied over hd."""
+    amax = np.abs(pool).max(-1)
+    if dtype == "int8":
+        scale = amax / 127.0
+        qp = np.clip(np.round(pool / np.maximum(scale, 1e-30)[..., None]),
+                     -127, 127).astype(np.int8)
+        qp = jnp.asarray(qp)
+    else:  # fp8-e4m3
+        scale = amax / 448.0
+        qp = jnp.asarray(pool / np.maximum(scale, 1e-30)[..., None]
+                         ).astype(jnp.float8_e4m3fn)
+    return qp, jnp.asarray(scale.astype(np.float32))
+
+
+def _clipped(tables):
+    return jnp.maximum(jnp.asarray(tables), 0)
+
+
+class TestEquivalence:
+    def test_float_all_candidates(self):
+        rng = np.random.RandomState(0)
+        q, kp, vp, tab, mask = _geometry(rng)
+        cands = _paged_decode.candidates(q, kp, vp, tab, mask, None, None)
+        assert len(cands) >= 2  # H=4 -> at least block_h 1, 2, 4
+        want = _ref_attend(q, kp, vp, tab, mask)
+        for cfg in cands:
+            out = paged_flash_decode(jnp.asarray(q), jnp.asarray(kp),
+                                     jnp.asarray(vp), _clipped(tab),
+                                     jnp.asarray(mask), **cfg)
+            np.testing.assert_allclose(np.asarray(out), want,
+                                       rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.parametrize("qdtype", ["int8", "fp8"])
+    def test_quantized_all_candidates(self, qdtype):
+        rng = np.random.RandomState(1)
+        q, kp, vp, tab, mask = _geometry(rng)
+        kq, ks = _quantize(kp, qdtype)
+        vq, vs = _quantize(vp, qdtype)
+        # the oracle attends over the SAME dequantized values, so the
+        # comparison isolates the kernel, not the quantizer
+        want = _ref_attend(q, kq, vq, tab, mask, ks, vs)
+        cands = _paged_decode.candidates(q, kq, vq, tab, mask, ks, vs)
+        for cfg in cands:
+            out = paged_flash_decode(jnp.asarray(q), kq, vq, _clipped(tab),
+                                     jnp.asarray(mask), ks, vs, **cfg)
+            np.testing.assert_allclose(np.asarray(out), want,
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_speculative_verify_width(self):
+        # T = 1+k (k=4) pads to the sublane tile inside the kernel; all
+        # T rows are valid queries at staggered causal positions
+        rng = np.random.RandomState(2)
+        q, kp, vp, tab, mask = _geometry(rng, T=5)
+        assert mask.all(-1).sum() == 0  # staggered causality is live
+        want = _ref_attend(q, kp, vp, tab, mask)
+        out = paged_flash_decode(jnp.asarray(q), jnp.asarray(kp),
+                                 jnp.asarray(vp), _clipped(tab),
+                                 jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(out), want,
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_drop_page_and_unmapped_pages_never_contribute(self):
+        rng = np.random.RandomState(3)
+        q, kp, vp, tab, mask = _geometry(rng)
+        out0 = paged_flash_decode(jnp.asarray(q), jnp.asarray(kp),
+                                  jnp.asarray(vp), _clipped(tab),
+                                  jnp.asarray(mask))
+        # poison the write-drop page (last) AND every unmapped page: the
+        # mask (not the data) must be what excludes them
+        kp2, vp2 = kp.copy(), vp.copy()
+        kp2[-1] = vp2[-1] = 1e4
+        used = set(tab[tab >= 0].ravel())
+        for p in range(kp.shape[0] - 1):
+            if p not in used:
+                kp2[p] = vp2[p] = -1e4
+        out1 = paged_flash_decode(jnp.asarray(q), jnp.asarray(kp2),
+                                  jnp.asarray(vp2), _clipped(tab),
+                                  jnp.asarray(mask))
+        np.testing.assert_array_equal(np.asarray(out0), np.asarray(out1))
+
+    def test_fully_masked_row_emits_zeros(self):
+        rng = np.random.RandomState(4)
+        q, kp, vp, tab, mask = _geometry(rng, T=2)
+        mask[1, 0, :] = False  # e.g. a slot mid-admission: no valid kv yet
+        out = paged_flash_decode(jnp.asarray(q), jnp.asarray(kp),
+                                 jnp.asarray(vp), _clipped(tab),
+                                 jnp.asarray(mask))
+        np.testing.assert_array_equal(np.asarray(out)[1, :, 0], 0.0)
+        want = _ref_attend(q, kp, vp, tab, mask)
+        vb, vt = np.nonzero(np.asarray(mask).any(-1))  # valid rows only
+        np.testing.assert_allclose(np.asarray(out)[vb, :, vt],
+                                   want[vb, :, vt], rtol=2e-4, atol=2e-5)
+
+    def test_bf16_query_pool(self):
+        rng = np.random.RandomState(5)
+        q, kp, vp, tab, mask = _geometry(rng)
+        qb = jnp.asarray(q, jnp.bfloat16)
+        kb = jnp.asarray(kp, jnp.bfloat16)
+        vb = jnp.asarray(vp, jnp.bfloat16)
+        out = paged_flash_decode(qb, kb, vb, _clipped(tab),
+                                 jnp.asarray(mask))
+        assert out.dtype == jnp.bfloat16
+        want = _ref_attend(np.asarray(qb, np.float32),
+                           np.asarray(kb, np.float32),
+                           np.asarray(vb, np.float32), tab, mask)
+        np.testing.assert_allclose(np.asarray(out, np.float32), want,
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_scale_pair_enforced(self):
+        rng = np.random.RandomState(6)
+        q, kp, vp, tab, mask = _geometry(rng)
+        kq, ks = _quantize(kp, "int8")
+        with pytest.raises(InvalidArgumentError):
+            paged_flash_decode(jnp.asarray(q), kq, kq, _clipped(tab),
+                               jnp.asarray(mask), k_scale=ks)
+
+
+class TestEligibility:
+    def test_cpu_backend_falls_back(self):
+        # the gather path is the CPU reference; interpret-mode pallas
+        # must never be the production dispatch
+        assert jax.default_backend() != "tpu"
+        assert not paged_flash_eligible(head_dim=64, page_size=16)
+
+    def test_tpu_override_would_dispatch(self):
+        assert paged_flash_eligible(head_dim=64, page_size=16,
+                                    backend="tpu")
+
+    def test_alignment_and_flag_gate(self):
+        assert not paged_flash_eligible(head_dim=12, backend="tpu")
+        assert not paged_flash_eligible(page_size=12, backend="tpu")
+        set_flags({"paged_flash": False})
+        try:
+            assert not paged_flash_eligible(head_dim=64, backend="tpu")
+        finally:
+            set_flags({"paged_flash": True})

@@ -254,7 +254,16 @@ def gate_paged(model):
     d_tps, p_tps = total / dense["wall"], total / paged["wall"]
     pst = paged["stats"]
     drafted = int(pst.get("spec_drafted", 0))
+    # paged-flash dispatch gate: on this CPU host the engine MUST have
+    # used the gather-then-attend fallback (so the bit-identity above is
+    # the fallback's correctness proof), while the same geometry on a
+    # TPU backend must select the Pallas kernel (ops/paged_attention.py)
+    from paddle_tpu.ops.paged_attention import paged_flash_eligible
+    hd = 32 // 4  # gate_paged model: hidden 32, 4 heads
     return {
+        "flash_fallback_on_cpu": not paged_flash_eligible(hd, PAGE_SIZE),
+        "flash_selected_on_tpu": paged_flash_eligible(hd, PAGE_SIZE,
+                                                      backend="tpu"),
         "token_identical": bool(paged["outs"] == refs),
         "dense_identical": bool(dense["outs"] == refs),
         "hbm_budget_pages": POOL_PAGES,  # DENSE_SLOTS * CACHE / PAGE_SIZE
@@ -290,7 +299,9 @@ def main():
               and probe["probe_failures"] == 0
               and paged["token_identical"] and paged["dense_identical"]
               and paged["resident_slots_up"] and paged["tps_not_worse"]
-              and paged["closed_compile_set"])
+              and paged["closed_compile_set"]
+              and paged["flash_fallback_on_cpu"]
+              and paged["flash_selected_on_tpu"])
     print(json.dumps({"pass": bool(passed), "hol": hol, "probe": probe,
                       "paged": paged,
                       "seconds": round(time.time() - t0, 1)}))

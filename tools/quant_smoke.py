@@ -205,6 +205,62 @@ def gate_hbm(model):
     }
 
 
+def _count_eqns(jaxpr, pred):
+    n = 0
+    for eqn in jaxpr.eqns:
+        n += int(pred(eqn))
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+                inner = getattr(sub, "jaxpr", None)
+                if inner is not None:
+                    n += _count_eqns(inner, pred)
+    return n
+
+
+def gate_flash_dispatch(model):
+    """The quantized paged decode's kernel-dispatch contract: on this CPU
+    host the engine keeps the gather-then-attend fallback (whose output
+    the agreement gate above scores), the same geometry on a TPU backend
+    selects the Pallas paged-flash kernel, and that kernel's dispatch
+    graph contains NO pool-sized int8→float conversion — pages are
+    dequantized per-block inside the kernel, so the quantized pool's
+    HBM-byte advantage (gate_hbm) survives the attention read."""
+    from paddle_tpu.ops.paged_attention import paged_flash_decode
+    from paddle_tpu.ops.paged_attention import paged_flash_eligible
+
+    cfg = model.gpt.cfg
+    hd = cfg.hidden_size // cfg.num_heads
+    H, P = cfg.num_heads, INT8_PAGES
+    rng = np.random.RandomState(23)
+    q = jnp.asarray(rng.randn(SLOTS, H, 1, hd), jnp.float32)
+    pool = jnp.asarray(rng.randint(-127, 128, (P + 1, H, PAGE, hd)),
+                       jnp.int8)
+    scale = jnp.asarray(rng.rand(P + 1, H, PAGE), jnp.float32)
+    tables = jnp.zeros((SLOTS, CACHE // PAGE), jnp.int32)
+    mask = jnp.ones((SLOTS, 1, CACHE), bool)
+    jaxpr = jax.make_jaxpr(
+        lambda *a: paged_flash_decode(*a, block_h=1))(
+            q, pool, pool, tables, mask, scale, scale)
+    pool_shape = tuple(pool.shape)
+    full_dequants = _count_eqns(
+        jaxpr.jaxpr,
+        lambda e: (e.primitive.name == "convert_element_type"
+                   and tuple(getattr(e.invars[0].aval, "shape", ())) ==
+                   pool_shape
+                   and str(e.outvars[0].aval.dtype) == "float32"))
+    kernel_calls = _count_eqns(
+        jaxpr.jaxpr, lambda e: e.primitive.name == "pallas_call")
+    return {
+        "fallback_on_cpu": not paged_flash_eligible(hd, PAGE),
+        "selected_on_tpu": paged_flash_eligible(hd, PAGE, backend="tpu"),
+        "kernel_calls_in_graph": kernel_calls,
+        "full_pool_float_dequants": full_dequants,
+        "ok": bool(not paged_flash_eligible(hd, PAGE)
+                   and paged_flash_eligible(hd, PAGE, backend="tpu")
+                   and kernel_calls == 1 and full_dequants == 0),
+    }
+
+
 def gate_rolling_swap(model):
     """Quantized rolling swap across a router: zero XLA compile events."""
     donor = _model(seed=29)  # different weights, same tree geometry
@@ -250,10 +306,13 @@ def main():
     model = _model()
     agreement = gate_agreement(model)
     hbm = gate_hbm(model)
+    flash = gate_flash_dispatch(model)
     swap = gate_rolling_swap(model)
-    passed = agreement["ok"] and hbm["ok"] and swap["ok"]
+    passed = (agreement["ok"] and hbm["ok"] and flash["ok"]
+              and swap["ok"])
     print(json.dumps({"pass": bool(passed), "agreement": agreement,
-                      "hbm": hbm, "rolling_swap": swap,
+                      "hbm": hbm, "flash_dispatch": flash,
+                      "rolling_swap": swap,
                       "seconds": round(time.time() - t0, 1)}))
     return 0 if passed else 1
 
