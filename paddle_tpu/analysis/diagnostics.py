@@ -83,6 +83,10 @@ RULES = {
     "S602": (Severity.WARNING,
              "serving router instability after warmup (replica health "
              "flapping, or hedged requests pinned at their budget)"),
+    "S607": (Severity.WARNING,
+             "multi-tenant isolation failure (an in-budget tenant "
+             "sustainedly starved past the weighted-fair share, or "
+             "installed LoRA adapters never matched by any request)"),
     # -- kernel autotuner (K7xx) ---------------------------------------------
     "K701": (Severity.WARNING,
              "kernel autotuning inside a serving hot path (tuning cache "

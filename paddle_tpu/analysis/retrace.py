@@ -85,6 +85,10 @@ class RetraceMonitor:
         # name, published on every C1004/C1005 violation (framework/
         # locking.py); the violation details ride last_rule/last_message
         self._concurrency_sites: Dict[str, dict] = {}
+        # ("tenancy", engine) multi-tenant scheduler snapshots: latest
+        # per engine — per-tenant starvation/budget state plus LoRA
+        # adapter-table liveness.  Rule S607.
+        self._tenancy_sites: Dict[str, dict] = {}
 
     # -- subscription --------------------------------------------------------
     def install(self):
@@ -172,6 +176,12 @@ class RetraceMonitor:
             # latest wins (rules C1004 / C1005)
             with self._lock:
                 self._concurrency_sites[key[1]] = dict(info)
+            return
+        if key[0] == "tenancy":
+            # multi-tenant scheduler snapshot: cumulative per-tenant
+            # counters + adapter-table liveness, latest wins (rule S607)
+            with self._lock:
+                self._tenancy_sites[key[1]] = dict(info)
             return
         sig = _freeze(info)
         with self._lock:
@@ -300,6 +310,15 @@ class RetraceMonitor:
                 return dict(self._concurrency_sites.get(name, {}))
             return {k: dict(v)
                     for k, v in self._concurrency_sites.items()}
+
+    def tenancy_stats(self, name: str = None):
+        """Latest multi-tenant scheduler snapshot(s) observed (per-tenant
+        admission/budget/starvation state plus LoRA adapter-table
+        liveness): the dict for one engine, or all of them."""
+        with self._lock:
+            if name is not None:
+                return dict(self._tenancy_sites.get(name, {}))
+            return {k: dict(v) for k, v in self._tenancy_sites.items()}
 
     def diagnostics(self) -> List[Diagnostic]:
         out = DiagnosticCollector()
@@ -738,6 +757,54 @@ class RetraceMonitor:
                          "order (C1004) or shrink the critical section / "
                          "construct the lock with warn=False when the "
                          "long hold is by design (C1005)")
+        with self._lock:
+            ten_sites = {k: dict(v) for k, v in self._tenancy_sites.items()}
+        for name, stats in ten_sites.items():
+            steps = int(stats.get("decode_steps_after_warm", 0))
+            # S607 (scheduler side): an IN-budget tenant sustainedly
+            # starved after warmup — the weighted-fair order is being
+            # defeated (misconfigured weights, a carry full of another
+            # tenant's work, or slots pinned by long requests), which is
+            # exactly the isolation failure the scheduler exists to
+            # prevent.  Over-budget tenants waiting is throttling by
+            # design and never fires this.
+            for tn, ts in (stats.get("tenants") or {}).items():
+                starved = int(ts.get("starved_after_warm", 0))
+                if starved <= self.budget or ts.get("over_budget"):
+                    continue
+                out.add("S607",
+                        f"tenant {tn!r} on engine {name} waited through "
+                        f"{starved} post-warmup admission passes (budget "
+                        f"{self.budget}) while IN budget "
+                        f"(weight {ts.get('weight')}, "
+                        f"{ts.get('queued', 0)} request(s) queued, "
+                        f"{ts.get('admitted', 0)} admitted so far) — "
+                        f"weighted-fair admission is failing to protect "
+                        f"this tenant's share",
+                        location=Location(file=name, function=name),
+                        hint="raise the tenant's TenantSpec weight, cap "
+                             "the competing tenants' token budgets, or "
+                             "add batch_size slots — sustained in-budget "
+                             "starvation means demand exceeds the fair "
+                             "share the current dials can grant")
+            # S607 (adapter side): installed LoRA table entries that no
+            # post-warmup decode step ever gathered — dead weights
+            # occupying adapter-table HBM on every step's gather
+            dead = int(stats.get("adapters_dead", 0))
+            if dead > 0 and steps >= 50:
+                out.add("S607",
+                        f"engine {name} carries {dead} installed LoRA "
+                        f"adapter(s) never matched by any request across "
+                        f"{steps} post-warmup decode steps "
+                        f"({stats.get('adapters_installed', 0)} "
+                        f"installed) — dead table entries ride every "
+                        f"step's adapter gather and hold table capacity "
+                        f"without serving a tenant",
+                        location=Location(file=name, function=name),
+                        hint="remove_adapter(slot) the unused entries "
+                             "(hot, zero recompiles) or fix the tenant "
+                             "spec adapter_id wiring so traffic actually "
+                             "reaches them")
         return out.diagnostics
 
     @staticmethod

@@ -60,6 +60,19 @@ def _quantized_forward(layer, x):
                             scale.value, bias)
 
 
+def _lora_leg(layer, x, y):
+    """Batched multi-LoRA delta shared by Column/RowParallelLinear: when
+    the layer carries an adapter table (``lora.enable_lora``) AND a
+    per-slot id scope is active (the serving step installs one), add the
+    ragged grouped delta; rows with id -1 keep the base output bitwise.
+    The membership check is the only cost for LoRA-free layers."""
+    if "lora_A" not in layer._buffers:
+        return y
+    from ..lora.batched import apply_lora
+
+    return apply_lora(layer, x, y)
+
+
 def constrain(x, *spec):
     """Apply a sharding constraint when tracing (no-op eagerly, and a
     no-op inside ``mesh.suppress_constraints`` scopes — fully-manual
@@ -97,6 +110,7 @@ class ColumnParallelLinear(Layer):
             y = jnp.matmul(jnp.asarray(x), jnp.asarray(self.weight))
             if self.bias is not None:
                 y = y + jnp.asarray(self.bias)
+        y = _lora_leg(self, x, y)
         if self.gather_output:
             y = constrain(y, *([None] * y.ndim))
         else:
@@ -140,13 +154,14 @@ class RowParallelLinear(Layer):
         defer = bool(get_overlap_schedule().get("defer_row_reduce"))
         if str(jnp.asarray(self.weight).dtype) in _QUANT_DTYPES:
             y = _quantized_forward(self, x)
+            y = _lora_leg(self, x, y)
             return y if defer else constrain(y, *([None] * y.ndim))
         y = jnp.matmul(x, jnp.asarray(self.weight))
         if not defer:
             y = constrain(y, *([None] * y.ndim))
         if self.bias is not None:
             y = y + jnp.asarray(self.bias)
-        return y
+        return _lora_leg(self, x, y)
 
 
 class VocabParallelEmbedding(Layer):

@@ -1,0 +1,255 @@
+"""Batched multi-LoRA: fixed-capacity adapter tables, ragged grouped apply.
+
+S-LoRA's observation (Sheng et al., MLSys 2024): serving N adapters from
+one continuously-batched engine beats N per-adapter replicas when the
+per-row adapter gather is a single ragged grouped computation instead of
+a per-request branch.  The TPU-native spelling here keeps every shape
+static so the serving compile set stays closed:
+
+* each targeted parallel linear carries THREE buffers —
+  ``lora_A [cap, in, r]``, ``lora_B [cap, r, out]``, ``lora_scale
+  [cap]`` — a fixed-capacity table of ``cap`` adapter slots.  Buffers
+  ride ``buffer_pytree()`` into the serving executables as ARGUMENTS, so
+  hot add/remove of an adapter edits host-side leaves (the
+  ``swap_weights`` machinery) and recompiles nothing;
+* per decode step the engine scopes a ``[B]`` id vector
+  (``runtime.adapter_scope``); the linear's base matmul is untouched and
+  the delta is ``grouped_matmul(scatter(x), A_stack) · B_stack`` over
+  the table — the same compacted one-hot/cumsum dispatch as the MoE
+  layer, with ``grouped_matmul`` (PR 14) when lane-aligned and the
+  masked-einsum reference otherwise;
+* slot id ``-1`` = no adapter: the final combine is a ``where`` that
+  SELECTS the base output for dead rows, so a base-tenant row is
+  bitwise identical to a model without LoRA enabled.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.errors import InvalidArgumentError
+
+__all__ = [
+    "DEFAULT_TARGETS", "lora_targets", "enable_lora", "apply_lora",
+    "lora_delta", "write_adapter", "clear_slot", "adapter_capacity",
+]
+
+#: leaf names of the parallel linears that take adapter deltas — the
+#: transformer block projections, NOT the (tied) embedding / LM head
+DEFAULT_TARGETS = ("qkv", "out", "fc1", "fc2")
+
+
+def lora_targets(model, targets: Sequence[str] = DEFAULT_TARGETS
+                 ) -> List[Tuple[str, object]]:
+    """``(dotted_name, layer)`` for every parallel linear whose leaf name
+    is in ``targets`` (``None`` = every parallel linear)."""
+    from ..distributed.meta_parallel import (ColumnParallelLinear,
+                                             RowParallelLinear)
+
+    out = []
+    for name, layer in model.named_sublayers(include_self=True):
+        if not isinstance(layer, (ColumnParallelLinear, RowParallelLinear)):
+            continue
+        leaf = name.rsplit(".", 1)[-1]
+        if targets is not None and leaf not in tuple(targets):
+            continue
+        out.append((name, layer))
+    return out
+
+
+def enable_lora(model, capacity: int, rank: int, alpha: float = None,
+                targets: Sequence[str] = DEFAULT_TARGETS,
+                dtype: str = "float32") -> List[str]:
+    """Register zero-initialized adapter tables on every target linear.
+
+    Zero tables mean an enabled-but-empty model computes ``base + 0`` on
+    live rows and exactly ``base`` on ``-1`` rows — safe to enable
+    eagerly at model construction.  Returns the dotted site names (the
+    keys adapters must address)."""
+    capacity = int(capacity)
+    rank = int(rank)
+    if capacity < 1:
+        raise InvalidArgumentError(
+            f"lora capacity must be >= 1, got {capacity}")
+    if rank < 1:
+        raise InvalidArgumentError(f"lora rank must be >= 1, got {rank}")
+    scale = (float(alpha) if alpha is not None else float(rank)) / float(rank)
+    sites = lora_targets(model, targets)
+    if not sites:
+        raise InvalidArgumentError(
+            f"enable_lora: no parallel-linear targets matching "
+            f"{tuple(targets)!r} under {type(model).__name__}")
+    for name, layer in sites:
+        if "lora_A" in layer._buffers:
+            raise InvalidArgumentError(
+                f"enable_lora: {name} already has an adapter table")
+        din, dout = (int(s) for s in layer.weight.value.shape)
+        layer.register_buffer(
+            "lora_A", jnp.zeros((capacity, din, rank), dtype))
+        layer.register_buffer(
+            "lora_B", jnp.zeros((capacity, rank, dout), dtype))
+        layer.register_buffer(
+            "lora_scale", jnp.full((capacity,), scale, jnp.float32))
+    return [n for n, _ in sites]
+
+
+def _grouped(xe, w, counts):
+    """[G, C, D] x [G, D, F] with per-group valid-row counts — Pallas
+    grouped kernel when lane-aligned, masked-einsum reference otherwise
+    (the MoE layer's exact gate; LoRA's inner dim is the rank, which is
+    rarely lane-aligned, so the first hop usually takes the einsum)."""
+    from ..ops import autotune as _at
+
+    if (_at.fused_epilogues_eligible(int(xe.shape[-1]))
+            and _at.fused_epilogues_eligible(int(w.shape[-1]))):
+        from ..ops.grouped_matmul import grouped_matmul
+
+        return grouped_matmul(xe, w, counts)
+    rows = xe.shape[1]
+    mask = (jnp.arange(rows)[None, :] < counts[:, None]).astype(xe.dtype)
+    return jnp.einsum("gcd,gdf->gcf", xe * mask[..., None], w)
+
+
+def lora_delta(A, B, scale, x2, ids_row):
+    """Per-row adapter delta over the fixed table.
+
+    ``x2 [N, D]`` rows carry ``ids_row [N]`` adapter ids (−1 = none).
+    Compacted dispatch (one-hot + exclusive cumsum = position within
+    group, as in ``moe.layer``) scatters live rows group-major into
+    ``[cap, N, D]``, runs both low-rank hops grouped, and gathers each
+    row's delta back.  Returns ``(delta [N, F], live [N] bool)``; dead
+    rows' delta is exact zero but callers should still ``where`` on
+    ``live`` for bitwise base output."""
+    cap = int(A.shape[0])
+    n = x2.shape[0]
+    onehot = jax.nn.one_hot(ids_row, cap, dtype=jnp.int32)  # -1 -> zeros
+    counts = onehot.sum(axis=0)
+    posn = jnp.cumsum(onehot, axis=0) - onehot
+    idx = (onehot * posn).sum(axis=-1)
+    cid = jnp.clip(ids_row, 0, cap - 1)
+    live = ids_row >= 0
+    xm = jnp.where(live[:, None], x2, 0).astype(A.dtype)
+    xd = jnp.zeros((cap, n) + (x2.shape[-1],), A.dtype).at[cid, idx].add(xm)
+    h = _grouped(xd, A, counts)        # [cap, N, r]
+    z = _grouped(h, B, counts)         # [cap, N, F]
+    d = z[cid, idx] * scale[cid][:, None].astype(z.dtype)
+    return d, live
+
+
+def apply_lora(layer, x, y):
+    """Add the scoped batched-LoRA delta to a parallel-linear output.
+
+    Called from ``ColumnParallelLinear.forward`` /
+    ``RowParallelLinear.forward`` when the layer carries a ``lora_A``
+    buffer.  Outside any ``runtime.adapter_scope`` this returns ``y``
+    untouched (training / plain forwards pay one dict lookup)."""
+    from . import runtime
+
+    ids = runtime.active_ids()
+    if ids is None:
+        return y
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    b = int(ids.shape[0])
+    lead = x.shape[:-1]
+    if not lead or int(lead[0]) != b:
+        raise InvalidArgumentError(
+            f"apply_lora: input leading dim {lead} does not start with "
+            f"the scoped batch {b}")
+    A = layer._buffers["lora_A"].value
+    B = layer._buffers["lora_B"].value
+    scale = layer._buffers["lora_scale"].value
+    x2 = x.reshape(-1, x.shape[-1])
+    ids_row = jnp.broadcast_to(
+        ids.reshape((b,) + (1,) * (len(lead) - 1)), lead).reshape(-1)
+    d, live = lora_delta(A, B, scale, x2, ids_row)
+    y2 = y.reshape(-1, y.shape[-1])
+    # where, not plain add: selects the untouched base row at id -1, so
+    # base-tenant output is bitwise the no-LoRA model's
+    y2 = jnp.where(live[:, None], y2 + d.astype(y2.dtype), y2)
+    return y2.reshape(y.shape)
+
+
+# -- host-side table edits (the swap_weights-shaped hot path) -----------------
+
+def adapter_capacity(buffers: Dict[str, object]) -> int:
+    """Adapter-table capacity from a flat buffer tree (0 = no LoRA)."""
+    for k, v in buffers.items():
+        if k.endswith(".lora_A") or k == "lora_A":
+            return int(np.asarray(v).shape[0])
+    return 0
+
+
+def write_adapter(buffers: Dict[str, object], slot: int, adapter
+                  ) -> Dict[str, object]:
+    """New flat buffer dict with ``adapter`` written into table ``slot``.
+
+    Pure w.r.t. the input tree (touched leaves are copies) so the engine
+    can swap the whole dict atomically between dispatches.  Shapes and
+    dtypes are preserved — the edit is invisible to the compile cache.
+    Adapters of rank ``r <= table rank`` zero-pad: padded A columns meet
+    padded B rows, so the delta is unchanged."""
+    out = dict(buffers)
+    slot = int(slot)
+    touched = 0
+    for site, (a_np, b_np) in adapter.sites.items():
+        ak, bk, sk = (site + ".lora_A", site + ".lora_B",
+                      site + ".lora_scale")
+        if ak not in out or bk not in out or sk not in out:
+            raise InvalidArgumentError(
+                f"adapter {adapter.name!r} addresses unknown site "
+                f"{site!r} (no {ak} buffer — was the model built with "
+                f"lora_capacity > 0 and matching targets?)")
+        at = np.array(out[ak], copy=True)
+        bt = np.array(out[bk], copy=True)
+        st = np.array(out[sk], copy=True)
+        cap, din, r_tab = at.shape
+        dout = bt.shape[2]
+        if not 0 <= slot < cap:
+            raise InvalidArgumentError(
+                f"adapter slot {slot} out of range [0, {cap})")
+        if adapter.rank > r_tab:
+            raise InvalidArgumentError(
+                f"adapter {adapter.name!r} rank {adapter.rank} exceeds "
+                f"table rank {r_tab} at {site}")
+        if a_np.shape != (din, adapter.rank) or \
+                b_np.shape != (adapter.rank, dout):
+            raise InvalidArgumentError(
+                f"adapter {adapter.name!r} site {site}: A{a_np.shape} / "
+                f"B{b_np.shape} do not match layer [{din} -> {dout}] at "
+                f"rank {adapter.rank}")
+        at[slot] = 0
+        at[slot, :, :adapter.rank] = a_np.astype(at.dtype)
+        bt[slot] = 0
+        bt[slot, :adapter.rank, :] = b_np.astype(bt.dtype)
+        st[slot] = adapter.scale
+        out[ak], out[bk], out[sk] = at, bt, st
+        touched += 1
+    if not touched:
+        raise InvalidArgumentError(
+            f"adapter {adapter.name!r} has no sites")
+    return out
+
+
+def clear_slot(buffers: Dict[str, object], slot: int) -> Dict[str, object]:
+    """New flat buffer dict with table ``slot`` zeroed at every site —
+    id ``slot`` then computes a zero delta (base output on live rows)."""
+    out = dict(buffers)
+    slot = int(slot)
+    touched = 0
+    for k in list(out.keys()):
+        if not (k.endswith(".lora_A") or k.endswith(".lora_B")):
+            continue
+        t = np.array(out[k], copy=True)
+        if not 0 <= slot < t.shape[0]:
+            raise InvalidArgumentError(
+                f"adapter slot {slot} out of range [0, {t.shape[0]})")
+        t[slot] = 0
+        out[k] = t
+        touched += 1
+    if not touched:
+        raise InvalidArgumentError("clear_slot: tree has no adapter tables")
+    return out

@@ -1,0 +1,65 @@
+"""Trace-scoped per-slot adapter ids for the batched multi-LoRA path.
+
+The serving engine decides *per decode step* which adapter each batch
+slot uses; the model's linear layers are many call frames below and
+their signatures should not grow a LoRA argument apiece.  Same problem
+shape as ``moe.stats``: thread-local scope, pushed by the caller that
+owns the step, read by whoever happens to run inside it.
+
+``adapter_scope(ids)`` installs a ``[B]`` int32 vector (slot id ``-1``
+= no adapter); ``active_ids()`` returns the innermost vector or
+``None``.  Under jit the vector is a tracer captured at trace time —
+scopes are per-thread, so a serving decode trace and a training trace
+on another thread never see each other's ids.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+__all__ = ["adapter_scope", "active_ids", "active"]
+
+_local = threading.local()
+
+
+def _stack():
+    st = getattr(_local, "stack", None)
+    if st is None:
+        st = _local.stack = []
+    return st
+
+
+class adapter_scope:
+    """Context manager binding a per-slot adapter-id vector ``[B]``.
+
+    Nesting is allowed (innermost wins) so a caller can temporarily
+    disable LoRA by pushing an all ``-1`` vector.
+    """
+
+    def __init__(self, ids):
+        self._ids = jnp.asarray(ids, jnp.int32)
+        if self._ids.ndim != 1:
+            from ..framework.errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                f"adapter_scope expects a [B] id vector, got shape "
+                f"{self._ids.shape}")
+
+    def __enter__(self):
+        _stack().append(self._ids)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _stack().pop()
+        return False
+
+
+def active_ids():
+    """The innermost scoped id vector, or ``None`` outside any scope."""
+    st = _stack()
+    return st[-1] if st else None
+
+
+def active() -> bool:
+    return bool(_stack())

@@ -77,7 +77,8 @@ class GPTConfig:
                  dropout=0.1, layer_norm_epsilon=1e-5, dtype="float32",
                  sequence_parallel=None, moe_experts=0, moe_top_k=2,
                  moe_capacity_factor=1.25, moe_jitter=0.01,
-                 moe_balance_weight=0.01, quantization="none"):
+                 moe_balance_weight=0.01, quantization="none",
+                 lora_capacity=0, lora_rank=8, lora_alpha=16.0):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -110,6 +111,20 @@ class GPTConfig:
                 f"quantization must be 'none', 'int8' or 'fp8', got "
                 f"{quantization!r}")
         self.quantization = quantization
+        #: > 0 registers fixed-capacity batched multi-LoRA adapter
+        #: tables on every block projection (``lora.enable_lora``) —
+        #: that many hot-swappable adapter slots per linear; per-slot
+        #: adapter ids flow through ``forward_cached``/``forward_paged``
+        #: and id -1 is bitwise the base model.  0 = no LoRA.
+        if int(lora_capacity) < 0:
+            raise ValueError(
+                f"lora_capacity must be >= 0, got {lora_capacity!r}")
+        if int(lora_capacity) > 0 and int(lora_rank) < 1:
+            raise ValueError(
+                f"lora_rank must be >= 1, got {lora_rank!r}")
+        self.lora_capacity = int(lora_capacity)
+        self.lora_rank = int(lora_rank)
+        self.lora_alpha = float(lora_alpha)
 
 
 def gpt_tiny(**kw):
@@ -437,6 +452,15 @@ class GPTModel(Layer):
             from ..slim.quantization import quantize_weights
 
             quantize_weights(self, cfg.quantization)
+        if getattr(cfg, "lora_capacity", 0) > 0:
+            # register zero-initialized batched multi-LoRA adapter tables
+            # on every block projection; zero tables + id -1 keep the
+            # enabled model bitwise the base model.  Lazy import for the
+            # same cycle reason as slim above.
+            from ..lora.batched import enable_lora
+
+            enable_lora(self, cfg.lora_capacity, cfg.lora_rank,
+                        cfg.lora_alpha, dtype=cfg.dtype)
 
     def forward(self, input_ids, attn_mask=None):
         from ..distributed.pipeline_parallel import (
@@ -638,7 +662,8 @@ class GPTModel(Layer):
             new_layers.append(nl)
         return {"layers": new_layers}
 
-    def forward_paged(self, input_ids, positions, pos_map, table, cache):
+    def forward_paged(self, input_ids, positions, pos_map, table, cache,
+                      adapter_ids=None):
         """Prefill/decode forward over :meth:`init_paged_cache` state.
 
         Same contract as :meth:`forward_cached` — ``input_ids`` /
@@ -674,13 +699,27 @@ class GPTModel(Layer):
         mask = (kp >= 0) & (kp <= qp) & (kp > qp - C)  # [B,T,C]
         gather_tab = jnp.maximum(table, 0)  # unmapped → page 0; mask hides it
         new_layers = []
-        for blk, kv in zip(self.blocks, cache["layers"]):
-            x, kv = blk.forward_paged(x, kv, write_page, write_off,
-                                      gather_tab, mask)
-            new_layers.append(kv)
+        with self._lora_scope(adapter_ids):
+            for blk, kv in zip(self.blocks, cache["layers"]):
+                x, kv = blk.forward_paged(x, kv, write_page, write_off,
+                                          gather_tab, mask)
+                new_layers.append(kv)
         return self.ln_f(x), {"layers": new_layers}
 
-    def forward_cached(self, input_ids, positions, cache):
+    def _lora_scope(self, adapter_ids):
+        """Scope the ``[B]`` per-slot adapter ids around the block stack
+        (inert ``nullcontext`` when the caller passed none) — the block
+        projections pick them up via ``lora.runtime``; the embeddings,
+        final LN and the tied LM head are outside and never adapted."""
+        if adapter_ids is None:
+            from contextlib import nullcontext
+
+            return nullcontext()
+        from ..lora.runtime import adapter_scope
+
+        return adapter_scope(adapter_ids)
+
+    def forward_cached(self, input_ids, positions, cache, adapter_ids=None):
         """Prefill/decode forward over :meth:`init_cache` state.
 
         ``input_ids``/``positions`` are ``[B,T]`` — ``T`` is the prompt
@@ -706,9 +745,10 @@ class GPTModel(Layer):
         kp, qp = new_pos[:, None, :], positions[:, :, None]
         mask = (kp >= 0) & (kp <= qp) & (kp > qp - C)  # [B,T,C]
         new_layers = []
-        for blk, kv in zip(self.blocks, cache["layers"]):
-            x, kv = blk.forward_cached(x, kv, hit, mask)
-            new_layers.append(kv)
+        with self._lora_scope(adapter_ids):
+            for blk, kv in zip(self.blocks, cache["layers"]):
+                x, kv = blk.forward_cached(x, kv, hit, mask)
+                new_layers.append(kv)
         return self.ln_f(x), {"pos": new_pos, "layers": new_layers}
 
 
@@ -734,7 +774,8 @@ class GPTForCausalLM(Layer):
         logits = jnp.einsum("bsd,vd->bsv", h, jnp.asarray(self.gpt.wte.weight))
         return constrain(logits, None, None, None)
 
-    def forward_cached(self, input_ids, positions, cache, gather_last=None):
+    def forward_cached(self, input_ids, positions, cache, gather_last=None,
+                       adapter_ids=None):
         """KV-cache forward (see :meth:`GPTModel.forward_cached`).
 
         With ``gather_last`` (per-sequence prompt lengths ``[B]``), only
@@ -744,7 +785,8 @@ class GPTForCausalLM(Layer):
         FLOPs for large vocabularies.  Returns ``(logits, new_cache)``
         with logits ``[B,T,V]`` (or ``[B,V]`` under ``gather_last``).
         """
-        h, cache = self.gpt.forward_cached(input_ids, positions, cache)
+        h, cache = self.gpt.forward_cached(input_ids, positions, cache,
+                                           adapter_ids=adapter_ids)
         if gather_last is not None:
             idx = jnp.maximum(jnp.asarray(gather_last, jnp.int32) - 1, 0)
             h = jnp.take_along_axis(
@@ -757,12 +799,13 @@ class GPTForCausalLM(Layer):
         return constrain(logits, None, None, None), cache
 
     def forward_paged(self, input_ids, positions, pos_map, table, cache,
-                      gather_last=None):
+                      gather_last=None, adapter_ids=None):
         """Paged KV forward (see :meth:`GPTModel.forward_paged`).  Same
         ``gather_last`` contract as :meth:`forward_cached`: per-sequence
         prompt lengths ``[B]`` project only the last hidden state."""
         h, cache = self.gpt.forward_paged(input_ids, positions, pos_map,
-                                          table, cache)
+                                          table, cache,
+                                          adapter_ids=adapter_ids)
         if gather_last is not None:
             idx = jnp.maximum(jnp.asarray(gather_last, jnp.int32) - 1, 0)
             h = jnp.take_along_axis(

@@ -54,8 +54,14 @@ KV-cache model paths into an online engine:
   page hand-offs from prefill-role to decode-role targets.
 * :mod:`~paddle_tpu.serving.scenarios` — deterministic open-loop
   traffic scenarios (diurnal ramps, flash crowds, heavy-tail budgets,
-  poison requests) and the :func:`run_scenario` harness that drives a
-  serving stack through them with zero-loss accounting.
+  poison requests, noisy-neighbor tenant floods) and the
+  :func:`run_scenario` harness that drives a serving stack through them
+  with zero-loss accounting.
+* :mod:`~paddle_tpu.serving.tenancy` — :class:`TenantScheduler`:
+  multi-tenant admission control in front of the continuous-batching
+  loop — weighted-fair (stride) ordering, per-tenant token budgets with
+  deterministic budget preemption, default LoRA adapter slots and
+  per-tenant SLO objectives (consumed by ``analysis`` rule S607).
 """
 from .batcher import MicroBatcher, Request
 from .bucketing import Bucket, BucketSet, as_bucket
@@ -67,7 +73,8 @@ from .pool import DisaggServer, ReplicaPool
 from .replica import Replica
 from .router import Router
 from .scenarios import (Scenario, ScenarioRequest, diurnal, flash_crowd,
-                        heavy_tail, poison, run_scenario)
+                        heavy_tail, noisy_neighbor, poison, run_scenario)
+from .tenancy import TenantScheduler, TenantSpec
 
 __all__ = [
     "Bucket",
@@ -89,6 +96,9 @@ __all__ = [
     "diurnal",
     "flash_crowd",
     "heavy_tail",
+    "noisy_neighbor",
     "poison",
     "run_scenario",
+    "TenantScheduler",
+    "TenantSpec",
 ]

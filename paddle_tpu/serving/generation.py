@@ -85,8 +85,9 @@ from ..resilience import CircuitBreaker, RetryPolicy
 from ..resilience import retry as _retry_mod
 from ..resilience.faults import fault_point
 from .batcher import MicroBatcher, Request
-from .metrics import (HANDOFF_COUNTERS, MOE_COUNTERS, PAGED_COUNTERS,
-                      QUANT_COUNTERS, ServingMetrics, SLOT_COUNTERS)
+from .metrics import (HANDOFF_COUNTERS, LORA_COUNTERS, MOE_COUNTERS,
+                      PAGED_COUNTERS, QUANT_COUNTERS, ServingMetrics,
+                      SLOT_COUNTERS, TENANCY_COUNTERS)
 from .paging import PagePool
 
 __all__ = ["GenerationEngine", "KVHandoff"]
@@ -202,6 +203,7 @@ class GenerationEngine:
                  speculative_k: Optional[int] = None,
                  role: str = "any",
                  quantized: Optional[str] = None,
+                 tenancy=None,
                  name: Optional[str] = None):
         if name is None:
             _gen_counter[0] += 1
@@ -283,45 +285,74 @@ class GenerationEngine:
             "moe_experts", 0) or 0)
         self._moe_pending = None
         self._moe_routed_cum = np.zeros(max(self._moe_experts, 1), np.int64)
+        # batched multi-LoRA: capacity > 0 threads a per-slot adapter-id
+        # column through every executable (warmup traces it with all -1,
+        # so the compile set closes exactly as without LoRA; adapter hot
+        # add/remove edits buffer leaves only)
+        self._lora_cap = int(getattr(
+            getattr(getattr(model, "gpt", None), "cfg", None),
+            "lora_capacity", 0) or 0)
+        self._adapters: Dict[int, str] = {}       # slot -> adapter name
+        self._adapter_hits = np.zeros(max(self._lora_cap, 1), np.int64)
+        self._tenancy_steps = 0  # post-warm decode steps (S607 denominator)
+        self._tenancy = tenancy
+        if tenancy is not None and not self._paged:
+            raise InvalidArgumentError(
+                "tenancy requires paged KV (budget preemption rides the "
+                "deterministic paged-pool release path)")
         extra = (SLOT_COUNTERS + PAGED_COUNTERS + HANDOFF_COUNTERS
                  if self._paged else SLOT_COUNTERS)
         if self._moe_experts:
             extra = extra + MOE_COUNTERS
         if self._quantized:
             extra = extra + QUANT_COUNTERS
+        if self._lora_cap:
+            extra = extra + LORA_COUNTERS
+        if tenancy is not None:
+            extra = extra + TENANCY_COUNTERS
         self.metrics = ServingMetrics(name, extra_counters=extra)
 
         mdl, traces = model, self._traces
+        # adapter-id args are threaded only when the model has LoRA
+        # tables — a 0-capacity engine's executables take aids=None and
+        # trace byte-identically to before
+        lora_on = bool(self._lora_cap)
 
-        def prefill(params, buffers, ids, positions, lens, cache):
-            def body(ids, positions, lens, cache):
+        def prefill(params, buffers, ids, positions, lens, cache,
+                    aids=None):
+            def body(ids, positions, lens, cache, aids):
                 traces["prefill"] += 1  # python side effect: once per trace
                 logits, cache = mdl.forward_cached(
-                    ids, positions, cache, gather_last=lens)
+                    ids, positions, cache, gather_last=lens,
+                    adapter_ids=aids if lora_on else None)
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
             return functional_call(mdl, params, ids, positions, lens, cache,
-                                   buffers=buffers, training=False, call=body)
+                                   aids, buffers=buffers, training=False,
+                                   call=body)
 
-        def decode(params, buffers, tok, pos, cache):
-            def body(tok, pos, cache):
+        def decode(params, buffers, tok, pos, cache, aids=None):
+            def body(tok, pos, cache, aids):
                 traces["decode"] += 1
                 if self._moe_experts:
                     from ..moe import stats as moe_stats
 
                     with moe_stats.collect() as ms:
                         logits, cache = mdl.forward_cached(
-                            tok[:, None], pos[:, None], cache)
+                            tok[:, None], pos[:, None], cache,
+                            adapter_ids=aids if lora_on else None)
                     return (jnp.argmax(logits[:, 0],
                                        axis=-1).astype(jnp.int32),
                             cache, ms.counts(self._moe_experts))
                 logits, cache = mdl.forward_cached(
-                    tok[:, None], pos[:, None], cache)
+                    tok[:, None], pos[:, None], cache,
+                    adapter_ids=aids if lora_on else None)
                 return (jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32),
                         cache)
-            return functional_call(mdl, params, tok, pos, cache,
+            return functional_call(mdl, params, tok, pos, cache, aids,
                                    buffers=buffers, training=False, call=body)
 
-        def admit(params, buffers, ids, positions, lens, mask, cache, tok):
+        def admit(params, buffers, ids, positions, lens, mask, cache, tok,
+                  aids=None):
             # slot admission: prefill into a FRESH cache (only admitted
             # rows carry real positions; the rest are -1 = inert), then
             # scatter exactly the admitted rows — cache AND first token —
@@ -329,16 +360,17 @@ class GenerationEngine:
             # bit-identical, so admission never perturbs live KV state,
             # and the admitted rows run the exact same per-row math as
             # the legacy prefill (token identity).
-            def body(ids, positions, lens, mask, cache, tok):
+            def body(ids, positions, lens, mask, cache, tok, aids):
                 traces["admit"] += 1
                 fresh = mdl.gpt.init_cache(ids.shape[0], self._cache_len)
                 logits, fresh = mdl.forward_cached(
-                    ids, positions, fresh, gather_last=lens)
+                    ids, positions, fresh, gather_last=lens,
+                    adapter_ids=aids if lora_on else None)
                 first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return (jnp.where(mask, first, tok),
                         mdl.gpt.write_slots(cache, fresh, mask))
             return functional_call(mdl, params, ids, positions, lens, mask,
-                                   cache, tok, buffers=buffers,
+                                   cache, tok, aids, buffers=buffers,
                                    training=False, call=body)
 
         def evict(tok, cache, mask):
@@ -352,14 +384,15 @@ class GenerationEngine:
         # so unlike the dense path no fresh-cache + row-scatter merge is
         # needed — live slots' KV is untouched by construction.
         def padmit(params, buffers, ids, positions, pos_map, table, lens,
-                   cache):
-            def body(ids, positions, pos_map, table, lens, cache):
+                   cache, aids=None):
+            def body(ids, positions, pos_map, table, lens, cache, aids):
                 traces["admit"] += 1
                 logits, cache = mdl.forward_paged(
-                    ids, positions, pos_map, table, cache, gather_last=lens)
+                    ids, positions, pos_map, table, cache, gather_last=lens,
+                    adapter_ids=aids if lora_on else None)
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
             return functional_call(mdl, params, ids, positions, pos_map,
-                                   table, lens, cache, buffers=buffers,
+                                   table, lens, cache, aids, buffers=buffers,
                                    training=False, call=body)
 
         def pstep(params, buffers, packed, cache):
@@ -376,21 +409,26 @@ class GenerationEngine:
                 traces["decode"] += 1
                 C = self._C
                 G = C // self._page
-                Tp = (packed.shape[1] - C - G) // 2
+                # with LoRA the pack carries one trailing per-slot
+                # adapter-id column: [B, 2T + C + G + 1]
+                L = 1 if lora_on else 0
+                Tp = (packed.shape[1] - C - G - L) // 2
+                aids = packed[:, -1] if lora_on else None
+                tab = packed[:, 2 * Tp + C:packed.shape[1] - L]
                 if self._moe_experts:
                     from ..moe import stats as moe_stats
 
                     with moe_stats.collect() as ms:
                         logits, cache = mdl.forward_paged(
                             packed[:, :Tp], packed[:, Tp:2 * Tp],
-                            packed[:, 2 * Tp:2 * Tp + C],
-                            packed[:, 2 * Tp + C:], cache)
+                            packed[:, 2 * Tp:2 * Tp + C], tab, cache,
+                            adapter_ids=aids)
                     return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
                             cache, ms.counts(self._moe_experts))
                 logits, cache = mdl.forward_paged(
                     packed[:, :Tp], packed[:, Tp:2 * Tp],
-                    packed[:, 2 * Tp:2 * Tp + C], packed[:, 2 * Tp + C:],
-                    cache)
+                    packed[:, 2 * Tp:2 * Tp + C], tab, cache,
+                    adapter_ids=aids)
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
             return functional_call(mdl, params, packed, cache,
                                    buffers=buffers, training=False,
@@ -508,7 +546,8 @@ class GenerationEngine:
                     np.arange(sb, dtype=np.int32), (B, sb)))
                 lens = jnp.asarray(np.full((B,), sb, np.int32))
                 _, cache = self._padmit(self._params, self._buffers, ids,
-                                        pos, pm0, tb0, lens, cache)
+                                        pos, pm0, tb0, lens, cache,
+                                        self._aids_arg())
             T = 1 + self._spec_k
             _, cache = self._step(
                 self._params, self._buffers,
@@ -569,13 +608,14 @@ class GenerationEngine:
                     np.arange(sb, dtype=np.int32), (B, sb)))
                 lens = jnp.asarray(np.full((B,), sb, np.int32))
                 tok, cache = self._admit(self._params, self._buffers, ids,
-                                         pos, lens, mask, cache, tok)
+                                         pos, lens, mask, cache, tok,
+                                         self._aids_arg())
             # steady-state placement of the decode step — same jaxpr as
             # the _init_state call (one trace), second XLA executable
             tok, cache = self._decode(
                 self._params, self._buffers, tok,
                 jnp.asarray(np.full((B,), self._buckets[-1], np.int32)),
-                cache)
+                cache, self._aids_arg())
             self._evict(tok, cache, mask)
         else:
             for sb in self._buckets:
@@ -585,9 +625,11 @@ class GenerationEngine:
                 lens = jnp.full((B,), sb, jnp.int32)
                 cache = self._model.gpt.init_cache(B, self._cache_len)
                 tok, cache = self._prefill(self._params, self._buffers,
-                                           ids, pos, lens, cache)
+                                           ids, pos, lens, cache,
+                                           self._aids_arg())
                 self._decode(self._params, self._buffers, tok,
-                             jnp.full((B,), sb, jnp.int32), cache)
+                             jnp.full((B,), sb, jnp.int32), cache,
+                             self._aids_arg())
         self.metrics.set_counter("compiles", self.compile_count)
         from ..ops import autotune
         autotune.mark_warm()  # later tuner searches are hot-path (K701)
@@ -804,6 +846,17 @@ class GenerationEngine:
         self._emit_quant()
 
     # -- continuous scheduler ------------------------------------------------
+    def _aids_arg(self, aidsv: Optional[np.ndarray] = None):
+        """Per-slot adapter ids as a host transfer for the dense-path
+        executables — ``None`` (not traced at all) when the model has no
+        LoRA tables, so a 0-capacity engine's compile set is unchanged.
+        The copy snapshots the host array against async dispatch."""
+        if not self._lora_cap:
+            return None
+        if aidsv is None:
+            aidsv = np.full((self._batch,), -1, np.int32)
+        return jnp.asarray(np.asarray(aidsv, np.int32).copy())
+
     def _init_state(self):
         """Fresh all-slots-empty (tok, cache) for the decode loop.
 
@@ -819,7 +872,8 @@ class GenerationEngine:
         return self._decode(self._params, self._buffers,
                             jnp.asarray(np.zeros((B,), np.int32)),
                             jnp.asarray(np.full((B,), -1, np.int32)),
-                            self._model.gpt.init_cache(B, self._cache_len))
+                            self._model.gpt.init_cache(B, self._cache_len),
+                            self._aids_arg())
 
     def _expire_carry(self, carry: List[tuple]) -> List[tuple]:
         """Deadline sweep for requests held outside the batcher queue
@@ -856,12 +910,18 @@ class GenerationEngine:
                                  queue_ms, cat="serving", args=args)
             profiler.record_span(f"{self.name}/decode", s["t0"],
                                  execute_ms, cat="serving", args=args)
+        tenant = s.get("tenant")
+        if tenant is not None:
+            self.metrics.observe_tenant(tenant, (now - r.enqueue_t) * 1e3,
+                                        len(s["out"]))
         tr = _tracing._active
         if tr is not None and r.trace is not None:
             # one span per slot residency, decode-step slices aggregated
+            args = {"engine": self.name, "steps": len(s["out"])}
+            if tenant is not None:
+                args["tenant"] = tenant
             tr.record("slot/decode", r.trace, s["t0"], execute_ms,
-                      kind="decode", args={"engine": self.name,
-                                           "steps": len(s["out"])})
+                      kind="decode", args=args)
         if self.breaker is not None:
             self.breaker.record_success(0)
         if not r.future.done():
@@ -899,23 +959,28 @@ class GenerationEngine:
 
     def _pack_step(self, ids: np.ndarray, positions: np.ndarray,
                    pos_map: Optional[np.ndarray] = None,
-                   table: Optional[np.ndarray] = None) -> np.ndarray:
+                   table: Optional[np.ndarray] = None,
+                   aids: Optional[np.ndarray] = None) -> np.ndarray:
         """One ``[B, 2T + C + G]`` int32 row per slot carrying every
         per-step host input of the unified step (``ids | positions |
-        pos_map | table``).  ``None`` pos_map/table mean all ``-1``
-        (inert warmup shapes).  The concatenate also snapshots the
-        host-owned pool state, so async dispatch never races a later
-        table edit."""
+        pos_map | table``), plus one trailing per-slot adapter-id column
+        when the model has LoRA tables.  ``None`` pos_map/table/aids
+        mean all ``-1`` (inert warmup shapes / no adapter).  The
+        concatenate also snapshots the host-owned pool state, so async
+        dispatch never races a later table edit."""
         B, C = self._batch, self._C
         G = C // self._page
         if pos_map is None:
             pos_map = np.full((B, C), -1, np.int32)
         if table is None:
             table = np.full((B, G), -1, np.int32)
-        return np.concatenate(
-            [np.asarray(ids, np.int32), np.asarray(positions, np.int32),
-             np.asarray(pos_map, np.int32), np.asarray(table, np.int32)],
-            axis=1)
+        cols = [np.asarray(ids, np.int32), np.asarray(positions, np.int32),
+                np.asarray(pos_map, np.int32), np.asarray(table, np.int32)]
+        if self._lora_cap:
+            if aids is None:
+                aids = np.full((B,), -1, np.int32)
+            cols.append(np.asarray(aids, np.int32).reshape(B, 1))
+        return np.concatenate(cols, axis=1)
 
     @staticmethod
     def _ngram_drafts(hist: List[int], k: int, n: int = 2) -> List[int]:
@@ -936,12 +1001,97 @@ class GenerationEngine:
     @staticmethod
     def _unpack_paged(r: Request):
         """Paged-mode request meta: ``(budget, prefix_key, prefix_len,
-        handoff)`` (see :meth:`submit`) — ``handoff`` is ``None`` for a
-        plain request, ``True`` to produce a :class:`KVHandoff`, or a
-        :class:`KVHandoff` instance to adopt."""
-        budget, key, plen, hand = r.meta
+        handoff, tenant, adapter_id)`` (see :meth:`submit`) — ``handoff``
+        is ``None`` for a plain request, ``True`` to produce a
+        :class:`KVHandoff`, or a :class:`KVHandoff` instance to adopt."""
+        budget, key, plen, hand, tenant, aid = r.meta
         prompt = np.asarray(r.inputs[0], np.int32).reshape(-1)
-        return prompt, key, min(int(plen), len(prompt)), int(budget), hand
+        return (prompt, key, min(int(plen), len(prompt)), int(budget), hand,
+                tenant, int(aid))
+
+    @staticmethod
+    def _tenant_of(r: Request) -> Optional[str]:
+        """Tenant name off a request's meta (paged 6-tuple or dense
+        3-tuple), ``None`` for untagged requests."""
+        m = r.meta
+        if isinstance(m, tuple):
+            if len(m) >= 6:
+                return m[4]
+            if len(m) == 3:
+                return m[1]
+        return None
+
+    # -- multi-LoRA adapter table --------------------------------------------
+    def install_adapter(self, slot: int, adapter) -> None:
+        """Hot-add ``adapter`` into table slot ``slot`` — a pure host-side
+        edit of the stacked A/B/scale buffers through the same
+        buffer-tree swap as ``swap_weights``: shapes and dtypes are
+        preserved, so every warmed executable keeps its signature and the
+        compile set stays closed.  Requests already decoding with this
+        slot id pick up the new weights on their next step."""
+        from ..lora.batched import write_adapter
+
+        if not self._lora_cap:
+            raise InvalidArgumentError(
+                f"{self.name}: model has no LoRA tables "
+                f"(GPTConfig.lora_capacity == 0)")
+        self._buffers = write_adapter(self._buffers, slot, adapter)
+        self._adapters[int(slot)] = adapter.name
+        self.metrics.incr("adapter_installs")
+
+    def remove_adapter(self, slot: int) -> None:
+        """Hot-remove the adapter in table slot ``slot`` (zero its A/B
+        rows) — slot id ``slot`` becomes a no-op delta, bitwise the base
+        model, without any recompilation."""
+        from ..lora.batched import clear_slot
+
+        if not self._lora_cap:
+            raise InvalidArgumentError(
+                f"{self.name}: model has no LoRA tables "
+                f"(GPTConfig.lora_capacity == 0)")
+        self._buffers = clear_slot(self._buffers, slot)
+        self._adapters.pop(int(slot), None)
+        # a decode step racing the removal can at worst lose one hit
+        # increment on a slot that is being cleared anyway; the counter
+        # only feeds the S607 dead-adapter heuristic, never control flow
+        # lock-order: benign stats race, slot is being cleared
+        self._adapter_hits[int(slot)] = 0
+        self.metrics.incr("adapter_removals")
+
+    @property
+    def adapters(self) -> Dict[int, str]:
+        """Installed adapter names by table slot (host-side view)."""
+        return dict(self._adapters)
+
+    def _emit_tenancy(self, carry: List[tuple]) -> None:
+        """Publish the tenancy/adapter health snapshot on the
+        ``("tenancy", <engine>)`` bus channel — rule S607's signal
+        (sustained in-budget starvation; dead adapter table entries).
+        Same latest-value semantics as the ``("serving", ·)`` family."""
+        from ..framework import trace_events
+
+        if not trace_events.active():
+            return
+        if self._tenancy is None and not self._lora_cap:
+            return
+        snap: dict = {
+            "decode_steps_after_warm": int(self._tenancy_steps),
+            "adapters_installed": len(self._adapters),
+            "adapters_dead": sum(
+                1 for sl in self._adapters
+                if self._adapter_hits[sl] == 0),
+        }
+        if self._tenancy is not None:
+            queued: Dict[str, int] = {}
+            for r, _ in carry:
+                tn = self._tenant_of(r)
+                if tn is not None:
+                    queued[tn] = queued.get(tn, 0) + 1
+            ts = self._tenancy.snapshot()
+            for tn, st in ts.items():
+                st["queued"] = queued.get(tn, 0)
+            snap["tenants"] = ts
+        trace_events.notify(("tenancy", self.name), snap)
 
     def _paged_loop(self):
         """The persistent paged decode loop — sole owner of the device
@@ -977,6 +1127,8 @@ class GenerationEngine:
                         if self._retry_transient else 0)
         slots: List[Optional[dict]] = [None] * B
         pos = np.full((B,), -1, np.int64)  # next write position (-1 = free)
+        aidsv = np.full((B,), -1, np.int32)  # per-slot adapter ids
+        ten = self._tenancy
         pool = self._pool if self._pool is not None else self._new_pool()
         self._pool = pool
         cache = None                       # device handles: the page pool
@@ -1010,6 +1162,7 @@ class GenerationEngine:
             pool.release(v)
             slots[v] = None
             pos[v] = -1
+            aidsv[v] = -1
             # regeneration from the prompt is deterministic greedy —
             # the requeued request produces bit-identical tokens
             carry.insert(0, (vs["req"], vs["restarts"]))
@@ -1040,7 +1193,36 @@ class GenerationEngine:
                             and q.queue_depth == 0):
                         return
 
-                    # ---- admission: FCFS, gated by the breaker AND the
+                    # ---- tenant budget enforcement: an over-budget
+                    # tenant's live slots preempt through the same
+                    # deterministic release path as pool exhaustion —
+                    # the requeued requests regenerate bit-identically
+                    # once the tenant is back in budget
+                    if ten is not None and live:
+                        over = ten.over_budget()
+                        if over:
+                            npre = 0
+                            for i in list(live):
+                                s = slots[i]
+                                if s is None or s.get("tenant") not in over:
+                                    continue
+                                pool.release(i)
+                                carry.insert(0, (s["req"], s["restarts"]))
+                                ten.note_preempted(s.get("tenant"))
+                                slots[i] = None
+                                pos[i] = -1
+                                aidsv[i] = -1
+                                npre += 1
+                            if npre:
+                                self.metrics.incr("preempted", npre)
+                                self.metrics.incr("tenant_preempted", npre)
+                                live = [i for i in range(B)
+                                        if slots[i] is not None]
+                                free = [i for i in range(B)
+                                        if slots[i] is None]
+
+                    # ---- admission: FCFS (or weighted-fair under a
+                    # TenantScheduler), gated by the breaker AND the
                     # page budget; neither sheds — deferred requests wait
                     # in carry under the deadline sweep
                     take: List[tuple] = []
@@ -1048,14 +1230,44 @@ class GenerationEngine:
                     if carry:
                         carry = self._expire_carry(carry)
                     if free:
-                        cand = carry[:len(free)]
-                        carry = carry[len(cand):]
-                        want = len(free) - len(cand)
-                        if want > 0:
+                        if ten is None:
+                            cand = carry[:len(free)]
+                            carry = carry[len(cand):]
+                            want = len(free) - len(cand)
+                            if want > 0:
+                                wait = (0.05 if not live and not cand
+                                        else 0.0)
+                                blocked_wait = wait > 0
+                                cand += [(r, 0)
+                                         for r in q.poll(want, wait_s=wait)]
+                        else:
+                            # weighted-fair admission considers ALL waiting
+                            # requests (carry + a widened queue window) so
+                            # the stride order can pass a FIFO-monopolizing
+                            # tenant; over-budget tenants defer back to
+                            # carry with per-tenant arrival order intact
+                            cand = carry
+                            carry = []
+                            # the widened window bounds ADMISSIBLE work:
+                            # a throttled tenant's deferred backlog must
+                            # not suppress polling new arrivals (victims
+                            # would sit in the queue behind it)
+                            n_adm = sum(
+                                1 for rc in cand
+                                if not ten.is_throttled(
+                                    self._tenant_of(rc[0])))
+                            want = max(2 * B - n_adm, 0)
                             wait = (0.05 if not live and not cand else 0.0)
                             blocked_wait = wait > 0
-                            cand += [(r, 0)
-                                     for r in q.poll(want, wait_s=wait)]
+                            if want > 0:
+                                cand += [(r, 0)
+                                         for r in q.poll(want, wait_s=wait)]
+                            cand, deferred = ten.schedule(
+                                cand,
+                                tenant_of=lambda rc: self._tenant_of(rc[0]),
+                                cost_of=lambda rc: max(int(rc[0].meta[0]),
+                                                       1))
+                            carry = deferred + carry
                         if (cand and self.breaker is not None
                                 and not self.breaker.allow(0)):
                             carry = cand + carry
@@ -1063,7 +1275,13 @@ class GenerationEngine:
                             q.sweep()
                         budget_pages = pool.free_pages
                         for ci, (r, nre) in enumerate(cand):
-                            prompt, key, _, _, hand = self._unpack_paged(r)
+                            if len(take) >= len(free):
+                                # widened tenancy window: surplus ordered
+                                # candidates wait their turn in carry
+                                carry = cand[ci:] + carry
+                                break
+                            prompt, key, _, _, hand, _, _ = \
+                                self._unpack_paged(r)
                             if isinstance(hand, KVHandoff):
                                 # adoption maps fresh private pages only
                                 need = -(-hand.length // page)
@@ -1095,7 +1313,7 @@ class GenerationEngine:
                         pre: List[tuple] = []
                         n_adevicted = 0
                         for (r, nre), i in zip(take, free):
-                            prompt, _, _, budget, hand = \
+                            prompt, _, _, budget, hand, tenant, aid = \
                                 self._unpack_paged(r)
                             if not isinstance(hand, KVHandoff):
                                 pre.append(((r, nre), i))
@@ -1116,9 +1334,13 @@ class GenerationEngine:
                             slots[i] = {"req": r, "budget": budget,
                                         "out": [t], "t0": now,
                                         "restarts": nre,
+                                        "tenant": tenant,
                                         "hist": [int(x) for x in prompt]
                                         + [t]}
                             pos[i] = hand.length
+                            aidsv[i] = aid
+                            if ten is not None and tenant is not None:
+                                ten.charge(tenant, 1)
                             n_adopted += 1
                             self.metrics.incr("handoffs_in")
                             tr = _tracing._active
@@ -1134,6 +1356,7 @@ class GenerationEngine:
                                 self._finish(slots[i], time.monotonic())
                                 slots[i] = None
                                 pos[i] = -1
+                                aidsv[i] = -1
                                 n_adevicted += 1
                         if n_adopted:
                             self.metrics.incr("admitted", n_adopted)
@@ -1149,7 +1372,7 @@ class GenerationEngine:
                         to_register: List[tuple] = []
                         admitted: List[tuple] = []
                         for (r, nre), i in pre:
-                            prompt, key, plen, budget, hand = \
+                            prompt, key, plen, budget, hand, tenant, aid = \
                                 self._unpack_paged(r)
                             pairs, shared = pool.admit(i, prompt, key)
                             cow_pairs += [(s_, d_, i) for s_, d_ in pairs]
@@ -1158,9 +1381,11 @@ class GenerationEngine:
                             pp[i, :L - shared] = np.arange(shared, L)
                             lens[i] = L - shared
                             pos[i] = L
+                            aidsv[i] = aid
                             slots[i] = {"req": r, "budget": budget,
                                         "out": [], "t0": now,
                                         "restarts": nre,
+                                        "tenant": tenant,
                                         "handoff": hand is True,
                                         "hist": [int(t) for t in prompt]}
                             admitted.append((r, i))
@@ -1179,7 +1404,8 @@ class GenerationEngine:
                                 jnp.asarray(ids), jnp.asarray(pp),
                                 jnp.asarray(pool.pos_map.copy()),
                                 jnp.asarray(pool.table.copy()),
-                                jnp.asarray(lens), cache)
+                                jnp.asarray(lens), cache,
+                                self._aids_arg(aidsv))
                             host_first = np.asarray(first)  # serial harvest
                         tr = _tracing._active
                         if tr is not None:
@@ -1220,6 +1446,8 @@ class GenerationEngine:
                                     kvh = jax.device_get(
                                         self._export(cache, idx))
                                 s["out"].append(t)
+                                if ten is not None and s.get("tenant"):
+                                    ten.charge(s["tenant"], 1)
                                 s["result"] = KVHandoff(
                                     np.asarray(s["hist"][:L], np.int32),
                                     t, kvh, L,
@@ -1231,16 +1459,20 @@ class GenerationEngine:
                                 self._finish(s, now)
                                 slots[i] = None
                                 pos[i] = -1
+                                aidsv[i] = -1
                                 n_evicted += 1
                                 continue
                             s["out"].append(t)
                             s["hist"].append(t)
+                            if ten is not None and s.get("tenant"):
+                                ten.charge(s["tenant"], 1)
                             if (len(s["out"]) >= s["budget"]
                                     or (eos is not None and t == eos)):
                                 pool.release(i)
                                 self._finish(s, now)
                                 slots[i] = None
                                 pos[i] = -1
+                                aidsv[i] = -1
                                 n_evicted += 1
                         self.metrics.incr("admitted", len(admitted))
                         self.metrics.incr("batches")
@@ -1248,14 +1480,48 @@ class GenerationEngine:
                             self.metrics.incr("evicted", n_evicted)
                     if take:
                         live = [i for i in range(B) if slots[i] is not None]
+                        if ten is not None:
+                            for r, _ in take:
+                                ten.note_admitted(self._tenant_of(r))
                     elif (free and not closing
                           and (carry or q.queue_depth > 0)):
-                        # free slots + waiting requests + nothing admitted:
-                        # S603 starvation — and, with the page gauges on
-                        # the same snapshot, S604's page-leak signal
-                        self.metrics.incr("starved_steps")
-                        if self._warm:
-                            self.metrics.incr("starved_steps_after_warm")
+                        if (ten is not None and carry
+                                and q.queue_depth == 0
+                                and all(ten.is_throttled(
+                                    self._tenant_of(r))
+                                    for r, _ in carry)):
+                            # every waiting request belongs to an
+                            # over-budget tenant: that is throttling by
+                            # design, not S603 starvation
+                            self.metrics.incr("tenant_throttled_steps")
+                        else:
+                            # free slots + waiting requests + nothing
+                            # admitted: S603 starvation — and, with the
+                            # page gauges on the same snapshot, S604's
+                            # page-leak signal
+                            self.metrics.incr("starved_steps")
+                            if self._warm:
+                                self.metrics.incr(
+                                    "starved_steps_after_warm")
+                    if (ten is not None and self._warm and carry
+                            and any(slots[i] is None for i in range(B))):
+                        # per-tenant starvation signal for S607: an
+                        # IN-budget tenant still waiting while a slot
+                        # sits IDLE after this step's admission pass
+                        # (`free` is stale here — admission above just
+                        # filled slots; a full batch is contention, not
+                        # an isolation failure)
+                        seen_tn = set()
+                        for r, _ in carry:
+                            tn = self._tenant_of(r)
+                            if (tn is None or tn in seen_tn
+                                    or ten.is_throttled(tn)):
+                                continue
+                            seen_tn.add(tn)
+                            ten.note_starved(tn)
+                        if seen_tn:
+                            self.metrics.incr(
+                                "tenant_starved_steps_after_warm")
 
                     # ---- unified decode/verify step (serialized) ----
                     dispatched = bool(take)
@@ -1356,7 +1622,8 @@ class GenerationEngine:
                                 self._params, self._buffers,
                                 self._pack_step(
                                     ids[:, :Td], pp[:, :Td],
-                                    pool.pos_map, pool.table), cache)
+                                    pool.pos_map, pool.table,
+                                    aidsv), cache)
                             host = np.asarray(out)  # serial harvest
                         dt = (time.monotonic() - t_step) * 1e3
                         if Td == 1:
@@ -1377,6 +1644,12 @@ class GenerationEngine:
                         self.metrics.incr("decode_steps")
                         self._note_quant_step()
                         self.metrics.observe_occupancy(len(live) / B)
+                        if self._lora_cap:
+                            if self._warm:
+                                self._tenancy_steps += 1
+                            for i in live:
+                                if aidsv[i] >= 0:
+                                    self._adapter_hits[aidsv[i]] += 1
                         now = time.monotonic()
                         n_evicted = 0
                         evicted_traces: List = []
@@ -1412,14 +1685,19 @@ class GenerationEngine:
                                     s["spec_fail"] = 0
                             pos[i] = p + a + 1
                             done = False
+                            n_out = 0
                             for j in range(a + 1):
                                 t = int(host[i, j])
                                 s["out"].append(t)
                                 s["hist"].append(t)
+                                n_out += 1
                                 if (len(s["out"]) >= s["budget"]
                                         or (eos is not None and t == eos)):
                                     done = True
                                     break
+                            if ten is not None and n_out and \
+                                    s.get("tenant"):
+                                ten.charge(s["tenant"], n_out)
                             if done:
                                 if s["req"].trace is not None:
                                     evicted_traces.append(s["req"].trace)
@@ -1427,6 +1705,7 @@ class GenerationEngine:
                                 self._finish(s, now)
                                 slots[i] = None
                                 pos[i] = -1
+                                aidsv[i] = -1
                                 n_evicted += 1
                         if n_evicted:
                             tr = _tracing._active
@@ -1467,6 +1746,7 @@ class GenerationEngine:
                             q.queue_depth + len(carry))
                         self.metrics.set_counter("compiles",
                                                  self.compile_count)
+                        self._emit_tenancy(carry)
                         self.metrics.publish()
                 except Exception as e:
                     # Device failure mid-flight: same restart contract as
@@ -1488,6 +1768,7 @@ class GenerationEngine:
                             if not s["req"].future.done():
                                 s["req"].future.set_exception(e)
                     pos[:] = -1
+                    aidsv[:] = -1
                     cache = None
                     pool = self._pool = self._new_pool()
                     carry = survivors + carry
@@ -1515,6 +1796,7 @@ class GenerationEngine:
         slots: List[Optional[dict]] = [None] * B
         gens = [0] * B                      # guards stale speculative tokens
         pos = np.full((B,), -1, np.int32)   # next decode position (-1 = free)
+        aidsv = np.full((B,), -1, np.int32)  # per-slot adapter ids
         cache = None                        # device handles: live KV state
         tok = None                          # ... and last dispatched tokens
         pending: deque = deque()            # in-flight steps, oldest first
@@ -1589,8 +1871,11 @@ class GenerationEngine:
                             mask[i] = True
                             gens[i] += 1
                             pos[i] = L
-                            slots[i] = {"req": r, "budget": int(r.meta),
+                            budget, tenant, aid = r.meta
+                            aidsv[i] = aid
+                            slots[i] = {"req": r, "budget": int(budget),
                                         "out": [], "t0": now,
+                                        "tenant": tenant,
                                         "restarts": nre}
                             targets.append((i, gens[i]))
                         fault_point("serving.decode")
@@ -1600,7 +1885,7 @@ class GenerationEngine:
                                 self._params, self._buffers,
                                 jnp.asarray(ids), jnp.asarray(pp),
                                 jnp.asarray(lens), jnp.asarray(mask),
-                                cache, tok)
+                                cache, tok, self._aids_arg(aidsv))
                         tr = _tracing._active
                         if tr is not None:
                             adm_ms = (time.monotonic() - now) * 1e3
@@ -1641,11 +1926,12 @@ class GenerationEngine:
                                     f"{self.name}/decode.step"):
                                 tok, cache = self._decode(
                                     self._params, self._buffers, tok,
-                                    dev_pos, cache)
+                                    dev_pos, cache,
+                                    self._aids_arg(aidsv))
                         else:
                             tok, cache = self._decode(
                                 self._params, self._buffers, tok,
-                                dev_pos, cache)
+                                dev_pos, cache, self._aids_arg(aidsv))
                         pending.append((tok, [(i, gens[i]) for i in live]))
                         for i in live:
                             pos[i] += 1
@@ -1677,6 +1963,7 @@ class GenerationEngine:
                                 self._finish(s, now)
                                 slots[i] = None
                                 pos[i] = -1
+                                aidsv[i] = -1
                         if finished.any():
                             tok, cache = self._evict(
                                 tok, cache, jnp.asarray(finished))
@@ -1731,6 +2018,7 @@ class GenerationEngine:
                             if not s["req"].future.done():
                                 s["req"].future.set_exception(e)
                     pos[:] = -1
+                    aidsv[:] = -1
                     pending.clear()
                     cache = None
                     tok = None
@@ -1749,19 +2037,22 @@ class GenerationEngine:
         positions = np.full((B, Sb), -1, np.int32)
         lens = np.ones((B,), np.int32)  # dummy rows: 1 garbage (unread) slot
         budgets = np.zeros((B,), np.int64)
+        aidsv = np.full((B,), -1, np.int32)
         for i, r in enumerate(requests):
             prompt = np.asarray(r.inputs[0], np.int32).reshape(-1)
             ids[i, : len(prompt)] = prompt
             positions[i, : len(prompt)] = np.arange(len(prompt))
             lens[i] = len(prompt)
-            budgets[i] = int(r.meta)
+            budgets[i] = int(r.meta[0])
+            aidsv[i] = int(r.meta[2])
 
         t0 = time.monotonic()
         cache = self._model.gpt.init_cache(B, self._cache_len)
         with profiler.RecordEvent(f"{self.name}/prefill[{Sb}]"):
             tok, cache = self._prefill(
                 self._params, self._buffers, jnp.asarray(ids),
-                jnp.asarray(positions), jnp.asarray(lens), cache)
+                jnp.asarray(positions), jnp.asarray(lens), cache,
+                self._aids_arg(aidsv))
         tr = _tracing._active
         if tr is not None:
             pf_ms = (time.monotonic() - t0) * 1e3
@@ -1793,7 +2084,8 @@ class GenerationEngine:
                 # (`pos + 1` on device would hand step 2 a committed array
                 # and silently recompile the step executable)
                 tok, cache = self._decode(self._params, self._buffers, tok,
-                                          jnp.asarray(lens + n_step), cache)
+                                          jnp.asarray(lens + n_step), cache,
+                                          self._aids_arg(aidsv))
                 n_step += 1
         self.metrics.observe_tokens(n_tokens, time.monotonic() - t0)
         self.metrics.set_counter("compiles", self.compile_count)
@@ -1808,7 +2100,9 @@ class GenerationEngine:
     def submit(self, prompt_ids, max_new_tokens: int = 32,
                deadline_ms: Optional[float] = None,
                trace_ctx=None, prefix_key: Optional[str] = None,
-               prefix_len: int = 0, handoff=None) -> Future:
+               prefix_len: int = 0, handoff=None,
+               tenant: Optional[str] = None,
+               adapter_id: Optional[int] = None) -> Future:
         """Async generation; resolves to the ``[<=max_new_tokens]`` int32
         array of greedily decoded tokens (stops after ``eos_token_id``).
         ``trace_ctx`` optionally parents the queue/slot spans under a
@@ -1830,9 +2124,27 @@ class GenerationEngine:
         ``role='decode'`` engine adopts the pages and decodes the
         remaining ``max_new_tokens - 1`` tokens, bit-identical to the
         co-located path.  Plain submits (``handoff=None``) work on every
-        role — that is what router health probes send."""
+        role — that is what router health probes send.
+
+        Multi-tenant serving: ``tenant`` tags the request for the
+        engine's :class:`~.tenancy.TenantScheduler` (weighted-fair
+        admission, token budgets, per-tenant metrics/spans) and
+        ``adapter_id`` selects a LoRA table slot for every decode step
+        of this request (``None`` resolves through the tenant's
+        registered spec when a scheduler is attached; the default is
+        ``-1`` — the base model, bitwise)."""
         if max_new_tokens < 1:
             raise InvalidArgumentError("max_new_tokens must be >= 1")
+        if adapter_id is not None:
+            aid = int(adapter_id)
+            if aid != -1 and not 0 <= aid < self._lora_cap:
+                raise InvalidArgumentError(
+                    f"{self.name}: adapter_id {aid} outside the adapter "
+                    f"table (capacity {self._lora_cap}; -1 = base model)")
+        elif tenant is not None and self._tenancy is not None:
+            aid = int(self._tenancy.adapter_id(tenant))
+        else:
+            aid = -1
         if handoff is not None:
             if not self._paged:
                 raise InvalidArgumentError(
@@ -1858,15 +2170,17 @@ class GenerationEngine:
                     f"handoff must be None, True, or a KVHandoff, got "
                     f"{type(handoff).__name__}")
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
-        meta = ((int(max_new_tokens), prefix_key, int(prefix_len), handoff)
-                if self._paged else int(max_new_tokens))
+        meta = ((int(max_new_tokens), prefix_key, int(prefix_len), handoff,
+                 tenant, aid)
+                if self._paged else (int(max_new_tokens), tenant, aid))
         return self._batcher.submit((prompt,), deadline_ms=deadline_ms,
                                     meta=meta, trace_ctx=trace_ctx)
 
     def generate(self, prompt_ids, max_new_tokens: int = 32,
-                 timeout: Optional[float] = None) -> np.ndarray:
-        """Blocking :meth:`submit`."""
-        return self.submit(prompt_ids, max_new_tokens).result(timeout)
+                 timeout: Optional[float] = None, **kw) -> np.ndarray:
+        """Blocking :meth:`submit` (extra keywords — ``adapter_id``,
+        ``tenant``, ``prefix_key``… — pass through)."""
+        return self.submit(prompt_ids, max_new_tokens, **kw).result(timeout)
 
     def reload_weights(self) -> None:
         """Re-snapshot weights from the live model (e.g. after

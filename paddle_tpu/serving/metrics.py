@@ -39,6 +39,13 @@ same snapshot.
 Engines serving MoE models (``GPTConfig.moe_experts > 0``) add the
 expert-routing family (``MOE_COUNTERS`` + the ``moe_overflow_frac`` /
 ``moe_dead_experts`` gauges) — rule S606 reads it.
+
+Multi-tenant engines add ``LORA_COUNTERS`` (adapter table hot-edits) and
+``TENANCY_COUNTERS`` (budget preemption / throttling / in-budget
+starvation — rule S607), plus the ``("engine", "tenant")``-labeled
+histogram ``paddle_tpu_serving_tenant_latency_ms`` and counter
+``paddle_tpu_serving_tenant_tokens_total`` via :meth:`observe_tenant` —
+both behind ``MetricRegistry``'s label-cardinality cap.
 """
 from __future__ import annotations
 
@@ -85,6 +92,21 @@ MOE_COUNTERS = ("moe_routed_tokens", "moe_dropped_tokens",
 #: silently pays dequantize-free float math at quantized prices) — rule
 #: Q801's engine-side signal.
 QUANT_COUNTERS = ("quant_fallback_steps_after_warm",)
+
+#: batched multi-LoRA counters (``GPTConfig.lora_capacity > 0``): adapter
+#: table hot-edits through ``install_adapter`` / ``remove_adapter`` — the
+#: closed-compile-set gate asserts compiles stay flat while these move.
+LORA_COUNTERS = ("adapter_installs", "adapter_removals")
+
+#: multi-tenant scheduler counters (``GenerationEngine(tenancy=...)``):
+#: slots preempted because their tenant ran over its token budget
+#: (``tenant_preempted``), steps where every waiting request belonged to
+#: an over-budget tenant (``tenant_throttled_steps`` — throttling by
+#: design, kept distinct from S603 starvation), and post-warmup steps
+#: where an IN-budget tenant waited with slots free
+#: (``tenant_starved_steps_after_warm`` — rule S607's signal).
+TENANCY_COUNTERS = ("tenant_preempted", "tenant_throttled_steps",
+                    "tenant_starved_steps_after_warm")
 
 
 def _quantile(sorted_vals, q: float) -> float:
@@ -167,6 +189,27 @@ class ServingMetrics:
                 "paddle_tpu_serving_latency_ms",
                 "end-to-end per-request latency (submit to completion)",
                 ("engine",)).labels(self.name).observe(ms)
+
+    def observe_tenant(self, tenant: str, ms: float, tokens: int):
+        """Per-tenant completion observation: latency histogram + token
+        counter labeled ``(engine, tenant)``.  The label sets route
+        through ``MetricRegistry``'s cardinality cap, so a tenant-id
+        flood lands in the ``__overflow__`` child instead of blowing up
+        Prometheus — per-tenant SLO objectives read the histogram
+        (``TenantScheduler.slo_objectives``)."""
+        from .. import observability
+
+        if not observability.enabled():
+            return
+        reg = observability.default_registry()
+        reg.histogram(
+            "paddle_tpu_serving_tenant_latency_ms",
+            "end-to-end per-request latency by tenant",
+            ("engine", "tenant")).labels(self.name, tenant).observe(ms)
+        reg.counter(
+            "paddle_tpu_serving_tenant_tokens_total",
+            "tokens generated by tenant",
+            ("engine", "tenant")).labels(self.name, tenant).inc(int(tokens))
 
     def observe_tokens(self, n: int, seconds: float):
         with self._lock:

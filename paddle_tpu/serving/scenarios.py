@@ -30,19 +30,21 @@ from typing import Callable, List, NamedTuple, Optional, Tuple
 import numpy as np
 
 __all__ = ["Scenario", "ScenarioRequest", "diurnal", "flash_crowd",
-           "heavy_tail", "poison", "run_scenario"]
+           "heavy_tail", "noisy_neighbor", "poison", "run_scenario"]
 
 
 class ScenarioRequest(NamedTuple):
     """One scheduled arrival.  ``t`` is scenario time in seconds from
     scenario start; ``poison=True`` marks a request *built to be
     rejected* (oversize prompt) — the harness asserts it never gets
-    accepted."""
+    accepted.  ``tenant`` optionally tags the request for a
+    multi-tenant target (:func:`noisy_neighbor`)."""
 
     t: float
     prompt_len: int
     max_new_tokens: int
     poison: bool = False
+    tenant: Optional[str] = None
 
 
 class Scenario(NamedTuple):
@@ -170,6 +172,43 @@ def poison(*, duration_s: float = 8.0, rps: float = 6.0,
     return _finalize(f"poison@{seed}", duration_s, events, seed)
 
 
+def noisy_neighbor(*, duration_s: float = 10.0,
+                   tenants: Tuple[str, ...] = ("acme", "globex"),
+                   flooder: str = "initech",
+                   rps: float = 3.0, flood_rps: float = 30.0,
+                   flood_at: float = 0.2,
+                   prompt_len: Tuple[int, int] = (4, 12),
+                   max_new_tokens: Tuple[int, int] = (4, 8),
+                   seed: int = 0) -> Scenario:
+    """One tenant floods; the victims' schedules don't move.
+
+    Each tenant's arrivals come from its OWN derived stream
+    (``RandomState([seed, idx])``), so the flooder's schedule — a steady
+    ``flood_rps`` torrent from ``flood_at`` of the scenario onward — is
+    generated independently of the victims'.  A given seed therefore
+    produces the exact same victim arrival times, prompt lengths and
+    token budgets whether or not the flood is present, which is what
+    lets the noisy-neighbor gate compare victim p99 across a flooded
+    and a flood-free run of the same seed."""
+    events: List[ScenarioRequest] = []
+    for idx, tn in enumerate(tenants):
+        rs = np.random.RandomState([seed, idx])
+        for t in _arrivals(rs, lambda _t: rps, duration_s, rps):
+            events.append(ScenarioRequest(
+                t, int(rs.randint(prompt_len[0], prompt_len[1] + 1)),
+                int(rs.randint(max_new_tokens[0], max_new_tokens[1] + 1)),
+                tenant=tn))
+    rs = np.random.RandomState([seed, len(tenants)])
+    f0 = flood_at * duration_s
+    for t in _arrivals(rs, lambda t_: flood_rps if t_ >= f0 else 0.0,
+                       duration_s, flood_rps):
+        events.append(ScenarioRequest(
+            t, int(rs.randint(prompt_len[0], prompt_len[1] + 1)),
+            int(rs.randint(max_new_tokens[0], max_new_tokens[1] + 1)),
+            tenant=flooder))
+    return _finalize(f"noisy_neighbor@{seed}", duration_s, events, seed)
+
+
 def run_scenario(target, scenario: Scenario, *, time_scale: float = 1.0,
                  vocab: int = 97, deadline_ms: Optional[float] = None,
                  tick: Optional[Callable[[float], None]] = None,
@@ -223,17 +262,18 @@ def run_scenario(target, scenario: Scenario, *, time_scale: float = 1.0,
             step = min(due - now, tick_s * time_scale)
             sleep(max(step, 0.0))
         try:
+            kw = {} if ev.tenant is None else {"tenant": ev.tenant}
             fut = target.submit(prompts[i], max_new_tokens=ev.max_new_tokens,
-                                deadline_ms=deadline_ms)
+                                deadline_ms=deadline_ms, **kw)
         except Exception:  # noqa: BLE001 — a submit-time raise IS the
             # rejection contract (InvalidArgumentError from the bucket
             # router, UnavailableError from a closed/saturated fleet)
             rejected += 1
             records.append({"t": ev.t, "prompt_len": ev.prompt_len,
                             "max_new_tokens": ev.max_new_tokens,
-                            "poison": ev.poison, "ok": False,
-                            "rejected": True, "latency_ms": 0.0,
-                            "tokens": None})
+                            "poison": ev.poison, "tenant": ev.tenant,
+                            "ok": False, "rejected": True,
+                            "latency_ms": 0.0, "tokens": None})
             continue
         accepted += 1
         if ev.poison:
@@ -249,7 +289,7 @@ def run_scenario(target, scenario: Scenario, *, time_scale: float = 1.0,
         ev = scenario.events[i]
         rec = {"t": ev.t, "prompt_len": ev.prompt_len,
                "max_new_tokens": ev.max_new_tokens, "poison": ev.poison,
-               "rejected": False, "tokens": None}
+               "tenant": ev.tenant, "rejected": False, "tokens": None}
         try:
             out = fut.result(timeout=max(deadline_t - clock(), 0.1))
             rec["ok"] = True

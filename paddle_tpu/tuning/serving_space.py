@@ -44,6 +44,11 @@ DIAL_SWEEPS = {
     # scores quantized candidates on the same trace, so int8/fp8 wins
     # only where its tokens/s actually beats the float engine's
     "quantization": ("none", "int8", "fp8"),
+    # multi-LoRA adapter-table capacity (GPTConfig.lora_capacity): every
+    # decode step gathers over the whole fixed table, so capacity is a
+    # per-step cost dial — swept only when the base config exposes it
+    # (dials absent from base are skipped, like every other dial)
+    "lora_capacity": (4, 8, 16),
 }
 
 

@@ -19,12 +19,13 @@
 #   obs-smoke tools/obs_smoke.py   (metrics scrape + JSONL sink + serving spans)
 #   router-smoke tools/router_smoke.py (replica kill -> zero-loss failover + rolling swap)
 #   gen-smoke tools/gen_smoke.py (continuous batching: HOL p99, zero recompiles, probes)
+#   tenancy-smoke tools/tenancy_smoke.py (multi-LoRA tenants: mixed-vs-serial bit identity, hot-add zero recompiles, noisy-neighbor cap)
 #   quant-smoke tools/quant_smoke.py (int8/fp8 serving: margin-accounted tokens, equal-HBM slots, quantized rolling swap)
 #   slo-smoke tools/slo_smoke.py (request tracing end-to-end + SLO burn-rate alert)
 #   elastic-smoke tools/elastic_smoke.py (NaN rollback + exact resume + collective watchdog)
 #   bench   python bench.py          (only when a real TPU answers)
 #
-# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|tune-smoke|scenario-smoke|moe-smoke|chaos-smoke|obs-smoke|router-smoke|gen-smoke|quant-smoke|slo-smoke|elastic-smoke|bench]...
+# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|tune-smoke|scenario-smoke|moe-smoke|chaos-smoke|obs-smoke|router-smoke|gen-smoke|tenancy-smoke|quant-smoke|slo-smoke|elastic-smoke|bench]...
 #         tools/run_gates.sh --only suite
 # Exit code: 0 iff every stage that ran passed.
 set -u
@@ -153,6 +154,11 @@ run_stage router-smoke env JAX_PLATFORMS=cpu FLAGS_lock_sanitizer=1 \
 # CoW shared-prefix reuse + speculative decoding, tokens bit-identical to
 # dense greedy and tokens/s no worse, closed compile set (buckets + 3)
 run_stage gen-smoke env JAX_PLATFORMS=cpu python tools/gen_smoke.py
+# multi-tenant serving: mixed multi-LoRA traffic bit-identical to per-tenant
+# serial baselines, adapter hot-add mid-traffic with zero post-warmup XLA
+# compiles, noisy-neighbor flooder capped at its token budget with victim
+# p99 within bound, S607 silent on the healthy run
+run_stage tenancy-smoke env JAX_PLATFORMS=cpu python tools/tenancy_smoke.py
 # quantized serving: int8/fp8 engines may flip near-tie tokens only (margin
 # accounting vs fp32), an int8-KV pool holds strictly more resident slots
 # at equal measured bytes with tokens/s no worse, and a quantized rolling
