@@ -95,6 +95,12 @@ RULES = {
     "F801": (Severity.WARNING,
              "resilience instability in a warmed serving path (transient "
              "retry storm or circuit flapping)"),
+    "F802": (Severity.WARNING,
+             "training supervisor rollback loop (re-divergence after "
+             "restoring the same checkpoint)"),
+    "F803": (Severity.WARNING,
+             "gang instability in a multi-host pod (gang-restore storm, "
+             "or a peer rank still lost after a completed gang restore)"),
     # -- training telemetry (M9xx) -------------------------------------------
     "M901": (Severity.WARNING,
              "data-starved training (input-pipeline wait dominates the "

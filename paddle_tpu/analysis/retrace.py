@@ -75,6 +75,8 @@ class RetraceMonitor:
         # ("supervisor", name) divergence-guard counter snapshots: latest
         # per supervisor (rule F802)
         self._supervisor_sites: Dict[str, dict] = {}
+        # gang watchdog / gang-collective snapshots (rule F803)
+        self._gang_sites: Dict[str, dict] = {}
         # ("amp", name) grad-scaler snapshots: latest per scaler
         self._amp_sites: Dict[str, dict] = {}
         # ("quant", name) quantization snapshots: latest per site — slim
@@ -159,6 +161,13 @@ class RetraceMonitor:
             # divergence-guard counter snapshot: cumulative, latest wins
             with self._lock:
                 self._supervisor_sites[key[1]] = dict(info)
+            return
+        if key[0] == "gang":
+            # gang watchdog / host-lane collective snapshot: cumulative
+            # counters (gang_restores, post_restore_lost, op timeouts),
+            # latest wins (rule F803)
+            with self._lock:
+                self._gang_sites[key[1]] = dict(info)
             return
         if key[0] == "amp":
             # grad-scaler snapshot (scale, skipped steps): latest wins
@@ -278,6 +287,17 @@ class RetraceMonitor:
             if name is not None:
                 return dict(self._supervisor_sites.get(name, {}))
             return {k: dict(v) for k, v in self._supervisor_sites.items()}
+
+    def gang_stats(self, name: str = None):
+        """Latest gang snapshot(s) observed: a per-host watchdog's
+        gang-restore counters (``name`` like ``"watch.p0"`` —
+        ``gang_restores`` / ``post_restore_lost`` / the lost ranks) or a
+        gang collective lane's op counters (``name`` like ``"gang"``).
+        The dict for one site, or all of them."""
+        with self._lock:
+            if name is not None:
+                return dict(self._gang_sites.get(name, {}))
+            return {k: dict(v) for k, v in self._gang_sites.items()}
 
     def amp_stats(self, name: str = None):
         """Latest grad-scaler snapshot(s) observed (loss scale, skipped
@@ -694,6 +714,39 @@ class RetraceMonitor:
                          "lower the learning rate / loss scale or inspect "
                          "the checkpoint itself — the restored state is "
                          "already on the divergence trajectory")
+        with self._lock:
+            gang_sites = {k: dict(v) for k, v in self._gang_sites.items()}
+        for name, stats in gang_sites.items():
+            restores = int(stats.get("gang_restores", 0))
+            stuck = int(stats.get("post_restore_lost", 0))
+            if restores >= 3:
+                out.add("F803",
+                        f"gang watchdog {name!r} performed {restores} "
+                        f"gang restores (last lost rank(s): "
+                        f"{list(stats.get('lost', ()))}) — the gang keeps "
+                        f"dying and restarting; every restore rolls every "
+                        f"host back to the last agreed checkpoint, so a "
+                        f"restore loop makes zero forward progress while "
+                        f"looking busy",
+                        location=Location(file=name, function=name),
+                        hint="find the host that keeps dying (its own "
+                             "watchdog metrics name the exit codes); the "
+                             "storm breaker (storm_window/storm_restarts, "
+                             "exit 77) bounds the loop but only fixing "
+                             "the dying host ends it")
+            elif stuck >= 1 and restores >= 1:
+                out.add("F803",
+                        f"gang watchdog {name!r} saw rank(s) still lost "
+                        f"after a completed gang restore ({stuck} "
+                        f"repeat-loss event(s), {restores} restores) — a "
+                        f"peer that never comes back means the gang "
+                        f"re-forms short and every collective will wait "
+                        f"on a dead rank until the watchdog trips",
+                        location=Location(file=name, function=name),
+                        hint="the lost rank's host is down or partitioned "
+                             "(not just its trainer): replace the host or "
+                             "relaunch with the surviving world size — "
+                             "restarting survivors again cannot revive it")
         with self._lock:
             quant_sites = {k: dict(v)
                            for k, v in self._quant_sites.items()}

@@ -7,8 +7,12 @@ mesh.py) instead of NCCL rings + Gloo + gRPC parameter servers.
 from .env import (  # noqa: F401
     ParallelEnv,
     init_parallel_env,
+    validate_env,
     get_rank,
     get_world_size,
+    process_index,
+    process_count,
+    gang_transport,
 )
 from .mesh import (  # noqa: F401
     build_mesh,
@@ -35,7 +39,21 @@ from .collective import (  # noqa: F401
     ppermute,
     all_to_all_single,
 )
-from .parallel import DataParallel, spawn  # noqa: F401
+from .parallel import (  # noqa: F401
+    DataParallel,
+    spawn,
+    shard_batch,
+    GANG_RESTART_EXIT_CODE,
+    RESTART_STORM_EXIT_CODE,
+)
+from .gang import (  # noqa: F401
+    Gang,
+    FileTransport,
+    KVStoreTransport,
+    default_gang,
+    current_gang,
+    set_gang,
+)
 
 
 def prepare_context(strategy=None):

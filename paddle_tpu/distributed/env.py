@@ -9,23 +9,177 @@ TPU-native: rendezvous is JAX's coordination service
 (imperative/nccl_context.cc) or Gloo file/HTTP KV stores (role_maker.py:33).
 One process per *host* (driving all its local chips), not one per device —
 collectives ride ICI/DCN via XLA, so there is no per-GPU process model.
+
+Env wiring is validated up front (:func:`validate_env`): a bad
+``PADDLE_TRAINER_*`` / ``COORDINATOR_ADDRESS`` combination raises a typed
+:class:`InvalidArgumentError` naming the offending variable instead of
+failing deep inside ``jax.distributed.initialize`` minutes later.  The
+coordinator join itself runs under a deadline-aware
+:class:`resilience.retry.RetryPolicy` with a ``fault_point`` seam
+(``"distributed.init"``) so chaos plans can exercise the flaky-rendezvous
+path.
+
+Transports (``PADDLE_TPU_GANG_TRANSPORT``):
+
+* ``jax`` — the coordination service; the production pod mode.  Global
+  device view, XLA collectives over ICI/DCN.
+* ``file`` — rank/world come from the env vars alone and *host-level*
+  gang collectives (:mod:`paddle_tpu.distributed.gang`) ride a shared
+  directory (``PADDLE_TPU_GANG_DIR``).  This is the CPU multi-process
+  lane: the CPU backend joins the coordination service fine but refuses
+  cross-process XLA computations, so the pod smoke runs real processes
+  over this transport instead.
+* ``auto`` (default) — ``jax`` when a coordinator address is wired,
+  ``file`` when only a gang dir is, single-host otherwise.
 """
 from __future__ import annotations
 
 import os
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
+
+from ..framework.errors import InvalidArgumentError
 
 __all__ = [
     "ParallelEnv",
     "init_parallel_env",
+    "validate_env",
     "get_rank",
     "get_world_size",
     "is_initialized",
+    "process_index",
+    "process_count",
+    "gang_transport",
 ]
 
+ENV_GANG_TRANSPORT = "PADDLE_TPU_GANG_TRANSPORT"
+ENV_GANG_DIR = "PADDLE_TPU_GANG_DIR"
+ENV_INIT_TIMEOUT = "PADDLE_TPU_INIT_TIMEOUT_S"
+
 _initialized = False
+#: resolved transport after init: "single" | "jax" | "file"
+_transport = "single"
+#: rank/world under the file transport (jax only sees local devices there)
+_gang_rank = 0
+_gang_world = 1
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw in (None, ""):
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise InvalidArgumentError(
+            f"{name}={raw!r} is not an integer") from None
+
+
+def validate_env(coordinator_address: Optional[str] = None,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None,
+                 ) -> Tuple[Optional[str], int, int]:
+    """Parse + cross-check the launch env; returns ``(addr, nproc, pid)``.
+
+    Every inconsistency raises :class:`InvalidArgumentError` naming the
+    offending variable — world size vs rank bounds, endpoint-count
+    mismatches, duplicate endpoints, malformed addresses — instead of the
+    opaque coordination-service failure those produce downstream.
+    """
+    eps_raw = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    endpoints = [e.strip() for e in eps_raw.split(",") if e.strip()]
+
+    explicit_coord = bool(coordinator_address
+                          or os.environ.get("COORDINATOR_ADDRESS"))
+    addr = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if addr is None and endpoints:
+        addr = endpoints[0]
+
+    nproc = (num_processes if num_processes is not None
+             else _env_int("PADDLE_TRAINERS_NUM", 0))
+    if nproc is None:
+        nproc = 0
+    if num_processes is None and os.environ.get("PADDLE_TRAINERS_NUM") \
+            and nproc < 1:
+        raise InvalidArgumentError(
+            f"PADDLE_TRAINERS_NUM={nproc} must be >= 1")
+    pid = (process_id if process_id is not None
+           else _env_int("PADDLE_TRAINER_ID", 0))
+
+    world = nproc if nproc > 0 else (len(endpoints) or 1)
+    if not 0 <= pid < max(world, 1):
+        raise InvalidArgumentError(
+            f"PADDLE_TRAINER_ID={pid} out of range [0, {world}) — "
+            "check PADDLE_TRAINER_ID against PADDLE_TRAINERS_NUM")
+    if endpoints and nproc > 0 and len(endpoints) != nproc \
+            and not explicit_coord:
+        # with an explicit COORDINATOR_ADDRESS the endpoint list is
+        # informational; when it IS the rendezvous source, every rank
+        # needs exactly one entry
+        raise InvalidArgumentError(
+            f"PADDLE_TRAINER_ENDPOINTS lists {len(endpoints)} endpoints "
+            f"but PADDLE_TRAINERS_NUM={nproc} — every rank needs exactly "
+            "one endpoint")
+    if len(set(endpoints)) != len(endpoints):
+        dups = sorted({e for e in endpoints if endpoints.count(e) > 1})
+        raise InvalidArgumentError(
+            f"PADDLE_TRAINER_ENDPOINTS contains duplicate endpoint(s) "
+            f"{dups} — two ranks cannot share an address")
+    if addr is not None:
+        host, _, port = addr.partition(":")
+        if not host or not port or not port.isdigit():
+            name = ("COORDINATOR_ADDRESS"
+                    if coordinator_address or os.environ.get(
+                        "COORDINATOR_ADDRESS")
+                    else "PADDLE_TRAINER_ENDPOINTS")
+            raise InvalidArgumentError(
+                f"{name}={addr!r} is not host:port")
+    transport = os.environ.get(ENV_GANG_TRANSPORT, "auto").lower()
+    if transport not in ("auto", "jax", "file"):
+        raise InvalidArgumentError(
+            f"{ENV_GANG_TRANSPORT}={transport!r} must be one of "
+            "auto|jax|file")
+    if world > 1 and addr is None and transport != "file" \
+            and not os.environ.get(ENV_GANG_DIR):
+        raise InvalidArgumentError(
+            f"PADDLE_TRAINERS_NUM={world} but neither COORDINATOR_ADDRESS "
+            f"nor PADDLE_TRAINER_ENDPOINTS (nor a {ENV_GANG_DIR} for the "
+            "file transport) is set — multi-host needs a rendezvous point")
+    if transport == "file" and world > 1 \
+            and not os.environ.get(ENV_GANG_DIR):
+        raise InvalidArgumentError(
+            f"{ENV_GANG_TRANSPORT}=file needs {ENV_GANG_DIR} to point at "
+            "a directory shared by all ranks")
+    return addr, world, pid
+
+
+def _join_coordinator(addr: str, nproc: int, pid: int) -> None:
+    """``jax.distributed.initialize`` under a deadline-aware retry.
+
+    Pod bring-up is racy by design — hosts boot in any order, the
+    coordinator may not be listening yet — so the join retries transient
+    rendezvous failures with backoff, bounded by a wall-clock deadline
+    (``PADDLE_TPU_INIT_TIMEOUT_S``, default 300s).  The
+    ``fault_point("distributed.init")`` seam lets chaos plans inject
+    exactly this failure mode.
+    """
+    from ..resilience.faults import fault_point
+    from ..resilience.retry import RetryPolicy
+
+    timeout_s = float(os.environ.get(ENV_INIT_TIMEOUT, "300") or 300)
+    policy = RetryPolicy(
+        max_attempts=8, backoff_ms=500.0, max_backoff_ms=10_000.0,
+        deadline_ms=timeout_s * 1e3,
+        retry_on=(RuntimeError, OSError, ConnectionError, TimeoutError),
+        name="distributed.init")
+
+    def _attempt():
+        fault_point("distributed.init")
+        jax.distributed.initialize(
+            coordinator_address=addr, num_processes=nproc, process_id=pid)
+
+    policy.call(_attempt)
 
 
 def init_parallel_env(coordinator_address: Optional[str] = None,
@@ -35,26 +189,34 @@ def init_parallel_env(coordinator_address: Optional[str] = None,
 
     Single-host (the common TPU pod-slice dev loop and all tests): no-op
     beyond marking the env initialized — every local device is already
-    visible.  Multi-host: wires ``jax.distributed.initialize`` from args or
-    the standard env vars (COORDINATOR_ADDRESS / PADDLE_TRAINER_ENDPOINTS,
-    PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ID — the launch-compatible names).
+    visible.  Multi-host: validates the env wiring up front
+    (:func:`validate_env`), then either joins the JAX coordination service
+    (``jax`` transport — retried, deadline-bounded, fault-injectable) or
+    records the env-derived rank/world (``file`` transport — host-level
+    gang collectives ride ``PADDLE_TPU_GANG_DIR``; see
+    :mod:`paddle_tpu.distributed.gang`).
     """
-    global _initialized
+    global _initialized, _transport, _gang_rank, _gang_world
     if _initialized:
         return ParallelEnv()
 
-    addr = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
-    if addr is None:
-        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS")
-        if eps:
-            addr = eps.split(",")[0]
-    nproc = num_processes or int(os.environ.get("PADDLE_TRAINERS_NUM", "0") or 0)
-    pid = process_id if process_id is not None else int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    addr, world, pid = validate_env(coordinator_address, num_processes,
+                                    process_id)
+    transport = os.environ.get(ENV_GANG_TRANSPORT, "auto").lower()
+    if transport == "auto":
+        if world > 1 and addr:
+            transport = "jax"
+        elif world > 1 and os.environ.get(ENV_GANG_DIR):
+            transport = "file"
 
-    if addr and nproc > 1:
-        jax.distributed.initialize(
-            coordinator_address=addr, num_processes=nproc, process_id=pid
-        )
+    if transport == "jax" and addr and world > 1:
+        _join_coordinator(addr, world, pid)
+        _transport = "jax"
+    elif transport == "file" and world > 1:
+        _transport = "file"
+        _gang_rank, _gang_world = pid, world
+    else:
+        _transport = "single"
     _initialized = True
     return ParallelEnv()
 
@@ -63,13 +225,39 @@ def is_initialized() -> bool:
     return _initialized
 
 
-def get_rank() -> int:
+def gang_transport() -> str:
+    """Resolved transport after :func:`init_parallel_env`:
+    ``"single"`` | ``"jax"`` | ``"file"``."""
+    return _transport
+
+
+def process_index() -> int:
+    """This host's rank in the gang.  Unlike raw ``jax.process_index()``
+    this honors the file transport, where jax itself only sees the local
+    host."""
+    if _transport == "file":
+        return _gang_rank
     return jax.process_index()
+
+
+def process_count() -> int:
+    """Number of host processes in the gang (see :func:`process_index`)."""
+    if _transport == "file":
+        return _gang_world
+    return jax.process_count()
+
+
+def get_rank() -> int:
+    return process_index()
 
 
 def get_world_size() -> int:
     """Number of participating *devices* across all processes (paddle's
-    world_size counts trainers = GPUs; the TPU analogue is chips)."""
+    world_size counts trainers = GPUs; the TPU analogue is chips).  Under
+    the file transport jax only sees local devices, so the count is
+    local x world (hosts are assumed homogeneous — true for pod slices)."""
+    if _transport == "file":
+        return jax.device_count() * _gang_world
     return jax.device_count()
 
 
@@ -78,19 +266,19 @@ class ParallelEnv:
 
     @property
     def rank(self) -> int:
-        return jax.process_index()
+        return process_index()
 
     @property
     def world_size(self) -> int:
-        return jax.device_count()
+        return get_world_size()
 
     @property
     def local_rank(self) -> int:
-        return jax.process_index()
+        return process_index()
 
     @property
     def nranks(self) -> int:
-        return jax.device_count()
+        return get_world_size()
 
     @property
     def device_id(self) -> int:
@@ -104,7 +292,7 @@ class ParallelEnv:
     @property
     def current_endpoint(self) -> str:
         eps = self.trainer_endpoints
-        i = jax.process_index()
+        i = process_index()
         return eps[i] if i < len(eps) else ""
 
     @property

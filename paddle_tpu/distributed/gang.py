@@ -1,0 +1,419 @@
+"""Host-level gang collectives — the control lane of a multi-host pod.
+
+The *data plane* of a pod (gradient allreduce over chips) rides XLA
+collectives through :mod:`paddle_tpu.distributed.collective` over
+ICI/DCN.  But a pod also needs a *host lane*: small host-resident values
+exchanged between the one-process-per-host gang members — checkpoint
+counters to negotiate a gang-consistent resume point, per-host gradient
+or parameter trees on backends whose XLA cannot span processes (the CPU
+backend joins the coordination service fine but refuses cross-process
+computations), barriers around save/restore, membership handshakes after
+an elastic gang restart.  That lane is this module.
+
+Two transports:
+
+* :class:`FileTransport` — a directory shared by all ranks
+  (``PADDLE_TPU_GANG_DIR``).  Atomic per-rank files (write tmp +
+  ``os.replace``), NFS-grade semantics suffice.  This is how the CPU
+  pod smoke runs N *real* processes, and works on any pod with a shared
+  filesystem.
+* :class:`KVStoreTransport` — the JAX coordination-service key-value
+  store (available once ``jax.distributed.initialize`` joined); the
+  zero-extra-infrastructure production option.
+
+Determinism: gathers return contributions in **rank order** and
+reductions fold in rank order, so every rank computes bit-identical
+results — and a single-process run folding the same per-shard values in
+the same order reproduces them exactly (the pod smoke's bit-identity
+gates are built on this).
+
+Failure: every blocking op runs under the ``FLAGS_collective_timeout_s``
+watchdog contract — a dead peer raises :class:`TransientDeviceError`
+naming the missing ranks instead of hanging the gang, bumping the same
+``collective_watchdog_trips`` counters as the XLA-side watchdog.  The
+``fault_point("gang.collective")`` seam lets chaos plans wedge or fail
+individual ops.
+
+Restart-safety: all op keys are namespaced by a **generation** digest
+negotiated at :meth:`Gang.join` from fresh per-incarnation nonces.  After
+a gang restart every member rejoins, the generation changes, and stale
+files written by the previous incarnation can never satisfy (or corrupt)
+a new collective.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..framework.errors import InvalidArgumentError, TransientDeviceError
+
+__all__ = ["Gang", "FileTransport", "KVStoreTransport", "default_gang",
+           "current_gang", "set_gang"]
+
+_POLL_S = 0.01
+
+
+class FileTransport:
+    """Shared-directory transport: ``put`` is atomic (tmp + rename) so a
+    reader never observes a torn value; keys map to flat file names."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key.replace(os.sep, "_"))
+
+    def put(self, key: str, value: bytes) -> None:
+        path = self._path(key)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(value)
+        os.replace(tmp, path)
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def delete(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+
+class KVStoreTransport:
+    """The jax.distributed coordination-service KV store.  Values are
+    hex-encoded (the store speaks strings).  Only usable after
+    ``init_parallel_env`` joined the coordinator; deletes are no-ops (the
+    store dies with the coordinator, and generations already fence stale
+    keys)."""
+
+    def __init__(self, client=None):
+        if client is None:
+            from jax._src import distributed as _jd
+
+            client = getattr(_jd.global_state, "client", None)
+        if client is None:
+            raise InvalidArgumentError(
+                "KVStoreTransport needs a joined jax.distributed client — "
+                "call init_parallel_env() first")
+        self._client = client
+
+    def put(self, key: str, value: bytes) -> None:
+        self._client.key_value_set(key, value.hex())
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        try:
+            raw = self._client.blocking_key_value_get(key, 1)  # 1 ms
+        except Exception:  # noqa: BLE001 — "not there yet" surfaces as
+            return None    # a backend-specific error; the caller polls
+        return bytes.fromhex(raw)
+
+    def delete(self, key: str) -> None:
+        pass
+
+
+class Gang:
+    """A joined set of host processes exchanging small values.
+
+    All collectives are synchronous and deterministic; ``world == 1``
+    degenerates to local no-ops (gather returns ``[x]``), so trainer code
+    is identical on one host and on a pod.
+    """
+
+    def __init__(self, rank: int, world: int, transport=None,
+                 name: str = "gang", default_timeout: Optional[float] = None,
+                 heartbeat: Optional[Callable[[], None]] = None):
+        if world < 1:
+            raise InvalidArgumentError("world must be >= 1")
+        if not 0 <= rank < world:
+            raise InvalidArgumentError(
+                f"rank {rank} out of range [0, {world})")
+        if world > 1 and transport is None:
+            raise InvalidArgumentError("world > 1 needs a transport")
+        self.rank = int(rank)
+        self.world = int(world)
+        self.transport = transport
+        self.name = name
+        self.default_timeout = default_timeout
+        self.generation = "solo" if world == 1 else None
+        self._seq = 0
+        self._nonces: Dict[int, str] = {}  # joined incarnations, by rank
+        self._written: Dict[int, List[str]] = {}
+        self._stats = {"ops": 0, "timeouts": 0, "joins": 0}
+        if heartbeat is None:
+            from .heartbeat import maybe_beat
+
+            heartbeat = maybe_beat
+        self._beat = heartbeat
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _timeout(self, timeout: Optional[float]) -> float:
+        if timeout is not None:
+            return float(timeout)
+        from ..framework.flags import flag
+
+        configured = float(flag("collective_timeout_s") or 0.0)
+        if configured > 0:
+            return configured
+        if self.default_timeout is not None:
+            return float(self.default_timeout)
+        return 600.0
+
+    def _publish(self, extra: Optional[dict] = None) -> None:
+        from ..framework import trace_events
+
+        if not trace_events.active():
+            return
+        info = {"rank": self.rank, "world": self.world,
+                "generation": self.generation, **self._stats}
+        if extra:
+            info.update(extra)
+        trace_events.notify(("gang", self.name), info)
+
+    def _trip(self, what: str, timeout: float, missing: List[int]):
+        from ..framework import monitor as _monitor
+        from ..framework.logging import vlog
+        from ..resilience import supervisor as _supervisor
+
+        self._stats["timeouts"] += 1
+        _monitor.stat_add("collective_watchdog_trips")
+        _supervisor.record("watchdog_trips")
+        vlog(0, "gang %s: %s timed out after %.1fs waiting for rank(s) %s",
+             self.name, what, timeout, missing)
+        self._publish({"last_timeout_op": what})
+        raise TransientDeviceError(
+            f"gang collective {what!r} timed out after {timeout:g}s "
+            f"waiting for rank(s) {missing} — peer dead or wedged "
+            "(FLAGS_collective_timeout_s watchdog)")
+
+    def _check_reincarnation(self, what: str) -> None:
+        """A peer whose join nonce changed has restarted and abandoned
+        this generation — the collective we are blocked in can NEVER
+        complete (the new incarnation will only ever speak the next
+        generation), so fail fast instead of waiting out the watchdog.
+        This is what breaks the fast-restart livelock: a SIGKILLed host
+        that relaunches within the peer-heartbeat timeout never looks
+        lost to any watchdog, yet its old generation is dead."""
+        if not self._nonces:
+            return
+        for r in range(self.world):
+            if r == self.rank:
+                continue
+            raw = self.transport.try_get(f"join.p{r}")
+            if raw is None or raw.decode() == self._nonces.get(r):
+                continue
+            from ..framework import monitor as _monitor
+            from ..framework.logging import vlog
+
+            _monitor.stat_add("gang_reincarnations")
+            vlog(0, "gang %s: rank %d reincarnated mid-%s — generation "
+                    "%s is abandoned", self.name, r, what, self.generation)
+            self._publish({"reincarnated_rank": r})
+            raise TransientDeviceError(
+                f"gang peer rank {r} restarted while {what!r} was in "
+                f"flight — generation {self.generation} is abandoned; "
+                f"rejoin the gang (exit GANG_RESTART_EXIT_CODE under a "
+                f"watchdog)")
+
+    def _await_keys(self, keys: Dict[int, str], what: str,
+                    timeout: float) -> Dict[int, bytes]:
+        deadline = time.monotonic() + timeout
+        got: Dict[int, bytes] = {}
+        polls = 0
+        while True:
+            for r, key in keys.items():
+                if r in got:
+                    continue
+                val = self.transport.try_get(key)
+                if val is not None:
+                    got[r] = val
+            if len(got) == len(keys):
+                return got
+            if time.monotonic() > deadline:
+                self._trip(what, timeout, sorted(set(keys) - set(got)))
+            polls += 1
+            if polls % 25 == 0:  # ~4x/s: reincarnation fencing
+                self._check_reincarnation(what)
+            self._beat()  # blocked-in-collective is alive, not hung
+            time.sleep(_POLL_S)
+
+    # -- membership -------------------------------------------------------
+
+    def join(self, timeout: Optional[float] = None) -> str:
+        """Handshake a fresh generation with every peer; returns the
+        generation id.  Each incarnation contributes a fresh nonce; the
+        generation is a digest over all nonces, and members ack the digest
+        they computed — convergence means every member saw the same set of
+        live incarnations.  A peer restarting mid-join changes its nonce,
+        digests diverge, and everyone re-reads until stable: the handshake
+        is self-healing across elastic restarts."""
+        self._stats["joins"] += 1
+        if self.world == 1:
+            self.generation = "solo"
+            return self.generation
+        from ..resilience.faults import fault_point
+
+        fault_point("gang.join")
+        timeout = self._timeout(timeout)
+        deadline = time.monotonic() + timeout
+        nonce = os.urandom(8).hex()
+        self.transport.put(f"join.p{self.rank}", nonce.encode())
+        digest = None
+        while True:
+            nonces = {}
+            for r in range(self.world):
+                raw = self.transport.try_get(f"join.p{r}")
+                if raw is not None:
+                    nonces[r] = raw.decode()
+            if len(nonces) == self.world and nonces[self.rank] == nonce:
+                material = ",".join(f"{r}:{nonces[r]}"
+                                    for r in range(self.world))
+                d = hashlib.sha256(material.encode()).hexdigest()[:16]
+                if d != digest:
+                    digest = d
+                    self.transport.put(f"ack.p{self.rank}", digest.encode())
+                acks = [self.transport.try_get(f"ack.p{r}")
+                        for r in range(self.world)]
+                if all(a is not None and a.decode() == digest
+                       for a in acks):
+                    break
+            if time.monotonic() > deadline:
+                missing = sorted(set(range(self.world)) - set(nonces))
+                self._trip("join", timeout, missing or
+                           list(range(self.world)))
+            self._beat()
+            time.sleep(_POLL_S)
+        self.generation = digest
+        self._nonces = dict(nonces)  # the incarnations this gen speaks for
+        self._seq = 0
+        self._written.clear()
+        self._publish({"joined": 1})
+        return self.generation
+
+    # -- collectives ------------------------------------------------------
+
+    def all_gather_bytes(self, data: bytes,
+                         timeout: Optional[float] = None) -> List[bytes]:
+        """Every rank contributes ``data``; returns all contributions in
+        rank order on every rank."""
+        if self.world == 1:
+            return [data]
+        if self.generation is None:
+            raise InvalidArgumentError("gang not joined — call join()")
+        from ..resilience.faults import fault_point
+
+        fault_point("gang.collective")
+        timeout = self._timeout(timeout)
+        seq = self._seq
+        self._seq += 1
+        self._stats["ops"] += 1
+        key = f"op.{self.generation}.{seq}"
+        self.transport.put(f"{key}.p{self.rank}", data)
+        self._written.setdefault(seq, []).append(f"{key}.p{self.rank}")
+        got = self._await_keys(
+            {r: f"{key}.p{r}" for r in range(self.world)},
+            f"all_gather[{seq}]", timeout)
+        self._gc(seq)
+        return [got[r] for r in range(self.world)]
+
+    def _gc(self, seq: int) -> None:
+        # every rank observed at seq means every rank finished seq-1 and
+        # earlier (ops are issued in order), so our own files a few seqs
+        # back can never be read again
+        for s in [s for s in self._written if s < seq - 2]:
+            for key in self._written.pop(s):
+                self.transport.delete(key)
+
+    def all_gather_obj(self, obj, timeout: Optional[float] = None) -> list:
+        return [pickle.loads(b) for b in
+                self.all_gather_bytes(pickle.dumps(obj), timeout)]
+
+    def barrier(self, timeout: Optional[float] = None) -> None:
+        self.all_gather_bytes(b"", timeout)
+
+    def broadcast_obj(self, obj=None, src: int = 0,
+                      timeout: Optional[float] = None):
+        """Rank ``src``'s object lands on every rank (others pass any
+        placeholder)."""
+        return self.all_gather_obj(obj, timeout)[src]
+
+    def min_int(self, value: int, timeout: Optional[float] = None) -> int:
+        """The gang-wide minimum — the checkpoint-counter negotiation
+        primitive for gang-consistent resume."""
+        return min(self.all_gather_obj(int(value), timeout))
+
+    def all_reduce_mean_tree(self, tree, timeout: Optional[float] = None):
+        """Mean of a pytree of numpy arrays across ranks, folded in rank
+        order — bit-identical on every rank, and bit-identical to a
+        single process folding the same per-rank trees in the same order
+        (see :func:`mean_trees`)."""
+        contributions = self.all_gather_obj(tree, timeout)
+        return mean_trees(contributions)
+
+
+def mean_trees(trees: list):
+    """Rank-ordered mean of pytrees of numpy arrays — THE reduction both
+    the gang and the single-process baseline use, so pod and solo runs
+    agree bitwise.  Left-fold in list order; no pairwise reassociation."""
+    import jax
+    import numpy as np
+
+    def _mean(*leaves):
+        acc = np.asarray(leaves[0], dtype=np.float32).copy()
+        for leaf in leaves[1:]:
+            acc += np.asarray(leaf, dtype=np.float32)
+        return acc / np.float32(len(leaves))
+
+    return jax.tree_util.tree_map(_mean, *trees)
+
+
+_gang: Optional[Gang] = None
+
+
+def set_gang(gang: Optional[Gang]) -> Optional[Gang]:
+    global _gang
+    _gang = gang
+    return gang
+
+
+def current_gang() -> Optional[Gang]:
+    return _gang
+
+
+def default_gang(name: str = "gang") -> Gang:
+    """Build (and cache) the gang described by the launch environment:
+    file transport when ``PADDLE_TPU_GANG_DIR`` is wired, the KV store
+    when a jax.distributed coordinator is joined, a solo gang otherwise.
+    The returned gang is already :meth:`Gang.join`-ed."""
+    global _gang
+    if _gang is not None:
+        return _gang
+    from . import env as _env
+
+    _env.init_parallel_env()
+    world = _env.process_count()
+    rank = _env.process_index()
+    transport = None
+    if world > 1:
+        gang_dir = os.environ.get(_env.ENV_GANG_DIR)
+        if _env.gang_transport() == "file" or (
+                gang_dir and _env.gang_transport() != "jax"):
+            if not gang_dir:
+                raise InvalidArgumentError(
+                    f"file gang transport needs {_env.ENV_GANG_DIR}")
+            transport = FileTransport(os.path.join(gang_dir, "ops"))
+        else:
+            transport = KVStoreTransport()
+    g = Gang(rank, world, transport, name=name)
+    g.join()
+    _gang = g
+    return g

@@ -33,7 +33,8 @@ from typing import Callable, Dict, Optional
 from ..framework.errors import InvalidArgumentError
 from ..framework.locking import OrderedLock
 
-__all__ = ["HeartBeatMonitor", "FileHeartbeat", "maybe_beat"]
+__all__ = ["HeartBeatMonitor", "FileHeartbeat", "PeerHeartbeatMonitor",
+           "maybe_beat", "gang_beat_path"]
 
 ENV_FILE = "PADDLE_TPU_HEARTBEAT_FILE"
 #: the training loop throttles beats to one per this many seconds —
@@ -49,22 +50,38 @@ class HeartBeatMonitor:
     ``on_lost(worker_id, age_seconds)`` for each worker whose last beat is
     older than ``timeout``.  A worker is reported lost once per outage;
     if it beats again it re-arms.  Workers that never beat are measured
-    from ``start()``.
+    from ``start()`` against ``grace`` (default: ``timeout``) — cross-host
+    gangs set a generous grace so slow interpreter/backend startup on a
+    peer isn't mistaken for a death.
+
+    Clock-skew tolerance: staleness is always measured on THIS host's
+    monotonic clock against the moment this host *observed* the worker's
+    beat — remote timestamps are never compared against local wall clock.
+    Transports that can only see a remote stamp (an mtime written by
+    another host) feed :meth:`update_stamp`, which records a local
+    observation time whenever the stamp *changes*; a peer whose clock
+    runs minutes ahead or behind is still exactly as live as its latest
+    beat delta.
     """
 
     def __init__(self, workers: int, timeout: float = 60.0,
                  interval: Optional[float] = None,
-                 on_lost: Optional[Callable[[int, float], None]] = None):
+                 on_lost: Optional[Callable[[int, float], None]] = None,
+                 grace: Optional[float] = None):
         if workers <= 0:
             raise InvalidArgumentError("workers must be > 0")
         if timeout <= 0:
             raise InvalidArgumentError("timeout must be > 0")
+        if grace is not None and grace < 0:
+            raise InvalidArgumentError("grace must be >= 0")
         self.workers = workers
         self.timeout = float(timeout)
+        self.grace = float(grace) if grace is not None else self.timeout
         self.interval = float(interval if interval is not None
                               else max(timeout / 4, 0.05))
         self._on_lost = on_lost
         self._beats: Dict[int, float] = {}
+        self._stamps: Dict[int, object] = {}
         self._lost: Dict[int, bool] = {i: False for i in range(workers)}
         self._lock = OrderedLock("HeartBeatMonitor._lock")
         self._stop = threading.Event()
@@ -80,6 +97,36 @@ class HeartBeatMonitor:
             self._beats[worker_id] = time.monotonic()
             self._lost[worker_id] = False  # re-arm after recovery
 
+    def update_stamp(self, worker_id: int, stamp) -> None:
+        """Record a beat iff ``stamp`` differs from the worker's previous
+        stamp.  ``stamp`` is opaque (an ``(mtime, size)`` pair, a sequence
+        number...) and is only ever compared for EQUALITY against the same
+        worker's prior value — never against this host's clock — which is
+        what makes ``lost_workers()`` immune to cross-host clock skew."""
+        if not 0 <= worker_id < self.workers:
+            raise InvalidArgumentError(
+                f"worker_id {worker_id} out of range [0, {self.workers})")
+        with self._lock:
+            if self._stamps.get(worker_id) == stamp:
+                return  # no new beat observed
+            self._stamps[worker_id] = stamp
+            self._beats[worker_id] = time.monotonic()
+            self._lost[worker_id] = False
+
+    def rearm(self, grace: Optional[float] = None) -> None:
+        """Forget all observed beats and re-apply the startup grace —
+        called after a gang restart, when every peer is expected to go
+        silent while its trainer relaunches and must not be re-flagged
+        as lost during the window."""
+        with self._lock:
+            if grace is not None:
+                self.grace = float(grace)
+            self._beats.clear()
+            self._stamps.clear()
+            for i in self._lost:
+                self._lost[i] = False
+            self._t0 = time.monotonic()
+
     def lost_workers(self):
         with self._lock:
             return sorted(i for i, lost in self._lost.items() if lost)
@@ -89,9 +136,13 @@ class HeartBeatMonitor:
         fire = []
         with self._lock:
             for i in range(self.workers):
-                last = self._beats.get(i, self._t0)
+                last = self._beats.get(i)
+                if last is None:  # never beaten: measured against grace
+                    last, limit = self._t0, self.grace
+                else:
+                    limit = self.timeout
                 age = now - last
-                if age > self.timeout and not self._lost[i]:
+                if age > limit and not self._lost[i]:
                     self._lost[i] = True
                     fire.append((i, age))
         for i, age in fire:
@@ -145,12 +196,20 @@ class FileHeartbeat:
     """Trainer-side beat writer: touches ``path``'s mtime.  The watchdog
     reads the mtime — no content parsing, atomic on every filesystem."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, touch: bool = True):
+        # touch=False: adopt the path without stamping it — used by the
+        # gang watchdog, where ONLY the trainer's own beats may refresh
+        # the file (a watchdog stamp would make peers think the trainer
+        # is alive while it is still relaunching)
         self.path = path
         d = os.path.dirname(path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        self.beat()
+        try:
+            if d:
+                os.makedirs(d, exist_ok=True)
+        except OSError:
+            pass  # side channel: beat() retries and counts the failure
+        if touch:
+            self.beat()
 
     def beat(self) -> None:
         try:
@@ -227,3 +286,85 @@ def maybe_beat(min_interval: float = BEAT_MIN_INTERVAL) -> None:
         _last_beat = now
     finally:
         _beat_lock.release()
+
+
+def gang_beat_path(gang_dir: str, rank: int) -> str:
+    """The per-rank beat file inside a shared gang directory — rank ``r``
+    writes ``beat.p<r>``; every peer's watchdog reads all of them."""
+    return os.path.join(gang_dir, f"beat.p{int(rank)}")
+
+
+class PeerHeartbeatMonitor:
+    """Cross-host liveness: every rank's watchdog reads every OTHER rank's
+    beat file from the shared gang directory and feeds stamp changes into
+    a :class:`HeartBeatMonitor`.
+
+    The transport is deliberately dumb — each trainer appends to its own
+    ``beat.p<rank>`` (the existing :class:`FileHeartbeat` writer, pointed
+    into the gang dir) — and the reader side never interprets remote
+    mtimes as times: a beat is "the ``(mtime, size)`` stamp changed since
+    I last looked", timed on the local monotonic clock via
+    :meth:`HeartBeatMonitor.update_stamp`.  NFS-grade semantics (close-to
+    -open consistency, coarse mtime) are enough, and cross-host clock skew
+    is irrelevant by construction.
+
+    ``self_rank`` is exempt: this watchdog supervises its own trainer
+    through the hang detector; the peer monitor only answers "did someone
+    ELSE's host die", so ``lost_workers()`` never contains ``self_rank``.
+    """
+
+    def __init__(self, gang_dir: str, world: int, self_rank: int,
+                 timeout: float = 10.0, interval: Optional[float] = None,
+                 grace: Optional[float] = None,
+                 on_lost: Optional[Callable[[int, float], None]] = None):
+        if not 0 <= self_rank < world:
+            raise InvalidArgumentError(
+                f"self_rank {self_rank} out of range [0, {world})")
+        self.gang_dir = gang_dir
+        self.world = int(world)
+        self.self_rank = int(self_rank)
+        self._mon = HeartBeatMonitor(
+            workers=world, timeout=timeout, interval=interval,
+            grace=grace if grace is not None else max(30.0, 3 * timeout),
+            on_lost=on_lost)
+        self._poll = self._mon.interval
+        self._stop = threading.Event()
+        self._stop.set()
+        self._thread: Optional[threading.Thread] = None
+
+    def _scan(self) -> None:
+        self._mon.update(self.self_rank)  # self is alive by definition
+        for r in range(self.world):
+            if r == self.self_rank:
+                continue
+            try:
+                st = os.stat(gang_beat_path(self.gang_dir, r))
+            except OSError:
+                continue  # not written yet / mid-replace: no new beat
+            self._mon.update_stamp(r, (st.st_mtime, st.st_size))
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._scan()
+            self._stop.wait(self._poll)
+
+    def start(self) -> "PeerHeartbeatMonitor":
+        self._mon.start()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="gang-peer-monitor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self._poll + 1)
+            self._thread = None
+        self._mon.stop()
+
+    def rearm(self, grace: Optional[float] = None) -> None:
+        self._mon.rearm(grace)
+
+    def lost_workers(self):
+        return [r for r in self._mon.lost_workers() if r != self.self_rank]

@@ -54,10 +54,42 @@ def build_mesh(
     sharding: int = 1,
     ep: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
+    allow_dcn_inner: bool = False,
 ) -> Mesh:
     """Construct the hybrid-parallel mesh.  ``dp=0`` means "all remaining
-    devices".  Degrees multiply to the device count."""
-    devices = list(devices if devices is not None else jax.devices())
+    devices".  Degrees multiply to the device count.
+
+    Multi-host (a ``jax.distributed``-joined gang): the mesh is DCN x ICI
+    aware.  Devices are ordered **process-major** so, with the
+    outer→inner ``AXIS_ORDER`` reshape, the outer axes (``pipe``,
+    ``data``) span process/DCN boundaries while the inner axes
+    (``sharding``/``sep``/``expert``/``model`` — the latency-sensitive
+    collectives) stay inside a host's directly-wired ICI domain.  An
+    inner-axis block that would straddle hosts (inner degrees not fitting
+    the per-host device count) is rejected with guidance unless
+    ``allow_dcn_inner=True`` — tensor-parallel allreduce over DCN is
+    usually a config bug, not a plan.
+    """
+    if devices is None:
+        devices = list(jax.devices())
+        if jax.process_count() > 1:
+            # process-major: contiguous ICI blocks per host, DCN on the
+            # outer axes.  jax.devices() usually already satisfies this,
+            # but the mesh must not depend on backend enumeration luck.
+            devices.sort(key=lambda d: (d.process_index, d.id))
+            local = len(devices) // jax.process_count()
+            inner = mp * ep * sep * sharding
+            if local and inner > 1 and local % inner != 0 \
+                    and not allow_dcn_inner:
+                raise InvalidArgumentError(
+                    f"inner (ICI) axes model*expert*sep*sharding={inner} "
+                    f"do not fit the {local} devices of one host — a "
+                    "tensor/expert-parallel group would cross DCN.  Move "
+                    "parallelism to data/pipe, or pass "
+                    "allow_dcn_inner=True if cross-host inner collectives "
+                    "are intended")
+    else:
+        devices = list(devices)
     n = len(devices)
     fixed = mp * pp * sep * sharding * ep
     if fixed <= 0:

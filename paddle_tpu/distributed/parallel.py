@@ -23,7 +23,8 @@ from ..nn.layer_base import Layer
 from . import env as _env
 from .mesh import get_mesh
 
-__all__ = ["DataParallel", "spawn", "launch", "RESTART_STORM_EXIT_CODE"]
+__all__ = ["DataParallel", "spawn", "launch", "shard_batch",
+           "RESTART_STORM_EXIT_CODE", "GANG_RESTART_EXIT_CODE"]
 
 #: watch() exit code when the restart-storm window trips: the trainer
 #: crash-looped (storm_restarts restarts inside storm_window seconds), so
@@ -31,6 +32,17 @@ __all__ = ["DataParallel", "spawn", "launch", "RESTART_STORM_EXIT_CODE"]
 #: codes so schedulers can tell "gave up on a crash loop" from "trainer
 #: failed once and exhausted the budget".
 RESTART_STORM_EXIT_CODE = 77
+
+#: a trainer exits with this code to REQUEST a gang restart from its
+#: watchdog: its gang generation was abandoned (a peer reincarnated while
+#: a collective was in flight — Gang raises TransientDeviceError) and
+#: only a relaunch-and-rejoin re-forms the group.  Like a peer-loss gang
+#: restart this consumes no failure budget: the peer's death is not this
+#: trainer's fault.  It exists because a SIGKILLed host can relaunch
+#: FASTER than the peer-heartbeat timeout — no watchdog ever sees a stale
+#: beat, yet the old generation is dead; the blocked survivors must break
+#: the livelock themselves (see Gang._check_reincarnation).
+GANG_RESTART_EXIT_CODE = 76
 
 
 class DataParallel(Layer):
@@ -70,6 +82,36 @@ class DataParallel(Layer):
         return self._layers.set_state_dict(*a, **k)
 
 
+def shard_batch(x, mesh=None, axes=None):
+    """Assemble a *global* batch array from this host's local shard.
+
+    Each host's ``DataLoader`` (with a ``DistributedBatchSampler`` ranked
+    by ``process_index``) loads only its slice; this places that slice on
+    the local devices and stitches the global sharded array via
+    ``jax.make_array_from_process_local_data`` — no host ever
+    materializes (or transfers) the full batch.  Single-process: a plain
+    ``device_put`` with the same sharding, so step functions are
+    identical on a laptop and a pod.
+
+    ``axes`` defaults to :func:`mesh.data_axes` (data + ZeRO sharding)
+    over the leading batch dimension.
+    """
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .mesh import data_axes
+
+    mesh = mesh or get_mesh()
+    if axes is None:
+        axes = data_axes(mesh)
+    x = np.asarray(x)
+    spec = P(tuple(axes)) if x.ndim else P()
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_process_local_data(sharding, x)
+
+
 def spawn(func, args=(), nprocs: Optional[int] = None, join: bool = True, **kwargs):
     """Parity: paddle.distributed.spawn.  On TPU the unit of spawning is a
     *host process driving all local chips* — inside one host there is nothing
@@ -102,32 +144,64 @@ def launch(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     usage = ("usage: python -m paddle_tpu.distributed.launch "
              "[--max-restarts=N] [--hang-timeout=SECONDS] "
-             "script.py [args...]")
+             "[--peer-timeout=SECONDS] [--storm-window=SECONDS] "
+             "[--storm-restarts=N] script.py [args...]")
     max_restarts = 0
     watched = False
     hang_timeout = None
+    peer_timeout = None
+    storm_window = None
+    storm_restarts = 5
+
+    def _flag_value(flag, argv):
+        return flag.split("=", 1)[1] if "=" in flag else argv.pop(0)
+
     while argv and argv[0].startswith("--"):
         flag = argv.pop(0)
         if flag == "--max-restarts" or flag.startswith("--max-restarts="):
             watched = True
             try:
-                value = (flag.split("=", 1)[1] if "=" in flag
-                         else argv.pop(0))
-                max_restarts = int(value)
+                max_restarts = int(_flag_value(flag, argv))
             except (IndexError, ValueError):
                 print(f"--max-restarts needs an integer value\n{usage}")
                 return 2
         elif flag == "--hang-timeout" or flag.startswith("--hang-timeout="):
             watched = True
             try:
-                value = (flag.split("=", 1)[1] if "=" in flag
-                         else argv.pop(0))
-                hang_timeout = float(value)
+                hang_timeout = float(_flag_value(flag, argv))
                 if hang_timeout <= 0:
                     raise ValueError
             except (IndexError, ValueError):
                 print(f"--hang-timeout needs a positive number of "
                       f"seconds\n{usage}")
+                return 2
+        elif flag == "--peer-timeout" or flag.startswith("--peer-timeout="):
+            watched = True
+            try:
+                peer_timeout = float(_flag_value(flag, argv))
+                if peer_timeout <= 0:
+                    raise ValueError
+            except (IndexError, ValueError):
+                print(f"--peer-timeout needs a positive number of "
+                      f"seconds\n{usage}")
+                return 2
+        elif flag == "--storm-window" or flag.startswith("--storm-window="):
+            try:
+                storm_window = float(_flag_value(flag, argv))
+                if storm_window <= 0:
+                    raise ValueError
+            except (IndexError, ValueError):
+                print(f"--storm-window needs a positive number of "
+                      f"seconds\n{usage}")
+                return 2
+        elif flag == "--storm-restarts" or flag.startswith(
+                "--storm-restarts="):
+            try:
+                storm_restarts = int(_flag_value(flag, argv))
+                if storm_restarts < 1:
+                    raise ValueError
+            except (IndexError, ValueError):
+                print(f"--storm-restarts needs an integer >= 1\n{usage}")
                 return 2
         else:
             print(f"unknown launch flag {flag}\n{usage}")
@@ -139,13 +213,87 @@ def launch(argv=None):
     if watched:
         # child re-enters launch in-process mode so init_parallel_env runs
         # inside each (re)started trainer, exactly like the unwatched path
-        return watch([sys.executable, "-m", "paddle_tpu.distributed.launch",
-                      script] + rest, max_restarts=max_restarts,
-                     hang_timeout=hang_timeout)
+        return _watch_host(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             script] + rest, max_restarts=max_restarts,
+            hang_timeout=hang_timeout, peer_timeout=peer_timeout,
+            storm_window=storm_window, storm_restarts=storm_restarts)
     sys.argv = [script] + rest
     _env.init_parallel_env()
     runpy.run_path(script, run_name="__main__")
     return 0
+
+
+def _watch_host(cmd, max_restarts: int, hang_timeout, peer_timeout,
+                storm_window, storm_restarts) -> int:
+    """Arm :func:`watch` with the gang wiring the environment describes.
+
+    With ``PADDLE_TPU_GANG_DIR`` + a multi-rank ``PADDLE_TRAINERS_NUM``
+    this watchdog becomes a *gang member*: the child's heartbeat file
+    moves into the shared gang directory (``beat.p<rank>`` — every peer
+    watchdog reads it) and a :class:`heartbeat.PeerHeartbeatMonitor`
+    feeds the gang-restore decision (``peer_timeout``, default
+    ``PADDLE_TPU_PEER_TIMEOUT_S`` or 10s).  On exit, the watchdog's gang
+    counters (``gang_restores``...) are appended to the per-rank metrics
+    JSONL so ``exporters.merge_jsonl`` collates them pod-wide.
+    """
+    from ..framework import monitor as _monitor
+    from .heartbeat import PeerHeartbeatMonitor, gang_beat_path
+
+    gang_dir = os.environ.get(_env.ENV_GANG_DIR)
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1)
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    peer_monitor = None
+    heartbeat_path = None
+    if gang_dir and world > 1:
+        if peer_timeout is None:
+            peer_timeout = float(
+                os.environ.get("PADDLE_TPU_PEER_TIMEOUT_S", "10") or 10)
+        heartbeat_path = gang_beat_path(gang_dir, rank)
+        peer_monitor = PeerHeartbeatMonitor(
+            gang_dir, world, rank, timeout=peer_timeout).start()
+        if hang_timeout is None:
+            # gang members always need the beat file written (peers read
+            # it); arm local hang detection too, generously
+            hang_timeout = max(30.0, 6 * peer_timeout)
+    for key in ("gang_restores", "trainer_restarts", "hung_trainers",
+                "restart_storms", "preemption_restarts"):
+        _monitor.reset_stat(key)
+    try:
+        rc = watch(cmd, max_restarts=max_restarts,
+                   hang_timeout=hang_timeout,
+                   storm_window=storm_window,
+                   storm_restarts=storm_restarts,
+                   peer_monitor=peer_monitor,
+                   heartbeat_path=heartbeat_path,
+                   gang_label=f"watch.p{rank}")
+    finally:
+        if peer_monitor is not None:
+            peer_monitor.stop()
+        metrics = os.environ.get("PADDLE_TPU_METRICS_JSONL")
+        if metrics:
+            try:
+                from ..observability.exporters import process_jsonl_path
+                import json
+                import time as _time
+
+                path = process_jsonl_path(metrics, rank)
+                with open(path, "a") as f:
+                    f.write(json.dumps({
+                        "ts": _time.time(), "process_index": rank,
+                        "kind": "gang_watch",
+                        "gang_restores":
+                            _monitor.get_stat("gang_restores"),
+                        "trainer_restarts":
+                            _monitor.get_stat("trainer_restarts"),
+                        "hung_trainers":
+                            _monitor.get_stat("hung_trainers"),
+                        "restart_storms":
+                            _monitor.get_stat("restart_storms"),
+                    }) + "\n")
+            except Exception:  # noqa: BLE001 — metrics are a side channel
+                pass
+    return rc
 
 
 def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
@@ -153,7 +301,8 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
           startup_grace: Optional[float] = None,
           backoff_cap: float = 60.0,
           storm_window: Optional[float] = None, storm_restarts: int = 5,
-          peer_monitor=None) -> int:
+          peer_monitor=None, heartbeat_path: Optional[str] = None,
+          gang_label: str = "watch") -> int:
     """Run ``cmd`` as a watched subprocess; restart on non-zero exit up to
     ``max_restarts`` times (reference: launch_utils.py watch_local_trainers /
     terminate_local_procs).  Returns the final exit code.  SIGTERM/SIGINT
@@ -184,13 +333,25 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
     ``storm_window`` seconds → give up with
     :data:`RESTART_STORM_EXIT_CODE` even if the budget has room.
 
-    ``peer_monitor`` (a started ``heartbeat.HeartBeatMonitor`` fed by the
-    gang's beat transport) arms the gang-restore decision: when a peer
-    goes lost (``lost_workers()`` non-empty) this watchdog kills its OWN
-    healthy child and restarts it — a rank whose peer died is wedged in a
-    collective it can never finish, and only a gang restart re-forms the
-    group.  Gang restarts don't consume the failure budget (a peer's
-    death is not this trainer's fault)."""
+    ``peer_monitor`` (a started ``heartbeat.HeartBeatMonitor`` /
+    ``PeerHeartbeatMonitor`` fed by the gang's beat transport) arms the
+    gang-restore decision: when a peer goes lost (``lost_workers()``
+    non-empty) this watchdog kills its OWN healthy child and restarts it
+    — a rank whose peer died is wedged in a collective it can never
+    finish, and only a gang restart re-forms the group.  Gang restarts
+    don't consume the failure budget (a peer's death is not this
+    trainer's fault); after each one the monitor is re-armed
+    (``rearm()``) so the whole gang's relaunch window isn't instantly
+    re-flagged as another loss (which would hot-loop into the storm
+    breaker).  Each gang restart publishes a ``("gang", gang_label)``
+    trace snapshot (``gang_restores``, ``post_restore_lost``, the lost
+    ranks) — the input to analysis rule F803.
+
+    ``heartbeat_path`` pins the child's beat file to a fixed location
+    (the shared gang directory) instead of a private tempdir.  The file
+    is then shared state: it is NOT reset between attempts, and the
+    watchdog never stamps it — only the trainer's own beats may make
+    this rank look alive to its peers."""
     import collections
     import os as _os
     import signal
@@ -216,6 +377,9 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
     restart_times = collections.deque(maxlen=max(storm_restarts, 1))
     child = None
     hb_dir = None
+    gang_restores_n = 0
+    post_restore_lost_n = 0
+    prev_gang_lost: set = set()
 
     def _storm_tripped() -> bool:
         """Record one restart; True when the storm window just filled."""
@@ -227,6 +391,29 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
 
     def _peers_lost():
         return peer_monitor.lost_workers() if peer_monitor is not None else ()
+
+    def _publish_gang(lost, reformed: bool) -> None:
+        from ..framework import trace_events
+
+        if not trace_events.active():
+            return
+        trace_events.notify(("gang", gang_label), {
+            "gang_restores": gang_restores_n,
+            "post_restore_lost": post_restore_lost_n,
+            "lost": tuple(lost), "reformed": int(reformed),
+        })
+
+    def _note_gang_restart(lost):
+        # a peer that is STILL lost after a completed gang restore never
+        # came back — that is a stuck-gang signal (F803), not churn
+        nonlocal gang_restores_n, post_restore_lost_n, prev_gang_lost
+        gang_restores_n += 1
+        again = prev_gang_lost & set(lost)
+        if again:
+            post_restore_lost_n += len(again)
+        prev_gang_lost = set(lost)
+        _monitor.stat_add("gang_restores")
+        _publish_gang(lost, reformed=False)
 
     def _teardown(signum, frame):
         if child is not None and child.poll() is None:
@@ -244,7 +431,16 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
             vlog(1, "watchdog: starting %s (attempt %d)", cmd, attempts + 1)
             hb = None
             env = None
-            if hang_timeout is not None:
+            if heartbeat_path is not None:
+                # gang beat file: shared state read by every peer's
+                # watchdog.  Never reset between attempts, and adopted
+                # without stamping (touch only on first creation) — a
+                # watchdog stamp would advertise a trainer that is still
+                # relaunching as alive
+                hb = FileHeartbeat(heartbeat_path,
+                                   touch=not _os.path.exists(heartbeat_path))
+                env = dict(_os.environ, **{ENV_FILE: heartbeat_path})
+            elif hang_timeout is not None:
                 if hb_dir is None:
                     hb_dir = tempfile.mkdtemp(prefix="pt_hb_")
                 hb_path = _os.path.join(hb_dir, "beat")
@@ -254,6 +450,8 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
                     pass
                 hb = FileHeartbeat(hb_path)  # creates + stamps t0
                 env = dict(_os.environ, **{ENV_FILE: hb_path})
+            if hb is not None and hang_timeout is None:
+                hb = None  # beat file for peers only; no local hang watch
             child = subprocess.Popen(cmd, env=env)
             gang_restart = False
             if hb is None and peer_monitor is None:
@@ -268,7 +466,7 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
                     if lost:
                         vlog(0, "watchdog: peer worker(s) %s lost — gang "
                                 "restart of the local trainer", lost)
-                        _monitor.stat_add("gang_restores")
+                        _note_gang_restart(lost)
                         gang_restart = True
                         child.kill()
                         rc = child.wait()
@@ -289,7 +487,7 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
                     if lost:
                         vlog(0, "watchdog: peer worker(s) %s lost — gang "
                                 "restart of the local trainer", lost)
-                        _monitor.stat_add("gang_restores")
+                        _note_gang_restart(lost)
                         gang_restart = True
                         child.kill()
                         rc = child.wait()
@@ -329,7 +527,29 @@ def watch(cmd, max_restarts: int = 0, _sleep: float = 1.0,
                 return RESTART_STORM_EXIT_CODE
             if gang_restart:
                 # a peer died: this child was healthy, the restart exists
-                # only to re-form the gang — no budget, base delay
+                # only to re-form the gang — no budget, base delay.  Re-arm
+                # the monitor so every peer gets a fresh grace window to
+                # relaunch and rejoin; without it the gang's own restart
+                # latency reads as another loss and hot-loops into the
+                # storm breaker.
+                if peer_monitor is not None and hasattr(peer_monitor,
+                                                        "rearm"):
+                    peer_monitor.rearm()
+                time.sleep(_sleep)
+                continue
+            if rc == GANG_RESTART_EXIT_CODE:
+                # the trainer ITSELF detected an abandoned gang generation
+                # (a peer reincarnated mid-collective — too fast for the
+                # peer heartbeat to ever look stale) and asked for a gang
+                # restart.  Same contract as the peer-loss path: no
+                # budget, counters, fresh monitor grace.
+                vlog(0, "watchdog: trainer requested a gang restart "
+                        "(rc=%d: gang generation abandoned) — rejoining",
+                     rc)
+                _note_gang_restart(())
+                if peer_monitor is not None and hasattr(peer_monitor,
+                                                        "rearm"):
+                    peer_monitor.rearm()
                 time.sleep(_sleep)
                 continue
             from ..resilience.preemption import PREEMPTION_EXIT_CODE

@@ -296,6 +296,17 @@ class AutoCheckpoint:
         dirs = self.committed_dirs()
         return dirs[0] if dirs else None
 
+    def latest_counter(self) -> int:
+        """Counter of the newest committed checkpoint (0 when none) —
+        the value each host contributes to the gang's ``min_int``
+        resume negotiation (see :meth:`resume` ``at_most``)."""
+        for d in self.committed_dirs():
+            try:
+                return int(os.path.basename(d)[len(_PREFIX):])
+            except ValueError:
+                continue
+        return 0
+
     def _load_verified(self, d: str) -> Dict[str, Any]:
         """Load + integrity-check one checkpoint dir.  Raises a typed
         error (InvalidArgumentError / NotFoundError) on any corruption:
@@ -329,13 +340,37 @@ class AutoCheckpoint:
         _monitor.stat_add("checkpoints_quarantined")
         vlog(0, "checkpoint: quarantined corrupt %s -> %s", d, target)
 
-    def resume(self) -> Optional[Dict[str, Any]]:
+    def resume(self, at_most: Optional[int] = None) -> Optional[Dict[str, Any]]:
         """Load the newest HEALTHY committed checkpoint into the model;
         returns its meta ({'epoch', 'global_step', ...}) or None on a
         fresh run.  A checkpoint that fails integrity verification
         (digest mismatch, unreadable payload) is quarantined and the walk
         falls back to the next older one — corruption of the newest save
-        costs ``save_steps`` of progress, never the job."""
+        costs ``save_steps`` of progress, never the job.
+
+        ``at_most`` bounds the resume point by checkpoint *counter* — the
+        gang-consistent restore primitive.  Checkpoint commits are per
+        host, so after a pod failure hosts may disagree on the newest
+        committed counter; every host gathers its local newest, the gang
+        takes the minimum (``Gang.min_int``), and each host resumes
+        ``at_most=`` that agreed counter.  Committed checkpoints NEWER
+        than the bound are deleted (``checkpoints_rewound``): they
+        represent progress the gang as a whole never agreed on, and a
+        later save would collide with their directories."""
+        if at_most is not None:
+            for d in self.committed_dirs():
+                try:
+                    cnt = int(os.path.basename(d)[len(_PREFIX):])
+                except ValueError:
+                    continue
+                if cnt > at_most:
+                    from ..framework import monitor as _monitor
+                    from ..framework.logging import vlog
+
+                    shutil.rmtree(d, ignore_errors=True)
+                    _monitor.stat_add("checkpoints_rewound")
+                    vlog(0, "checkpoint: rewound %s past the gang-agreed "
+                            "counter %d", d, at_most)
         loaded = None
         for d in self.committed_dirs():
             name = os.path.basename(d)

@@ -245,10 +245,15 @@ class DistributedBatchSampler(BatchSampler):
         self.shuffle = shuffle
         self.drop_last = drop_last
         if num_replicas is None or rank is None:
-            import jax
+            # gang-aware: distributed.env covers both the jax transport
+            # (process_count from the coordination service) and the file
+            # gang transport, where jax sees only the local host and the
+            # launch env carries rank/world
+            from ..distributed import env as _denv
 
-            num_replicas = num_replicas if num_replicas is not None else jax.process_count()
-            rank = rank if rank is not None else jax.process_index()
+            num_replicas = (num_replicas if num_replicas is not None
+                            else _denv.process_count())
+            rank = rank if rank is not None else _denv.process_index()
         if rank >= num_replicas or rank < 0:
             raise InvalidArgumentError(f"rank {rank} out of range for {num_replicas} replicas")
         self.nranks = self.num_replicas = num_replicas

@@ -116,12 +116,20 @@ class PrometheusExporter:
 
 
 def _process_index() -> int:
+    # gang-aware: under the file gang transport (CPU multi-process pods)
+    # jax itself only sees the local host, so the launch env carries the
+    # rank — distributed.env.process_index resolves both cases
     try:
-        import jax
+        from ..distributed.env import process_index
 
-        return int(jax.process_index())
+        return int(process_index())
     except Exception:
-        return 0
+        try:
+            import jax
+
+            return int(jax.process_index())
+        except Exception:
+            return 0
 
 
 def process_jsonl_path(base: str, process_index: Optional[int] = None) -> str:

@@ -13,6 +13,12 @@ Installed sites (grep for ``fault_point(`` to audit):
 ``checkpoint.write``    async checkpoint worker write (incubate/checkpoint)
 ``executor.dispatch``   compiled-runner dispatch in ``Executor.run``
 ``collective.call``     every user-facing collective (distributed)
+``distributed.init``    coordinator join in ``init_parallel_env`` — each
+                        retried attempt passes through (distributed/env)
+``gang.join``           gang membership handshake (distributed/gang)
+``gang.collective``     host-lane gang collectives (distributed/gang) —
+                        a ``latency_ms`` rule here wedges one rank and
+                        exercises the collective-timeout watchdog
 ``serving.runner``      micro-batcher batch execution (serving/batcher)
 ``router.dispatch``     replica pick → engine submit (serving/router)
 =====================  ====================================================

@@ -70,6 +70,7 @@ from .generation import GenerationEngine, KVHandoff
 from .metrics import ServingMetrics
 from .paging import PagePool
 from .pool import DisaggServer, ReplicaPool
+from .remote import EngineServer, RemoteEngineProxy
 from .replica import Replica
 from .router import Router
 from .scenarios import (Scenario, ScenarioRequest, diurnal, flash_crowd,
@@ -89,6 +90,8 @@ __all__ = [
     "PagePool",
     "Replica",
     "Router",
+    "EngineServer",
+    "RemoteEngineProxy",
     "ReplicaPool",
     "DisaggServer",
     "Scenario",

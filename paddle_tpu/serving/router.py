@@ -77,7 +77,7 @@ _ROUTER_COUNTERS = (
     "probes", "probe_failures", "readmissions", "drains", "drain_timeouts",
     "weight_swaps", "scale_up_signals", "scale_down_signals",
     "scale_steady_signals", "scale_hook_errors",
-    "replicas_added", "replicas_removed",
+    "replicas_added", "replicas_removed", "peer_evictions",
 )
 
 #: live routers, for the profiler "Serving router" summary section
@@ -197,6 +197,9 @@ class Router:
             self._health_thread = threading.Thread(
                 target=self._health_loop, name=f"{name}-health", daemon=True)
             self._health_thread.start()
+
+        # -- gang peer liveness (bind_peer_liveness) --
+        self._peer_liveness = None
 
         # -- hedging --
         self._hedge = bool(hedge)
@@ -450,6 +453,33 @@ class Router:
             rep.count("probe_failures")
             return False
 
+    def bind_peer_liveness(self, monitor, replica_to_process) -> None:
+        """Wire a gang peer monitor into replica health: a replica whose
+        owning host process goes lost (``monitor.lost_workers()``) is
+        marked unhealthy on the next sweep — milliseconds after the
+        heartbeat verdict — instead of waiting for its probe/request
+        timeouts to burn down.  ``replica_to_process`` maps replica index
+        → ``process_index`` of the host that owns that engine (replicas
+        on THIS host need no entry).  Recovery stays probe-driven: when
+        the host returns and its engine answers probes again, the normal
+        half-open path readmits the replica."""
+        self._peer_liveness = (monitor, dict(replica_to_process))
+
+    def _peer_sweep(self) -> None:
+        if self._peer_liveness is None:
+            return
+        monitor, mapping = self._peer_liveness
+        try:
+            lost = set(monitor.lost_workers())
+        except Exception:  # noqa: BLE001 — liveness is advisory
+            return
+        if not lost:
+            return
+        for rep in list(self._replicas):
+            if mapping.get(rep.index) in lost and rep.state == HEALTHY:
+                self.metrics.incr("peer_evictions")
+                self._mark_unhealthy(rep)
+
     def probe_now(self) -> None:
         """One synchronous health sweep (the background thread runs this
         every ``probe_interval_s``): active-probe healthy replicas, and
@@ -457,6 +487,7 @@ class Router:
         from ..distributed import heartbeat
         heartbeat.maybe_beat()  # serving liveness rides the same transport
         with self._probe_gate:
+            self._peer_sweep()
             self._probe_sweep()
 
     def _probe_sweep(self) -> None:

@@ -1,0 +1,318 @@
+"""Host-lane gang collectives + gang-scheduled elastic restart decisions.
+
+Reference capability: fleet elastic (python/paddle/distributed/fleet/elastic)
+— pod membership handshakes, dead-peer detection, gang-wide restart.  Here
+the control lane is :mod:`paddle_tpu.distributed.gang` (file/KV transports,
+generation-fenced collectives) and the restart decision lives in
+``watch(peer_monitor=...)``.  Real multi-process behavior is exercised by
+``tools/pod_smoke.py``; these tests pin the unit-level contracts with
+threads and fake monitors.
+"""
+import os
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.gang import (FileTransport, Gang, default_gang,
+                                         mean_trees, set_gang)
+from paddle_tpu.distributed.parallel import (GANG_RESTART_EXIT_CODE,
+                                             RESTART_STORM_EXIT_CODE, watch)
+from paddle_tpu.framework import monitor
+from paddle_tpu.framework.errors import (InvalidArgumentError,
+                                         TransientDeviceError)
+
+
+def _run_gang(world, fn, transport, timeout=20.0):
+    """Run ``fn(gang)`` on one thread per rank; returns per-rank results.
+
+    Any rank raising re-raises in the caller (first error wins)."""
+    results = [None] * world
+    errors = []
+
+    def _one(rank):
+        g = Gang(rank, world, transport, name="t", default_timeout=timeout)
+        try:
+            results[rank] = fn(g)
+        except BaseException as e:  # noqa: BLE001 — surfaced to caller
+            errors.append((rank, e))
+
+    threads = [threading.Thread(target=_one, args=(r,), daemon=True)
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout + 30)
+    if errors:
+        raise errors[0][1]
+    return results
+
+
+class TestFileTransport:
+    def test_put_get_delete_roundtrip(self, tmp_path):
+        tr = FileTransport(str(tmp_path))
+        assert tr.try_get("k") is None
+        tr.put("k", b"v1")
+        assert tr.try_get("k") == b"v1"
+        tr.put("k", b"v2")  # atomic overwrite
+        assert tr.try_get("k") == b"v2"
+        tr.delete("k")
+        assert tr.try_get("k") is None
+        tr.delete("k")  # idempotent
+
+    def test_keys_with_separators_are_flattened(self, tmp_path):
+        tr = FileTransport(str(tmp_path))
+        tr.put("a/b/c", b"x")
+        assert tr.try_get("a/b/c") == b"x"
+        # no nested directories created — keys map to flat files
+        assert all(not p.is_dir() for p in tmp_path.iterdir())
+
+
+class TestGangCollectives:
+    def test_solo_gang_degenerates_to_local(self):
+        g = Gang(0, 1)
+        assert g.join() == "solo"
+        assert g.all_gather_obj({"a": 1}) == [{"a": 1}]
+        assert g.min_int(7) == 7
+        g.barrier()  # no-op, must not hang
+
+    def test_join_converges_on_shared_generation(self, tmp_path):
+        tr = FileTransport(str(tmp_path))
+        gens = _run_gang(3, lambda g: g.join(), tr)
+        assert len(set(gens)) == 1 and gens[0] not in (None, "solo")
+
+    def test_all_gather_is_rank_ordered(self, tmp_path):
+        tr = FileTransport(str(tmp_path))
+
+        def fn(g):
+            g.join()
+            return g.all_gather_obj({"rank": g.rank, "x": g.rank * 10})
+
+        out = _run_gang(3, fn, tr)
+        # every rank sees the identical rank-ordered list
+        assert out[0] == out[1] == out[2]
+        assert [d["rank"] for d in out[0]] == [0, 1, 2]
+
+    def test_min_int_and_mean_tree(self, tmp_path):
+        tr = FileTransport(str(tmp_path))
+
+        def fn(g):
+            g.join()
+            agreed = g.min_int([5, 3, 9][g.rank])
+            tree = {"w": np.full((2,), float(g.rank), np.float32)}
+            mean = g.all_reduce_mean_tree(tree)
+            return agreed, mean
+
+        out = _run_gang(3, fn, tr)
+        assert all(agreed == 3 for agreed, _ in out)
+        for _, mean in out:
+            np.testing.assert_array_equal(mean["w"],
+                                          np.full((2,), 1.0, np.float32))
+
+    def test_mean_trees_matches_rank_order_fold(self):
+        trees = [{"w": np.float32(v)} for v in (0.1, 0.2, 0.7)]
+        expected = (np.float32(0.1) + np.float32(0.2) + np.float32(0.7)) \
+            / np.float32(3)
+        got = mean_trees(trees)["w"]
+        assert got == expected and got.dtype == np.float32
+
+    def test_dead_peer_trips_watchdog_not_hang(self, tmp_path):
+        tr = FileTransport(str(tmp_path))
+        gens = _run_gang(2, lambda g: g.join(), tr)
+        assert gens[0] == gens[1]
+        # rank 0 alone enters a collective; rank 1 never contributes
+        g0 = Gang(0, 2, tr, default_timeout=1.0)
+        g0.join  # noqa: B018 — rejoining would stall; reuse files instead
+        g0.generation = gens[0]
+        g0._nonces = {}  # not testing fencing here
+        t0 = time.monotonic()
+        with pytest.raises(TransientDeviceError, match="rank"):
+            g0.all_gather_obj({"x": 1}, timeout=1.0)
+        assert time.monotonic() - t0 < 10
+
+    def test_validation(self, tmp_path):
+        with pytest.raises(InvalidArgumentError, match="world"):
+            Gang(0, 0)
+        with pytest.raises(InvalidArgumentError, match="rank"):
+            Gang(5, 2, FileTransport(str(tmp_path)))
+        with pytest.raises(InvalidArgumentError, match="transport"):
+            Gang(0, 2)
+
+
+class TestReincarnationFencing:
+    """A peer that restarts mid-collective abandons the generation: the
+    survivor must get TransientDeviceError (→ exit 76 under a watchdog),
+    not block forever in a collective the dead incarnation can never
+    finish — the livelock where a host relaunches faster than the peer
+    heartbeat timeout."""
+
+    def test_changed_peer_nonce_aborts_blocked_collective(self, tmp_path):
+        tr = FileTransport(str(tmp_path))
+        gens = _run_gang(2, lambda g: g.join(), tr)
+        g0 = Gang(0, 2, tr, default_timeout=30.0)
+        g0.generation = gens[0]
+        g0._nonces = {0: tr.try_get("join.p0").decode(),
+                      1: tr.try_get("join.p1").decode()}
+
+        def _restart_peer():
+            time.sleep(0.3)
+            tr.put("join.p1", os.urandom(8).hex().encode())
+
+        before = monitor.get_stat("gang_reincarnations")
+        threading.Thread(target=_restart_peer, daemon=True).start()
+        t0 = time.monotonic()
+        with pytest.raises(TransientDeviceError, match="restarted"):
+            g0.all_gather_obj({"x": 1}, timeout=30.0)
+        # aborted by fencing (~0.3s + poll), not by the 30s timeout
+        assert time.monotonic() - t0 < 10
+        assert monitor.get_stat("gang_reincarnations") == before + 1
+
+    def test_unchanged_nonces_do_not_abort(self, tmp_path):
+        tr = FileTransport(str(tmp_path))
+
+        def fn(g):
+            g.join()
+            if g.rank == 1:
+                time.sleep(0.5)  # long enough for several fencing polls
+            return g.all_gather_obj(g.rank)
+
+        out = _run_gang(2, fn, tr)
+        assert out[0] == out[1] == [0, 1]
+
+    def test_default_gang_uses_gang_dir(self, tmp_path, monkeypatch):
+        import paddle_tpu.distributed.gang as gang_mod
+
+        monkeypatch.setenv("PADDLE_TPU_GANG_DIR", str(tmp_path))
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        prev = set_gang(None)
+        try:
+            g = default_gang("unit")
+            assert g.world == 1 and g.join() == "solo"
+        finally:
+            set_gang(prev)
+
+
+class _FakeMonitor:
+    """Scripted peer monitor: pops one lost_workers() answer per call,
+    repeating the last; records rearm() calls."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.rearms = 0
+
+    def lost_workers(self):
+        if len(self.script) > 1:
+            return self.script.pop(0)
+        return self.script[0]
+
+    def rearm(self, grace=None):
+        self.rearms += 1
+
+
+class TestWatchGangDecisions:
+    def _exit0_after_marker(self, tmp_path):
+        """Command that sleeps forever on first run, exits 0 once the
+        marker exists — one restart turns it into a success."""
+        marker = tmp_path / "second"
+        script = tmp_path / "t.py"
+        script.write_text(textwrap.dedent(f"""
+            import os, sys, time
+            if os.path.exists({str(marker)!r}):
+                sys.exit(0)
+            open({str(marker)!r}, "w").close()
+            time.sleep(3600)
+        """))
+        return [sys.executable, str(script)]
+
+    def test_lost_peer_kills_and_gang_restarts(self, tmp_path):
+        # peer reads lost until the watchdog re-arms it after the gang
+        # restart, and only once the first child has written its marker —
+        # otherwise the kill can land before the marker exists and the
+        # second attempt hangs instead of exiting 0
+        marker = tmp_path / "second"
+
+        class _LostUntilRearm(_FakeMonitor):
+            def lost_workers(self):
+                if self.rearms == 0 and marker.exists():
+                    return [1]
+                return []
+
+        mon = _LostUntilRearm([[]])
+        before = monitor.get_stat("gang_restores")
+        t0 = time.monotonic()
+        rc = watch(self._exit0_after_marker(tmp_path), max_restarts=0,
+                   _sleep=0.05, peer_monitor=mon, gang_label="unit.lost")
+        assert rc == 0  # gang restart did NOT consume the (zero) budget
+        assert time.monotonic() - t0 < 30
+        assert monitor.get_stat("gang_restores") == before + 1
+        assert mon.rearms >= 1  # relaunch window must not re-flag the loss
+
+    def test_healthy_peers_no_restart(self, tmp_path):
+        mon = _FakeMonitor([[]])
+        before = monitor.get_stat("gang_restores")
+        script = tmp_path / "ok.py"
+        script.write_text("import sys; sys.exit(0)")
+        rc = watch([sys.executable, str(script)], max_restarts=0,
+                   _sleep=0.05, peer_monitor=mon, gang_label="unit.ok")
+        assert rc == 0
+        assert monitor.get_stat("gang_restores") == before
+
+    def test_gang_restart_storm_trips_breaker(self, tmp_path):
+        mon = _FakeMonitor([[2]])  # peer permanently lost
+        rc = watch([sys.executable, "-c", "import time; time.sleep(3600)"],
+                   max_restarts=0, _sleep=0.05, storm_window=30.0,
+                   storm_restarts=3, peer_monitor=mon,
+                   gang_label="unit.storm")
+        assert rc == RESTART_STORM_EXIT_CODE
+
+    def test_child_exit_76_is_a_free_gang_restart(self, tmp_path):
+        # trainer detected peer reincarnation itself (fencing) and exited
+        # GANG_RESTART_EXIT_CODE: restart without burning the budget
+        marker = tmp_path / "second"
+        script = tmp_path / "t.py"
+        script.write_text(textwrap.dedent(f"""
+            import os, sys
+            if os.path.exists({str(marker)!r}):
+                sys.exit(0)
+            open({str(marker)!r}, "w").close()
+            sys.exit({GANG_RESTART_EXIT_CODE})
+        """))
+        mon = _FakeMonitor([[]])
+        before = monitor.get_stat("gang_restores")
+        rc = watch([sys.executable, str(script)], max_restarts=0,
+                   _sleep=0.05, peer_monitor=mon, gang_label="unit.rc76")
+        assert rc == 0
+        assert monitor.get_stat("gang_restores") == before + 1
+        assert mon.rearms >= 1
+
+
+class TestF803Retrace:
+    def test_restore_storm_fires_f803(self, tmp_path):
+        from paddle_tpu.analysis import RetraceMonitor
+
+        with RetraceMonitor() as mon:
+            rc = watch([sys.executable, "-c",
+                        "import time; time.sleep(3600)"],
+                       max_restarts=0, _sleep=0.05, storm_window=30.0,
+                       storm_restarts=3, peer_monitor=_FakeMonitor([[1]]),
+                       gang_label="f803.storm")
+        assert rc == RESTART_STORM_EXIT_CODE
+        f803 = [d for d in mon.diagnostics() if d.rule == "F803"]
+        assert f803 and any("f803.storm" in d.message for d in f803)
+
+    def test_healthy_watch_is_silent(self, tmp_path):
+        from paddle_tpu.analysis import RetraceMonitor
+
+        script = tmp_path / "ok.py"
+        script.write_text("import sys; sys.exit(0)")
+        with RetraceMonitor() as mon:
+            rc = watch([sys.executable, str(script)], max_restarts=0,
+                       peer_monitor=_FakeMonitor([[]]),
+                       gang_label="f803.ok")
+        assert rc == 0
+        assert not [d for d in mon.diagnostics()
+                    if d.rule == "F803" and "f803.ok" in d.message]

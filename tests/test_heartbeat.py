@@ -220,3 +220,147 @@ class TestWatchHangDetection:
         shutil.rmtree(d)
         hb.beat()  # must not raise; recreates the directory
         assert hb.age() < 5
+
+
+class TestUpdateStamp:
+    """Skew-tolerant beats: a remote stamp is opaque — compared only for
+    equality against the same worker's prior stamp, timed on the LOCAL
+    monotonic clock.  Cross-host clock skew cannot create or hide beats."""
+
+    def _mon(self, **kw):
+        kw.setdefault("workers", 2)
+        kw.setdefault("timeout", 0.3)
+        kw.setdefault("interval", 0.05)
+        kw.setdefault("grace", 0.3)
+        return HeartBeatMonitor(**kw)
+
+    def test_changed_stamp_counts_as_beat(self):
+        mon = self._mon()
+        mon.start()
+        try:
+            t0 = time.monotonic()
+            seq = 0
+            while time.monotonic() - t0 < 0.8:
+                mon.update(0)
+                mon.update_stamp(1, (123456.0, seq))  # size changes
+                seq += 1
+                time.sleep(0.05)
+            assert mon.lost_workers() == []
+        finally:
+            mon.stop()
+
+    def test_frozen_stamp_goes_lost(self):
+        # the file still EXISTS with a perfectly plausible mtime — but the
+        # stamp never changes, so the worker is dead
+        mon = self._mon()
+        mon.start()
+        try:
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 0.8:
+                mon.update(0)
+                mon.update_stamp(1, (987654.0, 64))  # same stamp forever
+                time.sleep(0.05)
+            assert mon.lost_workers() == [1]
+        finally:
+            mon.stop()
+
+    def test_skewed_clocks_are_irrelevant(self):
+        # remote mtimes jump far into the past and the future; only the
+        # CHANGE matters, so the worker stays live either way
+        mon = self._mon()
+        mon.start()
+        try:
+            stamps = [(-1e9, 1), (4e9, 2), (0.0, 3), (-5.0, 4),
+                      (4e9, 5), (1.0, 6), (2.0, 7), (3.0, 8),
+                      (9e9, 9), (-9e9, 10), (1.5, 11), (2.5, 12)]
+            for s in stamps:
+                mon.update(0)
+                mon.update_stamp(1, s)
+                time.sleep(0.05)
+            assert mon.lost_workers() == []
+        finally:
+            mon.stop()
+
+    def test_new_stamp_unlatches_lost(self):
+        mon = self._mon()
+        mon.start()
+        try:
+            mon.update_stamp(1, (1.0, 1))
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 0.8:
+                mon.update(0)
+                time.sleep(0.05)
+            assert 1 in mon.lost_workers()
+            mon.update_stamp(1, (1.0, 2))  # host came back
+            assert 1 not in mon.lost_workers()
+        finally:
+            mon.stop()
+
+    def test_out_of_range_worker_rejected(self):
+        mon = self._mon()
+        with pytest.raises(Exception, match="worker_id"):
+            mon.update_stamp(5, (1.0, 1))
+
+
+class TestHeartbeatWriteFailures:
+    def test_unwritable_path_suppressed_but_counted(self, tmp_path):
+        from paddle_tpu.framework import monitor
+
+        blocker = tmp_path / "blocker"
+        blocker.write_text("not a directory")
+        # parent "directory" is a regular file: _write fails, the mkdir
+        # recovery fails too — beat() must swallow it and bump the stat
+        hb = FileHeartbeat(str(blocker / "beat"), touch=False)
+        before = monitor.get_stat("heartbeat_write_failures")
+        hb.beat()  # must not raise
+        assert monitor.get_stat("heartbeat_write_failures") == before + 1
+        hb.beat()
+        assert monitor.get_stat("heartbeat_write_failures") == before + 2
+
+
+class TestPeerHeartbeatMonitor:
+    def test_beating_peer_live_stalled_peer_lost(self, tmp_path):
+        from paddle_tpu.distributed.heartbeat import (PeerHeartbeatMonitor,
+                                                      gang_beat_path)
+
+        hb1 = FileHeartbeat(gang_beat_path(str(tmp_path), 1))
+        # rank 2 never writes a beat file at all
+        mon = PeerHeartbeatMonitor(str(tmp_path), world=3, self_rank=0,
+                                   timeout=0.4, interval=0.05, grace=0.4)
+        mon.start()
+        try:
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 1.2:
+                hb1.beat()
+                time.sleep(0.05)
+            assert mon.lost_workers() == [2]  # never self_rank
+            # now rank 1 stalls too
+            time.sleep(0.9)
+            assert mon.lost_workers() == [1, 2]
+            hb1.beat()  # rank 1 recovers
+            time.sleep(0.3)
+            assert mon.lost_workers() == [2]
+        finally:
+            mon.stop()
+
+    def test_rearm_clears_lost_and_reapplies_grace(self, tmp_path):
+        from paddle_tpu.distributed.heartbeat import PeerHeartbeatMonitor
+
+        mon = PeerHeartbeatMonitor(str(tmp_path), world=2, self_rank=0,
+                                   timeout=0.3, interval=0.05, grace=0.3)
+        mon.start()
+        try:
+            time.sleep(0.8)
+            assert mon.lost_workers() == [1]
+            mon.rearm(grace=5.0)  # gang relaunch window
+            assert mon.lost_workers() == []
+            time.sleep(0.5)  # inside the new grace: still not lost
+            assert mon.lost_workers() == []
+        finally:
+            mon.stop()
+
+    def test_self_rank_validated(self, tmp_path):
+        from paddle_tpu.distributed.heartbeat import PeerHeartbeatMonitor
+
+        with pytest.raises(Exception, match="self_rank"):
+            PeerHeartbeatMonitor(str(tmp_path), world=2, self_rank=2)

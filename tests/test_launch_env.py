@@ -245,3 +245,97 @@ for epoch, acp in train_epoch_range(4, m, {os.path.join(tmp_path, "ck")!r}):
         assert watch([sys.executable, script], max_restarts=0,
                      _sleep=0.01) == 5
         assert monitor.get_stat("trainer_restarts") == 0
+
+
+class TestValidateEnv:
+    """Typed launch-env validation: every inconsistency raises
+    InvalidArgumentError NAMING the offending variable, before it can
+    surface as an opaque coordination-service failure."""
+
+    @pytest.fixture(autouse=True)
+    def _scrub(self, clean_env):
+        for k in ("PADDLE_TPU_GANG_TRANSPORT", "PADDLE_TPU_GANG_DIR"):
+            clean_env.delenv(k, raising=False)
+        self.env = clean_env
+
+    def _raises(self, match):
+        from paddle_tpu.framework.errors import InvalidArgumentError
+        return pytest.raises(InvalidArgumentError, match=match)
+
+    def test_single_process_defaults(self):
+        assert penv.validate_env() == (None, 1, 0)
+
+    def test_non_integer_trainers_num_named(self):
+        self.env.setenv("PADDLE_TRAINERS_NUM", "two")
+        with self._raises("PADDLE_TRAINERS_NUM='two' is not an integer"):
+            penv.validate_env()
+
+    def test_non_integer_trainer_id_named(self):
+        self.env.setenv("PADDLE_TRAINER_ID", "1.5")
+        with self._raises("PADDLE_TRAINER_ID='1.5' is not an integer"):
+            penv.validate_env()
+
+    def test_zero_trainers_num_rejected(self):
+        self.env.setenv("PADDLE_TRAINERS_NUM", "0")
+        with self._raises("PADDLE_TRAINERS_NUM"):
+            penv.validate_env()
+
+    def test_rank_out_of_range(self):
+        self.env.setenv("PADDLE_TRAINERS_NUM", "2")
+        self.env.setenv("PADDLE_TRAINER_ID", "2")
+        self.env.setenv("COORDINATOR_ADDRESS", "h:1234")
+        with self._raises(r"PADDLE_TRAINER_ID=2 out of range \[0, 2\)"):
+            penv.validate_env()
+
+    def test_endpoint_count_mismatch_without_coordinator(self):
+        self.env.setenv("PADDLE_TRAINERS_NUM", "3")
+        self.env.setenv("PADDLE_TRAINER_ENDPOINTS", "a:1,b:2")
+        with self._raises("every rank needs exactly one endpoint"):
+            penv.validate_env()
+
+    def test_endpoint_count_informational_with_coordinator(self):
+        # with an explicit rendezvous address the endpoint list is
+        # informational — a short list must NOT fail the launch
+        self.env.setenv("PADDLE_TRAINERS_NUM", "3")
+        self.env.setenv("PADDLE_TRAINER_ENDPOINTS", "a:1,b:2")
+        self.env.setenv("COORDINATOR_ADDRESS", "a:1")
+        addr, world, pid = penv.validate_env()
+        assert (addr, world, pid) == ("a:1", 3, 0)
+
+    def test_duplicate_endpoints_rejected(self):
+        self.env.setenv("PADDLE_TRAINER_ENDPOINTS", "a:1,b:2,a:1")
+        with self._raises("duplicate endpoint"):
+            penv.validate_env()
+
+    def test_malformed_address_names_source_var(self):
+        self.env.setenv("COORDINATOR_ADDRESS", "no-port")
+        with self._raises("COORDINATOR_ADDRESS='no-port' is not host:port"):
+            penv.validate_env()
+        self.env.delenv("COORDINATOR_ADDRESS")
+        self.env.setenv("PADDLE_TRAINER_ENDPOINTS", "host:notaport")
+        with self._raises("PADDLE_TRAINER_ENDPOINTS.*not host:port"):
+            penv.validate_env()
+
+    def test_bad_gang_transport_rejected(self):
+        self.env.setenv("PADDLE_TPU_GANG_TRANSPORT", "tcp")
+        with self._raises("PADDLE_TPU_GANG_TRANSPORT.*auto\\|jax\\|file"):
+            penv.validate_env()
+
+    def test_multi_host_needs_rendezvous(self):
+        self.env.setenv("PADDLE_TRAINERS_NUM", "4")
+        with self._raises("needs a rendezvous point"):
+            penv.validate_env()
+
+    def test_file_transport_needs_gang_dir(self):
+        self.env.setenv("PADDLE_TRAINERS_NUM", "2")
+        self.env.setenv("PADDLE_TPU_GANG_TRANSPORT", "file")
+        with self._raises("PADDLE_TPU_GANG_DIR"):
+            penv.validate_env()
+
+    def test_file_transport_with_gang_dir_ok(self, tmp_path):
+        self.env.setenv("PADDLE_TRAINERS_NUM", "2")
+        self.env.setenv("PADDLE_TRAINER_ID", "1")
+        self.env.setenv("PADDLE_TPU_GANG_TRANSPORT", "file")
+        self.env.setenv("PADDLE_TPU_GANG_DIR", str(tmp_path))
+        addr, world, pid = penv.validate_env()
+        assert (world, pid) == (2, 1)

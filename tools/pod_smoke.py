@@ -1,0 +1,523 @@
+"""Pod-scale multi-host gate: real process gangs, gang restart, failover (CPU).
+
+One-command proof of the multi-host execution contracts, run on every gate
+pass with N >= 2 REAL processes launched through
+``python -m paddle_tpu.distributed.launch`` over the file gang transport
+(the CPU backend joins the jax.distributed coordinator but refuses
+cross-process XLA computations, so the host lane carries the pod
+semantics — see distributed/gang.py):
+
+1. **Sharded bit-identity** — a 2-process run training NSHARD data
+   shards (``DistributedBatchSampler`` slices, per-shard steps combined
+   with the gang's rank-ordered ``mean_trees`` reduction) must produce
+   final params BIT-IDENTICAL on every rank and BIT-IDENTICAL to a
+   single-process run folding the same shards locally.
+2. **SIGKILL → gang restore** — one host of a watched 2-process pod is
+   SIGKILLed mid-run; the survivor's watchdog must gang-restart its own
+   healthy trainer, the gang must re-form (new generation), negotiate
+   the min committed ``AutoCheckpoint`` counter, and finish with params
+   bit-identical to the uninterrupted run — with ``gang_restores >= 1``
+   and both ranks present in the merged per-process metrics JSONL.
+3. **Wedged collective** — with ``FLAGS_collective_timeout_s`` armed and
+   a latency fault wedging one rank at the ``gang.collective`` site,
+   every LIVE rank must raise ``TransientDeviceError`` within the
+   deadline instead of hanging the pod.
+4. **Router failover across a host kill** — a Router fronting engines
+   served from two OTHER processes (``serving.remote``), with
+   ``bind_peer_liveness`` wired to the gang heartbeat: SIGKILL one
+   engine host mid-traffic; every accepted request must still complete
+   (zero lost) and ``peer_evictions >= 1``.
+5. **F803** — an injected gang-restart loop must trip the restart-storm
+   breaker (exit 77) and fire analysis rule F803; a healthy watched run
+   stays silent.
+
+Prints one JSON line; exit 0 iff every gate holds.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+SELF = os.path.abspath(__file__)
+NSHARD = 4   # virtual data shards, fixed across world sizes
+ROUNDS = 6   # averaging rounds == checkpoint commits per rank
+WEDGE_RC = 41  # wedge-child: TransientDeviceError raised within deadline
+
+
+# -- trainer (runs inside `python -m paddle_tpu.distributed.launch`) --------
+
+def _shard_batch(shard):
+    """Shard ``shard``'s slice of the fixed global dataset, selected the
+    way a pod host would: a DistributedBatchSampler ranked by shard."""
+    import numpy as np
+
+    from paddle_tpu.io.dataset import TensorDataset
+    from paddle_tpu.io.sampler import DistributedBatchSampler
+
+    rng = np.random.RandomState(7)
+    x = rng.randn(8 * NSHARD, 4).astype(np.float32)
+    y = rng.randint(0, 2, size=(8 * NSHARD,)).astype(np.int64)
+    sampler = DistributedBatchSampler(TensorDataset([x, y]), batch_size=8,
+                                      num_replicas=NSHARD, rank=shard,
+                                      shuffle=False)
+    idx = [i for batch in sampler for i in batch]
+    return x[idx], y[idx]
+
+
+def _np_tree(state):
+    import numpy as np
+
+    return {k: np.asarray(v) for k, v in state.items()}
+
+
+def pod_trainer(workdir):
+    """Per-host body: each process owns NSHARD/world contiguous shards;
+    every round runs one SGD step per owned shard from the shared params,
+    gathers all per-shard results over the gang, and takes the
+    rank-ordered mean (localsgd with H=1 — a pure function of the round
+    params, so any world size folding the same shards in the same order
+    is bit-identical).  Checkpoints the averaged params every round;
+    resume negotiates the gang-wide min committed counter."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import nn, optimizer as popt
+    from paddle_tpu.distributed import env as denv
+    from paddle_tpu.distributed import heartbeat
+    from paddle_tpu.distributed.gang import default_gang, mean_trees
+    from paddle_tpu.incubate.checkpoint import AutoCheckpoint
+
+    from paddle_tpu.distributed.parallel import GANG_RESTART_EXIT_CODE
+    from paddle_tpu.framework.errors import TransientDeviceError
+
+    rank, world = denv.process_index(), denv.process_count()
+    with open(os.path.join(workdir, f"pid.p{rank}"), "w") as f:
+        f.write(str(os.getpid()))
+    assert NSHARD % world == 0, (NSHARD, world)
+    gang = default_gang("podsmoke")
+    k = NSHARD // world
+    shards = list(range(rank * k, (rank + 1) * k))
+    batches = {s: _shard_batch(s) for s in shards}
+
+    pt.seed(123)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    model = pt.Model(net, inputs=["x"], labels=["y"])
+    model.prepare(optimizer=popt.SGD(learning_rate=5e-2),
+                  loss=nn.CrossEntropyLoss())
+    acp = AutoCheckpoint(model, os.path.join(workdir, f"ck.p{rank}"),
+                         save_steps=1, async_save=False)
+    try:
+        # gang-consistent resume: hosts may disagree on the newest
+        # committed counter after a pod failure — agree on the min,
+        # rewind past it
+        agreed = gang.min_int(acp.latest_counter())
+        meta = acp.resume(at_most=agreed) if agreed > 0 else None
+        start = int(meta["global_step"]) if meta else 0
+        p = _np_tree(model.network.state_dict())
+        slow = float(os.environ.get("POD_SMOKE_SLEEP_S", "0") or 0)
+        for _round in range(start, ROUNDS):
+            local = []
+            for s in shards:
+                model.network.set_state_dict(p)
+                x, y = batches[s]
+                model.train_batch([x], [y])
+                local.append((s, _np_tree(model.network.state_dict())))
+                heartbeat.maybe_beat()
+            pairs = sorted((pair for contrib in gang.all_gather_obj(local)
+                            for pair in contrib), key=lambda kv: kv[0])
+            p = mean_trees([tree for _, tree in pairs])
+            model.network.set_state_dict(p)
+            acp.step(0)
+            end = time.monotonic() + slow  # widen the parent's kill window
+            while time.monotonic() < end:
+                heartbeat.maybe_beat()
+                time.sleep(0.05)
+        acp.close()
+        gang.barrier()
+    except TransientDeviceError:
+        # dead peer or abandoned generation: ask the watchdog for a
+        # gang restart — relaunch, rejoin, resume from the agreed counter
+        acp.close()
+        sys.exit(GANG_RESTART_EXIT_CODE)
+    np.savez(os.path.join(workdir, f"out.p{rank}.npz"), **p)
+    return 0
+
+
+def wedge_child(workdir):
+    """One collective with a wedged peer: the live ranks must get
+    TransientDeviceError within FLAGS_collective_timeout_s, not a hang."""
+    from paddle_tpu.distributed import env as denv
+    from paddle_tpu.distributed.gang import default_gang
+    from paddle_tpu.framework.errors import TransientDeviceError
+
+    rank = denv.process_index()
+    gang = default_gang("podsmoke-wedge")
+    t0 = time.monotonic()
+    try:
+        gang.barrier()
+    except TransientDeviceError:
+        elapsed = time.monotonic() - t0
+        with open(os.path.join(workdir, f"wedge.p{rank}.json"), "w") as f:
+            json.dump({"elapsed": elapsed}, f)
+        return WEDGE_RC
+    return 40  # the wedged rank (or a watchdog failure): no raise
+
+
+def serve_child(rank, rpc_dir, hb_dir):
+    """Engine host: export a tiny model, serve it over the shared-dir RPC
+    lane, and beat ``beat.p<rank>`` until the parent kills us."""
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.heartbeat import FileHeartbeat, gang_beat_path
+    from paddle_tpu.serving import Bucket, EngineServer, InferenceEngine
+
+    pt.seed(1234)
+    net = pt.nn.Sequential(pt.nn.Linear(8, 4))
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix = os.path.join(tmp, "m")
+        pt.inference.save_inference_model(
+            prefix, net, [pt.static.InputSpec([None, None, 8], "float32")])
+        eng = InferenceEngine(prefix, [Bucket(((4, 8),))],
+                              max_batch_size=4, max_queue_delay_ms=1.0)
+        eng.warmup()
+        srv = EngineServer(eng, rpc_dir, name=f"engine.p{rank}")
+        srv.start()
+        hb = FileHeartbeat(gang_beat_path(hb_dir, rank))
+        while True:  # parent SIGKILLs us; beats prove liveness until then
+            hb.beat()
+            time.sleep(0.1)
+
+
+# -- parent-side helpers ----------------------------------------------------
+
+def _child_env(workdir, world, rank, **extra):
+    env = dict(os.environ)
+    for var in ("COORDINATOR_ADDRESS", "PADDLE_TRAINER_ENDPOINTS",
+                "PADDLE_TPU_GANG_TRANSPORT", "PADDLE_TPU_METRICS_JSONL",
+                "POD_SMOKE_SLEEP_S", "FLAGS_fault_plan",
+                "FLAGS_collective_timeout_s"):
+        env.pop(var, None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": _REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TPU_GANG_DIR": os.path.join(workdir, "gang"),
+    })
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _launch_pod(workdir, world, mode, launch_flags=(), env_extra=None,
+                log_tag="pod"):
+    """Start one launch process per rank; returns the Popen list."""
+    os.makedirs(os.path.join(workdir, "gang"), exist_ok=True)
+    procs = []
+    for r in range(world):
+        env = _child_env(workdir, world, r, **dict(env_extra or {}))
+        log = open(os.path.join(workdir, f"{log_tag}.p{r}.log"), "wb")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             *launch_flags, SELF, mode, workdir],
+            env=env, stdout=log, stderr=subprocess.STDOUT))
+    return procs
+
+
+def _wait_all(procs, deadline_s):
+    t1 = time.time() + deadline_s
+    rcs = []
+    for p in procs:
+        try:
+            rcs.append(p.wait(timeout=max(1.0, t1 - time.time())))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+            rcs.append(-999)
+    return rcs
+
+
+def _committed(ckpt_dir):
+    from paddle_tpu.incubate.checkpoint import _META, _PREFIX
+
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return sorted(n for n in os.listdir(ckpt_dir)
+                  if n.startswith(_PREFIX)
+                  and os.path.exists(os.path.join(ckpt_dir, n, _META)))
+
+
+def _params(path):
+    import numpy as np
+
+    return dict(np.load(path))
+
+
+def _identical(a, b):
+    import numpy as np
+
+    return set(a) == set(b) and all(np.array_equal(a[k], b[k]) for k in a)
+
+
+# -- gates ------------------------------------------------------------------
+
+def gate_sharded_bit_identity(tmp):
+    """2-process sharded run == single-process run, bit for bit."""
+    wd2 = os.path.join(tmp, "bitid-w2")
+    os.makedirs(wd2)
+    rcs = _wait_all(_launch_pod(wd2, 2, "--pod-trainer"), 180)
+    if rcs != [0, 0]:
+        return {"pass": False, "error": f"world=2 rcs={rcs}"}, None
+    wd1 = os.path.join(tmp, "bitid-w1")
+    os.makedirs(wd1)
+    rcs = _wait_all(_launch_pod(wd1, 1, "--pod-trainer"), 180)
+    if rcs != [0]:
+        return {"pass": False, "error": f"world=1 rcs={rcs}"}, None
+    p0 = _params(os.path.join(wd2, "out.p0.npz"))
+    p1 = _params(os.path.join(wd2, "out.p1.npz"))
+    solo = _params(os.path.join(wd1, "out.p0.npz"))
+    ranks_agree = _identical(p0, p1)
+    matches_solo = _identical(p0, solo)
+    return {"pass": bool(ranks_agree and matches_solo),
+            "ranks_agree": bool(ranks_agree),
+            "matches_single_process": bool(matches_solo)}, p0
+
+
+def _restore_attempt(tmp, tag, sleep_s):
+    """One SIGKILL-mid-run attempt; returns (killed, rcs, wd, metrics)."""
+    wd = os.path.join(tmp, tag)
+    os.makedirs(wd)
+    metrics = os.path.join(wd, "metrics.jsonl")
+    procs = _launch_pod(
+        wd, 2, "--pod-trainer",
+        launch_flags=["--max-restarts=4", "--peer-timeout=3"],
+        env_extra={"PADDLE_TPU_METRICS_JSONL": metrics,
+                   "POD_SMOKE_SLEEP_S": sleep_s},
+        log_tag="restore")
+    ck1 = os.path.join(wd, "ck.p1")
+    deadline = time.time() + 120
+    killed = False
+    try:
+        while time.time() < deadline:
+            if len(_committed(ck1)) >= 2:
+                with open(os.path.join(wd, "pid.p1")) as f:
+                    os.kill(int(f.read()), signal.SIGKILL)
+                killed = True
+                break
+            if any(p.poll() is not None for p in procs):
+                break  # a watchdog died before the kill window
+            time.sleep(0.02)
+        rcs = _wait_all(procs, 180)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return killed, rcs, wd, metrics
+
+
+def gate_gang_restore(tmp, ref):
+    """SIGKILL one host mid-run: gang restores, training finishes
+    bit-identical to the uninterrupted run, metrics JSONL merges."""
+    # on a starved machine the run can finish before this process ever
+    # observes the kill window — slow the trainer's inter-round sleep and
+    # retry rather than failing on gate-side scheduling noise
+    killed = False
+    for sleep_s in ("0.4", "1.0", "2.0"):
+        killed, rcs, wd, metrics = _restore_attempt(
+            tmp, f"restore-{sleep_s}", sleep_s)
+        if killed or rcs != [0, 0]:
+            break
+    if not killed:
+        return {"pass": False, "error": f"no kill window (rcs={rcs})"}
+    if rcs != [0, 0]:
+        return {"pass": False, "error": f"watchdog rcs={rcs}"}
+    from paddle_tpu.observability.exporters import merge_jsonl
+
+    merged = merge_jsonl(metrics, os.path.join(wd, "merged.jsonl"))
+    per_rank = {}
+    for rec in merged:
+        r = rec.get("process_index")
+        per_rank[r] = per_rank.get(r, 0) + 1
+    restores = sum(rec.get("gang_restores", 0) for rec in merged
+                   if rec.get("kind") == "gang_watch")
+    identical = (_identical(_params(os.path.join(wd, "out.p0.npz")), ref)
+                 and _identical(_params(os.path.join(wd, "out.p1.npz")), ref))
+    ok = (identical and restores >= 1
+          and per_rank.get(0, 0) >= 1 and per_rank.get(1, 0) >= 1)
+    return {"pass": bool(ok),
+            "final_params_bit_identical": bool(identical),
+            "gang_restores": restores,
+            "merged_records_per_rank": {str(k): v
+                                        for k, v in per_rank.items()}}
+
+
+def gate_wedged_gang(tmp):
+    """3 ranks; rank 2 wedged at gang.collective by a latency fault: both
+    live ranks raise TransientDeviceError within the armed deadline."""
+    wd = os.path.join(tmp, "wedge")
+    os.makedirs(wd)
+    os.makedirs(os.path.join(wd, "gang"), exist_ok=True)
+    procs = []
+    for r in range(3):
+        extra = {"FLAGS_collective_timeout_s": "2"}
+        if r == 2:
+            extra["FLAGS_fault_plan"] = \
+                "site=gang.collective,nth=1,latency_ms=120000"
+        env = _child_env(wd, 3, r, **extra)
+        log = open(os.path.join(wd, f"wedge.p{r}.log"), "wb")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             SELF, "--wedge-child", wd],
+            env=env, stdout=log, stderr=subprocess.STDOUT))
+    rcs = _wait_all(procs[:2], 90)  # the live ranks
+    procs[2].kill()
+    procs[2].wait()
+    elapsed = []
+    for r in range(2):
+        try:
+            with open(os.path.join(wd, f"wedge.p{r}.json")) as f:
+                elapsed.append(json.load(f)["elapsed"])
+        except OSError:
+            elapsed.append(None)
+    within = all(e is not None and e < 10.0 for e in elapsed)
+    ok = rcs == [WEDGE_RC, WEDGE_RC] and within
+    return {"pass": bool(ok), "live_rank_rcs": rcs,
+            "raised_within_deadline": bool(within),
+            "seconds": [round(e, 2) if e is not None else None
+                        for e in elapsed]}
+
+
+def gate_router_failover(tmp):
+    """Router fronting engines in two other processes; SIGKILL one host
+    mid-traffic: zero lost accepted requests, peer_evictions >= 1."""
+    import numpy as np
+
+    from paddle_tpu.distributed.heartbeat import PeerHeartbeatMonitor
+    from paddle_tpu.serving import RemoteEngineProxy, Router
+
+    wd = os.path.join(tmp, "router")
+    rpc, hb = os.path.join(wd, "rpc"), os.path.join(wd, "hb")
+    for d in (rpc, hb):
+        os.makedirs(d)
+    kids = []
+    for r in (1, 2):
+        log = open(os.path.join(wd, f"serve.p{r}.log"), "wb")
+        kids.append(subprocess.Popen(
+            [sys.executable, SELF, "--serve-child", str(r), rpc, hb],
+            env=_child_env(wd, 1, 0), stdout=log, stderr=subprocess.STDOUT))
+    mon = router = None
+    lost = completed = evictions = 0
+    try:
+        proxies = [RemoteEngineProxy(rpc, f"engine.p{r}", timeout_s=2.0)
+                   for r in (1, 2)]
+        for pr in proxies:
+            pr.synthetic_inputs()  # blocks until the hello file lands
+        mon = PeerHeartbeatMonitor(hb, world=3, self_rank=0,
+                                   timeout=1.5, interval=0.1).start()
+        router = Router(proxies, probe_interval_s=0.3, probe_timeout_s=5.0,
+                        close_engines=False)
+        router.bind_peer_liveness(mon, {0: 1, 1: 2})
+        x = np.zeros((3, 8), np.float32)
+        for _ in range(10):  # warm traffic over both hosts
+            router.infer([x], timeout=30)
+            completed += 1
+        kids[1].send_signal(signal.SIGKILL)  # kill engine host rank 2
+        kids[1].wait()
+        t_end = time.monotonic() + 12
+        while time.monotonic() < t_end:
+            try:
+                router.infer([x], timeout=30)
+                completed += 1
+            except Exception:  # noqa: BLE001 — a lost accepted request
+                lost += 1
+            evictions = router.metrics.snapshot().get("peer_evictions", 0)
+            if evictions >= 1 and completed >= 30:
+                break
+        ok = lost == 0 and evictions >= 1 and completed >= 20
+        return {"pass": bool(ok), "lost_accepted_requests": lost,
+                "completed": completed, "peer_evictions": int(evictions)}
+    finally:
+        for kid in kids:
+            if kid.poll() is None:
+                kid.kill()
+                kid.wait()
+        if router is not None:
+            router.close()
+        if mon is not None:
+            mon.stop()
+        for pr in proxies:
+            pr.close()
+
+
+def gate_f803(tmp):
+    """Injected gang-restart loop → storm exit 77 + F803; healthy watched
+    run → exit 0 and F803 silent."""
+    from paddle_tpu.analysis import RetraceMonitor
+    from paddle_tpu.distributed.parallel import (RESTART_STORM_EXIT_CODE,
+                                                 watch)
+
+    class _AlwaysLost:
+        def lost_workers(self):
+            return (1,)
+
+        def rearm(self, grace=None):
+            pass
+
+    class _NeverLost:
+        def lost_workers(self):
+            return ()
+
+    with RetraceMonitor() as monitor:
+        rc_storm = watch([sys.executable, "-c", "import time; time.sleep(60)"],
+                         _sleep=0.05, storm_window=30, storm_restarts=3,
+                         peer_monitor=_AlwaysLost(),
+                         gang_label="podsmoke.storm")
+        rc_ok = watch([sys.executable, "-c", "pass"],
+                      peer_monitor=_NeverLost(), gang_label="podsmoke.ok")
+    f803 = [d for d in monitor.diagnostics() if d.rule == "F803"]
+    fired_on_storm = any("podsmoke.storm" in (d.location.file or "")
+                         for d in f803)
+    silent_on_healthy = not any("podsmoke.ok" in (d.location.file or "")
+                                for d in f803)
+    ok = (rc_storm == RESTART_STORM_EXIT_CODE and rc_ok == 0
+          and fired_on_storm and silent_on_healthy)
+    return {"pass": bool(ok), "storm_rc": rc_storm, "healthy_rc": rc_ok,
+            "f803_fired_on_storm": bool(fired_on_storm),
+            "f803_silent_on_healthy": bool(silent_on_healthy)}
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--pod-trainer":
+        return pod_trainer(sys.argv[2])
+    if len(sys.argv) > 1 and sys.argv[1] == "--wedge-child":
+        return wedge_child(sys.argv[2])
+    if len(sys.argv) > 1 and sys.argv[1] == "--serve-child":
+        return serve_child(int(sys.argv[2]), sys.argv[3], sys.argv[4])
+
+    t0 = time.time()
+    with tempfile.TemporaryDirectory() as tmp:
+        bitid, ref = gate_sharded_bit_identity(tmp)
+        if not bitid["pass"]:
+            gates = {"sharded_bit_identity": bitid}
+            print(json.dumps({"pass": False, **gates,
+                              "seconds": round(time.time() - t0, 1)}))
+            return 1
+        restore = gate_gang_restore(tmp, ref)
+        wedge = gate_wedged_gang(tmp)
+        router = gate_router_failover(tmp)
+        f803 = gate_f803(tmp)
+    gates = {"sharded_bit_identity": bitid, "gang_restore": restore,
+             "wedged_gang": wedge, "router_failover": router, "f803": f803}
+    passed = all(g["pass"] for g in gates.values())
+    print(json.dumps({"pass": bool(passed), **gates,
+                      "seconds": round(time.time() - t0, 1)}))
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,9 +23,10 @@
 #   quant-smoke tools/quant_smoke.py (int8/fp8 serving: margin-accounted tokens, equal-HBM slots, quantized rolling swap)
 #   slo-smoke tools/slo_smoke.py (request tracing end-to-end + SLO burn-rate alert)
 #   elastic-smoke tools/elastic_smoke.py (NaN rollback + exact resume + collective watchdog)
+#   pod-smoke tools/pod_smoke.py (N-process gang: sharded bit identity, SIGKILL -> gang restore, wedge watchdog, router failover, F803)
 #   bench   python bench.py          (only when a real TPU answers)
 #
-# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|tune-smoke|scenario-smoke|moe-smoke|chaos-smoke|obs-smoke|router-smoke|gen-smoke|tenancy-smoke|quant-smoke|slo-smoke|elastic-smoke|bench]...
+# Usage:  tools/run_gates.sh [--skip analyze|fast|suite|audit|dryrun|perf-smoke|serving-smoke|kernel-smoke|tune-smoke|scenario-smoke|moe-smoke|chaos-smoke|obs-smoke|router-smoke|gen-smoke|tenancy-smoke|quant-smoke|slo-smoke|elastic-smoke|pod-smoke|bench]...
 #         tools/run_gates.sh --only suite
 # Exit code: 0 iff every stage that ran passed.
 set -u
@@ -173,6 +174,14 @@ run_stage slo-smoke env JAX_PLATFORMS=cpu python tools/slo_smoke.py
 # wedged collective -> watchdog raises within the deadline, F802 on a
 # rollback loop, disabled supervisor is a plain loop
 run_stage elastic-smoke env JAX_PLATFORMS=cpu python tools/elastic_smoke.py
+# pod-scale multi-host: N real processes through distributed.launch —
+# sharded-data training bit-identical to single-process, SIGKILLed host ->
+# gang restore from the agreed checkpoint with bit-identical finals,
+# wedged collective -> TransientDeviceError on every live rank within the
+# deadline, Router fronting cross-process engines loses zero accepted
+# requests across a host kill, F803 on a restore storm (per-process
+# metrics JSONL merged via exporters.merge_jsonl)
+run_stage pod-smoke env JAX_PLATFORMS=cpu python tools/pod_smoke.py
 
 # bench only when a real accelerator answers within 60s
 if want bench; then
